@@ -1,0 +1,118 @@
+"""Benchmark driver: the BASELINE.json north star.
+
+OTR one-third-rule consensus, n processes × S HO-fault scenarios, lockstep
+batched rounds on one chip.  Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "rounds/sec", "vs_baseline": N}
+
+"rounds/sec" = full-batch round steps per second (all S scenarios × n lanes
+advance one round).  vs_baseline is against the 100 rounds/sec/chip target
+(BASELINE.md): value/100.
+
+Scenario micro-batching: scenarios are processed in chunks under lax.map so
+the [chunk, n, n] delivery/count tensors stay within HBM while the full 10k
+scenario batch runs in one jitted call.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+if "--platform" in sys.argv:
+    # must happen before any backend use; env-var-only selection is unreliable
+    # when an accelerator PJRT plugin is pre-registered by sitecustomize
+    jax.config.update(
+        "jax_platforms", sys.argv[sys.argv.index("--platform") + 1]
+    )
+
+from round_tpu.engine.executor import run_instance
+from round_tpu.engine import scenarios
+from round_tpu.models.otr import OTR
+from round_tpu.models.common import consensus_io
+
+
+def make_bench(n, n_scenarios, chunk, phases, n_values, p_drop):
+    algo = OTR(after_decision=2)
+    sampler = scenarios.omission(n, p_drop)
+
+    def run_chunk(keys):  # [chunk] keys -> chunk results
+        def one(k):
+            k_init, k_run = jax.random.split(k)
+            init = jax.random.randint(k_init, (n,), 0, n_values, dtype=jnp.int32)
+            res = run_instance(
+                algo, consensus_io(init), n, k_run, sampler, max_phases=phases
+            )
+            return res.state.decided, res.decided_round
+
+        return jax.vmap(one)(keys)
+
+    @jax.jit
+    def bench(key):
+        keys = jax.random.split(key, n_scenarios).reshape(
+            n_scenarios // chunk, chunk, 2
+        )
+        decided, dec_round = jax.lax.map(run_chunk, keys)
+        return decided.reshape(-1, n), dec_round.reshape(-1, n)
+
+    return bench
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1024)
+    ap.add_argument("--scenarios", type=int, default=10_000)
+    ap.add_argument("--chunk", type=int, default=50)
+    ap.add_argument("--phases", type=int, default=10)
+    ap.add_argument("--values", type=int, default=16, help="initial-value domain size")
+    ap.add_argument("--p-drop", type=float, default=0.05)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--platform", type=str, default=None, help="override jax platform (e.g. cpu)")
+    args = ap.parse_args()
+
+    if args.scenarios < 1:
+        raise SystemExit("--scenarios must be >= 1")
+    # clamp chunk, then round the scenario count to a whole number of chunks
+    args.chunk = max(1, min(args.chunk, args.scenarios))
+    S = (args.scenarios // args.chunk) * args.chunk
+    bench = make_bench(args.n, S, args.chunk, args.phases, args.values, args.p_drop)
+
+    key = jax.random.PRNGKey(0)
+    decided, dec_round = jax.block_until_ready(bench(key))  # compile + warmup
+
+    best = None
+    for i in range(args.repeats):
+        t0 = time.perf_counter()
+        decided, dec_round = jax.block_until_ready(bench(jax.random.PRNGKey(i)))
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+
+    total_rounds = args.phases  # rounds per phase == 1 for OTR
+    rounds_per_sec = total_rounds / best
+
+    # health stats (not part of the metric line)
+    frac_decided = float(jnp.mean(decided.astype(jnp.float32)))
+    dr = dec_round[decided]
+    p50 = float(jnp.median(dr)) if dr.size else -1.0
+
+    result = {
+        "metric": f"otr_n{args.n}_s{S}_rounds_per_sec",
+        "value": round(rounds_per_sec, 3),
+        "unit": "rounds/sec",
+        "vs_baseline": round(rounds_per_sec / 100.0, 3),
+        "extra": {
+            "wall_s_per_run": round(best, 3),
+            "rounds_per_run": total_rounds,
+            "frac_lanes_decided": round(frac_decided, 4),
+            "decided_round_p50": p50,
+            "n": args.n,
+            "scenarios": S,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""round_tpu — a TPU-native framework for round-based distributed algorithms.
+
+A from-scratch re-design of the capabilities of PSync (dzufferey/round): users
+write fault-tolerant distributed algorithms in the round-based Heard-Of (HO)
+model, and the framework *executes* them — not over sockets, but as batched,
+jit-compiled tensor programs on TPU:
+
+  - one simulated process  = one vmap lane       (reference: one JVM + Netty)
+  - one round              = one jitted step     (reference: InstanceHandler hot loop)
+  - the mailbox            = a masked [n, n] tensor exchange
+                                                 (reference: Kryo packets over UDP/TCP)
+  - one fault scenario     = one batch lane      (reference: one shell-script run)
+  - multi-chip             = jax.sharding Mesh over scenario/process axes
+                                                 (reference: multiple hosts)
+
+The HO model makes this equivalence sound: communication-closed rounds mean an
+asynchronous execution is indistinguishable from a lockstep one with the right
+HO sets (who heard from whom).  Faults, timeouts, partitions and byzantine
+behavior all become families of HO masks.
+
+Layout (mirrors SURVEY.md §2's component inventory):
+  core/      Time/Instance arithmetic, Progress lattice, Round/Process/Algorithm DSL
+  ops/       mailbox reductions + the exchange kernel (the "network")
+  engine/    the scan-based executor and HO-scenario generators
+  models/    the algorithm library (OTR, LastVoting, BenOr, ...)
+  spec/      the specification DSL (forall/exists/filter -> masked reductions)
+  parallel/  device-mesh sharding of scenario and process axes
+  runtime/   instances, config, stats, checkpointing, decision logs
+  verification/  formula AST + VC generation + SMT-LIB bridge (offline)
+"""
+
+__version__ = "0.1.0"
+
+from round_tpu.core.time import Time
+from round_tpu.core.progress import Progress
+from round_tpu.core.rounds import Round, RoundCtx, SendSpec, broadcast, unicast, silence
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.ops.mailbox import Mailbox
+
+__all__ = [
+    "Time",
+    "Progress",
+    "Round",
+    "RoundCtx",
+    "SendSpec",
+    "broadcast",
+    "unicast",
+    "silence",
+    "Algorithm",
+    "Mailbox",
+]

@@ -1,0 +1,17 @@
+from round_tpu.core.time import Time, Instance
+from round_tpu.core.progress import Progress
+from round_tpu.core.rounds import Round, RoundCtx, SendSpec, broadcast, unicast, silence
+from round_tpu.core.algorithm import Algorithm
+
+__all__ = [
+    "Time",
+    "Instance",
+    "Progress",
+    "Round",
+    "RoundCtx",
+    "SendSpec",
+    "broadcast",
+    "unicast",
+    "silence",
+    "Algorithm",
+]

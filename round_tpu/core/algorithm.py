@@ -1,0 +1,51 @@
+"""Algorithm: ties a phase of Rounds to an initial state and a spec.
+
+Reference parity: psync Algorithm.scala (Algorithm base + instance pool) and
+Process.scala (user process = vars + init + rounds).  Here "vars" are the
+fields of a state pytree (one flax.struct dataclass per algorithm), "init" is
+a per-lane pure function and "rounds" is a static tuple — the phase executes
+round-robin, exactly like RtProcess.incrementRound (Process.scala:53-59).
+
+Instances/pooling (Algorithm.scala:59-86) have no analogue here: starting an
+instance is just calling the engine; *many* instances are a batch axis
+(runtime/instances.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+from round_tpu.core.rounds import Round, RoundCtx
+
+
+class Algorithm:
+    """Base class for round-based algorithms.
+
+    Subclasses define:
+      rounds: tuple[Round, ...] — the phase (executed round-robin).
+      make_init_state(ctx, io) -> state: per-lane initial state from the
+        per-lane io pytree (reference: Process.init(io)).
+      decided(state) / decision(state): accessors the engine and spec layer
+        use to extract decision traces (reference: the decide callback).
+      spec: optional Spec object (spec/dsl.py) for invariant checking.
+    """
+
+    rounds: Tuple[Round, ...] = ()
+    spec = None
+
+    @property
+    def rounds_per_phase(self) -> int:
+        return len(self.rounds)
+
+    def make_init_state(self, ctx: RoundCtx, io: Any):
+        raise NotImplementedError
+
+    # -- decision extraction (override per algorithm) ----------------------
+
+    def decided(self, state):
+        """[n] bool — which lanes have decided. Override."""
+        raise NotImplementedError
+
+    def decision(self, state):
+        """[n] values — the decided value per lane (garbage where undecided)."""
+        raise NotImplementedError

@@ -1,0 +1,117 @@
+"""The Round DSL: how users express one communication-closed round.
+
+A round is a pair of *pure, per-lane* functions over the process state:
+
+  - ``send(ctx, state) -> SendSpec``: what this process sends and to whom.
+  - ``update(ctx, state, mailbox) -> state``: fold the received messages into
+    the local state.  Termination is signalled with ``ctx.exit_at_end_of_round()``.
+
+The engine vmaps these over the process axis and again over the fault-scenario
+axis, so user code reads like the reference's per-process DSL (one process's
+view of one round) while executing as one fused tensor program per round.
+
+Reference parity: psync Round.scala:18-71 (Round: send/update/mailbox/
+exitAtEndOfRound), Round.scala:102-104 (broadcast helper).  Unlike the
+reference there is no serialization: payloads are pytrees of arrays, and the
+"wire" is the exchange kernel in ops/exchange.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from round_tpu.core.progress import Progress
+
+
+class RoundCtx:
+    """Per-lane execution context handed to ``send``/``update``/``init``.
+
+    Attributes:
+      id:  this process's id (traced int32 scalar; one vmap lane per process).
+      n:   group size (static Python int for a fixed group).
+      r:   current round number (traced int32 scalar, wrap-around Time).
+      rng: a PRNG key unique to (scenario, process, round) — e.g. BenOr's coin.
+    """
+
+    def __init__(self, id, n, r, rng=None):  # noqa: A002 - mirrors reference naming
+        self.id = id
+        self.n = n
+        self.r = r
+        self.rng = rng
+        self._exit = jnp.asarray(False)
+
+    def exit_at_end_of_round(self, when=True):
+        """Terminate this process's instance after the current round.
+
+        ``when`` may be a traced boolean (data-dependent exit becomes a lane
+        mask, not control flow).  Mirrors Round.scala:42-44.
+        """
+        self._exit = jnp.logical_or(self._exit, when)
+
+
+@jax.tree_util.register_pytree_node_class
+class SendSpec:
+    """What one process emits in a round: one payload + a destination mask.
+
+    ``payload`` is a pytree of arrays (this lane's message value — the same
+    value goes to every selected destination, exactly like the reference's
+    ``Map[ProcessID, A]`` built by ``broadcast``/point-to-point sends).
+    ``dest_mask`` is a ``[n]`` bool vector: dest_mask[d] == this process sends
+    to d this round.
+    """
+
+    def __init__(self, payload: Any, dest_mask: jnp.ndarray):
+        self.payload = payload
+        self.dest_mask = dest_mask
+
+    def tree_flatten(self):
+        return ((self.payload, self.dest_mask), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def broadcast(ctx: RoundCtx, payload: Any, guard=True) -> SendSpec:
+    """Send ``payload`` to everyone (including self).  Round.scala:102-104."""
+    mask = jnp.broadcast_to(jnp.asarray(guard), (ctx.n,))
+    return SendSpec(payload, mask)
+
+
+def unicast(ctx: RoundCtx, dest, payload: Any, guard=True) -> SendSpec:
+    """Send ``payload`` to the single process ``dest`` (e.g. the coordinator)."""
+    mask = (jnp.arange(ctx.n) == dest) & jnp.asarray(guard)
+    return SendSpec(payload, mask)
+
+
+def silence(ctx: RoundCtx, payload_like: Any) -> SendSpec:
+    """Send nothing.  A payload of the round's type is still required so every
+    lane produces identically-shaped arrays (XLA static shapes)."""
+    return SendSpec(payload_like, jnp.zeros((ctx.n,), dtype=bool))
+
+
+class Round:
+    """One communication-closed round.  Subclass and implement send/update.
+
+    Class attributes:
+      init_progress: the round's progress policy (Progress). In the batched
+        simulator this selects the HO-family semantics (timeout rounds can
+        lose messages; strict-wait rounds cannot); kept for API parity with
+        Round.scala:25.
+    """
+
+    init_progress: Progress = Progress.timeout(10)
+
+    def send(self, ctx: RoundCtx, state) -> SendSpec:
+        raise NotImplementedError
+
+    def update(self, ctx: RoundCtx, state, mailbox):
+        raise NotImplementedError
+
+    def expected_nbr_messages(self, ctx: RoundCtx, state):
+        """Early-exit hint (Round.scala:33-35). Unused by the lockstep engine,
+        used by the host event-round runtime."""
+        return ctx.n

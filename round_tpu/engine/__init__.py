@@ -1,0 +1,4 @@
+from round_tpu.engine.executor import run_instance, simulate, RunResult
+from round_tpu.engine import scenarios
+
+__all__ = ["run_instance", "simulate", "RunResult", "scenarios"]

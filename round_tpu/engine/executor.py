@@ -1,0 +1,272 @@
+"""The round engine: lockstep execution of an Algorithm as one scanned program.
+
+This is the TPU-native replacement for the reference's InstanceHandler hot
+loop (InstanceHandler.scala:164-258): where the JVM runtime interleaves
+per-process threads, blocking inboxes, timeouts and catch-up, the HO model
+lets us run all processes lockstep — asynchrony, faults and timeouts are
+absorbed into the HO masks a round executes against (SURVEY.md §2.9).
+
+Execution shape:
+  - per-lane user functions are vmapped over the process axis,
+  - one round = send → exchange → update (one fused XLA computation),
+  - a phase = the algorithm's round tuple, unrolled (k is small and static),
+  - the run = lax.scan over phases (fixed horizon; `done` lanes freeze),
+  - scenarios = an outer vmap (simulate()),
+  - chips = shard the scenario/process axes (parallel/mesh.py), which reuses
+    this module's round core through a Topology object so single-chip and
+    sharded execution cannot drift apart.
+
+PRNG discipline: every scenario key is split once into (ho_key, upd_key).
+`ho_key` is handed to the HO sampler *unchanged* every round, so fault sets
+that must be scenario-constant (crash sets, partitions, byzantine membership)
+stay constant; samplers derive per-round randomness themselves by folding in
+the round number.  `upd_key` is folded with the round for per-(lane, round)
+algorithm randomness (BenOr's coin).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import RoundCtx
+from round_tpu.ops.mailbox import Mailbox
+from round_tpu.utils.tree import tree_where
+
+HoSampler = Callable[[jax.Array, jnp.ndarray], jnp.ndarray]  # (key, r) -> [n,n] bool
+
+
+class LocalTopology:
+    """All n lanes live on this chip; gathers are identity."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self.n_local = n
+
+    def lane_ids(self) -> jnp.ndarray:
+        return jnp.arange(self.n, dtype=jnp.int32)
+
+    def gather(self, tree: Any) -> Any:
+        """Make per-lane outputs visible to every receiver (identity here;
+        an ICI all_gather in the proc-sharded topology)."""
+        return tree
+
+    def ho_rows(self, ho: jnp.ndarray) -> jnp.ndarray:
+        """This chip's receiver rows of the full [n, n] HO matrix."""
+        return ho
+
+    def dest_cols(self, dest: jnp.ndarray) -> jnp.ndarray:
+        """[n_local, n]: dest_mask[i, j] transposed to local receiver rows."""
+        return dest.T
+
+    def lane_keys(self, key: jax.Array) -> jax.Array:
+        return jax.random.split(key, self.n)
+
+
+def run_round(rnd, state, done, r, ho, key, topo):
+    """Execute one communication-closed round on this chip's lane slice.
+
+    `topo` abstracts where lanes live (LocalTopology above, or
+    parallel.mesh.ProcShardTopology for the proc-sharded multi-chip path);
+    everything else — the send/exchange/update semantics — is shared.
+    """
+    n = topo.n
+    ids = topo.lane_ids()
+    active_local = jnp.logical_not(done)
+
+    # send: per-lane -> payload [n_local, ...], dest_mask [n_local, n]
+    def _send(i, s):
+        ctx = RoundCtx(id=i, n=n, r=r)
+        spec = rnd.send(ctx, s)
+        return spec.payload, spec.dest_mask
+
+    payload_loc, dest_loc = jax.vmap(_send)(ids, state)
+
+    # the wire: make all senders visible, then one masked transpose
+    payload = topo.gather(payload_loc)
+    dest = topo.gather(dest_loc)
+    active = topo.gather(active_local)
+    deliver = topo.ho_rows(ho) & topo.dest_cols(dest) & active[None, :]
+
+    # update: per-lane fold of the mailbox into the state
+    upd_keys = topo.lane_keys(key)
+
+    def _update(i, s, mbox_mask, k):
+        ctx = RoundCtx(id=i, n=n, r=r, rng=k)
+        s2 = rnd.update(ctx, s, Mailbox(payload, mbox_mask))
+        return s2, ctx._exit
+
+    new_state, exit_flags = jax.vmap(_update)(ids, state, deliver, upd_keys)
+
+    # frozen lanes keep their state; exits only count for active lanes
+    state = tree_where(active_local, new_state, state)
+    done = jnp.logical_or(done, jnp.logical_and(active_local, exit_flags))
+    return state, done
+
+
+def _decided_or_false(algo: Algorithm, state, n_local: int):
+    try:
+        return algo.decided(state)
+    except NotImplementedError:
+        return jnp.zeros((n_local,), dtype=bool)
+
+
+def init_lanes(algo: Algorithm, io: Any, n: int, topo) -> Any:
+    """vmap the per-lane init over this chip's lane slice of the io pytree."""
+
+    def _init(i, io_lane):
+        ctx = RoundCtx(id=i, n=n, r=jnp.int32(0))
+        return algo.make_init_state(ctx, io_lane)
+
+    return jax.vmap(_init)(topo.lane_ids(), io)
+
+
+def run_phases(
+    algo: Algorithm,
+    state0: Any,
+    key: jax.Array,
+    ho_sampler: HoSampler,
+    max_phases: int,
+    topo,
+    record_fn: Optional[Callable[[Any, jnp.ndarray, jnp.ndarray], Any]] = None,
+):
+    """Scan `max_phases` phases over an initialized lane slice.  Shared by the
+    single-chip and proc-sharded paths."""
+    k_rounds = algo.rounds_per_phase
+    assert k_rounds >= 1, "algorithm has no rounds"
+    n_local = topo.n_local
+
+    done0 = jnp.zeros((n_local,), dtype=bool)
+    decided_round0 = jnp.full((n_local,), -1, dtype=jnp.int32)
+    ho_key, upd_key = jax.random.split(key)
+
+    def phase_step(carry, phase_idx):
+        state, done, decided_round = carry
+        recs = []
+        for j, rnd in enumerate(algo.rounds):
+            r = (phase_idx * k_rounds + j).astype(jnp.int32)
+            # ho_key is round-invariant (see module docstring); per-round
+            # algorithm randomness comes from folding the round into upd_key.
+            ho = ho_sampler(ho_key, r)
+            k_upd = jax.random.fold_in(upd_key, r)
+            state, done = run_round(rnd, state, done, r, ho, k_upd, topo)
+            dec = _decided_or_false(algo, state, n_local)
+            decided_round = jnp.where(dec & (decided_round < 0), r, decided_round)
+            if record_fn is not None:
+                recs.append(record_fn(state, done, r))
+        out = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *recs) if recs else None
+        return (state, done, decided_round), out
+
+    (state, done, decided_round), recorded = jax.lax.scan(
+        phase_step, (state0, done0, decided_round0), jnp.arange(max_phases)
+    )
+
+    if recorded is not None:
+        # [phases, k, ...] -> [rounds, ...]
+        recorded = jax.tree_util.tree_map(
+            lambda x: x.reshape((max_phases * k_rounds,) + x.shape[2:]), recorded
+        )
+    return state, done, decided_round, recorded
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("state", "done", "decided_round", "recorded"),
+    meta_fields=("rounds_run",),
+)
+@dataclasses.dataclass
+class RunResult:
+    """Outcome of one (or a batch of) simulated instance(s).
+
+    state:         final state pytree ([n, ...] per leaf; [S, n, ...] batched)
+    done:          [n] bool — lanes that exited (exitAtEndOfRound)
+    decided_round: [n] int32 — first round where `algo.decided` flipped, else -1
+    rounds_run:    total rounds executed (static)
+    recorded:      stacked per-round outputs of record_fn, if any ([T, ...])
+    """
+
+    state: Any
+    done: jnp.ndarray
+    decided_round: jnp.ndarray
+    rounds_run: int
+    recorded: Any = None
+
+
+def run_instance(
+    algo: Algorithm,
+    io: Any,
+    n: int,
+    key: jax.Array,
+    ho_sampler: HoSampler,
+    max_phases: int,
+    record_fn: Optional[Callable[[Any, jnp.ndarray, jnp.ndarray], Any]] = None,
+) -> RunResult:
+    """Run one instance (one fault scenario) for `max_phases` phases.
+
+    Args:
+      algo: the Algorithm (rounds + init).
+      io: per-lane input pytree, leaves [n, ...] (reference: the IO object
+        handed to Process.init, e.g. initial values).
+      n: number of processes. No n<64 cap — the reference's LongBitSet limit
+        (InstanceHandler.scala:116) does not exist here.
+      key: PRNG key for this scenario (HO draws + algorithm randomness).
+      ho_sampler: (key, r) -> [n, n] bool HO mask for round r.
+      max_phases: scan horizon, in phases (phases × rounds_per_phase rounds).
+      record_fn: optional (state, done, r) -> pytree, recorded every round.
+    """
+    topo = LocalTopology(n)
+    state0 = init_lanes(algo, io, n, topo)
+    state, done, decided_round, recorded = run_phases(
+        algo, state0, key, ho_sampler, max_phases, topo, record_fn
+    )
+    return RunResult(
+        state=state,
+        done=done,
+        decided_round=decided_round,
+        rounds_run=max_phases * algo.rounds_per_phase,
+        recorded=recorded,
+    )
+
+
+def simulate(
+    algo: Algorithm,
+    io: Any,
+    n: int,
+    key: jax.Array,
+    ho_sampler: HoSampler,
+    max_phases: int,
+    n_scenarios: int = 1,
+    record_fn=None,
+    jit: bool = True,
+    io_batched: Optional[bool] = None,
+) -> RunResult:
+    """Batch `n_scenarios` independent fault scenarios (the second batch axis).
+
+    `io` leaves may be [n, ...] (shared across scenarios) or [S, n, ...]
+    (per-scenario; pass io_batched=True to disambiguate when S == n).
+    Replaces the reference's repeated shell-script trials (test_scripts/*.sh)
+    with one vmapped run.
+    """
+    keys = jax.random.split(key, n_scenarios)
+
+    if io_batched is None:
+        shared_io = all(
+            jnp.ndim(leaf) >= 1 and jnp.shape(leaf)[0] == n
+            for leaf in jax.tree_util.tree_leaves(io)
+        )
+    else:
+        shared_io = not io_batched
+
+    def _one(io_s, k):
+        return run_instance(algo, io_s, n, k, ho_sampler, max_phases, record_fn)
+
+    io_axis = None if shared_io else 0
+    fn = jax.vmap(_one, in_axes=(io_axis, 0))
+    if jit:
+        fn = jax.jit(fn)
+    return fn(io, keys)

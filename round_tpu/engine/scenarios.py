@@ -1,0 +1,171 @@
+"""HO-mask families: the fault model as data.
+
+In the HO model every fault — crashes, message loss, partitions, a slow
+coordinator, byzantine silence — manifests as the *heard-of* sets HO(j) ⊆ P:
+who j receives from in a round.  The reference produces these implicitly
+(timeouts dropping packets, killed JVMs in test_scripts/oneDown*.sh); here
+they are explicit samplers `(key, r) -> ho[n, n]` so thousands of adversarial
+schedules run as one batch.
+
+Conventions: ho[j, i] = "j hears from i".  Self-delivery (ho[j, j]) is kept
+True by every family — the reference short-circuits self-messages past the
+network (Round.scala:114-117), so a process always hears itself.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def _with_self(ho: jnp.ndarray) -> jnp.ndarray:
+    n = ho.shape[-1]
+    eye = jnp.eye(n, dtype=bool)
+    return ho | eye
+
+
+def full(n: int) -> Callable:
+    """Synchronous fault-free network: everyone hears everyone."""
+
+    def sample(key, r):
+        return jnp.ones((n, n), dtype=bool)
+
+    return sample
+
+
+def crash(n: int, f: int) -> Callable:
+    """f crash-stop processes, chosen per scenario (from the key), silent from
+    round 0.  The batched analogue of test_scripts/oneDownOTR.sh (starting
+    only 2-of-3 replicas)."""
+
+    def sample(key, r):
+        # crashed set depends only on the scenario (fold in a constant, not r)
+        k = jax.random.fold_in(key, 0x5EED)
+        crashed = jax.random.permutation(k, n) < f  # [n] bool, f crashed
+        ho = jnp.ones((n, n), dtype=bool) & ~crashed[None, :]
+        return _with_self(ho)
+
+    return sample
+
+
+def crash_at(n: int, f: int, crash_round: int) -> Callable:
+    """f processes crash at a given round (alive and talkative before)."""
+
+    def sample(key, r):
+        k = jax.random.fold_in(key, 0x5EED)
+        crashed = jax.random.permutation(k, n) < f
+        dead = crashed[None, :] & (r >= crash_round)
+        return _with_self(jnp.ones((n, n), dtype=bool) & ~dead)
+
+    return sample
+
+
+def omission(n: int, p_drop: float) -> Callable:
+    """Each (sender, receiver) link drops independently with prob p_drop per
+    round — the timeout/packet-loss regime of the UDP transport."""
+
+    def sample(key, r):
+        k = jax.random.fold_in(key, r)
+        ho = jax.random.uniform(k, (n, n)) >= p_drop
+        return _with_self(ho)
+
+    return sample
+
+
+def quorum_omission(n: int, p_drop: float, quorum: Callable[[int], int]) -> Callable:
+    """Random omissions, but every receiver still hears at least `quorum(n)`
+    processes (the "good enough round" regime under which most algorithms are
+    live; cf. OTR's goodRound liveness predicate, Otr.scala:96)."""
+    q = quorum(n)
+
+    def sample(key, r):
+        k = jax.random.fold_in(key, r)
+        scores = jax.random.uniform(k, (n, n))
+        ho = scores >= p_drop
+        # force the q smallest scores per row to be heard
+        rank = jnp.argsort(jnp.argsort(scores, axis=1), axis=1)
+        ho = ho | (rank < q)
+        return _with_self(ho)
+
+    return sample
+
+
+def partition(n: int, round_heal: int) -> Callable:
+    """Network split into two halves until `round_heal`, then fully connected.
+    The split point is drawn per scenario."""
+
+    def sample(key, r):
+        k = jax.random.fold_in(key, 0x9A87)
+        side = jax.random.bernoulli(k, 0.5, (n,))
+        same = side[:, None] == side[None, :]
+        ho = jnp.where(r < round_heal, same, jnp.ones((n, n), dtype=bool))
+        return _with_self(ho)
+
+    return sample
+
+
+def coordinator_down(n: int, rounds_per_phase: int, p_drop: float = 0.0) -> Callable:
+    """The rotating coordinator of the current phase is crashed (nobody hears
+    it), plus optional background omissions — the adversarial schedule for
+    LastVoting-style algorithms (coord = r/k % n, LastVoting.scala:95)."""
+
+    def sample(key, r):
+        coord = (r // rounds_per_phase) % n
+        ho = jnp.ones((n, n), dtype=bool)
+        if p_drop > 0.0:
+            k = jax.random.fold_in(key, r)
+            ho = jax.random.uniform(k, (n, n)) >= p_drop
+        ho = ho & (jnp.arange(n) != coord)[None, :]
+        return _with_self(ho)
+
+    return sample
+
+
+def byzantine_silence(n: int, f: int) -> Callable:
+    """f byzantine processes that are silent toward a random half of the
+    receivers each round (equivocation-by-omission): the mask side of the
+    byzantine model.  Payload corruption is modeled separately (an adversary
+    transform on the payload tensor), mirroring the reference's tolerance of
+    garbage messages (InstanceHandler.scala:392-399)."""
+
+    def sample(key, r):
+        kb = jax.random.fold_in(key, 0xB12)
+        byz = jax.random.permutation(kb, n) < f
+        kt = jax.random.fold_in(key, r)
+        target = jax.random.bernoulli(kt, 0.5, (n, n))
+        ho = jnp.ones((n, n), dtype=bool) & ~(byz[None, :] & target)
+        return _with_self(ho)
+
+    return sample
+
+
+def from_schedule(schedule: jnp.ndarray) -> Callable:
+    """Replay an explicit [T, n, n] HO schedule (differential testing against
+    hand-computed traces)."""
+
+    def sample(key, r):
+        return schedule[jnp.minimum(r, schedule.shape[0] - 1)]
+
+    return sample
+
+
+def sync_k_filter(base: Callable, k_sync: int) -> Callable:
+    """Impose the `sync(k)` progress constraint (Progress.scala:16-20): every
+    receiver hears at least k processes — the mask-family encoding of the
+    byzantine round synchronizer's barrier (InstanceHandler.scala:277-287)."""
+
+    def sample(key, r):
+        ho = base(key, r)
+        n = ho.shape[-1]
+        # greedily re-enable the lowest-id senders per deficient row
+        count = ho.sum(axis=1)
+        need = jnp.maximum(k_sync - count, 0)
+        # positions of not-heard senders ranked by id
+        rank = jnp.cumsum(~ho, axis=1)
+        add = (~ho) & (rank <= need[:, None])
+        return ho | add
+
+    return sample

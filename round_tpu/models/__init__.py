@@ -1,0 +1,11 @@
+"""The algorithm library: the reference's example suite as lane programs.
+
+Each module re-expresses one of the reference's example algorithms
+(src/test/scala/example/) against the round_tpu DSL — same protocol, same
+decision semantics, tensor-native execution.
+"""
+
+from round_tpu.models.otr import OTR, OtrState
+from round_tpu.models.common import consensus_io
+
+__all__ = ["OTR", "OtrState", "consensus_io"]

@@ -1,0 +1,15 @@
+"""Shared IO conventions for the algorithm library.
+
+The reference threads a ConsensusIO callback object into Process.init
+(example/ConsensusIO.scala); decisions come back through `decide(v)`.  In
+tensor land the io is a pytree of per-lane inputs and decisions are fields of
+the state (extracted by Algorithm.decided/decision)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def consensus_io(initial_values) -> dict:
+    """io pytree for consensus algorithms: one initial value per process."""
+    return {"initial_value": jnp.asarray(initial_values)}

@@ -1,0 +1,74 @@
+"""OTR — One-Third-Rule consensus.
+
+Protocol (reference: example/Otr.scala:56-84): every round, broadcast x; if
+more than 2n/3 messages arrive, set x to the minimum most-often-received
+value, and if that value itself was received from more than 2n/3 processes,
+decide it.  After deciding, keep participating for `after_decision` more
+rounds (helping laggards catch up), then exit.
+
+Spec (Otr.scala:95-120): agreement/validity/integrity/irrevocability +
+termination under "good rounds" (some HO superset of a >2n/3 quorum shared by
+all).  See round_tpu/spec for the checked formulation.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import Round, RoundCtx, broadcast
+from round_tpu.ops.mailbox import Mailbox
+
+
+@flax.struct.dataclass
+class OtrState:
+    x: jnp.ndarray         # current estimate (int32)
+    decided: jnp.ndarray   # bool
+    decision: jnp.ndarray  # int32, -1 until decided (ghost in the reference)
+    after: jnp.ndarray     # rounds left before exiting once decided
+
+
+class OtrRound(Round):
+    def send(self, ctx: RoundCtx, state: OtrState):
+        return broadcast(ctx, state.x)
+
+    def update(self, ctx: RoundCtx, state: OtrState, mbox: Mailbox) -> OtrState:
+        n = ctx.n
+        quorum = mbox.size() > (2 * n) // 3
+
+        v = mbox.min_most_often_received()
+        v_count = mbox.count(lambda vals: vals == v)
+        super_quorum = quorum & (v_count > (2 * n) // 3)
+
+        x = jnp.where(quorum, v, state.x)
+        newly = super_quorum & ~state.decided
+        decided = state.decided | super_quorum
+        decision = jnp.where(newly, v, state.decision)
+
+        after = jnp.where(decided, state.after - 1, state.after)
+        ctx.exit_at_end_of_round(decided & (after <= 0))
+
+        return state.replace(x=x, decided=decided, decision=decision, after=after)
+
+
+class OTR(Algorithm):
+    """One-Third-Rule consensus over int payloads."""
+
+    def __init__(self, after_decision: int = 2):
+        self.after_decision = after_decision
+        self.rounds = (OtrRound(),)
+
+    def make_init_state(self, ctx: RoundCtx, io) -> OtrState:
+        return OtrState(
+            x=jnp.asarray(io["initial_value"], dtype=jnp.int32),
+            decided=jnp.asarray(False),
+            decision=jnp.asarray(-1, dtype=jnp.int32),
+            after=jnp.asarray(self.after_decision, dtype=jnp.int32),
+        )
+
+    def decided(self, state: OtrState):
+        return state.decided
+
+    def decision(self, state: OtrState):
+        return state.decision

@@ -1,0 +1,4 @@
+from round_tpu.ops.mailbox import Mailbox
+from round_tpu.ops.exchange import exchange, deliver_mask
+
+__all__ = ["Mailbox", "exchange", "deliver_mask"]

@@ -1,0 +1,69 @@
+"""The exchange kernel — the framework's "network".
+
+One round of communication for all n processes (and, vmapped, all scenarios)
+is a single masked tensor exchange:
+
+    deliver[j, i] = HO[j, i] & dest_mask[i, j] & active[i]
+
+i.e. receiver j hears sender i iff the HO set of j contains i (the fault
+model), i actually addressed j this round, and i's instance is still running.
+Payloads are shared ``[n, ...]`` tensors; no per-receiver copy is made.
+
+This implements exactly the reference's network semantics, the ``mailboxLink``
+axiom (TransitionRelation.scala:73-91):
+
+    ∀ i j v.  mailbox(j)[i] = v  ⇔  i ∈ HO(j) ∧ send(i)[j] = v
+    |mailbox(j)| ≤ |HO(j)|
+
+which is this module's unit-test oracle (tests/test_exchange.py).
+
+Replaces: Netty TCP/UDP transports, Kryo serialization, the InstanceHandler
+inbox/dedup path (TcpRuntime.scala, UdpRuntime.scala, InstanceHandler.scala:
+383-434).  Dedup is by construction: one slot per (sender, receiver).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+
+def deliver_mask(
+    ho: jnp.ndarray,
+    dest_mask: jnp.ndarray,
+    active: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Compute the ``[n_recv, n_send]`` delivery matrix.
+
+    Args:
+      ho: ``[n, n]`` bool, ho[j, i] = "j hears from i" (the HO sets).
+      dest_mask: ``[n, n]`` bool, dest_mask[i, d] = "i sends to d"
+        (stacked per-sender SendSpec masks).
+      active: optional ``[n]`` bool; inactive (exited/crashed) lanes send
+        nothing.
+
+    Returns:
+      deliver: ``[n, n]`` bool, deliver[j, i] = "j's mailbox contains i's msg".
+    """
+    d = ho & dest_mask.T
+    if active is not None:
+        d = d & active[None, :]
+    return d
+
+
+def exchange(
+    payload: Any,
+    dest_mask: jnp.ndarray,
+    ho: jnp.ndarray,
+    active: Optional[jnp.ndarray] = None,
+):
+    """Full exchange: returns (values, deliver) where values is the shared
+    sender-axis payload pytree and deliver the ``[n_recv, n_send]`` mask.
+
+    The payload is returned as-is (receiver views are rows of ``deliver``);
+    XLA fuses the masking into downstream reductions, so the "wire cost" of a
+    round is one boolean transpose — the TPU-native replacement for n² UDP
+    packets.
+    """
+    return payload, deliver_mask(ho, dest_mask, active)

@@ -1,0 +1,164 @@
+"""Mailbox: one receiver's view of a round's messages, as masked arrays.
+
+The reference hands ``update`` a ``Map[ProcessID, A]`` accumulated from the
+inbox (Round.scala:57-63).  Here the mailbox is a *view*: the shared ``[n]``
+payload tensor(s) of all senders plus a ``[n]`` bool presence mask (this
+receiver's row of the delivery matrix).  Every Map operation used by the
+reference examples has a masked-reduction counterpart:
+
+    Map op (reference example)               Mailbox op
+    ------------------------------------     -------------------------
+    mailbox.size           (Otr.scala:64)    size()
+    mailbox.count(pred)    (Otr.scala:67)    count(pred)
+    mailbox contains p     (LastVoting:153)  contains(p)
+    mailbox(p)             (LastVoting:154)  get(p)
+    mmor / groupBy+minBy   (Otr.scala:44)    min_most_often_received()
+    maxBy(key)             (LastVoting:132)  arg_best(key) / best_by(key)
+    foldLeft min           (FloodMin:26)     fold_min(init)
+    values.max/min         (Epsilon)         masked_max()/masked_min()
+    head (any element)     (TPC:72)          any_value()
+
+All ops are deterministic: ties break toward the smallest sender id (the JVM's
+Map iteration order is unspecified, so this is a sound refinement).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+_INT_MIN = jnp.iinfo(jnp.int32).min
+_INT_MAX = jnp.iinfo(jnp.int32).max
+
+
+def _tree_pick(values: Any, idx):
+    return jax.tree_util.tree_map(lambda v: v[idx], values)
+
+
+class Mailbox:
+    """One receiver's mailbox for one round.
+
+    Attributes:
+      values: pytree of arrays with leading sender axis ``[n, ...]`` — the
+        payloads of *all* lanes (shared across receivers; XLA never
+        materializes per-receiver copies).
+      mask: ``[n]`` bool — mask[i] is True iff this receiver heard from i.
+    """
+
+    def __init__(self, values: Any, mask: jnp.ndarray):
+        self.values = values
+        self.mask = mask
+
+    @property
+    def n(self) -> int:
+        return self.mask.shape[0]
+
+    @property
+    def senders(self) -> jnp.ndarray:
+        return jnp.arange(self.n)
+
+    # -- cardinalities -----------------------------------------------------
+
+    def size(self) -> jnp.ndarray:
+        """Number of messages received (``mailbox.size``)."""
+        return jnp.sum(self.mask.astype(jnp.int32))
+
+    def count(self, pred: Callable[[Any], jnp.ndarray]) -> jnp.ndarray:
+        """``mailbox.count{ case (k, v) => pred(v) }``; pred is vectorized over
+        the sender axis."""
+        return jnp.sum((pred(self.values) & self.mask).astype(jnp.int32))
+
+    def exists(self, pred: Callable[[Any], jnp.ndarray]) -> jnp.ndarray:
+        return jnp.any(pred(self.values) & self.mask)
+
+    def forall(self, pred: Callable[[Any], jnp.ndarray]) -> jnp.ndarray:
+        return jnp.all(jnp.where(self.mask, pred(self.values), True))
+
+    # -- point lookups -----------------------------------------------------
+
+    def contains(self, pid) -> jnp.ndarray:
+        """``mailbox contains pid``."""
+        return self.mask[pid]
+
+    def get(self, pid) -> Any:
+        """``mailbox(pid)`` — caller guards with ``contains`` (the value is
+        the sender's payload slot regardless of delivery; meaningless if
+        absent, exactly like reading an undelivered packet)."""
+        return _tree_pick(self.values, pid)
+
+    def get_or(self, pid, default: Any) -> Any:
+        present = self.mask[pid]
+        got = _tree_pick(self.values, pid)
+        return jax.tree_util.tree_map(
+            lambda g, d: jnp.where(present, g, d), got, default
+        )
+
+    # -- selection ---------------------------------------------------------
+
+    def arg_best(self, key: jnp.ndarray) -> jnp.ndarray:
+        """Index of the present sender maximizing ``key`` (ties -> smallest
+        sender id).  ``key`` is ``[n]``, already computed from values."""
+        key = jnp.where(self.mask, key, _INT_MIN)
+        best = jnp.max(key)
+        cand = self.mask & (key == best)
+        return jnp.argmax(cand)  # first True = smallest sender id
+
+    def best_by(self, key: jnp.ndarray) -> Any:
+        """Payload of ``arg_best(key)`` (``mailbox.maxBy(key)``)."""
+        return _tree_pick(self.values, self.arg_best(key))
+
+    def any_value(self) -> Any:
+        """Payload of the smallest present sender (``mailbox.head`` refined)."""
+        return _tree_pick(self.values, jnp.argmax(self.mask))
+
+    # -- aggregate reductions ---------------------------------------------
+
+    def fold_min(self, init, values=None) -> jnp.ndarray:
+        """``mailbox.foldLeft(init)(min)`` (FloodMin.scala:26)."""
+        vals = self.values if values is None else values
+        init = jnp.asarray(init)
+        return jnp.minimum(init, jnp.min(jnp.where(self.mask, vals, init)))
+
+    def masked_min(self, values=None, empty=_INT_MAX) -> jnp.ndarray:
+        vals = self.values if values is None else values
+        return jnp.min(jnp.where(self.mask, vals, empty))
+
+    def masked_max(self, values=None, empty=_INT_MIN) -> jnp.ndarray:
+        vals = self.values if values is None else values
+        return jnp.max(jnp.where(self.mask, vals, empty))
+
+    def masked_sum(self, values=None) -> jnp.ndarray:
+        vals = self.values if values is None else values
+        return jnp.sum(jnp.where(self.mask, vals, 0))
+
+    def min_most_often_received(self, values=None) -> jnp.ndarray:
+        """OTR's ``mmor`` (Otr.scala:44-49): the value received most often;
+        ties broken toward the smallest value.  Assumes at least one message
+        (guarded by the caller's quorum check, as in the reference).
+
+        Vectorized: count[i] = #{ j present : v_j == v_i }, take max count,
+        then min value among slots achieving it.
+
+        TPU note: written as a dot against the sender-equality matrix, which is
+        *shared* across receivers — under the engine's receiver-vmap this lowers
+        to one [n_recv, n_send] @ [n_send, n_send] matmul on the MXU instead of
+        an [n, n, n] broadcast-compare.  Counts ≤ n are exact in float32.
+        """
+        vals = self.values if values is None else values
+        eq = (vals[None, :] == vals[:, None]).astype(jnp.float32)  # unbatched
+        counts = jnp.dot(self.mask.astype(jnp.float32), eq)  # [n]
+        max_count = jnp.max(counts)
+        # a slot ties the max only if its value is held by max_count present
+        # senders; picking a non-present slot with that value is harmless.
+        cand_vals = jnp.where(counts == max_count, vals, _INT_MAX)
+        return jnp.min(cand_vals)
+
+    def sorted_values(self, values=None, fill=_INT_MAX):
+        """Present values sorted ascending, absent slots pushed to the end as
+        ``fill``; returns (sorted [n], count).  Basis for order-statistics
+        algorithms (Epsilon's reduce/select, byzantine quantile catch-up)."""
+        vals = self.values if values is None else values
+        filled = jnp.where(self.mask, vals, fill)
+        return jnp.sort(filled), self.size()

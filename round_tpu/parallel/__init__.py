@@ -1,0 +1,3 @@
+from round_tpu.parallel.mesh import make_mesh, sharded_simulate, dryrun
+
+__all__ = ["make_mesh", "sharded_simulate", "dryrun"]

@@ -1,0 +1,3 @@
+from round_tpu.utils.tree import tree_where, tree_stack, tree_select_lane
+
+__all__ = ["tree_where", "tree_stack", "tree_select_lane"]

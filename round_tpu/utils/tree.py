@@ -1,0 +1,33 @@
+"""Small pytree helpers used across the engine."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_where(cond, on_true: Any, on_false: Any) -> Any:
+    """Elementwise select between two identically-shaped pytrees.
+
+    ``cond`` broadcasts against each leaf from the left (a ``[n]`` lane mask
+    selects whole per-lane subtrees)."""
+
+    def _sel(t, f):
+        c = cond
+        # right-pad cond's shape so it broadcasts over trailing value dims
+        extra = t.ndim - jnp.ndim(c)
+        if extra > 0:
+            c = jnp.reshape(c, jnp.shape(c) + (1,) * extra)
+        return jnp.where(c, t, f)
+
+    return jax.tree_util.tree_map(_sel, on_true, on_false)
+
+
+def tree_stack(trees):
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def tree_select_lane(tree: Any, idx) -> Any:
+    return jax.tree_util.tree_map(lambda x: x[idx], tree)

@@ -1,0 +1,20 @@
+"""Test config: force a virtual 8-device CPU platform.
+
+The environment may pre-set JAX_PLATFORMS to a real accelerator (and a
+sitecustomize hook may have imported jax already), so we both force the env
+var AND update jax.config before any backend is initialized.  Multi-chip code
+paths (parallel/mesh.py) are exercised on the virtual mesh; bench.py runs on
+the real chip and does NOT import this."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

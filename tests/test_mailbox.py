@@ -1,0 +1,76 @@
+"""Mailbox masked reductions vs plain-Python Map semantics."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.ops.mailbox import Mailbox
+
+
+def _mbox(values, mask):
+    return Mailbox(jnp.asarray(values), jnp.asarray(mask))
+
+
+def test_size_count():
+    m = _mbox([5, 7, 5, 9], [True, True, False, True])
+    assert int(m.size()) == 3
+    assert int(m.count(lambda v: v == 5)) == 1
+    assert int(m.count(lambda v: v > 4)) == 3
+    assert bool(m.exists(lambda v: v == 9))
+    assert bool(m.exists(lambda v: v == 5))  # 5 present at idx 0
+    assert bool(m.forall(lambda v: v > 4))
+
+
+def test_contains_get():
+    m = _mbox([10, 20, 30], [False, True, True])
+    assert not bool(m.contains(0))
+    assert bool(m.contains(1))
+    assert int(m.get(1)) == 20
+    assert int(m.get_or(0, jnp.asarray(-1))) == -1
+    assert int(m.get_or(2, jnp.asarray(-1))) == 30
+
+
+def test_mmor_matches_reference_semantics():
+    """mmor = groupBy(value) then minBy (-count, value)  (Otr.scala:44-49)."""
+    rng = np.random.RandomState(1)
+    for _ in range(50):
+        n = rng.randint(1, 10)
+        vals = rng.randint(0, 4, size=n)
+        mask = rng.rand(n) < 0.7
+        if not mask.any():
+            mask[rng.randint(n)] = True
+        # reference computation
+        present = vals[mask]
+        groups = {}
+        for v in present:
+            groups[v] = groups.get(v, 0) + 1
+        want = min(groups.items(), key=lambda kv: (-kv[1], kv[0]))[0]
+        got = int(_mbox(vals, mask).min_most_often_received())
+        assert got == want, (vals, mask, got, want)
+
+
+def test_best_by_max_key_min_id_tiebreak():
+    m = _mbox([1, 2, 3, 4], [True, True, True, False])
+    keys = jnp.asarray([7, 9, 9, 99])  # sender 3 masked out
+    assert int(m.arg_best(keys)) == 1  # max key 9, smallest id wins
+    assert int(m.best_by(keys)) == 2
+
+
+def test_fold_min_and_extrema():
+    m = _mbox([4, 2, 9], [True, False, True])
+    assert int(m.fold_min(jnp.asarray(5))) == 4
+    assert int(m.fold_min(jnp.asarray(1))) == 1
+    assert int(m.masked_min()) == 4
+    assert int(m.masked_max()) == 9
+    assert int(m.masked_sum()) == 13
+
+
+def test_sorted_values():
+    m = _mbox([4, 2, 9], [True, True, False])
+    s, cnt = m.sorted_values()
+    assert int(cnt) == 2
+    assert s[:2].tolist() == [2, 4]
+
+
+def test_any_value():
+    m = _mbox([4, 2, 9], [False, True, True])
+    assert int(m.any_value()) == 2

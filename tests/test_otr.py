@@ -1,0 +1,158 @@
+"""OTR end-to-end: decision parity with an independent pure-Python oracle.
+
+The oracle reimplements Otr.scala:56-84 directly on Python dicts (per-process
+mailboxes under explicit HO sets), so engine + exchange + mmor are checked
+against the reference semantics, not against themselves."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.engine.executor import run_instance, simulate
+from round_tpu.engine import scenarios
+from round_tpu.models.otr import OTR
+from round_tpu.models.common import consensus_io
+
+
+def _oracle_otr(init_values, ho_schedule, after_decision=2):
+    """Pure-Python OTR under an explicit [T][n][n] HO schedule."""
+    n = len(init_values)
+    x = list(init_values)
+    decided = [False] * n
+    decision = [None] * n
+    after = [after_decision] * n
+    exited = [False] * n
+    for t, ho in enumerate(ho_schedule):
+        sent = list(x)
+        new_x = list(x)
+        was_exited = list(exited)
+        for j in range(n):
+            if was_exited[j]:
+                continue
+            mailbox = {i: sent[i] for i in range(n) if ho[j][i] and not was_exited[i]}
+            if len(mailbox) > 2 * n // 3:
+                groups = {}
+                for v in mailbox.values():
+                    groups[v] = groups.get(v, 0) + 1
+                v = min(groups.items(), key=lambda kv: (-kv[1], kv[0]))[0]
+                new_x[j] = v
+                if sum(1 for m in mailbox.values() if m == v) > 2 * n // 3:
+                    if not decided[j]:
+                        decision[j] = v
+                    decided[j] = True
+            if decided[j]:
+                after[j] -= 1
+                if after[j] <= 0:
+                    exited[j] = True
+        x = new_x
+    return x, decided, decision, exited
+
+
+def _run_tpu_otr(init_values, ho_schedule, max_phases, after_decision=2):
+    n = len(init_values)
+    algo = OTR(after_decision=after_decision)
+    sched = jnp.asarray(np.array(ho_schedule))
+    res = run_instance(
+        algo,
+        consensus_io(init_values),
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.from_schedule(sched),
+        max_phases=max_phases,
+    )
+    return res
+
+
+def test_otr_full_network_n4():
+    init = [3, 1, 3, 2]
+    T = 4
+    ho = np.ones((T, 4, 4), dtype=bool)
+    res = _run_tpu_otr(init, ho, max_phases=T)
+    ox, odec, odecv, oexit = _oracle_otr(init, ho)
+    # everyone decides 3 (most often received, n=4 quorum > 2)
+    assert res.state.decided.all()
+    assert res.state.decision.tolist() == odecv
+    assert res.state.x.tolist() == ox
+    assert res.done.tolist() == oexit
+    # round 0: count(3)=2 is not > 2n/3=2 — converge only; decide in round 1
+    assert res.decided_round.tolist() == [1, 1, 1, 1]
+
+
+def test_otr_tie_breaks_to_min_value():
+    init = [5, 5, 2, 2]
+    ho = np.ones((3, 4, 4), dtype=bool)
+    res = _run_tpu_otr(init, ho, max_phases=3)
+    ox, odec, odecv, _ = _oracle_otr(init, ho)
+    assert res.state.x.tolist() == ox
+    assert res.state.decision.tolist()[0] == 2  # min value wins the tie
+    assert res.state.decided.tolist() == odec
+
+
+def test_otr_random_ho_parity():
+    rng = np.random.RandomState(42)
+    for trial in range(8):
+        n = int(rng.randint(3, 8))
+        T = 6
+        init = rng.randint(0, 5, size=n).tolist()
+        ho = rng.rand(T, n, n) < 0.8
+        for t in range(T):
+            np.fill_diagonal(ho[t], True)
+        res = _run_tpu_otr(init, ho, max_phases=T)
+        ox, odec, odecv, oexit = _oracle_otr(init, ho)
+        assert res.state.x.tolist() == ox, (trial, init)
+        assert res.state.decided.tolist() == odec
+        for j in range(n):
+            if odec[j]:
+                assert int(res.state.decision[j]) == odecv[j]
+        assert res.done.tolist() == oexit
+
+
+def test_otr_no_quorum_no_decision():
+    # only self-delivery: nobody ever has a quorum
+    T, n = 5, 4
+    ho = np.zeros((T, n, n), dtype=bool)
+    for t in range(T):
+        np.fill_diagonal(ho[t], True)
+    init = [1, 2, 3, 4]
+    res = _run_tpu_otr(init, ho, max_phases=T)
+    assert not bool(res.state.decided.any())
+    assert res.state.x.tolist() == init
+    assert res.decided_round.tolist() == [-1] * n
+
+
+def test_otr_batched_scenarios():
+    n = 4
+    algo = OTR()
+    res = simulate(
+        algo,
+        consensus_io([4, 4, 1, 4]),
+        n,
+        jax.random.PRNGKey(7),
+        scenarios.full(n),
+        max_phases=3,
+        n_scenarios=5,
+    )
+    # all scenarios identical (full network): everyone decides 4
+    assert res.state.decided.shape == (5, n)
+    assert bool(res.state.decided.all())
+    assert (np.asarray(res.state.decision) == 4).all()
+
+
+def test_otr_agreement_under_omission():
+    """Safety under lossy networks: whoever decides, agrees."""
+    n = 7
+    algo = OTR()
+    res = simulate(
+        algo,
+        consensus_io(list(range(n))),
+        n,
+        jax.random.PRNGKey(3),
+        scenarios.omission(n, 0.25),
+        max_phases=10,
+        n_scenarios=32,
+    )
+    dec = np.asarray(res.state.decided)
+    decv = np.asarray(res.state.decision)
+    for s in range(32):
+        vals = set(decv[s][dec[s]].tolist())
+        assert len(vals) <= 1, f"scenario {s} violated agreement: {vals}"

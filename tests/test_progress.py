@@ -1,0 +1,98 @@
+"""Progress encoding + lattice laws (reference: Progress.scala, tested by
+ProgressTests.scala)."""
+
+import random
+
+import pytest
+
+from round_tpu.core.progress import Progress, timeout_in_bounds
+
+
+def test_kinds():
+    assert Progress.timeout(10).is_timeout
+    assert not Progress.timeout(10).is_strict
+    assert Progress.strict_timeout(10).is_timeout
+    assert Progress.strict_timeout(10).is_strict
+    assert Progress.WAIT_MESSAGE.is_wait_message
+    assert Progress.STRICT_WAIT_MESSAGE.is_strict
+    assert Progress.GO_AHEAD.is_go_ahead
+    assert Progress.UNCHANGED.is_unchanged
+    assert Progress.sync(3).is_sync
+    assert Progress.sync(3).is_strict  # sync is always strict
+
+
+def test_timeout_roundtrip_property():
+    rnd = random.Random(0)
+    for _ in range(200):
+        millis = rnd.randint(-(2**40), 2**40)
+        p = Progress.timeout(millis)
+        assert p.timeout_millis == millis
+        assert Progress.strict_timeout(millis).timeout_millis == millis
+    for k in (0, 1, 7, 63, 2**20):
+        assert Progress.sync(k).k == k
+
+
+def test_timeout_in_bounds():
+    assert timeout_in_bounds(10)
+    assert timeout_in_bounds(-10)
+    assert not timeout_in_bounds(2**62)
+
+
+def test_or_else():
+    t = Progress.timeout(5)
+    assert Progress.UNCHANGED.or_else(t) == t
+    assert t.or_else(Progress.GO_AHEAD) == t
+
+
+def test_lub():
+    t5, t9 = Progress.timeout(5), Progress.timeout(9)
+    assert t5.lub(t9) == t9
+    assert t5.lub(Progress.strict_timeout(3)) == Progress.strict_timeout(5)
+    assert t5.lub(Progress.WAIT_MESSAGE) == Progress.WAIT_MESSAGE
+    assert Progress.GO_AHEAD.lub(t5) == t5
+    assert Progress.sync(2).lub(Progress.sync(4)) == Progress.sync(4)
+    assert t5.lub(Progress.sync(2)) == Progress.sync(2)  # sync dominates
+
+
+def test_glb():
+    t5, t9 = Progress.timeout(5), Progress.timeout(9)
+    assert t5.glb(t9) == t5
+    assert Progress.GO_AHEAD.glb(t9) == Progress.GO_AHEAD
+    assert t9.glb(Progress.WAIT_MESSAGE) == t9
+    assert Progress.WAIT_MESSAGE.glb(Progress.sync(3)) == Progress.WAIT_MESSAGE
+    assert Progress.sync(2).glb(Progress.sync(4)) == Progress.sync(2)
+    # strictness: glb strict only if both strict
+    s = Progress.strict_timeout(5).glb(Progress.strict_timeout(9))
+    assert s.is_strict and s.timeout_millis == 5
+    assert not Progress.strict_timeout(5).glb(Progress.timeout(9)).is_strict
+
+
+def test_values_are_int64_range():
+    """Every Progress value fits a signed 64-bit word (two's complement), so
+    it can live in device arrays / be compared like the reference's Long."""
+    import numpy as np
+
+    for p in [
+        Progress.timeout(10),
+        Progress.strict_timeout(-5),
+        Progress.WAIT_MESSAGE,
+        Progress.STRICT_WAIT_MESSAGE,
+        Progress.GO_AHEAD,
+        Progress.UNCHANGED,
+        Progress.sync(7),
+    ]:
+        v = np.int64(p.value)  # raises OverflowError if out of range
+        assert int(v) == p.value
+
+
+def test_lattice_laws():
+    elems = [
+        Progress.timeout(5),
+        Progress.strict_timeout(9),
+        Progress.WAIT_MESSAGE,
+        Progress.GO_AHEAD,
+        Progress.sync(3),
+    ]
+    for a in elems:
+        assert a.lub(a) == a
+        assert a.glb(a) == a

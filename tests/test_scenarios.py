@@ -1,0 +1,130 @@
+"""HO-mask family semantics — especially round-invariance of per-scenario
+fault sets, which is what distinguishes crash-stop from per-round omission."""
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import Round, broadcast
+from round_tpu.engine import scenarios
+from round_tpu.engine.executor import run_instance
+
+
+@flax.struct.dataclass
+class ProbeState:
+    heard: jnp.ndarray  # [n] bool — who this lane heard from last round
+
+
+class ProbeRound(Round):
+    """Broadcasts a constant and records the mailbox mask verbatim."""
+
+    def send(self, ctx, state):
+        return broadcast(ctx, jnp.int32(0))
+
+    def update(self, ctx, state, mbox):
+        return state.replace(heard=mbox.mask)
+
+
+class ProbeAlgo(Algorithm):
+    def __init__(self, n):
+        self.rounds = (ProbeRound(),)
+        self.n = n
+
+    def make_init_state(self, ctx, io):
+        return ProbeState(heard=jnp.zeros((self.n,), dtype=bool))
+
+
+def _heard_trace(sampler, n, phases=6, key=0):
+    """[T, n, n] of observed delivery masks under the engine's key schedule."""
+    algo = ProbeAlgo(n)
+    res = run_instance(
+        algo,
+        {"_": jnp.zeros((n,))},
+        n,
+        jax.random.PRNGKey(key),
+        sampler,
+        max_phases=phases,
+        record_fn=lambda state, done, r: state.heard,
+    )
+    return np.asarray(res.recorded)
+
+
+def test_crash_set_constant_across_rounds():
+    """crash(): the crashed set must be the SAME every round (crash-stop,
+    not per-round omission) — regression test for the engine handing the
+    sampler a per-round key."""
+    n, f = 8, 3
+    trace = _heard_trace(scenarios.crash(n, f), n)
+    others = trace[0].copy()
+    np.fill_diagonal(others, False)
+    silent = others.sum(axis=0) == 0  # heard by nobody but themselves
+    assert silent.sum() == f
+    for t in range(1, trace.shape[0]):
+        np.testing.assert_array_equal(trace[t], trace[0])
+
+
+def test_crash_sets_differ_across_scenarios():
+    n, f = 8, 3
+    t0 = _heard_trace(scenarios.crash(n, f), n, key=0)
+    t1 = _heard_trace(scenarios.crash(n, f), n, key=1)
+    t2 = _heard_trace(scenarios.crash(n, f), n, key=2)
+    assert not (np.array_equal(t0[0], t1[0]) and np.array_equal(t1[0], t2[0]))
+
+
+def test_omission_varies_across_rounds():
+    n = 8
+    trace = _heard_trace(scenarios.omission(n, 0.4), n)
+    assert any(
+        not np.array_equal(trace[t], trace[0]) for t in range(1, trace.shape[0])
+    )
+
+
+def test_partition_halves_stable_then_heal():
+    n = 8
+    trace = _heard_trace(scenarios.partition(n, round_heal=3), n)
+    np.testing.assert_array_equal(trace[1], trace[0])
+    np.testing.assert_array_equal(trace[2], trace[0])
+    assert trace[3].all() and trace[5].all()  # healed: full connectivity
+    assert not trace[0].all()  # split before
+
+
+def test_self_delivery_always_on():
+    n = 8
+    for sampler in (
+        scenarios.crash(n, 3),
+        scenarios.omission(n, 0.9),
+        scenarios.partition(n, 3),
+        scenarios.byzantine_silence(n, 2),
+    ):
+        trace = _heard_trace(sampler, n, phases=3)
+        for t in range(trace.shape[0]):
+            assert np.diag(trace[t]).all(), "a process always hears itself"
+
+
+def test_quorum_omission_min_indegree():
+    n = 9
+    sampler = scenarios.quorum_omission(n, 0.8, quorum=lambda n: 2 * n // 3 + 1)
+    trace = _heard_trace(sampler, n, phases=4)
+    q = 2 * n // 3 + 1
+    assert (trace.sum(axis=2) >= q).all()
+
+
+def test_sync_k_filter():
+    n = 8
+    sampler = scenarios.sync_k_filter(scenarios.omission(n, 0.95), k_sync=5)
+    trace = _heard_trace(sampler, n, phases=3)
+    assert (trace.sum(axis=2) >= 5).all()
+
+
+def test_crash_at_round():
+    n = 6
+    trace = _heard_trace(scenarios.crash_at(n, f=2, crash_round=2), n, phases=5)
+    # before crash_round: everyone heard from everyone
+    assert trace[0].all() and trace[1].all()
+    # after: exactly the same 2 senders silent in every later round
+    silent2 = ~trace[2] & ~np.eye(n, dtype=bool)
+    assert silent2.any()
+    np.testing.assert_array_equal(trace[3], trace[2])
+    np.testing.assert_array_equal(trace[4], trace[2])

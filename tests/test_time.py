@@ -1,0 +1,52 @@
+"""Wrap-around Time/Instance arithmetic (reference: Time.scala:7-18,
+runtime/Instance.scala:6-33, tested by runtime/InstanceChecks.scala)."""
+
+import numpy as np
+
+from round_tpu.core.time import Time, Instance
+
+I32_MAX = 2**31 - 1
+
+
+def test_basic_order():
+    assert Time.lt(1, 2)
+    assert not Time.lt(2, 1)
+    assert Time.leq(2, 2)
+    assert Time.gt(3, 2)
+    assert Time.geq(2, 2)
+
+
+def test_wraparound_order():
+    # values straddling the 32-bit wrap: max < max+1 (which wraps negative)
+    a = I32_MAX
+    b = I32_MAX + 1  # wraps to -2**31
+    assert Time.lt(a, b)
+    assert not Time.lt(b, a)
+    assert int(Time.max(a, b)) == -(2**31)  # b, wrapped
+    assert int(Time.diff(b, a)) == 1
+
+
+def test_max_min():
+    assert int(Time.max(3, 7)) == 7
+    assert int(Time.min(3, 7)) == 3
+
+
+def test_add_wraps():
+    assert int(Time.add(I32_MAX, 1)) == -(2**31)
+
+
+def test_instance_wraparound():
+    a = 2**15 - 1
+    b = a + 1
+    assert Instance.lt(a, b)
+    assert not Instance.lt(b, a)
+    assert Instance.leq(a, a)
+
+
+def test_vectorized():
+    import jax.numpy as jnp
+
+    a = jnp.array([1, I32_MAX, 5], dtype=jnp.int32)
+    b = jnp.array([2, -(2**31), 5], dtype=jnp.int32)  # I32_MAX + 1, wrapped
+    lt = Time.lt(a, b)
+    assert lt.tolist() == [True, True, False]
