@@ -255,10 +255,23 @@ def simulate(
     keys = jax.random.split(key, n_scenarios)
 
     if io_batched is None:
-        shared_io = all(
-            jnp.ndim(leaf) >= 1 and jnp.shape(leaf)[0] == n
-            for leaf in jax.tree_util.tree_leaves(io)
+        leaves = jax.tree_util.tree_leaves(io)
+        looks_shared = all(
+            jnp.ndim(leaf) >= 1 and jnp.shape(leaf)[0] == n for leaf in leaves
         )
+        looks_batched = all(
+            jnp.ndim(leaf) >= 2
+            and jnp.shape(leaf)[0] == n_scenarios
+            and jnp.shape(leaf)[1] == n
+            for leaf in leaves
+        )
+        if looks_shared == looks_batched:
+            raise ValueError(
+                "cannot tell whether io is per-scenario [S, n, ...] or shared "
+                f"[n, ...] (n={n}, n_scenarios={n_scenarios}, leaf shapes="
+                f"{[jnp.shape(l) for l in leaves]}); pass io_batched explicitly"
+            )
+        shared_io = looks_shared
     else:
         shared_io = not io_batched
 

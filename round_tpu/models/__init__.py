@@ -6,6 +6,33 @@ decision semantics, tensor-native execution.
 """
 
 from round_tpu.models.otr import OTR, OtrState
+from round_tpu.models.floodmin import FloodMin, FloodMinState
+from round_tpu.models.benor import BenOr, BenOrState
+from round_tpu.models.lastvoting import LastVoting, LVState
+from round_tpu.models.tpc import TwoPhaseCommit, TpcState, tpc_io
+from round_tpu.models.kset import (
+    KSetAgreement,
+    KSetEarlyStopping,
+    KSetState,
+    KSetESState,
+)
 from round_tpu.models.common import consensus_io
 
-__all__ = ["OTR", "OtrState", "consensus_io"]
+__all__ = [
+    "OTR",
+    "OtrState",
+    "FloodMin",
+    "FloodMinState",
+    "BenOr",
+    "BenOrState",
+    "LastVoting",
+    "LVState",
+    "TwoPhaseCommit",
+    "TpcState",
+    "tpc_io",
+    "KSetAgreement",
+    "KSetEarlyStopping",
+    "KSetState",
+    "KSetESState",
+    "consensus_io",
+]

@@ -1,0 +1,123 @@
+"""Ben-Or — randomized binary consensus (two-round phases, coin flips).
+
+Protocol (reference: example/BenOr.scala:11-88, after Ben-Or PODC'83 with the
+termination tweak of Aguilera-Toueg):
+
+  phase round 1: broadcast (x, canDecide).  If canDecide: decide(x) and exit.
+    Else vote := Some(true) if >n/2 say true or someone who canDecide says
+    true; symmetric for false; else None.  canDecide := anyone canDecide.
+  phase round 2: broadcast vote.  If >n/2 vote Some(b): x := b, canDecide.
+    Else if more than one vote Some(b): x := b.  Else x := coin flip.
+
+The coin is the per-(scenario, process, round) PRNG key threaded through
+RoundCtx.rng — reproducible across shardings (reference uses
+util.Random.nextBoolean, BenOr.scala:77).
+
+Option[Boolean] on the wire is a (tag, value) pair of int32s here: vote in
+{-1 = None, 0 = Some(false), 1 = Some(true)}.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import Round, RoundCtx, broadcast
+from round_tpu.models.common import ghost_decide
+from round_tpu.ops.mailbox import Mailbox
+
+VOTE_NONE = -1
+VOTE_FALSE = 0
+VOTE_TRUE = 1
+
+
+@flax.struct.dataclass
+class BenOrState:
+    x: jnp.ndarray           # bool estimate
+    can_decide: jnp.ndarray  # bool
+    vote: jnp.ndarray        # int32 in {-1, 0, 1}
+    decided: jnp.ndarray     # bool (ghost)
+    decision: jnp.ndarray    # bool (ghost)
+
+
+class BenOrRound1(Round):
+    def send(self, ctx: RoundCtx, state: BenOrState):
+        return broadcast(ctx, {"x": state.x, "can": state.can_decide})
+
+    def update(self, ctx: RoundCtx, state: BenOrState, mbox: Mailbox):
+        n = ctx.n
+        t_cnt = mbox.count(lambda m: m["x"])
+        f_cnt = mbox.count(lambda m: ~m["x"])
+        t_dec = mbox.exists(lambda m: m["x"] & m["can"])
+        f_dec = mbox.exists(lambda m: ~m["x"] & m["can"])
+
+        vote = jnp.where(
+            (t_cnt > n // 2) | t_dec,
+            VOTE_TRUE,
+            jnp.where((f_cnt > n // 2) | f_dec, VOTE_FALSE, VOTE_NONE),
+        ).astype(jnp.int32)
+        can = mbox.exists(lambda m: m["can"])
+
+        # the canDecide branch decides and freezes (exit at end of round);
+        # its vote/can updates never matter afterwards but are masked anyway
+        deciding = state.can_decide
+        ctx.exit_at_end_of_round(deciding)
+        state = ghost_decide(state, deciding, state.x)
+        return state.replace(
+            vote=jnp.where(deciding, state.vote, vote),
+            can_decide=jnp.where(deciding, state.can_decide, can),
+        )
+
+
+class BenOrRound2(Round):
+    def send(self, ctx: RoundCtx, state: BenOrState):
+        return broadcast(ctx, state.vote)
+
+    def update(self, ctx: RoundCtx, state: BenOrState, mbox: Mailbox):
+        n = ctx.n
+        t = mbox.count(lambda v: v == VOTE_TRUE)
+        f = mbox.count(lambda v: v == VOTE_FALSE)
+        coin = jax.random.bernoulli(ctx.rng)
+
+        x = jnp.where(
+            t > n // 2,
+            True,
+            jnp.where(
+                f > n // 2,
+                False,
+                jnp.where(t > 1, True, jnp.where(f > 1, False, coin)),
+            ),
+        )
+        can = (t > n // 2) | (f > n // 2) | state.can_decide
+
+        # decided lanes already exited in round 1 of this phase, but keep the
+        # update masked for the phase in which they decide
+        frozen = state.decided
+        return state.replace(
+            x=jnp.where(frozen, state.x, x),
+            can_decide=jnp.where(frozen, state.can_decide, can),
+        )
+
+
+class BenOr(Algorithm):
+    """Randomized binary consensus; terminates with probability 1."""
+
+    def __init__(self):
+        self.rounds = (BenOrRound1(), BenOrRound2())
+
+    def make_init_state(self, ctx: RoundCtx, io) -> BenOrState:
+        return BenOrState(
+            x=jnp.asarray(io["initial_value"], dtype=bool),
+            can_decide=jnp.asarray(False),
+            vote=jnp.asarray(VOTE_NONE, dtype=jnp.int32),
+            decided=jnp.asarray(False),
+            decision=jnp.asarray(False),
+        )
+
+    def decided(self, state: BenOrState):
+        return state.decided
+
+    def decision(self, state: BenOrState):
+        return state.decision

@@ -13,3 +13,21 @@ import jax.numpy as jnp
 def consensus_io(initial_values) -> dict:
     """io pytree for consensus algorithms: one initial value per process."""
     return {"initial_value": jnp.asarray(initial_values)}
+
+
+def ghost_decide(state, deciding, value):
+    """Fold a decision event into the ghost ``decided``/``decision`` fields.
+
+    The one place that owns the irrevocability-preserving masking: a lane's
+    ``decision`` is written exactly once, on the round where ``deciding``
+    first becomes true (reference: the decide(v) callbacks + ghost updates in
+    the examples, e.g. Otr.scala:74-78, BenOr.scala:41-44).
+
+    Requires ``state`` to have bool ``decided`` and ``decision`` fields of
+    the decision dtype.
+    """
+    newly = deciding & ~state.decided
+    return state.replace(
+        decided=state.decided | deciding,
+        decision=jnp.where(newly, value, state.decision),
+    )

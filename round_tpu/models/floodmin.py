@@ -1,0 +1,59 @@
+"""FloodMin — synchronous min-flooding consensus under f crash faults.
+
+Protocol (reference: example/FloodMin.scala:22-33): every round broadcast x;
+fold the received values into x with min; after f+1 rounds (``r > f``) decide
+x and exit.  Tolerates f crash-stop faults in the synchronous model.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import Round, RoundCtx, broadcast
+from round_tpu.models.common import ghost_decide
+from round_tpu.ops.mailbox import Mailbox
+
+
+@flax.struct.dataclass
+class FloodMinState:
+    x: jnp.ndarray         # current min estimate (int32)
+    decided: jnp.ndarray   # bool (ghost; reference decides via callback)
+    decision: jnp.ndarray  # int32, -1 until decided
+
+
+class FloodMinRound(Round):
+    def __init__(self, f: int):
+        self.f = f
+
+    def send(self, ctx: RoundCtx, state: FloodMinState):
+        return broadcast(ctx, state.x)
+
+    def update(self, ctx: RoundCtx, state: FloodMinState, mbox: Mailbox):
+        # x = mailbox.foldLeft(x)(min)   (FloodMin.scala:26)
+        x = mbox.fold_min(state.x)
+        deciding = ctx.r > self.f
+        ctx.exit_at_end_of_round(deciding)
+        return ghost_decide(state.replace(x=x), deciding, x)
+
+
+class FloodMin(Algorithm):
+    """f-crash-tolerant min-flooding (decide after round f)."""
+
+    def __init__(self, f: int = 2):
+        self.f = f
+        self.rounds = (FloodMinRound(f),)
+
+    def make_init_state(self, ctx: RoundCtx, io) -> FloodMinState:
+        return FloodMinState(
+            x=jnp.asarray(io["initial_value"], dtype=jnp.int32),
+            decided=jnp.asarray(False),
+            decision=jnp.asarray(-1, dtype=jnp.int32),
+        )
+
+    def decided(self, state: FloodMinState):
+        return state.decided
+
+    def decision(self, state: FloodMinState):
+        return state.decision

@@ -1,0 +1,186 @@
+"""k-set agreement: KSetAgreement (map-merging) and KSetEarlyStopping.
+
+KSetAgreement (reference: example/KSetAgreement.scala:21-67): each process
+carries a partial map ``t: ProcessID -> Int`` of known initial values
+(initially just its own).  Every round broadcast (decider, t); a process that
+sees a decider adopts that decider's map; a process whose map is shared by
+more than n-k senders becomes a decider; otherwise it merges all received
+maps.  A decider broadcasts once more, then decides min(t.values).
+Model: n > 2(k-1), crash faults f < k (comment KSetAgreement.scala:73-79).
+
+The ``Map[ProcessID,Int]`` payload becomes a [n] value vector + [n] validity
+mask — the wire tensor is [n, n, n]-shaped per round (SURVEY.md §7 "hard
+parts"), fine at the reference's scale.  Scala Map iteration order is
+unspecified; merges and ``find`` here break ties toward the smallest sender
+id (a deterministic refinement).
+
+KSetEarlyStopping (reference: example/KSetEarlyStopping.scala:8-46, after
+Mostefaoui-Raynal): broadcast (est, canDecide); est := min received; decide
+when r > t/k or canDecide, where canDecide propagates or triggers when fewer
+than k processes dropped out since the last round.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import Round, RoundCtx, broadcast
+from round_tpu.models.common import ghost_decide
+from round_tpu.ops.mailbox import Mailbox
+
+_INT_MAX = jnp.iinfo(jnp.int32).max
+
+
+@flax.struct.dataclass
+class KSetState:
+    t_vals: jnp.ndarray    # [n] int32 — known initial values (garbage if unknown)
+    t_mask: jnp.ndarray    # [n] bool — which pids are known
+    decider: jnp.ndarray   # bool
+    decided: jnp.ndarray   # bool (ghost)
+    decision: jnp.ndarray  # int32, -1 until decided
+
+
+class KSetRound(Round):
+    def __init__(self, k: int):
+        self.k = k
+
+    def send(self, ctx: RoundCtx, state: KSetState):
+        return broadcast(
+            ctx,
+            {"dec": state.decider, "vals": state.t_vals, "mask": state.t_mask},
+        )
+
+    def update(self, ctx: RoundCtx, state: KSetState, mbox: Mailbox):
+        n, k = ctx.n, self.k
+        present = mbox.mask                      # [n]
+        s_dec = mbox.values["dec"]               # [n]
+        s_vals = mbox.values["vals"]             # [n, n]
+        s_mask = mbox.values["mask"]             # [n, n]
+
+        # --- branch 1: already a decider -> decide min(t.values), exit
+        own_min = jnp.min(jnp.where(state.t_mask, state.t_vals, _INT_MAX))
+        deciding = state.decider
+        ctx.exit_at_end_of_round(deciding)
+
+        # --- branch 2: adopt the map of the first (smallest-id) decider seen
+        seen_dec = present & s_dec
+        any_dec = jnp.any(seen_dec)
+        src = jnp.argmax(seen_dec)
+        adopt_vals, adopt_mask = s_vals[src], s_mask[src]
+
+        # --- branch 3: same-map count (Map equality: same keys, same values)
+        mask_eq = jnp.all(s_mask == state.t_mask[None, :], axis=1)
+        vals_eq = jnp.all(
+            jnp.where(
+                s_mask & state.t_mask[None, :], s_vals == state.t_vals[None, :], True
+            ),
+            axis=1,
+        )
+        same = jnp.sum((present & mask_eq & vals_eq).astype(jnp.int32))
+        promote = same > n - k
+
+        # --- branch 4: merge all received maps (union of masks; values from
+        # the smallest sender id that knows the pid, else own)
+        knows = present[:, None] & s_mask        # [sender, pid]
+        any_know = jnp.any(knows, axis=0)        # [pid]
+        first = jnp.argmax(knows, axis=0)        # [pid]
+        merged_vals = jnp.where(
+            any_know, s_vals[first, jnp.arange(n)], state.t_vals
+        )
+        merged_mask = state.t_mask | any_know
+
+        # combine branches (priority: decider > adopt > promote > merge)
+        use_adopt = ~deciding & any_dec
+        use_merge = ~deciding & ~any_dec & ~promote
+        t_vals = jnp.where(
+            use_adopt, adopt_vals, jnp.where(use_merge, merged_vals, state.t_vals)
+        )
+        t_mask = jnp.where(
+            use_adopt, adopt_mask, jnp.where(use_merge, merged_mask, state.t_mask)
+        )
+        decider = deciding | use_adopt | (~deciding & ~any_dec & promote)
+        state = ghost_decide(state, deciding, own_min)
+        return state.replace(t_vals=t_vals, t_mask=t_mask, decider=decider)
+
+
+class KSetAgreement(Algorithm):
+    """k-set agreement by map merging (decisions span ≤ k distinct values)."""
+
+    def __init__(self, k: int = 2):
+        self.k = k
+        self.rounds = (KSetRound(k),)
+
+    def make_init_state(self, ctx: RoundCtx, io) -> KSetState:
+        n = ctx.n
+        me = jnp.arange(n) == ctx.id
+        return KSetState(
+            t_vals=jnp.where(me, jnp.asarray(io["initial_value"], jnp.int32), 0),
+            t_mask=me,
+            decider=jnp.asarray(False),
+            decided=jnp.asarray(False),
+            decision=jnp.asarray(-1, dtype=jnp.int32),
+        )
+
+    def decided(self, state: KSetState):
+        return state.decided
+
+    def decision(self, state: KSetState):
+        return state.decision
+
+
+@flax.struct.dataclass
+class KSetESState:
+    est: jnp.ndarray       # int32
+    can_decide: jnp.ndarray
+    last_nb: jnp.ndarray   # int32 — |mailbox| of the previous round
+    decided: jnp.ndarray
+    decision: jnp.ndarray
+
+
+class KSetESRound(Round):
+    def __init__(self, t: int, k: int):
+        self.t = t
+        self.k = k
+
+    def send(self, ctx: RoundCtx, state: KSetESState):
+        return broadcast(ctx, {"est": state.est, "can": state.can_decide})
+
+    def update(self, ctx: RoundCtx, state: KSetESState, mbox: Mailbox):
+        curr_nb = mbox.size()
+        deciding = (ctx.r > self.t // self.k) | state.can_decide
+        ctx.exit_at_end_of_round(deciding)
+
+        est = mbox.masked_min(mbox.values["est"])
+        can = mbox.exists(lambda m: m["can"]) | (state.last_nb - curr_nb < self.k)
+        state = ghost_decide(state, deciding, state.est)
+        return state.replace(
+            est=jnp.where(deciding, state.est, est),
+            can_decide=jnp.where(deciding, state.can_decide, can),
+            last_nb=jnp.where(deciding, state.last_nb, curr_nb),
+        )
+
+
+class KSetEarlyStopping(Algorithm):
+    """Early-stopping k-set agreement (t crash faults, decide by round t/k+1)."""
+
+    def __init__(self, t: int = 2, k: int = 2):
+        self.t = t
+        self.k = k
+        self.rounds = (KSetESRound(t, k),)
+
+    def make_init_state(self, ctx: RoundCtx, io) -> KSetESState:
+        return KSetESState(
+            est=jnp.asarray(io["initial_value"], dtype=jnp.int32),
+            can_decide=jnp.asarray(False),
+            last_nb=jnp.asarray(ctx.n, dtype=jnp.int32),
+            decided=jnp.asarray(False),
+            decision=jnp.asarray(-1, dtype=jnp.int32),
+        )
+
+    def decided(self, state: KSetESState):
+        return state.decided
+
+    def decision(self, state: KSetESState):
+        return state.decision

@@ -1,0 +1,133 @@
+"""LastVoting — Paxos in the HO model (Charron-Bost & Schiper).
+
+Protocol (reference: example/LastVoting.scala:80-212): 4-round phases with a
+rotating coordinator ``coord = (r / 4) % n`` (LastVoting.scala:95):
+
+  round 0: everyone sends (x, ts) to coord; coord with a majority picks the
+           value with the highest timestamp as vote, commits.
+  round 1: coord broadcasts vote if committed; receivers adopt x := vote,
+           ts := current phase.
+  round 2: processes with ts == phase ack to coord; coord with majority acks
+           becomes ready.
+  round 3: coord broadcasts vote if ready; receivers decide it.  ready and
+           commit reset for the next phase.
+
+The reference asserts initial values != 0 (vote=0 means "unset",
+LastVoting.scala:133); we keep ts = -1 as "never adopted" and use the mailbox
+presence mask instead of sentinel values, so 0 is a legal input.
+
+Liveness needs one phase whose coordinator hears a majority and is heard by
+everyone (the livenessPredicate, LastVoting.scala:20-22) — exercised in tests
+via the coordinator_down / quorum families.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import Round, RoundCtx, SendSpec, broadcast, unicast
+from round_tpu.models.common import ghost_decide
+from round_tpu.ops.mailbox import Mailbox
+
+
+@flax.struct.dataclass
+class LVState:
+    x: jnp.ndarray         # int32 estimate
+    ts: jnp.ndarray        # int32 timestamp (phase of adoption), -1 initially
+    ready: jnp.ndarray     # bool (coordinator)
+    commit: jnp.ndarray    # bool (coordinator)
+    vote: jnp.ndarray      # int32 (coordinator's proposal)
+    decided: jnp.ndarray   # bool
+    decision: jnp.ndarray  # int32, -1 until decided
+
+
+def _coord(ctx: RoundCtx):
+    return (ctx.r // 4) % ctx.n
+
+
+class LVCollect(Round):
+    """Round 0: send (x, ts) to coord; coord picks highest-ts value."""
+
+    def send(self, ctx: RoundCtx, state: LVState):
+        return unicast(ctx, _coord(ctx), {"x": state.x, "ts": state.ts})
+
+    def update(self, ctx: RoundCtx, state: LVState, mbox: Mailbox):
+        n = ctx.n
+        is_coord = ctx.id == _coord(ctx)
+        first_phase = ctx.r == 0
+        have = mbox.size()
+        act = is_coord & ((have > n // 2) | (first_phase & (have > 0)))
+        # vote := the x of one of the largest ts received (maxBy over ts,
+        # ties -> smallest sender id; LastVoting.scala:132)
+        best = mbox.best_by(mbox.values["ts"])
+        return state.replace(
+            vote=jnp.where(act, best["x"], state.vote),
+            commit=state.commit | act,
+        )
+
+
+class LVPropose(Round):
+    """Round 1: committed coord broadcasts vote; receivers adopt it."""
+
+    def send(self, ctx: RoundCtx, state: LVState):
+        return broadcast(ctx, state.vote, guard=(ctx.id == _coord(ctx)) & state.commit)
+
+    def update(self, ctx: RoundCtx, state: LVState, mbox: Mailbox):
+        coord = _coord(ctx)
+        got = mbox.contains(coord)
+        return state.replace(
+            x=jnp.where(got, mbox.get(coord), state.x),
+            ts=jnp.where(got, ctx.r // 4, state.ts),
+        )
+
+
+class LVAck(Round):
+    """Round 2: adopters ack to coord; coord with majority acks is ready."""
+
+    def send(self, ctx: RoundCtx, state: LVState):
+        return unicast(ctx, _coord(ctx), state.x, guard=state.ts == ctx.r // 4)
+
+    def update(self, ctx: RoundCtx, state: LVState, mbox: Mailbox):
+        n = ctx.n
+        act = (ctx.id == _coord(ctx)) & (mbox.size() > n // 2)
+        return state.replace(ready=state.ready | act)
+
+
+class LVDecide(Round):
+    """Round 3: ready coord broadcasts vote; receivers decide."""
+
+    def send(self, ctx: RoundCtx, state: LVState):
+        return broadcast(ctx, state.vote, guard=(ctx.id == _coord(ctx)) & state.ready)
+
+    def update(self, ctx: RoundCtx, state: LVState, mbox: Mailbox):
+        coord = _coord(ctx)
+        got = mbox.contains(coord)
+        ctx.exit_at_end_of_round(got)
+        state = ghost_decide(state, got, mbox.get(coord))
+        return state.replace(ready=jnp.asarray(False), commit=jnp.asarray(False))
+
+
+class LastVoting(Algorithm):
+    """Paxos-style consensus with rotating coordinator (4-round phases)."""
+
+    def __init__(self):
+        self.rounds = (LVCollect(), LVPropose(), LVAck(), LVDecide())
+
+    def make_init_state(self, ctx: RoundCtx, io) -> LVState:
+        return LVState(
+            x=jnp.asarray(io["initial_value"], dtype=jnp.int32),
+            ts=jnp.asarray(-1, dtype=jnp.int32),
+            ready=jnp.asarray(False),
+            commit=jnp.asarray(False),
+            vote=jnp.asarray(0, dtype=jnp.int32),
+            decided=jnp.asarray(False),
+            decision=jnp.asarray(-1, dtype=jnp.int32),
+        )
+
+    def decided(self, state: LVState):
+        return state.decided
+
+    def decision(self, state: LVState):
+        return state.decision

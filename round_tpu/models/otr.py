@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from round_tpu.core.algorithm import Algorithm
 from round_tpu.core.rounds import Round, RoundCtx, broadcast
+from round_tpu.models.common import ghost_decide
 from round_tpu.ops.mailbox import Mailbox
 
 
@@ -41,15 +42,10 @@ class OtrRound(Round):
         v_count = mbox.count(lambda vals: vals == v)
         super_quorum = quorum & (v_count > (2 * n) // 3)
 
-        x = jnp.where(quorum, v, state.x)
-        newly = super_quorum & ~state.decided
-        decided = state.decided | super_quorum
-        decision = jnp.where(newly, v, state.decision)
-
-        after = jnp.where(decided, state.after - 1, state.after)
-        ctx.exit_at_end_of_round(decided & (after <= 0))
-
-        return state.replace(x=x, decided=decided, decision=decision, after=after)
+        state = ghost_decide(state, super_quorum, v)
+        after = jnp.where(state.decided, state.after - 1, state.after)
+        ctx.exit_at_end_of_round(state.decided & (after <= 0))
+        return state.replace(x=jnp.where(quorum, v, state.x), after=after)
 
 
 class OTR(Algorithm):

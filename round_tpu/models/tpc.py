@@ -1,0 +1,102 @@
+"""Two-Phase Commit in the HO model.
+
+Protocol (reference: example/TwoPhaseCommit.scala:16-81): a fixed coordinator
+(from the IO, not rotating):
+
+  round 0: coord broadcasts PrepareCommit (placeholder payload).
+  round 1: everyone sends its vote (canCommit) to coord; coord decides
+           Some(true) iff it heard *all n* votes and all are yes, else
+           Some(false).
+  round 2: coord broadcasts the decision; receivers adopt it if present and
+           decide — deciding None means the coordinator is suspected of a
+           crash (TpcIO.decide doc, TwoPhaseCommit.scala:13).
+
+Decision encoding: int32 {-1 = None (suspect), 0 = abort, 1 = commit}.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import Round, RoundCtx, broadcast, unicast
+from round_tpu.ops.mailbox import Mailbox
+
+DEC_NONE = -1
+DEC_ABORT = 0
+DEC_COMMIT = 1
+
+
+@flax.struct.dataclass
+class TpcState:
+    coord: jnp.ndarray     # int32, fixed coordinator id
+    vote: jnp.ndarray      # bool, this process's canCommit
+    decision: jnp.ndarray  # int32 in {-1, 0, 1}
+    decided: jnp.ndarray   # bool (ghost: callback fired)
+
+
+class TpcPrepare(Round):
+    def send(self, ctx: RoundCtx, state: TpcState):
+        return broadcast(ctx, jnp.asarray(True), guard=ctx.id == state.coord)
+
+    def update(self, ctx: RoundCtx, state: TpcState, mbox: Mailbox):
+        return state  # nothing to do (TwoPhaseCommit.scala:42-44)
+
+
+class TpcVote(Round):
+    def send(self, ctx: RoundCtx, state: TpcState):
+        return unicast(ctx, state.coord, state.vote)
+
+    def update(self, ctx: RoundCtx, state: TpcState, mbox: Mailbox):
+        n = ctx.n
+        is_coord = ctx.id == state.coord
+        all_yes = (mbox.size() == n) & mbox.forall(lambda v: v)
+        dec = jnp.where(all_yes, DEC_COMMIT, DEC_ABORT).astype(jnp.int32)
+        return state.replace(decision=jnp.where(is_coord, dec, state.decision))
+
+
+class TpcCommit(Round):
+    def send(self, ctx: RoundCtx, state: TpcState):
+        return broadcast(
+            ctx, state.decision == DEC_COMMIT, guard=ctx.id == state.coord
+        )
+
+    def update(self, ctx: RoundCtx, state: TpcState, mbox: Mailbox):
+        got = mbox.size() > 0
+        v = jnp.where(mbox.any_value(), DEC_COMMIT, DEC_ABORT).astype(jnp.int32)
+        ctx.exit_at_end_of_round(True)
+        return state.replace(
+            decision=jnp.where(got, v, state.decision),
+            decided=jnp.asarray(True),
+        )
+
+
+class TwoPhaseCommit(Algorithm):
+    """2PC with a fixed coordinator; one 3-round phase, always terminates."""
+
+    def __init__(self):
+        self.rounds = (TpcPrepare(), TpcVote(), TpcCommit())
+
+    def make_init_state(self, ctx: RoundCtx, io) -> TpcState:
+        return TpcState(
+            coord=jnp.asarray(io["coord"], dtype=jnp.int32),
+            vote=jnp.asarray(io["can_commit"], dtype=bool),
+            decision=jnp.asarray(DEC_NONE, dtype=jnp.int32),
+            decided=jnp.asarray(False),
+        )
+
+    def decided(self, state: TpcState):
+        return state.decided
+
+    def decision(self, state: TpcState):
+        return state.decision
+
+
+def tpc_io(coord, can_commit) -> dict:
+    cc = jnp.asarray(can_commit)
+    n = cc.shape[-1]
+    return {
+        "coord": jnp.broadcast_to(jnp.asarray(coord, dtype=jnp.int32), cc.shape[:-1] + (n,)),
+        "can_commit": cc,
+    }

@@ -1,0 +1,127 @@
+"""BenOr: deterministic fast path + safety properties under lossy networks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.engine.executor import run_instance, simulate
+from round_tpu.engine import scenarios
+from round_tpu.models.benor import BenOr, VOTE_NONE, VOTE_TRUE
+
+
+def _io(vals):
+    return {"initial_value": jnp.asarray(vals, dtype=bool)}
+
+
+def test_unanimous_true_decides_true():
+    """All start true: phase 0 sets vote=Some(true) then x=true+canDecide;
+    phase 1 round 1 decides true (global round r = 2)."""
+    n = 5
+    ho = np.ones((6, n, n), dtype=bool)
+    res = run_instance(
+        BenOr(),
+        _io([True] * n),
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.from_schedule(jnp.asarray(ho)),
+        max_phases=3,
+    )
+    assert res.state.decided.all()
+    assert res.state.decision.all()  # decision == true
+    assert res.decided_round.tolist() == [2] * n
+    assert res.done.all()
+
+
+def test_unanimous_false_decides_false():
+    n = 4
+    ho = np.ones((6, n, n), dtype=bool)
+    res = run_instance(
+        BenOr(),
+        _io([False] * n),
+        n,
+        jax.random.PRNGKey(1),
+        scenarios.from_schedule(jnp.asarray(ho)),
+        max_phases=3,
+    )
+    assert res.state.decided.all()
+    assert not res.state.decision.any()
+
+
+def test_majority_true_full_network():
+    """4-of-5 true: round 1 count(true)=4 > n/2 -> everyone votes true;
+    round 2: 5 votes Some(true) > n/2 -> x=true, canDecide; decide true."""
+    n = 5
+    ho = np.ones((6, n, n), dtype=bool)
+    res = run_instance(
+        BenOr(),
+        _io([True, True, True, True, False]),
+        n,
+        jax.random.PRNGKey(2),
+        scenarios.from_schedule(jnp.asarray(ho)),
+        max_phases=3,
+    )
+    assert res.state.decided.all()
+    assert res.state.decision.all()
+
+
+def test_vote_semantics_first_phase():
+    """Mid-phase state check: with a 2/3 true split and full HO, votes are
+    Some(true) for everyone after round 1 (count > n/2)."""
+    n = 3
+    ho = np.ones((1, n, n), dtype=bool)
+
+    algo = BenOr()
+    res = run_instance(
+        algo,
+        _io([True, True, False]),
+        n,
+        jax.random.PRNGKey(3),
+        scenarios.from_schedule(jnp.asarray(ho)),
+        max_phases=1,
+    )
+    # after one full phase (2 rounds): round1 votes = true (2 > 3/2=1),
+    # round2: 3 x Some(true) > n/2 -> x=true, canDecide=true
+    assert res.state.vote.tolist() == [VOTE_TRUE] * n
+    assert res.state.x.tolist() == [True] * n
+    assert res.state.can_decide.tolist() == [True] * n
+    assert not res.state.decided.any()  # decision fires next phase
+
+
+def test_agreement_under_majority_ho():
+    """Safety under the algorithm's own safety predicate: every receiver
+    hears a majority each round (BenOr.scala:96 safetyPredicate
+    ``P.forall(p => p.HO.size > n/2)``) — under arbitrary omission without
+    that quorum, Ben-Or is genuinely unsafe (a voteless receiver flips a
+    coin against an ongoing decision)."""
+    n = 7
+    res = simulate(
+        BenOr(),
+        _io([True, False, True, False, True, False, True]),
+        n,
+        jax.random.PRNGKey(7),
+        scenarios.quorum_omission(n, 0.35, lambda m: m // 2 + 1),
+        max_phases=20,
+        n_scenarios=48,
+    )
+    dec = np.asarray(res.state.decided)
+    decv = np.asarray(res.state.decision)
+    for s in range(48):
+        vals = set(decv[s][dec[s]].tolist())
+        assert len(vals) <= 1, f"scenario {s} violated agreement: {vals}"
+
+
+def test_terminates_whp_with_quorum():
+    """Under guaranteed majority quorums, termination happens w.h.p. within
+    a generous horizon (randomized, but the PRNG is fixed)."""
+    n = 5
+    res = simulate(
+        BenOr(),
+        _io([True, False, False, True, True]),
+        n,
+        jax.random.PRNGKey(11),
+        scenarios.quorum_omission(n, 0.1, lambda m: m // 2 + 1),
+        max_phases=40,
+        n_scenarios=16,
+    )
+    dec = np.asarray(res.state.decided)
+    assert dec.all(), f"undecided lanes: {np.argwhere(~dec)}"

@@ -1,0 +1,102 @@
+"""FloodMin: decision parity with a pure-Python oracle of FloodMin.scala."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.engine.executor import run_instance, simulate
+from round_tpu.engine import scenarios
+from round_tpu.models.floodmin import FloodMin
+from round_tpu.models.common import consensus_io
+
+
+def _oracle(init, ho_schedule, f):
+    n = len(init)
+    x = list(init)
+    decided = [False] * n
+    decision = [None] * n
+    exited = [False] * n
+    for r, ho in enumerate(ho_schedule):
+        sent = list(x)
+        was = list(exited)
+        for j in range(n):
+            if was[j]:
+                continue
+            mb = [sent[i] for i in range(n) if ho[j][i] and not was[i]]
+            x[j] = min([x[j]] + mb)
+            if r > f:
+                if not decided[j]:
+                    decision[j] = x[j]
+                decided[j] = True
+                exited[j] = True
+    return x, decided, decision, exited
+
+
+def _run(init, ho, f, phases):
+    n = len(init)
+    return run_instance(
+        FloodMin(f),
+        consensus_io(init),
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.from_schedule(jnp.asarray(np.array(ho))),
+        max_phases=phases,
+    )
+
+
+def test_full_network_decides_min():
+    init = [7, 3, 9, 5]
+    f = 1
+    T = 4
+    ho = np.ones((T, 4, 4), dtype=bool)
+    res = _run(init, ho, f, T)
+    assert res.state.decided.all()
+    assert res.state.decision.tolist() == [3, 3, 3, 3]
+    assert res.decided_round.tolist() == [f + 1] * 4  # decide at r > f
+
+
+def test_oracle_parity_random_ho():
+    rng = np.random.RandomState(11)
+    for trial in range(6):
+        n = int(rng.randint(3, 7))
+        f = int(rng.randint(0, 3))
+        T = f + 3
+        init = rng.randint(0, 50, size=n).tolist()
+        ho = rng.rand(T, n, n) < 0.7
+        for t in range(T):
+            np.fill_diagonal(ho[t], True)
+        res = _run(init, ho, f, T)
+        ox, odec, odecv, oexit = _oracle(init, ho, f)
+        assert res.state.x.tolist() == ox, (trial, init)
+        assert res.state.decided.tolist() == odec
+        assert res.done.tolist() == oexit
+        for j in range(n):
+            if odec[j]:
+                assert int(res.state.decision[j]) == odecv[j]
+
+
+def test_crash_f_agreement():
+    """With f crashed from round 0 and a synchronous network otherwise,
+    survivors agree (the min floods everywhere in f+1 rounds)."""
+    n, f = 8, 2
+    res = simulate(
+        FloodMin(f),
+        consensus_io(list(range(10, 10 + n))),
+        n,
+        jax.random.PRNGKey(5),
+        scenarios.crash(n, f),
+        max_phases=f + 2,
+        n_scenarios=16,
+    )
+    dec = np.asarray(res.state.decided)
+    decv = np.asarray(res.state.decision)
+    assert dec.all()  # synchronous: everyone (incl. crashed lanes' sims) decides
+    # reconstruct each scenario's crashed set (same key schedule as the engine:
+    # scenario key -> split -> ho_key -> fold_in(0x5EED) -> permutation < f)
+    keys = jax.random.split(jax.random.PRNGKey(5), 16)
+    for s in range(16):
+        ho_key, _ = jax.random.split(keys[s])
+        k = jax.random.fold_in(ho_key, 0x5EED)
+        crashed = np.asarray(jax.random.permutation(k, n) < f)
+        vals = set(decv[s][~crashed].tolist())
+        assert len(vals) == 1, f"scenario {s}: survivors disagree {vals}"

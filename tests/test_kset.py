@@ -1,0 +1,114 @@
+"""k-set agreement: KSetAgreement map merging + KSetEarlyStopping."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.engine.executor import run_instance, simulate
+from round_tpu.engine import scenarios
+from round_tpu.models.kset import KSetAgreement, KSetEarlyStopping
+from round_tpu.models.common import consensus_io
+
+
+def test_kset_full_network_converges_to_min():
+    """Full HO: round 0 merges everything, round 1 promotes everyone to
+    decider (n same maps > n-k), round 2 decides min of all inputs."""
+    n, k = 4, 2
+    init = [9, 4, 7, 6]
+    ho = np.ones((4, n, n), dtype=bool)
+    res = run_instance(
+        KSetAgreement(k),
+        consensus_io(init),
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.from_schedule(jnp.asarray(ho)),
+        max_phases=4,
+    )
+    assert res.state.decided.all()
+    assert res.state.decision.tolist() == [4] * n
+    assert res.decided_round.tolist() == [2] * n
+    # everyone ends with the full map
+    assert res.state.t_mask.all()
+
+
+def test_kset_decider_adoption():
+    """A decider's map is adopted verbatim by processes that hear it."""
+    n, k = 4, 2
+    # process 0 sees everyone round 0 (merges full map), others see only self
+    ho0 = np.eye(n, dtype=bool)
+    ho0[0, :] = True
+    # round 1: 0 not yet decider (maps differ). give 0 full view again:
+    # same-count for 0 is 1 (only self matches) -> merge keeps map.
+    # rounds 2+: full network
+    ho = np.stack([ho0, ho0] + [np.ones((n, n), dtype=bool)] * 4)
+    res = run_instance(
+        KSetAgreement(k),
+        consensus_io([5, 3, 8, 1]),
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.from_schedule(jnp.asarray(ho)),
+        max_phases=6,
+    )
+    # everyone eventually decides, decisions within k values from the inputs
+    assert res.state.decided.all()
+    vals = set(res.state.decision.tolist())
+    assert len(vals) <= k
+    assert vals <= {5, 3, 8, 1}
+
+
+def test_kset_at_most_k_decisions_under_crash():
+    n, k, f = 6, 2, 1  # f < k
+    init = [17, 3, 11, 8, 25, 6]
+    res = simulate(
+        KSetAgreement(k),
+        consensus_io(init),
+        n,
+        jax.random.PRNGKey(5),
+        scenarios.crash(n, f),
+        max_phases=8,
+        n_scenarios=24,
+    )
+    dec = np.asarray(res.state.decided)
+    decv = np.asarray(res.state.decision)
+    for s in range(24):
+        vals = set(decv[s][dec[s]].tolist())
+        assert len(vals) <= k, f"scenario {s}: {vals}"
+        assert vals <= set(init), f"scenario {s}: decision outside V0"
+
+
+def test_kset_es_full_network():
+    """Early stopping: no crashes between rounds 0 and 1 (lastNb - currNb =
+    0 < k) sets canDecide; decide at round 1 with the global min."""
+    n, t, k = 5, 2, 2
+    init = [12, 5, 9, 31, 7]
+    ho = np.ones((4, n, n), dtype=bool)
+    res = run_instance(
+        KSetEarlyStopping(t, k),
+        consensus_io(init),
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.from_schedule(jnp.asarray(ho)),
+        max_phases=4,
+    )
+    assert res.state.decided.all()
+    assert res.state.decision.tolist() == [5] * n
+    assert res.decided_round.tolist() == [1] * n
+
+
+def test_kset_es_horizon_decision():
+    """Even with churn suppressing the early path, r > t/k forces a decision."""
+    n, t, k = 6, 4, 2
+    res = simulate(
+        KSetEarlyStopping(t, k),
+        consensus_io([40, 10, 33, 21, 15, 28]),
+        n,
+        jax.random.PRNGKey(8),
+        scenarios.omission(n, 0.3),
+        max_phases=t // k + 3,
+        n_scenarios=16,
+    )
+    dec = np.asarray(res.state.decided)
+    assert dec.all()
+    decv = np.asarray(res.state.decision)
+    init = {40, 10, 33, 21, 15, 28}
+    assert set(decv.reshape(-1).tolist()) <= init

@@ -1,0 +1,167 @@
+"""LastVoting: round-by-round parity with a pure-Python oracle of
+LastVoting.scala's 4-round phase (collect / propose / ack / decide)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.engine.executor import run_instance, simulate
+from round_tpu.engine import scenarios
+from round_tpu.models.lastvoting import LastVoting
+from round_tpu.models.common import consensus_io
+
+
+def _oracle(init, ho_schedule):
+    n = len(init)
+    x = list(init)
+    ts = [-1] * n
+    ready = [False] * n
+    commit = [False] * n
+    vote = [0] * n
+    decided = [False] * n
+    decision = [None] * n
+    exited = [False] * n
+    for r in range(len(ho_schedule)):
+        ho = ho_schedule[r]
+        coord = (r // 4) % n
+        phase_round = r % 4
+        phase = r // 4
+        sends = {}
+        for i in range(n):
+            if exited[i]:
+                continue
+            if phase_round == 0:
+                sends[i] = ({coord}, (x[i], ts[i]))
+            elif phase_round == 1:
+                dests = set(range(n)) if (i == coord and commit[i]) else set()
+                sends[i] = (dests, vote[i])
+            elif phase_round == 2:
+                sends[i] = ({coord} if ts[i] == phase else set(), x[i])
+            else:
+                dests = set(range(n)) if (i == coord and ready[i]) else set()
+                sends[i] = (dests, vote[i])
+        new_exited = list(exited)
+        for j in range(n):
+            if exited[j]:
+                continue
+            mb = {i: p for i, (d, p) in sends.items() if j in d and ho[j][i]}
+            if phase_round == 0:
+                if j == coord and (len(mb) > n // 2 or (r == 0 and mb)):
+                    # maxBy ts, ties -> smallest sender id
+                    best = min(mb.items(), key=lambda kv: (-kv[1][1], kv[0]))
+                    vote[j] = best[1][0]
+                    commit[j] = True
+            elif phase_round == 1:
+                if coord in mb:
+                    x[j] = mb[coord]
+                    ts[j] = phase
+            elif phase_round == 2:
+                if j == coord and len(mb) > n // 2:
+                    ready[j] = True
+            else:
+                if coord in mb:
+                    if not decided[j]:
+                        decision[j] = mb[coord]
+                    decided[j] = True
+                    new_exited[j] = True
+                ready[j] = False
+                commit[j] = False
+        exited = new_exited
+    return x, ts, decided, decision, exited
+
+
+def _run(init, ho, phases):
+    n = len(init)
+    return run_instance(
+        LastVoting(),
+        consensus_io(init),
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.from_schedule(jnp.asarray(np.array(ho))),
+        max_phases=phases,
+    )
+
+
+def test_full_network_one_phase():
+    init = [4, 7, 2, 9]
+    ho = np.ones((4, 4, 4), dtype=bool)
+    res = _run(init, ho, phases=1)
+    # all ts = -1: coord 0 adopts smallest-id sender's x = 4
+    assert res.state.decided.all()
+    assert res.state.decision.tolist() == [4, 4, 4, 4]
+    assert res.decided_round.tolist() == [3, 3, 3, 3]
+    assert res.done.all()
+
+
+def test_oracle_parity_random_ho():
+    rng = np.random.RandomState(23)
+    for trial in range(6):
+        n = int(rng.randint(3, 7))
+        phases = 3
+        T = 4 * phases
+        init = rng.randint(1, 40, size=n).tolist()
+        ho = rng.rand(T, n, n) < 0.75
+        for t in range(T):
+            np.fill_diagonal(ho[t], True)
+        res = _run(init, ho, phases)
+        ox, ots, odec, odecv, oexit = _oracle(init, ho)
+        assert res.state.x.tolist() == ox, (trial, init)
+        assert res.state.ts.tolist() == ots
+        assert res.state.decided.tolist() == odec
+        assert res.done.tolist() == oexit
+        for j in range(n):
+            if odec[j]:
+                assert int(res.state.decision[j]) == odecv[j]
+
+
+def test_coordinator_down_blocks_then_heals():
+    """While every phase's coordinator is crashed nobody decides; once the
+    network heals (full HO), the next phase decides."""
+    n = 4
+    down = np.ones((8, n, n), dtype=bool)
+    for r in range(8):
+        coord = (r // 4) % n
+        down[r, :, coord] = False
+        np.fill_diagonal(down[r], True)
+    healed = np.ones((4, n, n), dtype=bool)
+    ho = np.concatenate([down, healed])
+    res = _run([5, 6, 7, 8], ho, phases=3)
+    assert res.state.decided.all()
+    assert (np.asarray(res.decided_round) == 11).all()  # round 3 of phase 2
+
+
+def test_agreement_and_irrevocability_under_omission():
+    n = 5
+    res = simulate(
+        LastVoting(),
+        consensus_io([1, 2, 3, 4, 5]),
+        n,
+        jax.random.PRNGKey(9),
+        scenarios.omission(n, 0.3),
+        max_phases=8,
+        n_scenarios=32,
+    )
+    dec = np.asarray(res.state.decided)
+    decv = np.asarray(res.state.decision)
+    init = [1, 2, 3, 4, 5]
+    for s in range(32):
+        vals = set(decv[s][dec[s]].tolist())
+        assert len(vals) <= 1, f"scenario {s} violated agreement: {vals}"
+        for v in vals:
+            assert v in init, f"scenario {s} violated validity: {v}"
+
+
+def test_liveness_under_quorum_omission():
+    """With every receiver guaranteed a majority quorum, some phase has a
+    correct coordinator and everyone decides."""
+    n = 5
+    res = simulate(
+        LastVoting(),
+        consensus_io([3, 1, 4, 1, 5]),
+        n,
+        jax.random.PRNGKey(2),
+        scenarios.quorum_omission(n, 0.2, lambda m: m // 2 + 1),
+        max_phases=6,
+        n_scenarios=16,
+    )
+    assert bool(np.asarray(res.state.decided).all())

@@ -1,0 +1,98 @@
+"""TwoPhaseCommit: commit/abort/suspect decision semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.engine.executor import run_instance, simulate
+from round_tpu.engine import scenarios
+from round_tpu.models.tpc import (
+    TwoPhaseCommit,
+    tpc_io,
+    DEC_NONE,
+    DEC_ABORT,
+    DEC_COMMIT,
+)
+
+
+def _run(coord, votes, ho, phases=1):
+    n = len(votes)
+    return run_instance(
+        TwoPhaseCommit(),
+        tpc_io(coord, votes),
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.from_schedule(jnp.asarray(np.array(ho))),
+        max_phases=phases,
+    )
+
+
+def test_all_yes_commits():
+    n = 4
+    ho = np.ones((3, n, n), dtype=bool)
+    res = _run(0, [True] * n, ho)
+    assert res.state.decided.all()
+    assert res.state.decision.tolist() == [DEC_COMMIT] * n
+    assert res.done.all()
+
+
+def test_one_no_aborts():
+    n = 4
+    ho = np.ones((3, n, n), dtype=bool)
+    res = _run(0, [True, True, False, True], ho)
+    assert res.state.decision.tolist() == [DEC_ABORT] * n
+
+
+def test_lost_vote_aborts():
+    """The coordinator must hear all n votes to commit; one lost vote in the
+    voting round forces abort (TwoPhaseCommit.scala:53)."""
+    n = 4
+    ho = np.ones((3, n, n), dtype=bool)
+    ho[1, 0, 2] = False  # coord 0 misses process 2's vote
+    res = _run(0, [True] * n, ho)
+    assert res.state.decision.tolist() == [DEC_ABORT] * n
+
+
+def test_crashed_coordinator_suspected():
+    """Nobody hears the coordinator in the commit round: everyone else
+    decides None (suspect), the coordinator itself knows the outcome."""
+    n = 4
+    ho = np.ones((3, n, n), dtype=bool)
+    ho[:, :, 0] = False  # nobody hears coord 0
+    np.fill_diagonal(ho[0], True)
+    np.fill_diagonal(ho[1], True)
+    np.fill_diagonal(ho[2], True)
+    res = _run(0, [True] * n, ho)
+    assert res.state.decided.all()  # everyone "decides" (possibly None)
+    dec = res.state.decision.tolist()
+    # the coord's inbound links are intact: it hears all votes and commits
+    assert dec[0] == DEC_COMMIT
+    assert dec[1:] == [DEC_NONE] * 3  # others suspect the coordinator
+
+
+def test_nondefault_coordinator():
+    n = 5
+    ho = np.ones((3, n, n), dtype=bool)
+    res = _run(3, [True] * n, ho)
+    assert res.state.decision.tolist() == [DEC_COMMIT] * n
+
+
+def test_uniform_agreement_under_omission():
+    """Whoever reaches a non-None decision agrees (uniform agreement), and
+    commit implies every vote was yes."""
+    n = 4
+    votes = [True, True, True, False]
+    res = simulate(
+        TwoPhaseCommit(),
+        tpc_io(0, votes),
+        n,
+        jax.random.PRNGKey(3),
+        scenarios.omission(n, 0.25),
+        max_phases=1,
+        n_scenarios=64,
+    )
+    decv = np.asarray(res.state.decision)
+    for s in range(64):
+        reached = set(v for v in decv[s].tolist() if v != DEC_NONE)
+        assert len(reached) <= 1, f"scenario {s}: {reached}"
+        assert DEC_COMMIT not in reached  # one vote was no
