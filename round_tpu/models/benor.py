@@ -27,6 +27,7 @@ from round_tpu.core.algorithm import Algorithm
 from round_tpu.core.rounds import Round, RoundCtx, broadcast
 from round_tpu.models.common import ghost_decide
 from round_tpu.ops.mailbox import Mailbox
+from round_tpu.spec.dsl import Spec, implies
 
 VOTE_NONE = -1
 VOTE_FALSE = 0
@@ -101,11 +102,74 @@ class BenOrRound2(Round):
         )
 
 
+class BenOrSpec(Spec):
+    """BenOr.scala:92-119, checked on traces.
+
+    Safety needs every receiver to hear a majority each round (the spec's
+    safetyPredicate, BenOr.scala:96) — under that assumption the invariant
+    says: either nobody is committed yet, or a majority holds some value v
+    and every decision/defined vote is on v.
+    """
+
+    def _safety(self, e):
+        return e.P.forall(lambda p: p.HO.size > e.n // 2)
+
+    def _inv0(self, e):
+        P = e.P
+        V = e.values(jnp.asarray([False, True]))
+        fresh = P.forall(lambda i: ~i.decided & ~i.can_decide)
+        locked = V.exists(
+            lambda v: (P.filter(lambda i: i.x == v).size > e.n // 2)
+            & P.forall(
+                lambda i: implies(i.decided, i.decision == v)
+                & implies(i.vote != VOTE_NONE, i.vote == v.astype(jnp.int32))
+            )
+        )
+        return fresh | locked
+
+    def _vote_majority(self, e):
+        # roundInvariants[0]: a defined vote names a majority value
+        # (BenOr.scala:112-114); holds after the first round of a phase.
+        P = e.P
+        return P.forall(
+            lambda p: implies(
+                p.vote != VOTE_NONE,
+                P.filter(lambda i: i.x == (p.vote == VOTE_TRUE)).size > e.n // 2,
+            )
+        )
+
+    def __init__(self):
+        self.safety_predicate = self._safety
+        self.invariants = (self._inv0,)
+        self.round_invariants = ((self._vote_majority,),)
+        self.properties = (
+            (
+                "Agreement",
+                lambda e: e.P.forall(
+                    lambda i: e.P.forall(
+                        lambda j: implies(
+                            i.decided & j.decided, i.decision == j.decision
+                        )
+                    )
+                ),
+            ),
+            (
+                "Irrevocability",
+                lambda e: e.P.forall(
+                    lambda i: implies(
+                        i.old.decided, i.decided & (i.old.decision == i.decision)
+                    )
+                ),
+            ),
+        )
+
+
 class BenOr(Algorithm):
     """Randomized binary consensus; terminates with probability 1."""
 
     def __init__(self):
         self.rounds = (BenOrRound1(), BenOrRound2())
+        self.spec = BenOrSpec()
 
     def make_init_state(self, ctx: RoundCtx, io) -> BenOrState:
         return BenOrState(

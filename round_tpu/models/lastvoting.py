@@ -30,6 +30,7 @@ from round_tpu.core.algorithm import Algorithm
 from round_tpu.core.rounds import Round, RoundCtx, SendSpec, broadcast, unicast
 from round_tpu.models.common import ghost_decide
 from round_tpu.ops.mailbox import Mailbox
+from round_tpu.spec.dsl import Spec, implies
 
 
 @flax.struct.dataclass
@@ -109,11 +110,111 @@ class LVDecide(Round):
         return state.replace(ready=jnp.asarray(False), commit=jnp.asarray(False))
 
 
+class LVSpec(Spec):
+    """LastVoting.scala:19-70, checked on traces at phase boundaries.
+
+    The phase invariant (``safetyInv``): either nothing is decided/ready yet,
+    or some value v backed by a majority of timestamps ≥ t locks every
+    decision, commit and ready vote to v.  Evaluate with the engine's
+    post-state round convention (env.r = recorded round + 1), at steps where
+    env.r % 4 == 0 — i.e. between phases, where the reference states it.
+    """
+
+    def _liveness(self, e):
+        def good_coord(p):
+            return e.P.forall(
+                lambda q: (p.id == (e.r // 4) % e.n)
+                & p.HO.contains(q)
+                & (p.HO.size > e.n // 2)
+            )
+
+        return e.P.exists(good_coord)
+
+    def _no_decision(self, e):
+        return e.P.forall(lambda i: ~i.decided & ~i.ready)
+
+    def _majority(self, e):
+        P = e.P
+        V = e.values(e.state.x, e.state.vote)
+        T_dom = e.values(e.state.ts)
+        coord = e.proc((e.r // 4) % e.n)
+
+        def with_v_t(v, t):
+            A = P.filter(lambda i: i.ts >= t)
+            return (
+                (A.size > e.n // 2)
+                & (e.r > 0)
+                & (t <= e.r // 4)
+                & P.forall(
+                    lambda i: implies(A.contains(i), i.x == v)
+                    & implies(i.decided, i.decision == v)
+                    & implies(i.commit, i.vote == v)
+                    & implies(i.ready, i.vote == v)
+                    & implies(i.ts == e.r // 4, coord.commit)
+                )
+            )
+
+        return V.exists(lambda v: T_dom.exists(lambda t: with_v_t(v, t)))
+
+    def _keep_init(self, e):
+        return e.P.forall(lambda i: e.P.exists(lambda j: i.x == j.init.x))
+
+    def _inv0(self, e):
+        return self._keep_init(e) & (self._no_decision(e) | self._majority(e))
+
+    def _inv1(self, e):
+        return e.P.exists(
+            lambda j: e.P.forall(lambda i: i.decided & (i.decision == j.init.x))
+        )
+
+    def __init__(self):
+        self.liveness_predicate = (self._liveness,)
+        self.invariants = (self._inv0, self._inv1)
+        self.properties = (
+            ("Termination", lambda e: e.P.forall(lambda i: i.decided)),
+            (
+                "Agreement",
+                lambda e: e.P.forall(
+                    lambda i: e.P.forall(
+                        lambda j: implies(
+                            i.decided & j.decided, i.decision == j.decision
+                        )
+                    )
+                ),
+            ),
+            (
+                "Validity",
+                lambda e: e.P.forall(
+                    lambda i: implies(
+                        i.decided, e.P.exists(lambda j: j.init.x == i.decision)
+                    )
+                ),
+            ),
+            (
+                "Integrity",
+                lambda e: e.P.exists(
+                    lambda j: e.P.forall(
+                        lambda i: implies(i.decided, i.decision == j.init.x)
+                    )
+                ),
+            ),
+            (
+                "Irrevocability",
+                lambda e: e.P.forall(
+                    lambda i: implies(
+                        i.old.decided, i.decided & (i.old.decision == i.decision)
+                    )
+                ),
+            ),
+        )
+
+
 class LastVoting(Algorithm):
     """Paxos-style consensus with rotating coordinator (4-round phases)."""
 
     def __init__(self):
         self.rounds = (LVCollect(), LVPropose(), LVAck(), LVDecide())
+        self.spec = LVSpec()
 
     def make_init_state(self, ctx: RoundCtx, io) -> LVState:
         return LVState(

@@ -20,6 +20,7 @@ from round_tpu.core.algorithm import Algorithm
 from round_tpu.core.rounds import Round, RoundCtx, broadcast
 from round_tpu.models.common import ghost_decide
 from round_tpu.ops.mailbox import Mailbox
+from round_tpu.spec.dsl import Spec, implies
 
 
 @flax.struct.dataclass
@@ -48,12 +49,96 @@ class OtrRound(Round):
         return state.replace(x=jnp.where(quorum, v, state.x), after=after)
 
 
+def _keep_init(e):
+    """Every estimate is some process's initial value (Otr.scala:102,107)."""
+    P = e.P
+    return P.forall(lambda i: P.exists(lambda j: i.x == j.init.x))
+
+
+def _decided_on(P, v):
+    return P.forall(lambda i: implies(i.decided, i.decision == v))
+
+
+class OtrSpec(Spec):
+    """Otr.scala:94-120, checked on traces instead of proven."""
+
+    def _good_round(self, e):
+        # S.exists(s => P.forall(p => p.HO == s && s.size > 2n/3))  (:95)
+        return e.S.exists(
+            lambda s: e.P.forall(lambda p: (p.HO == s) & (s.size > 2 * e.n // 3))
+        )
+
+    def _inv0(self, e):
+        P, V = e.P, e.values(e.state.x)
+        no_decision = P.forall(lambda i: ~i.decided)
+        quorum_on_v = V.exists(
+            lambda v: (P.filter(lambda i: i.x == v).size > 2 * e.n // 3)
+            & _decided_on(P, v)
+        )
+        return (no_decision | quorum_on_v) & _keep_init(e)
+
+    def _inv1(self, e):
+        P, V = e.P, e.values(e.state.x)
+        all_on_v = V.exists(
+            lambda v: (P.filter(lambda i: i.x == v).size == e.n) & _decided_on(P, v)
+        )
+        return all_on_v & _keep_init(e)
+
+    def _inv2(self, e):
+        P = e.P
+        return P.exists(
+            lambda j: P.forall(lambda i: i.decided & (i.decision == j.init.x))
+        )
+
+    def __init__(self):
+        self.liveness_predicate = (self._good_round, self._good_round)
+        self.invariants = (self._inv0, self._inv1, self._inv2)
+        self.properties = (
+            ("Termination", lambda e: e.P.forall(lambda i: i.decided)),
+            (
+                "Agreement",
+                lambda e: e.P.forall(
+                    lambda i: e.P.forall(
+                        lambda j: implies(
+                            i.decided & j.decided, i.decision == j.decision
+                        )
+                    )
+                ),
+            ),
+            (
+                "Validity",
+                lambda e: e.P.forall(
+                    lambda i: implies(
+                        i.decided, e.P.exists(lambda j: j.init.x == i.decision)
+                    )
+                ),
+            ),
+            (
+                "Integrity",
+                lambda e: e.P.exists(
+                    lambda j: e.P.forall(
+                        lambda i: implies(i.decided, i.decision == j.init.x)
+                    )
+                ),
+            ),
+            (
+                "Irrevocability",
+                lambda e: e.P.forall(
+                    lambda i: implies(
+                        i.old.decided, i.decided & (i.old.decision == i.decision)
+                    )
+                ),
+            ),
+        )
+
+
 class OTR(Algorithm):
     """One-Third-Rule consensus over int payloads."""
 
     def __init__(self, after_decision: int = 2):
         self.after_decision = after_decision
         self.rounds = (OtrRound(),)
+        self.spec = OtrSpec()
 
     def make_init_state(self, ctx: RoundCtx, io) -> OtrState:
         return OtrState(

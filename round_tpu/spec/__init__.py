@@ -1,0 +1,43 @@
+"""Specification layer: properties and invariants as masked tensor reductions.
+
+The reference's spec DSL (Specs.scala:8-41, SpecHelper init/old, the Domain
+forall/exists/filter stubs of Algorithm.scala:91-95) exists to *prove*
+algorithms offline via SMT.  Here the same formulas are *checked* — evaluated
+exactly, per round, over every lane of every simulated scenario, by compiling
+quantifiers to vmapped reductions over the state tensors.  (The offline
+proving pipeline lives in round_tpu.verification.)
+
+Quantifier mapping:
+    P.forall(f)        -> all over a vmapped lane axis
+    P.exists(f)        -> any
+    P.filter(f).size   -> sum of the predicate mask (Cardinality)
+    V.exists(f)        -> any over an explicit candidate-value axis
+    S.exists(f)        -> any over the HO rows (set-domain witnesses)
+    init(x) / old(x)   -> reads of the init / previous-round snapshot tensors
+"""
+
+from round_tpu.spec.dsl import (
+    Env,
+    ProcDomain,
+    ProcView,
+    SetView,
+    Spec,
+    TrivialSpec,
+    ValueDomain,
+    implies,
+)
+from round_tpu.spec.check import SpecReport, check_trace, replay_ho
+
+__all__ = [
+    "Env",
+    "ProcDomain",
+    "ProcView",
+    "SetView",
+    "Spec",
+    "TrivialSpec",
+    "ValueDomain",
+    "implies",
+    "SpecReport",
+    "check_trace",
+    "replay_ho",
+]

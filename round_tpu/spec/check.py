@@ -1,0 +1,169 @@
+"""Trace checking: evaluate a Spec over recorded execution traces.
+
+This is the runtime half of the reference's verification story: instead of
+discharging VCs to an SMT solver (Verifier.scala:234-276), the batched
+simulator records every round's state and the checker evaluates the spec
+formulas *exactly* on each step — over all scenarios at once.  The BASELINE
+"invariant parity" metric is this module agreeing with the JVM semantics.
+
+Conventions:
+  - a trace is the pytree of states stacked over rounds: leaves [T, n, ...]
+    (produced by running the engine with ``record_fn=lambda s, d, r: s``);
+  - ``old`` at step t is the state at t-1 (the init state at t=0);
+  - the HO matrix per step is replayed from the scenario key (the engine's
+    samplers are deterministic functions of (key, r): replay_ho).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from round_tpu.spec.dsl import Env, Spec
+
+
+def replay_ho(key: jax.Array, ho_sampler, rounds: int) -> jnp.ndarray:
+    """Recompute the [T, n, n] HO schedule an engine run drew from ``key``.
+
+    Matches the engine's key discipline (executor.run_phases: the scenario
+    key splits into (ho_key, upd_key) and ho_key is passed unchanged with the
+    round number folded in by the sampler)."""
+    ho_key, _ = jax.random.split(key)
+    return jax.vmap(lambda r: ho_sampler(ho_key, r))(
+        jnp.arange(rounds, dtype=jnp.int32)
+    )
+
+
+@dataclasses.dataclass
+class SpecReport:
+    """Per-step spec evaluation over one trace (or, vmapped, a batch).
+
+    invariant_held: [T, n_inv] bool — invariant i holds at step t.
+    any_invariant:  [T] bool — some invariant of the chain holds at t
+                    (all-True is the expected steady state; vacuously True
+                    when the spec has no invariants).
+    properties:     name -> [T] bool per-step evaluation.
+    safety_ok:      [T] bool — safety_predicate holds at t (True if absent).
+    final_properties: name -> bool at the last step (e.g. Termination).
+    """
+
+    invariant_held: jnp.ndarray
+    any_invariant: jnp.ndarray
+    properties: Dict[str, jnp.ndarray]
+    safety_ok: jnp.ndarray
+    final_properties: Dict[str, jnp.ndarray]
+    round_invariant_ok: Optional[jnp.ndarray] = None  # [T, n_groups], True
+    # where a group doesn't apply to the step's phase-round
+
+    def all_safety_properties_hold(self) -> jnp.ndarray:
+        """Conjunction over steps of every property except Termination
+        (which is a liveness property, meaningful only at the end)."""
+        ok = jnp.asarray(True)
+        for name, vals in self.properties.items():
+            if name.lower() == "termination":
+                continue
+            ok = ok & jnp.all(vals)
+        return ok
+
+
+def _shift_old(trace: Any, init_state: Any) -> Any:
+    """old[t] = trace[t-1], old[0] = init_state."""
+    return jax.tree_util.tree_map(
+        lambda x, i: jnp.concatenate([i[None], x[:-1]], axis=0), trace, init_state
+    )
+
+
+def check_trace(
+    spec: Spec,
+    trace: Any,
+    init_state: Any,
+    n: int,
+    ho: Optional[jnp.ndarray] = None,
+    rounds_per_phase: int = 1,
+    jit: bool = True,
+) -> SpecReport:
+    """Evaluate ``spec`` at every step of one recorded trace.
+
+    Round convention: the engine records the *post*-state of round t, which
+    is the reference's pre-state of round t+1 — so formulas see
+    ``env.r = t + 1`` (the reference states phase invariants at phase
+    boundaries, i.e. where env.r % rounds_per_phase == 0).
+
+    ``spec.round_invariants[j]`` (extra invariants holding after phase round
+    j; Specs.scala:14) is evaluated only at steps with t % k == j and
+    reported True elsewhere.
+
+    Args:
+      spec: the Spec to check.
+      trace: state pytree stacked over rounds, leaves [T, n, ...].
+      init_state: the round-0 initial state, leaves [n, ...].
+      n: number of processes.
+      ho: optional [T, n, n] HO schedule — ho[t] is the matrix round t
+        executed against (required if formulas use p.HO or the set domain;
+        see replay_ho).  The safety_predicate is evaluated against ho[t]
+        with the *pre*-state round number (env.r = t) since it constrains
+        the round being executed.
+      rounds_per_phase: the algorithm's phase length (for round_invariants
+        and the phase arithmetic in formulas).
+    """
+    leaves = jax.tree_util.tree_leaves(trace)
+    T = leaves[0].shape[0]
+    old_trace = _shift_old(trace, init_state)
+    rs = jnp.arange(1, T + 1, dtype=jnp.int32)
+    k = rounds_per_phase
+
+    def at_step(state_t, old_t, ho_t, r_t):
+        env = Env(state=state_t, n=n, old=old_t, init0=init_state, ho=ho_t, r=r_t)
+        inv = (
+            jnp.stack([jnp.asarray(f(env)) for f in spec.invariants])
+            if spec.invariants
+            else jnp.ones((0,), dtype=bool)
+        )
+        props = {name: jnp.asarray(f(env)) for name, f in spec.properties}
+        if spec.safety_predicate is not None:
+            pre_env = Env(
+                state=old_t, n=n, old=None, init0=init_state, ho=ho_t, r=r_t - 1
+            )
+            safe = jnp.asarray(spec.safety_predicate(pre_env))
+        else:
+            safe = jnp.asarray(True)
+        if spec.round_invariants:
+            phase_round = (r_t - 1) % k
+            rinv = jnp.stack(
+                [
+                    jnp.where(
+                        phase_round == j,
+                        jnp.all(jnp.stack([jnp.asarray(f(env)) for f in group]))
+                        if group
+                        else jnp.asarray(True),
+                        True,
+                    )
+                    for j, group in enumerate(spec.round_invariants)
+                ]
+            )
+        else:
+            rinv = None
+        return inv, props, safe, rinv
+
+    def run():
+        if ho is None:
+            return jax.vmap(lambda s, o, r: at_step(s, o, None, r))(
+                trace, old_trace, rs
+            )
+        return jax.vmap(at_step)(trace, old_trace, ho, rs)
+
+    inv, props, safe, rinv = (jax.jit(run) if jit else run)()
+    any_inv = (
+        jnp.any(inv, axis=1) if inv.shape[1] > 0 else jnp.ones((T,), dtype=bool)
+    )
+    return SpecReport(
+        invariant_held=inv,
+        any_invariant=any_inv,
+        properties=props,
+        safety_ok=safe,
+        final_properties={k_: v[-1] for k_, v in props.items()},
+        round_invariant_ok=rinv,
+    )

@@ -1,0 +1,269 @@
+"""The spec DSL: quantified formulas over process state, as JAX reductions.
+
+Users write specs almost verbatim from the reference
+(e.g. Otr.scala:94-120):
+
+    def agreement(e):
+        P = e.P
+        return P.forall(lambda i: P.forall(lambda j: implies(
+            i.decided & j.decided, i.decision == j.decision)))
+
+Each formula is a function of an Env — the evaluation context holding the
+current state, the previous-round snapshot (``old``), the initial snapshot
+(``init``), and the round's HO matrix.  Quantifiers evaluate by vmapping the
+body over a fresh lane axis, so nesting composes and everything stays jit-
+compatible (one fused reduction per formula).
+
+View semantics (reference: SpecHelper, Specs.scala:21-28):
+    i.x          — field x of process i (any field of the state pytree)
+    i.id         — i's ProcessID
+    i.HO         — i's heard-of set this round (SetView over the HO row)
+    i.old.x      — x at the previous step   (old(i.x))
+    i.init.x     — x at initialization      (init(i.x))
+
+State fields named ``old``, ``init``, ``id`` or ``HO`` would shadow these
+accessors; the framework's algorithms avoid those names.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def implies(a, b):
+    """``a ==> b`` (SpecHelper.BoolOps, Specs.scala:22-24)."""
+    return jnp.logical_or(jnp.logical_not(a), b)
+
+
+class _Snapshot:
+    """Field accessor over a state snapshot at a fixed lane index."""
+
+    __slots__ = ("_state", "_idx")
+
+    def __init__(self, state, idx):
+        self._state = state
+        self._idx = idx
+
+    def __getattr__(self, name):
+        return getattr(self._state, name)[self._idx]
+
+
+class ProcView:
+    """One process's view of the world inside a quantifier body."""
+
+    __slots__ = ("_env", "_idx")
+
+    def __init__(self, env: "Env", idx):
+        self._env = env
+        self._idx = idx
+
+    @property
+    def id(self):
+        return self._idx
+
+    @property
+    def HO(self) -> "SetView":
+        ho = self._env.ho
+        if ho is None:
+            raise ValueError("this Env carries no HO matrix (pass ho= to Env)")
+        return SetView(ho[self._idx])
+
+    @property
+    def old(self) -> _Snapshot:
+        if self._env.old is None:
+            raise ValueError("this Env carries no previous-round snapshot")
+        return _Snapshot(self._env.old, self._idx)
+
+    @property
+    def init(self) -> _Snapshot:
+        if self._env.init0 is None:
+            raise ValueError("this Env carries no init snapshot")
+        return _Snapshot(self._env.init0, self._idx)
+
+    def __getattr__(self, name):
+        return getattr(self._env.state, name)[self._idx]
+
+    def __eq__(self, other):
+        if isinstance(other, ProcView):
+            return self._idx == other._idx
+        return self._idx == other
+
+    def __ne__(self, other):
+        return jnp.logical_not(self.__eq__(other))
+
+    __hash__ = None
+
+
+class SetView:
+    """A set of processes as an [n] membership mask (HO sets, filter results).
+
+    Mirrors the set operations the reference specs use: size (Cardinality),
+    contains (∈), == (extensional equality), ∪/∩/⊆.
+    """
+
+    __slots__ = ("mask",)
+
+    def __init__(self, mask: jnp.ndarray):
+        self.mask = mask
+
+    @property
+    def size(self) -> jnp.ndarray:
+        return jnp.sum(self.mask.astype(jnp.int32))
+
+    def contains(self, p) -> jnp.ndarray:
+        idx = p._idx if isinstance(p, ProcView) else p
+        return self.mask[idx]
+
+    def subset_of(self, other: "SetView") -> jnp.ndarray:
+        return jnp.all(implies(self.mask, other.mask))
+
+    def __eq__(self, other):
+        if isinstance(other, SetView):
+            return jnp.all(self.mask == other.mask)
+        return NotImplemented
+
+    def __ne__(self, other):
+        return jnp.logical_not(self.__eq__(other))
+
+    def __and__(self, other):
+        return SetView(self.mask & other.mask)
+
+    def __or__(self, other):
+        return SetView(self.mask | other.mask)
+
+    __hash__ = None
+
+
+class ProcDomain:
+    """The process domain ``P`` (Algorithm.scala:91-95 Domain ops)."""
+
+    def __init__(self, env: "Env"):
+        self._env = env
+
+    def _over_lanes(self, f: Callable[[ProcView], Any]) -> jnp.ndarray:
+        env = self._env
+        return jax.vmap(lambda i: f(ProcView(env, i)))(
+            jnp.arange(env.n, dtype=jnp.int32)
+        )
+
+    def forall(self, f) -> jnp.ndarray:
+        return jnp.all(self._over_lanes(f))
+
+    def exists(self, f) -> jnp.ndarray:
+        return jnp.any(self._over_lanes(f))
+
+    def filter(self, f) -> SetView:
+        return SetView(self._over_lanes(f))
+
+    def count(self, f) -> jnp.ndarray:
+        return self.filter(f).size
+
+
+class ValueDomain:
+    """A finite value domain ``V`` with explicit witness candidates.
+
+    The reference's ``Domain[Int].exists`` quantifies over the full (infinite)
+    type and relies on the solver to find witnesses; the on-device checker
+    quantifies over an explicit candidate array.  For the consensus specs the
+    candidates are the current/initial estimates — any satisfying value must
+    occur in the state (e.g. a value held by >2n/3 processes is some lane's
+    x), so checking over them is exact.
+    """
+
+    def __init__(self, candidates: jnp.ndarray):
+        self.candidates = jnp.asarray(candidates).reshape(-1)
+
+    def exists(self, f) -> jnp.ndarray:
+        return jnp.any(jax.vmap(f)(self.candidates))
+
+    def forall(self, f) -> jnp.ndarray:
+        return jnp.all(jax.vmap(f)(self.candidates))
+
+
+class SetDomain:
+    """The domain ``S`` of process sets, witnessed by the round's HO rows.
+
+    Sound for specs of the shape ``S.exists(s => P.forall(p => p.HO == s &&
+    ...))`` (OTR's goodRound, Otr.scala:95): any witness equal to every HO
+    row must itself be an HO row.
+    """
+
+    def __init__(self, env: "Env"):
+        self._env = env
+
+    def exists(self, f) -> jnp.ndarray:
+        env = self._env
+        if env.ho is None:
+            raise ValueError("set domain needs an HO matrix in the Env")
+        return jnp.any(
+            jax.vmap(lambda i: f(SetView(env.ho[i])))(
+                jnp.arange(env.n, dtype=jnp.int32)
+            )
+        )
+
+
+@dataclasses.dataclass
+class Env:
+    """Evaluation context for one (state, old, init, HO) snapshot.
+
+    Leaves of ``state``/``old``/``init0`` are [n, ...] (one trace step, one
+    scenario); the checker vmaps formula evaluation over rounds/scenarios.
+    """
+
+    state: Any
+    n: int
+    old: Any = None
+    init0: Any = None
+    ho: Optional[jnp.ndarray] = None
+    r: Any = 0
+
+    @property
+    def P(self) -> ProcDomain:
+        return ProcDomain(self)
+
+    @property
+    def S(self) -> SetDomain:
+        return SetDomain(self)
+
+    def values(self, *arrays) -> ValueDomain:
+        """Value domain whose candidates are the concatenation of the given
+        arrays (e.g. ``e.values(e.state.x)``)."""
+        return ValueDomain(jnp.concatenate([jnp.reshape(a, (-1,)) for a in arrays]))
+
+    def proc(self, idx) -> ProcView:
+        """View a specific process (e.g. the current phase's coordinator —
+        the spec-only ``coord`` of LastVoting.scala:17)."""
+        return ProcView(self, jnp.asarray(idx, dtype=jnp.int32))
+
+
+Formula = Callable[[Env], jnp.ndarray]
+
+
+class Spec:
+    """Mirror of the reference Spec trait (Specs.scala:9-19).
+
+    Fields (all optional, all formulas are ``Env -> bool scalar``):
+      safety_predicate: network assumption required for safety (checked as a
+        precondition on each round's HO; e.g. BenOr needs majority HO).
+      liveness_predicate: per-phase-in-the-invariant-chain "magic round"
+        conditions.
+      invariants: the invariant chain; the checker reports which (if any)
+        holds at each step.
+      round_invariants: per-round-in-phase extra invariants.
+      properties: named properties; safety ones are checked at every step,
+        Termination-style ones at the end of the run.
+    """
+
+    safety_predicate: Optional[Formula] = None
+    liveness_predicate: Sequence[Formula] = ()
+    invariants: Sequence[Formula] = ()
+    round_invariants: Sequence[Sequence[Formula]] = ()
+    properties: Sequence[Tuple[str, Formula]] = ()
+
+
+class TrivialSpec(Spec):
+    """No constraints (Specs.scala:37-41)."""

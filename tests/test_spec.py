@@ -1,0 +1,166 @@
+"""Spec layer: invariant parity — the reference specs, checked on traces.
+
+The invariants here are transcriptions of Otr.scala:94-120, BenOr.scala:
+92-119 and LastVoting.scala:19-70; the tests assert they hold on live runs
+(the BASELINE invariant-parity metric) and that the checker actually catches
+violations on corrupted traces.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.engine.executor import LocalTopology, init_lanes, run_instance
+from round_tpu.engine import scenarios
+from round_tpu.models import BenOr, LastVoting, OTR, consensus_io
+from round_tpu.spec import check_trace, replay_ho
+from round_tpu.spec.dsl import Env, implies
+
+
+def _record(state, done, r):
+    return state
+
+
+def _run_with_trace(algo, io, n, key, sampler, phases):
+    res = run_instance(algo, io, n, key, sampler, phases, record_fn=_record)
+    state0 = init_lanes(algo, io, n, LocalTopology(n))
+    T = res.rounds_run
+    ho = replay_ho(key, sampler, T)
+    return res, state0, ho, T
+
+
+def test_otr_invariants_hold_under_omission():
+    n = 7
+    algo = OTR()
+    for seed in range(4):
+        key = jax.random.PRNGKey(seed)
+        res, state0, ho, T = _run_with_trace(
+            algo, consensus_io(list(range(n))), n, key, scenarios.omission(n, 0.25), 10
+        )
+        rep = check_trace(algo.spec, res.recorded, state0, n, ho=ho)
+        # the invariant chain: some invariant holds at every step
+        assert bool(rep.any_invariant.all()), np.asarray(rep.invariant_held)
+        # safety properties hold at every step
+        assert bool(rep.all_safety_properties_hold())
+
+
+def test_otr_termination_and_integrity_on_good_network():
+    n = 5
+    algo = OTR()
+    key = jax.random.PRNGKey(1)
+    res, state0, ho, T = _run_with_trace(
+        algo, consensus_io([2, 2, 1, 2, 3]), n, key, scenarios.full(n), 4
+    )
+    rep = check_trace(algo.spec, res.recorded, state0, n, ho=ho)
+    assert bool(rep.final_properties["Termination"])
+    assert bool(rep.final_properties["Integrity"])
+    # with everyone decided, the strongest invariant (inv2) holds at the end
+    assert bool(rep.invariant_held[-1, 2])
+
+
+def test_otr_liveness_predicate_good_round():
+    """goodRound (Otr.scala:95) is true exactly when all HO rows agree on a
+    >2n/3 quorum."""
+    n = 6
+    algo = OTR()
+    io = consensus_io(list(range(n)))
+    state0 = init_lanes(algo, io, n, LocalTopology(n))
+    good = jnp.ones((n, n), dtype=bool)
+    e = Env(state=state0, n=n, init0=state0, ho=good, r=0)
+    assert bool(algo.spec.liveness_predicate[0](e))
+    # rows disagree: lane 0 hears nobody else
+    bad = good.at[0, 1:].set(False)
+    e = Env(state=state0, n=n, init0=state0, ho=bad, r=0)
+    assert not bool(algo.spec.liveness_predicate[0](e))
+
+
+def test_checker_catches_irrevocability_violation():
+    n = 4
+    algo = OTR()
+    key = jax.random.PRNGKey(0)
+    res, state0, ho, T = _run_with_trace(
+        algo, consensus_io([1, 1, 1, 2]), n, key, scenarios.full(n), 4
+    )
+    # corrupt the trace: lane 0 flips its decision after deciding
+    bad = res.recorded.replace(
+        decision=res.recorded.decision.at[-1, 0].add(jnp.int32(5))
+    )
+    rep = check_trace(algo.spec, bad, state0, n, ho=ho)
+    assert not bool(rep.properties["Irrevocability"][-1])
+    assert not bool(rep.properties["Agreement"][-1])
+
+
+def test_checker_catches_agreement_violation():
+    n = 4
+    algo = OTR()
+    key = jax.random.PRNGKey(2)
+    res, state0, ho, T = _run_with_trace(
+        algo, consensus_io([3, 3, 3, 3]), n, key, scenarios.full(n), 3
+    )
+    bad = res.recorded.replace(
+        decision=res.recorded.decision.at[-1, 1].set(jnp.int32(9)),
+    )
+    rep = check_trace(algo.spec, bad, state0, n, ho=ho)
+    assert not bool(rep.properties["Agreement"][-1])
+    # the invariant chain also breaks (decisions no longer on the quorum value)
+    assert not bool(rep.any_invariant[-1])
+
+
+def test_benor_invariants_and_safety_predicate():
+    n = 5
+    algo = BenOr()
+    sampler = scenarios.quorum_omission(n, 0.3, lambda m: m // 2 + 1)
+    for seed in range(3):
+        key = jax.random.PRNGKey(seed)
+        res, state0, ho, T = _run_with_trace(
+            algo,
+            {"initial_value": jnp.asarray([True, False, True, False, True])},
+            n,
+            key,
+            sampler,
+            12,
+        )
+        rep = check_trace(
+            algo.spec, res.recorded, state0, n, ho=ho, rounds_per_phase=2
+        )
+        assert bool(rep.safety_ok.all())  # HO quorum held every round
+        assert bool(rep.any_invariant.all())
+        assert bool(rep.round_invariant_ok.all())
+        assert bool(rep.all_safety_properties_hold())
+
+
+def test_lastvoting_phase_invariants():
+    n = 5
+    algo = LastVoting()
+    for seed, sampler in [
+        (0, scenarios.full(n)),
+        (3, scenarios.quorum_omission(n, 0.25, lambda m: m // 2 + 1)),
+        (5, scenarios.crash(n, 2)),
+    ]:
+        key = jax.random.PRNGKey(seed)
+        res, state0, ho, T = _run_with_trace(
+            algo, consensus_io([1, 2, 3, 4, 5]), n, key, sampler, 5
+        )
+        rep = check_trace(
+            algo.spec, res.recorded, state0, n, ho=ho, rounds_per_phase=4
+        )
+        # phase invariants at phase boundaries (env.r % 4 == 0 <-> step 4p+3)
+        boundary = np.arange(T) % 4 == 3
+        held = np.asarray(rep.any_invariant)
+        assert held[boundary].all(), held
+        # safety properties hold at *every* step
+        assert bool(rep.all_safety_properties_hold())
+
+
+def test_lastvoting_termination_with_good_coordinator():
+    n = 4
+    algo = LastVoting()
+    key = jax.random.PRNGKey(7)
+    res, state0, ho, T = _run_with_trace(
+        algo, consensus_io([6, 7, 8, 9]), n, key, scenarios.full(n), 2
+    )
+    rep = check_trace(algo.spec, res.recorded, state0, n, ho=ho, rounds_per_phase=4)
+    assert bool(rep.final_properties["Termination"])
+    # liveness predicate (a good coordinator) holds for the deciding phase
+    e = Env(state=state0, n=n, init0=state0, ho=ho[0], r=0)
+    assert bool(algo.spec.liveness_predicate[0](e))
