@@ -1,0 +1,29 @@
+"""Runtime services around the engine: instances, membership, SMR, recovery.
+
+The reference's runtime (psync.runtime) multiplexes many concurrent protocol
+instances over sockets — InstanceDispatcher routes packets by the 16-bit
+instance id in the Tag, Algorithm pools InstanceHandlers, and the batching
+example builds state-machine replication with decision logs and recovery on
+top.  Here the same services are tensor-shaped:
+
+  - many concurrent instances  = a batch axis (instances.py InstancePool)
+  - the dispatcher             = host-side slot table keyed by instance id
+  - membership (Group/Directory) = host-side replica table + per-instance
+    group size (membership.py), updated between instances like the
+    reference's consensus-on-membership example
+  - SMR / batching             = ReplicatedStateMachine over a consensus
+    algorithm with a device decision log + replay/recovery (smr.py)
+"""
+
+from round_tpu.runtime.instances import InstancePool, InstanceResult
+from round_tpu.runtime.membership import Directory, Group, Replica
+from round_tpu.runtime.smr import ReplicatedStateMachine
+
+__all__ = [
+    "InstancePool",
+    "InstanceResult",
+    "Directory",
+    "Group",
+    "Replica",
+    "ReplicatedStateMachine",
+]

@@ -1,0 +1,156 @@
+"""Instance multiplexing: many concurrent protocol instances as a batch axis.
+
+The reference runs one thread + inbox per instance, routed by the 16-bit
+instance id of every packet (InstanceDispatcher.scala:84-89) and recycled
+through a pool (Algorithm.scala:59-86).  Here concurrent instances are lanes
+of a batch axis executed by ONE jitted vmapped run; the dispatcher becomes a
+host-side slot table, and the "pool" is the fixed batch width (slots are
+recycled between run calls just like pooled handlers).
+
+Instance ids live in the reference's 16-bit wrap-around space
+(core.time.Instance); the decision log is keyed by instance id.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.engine.executor import run_instance
+
+MAX_INSTANCE = 1 << 16
+
+
+@dataclasses.dataclass
+class InstanceResult:
+    """Outcome of one multiplexed instance."""
+
+    instance_id: int
+    decided: np.ndarray        # [n] bool per lane
+    decision: np.ndarray       # [n] values per lane
+    decided_round: np.ndarray  # [n] int32
+    value: Any                 # the instance's agreed value (first decided
+    # lane's decision; None if no lane decided)
+
+
+class InstancePool:
+    """Run up to ``window`` concurrent instances per step, batched on device.
+
+    Mirrors the reference's processPool/rate-limited in-flight window
+    (RuntimeOptions.scala:27 processPool=16; BatchingClient RateLimiting):
+    ``submit`` queues (instance_id, io); ``run_pending`` executes up to
+    ``window`` of them as one vmapped, jit-cached call and folds the results
+    into the decision log.
+    """
+
+    def __init__(
+        self,
+        algo: Algorithm,
+        n: int,
+        ho_sampler: Callable,
+        max_phases: int,
+        window: int = 16,
+    ):
+        self.algo = algo
+        self.n = n
+        self.ho_sampler = ho_sampler
+        self.max_phases = max_phases
+        self.window = window
+        self._pending: List[Tuple[int, Any]] = []
+        self._running: set = set()
+        self.decision_log: Dict[int, InstanceResult] = {}
+        self._batched_run = jax.jit(jax.vmap(self._one, in_axes=(0, 0)))
+
+    def _one(self, io, key):
+        res = run_instance(
+            self.algo, io, self.n, key, self.ho_sampler, self.max_phases
+        )
+        return (
+            self.algo.decided(res.state),
+            self.algo.decision(res.state),
+            res.decided_round,
+        )
+
+    # -- dispatcher surface (InstanceDispatcher.scala add/remove/dispatch) --
+
+    def can_start(self, instance_id: int) -> bool:
+        iid = instance_id % MAX_INSTANCE
+        return iid not in self._running and iid not in self.decision_log
+
+    def is_running(self, instance_id: int) -> bool:
+        return (instance_id % MAX_INSTANCE) in self._running
+
+    def submit(self, instance_id: int, io: Any) -> None:
+        """Queue an instance (Algorithm.startInstance's intake)."""
+        iid = instance_id % MAX_INSTANCE
+        if not self.can_start(iid):
+            raise ValueError(f"instance {iid} already running or decided")
+        self._running.add(iid)
+        self._pending.append((iid, io))
+
+    def stop(self, instance_id: int) -> None:
+        """Drop a queued/running instance (Algorithm.stopInstance)."""
+        iid = instance_id % MAX_INSTANCE
+        self._running.discard(iid)
+        self._pending = [(i, io) for i, io in self._pending if i != iid]
+
+    def run_pending(self, key: jax.Array) -> List[InstanceResult]:
+        """Execute up to ``window`` queued instances in one batched call."""
+        if not self._pending:
+            return []
+        batch, self._pending = (
+            self._pending[: self.window],
+            self._pending[self.window:],
+        )
+        ids = [iid for iid, _ in batch]
+        ios = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *[io for _, io in batch])
+        keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+            jnp.asarray(ids, dtype=jnp.uint32)
+        )
+        decided, decision, dec_round = jax.tree_util.tree_map(
+            np.asarray, self._batched_run(ios, keys)
+        )
+        out = []
+        for b, iid in enumerate(ids):
+            first = int(np.argmax(decided[b])) if decided[b].any() else -1
+            res = InstanceResult(
+                instance_id=iid,
+                decided=decided[b],
+                decision=decision[b],
+                decided_round=dec_round[b],
+                value=None if first < 0 else decision[b][first],
+            )
+            self.decision_log[iid] = res
+            self._running.discard(iid)
+            out.append(res)
+        return out
+
+    def run_all(self, key: jax.Array) -> List[InstanceResult]:
+        """Drain the queue, window by window."""
+        out = []
+        step = 0
+        while self._pending:
+            out.extend(self.run_pending(jax.random.fold_in(key, step)))
+            step += 1
+        return out
+
+    # -- recovery surface (Recovery.scala askDecision/sendRecoveryInfo) ----
+
+    def get_decision(self, instance_id: int) -> Optional[InstanceResult]:
+        return self.decision_log.get(instance_id % MAX_INSTANCE)
+
+    def recover_from(self, peer: "InstancePool", instance_id: int) -> bool:
+        """Fill a gap in our log from a peer's (the Decision flag path);
+        returns True if the peer had it."""
+        iid = instance_id % MAX_INSTANCE
+        got = peer.get_decision(iid)
+        if got is None:
+            return False
+        self.decision_log[iid] = got
+        self._running.discard(iid)
+        return True

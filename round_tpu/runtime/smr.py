@@ -1,0 +1,187 @@
+"""State-machine replication: request batching + consensus + replay/recovery.
+
+The reference's batching example (example/batching/, example/LastVotingB.scala)
+turns LastVoting into an SMR service: client requests are packed into byte
+batches, each batch is one consensus instance, decisions land in a
+DecisionLog, laggards recover by asking peers for missing decisions or a
+snapshot (Recovery.scala).  The TPU build keeps that architecture with the
+payload redesign of SURVEY.md §2.8: commands are fixed-width int records, a
+batch is a [batch_size] tensor, and the consensus payload is the *batch
+index* (the batch store is replicated host-side) — the analogue of
+LastVotingB shipping opaque Array[Byte].
+
+The state machine itself is a pure fold ``apply(state, cmd) -> state`` over
+decided batches, so replay and snapshot are jit-compiled scans.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.models.common import consensus_io
+from round_tpu.runtime.instances import InstancePool
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """State-machine state after applying instances [0, upto)."""
+
+    upto: int
+    state: Any
+
+
+class ReplicatedStateMachine:
+    """One replica's SMR view: propose commands, decide batches, apply in order.
+
+    Args:
+      algo: consensus algorithm over int payloads (LastVoting by default —
+        the reference's LastVotingB role).
+      n: group size.
+      apply_fn: (sm_state, cmd_batch [B] int32) -> sm_state — the replicated
+        state machine (pure, jit-compatible).
+      sm_init: initial state-machine state.
+      batch_size: commands per consensus instance (request batching).
+      ho_sampler / max_phases / window: engine parameters for the underlying
+        InstancePool.
+    """
+
+    def __init__(
+        self,
+        algo: Algorithm,
+        n: int,
+        apply_fn: Callable[[Any, jnp.ndarray], Any],
+        sm_init: Any,
+        ho_sampler: Callable,
+        batch_size: int = 8,
+        max_phases: int = 6,
+        window: int = 16,
+    ):
+        self.n = n
+        self.apply_fn = apply_fn
+        self.sm_init = sm_init
+        self.batch_size = batch_size
+        self.pool = InstancePool(algo, n, ho_sampler, max_phases, window)
+        self.batch_store: Dict[int, np.ndarray] = {}  # batch idx -> [B] cmds
+        self.decided_batches: Dict[int, int] = {}  # instance -> batch idx
+        self._queue: List[int] = []
+        self.next_instance = 0
+        self._applied = Snapshot(0, sm_init)
+
+        def _replay(state, batches):  # [K, B] int32
+            def step(s, b):
+                return self.apply_fn(s, b), None
+
+            out, _ = jax.lax.scan(step, state, batches)
+            return out
+
+        self._replay = jax.jit(_replay)
+
+    # -- client side -------------------------------------------------------
+
+    def propose(self, commands: Sequence[int]) -> None:
+        """Queue client commands (RequestProcessor intake)."""
+        self._queue.extend(int(c) for c in commands)
+
+    def pending_batches(self) -> int:
+        return len(self._queue) // self.batch_size
+
+    def _next_batch(self) -> Optional[int]:
+        if len(self._queue) < self.batch_size:
+            return None
+        cmds, self._queue = (
+            self._queue[: self.batch_size],
+            self._queue[self.batch_size:],
+        )
+        idx = len(self.batch_store)
+        self.batch_store[idx] = np.asarray(cmds, dtype=np.int32)
+        return idx
+
+    # -- consensus side ----------------------------------------------------
+
+    def run(self, key: jax.Array, pad_with_noop: bool = False) -> int:
+        """Batch queued commands, run one consensus instance per batch,
+        record decisions.  Returns the number of instances decided."""
+        if pad_with_noop and self._queue and len(self._queue) < self.batch_size:
+            self._queue.extend([0] * (self.batch_size - len(self._queue)))
+        count = 0
+        while True:
+            b = self._next_batch()
+            if b is None:
+                break
+            inst = self.next_instance
+            self.next_instance = (self.next_instance + 1) % (1 << 16)
+            # every lane proposes the batch index (in a real deployment each
+            # replica proposes the batch it heard; value-agreement on the
+            # index is what LastVotingB's byte payload gives)
+            self.pool.submit(inst, consensus_io([b] * self.n))
+            count += 1
+        for res in self.pool.run_all(key):
+            if res.value is not None:
+                self.decided_batches[res.instance_id] = int(res.value)
+        return count
+
+    # -- apply / replay / recovery ----------------------------------------
+
+    def log_gaps(self) -> List[int]:
+        """Instances < next_instance with no recorded decision."""
+        return [
+            i for i in range(self.next_instance) if i not in self.decided_batches
+        ]
+
+    def recover_from(self, peer: "ReplicatedStateMachine") -> int:
+        """Copy missing decisions (and their batches) from a peer — the
+        askDecision/Decision round-trip of Recovery.scala.  Returns number
+        of instances recovered."""
+        got = 0
+        for i in self.log_gaps():
+            if i in peer.decided_batches:
+                b = peer.decided_batches[i]
+                self.decided_batches[i] = b
+                if b not in self.batch_store and b in peer.batch_store:
+                    self.batch_store[b] = peer.batch_store[b]
+                got += 1
+        if self.next_instance < peer.next_instance:
+            for i in range(self.next_instance, peer.next_instance):
+                if i in peer.decided_batches:
+                    b = peer.decided_batches[i]
+                    self.decided_batches[i] = b
+                    if b not in self.batch_store and b in peer.batch_store:
+                        self.batch_store[b] = peer.batch_store[b]
+                    got += 1
+            self.next_instance = peer.next_instance
+        return got
+
+    def install_snapshot(self, snap: Snapshot) -> None:
+        """Adopt a peer's snapshot (the Late/writeSnapshot path)."""
+        if snap.upto > self._applied.upto:
+            self._applied = Snapshot(
+                snap.upto, jax.tree_util.tree_map(jnp.asarray, snap.state)
+            )
+
+    def snapshot(self) -> Snapshot:
+        self.apply_decided()
+        return self._applied
+
+    def apply_decided(self) -> Any:
+        """Apply all contiguously-decided instances to the state machine."""
+        upto = self._applied.upto
+        batches = []
+        while upto in self.decided_batches:
+            batches.append(self.batch_store[self.decided_batches[upto]])
+            upto += 1
+        if batches:
+            new_state = self._replay(
+                self._applied.state, jnp.asarray(np.stack(batches))
+            )
+            self._applied = Snapshot(upto, new_state)
+        return self._applied.state
+
+    @property
+    def applied_upto(self) -> int:
+        return self._applied.upto

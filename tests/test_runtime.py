@@ -1,0 +1,175 @@
+"""Runtime services: instance multiplexing, membership, SMR + recovery."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from round_tpu.engine import scenarios
+from round_tpu.models import LastVoting, OTR, consensus_io
+from round_tpu.runtime import (
+    Directory,
+    Group,
+    InstancePool,
+    Replica,
+    ReplicatedStateMachine,
+)
+from round_tpu.runtime.membership import local_group
+
+
+# -- instances -------------------------------------------------------------
+
+
+def test_instance_pool_multiplexes_and_logs():
+    n = 4
+    pool = InstancePool(OTR(), n, scenarios.full(n), max_phases=4, window=3)
+    for i in range(7):
+        pool.submit(i, consensus_io([i + 1] * n))
+    assert pool.is_running(2)
+    results = pool.run_all(jax.random.PRNGKey(0))
+    assert len(results) == 7
+    for i in range(7):
+        res = pool.get_decision(i)
+        assert res is not None and res.value == i + 1
+        assert not pool.is_running(i)
+    # decided instances cannot be restarted (dedup by instance id)
+    assert not pool.can_start(3)
+    with pytest.raises(ValueError):
+        pool.submit(3, consensus_io([9] * n))
+
+
+def test_instance_pool_stop_and_recovery():
+    n = 4
+    a = InstancePool(OTR(), n, scenarios.full(n), max_phases=4, window=4)
+    b = InstancePool(OTR(), n, scenarios.full(n), max_phases=4, window=4)
+    for i in range(3):
+        a.submit(i, consensus_io([10 + i] * n))
+    a.submit(3, consensus_io([99] * n))
+    a.stop(3)  # cancelled before running
+    a.run_all(jax.random.PRNGKey(1))
+    assert a.get_decision(3) is None
+    # b only ran instance 0; recovers 1 and 2 from a's log
+    b.submit(0, consensus_io([10] * n))
+    b.run_all(jax.random.PRNGKey(2))
+    assert b.recover_from(a, 1) and b.recover_from(a, 2)
+    assert not b.recover_from(a, 3)
+    assert b.get_decision(2).value == 12
+
+
+def test_instance_id_wraparound():
+    n = 4
+    pool = InstancePool(OTR(), n, scenarios.full(n), max_phases=3, window=2)
+    pool.submit(65535, consensus_io([1] * n))
+    pool.submit(65536, consensus_io([2] * n))  # wraps to 0
+    pool.run_all(jax.random.PRNGKey(0))
+    assert pool.get_decision(65535).value == 1
+    assert pool.get_decision(0).value == 2
+
+
+# -- membership ------------------------------------------------------------
+
+
+def test_group_add_remove_rename():
+    g = local_group(4)
+    assert g.size == 4
+    assert g.inet_to_id("127.0.0.1", 4446) == 2
+    g2 = g.remove(1)
+    assert g2.size == 3
+    # ids compacted: old 2 -> 1, old 3 -> 2 (Replicas.scala renameReplica)
+    ren = g2.renaming_from(g)
+    assert ren == {0: 0, 1: None, 2: 1, 3: 2}
+    g3 = g2.add("10.0.0.9", 7777)
+    assert g3.size == 4 and g3.get(3).address == "10.0.0.9"
+
+
+def test_group_rejects_non_contiguous_ids():
+    with pytest.raises(ValueError):
+        Group([Replica(0, "a"), Replica(2, "b")])
+
+
+def test_directory_membership_change_between_instances():
+    """The DynamicMembership pattern: run consensus on a 4-group, shrink to
+    3, run the next instance over the new group size."""
+    d = Directory(local_group(4))
+    pool4 = InstancePool(OTR(), d.size, scenarios.full(d.size), 4, window=2)
+    pool4.submit(0, consensus_io([5] * 4))
+    pool4.run_all(jax.random.PRNGKey(0))
+    assert pool4.get_decision(0).value == 5
+
+    d.remove_replica(3)
+    assert d.size == 3
+    pool3 = InstancePool(OTR(), d.size, scenarios.full(d.size), 4, window=2)
+    pool3.submit(1, consensus_io([7] * 3))
+    pool3.run_all(jax.random.PRNGKey(1))
+    res = pool3.get_decision(1)
+    assert res.value == 7 and len(res.decided) == 3
+
+
+# -- SMR -------------------------------------------------------------------
+
+
+def _counter_sm():
+    """State machine: state is a running int32 sum of commands."""
+
+    def apply_fn(state, batch):
+        return state + jnp.sum(batch)
+
+    return apply_fn, jnp.asarray(0, dtype=jnp.int32)
+
+
+def _make_rsm(n=4, batch=4, key_sampler=None):
+    apply_fn, init = _counter_sm()
+    return ReplicatedStateMachine(
+        LastVoting(),
+        n,
+        apply_fn,
+        init,
+        key_sampler or scenarios.full(n),
+        batch_size=batch,
+        max_phases=4,
+    )
+
+
+def test_smr_batches_decide_and_apply():
+    rsm = _make_rsm()
+    rsm.propose([1, 2, 3, 4, 5, 6, 7, 8])  # two batches
+    assert rsm.run(jax.random.PRNGKey(0)) == 2
+    state = rsm.apply_decided()
+    assert int(state) == 36
+    assert rsm.applied_upto == 2
+    assert rsm.log_gaps() == []
+
+
+def test_smr_partial_batch_padding():
+    rsm = _make_rsm(batch=4)
+    rsm.propose([10, 20])
+    assert rsm.run(jax.random.PRNGKey(0)) == 0  # not enough for a batch
+    assert rsm.run(jax.random.PRNGKey(0), pad_with_noop=True) == 1
+    assert int(rsm.apply_decided()) == 30
+
+
+def test_smr_recovery_fills_gaps():
+    """A replica that missed instances catches up from a peer's log and
+    reaches the same applied state (askDecision/Decision semantics)."""
+    a = _make_rsm()
+    a.propose(list(range(1, 13)))  # 3 batches
+    a.run(jax.random.PRNGKey(0))
+    assert int(a.apply_decided()) == sum(range(1, 13))
+
+    b = _make_rsm()
+    assert b.applied_upto == 0
+    got = b.recover_from(a)
+    assert got == 3
+    assert int(b.apply_decided()) == sum(range(1, 13))
+    assert b.applied_upto == a.applied_upto
+
+
+def test_smr_snapshot_install():
+    a = _make_rsm()
+    a.propose(list(range(1, 9)))
+    a.run(jax.random.PRNGKey(3))
+    snap = a.snapshot()
+    b = _make_rsm()
+    b.install_snapshot(snap)
+    assert b.applied_upto == 2
+    assert int(b.apply_decided()) == sum(range(1, 9))
