@@ -105,6 +105,11 @@ class Round:
 
     init_progress: Progress = Progress.timeout(10)
 
+    def pre(self, ctx: RoundCtx, state):
+        """Per-lane hook run at round start, before send — the EventRound
+        ``init`` slot (Round.scala:93-97).  Default: no-op."""
+        return state
+
     def send(self, ctx: RoundCtx, state) -> SendSpec:
         raise NotImplementedError
 
@@ -115,3 +120,47 @@ class Round:
         """Early-exit hint (Round.scala:33-35). Unused by the lockstep engine,
         used by the host event-round runtime."""
         return ctx.n
+
+
+class EventRound(Round):
+    """Open round (OOPSLA'20 EventRound, Round.scala:83-131): user code sees
+    one message at a time instead of the whole mailbox.
+
+    Subclasses implement:
+      pre(ctx, state) -> state                       (init: reset round vars)
+      send(ctx, state) -> SendSpec
+      receive(ctx, state, sender, payload) -> (state, go_ahead)
+      finish_round(ctx, state, did_timeout) -> state
+
+    The lockstep adapter folds ``receive`` over present senders in id order
+    (a deterministic refinement of the runtime's arrival order), then calls
+    ``finish_round`` with did_timeout = "no receive signalled goAhead" —
+    matching the InstanceHandler semantics where a round that never reaches
+    its goAhead condition ends by timeout (InstanceHandler.scala:239-244).
+    Prefer plain Round with a vectorized ``update`` for performance; this
+    adapter is for algorithms whose logic is genuinely sequential per
+    message (e.g. Dijkstra's token ring, PBFT quorum counting).
+    """
+
+    def receive(self, ctx: RoundCtx, state, sender, payload):
+        raise NotImplementedError
+
+    def finish_round(self, ctx: RoundCtx, state, did_timeout):
+        return state
+
+    def update(self, ctx: RoundCtx, state, mailbox):
+        from round_tpu.utils.tree import tree_where  # local: avoid cycle
+
+        def body(i, carry):
+            st, go = carry
+            payload_i = jax.tree_util.tree_map(lambda v: v[i], mailbox.values)
+            new_st, new_go = self.receive(ctx, st, i, payload_i)
+            present = mailbox.mask[i]
+            st = tree_where(present, new_st, st)
+            go = jnp.where(present, go | jnp.asarray(new_go), go)
+            return st, go
+
+        state, go = jax.lax.fori_loop(
+            0, ctx.n, body, (state, jnp.asarray(False))
+        )
+        return self.finish_round(ctx, state, jnp.logical_not(go))

@@ -79,6 +79,13 @@ def run_round(rnd, state, done, r, ho, key, topo):
     ids = topo.lane_ids()
     active_local = jnp.logical_not(done)
 
+    # pre (EventRound init slot): runs before send, visible to send+update
+    def _pre(i, s):
+        ctx = RoundCtx(id=i, n=n, r=r)
+        return rnd.pre(ctx, s)
+
+    state = tree_where(active_local, jax.vmap(_pre)(ids, state), state)
+
     # send: per-lane -> payload [n_local, ...], dest_mask [n_local, n]
     def _send(i, s):
         ctx = RoundCtx(id=i, n=n, r=r)

@@ -9,6 +9,11 @@ from round_tpu.models.otr import OTR, OtrState
 from round_tpu.models.floodmin import FloodMin, FloodMinState
 from round_tpu.models.benor import BenOr, BenOrState
 from round_tpu.models.lastvoting import LastVoting, LVState
+from round_tpu.models.lastvoting_variants import (
+    MultiLastVoting,
+    ShortLastVoting,
+    mlv_io,
+)
 from round_tpu.models.tpc import TwoPhaseCommit, TpcState, tpc_io
 from round_tpu.models.kset import (
     KSetAgreement,
@@ -16,6 +21,14 @@ from round_tpu.models.kset import (
     KSetState,
     KSetESState,
 )
+from round_tpu.models.epsilon import EpsilonConsensus, real_consensus_io
+from round_tpu.models.lattice import LatticeAgreement, lattice_io
+from round_tpu.models.erb import EagerReliableBroadcast, broadcast_io
+from round_tpu.models.failure_detector import Esfd
+from round_tpu.models.mutex import SelfStabilizingMutualExclusion, mutex_io
+from round_tpu.models.gameoflife import ConwayGameOfLife, cgol_io
+from round_tpu.models.theta import ThetaModel
+from round_tpu.models.pbft import PbftConsensus
 from round_tpu.models.common import consensus_io
 
 __all__ = [
@@ -27,6 +40,9 @@ __all__ = [
     "BenOrState",
     "LastVoting",
     "LVState",
+    "ShortLastVoting",
+    "MultiLastVoting",
+    "mlv_io",
     "TwoPhaseCommit",
     "TpcState",
     "tpc_io",
@@ -34,5 +50,18 @@ __all__ = [
     "KSetEarlyStopping",
     "KSetState",
     "KSetESState",
+    "EpsilonConsensus",
+    "real_consensus_io",
+    "LatticeAgreement",
+    "lattice_io",
+    "EagerReliableBroadcast",
+    "broadcast_io",
+    "Esfd",
+    "SelfStabilizingMutualExclusion",
+    "mutex_io",
+    "ConwayGameOfLife",
+    "cgol_io",
+    "ThetaModel",
+    "PbftConsensus",
     "consensus_io",
 ]

@@ -1,0 +1,130 @@
+"""ε-agreement — approximate consensus with f crash faults (order statistics).
+
+Protocol (reference: example/Epsilon.scala:16-71, after Lynch ch. 7): every
+round broadcast (x, halting?).  Round 0 computes the horizon from the initial
+spread: maxR = ⌈ log(diff(V)/ε) / log(c(n-3f, 2f)) ⌉ with c(m,k) = (m-1)/k+1,
+and x := sorted(V).drop(2f).head.  While r ≤ maxR, x := mean of every 2f-th
+element of sorted(V) with f trimmed from each end (the reduce/select
+convergence step).  After maxR, decide x; halted processes' last values stay
+in every V via the ``halted`` map.
+
+This is SURVEY.md §7's "order statistics + data-dependent round count" hard
+case: the sort is a masked sort over the [2n] (mailbox ∪ halted) value
+vector, and maxR is a per-lane tensor bounding participation under a global
+scan horizon.  Model requires n > 5f and f ≥ 1.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import Round, RoundCtx, broadcast
+from round_tpu.models.common import ghost_decide
+from round_tpu.ops.mailbox import Mailbox
+
+_INF = jnp.float32(jnp.inf)
+
+
+@flax.struct.dataclass
+class EpsilonState:
+    x: jnp.ndarray            # float32 estimate
+    max_r: jnp.ndarray        # int32 horizon (set in round 0)
+    halted_vals: jnp.ndarray  # [n] float32 — last value of halted processes
+    halted_mask: jnp.ndarray  # [n] bool
+    decided: jnp.ndarray
+    decision: jnp.ndarray     # float32
+
+
+class EpsilonRound(Round):
+    def __init__(self, n: int, f: int, epsilon: float):
+        assert f >= 1 and n > 5 * f, "ε-agreement needs n > 5f, f >= 1"
+        self.n = n
+        self.f = f
+        self.epsilon = float(epsilon)
+        # c(n-3f, 2f) = (n-3f-1)/(2f) + 1, static (Epsilon.scala:33)
+        self.c = (n - 3 * f - 1) // (2 * f) + 1
+
+    def send(self, ctx: RoundCtx, state: EpsilonState):
+        return broadcast(ctx, {"v": state.x, "halt": ctx.r > state.max_r})
+
+    def update(self, ctx: RoundCtx, state: EpsilonState, mbox: Mailbox):
+        f = self.f
+        present = mbox.mask
+        vals = mbox.values["v"]
+        halts = mbox.values["halt"]
+
+        # V = mailbox values ++ halted values (Epsilon.scala:55)
+        V_vals = jnp.concatenate([vals, state.halted_vals])
+        V_mask = jnp.concatenate([present, state.halted_mask])
+        cnt = jnp.sum(V_mask.astype(jnp.int32))
+        sorted_v = jnp.sort(jnp.where(V_mask, V_vals, _INF))
+
+        # halted ++= mailbox.filter(halting)
+        newly_halted = present & halts
+        halted_vals = jnp.where(newly_halted, vals, state.halted_vals)
+        halted_mask = state.halted_mask | newly_halted
+
+        # round 0: horizon from the spread; x = sorted.drop(2f).head
+        v_min = jnp.min(jnp.where(V_mask, V_vals, _INF))
+        v_max = jnp.max(jnp.where(V_mask, V_vals, -_INF))
+        diff = v_max - v_min
+        r1 = jnp.log(diff / self.epsilon) / jnp.log(jnp.float32(self.c))
+        max_r0 = jnp.where(
+            diff <= self.epsilon, 0, jnp.ceil(r1).astype(jnp.int32)
+        )
+        x_r0 = sorted_v[2 * f]
+
+        # r <= maxR: x = mean of sorted[f + 2f*i], i >= 0, index < cnt - f
+        idx = f + 2 * f * jnp.arange(2 * self.n)
+        valid = idx < (cnt - f)
+        idx = jnp.minimum(idx, 2 * self.n - 1)
+        sel = jnp.where(valid, sorted_v[idx], 0.0)
+        x_mid = jnp.sum(sel) / jnp.maximum(jnp.sum(valid.astype(jnp.int32)), 1)
+
+        is_r0 = ctx.r == 0
+        deciding = (~is_r0) & (ctx.r > state.max_r)
+        x = jnp.where(
+            is_r0, x_r0, jnp.where(deciding, state.x, x_mid)
+        )
+        ctx.exit_at_end_of_round(deciding)
+        state = ghost_decide(state, deciding, state.x)
+        return state.replace(
+            x=x,
+            max_r=jnp.where(is_r0, max_r0, state.max_r),
+            halted_vals=halted_vals,
+            halted_mask=halted_mask,
+        )
+
+
+class EpsilonConsensus(Algorithm):
+    """Approximate agreement: decisions within ε of each other, inside the
+    range of initial values, tolerating f crash faults."""
+
+    def __init__(self, n: int, f: int = 1, epsilon: float = 0.1):
+        self.f = f
+        self.epsilon = epsilon
+        self.rounds = (EpsilonRound(n, f, epsilon),)
+
+    def make_init_state(self, ctx: RoundCtx, io) -> EpsilonState:
+        n = ctx.n
+        return EpsilonState(
+            x=jnp.asarray(io["initial_value"], dtype=jnp.float32),
+            max_r=jnp.asarray(jnp.iinfo(jnp.int32).max, dtype=jnp.int32),
+            halted_vals=jnp.zeros((n,), dtype=jnp.float32),
+            halted_mask=jnp.zeros((n,), dtype=bool),
+            decided=jnp.asarray(False),
+            decision=jnp.asarray(jnp.nan, dtype=jnp.float32),
+        )
+
+    def decided(self, state: EpsilonState):
+        return state.decided
+
+    def decision(self, state: EpsilonState):
+        return state.decision
+
+
+def real_consensus_io(initial_values) -> dict:
+    """io for real-valued consensus (RealConsensusIO, Epsilon.scala:10-13)."""
+    return {"initial_value": jnp.asarray(initial_values, dtype=jnp.float32)}

@@ -1,0 +1,81 @@
+"""Eager reliable broadcast (one instance = one broadcast).
+
+Protocol (reference: example/EagerReliableBroadcast.scala:13-47): the
+originator starts with Some(v); every process that knows the value
+rebroadcasts it once, delivers, and exits; processes that receive it adopt
+it (``head`` of a non-empty mailbox); a process that hears nothing for 10
+rounds gives up (the originator crashed before anyone got it).
+
+In the reference each broadcast runs as its own instance started lazily by
+the defaultHandler on the first incoming message (ERBRunner.defaultHandler);
+here that multiplexing is the InstancePool batch axis.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import Round, RoundCtx, broadcast
+from round_tpu.ops.mailbox import Mailbox
+
+
+@flax.struct.dataclass
+class ErbState:
+    x_val: jnp.ndarray      # int32 (the broadcast value, if known)
+    x_def: jnp.ndarray      # bool — x.isDefined
+    delivered: jnp.ndarray  # bool ghost (deliver callback fired)
+    delivery: jnp.ndarray   # int32 ghost
+
+
+class ErbRound(Round):
+    def send(self, ctx: RoundCtx, state: ErbState):
+        return broadcast(ctx, state.x_val, guard=state.x_def)
+
+    def update(self, ctx: RoundCtx, state: ErbState, mbox: Mailbox):
+        got_any = mbox.size() > 0
+        adopted = mbox.any_value()
+
+        delivering = state.x_def
+        give_up = ~state.x_def & ~got_any & (ctx.r > 10)
+        ctx.exit_at_end_of_round(delivering | give_up)
+        newly = delivering & ~state.delivered
+        return state.replace(
+            x_val=jnp.where(~state.x_def & got_any, adopted, state.x_val),
+            x_def=state.x_def | got_any,
+            delivered=state.delivered | delivering,
+            delivery=jnp.where(newly, state.x_val, state.delivery),
+        )
+
+
+class EagerReliableBroadcast(Algorithm):
+    """Uniform reliable broadcast: if any correct process delivers v, every
+    correct process delivers v."""
+
+    def __init__(self):
+        self.rounds = (ErbRound(),)
+
+    def make_init_state(self, ctx: RoundCtx, io) -> ErbState:
+        return ErbState(
+            x_val=jnp.asarray(io["value"], dtype=jnp.int32),
+            x_def=jnp.asarray(io["is_origin"], dtype=bool),
+            delivered=jnp.asarray(False),
+            delivery=jnp.asarray(-1, dtype=jnp.int32),
+        )
+
+    def decided(self, state: ErbState):
+        return state.delivered
+
+    def decision(self, state: ErbState):
+        return state.delivery
+
+
+def broadcast_io(origin: int, value: int, n: int) -> dict:
+    """io: process ``origin`` broadcasts ``value`` (BroadcastIO semantics:
+    Some(v) at the origin, None elsewhere)."""
+    ids = jnp.arange(n)
+    return {
+        "value": jnp.where(ids == origin, value, 0).astype(jnp.int32),
+        "is_origin": ids == origin,
+    }

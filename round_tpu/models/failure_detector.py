@@ -1,0 +1,69 @@
+"""◇S eventually-strong failure detector (heartbeat + hysteresis).
+
+Protocol (reference: example/EventuallyStrongFailureDetector.scala:10-58):
+every period each process bumps a per-peer ``lastSeen`` counter (capped at
+hysteresis+1), broadcasts its suspected set {p : lastSeen(p) > hysteresis},
+zeroes the counter of every sender it hears, and adopts others' suspicions
+(a suspected peer it did not hear this round jumps straight past the
+hysteresis threshold).
+
+The reference's per-message EventRound receive loop is order-insensitive in
+aggregate (a present sender always ends unsuspected; an absent peer suspected
+by any present sender trips the threshold), so the update vectorizes to three
+masked writes.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import Round, RoundCtx, broadcast
+from round_tpu.ops.mailbox import Mailbox
+
+
+@flax.struct.dataclass
+class EsfdState:
+    last_seen: jnp.ndarray  # [n] int32, capped at hysteresis+1
+
+
+class EsfdRound(Round):
+    def __init__(self, hysteresis: int):
+        self.h = hysteresis
+
+    def suspected(self, state: EsfdState) -> jnp.ndarray:
+        return state.last_seen > self.h
+
+    def send(self, ctx: RoundCtx, state: EsfdState):
+        return broadcast(ctx, self.suspected(state))
+
+    def update(self, ctx: RoundCtx, state: EsfdState, mbox: Mailbox):
+        h = self.h
+        present = mbox.mask            # [n] senders heard this round
+        sus = mbox.values              # [n, n] suspected sets
+
+        # init slot: lastSeen := min(lastSeen + 1, h + 1)
+        ls = jnp.minimum(state.last_seen + 1, h + 1)
+        # adopt suspicions of peers we did not hear this round...
+        accused = jnp.any(present[:, None] & sus, axis=0)
+        ls = jnp.where(accused & ~present, h + 1, ls)
+        # ...and zero the counter of everyone we heard (wins over adoption)
+        ls = jnp.where(present, 0, ls)
+        return state.replace(last_seen=ls)
+
+
+class Esfd(Algorithm):
+    """◇S: eventually every crashed process is suspected by all correct
+    processes and some correct process is never suspected."""
+
+    def __init__(self, hysteresis: int = 5):
+        self.hysteresis = hysteresis
+        self.rounds = (EsfdRound(hysteresis),)
+
+    def make_init_state(self, ctx: RoundCtx, io) -> EsfdState:
+        return EsfdState(last_seen=jnp.zeros((ctx.n,), dtype=jnp.int32))
+
+    def suspected(self, state: EsfdState) -> jnp.ndarray:
+        """[n_lanes, n] suspicion matrix accessor."""
+        return state.last_seen > self.hysteresis

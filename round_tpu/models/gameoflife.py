@@ -1,0 +1,67 @@
+"""Conway's Game of Life on a torus overlay — synchronous cellular automaton.
+
+Reference: example/ConwayGameOfLife.scala:12-76 — one process per cell, each
+sends its aliveness to its 8 torus neighbours (getNeighbours,
+ConwayGameOfLife.scala:92-112) and applies the B3/S23 rule on what it heard.
+A deliberately non-consensus example: it exercises point-to-multipoint
+dest masks (neither broadcast nor unicast) and overlay topologies.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import Round, RoundCtx, SendSpec
+from round_tpu.ops.mailbox import Mailbox
+
+
+def torus_neighbours(rows: int, cols: int) -> np.ndarray:
+    """[n, n] bool: neighbours[i, j] = cell j is one of i's 8 neighbours."""
+    n = rows * cols
+    out = np.zeros((n, n), dtype=bool)
+    for i in range(n):
+        r, c = divmod(i, cols)
+        for dr in (-1, 0, 1):
+            for dc in (-1, 0, 1):
+                if dr == 0 and dc == 0:
+                    continue
+                j = ((r + dr) % rows) * cols + (c + dc) % cols
+                out[i, j] = True
+    return out
+
+
+@flax.struct.dataclass
+class CgolState:
+    alive: jnp.ndarray  # bool
+
+
+class CgolRound(Round):
+    def __init__(self, neighbours: jnp.ndarray):
+        self.neighbours = jnp.asarray(neighbours)
+
+    def send(self, ctx: RoundCtx, state: CgolState):
+        return SendSpec(state.alive, self.neighbours[ctx.id])
+
+    def update(self, ctx: RoundCtx, state: CgolState, mbox: Mailbox):
+        alive_nbrs = mbox.count(lambda v: v)
+        survive = state.alive & ((alive_nbrs == 2) | (alive_nbrs == 3))
+        born = ~state.alive & (alive_nbrs == 3)
+        return state.replace(alive=survive | born)
+
+
+class ConwayGameOfLife(Algorithm):
+    def __init__(self, rows: int, cols: int):
+        self.rows = rows
+        self.cols = cols
+        self.rounds = (CgolRound(torus_neighbours(rows, cols)),)
+
+    def make_init_state(self, ctx: RoundCtx, io) -> CgolState:
+        return CgolState(alive=jnp.asarray(io["alive"], dtype=bool))
+
+
+def cgol_io(grid) -> dict:
+    """io from a [rows, cols] bool array."""
+    return {"alive": jnp.asarray(grid, dtype=bool).reshape(-1)}

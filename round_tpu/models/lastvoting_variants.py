@@ -1,0 +1,218 @@
+"""LastVoting variants: ShortLastVoting (3-round flood) and MultiLastVoting
+(coordinator election + Option values).
+
+ShortLastVoting (reference: example/ShortLastVoting.scala:13-106): drops the
+ack round — after adopting the coordinator's vote, adopters flood x to
+everyone and any process hearing a majority of floods decides.  One round
+shorter per phase than LastVoting, more messages in the flood round.
+
+MultiLastVoting (reference: example/MultiLastVoting.scala:15-125): processes
+start as acceptor (Left(coord hint)) or proposer (Right(v)); round 0 elects
+the coordinator among senders (the hint if it sent, else the smallest sender
+id) and adopts its value; round 1 acks to the elected coordinator; round 2
+the ready coordinator floods and receivers decide Some(v) — or decide None
+after round 30 (suspected leader crash, triggering an election upstream).
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import Round, RoundCtx, broadcast, unicast
+from round_tpu.models.common import ghost_decide
+from round_tpu.ops.mailbox import Mailbox
+
+
+# -- ShortLastVoting -------------------------------------------------------
+
+
+@flax.struct.dataclass
+class SlvState:
+    x: jnp.ndarray
+    ts: jnp.ndarray
+    commit: jnp.ndarray
+    vote: jnp.ndarray
+    decided: jnp.ndarray
+    decision: jnp.ndarray
+
+
+def _coord4(ctx: RoundCtx):
+    return (ctx.r // 4) % ctx.n
+
+
+class SlvCollect(Round):
+    def send(self, ctx: RoundCtx, state: SlvState):
+        return unicast(ctx, _coord4(ctx), {"x": state.x, "ts": state.ts})
+
+    def update(self, ctx: RoundCtx, state: SlvState, mbox: Mailbox):
+        act = (ctx.id == _coord4(ctx)) & (mbox.size() > ctx.n // 2)
+        best = mbox.best_by(mbox.values["ts"])
+        return state.replace(
+            vote=jnp.where(act, best["x"], state.vote),
+            commit=state.commit | act,
+        )
+
+
+class SlvPropose(Round):
+    def send(self, ctx: RoundCtx, state: SlvState):
+        return broadcast(
+            ctx, state.vote, guard=(ctx.id == _coord4(ctx)) & state.commit
+        )
+
+    def update(self, ctx: RoundCtx, state: SlvState, mbox: Mailbox):
+        got = mbox.contains(_coord4(ctx))
+        return state.replace(
+            x=jnp.where(got, mbox.get(_coord4(ctx)), state.x),
+            ts=jnp.where(got, ctx.r // 4, state.ts),
+        )
+
+
+class SlvFlood(Round):
+    def send(self, ctx: RoundCtx, state: SlvState):
+        return broadcast(ctx, state.x, guard=state.ts == ctx.r // 4)
+
+    def update(self, ctx: RoundCtx, state: SlvState, mbox: Mailbox):
+        quorum = mbox.size() > ctx.n // 2
+        v = mbox.any_value()  # mailbox.head (all flooded values agree)
+        state = ghost_decide(state, quorum, v)
+        ctx.exit_at_end_of_round(state.decided)
+        return state.replace(commit=jnp.asarray(False))
+
+
+class ShortLastVoting(Algorithm):
+    """3-round LastVoting: collect / propose / flood-decide."""
+
+    def __init__(self):
+        self.rounds = (SlvCollect(), SlvPropose(), SlvFlood())
+        # NOTE the reference keeps the 4-round coordinator arithmetic
+        # (coord(r/4), ts = r/4) while the phase is 3 rounds long
+        # (ShortLastVoting.scala:37,78) — r advances by 3 per phase, so the
+        # coordinator rotates irregularly.  Mirrored faithfully.
+
+    def make_init_state(self, ctx: RoundCtx, io) -> SlvState:
+        return SlvState(
+            x=jnp.asarray(io["initial_value"], dtype=jnp.int32),
+            ts=jnp.asarray(-1, dtype=jnp.int32),
+            commit=jnp.asarray(False),
+            vote=jnp.asarray(0, dtype=jnp.int32),
+            decided=jnp.asarray(False),
+            decision=jnp.asarray(-1, dtype=jnp.int32),
+        )
+
+    def decided(self, state: SlvState):
+        return state.decided
+
+    def decision(self, state: SlvState):
+        return state.decision
+
+
+# -- MultiLastVoting -------------------------------------------------------
+
+MLV_NULL = -1
+
+
+@flax.struct.dataclass
+class MlvState:
+    x_val: jnp.ndarray
+    x_def: jnp.ndarray
+    coord_val: jnp.ndarray
+    coord_def: jnp.ndarray
+    ready: jnp.ndarray
+    decided: jnp.ndarray
+    decision: jnp.ndarray  # int32, -1 = None (suspected leader crash)
+
+
+class MlvElect(Round):
+    def send(self, ctx: RoundCtx, state: MlvState):
+        return broadcast(ctx, state.x_val, guard=state.x_def)
+
+    def update(self, ctx: RoundCtx, state: MlvState, mbox: Mailbox):
+        got_any = mbox.size() > 0
+        hint_ok = state.coord_def & mbox.contains(state.coord_val)
+        min_sender = jnp.argmax(mbox.mask)  # smallest present id (minBy)
+        chosen = jnp.where(hint_ok, state.coord_val, min_sender).astype(jnp.int32)
+        v = mbox.get(chosen)
+        return state.replace(
+            coord_val=jnp.where(got_any, chosen, state.coord_val),
+            coord_def=state.coord_def | got_any,
+            x_val=jnp.where(got_any, v, state.x_val),
+            x_def=state.x_def | got_any,
+        )
+
+
+class MlvAck(Round):
+    def send(self, ctx: RoundCtx, state: MlvState):
+        return unicast(
+            ctx, state.coord_val, state.x_val, guard=state.x_def & state.coord_def
+        )
+
+    def update(self, ctx: RoundCtx, state: MlvState, mbox: Mailbox):
+        return state.replace(ready=state.ready | (mbox.size() > ctx.n // 2))
+
+
+class MlvDecide(Round):
+    def send(self, ctx: RoundCtx, state: MlvState):
+        return broadcast(ctx, state.x_val, guard=state.ready)
+
+    def update(self, ctx: RoundCtx, state: MlvState, mbox: Mailbox):
+        got = mbox.size() > 0
+        v = mbox.any_value()
+        give_up = ~got & (ctx.r > 30)
+        ctx.exit_at_end_of_round(got | give_up)
+        state = ghost_decide(
+            state, got | give_up, jnp.where(got, v, MLV_NULL)
+        )
+        return state.replace(
+            ready=jnp.asarray(False),
+            coord_def=jnp.asarray(False),
+        )
+
+
+class MultiLastVoting(Algorithm):
+    """Coordinator-electing LastVoting over Option values."""
+
+    def __init__(self):
+        self.rounds = (MlvElect(), MlvAck(), MlvDecide())
+
+    def make_init_state(self, ctx: RoundCtx, io) -> MlvState:
+        return MlvState(
+            x_val=jnp.asarray(io["value"], dtype=jnp.int32),
+            x_def=jnp.asarray(io["is_proposer"], dtype=bool),
+            coord_val=jnp.asarray(io["coord_hint"], dtype=jnp.int32),
+            coord_def=jnp.asarray(io["has_hint"], dtype=bool),
+            ready=jnp.asarray(False),
+            decided=jnp.asarray(False),
+            decision=jnp.asarray(MLV_NULL, dtype=jnp.int32),
+        )
+
+    def decided(self, state: MlvState):
+        return state.decided
+
+    def decision(self, state: MlvState):
+        return state.decision
+
+
+def mlv_io(n: int, proposers: dict, coord_hints: dict = None) -> dict:
+    """io: ``proposers`` maps pid -> value (Right(v)); everyone else is an
+    acceptor, optionally with a coord hint (Left(pid))."""
+    import numpy as np
+
+    coord_hints = coord_hints or {}
+    val = np.zeros(n, dtype=np.int32)
+    is_prop = np.zeros(n, dtype=bool)
+    hint = np.zeros(n, dtype=np.int32)
+    has_hint = np.zeros(n, dtype=bool)
+    for p, v in proposers.items():
+        val[p] = v
+        is_prop[p] = True
+    for p, c in coord_hints.items():
+        hint[p] = c
+        has_hint[p] = True
+    return {
+        "value": jnp.asarray(val),
+        "is_proposer": jnp.asarray(is_prop),
+        "coord_hint": jnp.asarray(hint),
+        "has_hint": jnp.asarray(has_hint),
+    }

@@ -1,0 +1,87 @@
+"""Lattice agreement over finite set lattices (join = union).
+
+Protocol (reference: example/LatticeAgreement.scala:32-67): broadcast the
+proposed set; if more than n/2 received proposals equal yours, decide it;
+otherwise join (union) everything received and retry.  Decisions are
+comparable lattice elements: any two decided sets are ordered by ⊆.
+
+The reference fixes the lattice to Set[Int] for serialization
+(LatticeAgreement.scala:13-23); here an element is an [m] bool membership
+vector over a static universe of m values, so join is elementwise OR and
+equality is vector equality — the Kryo set serializer becomes a bitmask.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import Round, RoundCtx, broadcast
+from round_tpu.ops.mailbox import Mailbox
+
+
+@flax.struct.dataclass
+class LatticeState:
+    active: jnp.ndarray    # bool
+    proposed: jnp.ndarray  # [m] bool membership vector
+    decided: jnp.ndarray   # bool (decision.isDefined ghost)
+    decision: jnp.ndarray  # [m] bool (meaningless until decided)
+
+
+class LatticeRound(Round):
+    def send(self, ctx: RoundCtx, state: LatticeState):
+        return broadcast(ctx, state.proposed)
+
+    def update(self, ctx: RoundCtx, state: LatticeState, mbox: Mailbox):
+        same = mbox.count(
+            lambda v: jnp.all(v == state.proposed[None, :], axis=-1)
+        )
+        deciding = state.active & (same > ctx.n // 2)
+        joined = state.proposed | jnp.any(mbox.values & mbox.mask[:, None], axis=0)
+
+        ctx.exit_at_end_of_round(deciding)
+        newly = deciding & ~state.decided
+        return state.replace(
+            active=state.active & ~deciding,
+            proposed=jnp.where(
+                state.active & ~deciding, joined, state.proposed
+            ),
+            decided=state.decided | deciding,
+            decision=jnp.where(newly[..., None], state.proposed, state.decision),
+        )
+
+
+class LatticeAgreement(Algorithm):
+    """Lattice agreement: decided values form a chain under ⊆."""
+
+    def __init__(self, universe: int):
+        self.universe = universe
+        self.rounds = (LatticeRound(),)
+
+    def make_init_state(self, ctx: RoundCtx, io) -> LatticeState:
+        m = io["initial_value"].shape[-1]
+        return LatticeState(
+            active=jnp.asarray(True),
+            proposed=jnp.asarray(io["initial_value"], dtype=bool),
+            decided=jnp.asarray(False),
+            decision=jnp.zeros((m,), dtype=bool),
+        )
+
+    def decided(self, state: LatticeState):
+        return state.decided
+
+    def decision(self, state: LatticeState):
+        return state.decision
+
+
+def lattice_io(sets, universe: int) -> dict:
+    """io from per-process collections of ints < universe."""
+    import numpy as np
+
+    n = len(sets)
+    mat = np.zeros((n, universe), dtype=bool)
+    for i, s in enumerate(sets):
+        for v in s:
+            mat[i, v] = True
+    return {"initial_value": jnp.asarray(mat)}

@@ -1,0 +1,65 @@
+"""Dijkstra's self-stabilizing token-ring mutual exclusion.
+
+Protocol (reference: example/SelfStabilizingMutualExclusion.scala:10-46,
+after MIT 6.852 lec. 24): processes form a ring; each sends x to its right
+neighbour (so each receives from its left).  Process 0 holds the token when
+its value equals its left neighbour's and then increments mod n+1; everyone
+else holds the token when its value differs and then copies.  From ANY
+initial state the ring converges to exactly one token.
+
+Implemented over the EventRound adapter (the reference uses EventRound with
+Progress.goAhead on the single expected message) — each lane receives at
+most one message, from its left neighbour.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import EventRound, RoundCtx, unicast
+from round_tpu.models.common import consensus_io
+
+
+@flax.struct.dataclass
+class MutexState:
+    x: jnp.ndarray          # int32 in [0, n]
+    has_token: jnp.ndarray  # bool ghost: held the token this round
+
+
+class MutexRound(EventRound):
+    def pre(self, ctx: RoundCtx, state: MutexState):
+        return state.replace(has_token=jnp.asarray(False))
+
+    def send(self, ctx: RoundCtx, state: MutexState):
+        right = (ctx.id + 1) % ctx.n
+        return unicast(ctx, right, state.x)
+
+    def receive(self, ctx: RoundCtx, state: MutexState, sender, payload):
+        x_left = payload
+        is_zero = ctx.id == 0
+        token = jnp.where(is_zero, state.x == x_left, state.x != x_left)
+        new_x = jnp.where(
+            is_zero,
+            jnp.where(token, (state.x + 1) % (ctx.n + 1), state.x),
+            jnp.where(token, x_left, state.x),
+        )
+        return state.replace(x=new_x, has_token=token), jnp.asarray(True)
+
+
+class SelfStabilizingMutualExclusion(Algorithm):
+    """Converges to exactly one token holder per round from any state."""
+
+    def __init__(self):
+        self.rounds = (MutexRound(),)
+
+    def make_init_state(self, ctx: RoundCtx, io) -> MutexState:
+        return MutexState(
+            x=jnp.asarray(io["initial_value"], dtype=jnp.int32),
+            has_token=jnp.asarray(False),
+        )
+
+
+def mutex_io(initial_values) -> dict:
+    return consensus_io(initial_values)

@@ -1,0 +1,137 @@
+"""PBFT-style single-decision byzantine consensus (pre-prepare / prepare /
+commit with digest checks).
+
+Reference: example/byzantine/test/Consensus.scala:26-165 (``Bcp``): 3-round
+phases with coordinator ``coord = (r/3) % n``:
+
+  pre-prepare: coord broadcasts (request, digest); receivers adopt the
+    request, recompute the digest and null out on mismatch; a lane that
+    fails to get a valid request decides null and stops.
+  prepare: broadcast your digest; more than 2n/3 matches -> prepared.
+  commit: the prepared broadcast the digest; more than 2n/3 matches ->
+    decide(x), else decide(null).  The instance terminates either way.
+
+Digests here are an int32 mixing hash of the int request (SHA-256 in the
+reference); byzantine payload corruption that breaks the (request, digest)
+pair is caught exactly like a failed MessageDigest.isEqual.  Run under
+``scenarios.byzantine_silence`` + ``sync_k_filter(n - f)`` masks and/or the
+``utils.byzantine`` payload adversary; tolerates f < n/3.
+
+Decision encoding: int32, -1 = null (aborted / suspected coordinator).
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import Round, RoundCtx, broadcast
+from round_tpu.models.common import ghost_decide
+from round_tpu.ops.mailbox import Mailbox
+
+DECIDE_NULL = -1
+
+
+def digest(x: jnp.ndarray) -> jnp.ndarray:
+    """Cheap int32 mixing hash standing in for SHA-256 (collision-resistance
+    is not the point of the *model*; pair-consistency checking is)."""
+    h = x.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    return (h ^ (h >> 13)).astype(jnp.int32)
+
+
+@flax.struct.dataclass
+class BcpState:
+    x: jnp.ndarray         # int32 request
+    dig: jnp.ndarray       # int32 digest of x
+    valid: jnp.ndarray     # bool — x is non-null and digest-consistent
+    prepared: jnp.ndarray  # bool
+    decided: jnp.ndarray
+    decision: jnp.ndarray  # int32, -1 = null
+
+
+def _coord(ctx: RoundCtx):
+    return (ctx.r // 3) % ctx.n
+
+
+class BcpPrePrepare(Round):
+    def send(self, ctx: RoundCtx, state: BcpState):
+        return broadcast(
+            ctx,
+            {"req": state.x, "dig": state.dig},
+            guard=ctx.id == _coord(ctx),
+        )
+
+    def update(self, ctx: RoundCtx, state: BcpState, mbox: Mailbox):
+        coord = _coord(ctx)
+        got = mbox.contains(coord)
+        req = mbox.values["req"][coord]
+        claimed = mbox.values["dig"][coord]
+        recomputed = digest(req)
+
+        is_coord = ctx.id == coord
+        adopt = got & ~is_coord
+        x = jnp.where(adopt, req, state.x)
+        dig = jnp.where(adopt, recomputed, state.dig)
+        valid = jnp.where(adopt, recomputed == claimed, state.valid)
+
+        # finishRound: abort on no/invalid request (Consensus.scala:90-97)
+        fail = ~got | ~valid
+        ctx.exit_at_end_of_round(fail)
+        state = ghost_decide(state, fail, jnp.asarray(DECIDE_NULL))
+        return state.replace(x=x, dig=dig, valid=valid)
+
+
+class BcpPrepare(Round):
+    def send(self, ctx: RoundCtx, state: BcpState):
+        return broadcast(ctx, {"dig": state.dig, "ok": state.valid})
+
+    def update(self, ctx: RoundCtx, state: BcpState, mbox: Mailbox):
+        confirmed = mbox.count(
+            lambda m: m["ok"] & (m["dig"] == state.dig)
+        )
+        return state.replace(prepared=confirmed > 2 * ctx.n // 3)
+
+
+class BcpCommit(Round):
+    def send(self, ctx: RoundCtx, state: BcpState):
+        return broadcast(ctx, state.dig, guard=state.prepared)
+
+    def update(self, ctx: RoundCtx, state: BcpState, mbox: Mailbox):
+        confirmed = mbox.count(lambda d: d == state.dig)
+        committed = confirmed > 2 * ctx.n // 3
+        ctx.exit_at_end_of_round(True)  # terminate either way (:160)
+        return ghost_decide(
+            state, jnp.asarray(True), jnp.where(committed, state.x, DECIDE_NULL)
+        )
+
+
+class PbftConsensus(Algorithm):
+    """Single-decision PBFT-style consensus, f < n/3 byzantine."""
+
+    def __init__(self, synchronized: bool = False):
+        rounds = (BcpPrePrepare(), BcpPrepare(), BcpCommit())
+        if synchronized:
+            from round_tpu.utils.byzantine import synchronize
+
+            rounds = synchronize(rounds)
+        self.rounds = rounds
+
+    def make_init_state(self, ctx: RoundCtx, io) -> BcpState:
+        x = jnp.asarray(io["initial_value"], dtype=jnp.int32)
+        return BcpState(
+            x=x,
+            dig=digest(x),
+            valid=jnp.asarray(True),
+            prepared=jnp.asarray(False),
+            decided=jnp.asarray(False),
+            decision=jnp.asarray(DECIDE_NULL, dtype=jnp.int32),
+        )
+
+    def decided(self, state: BcpState):
+        return state.decided
+
+    def decision(self, state: BcpState):
+        return state.decision

@@ -1,0 +1,80 @@
+"""Θ-model round synchronizer (Widder & Schmid clock sync).
+
+Reference: example/ThetaModel.scala:34-105 — Θ bounds the ratio of longest to
+shortest end-to-end delays; the algorithm builds synchronized logical rounds
+on top: a process fires logical round ``round`` when the physical round
+counter hits ``nextRoundAt`` (3Θ(round+1)+1 for known Θ, the triangular
+schedule for unknown Θ), sending Some(payload) then; otherwise it broadcasts
+None.  Receivers deliver defined payloads and advance on n-f messages.
+
+Payload here is the sender's logical round (the reference ships an opaque A
+from TmIO.getMessage); deliveries are recorded as the highest logical round
+heard per peer — enough to state the Θ-model sync property (logical clocks
+within 1 of each other under bounded-delay HO families).
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import Round, RoundCtx, broadcast
+from round_tpu.ops.mailbox import Mailbox
+
+
+@flax.struct.dataclass
+class ThetaState:
+    round: jnp.ndarray         # int32 logical round
+    next_round_at: jnp.ndarray # int32 physical round of the next fire
+    heard: jnp.ndarray         # [n] int32 — highest logical round heard per peer
+
+
+def _next_round_at(theta: float, round_):
+    if theta >= 1:
+        return (3 * theta * (round_ + 1)).astype(jnp.int32) + 1
+    # unknown theta: triangular schedule (ThetaModel.scala:49-51)
+    return (round_ + 1) * (round_ + 2) // 2
+
+
+class ThetaRound(Round):
+    def __init__(self, f: int, theta: float):
+        self.f = f
+        self.theta = float(theta)
+
+    def send(self, ctx: RoundCtx, state: ThetaState):
+        firing = ctx.r == state.next_round_at
+        return broadcast(ctx, {"defined": firing, "round": state.round})
+
+    def update(self, ctx: RoundCtx, state: ThetaState, mbox: Mailbox):
+        defined = mbox.mask & mbox.values["defined"]
+        heard = jnp.where(
+            defined,
+            jnp.maximum(state.heard, mbox.values["round"]),
+            state.heard,
+        )
+        firing = ctx.r == state.next_round_at
+        new_round = jnp.where(firing, state.round + 1, state.round)
+        nra = jnp.where(
+            firing,
+            _next_round_at(self.theta, new_round),
+            state.next_round_at,
+        )
+        return state.replace(round=new_round, next_round_at=nra, heard=heard)
+
+
+class ThetaModel(Algorithm):
+    """Logical rounds synchronized by the Θ delay-ratio assumption."""
+
+    def __init__(self, f: int = 1, theta: float = 2.0):
+        self.f = f
+        self.theta = theta
+        self.rounds = (ThetaRound(f, theta),)
+
+    def make_init_state(self, ctx: RoundCtx, io) -> ThetaState:
+        r0 = jnp.asarray(0, dtype=jnp.int32)
+        return ThetaState(
+            round=r0,
+            next_round_at=jnp.asarray(_next_round_at(self.theta, r0), jnp.int32),
+            heard=jnp.full((ctx.n,), -1, dtype=jnp.int32),
+        )

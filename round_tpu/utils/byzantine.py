@@ -1,0 +1,114 @@
+"""Byzantine-tolerant round synchronization (f < n/3).
+
+The reference's PessimisticByzantineSynchronizer
+(utils/PessimisticByzantineSynchronizer.scala:16-67) wraps an EventRound[A]
+into an EventRound[Option[A]] that ALWAYS broadcasts — None where the inner
+round had nothing to send — so that every correct process receives n-f
+countable messages per round and can synchronize despite byzantine silence.
+
+In the lockstep engine the two halves of that contract split cleanly:
+
+  - the *message* side is `SynchronizedRound`: every lane broadcasts
+    (defined?, payload, dest-row); the inner round's mailbox is rebuilt from
+    the defined mask, so padding is visible on the wire exactly like the
+    reference's Option[A];
+  - the *timing* side (count n-f before progressing, short/long timeouts) is
+    an HO-family constraint: run under `scenarios.sync_k_filter(base, n - f)`
+    so every receiver hears at least n-f processes per round — the mask
+    encoding of `nMsg > nf` (PessimisticByzantineSynchronizer.scala:52-58).
+
+Payload corruption by byzantine senders is a separate adversary transform
+(`corrupt_payloads`), mirroring the runtime's tolerance of garbage messages
+(InstanceHandler.scala:392-399): correctness must come from the algorithm's
+quorums, never from trusting a payload.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from round_tpu.core.rounds import Round, RoundCtx, SendSpec
+from round_tpu.ops.mailbox import Mailbox
+
+
+class SynchronizedRound(Round):
+    """Wrap a Round so every lane always broadcasts (None-padded payloads).
+
+    The wire payload is {"defined": bool per receiver, "value": inner
+    payload}; since SendSpec carries one payload per sender, the inner
+    round's per-destination mask rides along as the ``dest_row`` field and
+    each receiver reads its own column — semantically identical to
+    Some/None padding per destination."""
+
+    def __init__(self, inner: Round):
+        self.inner = inner
+
+    def pre(self, ctx: RoundCtx, state):
+        return self.inner.pre(ctx, state)
+
+    def send(self, ctx: RoundCtx, state) -> SendSpec:
+        spec = self.inner.send(ctx, state)
+        wrapped = {"value": spec.payload, "dest_row": spec.dest_mask}
+        return SendSpec(wrapped, jnp.ones((ctx.n,), dtype=bool))
+
+    def update(self, ctx: RoundCtx, state, mbox: Mailbox):
+        defined = jnp.take(mbox.values["dest_row"], ctx.id, axis=1)
+        inner_mbox = Mailbox(mbox.values["value"], mbox.mask & defined)
+        return self.inner.update(ctx, state, inner_mbox)
+
+
+def synchronize(rounds) -> tuple:
+    """Wrap every round of a phase (the wrapRound helper of
+    byzantine/test/Consensus.scala:48-54)."""
+    return tuple(SynchronizedRound(r) for r in rounds)
+
+
+def corrupt_payloads(
+    payload_fn: Callable[[jax.Array, Any], Any], f: int
+) -> Callable:
+    """Build an adversary transform: (key, payload_tree, n) -> payload_tree
+    with the first-drawn f byzantine lanes' payloads replaced by
+    ``payload_fn(key, original)``.  Compose with the engine via
+    AdversarialRound below."""
+
+    def transform(key, payload, n):
+        kb = jax.random.fold_in(key, 0xB12)
+        byz = jax.random.permutation(kb, n) < f  # same draw as
+        # scenarios.byzantine_silence so mask- and payload-adversaries agree
+
+        def corrupt_leaf(leaf):
+            garbage = payload_fn(key, leaf)
+            mask = byz.reshape((n,) + (1,) * (leaf.ndim - 1))
+            return jnp.where(mask, garbage, leaf)
+
+        return jax.tree_util.tree_map(corrupt_leaf, payload)
+
+    return transform
+
+
+class AdversarialRound(Round):
+    """Apply a payload-corruption transform to what byzantine lanes send.
+
+    The transform runs receiver-side on the shared payload tensor (the wire
+    is a tensor; corrupting the sender's slot corrupts what everyone hears —
+    byzantine *equivocation* additionally needs per-receiver values, modeled
+    by the mask families in engine.scenarios.byzantine_silence)."""
+
+    def __init__(self, inner: Round, transform, key: jax.Array):
+        self.inner = inner
+        self.transform = transform
+        self.key = key
+
+    def pre(self, ctx: RoundCtx, state):
+        return self.inner.pre(ctx, state)
+
+    def send(self, ctx: RoundCtx, state) -> SendSpec:
+        return self.inner.send(ctx, state)
+
+    def update(self, ctx: RoundCtx, state, mbox: Mailbox):
+        k = jax.random.fold_in(self.key, ctx.r)
+        values = self.transform(k, mbox.values, ctx.n)
+        return self.inner.update(ctx, state, Mailbox(values, mbox.mask))

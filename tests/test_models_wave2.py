@@ -1,0 +1,419 @@
+"""Wave-2 algorithm library: epsilon, lattice, ERB, ESFD, mutex, CGoL,
+theta, PBFT, LastVoting variants."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.engine.executor import run_instance, simulate
+from round_tpu.engine import scenarios
+from round_tpu.models import (
+    ConwayGameOfLife,
+    EagerReliableBroadcast,
+    EpsilonConsensus,
+    Esfd,
+    LatticeAgreement,
+    MultiLastVoting,
+    PbftConsensus,
+    SelfStabilizingMutualExclusion,
+    ShortLastVoting,
+    ThetaModel,
+    broadcast_io,
+    cgol_io,
+    consensus_io,
+    lattice_io,
+    mlv_io,
+    mutex_io,
+    real_consensus_io,
+)
+from round_tpu.models.pbft import DECIDE_NULL, digest
+
+
+# -- epsilon ---------------------------------------------------------------
+
+
+def test_epsilon_converges_within_epsilon():
+    n, f, eps = 8, 1, 0.05
+    init = [0.0, 1.0, 0.3, 0.7, 0.2, 0.9, 0.5, 0.1]
+    res = run_instance(
+        EpsilonConsensus(n, f, eps),
+        real_consensus_io(init),
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.full(n),
+        max_phases=30,
+    )
+    dec = np.asarray(res.state.decided)
+    decv = np.asarray(res.state.decision)
+    assert dec.all()
+    assert decv.max() - decv.min() <= eps + 1e-6
+    assert decv.min() >= min(init) - 1e-6 and decv.max() <= max(init) + 1e-6
+
+
+def test_epsilon_under_crash():
+    n, f, eps = 8, 1, 0.1
+    res = simulate(
+        EpsilonConsensus(n, f, eps),
+        real_consensus_io([0.0, 0.8, 0.35, 0.6, 0.15, 0.95, 0.45, 0.25]),
+        n,
+        jax.random.PRNGKey(1),
+        scenarios.crash(n, f),
+        max_phases=30,
+        n_scenarios=8,
+    )
+    dec = np.asarray(res.state.decided)
+    decv = np.asarray(res.state.decision)
+    for s in range(8):
+        vals = decv[s][dec[s]]
+        assert vals.size > 0
+        assert vals.max() - vals.min() <= eps + 1e-6, (s, vals)
+
+
+def test_epsilon_identical_inputs_decide_immediately():
+    n = 8
+    res = run_instance(
+        EpsilonConsensus(n, 1, 0.1),
+        real_consensus_io([0.42] * n),
+        n,
+        jax.random.PRNGKey(2),
+        scenarios.full(n),
+        max_phases=5,
+    )
+    assert np.asarray(res.state.decided).all()
+    # diff = 0 <= eps: maxR = 0, decide at round 1
+    assert (np.asarray(res.decided_round) == 1).all()
+    np.testing.assert_allclose(np.asarray(res.state.decision), 0.42, rtol=1e-6)
+
+
+# -- lattice ---------------------------------------------------------------
+
+
+def test_lattice_decisions_form_chain():
+    n, m = 5, 8
+    sets = [{0}, {1}, {2, 3}, {4}, {5, 6}]
+    res = simulate(
+        LatticeAgreement(m),
+        lattice_io(sets, m),
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.quorum_omission(n, 0.3, lambda k: k // 2 + 1),
+        max_phases=8,
+        n_scenarios=16,
+    )
+    dec = np.asarray(res.state.decided)
+    decv = np.asarray(res.state.decision)
+    for s in range(16):
+        chosen = [decv[s, i] for i in range(n) if dec[s, i]]
+        # comparability: any two decisions ordered by inclusion
+        for a in chosen:
+            for b in chosen:
+                ab = (a & b == a).all() or (a & b == b).all()
+                assert ab, (s, a, b)
+
+
+def test_lattice_full_network_decides_round_two():
+    n, m = 4, 6
+    sets = [{0}, {1}, {2}, {3}]
+    res = run_instance(
+        LatticeAgreement(m),
+        lattice_io(sets, m),
+        n,
+        jax.random.PRNGKey(1),
+        scenarios.full(n),
+        max_phases=4,
+    )
+    assert np.asarray(res.state.decided).all()
+    # round 0 joins everything; round 1: all proposals equal -> decide
+    assert (np.asarray(res.decided_round) == 1).all()
+    assert np.asarray(res.state.decision)[:, :4].all()
+
+
+# -- eager reliable broadcast ---------------------------------------------
+
+
+def test_erb_delivers_to_all():
+    n = 6
+    res = run_instance(
+        EagerReliableBroadcast(),
+        broadcast_io(origin=2, value=77, n=n),
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.omission(n, 0.4),
+        max_phases=12,
+    )
+    assert np.asarray(res.state.delivered).all()
+    assert (np.asarray(res.state.delivery) == 77).all()
+
+
+def test_erb_gives_up_when_origin_silent():
+    n = 4
+    # origin never heard by anyone else; others give up after round 10
+    ho = np.zeros((13, n, n), dtype=bool)
+    for t in range(13):
+        np.fill_diagonal(ho[t], True)
+    res = run_instance(
+        EagerReliableBroadcast(),
+        broadcast_io(origin=0, value=5, n=n),
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.from_schedule(jnp.asarray(ho)),
+        max_phases=13,
+    )
+    assert res.done.all()
+    delivered = np.asarray(res.state.delivered)
+    assert delivered[0] and not delivered[1:].any()
+
+
+# -- failure detector ------------------------------------------------------
+
+
+def test_esfd_suspects_crashed_and_trusts_live():
+    n, h = 5, 3
+    algo = Esfd(hysteresis=h)
+    T = 12
+    ho = np.ones((T, n, n), dtype=bool)
+    ho[:, :, 4] = False  # 4 crashed from the start (nobody hears it)
+    for t in range(T):
+        np.fill_diagonal(ho[t], True)
+    res = run_instance(
+        algo,
+        {},
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.from_schedule(jnp.asarray(ho)),
+        max_phases=T,
+    )
+    sus = np.asarray(algo.suspected(res.state))
+    # every live process suspects 4 and nobody else (except 4's own view)
+    for j in range(4):
+        assert sus[j, 4], f"{j} should suspect 4"
+        assert not sus[j, :4].any(), f"{j} wrongly suspects {np.where(sus[j])}"
+
+
+def test_esfd_suspicion_gossip():
+    """A process that hears a suspicion about an unheard peer adopts it
+    immediately (the lastSeen := hysteresis+1 jump)."""
+    n, h = 4, 3
+    algo = Esfd(hysteresis=h)
+    T = 8
+    ho = np.ones((T, n, n), dtype=bool)
+    ho[:, :, 3] = False          # 3 is dead
+    ho[:, 1, :3] = False         # 1 only hears... nobody live except itself
+    for t in range(T):
+        np.fill_diagonal(ho[t], True)
+    ho[:, 1, 0] = True           # ...and 0 (who will gossip suspicion of 3)
+    res = run_instance(
+        algo, {}, n, jax.random.PRNGKey(0),
+        scenarios.from_schedule(jnp.asarray(ho)), max_phases=T,
+    )
+    sus = np.asarray(algo.suspected(res.state))
+    assert sus[1, 3]  # adopted via gossip from 0
+    assert sus[1, 2]  # 1 never hears 2 -> own counter trips too
+
+
+# -- self-stabilizing mutex ------------------------------------------------
+
+
+def test_mutex_stabilizes_to_one_token():
+    n = 6
+    res = run_instance(
+        SelfStabilizingMutualExclusion(),
+        mutex_io([3, 3, 1, 4, 0, 2]),  # arbitrary corrupted state
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.full(n),
+        max_phases=3 * n,
+    )
+    tokens = int(np.asarray(res.state.has_token).sum())
+    assert tokens == 1, np.asarray(res.state.has_token)
+
+
+def test_mutex_token_circulates():
+    n = 4
+    algo = SelfStabilizingMutualExclusion()
+    res = run_instance(
+        algo,
+        mutex_io([0, 0, 0, 0]),  # legal state: token at 0
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.full(n),
+        max_phases=2 * n,
+        record_fn=lambda s, d, r: s.has_token,
+    )
+    rec = np.asarray(res.recorded)  # [T, n]
+    assert (rec.sum(axis=1) == 1).all()  # exactly one token every round
+    holders = rec.argmax(axis=1)
+    assert len(set(holders.tolist())) == n  # everyone eventually holds it
+
+
+# -- game of life ----------------------------------------------------------
+
+
+def test_cgol_blinker_oscillates():
+    rows = cols = 5
+    grid = np.zeros((rows, cols), dtype=bool)
+    grid[2, 1:4] = True  # horizontal blinker
+    algo = ConwayGameOfLife(rows, cols)
+    res = run_instance(
+        algo,
+        cgol_io(grid),
+        rows * cols,
+        jax.random.PRNGKey(0),
+        scenarios.full(rows * cols),
+        max_phases=2,
+    )
+    final = np.asarray(res.state.alive).reshape(rows, cols)
+    np.testing.assert_array_equal(final, grid)  # period 2
+    res1 = run_instance(
+        algo, cgol_io(grid), rows * cols, jax.random.PRNGKey(0),
+        scenarios.full(rows * cols), max_phases=1,
+    )
+    vertical = np.zeros((rows, cols), dtype=bool)
+    vertical[1:4, 2] = True
+    np.testing.assert_array_equal(
+        np.asarray(res1.state.alive).reshape(rows, cols), vertical
+    )
+
+
+# -- theta model -----------------------------------------------------------
+
+
+def test_theta_logical_clocks_advance_and_sync():
+    n, f, theta = 4, 1, 1.0
+    algo = ThetaModel(f, theta)
+    res = run_instance(
+        algo, {}, n, jax.random.PRNGKey(0), scenarios.full(n), max_phases=40
+    )
+    rounds = np.asarray(res.state.round)
+    assert (rounds > 0).all()
+    assert rounds.max() - rounds.min() <= 1  # synchronized within 1
+    heard = np.asarray(res.state.heard)
+    assert (heard >= rounds.min() - 1).all()
+
+
+# -- PBFT ------------------------------------------------------------------
+
+
+def test_pbft_decides_coordinator_value():
+    n = 7
+    res = run_instance(
+        PbftConsensus(),
+        consensus_io([42, 1, 2, 3, 4, 5, 6]),  # coord 0's request wins
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.full(n),
+        max_phases=1,
+    )
+    assert np.asarray(res.state.decided).all()
+    assert (np.asarray(res.state.decision) == 42).all()
+    assert res.done.all()
+
+
+def test_pbft_null_decision_when_coordinator_silent():
+    n = 4
+    ho = np.ones((3, n, n), dtype=bool)
+    ho[:, :, 0] = False  # nobody hears coord 0
+    np.fill_diagonal(ho[0], True)
+    np.fill_diagonal(ho[1], True)
+    np.fill_diagonal(ho[2], True)
+    res = run_instance(
+        PbftConsensus(),
+        consensus_io([9, 9, 9, 9]),
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.from_schedule(jnp.asarray(ho)),
+        max_phases=1,
+    )
+    dec = np.asarray(res.state.decision)
+    assert (dec[1:] == DECIDE_NULL).all()
+
+
+def test_pbft_byzantine_silence_tolerated():
+    """f < n/3 byzantine-silent lanes: correct lanes still decide the
+    coordinator's value, under the n-f sync mask."""
+    n, f = 7, 2
+    base = scenarios.byzantine_silence(n, f)
+    sampler = scenarios.sync_k_filter(base, n - f)
+    res = simulate(
+        PbftConsensus(),
+        consensus_io([13] * n),
+        n,
+        jax.random.PRNGKey(3),
+        sampler,
+        max_phases=1,
+        n_scenarios=16,
+    )
+    decv = np.asarray(res.state.decision)
+    # whoever decided non-null decided 13; no two different non-null values
+    non_null = decv[decv != DECIDE_NULL]
+    assert (non_null == 13).all()
+    assert non_null.size > 0
+
+
+def test_pbft_synchronized_wrapper_equivalent_on_full_network():
+    n = 5
+    io = consensus_io([31, 0, 0, 0, 0])
+    r1 = run_instance(
+        PbftConsensus(False), io, n, jax.random.PRNGKey(0),
+        scenarios.full(n), max_phases=1,
+    )
+    r2 = run_instance(
+        PbftConsensus(True), io, n, jax.random.PRNGKey(0),
+        scenarios.full(n), max_phases=1,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(r1.state.decision), np.asarray(r2.state.decision)
+    )
+    assert (np.asarray(r2.state.decision) == 31).all()
+
+
+def test_pbft_corrupted_digest_rejected():
+    """A (request, digest) pair that doesn't check out nulls the lane
+    (Consensus.scala:76-81)."""
+    assert int(digest(jnp.asarray(5))) != int(digest(jnp.asarray(6)))
+
+
+# -- LastVoting variants ---------------------------------------------------
+
+
+def test_short_lastvoting_decides_first_phase():
+    n = 4
+    res = run_instance(
+        ShortLastVoting(),
+        consensus_io([8, 3, 5, 9]),
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.full(n),
+        max_phases=1,
+    )
+    assert np.asarray(res.state.decided).all()
+    assert (np.asarray(res.state.decision) == 8).all()  # coord 0 picks
+    # smallest-id max-ts sender (all ts = -1)
+
+
+def test_multi_lastvoting_single_proposer():
+    n = 5
+    res = run_instance(
+        MultiLastVoting(),
+        mlv_io(n, proposers={2: 44}),
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.full(n),
+        max_phases=2,
+    )
+    assert np.asarray(res.state.decided).all()
+    assert (np.asarray(res.state.decision) == 44).all()
+
+
+def test_multi_lastvoting_gives_up_without_proposer():
+    n = 4
+    res = run_instance(
+        MultiLastVoting(),
+        mlv_io(n, proposers={}),
+        n,
+        jax.random.PRNGKey(0),
+        scenarios.full(n),
+        max_phases=12,  # rounds 0..35; give-up needs r > 30
+    )
+    assert np.asarray(res.state.decided).all()
+    assert (np.asarray(res.state.decision) == -1).all()
