@@ -69,18 +69,19 @@ def synchronize(rounds) -> tuple:
 def corrupt_payloads(
     payload_fn: Callable[[jax.Array, Any], Any], f: int
 ) -> Callable:
-    """Build an adversary transform: (key, payload_tree, n) -> payload_tree
-    with the first-drawn f byzantine lanes' payloads replaced by
-    ``payload_fn(key, original)``.  Compose with the engine via
+    """Build an adversary transform: (base_key, round_key, payload_tree, n)
+    -> payload_tree with the first-drawn f byzantine lanes' payloads replaced
+    by ``payload_fn(round_key, original)``.  Compose with the engine via
     AdversarialRound below."""
 
-    def transform(key, payload, n):
-        kb = jax.random.fold_in(key, 0xB12)
+    def transform(base_key, round_key, payload, n):
+        kb = jax.random.fold_in(base_key, 0xB12)
         byz = jax.random.permutation(kb, n) < f  # same draw as
-        # scenarios.byzantine_silence so mask- and payload-adversaries agree
-
+        # scenarios.byzantine_silence so mask- and payload-adversaries agree:
+        # the byz *set* comes from the un-folded scenario key (round-invariant),
+        # only the garbage values vary per round via round_key
         def corrupt_leaf(leaf):
-            garbage = payload_fn(key, leaf)
+            garbage = payload_fn(round_key, leaf)
             mask = byz.reshape((n,) + (1,) * (leaf.ndim - 1))
             return jnp.where(mask, garbage, leaf)
 
@@ -109,6 +110,6 @@ class AdversarialRound(Round):
         return self.inner.send(ctx, state)
 
     def update(self, ctx: RoundCtx, state, mbox: Mailbox):
-        k = jax.random.fold_in(self.key, ctx.r)
-        values = self.transform(k, mbox.values, ctx.n)
+        rk = jax.random.fold_in(self.key, ctx.r)
+        values = self.transform(self.key, rk, mbox.values, ctx.n)
         return self.inner.update(ctx, state, Mailbox(values, mbox.mask))

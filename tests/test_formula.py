@@ -1,0 +1,164 @@
+"""Formula layer tests.
+
+Mirrors the reference's formula suites (src/test/scala/psync/formula/
+TyperSuite.scala, SimplifySuite.scala, FormulaUtilsSuite.scala) — same
+fixture style as formula/Common.scala: process-typed variables, HO sets,
+cardinalities.
+"""
+
+import pytest
+
+from round_tpu.verify.formula import (
+    And, Application, Bool, Card, Comprehension, Eq, Exists, FALSE, ForAll,
+    FSet, FunT, Geq, Gt, Implies, Int, IntLit, Leq, Literal, Lt, Neq, Not,
+    Or, TRUE, UnInterpretedFct, Variable, procType,
+)
+from round_tpu.verify.futils import (
+    alpha_normalize, collect_ground_terms, free_vars, get_conjuncts,
+    subst_vars,
+)
+from round_tpu.verify.simplify import cnf, dnf, nnf, pnf, simplify
+from round_tpu.verify.typer import TypingError, is_well_typed, typecheck
+
+i = Variable("i", procType)
+j = Variable("j", procType)
+n = Variable("n", Int)
+a = Variable("a", Bool)
+b = Variable("b", Bool)
+c = Variable("c", Bool)
+x = UnInterpretedFct("x", FunT([procType], Int))
+HO = UnInterpretedFct("HO", FunT([procType], FSet(procType)))
+
+
+def xi(v):
+    return Application(x, [v])
+
+
+def ho(v):
+    return Application(HO, [v])
+
+
+class TestConstructors:
+    def test_and_flattens_and_absorbs(self):
+        assert And(a, TRUE, And(b, c)) == And(a, b, c)
+        assert And(a, FALSE) == FALSE
+        assert And() == TRUE
+        assert Or(a, TRUE) == TRUE
+        assert Or() == FALSE
+
+    def test_not_involution(self):
+        assert Not(Not(a)) == a
+        assert Not(TRUE) == FALSE
+
+    def test_eq_reflexive(self):
+        assert Eq(xi(i), xi(i)) == TRUE
+        assert Neq(n, n) == FALSE
+
+    def test_structural_eq_and_hash(self):
+        assert xi(i) == xi(i)
+        assert hash(xi(i)) == hash(xi(i))
+        s = {And(a, b), And(a, b), Or(a, b)}
+        assert len(s) == 2
+
+    def test_operator_sugar(self):
+        f = (n + 1 > 2) & (Card(ho(i)) <= n)
+        typecheck(f)
+        assert is_well_typed(f)
+
+
+class TestTyper:
+    def test_simple(self):
+        f = ForAll([i], Gt(Card(ho(i)), 2 * n // 3))
+        typecheck(f)
+        assert f.tpe == Bool
+        assert f.body.args[0].tpe == Int  # Card(...)
+
+    def test_comprehension_type(self):
+        comp = Comprehension([i], Gt(xi(i), 0))
+        typecheck(Gt(Card(comp), 2))
+        assert comp.tpe == FSet(procType)
+
+    def test_reject_ill_typed(self):
+        assert not is_well_typed(Eq(n, ho(i)))           # Int = Set
+        assert not is_well_typed(And(n, a))              # Int as Bool
+        # (Gt(set, set) is *accepted*: Gt is polymorphic in the AST, like the
+        # reference's Leq; ReduceOrdered axiomatizes non-Int orders later.)
+        with pytest.raises(TypingError):
+            typecheck(Eq(n, ho(i)))
+
+    def test_quantifier_binds(self):
+        f = ForAll([i], Exists([j], Eq(xi(i), xi(j))))
+        typecheck(f)
+        assert free_vars(f) == set()
+
+
+class TestNormalForms:
+    def test_nnf_pushes_negation(self):
+        f = Not(ForAll([i], Implies(a, Exists([j], b))))
+        g = nnf(f)
+        # exists i. a /\ forall j. !b
+        assert g.binder == "Exists"
+        assert "Not" not in repr(g) or "Not(b)" in repr(g)
+
+    def test_nnf_negates_comparisons(self):
+        assert nnf(Not(Leq(n, IntLit(3)))) == Gt(n, IntLit(3))
+        assert nnf(Not(Eq(n, IntLit(3)))) == Neq(n, IntLit(3))
+
+    def test_pnf_prenexes(self):
+        f = And(ForAll([i], Gt(xi(i), 0)), Exists([j], Lt(xi(j), 0)))
+        g = pnf(f)
+        # prefix of two quantifiers then a quantifier-free matrix
+        assert g.binder in ("ForAll", "Exists")
+        assert g.body.binder in ("ForAll", "Exists")
+
+    def test_cnf_dnf(self):
+        f = Or(And(a, b), c)
+        assert cnf(f) == And(Or(a, c), Or(b, c))
+        g = And(Or(a, b), c)
+        assert dnf(g) == Or(And(a, c), And(b, c))
+
+    def test_alpha_normalize_identifies_alpha_equiv(self):
+        k = Variable("k", procType)
+        f1 = ForAll([i], Gt(xi(i), 0))
+        f2 = ForAll([k], Gt(xi(k), 0))
+        assert alpha_normalize(f1) == alpha_normalize(f2)
+
+
+class TestUtils:
+    def test_free_vars(self):
+        f = ForAll([i], Eq(xi(i), xi(j)))
+        assert free_vars(f) == {j}
+
+    def test_subst_capture_avoiding(self):
+        # (forall i. x(i) = x(j))[j := i]  must NOT capture
+        f = ForAll([i], Eq(xi(i), xi(j)))
+        g = subst_vars(f, {j: i})
+        bound = g.vars[0]
+        assert bound != i  # renamed
+        assert i in free_vars(g)
+
+    def test_conjuncts(self):
+        assert get_conjuncts(And(a, And(b, c))) == [a, b, c]
+
+    def test_ground_terms(self):
+        f = ForAll([i], Gt(Card(ho(j)), n))
+        typecheck(f)
+        terms = collect_ground_terms(f)
+        assert Application(HO, [j]) in terms
+        assert n in terms
+        # nothing mentioning the bound i
+        assert all("i" != repr(t) for t in terms)
+
+
+class TestSimplify:
+    def test_constant_folding(self):
+        f = Gt(IntLit(2) * IntLit(3), IntLit(5))
+        assert simplify(f) == TRUE
+
+    def test_contradiction(self):
+        assert simplify(And(a, Not(a))) == FALSE
+        assert simplify(Or(a, Not(a))) == TRUE
+
+    def test_unused_quantifier_dropped(self):
+        f = ForAll([i], Gt(n, 0))
+        assert simplify(f) == Gt(n, 0)
