@@ -37,11 +37,13 @@ SCENARIO_AXIS = "scenario"
 PROC_AXIS = "proc"
 
 
-def make_mesh(n_devices: Optional[int] = None, proc_shards: int = 1) -> Mesh:
-    """Build a (scenario × proc) mesh over the available devices."""
+def make_mesh(
+    n_devices: Optional[int] = None, proc_shards: int = 1, devices=None
+) -> Mesh:
+    """Build a (scenario × proc) mesh over `devices` (default: jax.devices())."""
     import numpy as np
 
-    devs = jax.devices()
+    devs = devices if devices is not None else jax.devices()
     if n_devices is None:
         n_devices = len(devs)
     assert n_devices <= len(devs), f"want {n_devices} devices, have {len(devs)}"
@@ -137,8 +139,25 @@ def dryrun(n_devices: int) -> None:
     from round_tpu.engine import scenarios
     from round_tpu.models.otr import OTR
 
+    devs = jax.devices()
+    if len(devs) < n_devices:
+        # The driver validates multi-chip sharding with virtual host devices
+        # (--xla_force_host_platform_device_count) while an accelerator plugin
+        # with fewer chips may be the default platform; use the CPU devices.
+        try:
+            cpu = jax.devices("cpu")
+        except RuntimeError:
+            cpu = []
+        if len(cpu) >= n_devices:
+            devs = cpu
+        else:
+            raise RuntimeError(
+                f"dryrun wants {n_devices} devices: default platform has "
+                f"{len(devs)}, cpu has {len(cpu)} (set "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices})"
+            )
     proc_shards = 2 if n_devices % 2 == 0 else 1
-    mesh = make_mesh(n_devices, proc_shards=proc_shards)
+    mesh = make_mesh(n_devices, proc_shards=proc_shards, devices=devs)
     s_shards = n_devices // proc_shards
 
     n = max(8, 4 * proc_shards)
