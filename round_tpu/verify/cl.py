@@ -1,0 +1,382 @@
+"""CL: the reduction from set-comprehension + cardinality formulas over a
+finite-but-unbounded process universe to ground EUF+LIA, and the entailment
+check built on it.
+
+Reference parity: psync.logic.CL / ClReducer (logic/CL.scala:197-264) with
+the same pipeline shape:
+
+    simplify → theory rewrites (sets / options / maps / Time / orders)
+      → NNF → strip ∃ prefix → skolemize → symbolize comprehensions
+      → congruence closure + eager quantifier instantiation
+      → Venn-region cardinality ILP (+ witness re-instantiation)
+      → drop remaining universals → ground solver (solver.py).
+
+`entailment(h, c)` checks h ⊨ c by reducing h ∧ ¬c and testing UNSAT
+(CL.scala:106-108).  Dropping universals only ever weakens the hypothesis,
+so an 'unsat' answer is authoritative while 'sat' may be a false alarm —
+the same asymmetry the reference's assertUnsat tests rely on.
+
+ClConfig mirrors logic/ClConfig.scala:9-31: `venn_bound` is the maximum
+number of sets intersected in one region group, `inst_depth` is the eager
+instantiation depth (QStrategy(Eager(depth))).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from round_tpu.verify import quantifiers, venn
+from round_tpu.verify.formula import (
+    And, Application, Binding, Bool, BoolT, CARD, COMPREHENSION, EMPTYSET,
+    EQ, EXISTS, FORALL, FNONE_SYM, FOption, FSOME, FSet, FMap, Formula,
+    FunT, GET, Geq, GEQ, GT, Gt, IMPLIES, IN, INTERSECTION, IS_DEFINED,
+    IS_DEFINED_AT, Int, IntLit, IntT, ITE, Implies, KEYSET, LEQ, LOOKUP, LT,
+    Leq, Literal, Lt, MSIZE, NEQ, NOT, Not, OR, Or, SETMINUS, SUBSET_EQ,
+    Type, UNION, UPDATED, UnInterpreted, UnInterpretedFct, Variable,
+    procType, timeType,
+)
+from round_tpu.verify.futils import (
+    fmap, free_vars, get_conjuncts, subst_vars,
+)
+from round_tpu.verify.simplify import nnf, simplify
+from round_tpu.verify.solver import SAT, UNKNOWN, UNSAT, solve_ground
+from round_tpu.verify.typer import typecheck
+
+_fresh = itertools.count()
+
+
+@dataclasses.dataclass(frozen=True)
+class ClConfig:
+    """Tunables (ClConfig.scala:9-31)."""
+
+    venn_bound: int = 2
+    inst_depth: int = 1
+    max_insts: int = 50_000
+
+
+ClDefault = ClConfig(venn_bound=2, inst_depth=1)
+ClFull = ClConfig(venn_bound=3, inst_depth=2)
+ClProc = ClConfig(venn_bound=2, inst_depth=1)
+
+
+# ---------------------------------------------------------------------------
+# Theory rewrites
+# ---------------------------------------------------------------------------
+
+def rewrite_set_algebra(f: Formula) -> Formula:
+    """Push membership through set algebra and expand subset/set-equality to
+    bounded quantification (the reference does this inside CL normalization +
+    SetOperationsAxioms, AxiomatizedTheories.scala:8-209)."""
+
+    def elem_type(s: Formula) -> Type:
+        return s.tpe.elem if isinstance(s.tpe, FSet) else procType
+
+    def step(g: Formula) -> Formula:
+        if not isinstance(g, Application):
+            return g
+        if g.fct == IN:
+            x, s = g.args
+            if isinstance(s, Application):
+                if s.fct == UNION:
+                    return Or(*[step(Application(IN, [x, a]).with_type(Bool))
+                                for a in s.args])
+                if s.fct == INTERSECTION:
+                    return And(*[step(Application(IN, [x, a]).with_type(Bool))
+                                 for a in s.args])
+                if s.fct == SETMINUS:
+                    return And(
+                        step(Application(IN, [x, s.args[0]]).with_type(Bool)),
+                        Not(step(Application(IN, [x, s.args[1]]).with_type(Bool))),
+                    )
+                if s.fct == EMPTYSET:
+                    return Literal(False)
+            if isinstance(s, Binding) and s.binder == COMPREHENSION:
+                # β-reduce: t ∈ {y | body} → body[y := t]
+                assert len(s.vars) == 1
+                return subst_vars(s.body, {s.vars[0]: x})
+        if g.fct == SUBSET_EQ:
+            a, b = g.args
+            v = Variable(f"sub!{next(_fresh)}", elem_type(a))
+            mem_a = Application(IN, [v, a]).with_type(Bool)
+            mem_b = Application(IN, [v, b]).with_type(Bool)
+            return Binding(FORALL, [v], Implies(step(mem_a), step(mem_b))
+                           ).with_type(Bool)
+        if g.fct == EQ and isinstance(g.args[0].tpe, FSet):
+            a, b = g.args
+            v = Variable(f"ext!{next(_fresh)}", elem_type(a))
+            mem_a = step(Application(IN, [v, a]).with_type(Bool))
+            mem_b = step(Application(IN, [v, b]).with_type(Bool))
+            ext = Binding(
+                FORALL, [v],
+                And(Implies(mem_a, mem_b), Implies(mem_b, mem_a)),
+            ).with_type(Bool)
+            # extensionality + matching cardinalities
+            card_a = Application(CARD, [a]).with_type(Int)
+            card_b = Application(CARD, [b]).with_type(Int)
+            return And(ext, Application(EQ, [card_a, card_b]).with_type(Bool))
+        if g.fct == CARD and isinstance(g.args[0], Application) \
+                and g.args[0].fct == EMPTYSET:
+            return IntLit(0)
+        return g
+
+    return fmap(step, f)
+
+
+def rewrite_options(f: Formula) -> Formula:
+    """Inline the option laws the reference axiomatizes (OptionAxioms,
+    AxiomatizedTheories.scala): IsDefined(Some x), ¬IsDefined(None),
+    Get(Some x) = x.  Remaining Get/IsDefined on opaque option terms stay
+    uninterpreted (sound)."""
+
+    def step(g: Formula) -> Formula:
+        if not isinstance(g, Application):
+            return g
+        if g.fct == IS_DEFINED and isinstance(g.args[0], Application):
+            inner = g.args[0]
+            if inner.fct == FSOME:
+                return Literal(True)
+            if inner.fct == FNONE_SYM:
+                return Literal(False)
+        if g.fct == GET and isinstance(g.args[0], Application) \
+                and g.args[0].fct == FSOME:
+            return g.args[0].args[0]
+        if g.fct in (EQ, NEQ) and isinstance(g.args[0].tpe, FOption):
+            a, b = g.args
+            # Some(x) = Some(y) → x = y ; Some(x) = None → false
+            if isinstance(a, Application) and isinstance(b, Application):
+                if a.fct == FSOME and b.fct == FSOME:
+                    inner = Application(EQ, [a.args[0], b.args[0]]).with_type(Bool)
+                    return inner if g.fct == EQ else Not(inner)
+                kinds = {a.fct, b.fct}
+                if kinds == {FSOME, FNONE_SYM}:
+                    return Literal(g.fct == NEQ)
+                if a.fct == FNONE_SYM and b.fct == FNONE_SYM:
+                    return Literal(g.fct == EQ)
+        return g
+
+    return fmap(step, f)
+
+
+def rewrite_maps(f: Formula) -> Formula:
+    """Maps → sets + uninterpreted lookups (ReduceMaps.scala:8-31 +
+    MapUpdateAxioms): IsDefinedAt(m,k) → k ∈ KeySet(m); Size(m) →
+    |KeySet(m)|; LookUp(Updated(m,k,v), j) → ite(j=k, v, LookUp(m,j));
+    KeySet(Updated(m,k,v)) → KeySet(m) ∪ {k}."""
+
+    def step(g: Formula) -> Formula:
+        if not isinstance(g, Application):
+            return g
+        if g.fct == IS_DEFINED_AT:
+            m, k = g.args
+            ks = Application(KEYSET, [m])
+            if isinstance(m.tpe, FMap):
+                ks.tpe = FSet(m.tpe.key)
+            return Application(IN, [k, ks]).with_type(Bool)
+        if g.fct == MSIZE:
+            m = g.args[0]
+            ks = Application(KEYSET, [m])
+            if isinstance(m.tpe, FMap):
+                ks.tpe = FSet(m.tpe.key)
+            return Application(CARD, [ks]).with_type(Int)
+        if g.fct == LOOKUP and isinstance(g.args[0], Application) \
+                and g.args[0].fct == UPDATED:
+            upd, j = g.args
+            m, k, v = upd.args
+            eq = Application(EQ, [j, k]).with_type(Bool)
+            rec = step(Application(LOOKUP, [m, j]).with_type(g.tpe))
+            return Application(ITE, [eq, v, rec]).with_type(g.tpe)
+        if g.fct == KEYSET and isinstance(g.args[0], Application) \
+                and g.args[0].fct == UPDATED:
+            m, k, _v = g.args[0].args
+            inner = step(Application(KEYSET, [m]).with_type(g.tpe))
+            x = Variable(f"ks!{next(_fresh)}", k.tpe)
+            singleton = Binding(
+                COMPREHENSION, [x],
+                Application(EQ, [x, k]).with_type(Bool),
+            )
+            singleton.tpe = g.tpe
+            return Application(UNION, [inner, singleton]).with_type(g.tpe)
+        return g
+
+    return fmap(step, f)
+
+
+def reduce_time(f: Formula) -> Formula:
+    """Erase the Time type to Int (ReduceTime.scala:8-46).  Time values in
+    this framework are already integer rounds (core/time.py), so only the
+    type annotation needs rewriting."""
+
+    def retype(t: Optional[Type]) -> Optional[Type]:
+        if t == timeType:
+            return Int
+        if isinstance(t, FSet):
+            return FSet(retype(t.elem))
+        if isinstance(t, FOption):
+            return FOption(retype(t.elem))
+        if isinstance(t, FMap):
+            return FMap(retype(t.key), retype(t.value))
+        if isinstance(t, FunT):
+            return FunT([retype(a) for a in t.args], retype(t.ret))
+        return t
+
+    def step(g: Formula) -> Formula:
+        if g.tpe is not None:
+            g.tpe = retype(g.tpe)
+        if isinstance(g, Variable):
+            return g
+        return g
+
+    out = fmap(step, f)
+
+    def fix_syms(g: Formula) -> Formula:
+        if isinstance(g, Application) and isinstance(g.fct, UnInterpretedFct) \
+                and g.fct.tpe is not None:
+            g.fct.tpe = retype(g.fct.tpe)
+        return g
+
+    return fmap(fix_syms, out)
+
+
+def reduce_ordered(f: Formula) -> Formula:
+    """Comparisons over non-Int uninterpreted types become an uninterpreted
+    total order lt_T with its axioms (ReduceOrdered.scala:31-82)."""
+    axioms: List[Formula] = []
+    orders: Dict[Type, UnInterpretedFct] = {}
+
+    def order_for(t: Type) -> UnInterpretedFct:
+        if t not in orders:
+            lt = UnInterpretedFct(f"lt!{t!r}", FunT([t, t], Bool))
+            orders[t] = lt
+            x = Variable(f"ox!{next(_fresh)}", t)
+            y = Variable(f"oy!{next(_fresh)}", t)
+            z = Variable(f"oz!{next(_fresh)}", t)
+
+            def app(a, b):
+                return Application(lt, [a, b]).with_type(Bool)
+
+            axioms.append(Binding(FORALL, [x], Not(app(x, x))).with_type(Bool))
+            axioms.append(Binding(
+                FORALL, [x, y, z],
+                Implies(And(app(x, y), app(y, z)), app(x, z)),
+            ).with_type(Bool))
+            axioms.append(Binding(
+                FORALL, [x, y],
+                Or(app(x, y), app(y, x),
+                   Application(EQ, [x, y]).with_type(Bool)),
+            ).with_type(Bool))
+        return orders[t]
+
+    def step(g: Formula) -> Formula:
+        if isinstance(g, Application) and g.fct in (LT, LEQ, GT, GEQ):
+            t = g.args[0].tpe
+            if t is not None and isinstance(t, UnInterpreted) and t != procType:
+                lt = order_for(t)
+                a, b = g.args
+
+                def app(u, v):
+                    return Application(lt, [u, v]).with_type(Bool)
+
+                if g.fct == LT:
+                    return app(a, b)
+                if g.fct == GT:
+                    return app(b, a)
+                eq = Application(EQ, [a, b]).with_type(Bool)
+                if g.fct == LEQ:
+                    return Or(app(a, b), eq)
+                return Or(app(b, a), eq)
+        return g
+
+    out = fmap(step, f)
+    if axioms:
+        out = And(out, *axioms)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The reducer
+# ---------------------------------------------------------------------------
+
+class ClReducer:
+    def __init__(self, config: ClConfig = ClDefault):
+        self.config = config
+
+    def reduce(self, f: Formula) -> Formula:
+        """Full reduction to a ground formula (CL.reduce, CL.scala:197-264)."""
+        cfg = self.config
+        f = simplify(f)
+        f = typecheck(f)
+        f = reduce_time(f)
+        f = rewrite_maps(f)
+        f = rewrite_options(f)
+        f = rewrite_set_algebra(f)
+        f = reduce_ordered(f)
+        f = typecheck(f)
+        f = nnf(f)
+        f, _consts = quantifiers.get_existential_prefix(f)
+        f = quantifiers.skolemize(f)
+        f, setdefs = quantifiers.symbolize_comprehensions(f)
+        f = typecheck(f)
+
+        ground, universals = quantifiers._clause_split(f)
+        for sd in setdefs:
+            if sd.definition is not None:
+                d = typecheck(sd.definition)
+                d = nnf(d)
+                for c in get_conjuncts(d):
+                    if isinstance(c, Binding) and c.binder == FORALL:
+                        universals.append(c)
+                    else:
+                        ground.append(c)
+
+        # round 1: eager instantiation over the ground terms
+        insts = quantifiers.instantiate(
+            universals, ground, depth=cfg.inst_depth, max_insts=cfg.max_insts
+        )
+        # membership may have been β-reduced inside instances
+        insts = [rewrite_set_algebra(i) for i in insts]
+        base = ground + insts
+
+        # venn regions over everything ground so far (persistent instances:
+        # the witness-round rewrite below must share card/region variables)
+        elements = quantifiers.ground_terms_by_type(base)
+        regions = venn.build_regions(base, elements, bound=cfg.venn_bound)
+        all_witnesses: List[Formula] = []
+        for vr in regions.values():
+            all_witnesses.extend(vr.witnesses)
+
+        # round 2: make the universals bite on the region witnesses
+        wit_ground = base + [
+            Application(EQ, [w, w]).with_type(Bool) for w in all_witnesses
+        ]
+        insts2 = quantifiers.instantiate(
+            universals, wit_ground, depth=1, max_insts=cfg.max_insts
+        )
+        insts2 = [rewrite_set_algebra(i) for i in insts2]
+        # round 2 regenerates the round-1 instances (fresh dedup state);
+        # keep only the genuinely new ones
+        base_set = set(base)
+        insts2 = [i for i in insts2 if i not in base_set]
+
+        rewritten = venn.rewrite_cards(regions, base + insts2)
+        constraints, _wits = venn.collect(regions)
+
+        out = And(*(rewritten + constraints))
+        return typecheck(out)
+
+    def check_sat(self, f: Formula) -> str:
+        return solve_ground(self.reduce(f))
+
+    def entailment(self, hypothesis: Formula, conclusion: Formula) -> bool:
+        """h ⊨ c  iff  h ∧ ¬c is UNSAT after reduction (CL.scala:106-108).
+        Only an UNSAT verdict proves entailment."""
+        return self.check_sat(And(hypothesis, Not(conclusion))) == UNSAT
+
+
+def reduce(f: Formula, config: ClConfig = ClDefault) -> Formula:
+    return ClReducer(config).reduce(f)
+
+
+def entailment(h: Formula, c: Formula, config: ClConfig = ClDefault) -> bool:
+    return ClReducer(config).entailment(h, c)

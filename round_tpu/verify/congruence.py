@@ -1,0 +1,221 @@
+"""Congruence closure over ground terms.
+
+Reference parity: psync.logic.CongruenceClosure (logic/CongruenceClosure.scala:13-429).
+Same role: (a) the EUF theory solver inside the SMT backend, and (b) the
+ground-term index that drives quantifier instantiation (repr-based dedup of
+instantiation candidates).
+
+Union-find with a congruence table keyed on (symbol, arg-representatives);
+merging two classes re-canonicalizes the parents of both classes (classic
+Nelson-Oppen style closure).  Terms are the immutable Formula values from
+round_tpu.verify.formula, so structural hashing is free.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from round_tpu.verify.formula import (
+    Application, Binding, EQ, Formula, Literal, NEQ, Variable,
+)
+from round_tpu.verify.futils import get_conjuncts
+
+
+class CongruenceClosure:
+    def __init__(self):
+        self._parent: Dict[Formula, Formula] = {}
+        self._members: Dict[Formula, List[Formula]] = {}
+        # (symbol, arg reprs) -> canonical application in that congruence class
+        self._sig: Dict[Tuple, Formula] = {}
+        # term -> applications it appears in as an argument
+        self._uses: Dict[Formula, List[Formula]] = {}
+
+    # -- union-find ---------------------------------------------------------
+
+    def contains(self, t: Formula) -> bool:
+        return t in self._parent
+
+    def find(self, t: Formula) -> Formula:
+        """Representative of t's class (t must be registered)."""
+        root = t
+        while self._parent[root] is not root:
+            root = self._parent[root]
+        while self._parent[t] is not root:  # path compression
+            self._parent[t], t = root, self._parent[t]
+        return root
+
+    def repr_of(self, t: Formula) -> Formula:
+        if not self.contains(t):
+            self.add_term(t)
+        return self.find(t)
+
+    def congruent(self, a: Formula, b: Formula) -> bool:
+        # register BOTH before comparing: adding b may trigger the congruence
+        # merge that changes a's representative
+        self.add_term(a)
+        self.add_term(b)
+        return self.find(a) == self.find(b)
+
+    # -- registration -------------------------------------------------------
+
+    def add_term(self, t: Formula) -> Formula:
+        """Register t and its subterms; returns t's representative."""
+        if isinstance(t, Binding):
+            raise ValueError(f"congruence closure is ground-only, got {t!r}")
+        if t in self._parent:
+            return self.find(t)
+        self._parent[t] = t
+        self._members[t] = [t]
+        if isinstance(t, Application):
+            for a in t.args:
+                self.add_term(a)
+                self._uses.setdefault(self.find(a), []).append(t)
+            sig = self._signature(t)
+            existing = self._sig.get(sig)
+            if existing is not None:
+                self._union(t, existing)
+            else:
+                self._sig[sig] = t
+        return self.find(t)
+
+    def _signature(self, t: Application) -> Tuple:
+        return (t.fct, tuple(self.find(a) for a in t.args))
+
+    # -- merging ------------------------------------------------------------
+
+    def assert_eq(self, a: Formula, b: Formula) -> None:
+        self.add_term(a)
+        self.add_term(b)
+        self._union(a, b)
+
+    def _union(self, a: Formula, b: Formula) -> None:
+        ra, rb = self.find(a), self.find(b)
+        if ra == rb:
+            return
+        # merge the smaller class into the larger
+        if len(self._members[ra]) < len(self._members[rb]):
+            ra, rb = rb, ra
+        self._parent[rb] = ra
+        self._members[ra].extend(self._members.pop(rb))
+        # re-canonicalize applications using rb; may trigger further merges
+        pending: List[Tuple[Formula, Formula]] = []
+        uses = self._uses.pop(rb, [])
+        for app in uses:
+            sig = self._signature(app)
+            existing = self._sig.get(sig)
+            if existing is None:
+                self._sig[sig] = app
+            elif self.find(existing) != self.find(app):
+                pending.append((existing, app))
+        self._uses.setdefault(ra, []).extend(uses)
+        for x, y in pending:
+            self._union(x, y)
+
+    # -- queries ------------------------------------------------------------
+
+    def classes(self) -> List[List[Formula]]:
+        return [list(m) for m in self._members.values()]
+
+    def ground_terms(self) -> Set[Formula]:
+        return set(self._parent.keys())
+
+    def class_of(self, t: Formula) -> List[Formula]:
+        return list(self._members[self.find(t)])
+
+    def normalize(self, f: Formula) -> Formula:
+        """Rewrite every registered subterm of f to its representative
+        (CongruenceClosure.normalize in the reference)."""
+        if isinstance(f, (Literal, Variable)):
+            return self.find(f) if self.contains(f) else f
+        if isinstance(f, Application):
+            args = [self.normalize(a) for a in f.args]
+            g = Application(f.fct, args)
+            g.tpe = f.tpe
+            return self.find(g) if self.contains(g) else g
+        if isinstance(f, Binding):
+            body = self.normalize(f.body)
+            g = Binding(f.binder, f.vars, body)
+            g.tpe = f.tpe
+            return g
+        return f
+
+    def copy(self) -> "CongruenceClosure":
+        out = CongruenceClosure()
+        out._parent = dict(self._parent)
+        out._members = {k: list(v) for k, v in self._members.items()}
+        out._sig = dict(self._sig)
+        out._uses = {k: list(v) for k, v in self._uses.items()}
+        return out
+
+    # -- formula-level entry points ----------------------------------------
+
+    def add_constraints(self, f: Formula) -> None:
+        """Register ground equalities from a conjunction (ground subformulas
+        only; quantified conjuncts contribute nothing)."""
+        for c in get_conjuncts(f):
+            if isinstance(c, Application) and c.fct == EQ:
+                a, b = c.args
+                try:
+                    self.assert_eq(a, b)
+                except ValueError:
+                    pass  # non-ground equality: skip
+            elif not isinstance(c, Binding):
+                self._register_ground(c)
+
+    def _register_ground(self, f: Formula) -> None:
+        if isinstance(f, Binding):
+            return
+        if isinstance(f, Application):
+            ok = all(not isinstance(x, Binding) for x in _subterms(f))
+            if ok:
+                self.add_term(f)
+
+
+def _subterms(f: Formula):
+    yield f
+    if isinstance(f, Application):
+        for a in f.args:
+            yield from _subterms(a)
+    elif isinstance(f, Binding):
+        yield f.body
+
+
+def euf_check(
+    eqs: List[Tuple[Formula, Formula]],
+    diseqs: List[Tuple[Formula, Formula]],
+    extra_terms: Iterable[Formula] = (),
+) -> Optional[Tuple[List[int], int]]:
+    """EUF satisfiability of a conjunction of ground (dis)equalities.
+
+    Returns None if consistent, else a conflict (indices into eqs, index into
+    diseqs): a subset of the equalities which together with that disequality
+    is inconsistent.  The subset is greedily minimized so the blocking clause
+    learned by the DPLL(T) loop stays small.
+    """
+    def build(active: List[int]) -> CongruenceClosure:
+        cc = CongruenceClosure()
+        for t in extra_terms:
+            cc.add_term(t)
+        for i in active:
+            cc.assert_eq(*eqs[i])
+        return cc
+
+    cc = build(list(range(len(eqs))))
+    bad = None
+    for j, (a, b) in enumerate(diseqs):
+        if cc.congruent(a, b):
+            bad = j
+            break
+    if bad is None:
+        return None
+    # greedy core minimization
+    core = list(range(len(eqs)))
+    i = 0
+    while i < len(core):
+        trial = core[:i] + core[i + 1:]
+        cc2 = build(trial)
+        if cc2.congruent(*diseqs[bad]):
+            core = trial
+        else:
+            i += 1
+    return core, bad

@@ -1,0 +1,256 @@
+"""Logic-layer tests: congruence closure, LIA, DPLL(T) solver, CL reducer.
+
+Mirrors the reference's solver-backed suites (logic/CLSuite.scala,
+logic/CongruenceClosureSuite.scala, logic/VennRegionsSuite.scala) — these are
+the "distributed semantics" tests: they check entailments against the HO-set
+axioms rather than executions.  The reference discharges them with z3; here
+the framework's own native backend (round_tpu/native/sat.cpp + EUF/LIA in
+round_tpu.verify) does the solving.
+"""
+
+import pytest
+
+from round_tpu.verify.formula import (
+    And, Application, Card, Comprehension, Eq, Exists, ForAll, FSet, FunT,
+    Geq, Gt, In, Int, IntLit, Leq, Lt, Neq, Not, Or, Plus, SubsetEq, Times,
+    UnInterpretedFct, Variable, Bool, procType,
+)
+from round_tpu.verify.congruence import CongruenceClosure, euf_check
+from round_tpu.verify.lia import SAT as LIA_SAT, UNSAT as LIA_UNSAT, solve_lia
+from round_tpu.verify.solver import SAT, UNSAT, solve_ground, to_smtlib2
+from round_tpu.verify.cl import ClConfig, ClDefault, entailment, reduce
+
+
+# ---------------------------------------------------------------------------
+# Congruence closure (CongruenceClosureSuite)
+# ---------------------------------------------------------------------------
+
+def _proc_vars(*names):
+    return [Variable(n, procType) for n in names]
+
+
+def test_cc_transitivity_and_congruence():
+    a, b, c = _proc_vars("a", "b", "c")
+    f = UnInterpretedFct("f", FunT([procType], procType))
+    cc = CongruenceClosure()
+    cc.assert_eq(a, b)
+    cc.assert_eq(b, c)
+    fa = Application(f, [a])
+    fc = Application(f, [c])
+    assert cc.congruent(a, c)
+    assert cc.congruent(fa, fc)
+
+
+def test_cc_nested_congruence():
+    a, b = _proc_vars("a", "b")
+    f = UnInterpretedFct("f", FunT([procType], procType))
+    ffa = Application(f, [Application(f, [a])])
+    ffb = Application(f, [Application(f, [b])])
+    cc = CongruenceClosure()
+    cc.assert_eq(a, b)
+    assert cc.congruent(ffa, ffb)
+    assert not cc.congruent(a, ffa)
+
+
+def test_cc_merge_order_independence():
+    # registering terms before or after the merge must not matter
+    a, b = _proc_vars("a", "b")
+    f = UnInterpretedFct("f", FunT([procType], procType))
+    fa, fb = Application(f, [a]), Application(f, [b])
+    cc = CongruenceClosure()
+    cc.add_term(fa)
+    cc.add_term(fb)
+    assert not cc.congruent(fa, fb)
+    cc.assert_eq(a, b)
+    assert cc.congruent(fa, fb)
+
+
+def test_euf_check_conflict_core():
+    a, b, c, d = _proc_vars("a", "b", "c", "d")
+    f = UnInterpretedFct("f", FunT([procType], procType))
+    eqs = [(a, b), (c, d), (b, c)]  # (c,d) is irrelevant
+    diseqs = [(Application(f, [a]), Application(f, [c]))]
+    res = euf_check(eqs, diseqs)
+    assert res is not None
+    core, bad = res
+    assert bad == 0
+    assert set(core) == {0, 2}  # minimized: (c,d) dropped
+
+
+# ---------------------------------------------------------------------------
+# LIA (simplex + branch and bound)
+# ---------------------------------------------------------------------------
+
+def test_lia_basic():
+    status, _ = solve_lia([({"x": 1, "y": 1}, "<=", 3), ({"x": 1}, ">=", 2),
+                           ({"y": 1}, ">=", 2)])
+    assert status == LIA_UNSAT
+    status, model = solve_lia([({"x": 1, "y": 1}, "==", 5),
+                               ({"x": 1, "y": -1}, "==", 1)])
+    assert status == LIA_SAT and model == {"x": 3, "y": 2}
+
+
+def test_lia_integrality():
+    # 2x = 3 is rationally feasible but integer-infeasible
+    status, _ = solve_lia([({"x": 2}, "==", 3)])
+    assert status == LIA_UNSAT
+
+
+def test_lia_conflict_core_is_small():
+    cons = [
+        ({"a": 1, "b": 1}, ">=", 101),
+        ({"pp": 1, "pm": 1, "a": -1}, "==", 0),
+        ({"pp": 1, "mp": 1, "b": -1}, "==", 0),
+        ({"pp": 1, "pm": 1, "mp": 1, "mm": 1}, "==", 100),
+        ({"pp": 1}, ">=", 0), ({"pm": 1}, ">=", 0),
+        ({"mp": 1}, ">=", 0), ({"mm": 1}, ">=", 0),
+        ({"pp": 1}, "<=", 0),
+        ({"zz": 1}, ">=", 0),  # irrelevant
+    ]
+    status, core = solve_lia(cons)
+    assert status == LIA_UNSAT
+    assert 9 not in core  # irrelevant constraint not in the explanation
+
+
+# ---------------------------------------------------------------------------
+# Ground DPLL(T)
+# ---------------------------------------------------------------------------
+
+def test_solver_euf():
+    a, b, c = _proc_vars("a", "b", "c")
+    f = UnInterpretedFct("f", FunT([procType], procType))
+    fa, fc = Application(f, [a]), Application(f, [c])
+    assert solve_ground(And(Eq(a, b), Eq(b, c), Neq(fa, fc))) == UNSAT
+    assert solve_ground(And(Eq(a, b), Neq(fa, fc))) == SAT
+
+
+def test_solver_lia_bool_mix():
+    x = Variable("x", Int)
+    assert solve_ground(And(Or(Gt(x, 2), Lt(x, 1)), Eq(x, 2))) == UNSAT
+    assert solve_ground(And(Or(Gt(x, 2), Lt(x, 1)), Eq(x, 3))) == SAT
+
+
+def test_solver_combined_euf_lia():
+    a, b = _proc_vars("a", "b")
+    g = UnInterpretedFct("g", FunT([procType], Int))
+    x, y = Variable("x", Int), Variable("y", Int)
+    f = And(Eq(Application(g, [a]), x), Eq(Application(g, [b]), y),
+            Eq(a, b), Lt(x, y))
+    assert solve_ground(f) == UNSAT
+
+
+def test_solver_int_disequalities():
+    x = Variable("x", Int)
+    assert solve_ground(And(Geq(x, 0), Leq(x, 1), Neq(x, 0), Neq(x, 1))) == UNSAT
+    assert solve_ground(And(Geq(x, 0), Leq(x, 2), Neq(x, 0), Neq(x, 1))) == SAT
+
+
+def test_smtlib2_output_shape():
+    x = Variable("x", Int)
+    a, b = _proc_vars("a", "b")
+    s = to_smtlib2(And(Geq(x, 2), Eq(a, b)))
+    assert "(declare-sort ProcessID 0)" in s
+    assert "(check-sat)" in s
+
+
+# ---------------------------------------------------------------------------
+# CL reducer entailments (CLSuite-style)
+# ---------------------------------------------------------------------------
+
+N = Variable("n", Int)
+
+
+def test_cl_quorum_intersection():
+    A = Variable("A", FSet(procType))
+    B = Variable("B", FSet(procType))
+    x = Variable("x", procType)
+    h = Gt(Plus(Card(A), Card(B)), N)
+    c = Exists([x], And(In(x, A), In(x, B)))
+    assert entailment(h, c)
+    # |A| ≥ 1 alone does not give an intersection
+    assert not entailment(Geq(Card(A), 1), c)
+
+
+def test_cl_majority_uniqueness():
+    """Two majorities over the same value function agree — the heart of the
+    OTR agreement argument (example/Otr.scala invariants)."""
+    V = UnInterpretedFct("v", FunT([procType], Int))
+    a, b = Variable("a", Int), Variable("b", Int)
+    i, j = _proc_vars("i", "j")
+    compA = Comprehension([i], Eq(Application(V, [i]), a))
+    compB = Comprehension([j], Eq(Application(V, [j]), b))
+    h = And(Gt(Times(2, Card(compA)), N), Gt(Times(2, Card(compB)), N))
+    assert entailment(h, Eq(a, b))
+    # a strict minority does not force agreement
+    h_weak = And(Geq(Times(2, Card(compA)), N), Geq(Times(2, Card(compB)), N))
+    assert not entailment(h_weak, Eq(a, b))
+
+
+def test_cl_full_universe_membership():
+    A = Variable("A", FSet(procType))
+    p = Variable("p", procType)
+    h = And(Eq(Card(A), N), Eq(p, p))
+    assert entailment(h, In(p, A))
+
+
+def test_cl_comprehension_membership():
+    P = UnInterpretedFct("P", FunT([procType], Bool))
+    q = Variable("q", procType)
+    i = Variable("i", procType)
+    comp = Comprehension([i], Application(P, [i]))
+    h = And(Application(P, [q]), Geq(Card(comp), 0))
+    assert entailment(h, In(q, comp))
+
+
+def test_cl_subset_cardinality():
+    A = Variable("A", FSet(procType))
+    B = Variable("B", FSet(procType))
+    assert entailment(SubsetEq(A, B), Leq(Card(A), Card(B)))
+    assert not entailment(SubsetEq(A, B), Lt(Card(A), Card(B)))
+
+
+def test_cl_ho_quorum():
+    """Heard-Of sets of two processes with |HO(p)|+|HO(q)| > n intersect —
+    the mailboxLink-style lemma (TransitionRelation.scala:73-91)."""
+    HO = UnInterpretedFct("HO", FunT([procType], FSet(procType)))
+    p, q, x = _proc_vars("p", "q", "x")
+    hop = Application(HO, [p])
+    hoq = Application(HO, [q])
+    h = Gt(Plus(Card(hop), Card(hoq)), N)
+    c = Exists([x], And(In(x, hop), In(x, hoq)))
+    assert entailment(h, c)
+
+
+def test_cl_universal_instantiation():
+    """∀i. v(i) = c entails v(p) = c for a known process."""
+    V = UnInterpretedFct("v", FunT([procType], Int))
+    cst = Variable("c", Int)
+    i, p = _proc_vars("i", "p")
+    h = And(ForAll([i], Eq(Application(V, [i]), cst)), Eq(p, p))
+    assert entailment(h, Eq(Application(V, [p]), cst))
+
+
+def test_cl_cardinality_bounds():
+    A = Variable("A", FSet(procType))
+    # |A| ≤ n always holds over a universe of size n
+    assert entailment(Geq(Card(A), 0), Leq(Card(A), N))
+
+
+def test_solver_euf_lia_propagation():
+    """x = y must propagate g(x) = g(y) into the arithmetic solver even when
+    g(x)/g(y) appear in no asserted equality themselves."""
+    x, y = _proc_vars("x", "y")
+    g = UnInterpretedFct("g", FunT([procType], Int))
+    f = And(Eq(x, y), Lt(Application(g, [x]), Application(g, [y])))
+    assert solve_ground(f) == UNSAT
+
+
+def test_cl_intersection_argument_order():
+    """|B ∩ A| must reuse the (A, B) Venn group (canonical group keys)."""
+    from round_tpu.verify.formula import Intersection
+
+    A = Variable("A", FSet(procType))
+    B = Variable("B", FSet(procType))
+    h = Gt(Plus(Card(A), Card(B)), N)
+    assert entailment(h, Geq(Card(Intersection(B, A)), 1))
+    assert entailment(h, Geq(Card(Intersection(A, B)), 1))
