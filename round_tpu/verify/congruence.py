@@ -29,6 +29,8 @@ class CongruenceClosure:
         self._sig: Dict[Tuple, Formula] = {}
         # term -> applications it appears in as an argument
         self._uses: Dict[Formula, List[Formula]] = {}
+        # proof forest: term -> (next term towards proof root, reason)
+        self._proof: Dict[Formula, Tuple[Formula, Tuple]] = {}
 
     # -- union-find ---------------------------------------------------------
 
@@ -73,7 +75,7 @@ class CongruenceClosure:
             sig = self._signature(t)
             existing = self._sig.get(sig)
             if existing is not None:
-                self._union(t, existing)
+                self._union(t, existing, ("cong", t, existing))
             else:
                 self._sig[sig] = t
         return self.find(t)
@@ -82,16 +84,26 @@ class CongruenceClosure:
         return (t.fct, tuple(self.find(a) for a in t.args))
 
     # -- merging ------------------------------------------------------------
+    #
+    # A proof forest (Nieuwenhuis & Oliveras) runs alongside the union-find:
+    # every union records WHY its two endpoint terms are equal — either an
+    # asserted equation (tagged) or a congruence step between two
+    # applications.  `explain(a, b)` then extracts the exact set of asserted
+    # equation tags needed, which is what keeps the DPLL(T) blocking clauses
+    # small on large instances.
 
-    def assert_eq(self, a: Formula, b: Formula) -> None:
+    def assert_eq(self, a: Formula, b: Formula, tag=None) -> None:
         self.add_term(a)
         self.add_term(b)
-        self._union(a, b)
+        self._union(a, b, ("eq", tag))
 
-    def _union(self, a: Formula, b: Formula) -> None:
+    def _union(self, a: Formula, b: Formula, reason=("eq", None)) -> None:
         ra, rb = self.find(a), self.find(b)
         if ra == rb:
             return
+        # proof forest: reroot a's proof tree at a, then a —reason→ b
+        self._reroot(a)
+        self._proof[a] = (b, reason)
         # merge the smaller class into the larger
         if len(self._members[ra]) < len(self._members[rb]):
             ra, rb = rb, ra
@@ -109,7 +121,66 @@ class CongruenceClosure:
                 pending.append((existing, app))
         self._uses.setdefault(ra, []).extend(uses)
         for x, y in pending:
-            self._union(x, y)
+            self._union(x, y, ("cong", x, y))
+
+    def _reroot(self, a: Formula) -> None:
+        """Reverse the proof-forest path from a to its proof root."""
+        path = []
+        node = a
+        while node in self._proof:
+            nxt, reason = self._proof[node]
+            path.append((node, nxt, reason))
+            node = nxt
+        for node, nxt, reason in reversed(path):
+            del self._proof[node]
+            self._proof[nxt] = (node, reason)
+
+    # -- explanations --------------------------------------------------------
+
+    def explain(self, a: Formula, b: Formula) -> Optional[Set]:
+        """The set of asserted-equation tags implying a = b (None if they
+        are not congruent).  Exact (proof-forest walk), not a minimization."""
+        if not self.contains(a) or not self.contains(b) \
+                or self.find(a) != self.find(b):
+            return None
+        out: Set = set()
+        seen: Set[Tuple[Formula, Formula]] = set()
+        self._explain_into(a, b, out, seen)
+        return out
+
+    def _proof_path(self, a: Formula) -> List[Formula]:
+        path = [a]
+        node = a
+        while node in self._proof:
+            node = self._proof[node][0]
+            path.append(node)
+        return path
+
+    def _explain_into(self, a, b, out: Set, seen: Set) -> None:
+        if a == b or (a, b) in seen:
+            return
+        seen.add((a, b))
+        pa = self._proof_path(a)
+        pb = self._proof_path(b)
+        in_pa = {t: i for i, t in enumerate(pa)}
+        meet = next((t for t in pb if t in in_pa), None)
+        assert meet is not None, "explain: no common proof ancestor"
+
+        def walk(start, stop):
+            node = start
+            while node != stop:
+                nxt, reason = self._proof[node]
+                if reason[0] == "eq":
+                    if reason[1] is not None:
+                        out.add(reason[1])
+                else:  # congruence between two applications
+                    _c, app1, app2 = reason
+                    for x, y in zip(app1.args, app2.args):
+                        self._explain_into(x, y, out, seen)
+                node = nxt
+
+        walk(a, meet)
+        walk(b, meet)
 
     # -- queries ------------------------------------------------------------
 
@@ -142,6 +213,7 @@ class CongruenceClosure:
     def copy(self) -> "CongruenceClosure":
         out = CongruenceClosure()
         out._parent = dict(self._parent)
+        out._proof = dict(self._proof)
         out._members = {k: list(v) for k, v in self._members.items()}
         out._sig = dict(self._sig)
         out._uses = {k: list(v) for k, v in self._uses.items()}
@@ -189,33 +261,16 @@ def euf_check(
 
     Returns None if consistent, else a conflict (indices into eqs, index into
     diseqs): a subset of the equalities which together with that disequality
-    is inconsistent.  The subset is greedily minimized so the blocking clause
-    learned by the DPLL(T) loop stays small.
+    is inconsistent.  The subset is the exact proof-forest explanation —
+    small in practice, though not guaranteed minimal.
     """
-    def build(active: List[int]) -> CongruenceClosure:
-        cc = CongruenceClosure()
-        for t in extra_terms:
-            cc.add_term(t)
-        for i in active:
-            cc.assert_eq(*eqs[i])
-        return cc
-
-    cc = build(list(range(len(eqs))))
-    bad = None
+    cc = CongruenceClosure()
+    for t in extra_terms:
+        cc.add_term(t)
+    for i, (a, b) in enumerate(eqs):
+        cc.assert_eq(a, b, tag=i)
     for j, (a, b) in enumerate(diseqs):
         if cc.congruent(a, b):
-            bad = j
-            break
-    if bad is None:
-        return None
-    # greedy core minimization
-    core = list(range(len(eqs)))
-    i = 0
-    while i < len(core):
-        trial = core[:i] + core[i + 1:]
-        cc2 = build(trial)
-        if cc2.congruent(*diseqs[bad]):
-            core = trial
-        else:
-            i += 1
-    return core, bad
+            core = cc.explain(a, b)
+            return sorted(core), j
+    return None

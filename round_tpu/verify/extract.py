@@ -1,0 +1,300 @@
+"""Transition-relation extraction from executable JAX round code.
+
+This is the macro layer's TPU-idiomatic replacement (reference:
+psync.macros — Macros.scala:65-77, TrExtractor.scala:101-160,
+FormulaExtractor.scala).  The reference rewrites Scala ASTs with whitebox
+macros; here the *same function the engine executes* is traced to a jaxpr
+(`jax.make_jaxpr`) and the jaxpr is abstractly interpreted over Formula
+values, producing the update/send equations of a RoundTR.
+
+Domain of the abstract interpreter:
+  * scalar slots  → a Formula over the receiver j (state fields are the
+    localized functions f(j), tr.py),
+  * mailbox slots → per-sender functions i ↦ Formula (payload fns), with
+    the mask slot i ↦ (i ∈ HO(j) ∧ dest(i, j)),
+  * reductions over the sender axis → comprehension forms:
+      sum(bool mask)   → Cardinality{ i | … }      (mbox.count)
+      any/or           → ∃ i ∈ senders. …
+      all/and          → ∀ i ∈ senders. …
+
+Like the reference (RoundRewrite.scala:48-50 warns EventRound extraction is
+unsupported; complex helpers become AuxiliaryMethods with pre/post specs),
+unsupported primitives raise ExtractionError naming the primitive — the
+algorithm then supplies that piece as an axiomatized auxiliary function
+(tr.py RoundTR.aux), e.g. OTR's min-most-often-received.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.extend import core as jax_core
+
+from round_tpu.verify.formula import (
+    And, Application, Bool, BoolT, Card, Comprehension, Eq, Exists, ForAll,
+    Formula, Geq, Gt, Implies, IntLit, IntT, Ite, Leq, Literal, Lt, Neq, Not,
+    Or, Plus, Times, Minus, Type, Variable, procType,
+)
+
+Int = IntT()
+
+
+class ExtractionError(Exception):
+    """A primitive outside the supported fragment was traced.  Provide the
+    enclosing computation as an axiomatized auxiliary instead
+    (RoundTR.aux; the reference's AuxiliaryMethod.scala:9-67)."""
+
+
+# -- abstract values --------------------------------------------------------
+
+class Scalar:
+    """A per-receiver scalar: one Formula."""
+
+    __slots__ = ("f",)
+
+    def __init__(self, f: Formula):
+        self.f = f
+
+
+class Vec:
+    """A per-sender vector: i ↦ Formula (the sender axis of the mailbox)."""
+
+    __slots__ = ("fn",)
+
+    def __init__(self, fn: Callable[[Formula], Formula]):
+        self.fn = fn
+
+
+def _lift(v) -> "Scalar | Vec":
+    if isinstance(v, (Scalar, Vec)):
+        return v
+    if isinstance(v, (bool, np.bool_)):
+        return Scalar(Literal(bool(v)))
+    if isinstance(v, (int, np.integer)):
+        return Scalar(IntLit(int(v)))
+    if isinstance(v, np.ndarray) and v.ndim == 0:
+        if v.dtype == np.bool_:
+            return Scalar(Literal(bool(v)))
+        return Scalar(IntLit(int(v)))
+    raise ExtractionError(f"cannot lift constant {v!r} into a formula")
+
+
+def _binop(mk, a, b):
+    a, b = _lift(a), _lift(b)
+    if isinstance(a, Scalar) and isinstance(b, Scalar):
+        return Scalar(mk(a.f, b.f))
+    fa = (lambda i: a.f) if isinstance(a, Scalar) else a.fn
+    fb = (lambda i: b.f) if isinstance(b, Scalar) else b.fn
+    return Vec(lambda i: mk(fa(i), fb(i)))
+
+
+def _unop(mk, a):
+    a = _lift(a)
+    if isinstance(a, Scalar):
+        return Scalar(mk(a.f))
+    return Vec(lambda i: mk(a.fn(i)))
+
+
+_BINOPS = {
+    "add": lambda x, y: Plus(x, y),
+    "sub": lambda x, y: Minus(x, y),
+    "mul": lambda x, y: Times(x, y),
+    "max": None,  # handled in interpreter (Ite form)
+    "min": None,
+    "lt": lambda x, y: Lt(x, y),
+    "le": lambda x, y: Leq(x, y),
+    "gt": lambda x, y: Gt(x, y),
+    "ge": lambda x, y: Geq(x, y),
+    "eq": lambda x, y: Eq(x, y),
+    "ne": lambda x, y: Neq(x, y),
+    "and": lambda x, y: And(x, y),
+    "or": lambda x, y: Or(x, y),
+    "xor": lambda x, y: Neq(x, y),
+}
+
+
+class _Interpreter:
+    def __init__(self, senders_domain: Callable[[Formula], Formula]):
+        """senders_domain(i): the guard restricting mailbox reductions —
+        i ∈ HO(j) ∧ dest(i, j) (the mailboxLink semantics)."""
+        self.senders = senders_domain
+        self._fresh = itertools.count()
+
+    def var(self) -> Variable:
+        return Variable(f"ext!{next(self._fresh)}", procType)
+
+    def run(self, jaxpr, consts, args):
+        env: Dict[Any, Any] = {}
+
+        def read(a):
+            if isinstance(a, jax_core.Literal):
+                return _lift(np.asarray(a.val)) if np.ndim(a.val) == 0 \
+                    else a.val
+            return env[a]
+
+        def write(v, val):
+            env[v] = val
+
+        for v, c in zip(jaxpr.constvars, consts):
+            write(v, _lift(np.asarray(c)) if np.ndim(c) == 0 else c)
+        for v, a in zip(jaxpr.invars, args):
+            write(v, a)
+
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            ins = [read(x) for x in eqn.invars]
+            out = self.eval_prim(prim, eqn, ins)
+            if len(eqn.outvars) != 1:
+                raise ExtractionError(f"multi-output primitive {prim}")
+            write(eqn.outvars[0], out)
+
+        return [read(v) for v in jaxpr.outvars]
+
+    def eval_prim(self, prim: str, eqn, ins):
+        if prim in ("convert_element_type", "copy", "stop_gradient",
+                    "squeeze", "reshape", "broadcast_in_dim"):
+            # shape/dtype adapters: pass through (bool→int32 before a
+            # reduce_sum is recognized at the reduction)
+            return _lift(ins[0]) if not isinstance(ins[0], (Scalar, Vec)) \
+                else ins[0]
+        if prim in _BINOPS and _BINOPS[prim] is not None:
+            return _binop(_BINOPS[prim], ins[0], ins[1])
+        if prim in ("max", "min"):
+            def mk(x, y, is_max=(prim == "max")):
+                c = Gt(x, y)
+                return Ite(c, x, y) if is_max else Ite(c, y, x)
+            return _binop(mk, ins[0], ins[1])
+        if prim == "neg":
+            from round_tpu.verify.formula import UMINUS
+            return _unop(lambda x: Application(UMINUS, [x]).with_type(Int),
+                         ins[0])
+        if prim == "not":
+            return _unop(lambda x: Not(x), ins[0])
+        if prim == "select_n":
+            which, *cases = ins
+            if len(cases) != 2:
+                raise ExtractionError("select_n with more than 2 cases")
+            # select_n(pred, on_false, on_true)
+            return _binop_3(which, cases[0], cases[1])
+        if prim == "reduce_sum":
+            return self._reduce(ins[0], kind="sum")
+        if prim == "reduce_or":
+            return self._reduce(ins[0], kind="or")
+        if prim == "reduce_and":
+            return self._reduce(ins[0], kind="and")
+        if prim == "iota":
+            return Vec(lambda i: i)
+        if prim in ("pjit", "jit", "closed_call", "custom_jvp_call"):
+            inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+            outs = _Interpreter.run(self, inner.jaxpr, inner.consts, ins)
+            return outs[0] if len(outs) == 1 else outs
+        raise ExtractionError(
+            f"unsupported primitive '{prim}' — express this computation as "
+            f"an axiomatized auxiliary function (RoundTR.aux) instead"
+        )
+
+    def _reduce(self, operand, kind: str):
+        if not isinstance(operand, Vec):
+            raise ExtractionError(f"reduce_{kind} over a non-mailbox value")
+        i = self.var()
+        body = operand.fn(i)
+        guard = self.senders(i)
+        if kind == "sum":
+            # count: Σ over senders of a 0/1 indicator → |{i | guard ∧ body}|
+            if not _is_boolish(body):
+                raise ExtractionError(
+                    "reduce_sum over non-indicator values (a true sum, not "
+                    "a count) — express it as an axiomatized auxiliary "
+                    "function (RoundTR.aux) instead"
+                )
+            return Scalar(Card(Comprehension([i], And(guard, body))))
+        if kind == "or":
+            return Scalar(Exists([i], And(guard, body)))
+        return Scalar(ForAll([i], Implies(guard, body)))
+
+
+_BOOL_FCTS = None
+
+
+def _is_boolish(f: Formula) -> bool:
+    """Is this formula a 0/1 indicator (so summing it is a count)?"""
+    global _BOOL_FCTS
+    if _BOOL_FCTS is None:
+        from round_tpu.verify.formula import (
+            AND, EQ, GEQ, GT, IMPLIES, IN, LEQ, LT, NEQ, NOT, OR,
+        )
+        _BOOL_FCTS = (AND, OR, NOT, IMPLIES, EQ, NEQ, LT, LEQ, GT, GEQ, IN)
+    if isinstance(f, Literal):
+        return isinstance(f.value, bool)
+    if isinstance(f, Variable):
+        return isinstance(f.tpe, BoolT)
+    if isinstance(f, Application):
+        if f.fct in _BOOL_FCTS:
+            return True
+        return isinstance(f.tpe, BoolT)
+    return False
+
+
+def _binop_3(which, on_false, on_true):
+    which, a, b = _lift(which), _lift(on_false), _lift(on_true)
+    parts = [which, a, b]
+    if all(isinstance(p, Scalar) for p in parts):
+        return Scalar(Ite(which.f, on_true.f, on_false.f))
+    fns = [(lambda i, p=p: p.f) if isinstance(p, Scalar) else p.fn
+           for p in parts]
+    return Vec(lambda i: Ite(fns[0](i), fns[2](i), fns[1](i)))
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+def extract_lane_fn(
+    fn: Callable,
+    example_args: Sequence[Any],
+    formula_args: Sequence["Scalar | Vec"],
+    senders_domain: Callable[[Formula], Formula],
+) -> List["Scalar | Vec"]:
+    """Trace `fn` (a pure per-lane function) with `example_args` (arrays /
+    ShapeDtypeStructs fixing shapes) and abstractly interpret its jaxpr over
+    `formula_args`.  Returns the outputs as Scalars/Vecs.
+
+    This is processSendUpdate (TrExtractor.scala:101-160) with jaxprs
+    instead of Scala trees: same inputs (the executable round code), same
+    output (formulas for the transition relation)."""
+    closed = jax.make_jaxpr(fn)(*example_args)
+    interp = _Interpreter(senders_domain)
+    flat_args, _ = jax.tree_util.tree_flatten(list(formula_args))
+    return interp.run(closed.jaxpr, closed.consts, flat_args)
+
+
+def extract_update_equations(
+    update_fn: Callable,
+    sig,
+    payloads: Dict[str, "Vec"],
+    mask: "Vec",
+    example_args: Sequence[Any],
+    formula_args: Sequence["Scalar | Vec"],
+    out_fields: Sequence[str],
+    senders_domain: Callable[[Formula], Formula],
+    j: Formula,
+) -> Formula:
+    """Extract a round's update as equations  field′(j) = extracted-expr.
+
+    `out_fields` names the state fields in the order update_fn returns them."""
+    outs = extract_lane_fn(update_fn, example_args, formula_args,
+                           senders_domain)
+    if len(outs) != len(out_fields):
+        raise ExtractionError(
+            f"update returns {len(outs)} values, expected {len(out_fields)}"
+        )
+    eqs = []
+    for name, out in zip(out_fields, outs):
+        if not isinstance(out, Scalar):
+            raise ExtractionError(f"output {name} is not per-lane scalar")
+        eqs.append(Eq(sig.get_primed(name, j), out.f))
+    return And(*eqs)

@@ -1,0 +1,226 @@
+"""Protocol specifications for the verifier, in the formula DSL.
+
+These mirror the reference's hand-translated VC suites
+(logic/TpcExample.scala, logic/OtrExample.scala, logic/LvExample.scala):
+each protocol's rounds are written as transition relations over localized
+state functions, with the communication assumption as the safety predicate,
+and the invariants/properties from the runtime examples
+(example/TwoPhaseCommit.scala, example/Otr.scala:95-120,
+example/LastVoting.scala:19-70).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from round_tpu.verify.cl import ClConfig
+from round_tpu.verify.formula import (
+    And, Application, Binding, Bool, Card, Comprehension, Eq, Exists, FORALL,
+    ForAll, FSet, Formula, FunT, Geq, Gt, Implies, In, Int, IntLit, Leq,
+    Literal, Not, Or, Plus, Times, UnInterpretedFct, Variable, procType,
+)
+from round_tpu.verify.tr import HO_FN, Mailbox, RoundTR, StateSig, ho_of
+from round_tpu.verify.venn import N_VAR as N
+from round_tpu.verify.verifier import ProtocolSpec
+
+
+# ---------------------------------------------------------------------------
+# Two-Phase Commit (example/TwoPhaseCommit.scala, logic/TpcExample.scala)
+# ---------------------------------------------------------------------------
+
+def tpc_spec() -> ProtocolSpec:
+    """2PC with coordinator 0: everyone sends its vote to the coordinator,
+    which commits iff it heard ALL n yes-votes; round 2 broadcasts the
+    outcome.  Agreement: any two processes that decided agree."""
+    sig = StateSig({
+        "vote": Bool,        # this process's yes/no vote (input)
+        "decided": Bool,
+        "commit": Bool,      # the decision value once decided
+    })
+    coord = Variable("coord", procType)
+
+    i = Variable("i", procType)
+    j = Variable("j", procType)
+
+    # Round 2 of TPC: outcome broadcast from the coordinator.  (Round 1 —
+    # vote collection into the coordinator — precedes any decision, so its
+    # preservation argument needs phase-staged invariants; the verified
+    # slice here is the decision broadcast, which carries the agreement
+    # argument.  The runtime model checks both rounds on traces:
+    # round_tpu/models/tpc.py.)
+    def r2_update(mb: Mailbox, jj, s: StateSig):
+        heard_coord = In(coord, mb.senders())
+        return And(
+            Implies(
+                heard_coord,
+                And(
+                    # the received payload is what the coordinator sent
+                    Eq(s.get_primed("commit", jj), mb.payload("d", coord)),
+                    s.get_primed("decided", jj),
+                ),
+            ),
+            Implies(
+                Not(heard_coord),
+                And(
+                    Eq(s.get_primed("commit", jj), s.get("commit", jj)),
+                    Eq(s.get_primed("decided", jj), s.get("decided", jj)),
+                ),
+            ),
+            s.frame_equal(["vote"], jj),
+        )
+
+    r2 = RoundTR(
+        sig=sig,
+        payload_defs={"d": (Bool, lambda ii: sig.get("commit", ii))},
+        dest_fn=lambda ii, jj: Eq(ii, coord),
+        update_fn=r2_update,
+    )
+
+    # Invariant: nobody decided yet, or everyone who decided carries the
+    # coordinator's commit value (the agreement core).
+    inv = ForAll(
+        [i],
+        Implies(
+            sig.get("decided", i),
+            Eq(sig.get("commit", i), sig.get("commit", coord)),
+        ),
+    )
+    agreement = ForAll(
+        [i, j],
+        Implies(
+            And(sig.get("decided", i), sig.get("decided", j)),
+            Eq(sig.get("commit", i), sig.get("commit", j)),
+        ),
+    )
+
+    init = ForAll([i], Not(sig.get("decided", i)))
+
+    return ProtocolSpec(
+        sig=sig,
+        rounds=[r2],
+        init=init,
+        invariants=[inv],
+        properties=[("agreement", agreement)],
+    )
+
+
+# ---------------------------------------------------------------------------
+# OTR / One-Third-Rule (example/Otr.scala, logic/OtrExample.scala)
+# ---------------------------------------------------------------------------
+
+def otr_spec() -> ProtocolSpec:
+    """The one-third-rule consensus round.
+
+    State: x (current estimate), decided, dec.  Everyone broadcasts x; with
+    |HO(j)| > 2n/3 (the safety predicate, Otr.scala:28) process j sets
+    x′ = the most-often-received value (axiomatized function mor(j)), and
+    decides when some value fills more than 2n/3 of its mailbox.
+
+    Invariant (Otr.scala:95-120): ∃v with 3·|{i | x(i)=v}| > 2n and every
+    decided process carries v.  Preservation is the one-third-rule argument:
+    under the invariant every receiver's most-often value IS v, so v's
+    support grows to n.
+    """
+    sig = StateSig({
+        "x": Int,
+        "decided": Bool,
+        "dec": Int,
+    })
+    i = Variable("i", procType)
+    j = Variable("j", procType)
+    v = Variable("v", Int)
+    w = Variable("w", Int)
+
+    # mor(j): the most-often-received value of receiver j this round
+    mor = UnInterpretedFct("mor", FunT([procType], Int))
+
+    def mor_of(jj):
+        return Application(mor, [jj]).with_type(Int)
+
+    def support(jj, val):
+        """{ k ∈ HO(jj) | x(k) = val } — senders supporting val (broadcast
+        round: every sender addresses everyone)."""
+        kk = Variable("supk", procType)
+        return Comprehension(
+            [kk], And(In(kk, ho_of(jj)), Eq(sig.get("x", kk), val))
+        )
+
+    def mor_axioms() -> List[Formula]:
+        # mor(j) is most-often: its support in HO(j) is ≥ any value's support
+        return [
+            ForAll(
+                [j, w],
+                Geq(Card(support(j, mor_of(j))), Card(support(j, w))),
+            )
+        ]
+
+    def update(mb: Mailbox, jj, s: StateSig):
+        newx = Eq(s.get_primed("x", jj), mor_of(jj))
+        # decide when mor's support exceeds 2n/3 (Otr.scala decision rule)
+        decide_cond = Gt(Times(3, Card(support(jj, mor_of(jj)))), Times(2, N))
+        return And(
+            newx,
+            Implies(
+                decide_cond,
+                And(
+                    s.get_primed("decided", jj),
+                    Eq(s.get_primed("dec", jj), mor_of(jj)),
+                ),
+            ),
+            Implies(
+                Not(decide_cond),
+                And(
+                    Eq(s.get_primed("decided", jj), s.get("decided", jj)),
+                    Eq(s.get_primed("dec", jj), s.get("dec", jj)),
+                ),
+            ),
+        )
+
+    rnd = RoundTR(
+        sig=sig,
+        payload_defs={"x": (Int, lambda ii: sig.get("x", ii))},
+        dest_fn=None,  # broadcast
+        update_fn=update,
+        aux=mor_axioms,
+    )
+
+    # safety predicate: every round, every receiver hears > 2n/3 processes
+    safety = ForAll([j], Gt(Times(3, Card(ho_of(j))), Times(2, N)))
+
+    # the invariant: ∃v. 3|{i | x(i)=v}| > 2n ∧ ∀i. decided(i) → dec(i)=v
+    def support_global(val):
+        kk = Variable("invk", procType)
+        return Comprehension([kk], Eq(sig.get("x", kk), val))
+
+    inv = Exists(
+        [v],
+        And(
+            Gt(Times(3, Card(support_global(v))), Times(2, N)),
+            ForAll([i], Implies(sig.get("decided", i),
+                                Eq(sig.get("dec", i), v))),
+        ),
+    )
+
+    agreement = ForAll(
+        [i, j],
+        Implies(
+            And(sig.get("decided", i), sig.get("decided", j)),
+            Eq(sig.get("dec", i), sig.get("dec", j)),
+        ),
+    )
+
+    init = And(
+        ForAll([i], Not(sig.get("decided", i))),
+        # all processes start with the same input → unanimity majority
+        Exists([v], ForAll([i], Eq(sig.get("x", i), v))),
+    )
+
+    return ProtocolSpec(
+        sig=sig,
+        rounds=[rnd],
+        init=init,
+        invariants=[inv],
+        properties=[("agreement", agreement)],
+        safety_predicate=safety,
+        config=ClConfig(venn_bound=3, inst_depth=1),
+    )

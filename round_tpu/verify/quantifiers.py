@@ -21,10 +21,12 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from round_tpu.verify.congruence import CongruenceClosure
 from round_tpu.verify.formula import (
-    Application, Binding, Bool, BoolT, COMPREHENSION, EXISTS, FORALL,
-    Formula, FunT, IN, Literal, Type, UnInterpretedFct, Variable,
-    And, ForAll, Implies,
+    Application, Binding, Bool, BoolT, CARD, COMPREHENSION, DIVIDES, EXISTS,
+    FORALL, Formula, FunT, IN, Literal, MINUS, PLUS, TIMES, Type, UMINUS,
+    UnInterpretedFct, Variable, And, ForAll, Implies,
 )
+
+_NON_MODEL_FCTS = (CARD, PLUS, MINUS, UMINUS, TIMES, DIVIDES)
 from round_tpu.verify.futils import (
     alpha_all, alpha_normalize, free_vars, get_conjuncts, subst_vars,
 )
@@ -215,8 +217,10 @@ def ground_terms_by_type(
     modulo congruence when a closure is supplied.
 
     "Ground" means: free of *bound* variables.  Free variables of the input
-    are constants (skolemized scope) and do qualify.  Quantified bodies are
-    not descended into — their terms mention bound variables."""
+    are constants (skolemized scope) and do qualify.  Quantified bodies ARE
+    mined for ground subterms (terms mentioning no bound variable) — the
+    reference's IncrementalGenerator does the same when gathering
+    instantiation candidates from axioms."""
     out: Dict[Type, List[Formula]] = {}
     seen: Set = set()
 
@@ -230,22 +234,37 @@ def ground_terms_by_type(
         seen.add(tag)
         out.setdefault(t.tpe, []).append(t)
 
-    def walk(g: Formula):
+    def is_clean(t: Formula, bound: frozenset) -> bool:
+        return not (free_vars(t) & bound)
+
+    def walk(g: Formula, bound: frozenset):
         if isinstance(g, Binding):
+            walk(g.body, bound | set(g.vars))
             return
-        if isinstance(g, (Variable, Literal)):
-            add(g)
+        if isinstance(g, Literal):
+            # integer literals are almost always arithmetic coefficients
+            # (3·|S| > 2n), not protocol values — using them as candidates
+            # multiplies the comprehension-symbol universe for nothing
+            return
+        if isinstance(g, Variable):
+            if g not in bound:
+                add(g)
             return
         if isinstance(g, Application):
-            if not isinstance(g.tpe, BoolT) and not any(
+            # only *model* terms are instantiation candidates: skip measure
+            # terms (Cardinality) and arithmetic combinations — using them
+            # as candidates feeds back through comprehension symbols into
+            # ever-larger terms (S(Card(S(n))), ...) and never helps a proof
+            skip = g.fct in _NON_MODEL_FCTS
+            if not skip and not isinstance(g.tpe, BoolT) and not any(
                 isinstance(x, Binding) for x in g.args
-            ):
+            ) and is_clean(g, bound):
                 add(g)
             for a in g.args:
-                walk(a)
+                walk(a, bound)
 
     for f in fs:
-        walk(f)
+        walk(f, frozenset())
     return out
 
 
@@ -263,7 +282,9 @@ def instantiate(
         cc.add_constraints(g)
     produced: List[Formula] = []
     seen_inst: Set = set()
-    pool = list(ground)
+    # the pool seeds candidate mining; universal clauses contribute the
+    # ground subterms of their bodies (bound-var-free ones)
+    pool = list(ground) + list(universals)
     for _round in range(depth):
         terms = ground_terms_by_type(pool, cc)
         new: List[Formula] = []

@@ -1,0 +1,90 @@
+"""Verification conditions and their discharge.
+
+Reference parity: psync.verification.VC (verification/VC.scala:48-142).
+A SingleVC is  hypothesis ∧ transition ⊨ conclusion ; it is *valid* iff the
+CL-reduced conjunction with the negated conclusion is UNSAT (VC.scala:62-63).
+CompositeVC aggregates sub-VCs with ∀ (all must hold) or ∃ (one suffices)
+semantics (VC.scala:116-142).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+from round_tpu.verify.cl import ClConfig, ClDefault, ClReducer
+from round_tpu.verify.formula import And, Formula, Not, TRUE
+from round_tpu.verify.simplify import simplify
+from round_tpu.verify.solver import UNSAT
+
+
+class VC:
+    name: str
+
+    def solve(self, config: ClConfig = ClDefault) -> bool:
+        raise NotImplementedError
+
+    def report(self, indent: str = "") -> str:
+        raise NotImplementedError
+
+
+class SingleVC(VC):
+    def __init__(
+        self,
+        name: str,
+        hypothesis: Formula,
+        transition: Formula,
+        conclusion: Formula,
+        config: Optional[ClConfig] = None,
+    ):
+        self.name = name
+        self.hypothesis = hypothesis
+        self.transition = transition
+        self.conclusion = conclusion
+        self.config = config
+        self.status: Optional[bool] = None
+        self.solve_time_s: Optional[float] = None
+
+    def formula(self) -> Formula:
+        return And(self.hypothesis, self.transition, Not(self.conclusion))
+
+    def solve(self, config: ClConfig = ClDefault) -> bool:
+        cfg = self.config or config
+        t0 = time.monotonic()
+        reducer = ClReducer(cfg)
+        try:
+            self.status = reducer.check_sat(simplify(self.formula())) == UNSAT
+        finally:
+            self.solve_time_s = time.monotonic() - t0
+        return self.status
+
+    def report(self, indent: str = "") -> str:
+        mark = {True: "✓", False: "✗", None: "?"}[self.status]
+        t = f" ({self.solve_time_s:.2f}s)" if self.solve_time_s is not None else ""
+        return f"{indent}{mark} {self.name}{t}"
+
+
+class CompositeVC(VC):
+    """∀: every sub-VC must hold; ∃: at least one must (VC.scala:116-142)."""
+
+    def __init__(self, name: str, all_of: bool, children: Sequence[VC]):
+        self.name = name
+        self.all_of = all_of
+        self.children = list(children)
+        self.status: Optional[bool] = None
+
+    def solve(self, config: ClConfig = ClDefault) -> bool:
+        results = []
+        for c in self.children:
+            results.append(c.solve(config))
+            if self.all_of and not results[-1]:
+                break
+            if not self.all_of and results[-1]:
+                break
+        self.status = all(results) if self.all_of else any(results)
+        return self.status
+
+    def report(self, indent: str = "") -> str:
+        mark = {True: "✓", False: "✗", None: "?"}[self.status]
+        head = f"{indent}{mark} {self.name} [{'all' if self.all_of else 'any'}]"
+        return "\n".join([head] + [c.report(indent + "  ") for c in self.children])

@@ -1,0 +1,131 @@
+"""The Verifier: generates and discharges a protocol's verification
+conditions.
+
+Reference parity: psync.verification.Verifier
+(verification/Verifier.scala:234-276 generateVCs; :170-181 inductiveness;
+:144-157 progress; :183-229 properties; :279-367 report).  The VC classes are
+the same four:
+
+  1. initial state ⇒ invariant 0,
+  2. every invariant is inductive across every round (inv ∧ TR ⇒ inv′),
+  3. progress: under the round's liveness predicate (the "magic round" HO
+     assumption), invariant i advances to invariant i+1,
+  4. invariants ⇒ stated safety properties.
+
+A ProtocolSpec mirrors the Specs trait (Specs.scala:8-41): invariants,
+properties, safetyPredicate (communication assumption conjoined to every
+TR, mkTR Verifier.scala:159-168), livenessPredicate per phase."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from round_tpu.verify.cl import ClConfig, ClDefault
+from round_tpu.verify.formula import And, Formula, TRUE
+from round_tpu.verify.tr import RoundTR, StateSig
+from round_tpu.verify.vc import VC, CompositeVC, SingleVC
+
+
+@dataclasses.dataclass
+class ProtocolSpec:
+    """What the user states about a protocol (Specs.scala:8-41)."""
+
+    sig: StateSig
+    rounds: List[RoundTR]
+    init: Formula                      # initial-state relation (over fields)
+    invariants: List[Formula]          # invariants[k] holds from phase k on
+    properties: List[Tuple[str, Formula]] = dataclasses.field(default_factory=list)
+    safety_predicate: Formula = TRUE   # communication assumption, every round
+    liveness: List[Formula] = dataclasses.field(default_factory=list)
+    config: Optional[ClConfig] = None
+
+
+class Verifier:
+    def __init__(self, spec: ProtocolSpec, config: ClConfig = ClDefault):
+        self.spec = spec
+        self.config = spec.config or config
+
+    # -- VC generation (Verifier.scala:234-276) -----------------------------
+
+    def generate_vcs(self) -> List[VC]:
+        spec = self.spec
+        sig = spec.sig
+        vcs: List[VC] = []
+
+        if spec.invariants:
+            vcs.append(SingleVC(
+                "initial state implies invariant 0",
+                spec.init, TRUE, spec.invariants[0],
+            ))
+
+        for inv_idx, inv in enumerate(spec.invariants):
+            children = []
+            for r_idx, rnd in enumerate(spec.rounds):
+                tr = And(spec.safety_predicate, rnd.full_tr())
+                children.append(SingleVC(
+                    f"invariant {inv_idx} inductive at round {r_idx}",
+                    inv, tr, sig.prime(inv),
+                ))
+            vcs.append(CompositeVC(
+                f"invariant {inv_idx} is inductive", True, children,
+            ))
+
+        # progress: inv_k ∧ liveness_k ∧ TR ⇒ inv_{k+1}′ (magic rounds,
+        # Verifier.scala:144-157) — one VC per consecutive invariant pair,
+        # any round of the phase may realize it
+        for k in range(len(spec.invariants) - 1):
+            live = spec.liveness[k] if k < len(spec.liveness) else TRUE
+            children = [
+                SingleVC(
+                    f"progress {k}→{k + 1} via round {r_idx}",
+                    And(spec.invariants[k], live),
+                    And(spec.safety_predicate, rnd.full_tr()),
+                    sig.prime(spec.invariants[k + 1]),
+                )
+                for r_idx, rnd in enumerate(spec.rounds)
+            ]
+            if children:
+                vcs.append(CompositeVC(
+                    f"progress {k}→{k + 1}", False, children,
+                ))
+
+        for name, prop in spec.properties:
+            inv_all = And(*spec.invariants) if spec.invariants else TRUE
+            vcs.append(SingleVC(
+                f"property: {name}", inv_all, TRUE, prop,
+            ))
+        return vcs
+
+    # -- checking + report (Verifier.scala:279-367) -------------------------
+
+    def check(self) -> bool:
+        self.vcs = self.generate_vcs()
+        ok = True
+        for vc in self.vcs:
+            ok = vc.solve(self.config) and ok
+        return ok
+
+    def report(self) -> str:
+        lines = ["Verification report", "==================="]
+        for vc in getattr(self, "vcs", []):
+            lines.append(vc.report())
+        return "\n".join(lines)
+
+    def html_report(self) -> str:
+        """Minimal HTML report (the reference emits one via dzufferey.report,
+        Verifier.scala:342-367)."""
+        rows = []
+        for vc in getattr(self, "vcs", []):
+            for line in vc.report().splitlines():
+                ok = line.lstrip().startswith("✓")
+                color = "#2a2" if ok else "#c33"
+                rows.append(
+                    f'<div style="color:{color};font-family:monospace">'
+                    f"{line}</div>"
+                )
+        return (
+            "<html><head><title>Verification report</title></head><body>"
+            + "\n".join(rows)
+            + "</body></html>"
+        )

@@ -1,0 +1,238 @@
+"""Extraction tests: jaxpr → Formula transition relations.
+
+The macro-layer analogue (reference: macros/FormulaExtractorSuite.scala
+tests tree→formula translation).  Includes a differential test: the
+extracted formula, evaluated on concrete small universes, must agree with
+actually executing the JAX function — the same oracle idea as the
+reference's macro suite, but against the real executable."""
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from round_tpu.verify.extract import (
+    ExtractionError, Scalar, Vec, extract_lane_fn,
+)
+from round_tpu.verify.formula import (
+    AND, Application, Binding, Bool, CARD, COMPREHENSION, EQ, EXISTS, FORALL,
+    FunT, GEQ, GT, IMPLIES, IN, Int, IntLit, ITE, LEQ, LT, Literal, MINUS,
+    NEQ, NOT, OR, PLUS, TIMES, UMINUS, UnInterpretedFct, Variable, procType,
+)
+from round_tpu.verify.tr import StateSig, ho_of
+
+
+# ---------------------------------------------------------------------------
+# A tiny concrete-model evaluator for extracted formulas
+# ---------------------------------------------------------------------------
+
+def evaluate(f, env):
+    """Evaluate a Formula over a concrete model.
+
+    env maps: variable name → value; function name → python callable;
+    '__universe__' → list of process ids (for quantifiers/comprehensions)."""
+    if isinstance(f, Literal):
+        return f.value
+    if isinstance(f, Variable):
+        return env[f.name]
+    if isinstance(f, Binding):
+        universe = env["__universe__"]
+        assert len(f.vars) == 1
+        var = f.vars[0]
+
+        def with_v(val):
+            sub = dict(env)
+            sub[var.name] = val
+            return sub
+
+        if f.binder == COMPREHENSION:
+            return [p for p in universe if evaluate(f.body, with_v(p))]
+        if f.binder == FORALL:
+            return all(evaluate(f.body, with_v(p)) for p in universe)
+        return any(evaluate(f.body, with_v(p)) for p in universe)
+    assert isinstance(f, Application)
+    a = [evaluate(x, env) for x in f.args]
+    fct = f.fct
+    if fct == AND:
+        return all(a)
+    if fct == OR:
+        return any(a)
+    if fct == NOT:
+        return not a[0]
+    if fct == IMPLIES:
+        return (not a[0]) or a[1]
+    if fct == EQ:
+        return a[0] == a[1]
+    if fct == NEQ:
+        return a[0] != a[1]
+    if fct == PLUS:
+        return sum(a)
+    if fct == MINUS:
+        return a[0] - a[1]
+    if fct == UMINUS:
+        return -a[0]
+    if fct == TIMES:
+        r = 1
+        for x in a:
+            r *= x
+        return r
+    if fct == LT:
+        return a[0] < a[1]
+    if fct == LEQ:
+        return a[0] <= a[1]
+    if fct == GT:
+        return a[0] > a[1]
+    if fct == GEQ:
+        return a[0] >= a[1]
+    if fct == ITE:
+        return a[1] if a[0] else a[2]
+    if fct == CARD:
+        return len(a[0])
+    if fct == IN:
+        return a[0] in a[1]
+    fn = env[fct.name]
+    return fn(*a)
+
+
+# ---------------------------------------------------------------------------
+# Extraction fixtures
+# ---------------------------------------------------------------------------
+
+N_EX = 5  # example shape for tracing
+
+
+def _voting_update(x, decided, vals, mask):
+    """A per-lane quorum-voting update in plain JAX: count the senders that
+    agree with my estimate; with more than 2·7/3 of them, decide."""
+    votes = jnp.sum((mask & (vals == x)).astype(jnp.int32))
+    quorum = votes * 3 > 2 * 7
+    return x, decided | quorum
+
+
+def _extract_voting():
+    sig = StateSig({"x": Int, "decided": Bool})
+    j = Variable("j", procType)
+    snd = UnInterpretedFct("sndx", FunT([procType], Int))
+
+    def senders(i):
+        return Application(IN, [i, ho_of(j)]).with_type(Bool)
+
+    ex_args = [jnp.int32(0), jnp.bool_(False),
+               jnp.zeros((N_EX,), jnp.int32), jnp.zeros((N_EX,), bool)]
+    fargs = [
+        Scalar(sig.get("x", j)),
+        Scalar(sig.get("decided", j)),
+        Vec(lambda i: Application(snd, [i]).with_type(Int)),
+        Vec(lambda i: Literal(True)),
+    ]
+    outs = extract_lane_fn(_voting_update, ex_args, fargs, senders)
+    return sig, j, snd, outs
+
+
+def test_extract_shapes():
+    sig, j, snd, outs = _extract_voting()
+    assert len(outs) == 2
+    x_out, dec_out = outs
+    assert isinstance(x_out, Scalar) and isinstance(dec_out, Scalar)
+    assert repr(x_out.f) == "x(j)"
+    s = repr(dec_out.f)
+    assert "Cardinality" in s and "HO(j)" in s and "sndx" in s
+
+
+def test_extract_differential_vs_execution():
+    """The extracted formula and the executed JAX function must agree on
+    every (HO set, values, estimate) over a small concrete universe."""
+    sig, j, snd, outs = _extract_voting()
+    dec_formula = outs[1].f
+    universe = list(range(N_EX))
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        ho = rng.random(N_EX) < 0.6
+        vals = rng.integers(0, 3, N_EX)
+        x = int(rng.integers(0, 3))
+        decided = bool(rng.integers(0, 2))
+        # concrete JAX execution: mailbox = senders in HO
+        _, dec_exec = _voting_update(
+            jnp.int32(x), jnp.bool_(decided),
+            jnp.asarray(vals, jnp.int32), jnp.asarray(ho),
+        )
+        env = {
+            "__universe__": universe,
+            "j": 0,
+            "x": lambda p, x=x: x,
+            "decided": lambda p, d=decided: d,
+            "sndx": lambda p, v=vals: int(v[p]),
+            "HO": lambda p, h=ho: [q for q in universe if h[q]],
+        }
+        assert evaluate(dec_formula, env) == bool(dec_exec), (
+            ho, vals, x, decided)
+
+
+def test_extract_any_all():
+    def upd(flag, vals, mask):
+        return jnp.any(mask & (vals > 0)), jnp.all(vals >= 0)
+
+    j = Variable("j", procType)
+    snd = UnInterpretedFct("s", FunT([procType], Int))
+
+    def senders(i):
+        return Application(IN, [i, ho_of(j)]).with_type(Bool)
+
+    outs = extract_lane_fn(
+        upd,
+        [jnp.bool_(False), jnp.zeros((N_EX,), jnp.int32),
+         jnp.zeros((N_EX,), bool)],
+        [Scalar(Literal(False)),
+         Vec(lambda i: Application(snd, [i]).with_type(Int)),
+         Vec(lambda i: Literal(True))],
+        senders,
+    )
+    assert isinstance(outs[0].f, Binding) and outs[0].f.binder == EXISTS
+    assert isinstance(outs[1].f, Binding) and outs[1].f.binder == FORALL
+
+
+def test_extract_select_n():
+    def upd(c, a, b):
+        return jnp.where(c, a, b)
+
+    outs = extract_lane_fn(
+        upd,
+        [jnp.bool_(True), jnp.int32(1), jnp.int32(2)],
+        [Scalar(Variable("c", Bool)), Scalar(Variable("a", Int)),
+         Scalar(Variable("b", Int))],
+        lambda i: Literal(True),
+    )
+    assert repr(outs[0].f) == "Ite(c, a, b)"
+
+
+def test_extract_unsupported_primitive_message():
+    """Data-dependent gathers (the heart of min-most-often-received) are
+    outside the fragment — the error must say so and point at the
+    auxiliary-function mechanism (the reference's AuxiliaryMethod)."""
+    def upd(vals):
+        return jnp.sort(vals)[0]
+
+    with pytest.raises(ExtractionError) as e:
+        extract_lane_fn(
+            upd, [jnp.zeros((N_EX,), jnp.int32)],
+            [Vec(lambda i: Variable("v", Int))],
+            lambda i: Literal(True),
+        )
+    assert "aux" in str(e.value) or "primitive" in str(e.value)
+
+
+def test_extract_true_sum_rejected():
+    """Summing payload values (not an indicator) must raise, not silently
+    emit a wrong Cardinality."""
+    def upd(vals):
+        return jnp.sum(vals)
+
+    snd = UnInterpretedFct("s2", FunT([procType], Int))
+    with pytest.raises(ExtractionError) as e:
+        extract_lane_fn(
+            upd, [jnp.zeros((N_EX,), jnp.int32)],
+            [Vec(lambda i: Application(snd, [i]).with_type(Int))],
+            lambda i: Literal(True),
+        )
+    assert "non-indicator" in str(e.value)
