@@ -1,0 +1,3 @@
+"""Applications built on the framework — the reference's example layer
+(src/test/scala/example/): benchmark drivers, the lock service, dynamic
+membership, and the verifier CLI."""

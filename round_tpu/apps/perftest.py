@@ -1,0 +1,89 @@
+"""Throughput benchmark driver: windows of concurrent consensus instances.
+
+Reference parity: example/PerfTest2.scala:19-110 + test_scripts/
+runPerfTest2.sh — a rate-limited stream of instances (Semaphore of `-rt`
+in-flight), per-decision TSV log, algorithm picked with `-a`.  Here the
+"rate" is the InstancePool window (one vmapped device batch per step).
+
+CLI:  python -m round_tpu.apps.perftest -a otr -n 16 -rt 32 \
+          --instances 256 --log decisions.tsv [--stat]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from round_tpu.apps.selector import select
+from round_tpu.engine import scenarios
+from round_tpu.models.common import consensus_io
+from round_tpu.runtime.config import Options, parse_args
+from round_tpu.runtime.decisions import DecisionLog
+from round_tpu.runtime.instances import InstancePool
+from round_tpu.runtime.stats import stats
+
+
+def run(
+    opts: Options,
+    n_instances: int = 64,
+    p_drop: float = 0.05,
+) -> dict:
+    """Run `n_instances` consensus instances, `opts.rate` at a time.
+    Returns {decided, total, wall_s, decisions_per_s}."""
+    algo = select(opts.algorithm)
+    sampler = scenarios.omission(opts.n, p_drop)
+    pool = InstancePool(
+        algo, opts.n, sampler, max_phases=opts.max_phases, window=opts.rate
+    )
+    log = DecisionLog()
+    key = jax.random.PRNGKey(opts.seed)
+
+    t0 = time.monotonic()
+    for iid in range(n_instances):
+        io = consensus_io(jnp.arange(opts.n, dtype=jnp.int32) % 5)
+        with stats.timer("perftest.submit"):
+            pool.submit(iid, io)
+        if (iid + 1) % opts.rate == 0 or iid == n_instances - 1:
+            with stats.timer("perftest.window"):
+                for res in pool.run_pending(jax.random.fold_in(key, iid)):
+                    stats.counter("perftest.instances")
+                    if res.value is not None:
+                        rnd = int(res.decided_round[res.decided.argmax()])
+                        ok = log.record(res.instance_id, rnd, int(res.value))
+                        assert ok, f"agreement violation at {res.instance_id}"
+    wall = time.monotonic() - t0
+    if opts.log_file:
+        log.dump_tsv(opts.log_file)
+    return {
+        "decided": len(log),
+        "total": n_instances,
+        "wall_s": wall,
+        "decisions_per_s": len(log) / wall if wall > 0 else 0.0,
+    }
+
+
+def main(argv=None) -> dict:
+    argv = sys.argv[1:] if argv is None else argv
+    import argparse
+
+    extra = argparse.ArgumentParser(add_help=False)
+    extra.add_argument("--instances", type=int, default=64)
+    extra.add_argument("--p-drop", type=float, default=0.05)
+    extra.add_argument("--platform", type=str, default=None)
+    ns, rest = extra.parse_known_args(argv)
+    if ns.platform:
+        jax.config.update("jax_platforms", ns.platform)
+    opts = parse_args(rest)
+    if opts.stats:
+        stats.enable()
+    out = run(opts, n_instances=ns.instances, p_drop=ns.p_drop)
+    print(out)
+    return out
+
+
+if __name__ == "__main__":
+    main()

