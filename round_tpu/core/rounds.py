@@ -117,8 +117,10 @@ class Round:
         raise NotImplementedError
 
     def expected_nbr_messages(self, ctx: RoundCtx, state):
-        """Early-exit hint (Round.scala:33-35). Unused by the lockstep engine,
-        used by the host event-round runtime."""
+        """Early-exit hint (Round.scala:33-35).  The lockstep engine does not
+        need it (a round is one fused step); kept for API parity and for
+        samplers that model goAhead-at-quorum as a mask family
+        (scenarios.sync_k_filter)."""
         return ctx.n
 
 

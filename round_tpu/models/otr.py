@@ -14,6 +14,7 @@ all).  See round_tpu/spec for the checked formulation.
 from __future__ import annotations
 
 import flax.struct
+import jax
 import jax.numpy as jnp
 
 from round_tpu.core.algorithm import Algorithm
@@ -32,15 +33,26 @@ class OtrState:
 
 
 class OtrRound(Round):
+    def __init__(self, n_values: int | None = None):
+        # Static value-domain hint: when every estimate lives in
+        # [0, n_values) (true whenever the *initial* values do — OTR only
+        # ever adopts received estimates), update uses the [n, V] histogram
+        # matmul instead of the [n, n] equality matmul (n/V fewer FLOPs).
+        self.n_values = n_values
+
     def send(self, ctx: RoundCtx, state: OtrState):
         return broadcast(ctx, state.x)
 
     def update(self, ctx: RoundCtx, state: OtrState, mbox: Mailbox) -> OtrState:
         n = ctx.n
         quorum = mbox.size() > (2 * n) // 3
-
-        v = mbox.min_most_often_received()
-        v_count = mbox.count(lambda vals: vals == v)
+        if self.n_values is not None:
+            counts = mbox.value_histogram(self.n_values)
+            v = jnp.argmax(counts).astype(state.x.dtype)  # first max = mmor
+            v_count = jnp.max(counts)
+        else:
+            v = mbox.min_most_often_received()
+            v_count = mbox.count(lambda vals: vals == v)
         super_quorum = quorum & (v_count > (2 * n) // 3)
 
         state = ghost_decide(state, super_quorum, v)
@@ -135,14 +147,25 @@ class OtrSpec(Spec):
 class OTR(Algorithm):
     """One-Third-Rule consensus over int payloads."""
 
-    def __init__(self, after_decision: int = 2):
+    def __init__(self, after_decision: int = 2, n_values: int | None = None):
         self.after_decision = after_decision
-        self.rounds = (OtrRound(),)
+        self.rounds = (OtrRound(n_values=n_values),)
         self.spec = OtrSpec()
 
     def make_init_state(self, ctx: RoundCtx, io) -> OtrState:
+        x = jnp.asarray(io["initial_value"], dtype=jnp.int32)
+        n_values = self.rounds[0].n_values
+        if n_values is not None and not isinstance(x, jax.core.Tracer):
+            import numpy as np
+
+            xv = np.asarray(x)
+            if xv.size and (xv.min() < 0 or xv.max() >= n_values):
+                raise ValueError(
+                    f"OTR(n_values={n_values}) requires initial values in "
+                    f"[0, {n_values}); got range [{xv.min()}, {xv.max()}]"
+                )
         return OtrState(
-            x=jnp.asarray(io["initial_value"], dtype=jnp.int32),
+            x=x,
             decided=jnp.asarray(False),
             decision=jnp.asarray(-1, dtype=jnp.int32),
             after=jnp.asarray(self.after_decision, dtype=jnp.int32),

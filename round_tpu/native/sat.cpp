@@ -224,7 +224,8 @@ struct Solver {
     // drop half of the learned clauses with lowest activity (not locked)
     std::vector<int> order;
     for (int i = 0; i < (int)clauses.size(); ++i)
-      if (clauses[i].learned) order.push_back(i);
+      if (clauses[i].learned && !clauses[i].lits.empty())  // skip tombstones
+        order.push_back(i);
     if (order.size() < 2000) return;
     // simple partial sort by activity
     std::sort(order.begin(), order.end(), [&](int a, int b) {
@@ -284,6 +285,11 @@ struct Solver {
         }
         var_inc /= 0.95;
         cla_inc /= 0.999;
+        if (cla_inc > 1e20) {  // rescale, mirroring the var-activity bump
+          for (auto& c : clauses)
+            if (c.learned) c.activity *= 1e-20;
+          cla_inc *= 1e-20;
+        }
         if (--conflict_budget <= 0) {
           backtrack(0);
           ++restart_n;

@@ -133,7 +133,27 @@ class Mailbox:
         vals = self.values if values is None else values
         return jnp.sum(jnp.where(self.mask, vals, 0))
 
-    def min_most_often_received(self, values=None) -> jnp.ndarray:
+    def value_histogram(self, num_values: int, values=None) -> jnp.ndarray:
+        """``counts[v] = #{ present senders with value == v }`` for a payload
+        whose value domain is the static range ``[0, num_values)``.
+
+        TPU note: lowered as ``mask @ onehot`` — the ``[n, num_values]``
+        one-hot matrix is shared across receivers, so under the engine's
+        receiver-vmap this is one ``[n, n] x [n, V]`` matmul: ``n/V``-fold
+        fewer FLOPs than the generic ``[n, n] x [n, n]`` equality-matmul of
+        :meth:`min_most_often_received`.  Inputs are cast to bfloat16 with
+        float32 accumulation (products are 0/1 and counts <= n, so the result
+        is exact up to n < 2^24)."""
+        vals = self.values if values is None else values
+        onehot = (vals[:, None] == jnp.arange(num_values, dtype=vals.dtype)[None, :])
+        counts = jnp.dot(
+            self.mask.astype(jnp.bfloat16),
+            onehot.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        return counts.astype(jnp.int32)
+
+    def min_most_often_received(self, values=None, num_values: int | None = None) -> jnp.ndarray:
         """OTR's ``mmor`` (Otr.scala:44-49): the value received most often;
         ties broken toward the smallest value.  Assumes at least one message
         (guarded by the caller's quorum check, as in the reference).
@@ -145,8 +165,17 @@ class Mailbox:
         *shared* across receivers — under the engine's receiver-vmap this lowers
         to one [n_recv, n_send] @ [n_send, n_send] matmul on the MXU instead of
         an [n, n, n] broadcast-compare.  Counts ≤ n are exact in float32.
+
+        When the value domain is the static range [0, num_values) pass
+        ``num_values``: the count matmul shrinks to [n, num_values] via
+        :meth:`value_histogram` and the answer is ``argmax(counts)`` (argmax
+        returns the first maximal index = the smallest value, matching the
+        tie-break).
         """
         vals = self.values if values is None else values
+        if num_values is not None:
+            counts = self.value_histogram(num_values, vals)
+            return jnp.argmax(counts).astype(vals.dtype)
         eq = (vals[None, :] == vals[:, None]).astype(jnp.float32)  # unbatched
         counts = jnp.dot(self.mask.astype(jnp.float32), eq)  # [n]
         max_count = jnp.max(counts)

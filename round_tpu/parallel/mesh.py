@@ -133,29 +133,80 @@ def sharded_simulate(
 
 def dryrun(n_devices: int) -> None:
     """Driver hook: jit the full multi-chip step over an n_devices mesh
-    (scenario-DP × proc sharding) and execute one tiny run."""
+    (scenario-DP × proc sharding) and execute one tiny run.
+
+    Hermeticity: this is a CPU-only *sharding correctness* check — it must
+    pass (or fail) independently of any accelerator plugin, including a
+    present-but-wedged TPU client (round-1 verdict: an eager asarray on the
+    default device failed the whole check).  If this process is not already
+    pinned to the CPU platform, the check re-execs itself in a subprocess
+    with jax_platforms=cpu set *before first backend use*, so it can never
+    touch the chip."""
+    plats = jax.config.jax_platforms
+    if plats and plats.split(",")[0] == "cpu":
+        cpu = jax.devices("cpu")
+        if len(cpu) >= n_devices:
+            return _dryrun_cpu(n_devices)
+    _dryrun_subprocess(n_devices)
+
+
+def _dryrun_subprocess(n_devices: int) -> None:
+    """Re-exec the dryrun in a CPU-pinned child with enough virtual devices."""
+    import os
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    code = (
+        "import jax; "
+        "jax.config.update('jax_platforms', 'cpu'); "
+        "from round_tpu.parallel.mesh import _dryrun_cpu; "
+        f"_dryrun_cpu({n_devices})"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # replace (not just append) any existing device-count flag: an inherited
+    # smaller value would starve the child of the devices it exists to provide
+    import re
+
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        env.get("XLA_FLAGS", ""),
+    )
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n_devices}".strip()
+    )
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=900,
+    )
+    if proc.stdout:
+        print(proc.stdout, end="")
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"CPU-pinned dryrun subprocess failed (rc={proc.returncode}):\n"
+            f"{proc.stderr[-4000:]}"
+        )
+
+
+def _dryrun_cpu(n_devices: int) -> None:
+    """The actual dryrun body, pinned to CPU devices end to end."""
     import numpy as np
 
     from round_tpu.engine import scenarios
     from round_tpu.models.otr import OTR
 
-    devs = jax.devices()
+    devs = jax.devices("cpu")
     if len(devs) < n_devices:
-        # The driver validates multi-chip sharding with virtual host devices
-        # (--xla_force_host_platform_device_count) while an accelerator plugin
-        # with fewer chips may be the default platform; use the CPU devices.
-        try:
-            cpu = jax.devices("cpu")
-        except RuntimeError:
-            cpu = []
-        if len(cpu) >= n_devices:
-            devs = cpu
-        else:
-            raise RuntimeError(
-                f"dryrun wants {n_devices} devices: default platform has "
-                f"{len(devs)}, cpu has {len(cpu)} (set "
-                f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices})"
-            )
+        raise RuntimeError(
+            f"dryrun wants {n_devices} CPU devices, have {len(devs)} (set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices})"
+        )
     proc_shards = 2 if n_devices % 2 == 0 else 1
     mesh = make_mesh(n_devices, proc_shards=proc_shards, devices=devs)
     s_shards = n_devices // proc_shards
@@ -163,20 +214,21 @@ def dryrun(n_devices: int) -> None:
     n = max(8, 4 * proc_shards)
     S = 2 * s_shards
     algo = OTR()
-    init = np.tile(np.arange(n, dtype=np.int32)[None, :] % 3, (S, 1))
-    io = {"initial_value": jnp.asarray(init)}
+    with jax.default_device(devs[0]):
+        init = np.tile(np.arange(n, dtype=np.int32)[None, :] % 3, (S, 1))
+        io = {"initial_value": jnp.asarray(init)}
 
-    state, done, decided_round = sharded_simulate(
-        algo,
-        io,
-        n,
-        jax.random.PRNGKey(0),
-        scenarios.full(n),
-        max_phases=3,
-        n_scenarios=S,
-        mesh=mesh,
-    )
-    jax.block_until_ready(state)
+        state, done, decided_round = sharded_simulate(
+            algo,
+            io,
+            n,
+            jax.random.PRNGKey(0),
+            scenarios.full(n),
+            max_phases=3,
+            n_scenarios=S,
+            mesh=mesh,
+        )
+        jax.block_until_ready(state)
     assert bool(jnp.asarray(done).all()), "OTR on a full network must terminate"
     print(
         f"dryrun_multichip ok: mesh={dict(zip(mesh.axis_names, mesh.devices.shape))} "

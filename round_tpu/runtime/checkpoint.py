@@ -23,7 +23,12 @@ def save(path: str, state: Any, *, step: int = 0, meta: Optional[Dict] = None) -
     os.makedirs(path, exist_ok=True)
     leaves, treedef = jax.tree_util.tree_flatten(state)
     arrays = {f"leaf{i}": np.asarray(v) for i, v in enumerate(leaves)}
-    np.savez(os.path.join(path, "state.npz"), **arrays)
+    # write-then-rename: a crash mid-overwrite must never leave a valid
+    # manifest pointing at a torn state.npz
+    tmp_npz = os.path.join(path, "state.npz.tmp")
+    with open(tmp_npz, "wb") as fh:
+        np.savez(fh, **arrays)
+    os.replace(tmp_npz, os.path.join(path, "state.npz"))
     manifest = {
         "step": int(step),
         "n_leaves": len(leaves),
@@ -49,6 +54,14 @@ def restore(path: str, like: Any) -> Tuple[Any, int, Dict]:
         f"checkpoint has {len(leaves)} leaves, template has "
         f"{treedef.num_leaves}"
     )
+    # leaf count alone lets a reordered pytree restore with fields swapped;
+    # the recorded treedef string must match the template's exactly
+    if manifest.get("treedef") is not None and manifest["treedef"] != str(treedef):
+        raise ValueError(
+            "checkpoint treedef does not match the restore template:\n"
+            f"  saved:    {manifest['treedef']}\n"
+            f"  template: {treedef}"
+        )
     state = jax.tree_util.tree_unflatten(treedef, leaves)
     return state, manifest["step"], manifest.get("meta", {})
 

@@ -101,7 +101,17 @@ def parse_config_file(path: str) -> Tuple[List[Tuple[str, int]], List[str]]:
         peers = [tuple(p) for p in data.pop("peers", [])]
         args: List[str] = []
         for k, v in data.items():
-            args.extend([f"--{k.replace('_', '-')}", str(v)])
+            flag = f"--{k.replace('_', '-')}"
+            if k in ("stats", "stat"):
+                # the parser knows --stat only as a no-value flag; the
+                # '--stats True' form would be silently dropped
+                if v:
+                    args.append("--stat")
+            elif isinstance(v, bool):
+                if v:
+                    args.append(flag)
+            else:
+                args.extend([flag, str(v)])
         return peers, args
     root = ET.parse(path).getroot()
     peers = []
@@ -128,7 +138,13 @@ def parse_args(argv: Sequence[str], base: Optional[Options] = None) -> Options:
         if peers:
             opts.peers = peers
             opts.n = len(peers)
-        fns, _ = parser.parse_known_args(file_args)
+        fns, unused = parser.parse_known_args(file_args)
+        if unused:
+            import warnings
+
+            warnings.warn(
+                f"config file {ns.conf}: unrecognized options ignored: {unused}"
+            )
         _apply(opts, fns)
     _apply(opts, ns)
     if opts.peers and opts.n != len(opts.peers):

@@ -191,10 +191,19 @@ class VennRegions:
             return None
         if not support:  # |∅|
             return IntLit(0)
-        region_vars = self._ensure_group(tuple(support))
+        # explosion guard (build() enforces self.bound/_MAX_GROUPS; this lazy
+        # path must too): leaving the Card uninterpreted is sound — the
+        # reducer merely loses the cardinality fact and fails to prove.
+        if len(support) > 12 or len(self._group_regions) >= _MAX_GROUPS:
+            return None
+        # profiles are positional over the *canonical* (repr-sorted) group
+        # _ensure_group builds, so zip that same ordering — zipping the raw
+        # encounter-ordered support attaches membership bits to wrong sets
+        group = tuple(sorted(support, key=repr))
+        region_vars = self._ensure_group(group)
         terms = []
         for profile, v in region_vars.items():
-            pmap = dict(zip(support, profile))
+            pmap = dict(zip(group, profile))
             if _profile_satisfies(expr, pmap):
                 terms.append(v)
         if not terms:

@@ -254,3 +254,23 @@ def test_cl_intersection_argument_order():
     h = Gt(Plus(Card(A), Card(B)), N)
     assert entailment(h, Geq(Card(Intersection(B, A)), 1))
     assert entailment(h, Geq(Card(Intersection(A, B)), 1))
+
+
+def test_cl_setminus_profile_alignment():
+    """|Q\\P| ≥ 1 ∧ P ⊆ Q is satisfiable: card_of must zip region profiles
+    with the *canonical* (sorted) group, not the encounter-ordered support —
+    the encounter order of SetMinus(Q, P) is (Q, P), the canonical group is
+    (P, Q), so a positional zip flips the membership bits and certifies a
+    false invariant (round-1 advisor finding)."""
+    from round_tpu.verify.formula import SETMINUS
+
+    P = Variable("P", FSet(procType))
+    Q = Variable("Q", FSet(procType))
+    qmp = Application(SETMINUS, [Q, P])
+    # satisfiable hypothesis must NOT entail a contradiction
+    assert not entailment(And(SubsetEq(P, Q), Geq(Card(qmp), 1)), Lt(N, 0))
+    # and the true consequence does hold
+    assert entailment(And(SubsetEq(P, Q), Geq(Card(qmp), 1)), Gt(Card(Q), Card(P)))
+    # while the converse-direction difference is correctly refuted
+    pmq = Application(SETMINUS, [P, Q])
+    assert entailment(SubsetEq(P, Q), Leq(Card(pmq), 0))

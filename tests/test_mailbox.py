@@ -48,6 +48,26 @@ def test_mmor_matches_reference_semantics():
         assert got == want, (vals, mask, got, want)
 
 
+def test_value_histogram_and_mmor_fast_path_parity():
+    """The [n, V] histogram matmul must agree with the generic [n, n]
+    equality-matmul mmor on every random instance (the bench's fast path)."""
+    rng = np.random.RandomState(7)
+    V = 6
+    for _ in range(80):
+        n = rng.randint(1, 12)
+        vals = rng.randint(0, V, size=n)
+        mask = rng.rand(n) < 0.6
+        if not mask.any():
+            mask[rng.randint(n)] = True
+        m = _mbox(vals, mask)
+        counts = np.asarray(m.value_histogram(V))
+        want = np.bincount(vals[mask], minlength=V)
+        np.testing.assert_array_equal(counts, want)
+        assert int(m.min_most_often_received(num_values=V)) == int(
+            m.min_most_often_received()
+        )
+
+
 def test_best_by_max_key_min_id_tiebreak():
     m = _mbox([1, 2, 3, 4], [True, True, True, False])
     keys = jnp.asarray([7, 9, 9, 99])  # sender 3 masked out

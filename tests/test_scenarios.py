@@ -81,6 +81,45 @@ def test_omission_varies_across_rounds():
     )
 
 
+def test_link_bernoulli_rate_and_decorrelation():
+    """The counter-based sampler must hit p within 1/256 quantization and
+    produce round- and key-decorrelated draws."""
+    import jax
+
+    n = 64
+    p = 0.25
+    key = jax.random.PRNGKey(3)
+    draws = np.stack(
+        [np.asarray(scenarios.link_bernoulli(key, r, n, p)) for r in range(8)]
+    )
+    rate = draws.mean()
+    assert abs(rate - p) < 0.02, rate
+    # rounds differ, keys differ
+    assert not np.array_equal(draws[0], draws[1])
+    other = np.asarray(scenarios.link_bernoulli(jax.random.PRNGKey(4), 0, n, p))
+    assert not np.array_equal(draws[0], other)
+    # no row/column degeneracy: every row sees both outcomes at p=0.25
+    assert draws[0].any(axis=1).all() or n < 8
+
+
+def test_omission_impls_agree_statistically():
+    n = 32
+    import jax
+
+    key = jax.random.PRNGKey(0)
+    h = np.stack(
+        [np.asarray(scenarios.omission(n, 0.3)(key, r)) for r in range(6)]
+    )
+    t = np.stack(
+        [
+            np.asarray(scenarios.omission(n, 0.3, impl="threefry")(key, r))
+            for r in range(6)
+        ]
+    )
+    # same deliver rate (within sampling noise + 1/256 quantization)
+    assert abs(h.mean() - t.mean()) < 0.03
+
+
 def test_partition_halves_stable_then_heal():
     n = 8
     trace = _heard_trace(scenarios.partition(n, round_heal=3), n)
