@@ -8,9 +8,21 @@ batched rounds on one chip.  Prints ONE JSON line:
 advance one round).  vs_baseline is against the 100 rounds/sec/chip target
 (BASELINE.md): value/100.
 
-Scenario micro-batching: scenarios are processed in chunks under lax.map so
-the [chunk, n, n] delivery/count tensors stay within HBM while the full 10k
-scenario batch runs in one jitted call.
+Engines:
+  --engine fused (default): the Pallas fast path (ops/fused.py +
+    engine/fast.py) — HO-mask generation and the value-histogram exchange
+    fused in VMEM; the scenario batch runs as one jitted scan.
+  --engine reference: the general engine (engine/executor.py), scenario
+    micro-batching via lax.map.
+
+Workload: the hardened mix (engine.fast.standard_mix) — scenarios split
+across iid omission / crash / partition / rotating-victim families, the
+batched analogue of testOTR.sh + oneDownOTR.sh.  --workload omission
+restores the plain 5%-omission scenario family.
+
+--parity K runs K scenarios of the same mix through BOTH engines (hash-mode
+RNG, bit-identical masks) and reports decision agreement — the bench checks
+its own fast path against the reference semantics in the same run.
 """
 
 import argparse
@@ -28,20 +40,60 @@ if "--platform" in sys.argv:
         "jax_platforms", sys.argv[sys.argv.index("--platform") + 1]
     )
 
+import numpy as np
+
+from round_tpu.engine import fast, scenarios
 from round_tpu.engine.executor import run_instance
-from round_tpu.engine import scenarios
-from round_tpu.models.otr import OTR
+from round_tpu.models.otr import OTR, OtrState
 from round_tpu.models.common import consensus_io
 
 
-def make_bench(n, n_scenarios, chunk, phases, n_values, p_drop):
-    algo = OTR(after_decision=2, n_values=n_values)
-    sampler = scenarios.omission(n, p_drop)
+def make_mix(args, key, S):
+    if args.workload == "omission":
+        mix = fast.fault_free(key, S, args.n)
+        return mix.replace(
+            p8=jnp.full((S,), max(1, round(args.p_drop * 256)), jnp.int32)
+        )
+    return fast.standard_mix(key, S, args.n, p_drop=args.p_drop)
+
+
+def make_fused_bench(args, S):
+    n, V, rounds = args.n, args.values, args.phases
+    rnd = fast.OtrHist(n_values=V, after_decision=2)
+    interpret = jax.default_backend() == "cpu"
+    # the TPU hardware PRNG has no interpreter lowering; CPU runs use the
+    # (bit-reproducible) hash sampler
+    mode = "hash" if interpret else args.rng
+
+    @jax.jit
+    def bench(key):
+        mix = make_mix(args, key, S)
+        k_init = jax.random.fold_in(key, 1)
+        init = jax.random.randint(k_init, (n,), 0, V, dtype=jnp.int32)
+        state0 = OtrState(
+            x=jnp.broadcast_to(init, (S, n)).astype(jnp.int32),
+            decided=jnp.zeros((S, n), dtype=bool),
+            decision=jnp.full((S, n), -1, dtype=jnp.int32),
+            after=jnp.full((S, n), 2, dtype=jnp.int32),
+        )
+        state, done, decided_round = fast.run_hist(
+            rnd, state0, lambda s: s.decided, mix,
+            max_rounds=rounds, mode=mode, interpret=interpret,
+        )
+        return state.decided, decided_round
+
+    return bench
+
+
+def make_reference_bench(args, S):
+    n, chunk, phases, V = args.n, args.chunk, args.phases, args.values
+    algo = OTR(after_decision=2, n_values=V)
+    sampler = scenarios.omission(n, args.p_drop)
 
     def run_chunk(keys):  # [chunk] keys -> chunk results
         def one(k):
             k_init, k_run = jax.random.split(k)
-            init = jax.random.randint(k_init, (n,), 0, n_values, dtype=jnp.int32)
+            init = jax.random.randint(k_init, (n,), 0, V, dtype=jnp.int32)
             res = run_instance(
                 algo, consensus_io(init), n, k_run, sampler, max_phases=phases
             )
@@ -51,33 +103,84 @@ def make_bench(n, n_scenarios, chunk, phases, n_values, p_drop):
 
     @jax.jit
     def bench(key):
-        keys = jax.random.split(key, n_scenarios).reshape(
-            n_scenarios // chunk, chunk, 2
-        )
+        keys = jax.random.split(key, S).reshape(S // chunk, chunk, 2)
         decided, dec_round = jax.lax.map(run_chunk, keys)
         return decided.reshape(-1, n), dec_round.reshape(-1, n)
 
     return bench
 
 
+def parity_check(args, k_scenarios: int) -> float:
+    """Fraction of lanes where fused (hash mode) and general engine agree on
+    (decided, decision) over the first k scenarios of the mix."""
+    n, V, rounds = args.n, args.values, args.phases
+    key = jax.random.PRNGKey(0)
+    mix = make_mix(args, key, k_scenarios)
+    init = jax.random.randint(
+        jax.random.fold_in(key, 1), (n,), 0, V, dtype=jnp.int32
+    )
+    rnd = fast.OtrHist(n_values=V, after_decision=2)
+    state0 = OtrState(
+        x=jnp.broadcast_to(init, (k_scenarios, n)).astype(jnp.int32),
+        decided=jnp.zeros((k_scenarios, n), dtype=bool),
+        decision=jnp.full((k_scenarios, n), -1, dtype=jnp.int32),
+        after=jnp.full((k_scenarios, n), 2, dtype=jnp.int32),
+    )
+    interpret = jax.default_backend() == "cpu"
+    state, _done, _dr = fast.run_hist(
+        rnd, state0, lambda s: s.decided, mix,
+        max_rounds=rounds, mode="hash", interpret=interpret,
+    )
+    algo = OTR(after_decision=2, n_values=V)
+    agree = 0
+    total = 0
+    for s in range(k_scenarios):
+        sampler = scenarios.from_fault_params(
+            n, mix.crashed[s], mix.crash_round[s], mix.side[s],
+            mix.heal_round[s], mix.rotate_down[s], mix.p8[s],
+            mix.salt0[s], mix.salt1[s],
+        )
+        res = run_instance(
+            algo, consensus_io(init), n, jax.random.fold_in(key, 99 + s),
+            sampler, max_phases=rounds,
+        )
+        agree += int(
+            np.sum(
+                (np.asarray(state.decided[s]) == np.asarray(res.state.decided))
+                & (np.asarray(state.decision[s]) == np.asarray(res.state.decision))
+            )
+        )
+        total += n
+    return agree / max(total, 1)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=1024)
     ap.add_argument("--scenarios", type=int, default=10_000)
-    ap.add_argument("--chunk", type=int, default=50)
+    ap.add_argument("--chunk", type=int, default=50, help="reference engine micro-batch")
     ap.add_argument("--phases", type=int, default=10)
     ap.add_argument("--values", type=int, default=16, help="initial-value domain size")
     ap.add_argument("--p-drop", type=float, default=0.05)
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--platform", type=str, default=None, help="override jax platform (e.g. cpu)")
+    ap.add_argument("--engine", choices=["fused", "reference"], default="fused")
+    ap.add_argument("--workload", choices=["mixed", "omission"], default="mixed")
+    ap.add_argument("--rng", choices=["hw", "hash"], default="hw",
+                    help="fused-engine per-link RNG: TPU hardware PRNG or the hash sampler")
+    ap.add_argument("--parity", type=int, default=0, metavar="K",
+                    help="also run K scenarios through both engines and report agreement")
     args = ap.parse_args()
 
     if args.scenarios < 1:
         raise SystemExit("--scenarios must be >= 1")
-    # clamp chunk, then round the scenario count to a whole number of chunks
-    args.chunk = max(1, min(args.chunk, args.scenarios))
-    S = (args.scenarios // args.chunk) * args.chunk
-    bench = make_bench(args.n, S, args.chunk, args.phases, args.values, args.p_drop)
+    if args.engine == "fused":
+        S = args.scenarios
+        bench = make_fused_bench(args, S)
+    else:
+        args.chunk = max(1, min(args.chunk, args.scenarios))
+        S = (args.scenarios // args.chunk) * args.chunk
+        bench = make_reference_bench(args, S)
 
     key = jax.random.PRNGKey(0)
     decided, dec_round = jax.block_until_ready(bench(key))  # compile + warmup
@@ -97,23 +200,29 @@ def main():
     rounds_per_sec = total_rounds / best
 
     # health stats (not part of the metric line)
-    frac_decided = float(jnp.mean(decided.astype(jnp.float32)))
-    dr = dec_round[decided]
-    p50 = float(jnp.median(dr)) if dr.size else -1.0
+    frac_decided = float(np.mean(np.asarray(decided, dtype=np.float32)))
+    dr = np.asarray(dec_round)[np.asarray(decided)]
+    p50 = float(np.median(dr)) if dr.size else -1.0
+
+    extra = {
+        "wall_s_per_run": round(best, 3),
+        "rounds_per_run": total_rounds,
+        "frac_lanes_decided": round(frac_decided, 4),
+        "decided_round_p50": p50,
+        "n": args.n,
+        "scenarios": S,
+        "engine": args.engine,
+        "workload": args.workload,
+    }
+    if args.parity > 0:
+        extra["parity_frac"] = round(parity_check(args, args.parity), 4)
 
     result = {
         "metric": f"otr_n{args.n}_s{S}_rounds_per_sec",
         "value": round(rounds_per_sec, 3),
         "unit": "rounds/sec",
         "vs_baseline": round(rounds_per_sec / 100.0, 3),
-        "extra": {
-            "wall_s_per_run": round(best, 3),
-            "rounds_per_run": total_rounds,
-            "frac_lanes_decided": round(frac_decided, 4),
-            "decided_round_p50": p50,
-            "n": args.n,
-            "scenarios": S,
-        },
+        "extra": extra,
     }
     print(json.dumps(result))
 

@@ -1,0 +1,247 @@
+"""The fused fast engine: histogram rounds via the Pallas exchange kernel.
+
+The general engine (executor.py) materializes an ``[S, n, n]`` delivery mask
+in HBM every round, which bounds the flagship bench at a few rounds/sec.
+For *histogram rounds* — broadcast a small-domain value, consume the mailbox
+only through per-value counts (OTR, FloodMin, BenOr vote phases) — this
+module runs the whole round through ops.fused.hist_exchange: the mask is
+generated and consumed inside VMEM, and the per-round HBM traffic drops from
+O(S·n²) to O(S·V·n).
+
+The fault model is a `FaultMix`: per-scenario structured parameters (crash
+sets, partition sides, a rotating suppressed process, an iid-omission
+threshold, hash salts) from which each round's O(S·n) kernel inputs are
+derived.  The same parameters replay exactly in the general engine through
+`scenarios.from_fault_params` (hash mode), which is how the differential
+parity tests pin the two engines together (tests/test_fast.py).
+
+Reference parity: this is the PerfTest2 hot path (the reference's
+InstanceHandler loop + UDP stack, PerfTest2.scala:19-110) re-designed as a
+single fused TPU program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from round_tpu.engine import scenarios
+from round_tpu.models.common import ghost_decide
+from round_tpu.ops import fused
+from round_tpu.utils.tree import tree_where
+
+_RMIX = 0x7FEB352D
+
+
+@flax.struct.dataclass
+class FaultMix:
+    """Per-scenario fault parameters (all leaves have leading axis [S]).
+
+    Families compose: a scenario may have a crash set AND omissions.  The
+    all-zeros row is the fault-free network.
+
+      crashed:      [S, n] bool — processes that crash at `crash_round`
+      crash_round:  [S] int32
+      side:         [S, n] int32 — partition side id until `heal_round`
+      heal_round:   [S] int32
+      rotate_down:  [S] int32 — 0 = off; k = process (r // k) % n is
+                    suppressed each round (the coordinator-down schedule,
+                    test_scripts/oneDownLV.sh analogue)
+      p8:           [S] int32 — iid per-link drop threshold (p = p8/256)
+      salt0/salt1:  [S] int32 — hash-sampler salts (scenarios._key_salt)
+    """
+
+    crashed: jnp.ndarray
+    crash_round: jnp.ndarray
+    side: jnp.ndarray
+    heal_round: jnp.ndarray
+    rotate_down: jnp.ndarray
+    p8: jnp.ndarray
+    salt0: jnp.ndarray
+    salt1: jnp.ndarray
+
+    @property
+    def n(self) -> int:
+        return self.crashed.shape[-1]
+
+
+def fault_free(key, S: int, n: int) -> FaultMix:
+    z = jnp.zeros((S,), dtype=jnp.int32)
+    return FaultMix(
+        crashed=jnp.zeros((S, n), dtype=bool),
+        crash_round=z,
+        side=jnp.zeros((S, n), dtype=jnp.int32),
+        heal_round=z,
+        rotate_down=z,
+        p8=z,
+        salt0=_salts(key, S, 0),
+        salt1=_salts(key, S, 1),
+    )
+
+
+def _salts(key, S: int, which: int) -> jnp.ndarray:
+    bits = jax.random.bits(jax.random.fold_in(key, which), (S,), jnp.uint32)
+    return bits.astype(jnp.int32)
+
+
+def standard_mix(
+    key,
+    S: int,
+    n: int,
+    p_drop: float = 0.05,
+    f: Optional[int] = None,
+    crash_round: int = 2,
+    heal_round: int = 4,
+    rotate_period: int = 1,
+) -> FaultMix:
+    """The hardened flagship workload: scenarios split evenly across four
+    families (VERDICT round-1 item 6 — not just 5% omission):
+
+      0: iid omission at p_drop,
+      1: f processes crash at `crash_round` (+ light omission),
+      2: two-way partition until `heal_round`,
+      3: rotating suppressed process (+ light omission).
+    """
+    if f is None:
+        f = max(1, n // 3 - 1)
+    fam = jnp.arange(S, dtype=jnp.int32) % 4
+    k1, k2, k3 = jax.random.split(jax.random.fold_in(key, 0xFA), 3)
+
+    crashed = jax.vmap(
+        lambda k: jax.random.permutation(k, jnp.arange(n)) < f
+    )(jax.random.split(k1, S))
+    side = jax.vmap(
+        lambda k: jax.random.bernoulli(k, 0.5, (n,)).astype(jnp.int32)
+    )(jax.random.split(k2, S))
+
+    p8_full = jnp.int32(max(1, round(p_drop * 256)))
+    p8_light = jnp.int32(max(1, round(p_drop * 64)))
+
+    return FaultMix(
+        crashed=crashed & (fam == 1)[:, None],
+        crash_round=jnp.full((S,), crash_round, dtype=jnp.int32),
+        side=side * (fam == 2)[:, None],
+        heal_round=jnp.where(fam == 2, heal_round, 0).astype(jnp.int32),
+        rotate_down=jnp.where(fam == 3, rotate_period, 0).astype(jnp.int32),
+        p8=jnp.where(
+            fam == 0, p8_full, jnp.where(fam == 2, 0, p8_light)
+        ).astype(jnp.int32),
+        salt0=_salts(key, S, 0),
+        salt1=_salts(key, S, 1),
+    )
+
+
+def round_params(mix: FaultMix, r) -> Tuple[jnp.ndarray, ...]:
+    """Derive round-r kernel inputs [S, n] from the mix (O(S·n) work)."""
+    S, n = mix.crashed.shape
+    r = jnp.asarray(r, dtype=jnp.int32)
+    alive = ~(mix.crashed & (r >= mix.crash_round)[:, None])
+    period = jnp.maximum(mix.rotate_down, 1)
+    victim = (r // period) % n
+    rotated = (jnp.arange(n)[None, :] == victim[:, None]) & (
+        mix.rotate_down > 0
+    )[:, None]
+    colmask = alive & ~rotated
+    side_r = jnp.where((r < mix.heal_round)[:, None], mix.side, 0)
+    salt1r = r * jnp.int32(_RMIX) + mix.salt1  # int32 wrap == uint32 wrap
+    return colmask, side_r, mix.p8, mix.salt0, salt1r
+
+
+class HistRound:
+    """A round whose update consumes only the value histogram.  Implemented
+    by algorithms on the fused path; `update_counts` is batched over [S, n]
+    (no vmap — plain array code)."""
+
+    num_values: int
+
+    def payload(self, state) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def update_counts(self, state, counts, size, r, n):
+        """counts [S, V, n] int32, size [S, n] int32 → (state, exit [S, n])."""
+        raise NotImplementedError
+
+
+class OtrHist(HistRound):
+    """OTR's round on the fused path — same math as models.otr.OtrRound
+    with the n_values histogram (decision parity is test-pinned)."""
+
+    def __init__(self, n_values: int, after_decision: int = 2):
+        self.num_values = n_values
+        self.after_decision = after_decision
+
+    def payload(self, state):
+        return state.x
+
+    def update_counts(self, state, counts, size, r, n):
+        quorum = size > (2 * n) // 3
+        v = jnp.argmax(counts, axis=1).astype(state.x.dtype)  # [S, n]
+        v_count = jnp.max(counts, axis=1)
+        super_quorum = quorum & (v_count > (2 * n) // 3)
+        state = ghost_decide(state, super_quorum, v)
+        after = jnp.where(state.decided, state.after - 1, state.after)
+        exit_ = state.decided & (after <= 0)
+        state = state.replace(
+            x=jnp.where(quorum, v, state.x), after=after
+        )
+        return state, exit_
+
+
+def run_hist(
+    rnd: HistRound,
+    state0,
+    decided_fn: Callable,
+    mix: FaultMix,
+    max_rounds: int,
+    mode: str = "hw",
+    tile: int = 128,
+    interpret: bool = False,
+):
+    """Scan `max_rounds` fused rounds over the full scenario batch.
+
+    state0 leaves are [S, n, ...].  Returns (state, done [S, n],
+    decided_round [S, n]).  Semantics mirror executor.run_phases: exited
+    lanes stop sending and freeze."""
+    S, n = mix.crashed.shape
+    V = rnd.num_values
+
+    done0 = jnp.zeros((S, n), dtype=bool)
+    decided_round0 = jnp.full((S, n), -1, dtype=jnp.int32)
+
+    def step(carry, r):
+        state, done, decided_round = carry
+        colmask, side_r, p8, salt0, salt1r = round_params(mix, r)
+        counts = fused.hist_exchange(
+            rnd.payload(state),
+            ~done,
+            colmask,
+            jnp.ones((S, n), dtype=jnp.int32),
+            side_r,
+            salt0,
+            salt1r,
+            p8,
+            V,
+            mode=mode,
+            tile=tile,
+            interpret=interpret,
+        ).astype(jnp.int32)
+        size = jnp.sum(counts, axis=1)
+        new_state, exit_ = rnd.update_counts(state, counts, size, r, n)
+        # frozen lanes keep their state; exits only count for active lanes
+        active = ~done
+        state = tree_where(active, new_state, state)
+        done = done | (active & exit_)
+        dec = decided_fn(state)
+        decided_round = jnp.where(dec & (decided_round < 0), r, decided_round)
+        return (state, done, decided_round), None
+
+    (state, done, decided_round), _ = jax.lax.scan(
+        step, (state0, done0, decided_round0),
+        jnp.arange(max_rounds, dtype=jnp.int32),
+    )
+    return state, done, decided_round

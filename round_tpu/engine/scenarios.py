@@ -197,6 +197,46 @@ def byzantine_silence(n: int, f: int) -> Callable:
     return sample
 
 
+def from_fault_params(
+    n: int,
+    crashed,
+    crash_round,
+    side,
+    heal_round,
+    rotate_down,
+    p8,
+    salt0,
+    salt1,
+) -> Callable:
+    """Replay ONE scenario row of an engine.fast.FaultMix in the general
+    engine, bit-exactly matching the fused kernel's hash-mode mask:
+
+        ho[j, i] = (colmask[i] ∧ side_r[j] = side_r[i] ∧ keep(j, i)) ∨ (i = j)
+
+    This is the differential-parity bridge between the two engines."""
+    crashed = jnp.asarray(crashed)
+    side = jnp.asarray(side, dtype=jnp.int32)
+
+    def sample(key, r):  # key unused: the salts carry the randomness
+        r = jnp.asarray(r, dtype=jnp.int32)
+        alive = ~(crashed & (r >= crash_round))
+        period = jnp.maximum(rotate_down, 1)
+        victim = (r // period) % n
+        rotated = (jnp.arange(n) == victim) & (rotate_down > 0)
+        colmask = alive & ~rotated
+        side_r = jnp.where(r < heal_round, side, 0)
+        i = jnp.arange(n, dtype=jnp.uint32)
+        idx = i[:, None] * jnp.uint32(n) + i[None, :]  # [recv j, sender i]
+        z = idx * jnp.uint32(0x9E3779B9) + jnp.asarray(salt0).astype(jnp.uint32)
+        z = z ^ (r * jnp.int32(0x7FEB352D) + jnp.asarray(salt1)).astype(jnp.uint32)
+        keep = (_mix32(z) & jnp.uint32(0xFF)) >= jnp.asarray(p8).astype(jnp.uint32)
+        keep = keep | (jnp.asarray(p8) <= 0)
+        ho = colmask[None, :] & (side_r[:, None] == side_r[None, :]) & keep
+        return _with_self(ho)
+
+    return sample
+
+
 def from_schedule(schedule: jnp.ndarray) -> Callable:
     """Replay an explicit [T, n, n] HO schedule (differential testing against
     hand-computed traces)."""

@@ -12,11 +12,20 @@
 // Features: two-watched-literal propagation, first-UIP clause learning,
 // VSIDS-style activity with decay, Luby restarts, learned-clause reduction.
 //
-// Protocol:
+// Protocol (batch):
 //   stdin:  DIMACS CNF ("p cnf <nvars> <nclauses>", clauses 0-terminated;
 //           lines starting with 'c' ignored)
 //   stdout: "s SATISFIABLE\nv <lit>* 0\n"  or  "s UNSATISFIABLE\n"
 // Exit code: 10 sat, 20 unsat (minisat convention).
+//
+// Protocol (incremental, `rtsat -i`) — the DPLL(T) driver in
+// round_tpu.verify.solver keeps one process per query and feeds theory
+// blocking clauses between solves, so learned clauses/activities persist
+// instead of re-solving the CNF from scratch each round:
+//   "p cnf <n> <m>"  init (once), then <m> clause lines
+//   "s"              solve; replies "r sat\nv <lit>* 0\n" or "r unsat\n"
+//   "a <lit>* 0"     add a clause at level 0
+//   "q"              quit
 
 #include <algorithm>
 #include <cstdio>
@@ -306,9 +315,62 @@ struct Solver {
   }
 };
 
+int run_incremental() {
+  Solver s;
+  bool ok = true;
+  bool inited = false;
+  char* line = nullptr;
+  size_t cap = 0;
+  ssize_t len;
+  std::vector<Lit> cur;
+  while ((len = getline(&line, &cap, stdin)) >= 0) {
+    char* p = line;
+    while (*p == ' ' || *p == '\t') ++p;
+    if (*p == 'q') break;
+    if (*p == 'c' || *p == '\n' || *p == '\0') continue;
+    if (*p == 'p') {
+      while (*p && *p != ' ') ++p;
+      while (*p == ' ') ++p;
+      while (*p && *p != ' ') ++p;  // skip "cnf"
+      long nv = strtol(p, &p, 10);
+      strtol(p, &p, 10);  // clause count: informational
+      s.init((int)nv);
+      inited = true;
+      continue;
+    }
+    if (*p == 's') {
+      if (!inited) return 1;
+      if (ok && s.solve()) {
+        printf("r sat\nv ");
+        for (int v = 1; v <= s.nvars; ++v)
+          printf("%d ", s.assigns[v] >= 0 ? v : -v);
+        printf("0\n");
+      } else {
+        ok = false;  // level-0 conflict: all later solves stay unsat
+        printf("r unsat\n");
+      }
+      fflush(stdout);
+      continue;
+    }
+    if (*p == 'a') ++p;  // "a <lits> 0" — also accept bare clause lines
+    if (!inited) return 1;
+    s.backtrack(0);
+    cur.clear();
+    for (;;) {
+      long l = strtol(p, &p, 10);
+      if (l == 0) break;
+      cur.push_back((Lit)l);
+    }
+    if (!s.add_clause(cur, false)) ok = false;
+  }
+  free(line);
+  return 0;
+}
+
 }  // namespace
 
-int main() {
+int main(int argc, char** argv) {
+  if (argc > 1 && strcmp(argv[1], "-i") == 0) return run_incremental();
   // read all of stdin
   std::vector<char> buf;
   {

@@ -1,0 +1,223 @@
+"""Fused round-exchange kernel: HO-mask generation + value histogram in VMEM.
+
+This is the framework's hot op.  The general engine (engine/executor.py)
+materializes the ``[S, n, n]`` delivery mask in HBM every round; at the
+flagship scale (n=1024, 10k scenarios) that makes the simulation HBM-bound
+(~2 MB of mask traffic per scenario-round).  For the broad class of rounds
+that (a) broadcast a small-domain value and (b) only consume the mailbox
+through its per-value counts — OTR's mmor/quorum (Otr.scala:44-49), FloodMin's
+min (FloodMin.scala:26), BenOr's vote counting (BenOr.scala:60-80) — the whole
+round exchange collapses to
+
+    counts[s, v, j] = #{ i : deliver[s, j, i] and vals[s, i] == v }
+
+and the deliver mask never needs to exist outside VMEM.  This kernel fuses:
+
+  1. per-link randomness: either the TPU hardware PRNG (mode="hw", fastest)
+     or the counter-based hash of engine.scenarios.link_bernoulli
+     (mode="hash", bit-exact with the general engine's omission sampler —
+     used for differential parity tests);
+  2. the structured fault families as O(n) per-scenario inputs: crash sets /
+     coordinator-down (a sender mask), partitions (a side vector compared
+     in-kernel), receiver-side dest masks (unicast rounds);
+  3. self-delivery (Round.scala:114-117: a process always hears itself) and
+     the active-lane mask (exited lanes stop sending);
+  4. the ``[V, n] x [n, TILE]`` bf16 histogram matmul on the MXU with f32
+     accumulation (counts <= n < 2^24: exact).
+
+The [n, TILE] mask tile lives only in VMEM; HBM sees O(S*n) inputs and the
+O(S*V*n) count output per round.
+
+Mask semantics (must match engine.executor.run_round + engine.scenarios):
+
+    ho[j, i]      = (colmask[i] & (side[j] == side[i]) & keep_p(j, i)) | (i == j)
+    deliver[j, i] = ho[j, i] & active[i] & rowmask[j]
+
+where keep_p is Bernoulli(1 - p8/256) per link per round.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_GOLD = 0x9E3779B9
+_RMIX = 0x7FEB352D
+
+
+def _fmix32(z):
+    """murmur3 finalizer — must stay in lockstep with scenarios._mix32."""
+    z = z ^ (z >> 16)
+    z = z * jnp.uint32(0x85EBCA6B)
+    z = z ^ (z >> 13)
+    z = z * jnp.uint32(0xC2B2AE35)
+    z = z ^ (z >> 16)
+    return z
+
+
+def _kernel(
+    vals_ref,       # (1, 1, n) int32   sender values in [0, V)
+    active_ref,     # (1, 1, n) int32   1 = lane still running (sender side)
+    colmask_ref,    # (1, 1, n) int32   1 = sender not crashed/suppressed
+    rowmask_ref,    # (1, 1, TILE) int32  1 = receiver selected by dest mask
+    side_s_ref,     # (1, 1, n) int32   partition side per sender
+    side_r_ref,     # (1, 1, TILE) int32  partition side per receiver (same array)
+    salt0_ref,      # (S,) int32 [SMEM]  per-scenario salt / seed
+    salt1_ref,      # (S,) int32 [SMEM]  per-(scenario, round) premixed salt
+    p8_ref,         # (S,) int32 [SMEM]  drop threshold in [0, 256]
+    out_ref,        # (1, V, TILE) f32     counts
+    *,
+    num_values: int,
+    tile: int,
+    mode: str,
+):
+    n = vals_ref.shape[2]
+    s = pl.program_id(0)
+    t = pl.program_id(1)
+
+    sender = jax.lax.broadcasted_iota(jnp.int32, (n, tile), 0)
+    recv = jax.lax.broadcasted_iota(jnp.int32, (n, tile), 1) + t * tile
+
+    p8 = p8_ref[s]
+
+    def keep_links():
+        if mode == "hash":
+            # bit-exact replica of scenarios.link_bernoulli: idx = j * n + i
+            idx = (recv * n + sender).astype(jnp.uint32)
+            z = idx * jnp.uint32(_GOLD) + salt0_ref[s].astype(jnp.uint32)
+            z = z ^ salt1_ref[s].astype(jnp.uint32)
+            z = _fmix32(z)
+            return (z & jnp.uint32(0xFF)) >= p8.astype(jnp.uint32)
+        # hw: TPU hardware PRNG; stream keyed by (scenario-round seed, tile)
+        pltpu.prng_seed(salt1_ref[s] ^ (t * jnp.int32(_GOLD - (1 << 32))))
+        bits = pltpu.prng_random_bits((n, tile))
+        return (bits & jnp.uint32(0xFF)) >= p8.astype(jnp.uint32)
+
+    # no lax.cond here: yielding vector masks from scf branches crashes the
+    # Mosaic lowering; p8 == 0 scenarios just keep every link instead
+    keep = keep_links() | (p8 <= 0)
+
+    side_eq = side_s_ref[0, 0][:, None] == side_r_ref[0, 0][None, :]
+    ho = (colmask_ref[0, 0][:, None] != 0) & side_eq & keep
+    ho = ho | (sender == recv)
+    deliver = ho & (active_ref[0, 0][:, None] != 0) & (rowmask_ref[0, 0][None, :] != 0)
+
+    vrange = jax.lax.broadcasted_iota(jnp.int32, (num_values, n), 0)
+    onehot_t = (vals_ref[0, 0][None, :] == vrange).astype(jnp.bfloat16)
+
+    out_ref[0] = jnp.dot(
+        onehot_t,
+        deliver.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_values", "mode", "tile", "interpret"),
+)
+def hist_exchange(
+    vals: jnp.ndarray,      # [S, n] int32
+    active: jnp.ndarray,    # [S, n] bool/int32
+    colmask: jnp.ndarray,   # [S, n] bool/int32
+    rowmask: jnp.ndarray,   # [S, n] bool/int32
+    side: jnp.ndarray,      # [S, n] int32
+    salt0: jnp.ndarray,     # [S] int32
+    salt1r: jnp.ndarray,    # [S] int32 (round premixed: see fault_salts)
+    p8: jnp.ndarray,        # [S] int32
+    num_values: int,
+    mode: str = "hw",
+    tile: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused masked exchange + per-value histogram.
+
+    Returns counts [S, num_values, n] float32 (exact integers):
+    counts[s, v, j] = number of senders i with deliver[s, j, i] and
+    vals[s, i] == v.  See module docstring for the deliver semantics.
+    """
+    S, n = vals.shape
+    if n < tile:
+        tile = n  # small groups: one receiver tile (block == array dim)
+    assert n % tile == 0, (n, tile)
+    # the count plane is the (sublane, lane) tile of the output: pad V up to
+    # the f32 sublane quantum; padded values match no payload (counts 0)
+    v_out = num_values
+    if num_values % 8 and not interpret:
+        num_values = num_values + (8 - num_values % 8)
+    to_i32 = lambda x: x.astype(jnp.int32).reshape(S, 1, n)
+    to_smem = lambda x: x.astype(jnp.int32).reshape(S)
+
+    grid = (S, n // tile)
+    row_spec = pl.BlockSpec((1, 1, n), lambda s, t: (s, 0, 0))
+    tile_spec = pl.BlockSpec((1, 1, tile), lambda s, t: (s, 0, t))
+    smem_spec = pl.BlockSpec((S,), lambda s, t: (0,), memory_space=pltpu.SMEM)
+
+    kernel = functools.partial(
+        _kernel, num_values=num_values, tile=tile, mode=mode
+    )
+    counts = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            row_spec,   # vals
+            row_spec,   # active
+            row_spec,   # colmask
+            tile_spec,  # rowmask
+            row_spec,   # side (sender view)
+            tile_spec,  # side (receiver view)
+            smem_spec,  # salt0
+            smem_spec,  # salt1r
+            smem_spec,  # p8
+        ],
+        out_specs=pl.BlockSpec((1, num_values, tile), lambda s, t: (s, 0, t)),
+        out_shape=jax.ShapeDtypeStruct((S, num_values, n), jnp.float32),
+        interpret=interpret,
+    )(
+        to_i32(vals),
+        to_i32(active),
+        to_i32(colmask),
+        to_i32(rowmask),
+        to_i32(side),
+        to_i32(side),  # same array, receiver-tile view (tile_spec)
+        to_smem(salt0),
+        to_smem(salt1r),
+        to_smem(p8),
+    )
+    return counts[:, :v_out, :]
+
+
+def hist_exchange_reference(
+    vals, active, colmask, rowmask, side, salt0, salt1r, p8, num_values
+) -> jnp.ndarray:
+    """Pure-XLA oracle of hist_exchange in "hash" mode (same bits), used by
+    the differential tests and as the CPU fallback."""
+    S, n = vals.shape
+
+    def one(v, act, cm, rm, sd, s0, s1, p):
+        i = jnp.arange(n, dtype=jnp.uint32)
+        idx = i[:, None] * jnp.uint32(n) + i[None, :]  # [recv j, sender i]
+        z = idx * jnp.uint32(_GOLD) + s0.astype(jnp.uint32)
+        z = z ^ s1.astype(jnp.uint32)
+        from round_tpu.engine.scenarios import _mix32
+
+        keep = (_mix32(z) & jnp.uint32(0xFF)) >= p.astype(jnp.uint32)
+        keep = keep | (p <= 0)
+        side_eq = sd[None, :] == sd[:, None]  # [j, i]
+        ho = (cm != 0)[None, :] & side_eq & keep
+        ho = ho | jnp.eye(n, dtype=bool)
+        deliver = ho & (act != 0)[None, :] & (rm != 0)[:, None]
+        onehot = v[:, None] == jnp.arange(num_values, dtype=v.dtype)[None, :]
+        counts = jnp.dot(
+            deliver.astype(jnp.float32), onehot.astype(jnp.float32)
+        )  # [j, V]
+        return counts.T  # [V, j]
+
+    return jax.vmap(one)(
+        vals, active, colmask, rowmask, side, salt0, salt1r, p8
+    )

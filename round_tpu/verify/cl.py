@@ -29,7 +29,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from round_tpu.verify import quantifiers, venn
 from round_tpu.verify.formula import (
-    And, Application, Binding, Bool, BoolT, CARD, COMPREHENSION, EMPTYSET,
+    AND, And, Application, Binding, Bool, BoolT, CARD, COMPREHENSION, EMPTYSET,
     EQ, EXISTS, FORALL, FNONE_SYM, FOption, FSOME, FSet, FMap, Formula,
     FunT, GET, Geq, GEQ, GT, Gt, IMPLIES, IN, INTERSECTION, IS_DEFINED,
     IS_DEFINED_AT, Int, IntLit, IntT, ITE, Implies, KEYSET, LEQ, LOOKUP, LT,
@@ -294,6 +294,51 @@ def reduce_ordered(f: Formula) -> Formula:
     return out
 
 
+def _eliminate_int_div(f: Formula) -> Tuple[Formula, List[Formula]]:
+    """Linearize integer division by a positive constant:  num // k  becomes
+    a fresh q with  k·q ≤ num ≤ k·q + (k-1).  Only terms whose variables are
+    all free in `f` are rewritten (a Divides under a binder over its own
+    variables stays put, and later fails as a foreign term — sound).
+
+    The jaxpr extractor produces these from ``(2 * n) // 3``-style quorum
+    arithmetic in executable round code (extract.py)."""
+    from round_tpu.verify.formula import DIVIDES
+
+    axioms: List[Formula] = []
+    cache: Dict[str, Variable] = {}
+
+    def walk(g: Formula, bound: frozenset) -> Formula:
+        if isinstance(g, Binding):
+            inner_bound = bound | {v.name for v in g.vars}
+            out = Binding(g.binder, g.vars, walk(g.body, inner_bound))
+            out.tpe = g.tpe
+            return out
+        if isinstance(g, Application):
+            args = [walk(a, bound) for a in g.args]
+            out = Application(g.fct, args)
+            out.tpe = g.tpe
+            if (
+                g.fct == DIVIDES
+                and isinstance(args[1], Literal)
+                and isinstance(args[1].value, int)
+                and args[1].value > 0
+                and not ({v.name for v in free_vars(args[0])} & bound)
+            ):
+                k = args[1].value
+                key = repr(out)
+                if key not in cache:
+                    q = Variable(f"divq!{next(_fresh)}", Int)
+                    cache[key] = q
+                    num = args[0]
+                    axioms.append(Leq(Times(k, q), num))
+                    axioms.append(Leq(num, Plus(Times(k, q), IntLit(k - 1))))
+                return cache[key]
+            return out
+        return g
+
+    return walk(f, frozenset()), axioms
+
+
 # ---------------------------------------------------------------------------
 # The reducer
 # ---------------------------------------------------------------------------
@@ -312,6 +357,9 @@ class ClReducer:
         f = rewrite_options(f)
         f = rewrite_set_algebra(f)
         f = reduce_ordered(f)
+        f, div_axioms = _eliminate_int_div(f)
+        if div_axioms:
+            f = And(f, *div_axioms)
         f = typecheck(f)
         f = nnf(f)
         f, _consts = quantifiers.get_existential_prefix(f)
@@ -320,6 +368,9 @@ class ClReducer:
         f = typecheck(f)
 
         ground, universals = quantifiers._clause_split(f)
+        # the process universe is nonempty (|ProcessID| = n ≥ 1,
+        # CL.sizeOfUniverse semantics): majority sets must have witnesses
+        ground.append(Geq(venn.N_VAR, 1))
         for sd in setdefs:
             if sd.definition is not None:
                 d = typecheck(sd.definition)
@@ -339,9 +390,18 @@ class ClReducer:
         base = ground + insts
 
         # venn regions over everything ground so far (persistent instances:
-        # the witness-round rewrite below must share card/region variables)
+        # the witness-round rewrite below must share card/region variables).
+        # Groups are restricted to card-relevant sets; membership facts about
+        # other sets flow through instantiation alone.  venn_bound=0 turns
+        # the ILP off entirely (EUF/LIA-only effort rung — sound, weaker).
         elements = quantifiers.ground_terms_by_type(base)
-        regions = venn.build_regions(base, elements, bound=cfg.venn_bound)
+        if cfg.venn_bound >= 1:
+            carded = venn.carded_supports(base)
+            regions = venn.build_regions(
+                base, elements, bound=cfg.venn_bound, only=carded
+            )
+        else:
+            regions = {}
         all_witnesses: List[Formula] = []
         for vr in regions.values():
             all_witnesses.extend(vr.witnesses)
@@ -351,7 +411,7 @@ class ClReducer:
             Application(EQ, [w, w]).with_type(Bool) for w in all_witnesses
         ]
         insts2 = quantifiers.instantiate(
-            universals, wit_ground, depth=1, max_insts=cfg.max_insts
+            universals, wit_ground, depth=cfg.inst_depth, max_insts=cfg.max_insts
         )
         insts2 = [rewrite_set_algebra(i) for i in insts2]
         # round 2 regenerates the round-1 instances (fresh dedup state);
@@ -378,5 +438,88 @@ def reduce(f: Formula, config: ClConfig = ClDefault) -> Formula:
     return ClReducer(config).reduce(f)
 
 
-def entailment(h: Formula, c: Formula, config: ClConfig = ClDefault) -> bool:
-    return ClReducer(config).entailment(h, c)
+def _ladder(config: ClConfig) -> List[ClConfig]:
+    """Effort ladder: EUF/LIA-only (no Venn ILP) first, then the requested
+    config.  Each rung is sound (UNSAT is final); rungs only add reasoning
+    power, so proofs that need no cardinality ILP stay cheap."""
+    rungs = []
+    if config.venn_bound >= 1:
+        rungs.append(dataclasses.replace(config, venn_bound=0))
+    if config.venn_bound > 2:
+        rungs.append(dataclasses.replace(config, venn_bound=2))
+    rungs.append(config)
+    return rungs
+
+
+def _hyp_disjuncts(f: Formula, budget: int = 16) -> List[Formula]:
+    """Bounded DNF expansion of a hypothesis: (A∨B) ∧ K → [A∧K, B∧K].
+    Mirrors the reference's decompose + optional DNF (VC.scala:76-96,
+    logic/TestCommon.scala:42-49) — each branch is a much easier query than
+    the combined disjunction, whose refutation the instantiation must find
+    for all branches at once."""
+    conj = get_conjuncts(f)
+    branches: List[List[Formula]] = [[]]
+    for c in conj:
+        if isinstance(c, Application) and c.fct == OR:
+            opts = c.args
+            if len(branches) * len(opts) > budget:
+                for b in branches:
+                    b.append(c)
+                continue
+            branches = [b + [o] for b in branches for o in opts]
+        else:
+            for b in branches:
+                b.append(c)
+    return [And(*b) if len(b) != 1 else b[0] for b in branches]
+
+
+def _concl_conjuncts(f: Formula, budget: int = 32) -> List[Formula]:
+    """Split a conclusion into independently-provable conjuncts, pushing the
+    split under universal quantifiers: ∀x (A∧B) → [∀x A, ∀x B]."""
+    out: List[Formula] = []
+
+    def go(g: Formula, binders: List):
+        if len(out) > budget:
+            return
+        if isinstance(g, Application) and g.fct == AND:
+            for a in g.args:
+                go(a, binders)
+        elif isinstance(g, Binding) and g.binder == FORALL:
+            go(g.body, binders + [g.vars])
+        else:
+            for vs in reversed(binders):
+                g = Binding(FORALL, vs, g).with_type(Bool)
+            out.append(g)
+
+    go(f, [])
+    return out if len(out) <= budget else [f]
+
+
+def entailment(
+    h: Formula,
+    c: Formula,
+    config: ClConfig = ClDefault,
+    timeout_s: Optional[float] = None,
+    decompose: bool = True,
+) -> bool:
+    """h ⊨ c via decomposition + the effort ladder.  `timeout_s` bounds each
+    rung's ground solve; only UNSAT verdicts (for every sub-VC) prove the
+    entailment."""
+    if not decompose:
+        return _entailment_core(h, c, config, timeout_s)
+    for hd in _hyp_disjuncts(h):
+        for cc in _concl_conjuncts(c):
+            if not _entailment_core(hd, cc, config, timeout_s):
+                return False
+    return True
+
+
+def _entailment_core(
+    h: Formula, c: Formula, config: ClConfig, timeout_s: Optional[float]
+) -> bool:
+    f = And(h, Not(c))
+    for cfg in _ladder(config):
+        red = ClReducer(cfg)
+        if solve_ground(red.reduce(f), timeout_s=timeout_s) == UNSAT:
+            return True
+    return False

@@ -36,8 +36,9 @@ from jax.extend import core as jax_core
 
 from round_tpu.verify.formula import (
     And, Application, Bool, BoolT, Card, Comprehension, Eq, Exists, ForAll,
-    Formula, Geq, Gt, Implies, IntLit, IntT, Ite, Leq, Literal, Lt, Neq, Not,
-    Or, Plus, Times, Minus, Type, Variable, procType,
+    Formula, FunT, Geq, Gt, Implies, IntLit, IntT, Ite, Leq, Literal, Lt,
+    Neq, Not, Or, Plus, Times, Minus, Type, UnInterpretedFct, Variable,
+    procType,
 )
 
 Int = IntT()
@@ -69,8 +70,19 @@ class Vec:
         self.fn = fn
 
 
+class Vec2:
+    """A process×process matrix: (row, col) ↦ Formula — e.g. the sender
+    equality matrix vals[:, None] == vals[None, :] of the executable mmor
+    (ops/mailbox.py).  Rows/cols are both process-indexed."""
+
+    __slots__ = ("fn",)
+
+    def __init__(self, fn: Callable[[Formula, Formula], Formula]):
+        self.fn = fn
+
+
 def _lift(v) -> "Scalar | Vec":
-    if isinstance(v, (Scalar, Vec)):
+    if isinstance(v, (Scalar, Vec, Vec2)):
         return v
     if isinstance(v, (bool, np.bool_)):
         return Scalar(Literal(bool(v)))
@@ -87,22 +99,59 @@ def _binop(mk, a, b):
     a, b = _lift(a), _lift(b)
     if isinstance(a, Scalar) and isinstance(b, Scalar):
         return Scalar(mk(a.f, b.f))
+    if isinstance(a, Vec2) or isinstance(b, Vec2):
+        fa = _as2(a)
+        fb = _as2(b)
+        return Vec2(lambda r, c: mk(fa(r, c), fb(r, c)))
     fa = (lambda i: a.f) if isinstance(a, Scalar) else a.fn
     fb = (lambda i: b.f) if isinstance(b, Scalar) else b.fn
     return Vec(lambda i: mk(fa(i), fb(i)))
+
+
+def _orient2(v, s_in):
+    """View an operand of a rank-2 result as a Vec2 using its own shape:
+    (n,1)/(n,) → rows, (1,n) → cols, (n,n) → as-is, scalar → const."""
+    v = _lift(v) if not isinstance(v, (Scalar, Vec, Vec2)) else v
+    if isinstance(v, Vec):
+        if len(s_in) == 2 and s_in[0] == 1:
+            return Vec2(lambda r, c: v.fn(c))
+        return Vec2(lambda r, c: v.fn(r))
+    if isinstance(v, Scalar):
+        return Vec2(lambda r, c: v.f)
+    return v
+
+
+def _as2(v):
+    """View any abstract value as a (row, col) function.  A bare Vec at a
+    2-D site can only come from a (n,1)/(1,n)-shaped value whose broadcast
+    was elided; orientation then defaults to rows (columns are produced by
+    explicit broadcast_in_dim, which yields Vec2 directly)."""
+    if isinstance(v, Scalar):
+        return lambda r, c: v.f
+    if isinstance(v, Vec):
+        return lambda r, c: v.fn(r)
+    return v.fn
 
 
 def _unop(mk, a):
     a = _lift(a)
     if isinstance(a, Scalar):
         return Scalar(mk(a.f))
+    if isinstance(a, Vec2):
+        return Vec2(lambda r, c: mk(a.fn(r, c)))
     return Vec(lambda i: mk(a.fn(i)))
+
+
+def _idiv(x, y):
+    from round_tpu.verify.formula import DIVIDES
+    return Application(DIVIDES, [x, y]).with_type(Int)
 
 
 _BINOPS = {
     "add": lambda x, y: Plus(x, y),
     "sub": lambda x, y: Minus(x, y),
     "mul": lambda x, y: Times(x, y),
+    "div": _idiv,  # integer floor-div; cl._eliminate_int_div linearizes it
     "max": None,  # handled in interpreter (Ite form)
     "min": None,
     "lt": lambda x, y: Lt(x, y),
@@ -118,10 +167,25 @@ _BINOPS = {
 
 
 class _Interpreter:
-    def __init__(self, senders_domain: Callable[[Formula], Formula]):
+    def __init__(
+        self,
+        senders_domain: Callable[[Formula], Formula],
+        receiver: Optional[Formula] = None,
+    ):
         """senders_domain(i): the guard restricting mailbox reductions —
-        i ∈ HO(j) ∧ dest(i, j) (the mailboxLink semantics)."""
+        i ∈ HO(j) ∧ dest(i, j) (the mailboxLink semantics).  Pass
+        ``lambda i: Literal(True)`` when the executable code applies its
+        mask explicitly (the Mailbox-method style), so raw vector reductions
+        range over the whole process domain.
+
+        `receiver` is the receiver variable j; axiomatized site functions
+        created for max/min/argmax reductions are parameterized on it and
+        their defining axioms accumulate in ``self.axioms``
+        (the AuxiliaryMethod mechanism, AuxiliaryMethod.scala:9-67)."""
         self.senders = senders_domain
+        self.receiver = receiver if receiver is not None else \
+            Variable("extj", procType)
+        self.axioms: List[Formula] = []
         self._fresh = itertools.count()
 
     def var(self) -> Variable:
@@ -154,19 +218,79 @@ class _Interpreter:
 
         return [read(v) for v in jaxpr.outvars]
 
+    # -- site functions (axiomatized reduction results) --------------------
+
+    def _site(self, tag: str, tpe: Type) -> Formula:
+        """A fresh uninterpreted per-receiver function for a reduction site:
+        site(j).  Its semantics are pinned by axioms in self.axioms."""
+        k = next(self._fresh)
+        fct = UnInterpretedFct(f"ext!{tag}!{k}", FunT([procType], tpe))
+        return Application(fct, [self.receiver]).with_type(tpe)
+
+    def _extremum(self, body_fn, tpe: Type, is_max: bool,
+                  guard_fn=None) -> Formula:
+        """m = max/min over {i | guard} of body(i):
+           ∀i. guard(i) → body(i) ≤ m        (≥ for min)
+           ∃i. guard(i) ∧ m = body(i)        (attainment; sound because the
+                                              executable reduces a nonempty
+                                              axis)."""
+        m = self._site("max" if is_max else "min", tpe)
+        i = self.var()
+        guard = guard_fn(i) if guard_fn is not None else Literal(True)
+        bound = Leq(body_fn(i), m) if is_max else Geq(body_fn(i), m)
+        self.axioms.append(ForAll([i], Implies(guard, bound)))
+        i2 = self.var()
+        self.axioms.append(
+            Exists([i2], And(guard_fn(i2) if guard_fn is not None
+                             else Literal(True), Eq(m, body_fn(i2))))
+        )
+        return m
+
+    def _arg_extremum(self, body_fn, is_max: bool) -> Formula:
+        """a = argmax/argmin over the process axis of body:
+           ∀i. body(i) ≤ body(a)   (≥ for min).
+        The tie-break (first index) is abstracted away — an
+        over-approximation of the executable, sound for safety VCs."""
+        a = self._site("argmax" if is_max else "argmin", procType)
+        i = self.var()
+        bound = (Leq(body_fn(i), body_fn(a)) if is_max
+                 else Geq(body_fn(i), body_fn(a)))
+        self.axioms.append(ForAll([i], bound))
+        return a
+
+    # -- primitive dispatch ------------------------------------------------
+
     def eval_prim(self, prim: str, eqn, ins):
+        def in_shape(k):
+            return tuple(getattr(eqn.invars[k].aval, "shape", ()))
+
+        def out_shape():
+            return tuple(getattr(eqn.outvars[0].aval, "shape", ()))
+
         if prim in ("convert_element_type", "copy", "stop_gradient",
-                    "squeeze", "reshape", "broadcast_in_dim"):
-            # shape/dtype adapters: pass through (bool→int32 before a
-            # reduce_sum is recognized at the reduction)
-            return _lift(ins[0]) if not isinstance(ins[0], (Scalar, Vec)) \
-                else ins[0]
+                    "squeeze", "reshape"):
+            # dtype adapters + rank-preserving reshapes (n,)↔(n,1)↔(1,n):
+            # orientation is recovered from shapes at the consuming op
+            return _lift(ins[0]) if not isinstance(
+                ins[0], (Scalar, Vec, Vec2)) else ins[0]
+        if prim == "broadcast_in_dim":
+            return self._broadcast(ins[0], in_shape(0), out_shape(),
+                                   eqn.params.get("broadcast_dimensions", ()))
         if prim in _BINOPS and _BINOPS[prim] is not None:
+            if len(out_shape()) == 2:
+                # rank-promoting binop (e.g. eq of (1,n) with (n,1)):
+                # orient each operand from its own shape
+                a2 = _orient2(ins[0], in_shape(0))
+                b2 = _orient2(ins[1], in_shape(1))
+                return _binop(_BINOPS[prim], a2, b2)
             return _binop(_BINOPS[prim], ins[0], ins[1])
         if prim in ("max", "min"):
             def mk(x, y, is_max=(prim == "max")):
                 c = Gt(x, y)
                 return Ite(c, x, y) if is_max else Ite(c, y, x)
+            if len(out_shape()) == 2:
+                return _binop(mk, _orient2(ins[0], in_shape(0)),
+                              _orient2(ins[1], in_shape(1)))
             return _binop(mk, ins[0], ins[1])
         if prim == "neg":
             from round_tpu.verify.formula import UMINUS
@@ -180,15 +304,28 @@ class _Interpreter:
                 raise ExtractionError("select_n with more than 2 cases")
             # select_n(pred, on_false, on_true)
             return _binop_3(which, cases[0], cases[1])
-        if prim == "reduce_sum":
-            return self._reduce(ins[0], kind="sum")
-        if prim == "reduce_or":
-            return self._reduce(ins[0], kind="or")
-        if prim == "reduce_and":
-            return self._reduce(ins[0], kind="and")
+        if prim in ("reduce_sum", "reduce_or", "reduce_and",
+                    "reduce_max", "reduce_min"):
+            return self._reduce(ins[0], prim[len("reduce_"):],
+                                eqn.params.get("axes", (0,)), in_shape(0))
+        if prim in ("argmax", "argmin"):
+            op = ins[0]
+            if not isinstance(op, Vec):
+                raise ExtractionError(f"{prim} over a non-vector value")
+            return Scalar(self._arg_extremum(op.fn, prim == "argmax"))
+        if prim == "dot_general":
+            return self._dot(ins[0], ins[1], in_shape(0), in_shape(1),
+                             eqn.params["dimension_numbers"])
+        if prim == "gather":
+            return self._gather(ins[0], ins[1], in_shape(0), out_shape())
         if prim == "iota":
             return Vec(lambda i: i)
         if prim in ("pjit", "jit", "closed_call", "custom_jvp_call"):
+            if eqn.params.get("name") == "floor_divide":
+                # jnp's int // expands into div + sign-correction ops;
+                # DIVIDES with the k·q ≤ num ≤ k·q + k - 1 axioms
+                # (cl._eliminate_int_div) IS floor semantics — emit directly
+                return _binop(_idiv, ins[0], ins[1])
             inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
             outs = _Interpreter.run(self, inner.jaxpr, inner.consts, ins)
             return outs[0] if len(outs) == 1 else outs
@@ -197,7 +334,127 @@ class _Interpreter:
             f"an axiomatized auxiliary function (RoundTR.aux) instead"
         )
 
-    def _reduce(self, operand, kind: str):
+    def _broadcast(self, v, s_in, s_out, bdims):
+        v = _lift(v) if not isinstance(v, (Scalar, Vec, Vec2)) else v
+        if len(s_out) <= 1 or (len(s_out) == 2 and 1 in s_out):
+            return v  # vector-ish broadcast: same abstract value
+        if len(s_out) == 2:
+            if isinstance(v, Scalar):
+                return Vec2(lambda r, c: v.f)
+            if isinstance(v, Vec):
+                # which output dim carries the original axis?
+                if s_in == () or len(s_in) == 0:
+                    return Vec2(lambda r, c: v.fn(r))  # unreachable
+                if len(s_in) == 1:
+                    dim = bdims[0] if bdims else 0
+                elif len(s_in) == 2:
+                    dim = 0 if s_in[0] != 1 else 1
+                else:
+                    raise ExtractionError("broadcast rank > 2")
+                if dim == 0:
+                    return Vec2(lambda r, c: v.fn(r))
+                return Vec2(lambda r, c: v.fn(c))
+            return v
+        raise ExtractionError(f"broadcast to rank-{len(s_out)} shape {s_out}")
+
+    def _dot(self, a, b, sa, sb, dnums):
+        """Indicator contraction: dot(a, b) over 0/1 operands is a count.
+        mmor's counts = mask[n] @ eq[n, n] (ops/mailbox.py) → per-slot
+        cardinalities |{i | mask(i) ∧ eq(i, c)}|."""
+        ((lc, rc), (lb, rb)) = dnums
+        if lb or rb:
+            raise ExtractionError("batched dot_general")
+        if len(lc) != 1 or len(rc) != 1:
+            raise ExtractionError("multi-axis contraction")
+        a = _lift(a) if not isinstance(a, (Scalar, Vec, Vec2)) else a
+        b = _lift(b) if not isinstance(b, (Scalar, Vec, Vec2)) else b
+
+        def body(av, bv, i, rem):
+            fa = av.fn(i) if isinstance(av, Vec) else av.fn(
+                *( (i, rem) if lc == (0,) else (rem, i) ))
+            fb = bv.fn(i) if isinstance(bv, Vec) else bv.fn(
+                *( (i, rem) if rc == (0,) else (rem, i) ))
+            if not (_is_boolish(fa) and _is_boolish(fb)):
+                raise ExtractionError(
+                    "dot_general over non-indicator values — use an "
+                    "axiomatized auxiliary (RoundTR.aux)"
+                )
+            return And(fa, fb)
+
+        if isinstance(a, Vec) and isinstance(b, Vec2):
+            return Vec(lambda rem: Card(Comprehension(
+                [iv := self.var()], body(a, b, iv, rem))))
+        if isinstance(a, Vec2) and isinstance(b, Vec):
+            return Vec(lambda rem: Card(Comprehension(
+                [iv := self.var()], body(b, a, iv, rem))))
+        if isinstance(a, Vec) and isinstance(b, Vec):
+            i = self.var()
+            return Scalar(Card(Comprehension([i], body(a, b, i, None))))
+        raise ExtractionError("dot_general over unsupported operand kinds")
+
+    def _gather(self, operand, idx, s_op, s_out):
+        operand = _lift(operand) if not isinstance(
+            operand, (Scalar, Vec, Vec2)) else operand
+        idx = _lift(idx) if not isinstance(idx, (Scalar, Vec, Vec2)) else idx
+        if isinstance(operand, Vec) and isinstance(idx, Scalar) \
+                and len(s_out) <= 1:
+            # v[i] with a traced process index (e.g. payload of argmax sender)
+            return Scalar(operand.fn(idx.f))
+        raise ExtractionError("unsupported gather pattern")
+
+    def _reduce(self, operand, kind: str, axes, s_in):
+        if isinstance(operand, Vec2) and len(axes) == 1:
+            # partial reduction: the remaining process axis stays a Vec
+            red_axis = axes[0]
+
+            def partial(rem):
+                i = self.var()
+                body = operand.fn(i, rem) if red_axis == 0 \
+                    else operand.fn(rem, i)
+                return i, body
+
+            if kind == "sum":
+                def mk(rem):
+                    i, body = partial(rem)
+                    if not _is_boolish(body):
+                        raise ExtractionError("sum over non-indicator values")
+                    return Card(Comprehension([i], body))
+                return Vec(mk)
+            if kind in ("max", "min"):
+                # one site per remaining index is not expressible; reduce to
+                # a two-arg site fn applied at rem
+                k = next(self._fresh)
+                fct = UnInterpretedFct(
+                    f"ext!{kind}2!{k}", FunT([procType, procType], Int))
+
+                def at(rem):
+                    return Application(fct, [self.receiver, rem]).with_type(Int)
+
+                rem0 = self.var()
+                i0 = self.var()
+                body0 = operand.fn(i0, rem0) if red_axis == 0 \
+                    else operand.fn(rem0, i0)
+                bound = Leq(body0, at(rem0)) if kind == "max" \
+                    else Geq(body0, at(rem0))
+                self.axioms.append(ForAll([rem0, i0], bound))
+                i1 = self.var()
+                rem1 = self.var()
+                body1 = operand.fn(i1, rem1) if red_axis == 0 \
+                    else operand.fn(rem1, i1)
+                self.axioms.append(
+                    ForAll([rem1], Exists([i1], Eq(at(rem1), body1)))
+                )
+                return Vec(at)
+            if kind == "or":
+                def mk_or(rem):
+                    i, body = partial(rem)
+                    return Exists([i], body)
+                return Vec(mk_or)
+            if kind == "and":
+                def mk_and(rem):
+                    i, body = partial(rem)
+                    return ForAll([i], body)
+                return Vec(mk_and)
         if not isinstance(operand, Vec):
             raise ExtractionError(f"reduce_{kind} over a non-mailbox value")
         i = self.var()
@@ -214,7 +471,20 @@ class _Interpreter:
             return Scalar(Card(Comprehension([i], And(guard, body))))
         if kind == "or":
             return Scalar(Exists([i], And(guard, body)))
-        return Scalar(ForAll([i], Implies(guard, body)))
+        if kind == "and":
+            return Scalar(ForAll([i], Implies(guard, body)))
+        # max / min over the full axis
+        tpe = body.tpe if body.tpe is not None else Int
+        return Scalar(self._extremum(
+            operand.fn, tpe if isinstance(tpe, Type) else Int,
+            is_max=(kind == "max"),
+            guard_fn=None if _is_true(self.senders) else self.senders,
+        ))
+
+
+def _is_true(guard_fn) -> bool:
+    probe = guard_fn(Variable("probe", procType))
+    return isinstance(probe, Literal) and probe.value is True
 
 
 _BOOL_FCTS = None
@@ -244,6 +514,9 @@ def _binop_3(which, on_false, on_true):
     parts = [which, a, b]
     if all(isinstance(p, Scalar) for p in parts):
         return Scalar(Ite(which.f, on_true.f, on_false.f))
+    if any(isinstance(p, Vec2) for p in parts):
+        fns = [_as2(p) for p in parts]
+        return Vec2(lambda r, c: Ite(fns[0](r, c), fns[2](r, c), fns[1](r, c)))
     fns = [(lambda i, p=p: p.f) if isinstance(p, Scalar) else p.fn
            for p in parts]
     return Vec(lambda i: Ite(fns[0](i), fns[2](i), fns[1](i)))
@@ -258,18 +531,25 @@ def extract_lane_fn(
     example_args: Sequence[Any],
     formula_args: Sequence["Scalar | Vec"],
     senders_domain: Callable[[Formula], Formula],
-) -> List["Scalar | Vec"]:
+    receiver: Optional[Formula] = None,
+    return_axioms: bool = False,
+):
     """Trace `fn` (a pure per-lane function) with `example_args` (arrays /
     ShapeDtypeStructs fixing shapes) and abstractly interpret its jaxpr over
-    `formula_args`.  Returns the outputs as Scalars/Vecs.
+    `formula_args`.  Returns the outputs as Scalars/Vecs (and, with
+    return_axioms, the site axioms pinning max/min/argmax reduction results
+    — quantify them over `receiver` when conjoining into the TR).
 
     This is processSendUpdate (TrExtractor.scala:101-160) with jaxprs
     instead of Scala trees: same inputs (the executable round code), same
     output (formulas for the transition relation)."""
     closed = jax.make_jaxpr(fn)(*example_args)
-    interp = _Interpreter(senders_domain)
+    interp = _Interpreter(senders_domain, receiver=receiver)
     flat_args, _ = jax.tree_util.tree_flatten(list(formula_args))
-    return interp.run(closed.jaxpr, closed.consts, flat_args)
+    outs = interp.run(closed.jaxpr, closed.consts, flat_args)
+    if return_axioms:
+        return outs, interp.axioms
+    return outs
 
 
 def extract_update_equations(

@@ -17,7 +17,7 @@ from round_tpu.verify.cl import ClConfig
 from round_tpu.verify.formula import (
     And, Application, Binding, Bool, Card, Comprehension, Eq, Exists, FORALL,
     ForAll, FSet, Formula, FunT, Geq, Gt, Implies, In, Int, IntLit, Leq,
-    Literal, Not, Or, Plus, Times, UnInterpretedFct, Variable, procType,
+    Literal, Lt, Not, Or, Plus, Times, UnInterpretedFct, Variable, procType,
 )
 from round_tpu.verify.tr import HO_FN, Mailbox, RoundTR, StateSig, ho_of
 from round_tpu.verify.venn import N_VAR as N
@@ -223,4 +223,503 @@ def otr_spec() -> ProtocolSpec:
         properties=[("agreement", agreement)],
         safety_predicate=safety,
         config=ClConfig(venn_bound=3, inst_depth=1),
+    )
+
+
+def otr_extracted_tr():
+    """OTR's transition relation extracted from the *executable* round code
+    (the Mailbox mmor path of models/otr.py) via the jaxpr abstract
+    interpreter — the macro-boundary capability (reference:
+    macros/TrExtractor.scala:101-160 extracts the TR from the same Scala
+    source the runtime executes; here the same JAX source the engine runs).
+
+    Returns (sig, j, update_equations, site_axioms, payload_def, value_bound):
+    conjoin ForAll([j], update_equations) ∧ site_axioms ∧ payload_def into a
+    TR.  `value_bound` (estimates below the int32 sentinel) reflects the
+    executable's actual domain and is required for the mmor sentinel
+    reasoning."""
+    import jax.numpy as jnp
+
+    from round_tpu.ops.mailbox import Mailbox as RtMailbox
+    from round_tpu.verify.extract import Scalar, Vec, extract_lane_fn
+    from round_tpu.verify.formula import IN, Lt as FLt
+
+    sig = StateSig({"x": Int, "decided": Bool, "dec": Int})
+    j = Variable("j", procType)
+    snd = UnInterpretedFct("sndx", FunT([procType], Int))
+
+    def upd(n, x, decided, dec, vals, mask):
+        # models/otr.py OtrRound.update, generic (histogram-free) path
+        m = RtMailbox(vals, mask)
+        quorum = m.size() > (2 * n) // 3
+        v = m.min_most_often_received()
+        v_count = m.count(lambda vs: vs == v)
+        super_q = quorum & (v_count > (2 * n) // 3)
+        decided2 = decided | super_q
+        dec2 = jnp.where(super_q & ~decided, v, dec)
+        x2 = jnp.where(quorum, v, x)
+        return x2, decided2, dec2
+
+    ne = 5
+    ex_args = [jnp.int32(ne), jnp.int32(0), jnp.bool_(False), jnp.int32(-1),
+               jnp.zeros((ne,), jnp.int32), jnp.zeros((ne,), bool)]
+    fargs = [
+        Scalar(N),
+        Scalar(sig.get("x", j)),
+        Scalar(sig.get("decided", j)),
+        Scalar(sig.get("dec", j)),
+        Vec(lambda i: Application(snd, [i]).with_type(Int)),
+        Vec(lambda i: Application(IN, [i, ho_of(j)]).with_type(Bool)),
+    ]
+    outs, axioms = extract_lane_fn(
+        upd, ex_args, fargs, lambda i: Literal(True), receiver=j,
+        return_axioms=True,
+    )
+    update_eqs = And(*[
+        Eq(sig.get_primed(name, j), out.f)
+        for name, out in zip(["x", "decided", "dec"], outs)
+    ])
+    i0 = Variable("i0", procType)
+    payload_def = ForAll([i0], Eq(Application(snd, [i0]).with_type(Int),
+                                  sig.get("x", i0)))
+    kb = Variable("kb", procType)
+    value_bound = ForAll([kb], FLt(sig.get("x", kb), IntLit(2**31 - 1)))
+    return sig, j, update_eqs, axioms, payload_def, value_bound
+
+
+# ---------------------------------------------------------------------------
+# LastVoting / Paxos-as-HO (example/LastVoting.scala, logic/LvExample.scala)
+# ---------------------------------------------------------------------------
+
+def lv_spec():
+    """LastVoting: the Charron-Bost/Schiper Paxos-as-HO protocol — 4 rounds
+    per phase with a rotating coordinator, timestamps, commit/ready flags
+    (example/LastVoting.scala:83-212).
+
+    The formula model mirrors the hand-translated suite
+    logic/LvExample.scala:77-215 but localizes mailboxes as sender-set
+    comprehensions + payload functions (no FMap theory needed):
+
+      round 1: everyone sends (x, ts) to coord; with a majority mailbox the
+               coordinator votes the max-timestamp value and commits.
+      round 2: a committed coordinator broadcasts vote; receivers adopt it
+               as x and stamp ts := phase.
+      round 3: processes with ts = phase ack to coord; a majority makes the
+               coordinator ready.
+      round 4: a ready coordinator broadcasts vote; receivers decide it.
+               commit/ready reset; the phase number advances.
+
+    The invariant is LvExample's invariant1 (:222-239): either nobody has
+    decided/committed/readied, or a majority set A = {i | ts(i) >= t}
+    anchors a value v carried by every decided/committed/ready process.
+
+    Returns (spec, lv) where `lv` carries the pieces the staged tests use
+    (per-round TRs, the invariant, the phase variable).  Note the reference
+    marks all four inductiveness VCs `ignore` ("those completely blow-up",
+    LvExample.scala:262-264); the staged VCs here are discharged by the
+    native reducer in tests/test_verifier.py.
+    """
+    sig = StateSig({
+        "x": Int,
+        "ts": Int,       # Time erased to Int (ReduceTime.scala:8-46)
+        "ready": Bool,
+        "commit": Bool,
+        "vote": Int,
+        "decided": Bool,
+        "dec": Int,
+    })
+    coord = Variable("coord", procType)
+    r = Variable("phase", Int)   # current phase number (r/4 in the runtime)
+
+    i = Variable("i", procType)
+    j = Variable("j", procType)
+    v = Variable("v", Int)
+    t = Variable("t", Int)
+
+    # ghost: initial values (SpecHelper.init, verification/Utils.scala:24-39)
+    x0 = UnInterpretedFct("x!init", FunT([procType], Int))
+
+    def x0_of(ii):
+        return Application(x0, [ii]).with_type(Int)
+
+    def majority(card_term):
+        return Gt(Times(2, card_term), N)
+
+    # -- round 1: (x, ts) -> coord; coordinator votes max-ts value ---------
+    maxx = UnInterpretedFct("lv!maxx", FunT([procType], Int))
+
+    def maxx_of(jj):
+        return Application(maxx, [jj]).with_type(Int)
+
+    r1 = RoundTR(
+        sig=sig,
+        payload_defs={
+            "x": (Int, lambda ii: sig.get("x", ii)),
+            "ts": (Int, lambda ii: sig.get("ts", ii)),
+        },
+        dest_fn=lambda ii, jj: Eq(jj, coord),
+        update_fn=lambda mb, jj, s: And(
+            Implies(
+                And(Eq(jj, coord), majority(mb.size())),
+                And(
+                    Eq(s.get_primed("vote", jj), maxx_of(jj)),
+                    s.get_primed("commit", jj),
+                ),
+            ),
+            Implies(
+                Not(And(Eq(jj, coord), majority(mb.size()))),
+                And(
+                    Not(s.get_primed("commit", jj)),
+                    Eq(s.get_primed("vote", jj), s.get("vote", jj)),
+                ),
+            ),
+            s.frame_equal(["x", "ts", "ready", "decided", "dec"], jj),
+        ),
+        aux=lambda: [_lv_maxx_axiom(sig, coord, maxx)],
+    )
+
+    # -- round 2: committed coordinator broadcasts vote --------------------
+    def r2_update(mb: Mailbox, jj, s: StateSig):
+        heard = In(coord, mb.senders())
+        return And(
+            Implies(
+                heard,
+                And(
+                    Eq(s.get_primed("x", jj), mb.payload("vote", coord)),
+                    Eq(s.get_primed("ts", jj), r),
+                ),
+            ),
+            Implies(
+                Not(heard),
+                And(
+                    Eq(s.get_primed("x", jj), s.get("x", jj)),
+                    Eq(s.get_primed("ts", jj), s.get("ts", jj)),
+                ),
+            ),
+            s.frame_equal(["ready", "commit", "vote", "decided", "dec"], jj),
+        )
+
+    r2 = RoundTR(
+        sig=sig,
+        payload_defs={"vote": (Int, lambda ii: sig.get("vote", ii))},
+        dest_fn=lambda ii, jj: And(Eq(ii, coord), sig.get("commit", ii)),
+        update_fn=r2_update,
+    )
+
+    # -- round 3: ts = phase acks -> coord; majority makes coord ready ----
+    r3 = RoundTR(
+        sig=sig,
+        payload_defs={"x": (Int, lambda ii: sig.get("x", ii))},
+        dest_fn=lambda ii, jj: And(Eq(jj, coord), Eq(sig.get("ts", ii), r)),
+        update_fn=lambda mb, jj, s: And(
+            Eq(
+                s.get_primed("ready", jj),
+                And(Eq(jj, coord), majority(mb.size())),
+            ),
+            s.frame_equal(["x", "ts", "commit", "vote", "decided", "dec"], jj),
+        ),
+    )
+
+    # -- round 4: ready coordinator broadcasts vote; receivers decide ------
+    def r4_update(mb: Mailbox, jj, s: StateSig):
+        heard = In(coord, mb.senders())
+        return And(
+            Implies(
+                heard,
+                And(
+                    Eq(s.get_primed("x", jj), mb.payload("vote", coord)),
+                    s.get_primed("decided", jj),
+                    Eq(s.get_primed("dec", jj), mb.payload("vote", coord)),
+                ),
+            ),
+            Implies(
+                Not(heard),
+                And(
+                    Eq(s.get_primed("x", jj), s.get("x", jj)),
+                    Eq(s.get_primed("decided", jj), s.get("decided", jj)),
+                    Eq(s.get_primed("dec", jj), s.get("dec", jj)),
+                ),
+            ),
+            # end-of-phase reset (LastVoting.scala:199-200)
+            Not(s.get_primed("ready", jj)),
+            Not(s.get_primed("commit", jj)),
+            s.frame_equal(["ts", "vote"], jj),
+        )
+
+    r4 = RoundTR(
+        sig=sig,
+        payload_defs={"vote": (Int, lambda ii: sig.get("vote", ii))},
+        dest_fn=lambda ii, jj: And(Eq(ii, coord), sig.get("ready", ii)),
+        update_fn=r4_update,
+    )
+
+    # -- invariant (LvExample invariant1, :222-239) ------------------------
+    def a_set(tt):
+        kk = Variable("lva", procType)
+        return Comprehension([kk], Geq(sig.get("ts", kk), tt))
+
+    no_decision = ForAll(
+        [i], And(Not(sig.get("decided", i)), Not(sig.get("ready", i)))
+    )
+
+    def anchored_body(vv, tt, ph=None):
+        """The anchor at explicit witnesses (vv, tt) — the staged VCs use
+        this skolemized form with chosen witnesses per round, which removes
+        the ∃v,t search from every sub-VC (the reference-style ∃ form made
+        the reducer enumerate v,t instantiations over all Int terms).
+        `ph` is the phase term (default: the current phase variable); the
+        round-4 VC passes phase+1 for the post-state."""
+        ph = r if ph is None else ph
+        return And(
+            majority(Card(a_set(tt))),
+            Leq(tt, ph),
+            ForAll(
+                [i],
+                And(
+                    Implies(Geq(sig.get("ts", i), tt), Eq(sig.get("x", i), vv)),
+                    Implies(sig.get("decided", i), Eq(sig.get("dec", i), vv)),
+                    Implies(sig.get("commit", i), Eq(sig.get("vote", i), vv)),
+                    Implies(sig.get("ready", i), Eq(sig.get("vote", i), vv)),
+                    Implies(Eq(sig.get("ts", i), ph), sig.get("commit", coord)),
+                ),
+            ),
+        )
+
+    anchored = Exists([v, t], anchored_body(v, t))
+    keep_init = ForAll([i], Exists([j], Eq(sig.get("x", i), x0_of(j))))
+    # committed votes and decisions also trace back to initial values —
+    # needed to push keepInit through rounds 2/4 (x := vote(coord)) in the
+    # noDecision world, where nothing anchors vote(coord) otherwise
+    vote_init = ForAll(
+        [i],
+        And(
+            Implies(
+                sig.get("commit", i),
+                Exists([j], Eq(sig.get("vote", i), x0_of(j))),
+            ),
+            Implies(
+                sig.get("decided", i),
+                Exists([j], Eq(sig.get("dec", i), x0_of(j))),
+            ),
+        ),
+    )
+    inv1 = And(Or(no_decision, anchored), keep_init, vote_init)
+
+    agreement = ForAll(
+        [i, j],
+        Implies(
+            And(sig.get("decided", i), sig.get("decided", j)),
+            Eq(sig.get("dec", i), sig.get("dec", j)),
+        ),
+    )
+    validity = ForAll(
+        [i],
+        Implies(
+            sig.get("decided", i),
+            Exists([j], Eq(sig.get("dec", i), x0_of(j))),
+        ),
+    )
+
+    init = ForAll(
+        [i],
+        And(
+            Not(sig.get("decided", i)),
+            Not(sig.get("ready", i)),
+            Not(sig.get("commit", i)),
+            Eq(sig.get("x", i), x0_of(i)),
+            Eq(sig.get("ts", i), IntLit(-1)),
+        ),
+    )
+
+    # -- phase-staged invariants (the roundInvariants mechanism,
+    #    LastVoting.scala:49-61 / Verifier round-staging) ------------------
+    #
+    # inv1 alone is NOT inductive round-by-round (the reference marks all
+    # four inductiveness VCs ignore with "those completely blow-up",
+    # LvExample.scala:262-291 — and semantically each round needs the
+    # phase-internal facts below).  F_k holds before round k+1:
+    def stamped(tt=None):
+        kk = Variable("lvs", procType)
+        return Comprehension([kk], Eq(sig.get("ts", kk), r))
+
+    F = {}
+
+    def stage0_at(ph):
+        return ForAll(
+            [i],
+            And(
+                Not(sig.get("commit", i)),
+                Not(sig.get("ready", i)),
+                Lt(sig.get("ts", i), ph),
+            ),
+        )
+
+    F[0] = stage0_at(r)
+    F[1] = ForAll(
+        [i],
+        And(
+            Not(sig.get("ready", i)),
+            Lt(sig.get("ts", i), r),
+            Implies(sig.get("commit", i), Eq(i, coord)),
+        ),
+    )
+    _stamp_fact = lambda ii: Implies(
+        Eq(sig.get("ts", ii), r),
+        And(
+            sig.get("commit", coord),
+            Eq(sig.get("x", ii), sig.get("vote", coord)),
+        ),
+    )
+    F[2] = ForAll(
+        [i],
+        And(
+            Not(sig.get("ready", i)),
+            Implies(sig.get("commit", i), Eq(i, coord)),
+            _stamp_fact(i),
+            Leq(sig.get("ts", i), r),
+        ),
+    )
+    F[3] = And(
+        ForAll(
+            [i],
+            And(
+                Implies(sig.get("commit", i), Eq(i, coord)),
+                _stamp_fact(i),
+                Leq(sig.get("ts", i), r),
+                Implies(
+                    sig.get("ready", i),
+                    And(Eq(i, coord), sig.get("commit", i)),
+                ),
+            ),
+        ),
+        # a ready coordinator is backed by a majority of current-phase stamps
+        Implies(
+            Exists([i], sig.get("ready", i)),
+            majority(Card(stamped())),
+        ),
+    )
+
+    safety_core = And(Or(no_decision, anchored), keep_init, vote_init)
+
+    spec = ProtocolSpec(
+        sig=sig,
+        rounds=[r1, r2, r3, r4],
+        init=init,
+        invariants=[inv1],
+        properties=[("agreement", agreement), ("validity", validity)],
+        config=ClConfig(venn_bound=2, inst_depth=1),
+    )
+    extras = {
+        "coord": coord,
+        "phase": r,
+        "maxx": maxx,
+        "x0": x0,
+        "inv1": inv1,
+        "no_decision": no_decision,
+        "anchored": anchored,
+        "anchored_body": anchored_body,
+        "keep_init": keep_init,
+        "vote_init": vote_init,
+        "a_set": a_set,
+        "stages": F,
+        "stage0_at": stage0_at,
+        "safety_core": safety_core,
+        "rounds": (r1, r2, r3, r4),
+    }
+    return spec, extras
+
+
+def lv_staged_vcs():
+    """The LV phase-staged inductiveness VCs, in skolemized-anchor form:
+
+       (SCsk(va, ta) ∧ F_k) ∧ TR_{k+1} ⇒ (SCsk′ with explicit witnesses)
+
+    for k = 0..2, and round 4 with the phase bump.  (va, ta) are fresh
+    constants naming the hypothesis anchor (sound: free constants are
+    implicitly ∀-quantified, and ∃v,t anchored ⇒ body(va, ta) for the
+    witnesses); each round's conclusion re-establishes the anchor at stated
+    witnesses — rounds 1–3 keep (va, ta); round 4 either keeps it or, when
+    the decision fires from the noDecision world, anchors at
+    (vote(coord), phase).  Choosing witnesses up front removes the ∃v,t
+    search that made the reducer enumerate tens of thousands of instances.
+
+    Returns ([(name, hypothesis, tr_formula, conclusion)], spec, extras).
+    Discharging these goes BEYOND the reference's logic suite, which ignores
+    every LV inductiveness VC (LvExample.scala:262-291)."""
+    from round_tpu.verify.futils import subst_vars
+
+    spec, lv = lv_spec()
+    sig = spec.sig
+    F = lv["stages"]
+    r = lv["phase"]
+    rounds = lv["rounds"]
+    nd, ab = lv["no_decision"], lv["anchored_body"]
+    ki, vi = lv["keep_init"], lv["vote_init"]
+    coord = lv["coord"]
+
+    va = Variable("va", Int)
+    ta = Variable("ta", Int)
+
+    def sc(anchor_options):
+        return And(Or(nd, *anchor_options), ki, vi)
+
+    hyp_sc = sc([ab(va, ta)])
+
+    vcs = []
+    for k in range(3):
+        hyp = And(hyp_sc, F[k])
+        concl = sig.prime(And(sc([ab(va, ta)]), F[k + 1]))
+        vcs.append(
+            (f"stage {k} -> {k + 1} via round {k + 1}",
+             hyp, rounds[k].full_tr(), concl)
+        )
+    # round 4 wraps the phase: post-state facts hold at phase+1; a decision
+    # fired from the noDecision world anchors at (vote(coord), phase)
+    rnext = Plus(r, IntLit(1))
+    post = sig.prime(
+        And(
+            Or(nd, ab(va, ta, rnext), ab(sig.get("vote", coord), r, rnext)),
+            ki,
+            vi,
+            lv["stage0_at"](rnext),
+        )
+    )
+    vcs.append(("stage 3 -> 0 via round 4 (phase bump)",
+                And(hyp_sc, F[3]), rounds[3].full_tr(), post))
+    return vcs, spec, lv
+
+
+def _lv_maxx_axiom(sig: StateSig, coord, maxx) -> Formula:
+    """maxx(j) is the x-payload of a max-timestamp sender in j's round-1
+    mailbox (LvExample maxTSdef, :77-97, localized: no FMap needed)."""
+    jj = Variable("mj", procType)
+    kk = Variable("mk", procType)
+    ii = Variable("mi", procType)
+
+    def in_mb(pp):
+        # round-1 mailbox of jj: senders heard, addressed to the coordinator
+        return And(In(pp, ho_of(jj)), Eq(jj, coord))
+
+    def maxx_of(p):
+        return Application(maxx, [p]).with_type(Int)
+
+    return ForAll(
+        [jj],
+        Implies(
+            Gt(Card(Comprehension([kk], in_mb(kk))), IntLit(0)),
+            Exists(
+                [kk],
+                And(
+                    in_mb(kk),
+                    Eq(maxx_of(jj), sig.get("x", kk)),
+                    ForAll(
+                        [ii],
+                        Implies(
+                            in_mb(ii),
+                            Leq(sig.get("ts", ii), sig.get("ts", kk)),
+                        ),
+                    ),
+                ),
+            ),
+        ),
     )

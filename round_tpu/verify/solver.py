@@ -72,6 +72,78 @@ class SatTimeout(Exception):
     pass
 
 
+class SatSession:
+    """One incremental native-solver process (rtsat -i): the DPLL(T) loop
+    adds theory blocking clauses between solves, and the solver keeps its
+    learned clauses and activities instead of restarting from scratch (the
+    round-1 loop re-ran the whole CNF per conflict — ~100x slower on
+    VC-sized queries)."""
+
+    def __init__(self, nvars: int, clauses: Sequence[Sequence[int]]):
+        self.nvars = nvars
+        self.proc = subprocess.Popen(
+            [_sat_binary(), "-i"],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            text=True,
+            bufsize=1,
+        )
+        lines = [f"p cnf {nvars} {len(clauses)}"]
+        for c in clauses:
+            lines.append(" ".join(map(str, c)) + " 0")
+        self.proc.stdin.write("\n".join(lines) + "\n")
+
+    def add_clause(self, clause: Sequence[int]) -> None:
+        self.proc.stdin.write("a " + " ".join(map(str, clause)) + " 0\n")
+
+    def solve(self, timeout_s: Optional[float] = None) -> Optional[List[bool]]:
+        """Returns assignment (index 1..nvars) or None for unsat; raises
+        SatTimeout (killing the process) if the budget expires."""
+        import threading
+
+        self.proc.stdin.write("s\n")
+        self.proc.stdin.flush()
+        timer = None
+        timed_out = [False]
+        if timeout_s is not None:
+            def _kill():
+                timed_out[0] = True
+                self.proc.kill()
+
+            timer = threading.Timer(max(timeout_s, 0.001), _kill)
+            timer.start()
+        try:
+            header = self.proc.stdout.readline()
+            if timed_out[0] or not header:
+                raise SatTimeout()
+            if header.strip() == "r unsat":
+                return None
+            assert header.strip() == "r sat", header
+            body = self.proc.stdout.readline()
+            if timed_out[0] or not body:
+                raise SatTimeout()
+        finally:
+            if timer is not None:
+                timer.cancel()
+        assign = [True] * (self.nvars + 1)
+        for tok in body.split():
+            if tok == "v":
+                continue
+            l = int(tok)
+            if l != 0:
+                assign[abs(l)] = l > 0
+        return assign
+
+    def close(self) -> None:
+        try:
+            if self.proc.poll() is None:
+                self.proc.stdin.write("q\n")
+                self.proc.stdin.flush()
+                self.proc.wait(timeout=2)
+        except Exception:
+            self.proc.kill()
+
+
 def sat_solve(
     nvars: int,
     clauses: Sequence[Sequence[int]],
@@ -297,32 +369,37 @@ def solve_ground(
     root = cnf.encode(f)
     cnf.clauses.append([root])
 
-    # Atom classification happens lazily per SAT model.
-    for _ in range(max_rounds):
-        try:
-            budget = (
-                None if deadline is None else deadline - _time.monotonic()
-            )
-            if budget is not None and budget <= 0:
+    # Atom classification happens lazily per SAT model; one incremental
+    # solver session serves the whole loop (learned clauses persist).
+    sess = SatSession(cnf.n, cnf.clauses)
+    try:
+        for _ in range(max_rounds):
+            try:
+                budget = (
+                    None if deadline is None else deadline - _time.monotonic()
+                )
+                if budget is not None and budget <= 0:
+                    return UNKNOWN
+                assign = sess.solve(timeout_s=budget)
+            except SatTimeout:
                 return UNKNOWN
-            assign = sat_solve(cnf.n, cnf.clauses, timeout_s=budget)
-        except SatTimeout:
-            return UNKNOWN
-        if assign is None:
-            return UNSAT
-        # literal values for each atom
-        atoms = [(a, assign[v]) for a, v in cnf.atom_var.items()]
-        conflict = _theory_check(atoms)
-        if conflict is None:
-            return SAT
-        # blocking clause: negate the conjunction of conflicting literals
-        blocking = []
-        for a in conflict:
-            v = cnf.atom_var[a]
-            blocking.append(-v if assign[v] else v)
-        assert blocking, "empty theory conflict"
-        cnf.clauses.append(blocking)
-    return UNKNOWN
+            if assign is None:
+                return UNSAT
+            # literal values for each atom
+            atoms = [(a, assign[v]) for a, v in cnf.atom_var.items()]
+            conflict = _theory_check(atoms)
+            if conflict is None:
+                return SAT
+            # blocking clause: negate the conjunction of conflicting literals
+            blocking = []
+            for a in conflict:
+                v = cnf.atom_var[a]
+                blocking.append(-v if assign[v] else v)
+            assert blocking, "empty theory conflict"
+            sess.add_clause(blocking)
+        return UNKNOWN
+    finally:
+        sess.close()
 
 
 def _theory_check(atoms: List[Tuple[Formula, bool]]) -> Optional[List[Formula]]:

@@ -211,15 +211,41 @@ class VennRegions:
         return Plus(*terms)
 
 
+def carded_supports(conjuncts: Sequence[Formula]) -> List[Formula]:
+    """Atomic set terms appearing (possibly inside set algebra) under a Card
+    — the sets whose region variables can actually influence arithmetic.
+    Building regions only over these keeps the free-atom count of the ground
+    query proportional to the cardinality reasoning the VC needs, instead of
+    quadratic in every set term mentioned anywhere (which made VC-sized
+    queries enumerate thousands of irrelevant Venn models)."""
+    out: List[Formula] = []
+
+    def walk(g: Formula):
+        if isinstance(g, Application):
+            if g.fct == CARD:
+                sup = _atomic_support(g.args[0])
+                for s in sup or []:
+                    if s not in out:
+                        out.append(s)
+            for a in g.args:
+                walk(a)
+
+    for c in conjuncts:
+        walk(c)
+    return out
+
+
 def build_regions(
     conjuncts: Sequence[Formula],
     elements_by_type: Dict[Type, List[Formula]],
     bound: int = 2,
+    only: Optional[Sequence[Formula]] = None,
 ) -> Dict[Type, VennRegions]:
     """Collect the atomic set terms per element type from `conjuncts` and
     build one VennRegions per type.  The instances are persistent: later
     `rewrite_cards` calls share their card/region variables, which is what
-    keeps |S| consistent across reduction rounds."""
+    keeps |S| consistent across reduction rounds.  With `only`, region
+    groups are restricted to those atomic sets (see carded_supports)."""
     sets_by_type: Dict[Type, List[Formula]] = {}
 
     def note_set(t: Formula):
@@ -227,6 +253,8 @@ def build_regions(
         # quantified bodies (bound-var-dependent) are never reached because
         # walk does not descend into Binding nodes
         if _is_atomic_set(t):
+            if only is not None and t not in only:
+                return
             lst = sets_by_type.setdefault(t.tpe.elem, [])
             if t not in lst:
                 lst.append(t)

@@ -1,0 +1,99 @@
+"""Scratch: extract OTR's executable update (Mailbox mmor path) and prove
+the mor lemma from the extracted site axioms."""
+import time
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+from round_tpu.ops.mailbox import Mailbox
+from round_tpu.verify.extract import Scalar, Vec, extract_lane_fn
+from round_tpu.verify.formula import (
+    And, Application, Bool, Card, Comprehension, Eq, Exists, ForAll, FunT,
+    Geq, Gt, Implies, In, Int, IntLit, Leq, Literal, Lt, Not, Times,
+    UnInterpretedFct, Variable, procType,
+)
+from round_tpu.verify.tr import StateSig, ho_of
+from round_tpu.verify.venn import N_VAR as N
+from round_tpu.verify.cl import ClConfig, entailment
+
+sig = StateSig({"x": Int, "decided": Bool, "dec": Int})
+j = Variable("j", procType)
+snd = UnInterpretedFct("sndx", FunT([procType], Int))
+
+
+def upd(n, x, decided, dec, vals, mask):
+    m = Mailbox(vals, mask)
+    size = m.size()
+    quorum = size > (2 * n) // 3
+    v = m.min_most_often_received()
+    v_count = m.count(lambda vs: vs == v)
+    super_q = quorum & (v_count > (2 * n) // 3)
+    decided2 = decided | super_q
+    dec2 = jnp.where(super_q & ~decided, v, dec)
+    x2 = jnp.where(quorum, v, x)
+    return x2, decided2, dec2
+
+
+NE = 5
+ex_args = [jnp.int32(NE), jnp.int32(0), jnp.bool_(False), jnp.int32(-1),
+           jnp.zeros((NE,), jnp.int32), jnp.zeros((NE,), bool)]
+fargs = [
+    Scalar(N),
+    Scalar(sig.get("x", j)),
+    Scalar(sig.get("decided", j)),
+    Scalar(sig.get("dec", j)),
+    Vec(lambda i: Application(snd, [i]).with_type(Int)),
+    Vec(lambda i: Application(
+        __import__("round_tpu.verify.formula", fromlist=["IN"]).IN,
+        [i, ho_of(j)]).with_type(Bool)),
+]
+
+outs, axioms = extract_lane_fn(
+    upd, ex_args, fargs, lambda i: Literal(True), receiver=j,
+    return_axioms=True,
+)
+import sys
+print("outputs:", flush=True)
+for name, o in zip(["x'", "decided'", "dec'"], outs):
+    print(f"  {name} = {repr(o.f)[:200]}")
+print(f"{len(axioms)} site axioms:")
+for a in axioms:
+    print("  ", repr(a)[:220])
+
+# payload tie: snd(i) = x(i)  (broadcast round)
+i0 = Variable("i0", procType)
+payload_def = ForAll([i0], Eq(Application(snd, [i0]).with_type(Int),
+                              sig.get("x", i0)))
+
+# the mor lemma from the extracted axioms: under the OTR invariant + the
+# 2n/3 communication assumption + int32-domain bound, x' equals the
+# majority value whenever the quorum fires.
+w = Variable("w", Int)
+k1 = Variable("k1", procType)
+S_w = Comprehension([k1], Eq(sig.get("x", k1), w))
+kb = Variable("kb", procType)
+INTMAX = IntLit(2**31 - 1)
+value_bound = ForAll([kb], Lt(sig.get("x", kb), INTMAX))
+
+hyp = And(
+    payload_def,
+    *axioms,
+    Gt(Times(3, Card(S_w)), Times(2, N)),           # invariant majority
+    Gt(Times(3, Card(ho_of(j))), Times(2, N)),      # safety: 3|HO(j)| > 2n
+    value_bound,
+)
+
+# the extracted mmor site is the unique ext!min site inside x'
+# find it: x' = Ite(quorum, msite, x(j))
+xp = outs[0].f
+print("\nx' head:", repr(xp)[:160])
+msite = xp.args[1]  # Ite(cond, then, else) -> then branch
+print("msite:", repr(msite))
+
+t0 = time.time()
+import os
+eff = os.environ.get("EXTRACT_EFFORT", "2,3").split(",")
+ok = entailment(hyp, Eq(msite, w), ClConfig(venn_bound=int(eff[1]), inst_depth=int(eff[0])),
+                timeout_s=90)
+print(f"\nextracted mor lemma: {ok} ({time.time()-t0:.1f}s)")

@@ -1,0 +1,98 @@
+"""Scratch: isolate fused-kernel cost components."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+n, TILE, V, S = 1024, 128, 16, 50
+
+
+def mk(mode, orient):
+    def kernel(vals_ref, p8_ref, out_ref):
+        s = pl.program_id(0)
+        t = pl.program_id(1)
+        p8 = p8_ref[s]
+        if orient == "JI":  # receivers in sublanes: [TILE, n]
+            shape = (TILE, n)
+            recv = jax.lax.broadcasted_iota(jnp.int32, shape, 0) + t * TILE
+            sender = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        else:  # senders in sublanes: [n, TILE]
+            shape = (n, TILE)
+            sender = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+            recv = jax.lax.broadcasted_iota(jnp.int32, shape, 1) + t * TILE
+
+        if mode == "none":
+            keep = jnp.ones(shape, dtype=bool)
+        elif mode == "hash":
+            idx = (recv * n + sender).astype(jnp.uint32)
+            z = idx * jnp.uint32(0x9E3779B9)
+            z = z ^ (z >> 16)
+            z = z * jnp.uint32(0x85EBCA6B)
+            z = z ^ (z >> 13)
+            z = z * jnp.uint32(0xC2B2AE35)
+            z = z ^ (z >> 16)
+            keep = (z & jnp.uint32(0xFF)) >= p8.astype(jnp.uint32)
+        else:  # hw
+            pltpu.prng_seed(s * 8 + t)
+            bits = pltpu.prng_random_bits(shape)
+            keep = (bits & jnp.uint32(0xFF)) >= p8.astype(jnp.uint32)
+
+        deliver = (keep | (sender == recv)).astype(jnp.bfloat16)
+        if orient == "JI":
+            onehot = (
+                vals_ref[0, 0][:, None]
+                == jax.lax.broadcasted_iota(jnp.int32, (n, V), 1)
+            ).astype(jnp.bfloat16)
+            out_ref[0] = jnp.dot(deliver, onehot, preferred_element_type=jnp.float32)
+        else:
+            onehot_t = (
+                vals_ref[0, 0][None, :]
+                == jax.lax.broadcasted_iota(jnp.int32, (V, n), 0)
+            ).astype(jnp.bfloat16)
+            out_ref[0] = jnp.dot(onehot_t, deliver, preferred_element_type=jnp.float32)
+
+    if orient == "JI":
+        out_spec = pl.BlockSpec((1, TILE, V), lambda s, t: (s, t, 0))
+        out_shape = jax.ShapeDtypeStruct((S, n, V), jnp.float32)
+    else:
+        out_spec = pl.BlockSpec((1, V, TILE), lambda s, t: (s, 0, t))
+        out_shape = jax.ShapeDtypeStruct((S, V, n), jnp.float32)
+
+    @jax.jit
+    def f(vals, p8):
+        return pl.pallas_call(
+            kernel,
+            grid=(S, n // TILE),
+            in_specs=[
+                pl.BlockSpec((1, 1, n), lambda s, t: (s, 0, 0)),
+                pl.BlockSpec((S,), lambda s, t: (0,), memory_space=pltpu.SMEM),
+            ],
+            out_specs=out_spec,
+            out_shape=out_shape,
+        )(vals.reshape(S, 1, n), p8)
+
+    return f
+
+
+vals = jax.random.randint(jax.random.PRNGKey(0), (S, n), 0, V, dtype=jnp.int32)
+p8 = jnp.full((S,), 13, dtype=jnp.int32)
+
+for orient in ("IJ", "JI"):
+    for mode in ("none", "hash", "hw"):
+        try:
+            f = mk(mode, orient)
+            out = jax.device_get(f(vals, p8))
+            reps = 30
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = f(vals, p8)
+            jax.block_until_ready(out)
+            np.asarray(out).ravel()[0]
+            dt = (time.perf_counter() - t0) / reps
+            print(f"{orient} {mode:5s}: {dt*1e3:7.2f} ms/round ({dt/S*1e6:7.2f} us/sc-round)")
+        except Exception as e:
+            print(f"{orient} {mode:5s}: FAIL {type(e).__name__}: {str(e)[:120]}")

@@ -1,0 +1,32 @@
+"""Scratch: per-sub-VC diagnosis of the LV staged inductiveness."""
+import sys
+import time
+
+from round_tpu.verify.protocols import lv_staged_vcs
+from round_tpu.verify.formula import And, Not
+from round_tpu.verify.cl import _hyp_disjuncts, _concl_conjuncts, _ladder, ClReducer
+from round_tpu.verify.solver import solve_ground
+
+import dataclasses
+
+which = int(sys.argv[1]) if len(sys.argv) > 1 else 1
+depth = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+vcs, spec, lv = lv_staged_vcs()
+name, hyp, tr, concl = vcs[which]
+print("VC:", name, "depth:", depth)
+cfg = dataclasses.replace(spec.config, inst_depth=depth)
+
+full_hyp = And(hyp, tr)
+for bi, hd in enumerate(_hyp_disjuncts(full_hyp)):
+    for ci, cc in enumerate(_concl_conjuncts(concl)):
+        verdicts = []
+        t0 = time.time()
+        for cfg_k in _ladder(cfg):
+            red = ClReducer(cfg_k)
+            r = solve_ground(red.reduce(And(hd, Not(cc))), timeout_s=20)
+            verdicts.append(f"vb{cfg_k.venn_bound}:{r}")
+            if r == "unsat":
+                break
+        status = "OK " if verdicts[-1].endswith("unsat") else "FAIL"
+        print(f"{status} branch{bi} concl{ci}: {' '.join(verdicts)} "
+              f"({time.time()-t0:.1f}s)  [{repr(cc)[:100]}]", flush=True)

@@ -1,0 +1,34 @@
+"""Scratch: the single stuck LV sub-VC — anchored branch preserving the
+invariant disjunction through round 2."""
+import sys
+import time
+import dataclasses
+
+from round_tpu.verify.protocols import lv_staged_vcs
+from round_tpu.verify.formula import And, Not
+from round_tpu.verify.cl import _hyp_disjuncts, _concl_conjuncts, ClReducer, ClConfig
+from round_tpu.verify.solver import solve_ground
+from round_tpu.verify.futils import get_conjuncts
+
+which = int(sys.argv[1]) if len(sys.argv) > 1 else 1
+depth = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+vb = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+tmo = int(sys.argv[4]) if len(sys.argv) > 4 else 120
+
+vcs, spec, lv = lv_staged_vcs()
+name, hyp, tr, concl = vcs[which]
+print("VC:", name, flush=True)
+
+hds = _hyp_disjuncts(And(hyp, tr))
+ccs = _concl_conjuncts(concl)
+hd = hds[1]  # anchored branch
+cc = ccs[0]  # Or(noDecision', anchored')
+
+cfg = ClConfig(venn_bound=vb, inst_depth=depth)
+red = ClReducer(cfg)
+t0 = time.time()
+g = red.reduce(And(hd, Not(cc)))
+print(f"reduce: {time.time()-t0:.1f}s, conjuncts={len(get_conjuncts(g))}", flush=True)
+t0 = time.time()
+r = solve_ground(g, timeout_s=tmo)
+print(f"solve: {r} ({time.time()-t0:.1f}s)")

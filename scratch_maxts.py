@@ -1,0 +1,45 @@
+"""Scratch: the maxts lemma verdicts per rung."""
+import sys
+import time
+
+from round_tpu.verify.protocols import lv_spec
+from round_tpu.verify.formula import (
+    And, Application, Card, Comprehension, Eq, ForAll, Geq, Gt, Implies, In,
+    Int, Not, Times, Variable, procType,
+)
+from round_tpu.verify.tr import ho_of
+from round_tpu.verify.venn import N_VAR as N
+from round_tpu.verify.cl import ClReducer, ClConfig
+from round_tpu.verify.solver import solve_ground
+from round_tpu.verify.futils import get_conjuncts
+
+spec, x = lv_spec()
+sig = spec.sig
+coord, maxx = x["coord"], x["maxx"]
+t = Variable("t", Int)
+v = Variable("v", Int)
+i = Variable("i", procType)
+kk = Variable("k", procType)
+
+a_set = Comprehension([kk], Geq(sig.get("ts", kk), t))
+mb = Comprehension([kk], And(In(kk, ho_of(coord)), Eq(coord, coord)))
+maxx_axiom = spec.rounds[0].aux()[0]
+hyp = And(
+    maxx_axiom,
+    Gt(Times(2, Card(a_set)), N),
+    ForAll([i], Implies(Geq(sig.get("ts", i), t), Eq(sig.get("x", i), v))),
+    Gt(Times(2, Card(mb)), N),
+)
+concl = Eq(Application(maxx, [coord]).with_type(Int), v)
+
+for vb, d in [(2, 1), (2, 2), (3, 2)]:
+    red = ClReducer(ClConfig(venn_bound=vb, inst_depth=d))
+    t0 = time.time()
+    g = red.reduce(And(hyp, Not(concl)))
+    tr = time.time() - t0
+    t0 = time.time()
+    r = solve_ground(g, timeout_s=90)
+    print(f"vb{vb} d{d}: {r} (reduce {tr:.1f}s, {len(get_conjuncts(g))} conj, "
+          f"solve {time.time()-t0:.1f}s)", flush=True)
+    if r == "unsat":
+        break

@@ -1,0 +1,57 @@
+"""Scratch: isolate per-round costs on the chip (not part of the framework)."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from round_tpu.engine.executor import run_instance
+from round_tpu.engine import scenarios
+from round_tpu.models.otr import OTR
+from round_tpu.models.common import consensus_io
+
+n = 1024
+S = 1000
+chunk = int(sys.argv[1]) if len(sys.argv) > 1 else 50
+phases = 10
+V = 16
+
+
+def timeit(tag, make):
+    bench = make()
+    key = jax.random.PRNGKey(0)
+    out = jax.device_get(bench(key))  # compile+warmup
+    best = 1e9
+    for i in range(2):
+        t0 = time.perf_counter()
+        jax.device_get(bench(jax.random.PRNGKey(i)))
+        best = min(best, time.perf_counter() - t0)
+    print(f"{tag:40s} {best*1000:8.1f} ms  ({phases/best:8.1f} rounds/s)")
+    return best
+
+
+def make(sampler, n_values):
+    algo = OTR(after_decision=2, n_values=n_values)
+
+    def run_chunk(keys):
+        def one(k):
+            k_init, k_run = jax.random.split(k)
+            init = jax.random.randint(k_init, (n,), 0, V, dtype=jnp.int32)
+            res = run_instance(algo, consensus_io(init), n, k_run, sampler, max_phases=phases)
+            return res.state.decided, res.decided_round
+
+        return jax.vmap(one)(keys)
+
+    @jax.jit
+    def bench(key):
+        keys = jax.random.split(key, S).reshape(S // chunk, chunk, 2)
+        decided, dec_round = jax.lax.map(run_chunk, keys)
+        return decided.reshape(-1, n), dec_round.reshape(-1, n)
+
+    return lambda: bench
+
+
+timeit("full net + hist", make(scenarios.full(n), V))
+timeit("hash-omission + hist", make(scenarios.omission(n, 0.05), V))
+timeit("full net + generic mmor", make(scenarios.full(n), None))
+timeit("threefry-omission + hist", make(scenarios.omission(n, 0.05, impl="threefry"), V))

@@ -1,0 +1,158 @@
+"""Fused fast engine: kernel correctness + differential parity vs the
+general engine.
+
+The fused path (ops/fused.py + engine/fast.py) is the flagship-bench hot
+path; these tests pin it to the reference semantics three ways:
+  1. the Pallas kernel (interpret mode on CPU) against the pure-XLA oracle,
+  2. OtrHist decisions against models.otr.OTR run through the general
+     engine on the SAME fault schedule (scenarios.from_fault_params replays
+     a FaultMix row bit-exactly in hash mode),
+  3. fault-family behavior (crash freeze, partition-then-heal).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from round_tpu.engine import fast, scenarios
+from round_tpu.engine.executor import run_instance
+from round_tpu.models.common import consensus_io
+from round_tpu.models.otr import OTR, OtrState
+from round_tpu.ops.fused import hist_exchange, hist_exchange_reference
+
+V = 8
+N = 16
+S = 12
+
+
+def _rand_inputs(key, S, n):
+    ks = jax.random.split(key, 8)
+    return dict(
+        vals=jax.random.randint(ks[0], (S, n), 0, V, dtype=jnp.int32),
+        active=jax.random.bernoulli(ks[1], 0.9, (S, n)),
+        colmask=jax.random.bernoulli(ks[2], 0.8, (S, n)),
+        rowmask=jax.random.bernoulli(ks[3], 0.9, (S, n)),
+        side=jax.random.randint(ks[4], (S, n), 0, 2, dtype=jnp.int32),
+        salt0=jax.random.bits(ks[5], (S,), jnp.uint32).astype(jnp.int32),
+        salt1r=jax.random.bits(ks[6], (S,), jnp.uint32).astype(jnp.int32),
+        p8=jnp.asarray(
+            [0, 13, 64, 128, 255, 256, 1, 0, 13, 64, 13, 13], dtype=jnp.int32
+        )[:S],
+    )
+
+
+def test_kernel_matches_oracle_hash_mode():
+    inp = _rand_inputs(jax.random.PRNGKey(0), S, N)
+    want = np.asarray(hist_exchange_reference(num_values=V, **inp))
+    got = np.asarray(
+        hist_exchange(num_values=V, mode="hash", interpret=True, **inp)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def _fast_otr(mix, n, init_vals, rounds):
+    rnd = fast.OtrHist(n_values=V, after_decision=2)
+    S = mix.crashed.shape[0]
+    state0 = OtrState(
+        x=jnp.broadcast_to(init_vals, (S, n)).astype(jnp.int32),
+        decided=jnp.zeros((S, n), dtype=bool),
+        decision=jnp.full((S, n), -1, dtype=jnp.int32),
+        after=jnp.full((S, n), 2, dtype=jnp.int32),
+    )
+    return fast.run_hist(
+        rnd,
+        state0,
+        lambda s: s.decided,
+        mix,
+        max_rounds=rounds,
+        mode="hash",
+        interpret=True,
+    )
+
+
+def test_fast_otr_parity_vs_general_engine():
+    """Decision parity: fused engine vs the general engine replaying the
+    identical FaultMix row (hash-mode masks are bit-equal)."""
+    n, rounds = N, 6
+    key = jax.random.PRNGKey(7)
+    mix = fast.standard_mix(key, S, n, p_drop=0.1, f=3, crash_round=1)
+    init_vals = jax.random.randint(
+        jax.random.fold_in(key, 9), (n,), 0, V, dtype=jnp.int32
+    )
+
+    state, done, decided_round = _fast_otr(mix, n, init_vals, rounds)
+
+    algo = OTR(after_decision=2, n_values=V)
+    for s in range(S):
+        sampler = scenarios.from_fault_params(
+            n,
+            mix.crashed[s],
+            mix.crash_round[s],
+            mix.side[s],
+            mix.heal_round[s],
+            mix.rotate_down[s],
+            mix.p8[s],
+            mix.salt0[s],
+            mix.salt1[s],
+        )
+        res = run_instance(
+            algo,
+            consensus_io(init_vals),
+            n,
+            jax.random.fold_in(key, 1000 + s),
+            sampler,
+            max_phases=rounds,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(state.decided[s]), np.asarray(res.state.decided),
+            err_msg=f"decided mismatch, scenario {s}",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(state.decision[s]), np.asarray(res.state.decision),
+            err_msg=f"decision mismatch, scenario {s}",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(state.x[s]), np.asarray(res.state.x),
+            err_msg=f"x mismatch, scenario {s}",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(decided_round[s]), np.asarray(res.decided_round),
+            err_msg=f"decided_round mismatch, scenario {s}",
+        )
+
+
+def test_fast_otr_fault_free_decides_round_zero():
+    n = N
+    mix = fast.fault_free(jax.random.PRNGKey(1), 4, n)
+    init = jnp.zeros((n,), dtype=jnp.int32).at[0].set(1)
+    state, done, decided_round = _fast_otr(mix, n, init, 4)
+    assert bool(state.decided.all())
+    # unanimity-majority on value 0 from round 0
+    np.testing.assert_array_equal(np.asarray(state.decision), 0)
+    np.testing.assert_array_equal(np.asarray(decided_round), 0)
+
+
+def test_fast_partition_blocks_until_heal():
+    """A half/half partition leaves no >2n/3 quorum: nobody decides before
+    heal_round; everyone decides after."""
+    n = N
+    S_ = 3
+    key = jax.random.PRNGKey(3)
+    side = jnp.concatenate(
+        [jnp.zeros((n // 2,), jnp.int32), jnp.ones((n - n // 2,), jnp.int32)]
+    )
+    mix = fast.FaultMix(
+        crashed=jnp.zeros((S_, n), dtype=bool),
+        crash_round=jnp.zeros((S_,), jnp.int32),
+        side=jnp.broadcast_to(side, (S_, n)),
+        heal_round=jnp.full((S_,), 3, jnp.int32),
+        rotate_down=jnp.zeros((S_,), jnp.int32),
+        p8=jnp.zeros((S_,), jnp.int32),
+        salt0=fast._salts(key, S_, 0),
+        salt1=fast._salts(key, S_, 1),
+    )
+    init = (jnp.arange(n) % 2).astype(jnp.int32)
+    state, done, decided_round = _fast_otr(mix, n, init, 6)
+    assert bool(state.decided.all())
+    assert int(decided_round.min()) >= 3, "decided during the partition"
