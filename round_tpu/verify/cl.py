@@ -40,7 +40,7 @@ from round_tpu.verify.formula import (
 from round_tpu.verify.futils import (
     fmap, free_vars, get_conjuncts, subst_vars,
 )
-from round_tpu.verify.simplify import nnf, simplify
+from round_tpu.verify.simplify import nnf, pnf, simplify
 from round_tpu.verify.solver import SAT, UNKNOWN, UNSAT, solve_ground
 from round_tpu.verify.typer import typecheck
 
@@ -364,6 +364,10 @@ class ClReducer:
         f = nnf(f)
         f, _consts = quantifiers.get_existential_prefix(f)
         f = quantifiers.skolemize(f)
+        # prenex each conjunct: a nested ∀ inside a disjunction (axiom shape
+        # ∀j. c → (a ∧ ∀i. d)) must join the clause prefix, or instantiation
+        # never reaches it and it survives as an opaque embedded quantifier
+        f = And(*[pnf(c) for c in get_conjuncts(f)])
         f, setdefs = quantifiers.symbolize_comprehensions(f)
         f = typecheck(f)
 
@@ -419,6 +423,13 @@ class ClReducer:
         base_set = set(base)
         insts2 = [i for i in insts2 if i not in base_set]
 
+        # close the membership→cardinality direction for the witnesses: a
+        # witness proved (through set definitions) to be in a carded set must
+        # force that set's region sum ≥ 1, or majority-intersection facts
+        # never reach Card hypotheses of instantiated axioms
+        for vr in regions.values():
+            vr.add_elements(vr.witnesses)
+
         rewritten = venn.rewrite_cards(regions, base + insts2)
         constraints, _wits = venn.collect(regions)
 
@@ -444,11 +455,19 @@ def _ladder(config: ClConfig) -> List[ClConfig]:
     power, so proofs that need no cardinality ILP stay cheap."""
     rungs = []
     if config.venn_bound >= 1:
-        rungs.append(dataclasses.replace(config, venn_bound=0))
+        rungs.append(
+            dataclasses.replace(config, venn_bound=0, inst_depth=1)
+        )
+        if config.inst_depth > 1:
+            rungs.append(dataclasses.replace(config, venn_bound=0))
+    if config.inst_depth > 1:
+        # depth-1 instantiation with the full ILP: an order of magnitude
+        # fewer ground conjuncts — most deep configs never need depth 2
+        rungs.append(dataclasses.replace(config, inst_depth=1))
     if config.venn_bound > 2:
         rungs.append(dataclasses.replace(config, venn_bound=2))
     rungs.append(config)
-    return rungs
+    return [r for i, r in enumerate(rungs) if r not in rungs[:i]]
 
 
 def _hyp_disjuncts(f: Formula, budget: int = 16) -> List[Formula]:

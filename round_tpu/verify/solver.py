@@ -502,9 +502,9 @@ def _theory_check(atoms: List[Tuple[Formula, bool]]) -> Optional[List[Formula]]:
     prop_atoms: List[List[Formula]] = []
     if foreign:
         cc = congruence.CongruenceClosure()
-        for a, b in eqs:
+        for idx, (a, b) in enumerate(eqs):
             try:
-                cc.assert_eq(a, b)
+                cc.assert_eq(a, b, tag=idx)
             except ValueError:
                 pass
         names = sorted(foreign)
@@ -523,7 +523,14 @@ def _theory_check(atoms: List[Tuple[Formula, bool]]) -> Optional[List[Formula]]:
         for group in by_repr.values():
             for other in group[1:]:
                 lia_cons.append(({group[0]: 1, other: -1}, "==", 0))
-                prop_atoms.append(eq_atoms)  # coarse: all positive equalities
+                # precise proof-forest explanation of the merge: blocking
+                # with all positive equalities (the round-1 fallback) made
+                # these conflicts nearly vacuous on VC-sized queries
+                core = cc.explain(foreign[group[0]], foreign[other])
+                if core is None:
+                    prop_atoms.append(eq_atoms)
+                else:
+                    prop_atoms.append([eq_atoms[i] for i in sorted(core)])
 
     # --- LIA with lazy negated-equality splits -----------------------------
     # A negated Int equality (Σc·x ≠ r) is non-convex; instead of eagerly

@@ -20,6 +20,7 @@ cardinality facts and membership facts — e.g. |A∩B| ≥ 1 ⇒ the instantiat
 
 from __future__ import annotations
 
+import functools
 import itertools
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -158,13 +159,7 @@ class VennRegions:
             pos = [v for p, v in region_vars.items() if p[idx]]
             self.constraints.append(Plus(*pos).eq(self.card_var(s)))
 
-        def profile_lits(x: Formula, profile: Tuple[bool, ...]) -> List[Formula]:
-            lits = []
-            for idx, s in enumerate(group):
-                member = Application(IN, [x, s])
-                member.tpe = Bool
-                lits.append(member if profile[idx] else Not(member))
-            return lits
+        profile_lits = functools.partial(self._profile_lits, group)
 
         # witnesses: region ≥ 1 ⇒ an element with that profile exists
         for profile, v in region_vars.items():
@@ -181,6 +176,45 @@ class VennRegions:
                     Implies(And(*profile_lits(t, profile)), Geq(v, 1))
                 )
         return region_vars
+
+    def _profile_lits(
+        self, group: Tuple[Formula, ...], x: Formula, profile: Tuple[bool, ...]
+    ) -> List[Formula]:
+        lits = []
+        for idx, s in enumerate(group):
+            member = Application(IN, [x, s])
+            member.tpe = Bool
+            lits.append(member if profile[idx] else Not(member))
+        return lits
+
+    def add_elements(self, new_elements: Sequence[Formula]) -> None:
+        """Register ground elements discovered after build() (e.g. region
+        witnesses, round-2 skolems): emit profile(t) ⇒ region ≥ 1 for every
+        existing group.  This closes the membership→cardinality direction for
+        witnesses — without it, a witness shown (via set definitions) to be a
+        member of some other carded set never forces that set's |·| ≥ 1.
+        Capped for soundness-preserving economy (omitting constraints only
+        weakens the hypothesis side)."""
+        fresh = [
+            e for e in new_elements
+            if e.tpe == self.elem_type and e not in self.elements
+        ]
+        if not fresh:
+            return
+        self.elements.extend(fresh)
+        budget = 20_000
+        for group, region_vars in self._group_regions.items():
+            for t in fresh:
+                for profile, v in region_vars.items():
+                    if budget <= 0:
+                        return
+                    budget -= 1
+                    self.constraints.append(
+                        Implies(
+                            And(*self._profile_lits(group, t, profile)),
+                            Geq(v, 1),
+                        )
+                    )
 
     def card_of(self, expr: Formula) -> Optional[Formula]:
         """An Int term equal to |expr| (atomic or compound set expr)."""
