@@ -93,10 +93,10 @@ def standard_mix(
     key,
     S: int,
     n: int,
-    p_drop: float = 0.05,
+    p_drop: float = 0.25,
     f: Optional[int] = None,
-    crash_round: int = 2,
-    heal_round: int = 4,
+    crash_round: int = 0,
+    heal_round: int = 5,
     rotate_period: int = 1,
 ) -> FaultMix:
     """The hardened flagship workload: scenarios split evenly across four
@@ -106,9 +106,14 @@ def standard_mix(
       1: f processes crash at `crash_round` (+ light omission),
       2: two-way partition until `heal_round`,
       3: rotating suppressed process (+ light omission).
+
+    Defaults are tuned so the fault machinery is genuinely on the hot path:
+    crashes from round 0 (f = n/4 keeps the 2n/3 quorum reachable), heavy
+    omission, partitions that block every quorum until `heal_round` — the
+    flagship p50 decided-round lands past round 1, not at it.
     """
     if f is None:
-        f = max(1, n // 3 - 1)
+        f = max(1, n // 4)
     fam = jnp.arange(S, dtype=jnp.int32) % 4
     k1, k2, k3 = jax.random.split(jax.random.fold_in(key, 0xFA), 3)
 
@@ -199,7 +204,7 @@ def run_hist(
     mix: FaultMix,
     max_rounds: int,
     mode: str = "hw",
-    tile: int = 128,
+    sb: int = 8,
     interpret: bool = False,
 ):
     """Scan `max_rounds` fused rounds over the full scenario batch.
@@ -220,14 +225,14 @@ def run_hist(
             rnd.payload(state),
             ~done,
             colmask,
-            jnp.ones((S, n), dtype=jnp.int32),
+            None,  # rowmask: broadcast rounds select every receiver
             side_r,
             salt0,
             salt1r,
             p8,
             V,
             mode=mode,
-            tile=tile,
+            sb=sb,
             interpret=interpret,
         ).astype(jnp.int32)
         size = jnp.sum(counts, axis=1)

@@ -11,29 +11,31 @@ round exchange collapses to
 
     counts[s, v, j] = #{ i : deliver[s, j, i] and vals[s, i] == v }
 
-and the deliver mask never needs to exist outside VMEM.  This kernel fuses:
+and the deliver mask never needs to exist outside VMEM.
 
-  1. per-link randomness: either the TPU hardware PRNG (mode="hw", fastest)
-     or the counter-based hash of engine.scenarios.link_bernoulli
-     (mode="hash", bit-exact with the general engine's omission sampler —
-     used for differential parity tests);
-  2. the structured fault families as O(n) per-scenario inputs: crash sets /
-     coordinator-down (a sender mask), partitions (a side vector compared
-     in-kernel), receiver-side dest masks (unicast rounds);
-  3. self-delivery (Round.scala:114-117: a process always hears itself) and
-     the active-lane mask (exited lanes stop sending);
-  4. the ``[V, n] x [n, TILE]`` bf16 histogram matmul on the MXU with f32
-     accumulation (counts <= n < 2^24: exact).
+Kernel shape (v2): the grid is blocked over SCENARIOS — each step loads
+``sb`` scenarios' O(n) inputs, loops over them generating the (n, n) mask
+and its histogram matmul entirely in VMEM, and writes (sb, V, n) counts.
+The v1 grid of (S, n/tile) steps moved 8 KB per step; measured on the chip,
+per-step overhead was ~10x the compute.  Per-link work is minimized:
 
-The [n, TILE] mask tile lives only in VMEM; HBM sees O(S*n) inputs and the
-O(S*V*n) count output per round.
+  * per-link randomness from the TPU hardware PRNG compared as a full
+    32-bit word against ``p8 << 24`` (exactly Bernoulli(p8/256), one op);
+  * sender-side masks (colmask & active) are folded into the onehot matmul
+    operand — O(n·V) instead of O(n²);
+  * the self-delivery diagonal is erased from the random mask in-kernel and
+    re-added outside as the O(S·n) correction counts[j, x[j]] += active[j];
+  * partition side equality costs 2 vector ops only for scenario batches
+    that carry a partition (`sided=False` skips them).
 
-Mask semantics (must match engine.executor.run_round + engine.scenarios):
+Mask semantics (must match engine.run_round + engine.scenarios):
 
     ho[j, i]      = (colmask[i] & (side[j] == side[i]) & keep_p(j, i)) | (i == j)
     deliver[j, i] = ho[j, i] & active[i] & rowmask[j]
 
-where keep_p is Bernoulli(1 - p8/256) per link per round.
+where keep_p is Bernoulli(1 - p8/256) per link per round.  mode="hash" is
+bit-exact with engine.scenarios.link_bernoulli (the differential-parity
+mode); mode="hw" uses the hardware PRNG (the fast path).
 """
 
 from __future__ import annotations
@@ -61,78 +63,93 @@ def _fmix32(z):
 
 
 def _kernel(
-    vals_ref,       # (1, 1, n) int32   sender values in [0, V)
-    active_ref,     # (1, 1, n) int32   1 = lane still running (sender side)
-    colmask_ref,    # (1, 1, n) int32   1 = sender not crashed/suppressed
-    rowmask_ref,    # (1, 1, TILE) int32  1 = receiver selected by dest mask
-    side_s_ref,     # (1, 1, n) int32   partition side per sender
-    side_r_ref,     # (1, 1, TILE) int32  partition side per receiver (same array)
-    salt0_ref,      # (S,) int32 [SMEM]  per-scenario salt / seed
-    salt1_ref,      # (S,) int32 [SMEM]  per-(scenario, round) premixed salt
-    p8_ref,         # (S,) int32 [SMEM]  drop threshold in [0, 256]
-    out_ref,        # (1, V, TILE) f32     counts
-    *,
+    *refs,
     num_values: int,
-    tile: int,
+    sb: int,
     mode: str,
+    sided: bool,
+    rowmasked: bool,
 ):
-    n = vals_ref.shape[2]
-    s = pl.program_id(0)
-    t = pl.program_id(1)
+    # operand order mirrors hist_exchange: vals, senders, [rowmask], [side],
+    # salt0, salt1r, p8 (SMEM), out.  rowmask/side refs exist only when the
+    # corresponding logic is compiled in.
+    it = iter(refs)
+    vals_ref = next(it)       # (sb, n) int32   sender values in [0, V)
+    senders_ref = next(it)    # (sb, n) int32   1 = colmask & active
+    rowmask_ref = next(it) if rowmasked else None  # (sb, n) int32
+    side_ref = next(it) if sided else None         # (sb, n) int32
+    salt0_ref = next(it)      # (S,) int32 [SMEM]  per-scenario salt
+    salt1_ref = next(it)      # (S,) int32 [SMEM]  round-premixed salt
+    p8_ref = next(it)         # (S,) int32 [SMEM]  drop threshold [0, 256]
+    out_ref = next(it)        # (sb, V, n) f32  counts (diag added outside)
+    n = vals_ref.shape[1]
+    b = pl.program_id(0)
+    notdiag = jax.lax.broadcasted_iota(
+        jnp.int32, (n, n), 0
+    ) != jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
 
-    sender = jax.lax.broadcasted_iota(jnp.int32, (n, tile), 0)
-    recv = jax.lax.broadcasted_iota(jnp.int32, (n, tile), 1) + t * tile
-
-    p8 = p8_ref[s]
-
-    def keep_links():
+    def per_scenario(s, _):
+        g = b * sb + s
+        p8 = p8_ref[g]
         if mode == "hash":
             # bit-exact replica of scenarios.link_bernoulli: idx = j * n + i
+            # (kernel layout here is [sender i, receiver j] = idx j*n + i
+            # with i along rows: build idx from iotas transposed)
+            sender = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+            recv = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
             idx = (recv * n + sender).astype(jnp.uint32)
-            z = idx * jnp.uint32(_GOLD) + salt0_ref[s].astype(jnp.uint32)
-            z = z ^ salt1_ref[s].astype(jnp.uint32)
-            z = _fmix32(z)
-            return (z & jnp.uint32(0xFF)) >= p8.astype(jnp.uint32)
-        # hw: TPU hardware PRNG; stream keyed by (scenario-round seed, tile)
-        pltpu.prng_seed(salt1_ref[s] ^ (t * jnp.int32(_GOLD - (1 << 32))))
-        bits = pltpu.prng_random_bits((n, tile))
-        return (bits & jnp.uint32(0xFF)) >= p8.astype(jnp.uint32)
+            z = idx * jnp.uint32(_GOLD) + salt0_ref[g].astype(jnp.uint32)
+            z = z ^ salt1_ref[g].astype(jnp.uint32)
+            keep = (_fmix32(z) & jnp.uint32(0xFF)) >= p8.astype(jnp.uint32)
+        else:
+            # hw PRNG: full-word UNSIGNED threshold — P(bits >= p8·2^24) is
+            # exactly 1 - p8/256.  prng_random_bits yields int32 on this
+            # stack, so bitcast both sides to uint32 or the compare is
+            # signed (measured: p8=0 kept only the non-negative half).
+            # p8 is clamped to 255 (thr 256<<24 overflows to 0): hw mode
+            # quantizes a total blackout to 255/256 — the hash mode stays
+            # exact for parity.
+            pltpu.prng_seed(salt1_ref[g])
+            bits = pltpu.prng_random_bits((n, n)).astype(jnp.uint32)
+            thr = (jnp.minimum(p8, 255).astype(jnp.uint32) << 24)
+            keep = bits >= thr
+        keep = keep & notdiag
+        if sided:
+            side = side_ref[s]
+            keep = keep & (side[:, None] == side[None, :])
+        onehot = (
+            vals_ref[s][None, :]
+            == jax.lax.broadcasted_iota(jnp.int32, (num_values, n), 0)
+        ) & (senders_ref[s] != 0)[None, :]
+        counts = jnp.dot(
+            onehot.astype(jnp.bfloat16),
+            keep.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        if rowmasked:
+            counts = counts * (rowmask_ref[s] != 0)[None, :].astype(jnp.float32)
+        out_ref[s] = counts
+        return 0
 
-    # no lax.cond here: yielding vector masks from scf branches crashes the
-    # Mosaic lowering; p8 == 0 scenarios just keep every link instead
-    keep = keep_links() | (p8 <= 0)
-
-    side_eq = side_s_ref[0, 0][:, None] == side_r_ref[0, 0][None, :]
-    ho = (colmask_ref[0, 0][:, None] != 0) & side_eq & keep
-    ho = ho | (sender == recv)
-    deliver = ho & (active_ref[0, 0][:, None] != 0) & (rowmask_ref[0, 0][None, :] != 0)
-
-    vrange = jax.lax.broadcasted_iota(jnp.int32, (num_values, n), 0)
-    onehot_t = (vals_ref[0, 0][None, :] == vrange).astype(jnp.bfloat16)
-
-    out_ref[0] = jnp.dot(
-        onehot_t,
-        deliver.astype(jnp.bfloat16),
-        preferred_element_type=jnp.float32,
-    )
+    jax.lax.fori_loop(0, sb, per_scenario, 0)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_values", "mode", "tile", "interpret"),
+    static_argnames=("num_values", "mode", "sb", "interpret"),
 )
 def hist_exchange(
     vals: jnp.ndarray,      # [S, n] int32
     active: jnp.ndarray,    # [S, n] bool/int32
     colmask: jnp.ndarray,   # [S, n] bool/int32
-    rowmask: jnp.ndarray,   # [S, n] bool/int32
-    side: jnp.ndarray,      # [S, n] int32
+    rowmask: Optional[jnp.ndarray],  # [S, n] bool/int32, or None (= all on)
+    side: Optional[jnp.ndarray],     # [S, n] int32, or None (= no partition)
     salt0: jnp.ndarray,     # [S] int32
     salt1r: jnp.ndarray,    # [S] int32 (round premixed: see fault_salts)
     p8: jnp.ndarray,        # [S] int32
     num_values: int,
     mode: str = "hw",
-    tile: int = 128,
+    sb: int = 8,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Fused masked exchange + per-value histogram.
@@ -140,56 +157,82 @@ def hist_exchange(
     Returns counts [S, num_values, n] float32 (exact integers):
     counts[s, v, j] = number of senders i with deliver[s, j, i] and
     vals[s, i] == v.  See module docstring for the deliver semantics.
+    Pass side=None / rowmask=None to compile out the partition / dest-mask
+    logic (the common case on the fast path).
     """
     S, n = vals.shape
-    if n < tile:
-        tile = n  # small groups: one receiver tile (block == array dim)
-    assert n % tile == 0, (n, tile)
+    orig_S = S
+    if S % sb:
+        pad = sb - S % sb
+        padz = lambda x: jnp.concatenate(
+            [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)], axis=0
+        )
+        vals, active, colmask = padz(vals), padz(active), padz(colmask)
+        rowmask = padz(rowmask) if rowmask is not None else None
+        side = padz(side) if side is not None else None
+        salt0, salt1r, p8 = padz(salt0), padz(salt1r), padz(p8)
+        S += pad
     # the count plane is the (sublane, lane) tile of the output: pad V up to
     # the f32 sublane quantum; padded values match no payload (counts 0)
     v_out = num_values
     if num_values % 8 and not interpret:
         num_values = num_values + (8 - num_values % 8)
-    to_i32 = lambda x: x.astype(jnp.int32).reshape(S, 1, n)
-    to_smem = lambda x: x.astype(jnp.int32).reshape(S)
 
-    grid = (S, n // tile)
-    row_spec = pl.BlockSpec((1, 1, n), lambda s, t: (s, 0, 0))
-    tile_spec = pl.BlockSpec((1, 1, tile), lambda s, t: (s, 0, t))
-    smem_spec = pl.BlockSpec((S,), lambda s, t: (0,), memory_space=pltpu.SMEM)
+    senders = (colmask.astype(jnp.int32) != 0) & (active.astype(jnp.int32) != 0)
+    # p8 = 256 is a total blackout: no non-self link delivers.  The in-kernel
+    # hw threshold clamps at 255 (256 << 24 overflows), so realize blackout
+    # exactly by silencing every sender for those scenarios — O(S·n), no
+    # per-link cost, and identical to the hash/oracle semantics (the self
+    # link is re-added outside from `active` alone, matching ho | (i == j)).
+    senders = senders & (p8 < 256)[:, None]
+    senders = senders.astype(jnp.int32)
+    sided = side is not None
+    rowmasked = rowmask is not None
+
+    grid = (S // sb,)
+    blk_spec = pl.BlockSpec((sb, n), lambda b: (b, 0))
+    smem_spec = pl.BlockSpec((S,), lambda b: (0,), memory_space=pltpu.SMEM)
 
     kernel = functools.partial(
-        _kernel, num_values=num_values, tile=tile, mode=mode
+        _kernel, num_values=num_values, sb=sb, mode=mode,
+        sided=sided, rowmasked=rowmasked,
     )
+    # compiled-out operands (rowmask/side = None) are not streamed at all —
+    # a dead [S, n] zeros array would still cost a VMEM DMA per grid step
+    operands = [vals.astype(jnp.int32), senders]
+    specs = [blk_spec, blk_spec]
+    if rowmasked:
+        operands.append(rowmask.astype(jnp.int32))
+        specs.append(blk_spec)
+    if sided:
+        operands.append(side.astype(jnp.int32))
+        specs.append(blk_spec)
+    operands += [
+        salt0.astype(jnp.int32), salt1r.astype(jnp.int32), p8.astype(jnp.int32)
+    ]
+    specs += [smem_spec] * 3
     counts = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            row_spec,   # vals
-            row_spec,   # active
-            row_spec,   # colmask
-            tile_spec,  # rowmask
-            row_spec,   # side (sender view)
-            tile_spec,  # side (receiver view)
-            smem_spec,  # salt0
-            smem_spec,  # salt1r
-            smem_spec,  # p8
-        ],
-        out_specs=pl.BlockSpec((1, num_values, tile), lambda s, t: (s, 0, t)),
+        in_specs=specs,
+        out_specs=pl.BlockSpec((sb, num_values, n), lambda b: (b, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((S, num_values, n), jnp.float32),
         interpret=interpret,
-    )(
-        to_i32(vals),
-        to_i32(active),
-        to_i32(colmask),
-        to_i32(rowmask),
-        to_i32(side),
-        to_i32(side),  # same array, receiver-tile view (tile_spec)
-        to_smem(salt0),
-        to_smem(salt1r),
-        to_smem(p8),
+    )(*operands)
+    counts = counts[:orig_S, :v_out, :]
+    # self-delivery (Round.scala:114-117): a process always hears itself
+    # while it is active and selected by the dest mask — the random-mask
+    # diagonal was erased in-kernel, so this O(S·n) scatter is the whole
+    # diagonal contribution
+    vals, active = vals[:orig_S], active[:orig_S]
+    self_on = active.astype(jnp.float32)
+    if rowmasked:
+        self_on = self_on * (rowmask[:orig_S] != 0)
+    onehot_self = (
+        vals[:, None, :]
+        == jnp.arange(v_out, dtype=jnp.int32)[None, :, None]
     )
-    return counts[:, :v_out, :]
+    return counts + onehot_self * self_on[:, None, :]
 
 
 def hist_exchange_reference(
@@ -218,6 +261,10 @@ def hist_exchange_reference(
         )  # [j, V]
         return counts.T  # [V, j]
 
+    if rowmask is None:
+        rowmask = jnp.ones((S, n), dtype=jnp.int32)
+    if side is None:
+        side = jnp.zeros((S, n), dtype=jnp.int32)
     return jax.vmap(one)(
         vals, active, colmask, rowmask, side, salt0, salt1r, p8
     )

@@ -1,0 +1,66 @@
+"""On-device benchmark summaries shared by bench.py and apps/ladder.py.
+
+Timing discipline (round-1 verdict): on this platform block_until_ready can
+return before a computation completes, so timed regions must end at a
+device→host transfer — but transferring raw [S, n] outputs costs ~1 s over
+the dev tunnel.  These O(1)-size reductions force the full computation while
+keeping the transfer negligible.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def decided_summary(
+    decided: jnp.ndarray,
+    dec_round: jnp.ndarray,
+    max_rounds: int,
+    decision: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, ...]:
+    """(decided count, decided-round histogram[, decision checksum]).
+
+    dec_round is -1 for undecided lanes; they are binned at `max_rounds` and
+    sliced off the histogram.  The checksum (when a decision array is given)
+    makes the summary depend on the decided *values*, not just the flags."""
+    cnt = jnp.sum(decided.astype(jnp.int32))
+    hist = jnp.bincount(
+        jnp.where(decided, dec_round, max_rounds).reshape(-1),
+        length=max_rounds + 1,
+    )[:max_rounds]
+    if decision is None:
+        return cnt, hist
+    checksum = jnp.sum(jnp.where(decided, decision, 0).astype(jnp.int32))
+    return cnt, hist, checksum
+
+
+def p50_from_hist(hist: np.ndarray) -> float:
+    """Median bin of a histogram (-1 when empty)."""
+    hist = np.asarray(hist)
+    total = int(hist.sum())
+    if total == 0:
+        return -1.0
+    return float(np.searchsorted(np.cumsum(hist), (total + 1) // 2))
+
+
+def speed_extra(
+    best: float,
+    rounds: int,
+    cnt,
+    hist,
+    lanes: int,
+    p50_key: str = "decided_round_p50",
+) -> dict:
+    """The shared stats block: throughput + decision health from an
+    on-device (count, histogram) summary.  `p50_key` names the histogram's
+    unit ("decided_phase_p50" when the engine reports phase indices)."""
+    return {
+        "rounds_per_sec": round(rounds / best, 3),
+        "wall_s_per_run": round(best, 4),
+        "rounds_per_run": rounds,
+        "frac_lanes_decided": round(float(cnt) / lanes, 4),
+        p50_key: p50_from_hist(hist),
+    }
