@@ -14,6 +14,8 @@ from round_tpu.models.lastvoting_variants import (
     ShortLastVoting,
     mlv_io,
 )
+from round_tpu.models.lastvoting_event import LastVotingEvent
+from round_tpu.models.tpc_event import TpcEState, TwoPhaseCommitEvent
 from round_tpu.models.tpc import TwoPhaseCommit, TpcState, tpc_io
 from round_tpu.models.kset import (
     KSetAgreement,
@@ -46,6 +48,9 @@ __all__ = [
     "TwoPhaseCommit",
     "TpcState",
     "tpc_io",
+    "LastVotingEvent",
+    "TwoPhaseCommitEvent",
+    "TpcEState",
     "KSetAgreement",
     "KSetEarlyStopping",
     "KSetState",

@@ -1,0 +1,180 @@
+"""LastVoting with event rounds — the open-round (OOPSLA'20) variant.
+
+Protocol (reference: example/LastVotingEvent.scala:25-201): the same 4-round
+Paxos-as-HO phase as the closed LastVoting, but expressed with per-message
+receive handlers and fine-grained Progress control:
+
+  round 1 (collect): processes send (x, ts) to coord; coord folds a running
+    max-timestamp (``payload._2 >= maxTime`` — the LAST arrival wins ties,
+    :77-81) seeded with its OWN x (init: maxVal = x, :58), commits when it
+    heard a majority — except in the very first round, where it goAheads
+    immediately and proposes its own value (:60-62).
+  round 2 (propose): committed coord broadcasts vote; receivers adopt
+    x := payload, ts := phase (:112-116).
+  round 3 (ack): adopters send x to coord; coord is ready on a majority
+    (:146-155).
+  round 4 (decide): ready coord broadcasts vote; receivers decide, reset
+    ready/commit, and exit once decided (:184-193).
+
+Implemented on ``FoldRound`` (core/rounds.py): each receive-fold becomes a
+masked O(log n) tree reduction.  Fold order is sender-id order, so the
+``>=`` running max lowers to a lexicographic (ts, sender_id) maximum —
+bit-identical to the sequential EventRound adapter at any n (tested against
+it in tests/test_event_models.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import FoldRound, RoundCtx, broadcast, unicast
+from round_tpu.models.common import consensus_io, ghost_decide
+from round_tpu.models.lastvoting import LVState
+
+
+def _coord(ctx: RoundCtx):
+    return (ctx.r // 4) % ctx.n
+
+
+class LVECollect(FoldRound):
+    """Round 1: (x, ts) to coord; running (ts, sender)-lex max; commit."""
+
+    def send(self, ctx: RoundCtx, state: LVState):
+        # r == 0: nothing is sent (LastVotingEvent.scala:68-73)
+        return unicast(ctx, _coord(ctx), {"x": state.x, "ts": state.ts},
+                       guard=ctx.r != 0)
+
+    def zero(self, ctx: RoundCtx, state: LVState):
+        # the coord's own x seeds the running max with ts = -1 and a
+        # sender id below every real one, so any message with ts >= -1
+        # replaces it — exactly the adapter's `>=` semantics (:58, :77-81)
+        return {"ts": jnp.asarray(-1, jnp.int32),
+                "id": jnp.asarray(-1, jnp.int32),
+                "x": state.x}
+
+    def lift(self, ctx: RoundCtx, state: LVState, sender, payload):
+        return {"ts": payload["ts"], "id": sender.astype(jnp.int32),
+                "x": payload["x"]}
+
+    def combine(self, a, b):
+        b_wins = (b["ts"] > a["ts"]) | ((b["ts"] == a["ts"]) & (b["id"] >= a["id"]))
+        pick = lambda x, y: jnp.where(b_wins, y, x)
+        return {"ts": pick(a["ts"], b["ts"]), "id": pick(a["id"], b["id"]),
+                "x": pick(a["x"], b["x"])}
+
+    def go_ahead(self, ctx: RoundCtx, state: LVState, m, count):
+        # init: r == 0 or non-coord goAhead immediately; coord otherwise
+        # needs a majority (:60-64, :82-83)
+        return (ctx.r == 0) | (ctx.id != _coord(ctx)) | (count > ctx.n // 2)
+
+    def post(self, ctx: RoundCtx, state: LVState, m, count, did_timeout):
+        act = (ctx.id == _coord(ctx)) & ~did_timeout
+        return state.replace(
+            commit=state.commit | act,
+            vote=jnp.where(act, m["x"], state.vote),
+        )
+
+
+class _CoordMessage(FoldRound):
+    """Shared monoid for rounds that only consume the coordinator's
+    broadcast: keep the payload that came from coord."""
+
+    def zero(self, ctx: RoundCtx, state: LVState):
+        return {"got": jnp.asarray(False), "v": jnp.asarray(0, jnp.int32)}
+
+    def lift(self, ctx: RoundCtx, state: LVState, sender, payload):
+        from_coord = sender == _coord(ctx)
+        return {"got": from_coord,
+                "v": jnp.where(from_coord, payload, 0).astype(jnp.int32)}
+
+    def combine(self, a, b):
+        pick = lambda x, y: jnp.where(b["got"], y, x)
+        return {"got": a["got"] | b["got"], "v": pick(a["v"], b["v"])}
+
+
+class LVEPropose(_CoordMessage):
+    """Round 2: committed coord broadcasts vote; receivers adopt."""
+
+    def send(self, ctx: RoundCtx, state: LVState):
+        return broadcast(ctx, state.vote,
+                         guard=(ctx.id == _coord(ctx)) & state.commit)
+
+    def go_ahead(self, ctx: RoundCtx, state: LVState, m, count):
+        # non-committed coord goAheads immediately (:99-101); receivers
+        # goAhead on the coord's message (:117)
+        return m["got"] | ((ctx.id == _coord(ctx)) & ~state.commit)
+
+    def post(self, ctx: RoundCtx, state: LVState, m, count, did_timeout):
+        return state.replace(
+            x=jnp.where(m["got"], m["v"], state.x),
+            ts=jnp.where(m["got"], ctx.r // 4, state.ts),
+        )
+
+
+class LVEAck(FoldRound):
+    """Round 3: adopters ack; coord ready on majority."""
+
+    def send(self, ctx: RoundCtx, state: LVState):
+        return unicast(ctx, _coord(ctx), state.x,
+                       guard=state.ts == ctx.r // 4)
+
+    def zero(self, ctx: RoundCtx, state: LVState):
+        return jnp.asarray(0, jnp.int32)
+
+    def lift(self, ctx: RoundCtx, state: LVState, sender, payload):
+        return jnp.asarray(1, jnp.int32)
+
+    def combine(self, a, b):
+        return a + b
+
+    def go_ahead(self, ctx: RoundCtx, state: LVState, m, count):
+        return (ctx.id != _coord(ctx)) | (count > ctx.n // 2)
+
+    def post(self, ctx: RoundCtx, state: LVState, m, count, did_timeout):
+        # ready = (!didTimeout && id == coord)  (:153-155)
+        return state.replace(ready=(ctx.id == _coord(ctx)) & ~did_timeout)
+
+
+class LVEDecide(_CoordMessage):
+    """Round 4: ready coord broadcasts vote; receivers decide and exit."""
+
+    def send(self, ctx: RoundCtx, state: LVState):
+        return broadcast(ctx, state.vote,
+                         guard=(ctx.id == _coord(ctx)) & state.ready)
+
+    def go_ahead(self, ctx: RoundCtx, state: LVState, m, count):
+        return m["got"] | ((ctx.id == _coord(ctx)) & ~state.ready)
+
+    def post(self, ctx: RoundCtx, state: LVState, m, count, did_timeout):
+        state = ghost_decide(state, m["got"], m["v"])
+        ctx.exit_at_end_of_round(state.decided)
+        return state.replace(ready=jnp.asarray(False),
+                             commit=jnp.asarray(False))
+
+
+class LastVotingEvent(Algorithm):
+    """Event-round LastVoting (LastVotingEvent.scala:25-201)."""
+
+    def __init__(self):
+        self.rounds = (LVECollect(), LVEPropose(), LVEAck(), LVEDecide())
+        from round_tpu.models.lastvoting import LVSpec
+
+        self.spec = LVSpec()
+
+    def make_init_state(self, ctx: RoundCtx, io) -> LVState:
+        return LVState(
+            x=jnp.asarray(io["initial_value"], dtype=jnp.int32),
+            ts=jnp.asarray(-1, jnp.int32),
+            ready=jnp.asarray(False),
+            commit=jnp.asarray(False),
+            vote=jnp.asarray(0, jnp.int32),
+            decided=jnp.asarray(False),
+            decision=jnp.asarray(-1, jnp.int32),
+        )
+
+    def decided(self, state: LVState):
+        return state.decided
+
+    def decision(self, state: LVState):
+        return state.decision

@@ -1,0 +1,190 @@
+"""Two-Phase Commit with event rounds: blocking / timeout / all-or-quorum.
+
+Protocol (reference: example/TwoPhaseCommitEvent.scala:26-114): the same
+3-round 2PC as the closed model, but with the reference's two progress
+modes per round:
+
+  blocking=True  → Progress.waitMessage: the round cannot end until its
+    goAhead condition fires.  In the lockstep HO model a lane whose
+    condition never fires is DEADLOCKED (the reference process waits
+    forever); it freezes — ``blocked`` ghost set, lane exits undecided.
+  blocking=False → Progress.timeout: the round ends anyway and the handler
+    sees didTimeout (the reference default; decisions may then be taken on
+    partial information, exactly as in the reference).
+
+  ``all``: round 2's coordinator waits for ALL n votes before committing;
+  with all=False it short-circuits to abort on the first NO
+  (TwoPhaseCommitEvent.scala:64-66: (!all && !ok) || nMsg == n).
+
+Rounds:
+  1: coord broadcasts PrepareCommit; any message → goAhead (:36-48).
+  2: everyone votes to coord; coord folds ok &= vote (:54-75); decision is
+     set from the heard votes even on timeout (finishRound, :69-74).
+  3: coord broadcasts the decision; receivers decide it; a lane that heard
+     nothing decides None (-1, coordinator suspected); everyone exits
+     (finishRound returns false, :95-101).
+
+Decision encoding matches models/tpc.py: {-1 = None, 0 = abort, 1 = commit}.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import FoldRound, RoundCtx, broadcast, unicast
+from round_tpu.models.tpc import DEC_ABORT, DEC_COMMIT, DEC_NONE
+
+
+@flax.struct.dataclass
+class TpcEState:
+    coord: jnp.ndarray     # int32, fixed coordinator id
+    vote: jnp.ndarray      # bool, this process's canCommit
+    decision: jnp.ndarray  # int32 in {-1, 0, 1}
+    decided: jnp.ndarray   # bool (ghost: callback fired)
+    blocked: jnp.ndarray   # bool (ghost: waitMessage deadlock)
+
+
+class _TpcERound(FoldRound):
+    def __init__(self, blocking: bool, all_votes: bool):
+        self.blocking = blocking
+        self.all_votes = all_votes
+
+    def _block_or_pass(self, ctx, state, ok_to_proceed):
+        """waitMessage semantics: a lane whose condition did not fire
+        freezes (deadlock ghost) instead of timing out."""
+        if not self.blocking:
+            return state
+        newly_blocked = ~ok_to_proceed & ~state.blocked
+        ctx.exit_at_end_of_round(newly_blocked)
+        return state.replace(blocked=state.blocked | newly_blocked)
+
+
+class TpcEPrepare(_TpcERound):
+    """Round 1: PrepareCommit broadcast; heard anything → goAhead."""
+
+    def send(self, ctx: RoundCtx, state: TpcEState):
+        return broadcast(ctx, jnp.asarray(True), guard=ctx.id == state.coord)
+
+    def zero(self, ctx: RoundCtx, state: TpcEState):
+        return jnp.asarray(False)
+
+    def lift(self, ctx: RoundCtx, state: TpcEState, sender, payload):
+        return jnp.asarray(True)
+
+    def combine(self, a, b):
+        return a | b
+
+    def go_ahead(self, ctx: RoundCtx, state: TpcEState, m, count):
+        return m
+
+    def post(self, ctx: RoundCtx, state: TpcEState, m, count, did_timeout):
+        return self._block_or_pass(ctx, state, ~did_timeout)
+
+
+class TpcEVote(_TpcERound):
+    """Round 2: votes to coord; ok &= payload; decision from heard votes."""
+
+    def send(self, ctx: RoundCtx, state: TpcEState):
+        return unicast(ctx, state.coord, state.vote)
+
+    def zero(self, ctx: RoundCtx, state: TpcEState):
+        return jnp.asarray(True)
+
+    def lift(self, ctx: RoundCtx, state: TpcEState, sender, payload):
+        return payload
+
+    def combine(self, a, b):
+        return a & b
+
+    def go_ahead(self, ctx: RoundCtx, state: TpcEState, m, count):
+        nonc = ctx.id != state.coord
+        full = count == ctx.n
+        early_no = (~m) if not self.all_votes else jnp.asarray(False)
+        return nonc | full | early_no
+
+    def post(self, ctx: RoundCtx, state: TpcEState, m, count, did_timeout):
+        is_coord = ctx.id == state.coord
+        dec = jnp.where(m, DEC_COMMIT, DEC_ABORT).astype(jnp.int32)
+        # timeout mode: finishRound runs even on timeout (:69-74) — the
+        # coordinator judges the votes it heard.  blocking mode: a starved
+        # lane never reaches finishRound (waitMessage), so no decision is
+        # stamped before the freeze.
+        act = is_coord & ~state.blocked
+        if self.blocking:
+            act = act & ~did_timeout
+        state = state.replace(
+            decision=jnp.where(act, dec, state.decision)
+        )
+        return self._block_or_pass(ctx, state, ~did_timeout)
+
+
+class TpcECommit(_TpcERound):
+    """Round 3: decision broadcast; decide whatever arrived (None if
+    nothing); everyone exits."""
+
+    def send(self, ctx: RoundCtx, state: TpcEState):
+        return broadcast(
+            ctx, state.decision == DEC_COMMIT,
+            guard=(ctx.id == state.coord) & ~state.blocked,
+        )
+
+    def zero(self, ctx: RoundCtx, state: TpcEState):
+        return {"got": jnp.asarray(False), "v": jnp.asarray(False)}
+
+    def lift(self, ctx: RoundCtx, state: TpcEState, sender, payload):
+        return {"got": jnp.asarray(True), "v": payload}
+
+    def combine(self, a, b):
+        return {"got": a["got"] | b["got"],
+                "v": jnp.where(b["got"], b["v"], a["v"])}
+
+    def go_ahead(self, ctx: RoundCtx, state: TpcEState, m, count):
+        return m["got"]
+
+    def post(self, ctx: RoundCtx, state: TpcEState, m, count, did_timeout):
+        dec = jnp.where(
+            m["got"],
+            jnp.where(m["v"], DEC_COMMIT, DEC_ABORT),
+            DEC_NONE,
+        ).astype(jnp.int32)
+        live = ~state.blocked
+        state = state.replace(
+            decision=jnp.where(live, dec, state.decision),
+            decided=state.decided | live,
+        )
+        ctx.exit_at_end_of_round(True)  # finishRound returns false (:101)
+        return state
+
+
+class TwoPhaseCommitEvent(Algorithm):
+    """Event-round 2PC (TwoPhaseCommitEvent.scala:26-114).
+
+    blocking: waitMessage mode (lanes freeze on missing messages).
+    all_votes: coordinator needs all n votes (no early abort short-circuit).
+    """
+
+    def __init__(self, blocking: bool = False, all_votes: bool = False):
+        self.blocking = blocking
+        self.all_votes = all_votes
+        self.rounds = (
+            TpcEPrepare(blocking, all_votes),
+            TpcEVote(blocking, all_votes),
+            TpcECommit(blocking, all_votes),
+        )
+
+    def make_init_state(self, ctx: RoundCtx, io) -> TpcEState:
+        return TpcEState(
+            coord=jnp.asarray(io["coord"], dtype=jnp.int32),
+            vote=jnp.asarray(io["can_commit"], dtype=bool),
+            decision=jnp.asarray(DEC_NONE, dtype=jnp.int32),
+            decided=jnp.asarray(False),
+            blocked=jnp.asarray(False),
+        )
+
+    def decided(self, state: TpcEState):
+        return state.decided
+
+    def decision(self, state: TpcEState):
+        return state.decision

@@ -144,9 +144,28 @@ class InstancePool:
     def get_decision(self, instance_id: int) -> Optional[InstanceResult]:
         return self.decision_log.get(instance_id % MAX_INSTANCE)
 
+    def adopt_decision(self, instance_id: int, value: Any) -> bool:
+        """Record a decision learned out-of-band (a FLAG_DECISION message —
+        PerfTest.onDecision, PerfTest.scala:64-84): stop any local run and
+        log the value.  Returns False if we already had it (the reference's
+        getDec(inst).isEmpty guard)."""
+        iid = instance_id % MAX_INSTANCE
+        if iid in self.decision_log:
+            return False
+        self.decision_log[iid] = InstanceResult(
+            instance_id=iid,
+            decided=np.ones((self.n,), dtype=bool),
+            decision=np.full((self.n,), value),
+            decided_round=np.full((self.n,), -1, dtype=np.int32),
+            value=value,
+        )
+        self.stop(iid)
+        return True
+
     def recover_from(self, peer: "InstancePool", instance_id: int) -> bool:
-        """Fill a gap in our log from a peer's (the Decision flag path);
-        returns True if the peer had it."""
+        """Direct-call shortcut over the Decision flag path; the
+        message-driven surface is runtime/oob.py (PoolNode/LocalBus).
+        Returns True if the peer had it."""
         iid = instance_id % MAX_INSTANCE
         got = peer.get_decision(iid)
         if got is None:

@@ -1,0 +1,206 @@
+"""Out-of-band messaging: Tag flags, the default handler, message recovery.
+
+Reference parity: every packet carries an 8-byte Tag
+``flag:1B | callStack:1B | instance:2B | round:4B`` (Tag.scala:22-25) whose
+flag routes it — Normal/Dummy to the instance dispatcher, Error reserved,
+anything else user-definable and routed to the Runtime's *defaultHandler*
+(Runtime.scala:99-101, 151-155).  The PerfTest harness builds its decision
+replay on exactly this: a normal message for an already-decided instance
+makes the peer answer with a ``Decision``-flagged message (or ``TooLate`` if
+evicted), and the laggard's defaultHandler records/stops accordingly
+(PerfTest.scala:40-60, trySendDecision :86-100); a message for an unknown
+*future* instance lazily starts it (PerfTest2.scala:72-110).
+
+In the TPU build the hot path has no packets (the round exchange is the
+fused kernel), but the *control plane* between pools keeps the reference's
+message shape: ``Message = Tag + payload`` over a host-side ``LocalBus``.
+``PoolNode`` wires an InstancePool to the bus with the reference's handler
+semantics, replacing the round-1 direct-call ``recover_from`` with a
+message-driven flow a real transport could carry unchanged (the Tag packs
+to the same 8-byte layout).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+from round_tpu.core.time import Instance
+from round_tpu.runtime.instances import InstancePool, MAX_INSTANCE
+
+# Flag space (Tag.scala:5-12): 0..2 reserved, >= 3 user-definable.
+FLAG_NORMAL = 0
+FLAG_DUMMY = 1
+FLAG_ERROR = 2
+# the PerfTest recovery protocol's user flags (PerfTest.scala:30-38)
+FLAG_DECISION = 4
+FLAG_TOO_LATE = 5
+FLAG_RECOVERY = 6
+
+
+@dataclasses.dataclass(frozen=True)
+class Tag:
+    """8-byte packet header (Tag.scala:22-62)."""
+
+    instance: int
+    round: int = 0
+    flag: int = FLAG_NORMAL
+    call_stack: int = 0
+
+    def pack(self) -> int:
+        """The reference's wire layout: flag byte 0, callStack byte 1,
+        instance bytes 2-3, round bytes 4-7."""
+        return (
+            (self.flag & 0xFF)
+            | (self.call_stack & 0xFF) << 8
+            | (self.instance & 0xFFFF) << 16
+            | (self.round & 0xFFFFFFFF) << 32
+        )
+
+    @classmethod
+    def unpack(cls, word: int) -> "Tag":
+        return cls(
+            flag=word & 0xFF,
+            call_stack=(word >> 8) & 0xFF,
+            instance=(word >> 16) & 0xFFFF,
+            round=(word >> 32) & 0xFFFFFFFF,
+        )
+
+
+@dataclasses.dataclass
+class Message:
+    """An out-of-band message: routed by tag.flag (Message.scala:15-80)."""
+
+    sender: int
+    tag: Tag
+    payload: Any = None
+
+
+class LocalBus:
+    """Host-side point-to-point wire between nodes (the control-plane
+    analogue of Runtime.sendMessage, Runtime.scala:138-143).  Delivery is
+    explicit (``deliver``/``deliver_all``) so tests can reorder/drop —
+    faults on the control plane, like the data plane's HO masks."""
+
+    def __init__(self):
+        self._nodes: Dict[int, "PoolNode"] = {}
+        self._queues: Dict[int, List[Message]] = {}
+
+    def register(self, node: "PoolNode") -> None:
+        self._nodes[node.node_id] = node
+        self._queues.setdefault(node.node_id, [])
+
+    def send(self, to: int, msg: Message) -> None:
+        if to in self._queues:  # unknown peers: dropped, like UDP
+            self._queues[to].append(msg)
+
+    def deliver(self, node_id: int, limit: Optional[int] = None) -> int:
+        """Hand queued messages to the node's default handler; returns the
+        number delivered."""
+        q = self._queues.get(node_id, [])
+        k = len(q) if limit is None else min(limit, len(q))
+        batch, self._queues[node_id] = q[:k], q[k:]
+        node = self._nodes[node_id]
+        for m in batch:
+            node.default_handler(m)
+        return k
+
+    def deliver_all(self) -> int:
+        total = 0
+        while any(self._queues.values()):
+            for nid in list(self._queues):
+                total += self.deliver(nid)
+        return total
+
+
+class PoolNode:
+    """An InstancePool attached to the bus with the reference's
+    defaultHandler semantics (PerfTest.scala:40-60, PerfTest2.scala:72-110).
+
+    - normal-flag message for an instance we already decided → reply
+      FLAG_DECISION with the value (trySendDecision);
+    - normal-flag for an instance past our window that we no longer have →
+      reply FLAG_TOO_LATE;
+    - normal-flag for an unknown *future* instance → lazy join: start it
+      via ``on_unknown_instance`` (PerfTest2's startInstance path);
+    - FLAG_DECISION → record the decision, stop any local run of it;
+    - FLAG_TOO_LATE → stop the local run (the value is unrecoverable here);
+    - FLAG_RECOVERY → explicit ask: same answer path as a normal probe.
+    """
+
+    def __init__(
+        self,
+        node_id: int,
+        pool: InstancePool,
+        bus: LocalBus,
+        on_unknown_instance: Optional[Callable[[int], None]] = None,
+        on_decision: Optional[Callable[[int, Any], None]] = None,
+    ):
+        self.node_id = node_id
+        self.pool = pool
+        self.bus = bus
+        self.on_unknown_instance = on_unknown_instance
+        self.on_decision = on_decision
+        self.version = 0  # highest instance id this node has opened
+        bus.register(self)
+
+    # -- outgoing ----------------------------------------------------------
+
+    def note_opened(self, instance_id: int) -> None:
+        iid = instance_id % MAX_INSTANCE
+        if Instance.lt(self.version, iid):
+            self.version = iid
+
+    def ask_decision(self, peer: int, instance_id: int) -> None:
+        """Ask a peer for an old instance's outcome (Recovery flag)."""
+        self.bus.send(
+            peer,
+            Message(self.node_id, Tag(instance_id % MAX_INSTANCE,
+                                      flag=FLAG_RECOVERY)),
+        )
+
+    def probe(self, peer: int, instance_id: int, round_: int = 0) -> None:
+        """A normal protocol message that leaks to a peer's default handler
+        (the implicit recovery trigger: the laggard's old traffic)."""
+        self.bus.send(
+            peer,
+            Message(self.node_id, Tag(instance_id % MAX_INSTANCE, round_)),
+        )
+
+    # -- incoming ----------------------------------------------------------
+
+    def default_handler(self, msg: Message) -> None:
+        tag = msg.tag
+        iid = tag.instance
+        if tag.flag in (FLAG_NORMAL, FLAG_DUMMY, FLAG_RECOVERY):
+            res = self.pool.get_decision(iid)
+            if res is not None and res.value is not None:
+                # only an actual decision is replayable (trySendDecision's
+                # getDec match, PerfTest.scala:86-100); an instance that
+                # *finished* undecided falls through to TooLate below
+                self.bus.send(
+                    msg.sender,
+                    Message(self.node_id, Tag(iid, flag=FLAG_DECISION),
+                            payload=res.value),
+                )
+            elif self.pool.is_running(iid):
+                pass  # live instance: the data plane handles it
+            elif res is not None or Instance.lt(iid, self.version):
+                # finished-undecided here, or older than anything we kept:
+                # unrecoverable from us
+                self.bus.send(
+                    msg.sender,
+                    Message(self.node_id, Tag(iid, flag=FLAG_TOO_LATE)),
+                )
+            elif tag.flag != FLAG_RECOVERY and self.on_unknown_instance:
+                # future instance: lazy join (PerfTest2.scala:72-83)
+                self.on_unknown_instance(iid)
+                self.note_opened(iid)
+        elif tag.flag == FLAG_DECISION:
+            self.pool.adopt_decision(iid, msg.payload)
+            if self.on_decision:
+                self.on_decision(iid, msg.payload)
+        elif tag.flag == FLAG_TOO_LATE:
+            self.pool.stop(iid)
+        else:
+            raise ValueError(f"unknown or error flag: {tag.flag}")

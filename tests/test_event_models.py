@@ -1,0 +1,287 @@
+"""Event-round models: LastVotingEvent, TwoPhaseCommitEvent, FoldRound.
+
+The load-bearing test is the FoldRound-vs-EventRound differential: the
+vectorized O(log n) fold must be bit-identical to the sequential per-message
+adapter (which is the reference semantics refined to sender-id order) on the
+same HO schedules — including the `>=` running-max tie-breaking of
+LastVotingEvent.scala:77-81.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import EventRound, RoundCtx
+from round_tpu.engine import scenarios
+from round_tpu.engine.executor import run_instance
+from round_tpu.models import (
+    LastVoting, LastVotingEvent, TwoPhaseCommit, TwoPhaseCommitEvent,
+    consensus_io, tpc_io,
+)
+from round_tpu.models.lastvoting_event import (
+    LVEAck, LVECollect, LVEDecide, LVEPropose, _coord,
+)
+from round_tpu.models.lastvoting import LVState
+
+
+# --- sequential (adapter) clone of LVE: the reference receive code 1:1 ----
+
+class _SeqCollect(EventRound):
+    send = LVECollect.send
+
+    def update(self, ctx, state, mailbox):
+        # reference :52-86: nMsg/maxTime/maxVal fold in arrival (= id) order
+        import functools
+
+        m0 = (jnp.asarray(-1, jnp.int32), state.x, jnp.asarray(0, jnp.int32))
+
+        def body(i, carry):
+            max_ts, max_val, nmsg = carry
+            p_ts = mailbox.values["ts"][i]
+            p_x = mailbox.values["x"][i]
+            present = mailbox.mask[i]
+            takes = present & (p_ts >= max_ts)
+            return (
+                jnp.where(takes, p_ts, max_ts),
+                jnp.where(takes, p_x, max_val),
+                nmsg + present.astype(jnp.int32),
+            )
+
+        max_ts, max_val, nmsg = jax.lax.fori_loop(0, ctx.n, body, m0)
+        go = (ctx.r == 0) | (ctx.id != _coord(ctx)) | (nmsg > ctx.n // 2)
+        act = (ctx.id == _coord(ctx)) & go
+        return state.replace(
+            commit=state.commit | act,
+            vote=jnp.where(act, max_val, state.vote),
+        )
+
+
+class _SeqPropose(EventRound):
+    send = LVEPropose.send
+
+    def update(self, ctx, state, mailbox):
+        got = mailbox.mask[_coord(ctx)]
+        v = mailbox.values[_coord(ctx)]
+        return state.replace(
+            x=jnp.where(got, v, state.x),
+            ts=jnp.where(got, ctx.r // 4, state.ts),
+        )
+
+
+class _SeqAck(EventRound):
+    send = LVEAck.send
+
+    def update(self, ctx, state, mailbox):
+        nmsg = jnp.sum(mailbox.mask.astype(jnp.int32))
+        go = (ctx.id != _coord(ctx)) | (nmsg > ctx.n // 2)
+        return state.replace(ready=(ctx.id == _coord(ctx)) & go)
+
+
+class _SeqDecide(EventRound):
+    send = LVEDecide.send
+
+    def update(self, ctx, state, mailbox):
+        from round_tpu.models.common import ghost_decide
+
+        got = mailbox.mask[_coord(ctx)]
+        v = mailbox.values[_coord(ctx)]
+        state = ghost_decide(state, got, v)
+        ctx.exit_at_end_of_round(state.decided)
+        return state.replace(
+            ready=jnp.asarray(False), commit=jnp.asarray(False)
+        )
+
+
+class _SeqLVE(Algorithm):
+    def __init__(self):
+        self.rounds = (_SeqCollect(), _SeqPropose(), _SeqAck(), _SeqDecide())
+
+    make_init_state = LastVotingEvent.make_init_state
+
+    def decided(self, state):
+        return state.decided
+
+    def decision(self, state):
+        return state.decision
+
+
+def _run(algo, io, n, ho_np, phases, key=0):
+    return run_instance(
+        algo, io, n, jax.random.PRNGKey(key),
+        scenarios.from_schedule(jnp.asarray(ho_np)), max_phases=phases,
+    )
+
+
+def test_foldround_matches_sequential_adapter():
+    """LVE via FoldRound == LVE via the sequential EventRound adapter,
+    bit-for-bit, over random lossy schedules (incl. ts ties)."""
+    rng = np.random.RandomState(3)
+    for trial in range(5):
+        n = int(rng.randint(3, 9))
+        phases = 3
+        T = phases * 4
+        ho = rng.rand(T, n, n) < rng.choice([0.45, 0.8, 1.0])
+        for t in range(T):
+            np.fill_diagonal(ho[t], True)
+        init = rng.randint(0, 40, size=n).tolist()
+        a = _run(LastVotingEvent(), consensus_io(init), n, ho, phases)
+        b = _run(_SeqLVE(), consensus_io(init), n, ho, phases)
+        for name in ("x", "ts", "vote", "commit", "ready", "decided", "decision"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a.state, name)),
+                np.asarray(getattr(b.state, name)),
+                err_msg=f"trial {trial} field {name}",
+            )
+        np.testing.assert_array_equal(np.asarray(a.done), np.asarray(b.done))
+
+
+def test_lve_full_network_decides_first_phase():
+    """Full network: phase-0 coordinator proposes its OWN value (r==0
+    goAhead with maxVal = x, LastVotingEvent.scala:58-62) and everyone
+    decides it in round 4."""
+    n = 5
+    init = [7, 3, 9, 5, 4]
+    ho = np.ones((4, n, n), dtype=bool)
+    res = _run(LastVotingEvent(), consensus_io(init), n, ho, 1)
+    assert np.asarray(res.state.decided).all()
+    assert np.asarray(res.state.decision).tolist() == [init[0]] * n
+    assert np.asarray(res.done).all()
+
+
+def test_lve_agreement_validity_under_faults():
+    rng = np.random.RandomState(11)
+    for trial in range(4):
+        n = int(rng.randint(4, 10))
+        phases = 4
+        T = phases * 4
+        ho = rng.rand(T, n, n) < 0.75
+        for t in range(T):
+            np.fill_diagonal(ho[t], True)
+        init = rng.randint(0, 50, size=n).tolist()
+        res = _run(LastVotingEvent(), consensus_io(init), n, ho, phases)
+        dec = np.asarray(res.state.decision)
+        got = np.asarray(res.state.decided)
+        if got.any():
+            assert len(set(dec[got].tolist())) == 1, trial  # agreement
+            assert set(dec[got].tolist()) <= set(init), trial  # validity
+
+
+def test_tpce_timeout_mode_matches_closed_tpc():
+    """Timeout mode on schedules where every vote reaches the coordinator:
+    decision parity with the closed TwoPhaseCommit (AND of all votes)."""
+    rng = np.random.RandomState(5)
+    for trial in range(6):
+        n = int(rng.randint(3, 8))
+        votes = rng.rand(n) < 0.7
+        ho = np.ones((3, n, n), dtype=bool)
+        # drop some coord->receiver links in round 3 sometimes: receivers
+        # that hear nothing decide None in both models
+        if trial % 2:
+            ho[2, 1:, :] = rng.rand(n - 1, n) < 0.6
+            np.fill_diagonal(ho[2], True)
+        io = tpc_io(0, votes.tolist())
+        a = _run(TwoPhaseCommitEvent(blocking=False), io, n, ho, 1)
+        b = _run(TwoPhaseCommit(), io, n, ho, 1)
+        np.testing.assert_array_equal(
+            np.asarray(a.state.decision), np.asarray(b.state.decision),
+            err_msg=f"trial {trial} votes {votes}",
+        )
+        assert np.asarray(a.state.decided).all()
+
+
+def test_tpce_early_abort_short_circuit():
+    """all_votes=False: one NO vote aborts even if other votes are lost
+    (the (!all && !ok) goAhead, TwoPhaseCommitEvent.scala:64-66)."""
+    n = 4
+    votes = [True, False, True, True]
+    ho = np.ones((3, n, n), dtype=bool)
+    ho[1, 0, 2:] = False  # coord misses two YES votes; the NO arrives
+    res = _run(TwoPhaseCommitEvent(blocking=False), tpc_io(0, votes), n, ho, 1)
+    assert np.asarray(res.state.decision).tolist() == [0] * n  # abort
+
+
+def test_tpce_blocking_mode_freezes_on_silent_coordinator():
+    """blocking=True with a crashed coordinator: round-1 waitMessage never
+    fires for the other lanes — they deadlock (blocked ghost), undecided."""
+    n = 4
+    ho = np.ones((3, n, n), dtype=bool)
+    ho[:, :, 0] = False  # nobody ever hears the coordinator
+    np.fill_diagonal(ho[0], True)
+    np.fill_diagonal(ho[1], True)
+    np.fill_diagonal(ho[2], True)
+    res = _run(
+        TwoPhaseCommitEvent(blocking=True), tpc_io(0, [True] * n), n, ho, 1
+    )
+    blocked = np.asarray(res.state.blocked)
+    decided = np.asarray(res.state.decided)
+    assert blocked[1:].all()     # every non-coord lane froze in round 1
+    assert blocked[0]            # the coord then starves of votes in round 2
+    assert not decided.any()     # deadlocked lanes never decide
+    assert np.asarray(res.done).all()  # frozen lanes exited the instance
+
+
+def test_tpce_blocking_mode_full_network_commits():
+    n = 5
+    ho = np.ones((3, n, n), dtype=bool)
+    res = _run(
+        TwoPhaseCommitEvent(blocking=True, all_votes=True),
+        tpc_io(0, [True] * n), n, ho, 1,
+    )
+    assert np.asarray(res.state.decided).all()
+    assert np.asarray(res.state.decision).tolist() == [1] * n
+
+
+def test_foldround_preserves_order_for_noncommutative_monoid():
+    """The tree reduction must be a left-to-right associative grouping:
+    a concatenation-like (associative, NON-commutative) monoid over packed
+    sender ids must come out in sender-id order."""
+    from round_tpu.core.rounds import FoldRound, broadcast as bcast
+
+    class Concat(FoldRound):
+        """Monoid: fixed-width base-n digit concatenation (first 3 heard)."""
+
+        def send(self, ctx, state):
+            return bcast(ctx, ctx.id)
+
+        def zero(self, ctx, state):
+            return {"v": jnp.asarray(0, jnp.int32),
+                    "k": jnp.asarray(0, jnp.int32)}
+
+        def lift(self, ctx, state, sender, payload):
+            return {"v": payload.astype(jnp.int32),
+                    "k": jnp.asarray(1, jnp.int32)}
+
+        def combine(self, a, b):
+            take = jnp.minimum(b["k"], 3 - jnp.minimum(a["k"], 3))
+            return {"v": a["v"] * (100 ** take)
+                    + b["v"] // (100 ** jnp.maximum(b["k"] - take, 0)),
+                    "k": a["k"] + b["k"]}
+
+        def post(self, ctx, state, m, count, did_timeout):
+            return state.replace(x=m["v"])
+
+    import flax.struct
+
+    @flax.struct.dataclass
+    class St:
+        x: jnp.ndarray
+
+    class Algo(Algorithm):
+        def __init__(self):
+            self.rounds = (Concat(),)
+
+        def make_init_state(self, ctx, io):
+            return St(x=jnp.asarray(0, jnp.int32))
+
+        def decided(self, state):
+            return jnp.zeros_like(state.x, dtype=bool) if state.x.ndim else jnp.asarray(False)
+
+    for n in (5, 8, 11):
+        ho = np.ones((1, n, n), dtype=bool)
+        ho[0, :, 1] = False  # everyone misses sender 1
+        res = _run(Algo(), {"initial_value": np.zeros(n)}, n, ho, 1)
+        want = 2 if n > 2 else 0
+        # lanes hear senders {0, 2, 3, ...}: first three in id order
+        expect = 0 * 10000 + 2 * 100 + 3
+        assert np.asarray(res.state.x).tolist() == [expect] * n

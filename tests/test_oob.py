@@ -1,0 +1,154 @@
+"""Out-of-band messaging: decision replay, TooLate, lazy join (runtime/oob).
+
+Mirrors the reference's recovery choreography (PerfTest.scala:40-100,
+PerfTest2.scala:72-110): recovery happens through MESSAGES between nodes —
+a laggard's stale traffic reaching a peer's default handler triggers a
+Decision/TooLate reply — not through direct log access.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.engine import scenarios
+from round_tpu.models.otr import OTR
+from round_tpu.models.common import consensus_io
+from round_tpu.runtime.instances import InstancePool
+from round_tpu.runtime.oob import (
+    FLAG_DECISION, FLAG_NORMAL, FLAG_TOO_LATE, LocalBus, Message, PoolNode,
+    Tag,
+)
+
+
+def _pool(n=4, window=4):
+    return InstancePool(
+        OTR(), n, scenarios.full(n), max_phases=4, window=window
+    )
+
+
+def _io(n, v0):
+    return consensus_io(jnp.full((n,), v0, dtype=jnp.int32))
+
+
+def test_tag_wire_layout_roundtrip():
+    """Tag packs to the reference's 8-byte layout (Tag.scala:22-25) and
+    round-trips."""
+    t = Tag(instance=0xBEEF, round=0x12345678, flag=5, call_stack=2)
+    w = t.pack()
+    assert w & 0xFF == 5
+    assert (w >> 8) & 0xFF == 2
+    assert (w >> 16) & 0xFFFF == 0xBEEF
+    assert (w >> 32) == 0x12345678
+    assert Tag.unpack(w) == t
+
+
+def test_laggard_recovers_gap_via_messages():
+    """Node B missed instances 1-2 that node A decided.  B's stale normal
+    message for instance 1 reaches A's default handler; A answers with a
+    Decision message; B's handler logs it.  An explicit Recovery ask fills
+    instance 2."""
+    n = 4
+    bus = LocalBus()
+    a_pool, b_pool = _pool(n), _pool(n)
+    a = PoolNode(1, a_pool, bus)
+    b = PoolNode(2, b_pool, bus)
+
+    for iid, v in [(1, 7), (2, 9)]:
+        a_pool.submit(iid, _io(n, v))
+        a.note_opened(iid)
+    a_pool.run_all(jax.random.PRNGKey(0))
+    assert a_pool.get_decision(1).value == 7
+
+    # implicit: B's old-instance traffic leaks to A -> Decision reply
+    b.probe(peer=1, instance_id=1)
+    # explicit: B asks for instance 2 (Recovery flag)
+    b.ask_decision(peer=1, instance_id=2)
+    assert b_pool.get_decision(1) is None
+    bus.deliver_all()
+    assert b_pool.get_decision(1).value == 7
+    assert b_pool.get_decision(2).value == 9
+    # adopt is idempotent (PerfTest.onDecision's getDec guard)
+    assert not b_pool.adopt_decision(1, 7)
+
+
+def test_too_late_stops_the_asker():
+    """A peer that no longer has the instance (older than everything it
+    kept) answers TooLate; the asker stops its local run."""
+    n = 4
+    bus = LocalBus()
+    a_pool, b_pool = _pool(n), _pool(n)
+    a = PoolNode(1, a_pool, bus)
+    b = PoolNode(2, b_pool, bus)
+    a.note_opened(10)  # A has moved on; it never kept instance 3
+
+    b_pool.submit(3, _io(n, 5))  # B still grinding on 3
+    b.probe(peer=1, instance_id=3)
+    bus.deliver_all()
+    assert not b_pool.is_running(3)      # stopped by the TooLate reply
+    assert b_pool.get_decision(3) is None
+
+
+def test_lazy_join_on_unknown_future_instance():
+    """A normal message for an instance a node has not opened yet starts it
+    (PerfTest2.scala:72-83's startInstance-on-dispatch)."""
+    n = 4
+    bus = LocalBus()
+    a_pool, b_pool = _pool(n), _pool(n)
+    started = []
+
+    def lazy_start(iid):
+        b_pool.submit(iid, _io(n, 3))
+        started.append(iid)
+
+    a = PoolNode(1, a_pool, bus)
+    b = PoolNode(2, b_pool, bus, on_unknown_instance=lazy_start)
+
+    a.note_opened(5)
+    a.probe(peer=2, instance_id=5, round_=1)
+    bus.deliver_all()
+    assert started == [5]
+    assert b_pool.is_running(5)
+    b_pool.run_all(jax.random.PRNGKey(1))
+    assert b_pool.get_decision(5).value == 3
+
+
+def test_decision_callback_fires():
+    n = 4
+    bus = LocalBus()
+    a_pool, b_pool = _pool(n), _pool(n)
+    seen = []
+    a = PoolNode(1, a_pool, bus)
+    b = PoolNode(2, b_pool, bus, on_decision=lambda i, v: seen.append((i, v)))
+    a_pool.submit(4, _io(n, 11))
+    a.note_opened(4)
+    a_pool.run_all(jax.random.PRNGKey(2))
+    b.ask_decision(peer=1, instance_id=4)
+    bus.deliver_all()
+    assert seen == [(4, 11)]
+
+
+def test_undecided_finish_replies_too_late_not_decision():
+    """An instance that FINISHED without any lane deciding must not be
+    replayed as a Decision (value=None would poison the asker's log) — the
+    peer answers TooLate instead."""
+    n = 4
+    # only self-delivery: nobody ever reaches the 2n/3 quorum
+    lonely = np.broadcast_to(np.eye(n, dtype=bool), (4, n, n))
+    bus = LocalBus()
+    a_pool = InstancePool(
+        OTR(), n, scenarios.from_schedule(jnp.asarray(lonely.copy())),
+        max_phases=4,
+    )
+    b_pool = _pool(n)
+    a = PoolNode(1, a_pool, bus)
+    b = PoolNode(2, b_pool, bus)
+    a_pool.submit(7, _io(n, 3))
+    a.note_opened(7)
+    a_pool.run_all(jax.random.PRNGKey(0))
+    assert a_pool.get_decision(7).value is None  # finished undecided
+
+    b_pool.submit(7, _io(n, 3))
+    b.probe(peer=1, instance_id=7)
+    bus.deliver_all()
+    assert b_pool.get_decision(7) is None   # no bogus None adopted
+    assert not b_pool.is_running(7)         # TooLate stopped the local run
