@@ -144,6 +144,9 @@ class TpcECommit(_TpcERound):
         return m["got"]
 
     def post(self, ctx: RoundCtx, state: TpcEState, m, count, did_timeout):
+        # blocking: a lane that missed the decision broadcast waits forever
+        # (waitMessage) — it freezes instead of deciding None
+        state = self._block_or_pass(ctx, state, ~did_timeout)
         dec = jnp.where(
             m["got"],
             jnp.where(m["v"], DEC_COMMIT, DEC_ABORT),

@@ -96,13 +96,22 @@ class LocalBus:
 
     def deliver(self, node_id: int, limit: Optional[int] = None) -> int:
         """Hand queued messages to the node's default handler; returns the
-        number delivered."""
+        number delivered.  A handler error (e.g. a reserved/unknown flag)
+        must not discard the rest of the popped batch — the remaining
+        messages are still delivered and the first error re-raised after."""
         q = self._queues.get(node_id, [])
         k = len(q) if limit is None else min(limit, len(q))
         batch, self._queues[node_id] = q[:k], q[k:]
         node = self._nodes[node_id]
+        first_err: Optional[Exception] = None
         for m in batch:
-            node.default_handler(m)
+            try:
+                node.default_handler(m)
+            except Exception as e:  # noqa: BLE001 - per-message isolation
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
         return k
 
     def deliver_all(self) -> int:

@@ -30,7 +30,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from round_tpu.verify import quantifiers, venn
 from round_tpu.verify.formula import (
     AND, And, Application, Binding, Bool, BoolT, CARD, COMPREHENSION, EMPTYSET,
-    EQ, EXISTS, FORALL, FNONE_SYM, FOption, FSOME, FSet, FMap, Formula,
+    EQ, Eq, EXISTS, FORALL, FNONE_SYM, FOption, FSOME, FSet, FMap, Formula,
     FunT, GET, Geq, GEQ, GT, Gt, IMPLIES, IN, INTERSECTION, IS_DEFINED,
     IS_DEFINED_AT, Int, IntLit, IntT, ITE, Implies, KEYSET, LEQ, LOOKUP, LT,
     Leq, Literal, Lt, MSIZE, NEQ, NOT, Not, OR, Or, SETMINUS, SUBSET_EQ,
@@ -103,6 +103,9 @@ def rewrite_set_algebra(f: Formula) -> Formula:
             mem_b = Application(IN, [v, b]).with_type(Bool)
             return Binding(FORALL, [v], Implies(step(mem_a), step(mem_b))
                            ).with_type(Bool)
+        if g.fct == NEQ and isinstance(g.args[0].tpe, FSet):
+            eq = Application(EQ, list(g.args)).with_type(Bool)
+            return Not(step(eq))
         if g.fct == EQ and isinstance(g.args[0].tpe, FSet):
             a, b = g.args
             v = Variable(f"ext!{next(_fresh)}", elem_type(a))
@@ -294,6 +297,39 @@ def reduce_ordered(f: Formula) -> Formula:
     return out
 
 
+def theory_ground_axioms(conjuncts: Sequence[Formula]) -> List[Formula]:
+    """Ground instances of the option/tuple laws for every constructor
+    application present (OptionAxioms/TupleAxioms,
+    AxiomatizedTheories.scala:8-209, e-matching-lite): for each ground
+    Some(x): IsDefined(Some x) ∧ Get(Some x) = x; for each None: ¬IsDefined;
+    for each Tuple(a, b, ...): Fst/Snd/Trd projections.  Congruence closure
+    then transports these to opaque terms merely EQUAL to a constructor
+    (x = Some(p) ⊢ Get(x) = p), which the syntactic rewrites cannot reach."""
+    from round_tpu.verify.formula import FST, SND, TUPLE
+    from round_tpu.verify.futils import collect_ground_terms
+
+    out: List[Formula] = []
+    seen: set = set()
+    for c in conjuncts:
+        for g in collect_ground_terms(c):
+            if not isinstance(g, Application) or g in seen:
+                continue
+            seen.add(g)
+            if g.fct == FSOME:
+                out.append(Application(IS_DEFINED, [g]).with_type(Bool))
+                out.append(Eq(Application(GET, [g]).with_type(g.args[0].tpe),
+                              g.args[0]))
+            elif g.fct == FNONE_SYM:
+                out.append(Not(Application(IS_DEFINED, [g]).with_type(Bool)))
+            elif g.fct == TUPLE and len(g.args) == 2:  # pairs (3-tuples: thin)
+                for k, proj in enumerate((FST, SND)):
+                    out.append(Eq(
+                        Application(proj, [g]).with_type(g.args[k].tpe),
+                        g.args[k],
+                    ))
+    return out
+
+
 def _eliminate_int_div(f: Formula) -> Tuple[Formula, List[Formula]]:
     """Linearize integer division by a positive constant:  num // k  becomes
     a fresh q with  k·q ≤ num ≤ k·q + (k-1).  Only terms whose variables are
@@ -378,12 +414,20 @@ class ClReducer:
         for sd in setdefs:
             if sd.definition is not None:
                 d = typecheck(sd.definition)
-                d = nnf(d)
-                for c in get_conjuncts(d):
-                    if isinstance(c, Binding) and c.binder == FORALL:
-                        universals.append(c)
-                    else:
-                        ground.append(c)
+                # a comprehension body with its own quantifier (e.g. the
+                # kernel {i | ∀j. i ∈ HO(j)}) leaves the def's ↔ with a
+                # nested ∀ / (after nnf) ∃: skolemize the ∃ and prenex so
+                # instantiation can reach the inner variable
+                d = quantifiers.skolemize(nnf(d))
+                d = And(*[pnf(c) for c in get_conjuncts(d)])
+                # split like the main formula: ∀∀ chains collapse and ∀
+                # distributes over ∧, so EVERY bound variable (including
+                # ones prenexed out of the comprehension body) is
+                # instantiated — an appended ∀x.∀j clause would only ever
+                # get its outer variable substituted
+                dg, du = quantifiers._clause_split(d)
+                ground.extend(dg)
+                universals.extend(du)
 
         # round 1: eager instantiation over the ground terms
         insts = quantifiers.instantiate(
@@ -392,6 +436,7 @@ class ClReducer:
         # membership may have been β-reduced inside instances
         insts = [rewrite_set_algebra(i) for i in insts]
         base = ground + insts
+        base = base + theory_ground_axioms(base)
 
         # venn regions over everything ground so far (persistent instances:
         # the witness-round rewrite below must share card/region variables).

@@ -224,8 +224,17 @@ def ground_terms_by_type(
     out: Dict[Type, List[Formula]] = {}
     seen: Set = set()
 
-    def add(t: Formula):
+    def _contains_binding(t: Formula) -> bool:
         if isinstance(t, Binding):
+            return True
+        if isinstance(t, Application):
+            return any(_contains_binding(a) for a in t.args)
+        return False
+
+    def add(t: Formula):
+        if _contains_binding(t):
+            # e.g. an Ite/app over a still-quantified subformula from a
+            # nested-forall comprehension: not a usable candidate term
             return
         key = cc.repr_of(t) if cc is not None else t
         tag = (t.tpe, key)
@@ -256,10 +265,9 @@ def ground_terms_by_type(
             # as candidates feeds back through comprehension symbols into
             # ever-larger terms (S(Card(S(n))), ...) and never helps a proof
             skip = g.fct in _NON_MODEL_FCTS
-            if not skip and not isinstance(g.tpe, BoolT) and not any(
-                isinstance(x, Binding) for x in g.args
-            ) and is_clean(g, bound):
-                add(g)
+            if not skip and not isinstance(g.tpe, BoolT) \
+                    and is_clean(g, bound):
+                add(g)  # add() rejects Binding-containing terms itself
             for a in g.args:
                 walk(a, bound)
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import List
 
 from round_tpu.verify.formula import (
-    AND, Application, Binding, COMPREHENSION, EQ, EXISTS, FALSE, FORALL,
+    AND, Application, Binding, BoolT, COMPREHENSION, EQ, EXISTS, FALSE, FORALL,
     Formula, GEQ, GT, IMPLIES, ITE, LEQ, LT, Literal, NEQ, NOT, OR, TRUE,
     And, Eq, Exists, ForAll, Geq, Gt, Implies, Leq, Literal as Lit, Lt, Neq,
     Not, Or, Variable,
@@ -39,6 +39,22 @@ def nnf(f: Formula, neg: bool = False) -> Formula:
             if neg:
                 return And(nnf(a, False), nnf(b, True))
             return Or(nnf(a, True), nnf(b, False))
+        if f.fct in (EQ, NEQ) and f.args[0].tpe is not None \
+                and isinstance(f.args[0].tpe, BoolT):
+            # boolean equality is a biconditional, not an EUF atom — expand
+            # so the case split is visible to the SAT core (x = (|A| > t)
+            # shapes from predicate-definition axioms)
+            a, b = f.args
+            flip = neg == (f.fct == EQ)  # Eq negated or Neq positive -> xor
+            if flip:
+                return Or(
+                    And(nnf(a, False), nnf(b, True)),
+                    And(nnf(a, True), nnf(b, False)),
+                )
+            return And(
+                Or(nnf(a, True), nnf(b, False)),
+                Or(nnf(a, False), nnf(b, True)),
+            )
         if neg and f.fct in _NEG_DUAL:
             g = Application(_NEG_DUAL[f.fct], list(f.args))
             g.tpe = f.tpe

@@ -1,0 +1,328 @@
+"""CL reducer grid: the CLSuite entailment battery (logic/CLSuite.scala).
+
+Each case mirrors a reference CLSuite test (cited by its test name) and runs
+across a ClConfig grid like the reference's c2e1/c2e2/c3e2 variants
+(logic/TestCommon.scala:26-40).  UNSAT verdicts are authoritative; for SAT
+cases the assertion is only that the reducer does NOT prove UNSAT (the
+reference relies on the same asymmetry).
+
+Majority thresholds use the multiplicative encoding (2·|a| > n for
+|a| > n/2): the reference's integer division appears where the original
+formula genuinely needs it.
+"""
+
+import pytest
+
+from round_tpu.verify.cl import ClConfig, ClReducer
+from round_tpu.verify.formula import (
+    And, Application, Bool, Card, Comprehension, Eq, Exists, ForAll, FOption,
+    FNone, FSet, FSome, FST, FunT, Geq, GET, Gt, Implies, In, Int,
+    Intersection, IntLit, IS_DEFINED, Leq, Lt, Neq, Not, Or, Plus, SND,
+    SubsetEq, Times, TUPLE, Product, UnInterpreted, UnInterpretedFct,
+    Variable, procType,
+)
+from round_tpu.verify.solver import UNSAT
+from round_tpu.verify.venn import N_VAR as n
+
+i = Variable("i", procType)
+j = Variable("j", procType)
+p = Variable("p", procType)
+p1 = Variable("p1", procType)
+p2 = Variable("p2", procType)
+
+data = UnInterpretedFct("data", FunT([procType], Int))
+ho = UnInterpretedFct("HO", FunT([procType], FSet(procType)))
+
+
+def d(x):
+    return Application(data, [x]).with_type(Int)
+
+
+def HO(x):
+    return Application(ho, [x]).with_type(FSet(procType))
+
+
+GRID = (ClConfig(venn_bound=2, inst_depth=1), ClConfig(venn_bound=2, inst_depth=2))
+
+
+def assert_unsat(fs, cfgs=GRID, timeout_s=60):
+    f = And(*fs)
+    for cfg in cfgs:
+        red = ClReducer(cfg)
+        from round_tpu.verify.solver import solve_ground
+
+        if solve_ground(red.reduce(f), timeout_s=timeout_s) == UNSAT:
+            return
+    raise AssertionError(f"no config proved UNSAT: {fs}")
+
+
+def assert_sat(fs, cfgs=GRID, timeout_s=30):
+    """Soundness control: no config may claim UNSAT of a satisfiable set."""
+    f = And(*fs)
+    from round_tpu.verify.solver import solve_ground
+
+    for cfg in cfgs:
+        red = ClReducer(cfg)
+        assert solve_ground(red.reduce(f), timeout_s=timeout_s) != UNSAT, cfg
+
+
+# --- universe / membership <-> cardinality (CLSuite "universe cardinality") --
+
+def test_full_comprehension_forces_membership():
+    """CLSuite "universe cardinality => forall (2)": |{i|data=1}| = n and
+    data(j) = 0 contradict."""
+    a = Comprehension([i], Eq(d(i), 1))
+    assert_unsat([Eq(Card(a), n), Eq(d(j), 0)])
+
+
+def test_full_comprehension_contradicts_forall():
+    """CLSuite "universe cardinality => forall (1)"."""
+    a = Comprehension([i], Eq(d(i), 1))
+    assert_unsat([Eq(Card(a), n), ForAll([i], Eq(d(i), 0))])
+
+
+def test_process_j_and_one_comprehension():
+    """CLSuite "process j and one comprehension"."""
+    a = Comprehension([i], Eq(d(i), 1))
+    assert_unsat([Eq(d(j), 2), Eq(Card(a), n)])
+
+
+def test_n_zero_unsat():
+    """CLSuite "n = 0": the process universe is nonempty."""
+    assert_unsat([Eq(n, 0)])
+
+
+# --- majority intersections (CLSuite "cardinality ... intersect") -----------
+
+def test_two_majorities_intersect():
+    a = Comprehension([i], Eq(d(i), 1))
+    b = Comprehension([i], Eq(d(i), 0))
+    assert_unsat([Gt(Times(2, Card(a)), n), Gt(Times(2, Card(b)), n)])
+
+
+def test_three_comprehensions():
+    """CLSuite "cardinality three comprehensions"."""
+    x = Variable("x", Int)
+    a = Comprehension([i], Eq(d(i), 1))
+    b = Comprehension([i], Eq(d(i), 0))
+    c = Comprehension([i], Eq(d(i), x))
+    assert_unsat(
+        [
+            Gt(Times(2, Card(a)), n),
+            Lt(Times(2, Card(b)), n),
+            Gt(Times(3, Card(b)), n),
+            Gt(Times(3, Card(c)), Times(2, n)),
+        ],
+        cfgs=(ClConfig(venn_bound=3, inst_depth=1),
+              ClConfig(venn_bound=3, inst_depth=2)),
+    )
+
+
+def test_instantiate_universal_on_intersection():
+    """CLSuite "Instantiate univ on set intersection"."""
+    a = Comprehension([i], Gt(d(i), 1))
+    b = Comprehension([i], Lt(d(i), 3))
+    assert_unsat(
+        [
+            Gt(Times(2, Card(a)), n),
+            Gt(Times(2, Card(b)), n),
+            ForAll([i], Neq(d(i), 2)),
+        ]
+    )
+
+
+def test_lv_two_timestamp_majorities():
+    """CLSuite "lv 2x inv simple": two ts-threshold majorities carrying
+    different values contradict."""
+    ts = UnInterpretedFct("ts", FunT([procType], Int))
+    tsf = lambda x: Application(ts, [x]).with_type(Int)
+    d1, d2 = Variable("d1", Int), Variable("d2", Int)
+    a = Comprehension([i], Geq(tsf(i), Variable("tA", Int)))
+    b = Comprehension([i], Geq(tsf(i), Variable("tB", Int)))
+    assert_unsat(
+        [
+            ForAll([i], Implies(In(i, a), Eq(d(i), d1))),
+            ForAll([i], Implies(In(i, b), Eq(d(i), d2))),
+            Gt(Times(2, Card(a)), n),
+            Gt(Times(2, Card(b)), n),
+            Neq(d1, d2),
+        ]
+    )
+
+
+# --- BAPA set algebra --------------------------------------------------------
+
+def test_bapa_0():
+    a = Variable("A", FSet(procType))
+    b = Variable("B", FSet(procType))
+    c = Variable("C", FSet(procType))
+    assert_unsat(
+        [
+            Eq(Card(a), n),
+            Eq(Card(b), n),
+            Eq(c, Intersection(a, b)),
+            Eq(Card(c), 0),
+        ]
+    )
+
+
+def test_bapa_1():
+    a = Variable("A", FSet(procType))
+    b = Variable("B", FSet(procType))
+    assert_unsat(
+        [
+            Neq(a, b),
+            SubsetEq(a, b),
+            # |b| < |a ∪ b| — with a ⊆ b the union IS b
+            Lt(Card(b), Card(Application(
+                __import__("round_tpu.verify.formula", fromlist=["UNION"]).UNION,
+                [a, b]).with_type(FSet(procType)))),
+        ]
+    )
+
+
+def test_sets_not_equal_needs_witness():
+    """CLSuite "sets not equal": a != b with both full is UNSAT."""
+    a = Variable("A", FSet(procType))
+    b = Variable("B", FSet(procType))
+    assert_unsat([Neq(a, b), Eq(Card(a), n), Eq(Card(b), n)])
+
+
+# --- HO-set shapes (CLSuite HO tests) ----------------------------------------
+
+def test_ho_universals_and_comprehension():
+    """CLSuite "HO test: universals and comprehension"."""
+    a = Comprehension([i], Gt(Times(2, Card(HO(i))), n))
+    assert_unsat(
+        [Eq(Card(a), n), ForAll([i], Lt(Card(HO(i)), 1))],
+        cfgs=(ClConfig(venn_bound=2, inst_depth=2),),
+    )
+
+
+def test_kernel_and_not_in_own_ho():
+    """CLSuite "In Kernel and not in its HO": a majority outside its own HO
+    and a majority kernel (in everyone's HO) intersect."""
+    a = Comprehension([i], Not(In(i, HO(i))))
+    k = Comprehension([i], ForAll([j], In(i, HO(j))))
+    assert_unsat(
+        [Gt(Times(2, Card(a)), n), Gt(Times(2, Card(k)), n)],
+        cfgs=(ClConfig(venn_bound=2, inst_depth=2),),
+    )
+
+
+def test_nonempty_ho_n1():
+    """CLSuite "i notIn HO(i) > 0 and n=1"."""
+    a = Comprehension([i], Not(In(p, HO(i))))
+    assert_unsat(
+        [
+            ForAll([i], Geq(Card(HO(i)), 1)),
+            Geq(Card(a), 1),
+            Eq(n, 1),
+        ],
+        cfgs=(ClConfig(venn_bound=2, inst_depth=2),),
+    )
+
+
+# --- quantified set variables (CLSuite "majority is a quorum") ---------------
+
+def test_majority_predicate_is_quorum():
+    a = Variable("A", FSet(procType))
+    b = Variable("B", FSet(procType))
+    sa = Variable("sa", FSet(procType))
+    sb = Variable("sb", FSet(procType))
+    maj = UnInterpretedFct("majority", FunT([FSet(procType)], Bool))
+    majf = lambda s: Application(maj, [s]).with_type(Bool)
+    assert_unsat(
+        [
+            ForAll([sa], Eq(majf(sa), Gt(Times(2, Card(sa)), n))),
+            majf(a),
+            majf(b),
+            Eq(Card(Intersection(a, b)), 0),
+        ],
+        cfgs=(ClConfig(venn_bound=2, inst_depth=2),),
+    )
+
+
+# --- SAT controls (no vacuous UNSAT) ------------------------------------------
+
+def test_sat_control_majority_plus_minority():
+    a = Comprehension([i], Eq(d(i), 1))
+    b = Comprehension([i], Eq(d(i), 0))
+    assert_sat([Gt(Times(2, Card(a)), n), Lt(Times(2, Card(b)), n)])
+
+
+def test_sat_control_reference_sat1_shape():
+    """CLSuite "sat 1" (simplified shape): consistent mixed constraints."""
+    assert_sat(
+        [
+            Exists([i], Eq(d(i), 2)),
+            ForAll([i], Or(Leq(d(p1), d(i)), Eq(d(p1), 3))),
+            Not(Exists([i], Eq(d(i), 1))),
+        ]
+    )
+
+
+def test_sat_control_two_thirds():
+    a = Comprehension([i], Gt(d(i), 0))
+    assert_sat([Gt(Times(3, Card(a)), Times(2, n)), Gt(n, 3)])
+
+
+# --- options (CLSuite "options 0/1/2") ----------------------------------------
+
+def test_options_none_not_defined():
+    none = FNone(Int)
+    defined = Application(IS_DEFINED, [none]).with_type(Bool)
+    assert_unsat([defined])
+
+
+def test_options_some_get_mismatch():
+    x = Variable("x", FOption(procType))
+    get_x = Application(GET, [x]).with_type(procType)
+    defined = Application(IS_DEFINED, [x]).with_type(Bool)
+    assert_unsat(
+        [
+            Neq(p1, p2),
+            Eq(x, FSome(p1)),
+            Implies(defined, Eq(get_x, p2)),
+        ]
+    )
+
+
+def test_options_sat_control():
+    x = Variable("x", FOption(procType))
+    get_x = Application(GET, [x]).with_type(procType)
+    defined = Application(IS_DEFINED, [x]).with_type(Bool)
+    assert_sat(
+        [
+            Or(Eq(x, FSome(p1)), Eq(x, FNone(procType))),
+            Implies(defined, Eq(get_x, p1)),
+        ]
+    )
+
+
+# --- tuples (CLSuite "pairs 0") ------------------------------------------------
+
+def test_pairs():
+    tt = Product((procType, procType))
+    t1 = Variable("tpl1", tt)
+    t2 = Variable("tpl2", tt)
+    l = Variable("l", procType)
+    mk = lambda a, b: Application(TUPLE, [a, b]).with_type(tt)
+    fst = lambda t: Application(FST, [t]).with_type(procType)
+    snd = lambda t: Application(SND, [t]).with_type(procType)
+    base = [Eq(t1, mk(i, j)), Eq(t2, mk(l, j))]
+    assert_sat(base + [Neq(snd(t2), i)])
+    assert_unsat(base + [Neq(fst(t1), i)])
+
+
+# --- ordered uninterpreted types (CLSuite "ordered") ----------------------------
+
+def test_ordered_uninterpreted():
+    T = UnInterpreted("T")
+    t1, t2, t3 = (Variable(f"t{k}", T) for k in (1, 2, 3))
+    assert_unsat([Leq(t1, t2), Leq(t2, t1), Not(Eq(t1, t2))])
+    assert_unsat([Leq(t1, t2), Leq(t2, t3), Not(Leq(t1, t3))])
+    assert_unsat([Lt(t1, t2), Lt(t2, t1)])
+    assert_unsat([Leq(t1, t2), Leq(t2, t3), Leq(t3, t1), Not(Eq(t1, t3))])
+    assert_sat([Leq(t1, t2), Leq(t2, t1)])
+    assert_sat([Leq(t1, t2), Leq(t2, t3), Leq(t3, t1)])

@@ -281,7 +281,21 @@ def test_foldround_preserves_order_for_noncommutative_monoid():
         ho = np.ones((1, n, n), dtype=bool)
         ho[0, :, 1] = False  # everyone misses sender 1
         res = _run(Algo(), {"initial_value": np.zeros(n)}, n, ho, 1)
-        want = 2 if n > 2 else 0
         # lanes hear senders {0, 2, 3, ...}: first three in id order
         expect = 0 * 10000 + 2 * 100 + 3
         assert np.asarray(res.state.x).tolist() == [expect] * n
+
+
+def test_tpce_blocking_round3_freeze_on_missed_decision():
+    """blocking=True, only the round-3 decision broadcast to one lane is
+    lost: that lane freezes (waitMessage) instead of deciding None."""
+    n = 4
+    ho = np.ones((3, n, n), dtype=bool)
+    ho[2, 3, 0] = False  # lane 3 misses the coord's decision
+    res = _run(
+        TwoPhaseCommitEvent(blocking=True), tpc_io(0, [True] * n), n, ho, 1
+    )
+    blocked = np.asarray(res.state.blocked)
+    decided = np.asarray(res.state.decided)
+    assert decided[:3].all() and np.asarray(res.state.decision)[:3].tolist() == [1] * 3
+    assert blocked[3] and not decided[3]
