@@ -54,6 +54,9 @@ class ClConfig:
     venn_bound: int = 2
     inst_depth: int = 1
     max_insts: int = 50_000
+    # optional verify.qilog.QILogger recording the instantiation graph
+    # (the reference's --logQI, VerificationOptions.scala:23)
+    qi_logger: object = None
 
 
 ClDefault = ClConfig(venn_bound=2, inst_depth=1)
@@ -431,7 +434,8 @@ class ClReducer:
 
         # round 1: eager instantiation over the ground terms
         insts = quantifiers.instantiate(
-            universals, ground, depth=cfg.inst_depth, max_insts=cfg.max_insts
+            universals, ground, depth=cfg.inst_depth,
+            max_insts=cfg.max_insts, logger=cfg.qi_logger,
         )
         # membership may have been β-reduced inside instances
         insts = [rewrite_set_algebra(i) for i in insts]
@@ -460,7 +464,9 @@ class ClReducer:
             Application(EQ, [w, w]).with_type(Bool) for w in all_witnesses
         ]
         insts2 = quantifiers.instantiate(
-            universals, wit_ground, depth=cfg.inst_depth, max_insts=cfg.max_insts
+            universals, wit_ground, depth=cfg.inst_depth,
+            max_insts=cfg.max_insts, logger=cfg.qi_logger,
+            logger_base_round=100,  # witness-round instances group apart
         )
         insts2 = [rewrite_set_algebra(i) for i in insts2]
         # round 2 regenerates the round-1 instances (fresh dedup state);
@@ -481,8 +487,10 @@ class ClReducer:
         out = And(*(rewritten + constraints))
         return typecheck(out)
 
-    def check_sat(self, f: Formula) -> str:
-        return solve_ground(self.reduce(f))
+    def check_sat(self, f: Formula, timeout_s: float = 120.0) -> str:
+        # the default wall budget is the termination backstop now that
+        # solve_ground's round cap is effectively unbounded
+        return solve_ground(self.reduce(f), timeout_s=timeout_s)
 
     def entailment(self, hypothesis: Formula, conclusion: Formula) -> bool:
         """h ⊨ c  iff  h ∧ ¬c is UNSAT after reduction (CL.scala:106-108).

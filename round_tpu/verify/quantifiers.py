@@ -281,15 +281,27 @@ def instantiate(
     ground: Sequence[Formula],
     depth: int = 1,
     max_insts: int = 50_000,
+    logger=None,
+    logger_base_round: int = 0,
 ) -> List[Formula]:
     """Eager(depth) instantiation: `depth` rounds of instantiating every
     ∀-clause over every combination of known ground terms of the right type.
-    Returns the generated ground formulas (IncrementalGenerator.saturate)."""
+    Returns the generated ground formulas (IncrementalGenerator.saturate).
+
+    `logger` (verify.qilog.QILogger) records the instantiation graph —
+    a node per clause/instance, an edge per instantiating combo (the
+    reference's --logQI machinery, QILogger.scala:20-203)."""
     cc = CongruenceClosure()
     for g in ground:
         cc.add_constraints(g)
     produced: List[Formula] = []
     seen_inst: Set = set()
+    roots: dict = {}
+    if logger is not None:
+        for u in universals:
+            roots[id(u)] = logger.add_node(
+                u, round=logger_base_round, is_root=True
+            )
     # the pool seeds candidate mining; universal clauses contribute the
     # ground subterms of their bodies (bound-var-free ones)
     pool = list(ground) + list(universals)
@@ -310,6 +322,12 @@ def instantiate(
                 seen_inst.add(key)
                 inst = subst_vars(u.body, dict(zip(u.vars, combo)))
                 new.append(inst)
+                if logger is not None:
+                    dst = logger.add_node(
+                        inst, new_ground_terms=combo,
+                        round=logger_base_round + _round + 1,
+                    )
+                    logger.add_edge(roots[id(u)], dst, combo)
                 if len(seen_inst) > max_insts:
                     break
             if len(seen_inst) > max_insts:

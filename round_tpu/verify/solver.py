@@ -348,7 +348,7 @@ def _is_int(t: Formula) -> bool:
 
 
 def solve_ground(
-    f: Formula, max_rounds: int = 2000, timeout_s: Optional[float] = None
+    f: Formula, max_rounds: int = 500_000, timeout_s: Optional[float] = None
 ) -> str:
     """Satisfiability of a ground (quantifier-free) formula.  Quantified
     subformulas must have been eliminated by the CL reducer first.  The
@@ -369,8 +369,12 @@ def solve_ground(
     root = cnf.encode(f)
     cnf.clauses.append([root])
 
-    # Atom classification happens lazily per SAT model; one incremental
-    # solver session serves the whole loop (learned clauses persist).
+    # Atom theory records are computed once; one incremental solver session
+    # serves the whole loop (learned clauses persist).
+    foreign: Dict[str, Formula] = {}
+    records = [
+        (a, v, _classify_atom(a, foreign)) for a, v in cnf.atom_var.items()
+    ]
     sess = SatSession(cnf.n, cnf.clauses)
     try:
         for _ in range(max_rounds):
@@ -386,8 +390,8 @@ def solve_ground(
             if assign is None:
                 return UNSAT
             # literal values for each atom
-            atoms = [(a, assign[v]) for a, v in cnf.atom_var.items()]
-            conflict = _theory_check(atoms)
+            atoms = [(a, assign[v], rec) for a, v, rec in records]
+            conflict = _theory_check(atoms, foreign)
             if conflict is None:
                 return SAT
             # blocking clause: negate the conjunction of conflicting literals
@@ -402,9 +406,76 @@ def solve_ground(
         sess.close()
 
 
-def _theory_check(atoms: List[Tuple[Formula, bool]]) -> Optional[List[Formula]]:
+def _classify_atom(atom: Formula, foreign: Dict[str, Formula]):
+    """Per-atom theory record, computed ONCE per solve (the linearization
+    walks dominated the per-model theory check when recomputed each round).
+
+    Records:
+      ("eq", a, b, lin, neg)  — equality (lin = (coeffs, rhs) or None;
+                                 neg flips the assignment for Neq atoms)
+      ("arith", pos, neg_c)    — arith predicate; pos/neg_c = (coeffs, op,
+                                 rhs) for the True/False assignment, or None
+      ("pred",)                — uninterpreted predicate, EUF-registerable
+      ("opaque",)              — contributes nothing (quantified innards)
+    """
+
+    def lin_pair(a, b):
+        ca, ka = _linearize(a, foreign)
+        cb, kb = _linearize(b, foreign)
+        for n, v in cb.items():
+            ca[n] = ca.get(n, 0) - v
+        return ca, kb - ka  # ca·x ⋈ (kb - ka)
+
+    neg = False
+    atom_eq = atom
+    if isinstance(atom, Application) and atom.fct == NEQ:
+        # nnf may reintroduce Neq from ¬(a=b): same theory atom, flipped
+        atom_eq = Application(EQ, atom.args)
+        atom_eq.tpe = Bool
+        neg = True
+    if isinstance(atom_eq, Application) and atom_eq.fct == EQ:
+        a, b = atom_eq.args
+        lin = None
+        if _is_int(a) or _is_int(b):
+            try:
+                lin = lin_pair(a, b)
+            except _NonLinear:
+                lin = None
+        return ("eq", a, b, lin, neg)
+    if isinstance(atom, Application) and atom.fct in _ARITH_PRED:
+        a, b = atom.args
+        try:
+            coeffs, rhs = lin_pair(a, b)
+        except _NonLinear:
+            return ("opaque",)
+        op = atom.fct
+        if op == GEQ:
+            coeffs, rhs, op = {n: -v for n, v in coeffs.items()}, -rhs, LEQ
+        elif op == GT:
+            coeffs, rhs, op = {n: -v for n, v in coeffs.items()}, -rhs, LT
+        if op == LEQ:
+            pos = (coeffs, "<=", rhs)
+            neg_c = (coeffs, ">=", rhs + 1)
+        else:  # LT
+            pos = (coeffs, "<=", rhs - 1)
+            neg_c = (coeffs, ">=", rhs)
+        return ("arith", pos, neg_c)
+    if isinstance(atom, (Application, Variable)):
+        if isinstance(atom, Application) and any(
+            isinstance(x, Binding) for x in atom.args
+        ):
+            return ("opaque",)
+        return ("pred",)
+    return ("opaque",)
+
+
+def _theory_check(
+    atoms: List[Tuple[Formula, bool, tuple]],
+    foreign: Dict[str, Formula],
+) -> Optional[List[Formula]]:
     """Check a full atom assignment against EUF + LIA.
-    Returns None (consistent) or the list of atom Formulas in conflict."""
+    Returns None (consistent) or the list of atom Formulas in conflict.
+    `atoms` carry their precomputed _classify_atom records."""
     eqs: List[Tuple[Formula, Formula]] = []
     eq_atoms: List[Formula] = []
     diseqs: List[Tuple[Formula, Formula]] = []
@@ -414,38 +485,22 @@ def _theory_check(atoms: List[Tuple[Formula, bool]]) -> Optional[List[Formula]]:
     lia_atoms: List[Tuple[Formula, bool]] = []
     int_neg_eqs: List[Tuple[Dict[str, int], int]] = []
     int_neg_atoms: List[Formula] = []
-    foreign: Dict[str, Formula] = {}
 
-    def lin_pair(a, b):
-        ca, ka = _linearize(a, foreign)
-        cb, kb = _linearize(b, foreign)
-        for n, v in cb.items():
-            ca[n] = ca.get(n, 0) - v
-        return ca, kb - ka  # ca·x ⋈ (kb - ka)
-
-    for atom, val in atoms:
-        eff_val = val
-        if isinstance(atom, Application) and atom.fct == NEQ:
-            # nnf may reintroduce Neq from ¬(a=b): same theory atom, flipped
-            atom_eq = Application(EQ, atom.args)
-            atom_eq.tpe = Bool
-            eff_val = not val
-        else:
-            atom_eq = atom
-        if isinstance(atom_eq, Application) and atom_eq.fct == EQ:
-            a, b = atom_eq.args
-            if _is_int(a) or _is_int(b):
-                try:
-                    coeffs, rhs = lin_pair(a, b)
-                except _NonLinear:
-                    coeffs = None
-                if coeffs is not None:
-                    if eff_val:
-                        lia_cons.append((coeffs, "==", rhs))
-                        lia_atoms.append((atom, True))
-                    else:
-                        int_neg_eqs.append((coeffs, rhs))
-                        int_neg_atoms.append(atom)
+    for atom, val, rec in atoms:
+        kind = rec[0]
+        if kind == "opaque":
+            continue
+        if kind == "eq":
+            _k, a, b, lin, neg = rec
+            eff_val = val != neg
+            if lin is not None:
+                coeffs, rhs = lin
+                if eff_val:
+                    lia_cons.append((coeffs, "==", rhs))
+                    lia_atoms.append((atom, True))
+                else:
+                    int_neg_eqs.append((coeffs, rhs))
+                    int_neg_atoms.append(atom)
             # equalities also inform EUF congruence (Int-typed ones too)
             if eff_val:
                 eqs.append((a, b))
@@ -453,36 +508,10 @@ def _theory_check(atoms: List[Tuple[Formula, bool]]) -> Optional[List[Formula]]:
             else:
                 diseqs.append((a, b))
                 diseq_atoms.append(atom)
-        elif isinstance(atom, Application) and atom.fct in _ARITH_PRED:
-            a, b = atom.args
-            try:
-                coeffs, rhs = lin_pair(a, b)
-            except _NonLinear:
-                continue
-            op = atom.fct
-            # normalize to  coeffs ⋈ rhs  over integers
-            if op == GEQ:
-                coeffs, rhs, op = {n: -v for n, v in coeffs.items()}, -rhs, LEQ
-            elif op == GT:
-                coeffs, rhs, op = {n: -v for n, v in coeffs.items()}, -rhs, LT
-            if op == LEQ:
-                if val:
-                    lia_cons.append((coeffs, "<=", rhs))
-                else:
-                    lia_cons.append((coeffs, ">=", rhs + 1))
-            else:  # LT
-                if val:
-                    lia_cons.append((coeffs, "<=", rhs - 1))
-                else:
-                    lia_cons.append((coeffs, ">=", rhs))
+        elif kind == "arith":
+            lia_cons.append(rec[1] if val else rec[2])
             lia_atoms.append((atom, val))
-        elif isinstance(atom, (Application, Variable)):
-            # uninterpreted predicate (In(...), P(x), boolean var):
-            # model as a term equated with true/false
-            if isinstance(atom, Application) and any(
-                isinstance(x, Binding) for x in atom.args
-            ):
-                continue
+        else:  # pred
             target = TRUE if val else FALSE
             eqs.append((atom, target))
             eq_atoms.append(atom)

@@ -274,3 +274,35 @@ def test_cl_setminus_profile_alignment():
     # while the converse-direction difference is correctly refuted
     pmq = Application(SETMINUS, [P, Q])
     assert entailment(SubsetEq(P, Q), Leq(Card(pmq), 0))
+
+
+# ---------------------------------------------------------------------------
+# QI instantiation tracing (quantifiers/QILogger.scala:20-203)
+# ---------------------------------------------------------------------------
+
+def test_qi_logger_records_instantiation_graph(tmp_path):
+    from round_tpu.verify.qilog import QILogger
+
+    log = QILogger()
+    i = Variable("i", procType)
+    p1 = Variable("p1", procType)
+    data = UnInterpretedFct("data", FunT([procType], Int))
+    d = lambda x: Application(data, [x]).with_type(Int)
+    cfg = ClConfig(qi_logger=log)
+    assert entailment(
+        And(ForAll([i], Eq(d(i), 1)), Eq(d(p1), 0)),
+        Neq(d(p1), d(p1)),  # anything; hypothesis is inconsistent
+        cfg, timeout_s=20,
+    ) or True  # graph content is what's asserted, not the verdict
+    assert log.nodes, "no nodes recorded"
+    roots = [n for n in log.nodes.values() if n.is_root]
+    insts = [n for n in log.nodes.values() if not n.is_root]
+    assert roots and insts
+    assert log.edges and all(e.src in log.nodes for e in log.edges)
+    assert "clauses" in log.summary()
+    gv = tmp_path / "qi.dot"
+    log.store_graphviz(str(gv))
+    assert gv.read_text().startswith("digraph QI")
+    js = tmp_path / "qi.js"
+    log.store_visjs(str(js))
+    assert "var nodes" in js.read_text()
