@@ -389,6 +389,10 @@ class ClReducer:
     def reduce(self, f: Formula) -> Formula:
         """Full reduction to a ground formula (CL.reduce, CL.scala:197-264)."""
         cfg = self.config
+        if cfg.qi_logger is not None:
+            cfg.qi_logger.new_phase(
+                f"vb{cfg.venn_bound}/d{cfg.inst_depth}#{next(_fresh)}"
+            )
         f = simplify(f)
         f = typecheck(f)
         f = reduce_time(f)
@@ -571,11 +575,12 @@ def entailment(
     h: Formula,
     c: Formula,
     config: ClConfig = ClDefault,
-    timeout_s: Optional[float] = None,
+    timeout_s: Optional[float] = 120.0,
     decompose: bool = True,
 ) -> bool:
     """h ⊨ c via decomposition + the effort ladder.  `timeout_s` bounds each
-    rung's ground solve; only UNSAT verdicts (for every sub-VC) prove the
+    rung's ground solve (default 120 s — the solver's round cap is not a
+    practical backstop); only UNSAT verdicts (for every sub-VC) prove the
     entailment."""
     if not decompose:
         return _entailment_core(h, c, config, timeout_s)

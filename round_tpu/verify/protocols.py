@@ -723,3 +723,131 @@ def _lv_maxx_axiom(sig: StateSig, coord, maxx) -> Formula:
             ),
         ),
     )
+
+
+def otr_extracted_stage_vcs():
+    """The extracted-TR mmor lemma as a STAGED proof chain (the VERDICT
+    round-2 target: the verifier proves from the *extracted* transition
+    relation what verify/protocols.py's hand-written OTR lemmas prove).
+
+    The monolithic entailment (site axioms ∧ majorities ⊨ mmor-site = w)
+    drowns the reducer; the chain below discharges it by ∃-elimination —
+    every stage is an `entailment(hyp, concl, cfg)` call, and the chain
+    composes soundly:
+
+      A. majorities ⊨ ∃k. x(k) = w                 (introduce the witness pw)
+      B. ... ∧ x(pw)=w ⊨ 3·|C_pw| > n              (pw's support is > n/3;
+                                                     C_pw = the extraction's
+                                                     per-candidate count set)
+      Ci/Cii. max-site = |C_pw|                     (≥ via the ∀ site axiom
+                                                     at pw + card transfer;
+                                                     ≤ via the attainment
+                                                     skolem: a non-w
+                                                     attainer's support is
+                                                     < n/3 < |C_pw|)
+      Di/Dii. min-site (= the mmor value x' adopts) = w
+
+    Since pw is fresh in A's conclusion and every later stage only assumes
+    x(pw) = w plus previously-proven facts, ⊨-transitivity + ∃-elimination
+    give: site axioms ∧ payload ∧ value-bound ∧ majorities ⊨ msite = w —
+    exactly the hand-written mor lemma (tests/test_verifier.py) but with the
+    sites and equations EXTRACTED from models/otr.py's executable update.
+
+    Returns (stages, meta): stages = [(name, hyp, concl, ClConfig)],
+    meta = dict with the sites and the x'-structure for shape assertions.
+    """
+    sig, j, update_eqs, axioms, payload_def, value_bound = otr_extracted_tr()
+
+    w = Variable("w", Int)
+    pw = Variable("pw", procType)
+    k1 = Variable("k1", procType)
+    k2 = Variable("k2", procType)
+    k3 = Variable("k3", procType)
+    snd = UnInterpretedFct("sndx", FunT([procType], Int))
+    sx = lambda p: Application(snd, [p]).with_type(Int)
+
+    S_w = Comprehension([k1], Eq(sig.get("x", k1), w))
+    HOset = Comprehension([k2], In(k2, ho_of(j)))
+    C_pw = Comprehension([k3], And(In(k3, ho_of(j)), Eq(sx(pw), sx(k3))))
+
+    # x'(j) = Ite(quorum, msite, x(j)); the sites are the extraction's
+    # axiomatized reduction results (extract.py _site)
+    xp = update_eqs.args[0].args[1]
+    msite = xp.args[1]
+    maxsite = None
+
+    def _find_max(f):
+        nonlocal maxsite
+        if maxsite is None and isinstance(f, Application):
+            if "max" in getattr(f.fct, "name", ""):
+                maxsite = f
+                return
+            for a in f.args:
+                _find_max(a)
+        elif isinstance(f, Binding):
+            _find_max(f.body)
+
+    for ax in axioms:
+        _find_max(ax)
+
+    assert maxsite is not None and msite is not None, "sites not found"
+
+    def _mentions(f, fct) -> bool:
+        if isinstance(f, Application):
+            return f.fct == fct or any(_mentions(a, fct) for a in f.args)
+        if isinstance(f, Binding):
+            return _mentions(f.body, fct)
+        return False
+
+    def _is_forall(f) -> bool:
+        return isinstance(f, Binding) and f.binder == FORALL
+
+    # bucket by which SITE SYMBOL an axiom pins (structural: the min axioms
+    # mention the max site inside their Ite conditions, so min wins)
+    min_axs = [a for a in axioms if _mentions(a, msite.fct)]
+    max_axs = [a for a in axioms
+               if a not in min_axs and _mentions(a, maxsite.fct)]
+    max_forall = [a for a in max_axs if _is_forall(a)]
+    max_attain = [a for a in max_axs if not _is_forall(a)]
+    min_forall = [a for a in min_axs if _is_forall(a)]
+    min_attain = [a for a in min_axs if not _is_forall(a)]
+    assert max_forall and max_attain and min_forall and min_attain
+
+    majorities = And(
+        Gt(Times(3, Card(S_w)), Times(2, N)),
+        Gt(Times(3, Card(HOset)), Times(2, N)),
+    )
+    c21 = ClConfig(venn_bound=2, inst_depth=1)
+    c32 = ClConfig(venn_bound=3, inst_depth=2)
+
+    stages = [
+        ("A: majority witness", majorities,
+         Exists([k1], Eq(sig.get("x", k1), w)), c21),
+        ("B: witness support > n/3",
+         And(majorities, payload_def, Eq(sig.get("x", pw), w)),
+         Gt(Times(3, Card(C_pw)), N), c32),
+        ("Ci: max >= |C_pw|", And(*max_forall),
+         Geq(maxsite, Card(C_pw)), c21),
+        ("Cii: max <= |C_pw|",
+         And(Gt(Times(3, Card(S_w)), Times(2, N)), payload_def,
+             Eq(sig.get("x", pw), w), *max_attain,
+             Gt(Times(3, Card(C_pw)), N)),
+         Leq(maxsite, Card(C_pw)), c21),
+        ("Di: msite <= w",
+         And(payload_def, Eq(sig.get("x", pw), w), *min_forall,
+             Eq(maxsite, Card(C_pw))),
+         Leq(msite, w), c21),
+        ("Dii: msite >= w",
+         And(Gt(Times(3, Card(S_w)), Times(2, N)), payload_def, value_bound,
+             Eq(sig.get("x", pw), w), *min_attain,
+             Gt(Times(3, Card(C_pw)), N), Eq(maxsite, Card(C_pw)),
+             Leq(msite, w)),
+         Geq(msite, w), c21),
+    ]
+    meta = {
+        "sig": sig, "j": j, "w": w, "pw": pw, "msite": msite,
+        "maxsite": maxsite, "xp": xp, "update_eqs": update_eqs,
+        "C_pw": C_pw, "S_w": S_w, "majorities": majorities,
+        "payload_def": payload_def, "value_bound": value_bound,
+    }
+    return stages, meta

@@ -30,6 +30,7 @@ class Node:
     new_ground_terms: Tuple[Formula, ...] = ()
     round: int = 0
     is_root: bool = False  # a universal clause (vs a produced instance)
+    phase: str = ""        # which reduce() pass produced it (ladder rung)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,6 +50,13 @@ class QILogger:
         self.edges: List[Edge] = []
         self._edge_set: set = set()
         self._next = 0
+        self.phase = ""
+
+    def new_phase(self, label: str) -> None:
+        """Mark the start of an independent reduction (one effort-ladder
+        rung / decomposition branch); later nodes carry the label so the
+        graph separates per pass instead of conflating them."""
+        self.phase = label
 
     def reset(self) -> None:
         self.nodes.clear()
@@ -66,7 +74,7 @@ class QILogger:
         idx = self._next
         self._next += 1
         self.nodes[idx] = Node(
-            idx, formula, tuple(new_ground_terms), round, is_root
+            idx, formula, tuple(new_ground_terms), round, is_root, self.phase
         )
         return idx
 
@@ -85,12 +93,14 @@ class QILogger:
 
     def summary(self) -> str:
         roots = [n for n in self.nodes.values() if n.is_root]
-        per_round: Dict[int, int] = {}
+        per_key: Dict[Tuple[str, int], int] = {}
         for n in self.nodes.values():
             if not n.is_root:
-                per_round[n.round] = per_round.get(n.round, 0) + 1
+                key = (n.phase, n.round)
+                per_key[key] = per_key.get(key, 0) + 1
         rounds = ", ".join(
-            f"round {r}: {k} instances" for r, k in sorted(per_round.items())
+            (f"{ph} " if ph else "") + f"round {r}: {k} instances"
+            for (ph, r), k in sorted(per_key.items())
         )
         return f"{len(roots)} clauses; {rounds or 'no instances'}"
 

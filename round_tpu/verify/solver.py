@@ -352,9 +352,13 @@ def solve_ground(
 ) -> str:
     """Satisfiability of a ground (quantifier-free) formula.  Quantified
     subformulas must have been eliminated by the CL reducer first.  The
-    wall-clock budget covers all native SAT calls together; expiry → unknown."""
+    wall-clock budget covers all native SAT calls together; expiry → unknown.
+    With no explicit budget a 600 s default applies — the round cap is no
+    longer a practical termination backstop."""
     import time as _time
-    deadline = None if timeout_s is None else _time.monotonic() + timeout_s
+    if timeout_s is None:
+        timeout_s = 600.0
+    deadline = _time.monotonic() + timeout_s
     f = simplify(f)
     f = typecheck(f)
     f = lift_ite(f)

@@ -48,12 +48,17 @@ class SingleVC(VC):
     def formula(self) -> Formula:
         return And(self.hypothesis, self.transition, Not(self.conclusion))
 
-    def solve(self, config: ClConfig = ClDefault) -> bool:
+    def solve(
+        self, config: ClConfig = ClDefault, timeout_s: float = 120.0
+    ) -> bool:
         cfg = self.config or config
         t0 = time.monotonic()
         reducer = ClReducer(cfg)
         try:
-            self.status = reducer.check_sat(simplify(self.formula())) == UNSAT
+            self.status = (
+                reducer.check_sat(simplify(self.formula()), timeout_s=timeout_s)
+                == UNSAT
+            )
         finally:
             self.solve_time_s = time.monotonic() - t0
         return self.status
