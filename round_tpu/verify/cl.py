@@ -33,9 +33,9 @@ from round_tpu.verify.formula import (
     EQ, Eq, EXISTS, FORALL, FNONE_SYM, FOption, FSOME, FSet, FMap, Formula,
     FunT, GET, Geq, GEQ, GT, Gt, IMPLIES, IN, INTERSECTION, IS_DEFINED,
     IS_DEFINED_AT, Int, IntLit, IntT, ITE, Implies, KEYSET, LEQ, LOOKUP, LT,
-    Leq, Literal, Lt, MSIZE, NEQ, NOT, Not, OR, Or, SETMINUS, SUBSET_EQ,
-    Type, UNION, UPDATED, UnInterpreted, UnInterpretedFct, Variable,
-    procType, timeType,
+    Leq, Literal, Lt, MSIZE, NEQ, NOT, Not, OR, Or, Plus, SETMINUS,
+    SUBSET_EQ, Times, Type, UNION, UPDATED, UnInterpreted, UnInterpretedFct,
+    Variable, procType, timeType,
 )
 from round_tpu.verify.futils import (
     fmap, free_vars, get_conjuncts, subst_vars,

@@ -147,6 +147,34 @@ def _idiv(x, y):
     return Application(DIVIDES, [x, y]).with_type(Int)
 
 
+def _imod(x, y):
+    """Floor-mod via the floor-div symbol: x mod y = x − y·(x div y).
+
+    When the divisor is a positive constant, cl._eliminate_int_div's floor
+    axioms (k·q ≤ x ≤ k·q + k − 1) make this exactly jnp.remainder.  With a
+    *symbolic* divisor (the coordinator arithmetic's `% n`) DIVIDES stays
+    uninterpreted — the axioms would be nonlinear — so the term is a sound
+    over-approximation usable only up to congruence (enough for "j is the
+    coordinator" hypotheses; NOT enough to derive 0 ≤ coord < n)."""
+    return Minus(x, Times(y, _idiv(x, y)))
+
+
+ID_TO_P = UnInterpretedFct("idToP", FunT([Int], procType))
+
+
+def _coerce_proc(x, y):
+    """The runtime compares int32 lane ids against id arithmetic (e.g.
+    ctx.id == (r // 4) % n, LastVoting.scala:95); formula-land keeps
+    ProcessID opaque, so the Int side is wrapped in the uninterpreted
+    idToP — the reference's SpecHelper.idToP ghost op (Specs.scala:28-41)."""
+    tx, ty = getattr(x, "tpe", None), getattr(y, "tpe", None)
+    if tx == procType and ty != procType:
+        return x, Application(ID_TO_P, [y]).with_type(procType)
+    if ty == procType and tx != procType:
+        return Application(ID_TO_P, [x]).with_type(procType), y
+    return x, y
+
+
 _BINOPS = {
     "add": lambda x, y: Plus(x, y),
     "sub": lambda x, y: Minus(x, y),
@@ -158,8 +186,8 @@ _BINOPS = {
     "le": lambda x, y: Leq(x, y),
     "gt": lambda x, y: Gt(x, y),
     "ge": lambda x, y: Geq(x, y),
-    "eq": lambda x, y: Eq(x, y),
-    "ne": lambda x, y: Neq(x, y),
+    "eq": lambda x, y: Eq(*_coerce_proc(x, y)),
+    "ne": lambda x, y: Neq(*_coerce_proc(x, y)),
     "and": lambda x, y: And(x, y),
     "or": lambda x, y: Or(x, y),
     "xor": lambda x, y: Neq(x, y),
@@ -249,12 +277,20 @@ class _Interpreter:
     def _arg_extremum(self, body_fn, is_max: bool) -> Formula:
         """a = argmax/argmin over the process axis of body:
            ∀i. body(i) ≤ body(a)   (≥ for min).
-        The tie-break (first index) is abstracted away — an
-        over-approximation of the executable, sound for safety VCs."""
+        Over a BOOLEAN body (jnp.argmax(cand) = "first True", the
+        Mailbox.arg_best tie-break pattern) the bound is the implication
+        cand(i) → cand(a): if any candidate exists the site is one.  The
+        tie-break (first index) is abstracted away — an over-approximation
+        of the executable, sound for safety VCs."""
         a = self._site("argmax" if is_max else "argmin", procType)
         i = self.var()
-        bound = (Leq(body_fn(i), body_fn(a)) if is_max
-                 else Geq(body_fn(i), body_fn(a)))
+        probe = body_fn(i)
+        if _is_boolish(probe):
+            bound = (Implies(probe, body_fn(a)) if is_max
+                     else Implies(body_fn(a), probe))
+        else:
+            bound = (Leq(probe, body_fn(a)) if is_max
+                     else Geq(probe, body_fn(a)))
         self.axioms.append(ForAll([i], bound))
         return a
 
@@ -276,6 +312,14 @@ class _Interpreter:
         if prim == "broadcast_in_dim":
             return self._broadcast(ins[0], in_shape(0), out_shape(),
                                    eqn.params.get("broadcast_dimensions", ()))
+        if prim == "lt" and isinstance(ins[0], Scalar) \
+                and getattr(ins[0].f, "tpe", None) == procType \
+                and isinstance(ins[1], Scalar) \
+                and isinstance(ins[1].f, Literal) and ins[1].f.value == 0:
+            # jnp's negative-index normalization around a traced index
+            # (idx < 0 ? idx + n : idx): process indices are 0..n-1 by
+            # construction, so the correction branch is dead
+            return Scalar(Literal(False))
         if prim in _BINOPS and _BINOPS[prim] is not None:
             if len(out_shape()) == 2:
                 # rank-promoting binop (e.g. eq of (1,n) with (n,1)):
@@ -318,6 +362,15 @@ class _Interpreter:
                              eqn.params["dimension_numbers"])
         if prim == "gather":
             return self._gather(ins[0], ins[1], in_shape(0), out_shape())
+        if prim == "dynamic_slice":
+            # v[idx] with a traced process index lowers to a size-1
+            # dynamic_slice + squeeze (Mailbox._tree_pick / best_by)
+            op, *idxs = ins
+            op = _lift(op) if not isinstance(op, (Scalar, Vec, Vec2)) else op
+            if isinstance(op, Vec) and len(idxs) == 1 \
+                    and isinstance(idxs[0], Scalar) and out_shape() == (1,):
+                return Scalar(op.fn(idxs[0].f))
+            raise ExtractionError("unsupported dynamic_slice pattern")
         if prim == "iota":
             return Vec(lambda i: i)
         if prim in ("pjit", "jit", "closed_call", "custom_jvp_call"):
@@ -326,6 +379,10 @@ class _Interpreter:
                 # DIVIDES with the k·q ≤ num ≤ k·q + k - 1 axioms
                 # (cl._eliminate_int_div) IS floor semantics — emit directly
                 return _binop(_idiv, ins[0], ins[1])
+            if eqn.params.get("name") == "remainder":
+                # same shortcut for jnp's % (the coordinator arithmetic
+                # (r // 4) % n, LastVoting.scala:95)
+                return _binop(_imod, ins[0], ins[1])
             inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
             outs = _Interpreter.run(self, inner.jaxpr, inner.consts, ins)
             return outs[0] if len(outs) == 1 else outs
@@ -511,6 +568,11 @@ def _is_boolish(f: Formula) -> bool:
 
 def _binop_3(which, on_false, on_true):
     which, a, b = _lift(which), _lift(on_false), _lift(on_true)
+    if isinstance(which, Scalar) and isinstance(which.f, Literal) \
+            and isinstance(which.f.value, bool):
+        # fold a statically-decided select (e.g. the dead negative-index
+        # correction branch around an argmax site)
+        return b if which.f.value else a
     parts = [which, a, b]
     if all(isinstance(p, Scalar) for p in parts):
         return Scalar(Ite(which.f, on_true.f, on_false.f))

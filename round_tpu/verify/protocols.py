@@ -725,6 +725,223 @@ def _lv_maxx_axiom(sig: StateSig, coord, maxx) -> Formula:
     )
 
 
+def lv_extracted_tr():
+    """LastVoting round-1 (LVCollect: the coordinator's max-timestamp
+    selection, LastVoting.scala:123-137) extracted from the *executable*
+    round class models/lastvoting.py:LVCollect — ctx/state/mailbox and all.
+
+    The trace runs the real `LVCollect().update` (not a re-written copy):
+    Mailbox.best_by lowers to masked reduce_max + boolean argmax +
+    dynamic_slice-gather, and the coordinator arithmetic (r // 4) % n
+    lowers through the floor-div/mod shortcuts.  The returned pieces feed
+    lv_extracted_stage_vcs, which proves the LvExample maxTS lemma from
+    these EXTRACTED axioms (the hand-written twin is _lv_maxx_axiom).
+
+    Returns (sig, j, r, update_eqs, axioms, payload_def):
+      update_eqs  — vote′(j) = ⟨extracted Ite⟩ ∧ commit′(j) = ⟨extracted⟩
+      axioms      — the max/argmax site axioms for j's mailbox
+      payload_def — ∀i. sndts(i) = ts(i) ∧ sndx(i) = x(i)
+    """
+    import jax.numpy as jnp
+
+    from round_tpu.core.rounds import RoundCtx
+    from round_tpu.models.lastvoting import LVCollect, LVState
+    from round_tpu.ops.mailbox import Mailbox as RtMailbox
+    from round_tpu.verify.extract import Scalar, Vec, extract_lane_fn
+    from round_tpu.verify.formula import IN
+
+    sig = StateSig({"x": Int, "ts": Int, "ready": Bool, "commit": Bool,
+                    "vote": Int, "decided": Bool, "dec": Int})
+    j = Variable("lvj", procType)
+    r = Variable("r", Int)
+    sndx = UnInterpretedFct("lvsndx", FunT([procType], Int))
+    sndts = UnInterpretedFct("lvsndts", FunT([procType], Int))
+
+    def upd(n, r, jid, x, ts, ready, commit, vote, decided, decision,
+            ts_p, x_p, mask):
+        ctx = RoundCtx(id=jid, n=n, r=r)
+        st = LVState(x=x, ts=ts, ready=ready, commit=commit, vote=vote,
+                     decided=decided, decision=decision)
+        st2 = LVCollect().update(ctx, st, RtMailbox({"x": x_p, "ts": ts_p},
+                                                    mask))
+        return st2.vote, st2.commit
+
+    ne = 5
+    ex = [jnp.int32(ne), jnp.int32(0), jnp.int32(0), jnp.int32(0),
+          jnp.int32(-1), jnp.bool_(False), jnp.bool_(False), jnp.int32(0),
+          jnp.bool_(False), jnp.int32(-1), jnp.zeros((ne,), jnp.int32),
+          jnp.zeros((ne,), jnp.int32), jnp.zeros((ne,), bool)]
+    fargs = [
+        Scalar(N), Scalar(r), Scalar(j),
+        Scalar(sig.get("x", j)), Scalar(sig.get("ts", j)),
+        Scalar(sig.get("ready", j)), Scalar(sig.get("commit", j)),
+        Scalar(sig.get("vote", j)), Scalar(sig.get("decided", j)),
+        Scalar(sig.get("dec", j)),
+        Vec(lambda i: Application(sndts, [i]).with_type(Int)),
+        Vec(lambda i: Application(sndx, [i]).with_type(Int)),
+        Vec(lambda i: Application(IN, [i, ho_of(j)]).with_type(Bool)),
+    ]
+    outs, axioms = extract_lane_fn(
+        upd, ex, fargs, lambda i: Literal(True), receiver=j,
+        return_axioms=True,
+    )
+    update_eqs = And(
+        Eq(sig.get_primed("vote", j), outs[0].f),
+        Eq(sig.get_primed("commit", j), outs[1].f),
+    )
+    i0 = Variable("i0", procType)
+    payload_def = ForAll([i0], And(
+        Eq(Application(sndts, [i0]).with_type(Int), sig.get("ts", i0)),
+        Eq(Application(sndx, [i0]).with_type(Int), sig.get("x", i0)),
+    ))
+    return sig, j, r, update_eqs, axioms, payload_def
+
+
+def lv_extracted_stage_vcs():
+    """The LvExample maxTS lemma (logic/LvExample.scala:268-284) proved from
+    the EXTRACTED LVCollect transition relation, as a staged ∃-elimination
+    chain (the same discipline as otr_extracted_stage_vcs):
+
+      A. the two majorities (timestamp set, mailbox) intersect:
+         ⊨ ∃k. k ∈ HO(j) ∧ ts(k) ≥ t
+      B. ...hence the masked-max site is ≥ t (∀ site axiom at the witness)
+      C. the attainment skolem must lie in the mailbox (t above the int32
+         sentinel rules the empty-mask branch out):
+         ⊨ ∃i. i ∈ HO(j) ∧ sndts(i) = max
+      D. the argmax site inherits membership + max timestamp, so the
+         property ∀i. ts(i) ≥ t → x(i) = v pins its payload:
+         ⊨ sndx(a) = v
+      E. the extracted Ite condition holds (j is the coordinator, the
+         mailbox majority beats n div 2), so vote′(j) = sndx(a) = v.
+
+    Every stage is entailment(hyp, concl, cfg); witnesses introduced by ∃
+    stages enter later hyps as fresh free variables, so the chain composes
+    by ∃-elimination into: extracted axioms ∧ payload ∧ majorities ∧
+    ts-property ⊨ vote′(j) = v — the reference's maxTS test, but from the
+    jaxpr of the executable round instead of a hand-written axiom.
+
+    Returns (stages, meta)."""
+    sig, j, r, update_eqs, axioms, payload_def = lv_extracted_tr()
+
+    t = Variable("t", Int)
+    v = Variable("v", Int)
+    kw = Variable("kw", procType)   # stage-A witness
+    iw = Variable("iw", procType)   # stage-C witness
+    k1 = Variable("k1", procType)
+    k2 = Variable("k2", procType)
+    i = Variable("i", procType)
+
+    A_t = Comprehension([k1], Geq(sig.get("ts", k1), t))
+    MB = Comprehension([k2], In(k2, ho_of(j)))
+
+    # locate the extracted sites: vote′(j) = Ite(cond, sndx(argsite), vote(j))
+    votep = update_eqs.args[0].args[1]
+    cond, adopted = votep.args[0], votep.args[1]
+    argsite = adopted.args[0]
+    maxsite = _find_site(axioms, "ext!max!")
+    assert maxsite is not None and "argmax" in argsite.fct.name
+
+    arg_axs = [a for a in axioms
+               if _is_forall(a) and _mentions_fct(a, argsite.fct)]
+    max_forall = [a for a in axioms
+                  if a not in arg_axs and _is_forall(a)
+                  and _mentions_fct(a, maxsite.fct)]
+    max_attain = [a for a in axioms
+                  if not _is_forall(a) and _mentions_fct(a, maxsite.fct)]
+    assert arg_axs and max_forall and max_attain
+
+    maj = And(Gt(Times(2, Card(A_t)), N), Gt(Times(2, Card(MB)), N))
+    prop = ForAll([i], Implies(Geq(sig.get("ts", i), t),
+                               Eq(sig.get("x", i), v)))
+    t_bound = Gt(t, IntLit(-(2 ** 31)))
+    sndts_fct = _payload_fct(max_forall[0])
+
+    def sndts_of(p):
+        return Application(sndts_fct, [p]).with_type(Int)
+
+    c21 = ClConfig(venn_bound=2, inst_depth=1)
+    c22 = ClConfig(venn_bound=2, inst_depth=2)
+
+    stages = [
+        ("A: majorities intersect", maj,
+         Exists([k1], And(In(k1, ho_of(j)), Geq(sig.get("ts", k1), t))),
+         c21),
+        ("B: max site >= t",
+         And(In(kw, ho_of(j)), Geq(sig.get("ts", kw), t), payload_def,
+             *max_forall),
+         Geq(maxsite, t), c22),
+        ("C: attainer in mailbox",
+         And(Geq(maxsite, t), t_bound, *max_attain),
+         Exists([k1], And(In(k1, ho_of(j)),
+                          Eq(sndts_of(k1), maxsite))), c22),
+        ("D: argmax payload = v",
+         And(In(iw, ho_of(j)), Eq(sndts_of(iw), maxsite),
+             Geq(maxsite, t), payload_def, prop, *arg_axs),
+         Eq(adopted, v), c22),
+        ("E: vote' = v under the extracted condition",
+         And(Eq(j, cond.args[0].args[1]), Gt(Times(2, Card(MB)), N),
+             Eq(adopted, v), update_eqs),
+         Eq(sig.get_primed("vote", j), v), c22),
+    ]
+    meta = {
+        "sig": sig, "j": j, "r": r, "t": t, "v": v, "kw": kw, "iw": iw,
+        "cond": cond, "adopted": adopted, "argsite": argsite,
+        "maxsite": maxsite, "update_eqs": update_eqs, "axioms": axioms,
+        "payload_def": payload_def, "A_t": A_t, "MB": MB, "maj": maj,
+        "prop": prop,
+    }
+    return stages, meta
+
+
+def _mentions_fct(f: Formula, fct) -> bool:
+    if isinstance(f, Application):
+        return f.fct == fct or any(_mentions_fct(a, fct) for a in f.args)
+    if isinstance(f, Binding):
+        return _mentions_fct(f.body, fct)
+    return False
+
+
+def _is_forall(f: Formula) -> bool:
+    return isinstance(f, Binding) and f.binder == FORALL
+
+
+def _find_site(fs, prefix: str):
+    """First extraction-site application (extract.py _site names sites
+    ``ext!<tag>!<k>``) whose symbol name starts with `prefix`, searched
+    across the formulas `fs`."""
+    found = None
+
+    def walk(f):
+        nonlocal found
+        if found is not None:
+            return
+        if isinstance(f, Application):
+            if getattr(f.fct, "name", "").startswith(prefix):
+                found = f
+                return
+            for a in f.args:
+                walk(a)
+        elif isinstance(f, Binding):
+            walk(f.body)
+
+    for f in fs:
+        walk(f)
+        if found is not None:
+            break
+    return found
+
+
+def _payload_fct(max_forall_axiom: Formula):
+    """The sndts payload symbol, recovered from the masked-max ∀ axiom
+    Leq(Ite(In(i, HO(j)), sndts(i), MIN), max(j))."""
+    f = max_forall_axiom
+    while isinstance(f, Binding):
+        f = f.body
+    # Leq(Ite(cond, sndts(i), MIN), site)
+    ite = f.args[0]
+    return ite.args[1].fct
+
+
 def otr_extracted_stage_vcs():
     """The extracted-TR mmor lemma as a STAGED proof chain (the VERDICT
     round-2 target: the verifier proves from the *extracted* transition
@@ -774,39 +991,15 @@ def otr_extracted_stage_vcs():
     # axiomatized reduction results (extract.py _site)
     xp = update_eqs.args[0].args[1]
     msite = xp.args[1]
-    maxsite = None
-
-    def _find_max(f):
-        nonlocal maxsite
-        if maxsite is None and isinstance(f, Application):
-            if "max" in getattr(f.fct, "name", ""):
-                maxsite = f
-                return
-            for a in f.args:
-                _find_max(a)
-        elif isinstance(f, Binding):
-            _find_max(f.body)
-
-    for ax in axioms:
-        _find_max(ax)
+    maxsite = _find_site(axioms, "ext!max!")
 
     assert maxsite is not None and msite is not None, "sites not found"
 
-    def _mentions(f, fct) -> bool:
-        if isinstance(f, Application):
-            return f.fct == fct or any(_mentions(a, fct) for a in f.args)
-        if isinstance(f, Binding):
-            return _mentions(f.body, fct)
-        return False
-
-    def _is_forall(f) -> bool:
-        return isinstance(f, Binding) and f.binder == FORALL
-
     # bucket by which SITE SYMBOL an axiom pins (structural: the min axioms
     # mention the max site inside their Ite conditions, so min wins)
-    min_axs = [a for a in axioms if _mentions(a, msite.fct)]
+    min_axs = [a for a in axioms if _mentions_fct(a, msite.fct)]
     max_axs = [a for a in axioms
-               if a not in min_axs and _mentions(a, maxsite.fct)]
+               if a not in min_axs and _mentions_fct(a, maxsite.fct)]
     max_forall = [a for a in max_axs if _is_forall(a)]
     max_attain = [a for a in max_axs if not _is_forall(a)]
     min_forall = [a for a in min_axs if _is_forall(a)]

@@ -18,7 +18,7 @@ import pytest
 jax.config.update("jax_platforms", "cpu")
 
 from round_tpu.verify.cl import ClConfig, entailment
-from round_tpu.verify.formula import And, Eq, Gt, Times, Card, Geq
+from round_tpu.verify.formula import And, Eq, Card, Geq
 from round_tpu.verify.protocols import otr_extracted_stage_vcs
 from round_tpu.verify.venn import N_VAR as N
 

@@ -1,0 +1,66 @@
+"""LastVoting maxTS lemma proved from the EXTRACTED transition relation.
+
+The round-1 update of the *executable* round class (models/lastvoting.py
+LVCollect — Mailbox.best_by's masked reduce_max + boolean argmax +
+dynamic-slice gather, and the (r // 4) % n coordinator arithmetic) is
+extracted by the jaxpr interpreter and the LvExample maxTS lemma
+(logic/LvExample.scala:268-284) is discharged from the extracted site
+axioms as a staged ∃-elimination chain — the macro-boundary parity the
+reference gets from FormulaExtractor.scala:317-463 (maxBy handling).
+
+The hand-written twin of this proof is tests/test_lv_verify.py's
+test_lv_maxts_lemma (axiom _lv_maxx_axiom); here the axioms come from the
+code the engine runs.
+"""
+
+import jax
+import pytest
+
+jax.config.update("jax_platforms", "cpu")
+
+from round_tpu.verify.cl import entailment
+from round_tpu.verify.formula import And
+from round_tpu.verify.protocols import lv_extracted_stage_vcs
+
+_stages, _meta = lv_extracted_stage_vcs()
+
+
+@pytest.mark.parametrize("name,hyp,concl,cfg", _stages,
+                         ids=[s[0].split(":")[0] for s in _stages])
+def test_lv_extracted_stage(name, hyp, concl, cfg):
+    assert entailment(hyp, concl, cfg, timeout_s=180), name
+
+
+def test_lv_extracted_structure():
+    """The extraction produced vote′(j) = Ite(coord ∧ majority,
+    sndx(argmax-site), vote(j)) with max/argmax site axioms."""
+    m = _meta
+    assert "argmax" in m["argsite"].fct.name
+    assert "max!" in m["maxsite"].fct.name
+    # the condition is Eq(j, idToP(coord arithmetic)) ∧ (majority ∨ first-phase)
+    cond = m["cond"]
+    assert cond.args[0].args[0] is m["j"]
+    assert "idToP" in cond.args[0].args[1].fct.name
+    # two update equations: vote' and commit'
+    assert len(m["update_eqs"].args) == 2
+
+
+def test_lv_extracted_negative_no_property():
+    """Without the ts-property the argmax payload is NOT pinned to v —
+    guards stage D against vacuous UNSAT."""
+    m = _meta
+    _name, hyp, concl, cfg = _stages[3]
+    # drop `prop`: rebuild the hypothesis without it
+    weak = And(*[p for p in hyp.args if p is not m["prop"]])
+    assert not entailment(weak, concl, cfg, timeout_s=30)
+
+
+def test_lv_extracted_negative_no_majority():
+    """Without the mailbox majority the two sets need not intersect."""
+    m = _meta
+    _name, _hyp, concl, cfg = _stages[0]
+    from round_tpu.verify.formula import Card, Gt, Times
+    from round_tpu.verify.venn import N_VAR as N
+
+    weak = Gt(Times(2, Card(m["A_t"])), N)  # timestamp majority alone
+    assert not entailment(weak, concl, cfg, timeout_s=30)
