@@ -22,7 +22,10 @@ every round does identical full-batch work (decided lanes freeze but stay
 resident).
 
 Engines:
-  --engine fused (default): the Pallas fast path (ops/fused.py +
+  --engine loop (default): the whole-run Pallas kernel (ops.fused.otr_loop)
+    — all rounds execute inside one kernel with state resident in VMEM;
+    per-round HBM traffic is zero.
+  --engine fused: the per-round Pallas fast path (ops/fused.py +
     engine/fast.py) — HO-mask generation and the value-histogram exchange
     fused in VMEM; the scenario batch runs as one jitted scan.
   --engine reference: the general engine (engine/executor.py), scenario
@@ -75,7 +78,7 @@ def make_mix(args, key, S):
     return fast.standard_mix(key, S, args.n, p_drop=args.p_drop)
 
 
-def make_fused_bench(args, S):
+def make_fused_bench(args, S, engine="fused"):
     n, V, rounds = args.n, args.values, args.phases
     rnd = fast.OtrHist(n_values=V, after_decision=2)
     interpret = jax.default_backend() == "cpu"
@@ -94,10 +97,16 @@ def make_fused_bench(args, S):
             decision=jnp.full((S, n), -1, dtype=jnp.int32),
             after=jnp.full((S, n), 2, dtype=jnp.int32),
         )
-        state, done, decided_round = fast.run_hist(
-            rnd, state0, lambda s: s.decided, mix,
-            max_rounds=rounds, mode=mode, interpret=interpret,
-        )
+        if engine == "loop":
+            state, done, decided_round = fast.run_otr_loop(
+                rnd, state0, mix, max_rounds=rounds, mode=mode,
+                sb=args.sb, interpret=interpret,
+            )
+        else:
+            state, done, decided_round = fast.run_hist(
+                rnd, state0, lambda s: s.decided, mix,
+                max_rounds=rounds, mode=mode, interpret=interpret,
+            )
         return decided_summary(state.decided, decided_round, rounds, state.decision)
 
     return bench
@@ -129,8 +138,9 @@ def make_reference_bench(args, S):
 
 
 def parity_check(args, k_scenarios: int) -> float:
-    """Fraction of lanes where fused (hash mode) and general engine agree on
-    (decided, decision) over the first k scenarios of the mix."""
+    """Fraction of lanes where the BENCHED fast engine (hash mode) and the
+    general engine agree on (decided, decision) over the first k scenarios
+    of the mix."""
     n, V, rounds = args.n, args.values, min(args.phases, 10)
     key = jax.random.PRNGKey(0)
     mix = make_mix(args, key, k_scenarios)
@@ -145,10 +155,16 @@ def parity_check(args, k_scenarios: int) -> float:
         after=jnp.full((k_scenarios, n), 2, dtype=jnp.int32),
     )
     interpret = jax.default_backend() == "cpu"
-    state, _done, _dr = fast.run_hist(
-        rnd, state0, lambda s: s.decided, mix,
-        max_rounds=rounds, mode="hash", interpret=interpret,
-    )
+    if args.engine == "loop":
+        state, _done, _dr = fast.run_otr_loop(
+            rnd, state0, mix, max_rounds=rounds, mode="hash", sb=args.sb,
+            interpret=interpret,
+        )
+    else:
+        state, _done, _dr = fast.run_hist(
+            rnd, state0, lambda s: s.decided, mix,
+            max_rounds=rounds, mode="hash", interpret=interpret,
+        )
     algo = OTR(after_decision=2, n_values=V)
     agree = 0
     total = 0
@@ -182,7 +198,10 @@ def main():
     ap.add_argument("--p-drop", type=float, default=0.25)
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--platform", type=str, default=None, help="override jax platform (e.g. cpu)")
-    ap.add_argument("--engine", choices=["fused", "reference"], default="fused")
+    ap.add_argument("--engine", choices=["loop", "fused", "reference"],
+                    default="loop")
+    ap.add_argument("--sb", type=int, default=8,
+                    help="loop-engine scenarios per kernel grid step")
     ap.add_argument("--workload", choices=["mixed", "omission"], default="mixed")
     ap.add_argument("--rng", choices=["hw", "hash"], default="hw",
                     help="fused-engine per-link RNG: TPU hardware PRNG or the hash sampler")
@@ -219,9 +238,9 @@ def main():
 
     if args.scenarios < 1:
         raise SystemExit("--scenarios must be >= 1")
-    if args.engine == "fused":
+    if args.engine in ("fused", "loop"):
         S = args.scenarios
-        bench = make_fused_bench(args, S)
+        bench = make_fused_bench(args, S, engine=args.engine)
     else:
         args.chunk = max(1, min(args.chunk, args.scenarios))
         S = (args.scenarios // args.chunk) * args.chunk

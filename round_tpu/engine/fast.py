@@ -250,3 +250,47 @@ def run_hist(
         jnp.arange(max_rounds, dtype=jnp.int32),
     )
     return state, done, decided_round
+
+
+def run_otr_loop(
+    rnd: "OtrHist",
+    state0,
+    mix: FaultMix,
+    max_rounds: int,
+    mode: str = "hw",
+    sb: int = 8,
+    interpret: bool = False,
+):
+    """The flagship fast path: the whole OTR run as ONE Pallas kernel
+    (ops.fused.otr_loop) — state stays in VMEM across rounds, so per-round
+    HBM traffic (the [S, V, n] counts tensor and the scan-carried state of
+    run_hist) disappears entirely.
+
+    Drop-in for run_hist(OtrHist(...), fresh state0, ...): same
+    (state, done, decided_round) result, same mask semantics per FaultMix —
+    differential-pinned by tests/test_fast.py.  `state0` must be a FRESH
+    OtrState (decided/decision/after at their init values); only its `x`
+    enters the kernel, the rest is initialized in-VMEM.  Resuming from a
+    partial run is run_hist territory — rejected here when detectable
+    (concrete arrays; under jit the precondition is the caller's)."""
+    from round_tpu.models.otr import OtrState
+
+    if not isinstance(state0.decided, jax.core.Tracer) and (
+        bool(jnp.any(state0.decided))
+        or bool(jnp.any(state0.after != rnd.after_decision))
+    ):
+        raise ValueError(
+            "run_otr_loop requires a fresh state0 (nothing decided, after "
+            "counters at their init value); resume partial runs with "
+            "run_hist instead"
+        )
+
+    x, dec, decision, after, done, dround = fused.otr_loop(
+        state0.x, mix.crashed, mix.side, mix.crash_round, mix.heal_round,
+        mix.rotate_down, mix.p8, mix.salt0, mix.salt1,
+        num_values=rnd.num_values, rounds=max_rounds,
+        after_decision=rnd.after_decision, mode=mode, sb=sb,
+        interpret=interpret,
+    )
+    state = OtrState(x=x, decided=dec, decision=decision, after=after)
+    return state, done, dround

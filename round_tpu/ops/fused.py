@@ -62,6 +62,25 @@ def _fmix32(z):
     return z
 
 
+def _pad_scenarios(sb: int, *arrays):
+    """Zero-pad every array's leading (scenario) axis up to a multiple of
+    the kernel's scenario-block size `sb`.  None entries pass through.
+    Returns (padded_arrays, padded_S)."""
+    S = next(a.shape[0] for a in arrays if a is not None)
+    if S % sb == 0:
+        return arrays, S
+    pad = sb - S % sb
+
+    def padz(a):
+        if a is None:
+            return None
+        return jnp.concatenate(
+            [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)], axis=0
+        )
+
+    return tuple(padz(a) for a in arrays), S + pad
+
+
 def _kernel(
     *refs,
     num_values: int,
@@ -91,29 +110,7 @@ def _kernel(
     def per_scenario(s, _):
         g = b * sb + s
         p8 = p8_ref[g]
-        if mode == "hash":
-            # bit-exact replica of scenarios.link_bernoulli: idx = j * n + i
-            # (kernel layout here is [sender i, receiver j] = idx j*n + i
-            # with i along rows: build idx from iotas transposed)
-            sender = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
-            recv = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
-            idx = (recv * n + sender).astype(jnp.uint32)
-            z = idx * jnp.uint32(_GOLD) + salt0_ref[g].astype(jnp.uint32)
-            z = z ^ salt1_ref[g].astype(jnp.uint32)
-            keep = (_fmix32(z) & jnp.uint32(0xFF)) >= p8.astype(jnp.uint32)
-        else:
-            # hw PRNG: full-word UNSIGNED threshold — P(bits >= p8·2^24) is
-            # exactly 1 - p8/256.  prng_random_bits yields int32 on this
-            # stack, so bitcast both sides to uint32 or the compare is
-            # signed (measured: p8=0 kept only the non-negative half).
-            # p8 is clamped to 255 (thr 256<<24 overflows to 0): hw mode
-            # quantizes a total blackout to 255/256 — the hash mode stays
-            # exact for parity.
-            pltpu.prng_seed(salt1_ref[g])
-            bits = pltpu.prng_random_bits((n, n)).astype(jnp.uint32)
-            thr = (jnp.minimum(p8, 255).astype(jnp.uint32) << 24)
-            keep = bits >= thr
-        keep = keep & notdiag
+        keep = _keep_mask(n, mode, salt0_ref[g], salt1_ref[g], p8, notdiag)
         if sided:
             side = side_ref[s]
             keep = keep & (side[:, None] == side[None, :])
@@ -162,16 +159,10 @@ def hist_exchange(
     """
     S, n = vals.shape
     orig_S = S
-    if S % sb:
-        pad = sb - S % sb
-        padz = lambda x: jnp.concatenate(
-            [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)], axis=0
+    (vals, active, colmask, rowmask, side, salt0, salt1r, p8), S = \
+        _pad_scenarios(
+            sb, vals, active, colmask, rowmask, side, salt0, salt1r, p8
         )
-        vals, active, colmask = padz(vals), padz(active), padz(colmask)
-        rowmask = padz(rowmask) if rowmask is not None else None
-        side = padz(side) if side is not None else None
-        salt0, salt1r, p8 = padz(salt0), padz(salt1r), padz(p8)
-        S += pad
     # the count plane is the (sublane, lane) tile of the output: pad V up to
     # the f32 sublane quantum; padded values match no payload (counts 0)
     v_out = num_values
@@ -233,6 +224,217 @@ def hist_exchange(
         == jnp.arange(v_out, dtype=jnp.int32)[None, :, None]
     )
     return counts + onehot_self * self_on[:, None, :]
+
+
+def _keep_mask(n, mode, salt0, salt1r, p8, notdiag):
+    """The per-link delivery mask for one (scenario, round): Bernoulli keeps
+    minus the diagonal.  Shared by the per-round kernel (_kernel) and the
+    whole-loop kernel (_otr_kernel); see the module docstring for the exact
+    hash/hw semantics."""
+    if mode == "hash":
+        # bit-exact replica of scenarios.link_bernoulli: idx = j * n + i
+        # (kernel layout is [sender i, receiver j] = idx j*n + i with i
+        # along rows: build idx from iotas transposed)
+        sender = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+        recv = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+        idx = (recv * n + sender).astype(jnp.uint32)
+        z = idx * jnp.uint32(_GOLD) + salt0.astype(jnp.uint32)
+        z = z ^ salt1r.astype(jnp.uint32)
+        keep = (_fmix32(z) & jnp.uint32(0xFF)) >= p8.astype(jnp.uint32)
+    else:
+        # hw PRNG: full-word UNSIGNED threshold — P(bits >= p8·2^24) is
+        # exactly 1 - p8/256.  prng_random_bits yields int32 on this stack,
+        # so bitcast both sides to uint32 or the compare is signed
+        # (measured: p8=0 kept only the non-negative half).  p8 is clamped
+        # to 255 (thr 256<<24 overflows to 0): hw mode quantizes a total
+        # blackout to 255/256 — callers silence every sender for p8 >= 256
+        # (hist_exchange/otr_loop), keeping blackout exact.
+        pltpu.prng_seed(salt1r)
+        bits = pltpu.prng_random_bits((n, n)).astype(jnp.uint32)
+        thr = (jnp.minimum(p8, 255).astype(jnp.uint32) << 24)
+        keep = bits >= thr
+    return keep & notdiag
+
+
+def _otr_kernel(
+    x0_ref, crashed_ref, side_ref,
+    crash_round_ref, heal_round_ref, rotate_ref, p8_ref,
+    salt0_ref, salt1_ref,
+    x_out, dec_out, decision_out, after_out, done_out, dround_out,
+    *,
+    num_values: int,
+    v_pad: int,
+    sb: int,
+    rounds: int,
+    after_decision: int,
+    mode: str,
+):
+    """The flagship workload as ONE kernel: the whole `rounds`-round OTR run
+    for `sb` scenarios per grid step, state resident in VMEM.
+
+    This removes the per-round HBM round-trip of the counts tensor and the
+    scan-carried [S, n] state (engine/fast.run_hist): per scenario the only
+    HBM traffic is O(n) inputs and O(n) final state.  The per-round math is
+    identical to OtrHist.update_counts + run_hist's freeze semantics — the
+    differential tests pin it lane-for-lane to the general engine.
+
+    The count matmul is augmented with a ones-row (row `num_values` of the
+    onehot operand is the senders mask), so mailbox SIZE falls out of the
+    same MXU pass as the per-value counts."""
+    n = x0_ref.shape[1]
+    b = pl.program_id(0)
+    notdiag = jax.lax.broadcasted_iota(
+        jnp.int32, (n, n), 0
+    ) != jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (v_pad, n), 0)
+    lane_ids = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)[0]
+    quorum_thr = jnp.float32((2 * n) // 3)
+
+    def per_scenario(s, _):
+        g = b * sb + s
+        x0 = x0_ref[s]
+        crashed = crashed_ref[s] != 0
+        side = side_ref[s]
+        cr, hr = crash_round_ref[g], heal_round_ref[g]
+        rot, p8 = rotate_ref[g], p8_ref[g]
+        s0, s1 = salt0_ref[g], salt1_ref[g]
+        period = jnp.maximum(rot, 1)
+
+        def round_body(r, carry):
+            x, decided, decision, after, done, dround = carry
+            alive = ~(crashed & (r >= cr))
+            victim = (r // period) % n
+            rotated = (lane_ids == victim) & (rot > 0)
+            colmask = alive & ~rotated
+            side_r = jnp.where(r < hr, side, 0)
+            salt1r = r * jnp.int32(_RMIX) + s1
+            active = ~done
+            senders = colmask & active & (p8 < 256)
+
+            keep = _keep_mask(n, mode, s0, salt1r, p8, notdiag)
+            keep = keep & (side_r[:, None] == side_r[None, :])
+            # value indicator with the ones-row at row `num_values` (the
+            # mailbox-size trick): shared by the matmul operand and the
+            # self-delivery correction
+            oh = (x[None, :] == rows) | (rows == num_values)
+            counts = jnp.dot(
+                (oh & senders[None, :]).astype(jnp.bfloat16),
+                keep.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            # self-delivery (ho | i == j): active lanes always hear
+            # themselves, independent of colmask/p8
+            counts = counts + (oh & active[None, :]).astype(jnp.float32)
+
+            size = counts[num_values]
+            cvals = jnp.where(rows < num_values, counts,
+                              jnp.float32(-1.0))
+            bestc = jnp.max(cvals, axis=0)
+            bestv = jnp.min(
+                jnp.where(cvals == bestc[None, :], rows, num_values), axis=0
+            )
+            quorum = size > quorum_thr
+            superq = quorum & (bestc > quorum_thr)
+
+            newly = superq & ~decided
+            decided2 = decided | superq
+            decision2 = jnp.where(newly, bestv, decision)
+            after2 = jnp.where(decided2, after - 1, after)
+            exit_ = decided2 & (after2 <= 0)
+            x2 = jnp.where(quorum, bestv, x)
+
+            x = jnp.where(active, x2, x)
+            decided = jnp.where(active, decided2, decided)
+            decision = jnp.where(active, decision2, decision)
+            after = jnp.where(active, after2, after)
+            done = done | (active & exit_)
+            dround = jnp.where(decided & (dround < 0), r, dround)
+            return x, decided, decision, after, done, dround
+
+        init = (
+            x0,
+            jnp.zeros((n,), dtype=bool),
+            jnp.full((n,), -1, jnp.int32),
+            jnp.full((n,), after_decision, jnp.int32),
+            jnp.zeros((n,), dtype=bool),
+            jnp.full((n,), -1, jnp.int32),
+        )
+        x, decided, decision, after, done, dround = jax.lax.fori_loop(
+            0, rounds, round_body, init
+        )
+        x_out[s] = x
+        dec_out[s] = decided.astype(jnp.int32)
+        decision_out[s] = decision
+        after_out[s] = after
+        done_out[s] = done.astype(jnp.int32)
+        dround_out[s] = dround
+        return 0
+
+    jax.lax.fori_loop(0, sb, per_scenario, 0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_values", "rounds", "after_decision", "mode", "sb",
+                     "interpret"),
+)
+def otr_loop(
+    x0: jnp.ndarray,        # [S, n] int32 initial estimates
+    crashed: jnp.ndarray,   # [S, n] bool
+    side: jnp.ndarray,      # [S, n] int32
+    crash_round: jnp.ndarray,   # [S] int32
+    heal_round: jnp.ndarray,    # [S] int32
+    rotate_down: jnp.ndarray,   # [S] int32
+    p8: jnp.ndarray,            # [S] int32
+    salt0: jnp.ndarray,         # [S] int32
+    salt1: jnp.ndarray,         # [S] int32 (UNmixed; rounds premix in-kernel)
+    num_values: int,
+    rounds: int,
+    after_decision: int = 2,
+    mode: str = "hw",
+    sb: int = 8,
+    interpret: bool = False,
+):
+    """Run the whole OTR flagship workload in one Pallas kernel.
+
+    Returns (x, decided, decision, after, done, decided_round), each [S, n]
+    (decided/done as bool).  Mask/update semantics are bit-identical to
+    run_hist(OtrHist(...), ...) with the same FaultMix in the same mode —
+    pinned by tests/test_fast.py::test_otr_loop_parity."""
+    S, n = x0.shape
+    orig_S = S
+    (x0, crashed, side, crash_round, heal_round, rotate_down, p8, salt0,
+     salt1), S = _pad_scenarios(
+        sb, x0, crashed, side, crash_round, heal_round, rotate_down, p8,
+        salt0, salt1,
+    )
+    v_pad = num_values + 1
+    if v_pad % 8 and not interpret:
+        v_pad += 8 - v_pad % 8
+
+    grid = (S // sb,)
+    blk = pl.BlockSpec((sb, n), lambda b: (b, 0))
+    smem = pl.BlockSpec((S,), lambda b: (0,), memory_space=pltpu.SMEM)
+    kernel = functools.partial(
+        _otr_kernel, num_values=num_values, v_pad=v_pad, sb=sb,
+        rounds=rounds, after_decision=after_decision, mode=mode,
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[blk, blk, blk] + [smem] * 6,
+        out_specs=[blk] * 6,
+        out_shape=[jax.ShapeDtypeStruct((S, n), jnp.int32)] * 6,
+        interpret=interpret,
+    )(
+        x0.astype(jnp.int32), crashed.astype(jnp.int32),
+        side.astype(jnp.int32), crash_round.astype(jnp.int32),
+        heal_round.astype(jnp.int32), rotate_down.astype(jnp.int32),
+        p8.astype(jnp.int32), salt0.astype(jnp.int32),
+        salt1.astype(jnp.int32),
+    )
+    x, dec, decision, after, done, dround = [o[:orig_S] for o in outs]
+    return (x, dec.astype(bool), decision, after, done.astype(bool), dround)
 
 
 def hist_exchange_reference(

@@ -156,3 +156,65 @@ def test_fast_partition_blocks_until_heal():
     state, done, decided_round = _fast_otr(mix, n, init, 6)
     assert bool(state.decided.all())
     assert int(decided_round.min()) >= 3, "decided during the partition"
+
+
+def test_otr_loop_parity_vs_run_hist():
+    """The whole-run kernel (ops.fused.otr_loop) is lane-for-lane identical
+    to run_hist(OtrHist) on the same mix in hash mode — every output
+    (x, decided, decision, after, done, decided_round)."""
+    n, rounds = N, 6
+    key = jax.random.PRNGKey(3)
+    mix = fast.standard_mix(key, S, n, p_drop=0.15, f=3, crash_round=1)
+    init_vals = jax.random.randint(
+        jax.random.fold_in(key, 5), (n,), 0, V, dtype=jnp.int32
+    )
+    state, done, dround = _fast_otr(mix, n, init_vals, rounds)
+
+    rnd = fast.OtrHist(n_values=V, after_decision=2)
+    state0 = OtrState(
+        x=jnp.broadcast_to(init_vals, (S, n)).astype(jnp.int32),
+        decided=jnp.zeros((S, n), dtype=bool),
+        decision=jnp.full((S, n), -1, dtype=jnp.int32),
+        after=jnp.full((S, n), 2, dtype=jnp.int32),
+    )
+    state2, done2, dround2 = fast.run_otr_loop(
+        rnd, state0, mix, max_rounds=rounds, mode="hash", interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(state2.x), np.asarray(state.x))
+    np.testing.assert_array_equal(
+        np.asarray(state2.decided), np.asarray(state.decided))
+    np.testing.assert_array_equal(
+        np.asarray(state2.decision), np.asarray(state.decision))
+    np.testing.assert_array_equal(
+        np.asarray(state2.after), np.asarray(state.after))
+    np.testing.assert_array_equal(np.asarray(done2), np.asarray(done))
+    np.testing.assert_array_equal(np.asarray(dround2), np.asarray(dround))
+
+
+def test_otr_loop_padding_and_blackout():
+    """Scenario-count padding (S % sb != 0) and the p8=256 blackout row
+    behave identically in the whole-run kernel."""
+    n, rounds = N, 5
+    key = jax.random.PRNGKey(11)
+    mix = fast.fault_free(key, 5, n)
+    mix = mix.replace(
+        p8=jnp.asarray([0, 64, 255, 256, 13], dtype=jnp.int32))
+    init_vals = jax.random.randint(
+        jax.random.fold_in(key, 1), (n,), 0, V, dtype=jnp.int32
+    )
+    state, done, dround = _fast_otr(mix, n, init_vals, rounds)
+    rnd = fast.OtrHist(n_values=V, after_decision=2)
+    state0 = OtrState(
+        x=jnp.broadcast_to(init_vals, (5, n)).astype(jnp.int32),
+        decided=jnp.zeros((5, n), dtype=bool),
+        decision=jnp.full((5, n), -1, dtype=jnp.int32),
+        after=jnp.full((5, n), 2, dtype=jnp.int32),
+    )
+    state2, done2, dround2 = fast.run_otr_loop(
+        rnd, state0, mix, max_rounds=rounds, mode="hash", sb=4,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(state2.decision), np.asarray(state.decision))
+    np.testing.assert_array_equal(np.asarray(dround2), np.asarray(dround))
+    np.testing.assert_array_equal(np.asarray(done2), np.asarray(done))
