@@ -78,6 +78,29 @@ def make_mix(args, key, S):
     return fast.standard_mix(key, S, args.n, p_drop=args.p_drop)
 
 
+def _fresh_otr_state(init, S, n):
+    return OtrState(
+        x=jnp.broadcast_to(init, (S, n)).astype(jnp.int32),
+        decided=jnp.zeros((S, n), dtype=bool),
+        decision=jnp.full((S, n), -1, dtype=jnp.int32),
+        after=jnp.full((S, n), 2, dtype=jnp.int32),
+    )
+
+
+def _run_fast_engine(engine, args, rnd, state0, mix, rounds, mode, interpret):
+    """Dispatch to the engine being benched — ONE site, shared by the timed
+    bench and parity_check so they cannot drift apart."""
+    if engine == "loop":
+        return fast.run_otr_loop(
+            rnd, state0, mix, max_rounds=rounds, mode=mode, sb=args.sb,
+            interpret=interpret,
+        )
+    return fast.run_hist(
+        rnd, state0, lambda s: s.decided, mix,
+        max_rounds=rounds, mode=mode, interpret=interpret,
+    )
+
+
 def make_fused_bench(args, S, engine="fused"):
     n, V, rounds = args.n, args.values, args.phases
     rnd = fast.OtrHist(n_values=V, after_decision=2)
@@ -91,22 +114,10 @@ def make_fused_bench(args, S, engine="fused"):
         mix = make_mix(args, key, S)
         k_init = jax.random.fold_in(key, 1)
         init = jax.random.randint(k_init, (n,), 0, V, dtype=jnp.int32)
-        state0 = OtrState(
-            x=jnp.broadcast_to(init, (S, n)).astype(jnp.int32),
-            decided=jnp.zeros((S, n), dtype=bool),
-            decision=jnp.full((S, n), -1, dtype=jnp.int32),
-            after=jnp.full((S, n), 2, dtype=jnp.int32),
+        state0 = _fresh_otr_state(init, S, n)
+        state, done, decided_round = _run_fast_engine(
+            engine, args, rnd, state0, mix, rounds, mode, interpret
         )
-        if engine == "loop":
-            state, done, decided_round = fast.run_otr_loop(
-                rnd, state0, mix, max_rounds=rounds, mode=mode,
-                sb=args.sb, interpret=interpret,
-            )
-        else:
-            state, done, decided_round = fast.run_hist(
-                rnd, state0, lambda s: s.decided, mix,
-                max_rounds=rounds, mode=mode, interpret=interpret,
-            )
         return decided_summary(state.decided, decided_round, rounds, state.decision)
 
     return bench
@@ -148,23 +159,12 @@ def parity_check(args, k_scenarios: int) -> float:
         jax.random.fold_in(key, 1), (n,), 0, V, dtype=jnp.int32
     )
     rnd = fast.OtrHist(n_values=V, after_decision=2)
-    state0 = OtrState(
-        x=jnp.broadcast_to(init, (k_scenarios, n)).astype(jnp.int32),
-        decided=jnp.zeros((k_scenarios, n), dtype=bool),
-        decision=jnp.full((k_scenarios, n), -1, dtype=jnp.int32),
-        after=jnp.full((k_scenarios, n), 2, dtype=jnp.int32),
-    )
+    state0 = _fresh_otr_state(init, k_scenarios, n)
     interpret = jax.default_backend() == "cpu"
-    if args.engine == "loop":
-        state, _done, _dr = fast.run_otr_loop(
-            rnd, state0, mix, max_rounds=rounds, mode="hash", sb=args.sb,
-            interpret=interpret,
-        )
-    else:
-        state, _done, _dr = fast.run_hist(
-            rnd, state0, lambda s: s.decided, mix,
-            max_rounds=rounds, mode="hash", interpret=interpret,
-        )
+    state, _done, _dr = _run_fast_engine(
+        args.engine if args.engine != "reference" else "fused",
+        args, rnd, state0, mix, rounds, "hash", interpret,
+    )
     algo = OTR(after_decision=2, n_values=V)
     agree = 0
     total = 0

@@ -54,9 +54,21 @@ class ClConfig:
     venn_bound: int = 2
     inst_depth: int = 1
     max_insts: int = 50_000
+    # quantifier-instantiation strategy (QStrategy, ClConfig.scala:20-24):
+    # "eager" = full type-correct product (Eager(depth)); "ematch" =
+    # trigger-guided e-matching (logic/Matching.scala) — far fewer
+    # instances on clause-heavy problems, same soundness
+    strategy: str = "eager"
     # optional verify.qilog.QILogger recording the instantiation graph
     # (the reference's --logQI, VerificationOptions.scala:23)
     qi_logger: object = None
+
+    def __post_init__(self):
+        if self.strategy not in ("eager", "ematch"):
+            raise ValueError(
+                f"unknown QI strategy {self.strategy!r}: "
+                "expected 'eager' or 'ematch'"
+            )
 
 
 ClDefault = ClConfig(venn_bound=2, inst_depth=1)
@@ -436,11 +448,18 @@ class ClReducer:
                 ground.extend(dg)
                 universals.extend(du)
 
-        # round 1: eager instantiation over the ground terms
-        insts = quantifiers.instantiate(
-            universals, ground, depth=cfg.inst_depth,
-            max_insts=cfg.max_insts, logger=cfg.qi_logger,
-        )
+        # round 1: quantifier instantiation over the ground terms
+        if cfg.strategy == "ematch":
+            from round_tpu.verify.matching import instantiate_matching
+            insts = instantiate_matching(
+                universals, ground, depth=cfg.inst_depth,
+                max_insts=cfg.max_insts, logger=cfg.qi_logger,
+            )
+        else:
+            insts = quantifiers.instantiate(
+                universals, ground, depth=cfg.inst_depth,
+                max_insts=cfg.max_insts, logger=cfg.qi_logger,
+            )
         # membership may have been β-reduced inside instances
         insts = [rewrite_set_algebra(i) for i in insts]
         base = ground + insts

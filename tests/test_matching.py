@@ -1,0 +1,176 @@
+"""E-matching instantiation (verify/matching.py; reference
+logic/Matching.scala:12-146 + MatchingSuite.scala).
+
+Covers: trigger mining/minimality, matching modulo congruence, the
+instantiation driver's economy vs the eager strategy, and end-to-end CL
+entailments under ClConfig(strategy="ematch") — including a staged LV VC
+re-proved with e-matching and a SAT negative control (no false UNSAT)."""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import dataclasses
+
+from round_tpu.verify.cl import ClConfig, entailment
+from round_tpu.verify.congruence import CongruenceClosure
+from round_tpu.verify.formula import (
+    And, Application, Card, Comprehension, Eq, ForAll, FunT, Geq, Gt, Implies,
+    In, Int, IntLit, Leq, Times, UnInterpretedFct, Variable, procType,
+)
+from round_tpu.verify.matching import (
+    collect_triggers, instantiate_matching, select_trigger_set,
+)
+from round_tpu.verify.quantifiers import instantiate
+from round_tpu.verify.tr import ho_of
+from round_tpu.verify.venn import N_VAR as N
+
+x_fn = UnInterpretedFct("x", FunT([procType], Int))
+ts_fn = UnInterpretedFct("ts", FunT([procType], Int))
+g_fn = UnInterpretedFct("g", FunT([Int], Int))
+
+
+def x(p):
+    return Application(x_fn, [p]).with_type(Int)
+
+
+def ts(p):
+    return Application(ts_fn, [p]).with_type(Int)
+
+
+def g(a):
+    return Application(g_fn, [a]).with_type(Int)
+
+
+def test_triggers_minimal():
+    """f(g(i)) yields the inner g(i), not the enclosing application."""
+    i = Variable("i", procType)
+    clause = ForAll([i], Eq(g(x(i)), IntLit(0)))
+    trigs = collect_triggers(clause)
+    assert trigs == [x(i)]
+
+
+def test_trigger_set_covers_all_vars_or_reports():
+    i = Variable("i", procType)
+    j = Variable("j", procType)
+    clause = ForAll([i, j], Implies(In(i, ho_of(j)), Eq(x(i), x(j))))
+    chosen, uncovered = select_trigger_set(clause)
+    assert not uncovered
+    covered = set()
+    for p in chosen:
+        from round_tpu.verify.futils import free_vars
+        covered |= free_vars(p) & {i, j}
+    assert covered == {i, j}
+
+
+def test_ematch_respects_congruence():
+    """Pattern x(i) must match x(b) when a = b and only x(a) is written
+    with a different spelling in the hypothesis set."""
+    i = Variable("i", procType)
+    a = Variable("a", procType)
+    b = Variable("b", procType)
+    clause = ForAll([i], Geq(x(i), IntLit(0)))
+    ground = [Eq(a, b), Eq(x(b), IntLit(3))]
+    insts = instantiate_matching([clause], ground)
+    # one instance (a and b are one congruence class)
+    assert len(insts) == 1
+    assert insts[0] == Geq(x(b), IntLit(0))
+
+
+def test_ematch_is_leaner_than_eager():
+    """On a 2-variable clause with k process terms, eager makes k² instances
+    while matching only instantiates where the trigger fires."""
+    i = Variable("i", procType)
+    j = Variable("j", procType)
+    clause = ForAll([i, j], Implies(Eq(x(i), x(j)), Eq(ts(i), ts(j))))
+    ps = [Variable(f"p{k}", procType) for k in range(5)]
+    ground = [Eq(x(ps[0]), IntLit(1))] + [Eq(ts(p), IntLit(0)) for p in ps]
+    eager = instantiate([clause], ground)
+    matched = instantiate_matching([clause], ground)
+    assert len(matched) <= len(eager)
+    assert len(matched) >= 1
+
+
+def test_cl_entailment_with_ematch_strategy():
+    """A CLSuite-style HO entailment proves under strategy="ematch"."""
+    i = Variable("i", procType)
+    j = Variable("j", procType)
+    v = Variable("v", Int)
+    k = Variable("k", procType)
+    ho_j = Comprehension([k], In(k, ho_of(j)))
+    hyp = And(
+        Gt(Times(2, Card(ho_j)), N),
+        ForAll([i], Eq(x(i), v)),
+    )
+    # j heard a majority, everyone holds v -> someone in HO(j) holds v
+    from round_tpu.verify.formula import Exists
+    concl = Exists([k], And(In(k, ho_of(j)), Eq(x(k), v)))
+    cfg = ClConfig(venn_bound=2, inst_depth=1, strategy="ematch")
+    assert entailment(hyp, concl, cfg, timeout_s=60)
+
+
+def test_cl_ematch_no_false_unsat():
+    """SAT stays SAT under e-matching: nobody-decided is not entailed."""
+    i = Variable("i", procType)
+    v = Variable("v", Int)
+    from round_tpu.verify.formula import Exists
+    hyp = ForAll([i], Geq(x(i), IntLit(0)))
+    concl = Exists([i], Eq(x(i), IntLit(7)))
+    cfg = ClConfig(venn_bound=2, inst_depth=1, strategy="ematch")
+    assert not entailment(hyp, concl, cfg, timeout_s=30)
+
+
+def test_lv_stage_reproves_with_ematch():
+    """Stage B of the extracted-LV chain (max site >= t) discharges under
+    the e-matching strategy too."""
+    from round_tpu.verify.protocols import lv_extracted_stage_vcs
+
+    stages, _meta = lv_extracted_stage_vcs()
+    name, hyp, concl, cfg = stages[1]
+    assert name.startswith("B")
+    cfg = dataclasses.replace(cfg, strategy="ematch")
+    assert entailment(hyp, concl, cfg, timeout_s=120), name
+
+
+def test_ematch_interpreted_arg_trigger():
+    """A trigger whose bound var sits under an interpreted function
+    (g(x(i)+1)) must still instantiate: deep minimality picks x(i), and the
+    enclosing structure is recovered by congruence (review regression)."""
+    i = Variable("i", procType)
+    p = Variable("p", procType)
+    from round_tpu.verify.formula import Plus
+
+    clause = ForAll([i], Geq(g(Plus(x(i), IntLit(1))), IntLit(0)))
+    trigs = collect_triggers(clause)
+    assert trigs == [x(i)]
+    ground = [Eq(g(Plus(x(p), IntLit(1))), IntLit(5))]
+    insts = instantiate_matching([clause], ground)
+    assert insts == [Geq(g(Plus(x(p), IntLit(1))), IntLit(0))]
+
+
+def test_ematch_interpreted_arg_inside_uninterpreted_head():
+    """f2(i, i+1)-style patterns: the interpreted sibling argument checks by
+    congruence after the var argument binds (argument reordering)."""
+    i = Variable("i", procType)
+    p = Variable("p", procType)
+    from round_tpu.verify.formula import Plus
+
+    f2 = UnInterpretedFct("f2", FunT([procType, Int], Int))
+
+    def f2_of(a, b):
+        return Application(f2, [a, b]).with_type(Int)
+
+    # ts(i) stands in for an int-typed bound expr: pattern arg Plus(ts(i),1)
+    clause = ForAll(
+        [i], Geq(f2_of(i, Plus(ts(i), IntLit(1))), IntLit(0))
+    )
+    ground = [Eq(f2_of(p, Plus(ts(p), IntLit(1))), IntLit(9))]
+    insts = instantiate_matching([clause], ground)
+    assert insts == [Geq(f2_of(p, Plus(ts(p), IntLit(1))), IntLit(0))]
+
+
+def test_clconfig_rejects_unknown_strategy():
+    import pytest
+
+    with pytest.raises(ValueError):
+        ClConfig(strategy="e-match")
