@@ -1,0 +1,77 @@
+"""One replica OS process of a host deployment.
+
+Reference parity: the multi-JVM integration scripts (test_scripts/testOTR.sh
+spawning 4 `example.PerfTest2` JVMs over localhost with an XML peer list,
+Runner.scala:26-32).  Usage:
+
+    python -m round_tpu.apps.host_replica --id 0 \
+        --peers 127.0.0.1:7001,127.0.0.1:7002,127.0.0.1:7003 \
+        --algo otr --value 3
+
+Each process binds its slot of the peer list, runs the algorithm over the
+native TCP transport (runtime/host.py), and prints one JSON line with its
+decision — the shape the shell harness (and tests/test_host.py) collect.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# replicas are CPU processes and must never initialize an accelerator
+# backend (a wedged TPU tunnel would hang the whole deployment): force the
+# platform BEFORE any jax-touching import (the conftest.py pattern)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--id", type=int, required=True)
+    ap.add_argument("--peers", type=str, required=True,
+                    help="comma-separated host:port, index = node id")
+    ap.add_argument("--algo", type=str, default="otr")
+    ap.add_argument("--value", type=int, default=0)
+    ap.add_argument("--instance", type=int, default=1)
+    ap.add_argument("--timeout-ms", type=int, default=300)
+    ap.add_argument("--max-rounds", type=int, default=48)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from round_tpu.apps.selector import select
+    from round_tpu.runtime.host import HostRunner
+    from round_tpu.runtime.transport import HostTransport
+
+    peers = {}
+    for i, hp in enumerate(args.peers.split(",")):
+        host, port = hp.rsplit(":", 1)
+        peers[i] = (host, int(port))
+    algo = select(args.algo)
+
+    with HostTransport(args.id, peers[args.id][1]) as tr:
+        runner = HostRunner(
+            algo, args.id, peers, tr, instance_id=args.instance,
+            timeout_ms=args.timeout_ms, seed=args.seed,
+        )
+        res = runner.run(
+            {"initial_value": np.int32(args.value)},
+            max_rounds=args.max_rounds,
+        )
+    print(json.dumps({
+        "id": args.id,
+        "decided": res.decided,
+        "decision": int(np.asarray(res.decision)),
+        "rounds": res.rounds_run,
+        "dropped": res.dropped_messages,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -88,3 +88,80 @@ class LockManager:
     def holder(self) -> int:
         """The current lock holder (applies decided batches first)."""
         return int(self.smr.apply_decided())
+
+
+# ---------------------------------------------------------------------------
+# External TCP client service (LockManager.scala + README.md:183-199: the
+# lock service replicas accept out-of-group clients over the wire)
+# ---------------------------------------------------------------------------
+
+# user-definable Tag flags (>= 3, Tag.scala:5-12) for the client protocol
+FLAG_LOCK_REQ = 8    # payload: (op, client_id); op in {ACQUIRE, RELEASE}
+FLAG_LOCK_REPLY = 9  # payload: (ok, holder)
+
+
+def serve(lm: LockManager, transport, rounds: Optional[int] = None) -> int:
+    """Run the service loop on `transport` (runtime/transport.py
+    HostTransport): each FLAG_LOCK_REQ message is proposed to the replicated
+    state machine, consensus runs, and the client gets FLAG_LOCK_REPLY with
+    (ok, holder).  `rounds` bounds the loop for tests; None = serve forever.
+    Returns the number of requests served."""
+    import pickle
+
+    from round_tpu.runtime.oob import Tag
+
+    served = 0
+    while rounds is None or served < rounds:
+        got = transport.recv(200)
+        if got is None:
+            continue
+        sender, tag, raw = got
+        if tag.flag != FLAG_LOCK_REQ:
+            continue
+        op, client = pickle.loads(raw)
+        before = lm.holder()
+        lm.request(op, client)
+        lm.process()
+        holder = lm.holder()
+        ok = (
+            (op == ACQUIRE and holder == client)
+            or (op == RELEASE and before == client and holder == FREE)
+        )
+        transport.send(
+            sender, Tag(instance=tag.instance, flag=FLAG_LOCK_REPLY),
+            pickle.dumps((ok, holder)),
+        )
+        served += 1
+    return served
+
+
+def main(argv=None) -> int:
+    """Serve the replicated lock over the native transport:
+
+        python -m round_tpu.apps.lock_manager --port 7500
+
+    Clients connect with a HostTransport id outside the service id and send
+    FLAG_LOCK_REQ messages (tests/test_host.py::test_lock_manager_service
+    is the client recipe)."""
+    import argparse
+
+    from round_tpu.runtime.transport import HostTransport
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--n", type=int, default=4)
+    ap.add_argument("--algorithm", type=str, default="lv")
+    ap.add_argument("--rounds", type=int, default=None,
+                    help="serve this many requests then exit (default: forever)")
+    args = ap.parse_args(argv)
+    lm = LockManager(n=args.n, algorithm=args.algorithm)
+    with HostTransport(0, args.port) as tr:
+        print(f"lock service on port {tr.port}", flush=True)
+        serve(lm, tr, rounds=args.rounds)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,403 @@
+// Host message transport: the native runtime piece of round_tpu.
+//
+// Reference parity: psync's runtime moves 8-byte-Tag + payload packets over
+// Netty TCP channels with length-field framing and a connection handshake
+// (TcpRuntime.scala:27-232, Tag.scala:22-25, Message.scala:15-80).  This is
+// the same wire discipline as a self-contained C++ library driven from
+// Python over ctypes (runtime/transport.py):
+//
+//   frame     := u32_be length | u64_be tag | payload bytes
+//   handshake := u32_be node id, sent by the connecting side first
+//                (the reference sends "host:port"; an id is the same
+//                information under the Directory's id->address map,
+//                Replicas.scala:74-80)
+//
+// Differences from the reference, by design: 4-byte length framing instead
+// of 2 (no 64 KiB payload cap), connect-on-demand from either side instead
+// of the lower-id-connects rule (duplicate channels are harmless: both are
+// read, sends use the newest), and a poll(2) event loop thread instead of
+// epoll/NIO event-loop groups (peer counts here are small).
+//
+// Threading model (one object = one node):
+//   * one event-loop thread owns ALL socket reads + accepts (poll loop),
+//   * senders write from their calling thread under a per-connection mutex
+//     (full-duplex sockets: concurrent read from the loop is safe),
+//   * received messages land in a mutex+condvar inbox drained by
+//     rt_node_recv (the InstanceHandler's ArrayBlockingQueue analogue,
+//     InstanceHandler.scala:45).
+
+#include <algorithm>
+#include <arpa/inet.h>
+#include <cerrno>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Msg {
+  int from;
+  uint64_t tag;
+  std::vector<uint8_t> payload;
+};
+
+struct Conn {
+  int fd = -1;
+  int peer = -1;                  // -1 until the handshake id arrives
+  std::vector<uint8_t> rbuf;      // read accumulator (frames + handshake)
+  bool handshaked = false;
+  std::mutex wmu;                 // serializes writes from sender threads
+};
+
+bool write_all(int fd, const uint8_t *p, size_t len) {
+  while (len > 0) {
+    ssize_t k = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += k;
+    len -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+void put_u32(std::vector<uint8_t> &b, uint32_t v) {
+  b.push_back(v >> 24); b.push_back(v >> 16); b.push_back(v >> 8);
+  b.push_back(v);
+}
+
+uint32_t get_u32(const uint8_t *p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+uint64_t get_u64(const uint8_t *p) {
+  return (uint64_t(get_u32(p)) << 32) | get_u32(p + 4);
+}
+
+struct Node {
+  int id;
+  int listen_fd = -1;
+  int wake_pipe[2] = {-1, -1};    // poke the poll loop on shutdown/connect
+  std::thread loop;
+  bool running = false;
+
+  std::mutex mu;                               // guards conns + peer_addr
+  std::vector<std::shared_ptr<Conn>> conns;
+  std::map<int, std::shared_ptr<Conn>> by_peer;
+  std::map<int, std::pair<std::string, int>> peer_addr;
+
+  std::mutex inbox_mu;
+  std::condition_variable inbox_cv;
+  std::deque<Msg> inbox;
+  size_t max_inbox = 1 << 16;     // drop + count when full (bufferSize
+  size_t dropped = 0;             // semantics, InstanceHandler.scala:85-90)
+
+  ~Node() { stop(); }
+
+  void stop() {
+    {
+      std::lock_guard<std::mutex> l(mu);
+      if (!running) return;
+      running = false;
+    }
+    if (wake_pipe[1] >= 0) { uint8_t b = 0; (void)!write(wake_pipe[1], &b, 1); }
+    if (loop.joinable()) loop.join();
+    // close each fd under ITS write mutex without holding `mu` (senders
+    // take wmu then possibly mu, so mu->wmu nesting here could deadlock)
+    std::vector<std::shared_ptr<Conn>> snapshot;
+    {
+      std::lock_guard<std::mutex> l(mu);
+      snapshot = conns;
+    }
+    for (auto &c : snapshot) {
+      std::lock_guard<std::mutex> lw(c->wmu);
+      if (c->fd >= 0) { close(c->fd); c->fd = -1; }
+    }
+    std::lock_guard<std::mutex> l(mu);
+    conns.clear(); by_peer.clear();
+    if (listen_fd >= 0) { close(listen_fd); listen_fd = -1; }
+    for (int i = 0; i < 2; ++i)
+      if (wake_pipe[i] >= 0) { close(wake_pipe[i]); wake_pipe[i] = -1; }
+    inbox_cv.notify_all();
+  }
+
+  void enqueue(Msg &&m) {
+    {
+      std::lock_guard<std::mutex> l(inbox_mu);
+      if (inbox.size() >= max_inbox) { ++dropped; return; }
+      inbox.push_back(std::move(m));
+    }
+    inbox_cv.notify_one();
+  }
+
+  // parse as many complete frames as rbuf holds
+  void drain(Conn &c) {
+    size_t off = 0;
+    for (;;) {
+      if (!c.handshaked) {
+        if (c.rbuf.size() - off < 4) break;
+        c.peer = static_cast<int>(get_u32(c.rbuf.data() + off));
+        c.handshaked = true;
+        off += 4;
+        std::lock_guard<std::mutex> l(mu);
+        by_peer[c.peer] = nullptr;  // placeholder; fixed below under lock
+        for (auto &sp : conns)
+          if (sp.get() == &c) by_peer[c.peer] = sp;
+        continue;
+      }
+      if (c.rbuf.size() - off < 4) break;
+      uint32_t len = get_u32(c.rbuf.data() + off);
+      if (c.rbuf.size() - off < 4 + len) break;
+      if (len < 8) { off += 4 + len; continue; }  // malformed: skip frame
+      Msg m;
+      m.from = c.peer;
+      m.tag = get_u64(c.rbuf.data() + off + 4);
+      m.payload.assign(c.rbuf.begin() + off + 12,
+                       c.rbuf.begin() + off + 4 + len);
+      enqueue(std::move(m));
+      off += 4 + len;
+    }
+    if (off > 0) c.rbuf.erase(c.rbuf.begin(), c.rbuf.begin() + off);
+  }
+
+  void loop_body() {
+    std::vector<uint8_t> tmp(1 << 16);
+    while (true) {
+      std::vector<pollfd> pfds;
+      std::vector<std::shared_ptr<Conn>> snapshot;
+      {
+        std::lock_guard<std::mutex> l(mu);
+        if (!running) return;
+        pfds.push_back({listen_fd, POLLIN, 0});
+        pfds.push_back({wake_pipe[0], POLLIN, 0});
+        for (auto &c : conns)
+          if (c->fd >= 0) {
+            pfds.push_back({c->fd, POLLIN, 0});
+            snapshot.push_back(c);
+          }
+      }
+      int rc = poll(pfds.data(), pfds.size(), 200);
+      if (rc < 0 && errno != EINTR) return;
+      {
+        std::lock_guard<std::mutex> l(mu);
+        if (!running) return;
+      }
+      if (rc <= 0) continue;
+      if (pfds[1].revents & POLLIN) {
+        uint8_t b;
+        while (read(wake_pipe[0], &b, 1) > 0) {}
+      }
+      if (pfds[0].revents & POLLIN) {
+        int fd = accept(listen_fd, nullptr, nullptr);
+        if (fd >= 0) {
+          int one = 1;
+          setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          auto c = std::make_shared<Conn>();
+          c->fd = fd;
+          std::lock_guard<std::mutex> l(mu);
+          conns.push_back(c);
+        }
+      }
+      for (size_t k = 0; k < snapshot.size(); ++k) {
+        if (!(pfds[2 + k].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+        auto &c = snapshot[k];
+        ssize_t got = recv(c->fd, tmp.data(), tmp.size(), 0);
+        if (got <= 0) {
+          {
+            // exclude senders mid-write before closing: otherwise the fd
+            // number can be reused by a new accept and write_all would
+            // send a frame down the wrong socket
+            std::lock_guard<std::mutex> lw(c->wmu);
+            close(c->fd);
+            c->fd = -1;
+          }
+          std::lock_guard<std::mutex> l(mu);
+          if (c->handshaked) {
+            auto it = by_peer.find(c->peer);
+            if (it != by_peer.end() && it->second == c) by_peer.erase(it);
+          }
+          continue;
+        }
+        c->rbuf.insert(c->rbuf.end(), tmp.data(), tmp.data() + got);
+        drain(*c);
+      }
+      // compact closed connections
+      std::lock_guard<std::mutex> l(mu);
+      conns.erase(
+          std::remove_if(conns.begin(), conns.end(),
+                         [](const std::shared_ptr<Conn> &c) {
+                           return c->fd < 0;
+                         }),
+          conns.end());
+    }
+  }
+
+  std::shared_ptr<Conn> connect_to(int peer) {
+    std::pair<std::string, int> addr;
+    {
+      std::lock_guard<std::mutex> l(mu);
+      auto it = by_peer.find(peer);
+      if (it != by_peer.end() && it->second && it->second->fd >= 0)
+        return it->second;
+      auto ad = peer_addr.find(peer);
+      if (ad == peer_addr.end()) return nullptr;
+      addr = ad->second;
+    }
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    std::string port = std::to_string(addr.second);
+    if (getaddrinfo(addr.first.c_str(), port.c_str(), &hints, &res) != 0)
+      return nullptr;
+    int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    int ok = fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0;
+    freeaddrinfo(res);
+    if (!ok) {
+      if (fd >= 0) close(fd);
+      return nullptr;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // handshake: our id first (TcpRuntime.scala:357-368's client hello)
+    std::vector<uint8_t> hello;
+    put_u32(hello, static_cast<uint32_t>(id));
+    if (!write_all(fd, hello.data(), hello.size())) {
+      close(fd);
+      return nullptr;
+    }
+    auto c = std::make_shared<Conn>();
+    c->fd = fd;
+    c->peer = peer;
+    c->handshaked = true;  // outbound: we know who we dialed
+    {
+      std::lock_guard<std::mutex> l(mu);
+      conns.push_back(c);
+      by_peer[peer] = c;
+    }
+    if (wake_pipe[1] >= 0) { uint8_t b = 0; (void)!write(wake_pipe[1], &b, 1); }
+    return c;
+  }
+
+  bool send_msg(int peer, uint64_t tag, const uint8_t *payload, int len) {
+    auto c = connect_to(peer);
+    if (!c) return false;
+    std::vector<uint8_t> frame;
+    frame.reserve(12 + len);
+    put_u32(frame, static_cast<uint32_t>(8 + len));
+    put_u32(frame, static_cast<uint32_t>(tag >> 32));
+    put_u32(frame, static_cast<uint32_t>(tag & 0xFFFFFFFFu));
+    frame.insert(frame.end(), payload, payload + len);
+    std::lock_guard<std::mutex> l(c->wmu);
+    if (c->fd < 0) return false;
+    if (!write_all(c->fd, frame.data(), frame.size())) {
+      // connection died mid-write: drop it, caller may retry (reconnect
+      // semantics of TcpRuntime.scala:162-211)
+      std::lock_guard<std::mutex> l2(mu);
+      auto it = by_peer.find(peer);
+      if (it != by_peer.end() && it->second == c) by_peer.erase(it);
+      return false;
+    }
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void *rt_node_create(int id, int listen_port) {
+  auto *n = new Node();
+  n->id = id;
+  n->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (n->listen_fd < 0) { delete n; return nullptr; }
+  int one = 1;
+  setsockopt(n->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_ANY);
+  sa.sin_port = htons(static_cast<uint16_t>(listen_port));
+  if (bind(n->listen_fd, reinterpret_cast<sockaddr *>(&sa), sizeof(sa)) != 0 ||
+      listen(n->listen_fd, 64) != 0 || pipe(n->wake_pipe) != 0) {
+    close(n->listen_fd);
+    delete n;
+    return nullptr;
+  }
+  // the wake pipe is drained with a read loop: it MUST be non-blocking or
+  // the drain blocks the event loop once empty
+  fcntl(n->wake_pipe[0], F_SETFL, O_NONBLOCK);
+  fcntl(n->wake_pipe[1], F_SETFL, O_NONBLOCK);
+  n->running = true;
+  n->loop = std::thread([n] { n->loop_body(); });
+  return n;
+}
+
+int rt_node_port(void *node) {
+  auto *n = static_cast<Node *>(node);
+  sockaddr_in sa{};
+  socklen_t len = sizeof(sa);
+  if (getsockname(n->listen_fd, reinterpret_cast<sockaddr *>(&sa), &len) != 0)
+    return -1;
+  return ntohs(sa.sin_port);
+}
+
+void rt_node_add_peer(void *node, int peer_id, const char *host, int port) {
+  auto *n = static_cast<Node *>(node);
+  std::lock_guard<std::mutex> l(n->mu);
+  n->peer_addr[peer_id] = {host, port};
+}
+
+int rt_node_send(void *node, int peer_id, uint64_t tag,
+                 const uint8_t *payload, int len) {
+  auto *n = static_cast<Node *>(node);
+  return n->send_msg(peer_id, tag, payload, len) ? 0 : -1;
+}
+
+// Returns payload length (>= 0) with *from/*tag filled, -1 on timeout,
+// -2 if buf is too small (message stays queued; call again bigger).
+int rt_node_recv(void *node, int *from, uint64_t *tag, uint8_t *buf,
+                 int buflen, int timeout_ms) {
+  auto *n = static_cast<Node *>(node);
+  std::unique_lock<std::mutex> l(n->inbox_mu);
+  if (!n->inbox_cv.wait_for(l, std::chrono::milliseconds(timeout_ms),
+                            [n] { return !n->inbox.empty(); }))
+    return -1;
+  Msg &m = n->inbox.front();
+  if (static_cast<int>(m.payload.size()) > buflen) return -2;
+  *from = m.from;
+  *tag = m.tag;
+  std::memcpy(buf, m.payload.data(), m.payload.size());
+  int len = static_cast<int>(m.payload.size());
+  n->inbox.pop_front();
+  return len;
+}
+
+uint64_t rt_node_dropped(void *node) {
+  auto *n = static_cast<Node *>(node);
+  std::lock_guard<std::mutex> l(n->inbox_mu);
+  return n->dropped;
+}
+
+void rt_node_destroy(void *node) {
+  auto *n = static_cast<Node *>(node);
+  n->stop();
+  delete n;
+}
+
+}  // extern "C"

@@ -1,0 +1,171 @@
+"""Host multi-process round execution: one OS process per replica.
+
+This is the deployment shape of the reference — n JVMs, one per ProcessID,
+full TCP mesh, the InstanceHandler loop driving init → send → accumulate →
+update per round (InstanceHandler.scala:164-258) — rebuilt on the native
+transport (native/transport.cpp via runtime/transport.py).
+
+The SAME algorithm classes the TPU engine runs (core/algorithm.py Round
+DSL) run here unchanged: their send/update are per-lane pure functions, so
+one process evaluates them for its own lane on CPU scalars while the
+simulator vmaps them over [scenario, lane] axes on the chip.  That is the
+framework's deployment story: simulate at scale on TPU, deploy the
+identical protocol code process-per-replica.
+
+Round discipline (benign model):
+  * send: evaluate SendSpec, unicast payload bytes per selected dest
+    (self-delivery short-circuits the wire, Round.scala:114-117);
+  * accumulate: block on the transport inbox until every live peer was
+    heard or the round timeout fires (Progress.timeout,
+    InstanceHandler.scala:197-245);
+  * early messages for future rounds are buffered, late ones dropped
+    (the pendingMessages priority queue role, InstanceHandler.scala:68-72);
+  * update: fold the mailbox; `exitAtEndOfRound` ends the run.
+
+Payloads cross the wire pickled (the Kryo role; same trust model as the
+reference — replicas deserialize only from their own group).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+import time as _time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import RoundCtx
+from round_tpu.ops.mailbox import Mailbox
+from round_tpu.runtime.oob import FLAG_NORMAL, Message, Tag
+from round_tpu.runtime.transport import HostTransport
+
+
+@dataclasses.dataclass
+class HostResult:
+    state: Any
+    decided: bool
+    decision: Any
+    rounds_run: int
+    dropped_messages: int
+
+
+class HostRunner:
+    """Run one replica of an Algorithm instance over the host transport.
+
+    `peers` maps every node id (including ours) to (host, port).  The run is
+    an instance in the reference sense: `instance_id` tags every packet and
+    foreign-instance packets are handed to `default_handler` (or dropped)."""
+
+    def __init__(
+        self,
+        algo: Algorithm,
+        my_id: int,
+        peers: Dict[int, Tuple[str, int]],
+        transport: HostTransport,
+        instance_id: int = 1,
+        timeout_ms: int = 200,
+        seed: int = 0,
+        default_handler=None,
+    ):
+        self.algo = algo
+        self.id = my_id
+        self.n = len(peers)
+        self.transport = transport
+        self.instance_id = instance_id & 0xFFFF
+        self.timeout_ms = timeout_ms
+        self.seed = seed
+        self.default_handler = default_handler
+        for pid, (host, port) in peers.items():
+            if pid != my_id:
+                transport.add_peer(pid, host, port)
+        # round -> {sender: payload}; early messages wait here
+        self._pending: Dict[int, Dict[int, Any]] = {}
+
+    def _ctx(self, r: int) -> RoundCtx:
+        rng = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(self.seed), r), self.id
+        )
+        return RoundCtx(id=np.int32(self.id), n=self.n, r=np.int32(r),
+                        rng=rng)
+
+    def run(self, io: Any, max_rounds: int = 64) -> HostResult:
+        algo = self.algo
+        state = algo.make_init_state(self._ctx(0), io)
+        rounds = algo.rounds
+        exited = False
+        r = 0
+        while r < max_rounds and not exited:
+            rnd = rounds[r % len(rounds)]
+            ctx = self._ctx(r)
+            spec = rnd.send(ctx, state)
+            dest = np.asarray(spec.dest_mask)
+            payload_np = jax.tree_util.tree_map(np.asarray, spec.payload)
+            wire = pickle.dumps(payload_np)
+            for d in range(self.n):
+                if d == self.id or not dest[d]:
+                    continue
+                self.transport.send(
+                    d, Tag(instance=self.instance_id, round=r), wire
+                )
+
+            # -- accumulate (InstanceHandler.scala:197-245) ---------------
+            inbox: Dict[int, Any] = dict(self._pending.pop(r, {}))
+            if dest[self.id]:
+                inbox[self.id] = payload_np  # self-delivery off the wire
+            deadline = _time.monotonic() + self.timeout_ms / 1000.0
+            expected = rnd.expected_nbr_messages(ctx, state)
+            while len(inbox) < min(self.n, int(expected)):
+                left_ms = int((deadline - _time.monotonic()) * 1000)
+                if left_ms <= 0:
+                    break
+                got = self.transport.recv(left_ms)
+                if got is None:
+                    break
+                sender, tag, raw = got
+                if tag.instance != self.instance_id or tag.flag != FLAG_NORMAL:
+                    if self.default_handler is not None:
+                        self.default_handler(Message(
+                            sender=sender, tag=tag,
+                            payload=pickle.loads(raw) if raw else None,
+                        ))
+                    continue
+                if tag.round < r:
+                    continue  # late: the round is communication-closed
+                payload = pickle.loads(raw)
+                if tag.round > r:
+                    self._pending.setdefault(tag.round, {})[sender] = payload
+                    continue
+                inbox[sender] = payload
+
+            # -- update ---------------------------------------------------
+            mbox = self._mailbox(inbox, payload_np)
+            state = rnd.update(ctx, state, mbox)
+            exited = bool(np.asarray(ctx._exit))
+            r += 1
+
+        decided = bool(np.asarray(algo.decided(state)))
+        decision = np.asarray(algo.decision(state))
+        return HostResult(
+            state=state, decided=decided, decision=decision, rounds_run=r,
+            dropped_messages=self.transport.dropped,
+        )
+
+    def _mailbox(self, inbox: Dict[int, Any], like: Any) -> Mailbox:
+        """Stack per-sender payloads into the [n, ...] arrays + mask the
+        Round DSL's update expects (the dense-mailbox view of the wire)."""
+        leaves_like, treedef = jax.tree_util.tree_flatten(like)
+        stacked = [
+            np.zeros((self.n,) + np.shape(l), dtype=np.asarray(l).dtype)
+            for l in leaves_like
+        ]
+        mask = np.zeros((self.n,), dtype=bool)
+        for sender, payload in inbox.items():
+            leaves = jax.tree_util.tree_flatten(payload)[0]
+            for slot, leaf in zip(stacked, leaves):
+                slot[sender] = leaf
+            mask[sender] = True
+        values = jax.tree_util.tree_unflatten(treedef, stacked)
+        return Mailbox(values, np.asarray(mask))

@@ -12,10 +12,11 @@ names/addresses.  Membership changes happen *between* instances (exactly the
 reference's DynamicMembership pattern: consensus decides a membership op,
 then the group is updated and the next instance runs over the new group) —
 so a change is: mutate the Directory, then start new instances with the new
-``group.size``.  Addresses are opaque to the simulator (kept as "host:port"
-strings for config parity with the reference's Replica records,
-Replicas.scala:9-18); no host transport consumes them — the wire is the
-on-device exchange kernel.
+``group.size``.  Addresses are opaque to the simulator (the wire there is
+the on-device exchange kernel); the host deployment path consumes them —
+runtime/host.py + runtime/transport.py run one replica per OS process with
+the id→(host, port) map as the peer table (the reference's Replica records,
+Replicas.scala:9-18).
 """
 
 from __future__ import annotations

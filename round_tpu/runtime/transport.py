@@ -1,0 +1,186 @@
+"""Host TCP transport over the native library (native/transport.cpp).
+
+This is the real multi-process deployment path: the reference runs one JVM
+per replica with Netty TCP channels between them (TcpRuntime.scala:27-232);
+here each OS process owns a `HostTransport` backed by the C++ poll-loop
+library, and messages keep the reference's shape — the 8-byte Tag of
+runtime/oob.py (flag | callStack | instance | round, Tag.scala:22-25)
+followed by payload bytes.
+
+The same `Message` objects that flow over the in-process `LocalBus`
+(runtime/oob.py) travel here unchanged: `HostBus` implements the LocalBus
+surface (send/deliver) over sockets, so a `PoolNode` — decision replay,
+lazy join, recovery — works across real processes too.  The lockstep
+round-execution path on top of this lives in runtime/host.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import subprocess
+import threading
+from typing import Dict, Optional, Tuple
+
+from round_tpu.runtime.oob import Message, Tag
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        # cross-PROCESS build lock: replicas start concurrently (one OS
+        # process each) and must not race `make` writing the same .so
+        import fcntl
+
+        os.makedirs(os.path.join(_NATIVE_DIR, "_build"), exist_ok=True)
+        with open(os.path.join(_NATIVE_DIR, "_build", ".lock"), "w") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            subprocess.run(
+                ["make", "-s"], cwd=_NATIVE_DIR, check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(
+            os.path.join(_NATIVE_DIR, "_build", "libroundnet.so")
+        )
+        lib.rt_node_create.restype = ctypes.c_void_p
+        lib.rt_node_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.rt_node_port.restype = ctypes.c_int
+        lib.rt_node_port.argtypes = [ctypes.c_void_p]
+        lib.rt_node_add_peer.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int
+        ]
+        lib.rt_node_send.restype = ctypes.c_int
+        lib.rt_node_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.rt_node_recv.restype = ctypes.c_int
+        lib.rt_node_recv.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.rt_node_dropped.restype = ctypes.c_uint64
+        lib.rt_node_dropped.argtypes = [ctypes.c_void_p]
+        lib.rt_node_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class HostTransport:
+    """One node of the host runtime: a listening socket + lazy outbound
+    connections, sending/receiving Tag+payload frames.
+
+    `port=0` binds an ephemeral port (read it back from `.port` — the test
+    harness pattern; fixed ports mirror the reference's XML peer lists,
+    Config.scala:6-27)."""
+
+    def __init__(self, node_id: int, port: int = 0):
+        self._lib = _load()
+        self.id = node_id
+        self._node = self._lib.rt_node_create(node_id, port)
+        if not self._node:
+            raise OSError(f"could not bind node {node_id} on port {port}")
+        self.port = self._lib.rt_node_port(self._node)
+        self._buf = ctypes.create_string_buffer(1 << 20)
+
+    def add_peer(self, peer_id: int, host: str, port: int) -> None:
+        self._lib.rt_node_add_peer(
+            self._node, peer_id, host.encode(), port
+        )
+
+    def send(self, to: int, tag: Tag, payload: bytes = b"") -> bool:
+        """False when the peer is unreachable (reconnect is retried on the
+        next send, TcpRuntime.scala:162-211 semantics)."""
+        rc = self._lib.rt_node_send(
+            self._node, to, tag.pack() & 0xFFFFFFFFFFFFFFFF, payload,
+            len(payload),
+        )
+        return rc == 0
+
+    def recv(self, timeout_ms: int) -> Optional[Tuple[int, Tag, bytes]]:
+        from_id = ctypes.c_int()
+        tagw = ctypes.c_uint64()
+        n = self._lib.rt_node_recv(
+            self._node, ctypes.byref(from_id), ctypes.byref(tagw),
+            self._buf, len(self._buf), timeout_ms,
+        )
+        if n == -1:
+            return None
+        if n == -2:  # grow and retry (message stays queued)
+            self._buf = ctypes.create_string_buffer(len(self._buf) * 4)
+            return self.recv(timeout_ms)
+        tag = Tag.unpack(_to_signed64(tagw.value))
+        # string_at copies exactly n bytes (.raw would copy the whole buffer)
+        return from_id.value, tag, ctypes.string_at(self._buf, n)
+
+    @property
+    def dropped(self) -> int:
+        return int(self._lib.rt_node_dropped(self._node))
+
+    def close(self) -> None:
+        if self._node:
+            self._lib.rt_node_destroy(self._node)
+            self._node = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _to_signed64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+class HostBus:
+    """LocalBus surface over HostTransport: Message objects (runtime/oob.py)
+    cross process boundaries with their Tag on the wire and the payload
+    pickled (the Kryo role, utils/serialization in the reference — pytree
+    payloads on the hot path never come through here; this is the control
+    plane: decisions, probes, recovery)."""
+
+    def __init__(self, transport: HostTransport):
+        self.transport = transport
+        self.node = None  # PoolNode, set by register()
+
+    def register(self, node) -> None:
+        self.node = node
+        node.bus = self
+
+    def send(self, to: int, msg: Message) -> None:
+        self.transport.send(to, msg.tag, pickle.dumps(msg.payload))
+
+    def deliver(self, node_id: Optional[int] = None,
+                limit: Optional[int] = None, timeout_ms: int = 0) -> int:
+        """Drain received messages into the registered node's
+        default_handler (LocalBus.deliver semantics: a handler error does
+        not discard the rest of the batch).  `node_id` is accepted for
+        LocalBus signature compatibility — a HostBus has exactly one node."""
+        count = 0
+        first_err: Optional[Exception] = None
+        while limit is None or count < limit:
+            got = self.transport.recv(timeout_ms if count == 0 else 0)
+            if got is None:
+                break
+            from_id, tag, raw = got
+            payload = pickle.loads(raw) if raw else None
+            count += 1
+            try:
+                self.node.default_handler(
+                    Message(tag=tag, sender=from_id, payload=payload)
+                )
+            except Exception as e:  # noqa: BLE001 - per-message isolation
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+        return count

@@ -482,7 +482,12 @@ class ClReducer:
         for vr in regions.values():
             all_witnesses.extend(vr.witnesses)
 
-        # round 2: make the universals bite on the region witnesses
+        # round 2: make the universals bite on the region witnesses.
+        # DELIBERATELY eager even under strategy="ematch": witnesses are
+        # fresh variables with no function applications over them, so no
+        # trigger can fire on them — e-matching here would drop exactly the
+        # witness instances the venn chain needs (the cost is bounded: the
+        # witness universe is the region count, not the full term universe)
         wit_ground = base + [
             Application(EQ, [w, w]).with_type(Bool) for w in all_witnesses
         ]

@@ -54,7 +54,8 @@ def collect_triggers(clause: Binding) -> List[Application]:
         return bool(free_vars(t) & bound)
 
     def walk(t: Formula) -> bool:
-        """Mine t; returns True if t or any subterm became a candidate."""
+        """Mine t; returns True if t or any subterm IS a candidate (seen or
+        new — dedup must not leak the enclosing term past minimality)."""
         if isinstance(t, Binding):
             # nested binders: their own vars are not ours; still mine the
             # body for patterns over OUR bound vars
@@ -68,10 +69,10 @@ def collect_triggers(clause: Binding) -> List[Application]:
             isinstance(t.fct, UnInterpretedFct)
             and has_bound(t)
             and not sub_has  # deep minimality
-            and t not in seen
         ):
-            seen.add(t)
-            out.append(t)
+            if t not in seen:
+                seen.add(t)
+                out.append(t)
             return True
         return sub_has
 

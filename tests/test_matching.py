@@ -174,3 +174,15 @@ def test_clconfig_rejects_unknown_strategy():
 
     with pytest.raises(ValueError):
         ClConfig(strategy="e-match")
+
+
+def test_triggers_dedup_does_not_leak_minimality():
+    """x(i) occurring twice (once under g) must still suppress g(x(i)):
+    the seen-dedup must report candidacy for already-seen subterms
+    (review regression: the enclosing term used to become a trigger)."""
+    i = Variable("i", procType)
+    p = Variable("p", procType)
+    clause = ForAll([i], And(Geq(x(i), IntLit(0)), Eq(g(x(i)), IntLit(1))))
+    assert collect_triggers(clause) == [x(i)]
+    insts = instantiate_matching([clause], [Eq(x(p), IntLit(3))])
+    assert len(insts) == 1
