@@ -114,6 +114,8 @@ def serve(lm: LockManager, transport, rounds: Optional[int] = None) -> int:
     while rounds is None or served < rounds:
         got = transport.recv(200)
         if got is None:
+            if transport.closed:  # transport.stop() ends the service loop
+                break
             continue
         sender, tag, raw = got
         if tag.flag != FLAG_LOCK_REQ:

@@ -108,6 +108,9 @@ struct Node {
   std::deque<Msg> inbox;
   size_t max_inbox = 1 << 16;     // drop + count when full (bufferSize
   size_t dropped = 0;             // semantics, InstanceHandler.scala:85-90)
+  bool recv_stopped = false;      // recv returns -3 once stopped, so
+                                  // blocked receiver threads can unwind
+                                  // BEFORE the node is destroyed
 
   ~Node() { stop(); }
 
@@ -117,6 +120,11 @@ struct Node {
       if (!running) return;
       running = false;
     }
+    {
+      std::lock_guard<std::mutex> l(inbox_mu);
+      recv_stopped = true;
+    }
+    inbox_cv.notify_all();
     if (wake_pipe[1] >= 0) { uint8_t b = 0; (void)!write(wake_pipe[1], &b, 1); }
     if (loop.joinable()) loop.join();
     // close each fd under ITS write mutex without holding `mu` (senders
@@ -370,14 +378,15 @@ int rt_node_send(void *node, int peer_id, uint64_t tag,
 }
 
 // Returns payload length (>= 0) with *from/*tag filled, -1 on timeout,
-// -2 if buf is too small (message stays queued; call again bigger).
+// -2 if buf is too small (message stays queued; call again bigger),
+// -3 once the node was stopped (rt_node_stop) and the inbox is empty.
 int rt_node_recv(void *node, int *from, uint64_t *tag, uint8_t *buf,
                  int buflen, int timeout_ms) {
   auto *n = static_cast<Node *>(node);
   std::unique_lock<std::mutex> l(n->inbox_mu);
-  if (!n->inbox_cv.wait_for(l, std::chrono::milliseconds(timeout_ms),
-                            [n] { return !n->inbox.empty(); }))
-    return -1;
+  n->inbox_cv.wait_for(l, std::chrono::milliseconds(timeout_ms),
+                       [n] { return !n->inbox.empty() || n->recv_stopped; });
+  if (n->inbox.empty()) return n->recv_stopped ? -3 : -1;
   Msg &m = n->inbox.front();
   if (static_cast<int>(m.payload.size()) > buflen) return -2;
   *from = m.from;
@@ -386,6 +395,13 @@ int rt_node_recv(void *node, int *from, uint64_t *tag, uint8_t *buf,
   int len = static_cast<int>(m.payload.size());
   n->inbox.pop_front();
   return len;
+}
+
+// Stop the node (event loop joined, sockets closed, blocked rt_node_recv
+// calls return -3) WITHOUT freeing it: lets receiver threads unwind before
+// rt_node_destroy.  Idempotent.
+void rt_node_stop(void *node) {
+  static_cast<Node *>(node)->stop();
 }
 
 uint64_t rt_node_dropped(void *node) {

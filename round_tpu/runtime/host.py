@@ -100,6 +100,7 @@ class HostRunner:
         while r < max_rounds and not exited:
             rnd = rounds[r % len(rounds)]
             ctx = self._ctx(r)
+            state = rnd.pre(ctx, state)  # round-var resets (executor.py:85)
             spec = rnd.send(ctx, state)
             dest = np.asarray(spec.dest_mask)
             payload_np = jax.tree_util.tree_map(np.asarray, spec.payload)

@@ -42,9 +42,11 @@ def _load() -> ctypes.CDLL:
         os.makedirs(os.path.join(_NATIVE_DIR, "_build"), exist_ok=True)
         with open(os.path.join(_NATIVE_DIR, "_build", ".lock"), "w") as lk:
             fcntl.flock(lk, fcntl.LOCK_EX)
+            # build only the transport library: the sat solver binary is an
+            # unrelated target and must not gate (or slow) replica startup
             subprocess.run(
-                ["make", "-s"], cwd=_NATIVE_DIR, check=True,
-                capture_output=True,
+                ["make", "-s", "_build/libroundnet.so"], cwd=_NATIVE_DIR,
+                check=True, capture_output=True,
             )
         lib = ctypes.CDLL(
             os.path.join(_NATIVE_DIR, "_build", "libroundnet.so")
@@ -69,6 +71,7 @@ def _load() -> ctypes.CDLL:
         ]
         lib.rt_node_dropped.restype = ctypes.c_uint64
         lib.rt_node_dropped.argtypes = [ctypes.c_void_p]
+        lib.rt_node_stop.argtypes = [ctypes.c_void_p]
         lib.rt_node_destroy.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
@@ -90,6 +93,7 @@ class HostTransport:
             raise OSError(f"could not bind node {node_id} on port {port}")
         self.port = self._lib.rt_node_port(self._node)
         self._buf = ctypes.create_string_buffer(1 << 20)
+        self.closed = False  # set once recv observes the stopped node
 
     def add_peer(self, peer_id: int, host: str, port: int) -> None:
         self._lib.rt_node_add_peer(
@@ -114,6 +118,9 @@ class HostTransport:
         )
         if n == -1:
             return None
+        if n == -3:  # node stopped: no more messages will ever arrive
+            self.closed = True
+            return None
         if n == -2:  # grow and retry (message stays queued)
             self._buf = ctypes.create_string_buffer(len(self._buf) * 4)
             return self.recv(timeout_ms)
@@ -125,10 +132,22 @@ class HostTransport:
     def dropped(self) -> int:
         return int(self._lib.rt_node_dropped(self._node))
 
-    def close(self) -> None:
+    def stop(self) -> None:
+        """Stop the node without freeing it: blocked recv() calls in other
+        threads return None (and flag `closed`) so they can unwind before
+        close() frees the native object.  Idempotent."""
         if self._node:
+            self._lib.rt_node_stop(self._node)
+
+    def close(self) -> None:
+        """Free the node.  Callers with receiver threads must stop() and
+        join them first (tests/test_host.py::test_lock_manager_service is
+        the pattern)."""
+        if self._node:
+            self._lib.rt_node_stop(self._node)
             self._lib.rt_node_destroy(self._node)
             self._node = None
+            self.closed = True
 
     def __enter__(self):
         return self

@@ -33,7 +33,7 @@ from round_tpu.verify.formula import (
     EQ, Eq, EXISTS, FORALL, FNONE_SYM, FOption, FSOME, FSet, FMap, Formula,
     FunT, GET, Geq, GEQ, GT, Gt, IMPLIES, IN, INTERSECTION, IS_DEFINED,
     IS_DEFINED_AT, Int, IntLit, IntT, ITE, Implies, KEYSET, LEQ, LOOKUP, LT,
-    Leq, Literal, Lt, MSIZE, NEQ, NOT, Not, OR, Or, Plus, SETMINUS,
+    Leq, Literal, Lt, MSIZE, NEQ, Neq, NOT, Not, OR, Or, Plus, SETMINUS,
     SUBSET_EQ, Times, Type, UNION, UPDATED, UnInterpreted, UnInterpretedFct,
     Variable, procType, timeType,
 )
@@ -313,20 +313,35 @@ def reduce_ordered(f: Formula) -> Formula:
 
 
 def theory_ground_axioms(conjuncts: Sequence[Formula]) -> List[Formula]:
-    """Ground instances of the option/tuple laws for every constructor
-    application present (OptionAxioms/TupleAxioms,
-    AxiomatizedTheories.scala:8-209, e-matching-lite): for each ground
-    Some(x): IsDefined(Some x) ∧ Get(Some x) = x; for each None: ¬IsDefined;
-    for each Tuple(a, b, ...): Fst/Snd/Trd projections.  Congruence closure
-    then transports these to opaque terms merely EQUAL to a constructor
-    (x = Some(p) ⊢ Get(x) = p), which the syntactic rewrites cannot reach."""
+    """Ground instances of the option/tuple/map-update laws for every
+    constructor application present (OptionAxioms/TupleAxioms/
+    MapUpdateAxioms, AxiomatizedTheories.scala:8-209, e-matching-lite):
+
+      Some(x)          ⊢ IsDefined ∧ Get = x;  None ⊢ ¬IsDefined
+      Tuple(a, b)      ⊢ Fst = a ∧ Snd = b  (pairs; wider tuples thin)
+      U = Updated(m, k, v) ⊢ LookUp(U, k) = v ∧ k ∈ KeySet(U), and for
+        every OTHER ground key-typed term j in the universe:
+        j ≠ k → LookUp(U, j) = LookUp(m, j)
+        j ≠ k → (j ∈ KeySet(U) ↔ j ∈ KeySet(m))
+
+    Congruence closure then transports these to opaque terms merely EQUAL
+    to a constructor (x = Some(p) ⊢ Get(x) = p; log1 = Updated(log0, …) ⊢
+    the VsExample "check" lemmas), which the syntactic rewrites
+    (rewrite_maps) cannot reach."""
     from round_tpu.verify.formula import FST, SND, TUPLE
     from round_tpu.verify.futils import collect_ground_terms
 
     out: List[Formula] = []
     seen: set = set()
+    updates: List[Application] = []
+    key_terms: Dict[Type, List[Formula]] = {}
+    all_ground: set = set()
     for c in conjuncts:
         for g in collect_ground_terms(c):
+            if g in all_ground:
+                continue
+            all_ground.add(g)
+            key_terms.setdefault(g.tpe, []).append(g)
             if not isinstance(g, Application) or g in seen:
                 continue
             seen.add(g)
@@ -342,6 +357,52 @@ def theory_ground_axioms(conjuncts: Sequence[Formula]) -> List[Formula]:
                         Application(proj, [g]).with_type(g.args[k].tpe),
                         g.args[k],
                     ))
+            elif g.fct == UPDATED:
+                updates.append(g)
+
+    # Literal keys too (LookUp(m, 3)): collect_ground_terms never yields
+    # Literals, but the Updated frame axioms below must range over them —
+    # they are used only here, so the usual literal-bloat concern
+    # (quantifiers.ground_terms_by_type) does not apply
+    def _mine_literals(g: Formula):
+        if isinstance(g, Literal):
+            if g not in all_ground:
+                all_ground.add(g)
+                key_terms.setdefault(g.tpe, []).append(g)
+        elif isinstance(g, Application):
+            for a in g.args:
+                _mine_literals(a)
+        elif isinstance(g, Binding):
+            _mine_literals(g.body)
+
+    if updates:
+        for c in conjuncts:
+            _mine_literals(c)
+
+    def keyset_of(m):
+        ks = Application(KEYSET, [m])
+        if isinstance(m.tpe, FMap):
+            ks.tpe = FSet(m.tpe.key)
+        return ks
+
+    for u in updates:
+        m, k, v = u.args
+        val_t = m.tpe.value if isinstance(m.tpe, FMap) else v.tpe
+        key_t = m.tpe.key if isinstance(m.tpe, FMap) else k.tpe
+        out.append(Eq(Application(LOOKUP, [u, k]).with_type(val_t), v))
+        out.append(Application(IN, [k, keyset_of(u)]).with_type(Bool))
+        for j in key_terms.get(key_t, []):
+            if j == k:
+                continue
+            ne = Neq(j, k)
+            out.append(Or(Not(ne), Eq(
+                Application(LOOKUP, [u, j]).with_type(val_t),
+                Application(LOOKUP, [m, j]).with_type(val_t),
+            )))
+            in_u = Application(IN, [j, keyset_of(u)]).with_type(Bool)
+            in_m = Application(IN, [j, keyset_of(m)]).with_type(Bool)
+            out.append(Or(Not(ne), And(Or(Not(in_u), in_m),
+                                       Or(Not(in_m), in_u))))
     return out
 
 

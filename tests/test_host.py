@@ -176,6 +176,11 @@ def test_lock_manager_service():
         ok3, holder3 = ask(RELEASE, 7)
         assert ok3 and holder3 == FREE
     finally:
-        t.join(timeout=120)
+        # stop-then-join-then-free: the serve thread observes the stopped
+        # transport (recv -> None with .closed) and exits before close()
+        # releases the native node, even when an assertion failed mid-test
+        server.stop()
+        t.join(timeout=60)
+        assert not t.is_alive(), "serve thread failed to unwind"
         server.close()
         client.close()

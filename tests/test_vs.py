@@ -1,0 +1,236 @@
+"""ViewSync (view-synchronous log replication) — the reference's VsExample
+suite (logic/VsExample.scala:1-178) through the native reducer.
+
+The reference PROVES: the invariants are satisfiable (jointly and each
+non-vacuous via inv ∧ ¬inv UNSAT), the round-1 transition relation is
+satisfiable alone and with the invariants, and the two map-update lemmas
+("check 0"/"check 1": updating the log at index li0 cannot change the
+committed bit at li0 − 1).  All three inductiveness VCs are `ignore`d
+upstream ("needs to look deeper", VsExample.scala:127-146) — this suite
+matches the proven set, exercising the FMap + pair-tuple theory stack
+(rewrite_maps, theory_ground_axioms) the other protocol suites don't.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+from round_tpu.verify.cl import ClConfig, entailment
+from round_tpu.verify.formula import (
+    And, Application, Bool, Card, Comprehension, Eq, ForAll, FMap, FSet,
+    FunT, Geq, Gt, Implies, In, Int, IntLit, Leq, Literal, Lt, Minus, Neq,
+    Not, Or, Plus, Product, UnInterpreted, UnInterpretedFct, Variable,
+    procType, FST, SND, TUPLE, LOOKUP, IS_DEFINED_AT, MSIZE, UPDATED,
+    DIVIDES,
+)
+from round_tpu.verify.venn import N_VAR as N
+
+pld = UnInterpreted("payload")
+entry_t = Product((pld, Bool))
+log_t = FMap(Int, entry_t)
+
+coord = Variable("coord", procType)
+li0 = Variable("li0", Int)
+li1 = Variable("li1", Int)
+act0 = Variable("Act0", FSet(procType))
+act1 = Variable("Act1", FSet(procType))
+log0_f = UnInterpretedFct("log0", FunT([procType], log_t))
+log1_f = UnInterpretedFct("log1", FunT([procType], log_t))
+mbox_f = UnInterpretedFct("vsmailbox", FunT([procType], FMap(procType, pld)))
+
+
+def log0(p):
+    return Application(log0_f, [p]).with_type(log_t)
+
+
+def log1(p):
+    return Application(log1_f, [p]).with_type(log_t)
+
+
+def mbox(p):
+    return Application(mbox_f, [p]).with_type(FMap(procType, pld))
+
+
+def defined(m, k):
+    return Application(IS_DEFINED_AT, [m, k]).with_type(Bool)
+
+
+def lookup(m, k, t):
+    return Application(LOOKUP, [m, k]).with_type(t)
+
+
+def size(m):
+    return Application(MSIZE, [m]).with_type(Int)
+
+
+def updated(m, k, v):
+    return Application(UPDATED, [m, k, v]).with_type(m.tpe)
+
+
+def fst(t, tpe):
+    return Application(FST, [t]).with_type(tpe)
+
+
+def snd(t):
+    return Application(SND, [t]).with_type(Bool)
+
+
+def pair(a, b):
+    return Application(TUPLE, [a, b]).with_type(entry_t)
+
+
+i = Variable("i", procType)
+j = Variable("j", procType)
+idx = Variable("idx", Int)
+
+INV0 = And(
+    ForAll([i, idx], Implies(defined(log0(i), idx),
+                             And(Leq(idx, li0), Geq(idx, IntLit(1))))),
+    ForAll([i], Leq(size(log0(i)), li0)),
+)
+INV1 = And(
+    defined(log0(coord), Minus(li0, IntLit(1))),
+    snd(lookup(log0(coord), Minus(li0, IntLit(1)), entry_t)),
+    ForAll([i], Implies(
+        In(i, act0),
+        Eq(fst(lookup(log0(i), Minus(li0, IntLit(1)), entry_t), pld),
+           fst(lookup(log0(coord), Minus(li0, IntLit(1)), entry_t), pld)),
+    )),
+)
+INV2 = Geq(
+    Card(Comprehension([i], And(
+        Eq(size(log0(i)), size(log0(coord))),
+        Not(snd(lookup(log0(i), li0, entry_t))),
+        In(i, act0),
+    ))),
+    Application(DIVIDES, [N, IntLit(2)]).with_type(Int),
+)
+
+
+def _round1():
+    """The r1 send ∧ update relation (VsExample.scala:66-95)."""
+    send_cond = And(In(i, act0), Eq(i, coord), defined(log0(i), li0))
+    send = And(
+        ForAll([i, j], Implies(send_cond, And(
+            defined(mbox(j), i),
+            Eq(lookup(mbox(j), i, pld),
+               fst(lookup(log0(i), li0, entry_t), pld)),
+        ))),
+        ForAll([i, j], Implies(Not(send_cond), Not(defined(mbox(j), i)))),
+    )
+    upd_a = And(In(i, act0), defined(mbox(i), coord))
+    upd_b = Not(snd(lookup(log0(i), Minus(li0, IntLit(1)), entry_t)))
+    new_entry = pair(lookup(mbox(i), coord, pld), Literal(False))
+    commit_prev = pair(
+        fst(lookup(log0(i), Minus(li0, IntLit(1)), entry_t), pld),
+        Literal(True),
+    )
+    update = And(
+        Eq(li1, li0),
+        ForAll([i], Implies(upd_a, And(
+            In(i, act1),
+            Implies(upd_b, Eq(
+                log1(i),
+                updated(updated(log0(i), li0, new_entry),
+                        Minus(li0, IntLit(1)), commit_prev),
+            )),
+            Implies(Not(upd_b), Eq(log1(i), updated(log0(i), li0, new_entry))),
+        ))),
+        ForAll([i], Implies(Not(upd_a), And(
+            Not(In(i, act1)), Eq(log1(i), log0(i)),
+        ))),
+    )
+    return And(send, update)
+
+
+CFG = ClConfig(venn_bound=1, inst_depth=1)
+
+
+def assert_sat(fs, cfg=CFG, timeout_s=120):
+    assert not entailment(And(*fs), Literal(False), cfg, timeout_s=timeout_s)
+
+
+def assert_unsat(fs, cfg=CFG, timeout_s=120):
+    assert entailment(And(*fs), Literal(False), cfg, timeout_s=timeout_s)
+
+
+def test_vs_sanity1_invariants_sat():
+    assert_sat([INV0, INV1, INV2])
+
+
+@pytest.mark.parametrize("inv", [INV0, INV1, INV2],
+                         ids=["inv0", "inv1", "inv2"])
+def test_vs_sanity_inv_nonvacuous(inv):
+    assert_unsat([inv, Not(inv)])
+
+
+def test_vs_sanity5_conjunction():
+    allinv = And(INV0, INV1, INV2)
+    assert_unsat([allinv, Not(allinv)])
+
+
+def test_vs_sanity6_round_sat():
+    assert_sat([_round1()])
+
+
+def test_vs_sanity7_round_with_invariants_sat():
+    assert_sat([_round1(), INV0, INV1, INV2])
+
+
+def test_vs_check0_update_preserves_committed_pairs():
+    """VsExample "check 0": with li0 = li1, updating index li0 cannot flip
+    the committed bit at li0 − 1 (pair-payload version)."""
+    ilog_t = FMap(Int, Product((Int, Bool)))
+    l0 = Application(UnInterpretedFct("vlog0", FunT([procType], ilog_t)),
+                     [coord]).with_type(ilog_t)
+    l1 = Application(UnInterpretedFct("vlog1", FunT([procType], ilog_t)),
+                     [coord]).with_type(ilog_t)
+    ituple = Product((Int, Bool))
+    f = And(
+        defined(l0, Minus(li0, IntLit(1))),
+        snd(lookup(l0, Minus(li0, IntLit(1)), ituple)),
+        defined(l1, Minus(li1, IntLit(1))),
+        Not(snd(lookup(l1, Minus(li1, IntLit(1)), ituple))),
+        Eq(li0, li1),
+        Eq(l1, updated(l0, li0,
+                       Application(TUPLE, [IntLit(1), Literal(False)])
+                       .with_type(ituple))),
+    )
+    assert_unsat([f])
+
+
+def test_vs_check1_update_preserves_committed_bools():
+    """VsExample "check 1": same lemma with a bare Bool log value."""
+    blog_t = FMap(Int, Bool)
+    l0 = Application(UnInterpretedFct("blog0", FunT([procType], blog_t)),
+                     [coord]).with_type(blog_t)
+    l1 = Application(UnInterpretedFct("blog1", FunT([procType], blog_t)),
+                     [coord]).with_type(blog_t)
+    f = And(
+        defined(l0, Minus(li0, IntLit(1))),
+        lookup(l0, Minus(li0, IntLit(1)), Bool),
+        defined(l1, Minus(li1, IntLit(1))),
+        Not(lookup(l1, Minus(li1, IntLit(1)), Bool)),
+        Eq(li0, li1),
+        Eq(l1, updated(l0, li0, Literal(False))),
+    )
+    assert_unsat([f])
+
+
+def test_map_update_frame_with_literal_key():
+    """Frame axioms must range over LITERAL keys too (review regression:
+    collect_ground_terms never yields literals, so they are mined
+    separately): k != 3 ⊢ LookUp(Updated(m, k, 9), 3) = LookUp(m, 3)."""
+    m_t = FMap(Int, Int)
+    mf = UnInterpretedFct("mlit", FunT([procType], m_t))
+    k = Variable("k", Int)
+    m = Application(mf, [coord]).with_type(m_t)
+    u = updated(m, k, IntLit(9))
+    f = And(
+        Neq(k, IntLit(3)),
+        Eq(lookup(m, IntLit(3), Int), IntLit(5)),
+        Neq(lookup(u, IntLit(3), Int), IntLit(5)),
+    )
+    assert_unsat([f])

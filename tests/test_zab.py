@@ -1,0 +1,127 @@
+"""Zab discovery-phase lemmas (reference: logic/ZabDiscNoMailbox.scala, the
+VMCAI-paper port) through the native reducer.
+
+The reference marks EVERY test in that suite `ignore` — nothing is proved
+upstream.  This suite goes further and actually discharges the tractable
+lemmas:
+
+  * "cardinality two comprehensions intersect" (:334-347): two disjoint
+    epoch-classes cannot both hold a majority;
+  * invariantV1b ⇒ agreement (:313-318 with the decided-pinning invariant
+    variant V1b, :187-203 — the V1 variant does not constrain `decided`
+    and the implication is genuinely not valid, see the negative control);
+  * satisfiability sanity for the invariant and the initial state.
+
+The round-1 inductiveness VC stays undischarged here as upstream: the
+reference's own "invariant 1 is inductive at round 1" (:321) calls
+assertSat + getModel — the invariant as stated is NOT inductive (nothing
+forces the new coordinator's ready1 to line up with an unprimed coord
+majority), and our reducer concurs (no UNSAT at depth 1-2; ~2.5 min to
+check — too slow and too inconclusive for CI)."""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from round_tpu.verify.cl import ClConfig, entailment
+from round_tpu.verify.formula import (
+    And, Application, Bool, Card, Comprehension, Eq, Exists, ForAll, FunT,
+    Implies, In, Int, IntLit, Literal, Lt, Times, UnInterpretedFct,
+    Variable, procType,
+)
+from round_tpu.verify.venn import N_VAR as N
+
+i = Variable("i", procType)
+j = Variable("j", procType)
+leader = Variable("leader", procType)
+
+epoch = UnInterpretedFct("epoch", FunT([procType], Int))
+coord = UnInterpretedFct("coord", FunT([procType], procType))
+ready = UnInterpretedFct("ready", FunT([procType], Bool))
+commit = UnInterpretedFct("commit", FunT([procType], Bool))
+decided = UnInterpretedFct("decided", FunT([procType], Bool))
+
+
+def ep(p):
+    return Application(epoch, [p]).with_type(Int)
+
+
+def co(p):
+    return Application(coord, [p]).with_type(procType)
+
+
+def rd(p):
+    return Application(ready, [p]).with_type(Bool)
+
+
+def cm(p):
+    return Application(commit, [p]).with_type(Bool)
+
+
+def dc(p):
+    return Application(decided, [p]).with_type(Bool)
+
+
+def maj(card):
+    return Lt(N, Times(2, card))
+
+
+S = Comprehension([j], Eq(co(j), leader))
+
+# invariantV1b (ZabDiscNoMailbox.scala:187-203): a majority coord-class
+# around `leader`, with ready/commit/decided processes pinned to the
+# leader's epoch and coordinator
+INV_V1B = Exists([leader], And(
+    maj(Card(S)),
+    ForAll([i], And(
+        Implies(And(In(i, S), rd(i)),
+                And(Lt(ep(i), ep(leader)), Eq(co(i), leader))),
+        Implies(And(In(i, S), cm(i)),
+                And(Eq(ep(i), ep(leader)), Eq(co(i), leader))),
+        Implies(dc(i), And(Eq(ep(i), ep(leader)), Eq(co(i), leader))),
+    )),
+))
+
+AGREEMENT = ForAll([i, j], Implies(
+    And(dc(i), dc(j)), And(Eq(ep(i), ep(j)), Eq(co(i), co(j)))
+))
+
+CFG = ClConfig(venn_bound=2, inst_depth=1)
+
+
+def test_zab_two_majorities_intersect():
+    """Upstream `ignore`d (:334-347); here: proved.  {epoch=1} and
+    {epoch=0} are disjoint, so two majorities are contradictory."""
+    a = Comprehension([i], Eq(ep(i), IntLit(1)))
+    b = Comprehension([i], Eq(ep(i), IntLit(0)))
+    f = And(maj(Card(a)), maj(Card(b)))
+    assert entailment(f, Literal(False), CFG, timeout_s=60)
+
+
+def test_zab_invariant_implies_agreement():
+    """Upstream `ignore`d (:313-318); here: proved from the V1b variant."""
+    assert entailment(INV_V1B, AGREEMENT, CFG, timeout_s=120)
+
+
+def test_zab_invariant_sat():
+    assert not entailment(INV_V1B, Literal(False), CFG, timeout_s=60)
+
+
+def test_zab_initial_state_sat():
+    """initialState (:85-92) is satisfiable (flags down, epoch frozen)."""
+    epoch0 = UnInterpretedFct("epoch0", FunT([procType], Int))
+    init = ForAll([i], And(
+        Eq(dc(i), Literal(False)),
+        Eq(rd(i), Literal(False)),
+        Eq(cm(i), Literal(False)),
+        Eq(Application(epoch0, [i]).with_type(Int), ep(i)),
+    ))
+    assert not entailment(init, Literal(False), CFG, timeout_s=60)
+
+
+def test_zab_agreement_needs_decided_pinning():
+    """Negative control: the reference's invariantV1 (no decided clause,
+    :212-224) does NOT imply agreement — guards the V1b proof against a
+    vacuous pass."""
+    weak = Exists([leader], And(maj(Card(S)), rd(leader)))
+    assert not entailment(weak, AGREEMENT, CFG, timeout_s=60)
