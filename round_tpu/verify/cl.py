@@ -59,6 +59,10 @@ class ClConfig:
     # trigger-guided e-matching (logic/Matching.scala) — far fewer
     # instances on clause-heavy problems, same soundness
     strategy: str = "eager"
+    # optional verify.tactics.Tactic guiding round-1 instantiation with a
+    # depth-bounded term priority queue (Tactic.scala); overrides
+    # `strategy` when set.  Stateful but re-initialized per reduce().
+    tactic: object = None
     # optional verify.qilog.QILogger recording the instantiation graph
     # (the reference's --logQI, VerificationOptions.scala:23)
     qi_logger: object = None
@@ -332,7 +336,6 @@ def theory_ground_axioms(conjuncts: Sequence[Formula]) -> List[Formula]:
     from round_tpu.verify.futils import collect_ground_terms
 
     out: List[Formula] = []
-    seen: set = set()
     updates: List[Application] = []
     key_terms: Dict[Type, List[Formula]] = {}
     all_ground: set = set()
@@ -342,9 +345,8 @@ def theory_ground_axioms(conjuncts: Sequence[Formula]) -> List[Formula]:
                 continue
             all_ground.add(g)
             key_terms.setdefault(g.tpe, []).append(g)
-            if not isinstance(g, Application) or g in seen:
+            if not isinstance(g, Application):
                 continue
-            seen.add(g)
             if g.fct == FSOME:
                 out.append(Application(IS_DEFINED, [g]).with_type(Bool))
                 out.append(Eq(Application(GET, [g]).with_type(g.args[0].tpe),
@@ -510,7 +512,13 @@ class ClReducer:
                 universals.extend(du)
 
         # round 1: quantifier instantiation over the ground terms
-        if cfg.strategy == "ematch":
+        if cfg.tactic is not None:
+            from round_tpu.verify.tactics import instantiate_tactic
+            insts = instantiate_tactic(
+                universals, ground, cfg.tactic,
+                max_insts=cfg.max_insts, logger=cfg.qi_logger,
+            )
+        elif cfg.strategy == "ematch":
             from round_tpu.verify.matching import instantiate_matching
             insts = instantiate_matching(
                 universals, ground, depth=cfg.inst_depth,
@@ -549,19 +557,31 @@ class ClReducer:
         # trigger can fire on them — e-matching here would drop exactly the
         # witness instances the venn chain needs (the cost is bounded: the
         # witness universe is the region count, not the full term universe)
-        wit_ground = base + [
-            Application(EQ, [w, w]).with_type(Bool) for w in all_witnesses
-        ]
-        insts2 = quantifiers.instantiate(
-            universals, wit_ground, depth=cfg.inst_depth,
-            max_insts=cfg.max_insts, logger=cfg.qi_logger,
-            logger_base_round=100,  # witness-round instances group apart
-        )
-        insts2 = [rewrite_set_algebra(i) for i in insts2]
-        # round 2 regenerates the round-1 instances (fresh dedup state);
-        # keep only the genuinely new ones
-        base_set = set(base)
-        insts2 = [i for i in insts2 if i not in base_set]
+        # Round 2 runs eagerly over `base` (ground + round-1 instances +
+        # theory axioms) — for the eager strategy this IS the second depth
+        # level (instances over terms first created in round 1), so it must
+        # run even without witnesses.  For tactic/ematch configs an eager
+        # re-run would bypass the configured strategy entirely (the
+        # depth-0 control test pins this), so without witnesses it is
+        # skipped there.
+        guided = cfg.tactic is not None or cfg.strategy == "ematch"
+        if all_witnesses or not guided:
+            wit_ground = base + [
+                Application(EQ, [w, w]).with_type(Bool)
+                for w in all_witnesses
+            ]
+            insts2 = quantifiers.instantiate(
+                universals, wit_ground, depth=cfg.inst_depth,
+                max_insts=cfg.max_insts, logger=cfg.qi_logger,
+                logger_base_round=100,  # witness-round instances group apart
+            )
+            insts2 = [rewrite_set_algebra(i) for i in insts2]
+            # round 2 regenerates the round-1 instances (fresh dedup
+            # state); keep only the genuinely new ones
+            base_set = set(base)
+            insts2 = [i for i in insts2 if i not in base_set]
+        else:
+            insts2 = []
 
         # close the membership→cardinality direction for the witnesses: a
         # witness proved (through set definitions) to be in a carded set must

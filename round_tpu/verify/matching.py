@@ -120,6 +120,25 @@ def select_trigger_set(clause: Binding) -> Tuple[List[Application], List[Variabl
     return chosen, [v for v in clause.vars if v not in covered]
 
 
+def trigger_alternatives(
+    clause: Binding,
+) -> List[Tuple[List[Application], List[Variable]]]:
+    """The clause's usable trigger SETS, each an independent alternative
+    (multi-pattern semantics: a clause fires when ANY of its pattern sets
+    matches).  Every single trigger covering all bound variables is its own
+    alternative — ∀i. sndts(i) = ts(i) must fire from a ground ts(kw) even
+    when no ground sndts exists — with the greedy covering set as the
+    fallback when no single trigger covers everything."""
+    bound = set(clause.vars)
+    singles = [
+        p for p in collect_triggers(clause)
+        if matchable_vars(p, bound) >= bound
+    ]
+    if singles:
+        return [([p], []) for p in singles]
+    return [select_trigger_set(clause)]
+
+
 # ---------------------------------------------------------------------------
 # Matching modulo congruence
 # ---------------------------------------------------------------------------
@@ -257,7 +276,11 @@ def instantiate_matching(
                 u, round=logger_base_round, is_root=True
             )
 
-    plans = [(u, *select_trigger_set(u)) for u in universals]
+    plans = [
+        (u, patterns, uncovered)
+        for u in universals
+        for patterns, uncovered in trigger_alternatives(u)
+    ]
     pool: List[Formula] = list(ground) + list(universals)
 
     for _round in range(depth):

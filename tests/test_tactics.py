@@ -1,0 +1,154 @@
+"""Tactic-guided instantiation (verify/tactics.py; reference
+logic/quantifiers/Tactic.scala + IncrementalGenerator.scala).
+
+Covers: Eager depth bounds (global and per-type), ByName bounds, Sequence
+chaining, the pinned-term completeness of the incremental driver (every
+combo over released terms appears exactly once), and CL entailments under
+ClConfig(tactic=...) including a depth-0 incompleteness control."""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from round_tpu.verify.cl import ClConfig, entailment
+from round_tpu.verify.formula import (
+    And, Application, Card, Comprehension, Eq, Exists, ForAll, FunT, Geq,
+    Gt, Implies, In, Int, IntLit, Times, UnInterpretedFct, Variable,
+    procType,
+)
+from round_tpu.verify.quantifiers import instantiate
+from round_tpu.verify.tactics import ByName, Eager, Sequence, instantiate_tactic
+from round_tpu.verify.tr import ho_of
+from round_tpu.verify.venn import N_VAR as N
+
+x_fn = UnInterpretedFct("x", FunT([procType], Int))
+g_fn = UnInterpretedFct("g", FunT([procType], procType))
+
+
+def x(p):
+    return Application(x_fn, [p]).with_type(Int)
+
+
+def g(p):
+    return Application(g_fn, [p]).with_type(procType)
+
+
+def test_eager_tactic_matches_eager_strategy_at_depth1():
+    """With a uniform depth bound the tactic driver reproduces the eager
+    product over the seed terms (same instances modulo order)."""
+    i = Variable("i", procType)
+    j = Variable("j", procType)
+    clause = ForAll([i, j], Implies(Eq(x(i), x(j)), Eq(i, j)))
+    ps = [Variable(f"p{k}", procType) for k in range(3)]
+    ground = [Eq(x(p), IntLit(0)) for p in ps]
+    eager = instantiate([clause], ground, depth=1)
+    tactical = instantiate_tactic([clause], ground, Eager(1))
+    assert set(map(repr, eager)) == set(map(repr, tactical))
+
+
+def test_eager_depth_bounds_generation():
+    """Depth 1 stops g-chains after one generation; depth 3 grows them.
+    (g(p) enters at depth 1 via the instantiation result, g(g(p)) at 2...)"""
+    i = Variable("i", procType)
+    clause = ForAll([i], Geq(x(g(i)), x(i)))
+    p = Variable("p", procType)
+    ground = [Eq(x(p), IntLit(0))]
+    shallow = instantiate_tactic([clause], ground, Eager(1))
+    deep = instantiate_tactic([clause], ground, Eager(3))
+    assert len(shallow) < len(deep)
+    assert any("g(g(" in repr(f) for f in deep)
+    assert not any("g(g(g(" in repr(f) for f in shallow)
+
+
+def test_per_type_depth():
+    """Eager({Int: 0}, default=1): Int terms are never released, so no
+    instance of an Int-quantified clause appears."""
+    v = Variable("v", Int)
+    i = Variable("i", procType)
+    c_int = ForAll([v], Geq(Times(v, v), IntLit(0)))
+    c_proc = ForAll([i], Geq(x(i), IntLit(0)))
+    p = Variable("p", procType)
+    ground = [Eq(x(p), IntLit(5))]
+    insts = instantiate_tactic([c_int, c_proc], ground,
+                               Eager({Int: 0}, default=1))
+    assert any("x(p)" in repr(f) for f in insts)
+    assert not any("Times" in repr(f) for f in insts)
+
+
+def test_byname_tactic():
+    """ByName releases only terms whose head-symbol name is budgeted."""
+    i = Variable("i", procType)
+    clause = ForAll([i], Geq(x(i), IntLit(0)))
+    p = Variable("p", procType)
+    q = Variable("q", procType)
+    ground = [Eq(x(p), IntLit(1)), Eq(x(q), IntLit(2))]
+    only_p = instantiate_tactic([clause], ground, ByName({"p": 1}))
+    assert len(only_p) == 1 and "x(p)" in repr(only_p[0])
+
+
+def test_sequence_tactic():
+    """Sequence(ByName p-only, Eager(1)) first releases p, then everything
+    else over the grown universe."""
+    i = Variable("i", procType)
+    clause = ForAll([i], Geq(x(i), IntLit(0)))
+    p = Variable("p", procType)
+    q = Variable("q", procType)
+    ground = [Eq(x(p), IntLit(1)), Eq(x(q), IntLit(2))]
+    seq = Sequence(ByName({"p": 1}), Eager(1))
+    insts = instantiate_tactic([clause], ground, seq)
+    reprs = set(map(repr, insts))
+    assert any("x(p)" in r for r in reprs)
+    assert any("x(q)" in r for r in reprs)
+
+
+def test_cl_entailment_with_tactic():
+    """The majority-witness entailment proves under a tactic-guided config
+    (the CLSuite shape with QStrategy(tactic), TestCommon.scala:26-40)."""
+    i = Variable("i", procType)
+    j = Variable("j", procType)
+    v = Variable("v", Int)
+    k = Variable("k", procType)
+    hyp = And(
+        Gt(Times(2, Card(Comprehension([k], In(k, ho_of(j))))), N),
+        ForAll([i], Eq(x(i), v)),
+    )
+    concl = Exists([k], And(In(k, ho_of(j)), Eq(x(k), v)))
+    cfg = ClConfig(venn_bound=2, tactic=Eager(1))
+    assert entailment(hyp, concl, cfg, timeout_s=60)
+
+
+def test_cl_tactic_depth0_is_incomplete_control():
+    """Releasing no terms (depth 0) must make a witness-free entailment
+    fail while depth 1 proves it — the tactic is genuinely in the loop.
+    (Cardinality-style goals also get the always-eager venn-witness round,
+    cl.py round 2, so the control is venn-free.)"""
+    i = Variable("i", procType)
+    p = Variable("p", procType)
+    hyp = ForAll([i], Geq(x(i), IntLit(0)))
+    concl = Geq(x(p), IntLit(0))
+    assert entailment(hyp, concl,
+                      ClConfig(venn_bound=0, tactic=Eager(1)), timeout_s=60)
+    assert not entailment(hyp, concl,
+                          ClConfig(venn_bound=0, tactic=Eager(0)),
+                          timeout_s=60)
+
+
+def test_eager_round2_depth_still_runs_without_witnesses():
+    """Review regression: the witness round doubles as the eager strategy's
+    second depth level (instances over round-1-created terms) and must run
+    even when no venn witnesses exist — only guided configs skip it."""
+    g_fn2 = UnInterpretedFct("g2", FunT([procType], procType))
+
+    def g2(p):
+        return Application(g_fn2, [p]).with_type(procType)
+
+    i = Variable("i", procType)
+    p = Variable("p", procType)
+    h = And(
+        ForAll([i], Eq(x(g2(i)), IntLit(3))),
+        ForAll([i], Implies(Eq(x(i), IntLit(3)), Eq(x(i), IntLit(7)))),
+        Eq(x(p), IntLit(0)),
+    )
+    from round_tpu.verify.formula import Literal
+    assert entailment(h, Literal(False),
+                      ClConfig(venn_bound=1, inst_depth=1), timeout_s=30)
