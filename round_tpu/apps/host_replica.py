@@ -40,7 +40,11 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout-ms", type=int, default=300)
     ap.add_argument("--max-rounds", type=int, default=48)
     ap.add_argument("--seed", type=int, default=0)
+    from round_tpu.runtime.log import add_verbosity_flags, configure_from_args
+
+    add_verbosity_flags(ap)
     args = ap.parse_args(argv)
+    configure_from_args(args)
 
     import numpy as np
 

@@ -39,8 +39,11 @@ import numpy as np
 from round_tpu.core.algorithm import Algorithm
 from round_tpu.core.rounds import RoundCtx
 from round_tpu.ops.mailbox import Mailbox
+from round_tpu.runtime.log import get_logger
 from round_tpu.runtime.oob import FLAG_NORMAL, Message, Tag
 from round_tpu.runtime.transport import HostTransport
+
+log = get_logger("host")
 
 
 @dataclasses.dataclass
@@ -145,6 +148,8 @@ class HostRunner:
             mbox = self._mailbox(inbox, payload_np)
             state = rnd.update(ctx, state, mbox)
             exited = bool(np.asarray(ctx._exit))
+            log.debug("node %d round %d: heard %d/%d%s", self.id, r,
+                      len(inbox), self.n, " exit" if exited else "")
             r += 1
 
         decided = bool(np.asarray(algo.decided(state)))

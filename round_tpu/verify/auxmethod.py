@@ -1,0 +1,74 @@
+"""AuxiliaryMethod: require/ensuring lifting for round-code helpers.
+
+Reference parity: psync lifts a helper's `require`/`ensuring` clauses into
+an AuxiliaryMethod pre/post spec at macro time (TrExtractor.scala:78-99,
+AuxiliaryMethod.scala:9-67); call sites inline the post as an assumption
+(TransitionRelation.scala:93-111) and the pre becomes a proof obligation.
+
+The TPU build gets the same boundary from jit: decorating a helper with
+``@aux_method(pre=..., post=...)`` wraps it in ``jax.jit``, so inside the
+traced round code it appears as a NAMED pjit equation — the jaxpr
+extractor (extract.py) intercepts the name instead of recursing, models
+the call as an uninterpreted application over the argument formulas,
+assumes ``post(result, *args)`` as a site axiom, and records
+``pre(*args)`` as a proof obligation for the verifier.  Outside
+extraction the decorator is transparent: the engine executes the jitted
+helper as usual.
+
+    @aux_method(post=lambda r, a, b: And(Geq(r, a), Geq(r, b)))
+    def imax(a, b):
+        return jnp.maximum(a, b)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class AuxSpec:
+    """Pre/post spec of a helper (AuxiliaryMethod.scala:9-67).
+
+    pre:  (*arg_formulas) -> Formula — obligation at every call site.
+    post: (result_formula, *arg_formulas) -> Formula — assumed axiom.
+    """
+
+    name: str
+    pre: Optional[Callable] = None
+    post: Optional[Callable] = None
+
+
+REGISTRY: Dict[str, AuxSpec] = {}
+
+
+def aux_method(pre: Optional[Callable] = None,
+               post: Optional[Callable] = None,
+               name: Optional[str] = None):
+    """Register a helper's pre/post spec and give it a jit boundary the
+    extractor can see.  The reference's @requires/@ensures annotations
+    (verification/Annotations.scala:12-32) by decorator."""
+
+    def deco(fn):
+        nm = name or fn.__name__
+        if nm in REGISTRY:
+            raise ValueError(
+                f"aux method name {nm!r} already registered; pass an "
+                "explicit name= to disambiguate"
+            )
+        REGISTRY[nm] = AuxSpec(name=nm, pre=pre, post=post)
+
+        # the pjit equation is named after the traced function's __name__ —
+        # that name is the extractor's interception key, so it must match
+        # the registry entry even when name= overrides it
+        def _renamed(*args, **kwargs):
+            return fn(*args, **kwargs)
+
+        _renamed.__name__ = nm
+        wrapped = jax.jit(_renamed)
+        wrapped.aux_name = nm
+        return wrapped
+
+    return deco

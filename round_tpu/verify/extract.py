@@ -214,6 +214,10 @@ class _Interpreter:
         self.receiver = receiver if receiver is not None else \
             Variable("extj", procType)
         self.axioms: List[Formula] = []
+        # pre-condition obligations of @aux_method call sites: the verifier
+        # must discharge these (invariants ⊢ pre), mirroring the
+        # reference's AuxiliaryMethod VC class
+        self.obligations: List[Formula] = []
         self._fresh = itertools.count()
 
     def var(self) -> Variable:
@@ -247,6 +251,34 @@ class _Interpreter:
         return [read(v) for v in jaxpr.outvars]
 
     # -- site functions (axiomatized reduction results) --------------------
+
+    def _aux_call(self, spec, eqn, ins):
+        """An @aux_method helper call: model it as an uninterpreted
+        application over the argument formulas, assume its post, record its
+        pre as a proof obligation (AuxiliaryMethod.scala:9-67;
+        TransitionRelation.scala:93-111 inlines posts the same way)."""
+        args = []
+        for a in ins:
+            a = _lift(a) if not isinstance(a, (Scalar, Vec, Vec2)) else a
+            if not isinstance(a, Scalar):
+                raise ExtractionError(
+                    f"aux method '{spec.name}' with a non-scalar argument — "
+                    "only per-lane scalar helpers are liftable"
+                )
+            args.append(a.f)
+        if len(eqn.outvars) != 1 or getattr(eqn.outvars[0].aval, "shape", ()):
+            raise ExtractionError(
+                f"aux method '{spec.name}' must return one scalar"
+            )
+        out_t = Bool if eqn.outvars[0].aval.dtype == jnp.bool_ else Int
+        arg_ts = [getattr(a, "tpe", None) or Int for a in args]
+        fct = UnInterpretedFct(f"aux!{spec.name}", FunT(arg_ts, out_t))
+        result = Application(fct, list(args)).with_type(out_t)
+        if spec.post is not None:
+            self.axioms.append(spec.post(result, *args))
+        if spec.pre is not None:
+            self.obligations.append(spec.pre(*args))
+        return Scalar(result)
 
     def _site(self, tag: str, tpe: Type) -> Formula:
         """A fresh uninterpreted per-receiver function for a reduction site:
@@ -374,6 +406,9 @@ class _Interpreter:
         if prim == "iota":
             return Vec(lambda i: i)
         if prim in ("pjit", "jit", "closed_call", "custom_jvp_call"):
+            from round_tpu.verify.auxmethod import REGISTRY as _AUX
+            if eqn.params.get("name") in _AUX:
+                return self._aux_call(_AUX[eqn.params["name"]], eqn, ins)
             if eqn.params.get("name") == "floor_divide":
                 # jnp's int // expands into div + sign-correction ops;
                 # DIVIDES with the k·q ≤ num ≤ k·q + k - 1 axioms
@@ -595,6 +630,7 @@ def extract_lane_fn(
     senders_domain: Callable[[Formula], Formula],
     receiver: Optional[Formula] = None,
     return_axioms: bool = False,
+    return_obligations: bool = False,
 ):
     """Trace `fn` (a pure per-lane function) with `example_args` (arrays /
     ShapeDtypeStructs fixing shapes) and abstractly interpret its jaxpr over
@@ -609,9 +645,21 @@ def extract_lane_fn(
     interp = _Interpreter(senders_domain, receiver=receiver)
     flat_args, _ = jax.tree_util.tree_flatten(list(formula_args))
     outs = interp.run(closed.jaxpr, closed.consts, flat_args)
+    if interp.obligations and not return_obligations:
+        # a dropped pre-condition would let the verifier assume the post of
+        # a helper called outside its contract — refuse to extract unless
+        # the caller collects (and discharges) the obligations
+        raise ExtractionError(
+            "aux-method pre-conditions were recorded "
+            f"({len(interp.obligations)}); pass return_obligations=True "
+            "and discharge them as VCs"
+        )
+    extras = []
     if return_axioms:
-        return outs, interp.axioms
-    return outs
+        extras.append(interp.axioms)
+    if return_obligations:
+        extras.append(interp.obligations)
+    return (outs, *extras) if extras else outs
 
 
 def extract_update_equations(

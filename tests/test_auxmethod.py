@@ -1,0 +1,111 @@
+"""@aux_method require/ensuring lifting (verify/auxmethod.py; reference
+TrExtractor.scala:78-99 + AuxiliaryMethod.scala:9-67).
+
+A decorated helper executes normally under the engine (jit-wrapped) but
+extracts as an uninterpreted application with its post assumed and its pre
+recorded as a proof obligation — the reference's AuxiliaryMethod mechanism
+through the jaxpr boundary instead of Scala trees."""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.verify.auxmethod import aux_method
+from round_tpu.verify.cl import ClConfig, entailment
+from round_tpu.verify.extract import Scalar, extract_lane_fn
+from round_tpu.verify.formula import (
+    And, Eq, Geq, Gt, IntLit, IntT, Literal, Or, Plus, Variable, procType,
+)
+
+Int = IntT()
+
+
+@aux_method(
+    pre=lambda a, b: And(Geq(a, IntLit(0)), Geq(b, IntLit(0))),
+    post=lambda r, a, b: And(Geq(r, a), Geq(r, b), Or(Eq(r, a), Eq(r, b))),
+    name="imax_t",
+)
+def imax(a, b):
+    return jnp.maximum(a, b)
+
+
+def _extract():
+    def upd(x, y):
+        return imax(x, y) + 1
+
+    xv = Variable("xv", Int)
+    yv = Variable("yv", Int)
+    outs, axioms, obligations = extract_lane_fn(
+        upd, [jnp.int32(0), jnp.int32(0)], [Scalar(xv), Scalar(yv)],
+        lambda i: Literal(True), return_axioms=True,
+        return_obligations=True,
+    )
+    return xv, yv, outs, axioms, obligations
+
+
+def test_aux_executes_normally():
+    assert int(np.asarray(imax(jnp.int32(3), jnp.int32(7)))) == 7
+
+
+def test_aux_extraction_shape():
+    xv, yv, outs, axioms, obligations = _extract()
+    out = outs[0].f
+    # x' = aux!imax_t(xv, yv) + 1
+    assert "aux!imax_t" in repr(out)
+    assert len(axioms) == 1 and len(obligations) == 1
+    assert "Geq" in repr(axioms[0])
+    assert repr(obligations[0]) == repr(
+        And(Geq(xv, IntLit(0)), Geq(yv, IntLit(0)))
+    )
+
+
+def test_aux_post_supports_proof():
+    """The assumed post makes  x' > x ∧ x' > y  provable from the
+    extracted equation (the call-site inlining of posts,
+    TransitionRelation.scala:93-111)."""
+    xv, yv, outs, axioms, _obl = _extract()
+    xp = Variable("xp", Int)
+    hyp = And(Eq(xp, outs[0].f), *axioms)
+    cfg = ClConfig(venn_bound=0, inst_depth=1)
+    assert entailment(hyp, And(Gt(xp, xv), Gt(xp, yv)), cfg, timeout_s=30)
+
+
+def test_aux_without_post_is_opaque():
+    """Negative control: without the post axioms the same claim must
+    fail — the helper really is uninterpreted."""
+    xv, yv, outs, _axioms, _obl = _extract()
+    xp = Variable("xp", Int)
+    hyp = Eq(xp, outs[0].f)
+    cfg = ClConfig(venn_bound=0, inst_depth=1)
+    assert not entailment(hyp, Gt(xp, xv), cfg, timeout_s=30)
+
+
+def test_aux_duplicate_name_rejected():
+    import pytest
+
+    with pytest.raises(ValueError):
+        @aux_method(name="imax_t")
+        def other(a):
+            return a
+
+
+def test_aux_obligations_cannot_be_dropped():
+    """extract_lane_fn refuses to discard recorded pre-conditions: a caller
+    not collecting obligations gets an ExtractionError, not silent
+    unsoundness (review regression)."""
+    import pytest
+
+    from round_tpu.verify.extract import ExtractionError
+
+    def upd(x, y):
+        return imax(x, y)
+
+    with pytest.raises(ExtractionError, match="pre-conditions"):
+        extract_lane_fn(
+            upd, [jnp.int32(0), jnp.int32(0)],
+            [Scalar(Variable("a", Int)), Scalar(Variable("b", Int))],
+            lambda i: Literal(True), return_axioms=True,
+        )

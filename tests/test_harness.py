@@ -226,3 +226,22 @@ def test_verifier_cli(tmp_path, capsys):
     assert os.path.exists(report)
     out = capsys.readouterr().out
     assert "VERIFIED" in out
+
+
+def test_log_levels_and_hide(capsys):
+    """Leveled logging (runtime/log.py): -v raises to info, hide()
+    silences one component, -q drops to errors (Options.scala:8-27)."""
+    import logging
+
+    from round_tpu.runtime import log as rlog
+
+    root = rlog.configure(1)  # one -v: info
+    assert root.level == logging.INFO
+    rlog.get_logger("engine").info("visible")
+    rlog.hide("noisy")
+    rlog.get_logger("noisy").error("suppressed")
+    err = capsys.readouterr().err
+    assert "visible" in err and "suppressed" not in err
+    assert rlog.configure(-1).level == logging.ERROR
+    assert rlog.configure(0).level == logging.WARNING
+    rlog.get_logger("noisy").setLevel(logging.NOTSET)  # undo hide()
