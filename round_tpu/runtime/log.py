@@ -61,9 +61,19 @@ def configure(verbosity: int = 0, stream=None) -> logging.Logger:
     return root
 
 
+_hidden: set = set()
+
+
 def hide(component: str) -> None:
-    """Suppress one component's output (--hide, Options.scala:11-13)."""
+    """Suppress one component's output (--hide, Options.scala:11-13).
+    Undone by unhide() or any configure_from_args() without the name."""
+    _hidden.add(component)
     get_logger(component).setLevel(logging.CRITICAL + 1)
+
+
+def unhide(component: str) -> None:
+    _hidden.discard(component)
+    get_logger(component).setLevel(logging.NOTSET)
 
 
 def add_verbosity_flags(ap) -> None:
@@ -77,6 +87,8 @@ def add_verbosity_flags(ap) -> None:
 
 def configure_from_args(args) -> logging.Logger:
     root = configure(args.verbose - args.quiet)
+    for c in list(_hidden):  # reconfiguration clears prior hides
+        unhide(c)
     for c in args.hide:
         hide(c)
     return root

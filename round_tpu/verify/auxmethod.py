@@ -28,6 +28,11 @@ from typing import Callable, Dict, Optional
 import jax
 
 
+# the traced-name prefix marking aux boundaries: user jit functions cannot
+# collide unless they deliberately name themselves "rtaux!…" (reserved)
+AUX_PREFIX = "rtaux!"
+
+
 @dataclasses.dataclass(frozen=True)
 class AuxSpec:
     """Pre/post spec of a helper (AuxiliaryMethod.scala:9-67).
@@ -39,6 +44,7 @@ class AuxSpec:
     name: str
     pre: Optional[Callable] = None
     post: Optional[Callable] = None
+    fn_qualname: str = ""
 
 
 REGISTRY: Dict[str, AuxSpec] = {}
@@ -53,20 +59,27 @@ def aux_method(pre: Optional[Callable] = None,
 
     def deco(fn):
         nm = name or fn.__name__
-        if nm in REGISTRY:
+        qual = f"{fn.__module__}.{fn.__qualname__}"
+        prev = REGISTRY.get(nm)
+        if prev is not None and prev.fn_qualname != qual:
+            # same-name re-registration of the SAME function (module
+            # reloads, dual import paths) is tolerated; a different
+            # function must pick its own name
             raise ValueError(
-                f"aux method name {nm!r} already registered; pass an "
-                "explicit name= to disambiguate"
+                f"aux method name {nm!r} already registered by "
+                f"{prev.fn_qualname}; pass an explicit name= to "
+                "disambiguate"
             )
-        REGISTRY[nm] = AuxSpec(name=nm, pre=pre, post=post)
+        REGISTRY[nm] = AuxSpec(name=nm, pre=pre, post=post,
+                               fn_qualname=qual)
 
-        # the pjit equation is named after the traced function's __name__ —
-        # that name is the extractor's interception key, so it must match
-        # the registry entry even when name= overrides it
+        # the pjit equation is named after the traced function's __name__;
+        # the reserved prefix is the extractor's interception key, so a
+        # user's plain jax.jit helper can never be mistaken for an aux
         def _renamed(*args, **kwargs):
             return fn(*args, **kwargs)
 
-        _renamed.__name__ = nm
+        _renamed.__name__ = AUX_PREFIX + nm
         wrapped = jax.jit(_renamed)
         wrapped.aux_name = nm
         return wrapped

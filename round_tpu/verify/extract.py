@@ -270,7 +270,15 @@ class _Interpreter:
             raise ExtractionError(
                 f"aux method '{spec.name}' must return one scalar"
             )
-        out_t = Bool if eqn.outvars[0].aval.dtype == jnp.bool_ else Int
+        dt = eqn.outvars[0].aval.dtype
+        if not (dt == jnp.bool_ or jnp.issubdtype(dt, jnp.integer)):
+            # an Int-typed site over a float value would hand integer
+            # arithmetic to the reducer for a fractional runtime quantity
+            raise ExtractionError(
+                f"aux method '{spec.name}' returns dtype {dt}; the formula "
+                "fragment is int/bool-only"
+            )
+        out_t = Bool if dt == jnp.bool_ else Int
         arg_ts = [getattr(a, "tpe", None) or Int for a in args]
         fct = UnInterpretedFct(f"aux!{spec.name}", FunT(arg_ts, out_t))
         result = Application(fct, list(args)).with_type(out_t)
@@ -406,9 +414,16 @@ class _Interpreter:
         if prim == "iota":
             return Vec(lambda i: i)
         if prim in ("pjit", "jit", "closed_call", "custom_jvp_call"):
-            from round_tpu.verify.auxmethod import REGISTRY as _AUX
-            if eqn.params.get("name") in _AUX:
-                return self._aux_call(_AUX[eqn.params["name"]], eqn, ins)
+            from round_tpu.verify.auxmethod import AUX_PREFIX, REGISTRY
+            pname = eqn.params.get("name") or ""
+            if pname.startswith(AUX_PREFIX):
+                spec = REGISTRY.get(pname[len(AUX_PREFIX):])
+                if spec is None:
+                    raise ExtractionError(
+                        f"jit name {pname!r} uses the reserved aux prefix "
+                        "but is not registered"
+                    )
+                return self._aux_call(spec, eqn, ins)
             if eqn.params.get("name") == "floor_divide":
                 # jnp's int // expands into div + sign-correction ops;
                 # DIVIDES with the k·q ≤ num ≤ k·q + k - 1 axioms

@@ -244,4 +244,4 @@ def test_log_levels_and_hide(capsys):
     assert "visible" in err and "suppressed" not in err
     assert rlog.configure(-1).level == logging.ERROR
     assert rlog.configure(0).level == logging.WARNING
-    rlog.get_logger("noisy").setLevel(logging.NOTSET)  # undo hide()
+    rlog.unhide("noisy")

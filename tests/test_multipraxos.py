@@ -1,0 +1,93 @@
+"""MultiPraxos mailbox-axiom suite (reference:
+logic/MultiPraxosMboxAxioms.scala — its one live test).
+
+The reference axiomatizes the broadcast round's mailbox/send/HO relation
+as FMap keysets and proves: under full HO (|ho(p)| ≥ n) with the leader
+sending to everyone, NO process can have a nonempty mailbox missing the
+leader (the exists-implication is UNSAT).  The proof needs
+cardinality-extensionality through the venn layer: |ho(p)| ≥ n over an
+n-sized universe forces leader ∈ ho(p), and the mailbox axioms transport
+membership through the send keyset.
+
+Adaptation: the reference's explicit π (set of all processes) is our
+implicit finite universe of size N (venn.N_VAR), so π-membership clauses
+drop and |π| = n is the universe constraint the venn regions already
+carry.  The redundant bounds of the Scala axiom block (card ≥ 0, ≤ n on
+every set) are venn built-ins too."""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from round_tpu.verify.cl import ClConfig, entailment
+from round_tpu.verify.formula import (
+    And, Application, Card, Comprehension, Exists, FMap, FSet, ForAll, FunT,
+    Geq, Gt, Implies, In, IntLit, Leq, Literal, Not, UnInterpreted,
+    UnInterpretedFct, Variable, procType, KEYSET,
+)
+from round_tpu.verify.tr import ho_of
+from round_tpu.verify.venn import N_VAR as N
+
+cmd = UnInterpreted("command")
+p = Variable("p", procType)
+q = Variable("q", procType)
+leader = Variable("leader", procType)
+send_f = UnInterpretedFct("send", FunT([procType], FMap(procType, cmd)))
+mbox_f = UnInterpretedFct("mbox", FunT([procType], FMap(procType, cmd)))
+
+
+def keyset(m):
+    return Application(KEYSET, [m]).with_type(FSet(procType))
+
+
+def send(pp):
+    return Application(send_f, [pp]).with_type(FMap(procType, cmd))
+
+
+def mbox(pp):
+    return Application(mbox_f, [pp]).with_type(FMap(procType, cmd))
+
+
+def card_of(s):
+    k = Variable("kc", procType)
+    return Card(Comprehension([k], In(k, s)))
+
+
+AXIOMS = And(
+    # mailboxLink over keysets (MultiPraxosMboxAxioms.scala:63-68)
+    ForAll([p, q], Implies(And(In(q, ho_of(p)), In(p, keyset(send(q)))),
+                           In(q, keyset(mbox(p))))),
+    ForAll([p, q], Implies(In(q, keyset(mbox(p))),
+                           And(In(q, ho_of(p)), In(p, keyset(send(q)))))),
+    ForAll([p], Leq(card_of(keyset(mbox(p))), N)),
+    ForAll([p], Geq(card_of(ho_of(p)), N)),          # full HO
+    ForAll([p], In(p, keyset(send(leader)))),        # leader broadcasts
+)
+
+CFG = ClConfig(venn_bound=2, inst_depth=1)
+
+
+def test_multipraxos_mbox_axioms():
+    """The reference's "test" (:101-110): a nonempty mailbox without the
+    leader contradicts full-HO broadcast."""
+    lmbox = Exists([p], Implies(
+        Gt(card_of(keyset(mbox(p))), IntLit(0)),
+        Not(In(leader, keyset(mbox(p)))),
+    ))
+    assert entailment(And(AXIOMS, lmbox), Literal(False), CFG, timeout_s=240)
+
+
+def test_multipraxos_negative_control():
+    """Without the full-HO axiom the lemma must NOT hold (a partitioned
+    process can miss the leader) — guards against vacuous UNSAT."""
+    weak = And(
+        ForAll([p, q], Implies(In(q, keyset(mbox(p))),
+                               And(In(q, ho_of(p)),
+                                   In(p, keyset(send(q)))))),
+        ForAll([p], In(p, keyset(send(leader)))),
+    )
+    lmbox = Exists([p], Implies(
+        Gt(card_of(keyset(mbox(p))), IntLit(0)),
+        Not(In(leader, keyset(mbox(p)))),
+    ))
+    assert not entailment(And(weak, lmbox), Literal(False), CFG, timeout_s=60)
