@@ -9,9 +9,17 @@ Usage:  python -m round_tpu.apps.verifier_cli tpc [-r report.html] [-v]
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
-from round_tpu.verify.verifier import Verifier
+# the verifier is a CPU tool: never let an import chain initialize an
+# accelerator backend (a wedged TPU tunnel would hang, not error)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from round_tpu.verify.verifier import Verifier  # noqa: E402
 
 
 def spec_by_name(name: str):
@@ -43,7 +51,10 @@ def main(argv=None) -> bool:
         with open(ns.report, "w") as fh:
             fh.write(ver.html_report())
         print(f"report written to {ns.report}")
-    print("VERIFIED" if ok else "NOT PROVED")
+    verdict = "VERIFIED" if ok else "NOT PROVED"
+    if ok and ver.used_staged:
+        verdict = "VERIFIED (modulo staged composition, see report note)"
+    print(verdict)
     return ok
 
 

@@ -215,6 +215,57 @@ def otr_spec() -> ProtocolSpec:
         Exists([v], ForAll([i], Eq(sig.get("x", i), v))),
     )
 
+    # -- staged inductiveness chain (the monolithic inv ∧ TR ⊨ inv′ blows
+    # up, exactly as the reference notes for its suites; the chain below is
+    # the one-third-rule preservation argument as ∃-elimination).
+    # Composition: v is the invariant's skolemized witness, j0 an arbitrary
+    # receiver (A's conclusion ∀-generalizes to B's hypothesis), and the
+    # hypotheses of B/C/D are subformulas of the TR (the x′/decide update
+    # equations and the mor axiom) plus earlier conclusions.  inv′'s
+    # witness is the same v.
+    vfree = Variable("v!w", Int)
+    j0 = Variable("j0", procType)
+    maj_Sv = Gt(Times(3, Card(support_global(vfree))), Times(2, N))
+    x_all = ForAll([j], Eq(sig.get_primed("x", j), mor_of(j)))
+    mor_all_v = ForAll([j], Eq(mor_of(j), vfree))
+    dec_cond = Gt(Times(3, Card(support(j, mor_of(j)))), Times(2, N))
+    tr_decide = ForAll([j], And(
+        Implies(dec_cond, And(sig.get_primed("decided", j),
+                              Eq(sig.get_primed("dec", j), mor_of(j)))),
+        Implies(Not(dec_cond),
+                And(Eq(sig.get_primed("decided", j), sig.get("decided", j)),
+                    Eq(sig.get_primed("dec", j), sig.get("dec", j)))),
+    ))
+    sup_prime = Comprehension(
+        [Variable("spk", procType)],
+        Eq(sig.get_primed("x", Variable("spk", procType)), vfree),
+    )
+    c31 = ClConfig(venn_bound=3, inst_depth=1)
+    c21 = ClConfig(venn_bound=2, inst_depth=1)
+    staged_inv0 = [
+        ("A: mor(j0) = v (one-third rule)",
+         # the mor axiom INSTANCE at (j0, v) — author-supplied
+         # instantiation of rnd.aux's ∀j,w clause (the full clause makes
+         # the venn group explode; the instance is what the argument uses)
+         And(maj_Sv, Gt(Times(3, Card(ho_of(j0))), Times(2, N)),
+             Geq(Card(support(j0, mor_of(j0))), Card(support(j0, vfree)))),
+         Eq(mor_of(j0), vfree), c31),
+        ("B: everyone adopts v",
+         And(mor_all_v, x_all),
+         ForAll([i], Eq(sig.get_primed("x", i), vfree)), c21),
+        ("C: v's new support is a supermajority",
+         And(ForAll([i], Eq(sig.get_primed("x", i), vfree)),
+             Gt(Times(3, Card(ho_of(j0))), Times(2, N))),
+         Gt(Times(3, Card(sup_prime)), Times(2, N)), c21),
+        ("D: decisions stay pinned to v",
+         And(mor_all_v,
+             ForAll([i], Implies(sig.get("decided", i),
+                                 Eq(sig.get("dec", i), vfree))),
+             tr_decide),
+         ForAll([i], Implies(sig.get_primed("decided", i),
+                             Eq(sig.get_primed("dec", i), vfree))), c21),
+    ]
+
     return ProtocolSpec(
         sig=sig,
         rounds=[rnd],
@@ -223,6 +274,7 @@ def otr_spec() -> ProtocolSpec:
         properties=[("agreement", agreement)],
         safety_predicate=safety,
         config=ClConfig(venn_bound=3, inst_depth=1),
+        staged={"invariant 0 inductive at round 0": staged_inv0},
     )
 
 

@@ -29,7 +29,18 @@ from round_tpu.verify.vc import VC, CompositeVC, SingleVC
 
 @dataclasses.dataclass
 class ProtocolSpec:
-    """What the user states about a protocol (Specs.scala:8-41)."""
+    """What the user states about a protocol (Specs.scala:8-41).
+
+    `staged` maps a generated VC's name to a protocol-supplied
+    ∃-elimination chain — a list of (stage name, hypothesis, conclusion,
+    ClConfig-or-None).  When present, the verifier discharges the chain
+    (a CompositeVC, all-of) in place of the monolithic VC: the reference's
+    VC.decompose (VC.scala:76-96) generalized to author-chosen stages,
+    exactly the discipline of the hand-translated logic suites
+    (LvExample.scala et al.) where monolithic inductiveness "completely
+    blows up".  Soundness is the author's composition argument — each
+    stage's hypothesis must be a skolemized piece of the original VC or a
+    ∀-generalized earlier conclusion — stated in the spec's code."""
 
     sig: StateSig
     rounds: List[RoundTR]
@@ -39,6 +50,8 @@ class ProtocolSpec:
     safety_predicate: Formula = TRUE   # communication assumption, every round
     liveness: List[Formula] = dataclasses.field(default_factory=list)
     config: Optional[ClConfig] = None
+    staged: Dict[str, List[Tuple[str, Formula, Formula, Optional[ClConfig]]]] = \
+        dataclasses.field(default_factory=dict)
 
 
 class Verifier:
@@ -52,6 +65,7 @@ class Verifier:
         spec = self.spec
         sig = spec.sig
         vcs: List[VC] = []
+        self._staged_unused = set(spec.staged)
 
         if spec.invariants:
             vcs.append(SingleVC(
@@ -62,10 +76,13 @@ class Verifier:
         for inv_idx, inv in enumerate(spec.invariants):
             children = []
             for r_idx, rnd in enumerate(spec.rounds):
+                name = f"invariant {inv_idx} inductive at round {r_idx}"
+                if name in spec.staged:
+                    children.append(self._staged_vc(name))
+                    continue
                 tr = And(spec.safety_predicate, rnd.full_tr())
                 children.append(SingleVC(
-                    f"invariant {inv_idx} inductive at round {r_idx}",
-                    inv, tr, sig.prime(inv),
+                    name, inv, tr, sig.prime(inv),
                 ))
             vcs.append(CompositeVC(
                 f"invariant {inv_idx} is inductive", True, children,
@@ -95,7 +112,32 @@ class Verifier:
             vcs.append(SingleVC(
                 f"property: {name}", inv_all, TRUE, prop,
             ))
+        if self._staged_unused:
+            # an unconsumed staged key means a renamed/shifted VC would
+            # silently fall back to the monolithic form the chain exists
+            # to avoid — refuse instead
+            raise ValueError(
+                "staged chains matched no generated VC: "
+                f"{sorted(self._staged_unused)} (generated: "
+                f"{[v.name for v in vcs]})"
+            )
         return vcs
+
+    def _staged_vc(self, name: str) -> VC:
+        stages = self.spec.staged[name]
+        self._staged_unused.discard(name)
+        children = [
+            SingleVC(sname, hyp, TRUE, concl, config=cfg)
+            for sname, hyp, concl, cfg in stages
+        ]
+        return CompositeVC(f"{name} [staged ∃-elim]", True, children)
+
+    @property
+    def used_staged(self) -> bool:
+        """True when any discharged VC went through an author-supplied
+        staged chain (the verdict is then 'verified modulo the chain's
+        composition argument' — surfaced by report()/the CLI)."""
+        return bool(self.spec.staged) and hasattr(self, "vcs")
 
     # -- checking + report (Verifier.scala:279-367) -------------------------
 
@@ -110,6 +152,12 @@ class Verifier:
         lines = ["Verification report", "==================="]
         for vc in getattr(self, "vcs", []):
             lines.append(vc.report())
+        if self.used_staged:
+            lines.append(
+                "note: staged ∃-elim chains are author-supplied "
+                "decompositions; each stage is machine-checked, the "
+                "composition argument is stated in the protocol spec"
+            )
         return "\n".join(lines)
 
     def html_report(self) -> str:

@@ -1,0 +1,86 @@
+"""The VMCAI replay VC (reference: logic/Replay.scala:125-132, its one
+live test) through the native reducer.
+
+If nobody is ready, the round-1a relation fires ready1 only for a
+coordinator with an HO majority whose hearers all adopt it — so if NO
+coordinator class holds a majority, nobody can become ready.  The
+hypothesis here states the no-majority side directly as
+∀leader. ¬majority({j | coord(j) = leader}) (the reference routes it
+through a free set variable S equated under the quantifier — a shape that
+is inconsistent on its own; stating it directly keeps the UNSAT from
+coming from the hypothesis).
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from round_tpu.verify.cl import ClConfig, entailment
+from round_tpu.verify.formula import (
+    And, Application, Bool, Card, Comprehension, Eq, Exists, ForAll, FunT,
+    Implies, In, Literal, Lt, Not, Times, UnInterpretedFct, Variable,
+    procType,
+)
+from round_tpu.verify.tr import ho_of
+from round_tpu.verify.venn import N_VAR as N
+
+i = Variable("i", procType)
+j = Variable("j", procType)
+leader = Variable("leader", procType)
+coord = UnInterpretedFct("coord", FunT([procType], procType))
+ready1 = UnInterpretedFct("ready1", FunT([procType], Bool))
+
+
+def co(p):
+    return Application(coord, [p]).with_type(procType)
+
+
+def rd1(p):
+    return Application(ready1, [p]).with_type(Bool)
+
+
+def maj(c):
+    return Lt(N, Times(2, c))
+
+
+def hocard(p):
+    k = Variable("k", procType)
+    return Card(Comprehension([k], In(k, ho_of(p))))
+
+
+ROUND1A = And(
+    ForAll([i, j], Implies(
+        And(Eq(i, co(i)), maj(hocard(i)), In(j, ho_of(i))),
+        And(Eq(co(j), i), Eq(rd1(i), Literal(True))),
+    )),
+    ForAll([i], Implies(Not(And(Eq(i, co(i)), maj(hocard(i)))),
+                        Eq(rd1(i), Literal(False)))),
+)
+NOT_PROPOUTRO = ForAll([leader], Not(maj(Card(Comprehension(
+    [j], Eq(co(j), leader))))))
+SOMEBODY_READY = Exists([i], Eq(rd1(i), Literal(True)))
+
+CFG = ClConfig(venn_bound=2, inst_depth=1)
+
+
+def test_replay_round_one_update_condition():
+    """Replay.scala's "round one if update condition": no coord-majority
+    anywhere ∧ round-1a ⊨ nobody becomes ready."""
+    assert entailment(And(ROUND1A, NOT_PROPOUTRO, SOMEBODY_READY),
+                      Literal(False), CFG, timeout_s=240)
+
+
+def test_replay_negative_control():
+    """Without the hearers-adopt-the-coordinator conclusion (coord(j) = i)
+    the HO majority never transfers to a coord class and the VC must not
+    close."""
+    weak = And(
+        ForAll([i, j], Implies(
+            And(Eq(i, co(i)), maj(hocard(i)), In(j, ho_of(i))),
+            Eq(rd1(i), Literal(True)),
+        )),
+        ForAll([i], Implies(Not(And(Eq(i, co(i)), maj(hocard(i)))),
+                            Eq(rd1(i), Literal(False)))),
+    )
+    assert not entailment(And(weak, NOT_PROPOUTRO, SOMEBODY_READY),
+                          Literal(False), CFG, timeout_s=120)

@@ -137,8 +137,8 @@ def test_otr_mor_lemma_needs_quorum():
 
 
 def test_otr_spec_generates_vcs():
-    """The full OTR ProtocolSpec produces the expected VC classes (the full
-    inductive closure is exercised out-of-band: it is solver-heavy)."""
+    """The full OTR ProtocolSpec produces the expected VC classes, with the
+    inductiveness VC routed through the spec's staged chain."""
     spec = otr_spec()
     ver = Verifier(spec)
     vcs = ver.generate_vcs()
@@ -146,6 +146,36 @@ def test_otr_spec_generates_vcs():
     assert any("initial state" in n for n in names)
     assert any("inductive" in n for n in names)
     assert any("property" in n for n in names)
+    rep = "\n".join(vc.report() for vc in vcs)
+    assert "staged" in rep
+
+
+def test_otr_verifies_end_to_end():
+    """The FULL OTR check — init, staged inductiveness (the one-third-rule
+    preservation chain), agreement — is green through the Verifier: the
+    capability the reference's own pipeline lacks (its README:155-156 marks
+    verification broken pending a new cardinality encoding)."""
+    ver = Verifier(otr_spec())
+    assert ver.check(), "\n" + ver.report()
+    assert "✗" not in ver.report()
+
+
+def test_otr_staged_chain_broken_stage_rejected():
+    """Negative control: corrupting one stage of the staged chain must fail
+    the composite inductiveness VC."""
+    import dataclasses as _dc
+
+    from round_tpu.verify.formula import Lt as _Lt
+
+    spec = otr_spec()
+    name = "invariant 0 inductive at round 0"
+    sname, hyp, concl, cfg = spec.staged[name][0]
+    # claim the opposite of stage A's conclusion
+    broken = [(sname, hyp, _Lt(concl.args[0], concl.args[1]), cfg)] + \
+        spec.staged[name][1:]
+    spec = _dc.replace(spec, staged={name: broken})
+    ver = Verifier(spec)
+    assert not ver.check()
 
 
 # ---------------------------------------------------------------------------
@@ -165,3 +195,15 @@ def test_single_vc_report():
     vc = SingleVC("demo", Geq(N, 1), Geq(N, 0), Geq(N, 0))
     assert vc.solve()
     assert "✓" in vc.report()
+
+
+def test_staged_key_mismatch_rejected():
+    """A staged chain whose key matches no generated VC must raise (review
+    regression: silent fallback to the monolithic VC)."""
+    import dataclasses as _dc
+
+    spec = otr_spec()
+    chain = spec.staged["invariant 0 inductive at round 0"]
+    spec = _dc.replace(spec, staged={"invariant 7 inductive at round 9": chain})
+    with pytest.raises(ValueError, match="matched no generated VC"):
+        Verifier(spec).generate_vcs()
