@@ -115,11 +115,16 @@ class Verifier:
         if self._staged_unused:
             # an unconsumed staged key means a renamed/shifted VC would
             # silently fall back to the monolithic form the chain exists
-            # to avoid — refuse instead
+            # to avoid — refuse instead.  List the MATCHABLE names (the
+            # per-round inductiveness children), not the composite heads.
+            matchable = [
+                f"invariant {k} inductive at round {r}"
+                for k in range(len(spec.invariants))
+                for r in range(len(spec.rounds))
+            ]
             raise ValueError(
                 "staged chains matched no generated VC: "
-                f"{sorted(self._staged_unused)} (generated: "
-                f"{[v.name for v in vcs]})"
+                f"{sorted(self._staged_unused)} (matchable: {matchable})"
             )
         return vcs
 
@@ -172,6 +177,13 @@ class Verifier:
                     f'<div style="color:{color};font-family:monospace">'
                     f"{line}</div>"
                 )
+        if self.used_staged:
+            rows.append(
+                '<div style="color:#777;font-style:italic">note: staged '
+                "∃-elim chains are author-supplied decompositions; each "
+                "stage is machine-checked, the composition argument is "
+                "stated in the protocol spec</div>"
+            )
         return (
             "<html><head><title>Verification report</title></head><body>"
             + "\n".join(rows)

@@ -102,9 +102,23 @@ def test_lv_negative_controls(lv):
 
 def test_lv_staged_vcs_exist():
     """The staged inductiveness chain is wired (4 VCs, phase bump on the
-    last); discharge status is tracked in scratch until the reducer closes
-    them — the reference never discharges these at all."""
+    last).  The reference never discharges ANY of these
+    (LvExample.scala:262-291 "those completely blow-up")."""
     vcs, spec, x = lv_staged_vcs()
     assert len(vcs) == 4
     names = [v[0] for v in vcs]
     assert "phase bump" in names[-1]
+
+
+@pytest.mark.parametrize("idx", [1, 3], ids=["adopt-round", "decide-round"])
+def test_lv_inductive_stages_discharge(idx):
+    """BEYOND the reference: two of the four LV round-inductiveness VCs
+    discharge through the native reducer — stage 1→2 via round 2 (the
+    vote-broadcast/adopt round) and stage 3→0 via round 4 (decide + phase
+    bump).  Round 1 (collect/maxTS) and round 3 (ack) remain open, as
+    upstream where all four are `ignore`d."""
+    from round_tpu.verify.formula import And as FAnd
+
+    vcs, spec, _x = lv_staged_vcs()
+    name, hyp, tr, concl = vcs[idx]
+    assert entailment(FAnd(hyp, tr), concl, spec.config, timeout_s=240), name
