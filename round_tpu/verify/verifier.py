@@ -168,6 +168,8 @@ class Verifier:
     def html_report(self) -> str:
         """Minimal HTML report (the reference emits one via dzufferey.report,
         Verifier.scala:342-367)."""
+        import html as _html
+
         rows = []
         for vc in getattr(self, "vcs", []):
             for line in vc.report().splitlines():
@@ -175,7 +177,7 @@ class Verifier:
                 color = "#2a2" if ok else "#c33"
                 rows.append(
                     f'<div style="color:{color};font-family:monospace">'
-                    f"{line}</div>"
+                    f"{_html.escape(line)}</div>"
                 )
         if self.used_staged:
             rows.append(

@@ -245,3 +245,17 @@ def test_log_levels_and_hide(capsys):
     assert rlog.configure(-1).level == logging.ERROR
     assert rlog.configure(0).level == logging.WARNING
     rlog.unhide("noisy")
+
+
+def test_ladder_first_rung_smoke():
+    """The BASELINE ladder's first rung (OTR n=4, the testOTR.sh shape)
+    runs end-to-end on CPU and reports the JSON fields the driver records,
+    with both parity flags true — protects `bench.py --ladder` plumbing."""
+    from round_tpu.apps.ladder import rung_otr4
+
+    r = rung_otr4(repeats=1)
+    assert r["metric"] == "ladder_otr_n4"
+    x = r["extra"]
+    assert x["invariant_parity"] is True
+    assert x["property_parity"] is True
+    assert x["rounds_per_sec"] > 0

@@ -117,8 +117,6 @@ def test_lv_inductive_stages_discharge(idx):
     vote-broadcast/adopt round) and stage 3→0 via round 4 (decide + phase
     bump).  Round 1 (collect/maxTS) and round 3 (ack) remain open, as
     upstream where all four are `ignore`d."""
-    from round_tpu.verify.formula import And as FAnd
-
     vcs, spec, _x = lv_staged_vcs()
     name, hyp, tr, concl = vcs[idx]
-    assert entailment(FAnd(hyp, tr), concl, spec.config, timeout_s=240), name
+    assert entailment(And(hyp, tr), concl, spec.config, timeout_s=240), name
