@@ -17,7 +17,8 @@ from round_tpu.verify.cl import ClConfig
 from round_tpu.verify.formula import (
     And, Application, Binding, Bool, Card, Comprehension, Eq, Exists, FORALL,
     ForAll, FSet, Formula, FunT, Geq, Gt, Implies, In, Int, IntLit, Leq,
-    Literal, Lt, Not, Or, Plus, Times, UnInterpretedFct, Variable, procType,
+    Literal, Lt, Not, OR, Or, Plus, Times, UnInterpretedFct, Variable,
+    procType,
 )
 from round_tpu.verify.tr import HO_FN, Mailbox, RoundTR, StateSig, ho_of
 from round_tpu.verify.venn import N_VAR as N
@@ -739,6 +740,70 @@ def lv_staged_vcs():
     vcs.append(("stage 3 -> 0 via round 4 (phase bump)",
                 And(hyp_sc, F[3]), rounds[3].full_tr(), post))
     return vcs, spec, lv
+
+
+def lv_stage_subvcs():
+    """VC.decompose (VC.scala:76-96) applied to the two OPEN LV
+    inductiveness stages: hypothesis-disjunct (noDecision vs anchored) ×
+    conclusion-conjunct sub-VCs.  Discharge matrix measured on the native
+    reducer (vb=2, d=1; timings on this box):
+
+      stage 0 (collect, round 1):
+        keep_init′                 PROVED (~1s)
+        stage flag (no ready, ts<phase, commit⇒coord)   PROVED (~3s)
+        anchor-disjunction, noDecision case             PROVED (~1s)
+        anchor-disjunction, anchored case               OPEN  (the maxTS
+          argument through the full TR; its core is proved standalone in
+          tests/test_lv_extract.py from the EXTRACTED round-1 code)
+        vote_init′ (new commit's vote traces to init)   OPEN (both cases)
+      stage 2 (ack, round 3):
+        keep_init′ / vote_init′ / commit-ts obligations PROVED (1-20s)
+        ready′ ⇒ ts=phase majority                      PROVED (~95s, slow)
+        anchor-disjunction, anchored case               PROVED (~210s, slow)
+        anchor-disjunction, noDecision case             OPEN (re-anchoring
+          at (vote(coord), phase) needs round-2 adoption history)
+
+    The reference proves NONE of these (LvExample.scala:262-291 ignores
+    all four stages outright).  Returns [(label, hyp, concl, cfg, proved,
+    slow)] — `proved` is the pinned expectation, `slow` marks entries the
+    CI skips without RUN_SLOW_VCS=1."""
+    vcs, spec, lv = lv_staged_vcs()
+    cfg = spec.config
+    out = []
+    for idx, stage_tag in ((0, "collect-r1"), (2, "ack-r3")):
+        name, hyp, tr, concl = vcs[idx]
+        parts = list(hyp.args)
+        disj = next(p for p in parts
+                    if isinstance(p, Application) and p.fct == OR)
+        rest = [p for p in parts if p is not disj]
+        nd_case, anchor_case = disj.args
+        conjs = list(concl.args)
+        H = lambda case=None: And(*( [case] if case is not None else [] ),
+                                  *rest, tr)
+        if idx == 0:
+            out += [
+                (f"{stage_tag}: keep_init'", H(), conjs[1], cfg, True, False),
+                (f"{stage_tag}: stage flag", H(), conjs[3], cfg, True, False),
+                (f"{stage_tag}: anchor-disj, noDecision case",
+                 H(nd_case), conjs[0], cfg, True, False),
+                (f"{stage_tag}: anchor-disj, anchored case",
+                 H(anchor_case), conjs[0], cfg, False, True),
+                (f"{stage_tag}: vote_init'", H(), conjs[2], cfg, False, True),
+            ]
+        else:
+            out += [
+                (f"{stage_tag}: keep_init'", H(), conjs[1], cfg, True, False),
+                (f"{stage_tag}: vote_init'", H(), conjs[2], cfg, True, False),
+                (f"{stage_tag}: commit/ts obligations", H(), conjs[3], cfg,
+                 True, False),
+                (f"{stage_tag}: ready' => ts=phase majority", H(), conjs[4],
+                 cfg, True, True),
+                (f"{stage_tag}: anchor-disj, anchored case",
+                 H(anchor_case), conjs[0], cfg, True, True),
+                (f"{stage_tag}: anchor-disj, noDecision case",
+                 H(nd_case), conjs[0], cfg, False, True),
+            ]
+    return out
 
 
 def _lv_maxx_axiom(sig: StateSig, coord, maxx) -> Formula:
