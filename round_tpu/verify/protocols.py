@@ -763,20 +763,38 @@ def lv_stage_subvcs():
         anchor-disjunction, noDecision case             OPEN (re-anchoring
           at (vote(coord), phase) needs round-2 adoption history)
 
+    Drilling further into the collect-round anchored case (the
+    Hoare-style lemma split; hyps of later entries use earlier entries'
+    conclusions, which is sound chaining):
+        maxTS bridge: anchor ∧ TR ∧ act ⊨ maxx(coord)=va   PROVED (~110s)
+        frame extraction: TR ⊨ x/ts/decided/dec/ready frames PROVED (<1s)
+        pruned majority transfer + phase bound                PROVED (<1s)
+        the ∀-block reconstruction                            OPEN — the
+          per-witness congruence of comprehension card terms across
+          Eq(witness, coord) splits is the exact blow-up the reference
+          names; the reducer needs set-extensionality transport there.
+
     The reference proves NONE of these (LvExample.scala:262-291 ignores
     all four stages outright).  Returns [(label, hyp, concl, cfg, proved,
     slow)] — `proved` is the pinned expectation, `slow` marks entries the
     CI skips without RUN_SLOW_VCS=1."""
     vcs, spec, lv = lv_staged_vcs()
     cfg = spec.config
+    sig = spec.sig
     out = []
-    for idx, stage_tag in ((0, "collect-r1"), (2, "ack-r3")):
-        name, hyp, tr, concl = vcs[idx]
-        parts = list(hyp.args)
+
+    def split_hyp(h):
+        """(nd_case, anchored_case, rest): unpack the staged hypothesis's
+        noDecision-vs-anchored disjunction from its other conjuncts."""
+        parts = list(h.args)
         disj = next(p for p in parts
                     if isinstance(p, Application) and p.fct == OR)
         rest = [p for p in parts if p is not disj]
-        nd_case, anchor_case = disj.args
+        return disj.args[0], disj.args[1], rest
+
+    for idx, stage_tag in ((0, "collect-r1"), (2, "ack-r3")):
+        name, hyp, tr, concl = vcs[idx]
+        nd_case, anchor_case, rest = split_hyp(hyp)
         conjs = list(concl.args)
         H = lambda case=None: And(*( [case] if case is not None else [] ),
                                   *rest, tr)
@@ -803,6 +821,36 @@ def lv_stage_subvcs():
                 (f"{stage_tag}: anchor-disj, noDecision case",
                  H(nd_case), conjs[0], cfg, False, True),
             ]
+
+    # the Hoare-style drill-down of collect-r1's anchored case (docstring
+    # matrix, last block)
+    name, hyp, tr, concl = vcs[0]
+    _nd, anchor_case, rest = split_hyp(hyp)
+    coord, maxx = lv["coord"], lv["maxx"]
+    va = Variable("va", Int)
+    k = Variable("k", procType)
+    i = Variable("i", procType)
+    act = Gt(Times(2, Card(Comprehension([k], In(k, ho_of(coord))))), N)
+    maxx_coord = Application(maxx, [coord]).with_type(Int)
+    frame = ForAll([i], And(*[
+        Eq(sig.get_primed(f, i), sig.get(f, i))
+        for f in ("ts", "x", "decided", "dec", "ready")
+    ]))
+    anchored_post = concl.args[0].args[1]
+    c01 = ClConfig(venn_bound=0, inst_depth=1)
+    out += [
+        ("collect-r1/anchored: maxTS bridge (act => maxx = va)",
+         And(anchor_case, *rest, tr, act), Eq(maxx_coord, va), cfg,
+         True, True),
+        ("collect-r1/anchored: frame extraction from the TR",
+         tr, frame, c01, True, False),
+        ("collect-r1/anchored: pruned majority transfer",
+         And(anchor_case, frame), anchored_post.args[0], cfg, True, False),
+        ("collect-r1/anchored: pruned phase bound",
+         And(anchor_case, frame), anchored_post.args[1], cfg, True, False),
+        ("collect-r1/anchored: forall-block reconstruction",
+         And(anchor_case, frame), anchored_post.args[2], cfg, False, True),
+    ]
     return out
 
 

@@ -184,3 +184,56 @@ def test_lock_manager_service():
         assert not t.is_alive(), "serve thread failed to unwind"
         server.close()
         client.close()
+
+
+def test_host_dynamic_membership_group_change():
+    """The DynamicMembership pattern over REAL sockets (Replicas.scala
+    group change + DynamicMembership.scala:231-245: decide, update the
+    group, run the next instance over it): 3 replicas decide instance 1,
+    then a 4th joins and all 4 decide instance 2 — each OS-level node keeps
+    its transport, only the peer table and n change between instances."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from round_tpu.apps.selector import select
+    from round_tpu.runtime.host import HostRunner
+
+    ports = _free_ports(4)
+    addr = {i: ("127.0.0.1", ports[i]) for i in range(4)}
+    peers1 = {i: addr[i] for i in range(3)}      # instance 1: nodes 0-2
+    peers2 = dict(addr)                          # instance 2: nodes 0-3
+    values1 = [5, 1, 5]
+    values2 = [2, 7, 2, 7]
+    barrier = threading.Barrier(4, timeout=120)
+    res1, res2 = {}, {}
+
+    def node(my_id):
+        tr = HostTransport(my_id, addr[my_id][1])
+        try:
+            if my_id < 3:
+                r1 = HostRunner(select("otr"), my_id, peers1, tr,
+                                instance_id=1, timeout_ms=500)
+                res1[my_id] = r1.run(
+                    {"initial_value": np.int32(values1[my_id])},
+                    max_rounds=24,
+                )
+            barrier.wait()  # the group change point
+            r2 = HostRunner(select("otr"), my_id, peers2, tr,
+                            instance_id=2, timeout_ms=500)
+            res2[my_id] = r2.run(
+                {"initial_value": np.int32(values2[my_id])}, max_rounds=24,
+            )
+        finally:
+            tr.close()
+
+    threads = [threading.Thread(target=node, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+    assert len(res1) == 3 and len(res2) == 4
+    d1 = {int(np.asarray(r.decision)) for r in res1.values()}
+    d2 = {int(np.asarray(r.decision)) for r in res2.values()}
+    assert all(r.decided for r in res1.values()) and d1 == {5}
+    assert all(r.decided for r in res2.values()) and len(d2) == 1
+    assert d2 == {2}  # min-most-often over the NEW 4-member group
