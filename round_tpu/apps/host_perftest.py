@@ -1,0 +1,160 @@
+"""Host-deployment throughput harness: decisions/sec over the native
+transport.
+
+Reference parity: PerfTest2 + runPerfTest2.sh — the reference's actual
+measurement apparatus (4 JVM replicas on localhost, bounded in-flight
+instances, decisions/sec; PerfTest2.scala:19-110, SURVEY.md §6).  Here:
+n replica processes (or threads) run consecutive consensus instances over
+the C++ TCP transport, each instance through the same Round-DSL classes
+the TPU engine simulates, and the harness reports decisions/sec.
+
+    python -m round_tpu.apps.host_perftest --n 4 --instances 50
+    → {"metric": "host_otr_n4_decisions_per_sec", "value": ..., ...}
+
+This complements bench.py (the TPU simulation throughput): bench.py
+measures simulated rounds/sec on-chip; this measures REAL deployed
+decisions/sec on the host path, the reference's own headline metric.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from round_tpu.apps.selector import select  # noqa: E402
+from round_tpu.runtime.host import HostRunner  # noqa: E402
+from round_tpu.runtime.transport import HostTransport  # noqa: E402
+
+
+def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed):
+    tr = HostTransport(my_id, peers[my_id][1])
+    # ONE algorithm object across instances: the jitted round functions
+    # cache on its rounds, so instance 2+ skip compilation entirely
+    algo = select(algo_name)
+    # start-skew buffer: messages for FUTURE instances are stashed and
+    # prefilled into that instance's runner (PerfTest2's lazy-join role);
+    # traffic for completed instances is dropped (TooLate semantics) or
+    # the stash would leak one entry per instance
+    stash: dict = {}
+    current = {"inst": 0}
+
+    def foreign(sender, tag, payload):
+        if tag.instance <= current["inst"]:
+            return
+        stash.setdefault(tag.instance, {}).setdefault(
+            tag.round, {})[sender] = payload
+
+    try:
+        decisions = []
+        for inst in range(1, instances + 1):
+            current["inst"] = inst
+            runner = HostRunner(
+                algo, my_id, peers, tr,
+                instance_id=inst, timeout_ms=timeout_ms, seed=seed + inst,
+                foreign=foreign, prefill=stash.pop(inst, None),
+            )
+            value = (my_id * 7 + inst) % 5
+            res = runner.run({"initial_value": np.int32(value)},
+                             max_rounds=32)
+            decisions.append(
+                int(np.asarray(res.decision)) if res.decided else None
+            )
+        results[my_id] = decisions
+    finally:
+        tr.close()
+
+
+def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0):
+    """Run `instances` consecutive consensus instances over `n` replicas
+    (threads, each with its own transport+sockets — the cheapest faithful
+    stand-in for the reference's 4 local JVMs).  Returns (result dict,
+    per-node decision logs)."""
+    import socket
+
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
+    results: dict = {}
+    threads = [
+        threading.Thread(
+            target=run_node,
+            args=(i, peers, algo, instances, timeout_ms, results, seed),
+        )
+        for i in range(n)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    join_timeout = max(60.0, instances * n * timeout_ms / 1000.0)
+    for t in threads:
+        t.join(timeout=join_timeout)
+    wall = time.perf_counter() - t0
+    if any(t.is_alive() for t in threads):
+        raise RuntimeError(
+            f"replica thread(s) wedged after {join_timeout:.0f}s; "
+            f"results so far: {sorted(results)}"
+        )
+
+    decided = sum(
+        1 for log in results.values() for d in log if d is not None
+    )
+    # an instance counts only when EVERY replica decided it and they agree
+    # (a single decider with the rest timed out is a partial instance, not
+    # a group decision)
+    agreed = partial = 0
+    for inst in range(instances):
+        vals = [results[i][inst] for i in results]
+        if all(v is not None for v in vals) and len(set(vals)) == 1:
+            agreed += 1
+        elif any(v is not None for v in vals):
+            partial += 1
+    dps = agreed / wall if wall > 0 else 0.0
+    return {
+        "metric": f"host_{algo}_n{n}_decisions_per_sec",
+        "value": round(dps, 2),
+        "unit": "decisions/sec",
+        "extra": {
+            "wall_s": round(wall, 3),
+            "instances": instances,
+            "agreed_instances": agreed,
+            "partial_instances": partial,
+            "replica_decisions": decided,
+            "n": n,
+            "timeout_ms": timeout_ms,
+            "transport": "native tcp (native/transport.cpp)",
+        },
+    }, results
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4)
+    ap.add_argument("--instances", type=int, default=20)
+    ap.add_argument("--algo", type=str, default="otr")
+    ap.add_argument("--timeout-ms", type=int, default=300)
+    args = ap.parse_args(argv)
+    result, _logs = measure(
+        n=args.n, instances=args.instances, algo=args.algo,
+        timeout_ms=args.timeout_ms,
+    )
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

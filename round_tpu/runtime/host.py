@@ -59,8 +59,10 @@ class HostRunner:
     """Run one replica of an Algorithm instance over the host transport.
 
     `peers` maps every node id (including ours) to (host, port).  The run is
-    an instance in the reference sense: `instance_id` tags every packet and
-    foreign-instance packets are handed to `default_handler` (or dropped)."""
+    an instance in the reference sense: `instance_id` tags every packet.
+    Foreign-instance NORMAL packets go to the `foreign` sink when one is
+    set (the consecutive-instance driver's stash — see __init__), else
+    with other-flag traffic to `default_handler` (or are dropped)."""
 
     def __init__(
         self,
@@ -72,6 +74,8 @@ class HostRunner:
         timeout_ms: int = 200,
         seed: int = 0,
         default_handler=None,
+        foreign=None,
+        prefill: Optional[Dict[int, Dict[int, Any]]] = None,
     ):
         self.algo = algo
         self.id = my_id
@@ -81,18 +85,56 @@ class HostRunner:
         self.timeout_ms = timeout_ms
         self.seed = seed
         self.default_handler = default_handler
+        # sink for NORMAL messages of other instances: a consecutive-
+        # instance driver (PerfTest2's loop) stashes them and prefills the
+        # next runner — without it, start-skew between replicas drops the
+        # fast node's round-0 send and the slow node burns a full timeout
+        # every instance (the reference solves this with defaultHandler's
+        # lazy join, PerfTest2.scala:72-110)
+        self.foreign = foreign
         for pid, (host, port) in peers.items():
             if pid != my_id:
                 transport.add_peer(pid, host, port)
         # round -> {sender: payload}; early messages wait here
-        self._pending: Dict[int, Dict[int, Any]] = {}
+        self._pending: Dict[int, Dict[int, Any]] = dict(prefill or {})
 
     def _ctx(self, r: int) -> RoundCtx:
-        rng = jax.random.fold_in(
-            jax.random.fold_in(jax.random.PRNGKey(self.seed), r), self.id
-        )
-        return RoundCtx(id=np.int32(self.id), n=self.n, r=np.int32(r),
-                        rng=rng)
+        """Context for eager hooks (expected_nbr_messages).  No rng: the
+        per-round key is derived INSIDE the jitted round functions — two
+        eager fold-ins per round would dominate host-round latency."""
+        return RoundCtx(id=np.int32(self.id), n=self.n, r=np.int32(r))
+
+    def _round_fns(self, rnd):
+        """Jitted (pre+send, update) for one Round at this group size —
+        eager per-op dispatch (including the per-round PRNG fold-in)
+        dominates host-round latency otherwise.  The cache lives ON the
+        round object so every instance over the same Algorithm (the
+        PerfTest2 loop) reuses the compiled pair."""
+        cached = getattr(rnd, "_host_jit", None)
+        if cached is not None and cached[0] == self.n:
+            return cached[1], cached[2]
+        n = self.n
+
+        def mk_ctx(rr, sid, seed):
+            rng = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(seed), rr), sid
+            )
+            return RoundCtx(id=sid, n=n, r=rr, rng=rng)
+
+        def f_send(rr, sid, seed, state):
+            ctx = mk_ctx(rr, sid, seed)
+            st = rnd.pre(ctx, state)
+            spec = rnd.send(ctx, st)
+            return st, spec.payload, spec.dest_mask
+
+        def f_update(rr, sid, seed, state, vals, mask):
+            ctx = mk_ctx(rr, sid, seed)
+            st2 = rnd.update(ctx, state, Mailbox(vals, mask))
+            return st2, ctx._exit
+
+        fns = (jax.jit(f_send), jax.jit(f_update))
+        rnd._host_jit = (n, *fns)
+        return fns
 
     def run(self, io: Any, max_rounds: int = 64) -> HostResult:
         algo = self.algo
@@ -102,11 +144,12 @@ class HostRunner:
         r = 0
         while r < max_rounds and not exited:
             rnd = rounds[r % len(rounds)]
-            ctx = self._ctx(r)
-            state = rnd.pre(ctx, state)  # round-var resets (executor.py:85)
-            spec = rnd.send(ctx, state)
-            dest = np.asarray(spec.dest_mask)
-            payload_np = jax.tree_util.tree_map(np.asarray, spec.payload)
+            rr, sid = np.int32(r), np.int32(self.id)
+            seed = np.uint32(self.seed)
+            f_send, f_update = self._round_fns(rnd)
+            state, payload, dest_mask = f_send(rr, sid, seed, state)
+            dest = np.asarray(dest_mask)
+            payload_np = jax.tree_util.tree_map(np.asarray, payload)
             wire = pickle.dumps(payload_np)
             for d in range(self.n):
                 if d == self.id or not dest[d]:
@@ -120,7 +163,7 @@ class HostRunner:
             if dest[self.id]:
                 inbox[self.id] = payload_np  # self-delivery off the wire
             deadline = _time.monotonic() + self.timeout_ms / 1000.0
-            expected = rnd.expected_nbr_messages(ctx, state)
+            expected = rnd.expected_nbr_messages(self._ctx(r), state)
             while len(inbox) < min(self.n, int(expected)):
                 left_ms = int((deadline - _time.monotonic()) * 1000)
                 if left_ms <= 0:
@@ -130,7 +173,10 @@ class HostRunner:
                     break
                 sender, tag, raw = got
                 if tag.instance != self.instance_id or tag.flag != FLAG_NORMAL:
-                    if self.default_handler is not None:
+                    if tag.flag == FLAG_NORMAL and self.foreign is not None:
+                        self.foreign(sender, tag,
+                                     pickle.loads(raw) if raw else None)
+                    elif self.default_handler is not None:
                         self.default_handler(Message(
                             sender=sender, tag=tag,
                             payload=pickle.loads(raw) if raw else None,
@@ -146,8 +192,10 @@ class HostRunner:
 
             # -- update ---------------------------------------------------
             mbox = self._mailbox(inbox, payload_np)
-            state = rnd.update(ctx, state, mbox)
-            exited = bool(np.asarray(ctx._exit))
+            state, exit_flag = f_update(
+                rr, sid, seed, state, mbox.values, mbox.mask,
+            )
+            exited = bool(np.asarray(exit_flag))
             log.debug("node %d round %d: heard %d/%d%s", self.id, r,
                       len(inbox), self.n, " exit" if exited else "")
             r += 1

@@ -237,3 +237,16 @@ def test_host_dynamic_membership_group_change():
     assert all(r.decided for r in res1.values()) and d1 == {5}
     assert all(r.decided for r in res2.values()) and len(d2) == 1
     assert d2 == {2}  # min-most-often over the NEW 4-member group
+
+
+def test_host_perftest_measure():
+    """The PerfTest2-shaped throughput harness (apps/host_perftest):
+    consecutive instances over the native transport with start-skew
+    stashing — every instance must reach agreement."""
+    from round_tpu.apps.host_perftest import measure
+
+    result, logs = measure(n=3, instances=8, timeout_ms=400)
+    assert result["extra"]["agreed_instances"] == 8
+    assert result["value"] > 0
+    # per-node logs cover every instance
+    assert all(len(v) == 8 for v in logs.values())
