@@ -30,56 +30,31 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-import numpy as np  # noqa: E402
-
 from round_tpu.apps.selector import select  # noqa: E402
-from round_tpu.runtime.host import HostRunner  # noqa: E402
+from round_tpu.runtime.host import run_instance_loop  # noqa: E402
 from round_tpu.runtime.transport import HostTransport  # noqa: E402
 
 
-def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed):
+def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
+             errors=None):
     tr = HostTransport(my_id, peers[my_id][1])
     # ONE algorithm object across instances: the jitted round functions
     # cache on its rounds, so instance 2+ skip compilation entirely
     algo = select(algo_name)
-    # start-skew buffer: messages for FUTURE instances are stashed and
-    # prefilled into that instance's runner (PerfTest2's lazy-join role);
-    # traffic for completed instances is dropped (TooLate semantics) or
-    # the stash would leak one entry per instance
-    stash: dict = {}
-    current = {"inst": 0}
-
-    def foreign(sender, tag, payload):
-        if tag.instance <= current["inst"]:
-            return
-        stash.setdefault(tag.instance, {}).setdefault(
-            tag.round, {})[sender] = payload
-
     try:
-        decisions = []
-        for inst in range(1, instances + 1):
-            current["inst"] = inst
-            runner = HostRunner(
-                algo, my_id, peers, tr,
-                instance_id=inst, timeout_ms=timeout_ms, seed=seed + inst,
-                foreign=foreign, prefill=stash.pop(inst, None),
-            )
-            value = (my_id * 7 + inst) % 5
-            res = runner.run({"initial_value": np.int32(value)},
-                             max_rounds=32)
-            decisions.append(
-                int(np.asarray(res.decision)) if res.decided else None
-            )
-        results[my_id] = decisions
+        results[my_id] = run_instance_loop(
+            algo, my_id, peers, tr, instances, timeout_ms=timeout_ms,
+            seed=seed,
+        )
+    except Exception as e:  # noqa: BLE001 - surfaced by measure()
+        if errors is not None:
+            errors[my_id] = e
+        raise
     finally:
         tr.close()
 
 
-def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0):
-    """Run `instances` consecutive consensus instances over `n` replicas
-    (threads, each with its own transport+sockets — the cheapest faithful
-    stand-in for the reference's 4 local JVMs).  Returns (result dict,
-    per-node decision logs)."""
+def _alloc_ports(n):
     import socket
 
     socks = [socket.socket() for _ in range(n)]
@@ -88,12 +63,55 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0):
     ports = [s.getsockname()[1] for s in socks]
     for s in socks:
         s.close()
+    return ports
+
+
+def _score(logs, instances, wall, n, algo, timeout_ms, mode):
+    """Strict instance scoring: agreed = every replica decided AND equal;
+    any decider short of that = partial."""
+    agreed = partial = 0
+    for inst in range(instances):
+        vals = [logs[i][inst] for i in logs]
+        if all(v is not None for v in vals) and len(set(vals)) == 1:
+            agreed += 1
+        elif any(v is not None for v in vals):
+            partial += 1
+    dps = agreed / wall if wall > 0 else 0.0
+    return {
+        "metric": f"host_{algo}_n{n}_decisions_per_sec",
+        "value": round(dps, 2),
+        "unit": "decisions/sec",
+        "extra": {
+            "wall_s": round(wall, 3),
+            "instances": instances,
+            "agreed_instances": agreed,
+            "partial_instances": partial,
+            "replica_decisions": sum(
+                1 for log in logs.values() for d in log if d is not None
+            ),
+            "n": n,
+            "timeout_ms": timeout_ms,
+            "mode": mode,
+            "transport": "native tcp (native/transport.cpp)",
+        },
+    }
+
+
+def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0):
+    """Run `instances` consecutive consensus instances over `n` replicas
+    (threads, each with its own transport+sockets — on a single-vCPU box
+    the GIL interleaving beats process-per-replica; see measure_processes
+    for the reference's exact multi-process shape).  Returns (result dict,
+    per-node decision logs)."""
+    ports = _alloc_ports(n)
     peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
     results: dict = {}
+    errors: dict = {}
     threads = [
         threading.Thread(
             target=run_node,
-            args=(i, peers, algo, instances, timeout_ms, results, seed),
+            args=(i, peers, algo, instances, timeout_ms, results, seed,
+                  errors),
         )
         for i in range(n)
     ]
@@ -109,36 +127,55 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0):
             f"replica thread(s) wedged after {join_timeout:.0f}s; "
             f"results so far: {sorted(results)}"
         )
+    if len(results) != n:
+        # a crashed replica must fail the run, not shrink the quorum the
+        # agreement score is computed over
+        raise RuntimeError(
+            f"replica(s) died: {sorted(set(range(n)) - set(results))}; "
+            f"errors: {errors}"
+        )
+    return _score(results, instances, wall, n, algo, timeout_ms,
+                  "thread-per-replica"), results
 
-    decided = sum(
-        1 for log in results.values() for d in log if d is not None
-    )
-    # an instance counts only when EVERY replica decided it and they agree
-    # (a single decider with the rest timed out is a partial instance, not
-    # a group decision)
-    agreed = partial = 0
-    for inst in range(instances):
-        vals = [results[i][inst] for i in results]
-        if all(v is not None for v in vals) and len(set(vals)) == 1:
-            agreed += 1
-        elif any(v is not None for v in vals):
-            partial += 1
-    dps = agreed / wall if wall > 0 else 0.0
-    return {
-        "metric": f"host_{algo}_n{n}_decisions_per_sec",
-        "value": round(dps, 2),
-        "unit": "decisions/sec",
-        "extra": {
-            "wall_s": round(wall, 3),
-            "instances": instances,
-            "agreed_instances": agreed,
-            "partial_instances": partial,
-            "replica_decisions": decided,
-            "n": n,
-            "timeout_ms": timeout_ms,
-            "transport": "native tcp (native/transport.cpp)",
-        },
-    }, results
+
+def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300):
+    """One OS PROCESS per replica (the reference's exact shape: 4 JVMs on
+    localhost) via the host_replica CLI's --instances loop: no shared GIL,
+    true parallel replicas.  Returns the same result dict as measure()."""
+    import subprocess
+
+    ports = _alloc_ports(n)
+    peer_arg = ",".join(f"127.0.0.1:{p}" for p in ports)
+    t0 = time.perf_counter()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "round_tpu.apps.host_replica",
+             "--id", str(i), "--peers", peer_arg, "--algo", algo,
+             "--instances", str(instances),
+             "--timeout-ms", str(timeout_ms)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(n)
+    ]
+    join_timeout = max(120.0, instances * n * timeout_ms / 1000.0)
+    outs = {}
+    try:
+        for i, p in enumerate(procs):
+            stdout, stderr = p.communicate(timeout=join_timeout)
+            if p.returncode != 0:
+                raise RuntimeError(f"replica {i} failed: {stderr[-2000:]}")
+            outs[i] = json.loads(stdout.strip().splitlines()[-1])
+    finally:
+        # a failed/wedged replica must not orphan the others (each would
+        # keep burning its full --instances loop of timeouts)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    wall = time.perf_counter() - t0
+
+    logs = {i: outs[i]["decisions"] for i in outs}
+    return _score(logs, instances, wall, n, algo, timeout_ms,
+                  "process-per-replica"), logs
 
 
 def main(argv=None) -> int:
@@ -147,8 +184,12 @@ def main(argv=None) -> int:
     ap.add_argument("--instances", type=int, default=20)
     ap.add_argument("--algo", type=str, default="otr")
     ap.add_argument("--timeout-ms", type=int, default=300)
+    ap.add_argument("--processes", action="store_true",
+                    help="one OS process per replica (the reference's "
+                         "4-JVM shape) instead of threads")
     args = ap.parse_args(argv)
-    result, _logs = measure(
+    fn = measure_processes if args.processes else measure
+    result, _logs = fn(
         n=args.n, instances=args.instances, algo=args.algo,
         timeout_ms=args.timeout_ms,
     )

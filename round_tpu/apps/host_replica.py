@@ -40,6 +40,9 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout-ms", type=int, default=300)
     ap.add_argument("--max-rounds", type=int, default=48)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--instances", type=int, default=1,
+                    help="run this many consecutive instances (PerfTest2 "
+                         "loop; one summary JSON line at the end)")
     from round_tpu.runtime.log import add_verbosity_flags, configure_from_args
 
     add_verbosity_flags(ap)
@@ -59,21 +62,51 @@ def main(argv=None) -> int:
     algo = select(args.algo)
 
     with HostTransport(args.id, peers[args.id][1]) as tr:
-        runner = HostRunner(
-            algo, args.id, peers, tr, instance_id=args.instance,
+        if args.instances <= 1:
+            runner = HostRunner(
+                algo, args.id, peers, tr, instance_id=args.instance,
+                timeout_ms=args.timeout_ms, seed=args.seed,
+            )
+            res = runner.run(
+                {"initial_value": np.int32(args.value)},
+                max_rounds=args.max_rounds,
+            )
+            print(json.dumps({
+                "id": args.id,
+                "decided": res.decided,
+                "decision": int(np.asarray(res.decision)),
+                "rounds": res.rounds_run,
+                "dropped": res.dropped_messages,
+            }))
+            return 0
+
+        # PerfTest2 loop: consecutive instances via the shared helper
+        # (runtime.host.run_instance_loop); --value offsets the
+        # deterministic value schedule, --instance is single-run-only
+        import time
+
+        from round_tpu.runtime.host import run_instance_loop
+
+        if args.instance != 1:
+            print("warning: --instance is ignored with --instances > 1 "
+                  "(instances are numbered 1..N)", file=sys.stderr)
+        t0 = time.perf_counter()
+        decisions = run_instance_loop(
+            algo, args.id, peers, tr, args.instances,
             timeout_ms=args.timeout_ms, seed=args.seed,
+            base_value=args.value, max_rounds=args.max_rounds,
         )
-        res = runner.run(
-            {"initial_value": np.int32(args.value)},
-            max_rounds=args.max_rounds,
-        )
-    print(json.dumps({
-        "id": args.id,
-        "decided": res.decided,
-        "decision": int(np.asarray(res.decision)),
-        "rounds": res.rounds_run,
-        "dropped": res.dropped_messages,
-    }))
+        wall = time.perf_counter() - t0
+        ok = sum(1 for d in decisions if d is not None)
+        print(json.dumps({
+            "id": args.id,
+            "instances": args.instances,
+            "decided_instances": ok,
+            "wall_s": round(wall, 3),
+            "decisions_per_sec": round(ok / wall, 2) if wall > 0 else 0.0,
+            "decisions": decisions,
+            "dropped": tr.dropped,
+        }))
     return 0
 
 

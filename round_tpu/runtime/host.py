@@ -55,6 +55,52 @@ class HostResult:
     dropped_messages: int
 
 
+def run_instance_loop(
+    algo: Algorithm,
+    my_id: int,
+    peers: Dict[int, Tuple[str, int]],
+    transport: HostTransport,
+    instances: int,
+    timeout_ms: int = 300,
+    seed: int = 0,
+    base_value: int = 0,
+    max_rounds: int = 32,
+) -> List[Optional[int]]:
+    """The PerfTest2 loop (PerfTest2.scala:19-110): `instances` consecutive
+    consensus instances over one transport, with start-skew stashing —
+    NORMAL messages tagged with a FUTURE instance are buffered and
+    prefilled into that instance's runner (the defaultHandler lazy-join
+    role); traffic for completed instances is dropped (TooLate).  Initial
+    values follow the deterministic schedule (base_value + id·7 + inst)
+    mod 5, so runs are reproducible across replicas and modes.
+
+    Returns the per-instance decision log (None where undecided)."""
+    stash: Dict[int, Dict[int, Dict[int, Any]]] = {}
+    current = {"inst": 0}
+
+    def foreign(sender, tag, payload):
+        if tag.instance <= current["inst"]:
+            return
+        stash.setdefault(tag.instance, {}).setdefault(
+            tag.round, {})[sender] = payload
+
+    decisions: List[Optional[int]] = []
+    for inst in range(1, instances + 1):
+        current["inst"] = inst
+        runner = HostRunner(
+            algo, my_id, peers, transport, instance_id=inst,
+            timeout_ms=timeout_ms, seed=seed + inst,
+            foreign=foreign, prefill=stash.pop(inst, None),
+        )
+        value = (base_value + my_id * 7 + inst) % 5
+        res = runner.run({"initial_value": np.int32(value)},
+                         max_rounds=max_rounds)
+        decisions.append(
+            int(np.asarray(res.decision)) if res.decided else None
+        )
+    return decisions
+
+
 class HostRunner:
     """Run one replica of an Algorithm instance over the host transport.
 

@@ -250,3 +250,14 @@ def test_host_perftest_measure():
     assert result["value"] > 0
     # per-node logs cover every instance
     assert all(len(v) == 8 for v in logs.values())
+
+
+def test_host_perftest_processes_mode():
+    """--processes: one OS process per replica (the reference's 4-JVM
+    shape) through the host_replica --instances loop, strict agreement."""
+    from round_tpu.apps.host_perftest import measure_processes
+
+    result, logs = measure_processes(n=3, instances=5, timeout_ms=400)
+    assert result["extra"]["agreed_instances"] == 5
+    assert result["extra"]["partial_instances"] == 0
+    assert all(len(v) == 5 for v in logs.values())
