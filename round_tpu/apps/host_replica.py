@@ -71,10 +71,15 @@ def main(argv=None) -> int:
                 {"initial_value": np.int32(args.value)},
                 max_rounds=args.max_rounds,
             )
+            d = int(np.asarray(res.decision)) if res.decided else None
             print(json.dumps({
                 "id": args.id,
                 "decided": res.decided,
                 "decision": int(np.asarray(res.decision)),
+                # list form so harnesses consume single- and multi-instance
+                # runs uniformly (host_perftest.measure_processes)
+                "decisions": [d],
+                "decided_instances": 1 if res.decided else 0,
                 "rounds": res.rounds_run,
                 "dropped": res.dropped_messages,
             }))

@@ -259,3 +259,14 @@ def test_ladder_first_rung_smoke():
     assert x["invariant_parity"] is True
     assert x["property_parity"] is True
     assert x["rounds_per_sec"] > 0
+
+
+def test_ladder_floodmin_rung_smoke():
+    """Second rung (FloodMin n=64 x 256 crash draws) end-to-end on CPU with
+    property parity — the ladder's fault-family plumbing."""
+    from round_tpu.apps.ladder import rung_floodmin
+
+    r = rung_floodmin(repeats=1)
+    assert r["metric"] == "ladder_floodmin_n64"
+    assert r["extra"]["property_parity"] is True
+    assert r["extra"]["frac_lanes_decided"] == 1.0

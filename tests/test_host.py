@@ -261,3 +261,41 @@ def test_host_perftest_processes_mode():
     assert result["extra"]["agreed_instances"] == 5
     assert result["extra"]["partial_instances"] == 0
     assert all(len(v) == 5 for v in logs.values())
+
+
+def test_host_benor_randomized_consensus():
+    """Randomized consensus over the host path: BenOr's coin flips flow
+    through the jitted per-round rng (derived inside the compiled round
+    functions), and a split 2-2 start still reaches binary agreement."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from round_tpu.apps.selector import select
+    from round_tpu.runtime.host import HostRunner
+
+    n = 4
+    ports = _free_ports(n)
+    peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
+    values = [1, 0, 1, 0]  # perfect split: the coin must break it
+    results = {}
+
+    def node(my_id):
+        tr = HostTransport(my_id, peers[my_id][1])
+        try:
+            runner = HostRunner(select("benor"), my_id, peers, tr,
+                                timeout_ms=500, seed=42)
+            results[my_id] = runner.run(
+                {"initial_value": np.int32(values[my_id])}, max_rounds=64,
+            )
+        finally:
+            tr.close()
+
+    threads = [threading.Thread(target=node, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert len(results) == n
+    assert all(r.decided for r in results.values())
+    decisions = {int(np.asarray(r.decision)) for r in results.values()}
+    assert len(decisions) == 1 and decisions <= {0, 1}
