@@ -179,9 +179,10 @@ def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300):
                     pass
     harness_wall = time.perf_counter() - t0
     # score against the slowest replica's OWN loop time: the harness wall
-    # includes each subprocess's interpreter+jax startup and jit compile,
-    # which thread mode pays outside its timed window — comparing modes on
-    # harness wall would mostly measure startup
+    # additionally includes each subprocess's interpreter + jax-import
+    # startup (~seconds each), which thread mode pays before its timed
+    # window.  (Both modes still include first-instance jit compiles in
+    # their loop walls.)
     wall = max(
         (o["wall_s"] for o in outs.values() if "wall_s" in o),
         default=harness_wall,

@@ -75,7 +75,7 @@ def main(argv=None) -> int:
             print(json.dumps({
                 "id": args.id,
                 "decided": res.decided,
-                "decision": int(np.asarray(res.decision)),
+                "decision": d,  # null when undecided (never state garbage)
                 # list form so harnesses consume single- and multi-instance
                 # runs uniformly (host_perftest.measure_processes)
                 "decisions": [d],

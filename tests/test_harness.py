@@ -270,3 +270,16 @@ def test_ladder_floodmin_rung_smoke():
     assert r["metric"] == "ladder_floodmin_n64"
     assert r["extra"]["property_parity"] is True
     assert r["extra"]["frac_lanes_decided"] == 1.0
+
+
+def test_ladder_lv_rung_smoke():
+    """Third rung (LastVoting n=256, crash + coordinator-down families)
+    end-to-end on CPU with BOTH parity flags — the ladder's flagship
+    Paxos-shaped rung (testLV.sh analogue)."""
+    from round_tpu.apps.ladder import rung_lv
+
+    r = rung_lv(repeats=1)
+    assert r["metric"] == "ladder_lv_n256"
+    assert r["extra"]["invariant_parity"] is True
+    assert r["extra"]["property_parity"] is True
+    assert r["extra"]["frac_lanes_decided"] == 1.0

@@ -63,6 +63,12 @@ def test_transport_large_payload():
 
 
 def _run_replica_thread(results, algo_name, my_id, peers, value, n_rounds=48):
+    _replica_body(results, my_id, peers, algo_name, {},
+                  {"initial_value": np.int32(value)}, 500, 0, n_rounds)
+
+
+def _replica_body(results, my_id, peers, algo_name, algo_opts, io,
+                  timeout_ms, seed, max_rounds):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -72,13 +78,35 @@ def _run_replica_thread(results, algo_name, my_id, peers, value, n_rounds=48):
     tr = HostTransport(my_id, peers[my_id][1])
     try:
         runner = HostRunner(
-            select(algo_name), my_id, peers, tr, timeout_ms=500
+            select(algo_name, algo_opts or None), my_id, peers, tr,
+            timeout_ms=timeout_ms, seed=seed,
         )
-        res = runner.run({"initial_value": np.int32(value)},
-                         max_rounds=n_rounds)
-        results[my_id] = res
+        results[my_id] = runner.run(io, max_rounds=max_rounds)
     finally:
         tr.close()
+
+
+def _deploy(n, algo_name, make_io, algo_opts=None, timeout_ms=500, seed=0,
+            max_rounds=24):
+    """Spawn n replica threads over real sockets; returns {id: HostResult}.
+    `make_io(my_id)` builds each replica's io pytree."""
+    ports = _free_ports(n)
+    peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
+    results: dict = {}
+    threads = [
+        threading.Thread(
+            target=_replica_body,
+            args=(results, i, peers, algo_name, algo_opts or {},
+                  make_io(i), timeout_ms, seed, max_rounds),
+        )
+        for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert len(results) == n, f"replicas finished: {sorted(results)}"
+    return results
 
 
 def test_host_otr_four_replicas_threads():
@@ -299,3 +327,83 @@ def test_host_benor_randomized_consensus():
     assert all(r.decided for r in results.values())
     decisions = {int(np.asarray(r.decision)) for r in results.values()}
     assert len(decisions) == 1 and decisions <= {0, 1}
+
+
+def test_host_kset_vector_payload():
+    """KSetAgreement carries a [n]-vector+mask payload (the reference's
+    Map[ProcessID,Int] hard case, KSetAgreement.scala:33-41): vector
+    payloads must survive the wire and k-agreement must hold (at most k
+    distinct decisions, each an initial value)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from round_tpu.apps.selector import select
+    from round_tpu.runtime.host import HostRunner
+
+    n, k = 4, 2
+    ports = _free_ports(n)
+    peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
+    values = [9, 3, 7, 5]
+    results = {}
+
+    def node(my_id):
+        tr = HostTransport(my_id, peers[my_id][1])
+        try:
+            runner = HostRunner(select("kset", {"k": k}), my_id, peers, tr,
+                                timeout_ms=500)
+            results[my_id] = runner.run(
+                {"initial_value": np.int32(values[my_id])}, max_rounds=24,
+            )
+        finally:
+            tr.close()
+
+    threads = [threading.Thread(target=node, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert len(results) == n
+    assert all(r.decided for r in results.values())
+    decisions = {int(np.asarray(r.decision)) for r in results.values()}
+    assert len(decisions) <= k
+    assert decisions <= set(values)
+
+
+def test_host_tpc_commit_and_abort():
+    """Two-phase commit over the host path: unanimous yes commits,
+    any no aborts (TwoPhaseCommit.scala semantics, real sockets)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from round_tpu.apps.selector import select
+    from round_tpu.runtime.host import HostRunner
+
+    for votes, expect in (([1, 1, 1], 1), ([1, 0, 1], 0)):
+        n = len(votes)
+        ports = _free_ports(n)
+        peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
+        results = {}
+
+        def node(my_id):
+            tr = HostTransport(my_id, peers[my_id][1])
+            try:
+                runner = HostRunner(select("tpc"), my_id, peers, tr,
+                                    timeout_ms=500)
+                results[my_id] = runner.run(
+                    {"coord": np.int32(0),
+                     "can_commit": np.bool_(votes[my_id])},
+                    max_rounds=8,
+                )
+            finally:
+                tr.close()
+
+        threads = [threading.Thread(target=node, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert len(results) == n, f"votes={votes}"
+        ds = {int(np.asarray(r.decision)) for r in results.values()}
+        assert all(r.decided for r in results.values())
+        assert ds == {expect}, f"votes={votes}: {ds}"
