@@ -10,6 +10,27 @@ batched rounds on one chip.  Prints one JSON line per ladder rung
 advance one round).  vs_baseline is against the 100 rounds/sec/chip target
 (BASELINE.md): value/100.
 
+CRASH-PROOFING (round-2 verdict item 1).  This file is a two-stage
+driver/worker: the top-level process imports NO jax and NO round_tpu —
+on this box an accelerator PJRT plugin is pre-registered by sitecustomize
+and backend init has been observed to either raise (r02: axon UNAVAILABLE
+at import time via a module-level jnp.asarray) or HANG FOREVER (wedged
+tunnel relay).  Neither failure mode can be survived in-process, so:
+
+  1. the driver probes backend init in a killable subprocess with a hard
+     timeout;
+  2. the timed bench runs in a second killable subprocess (--worker) under
+     a watchdog;
+  3. every failure path — probe raise, probe hang, worker crash, worker
+     hang, missing metric line — ends with ONE machine-readable JSON line
+     (an "error" field + the flagship metric shape, value 0) and EXIT 0,
+     so the unattended end-of-round run always records a parseable
+     artifact instead of rc=1.
+
+On backend unavailability the driver additionally runs a tiny CPU-forced
+degraded worker so the artifact still proves the bench path executes; its
+result is embedded in the error line's extra.cpu_degraded.
+
 Timing discipline (round-1 verdict): on this platform block_until_ready can
 return before the computation completes, so the timed region ends at a
 device→host transfer of the outputs.  The outputs are O(1)-size ON-DEVICE
@@ -47,148 +68,31 @@ from the on-device spec checker.
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
+BASELINE_ROUNDS_PER_SEC = 100.0
+
+# Backend probe source, run via `python -c` in a killable subprocess.  It
+# must exercise an actual device computation (not just jax.devices()): the
+# r02 failure surfaced only at the first array op.
+_PROBE_SRC = """
+import json, sys
 import jax
+platform = {platform!r}
+if platform:
+    jax.config.update("jax_platforms", platform)
 import jax.numpy as jnp
-
-if "--platform" in sys.argv:
-    # must happen before any backend use; env-var-only selection is unreliable
-    # when an accelerator PJRT plugin is pre-registered by sitecustomize
-    jax.config.update(
-        "jax_platforms", sys.argv[sys.argv.index("--platform") + 1]
-    )
-
-import numpy as np
-
-from round_tpu.engine import fast, scenarios
-from round_tpu.engine.executor import run_instance
-from round_tpu.utils.benchstat import decided_summary, speed_extra
-from round_tpu.models.otr import OTR, OtrState
-from round_tpu.models.common import consensus_io
+x = int(jax.device_get(jnp.arange(8).sum()))
+assert x == 28, x
+ds = jax.devices()
+print(json.dumps({{"platform": ds[0].platform, "n_devices": len(ds)}}))
+"""
 
 
-def make_mix(args, key, S):
-    if args.workload == "omission":
-        mix = fast.fault_free(key, S, args.n)
-        return mix.replace(
-            p8=jnp.full((S,), max(1, round(args.p_drop * 256)), jnp.int32)
-        )
-    return fast.standard_mix(key, S, args.n, p_drop=args.p_drop)
-
-
-def _fresh_otr_state(init, S, n):
-    return OtrState(
-        x=jnp.broadcast_to(init, (S, n)).astype(jnp.int32),
-        decided=jnp.zeros((S, n), dtype=bool),
-        decision=jnp.full((S, n), -1, dtype=jnp.int32),
-        after=jnp.full((S, n), 2, dtype=jnp.int32),
-    )
-
-
-def _run_fast_engine(engine, args, rnd, state0, mix, rounds, mode, interpret):
-    """Dispatch to the engine being benched — ONE site, shared by the timed
-    bench and parity_check so they cannot drift apart."""
-    if engine == "loop":
-        return fast.run_otr_loop(
-            rnd, state0, mix, max_rounds=rounds, mode=mode, sb=args.sb,
-            interpret=interpret,
-        )
-    return fast.run_hist(
-        rnd, state0, lambda s: s.decided, mix,
-        max_rounds=rounds, mode=mode, interpret=interpret,
-    )
-
-
-def make_fused_bench(args, S, engine="fused"):
-    n, V, rounds = args.n, args.values, args.phases
-    rnd = fast.OtrHist(n_values=V, after_decision=2)
-    interpret = jax.default_backend() == "cpu"
-    # the TPU hardware PRNG has no interpreter lowering; CPU runs use the
-    # (bit-reproducible) hash sampler
-    mode = "hash" if interpret else args.rng
-
-    @jax.jit
-    def bench(key):
-        mix = make_mix(args, key, S)
-        k_init = jax.random.fold_in(key, 1)
-        init = jax.random.randint(k_init, (n,), 0, V, dtype=jnp.int32)
-        state0 = _fresh_otr_state(init, S, n)
-        state, done, decided_round = _run_fast_engine(
-            engine, args, rnd, state0, mix, rounds, mode, interpret
-        )
-        return decided_summary(state.decided, decided_round, rounds, state.decision)
-
-    return bench
-
-
-def make_reference_bench(args, S):
-    n, chunk, phases, V = args.n, args.chunk, args.phases, args.values
-    algo = OTR(after_decision=2, n_values=V)
-    sampler = scenarios.omission(n, args.p_drop)
-
-    def run_chunk(keys):  # [chunk] keys -> chunk results
-        def one(k):
-            k_init, k_run = jax.random.split(k)
-            init = jax.random.randint(k_init, (n,), 0, V, dtype=jnp.int32)
-            res = run_instance(
-                algo, consensus_io(init), n, k_run, sampler, max_phases=phases
-            )
-            return res.state.decided, res.decided_round, res.state.decision
-
-        return jax.vmap(one)(keys)
-
-    @jax.jit
-    def bench(key):
-        keys = jax.random.split(key, S).reshape(S // chunk, chunk, 2)
-        decided, dec_round, decision = jax.lax.map(run_chunk, keys)
-        return decided_summary(decided, dec_round, phases, decision)
-
-    return bench
-
-
-def parity_check(args, k_scenarios: int) -> float:
-    """Fraction of lanes where the BENCHED fast engine (hash mode) and the
-    general engine agree on (decided, decision) over the first k scenarios
-    of the mix."""
-    n, V, rounds = args.n, args.values, min(args.phases, 10)
-    key = jax.random.PRNGKey(0)
-    mix = make_mix(args, key, k_scenarios)
-    init = jax.random.randint(
-        jax.random.fold_in(key, 1), (n,), 0, V, dtype=jnp.int32
-    )
-    rnd = fast.OtrHist(n_values=V, after_decision=2)
-    state0 = _fresh_otr_state(init, k_scenarios, n)
-    interpret = jax.default_backend() == "cpu"
-    state, _done, _dr = _run_fast_engine(
-        args.engine if args.engine != "reference" else "fused",
-        args, rnd, state0, mix, rounds, "hash", interpret,
-    )
-    algo = OTR(after_decision=2, n_values=V)
-    agree = 0
-    total = 0
-    for s in range(k_scenarios):
-        sampler = scenarios.from_fault_params(
-            n, mix.crashed[s], mix.crash_round[s], mix.side[s],
-            mix.heal_round[s], mix.rotate_down[s], mix.p8[s],
-            mix.salt0[s], mix.salt1[s],
-        )
-        res = run_instance(
-            algo, consensus_io(init), n, jax.random.fold_in(key, 99 + s),
-            sampler, max_phases=rounds,
-        )
-        agree += int(
-            np.sum(
-                (np.asarray(state.decided[s]) == np.asarray(res.state.decided))
-                & (np.asarray(state.decision[s]) == np.asarray(res.state.decision))
-            )
-        )
-        total += n
-    return agree / max(total, 1)
-
-
-def main():
+def build_parser():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=1024)
     ap.add_argument("--scenarios", type=int, default=10_000)
@@ -211,7 +115,275 @@ def main():
                     help="also run the 5-rung BASELINE config ladder (one JSON line each)")
     ap.add_argument("--ladder-only", type=str, default=None,
                     help="comma-separated rung names (implies --ladder)")
-    args = ap.parse_args()
+    # crash-proofing knobs (driver mode)
+    ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--probe-timeout", type=float, default=240.0,
+                    help="seconds before the backend-init probe is killed")
+    ap.add_argument("--watchdog", type=float, default=2400.0,
+                    help="seconds before the bench worker is killed")
+    ap.add_argument("--no-subprocess", action="store_true",
+                    help="run the bench in-process (dev/tests; no hang protection)")
+    return ap
+
+
+def flagship_metric_name(args):
+    if args.engine == "reference":
+        chunk = max(1, min(args.chunk, args.scenarios))
+        s = (args.scenarios // chunk) * chunk
+    else:
+        s = args.scenarios
+    return f"otr_n{args.n}_s{s}_rounds_per_sec"
+
+
+# --------------------------------------------------------------------------
+# Driver (no jax imports anywhere on this path)
+# --------------------------------------------------------------------------
+
+def _emit_error(args, error, extra):
+    extra = dict(extra)
+    extra.update({"n": args.n, "engine": args.engine, "workload": args.workload})
+    line = {
+        "metric": flagship_metric_name(args),
+        "value": 0.0,
+        "unit": "rounds/sec",
+        "vs_baseline": 0.0,
+        "error": error,
+        "extra": extra,
+    }
+    print(json.dumps(line), flush=True)
+    return 0
+
+
+def _run_probe(args):
+    """Backend-init probe in a killable subprocess.  Returns (ok, info)."""
+    src = _PROBE_SRC.format(platform=args.platform or "")
+    try:
+        cp = subprocess.run(
+            [sys.executable, "-c", src],
+            capture_output=True, text=True, timeout=args.probe_timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return False, {"probe": "hang", "probe_timeout_s": args.probe_timeout}
+    if cp.returncode != 0:
+        return False, {
+            "probe": "raise",
+            "probe_rc": cp.returncode,
+            "probe_stderr_tail": cp.stderr[-800:],
+        }
+    try:
+        info = json.loads(cp.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return False, {"probe": "unparseable", "probe_stdout_tail": cp.stdout[-400:]}
+    return True, info
+
+
+def _run_worker(argv, timeout):
+    """Run `bench.py --worker <argv>` under a watchdog.  Returns
+    (status, stdout_text, diag) where status is ok|timeout|crash."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker"] + argv
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=None, text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        return "timeout", out or "", {"watchdog_s": timeout}
+    if proc.returncode != 0:
+        return "crash", out or "", {"worker_rc": proc.returncode}
+    return "ok", out or "", {}
+
+
+def _degraded_cpu_result(args):
+    """Tiny CPU-forced run proving the bench path executes even with the
+    accelerator gone; returns its parsed metric line or a status dict."""
+    argv = [
+        "--platform", "cpu", "--engine", "fused", "--rng", "hash",
+        "--n", "32", "--scenarios", "32", "--phases", "10",
+        "--values", str(min(args.values, 8)), "--repeats", "1",
+    ]
+    status, out, diag = _run_worker(argv, timeout=min(600.0, args.watchdog))
+    if status != "ok":
+        return {"status": status, **diag}
+    for ln in reversed(out.strip().splitlines()):
+        try:
+            parsed = json.loads(ln)
+            parsed["status"] = "ok"
+            return parsed
+        except ValueError:
+            continue
+    return {"status": "no-metric-line"}
+
+
+def driver_main(args, argv):
+    ok, info = _run_probe(args)
+    if not ok:
+        sys.stderr.write(f"bench: backend unavailable: {info}\n")
+        extra = dict(info)
+        extra["cpu_degraded"] = _degraded_cpu_result(args)
+        return _emit_error(args, "backend-unavailable", extra)
+
+    status, out, diag = _run_worker(argv, timeout=args.watchdog)
+    # echo whatever the worker managed to print (ladder lines survive a
+    # mid-run wedge this way), keeping the flagship/error line last
+    lines = out.strip().splitlines() if out.strip() else []
+    if status == "ok":
+        parseable = False
+        for ln in lines:
+            print(ln, flush=True)
+            if ln.startswith("{"):
+                try:
+                    json.loads(ln)
+                    parseable = True
+                except ValueError:
+                    pass
+        if not parseable:
+            return _emit_error(args, "no-metric-line", {**info, **diag})
+        return 0
+    for ln in lines:
+        # suppress a half-written last line
+        if ln.startswith("{") and ln.endswith("}"):
+            print(ln, flush=True)
+    err = "bench-timeout" if status == "timeout" else "bench-crash"
+    sys.stderr.write(f"bench: worker {status}: {diag}\n")
+    return _emit_error(args, err, {**info, **diag})
+
+
+# --------------------------------------------------------------------------
+# Worker (all jax / round_tpu imports live below this line)
+# --------------------------------------------------------------------------
+
+def worker_main(args):
+    import jax
+
+    if args.platform:
+        # must happen before any backend use; env-var-only selection is
+        # unreliable when an accelerator PJRT plugin is pre-registered by
+        # sitecustomize
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from round_tpu.engine import fast, scenarios
+    from round_tpu.engine.executor import run_instance
+    from round_tpu.utils.benchstat import decided_summary, speed_extra
+    from round_tpu.models.otr import OTR, OtrState
+    from round_tpu.models.common import consensus_io
+
+    def make_mix(key, S):
+        if args.workload == "omission":
+            mix = fast.fault_free(key, S, args.n)
+            return mix.replace(
+                p8=jnp.full((S,), max(1, round(args.p_drop * 256)), jnp.int32)
+            )
+        return fast.standard_mix(key, S, args.n, p_drop=args.p_drop)
+
+    def fresh_otr_state(init, S, n):
+        return OtrState(
+            x=jnp.broadcast_to(init, (S, n)).astype(jnp.int32),
+            decided=jnp.zeros((S, n), dtype=bool),
+            decision=jnp.full((S, n), -1, dtype=jnp.int32),
+            after=jnp.full((S, n), 2, dtype=jnp.int32),
+        )
+
+    def run_fast_engine(engine, rnd, state0, mix, rounds, mode, interpret):
+        """Dispatch to the engine being benched — ONE site, shared by the
+        timed bench and parity_check so they cannot drift apart."""
+        if engine == "loop":
+            return fast.run_otr_loop(
+                rnd, state0, mix, max_rounds=rounds, mode=mode, sb=args.sb,
+                interpret=interpret,
+            )
+        return fast.run_hist(
+            rnd, state0, lambda s: s.decided, mix,
+            max_rounds=rounds, mode=mode, interpret=interpret,
+        )
+
+    def make_fused_bench(S, engine="fused"):
+        n, V, rounds = args.n, args.values, args.phases
+        rnd = fast.OtrHist(n_values=V, after_decision=2)
+        interpret = jax.default_backend() == "cpu"
+        # the TPU hardware PRNG has no interpreter lowering; CPU runs use
+        # the (bit-reproducible) hash sampler
+        mode = "hash" if interpret else args.rng
+
+        @jax.jit
+        def bench(key):
+            mix = make_mix(key, S)
+            k_init = jax.random.fold_in(key, 1)
+            init = jax.random.randint(k_init, (n,), 0, V, dtype=jnp.int32)
+            state0 = fresh_otr_state(init, S, n)
+            state, done, decided_round = run_fast_engine(
+                engine, rnd, state0, mix, rounds, mode, interpret
+            )
+            return decided_summary(state.decided, decided_round, rounds, state.decision)
+
+        return bench
+
+    def make_reference_bench(S):
+        n, chunk, phases, V = args.n, args.chunk, args.phases, args.values
+        algo = OTR(after_decision=2, n_values=V)
+        sampler = scenarios.omission(n, args.p_drop)
+
+        def run_chunk(keys):  # [chunk] keys -> chunk results
+            def one(k):
+                k_init, k_run = jax.random.split(k)
+                init = jax.random.randint(k_init, (n,), 0, V, dtype=jnp.int32)
+                res = run_instance(
+                    algo, consensus_io(init), n, k_run, sampler, max_phases=phases
+                )
+                return res.state.decided, res.decided_round, res.state.decision
+
+            return jax.vmap(one)(keys)
+
+        @jax.jit
+        def bench(key):
+            keys = jax.random.split(key, S).reshape(S // chunk, chunk, 2)
+            decided, dec_round, decision = jax.lax.map(run_chunk, keys)
+            return decided_summary(decided, dec_round, phases, decision)
+
+        return bench
+
+    def parity_check(k_scenarios: int) -> float:
+        """Fraction of lanes where the BENCHED fast engine (hash mode) and
+        the general engine agree on (decided, decision) over the first k
+        scenarios of the mix."""
+        n, V, rounds = args.n, args.values, min(args.phases, 10)
+        key = jax.random.PRNGKey(0)
+        mix = make_mix(key, k_scenarios)
+        init = jax.random.randint(
+            jax.random.fold_in(key, 1), (n,), 0, V, dtype=jnp.int32
+        )
+        rnd = fast.OtrHist(n_values=V, after_decision=2)
+        state0 = fresh_otr_state(init, k_scenarios, n)
+        interpret = jax.default_backend() == "cpu"
+        state, _done, _dr = run_fast_engine(
+            args.engine if args.engine != "reference" else "fused",
+            rnd, state0, mix, rounds, "hash", interpret,
+        )
+        algo = OTR(after_decision=2, n_values=V)
+        agree = 0
+        total = 0
+        for s in range(k_scenarios):
+            sampler = scenarios.from_fault_params(
+                n, mix.crashed[s], mix.crash_round[s], mix.side[s],
+                mix.heal_round[s], mix.rotate_down[s], mix.p8[s],
+                mix.salt0[s], mix.salt1[s],
+            )
+            res = run_instance(
+                algo, consensus_io(init), n, jax.random.fold_in(key, 99 + s),
+                sampler, max_phases=rounds,
+            )
+            agree += int(
+                np.sum(
+                    (np.asarray(state.decided[s]) == np.asarray(res.state.decided))
+                    & (np.asarray(state.decision[s]) == np.asarray(res.state.decision))
+                )
+            )
+            total += n
+        return agree / max(total, 1)
 
     ladder_results = []
     if args.ladder or args.ladder_only:
@@ -240,11 +412,11 @@ def main():
         raise SystemExit("--scenarios must be >= 1")
     if args.engine in ("fused", "loop"):
         S = args.scenarios
-        bench = make_fused_bench(args, S, engine=args.engine)
+        bench = make_fused_bench(S, engine=args.engine)
     else:
         args.chunk = max(1, min(args.chunk, args.scenarios))
         S = (args.scenarios // args.chunk) * args.chunk
-        bench = make_reference_bench(args, S)
+        bench = make_reference_bench(S)
 
     key = jax.random.PRNGKey(0)
     engine_fallback = None
@@ -264,7 +436,7 @@ def main():
         )
         args.engine = "fused"
         engine_fallback = f"loop failed: {type(e).__name__}"
-        bench = make_fused_bench(args, S, engine="fused")
+        bench = make_fused_bench(S, engine="fused")
         cnt, hist, _ck = jax.device_get(bench(key))
 
     best = None
@@ -285,6 +457,7 @@ def main():
         "n": args.n,
         "scenarios": S,
         "engine": args.engine,
+        "backend": jax.default_backend(),
         "workload": args.workload,
         "p_drop": args.p_drop,
     })
@@ -293,17 +466,26 @@ def main():
         # from the fallback engine, not the one requested
         extra["engine_fallback"] = engine_fallback
     if args.parity > 0:
-        extra["parity_frac"] = round(parity_check(args, args.parity), 4)
+        extra["parity_frac"] = round(parity_check(args.parity), 4)
 
     result = {
-        "metric": f"otr_n{args.n}_s{S}_rounds_per_sec",
+        "metric": flagship_metric_name(args),
         "value": round(rounds_per_sec, 3),
         "unit": "rounds/sec",
-        "vs_baseline": round(rounds_per_sec / 100.0, 3),
+        "vs_baseline": round(rounds_per_sec / BASELINE_ROUNDS_PER_SEC, 3),
         "extra": extra,
     }
-    print(json.dumps(result))
+    print(json.dumps(result), flush=True)
+
+
+def main():
+    argv = sys.argv[1:]
+    args = build_parser().parse_args(argv)
+    if args.worker or args.no_subprocess:
+        worker_main(args)
+        return 0
+    return driver_main(args, argv)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

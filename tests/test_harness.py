@@ -283,3 +283,57 @@ def test_ladder_lv_rung_smoke():
     assert r["extra"]["invariant_parity"] is True
     assert r["extra"]["property_parity"] is True
     assert r["extra"]["frac_lanes_decided"] == 1.0
+
+
+def test_bench_driver_is_hang_proof():
+    """bench.py's driver stage (round-2 verdict item 1): the top level must
+    import no jax, classify backend failures via a killable subprocess
+    probe, and always end with a parseable metric/error line + exit 0."""
+    import ast
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    # structural guard: no module-level jax/round_tpu import may sneak back
+    tree = ast.parse(open(bench.__file__).read())
+    top_imports = set()
+    for n in tree.body:
+        if isinstance(n, ast.ImportFrom):
+            top_imports.add((n.module or "").split(".")[0])
+        elif isinstance(n, ast.Import):
+            top_imports.update(a.name.split(".")[0] for a in n.names)
+    assert "jax" not in top_imports and "round_tpu" not in top_imports
+
+    args = bench.build_parser().parse_args(["--platform", "cpu"])
+    ok, info = bench._run_probe(args)
+    assert ok and info["platform"] == "cpu"
+
+    # a nonexistent platform must classify as a probe raise, not propagate
+    bad = bench.build_parser().parse_args(["--platform", "no_such_backend"])
+    ok, info = bench._run_probe(bad)
+    assert not ok and info["probe"] == "raise"
+
+
+def test_bench_error_line_shape(capsys):
+    """Every bench failure path must emit the flagship metric shape with an
+    error field and return exit code 0 (the r02 rc=1 regression)."""
+    import importlib.util
+    import json as _json
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test2", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    args = bench.build_parser().parse_args([])
+    rc = bench._emit_error(args, "backend-unavailable", {"probe": "hang"})
+    assert rc == 0
+    line = _json.loads(capsys.readouterr().out.strip())
+    assert line["error"] == "backend-unavailable"
+    assert line["metric"] == "otr_n1024_s10000_rounds_per_sec"
+    assert line["value"] == 0.0 and line["unit"] == "rounds/sec"
