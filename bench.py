@@ -367,14 +367,9 @@ def worker_main(args):
         agree = 0
         total = 0
         for s in range(k_scenarios):
-            sampler = scenarios.from_fault_params(
-                n, mix.crashed[s], mix.crash_round[s], mix.side[s],
-                mix.heal_round[s], mix.rotate_down[s], mix.p8[s],
-                mix.salt0[s], mix.salt1[s],
-            )
             res = run_instance(
                 algo, consensus_io(init), n, jax.random.fold_in(key, 99 + s),
-                sampler, max_phases=rounds,
+                scenarios.from_mix_row(mix, s), max_phases=rounds,
             )
             agree += int(
                 np.sum(

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from round_tpu.engine import scenarios
+from round_tpu.engine import fast, scenarios
 from round_tpu.engine.executor import LocalTopology, init_lanes, run_instance
 from round_tpu.models import (
     BenOr, FloodMin, LastVoting, OTR, consensus_io,
@@ -43,10 +43,13 @@ from round_tpu.spec import check_trace, replay_ho
 from round_tpu.utils.benchstat import decided_summary, speed_extra
 
 
-def _time_best(fn, keys: List[jax.Array]):
+def _time_best(fn, keys: List[jax.Array], warmed: bool = False):
     """(best wall seconds, last materialized outputs) — the outputs double
-    as the stats sample, so no extra device run is needed."""
-    out = jax.device_get(fn(keys[0]))  # compile + warmup
+    as the stats sample, so no extra device run is needed.  Pass warmed=True
+    when the caller already compiled+ran fn (e.g. the loop-engine probe)."""
+    out = None
+    if not warmed:
+        out = jax.device_get(fn(keys[0]))  # compile + warmup
     best = None
     for k in keys:
         t0 = time.perf_counter()
@@ -136,40 +139,138 @@ def rung_otr4(repeats: int = 2) -> Dict[str, Any]:
     return {"metric": "ladder_otr_n4", "extra": extra}
 
 
-def rung_floodmin(repeats: int = 2) -> Dict[str, Any]:
-    n, S, f = 64, 256, 2
-    phases = f + 2
-    algo = FloodMin(f)
-    sampler = scenarios.crash(n, f)
-    io_fn = lambda k: consensus_io(
-        jax.random.randint(k, (n,), 0, 1000, dtype=jnp.int32)
+def _crash_mix(key, S: int, n: int, f: int) -> "fast.FaultMix":
+    """f crash-stop processes per scenario, silent from round 0 — the
+    FaultMix form of scenarios.crash (testFloodMin.sh's fault family)."""
+    mix = fast.fault_free(key, S, n)
+    crashed = jax.vmap(
+        lambda k: jax.random.permutation(k, jnp.arange(n)) < f
+    )(jax.random.split(jax.random.fold_in(key, 0xCC), S))
+    return mix.replace(crashed=crashed)
+
+
+def _diff_parity(state, dround, mix, make_algo, io, n, phases, fields, k):
+    """Lane-exact differential parity: fraction of lanes (over the first k
+    scenarios) where the fused outputs equal the general engine replaying
+    the same FaultMix row in hash mode — the bench.py --parity discipline,
+    now per ladder rung."""
+    agree = total = 0
+    for s in range(k):
+        res = run_instance(
+            make_algo(s), io, n, jax.random.PRNGKey(s),
+            scenarios.from_mix_row(mix, s), max_phases=phases,
+        )
+        ok = np.ones(n, dtype=bool)
+        for name in fields:
+            ok &= np.asarray(getattr(state, name)[s]) == np.asarray(
+                getattr(res.state, name)
+            )
+        ok &= np.asarray(dround[s]) == np.asarray(res.decided_round)
+        agree += int(ok.sum())
+        total += n
+    return agree / max(total, 1)
+
+
+def _fused_engine_bench(run_loop, run_hist_fallback):
+    """(engine_name, bench_fn): try the whole-run loop kernel, degrade to
+    the per-round fused engine on compile failure (the bench.py discipline —
+    a rung must produce a number, with the degradation recorded)."""
+    try:
+        fn = run_loop
+        jax.device_get(fn(jax.random.PRNGKey(0)))  # compile + warmup probe
+        return "loop", fn
+    except Exception as e:  # noqa: BLE001
+        import sys
+
+        print(
+            f"warning: ladder loop engine failed ({type(e).__name__}: {e}); "
+            "falling back to the per-round fused engine",
+            file=sys.stderr,
+        )
+        return "hist-fallback", run_hist_fallback
+
+
+def rung_floodmin(repeats: int = 2, n: int = 64, S: int = 256) -> Dict[str, Any]:
+    """FloodMin on the FUSED path (FloodMinHist / FloodMinLoop kernel) under
+    the crash-f FaultMix family, with lane-exact differential parity vs the
+    general engine — testFloodMin.sh's shape on the flagship engine."""
+    f = 2
+    rounds = f + 2  # 1 round per phase
+    V = 1000
+    rnd = fast.FloodMinHist(n_values=V, f=f)
+    interpret = jax.default_backend() == "cpu"
+    mode = "hash" if interpret else "hw"
+
+    def state0_of(init):
+        from round_tpu.models.floodmin import FloodMinState
+
+        return FloodMinState(
+            x=jnp.broadcast_to(init, (S, n)).astype(jnp.int32),
+            decided=jnp.zeros((S, n), dtype=bool),
+            decision=jnp.full((S, n), -1, dtype=jnp.int32),
+        )
+
+    def make_bench(engine):
+        @jax.jit
+        def bench(key):
+            mix = _crash_mix(key, S, n, f)
+            init = jax.random.randint(
+                jax.random.fold_in(key, 1), (n,), 0, V, dtype=jnp.int32
+            )
+            if engine == "loop":
+                state, _done, dround = fast.run_floodmin_loop(
+                    rnd, state0_of(init), mix, max_rounds=rounds,
+                    mode=mode, interpret=interpret,
+                )
+            else:
+                state, _done, dround = fast.run_hist(
+                    rnd, state0_of(init), lambda s: s.decided, mix,
+                    max_rounds=rounds, mode=mode, interpret=interpret,
+                )
+            return decided_summary(
+                state.decided, dround, rounds, state.decision
+            )
+
+        return bench
+
+    engine, bench = _fused_engine_bench(
+        make_bench("loop"), make_bench("hist")
     )
-    bench, rounds = _chunked_runner(algo, io_fn, n, sampler, phases, S, 64)
-    best, (cnt, hist) = _time_best(
-        bench, [jax.random.PRNGKey(i) for i in range(repeats)]
+    best, (cnt, hist, _ck) = _time_best(
+        bench, [jax.random.PRNGKey(i) for i in range(repeats)],
+        warmed=(engine == "loop"),
     )
 
-    # parity: survivors (senders alive in the replayed HO) agree; every
-    # decision is some process's initial value (k-set with k=1 under crash-f)
-    ok = True
-    for seed in range(3):
-        key = jax.random.PRNGKey(100 + seed)
-        init = jax.random.randint(
-            jax.random.fold_in(key, 7), (n,), 0, 1000, dtype=jnp.int32
-        )
-        res = run_instance(
-            algo, consensus_io(init), n, key, sampler, max_phases=phases
-        )
-        ho = np.asarray(replay_ho(key, sampler, res.rounds_run))
-        alive = ho[0].all(axis=0)  # column i true everywhere => i not crashed
-        dec = np.asarray(res.state.decision)
-        decided = np.asarray(res.state.decided)
-        ok &= bool(decided[alive].all())
-        ok &= len(set(dec[alive].tolist())) == 1
-        ok &= bool(np.isin(dec[decided], np.asarray(init)).all())
-    extra = _speed_extra(best, rounds, cnt, hist, n, S)
-    extra.update({"f": f, "property_parity": ok})
-    return {"metric": "ladder_floodmin_n64", "extra": extra}
+    # differential parity + safety on the fused outputs themselves: rerun
+    # the warmup mix in hash mode (bit-replayable), compare k scenarios
+    # lane-exactly, and check crash-tolerant agreement/validity across ALL
+    # scenarios
+    key = jax.random.PRNGKey(0)
+    mix = _crash_mix(key, S, n, f)
+    init = jax.random.randint(
+        jax.random.fold_in(key, 1), (n,), 0, V, dtype=jnp.int32
+    )
+    state, _done, dround = fast.run_hist(
+        rnd, state0_of(init), lambda s: s.decided, mix,
+        max_rounds=rounds, mode="hash", interpret=interpret,
+    )
+    parity_frac = _diff_parity(
+        state, dround, mix, lambda s: FloodMin(f), consensus_io(init), n,
+        rounds, ("x", "decided", "decision"), k=min(6, S),
+    )
+    decided = np.asarray(state.decided)
+    dec = np.asarray(state.decision)
+    alive = ~np.asarray(mix.crashed)
+    ok = bool(decided.all())
+    for s in range(S):
+        ok &= len(set(dec[s][alive[s]].tolist())) == 1
+    ok &= bool(np.isin(dec[decided], np.asarray(init)).all())
+    extra = speed_extra(best, rounds, cnt, hist, n * S)
+    extra.update({
+        "f": f, "engine": engine, "parity_frac": round(parity_frac, 4),
+        "property_parity": ok,
+    })
+    return {"metric": f"ladder_floodmin_n{n}", "extra": extra}
 
 
 def rung_lv(repeats: int = 2) -> Dict[str, Any]:
@@ -201,33 +302,106 @@ def rung_lv(repeats: int = 2) -> Dict[str, Any]:
     return {"metric": "ladder_lv_n256", "extra": extra}
 
 
-def rung_benor(repeats: int = 2) -> Dict[str, Any]:
-    n, S, phases = 512, 4096, 8
-    algo = BenOr()
-    sampler = scenarios.omission(n, 0.05)
+def rung_benor(repeats: int = 2, n: int = 512, S: int = 4096) -> Dict[str, Any]:
+    """Ben-Or on the FUSED path (BenOrHist / BenOrLoop kernel, two subrounds
+    per phase + the deterministic hash coin) under the iid-omission family,
+    with lane-exact differential parity vs the general engine replaying the
+    same masks AND the same coins — testBenOr.sh's shape on the flagship
+    engine."""
+    phases = 8
+    rounds = 2 * phases
+    p_drop = 0.05
+    rnd = fast.BenOrHist()
+    interpret = jax.default_backend() == "cpu"
+    mode = "hash" if interpret else "hw"
 
-    def io_fn(k):
-        # near-even binary split: the hard randomized-consensus instance
-        return consensus_io(
-            jax.random.bernoulli(k, 0.5, (n,)).astype(jnp.int32)
+    def mix_of(key):
+        mix = fast.fault_free(key, S, n)
+        return mix.replace(
+            p8=jnp.full((S,), max(1, round(p_drop * 256)), jnp.int32)
         )
 
-    bench, rounds = _chunked_runner(algo, io_fn, n, sampler, phases, S, 256)
-    best, (cnt, hist) = _time_best(
-        bench, [jax.random.PRNGKey(i) for i in range(repeats)]
+    def state0_of(init):
+        from round_tpu.models.benor import BenOrState
+
+        return BenOrState(
+            x=jnp.broadcast_to(init, (S, n)).astype(bool),
+            can_decide=jnp.zeros((S, n), dtype=bool),
+            vote=jnp.full((S, n), -1, dtype=jnp.int32),
+            decided=jnp.zeros((S, n), dtype=bool),
+            decision=jnp.zeros((S, n), dtype=bool),
+        )
+
+    def make_bench(engine):
+        @jax.jit
+        def bench(key):
+            mix = mix_of(key)
+            # near-even binary split: the hard randomized-consensus instance
+            init = jax.random.bernoulli(jax.random.fold_in(key, 1), 0.5, (n,))
+            if engine == "loop":
+                state, _done, dround = fast.run_benor_loop(
+                    rnd, state0_of(init), mix, max_rounds=rounds,
+                    mode=mode, interpret=interpret,
+                )
+            else:
+                state, _done, dround = fast.run_hist(
+                    rnd, state0_of(init), lambda s: s.decided, mix,
+                    max_rounds=rounds, mode=mode, interpret=interpret,
+                )
+            return decided_summary(
+                state.decided, dround, rounds,
+                state.decision.astype(jnp.int32),
+            )
+
+        return bench
+
+    engine, bench = _fused_engine_bench(
+        make_bench("loop"), make_bench("hist")
+    )
+    best, (cnt, hist, _ck) = _time_best(
+        bench, [jax.random.PRNGKey(i) for i in range(repeats)],
+        warmed=(engine == "loop"),
     )
 
+    # differential parity (masks AND coins replay in the general engine via
+    # BenOr(coin_salt=...)) + agreement over every fused scenario
+    key = jax.random.PRNGKey(0)
+    mix = mix_of(key)
+    init = jax.random.bernoulli(jax.random.fold_in(key, 1), 0.5, (n,))
+    state, _done, dround = fast.run_hist(
+        rnd, state0_of(init), lambda s: s.decided, mix,
+        max_rounds=rounds, mode="hash", interpret=interpret,
+    )
+    parity_frac = _diff_parity(
+        state, dround, mix,
+        lambda s: BenOr(coin_salt=(int(mix.salt0[s]), int(mix.salt1[s]))),
+        consensus_io(init), n, phases,
+        ("x", "can_decide", "vote", "decided", "decision"), k=min(4, S),
+    )
+    decided = np.asarray(state.decided)
+    dec = np.asarray(state.decision)
+    # agreement over ALL S scenarios, vectorized: every decided lane must
+    # match the scenario's first decided lane
+    ref = dec[np.arange(S), np.argmax(decided, axis=1)]
+    agree_ok = not bool((decided & (dec != ref[:, None])).any())
+
     inv_ok = prop_ok = True
+    algo_spec = BenOr()
+    sampler = scenarios.omission(n, p_drop)
     for seed in range(2):
         _res, rep = _parity_trace(
-            algo, consensus_io(list(np.arange(n) % 2)), n,
+            algo_spec, consensus_io(list(np.arange(n) % 2)), n,
             jax.random.PRNGKey(seed), sampler, phases, rounds_per_phase=2,
         )
         inv_ok &= bool(rep.any_invariant.all())
         prop_ok &= bool(rep.all_safety_properties_hold())
-    extra = _speed_extra(best, rounds, cnt, hist, n, S)
-    extra.update({"invariant_parity": inv_ok, "property_parity": prop_ok})
-    return {"metric": "ladder_benor_n512", "extra": extra}
+    extra = speed_extra(best, rounds, cnt, hist, n * S)
+    extra.update({
+        "engine": engine, "parity_frac": round(parity_frac, 4),
+        "agreement_parity": agree_ok,
+        "invariant_parity": inv_ok, "property_parity": prop_ok,
+    })
+    return {"metric": f"ladder_benor_n{n}", "extra": extra}
 
 
 def rung_epsilon(repeats: int = 2) -> Dict[str, Any]:

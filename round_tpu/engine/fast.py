@@ -160,14 +160,23 @@ def round_params(mix: FaultMix, r) -> Tuple[jnp.ndarray, ...]:
 class HistRound:
     """A round whose update consumes only the value histogram.  Implemented
     by algorithms on the fused path; `update_counts` is batched over [S, n]
-    (no vmap — plain array code)."""
+    (no vmap — plain array code).
+
+    Multi-subround algorithms (BenOr's two-round phases) set
+    ``phase_len > 1``: subround ``k = r % phase_len`` selects the payload
+    encoding and update branch.  All subrounds share one histogram domain
+    (``num_values`` = the max over subrounds) so every branch of the
+    dispatch has identical shapes.  ``needs_coin`` asks run_hist for the
+    deterministic [S, n] hash-coin matrix (ops.fused.hash_coin) each round."""
 
     num_values: int
+    phase_len: int = 1
+    needs_coin: bool = False
 
-    def payload(self, state) -> jnp.ndarray:
+    def payload(self, state, k: int = 0) -> jnp.ndarray:
         raise NotImplementedError
 
-    def update_counts(self, state, counts, size, r, n):
+    def update_counts(self, state, counts, size, r, n, k: int = 0, coin=None):
         """counts [S, V, n] int32, size [S, n] int32 → (state, exit [S, n])."""
         raise NotImplementedError
 
@@ -180,10 +189,10 @@ class OtrHist(HistRound):
         self.num_values = n_values
         self.after_decision = after_decision
 
-    def payload(self, state):
+    def payload(self, state, k: int = 0):
         return state.x
 
-    def update_counts(self, state, counts, size, r, n):
+    def update_counts(self, state, counts, size, r, n, k: int = 0, coin=None):
         quorum = size > (2 * n) // 3
         v = jnp.argmax(counts, axis=1).astype(state.x.dtype)  # [S, n]
         v_count = jnp.max(counts, axis=1)
@@ -195,6 +204,92 @@ class OtrHist(HistRound):
             x=jnp.where(quorum, v, state.x), after=after
         )
         return state, exit_
+
+
+class FloodMinHist(HistRound):
+    """FloodMin on the fused path (FloodMin.scala:22-33): x folds to the min
+    over delivered values, decide after round f.  The min over the mailbox
+    is min{v : counts[v] > 0} — straight off the histogram."""
+
+    def __init__(self, n_values: int, f: int):
+        self.num_values = n_values
+        self.f = f
+
+    def payload(self, state, k: int = 0):
+        return state.x
+
+    def update_counts(self, state, counts, size, r, n, k: int = 0, coin=None):
+        V = self.num_values
+        rows = jnp.arange(V, dtype=jnp.int32)[None, :, None]  # [1, V, 1]
+        xm = jnp.min(
+            jnp.where(counts > 0, rows, V), axis=1
+        ).astype(state.x.dtype)
+        x = jnp.minimum(state.x, xm)  # self-delivery already includes own x
+        deciding = jnp.broadcast_to(r > self.f, x.shape)
+        state = ghost_decide(state.replace(x=x), deciding, x)
+        return state, deciding
+
+
+class BenOrHist(HistRound):
+    """Ben-Or on the fused path (BenOr.scala:11-88): two subrounds per
+    phase over one 4-value histogram domain.
+
+    Subround 0 broadcasts (x, canDecide) as v = x + 2·can; subround 1
+    broadcasts the vote as v = vote + 1 (3 live values).  The coin is the
+    deterministic hash coin (ops.fused.hash_coin) — replayable in the
+    general engine via BenOr(coin_salt=...), giving randomized consensus
+    the same differential-parity story as the link masks."""
+
+    num_values = 4
+    phase_len = 2
+    needs_coin = True
+
+    def payload(self, state, k: int = 0):
+        if k == 0:
+            return state.x.astype(jnp.int32) + 2 * state.can_decide.astype(
+                jnp.int32
+            )
+        return state.vote + 1
+
+    def update_counts(self, state, counts, size, r, n, k: int = 0, coin=None):
+        half = n // 2
+        if k == 0:
+            t_cnt = counts[:, 1] + counts[:, 3]
+            f_cnt = counts[:, 0] + counts[:, 2]
+            t_dec = counts[:, 3] > 0
+            f_dec = counts[:, 2] > 0
+            vote_new = jnp.where(
+                (t_cnt > half) | t_dec,
+                jnp.int32(1),
+                jnp.where((f_cnt > half) | f_dec, jnp.int32(0), jnp.int32(-1)),
+            )
+            can_any = (counts[:, 2] + counts[:, 3]) > 0
+
+            deciding = state.can_decide
+            state = ghost_decide(state, deciding, state.x)
+            state = state.replace(
+                vote=jnp.where(deciding, state.vote, vote_new),
+                can_decide=jnp.where(deciding, state.can_decide, can_any),
+            )
+            return state, deciding
+        t = counts[:, 2]
+        f = counts[:, 1]
+        x2 = jnp.where(
+            t > half,
+            True,
+            jnp.where(
+                f > half,
+                False,
+                jnp.where(t > 1, True, jnp.where(f > 1, False, coin)),
+            ),
+        )
+        can2 = (t > half) | (f > half) | state.can_decide
+        frozen = state.decided
+        state = state.replace(
+            x=jnp.where(frozen, state.x, x2),
+            can_decide=jnp.where(frozen, state.can_decide, can2),
+        )
+        return state, jnp.zeros_like(frozen)
 
 
 def run_hist(
@@ -221,22 +316,41 @@ def run_hist(
     def step(carry, r):
         state, done, decided_round = carry
         colmask, side_r, p8, salt0, salt1r = round_params(mix, r)
-        counts = fused.hist_exchange(
-            rnd.payload(state),
-            ~done,
-            colmask,
-            None,  # rowmask: broadcast rounds select every receiver
-            side_r,
-            salt0,
-            salt1r,
-            p8,
-            V,
-            mode=mode,
-            sb=sb,
-            interpret=interpret,
-        ).astype(jnp.int32)
-        size = jnp.sum(counts, axis=1)
-        new_state, exit_ = rnd.update_counts(state, counts, size, r, n)
+        coin = (
+            fused.hash_coin(
+                mix.salt0[:, None], mix.salt1[:, None], r,
+                jnp.arange(n, dtype=jnp.int32)[None, :],
+            )
+            if rnd.needs_coin
+            else None
+        )
+
+        def subround(k, state):
+            counts = fused.hist_exchange(
+                rnd.payload(state, k),
+                ~done,
+                colmask,
+                None,  # rowmask: broadcast rounds select every receiver
+                side_r,
+                salt0,
+                salt1r,
+                p8,
+                V,
+                mode=mode,
+                sb=sb,
+                interpret=interpret,
+            ).astype(jnp.int32)
+            size = jnp.sum(counts, axis=1)
+            return rnd.update_counts(state, counts, size, r, n, k=k, coin=coin)
+
+        if rnd.phase_len == 1:
+            new_state, exit_ = subround(0, state)
+        else:
+            new_state, exit_ = jax.lax.switch(
+                r % rnd.phase_len,
+                [partial(subround, k) for k in range(rnd.phase_len)],
+                state,
+            )
         # frozen lanes keep their state; exits only count for active lanes
         active = ~done
         state = tree_where(active, new_state, state)
@@ -293,4 +407,84 @@ def run_otr_loop(
         interpret=interpret,
     )
     state = OtrState(x=x, decided=dec, decision=decision, after=after)
+    return state, done, dround
+
+
+def _mix_args(mix: FaultMix):
+    return (mix.crashed, mix.side, mix.crash_round, mix.heal_round,
+            mix.rotate_down, mix.p8, mix.salt0, mix.salt1)
+
+
+def _require_fresh(ok: bool, what: str):
+    if not ok:
+        raise ValueError(
+            f"run_{what}_loop requires a fresh state0 (nothing decided, "
+            "round variables at their init values); resume partial runs "
+            "with run_hist instead"
+        )
+
+
+def run_floodmin_loop(
+    rnd: "FloodMinHist",
+    state0,
+    mix: FaultMix,
+    max_rounds: int,
+    mode: str = "hw",
+    sb: int = 8,
+    interpret: bool = False,
+):
+    """FloodMin's whole run as ONE Pallas kernel (ops.fused.FloodMinLoop) —
+    drop-in for run_hist(FloodMinHist(...), fresh state0, ...); same
+    (state, done, decided_round), differential-pinned by tests/test_fast.py."""
+    from round_tpu.models.floodmin import FloodMinState
+
+    if not isinstance(state0.decided, jax.core.Tracer):
+        _require_fresh(not bool(jnp.any(state0.decided)), "floodmin")
+
+    (x, dec, decision), done, dround = fused.hist_loop(
+        fused.FloodMinLoop(num_values=rnd.num_values, f=rnd.f),
+        state0.x, *_mix_args(mix),
+        rounds=max_rounds, mode=mode, sb=sb, interpret=interpret,
+    )
+    state = FloodMinState(x=x, decided=dec.astype(bool), decision=decision)
+    return state, done, dround
+
+
+def run_benor_loop(
+    rnd: "BenOrHist",
+    state0,
+    mix: FaultMix,
+    max_rounds: int,
+    mode: str = "hw",
+    sb: int = 8,
+    interpret: bool = False,
+):
+    """Ben-Or's whole run as ONE Pallas kernel (ops.fused.BenOrLoop, two
+    subrounds per phase dispatched in-kernel) — drop-in for
+    run_hist(BenOrHist(), fresh state0, ...); the coin is the deterministic
+    hash coin in BOTH paths, so parity is lane-exact."""
+    from round_tpu.models.benor import BenOrState
+
+    if not isinstance(state0.decided, jax.core.Tracer):
+        _require_fresh(
+            not (
+                bool(jnp.any(state0.decided))
+                or bool(jnp.any(state0.can_decide))
+                or bool(jnp.any(state0.vote != -1))
+            ),
+            "benor",
+        )
+
+    (x, can, vote, dec, decision), done, dround = fused.hist_loop(
+        fused.BenOrLoop(),
+        state0.x.astype(jnp.int32), *_mix_args(mix),
+        rounds=max_rounds, mode=mode, sb=sb, interpret=interpret,
+    )
+    state = BenOrState(
+        x=x.astype(bool),
+        can_decide=can.astype(bool),
+        vote=vote,
+        decided=dec.astype(bool),
+        decision=decision.astype(bool),
+    )
     return state, done, dround

@@ -237,6 +237,18 @@ def from_fault_params(
     return sample
 
 
+def from_mix_row(mix, s: int) -> Callable:
+    """from_fault_params over row `s` of an engine.fast.FaultMix — the one
+    place that unpacks a mix row, shared by every differential-parity site
+    (bench.py, apps/ladder.py, tests) so a new FaultMix field cannot be
+    silently dropped from a replay."""
+    return from_fault_params(
+        mix.crashed.shape[1], mix.crashed[s], mix.crash_round[s], mix.side[s],
+        mix.heal_round[s], mix.rotate_down[s], mix.p8[s],
+        mix.salt0[s], mix.salt1[s],
+    )
+
+
 def from_schedule(schedule: jnp.ndarray) -> Callable:
     """Replay an explicit [T, n, n] HO schedule (differential testing against
     hand-computed traces)."""

@@ -73,6 +73,12 @@ class BenOrRound1(Round):
 
 
 class BenOrRound2(Round):
+    def __init__(self, coin_salt=None):
+        # coin_salt = (salt0, salt1): use the deterministic hash coin
+        # (ops.fused.hash_coin) instead of ctx.rng — the differential-parity
+        # bridge to the fused engine, same role as hash-mode link masks
+        self.coin_salt = coin_salt
+
     def send(self, ctx: RoundCtx, state: BenOrState):
         return broadcast(ctx, state.vote)
 
@@ -80,7 +86,14 @@ class BenOrRound2(Round):
         n = ctx.n
         t = mbox.count(lambda v: v == VOTE_TRUE)
         f = mbox.count(lambda v: v == VOTE_FALSE)
-        coin = jax.random.bernoulli(ctx.rng)
+        if self.coin_salt is None:
+            coin = jax.random.bernoulli(ctx.rng)
+        else:
+            from round_tpu.ops.fused import hash_coin
+
+            coin = hash_coin(
+                self.coin_salt[0], self.coin_salt[1], ctx.r, ctx.id
+            )
 
         x = jnp.where(
             t > n // 2,
@@ -165,10 +178,14 @@ class BenOrSpec(Spec):
 
 
 class BenOr(Algorithm):
-    """Randomized binary consensus; terminates with probability 1."""
+    """Randomized binary consensus; terminates with probability 1.
 
-    def __init__(self):
-        self.rounds = (BenOrRound1(), BenOrRound2())
+    ``coin_salt=(salt0, salt1)`` switches round 2 to the deterministic hash
+    coin so a FaultMix scenario replays bit-exactly against the fused
+    engine (see BenOrRound2)."""
+
+    def __init__(self, coin_salt=None):
+        self.rounds = (BenOrRound1(), BenOrRound2(coin_salt=coin_salt))
         self.spec = BenOrSpec()
 
     def make_init_state(self, ctx: RoundCtx, io) -> BenOrState:

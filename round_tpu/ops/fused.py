@@ -40,8 +40,9 @@ mode); mode="hw" uses the hardware PRNG (the fast path).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -50,6 +51,26 @@ from jax.experimental.pallas import tpu as pltpu
 
 _GOLD = 0x9E3779B9
 _RMIX = 0x7FEB352D
+_COIN = 0x1B873593  # domain separator: lane-coin stream vs link stream
+
+
+def hash_coin(salt0, salt1, r, lane) -> jnp.ndarray:
+    """Deterministic fair coin per (scenario, lane, round) — the coin-flip
+    analogue of the link hash sampler (scenarios.link_bernoulli): murmur3
+    finalizer over (lane, round, scenario salts) with a distinct stream
+    constant so coins never correlate with link drops.
+
+    Used by BOTH engines (models.benor.BenOr(coin_salt=...) and the fused
+    path) so randomized algorithms get the same differential-parity story as
+    the masks.  Accepts scalars or arrays (broadcasts)."""
+    lane = jnp.asarray(lane).astype(jnp.uint32)
+    z = lane * jnp.uint32(_GOLD) + jnp.asarray(salt0).astype(jnp.uint32)
+    z = z ^ (
+        jnp.asarray(r).astype(jnp.uint32) * jnp.uint32(_RMIX)
+        + jnp.asarray(salt1).astype(jnp.uint32)
+        + jnp.uint32(_COIN)
+    )
+    return (_fmix32(z) & jnp.uint32(1)) == jnp.uint32(1)
 
 
 def _fmix32(z):
@@ -256,31 +277,242 @@ def _keep_mask(n, mode, salt0, salt1r, p8, notdiag):
     return keep & notdiag
 
 
-def _otr_kernel(
-    x0_ref, crashed_ref, side_ref,
-    crash_round_ref, heal_round_ref, rotate_ref, p8_ref,
-    salt0_ref, salt1_ref,
-    x_out, dec_out, decision_out, after_out, done_out, dround_out,
-    *,
-    num_values: int,
+class LoopAlgo:
+    """Algorithm plugin for the whole-run loop kernel (`hist_loop`).
+
+    A LoopAlgo describes one histogram-round algorithm as in-VMEM vector
+    code: per-lane state is a tuple of [n] vectors, each (sub)round's
+    mailbox arrives as the padded per-value counts matrix, and the kernel
+    template owns everything else — fault-mask derivation, the MXU count
+    matmul with the ones-row size trick, freeze/exit bookkeeping,
+    decided-round tracking.  Implementations must be frozen dataclasses
+    (hashable by config) so `hist_loop`'s jit cache keys on the config, not
+    the instance.
+
+    Contract (all methods are traced INSIDE the kernel):
+      init(x0)          -> tuple of [n] state vectors (int32 or bool)
+      payload(k, us)    -> [n] int32 in [0, num_values) for subround k
+                           (k is a static Python int)
+      update(r, k, us, counts, size, n, coin)
+                        -> (new_us, exit_ [n] bool); counts is the padded
+                           [v_pad, n] float32 matrix (exact integers; row
+                           `num_values` is the mailbox size, rows beyond are
+                           zero), size = counts[num_values].  `coin` is a
+                           [n] bool hash-coin vector when needs_coin, else
+                           None.  The TEMPLATE applies the active-lane
+                           freeze; update returns the unmasked new state.
+      decided_slot      -> index in the state tuple of the bool decided
+                           flag (drives decided_round bookkeeping).
+    """
+
+    num_values: int
+    phase_len: int = 1
+    needs_coin: bool = False
+    decided_slot: int = 1
+
+    def init(self, x0) -> Tuple[jnp.ndarray, ...]:
+        raise NotImplementedError
+
+    def payload(self, k: int, us) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def update(self, r, k: int, us, counts, size, n: int, coin):
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class OtrLoop(LoopAlgo):
+    """OTR's round as a LoopAlgo — same math as engine.fast.OtrHist
+    (Otr.scala:44-49 mmor/quorum), parity-pinned by tests/test_fast.py.
+    State: (x, decided, decision, after)."""
+
+    num_values: int = 16
+    after_decision: int = 2
+    phase_len: int = 1
+    needs_coin: bool = False
+    decided_slot: int = 1
+
+    def init(self, x0):
+        n = x0.shape[0]
+        return (
+            x0,
+            jnp.zeros((n,), dtype=bool),
+            jnp.full((n,), -1, jnp.int32),
+            jnp.full((n,), self.after_decision, jnp.int32),
+        )
+
+    def payload(self, k, us):
+        return us[0]
+
+    def update(self, r, k, us, counts, size, n, coin):
+        x, decided, decision, after = us
+        v_pad = counts.shape[0]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (v_pad, n), 0)
+        quorum_thr = jnp.float32((2 * n) // 3)
+        cvals = jnp.where(rows < self.num_values, counts, jnp.float32(-1.0))
+        bestc = jnp.max(cvals, axis=0)
+        bestv = jnp.min(
+            jnp.where(cvals == bestc[None, :], rows, self.num_values), axis=0
+        )
+        quorum = size > quorum_thr
+        superq = quorum & (bestc > quorum_thr)
+
+        newly = superq & ~decided
+        decided2 = decided | superq
+        decision2 = jnp.where(newly, bestv, decision)
+        after2 = jnp.where(decided2, after - 1, after)
+        exit_ = decided2 & (after2 <= 0)
+        x2 = jnp.where(quorum, bestv, x)
+        return (x2, decided2, decision2, after2), exit_
+
+
+@dataclasses.dataclass(frozen=True)
+class FloodMinLoop(LoopAlgo):
+    """FloodMin as a LoopAlgo (FloodMin.scala:22-33): fold min over the
+    mailbox each round, decide after round f.  The min over delivered values
+    falls out of the histogram: min{v : counts[v] > 0}.
+    State: (x, decided, decision)."""
+
+    num_values: int = 16
+    f: int = 2
+    phase_len: int = 1
+    needs_coin: bool = False
+    decided_slot: int = 1
+
+    def init(self, x0):
+        n = x0.shape[0]
+        return (
+            x0,
+            jnp.zeros((n,), dtype=bool),
+            jnp.full((n,), -1, jnp.int32),
+        )
+
+    def payload(self, k, us):
+        return us[0]
+
+    def update(self, r, k, us, counts, size, n, coin):
+        x, decided, decision = us
+        v_pad = counts.shape[0]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (v_pad, n), 0)
+        present = (rows < self.num_values) & (counts > 0)
+        xm = jnp.min(
+            jnp.where(present, rows, self.num_values), axis=0
+        )
+        x2 = jnp.minimum(x, xm)  # self-delivery already includes own x
+
+        deciding = jnp.broadcast_to(r > self.f, decided.shape)
+        newly = deciding & ~decided
+        decided2 = decided | deciding
+        decision2 = jnp.where(newly, x2, decision)
+        return (x2, decided2, decision2), deciding
+
+
+@dataclasses.dataclass(frozen=True)
+class BenOrLoop(LoopAlgo):
+    """Ben-Or as a LoopAlgo (BenOr.scala:11-88): two subrounds per phase.
+    Subround 0 broadcasts (x, canDecide) encoded as v = x + 2·can (domain
+    4); subround 1 broadcasts the vote encoded as v = vote + 1 (domain 3,
+    padded into the same 4-value histogram).  The coin is the deterministic
+    hash coin (`hash_coin`) — fair, iid per (scenario, lane, round), and
+    replayable in the general engine via BenOr(coin_salt=...), which is how
+    the differential parity tests pin this kernel.
+    State: (x, can, vote, decided, decision); x/can/decision are 0/1 int32
+    (the model's bools), vote is {-1, 0, 1}."""
+
+    num_values: int = 4
+    phase_len: int = 2
+    needs_coin: bool = True
+    decided_slot: int = 3
+
+    def init(self, x0):
+        n = x0.shape[0]
+        return (
+            x0,
+            jnp.zeros((n,), jnp.int32),
+            jnp.full((n,), -1, jnp.int32),
+            jnp.zeros((n,), dtype=bool),
+            jnp.zeros((n,), jnp.int32),
+        )
+
+    def payload(self, k, us):
+        if k == 0:
+            return us[0] + 2 * us[1]
+        return us[2] + 1
+
+    def update(self, r, k, us, counts, size, n, coin):
+        x, can, vote, decided, decision = us
+        half = jnp.float32(n // 2)
+        if k == 0:
+            t_cnt = counts[1] + counts[3]
+            f_cnt = counts[0] + counts[2]
+            t_dec = counts[3] > 0
+            f_dec = counts[2] > 0
+            vote_new = jnp.where(
+                (t_cnt > half) | t_dec,
+                jnp.int32(1),
+                jnp.where((f_cnt > half) | f_dec, jnp.int32(0), jnp.int32(-1)),
+            )
+            can_any = (counts[2] + counts[3]) > 0
+
+            deciding = can != 0
+            newly = deciding & ~decided
+            decided2 = decided | deciding
+            decision2 = jnp.where(newly, x, decision)
+            vote2 = jnp.where(deciding, vote, vote_new)
+            can2 = jnp.where(deciding, can, can_any.astype(jnp.int32))
+            return (x, can2, vote2, decided2, decision2), deciding
+        t = counts[2]
+        f = counts[1]
+        x2 = jnp.where(
+            t > half,
+            jnp.int32(1),
+            jnp.where(
+                f > half,
+                jnp.int32(0),
+                jnp.where(
+                    t > 1,
+                    jnp.int32(1),
+                    jnp.where(f > 1, jnp.int32(0), coin.astype(jnp.int32)),
+                ),
+            ),
+        )
+        can2 = ((t > half) | (f > half) | (can != 0)).astype(jnp.int32)
+        frozen = decided
+        x3 = jnp.where(frozen, x, x2)
+        can3 = jnp.where(frozen, can, can2)
+        no_exit = jnp.zeros_like(decided)
+        return (x3, can3, vote, decided, decision), no_exit
+
+
+def _loop_kernel(
+    *refs,
+    algo: LoopAlgo,
     v_pad: int,
     sb: int,
     rounds: int,
-    after_decision: int,
     mode: str,
 ):
-    """The flagship workload as ONE kernel: the whole `rounds`-round OTR run
-    for `sb` scenarios per grid step, state resident in VMEM.
+    """The whole-run kernel template: `rounds` rounds of any LoopAlgo for
+    `sb` scenarios per grid step, state resident in VMEM.
 
     This removes the per-round HBM round-trip of the counts tensor and the
     scan-carried [S, n] state (engine/fast.run_hist): per scenario the only
     HBM traffic is O(n) inputs and O(n) final state.  The per-round math is
-    identical to OtrHist.update_counts + run_hist's freeze semantics — the
-    differential tests pin it lane-for-lane to the general engine.
+    identical to the algo's HistRound counterpart + run_hist's freeze
+    semantics — the differential tests pin it lane-for-lane to the general
+    engine.
 
     The count matmul is augmented with a ones-row (row `num_values` of the
     onehot operand is the senders mask), so mailbox SIZE falls out of the
-    same MXU pass as the per-value counts."""
+    same MXU pass as the per-value counts.  Multi-subround algorithms
+    (phase_len > 1) dispatch on r % phase_len with lax.switch; every branch
+    shares the same matmul structure so the kernel stays one fused loop."""
+    x0_ref, crashed_ref, side_ref = refs[0:3]
+    (crash_round_ref, heal_round_ref, rotate_ref, p8_ref,
+     salt0_ref, salt1_ref) = refs[3:9]
+    outs = refs[9:]  # n_state outputs + done + dround, all int32
+    num_values = algo.num_values
+    K = algo.phase_len
     n = x0_ref.shape[1]
     b = pl.program_id(0)
     notdiag = jax.lax.broadcasted_iota(
@@ -288,7 +520,6 @@ def _otr_kernel(
     ) != jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
     rows = jax.lax.broadcasted_iota(jnp.int32, (v_pad, n), 0)
     lane_ids = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)[0]
-    quorum_thr = jnp.float32((2 * n) // 3)
 
     def per_scenario(s, _):
         g = b * sb + s
@@ -301,7 +532,7 @@ def _otr_kernel(
         period = jnp.maximum(rot, 1)
 
         def round_body(r, carry):
-            x, decided, decision, after, done, dround = carry
+            us, done, dround = carry[:-2], carry[-2], carry[-1]
             alive = ~(crashed & (r >= cr))
             victim = (r // period) % n
             rotated = (lane_ids == victim) & (rot > 0)
@@ -313,64 +544,118 @@ def _otr_kernel(
 
             keep = _keep_mask(n, mode, s0, salt1r, p8, notdiag)
             keep = keep & (side_r[:, None] == side_r[None, :])
-            # value indicator with the ones-row at row `num_values` (the
-            # mailbox-size trick): shared by the matmul operand and the
-            # self-delivery correction
-            oh = (x[None, :] == rows) | (rows == num_values)
-            counts = jnp.dot(
-                (oh & senders[None, :]).astype(jnp.bfloat16),
-                keep.astype(jnp.bfloat16),
-                preferred_element_type=jnp.float32,
+            coin = hash_coin(s0, s1, r, lane_ids) if algo.needs_coin else None
+
+            def body_k(k, us):
+                vals = algo.payload(k, us)
+                # value indicator with the ones-row at row `num_values` (the
+                # mailbox-size trick): shared by the matmul operand and the
+                # self-delivery correction
+                oh = (vals[None, :] == rows) | (rows == num_values)
+                counts = jnp.dot(
+                    (oh & senders[None, :]).astype(jnp.bfloat16),
+                    keep.astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32,
+                )
+                # self-delivery (ho | i == j): active lanes always hear
+                # themselves, independent of colmask/p8
+                counts = counts + (oh & active[None, :]).astype(jnp.float32)
+                size = counts[num_values]
+                return algo.update(r, k, us, counts, size, n, coin)
+
+            if K == 1:
+                us2, exit_ = body_k(0, us)
+            else:
+                us2, exit_ = jax.lax.switch(
+                    r % K,
+                    [functools.partial(body_k, k) for k in range(K)],
+                    us,
+                )
+            us = tuple(
+                jnp.where(active, a2, a) for a2, a in zip(us2, us)
             )
-            # self-delivery (ho | i == j): active lanes always hear
-            # themselves, independent of colmask/p8
-            counts = counts + (oh & active[None, :]).astype(jnp.float32)
-
-            size = counts[num_values]
-            cvals = jnp.where(rows < num_values, counts,
-                              jnp.float32(-1.0))
-            bestc = jnp.max(cvals, axis=0)
-            bestv = jnp.min(
-                jnp.where(cvals == bestc[None, :], rows, num_values), axis=0
-            )
-            quorum = size > quorum_thr
-            superq = quorum & (bestc > quorum_thr)
-
-            newly = superq & ~decided
-            decided2 = decided | superq
-            decision2 = jnp.where(newly, bestv, decision)
-            after2 = jnp.where(decided2, after - 1, after)
-            exit_ = decided2 & (after2 <= 0)
-            x2 = jnp.where(quorum, bestv, x)
-
-            x = jnp.where(active, x2, x)
-            decided = jnp.where(active, decided2, decided)
-            decision = jnp.where(active, decision2, decision)
-            after = jnp.where(active, after2, after)
             done = done | (active & exit_)
+            decided = us[algo.decided_slot]
             dround = jnp.where(decided & (dround < 0), r, dround)
-            return x, decided, decision, after, done, dround
+            return (*us, done, dround)
 
-        init = (
-            x0,
-            jnp.zeros((n,), dtype=bool),
-            jnp.full((n,), -1, jnp.int32),
-            jnp.full((n,), after_decision, jnp.int32),
+        init = algo.init(x0) + (
             jnp.zeros((n,), dtype=bool),
             jnp.full((n,), -1, jnp.int32),
         )
-        x, decided, decision, after, done, dround = jax.lax.fori_loop(
-            0, rounds, round_body, init
-        )
-        x_out[s] = x
-        dec_out[s] = decided.astype(jnp.int32)
-        decision_out[s] = decision
-        after_out[s] = after
-        done_out[s] = done.astype(jnp.int32)
-        dround_out[s] = dround
+        final = jax.lax.fori_loop(0, rounds, round_body, init)
+        for i, a in enumerate(final):
+            outs[i][s] = a.astype(jnp.int32)
         return 0
 
     jax.lax.fori_loop(0, sb, per_scenario, 0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("algo", "rounds", "mode", "sb", "interpret"),
+)
+def hist_loop(
+    algo: LoopAlgo,
+    x0: jnp.ndarray,        # [S, n] int32 initial per-lane input
+    crashed: jnp.ndarray,   # [S, n] bool
+    side: jnp.ndarray,      # [S, n] int32
+    crash_round: jnp.ndarray,   # [S] int32
+    heal_round: jnp.ndarray,    # [S] int32
+    rotate_down: jnp.ndarray,   # [S] int32
+    p8: jnp.ndarray,            # [S] int32
+    salt0: jnp.ndarray,         # [S] int32
+    salt1: jnp.ndarray,         # [S] int32 (UNmixed; rounds premix in-kernel)
+    rounds: int,
+    mode: str = "hw",
+    sb: int = 8,
+    interpret: bool = False,
+):
+    """Run a whole LoopAlgo workload in one Pallas kernel.
+
+    Returns (state_arrays, done, decided_round): state_arrays is the algo's
+    state tuple as [S, n] int32 (bool slots as 0/1), done [S, n] bool,
+    decided_round [S, n] int32.  Mask/update semantics are bit-identical to
+    run_hist on the algo's HistRound counterpart with the same FaultMix in
+    the same mode — pinned by tests/test_fast.py."""
+    S, n = x0.shape
+    orig_S = S
+    (x0, crashed, side, crash_round, heal_round, rotate_down, p8, salt0,
+     salt1), S = _pad_scenarios(
+        sb, x0, crashed, side, crash_round, heal_round, rotate_down, p8,
+        salt0, salt1,
+    )
+    v_pad = algo.num_values + 1
+    if v_pad % 8 and not interpret:
+        v_pad += 8 - v_pad % 8
+    n_state = len(algo.init(jnp.zeros((n,), jnp.int32)))
+
+    grid = (S // sb,)
+    blk = pl.BlockSpec((sb, n), lambda b: (b, 0))
+    smem = pl.BlockSpec((S,), lambda b: (0,), memory_space=pltpu.SMEM)
+    kernel = functools.partial(
+        _loop_kernel, algo=algo, v_pad=v_pad, sb=sb, rounds=rounds, mode=mode,
+    )
+    n_out = n_state + 2
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[blk, blk, blk] + [smem] * 6,
+        out_specs=[blk] * n_out,
+        out_shape=[jax.ShapeDtypeStruct((S, n), jnp.int32)] * n_out,
+        interpret=interpret,
+    )(
+        x0.astype(jnp.int32), crashed.astype(jnp.int32),
+        side.astype(jnp.int32), crash_round.astype(jnp.int32),
+        heal_round.astype(jnp.int32), rotate_down.astype(jnp.int32),
+        p8.astype(jnp.int32), salt0.astype(jnp.int32),
+        salt1.astype(jnp.int32),
+    )
+    outs = [o[:orig_S] for o in outs]
+    state_arrays = tuple(outs[:n_state])
+    done = outs[n_state].astype(bool)
+    dround = outs[n_state + 1]
+    return state_arrays, done, dround
 
 
 @functools.partial(
@@ -395,46 +680,20 @@ def otr_loop(
     sb: int = 8,
     interpret: bool = False,
 ):
-    """Run the whole OTR flagship workload in one Pallas kernel.
+    """Run the whole OTR flagship workload in one Pallas kernel (the OtrLoop
+    instance of `hist_loop`; the historical entry point — bench.py's
+    --engine loop).
 
     Returns (x, decided, decision, after, done, decided_round), each [S, n]
     (decided/done as bool).  Mask/update semantics are bit-identical to
     run_hist(OtrHist(...), ...) with the same FaultMix in the same mode —
     pinned by tests/test_fast.py::test_otr_loop_parity."""
-    S, n = x0.shape
-    orig_S = S
-    (x0, crashed, side, crash_round, heal_round, rotate_down, p8, salt0,
-     salt1), S = _pad_scenarios(
-        sb, x0, crashed, side, crash_round, heal_round, rotate_down, p8,
-        salt0, salt1,
+    algo = OtrLoop(num_values=num_values, after_decision=after_decision)
+    (x, dec, decision, after), done, dround = hist_loop(
+        algo, x0, crashed, side, crash_round, heal_round, rotate_down, p8,
+        salt0, salt1, rounds=rounds, mode=mode, sb=sb, interpret=interpret,
     )
-    v_pad = num_values + 1
-    if v_pad % 8 and not interpret:
-        v_pad += 8 - v_pad % 8
-
-    grid = (S // sb,)
-    blk = pl.BlockSpec((sb, n), lambda b: (b, 0))
-    smem = pl.BlockSpec((S,), lambda b: (0,), memory_space=pltpu.SMEM)
-    kernel = functools.partial(
-        _otr_kernel, num_values=num_values, v_pad=v_pad, sb=sb,
-        rounds=rounds, after_decision=after_decision, mode=mode,
-    )
-    outs = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[blk, blk, blk] + [smem] * 6,
-        out_specs=[blk] * 6,
-        out_shape=[jax.ShapeDtypeStruct((S, n), jnp.int32)] * 6,
-        interpret=interpret,
-    )(
-        x0.astype(jnp.int32), crashed.astype(jnp.int32),
-        side.astype(jnp.int32), crash_round.astype(jnp.int32),
-        heal_round.astype(jnp.int32), rotate_down.astype(jnp.int32),
-        p8.astype(jnp.int32), salt0.astype(jnp.int32),
-        salt1.astype(jnp.int32),
-    )
-    x, dec, decision, after, done, dround = [o[:orig_S] for o in outs]
-    return (x, dec.astype(bool), decision, after, done.astype(bool), dround)
+    return (x, dec.astype(bool), decision, after, done, dround)
 
 
 def hist_exchange_reference(

@@ -85,23 +85,12 @@ def test_fast_otr_parity_vs_general_engine():
 
     algo = OTR(after_decision=2, n_values=V)
     for s in range(S):
-        sampler = scenarios.from_fault_params(
-            n,
-            mix.crashed[s],
-            mix.crash_round[s],
-            mix.side[s],
-            mix.heal_round[s],
-            mix.rotate_down[s],
-            mix.p8[s],
-            mix.salt0[s],
-            mix.salt1[s],
-        )
         res = run_instance(
             algo,
             consensus_io(init_vals),
             n,
             jax.random.fold_in(key, 1000 + s),
-            sampler,
+            scenarios.from_mix_row(mix, s),
             max_phases=rounds,
         )
         np.testing.assert_array_equal(
@@ -218,3 +207,167 @@ def test_otr_loop_padding_and_blackout():
         np.asarray(state2.decision), np.asarray(state.decision))
     np.testing.assert_array_equal(np.asarray(dround2), np.asarray(dround))
     np.testing.assert_array_equal(np.asarray(done2), np.asarray(done))
+
+
+def _floodmin_state0(S_, n, init_vals):
+    from round_tpu.models.floodmin import FloodMinState
+
+    return FloodMinState(
+        x=jnp.broadcast_to(init_vals, (S_, n)).astype(jnp.int32),
+        decided=jnp.zeros((S_, n), dtype=bool),
+        decision=jnp.full((S_, n), -1, dtype=jnp.int32),
+    )
+
+
+def _benor_state0(S_, n, init_bits):
+    from round_tpu.models.benor import BenOrState
+
+    return BenOrState(
+        x=jnp.broadcast_to(init_bits, (S_, n)).astype(bool),
+        can_decide=jnp.zeros((S_, n), dtype=bool),
+        vote=jnp.full((S_, n), -1, dtype=jnp.int32),
+        decided=jnp.zeros((S_, n), dtype=bool),
+        decision=jnp.zeros((S_, n), dtype=bool),
+    )
+
+
+def _replay_scenario(mix, s, n):
+    return scenarios.from_mix_row(mix, s)
+
+
+def test_fast_floodmin_parity_vs_general_engine():
+    """FloodMinHist (fused path) is lane-exact vs models.floodmin.FloodMin
+    run through the general engine on the same FaultMix rows — crash,
+    omission and partition families included."""
+    from round_tpu.models.floodmin import FloodMin
+
+    n, f = N, 3
+    rounds = f + 2
+    key = jax.random.PRNGKey(21)
+    mix = fast.standard_mix(key, S, n, p_drop=0.1, f=f, crash_round=1)
+    init_vals = jax.random.randint(
+        jax.random.fold_in(key, 2), (n,), 0, V, dtype=jnp.int32
+    )
+    rnd = fast.FloodMinHist(n_values=V, f=f)
+    state, done, dround = fast.run_hist(
+        rnd, _floodmin_state0(S, n, init_vals), lambda s: s.decided, mix,
+        max_rounds=rounds, mode="hash", interpret=True,
+    )
+
+    algo = FloodMin(f)
+    for s in range(S):
+        res = run_instance(
+            algo, consensus_io(init_vals), n,
+            jax.random.fold_in(key, 500 + s), _replay_scenario(mix, s, n),
+            max_phases=rounds,
+        )
+        for name, got, want in [
+            ("x", state.x[s], res.state.x),
+            ("decided", state.decided[s], res.state.decided),
+            ("decision", state.decision[s], res.state.decision),
+            ("decided_round", dround[s], res.decided_round),
+            ("done", done[s], res.done),
+        ]:
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(want),
+                err_msg=f"floodmin {name} mismatch, scenario {s}",
+            )
+
+
+def test_fast_benor_parity_vs_general_engine():
+    """BenOrHist (fused path, 2 subrounds/phase + hash coin) is lane-exact
+    vs models.benor.BenOr(coin_salt=...) through the general engine on the
+    same FaultMix rows — randomized consensus with a replayable coin."""
+    from round_tpu.models.benor import BenOr
+
+    n, phases = N, 6
+    rounds = 2 * phases
+    key = jax.random.PRNGKey(33)
+    mix = fast.standard_mix(key, S, n, p_drop=0.08, f=3, crash_round=1)
+    init_bits = (jnp.arange(n) % 2).astype(bool)
+    rnd = fast.BenOrHist()
+    state, done, dround = fast.run_hist(
+        rnd, _benor_state0(S, n, init_bits), lambda s: s.decided, mix,
+        max_rounds=rounds, mode="hash", interpret=True,
+    )
+
+    for s in range(S):
+        algo = BenOr(
+            coin_salt=(int(mix.salt0[s]), int(mix.salt1[s]))
+        )
+        res = run_instance(
+            algo, consensus_io(init_bits), n,
+            jax.random.fold_in(key, 700 + s), _replay_scenario(mix, s, n),
+            max_phases=phases,
+        )
+        for name, got, want in [
+            ("x", state.x[s], res.state.x),
+            ("can", state.can_decide[s], res.state.can_decide),
+            ("vote", state.vote[s], res.state.vote),
+            ("decided", state.decided[s], res.state.decided),
+            ("decision", state.decision[s], res.state.decision),
+            ("decided_round", dround[s], res.decided_round),
+            ("done", done[s], res.done),
+        ]:
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(want),
+                err_msg=f"benor {name} mismatch, scenario {s}",
+            )
+
+
+def test_floodmin_loop_parity_vs_run_hist():
+    """The FloodMin whole-run kernel == run_hist(FloodMinHist) lane-for-lane
+    (every output) on a mixed-fault batch."""
+    n, f = N, 3
+    rounds = f + 2
+    key = jax.random.PRNGKey(5)
+    mix = fast.standard_mix(key, S, n, p_drop=0.12, f=f, crash_round=1)
+    init_vals = jax.random.randint(
+        jax.random.fold_in(key, 4), (n,), 0, V, dtype=jnp.int32
+    )
+    rnd = fast.FloodMinHist(n_values=V, f=f)
+    state, done, dround = fast.run_hist(
+        rnd, _floodmin_state0(S, n, init_vals), lambda s: s.decided, mix,
+        max_rounds=rounds, mode="hash", interpret=True,
+    )
+    state2, done2, dround2 = fast.run_floodmin_loop(
+        rnd, _floodmin_state0(S, n, init_vals), mix,
+        max_rounds=rounds, mode="hash", sb=5, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(state2.x), np.asarray(state.x))
+    np.testing.assert_array_equal(
+        np.asarray(state2.decided), np.asarray(state.decided))
+    np.testing.assert_array_equal(
+        np.asarray(state2.decision), np.asarray(state.decision))
+    np.testing.assert_array_equal(np.asarray(done2), np.asarray(done))
+    np.testing.assert_array_equal(np.asarray(dround2), np.asarray(dround))
+
+
+def test_benor_loop_parity_vs_run_hist():
+    """The Ben-Or whole-run kernel (in-kernel subround switch + hash coin)
+    == run_hist(BenOrHist) lane-for-lane on a mixed-fault batch."""
+    n, phases = N, 6
+    rounds = 2 * phases
+    key = jax.random.PRNGKey(17)
+    mix = fast.standard_mix(key, S, n, p_drop=0.1, f=3, crash_round=1)
+    init_bits = (jnp.arange(n) % 2).astype(bool)
+    rnd = fast.BenOrHist()
+    state, done, dround = fast.run_hist(
+        rnd, _benor_state0(S, n, init_bits), lambda s: s.decided, mix,
+        max_rounds=rounds, mode="hash", interpret=True,
+    )
+    state2, done2, dround2 = fast.run_benor_loop(
+        rnd, _benor_state0(S, n, init_bits), mix,
+        max_rounds=rounds, mode="hash", sb=4, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(state2.x), np.asarray(state.x))
+    np.testing.assert_array_equal(
+        np.asarray(state2.can_decide), np.asarray(state.can_decide))
+    np.testing.assert_array_equal(
+        np.asarray(state2.vote), np.asarray(state.vote))
+    np.testing.assert_array_equal(
+        np.asarray(state2.decided), np.asarray(state.decided))
+    np.testing.assert_array_equal(
+        np.asarray(state2.decision), np.asarray(state.decision))
+    np.testing.assert_array_equal(np.asarray(done2), np.asarray(done))
+    np.testing.assert_array_equal(np.asarray(dround2), np.asarray(dround))

@@ -262,14 +262,32 @@ def test_ladder_first_rung_smoke():
 
 
 def test_ladder_floodmin_rung_smoke():
-    """Second rung (FloodMin n=64 x 256 crash draws) end-to-end on CPU with
-    property parity — the ladder's fault-family plumbing."""
+    """Second rung (FloodMin on the FUSED path, crash draws) end-to-end on
+    CPU: loop kernel timed, lane-exact differential parity vs the general
+    engine, crash-tolerant agreement/validity."""
     from round_tpu.apps.ladder import rung_floodmin
 
-    r = rung_floodmin(repeats=1)
-    assert r["metric"] == "ladder_floodmin_n64"
+    r = rung_floodmin(repeats=1, n=16, S=24)
+    assert r["metric"] == "ladder_floodmin_n16"
+    assert r["extra"]["engine"] == "loop"
+    assert r["extra"]["parity_frac"] == 1.0
     assert r["extra"]["property_parity"] is True
     assert r["extra"]["frac_lanes_decided"] == 1.0
+
+
+def test_ladder_benor_rung_smoke():
+    """Fourth rung (Ben-Or on the FUSED path, omission family) end-to-end on
+    CPU: loop kernel timed, lane-exact differential parity (masks AND hash
+    coins) vs the general engine, agreement across scenarios."""
+    from round_tpu.apps.ladder import rung_benor
+
+    r = rung_benor(repeats=1, n=16, S=16)
+    assert r["metric"] == "ladder_benor_n16"
+    assert r["extra"]["engine"] == "loop"
+    assert r["extra"]["parity_frac"] == 1.0
+    assert r["extra"]["agreement_parity"] is True
+    assert r["extra"]["invariant_parity"] is True
+    assert r["extra"]["property_parity"] is True
 
 
 def test_ladder_lv_rung_smoke():
