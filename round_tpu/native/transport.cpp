@@ -108,6 +108,9 @@ struct Node {
   std::deque<Msg> inbox;
   size_t max_inbox = 1 << 16;     // drop + count when full (bufferSize
   size_t dropped = 0;             // semantics, InstanceHandler.scala:85-90)
+  static constexpr uint32_t kMaxFrame = 64u << 20;  // sane frame-size cap:
+                                  // a larger claimed len closes the
+                                  // connection (protocol violation)
   bool recv_stopped = false;      // recv returns -3 once stopped, so
                                   // blocked receiver threads can unwind
                                   // BEFORE the node is destroyed
@@ -155,9 +158,11 @@ struct Node {
     inbox_cv.notify_one();
   }
 
-  // parse as many complete frames as rbuf holds
-  void drain(Conn &c) {
+  // parse as many complete frames as rbuf holds; false = protocol
+  // violation, the caller must close the connection
+  bool drain(Conn &c) {
     size_t off = 0;
+    bool ok = true;
     for (;;) {
       if (!c.handshaked) {
         if (c.rbuf.size() - off < 4) break;
@@ -172,7 +177,14 @@ struct Node {
       }
       if (c.rbuf.size() - off < 4) break;
       uint32_t len = get_u32(c.rbuf.data() + off);
-      if (c.rbuf.size() - off < 4 + len) break;
+      // cap the claimed frame size: the listen port is unauthenticated,
+      // and an unbounded len would buffer rbuf without limit (advisor r02,
+      // medium)
+      if (len > kMaxFrame) { ok = false; break; }
+      // size_t-widen before the addition: `4 + len` in 32-bit wraps for
+      // len >= 0xFFFFFFFC and would pass this check while the 64-bit
+      // iterator math below overruns rbuf (advisor r02, medium)
+      if (c.rbuf.size() - off < 4 + static_cast<size_t>(len)) break;
       if (len < 8) { off += 4 + len; continue; }  // malformed: skip frame
       Msg m;
       m.from = c.peer;
@@ -183,6 +195,7 @@ struct Node {
       off += 4 + len;
     }
     if (off > 0) c.rbuf.erase(c.rbuf.begin(), c.rbuf.begin() + off);
+    return ok;
   }
 
   void loop_body() {
@@ -227,7 +240,12 @@ struct Node {
         if (!(pfds[2 + k].revents & (POLLIN | POLLHUP | POLLERR))) continue;
         auto &c = snapshot[k];
         ssize_t got = recv(c->fd, tmp.data(), tmp.size(), 0);
-        if (got <= 0) {
+        bool healthy = got > 0;
+        if (healthy) {
+          c->rbuf.insert(c->rbuf.end(), tmp.data(), tmp.data() + got);
+          healthy = drain(*c);  // false: frame-size protocol violation
+        }
+        if (!healthy) {
           {
             // exclude senders mid-write before closing: otherwise the fd
             // number can be reused by a new accept and write_all would
@@ -243,8 +261,6 @@ struct Node {
           }
           continue;
         }
-        c->rbuf.insert(c->rbuf.end(), tmp.data(), tmp.data() + got);
-        drain(*c);
       }
       // compact closed connections
       std::lock_guard<std::mutex> l(mu);

@@ -121,9 +121,12 @@ class HostTransport:
         if n == -3:  # node stopped: no more messages will ever arrive
             self.closed = True
             return None
-        if n == -2:  # grow and retry (message stays queued)
+        if n == -2:  # grow and retry (message stays queued, so retry with
+            # timeout 0: it is returned immediately — a full-timeout retry
+            # would let one logical recv block up to 2x the requested
+            # deadline and skew HostRunner's round accounting)
             self._buf = ctypes.create_string_buffer(len(self._buf) * 4)
-            return self.recv(timeout_ms)
+            return self.recv(0)
         tag = Tag.unpack(_to_signed64(tagw.value))
         # string_at copies exactly n bytes (.raw would copy the whole buffer)
         return from_id.value, tag, ctypes.string_at(self._buf, n)

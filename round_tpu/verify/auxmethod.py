@@ -23,9 +23,52 @@ helper as usual.
 from __future__ import annotations
 
 import dataclasses
+import functools
+import warnings
 from typing import Callable, Dict, Optional
 
 import jax
+
+
+def _contract_src(pre, post) -> tuple:
+    """Comparable identity of a (pre, post) pair: bytecode + names +
+    captured values (line numbers excluded, so the same lambda re-defined
+    on a different line still counts as the same contract; closure cells
+    and defaults included, so a contract change routed through a captured
+    variable is still detected)."""
+
+    def ident(x):
+        if callable(x):
+            return one(x)
+        try:
+            return repr(x)
+        except Exception:  # noqa: BLE001 - identity only, never raise
+            return type(x).__name__
+
+    def one(f):
+        if f is None:
+            return None
+        if isinstance(f, functools.partial):
+            return ("partial", one(f.func), tuple(ident(a) for a in f.args),
+                    tuple(sorted((k, ident(v)) for k, v in f.keywords.items())))
+        try:
+            c = f.__code__
+        except AttributeError:
+            # exotic callable: same type counts as same contract (avoids
+            # spurious warnings on every reload; changes inside such
+            # objects are invisible to this check)
+            return ("obj", type(f).__module__, type(f).__qualname__)
+        consts = tuple(
+            x.co_code if hasattr(x, "co_code") else x for x in c.co_consts
+        )
+        closure = tuple(
+            ident(cell.cell_contents) for cell in (f.__closure__ or ())
+        )
+        defaults = tuple(ident(d) for d in (f.__defaults__ or ()))
+        return (c.co_code, c.co_names, c.co_varnames, consts, closure,
+                defaults)
+
+    return (one(pre), one(post))
 
 
 # the traced-name prefix marking aux boundaries: user jit functions cannot
@@ -69,6 +112,18 @@ def aux_method(pre: Optional[Callable] = None,
                 f"aux method name {nm!r} already registered by "
                 f"{prev.fn_qualname}; pass an explicit name= to "
                 "disambiguate"
+            )
+        if prev is not None and _contract_src(prev.pre, prev.post) != \
+                _contract_src(pre, post):
+            # tolerated re-registration, but the CONTRACT changed: formulas
+            # extracted before the reload baked in the old pre/post, so a
+            # weaker replacement could silently supersede obligations
+            # already assumed elsewhere (advisor r02)
+            warnings.warn(
+                f"aux method {nm!r} re-registered with a different pre/post "
+                "contract; formulas extracted earlier used the previous one "
+                "— re-run extraction",
+                stacklevel=3,
             )
         REGISTRY[nm] = AuxSpec(name=nm, pre=pre, post=post,
                                fn_qualname=qual)

@@ -109,3 +109,40 @@ def test_aux_obligations_cannot_be_dropped():
             [Scalar(Variable("a", Int)), Scalar(Variable("b", Int))],
             lambda i: Literal(True), return_axioms=True,
         )
+
+
+def test_aux_reregistration_contract_change_warns():
+    """Same-qualname re-registration with a CHANGED pre/post (module reload
+    with an edited contract) must warn — earlier extractions baked in the
+    old contract (advisor r02)."""
+    import warnings
+
+    import pytest
+
+    from round_tpu.verify import auxmethod
+
+    def helper(a):
+        return a
+
+    try:
+        deco = aux_method(post=lambda r, a: Geq(r, a), name="rereg_t")
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            deco(helper)                 # first registration: silent
+            aux_method(post=lambda r, a: Geq(r, a), name="rereg_t")(helper)
+            # identical contract re-registration: still silent
+        with pytest.warns(UserWarning, match="different pre/post"):
+            aux_method(post=lambda r, a: Gt(r, a), name="rereg_t")(helper)
+        # a contract change hidden in a CLOSURE cell must also warn
+        def mk(bound):
+            return lambda r, a: Geq(r, IntLit(bound))
+
+        with pytest.warns(UserWarning, match="different pre/post"):
+            aux_method(post=mk(5), name="rereg_t")(helper)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            aux_method(post=mk(5), name="rereg_t")(helper)  # same bound: silent
+        with pytest.warns(UserWarning, match="different pre/post"):
+            aux_method(post=mk(6), name="rereg_t")(helper)
+    finally:
+        auxmethod.REGISTRY.pop("rereg_t", None)

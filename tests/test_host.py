@@ -53,6 +53,33 @@ def test_transport_unreachable_peer_and_timeout():
         assert a.recv(50) is None  # clean timeout
 
 
+def test_transport_malicious_frame_length():
+    """A frame header claiming a huge length (advisor r02: 32-bit wrap at
+    len >= 0xFFFFFFFC, and unbounded buffering below that) must close the
+    offending connection as a protocol violation — not crash the node or
+    buffer without limit — and the node must keep serving honest peers."""
+    with HostTransport(0) as a, HostTransport(1) as b:
+        a.add_peer(1, "127.0.0.1", b.port)
+        # raw attacker socket straight at b's unauthenticated listen port
+        evil = socket.create_connection(("127.0.0.1", b.port))
+        try:
+            evil.sendall((99).to_bytes(4, "big"))       # handshake id
+            evil.sendall((0xFFFFFFFE).to_bytes(4, "big"))  # wrapping len
+            # the node closes the connection on the violation (FIN, or RST
+            # if bytes were still in flight)
+            evil.settimeout(5)
+            try:
+                assert evil.recv(1) == b""
+            except ConnectionResetError:
+                pass
+        finally:
+            evil.close()
+        # honest traffic still flows
+        assert a.send(1, Tag(instance=3), b"still-alive")
+        got = b.recv(2000)
+        assert got is not None and got[2] == b"still-alive"
+
+
 def test_transport_large_payload():
     with HostTransport(0) as a, HostTransport(1) as b:
         a.add_peer(1, "127.0.0.1", b.port)
