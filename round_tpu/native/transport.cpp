@@ -320,6 +320,9 @@ struct Node {
   }
 
   bool send_msg(int peer, uint64_t tag, const uint8_t *payload, int len) {
+    // mirror the receiver's frame cap: an oversized frame would report
+    // send success while the peer severs the link as a protocol violation
+    if (len < 0 || static_cast<uint32_t>(len) > kMaxFrame - 8) return false;
     auto c = connect_to(peer);
     if (!c) return false;
     std::vector<uint8_t> frame;

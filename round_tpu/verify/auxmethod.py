@@ -37,34 +37,60 @@ def _contract_src(pre, post) -> tuple:
     and defaults included, so a contract change routed through a captured
     variable is still detected)."""
 
-    def ident(x):
-        if callable(x):
-            return one(x)
-        try:
-            return repr(x)
-        except Exception:  # noqa: BLE001 - identity only, never raise
-            return type(x).__name__
+    seen: set = set()
 
-    def one(f):
+    def type_key(x):
+        return ("obj", type(x).__module__, type(x).__qualname__)
+
+    def ident(x, depth):
+        if callable(x):
+            return one(x, depth)
+        try:
+            r = repr(x)
+        except Exception:  # noqa: BLE001 - identity only, never raise
+            return type_key(x)
+        if " object at 0x" in r:
+            # default object repr embeds the address: compares unequal on
+            # every reload — fall back to type identity (same tradeoff as
+            # exotic callables below)
+            return type_key(x)
+        return r
+
+    def one(f, depth=0):
         if f is None:
             return None
+        if depth > 8 or id(f) in seen:
+            # cycle (e.g. a self-recursive helper captured in a closure
+            # cell) or pathological nesting: stop at type identity
+            return type_key(f)
+        seen.add(id(f))
         if isinstance(f, functools.partial):
-            return ("partial", one(f.func), tuple(ident(a) for a in f.args),
-                    tuple(sorted((k, ident(v)) for k, v in f.keywords.items())))
+            return (
+                "partial", one(f.func, depth + 1),
+                tuple(ident(a, depth + 1) for a in f.args),
+                tuple(sorted(
+                    (k, ident(v, depth + 1)) for k, v in f.keywords.items()
+                )),
+            )
         try:
             c = f.__code__
         except AttributeError:
             # exotic callable: same type counts as same contract (avoids
             # spurious warnings on every reload; changes inside such
             # objects are invisible to this check)
-            return ("obj", type(f).__module__, type(f).__qualname__)
+            return type_key(f)
         consts = tuple(
             x.co_code if hasattr(x, "co_code") else x for x in c.co_consts
         )
-        closure = tuple(
-            ident(cell.cell_contents) for cell in (f.__closure__ or ())
-        )
-        defaults = tuple(ident(d) for d in (f.__defaults__ or ()))
+
+        def cell_val(cell):
+            try:
+                return ident(cell.cell_contents, depth + 1)
+            except ValueError:  # empty cell
+                return "<empty-cell>"
+
+        closure = tuple(cell_val(cell) for cell in (f.__closure__ or ()))
+        defaults = tuple(ident(d, depth + 1) for d in (f.__defaults__ or ()))
         return (c.co_code, c.co_names, c.co_varnames, consts, closure,
                 defaults)
 
