@@ -23,6 +23,10 @@ def select(name: str, options: Optional[Dict[str, Any]] = None) -> Algorithm:
         from round_tpu.models.lastvoting import LastVoting
 
         return LastVoting()
+    if name in ("lve", "lastvotingevent"):
+        from round_tpu.models.lastvoting_event import LastVotingEvent
+
+        return LastVotingEvent()
     if name in ("slv", "short"):
         from round_tpu.models.lastvoting_variants import ShortLastVoting
 
@@ -49,5 +53,5 @@ def select(name: str, options: Optional[Dict[str, Any]] = None) -> Algorithm:
         return TwoPhaseCommit()
     raise ValueError(
         f"unknown algorithm {name!r} "
-        "(expected otr|lv|slv|mlv|benor|floodmin|kset|tpc)"
+        "(expected otr|lv|lve|slv|mlv|benor|floodmin|kset|tpc)"
     )

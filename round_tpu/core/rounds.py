@@ -215,6 +215,15 @@ class FoldRound(Round):
         return state
 
     def update(self, ctx: RoundCtx, state, mailbox):
+        m, count = self.fold(ctx, state, mailbox)
+        go = self.go_ahead(ctx, state, m, count)
+        return self.post(ctx, state, m, count, jnp.logical_not(go))
+
+    def fold(self, ctx: RoundCtx, state, mailbox):
+        """The masked O(log n) reduction alone: (m, count).  Exposed so the
+        host runtime can probe ``go_ahead`` after each arriving message
+        (the reference's per-receive Progress, InstanceHandler.scala:383-400)
+        without running ``post``."""
         from round_tpu.utils.tree import tree_where  # local: avoid cycle
 
         n = mailbox.n
@@ -254,5 +263,4 @@ class FoldRound(Round):
             size = size // 2
         m = jax.tree_util.tree_map(lambda x: x[0], elems)
         count = mailbox.size()
-        go = self.go_ahead(ctx, state, m, count)
-        return self.post(ctx, state, m, count, jnp.logical_not(go))
+        return m, count

@@ -12,15 +12,34 @@ simulator vmaps them over [scenario, lane] axes on the chip.  That is the
 framework's deployment story: simulate at scale on TPU, deploy the
 identical protocol code process-per-replica.
 
-Round discipline (benign model):
+Round discipline (benign model, full Progress semantics —
+InstanceHandler.scala:164-353):
   * send: evaluate SendSpec, unicast payload bytes per selected dest
     (self-delivery short-circuits the wire, Round.scala:114-117);
-  * accumulate: block on the transport inbox until every live peer was
-    heard or the round timeout fires (Progress.timeout,
-    InstanceHandler.scala:197-245);
+  * accumulate: honor the round's Progress policy (core/progress.py):
+      - Timeout(ms): block until goAhead or the deadline; STRICT additionally
+        refuses round-skew catch-up until the deadline;
+      - WaitForMessage: no deadline — only goAhead (or, non-strict,
+        catch-up) ends the round;
+      - Sync(k): block until k processes are observed at >= this round
+        (the benign form of the byzantine synchronizer barrier,
+        InstanceHandler.scala:277-287);
+      - GoAhead: the round ends after delivering pending messages.
+    goAhead = expected_nbr_messages reached (plain rounds,
+    Round.scala:60-66) or the per-receive go_ahead probe (FoldRound) —
+    the fine-grained control LastVotingEvent uses;
+  * benign catch-up (InstanceHandler.scala:289-301): the max round observed
+    from any peer pulls this replica forward — skewed rounds fast-forward
+    one at a time (send, deliver pending, update with didTimeout) without
+    burning their timeouts;
   * early messages for future rounds are buffered, late ones dropped
     (the pendingMessages priority queue role, InstanceHandler.scala:68-72);
   * update: fold the mailbox; `exitAtEndOfRound` ends the run.
+
+Deviation from the reference: a WaitForMessage/Sync round that makes no
+progress for `wait_cap_ms` (default 30 s) is force-timed-out with a warning
+— the reference blocks forever (buffer.take()), which an unattended
+deployment of THIS framework must not.
 
 Payloads cross the wire pickled (the Kryo role; same trust model as the
 reference — replicas deserialize only from their own group).
@@ -37,7 +56,8 @@ import jax
 import numpy as np
 
 from round_tpu.core.algorithm import Algorithm
-from round_tpu.core.rounds import RoundCtx
+from round_tpu.core.progress import Progress
+from round_tpu.core.rounds import FoldRound, Round, RoundCtx
 from round_tpu.ops.mailbox import Mailbox
 from round_tpu.runtime.log import get_logger
 from round_tpu.runtime.oob import FLAG_NORMAL, Message, Tag
@@ -122,6 +142,7 @@ class HostRunner:
         default_handler=None,
         foreign=None,
         prefill: Optional[Dict[int, Dict[int, Any]]] = None,
+        wait_cap_ms: int = 30_000,
     ):
         self.algo = algo
         self.id = my_id
@@ -129,6 +150,7 @@ class HostRunner:
         self.transport = transport
         self.instance_id = instance_id & 0xFFFF
         self.timeout_ms = timeout_ms
+        self.wait_cap_ms = wait_cap_ms
         self.seed = seed
         self.default_handler = default_handler
         # sink for NORMAL messages of other instances: a consecutive-
@@ -151,14 +173,21 @@ class HostRunner:
         return RoundCtx(id=np.int32(self.id), n=self.n, r=np.int32(r))
 
     def _round_fns(self, rnd):
-        """Jitted (pre+send, update) for one Round at this group size —
-        eager per-op dispatch (including the per-round PRNG fold-in)
+        """Jitted (pre+send, update, go-probe) for one Round at this group
+        size — eager per-op dispatch (including the per-round PRNG fold-in)
         dominates host-round latency otherwise.  The cache lives ON the
         round object so every instance over the same Algorithm (the
-        PerfTest2 loop) reuses the compiled pair."""
+        PerfTest2 loop) reuses the compiled trio.
+
+        The go-probe is the per-receive Progress of the reference
+        (InstanceHandler.scala:383-400): for a FoldRound it evaluates
+        ``go_ahead`` over the current masked mailbox, which is how
+        LastVotingEvent's fine-grained conditions (coord majority,
+        non-coord immediate goAhead) run host-side; plain Rounds fall back
+        to the expected_nbr_messages count (Round.scala:60-66)."""
         cached = getattr(rnd, "_host_jit", None)
         if cached is not None and cached[0] == self.n:
-            return cached[1], cached[2]
+            return cached[1], cached[2], cached[3]
         n = self.n
 
         def mk_ctx(rr, sid, seed):
@@ -178,9 +207,27 @@ class HostRunner:
             st2 = rnd.update(ctx, state, Mailbox(vals, mask))
             return st2, ctx._exit
 
-        fns = (jax.jit(f_send), jax.jit(f_update))
+        f_go = None
+        if isinstance(rnd, FoldRound):
+            def f_go(rr, sid, seed, state, vals, mask):  # noqa: E306
+                ctx = mk_ctx(rr, sid, seed)
+                m, count = rnd.fold(ctx, state, Mailbox(vals, mask))
+                return rnd.go_ahead(ctx, state, m, count)
+
+            f_go = jax.jit(f_go)
+
+        fns = (jax.jit(f_send), jax.jit(f_update), f_go)
         rnd._host_jit = (n, *fns)
         return fns
+
+    def _round_progress(self, rnd) -> Progress:
+        """The round's declared Progress policy; a round that keeps the
+        Round-class default delegates to the runner's configured timeout
+        (the RuntimeOptions role)."""
+        p = rnd.init_progress
+        if p is Round.init_progress:
+            return Progress.timeout(self.timeout_ms)
+        return p
 
     def run(self, io: Any, max_rounds: int = 64) -> HostResult:
         algo = self.algo
@@ -188,11 +235,16 @@ class HostRunner:
         rounds = algo.rounds
         exited = False
         r = 0
+        # benign catch-up state (InstanceHandler.scala:289-301): highest
+        # round observed per peer; their max pulls this replica forward
+        max_rnd = np.full(self.n, -1, dtype=np.int64)
+        max_rnd[self.id] = 0
+        next_round = 0
         while r < max_rounds and not exited:
             rnd = rounds[r % len(rounds)]
             rr, sid = np.int32(r), np.int32(self.id)
             seed = np.uint32(self.seed)
-            f_send, f_update = self._round_fns(rnd)
+            f_send, f_update, f_go = self._round_fns(rnd)
             state, payload, dest_mask = f_send(rr, sid, seed, state)
             dest = np.asarray(dest_mask)
             payload_np = jax.tree_util.tree_map(np.asarray, payload)
@@ -204,19 +256,50 @@ class HostRunner:
                     d, Tag(instance=self.instance_id, round=r), wire
                 )
 
-            # -- accumulate (InstanceHandler.scala:197-245) ---------------
+            # -- accumulate (InstanceHandler.scala:164-353) ---------------
             inbox: Dict[int, Any] = dict(self._pending.pop(r, {}))
             if dest[self.id]:
                 inbox[self.id] = payload_np  # self-delivery off the wire
-            deadline = _time.monotonic() + self.timeout_ms / 1000.0
+            prog = self._round_progress(rnd)
+            block = prog.is_strict       # strict: no catch-up early-exit
+            use_deadline = prog.is_timeout
+            t0 = _time.monotonic()
+            deadline = t0 + (prog.timeout_millis if use_deadline
+                             else self.wait_cap_ms) / 1000.0
             expected = rnd.expected_nbr_messages(self._ctx(r), state)
-            while len(inbox) < min(self.n, int(expected)):
+            timedout = False
+
+            def go_ahead() -> bool:
+                if f_go is not None:
+                    mbox = self._mailbox(inbox, payload_np)
+                    return bool(np.asarray(
+                        f_go(rr, sid, seed, state, mbox.values, mbox.mask)
+                    ))
+                return len(inbox) >= min(self.n, int(expected))
+
+            dirty = True  # inbox changed since the last go probe
+            while not prog.is_go_ahead:
+                if dirty and go_ahead():
+                    break
+                dirty = False
+                if prog.is_sync and int((max_rnd >= r).sum()) >= prog.k:
+                    break  # sync(k) barrier reached
+                if next_round > r and not block:
+                    timedout = True  # catching up counts as TO (:245)
+                    break
                 left_ms = int((deadline - _time.monotonic()) * 1000)
                 if left_ms <= 0:
+                    timedout = True
+                    if not use_deadline:
+                        log.warning(
+                            "node %d round %d: %s was idle for "
+                            "%d ms; forcing timeout (the reference would "
+                            "block forever)", self.id, r, prog,
+                            self.wait_cap_ms)
                     break
                 got = self.transport.recv(left_ms)
                 if got is None:
-                    break
+                    continue  # re-check the deadline
                 sender, tag, raw = got
                 if tag.instance != self.instance_id or tag.flag != FLAG_NORMAL:
                     if tag.flag == FLAG_NORMAL and self.foreign is not None:
@@ -228,13 +311,22 @@ class HostRunner:
                             payload=pickle.loads(raw) if raw else None,
                         ))
                     continue
+                if 0 <= sender < self.n and tag.round > max_rnd[sender]:
+                    max_rnd[sender] = tag.round
                 if tag.round < r:
                     continue  # late: the round is communication-closed
                 payload = pickle.loads(raw)
+                if not use_deadline:
+                    # the wait cap is an IDLE cap: any same-instance
+                    # message is progress and extends the deadline
+                    deadline = _time.monotonic() + self.wait_cap_ms / 1000.0
                 if tag.round > r:
                     self._pending.setdefault(tag.round, {})[sender] = payload
+                    # benign catch-up: the furthest peer sets the target
+                    next_round = max(next_round, int(max_rnd.max()))
                     continue
                 inbox[sender] = payload
+                dirty = True
 
             # -- update ---------------------------------------------------
             mbox = self._mailbox(inbox, payload_np)
@@ -242,9 +334,12 @@ class HostRunner:
                 rr, sid, seed, state, mbox.values, mbox.mask,
             )
             exited = bool(np.asarray(exit_flag))
-            log.debug("node %d round %d: heard %d/%d%s", self.id, r,
-                      len(inbox), self.n, " exit" if exited else "")
+            log.debug("node %d round %d: heard %d/%d%s%s", self.id, r,
+                      len(inbox), self.n, " TO" if timedout else "",
+                      " exit" if exited else "")
             r += 1
+            max_rnd[self.id] = r
+            next_round = max(next_round, r)
 
         decided = bool(np.asarray(algo.decided(state)))
         decision = np.asarray(algo.decision(state))

@@ -49,7 +49,7 @@ def _contract_src(pre, post) -> tuple:
             r = repr(x)
         except Exception:  # noqa: BLE001 - identity only, never raise
             return type_key(x)
-        if " object at 0x" in r:
+        if " at 0x" in r:
             # default object repr embeds the address: compares unequal on
             # every reload — fall back to type identity (same tradeoff as
             # exotic callables below)

@@ -434,3 +434,223 @@ def test_host_tpc_commit_and_abort():
         ds = {int(np.asarray(r.decision)) for r in results.values()}
         assert all(r.decided for r in results.values())
         assert ds == {expect}, f"votes={votes}: {ds}"
+
+
+# ---------------------------------------------------------------------------
+# Progress semantics (InstanceHandler.scala:164-353 parity)
+# ---------------------------------------------------------------------------
+
+def _progress_test_algo(expected_quorum=None, progress=None):
+    """A minimal flood-max algorithm for progress tests: broadcast x, fold
+    max, never exit (the runner's max_rounds bounds the run)."""
+    import jax.numpy as jnp
+
+    from round_tpu.core.algorithm import Algorithm
+    from round_tpu.core.rounds import Round, broadcast
+
+    class FloodRound(Round):
+        def send(self, ctx, state):
+            return broadcast(ctx, state)
+
+        def update(self, ctx, state, mbox):
+            return jnp.maximum(state, mbox.masked_max(empty=-(2**31)))
+
+        def expected_nbr_messages(self, ctx, state):
+            return ctx.n if expected_quorum is None else expected_quorum
+
+    if progress is not None:
+        FloodRound.init_progress = progress
+
+    class FloodAlgo(Algorithm):
+        def __init__(self):
+            self.rounds = (FloodRound(),)
+
+        def make_init_state(self, ctx, io):
+            return jnp.asarray(io["initial_value"], dtype=jnp.int32)
+
+        def decided(self, state):
+            return jnp.asarray(True)
+
+        def decision(self, state):
+            return state
+
+    return FloodAlgo()
+
+
+def _run_progress_replica(results, algo, my_id, peers, value, timeout_ms,
+                          max_rounds, start_delay=0.0, wait_cap_ms=30_000):
+    import time
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from round_tpu.runtime.host import HostRunner
+
+    if start_delay:
+        time.sleep(start_delay)
+    tr = HostTransport(my_id, peers[my_id][1])
+    try:
+        runner = HostRunner(
+            algo, my_id, peers, tr, timeout_ms=timeout_ms,
+            wait_cap_ms=wait_cap_ms,
+        )
+        t0 = time.perf_counter()
+        res = runner.run({"initial_value": np.int32(value)},
+                         max_rounds=max_rounds)
+        results[my_id] = (res, time.perf_counter() - t0)
+    finally:
+        tr.close()
+
+
+def _deploy_progress(algos, timeout_ms, max_rounds, delays=None,
+                     wait_cap_ms=30_000, only=None):
+    n = len(algos)
+    ports = _free_ports(n)
+    peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
+    results: dict = {}
+    ids = range(n) if only is None else only
+    threads = [
+        threading.Thread(
+            target=_run_progress_replica,
+            args=(results, algos[i], i, peers, 10 + i, timeout_ms,
+                  max_rounds, (delays or {}).get(i, 0.0), wait_cap_ms),
+        )
+        for i in ids
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    return results
+
+
+def test_host_early_exit_on_expected_messages():
+    """A round whose expectedNbrMessages is a quorum ends as soon as the
+    quorum is heard — with a 5-second round timeout, 6 rounds over 3 live
+    replicas must finish in far less than 6 x 5 s (Round.scala:33-35 +
+    InstanceHandler goAhead)."""
+    n, rounds = 3, 6
+    algos = [_progress_test_algo(expected_quorum=2) for _ in range(n)]
+    results = _deploy_progress(algos, timeout_ms=5000, max_rounds=rounds)
+    assert len(results) == n
+    for res, wall in results.values():
+        assert res.rounds_run == rounds
+        assert wall < 10.0, f"quorum early-exit did not fire (wall={wall:.1f}s)"
+    # quorum-2 rounds fold SOME peer's value each round (full convergence
+    # to the global max is not guaranteed when a round closes at 2-of-3):
+    # every decision is a max over a subset containing self
+    for i, (res, _wall) in results.items():
+        assert int(np.asarray(res.decision)) >= 10 + i
+
+
+def test_host_benign_catch_up_from_round_skew():
+    """A late-starting replica that receives future-round traffic jumps
+    forward (benign catch-up, InstanceHandler.scala:289-301) instead of
+    burning its full timeout on every skipped round."""
+    n, rounds = 2, 10
+    to_ms = 2000
+    algos = [_progress_test_algo() for _ in range(n)]
+    # replica 0 starts immediately with a short timeout and runs ahead
+    # (its peer is silent at first, so its early rounds time out at 150 ms);
+    # replica 1 starts 1.2 s late with a LONG timeout: without catch-up it
+    # would need up to 10 x 2 s — with catch-up it rejoins and finishes
+    # shortly after replica 0.
+    ports = _free_ports(n)
+    peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
+    results: dict = {}
+    threads = [
+        threading.Thread(
+            target=_run_progress_replica,
+            args=(results, algos[0], 0, peers, 10, 150, rounds, 0.0),
+        ),
+        threading.Thread(
+            target=_run_progress_replica,
+            args=(results, algos[1], 1, peers, 11, to_ms, rounds, 1.2),
+        ),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert len(results) == n
+    res1, wall1 = results[1]
+    assert res1.rounds_run == rounds
+    # generous bound: well under the 20 s a no-catch-up replica could take,
+    # and the late replica must not pay (rounds x its own timeout)
+    assert wall1 < 8.0, f"catch-up did not fire (wall={wall1:.1f}s)"
+    assert {int(np.asarray(r.decision)) for r, _ in results.values()} == {11}
+
+
+def test_host_wait_message_and_cap():
+    """WaitForMessage (no deadline) ends on goAhead when the quorum
+    arrives; a deserted WaitForMessage round is force-timed-out after
+    wait_cap_ms (documented deviation — the reference blocks forever)."""
+    from round_tpu.core.progress import Progress
+
+    # live pair: WaitForMessage + quorum goAhead -> fast
+    n = 2
+    algos = [
+        _progress_test_algo(expected_quorum=2, progress=Progress.WAIT_MESSAGE)
+        for _ in range(n)
+    ]
+    results = _deploy_progress(algos, timeout_ms=50, max_rounds=4)
+    assert len(results) == n
+    for res, wall in results.values():
+        assert res.rounds_run == 4 and wall < 10.0
+
+    # deserted replica: only the cap ends its rounds
+    algos = [
+        _progress_test_algo(expected_quorum=2, progress=Progress.WAIT_MESSAGE)
+        for _ in range(2)
+    ]
+    results = _deploy_progress(
+        algos, timeout_ms=50, max_rounds=2, wait_cap_ms=400, only=[0]
+    )
+    res, wall = results[0]
+    assert res.rounds_run == 2
+    assert wall >= 0.7, "wait cap fired too early"
+
+
+def test_host_sync_k_barrier():
+    """Progress.sync(k): a round proceeds once k processes are observed at
+    (or past) the current round — the benign form of the byzantine round
+    synchronizer (InstanceHandler.scala:277-287)."""
+    from round_tpu.core.progress import Progress
+
+    n = 2
+    algos = [
+        _progress_test_algo(expected_quorum=99, progress=Progress.sync(2))
+        for _ in range(n)
+    ]
+    # expected_quorum=99 disables the goAhead path: only the sync barrier
+    # (peer observed at >= r) can end a round before the cap
+    results = _deploy_progress(
+        algos, timeout_ms=50, max_rounds=4, wait_cap_ms=5000
+    )
+    assert len(results) == n
+    for res, wall in results.values():
+        assert res.rounds_run == 4
+        assert wall < 10.0, f"sync barrier never released (wall={wall:.1f}s)"
+    assert {int(np.asarray(r.decision)) for r, _ in results.values()} == {11}
+
+
+def test_host_lastvoting_event_fine_grained_progress():
+    """LastVotingEvent host-side: the FoldRound go_ahead probe gives the
+    reference's fine-grained Progress (non-coord lanes goAhead immediately,
+    the coordinator waits only for its majority), so a fault-free run
+    decides in far less than rounds x timeout."""
+    import time
+
+    n = 3
+    t0 = time.perf_counter()
+    results = _deploy(n, "lve", lambda i: {"initial_value": np.int32(i + 5)},
+                      timeout_ms=4000, max_rounds=12)
+    wall = time.perf_counter() - t0
+    decided = [r for r in results.values() if r.decided]
+    assert decided, "no replica decided"
+    vals = {int(np.asarray(r.decision)) for r in decided}
+    assert len(vals) == 1, f"disagreement: {vals}"
+    assert vals.pop() in {5, 6, 7}
+    # 12 rounds x 4 s timeout = 48 s worst case; fine-grained goAhead keeps
+    # every fault-free round at message latency
+    assert wall < 20.0, f"fine-grained progress did not fire (wall={wall:.1f}s)"
