@@ -36,8 +36,8 @@ from round_tpu.runtime.transport import HostTransport  # noqa: E402
 
 
 def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
-             errors=None):
-    tr = HostTransport(my_id, peers[my_id][1])
+             errors=None, proto="tcp"):
+    tr = HostTransport(my_id, peers[my_id][1], proto=proto)
     # ONE algorithm object across instances: the jitted round functions
     # cache on its rounds, so instance 2+ skip compilation entirely
     algo = select(algo_name)
@@ -105,7 +105,8 @@ def _score(logs, instances, wall, n, algo, timeout_ms, mode,
     }
 
 
-def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0):
+def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
+            proto="tcp"):
     """Run `instances` consecutive consensus instances over `n` replicas
     (threads, each with its own transport+sockets — on a single-vCPU box
     the GIL interleaving beats process-per-replica; see measure_processes
@@ -119,7 +120,7 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0):
         threading.Thread(
             target=run_node,
             args=(i, peers, algo, instances, timeout_ms, results, seed,
-                  errors),
+                  errors, proto),
         )
         for i in range(n)
     ]
@@ -142,11 +143,14 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0):
             f"replica(s) died: {sorted(set(range(n)) - set(results))}; "
             f"errors: {errors}"
         )
-    return _score(results, instances, wall, n, algo, timeout_ms,
-                  "thread-per-replica"), results
+    result = _score(results, instances, wall, n, algo, timeout_ms,
+                    "thread-per-replica")
+    result["extra"]["transport"] = f"native {proto} (native/transport.cpp)"
+    return result, results
 
 
-def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300):
+def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,
+                      proto="tcp"):
     """One OS PROCESS per replica (the reference's exact shape: 4 JVMs on
     localhost) via the host_replica CLI's --instances loop: no shared GIL,
     true parallel replicas.  Returns the same result dict as measure()."""
@@ -161,6 +165,7 @@ def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300):
              "--id", str(i), "--peers", peer_arg, "--algo", algo,
              "--instances", str(instances),
              "--timeout-ms", str(timeout_ms),
+             "--proto", proto,
              "--max-rounds", "32"],  # same per-instance cap as measure()
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         )
@@ -198,6 +203,8 @@ def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300):
     logs = {i: outs[i]["decisions"] for i in outs}
     result = _score(logs, instances, wall, n, algo, timeout_ms,
                     "process-per-replica", wall_basis="slowest-replica-loop")
+    result["extra"]["transport"] = f"native {proto} (native/transport.cpp)"
+
     result["extra"]["harness_wall_s"] = round(harness_wall, 3)
     # also report the harness-wall-based rate so the two modes ARE
     # comparable on a shared basis (advisor r02)
@@ -217,11 +224,14 @@ def main(argv=None) -> int:
     ap.add_argument("--processes", action="store_true",
                     help="one OS process per replica (the reference's "
                          "4-JVM shape) instead of threads")
+    ap.add_argument("--proto", choices=["tcp", "udp"], default="tcp",
+                    help="native transport: tcp (framed/reconnecting) or "
+                         "udp (the reference's default perf transport)")
     args = ap.parse_args(argv)
     fn = measure_processes if args.processes else measure
     result, _logs = fn(
         n=args.n, instances=args.instances, algo=args.algo,
-        timeout_ms=args.timeout_ms,
+        timeout_ms=args.timeout_ms, proto=args.proto,
     )
     print(json.dumps(result))
     return 0

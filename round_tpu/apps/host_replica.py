@@ -43,6 +43,9 @@ def main(argv=None) -> int:
     ap.add_argument("--instances", type=int, default=1,
                     help="run this many consecutive instances (PerfTest2 "
                          "loop; one summary JSON line at the end)")
+    ap.add_argument("--proto", choices=["tcp", "udp"], default="tcp",
+                    help="native transport: tcp (framed/reconnecting) or "
+                         "udp (the reference's default perf transport)")
     from round_tpu.runtime.log import add_verbosity_flags, configure_from_args
 
     add_verbosity_flags(ap)
@@ -61,7 +64,7 @@ def main(argv=None) -> int:
         peers[i] = (host, int(port))
     algo = select(args.algo)
 
-    with HostTransport(args.id, peers[args.id][1]) as tr:
+    with HostTransport(args.id, peers[args.id][1], proto=args.proto) as tr:
         if args.instances <= 1:
             runner = HostRunner(
                 algo, args.id, peers, tr, instance_id=args.instance,

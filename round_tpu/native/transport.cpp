@@ -18,6 +18,13 @@
 // read, sends use the newest), and a poll(2) event loop thread instead of
 // epoll/NIO event-loop groups (peer counts here are small).
 //
+// UDP mode (rt_node_create_udp) mirrors the reference's default perf
+// transport (UdpRuntime.scala:19-96): one datagram socket per node, packet
+// := u32_be sender id | u64_be tag | payload (datagram boundaries replace
+// the length field; the explicit sender id replaces the TCP handshake under
+// the same trust model), drop-tolerant by construction — no reconnect, no
+// delivery guarantee, payloads capped at one datagram (~64 KiB).
+//
 // Threading model (one object = one node):
 //   * one event-loop thread owns ALL socket reads + accepts (poll loop),
 //   * senders write from their calling thread under a per-connection mutex
@@ -93,7 +100,8 @@ uint64_t get_u64(const uint8_t *p) {
 
 struct Node {
   int id;
-  int listen_fd = -1;
+  int listen_fd = -1;             // TCP listen socket, or the UDP socket
+  bool udp = false;
   int wake_pipe[2] = {-1, -1};    // poke the poll loop on shutdown/connect
   std::thread loop;
   bool running = false;
@@ -102,6 +110,7 @@ struct Node {
   std::vector<std::shared_ptr<Conn>> conns;
   std::map<int, std::shared_ptr<Conn>> by_peer;
   std::map<int, std::pair<std::string, int>> peer_addr;
+  std::map<int, sockaddr_in> peer_sa;          // UDP: resolved at add_peer
 
   std::mutex inbox_mu;
   std::condition_variable inbox_cv;
@@ -198,7 +207,68 @@ struct Node {
     return ok;
   }
 
+  // UDP event loop: one socket, datagram = whole message
+  void udp_loop_body() {
+    std::vector<uint8_t> tmp(1 << 16);
+    while (true) {
+      pollfd pfds[2] = {{listen_fd, POLLIN, 0}, {wake_pipe[0], POLLIN, 0}};
+      {
+        std::lock_guard<std::mutex> l(mu);
+        if (!running) return;
+      }
+      int rc = poll(pfds, 2, 200);
+      if (rc < 0 && errno != EINTR) return;
+      {
+        std::lock_guard<std::mutex> l(mu);
+        if (!running) return;
+      }
+      if (rc <= 0) continue;
+      if (pfds[1].revents & POLLIN) {
+        uint8_t b;
+        while (read(wake_pipe[0], &b, 1) > 0) {}
+      }
+      if (!(pfds[0].revents & POLLIN)) continue;
+      for (;;) {  // drain every queued datagram before re-polling
+        ssize_t got = recvfrom(listen_fd, tmp.data(), tmp.size(),
+                               MSG_DONTWAIT, nullptr, nullptr);
+        if (got < 0) break;
+        if (got < 12) continue;  // malformed datagram: drop
+        Msg m;
+        m.from = static_cast<int>(get_u32(tmp.data()));
+        m.tag = get_u64(tmp.data() + 4);
+        m.payload.assign(tmp.data() + 12, tmp.data() + got);
+        enqueue(std::move(m));
+      }
+    }
+  }
+
+  bool udp_send(int peer, uint64_t tag, const uint8_t *payload, int len) {
+    // one datagram per message; 12-byte header, kernel caps the rest
+    if (len < 0 || len > 65507 - 12) return false;
+    std::vector<uint8_t> pkt;
+    pkt.reserve(12 + len);
+    put_u32(pkt, static_cast<uint32_t>(id));
+    put_u32(pkt, static_cast<uint32_t>(tag >> 32));
+    put_u32(pkt, static_cast<uint32_t>(tag & 0xFFFFFFFFu));
+    pkt.insert(pkt.end(), payload, payload + len);
+    // sendto under `mu`: excludes stop() closing (and the fd number being
+    // reused) mid-send — the UDP analogue of the TCP per-connection write
+    // mutex.  The address was resolved once at add_peer, and MSG_DONTWAIT
+    // keeps a full send buffer a DROP (UDP semantics), so the critical
+    // section is short and never blocks the event loop.
+    std::lock_guard<std::mutex> l(mu);
+    auto sa = peer_sa.find(peer);
+    if (sa == peer_sa.end() || listen_fd < 0) return false;
+    ssize_t sent = sendto(
+        listen_fd, pkt.data(), pkt.size(), MSG_DONTWAIT,
+        reinterpret_cast<sockaddr *>(&sa->second), sizeof(sa->second));
+    return sent == static_cast<ssize_t>(pkt.size()) ||
+           (sent < 0 && (errno == EAGAIN || errno == EWOULDBLOCK ||
+                         errno == ECONNREFUSED));
+  }
+
   void loop_body() {
+    if (udp) return udp_loop_body();
     std::vector<uint8_t> tmp(1 << 16);
     while (true) {
       std::vector<pollfd> pfds;
@@ -320,6 +390,7 @@ struct Node {
   }
 
   bool send_msg(int peer, uint64_t tag, const uint8_t *payload, int len) {
+    if (udp) return udp_send(peer, tag, payload, len);
     // mirror the receiver's frame cap: an oversized frame would report
     // send success while the peer severs the link as a protocol violation
     if (len < 0 || static_cast<uint32_t>(len) > kMaxFrame - 8) return false;
@@ -349,10 +420,11 @@ struct Node {
 
 extern "C" {
 
-void *rt_node_create(int id, int listen_port) {
+static void *node_create(int id, int listen_port, bool udp) {
   auto *n = new Node();
   n->id = id;
-  n->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  n->udp = udp;
+  n->listen_fd = socket(AF_INET, udp ? SOCK_DGRAM : SOCK_STREAM, 0);
   if (n->listen_fd < 0) { delete n; return nullptr; }
   int one = 1;
   setsockopt(n->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
@@ -361,7 +433,7 @@ void *rt_node_create(int id, int listen_port) {
   sa.sin_addr.s_addr = htonl(INADDR_ANY);
   sa.sin_port = htons(static_cast<uint16_t>(listen_port));
   if (bind(n->listen_fd, reinterpret_cast<sockaddr *>(&sa), sizeof(sa)) != 0 ||
-      listen(n->listen_fd, 64) != 0 || pipe(n->wake_pipe) != 0) {
+      (!udp && listen(n->listen_fd, 64) != 0) || pipe(n->wake_pipe) != 0) {
     close(n->listen_fd);
     delete n;
     return nullptr;
@@ -375,6 +447,16 @@ void *rt_node_create(int id, int listen_port) {
   return n;
 }
 
+void *rt_node_create(int id, int listen_port) {
+  return node_create(id, listen_port, false);
+}
+
+// The reference's default perf transport shape (UdpRuntime.scala:19-96):
+// datagram socket, drop-tolerant, one packet per message.
+void *rt_node_create_udp(int id, int listen_port) {
+  return node_create(id, listen_port, true);
+}
+
 int rt_node_port(void *node) {
   auto *n = static_cast<Node *>(node);
   sockaddr_in sa{};
@@ -386,8 +468,29 @@ int rt_node_port(void *node) {
 
 void rt_node_add_peer(void *node, int peer_id, const char *host, int port) {
   auto *n = static_cast<Node *>(node);
+  sockaddr_in sa{};
+  bool have_sa = false;
+  if (n->udp) {
+    // resolve ONCE here, not per datagram (the send path is hot and must
+    // not do synchronous DNS); resolution happens outside the node lock
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, host, &sa.sin_addr) == 1) {
+      have_sa = true;
+    } else {
+      addrinfo hints{}, *res = nullptr;
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_DGRAM;
+      if (getaddrinfo(host, nullptr, &hints, &res) == 0) {
+        sa.sin_addr = reinterpret_cast<sockaddr_in *>(res->ai_addr)->sin_addr;
+        freeaddrinfo(res);
+        have_sa = true;
+      }
+    }
+  }
   std::lock_guard<std::mutex> l(n->mu);
   n->peer_addr[peer_id] = {host, port};
+  if (have_sa) n->peer_sa[peer_id] = sa;
 }
 
 int rt_node_send(void *node, int peer_id, uint64_t tag,

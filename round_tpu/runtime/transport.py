@@ -53,6 +53,8 @@ def _load() -> ctypes.CDLL:
         )
         lib.rt_node_create.restype = ctypes.c_void_p
         lib.rt_node_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.rt_node_create_udp.restype = ctypes.c_void_p
+        lib.rt_node_create_udp.argtypes = [ctypes.c_int, ctypes.c_int]
         lib.rt_node_port.restype = ctypes.c_int
         lib.rt_node_port.argtypes = [ctypes.c_void_p]
         lib.rt_node_add_peer.argtypes = [
@@ -83,12 +85,22 @@ class HostTransport:
 
     `port=0` binds an ephemeral port (read it back from `.port` — the test
     harness pattern; fixed ports mirror the reference's XML peer lists,
-    Config.scala:6-27)."""
+    Config.scala:6-27).
 
-    def __init__(self, node_id: int, port: int = 0):
+    `proto="udp"` switches to the datagram transport — the reference's
+    default perf transport shape (UdpRuntime.scala:19-96): drop-tolerant,
+    no reconnect state, one datagram per message (payloads over ~64 KiB
+    fail at send)."""
+
+    def __init__(self, node_id: int, port: int = 0, proto: str = "tcp"):
+        if proto not in ("tcp", "udp"):
+            raise ValueError(f"proto must be tcp or udp, got {proto!r}")
         self._lib = _load()
         self.id = node_id
-        self._node = self._lib.rt_node_create(node_id, port)
+        self.proto = proto
+        create = (self._lib.rt_node_create_udp if proto == "udp"
+                  else self._lib.rt_node_create)
+        self._node = create(node_id, port)
         if not self._node:
             raise OSError(f"could not bind node {node_id} on port {port}")
         self.port = self._lib.rt_node_port(self._node)
