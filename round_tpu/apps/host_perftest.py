@@ -67,7 +67,7 @@ def _alloc_ports(n):
 
 
 def _score(logs, instances, wall, n, algo, timeout_ms, mode,
-           wall_basis="harness-wall"):
+           wall_basis="harness-wall", proto="tcp"):
     """Strict instance scoring: agreed = every replica decided AND equal;
     any decider short of that = partial.
 
@@ -100,7 +100,7 @@ def _score(logs, instances, wall, n, algo, timeout_ms, mode,
             "n": n,
             "timeout_ms": timeout_ms,
             "mode": mode,
-            "transport": "native tcp (native/transport.cpp)",
+            "transport": f"native {proto} (native/transport.cpp)",
         },
     }
 
@@ -143,10 +143,8 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
             f"replica(s) died: {sorted(set(range(n)) - set(results))}; "
             f"errors: {errors}"
         )
-    result = _score(results, instances, wall, n, algo, timeout_ms,
-                    "thread-per-replica")
-    result["extra"]["transport"] = f"native {proto} (native/transport.cpp)"
-    return result, results
+    return _score(results, instances, wall, n, algo, timeout_ms,
+                  "thread-per-replica", proto=proto), results
 
 
 def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,
@@ -202,8 +200,8 @@ def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,
     )
     logs = {i: outs[i]["decisions"] for i in outs}
     result = _score(logs, instances, wall, n, algo, timeout_ms,
-                    "process-per-replica", wall_basis="slowest-replica-loop")
-    result["extra"]["transport"] = f"native {proto} (native/transport.cpp)"
+                    "process-per-replica", wall_basis="slowest-replica-loop",
+                    proto=proto)
 
     result["extra"]["harness_wall_s"] = round(harness_wall, 3)
     # also report the harness-wall-based rate so the two modes ARE

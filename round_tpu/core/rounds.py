@@ -208,6 +208,26 @@ class FoldRound(Round):
     def combine(self, m1, m2):
         raise NotImplementedError
 
+    def reduce(self, ctx: RoundCtx, state, lifted, mask):
+        """Optional vectorized-reduction equivalent of the pairwise tree
+        fold: return m computed with jnp reductions (any/all/sum/max/
+        argmax + gather) over the [n]-shaped `lifted` pytree and `mask`.
+
+        Declared by rounds whose monoid admits one — commutative monoids
+        directly; order-sensitive folds (the last-sender-wins or
+        `>=`-running-max shapes) encode the sender-id tie-break as an
+        argmax over ids.  This is the round's EXTRACTION form: the jaxpr
+        abstract interpreter (verify/extract.py) follows reductions
+        symbolically but not the strided-slice tree of ``fold``, so
+        transition-relation extraction for event rounds
+        (verify/protocols.py tpce/lve-event TRs) traces ``fold_reduced``.
+        Differential tests pin it to ``fold`` (tests/test_event_models.py)
+        — the reference cannot extract event rounds at all
+        (RoundRewrite.scala:48-50, TransitionRelation.scala:156-174 stub).
+
+        Default None: the round has no declared reduction form."""
+        return None
+
     def go_ahead(self, ctx: RoundCtx, state, m, count):
         return count > 0
 
@@ -218,6 +238,17 @@ class FoldRound(Round):
         m, count = self.fold(ctx, state, mailbox)
         go = self.go_ahead(ctx, state, m, count)
         return self.post(ctx, state, m, count, jnp.logical_not(go))
+
+    def fold_reduced(self, ctx: RoundCtx, state, mailbox):
+        """(m, count) via the round's declared `reduce` — the extraction
+        form.  Falls back to the tree fold when none is declared."""
+        lifted = jax.vmap(lambda i, p: self.lift(ctx, state, i, p))(
+            mailbox.senders, mailbox.values
+        )
+        m = self.reduce(ctx, state, lifted, mailbox.mask)
+        if m is None:
+            return self.fold(ctx, state, mailbox)
+        return m, mailbox.size()
 
     def fold(self, ctx: RoundCtx, state, mailbox):
         """The masked O(log n) reduction alone: (m, count).  Exposed so the

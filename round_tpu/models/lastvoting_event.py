@@ -63,6 +63,21 @@ class LVECollect(FoldRound):
         return {"ts": pick(a["ts"], b["ts"]), "id": pick(a["id"], b["id"]),
                 "x": pick(a["x"], b["x"])}
 
+    def reduce(self, ctx: RoundCtx, state: LVState, lifted, mask):
+        # the `>=`-running lex (ts, id) max as reductions: max timestamp
+        # over present senders (zero: ts=-1, id=-1, x=state.x), then the
+        # highest-id sender at that timestamp (argmax over masked ids —
+        # ids are distinct, so the max IS the last-wins tie-break)
+        ts = jnp.where(mask, lifted["ts"], -1)
+        m_ts = jnp.max(ts)  # the fold's zero carries ts = -1 too
+        at_max = mask & (lifted["ts"] == m_ts)
+        # mask.shape, not ctx.n: n may be traced under extraction
+        ids = jnp.where(at_max, jnp.arange(mask.shape[0]), -1)
+        m_id = jnp.max(ids)
+        idx = jnp.argmax(ids)
+        m_x = jnp.where(m_id >= 0, lifted["x"][idx], state.x)
+        return {"ts": m_ts, "id": m_id, "x": m_x}
+
     def go_ahead(self, ctx: RoundCtx, state: LVState, m, count):
         # init: r == 0 or non-coord goAhead immediately; coord otherwise
         # needs a majority (:60-64, :82-83)

@@ -76,6 +76,10 @@ class TpcEPrepare(_TpcERound):
     def combine(self, a, b):
         return a | b
 
+    def reduce(self, ctx: RoundCtx, state: TpcEState, lifted, mask):
+        # OR-monoid: the tree fold is any() over the present senders
+        return jnp.any(jnp.where(mask, lifted, False))
+
     def go_ahead(self, ctx: RoundCtx, state: TpcEState, m, count):
         return m
 
@@ -97,6 +101,10 @@ class TpcEVote(_TpcERound):
 
     def combine(self, a, b):
         return a & b
+
+    def reduce(self, ctx: RoundCtx, state: TpcEState, lifted, mask):
+        # AND-monoid: the tree fold is all() over the present senders
+        return jnp.all(jnp.where(mask, lifted, True))
 
     def go_ahead(self, ctx: RoundCtx, state: TpcEState, m, count):
         nonc = ctx.id != state.coord
@@ -139,6 +147,14 @@ class TpcECommit(_TpcERound):
     def combine(self, a, b):
         return {"got": a["got"] | b["got"],
                 "v": jnp.where(b["got"], b["v"], a["v"])}
+
+    def reduce(self, ctx: RoundCtx, state: TpcEState, lifted, mask):
+        # last-sender-wins fold: the winner is the highest-id present
+        # sender (sender-id fold order) — an argmax over masked ids
+        # (mask.shape, not ctx.n: n may be traced under extraction)
+        got = jnp.any(mask)
+        idx = jnp.argmax(jnp.where(mask, jnp.arange(mask.shape[0]), -1))
+        return {"got": got, "v": jnp.where(got, lifted["v"][idx], False)}
 
     def go_ahead(self, ctx: RoundCtx, state: TpcEState, m, count):
         return m["got"]

@@ -109,15 +109,66 @@ class SetDef:
         return f"SetDef({self.sym!r} := {self.comp!r})"
 
 
-def symbolize_comprehensions(f: Formula) -> Tuple[Formula, List[SetDef]]:
-    """Replace every comprehension {x | body} whose body only mentions x and
-    ground terms with a fresh set constant S plus the definition axiom
-    ∀x. x ∈ S ⇔ body (quantifiers/package.scala:195).
+def _comprehension_template(comp: Binding) -> Tuple[Formula, List[Formula]]:
+    """Abstract the maximal element-free subterms of a comprehension body
+    into parameter variables, in first-occurrence order.
 
-    Comprehensions capturing enclosing bound variables become applications
-    of a fresh set-valued function of those variables."""
+    {k | k ∈ HO(j) ∧ x(k) = w}  and  {k | k ∈ HO(j0) ∧ x(k) = v}  both
+    yield the template {k | k ∈ tp!0 ∧ x(k) = tp!1} with parameter lists
+    [HO(j), w] and [HO(j0), v] — the α-normalized template is the KEY under
+    which both occurrences share one set-valued function symbol, so their
+    card terms become congruent applications instead of unrelated
+    constants.  This is the set-extensionality transport the LV/OTR
+    inductiveness VCs need: without it, a ground comprehension and the ∀-
+    quantified comprehension it instantiates get distinct symbols and the
+    solver cannot connect their cardinalities."""
+    params: List[Formula] = []
+    pvars: List[Variable] = []
+
+    def abstract(t: Formula, blocked: frozenset) -> Formula:
+        # a subterm is a parameter only if it mentions NO blocked variable
+        # — the element vars AND any variable bound by a binder we have
+        # recursed into (otherwise an inner-bound variable would leak free
+        # into the shared symbol's arguments and definition axiom)
+        if not (free_vars(t) & blocked):
+            for idx, seen in enumerate(params):
+                if seen == t:
+                    return pvars[idx]
+            pv = Variable(f"tp!{len(params)}", t.tpe)
+            params.append(t)
+            pvars.append(pv)
+            return pv
+        if isinstance(t, Application):
+            h = Application(t.fct, [abstract(a, blocked) for a in t.args])
+            h.tpe = t.tpe
+            return h
+        if isinstance(t, Binding):
+            h = Binding(t.binder, t.vars,
+                        abstract(t.body, blocked | frozenset(t.vars)))
+            h.tpe = t.tpe
+            return h
+        return t  # an element or inner-bound variable
+
+    body_t = abstract(comp.body, frozenset(comp.vars))
+    tcomp = Binding(COMPREHENSION, comp.vars, body_t)
+    tcomp.tpe = comp.tpe
+    return alpha_normalize(tcomp), params
+
+
+def symbolize_comprehensions(f: Formula) -> Tuple[Formula, List[SetDef]]:
+    """Replace every comprehension {x | body} with a set symbol S plus the
+    definition axiom ∀x. x ∈ S ⇔ body (quantifiers/package.scala:195).
+
+    Symbols are keyed by the comprehension's α-normalized TEMPLATE (body
+    with its element-free subterms abstracted, _comprehension_template):
+    occurrences that are instances of the same template share one
+    set-valued function symbol applied to their actual parameter terms, so
+    instantiating a ∀-quantified comprehension produces the SAME card term
+    as a ground occurrence of that instance (comprehension-card congruence
+    across witnesses).  Parameter-free comprehensions stay constants."""
     defs: List[SetDef] = []
     cache: Dict[Formula, Formula] = {}
+    templates: Dict[Formula, object] = {}
 
     def go(g: Formula, bound: Tuple[Variable, ...]) -> Formula:
         if isinstance(g, Binding):
@@ -128,20 +179,28 @@ def symbolize_comprehensions(f: Formula) -> Tuple[Formula, List[SetDef]]:
                 norm = alpha_normalize(comp)
                 if norm in cache:
                     return cache[norm]
+                key, params = _comprehension_template(comp)
                 captured = sorted(
                     (v for v in free_vars(comp) if v in set(bound)),
                     key=lambda v: v.name,
                 )
                 elem_vars = list(comp.vars)
-                if captured:
-                    fn = UnInterpretedFct(
-                        _fresh_name("S"),
-                        FunT([c.tpe for c in captured], comp.tpe),
-                    )
-                    sym: Formula = Application(fn, list(captured))
+                if params:
+                    fn = templates.get(key)
+                    if fn is None:
+                        fn = UnInterpretedFct(
+                            _fresh_name("S"),
+                            FunT([p.tpe for p in params], comp.tpe),
+                        )
+                        templates[key] = fn
+                    sym: Formula = Application(fn, params)
                     sym.tpe = comp.tpe
                 else:
-                    sym = Variable(_fresh_name("S"), comp.tpe)
+                    sym0 = templates.get(key)
+                    if sym0 is None:
+                        sym0 = Variable(_fresh_name("S"), comp.tpe)
+                        templates[key] = sym0
+                    sym = sym0
                 x = elem_vars[0] if len(elem_vars) == 1 else None
                 if x is not None:
                     member = Application(IN, [x, sym])

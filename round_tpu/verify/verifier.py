@@ -19,12 +19,59 @@ TR, mkTR Verifier.scala:159-168), livenessPredicate per phase."""
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from round_tpu.verify.cl import ClConfig, ClDefault
-from round_tpu.verify.formula import And, Formula, TRUE
+from round_tpu.verify.formula import (
+    And, Exists, ForAll, Formula, TRUE, Variable,
+)
+from round_tpu.verify.futils import free_vars
 from round_tpu.verify.tr import RoundTR, StateSig
 from round_tpu.verify.vc import VC, CompositeVC, SingleVC
+
+Stage = Tuple[str, Formula, Formula, Optional[ClConfig]]
+
+
+@dataclasses.dataclass
+class StagedChain:
+    """A staged decomposition of one VC whose COMPOSITION is machine-checked.
+
+    The chain proves  H ⊨ G  (H = the VC's hypothesis ∧ transition,
+    G = its conclusion) by natural deduction:
+
+      * `intros`: ∃-eliminations from H — each (vars, P, cfg) asserts
+        H ⊨ ∃vars. P and names the witnesses as free constants carrying P.
+      * `stages`: each (name, h_i, c_i, cfg) is an entailment h_i ⊨ c_i,
+        valid for every valuation of its free variables.  Variables free in
+        a stage but nowhere earlier are that stage's UNIVERSALS: since they
+        are fresh (checked syntactically), the stage's conclusion may be
+        ∀-generalized over them for later stages (∀-intro).
+
+    The verifier discharges, per chain:
+      1. each intro VC          H ⊨ ∃vars. P                    (reducer)
+      2. each stage VC          h_i ⊨ c_i                        (reducer)
+      3. each justification VC  H ∧ P* ∧ ∀-closed c_{<i} ⊨ h_i   (reducer)
+      4. the final VC           H ∧ P* ∧ ∀-closed c_* ⊨ G        (reducer)
+      5. freshness side conditions: witnesses/universals are fresh where
+         introduced and witnesses do not occur in H or G (syntactic;
+         violation raises at VC-generation time)
+
+    Together these ARE the composition argument — nothing is left
+    author-supplied.  `just_configs` / `final_config` tune the reducer for
+    the bookkeeping VCs (they default to the spec config)."""
+
+    stages: List[Stage]
+    intros: List[Tuple[List[Variable], Formula, Optional[ClConfig]]] = \
+        dataclasses.field(default_factory=list)
+    just_configs: Dict[str, ClConfig] = dataclasses.field(default_factory=dict)
+    final_config: Optional[ClConfig] = None
+    # hypothesis pruning for the bookkeeping VCs: key = "intro:<k>",
+    # "justify:<stage name>" or "final"; value = the EXACT conjuncts of the
+    # available context to keep.  Pruning is hypothesis WEAKENING (sound);
+    # membership of every listed formula in the actual context is verified
+    # structurally at VC-generation time, so an author cannot smuggle in a
+    # fact the chain does not have.
+    prune: Dict[str, List[Formula]] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -50,7 +97,9 @@ class ProtocolSpec:
     safety_predicate: Formula = TRUE   # communication assumption, every round
     liveness: List[Formula] = dataclasses.field(default_factory=list)
     config: Optional[ClConfig] = None
-    staged: Dict[str, List[Tuple[str, Formula, Formula, Optional[ClConfig]]]] = \
+    # a plain stage list = legacy author-supplied composition (caveat in the
+    # report); a StagedChain = machine-checked composition (no caveat)
+    staged: Dict[str, Union[List[Stage], StagedChain]] = \
         dataclasses.field(default_factory=dict)
 
 
@@ -77,10 +126,12 @@ class Verifier:
             children = []
             for r_idx, rnd in enumerate(spec.rounds):
                 name = f"invariant {inv_idx} inductive at round {r_idx}"
-                if name in spec.staged:
-                    children.append(self._staged_vc(name))
-                    continue
                 tr = And(spec.safety_predicate, rnd.full_tr())
+                if name in spec.staged:
+                    children.append(
+                        self._staged_vc(name, And(inv, tr), sig.prime(inv))
+                    )
+                    continue
                 children.append(SingleVC(
                     name, inv, tr, sig.prime(inv),
                 ))
@@ -128,21 +179,128 @@ class Verifier:
             )
         return vcs
 
-    def _staged_vc(self, name: str) -> VC:
-        stages = self.spec.staged[name]
+    def _staged_vc(self, name: str, H: Formula, G: Formula) -> VC:
+        chain = self.spec.staged[name]
         self._staged_unused.discard(name)
-        children = [
-            SingleVC(sname, hyp, TRUE, concl, config=cfg)
-            for sname, hyp, concl, cfg in stages
-        ]
-        return CompositeVC(f"{name} [staged ∃-elim]", True, children)
+        if not isinstance(chain, StagedChain):
+            # legacy: stage list only, composition author-supplied
+            children = [
+                SingleVC(sname, hyp, TRUE, concl, config=cfg)
+                for sname, hyp, concl, cfg in chain
+            ]
+            return CompositeVC(f"{name} [staged ∃-elim]", True, children)
+        return self._composed_vc(name, chain, H, G)
+
+    def _composed_vc(self, name: str, chain: StagedChain,
+                     H: Formula, G: Formula) -> VC:
+        """Build the machine-checked chain (see StagedChain): intro VCs,
+        stage VCs, justification VCs, the final VC — plus the syntactic
+        freshness side conditions, which raise on violation (a spec bug,
+        not a proof failure)."""
+        from round_tpu.verify.futils import get_conjuncts
+
+        base_fv = free_vars(H) | free_vars(G)
+        h_conjuncts = get_conjuncts(H)
+        children: List[VC] = []
+
+        def pruned_hyp(key: str, context: List[Formula]) -> Formula:
+            """The VC's hypothesis: the full context, or — when the chain
+            prunes this key — the listed conjuncts, each verified to BE a
+            conjunct of the context (weakening only)."""
+            if key not in chain.prune:
+                return And(*context)
+            keep = chain.prune[key]
+            universe = []
+            for c in context:
+                universe.extend(get_conjuncts(c))
+            for f in keep:
+                if not any(f == c for c in universe):
+                    raise ValueError(
+                        f"staged chain {name!r}, {key}: pruned hypothesis "
+                        f"lists a formula that is NOT a conjunct of the "
+                        f"available context: {f!r}"
+                    )
+            return And(*keep)
+
+        witnesses: List[Variable] = []
+        intro_facts: List[Formula] = []
+        intro_seen = set(base_fv)
+        for idx, (vars_, P, cfg) in enumerate(chain.intros):
+            # fresh against the VC AND every earlier intro: reusing an
+            # earlier witness would conjoin facts about two different
+            # existential witnesses under one constant (unsound)
+            clash = set(vars_) & intro_seen
+            if clash:
+                raise ValueError(
+                    f"staged chain {name!r}: witness(es) {sorted(str(v) for v in clash)} "
+                    "occur free in the VC or an earlier intro — not fresh"
+                )
+            intro_seen |= set(vars_) | free_vars(P)
+            children.append(SingleVC(
+                f"intro ∃{','.join(v.name for v in vars_)}",
+                pruned_hyp(f"intro:{idx}", h_conjuncts),
+                TRUE, Exists(list(vars_), P), config=cfg,
+            ))
+            witnesses += list(vars_)
+            intro_facts.append(P)
+
+        seen = set(base_fv) | set(witnesses)
+        for fact in intro_facts:
+            seen |= free_vars(fact)
+        closed_concls: List[Formula] = []
+        for sname, hyp, concl, cfg in chain.stages:
+            # this stage's fresh universals: free in the stage, unseen
+            # anywhere earlier — ∀-intro over them is sound by freshness
+            univ = sorted(
+                (free_vars(hyp) | free_vars(concl)) - seen,
+                key=lambda v: v.name,
+            )
+            context = h_conjuncts + intro_facts + closed_concls
+            # justify each conjunct of the stage hypothesis separately
+            # (sound: ⋀ goals ⇔ the conjunction) — the conjuncts have
+            # different proof characters (a pure axiom instantiation wants
+            # venn_bound 0; a majority fact wants the card machinery), and
+            # per-conjunct prune/config keys ("justify:<name>#<k>") keep
+            # each tiny
+            h_parts = get_conjuncts(hyp)
+            for ci, part in enumerate(h_parts):
+                key = f"justify:{sname}#{ci}"
+                base = f"justify:{sname}"
+                pkey = key if key in chain.prune else base
+                jcfg = chain.just_configs.get(
+                    key, chain.just_configs.get(base, cfg))
+                label = (f"justify: {sname} [{ci + 1}/{len(h_parts)}]"
+                         if len(h_parts) > 1 else f"justify: {sname}")
+                children.append(SingleVC(
+                    label,
+                    pruned_hyp(pkey, context),
+                    TRUE, part, config=jcfg,
+                ))
+            children.append(SingleVC(sname, hyp, TRUE, concl, config=cfg))
+            closed_concls.append(
+                ForAll(univ, concl) if univ else concl
+            )
+            seen |= set(univ)
+        children.append(SingleVC(
+            "composition: chain entails the goal",
+            pruned_hyp("final", h_conjuncts + intro_facts + closed_concls),
+            TRUE, G,
+            config=chain.final_config,
+        ))
+        return CompositeVC(
+            f"{name} [staged, composition machine-checked]", True, children,
+        )
 
     @property
     def used_staged(self) -> bool:
-        """True when any discharged VC went through an author-supplied
-        staged chain (the verdict is then 'verified modulo the chain's
-        composition argument' — surfaced by report()/the CLI)."""
-        return bool(self.spec.staged) and hasattr(self, "vcs")
+        """True when any discharged VC went through a LEGACY staged chain
+        (plain stage list) whose composition argument is author-supplied —
+        the verdict then carries the 'modulo staged composition' caveat.
+        StagedChain chains machine-check their composition and carry no
+        caveat."""
+        return hasattr(self, "vcs") and any(
+            not isinstance(c, StagedChain) for c in self.spec.staged.values()
+        )
 
     # -- checking + report (Verifier.scala:279-367) -------------------------
 

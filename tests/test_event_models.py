@@ -299,3 +299,71 @@ def test_tpce_blocking_round3_freeze_on_missed_decision():
     decided = np.asarray(res.state.decided)
     assert decided[:3].all() and np.asarray(res.state.decision)[:3].tolist() == [1] * 3
     assert blocked[3] and not decided[3]
+
+
+def test_fold_reduced_matches_tree_fold():
+    """Every FoldRound that declares a `reduce` form must produce the SAME
+    (m, count) as the pairwise tree fold on random mailboxes — the
+    reduction form is the round's extraction surface (the jaxpr extractor
+    follows reductions, not the strided-slice tree), so drift here would
+    extract a wrong transition relation."""
+    from round_tpu.models.lastvoting_event import LVECollect
+    from round_tpu.models.tpc_event import (
+        TpcECommit, TpcEPrepare, TpcEVote, TpcEState,
+    )
+    from round_tpu.ops.mailbox import Mailbox as RtMailbox
+
+    n = 7
+    key = jax.random.PRNGKey(4)
+
+    def tpce_state():
+        return TpcEState(
+            coord=jnp.int32(2), vote=jnp.asarray(True),
+            decision=jnp.int32(-1), decided=jnp.asarray(False),
+            blocked=jnp.asarray(False),
+        )
+
+    def lv_state():
+        return LVState(
+            x=jnp.int32(9), ts=jnp.int32(-1), ready=jnp.asarray(False),
+            commit=jnp.asarray(False), vote=jnp.int32(0),
+            decided=jnp.asarray(False), decision=jnp.int32(-1),
+        )
+
+    cases = [
+        (TpcEPrepare(False, False), tpce_state(),
+         lambda k: jax.random.bernoulli(k, 0.7, (n,))),
+        (TpcEVote(False, True), tpce_state(),
+         lambda k: jax.random.bernoulli(k, 0.6, (n,))),
+        (TpcECommit(False, False), tpce_state(),
+         lambda k: jax.random.bernoulli(k, 0.5, (n,))),
+        (LVECollect(), lv_state(),
+         lambda k: {
+             "x": jax.random.randint(k, (n,), 0, 50, dtype=jnp.int32),
+             "ts": jax.random.randint(
+                 jax.random.fold_in(k, 1), (n,), -1, 4, dtype=jnp.int32),
+         }),
+    ]
+    for rnd, state, payload_fn in cases:
+        for trial in range(12):
+            k = jax.random.fold_in(key, hash(type(rnd).__name__) % 997 + trial)
+            mask = np.array(
+                jax.random.bernoulli(jax.random.fold_in(k, 7), 0.55, (n,))
+            )
+            if trial == 0:
+                mask[:] = False  # empty mailbox edge case
+            if trial == 1:
+                mask[:] = True
+            payload = payload_fn(jax.random.fold_in(k, 9))
+            ctx = RoundCtx(id=jnp.int32(2), n=n, r=jnp.int32(5))
+            mbox = RtMailbox(payload, jnp.asarray(mask))
+            m1, c1 = rnd.fold(ctx, state, mbox)
+            m2, c2 = rnd.fold_reduced(ctx, state, mbox)
+            assert int(c1) == int(c2), type(rnd).__name__
+            for a, b in zip(
+                jax.tree_util.tree_leaves(m1), jax.tree_util.tree_leaves(m2)
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b),
+                    err_msg=f"{type(rnd).__name__} trial {trial}",
+                )

@@ -306,3 +306,53 @@ def test_qi_logger_records_instantiation_graph(tmp_path):
     js = tmp_path / "qi.js"
     log.store_visjs(str(js))
     assert "var nodes" in js.read_text()
+
+
+def test_comprehension_template_blocks_nested_binders():
+    """Template abstraction (quantifiers._comprehension_template) must not
+    parameterize subterms mentioning variables bound INSIDE the body — a
+    leaked inner-bound variable would appear free in the shared symbol's
+    arguments and definition axiom (review r03 soundness finding)."""
+    from round_tpu.verify.formula import (
+        Application, Card, Comprehension, Exists, FunT, Gt, Int, IntLit,
+        UnInterpretedFct, Variable, procType,
+    )
+    from round_tpu.verify.futils import free_vars
+    from round_tpu.verify.quantifiers import symbolize_comprehensions
+
+    k = Variable("k", procType)
+    mm = Variable("mm", procType)
+    f = UnInterpretedFct("f", FunT([procType], Int))
+    x = UnInterpretedFct("x", FunT([procType], Int))
+    comp = Comprehension(
+        [k],
+        Exists([mm], Gt(Application(f, [mm]).with_type(Int),
+                        Application(x, [k]).with_type(Int))),
+    )
+    g, defs = symbolize_comprehensions(Gt(Card(comp), IntLit(0)))
+    assert mm not in free_vars(g), f"inner-bound var leaked: {g!r}"
+    for d in defs:
+        if d.definition is not None:
+            assert mm not in free_vars(d.definition), \
+                f"leak in definition: {d.definition!r}"
+
+
+def test_staged_chain_rejects_reused_intro_witness():
+    """Two intros naming the SAME witness constant must be rejected: their
+    facts would conjoin about one constant despite coming from different
+    existentials (review r03 soundness finding)."""
+    import pytest
+
+    from round_tpu.verify.protocols import otr_spec
+    from round_tpu.verify.verifier import StagedChain, Verifier
+
+    spec = otr_spec()
+    name = "invariant 0 inductive at round 0"
+    chain = spec.staged[name]
+    (vars_, P, cfg) = chain.intros[0]
+    import dataclasses as _dc
+
+    doubled = _dc.replace(chain, intros=[(vars_, P, cfg), (vars_, P, cfg)])
+    ver = Verifier(_dc.replace(spec, staged={name: doubled}))
+    with pytest.raises(ValueError, match="not fresh"):
+        ver.generate_vcs()

@@ -161,21 +161,47 @@ def test_otr_verifies_end_to_end():
 
 
 def test_otr_staged_chain_broken_stage_rejected():
-    """Negative control: corrupting one stage of the staged chain must fail
-    the composite inductiveness VC."""
+    """Negative control: corrupting one stage of the staged chain must be
+    rejected — either the composite VC fails, or (when the corrupted
+    conclusion is referenced by a pruned hypothesis) VC generation itself
+    refuses the now-inconsistent chain."""
     import dataclasses as _dc
+
+    import pytest as _pytest
 
     from round_tpu.verify.formula import Lt as _Lt
 
     spec = otr_spec()
     name = "invariant 0 inductive at round 0"
-    sname, hyp, concl, cfg = spec.staged[name][0]
+    chain = spec.staged[name]
+    sname, hyp, concl, cfg = chain.stages[0]
     # claim the opposite of stage A's conclusion
-    broken = [(sname, hyp, _Lt(concl.args[0], concl.args[1]), cfg)] + \
-        spec.staged[name][1:]
+    broken = _dc.replace(
+        chain,
+        stages=[(sname, hyp, _Lt(concl.args[0], concl.args[1]), cfg)]
+        + chain.stages[1:],
+    )
     spec = _dc.replace(spec, staged={name: broken})
     ver = Verifier(spec)
-    assert not ver.check()
+    try:
+        ok = ver.check()
+    except ValueError:
+        return  # prune-membership check rejected the corrupted chain
+    assert not ok
+
+    # a corruption the prune maps do NOT reference (a stage hypothesis
+    # strengthened out of reach of its justification) must fail solving
+    spec2 = otr_spec()
+    chain2 = spec2.staged[name]
+    sname, hyp, concl, cfg = chain2.stages[0]
+    from round_tpu.verify.formula import And as _And, FALSE as _FALSE
+
+    broken2 = _dc.replace(
+        chain2,
+        stages=[(sname, _And(hyp, _FALSE), concl, cfg)] + chain2.stages[1:],
+    )
+    ver2 = Verifier(_dc.replace(spec2, staged={name: broken2}))
+    assert not ver2.check()
 
 
 # ---------------------------------------------------------------------------
