@@ -242,6 +242,8 @@ class FoldRound(Round):
     def fold_reduced(self, ctx: RoundCtx, state, mailbox):
         """(m, count) via the round's declared `reduce` — the extraction
         form.  Falls back to the tree fold when none is declared."""
+        if type(self).reduce is FoldRound.reduce:
+            return self.fold(ctx, state, mailbox)
         lifted = jax.vmap(lambda i, p: self.lift(ctx, state, i, p))(
             mailbox.senders, mailbox.values
         )

@@ -453,6 +453,57 @@ def _eliminate_int_div(f: Formula) -> Tuple[Formula, List[Formula]]:
     return walk(f, frozenset()), axioms
 
 
+def _contains_binder(t: Formula) -> bool:
+    if isinstance(t, Binding):
+        return True
+    if isinstance(t, Application):
+        return any(_contains_binder(a) for a in t.args)
+    return False
+
+
+def lift_quantified_ites(f: Formula) -> Formula:
+    """atom[Ite(c, t, e)] with a QUANTIFIER inside c →
+    (c ∧ atom[t]) ∨ (¬c ∧ atom[e]).
+
+    Term-level Ites with ground conditions are left for the solver's late
+    lifting (solver.lift_ite); a quantified condition must surface into
+    boolean structure BEFORE nnf/skolemization/instantiation or QI never
+    sees it — the event-round extracted folds produce exactly this shape
+    (an AND-fold extracts as ∀ inside the decision Ite)."""
+    from round_tpu.verify.futils import replace as _replace
+
+    def find_qite(t):
+        if isinstance(t, Application):
+            if t.fct == ITE and _contains_binder(t.args[0]):
+                return t
+            for a in t.args:
+                r = find_qite(a)
+                if r is not None:
+                    return r
+        return None
+
+    def go(g: Formula) -> Formula:
+        if isinstance(g, Binding):
+            h = Binding(g.binder, g.vars, go(g.body))
+            h.tpe = g.tpe
+            return h
+        if isinstance(g, Application) and g.fct in (AND, OR, NOT, IMPLIES):
+            h = Application(g.fct, [go(a) for a in g.args])
+            h.tpe = g.tpe
+            return h
+        if isinstance(g, Application):
+            ite = find_qite(g)
+            if ite is not None:
+                c, t, e = ite.args
+                return go(Or(
+                    And(c, _replace(g, ite, t)),
+                    And(Not(c), _replace(g, ite, e)),
+                ))
+        return g
+
+    return go(f)
+
+
 # ---------------------------------------------------------------------------
 # The reducer
 # ---------------------------------------------------------------------------
@@ -479,6 +530,7 @@ class ClReducer:
         if div_axioms:
             f = And(f, *div_axioms)
         f = typecheck(f)
+        f = lift_quantified_ites(f)
         f = nnf(f)
         f, _consts = quantifiers.get_existential_prefix(f)
         f = quantifiers.skolemize(f)

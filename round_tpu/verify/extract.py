@@ -35,9 +35,9 @@ import numpy as np
 from jax.extend import core as jax_core
 
 from round_tpu.verify.formula import (
-    And, Application, Bool, BoolT, Card, Comprehension, Eq, Exists, ForAll,
-    Formula, FunT, Geq, Gt, Implies, IntLit, IntT, Ite, Leq, Literal, Lt,
-    Neq, Not, Or, Plus, Times, Minus, Type, UnInterpretedFct, Variable,
+    And, Application, Binding, Bool, BoolT, Card, Comprehension, Eq, Exists,
+    ForAll, Formula, FunT, Geq, Gt, Implies, IntLit, IntT, Ite, Leq, Literal,
+    Lt, Neq, Not, Or, Plus, Times, Minus, Type, UnInterpretedFct, Variable,
     procType,
 )
 
@@ -160,6 +160,7 @@ def _imod(x, y):
 
 
 ID_TO_P = UnInterpretedFct("idToP", FunT([Int], procType))
+P_TO_ID = UnInterpretedFct("pToId", FunT([procType], Int))
 
 
 def _coerce_proc(x, y):
@@ -175,6 +176,23 @@ def _coerce_proc(x, y):
     return x, y
 
 
+def _to_int(x):
+    """Move a ProcessID-typed term into the Int domain via the
+    uninterpreted pToId (lane ids ARE ints 0..n-1 in the runtime; the
+    extractor emits ∀p. pToId(p) ≥ 0 whenever pToId appears — see
+    extract_lane_fn).  The sender-id tie-break reductions (FoldRound
+    reduce forms: jnp.where(mask, arange, -1) + max/argmax) need this:
+    they order lane ids against the -1 sentinel."""
+    if getattr(x, "tpe", None) == procType:
+        return Application(P_TO_ID, [x]).with_type(Int)
+    return x
+
+
+def _coerce_order(mk):
+    """Order/arithmetic binop with proc→Int coercion on either side."""
+    return lambda x, y: mk(_to_int(x), _to_int(y))
+
+
 _BINOPS = {
     "add": lambda x, y: Plus(x, y),
     "sub": lambda x, y: Minus(x, y),
@@ -182,10 +200,10 @@ _BINOPS = {
     "div": _idiv,  # integer floor-div; cl._eliminate_int_div linearizes it
     "max": None,  # handled in interpreter (Ite form)
     "min": None,
-    "lt": lambda x, y: Lt(x, y),
-    "le": lambda x, y: Leq(x, y),
-    "gt": lambda x, y: Gt(x, y),
-    "ge": lambda x, y: Geq(x, y),
+    "lt": _coerce_order(lambda x, y: Lt(x, y)),
+    "le": _coerce_order(lambda x, y: Leq(x, y)),
+    "gt": _coerce_order(lambda x, y: Gt(x, y)),
+    "ge": _coerce_order(lambda x, y: Geq(x, y)),
     "eq": lambda x, y: Eq(*_coerce_proc(x, y)),
     "ne": lambda x, y: Neq(*_coerce_proc(x, y)),
     "and": lambda x, y: And(x, y),
@@ -370,6 +388,7 @@ class _Interpreter:
             return _binop(_BINOPS[prim], ins[0], ins[1])
         if prim in ("max", "min"):
             def mk(x, y, is_max=(prim == "max")):
+                x, y = _to_int(x), _to_int(y)
                 c = Gt(x, y)
                 return Ite(c, x, y) if is_max else Ite(c, y, x)
             if len(out_shape()) == 2:
@@ -386,8 +405,10 @@ class _Interpreter:
             which, *cases = ins
             if len(cases) != 2:
                 raise ExtractionError("select_n with more than 2 cases")
-            # select_n(pred, on_false, on_true)
-            return _binop_3(which, cases[0], cases[1])
+            # select_n(pred, on_false, on_true); mixed proc/int branches
+            # (jnp.where(mask, arange, -1) in the FoldRound reduce forms)
+            # unify in the Int domain via pToId
+            return _binop_3(which, cases[0], cases[1], mixed_to_int=True)
         if prim in ("reduce_sum", "reduce_or", "reduce_and",
                     "reduce_max", "reduce_min"):
             return self._reduce(ins[0], prim[len("reduce_"):],
@@ -616,7 +637,7 @@ def _is_boolish(f: Formula) -> bool:
     return False
 
 
-def _binop_3(which, on_false, on_true):
+def _binop_3(which, on_false, on_true, mixed_to_int=False):
     which, a, b = _lift(which), _lift(on_false), _lift(on_true)
     if isinstance(which, Scalar) and isinstance(which.f, Literal) \
             and isinstance(which.f.value, bool):
@@ -624,14 +645,25 @@ def _binop_3(which, on_false, on_true):
         # correction branch around an argmax site)
         return b if which.f.value else a
     parts = [which, a, b]
+
+    def mk_ite(c, t, e):
+        if mixed_to_int:
+            tt = getattr(t, "tpe", None)
+            te = getattr(e, "tpe", None)
+            if (tt == procType) != (te == procType):
+                t, e = _to_int(t), _to_int(e)
+        return Ite(c, t, e)
+
     if all(isinstance(p, Scalar) for p in parts):
-        return Scalar(Ite(which.f, on_true.f, on_false.f))
+        return Scalar(mk_ite(which.f, on_true.f, on_false.f))
     if any(isinstance(p, Vec2) for p in parts):
         fns = [_as2(p) for p in parts]
-        return Vec2(lambda r, c: Ite(fns[0](r, c), fns[2](r, c), fns[1](r, c)))
+        return Vec2(
+            lambda r, c: mk_ite(fns[0](r, c), fns[2](r, c), fns[1](r, c))
+        )
     fns = [(lambda i, p=p: p.f) if isinstance(p, Scalar) else p.fn
            for p in parts]
-    return Vec(lambda i: Ite(fns[0](i), fns[2](i), fns[1](i)))
+    return Vec(lambda i: mk_ite(fns[0](i), fns[2](i), fns[1](i)))
 
 
 # ---------------------------------------------------------------------------
@@ -671,7 +703,31 @@ def extract_lane_fn(
         )
     extras = []
     if return_axioms:
-        extras.append(interp.axioms)
+        axioms = list(interp.axioms)
+        probe = Variable("ptid!probe", procType)
+        everything = axioms + [
+            o.f if isinstance(o, Scalar)
+            else (o.fn(probe) if isinstance(o, Vec)
+                  else o.fn(probe, probe))
+            for o in outs
+            if isinstance(o, (Scalar, Vec, Vec2))
+        ]
+
+        def uses_ptoid(t):
+            if isinstance(t, Application):
+                return t.fct == P_TO_ID or any(uses_ptoid(a) for a in t.args)
+            if isinstance(t, Binding):
+                return uses_ptoid(t.body)
+            return False
+
+        if any(uses_ptoid(t) for t in everything):
+            # lane ids are 0..n-1 in the runtime: the sentinel comparisons
+            # of the FoldRound reduce forms (ids vs -1) are decided by this
+            p = Variable("ptid", procType)
+            axioms.append(ForAll([p], Geq(
+                Application(P_TO_ID, [p]).with_type(Int), IntLit(0)
+            )))
+        extras.append(axioms)
     if return_obligations:
         extras.append(interp.obligations)
     return (outs, *extras) if extras else outs

@@ -1531,3 +1531,97 @@ def lve_extracted_tr():
         Eq(Application(sndx, [i0]).with_type(Int), sig.get("x", i0)),
     ))
     return sig, j, r, update_eqs, axioms, payload_def
+
+
+def lve_extracted_stage_vcs():
+    """The maxTS lemma (LvExample.scala:268-284) proved from the EVENT-round
+    LastVoting collect — extracted via LVECollect's reduction form — as a
+    staged ∃-elimination chain (the discipline of lv_extracted_stage_vcs,
+    which proves the same lemma from the CLOSED round):
+
+      A. the timestamp majority and the mailbox majority intersect:
+         ⊨ ∃k ∈ HO(j). ts(k) ≥ t
+      B. ...so the masked ts-max site is ≥ t (∀ bound at the witness)
+      C. the max is attained IN the mailbox (t ≥ 0 rules out the -1
+         sentinel branch): ∃i ∈ HO(j). sndts(i) = max
+      D. the argmax site is an at-max mailbox sender, and the id-max site
+         is ≥ 0 (the C witness's id bounds both)
+      E. vote′(j) = sndx(argmax) = v: the extracted condition holds (j is
+         the coordinator with a majority mailbox), the inner guard
+         max-id ≥ 0 holds by D, and the at-max sender's payload is pinned
+         by the ts-property.
+
+    The reference cannot state ANY of this: event-round verification is
+    declared unsupported (RoundRewrite.scala:48-50) and its event-round
+    transition relation is a stub (TransitionRelation.scala:156-174).
+
+    Returns (stages, meta); discharged in tests/test_event_extract.py."""
+    sig, j, r, update_eqs, axioms, payload_def = lve_extracted_tr()
+
+    t = Variable("t", Int)
+    v = Variable("v", Int)
+    kw = Variable("kw", procType)   # stage-A witness
+    iw = Variable("iw", procType)   # stage-C witness
+    k1 = Variable("k1", procType)
+    k2 = Variable("k2", procType)
+    i = Variable("i", procType)
+
+    sndts = UnInterpretedFct("lvesndts", FunT([procType], Int))
+    sndx = UnInterpretedFct("lvesndx", FunT([procType], Int))
+
+    def ts_of(p):
+        return Application(sndts, [p]).with_type(Int)
+
+    A_t = Comprehension([k1], Geq(sig.get("ts", k1), t))
+    MB = Comprehension([k2], In(k2, ho_of(j)))
+
+    votep = update_eqs.args[1].args[1]           # Ite(cond, inner, vote(j))
+    cond = votep.args[0]
+    inner = votep.args[1]                        # Ite(max5 >= 0, sndx(arg), x(j))
+    is_coord = cond.args[0]                      # Eq(j, idToP(...))
+    maxsite = _find_site(axioms, "ext!max!1")
+    argsite = _find_site([inner.args[1]], "ext!argmax!")
+    idmax = inner.args[0].args[0]                # Geq(idmax, 0) LHS
+    assert maxsite is not None and argsite is not None
+    assert getattr(idmax, "fct", None) is not None and \
+        idmax.fct.name.startswith("ext!max!"), repr(idmax)
+
+    ts_prop = ForAll([i], Implies(Geq(sig.get("ts", i), t),
+                                  Eq(sig.get("x", i), v)))
+    majorities = And(
+        Gt(Times(2, Card(A_t)), N),
+        Gt(Times(2, Card(MB)), N),
+        Geq(t, IntLit(0)),
+    )
+    base = And(*axioms, payload_def, ts_prop, majorities)
+
+    c21 = ClConfig(venn_bound=2, inst_depth=1)
+    c02 = ClConfig(venn_bound=0, inst_depth=2)
+    c01 = ClConfig(venn_bound=0, inst_depth=1)
+
+    stages = [
+        ("A: the majorities intersect",
+         base,
+         Exists([k1], And(In(k1, ho_of(j)), Geq(sig.get("ts", k1), t))),
+         c21),
+        ("B: the ts-max site dominates the witness",
+         And(base, In(kw, ho_of(j)), Geq(sig.get("ts", kw), t)),
+         Geq(maxsite, t), c02),
+        ("C: the max is attained in the mailbox",
+         And(base, Geq(maxsite, t)),
+         Exists([k1], And(In(k1, ho_of(j)), Eq(ts_of(k1), maxsite))),
+         c02),
+        ("D: the argmax site is an at-max mailbox sender",
+         And(base, In(iw, ho_of(j)), Eq(ts_of(iw), maxsite)),
+         And(In(argsite, ho_of(j)), Eq(ts_of(argsite), maxsite),
+             Geq(idmax, IntLit(0))),
+         c02),
+        ("E: the adopted vote is the anchored value",
+         And(base, is_coord, Geq(maxsite, t),
+             In(argsite, ho_of(j)), Eq(ts_of(argsite), maxsite),
+             Geq(idmax, IntLit(0)), update_eqs),
+         Eq(sig.get_primed("vote", j), v), c21),
+    ]
+    meta = {"sig": sig, "j": j, "cond": cond, "maxsite": maxsite,
+            "argsite": argsite, "idmax": idmax}
+    return stages, meta

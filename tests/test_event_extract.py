@@ -1,0 +1,63 @@
+"""Event-round transition-relation extraction — BEYOND the reference.
+
+The reference explicitly cannot verify event rounds: RoundRewrite.scala:48-50
+warns EventRound verification is unsupported and the event-round
+TransitionRelation.scala:156-174 is a ??? stub.  Here the EXECUTABLE
+FoldRound classes (models/tpc_event.py, models/lastvoting_event.py) extract
+through their declared reduction forms (FoldRound.reduce, pinned to the
+pairwise tree fold by tests/test_event_models.py), and lemmas are proved
+from the extracted TRs through the native reducer.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+from round_tpu.verify.cl import entailment
+from round_tpu.verify.protocols import (
+    lve_extracted_stage_vcs, lve_extracted_tr, tpce_extracted_tr,
+    tpce_extracted_vcs,
+)
+
+
+def test_tpce_tr_extracts():
+    """The vote-fold round of TwoPhaseCommitEvent extracts: the AND-fold
+    becomes a ∀ over the mailbox inside the decision equation."""
+    sig, j, coord, update_eqs, axioms, payload_def = tpce_extracted_tr()
+    r = repr(update_eqs)
+    assert "decision!prime" in r
+    assert "forall" in r  # the extracted AND-fold
+    assert "tesndv" in r
+
+
+def test_lve_tr_extracts():
+    """LastVotingEvent's collect round extracts: max-ts site, at-max
+    argmax site, payload gather, coordinator arithmetic."""
+    sig, j, r_, update_eqs, axioms, payload_def = lve_extracted_tr()
+    rep = repr(update_eqs)
+    assert "commit!prime" in rep and "vote!prime" in rep
+    assert "ext!argmax" in rep
+    assert any("ext!max" in repr(a) for a in axioms)
+    # the sender-id tie-break uses the pToId coercion with its >= 0 axiom
+    assert any("pToId" in repr(a) for a in axioms)
+
+
+@pytest.mark.parametrize("k", range(2))
+def test_tpce_extracted_lemmas(k):
+    """Commit/abort lemmas proved from the extracted event-round TR —
+    the quantified-Ite lifting (cl.lift_quantified_ites) surfaces the
+    extracted ∀-fold to the instantiation engine."""
+    name, hyp, concl, cfg = tpce_extracted_vcs()[k]
+    assert entailment(hyp, concl, cfg, timeout_s=240), name
+
+
+@pytest.mark.parametrize("k", range(5))
+def test_lve_extracted_maxts_chain(k):
+    """The LvExample maxTS lemma proved from the EVENT-round collect
+    (staged ∃-elim chain; the closed-round twin is
+    tests/test_lv_extract.py)."""
+    stages, _meta = lve_extracted_stage_vcs()
+    name, hyp, concl, cfg = stages[k]
+    assert entailment(hyp, concl, cfg, timeout_s=240), name
