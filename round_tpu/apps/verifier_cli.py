@@ -28,6 +28,7 @@ def spec_by_name(name: str):
     registry = {
         "tpc": protocols.tpc_spec,
         "otr": protocols.otr_spec,
+        "lv": protocols.lv_verifier_spec,
     }
     if name not in registry:
         raise SystemExit(
@@ -38,7 +39,7 @@ def spec_by_name(name: str):
 
 def main(argv=None) -> bool:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("protocol", help="tpc | otr")
+    ap.add_argument("protocol", help="tpc | otr | lv")
     ap.add_argument("-r", "--report", default=None,
                     help="write an HTML report to this path")
     ap.add_argument("-v", "--verbose", action="store_true")

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from round_tpu.verify import quantifiers, venn
@@ -453,6 +454,74 @@ def _eliminate_int_div(f: Formula) -> Tuple[Formula, List[Formula]]:
     return walk(f, frozenset()), axioms
 
 
+_FRESH_NAME = re.compile(r"^(.*!)(\d+)$")
+
+
+def _canonicalize_fresh_names(f: Formula) -> Formula:
+    """Rename every counter-suffixed symbol (``prefix!<digits>`` — the
+    shape ALL fresh-name generators here produce) to a canonical
+    first-occurrence index: ``prefix!cn<k>``.
+
+    Solver behavior is otherwise sensitive to the global fresh counters'
+    values at spec-BUILD time: two semantically identical problems whose
+    symbols differ only in counter digits sort differently in the venn
+    group enumeration and the SAT branching order, and a measured 6 s
+    proof became a 450 s timeout purely from building another spec first.
+    After canonicalization the reduction is a function of the formula's
+    structure alone."""
+    mapping: Dict[str, str] = {}
+    seq = itertools.count()
+
+    def canon(name: str) -> str:
+        m = _FRESH_NAME.match(name)
+        if not m:
+            return name
+        if name not in mapping:
+            mapping[name] = f"{m.group(1)}cn{next(seq)}"
+        return mapping[name]
+
+    fct_cache: Dict[int, object] = {}
+    node_cache: Dict[int, Formula] = {}  # id-keyed: formulas share sub-DAGs
+
+    def go(g: Formula) -> Formula:
+        key = id(g)
+        hit = node_cache.get(key)
+        if hit is not None:
+            return hit
+        if isinstance(g, Variable):
+            new = canon(g.name)
+            out = g if new is g.name else Variable(new, g.tpe)
+        elif isinstance(g, Application):
+            fct = g.fct
+            if isinstance(fct, UnInterpretedFct):
+                new = canon(fct.name)
+                if new != fct.name:
+                    fkey = id(fct)
+                    if fkey not in fct_cache:
+                        fct_cache[fkey] = UnInterpretedFct(new, fct.tpe)
+                    fct = fct_cache[fkey]
+            args = [go(a) for a in g.args]
+            if fct is g.fct and all(a is b for a, b in zip(args, g.args)):
+                out = g  # untouched subtree: keep the shared node
+            else:
+                out = Application(fct, args)
+                out.tpe = g.tpe
+        elif isinstance(g, Binding):
+            vars_ = [go(v) for v in g.vars]
+            body = go(g.body)
+            if body is g.body and all(a is b for a, b in zip(vars_, g.vars)):
+                out = g
+            else:
+                out = Binding(g.binder, vars_, body)
+                out.tpe = g.tpe
+        else:
+            out = g
+        node_cache[key] = out
+        return out
+
+    return go(f)
+
+
 def _contains_binder(t: Formula) -> bool:
     if isinstance(t, Binding):
         return True
@@ -519,6 +588,7 @@ class ClReducer:
             cfg.qi_logger.new_phase(
                 f"vb{cfg.venn_bound}/d{cfg.inst_depth}#{next(_fresh)}"
             )
+        f = _canonicalize_fresh_names(f)
         f = simplify(f)
         f = typecheck(f)
         f = reduce_time(f)
@@ -734,26 +804,48 @@ def entailment(
     config: ClConfig = ClDefault,
     timeout_s: Optional[float] = 120.0,
     decompose: bool = True,
+    total_timeout_s: Optional[float] = None,
 ) -> bool:
     """h ⊨ c via decomposition + the effort ladder.  `timeout_s` bounds each
     rung's ground solve (default 120 s — the solver's round cap is not a
-    practical backstop); only UNSAT verdicts (for every sub-VC) prove the
-    entailment."""
+    practical backstop); `total_timeout_s` additionally bounds the WHOLE
+    call (decomposition multiplies solves: rungs × hypothesis disjuncts ×
+    conclusion conjuncts — a failing query must not burn the per-solve
+    budget once per piece).  Only UNSAT verdicts (for every sub-VC) prove
+    the entailment."""
+    import time as _time
+
+    t0 = _time.monotonic()
+
+    def budget() -> Optional[float]:
+        if total_timeout_s is None:
+            return timeout_s
+        left = total_timeout_s - (_time.monotonic() - t0)
+        if left <= 0:
+            return 0.0
+        return min(timeout_s, left) if timeout_s is not None else left
+
     if not decompose:
-        return _entailment_core(h, c, config, timeout_s)
+        return _entailment_core(h, c, config, budget)
     for hd in _hyp_disjuncts(h):
         for cc in _concl_conjuncts(c):
-            if not _entailment_core(hd, cc, config, timeout_s):
+            if not _entailment_core(hd, cc, config, budget):
                 return False
     return True
 
 
 def _entailment_core(
-    h: Formula, c: Formula, config: ClConfig, timeout_s: Optional[float]
+    h: Formula, c: Formula, config: ClConfig, budget
 ) -> bool:
+    if not callable(budget):
+        fixed = budget
+        budget = lambda: fixed  # noqa: E731 - plain-timeout compatibility
     f = And(h, Not(c))
     for cfg in _ladder(config):
+        left = budget()
+        if left is not None and left <= 0:
+            return False
         red = ClReducer(cfg)
-        if solve_ground(red.reduce(f), timeout_s=timeout_s) == UNSAT:
+        if solve_ground(red.reduce(f), timeout_s=left) == UNSAT:
             return True
     return False

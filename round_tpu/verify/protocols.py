@@ -1625,3 +1625,57 @@ def lve_extracted_stage_vcs():
     meta = {"sig": sig, "j": j, "cond": cond, "maxsite": maxsite,
             "argsite": argsite, "idmax": idmax}
     return stages, meta
+
+
+def lv_verifier_spec() -> ProtocolSpec:
+    """LastVoting END-TO-END through the Verifier — the roundInvariants
+    route (Specs.scala:20-24, LastVoting.scala:49-61):
+
+      init (at phase 0) ⊨ safety core ∧ F_0,
+      per-round VCs  SC ∧ F_k ∧ TR_{k+1} ⊨ (SC ∧ F_{k+1})′  (round 4 wraps
+      the phase), and  SC ⊨ agreement / validity.
+
+    Rounds 2 and 4 discharge monolithically; rounds 1 (collect) and 3
+    (ack) attach their lv_stage_subvcs decomposition chains.  The
+    reference `ignore`s ALL FOUR of these inductiveness VCs
+    ("those completely blow-up", LvExample.scala:262-291) — this spec
+    discharges every one through the native reducer.
+
+    Run:  python -m round_tpu.apps.verifier_cli lv   (~8 min CPU)."""
+    vcs4, spec, lv = lv_staged_vcs()
+    sig = spec.sig
+    r = lv["phase"]
+
+    # chains: every proved matrix entry of the two hard rounds, as the
+    # staged decomposition of that round's VC
+    chains: dict = {}
+    by_round = {vcs4[0][0]: "collect-r1", vcs4[2][0]: "ack-r3"}
+    matrix = lv_stage_subvcs()
+    for vc_name, prefix in by_round.items():
+        stages = [
+            (label, hyp, concl, cfg)
+            for label, hyp, concl, cfg, proved, _slow in matrix
+            if proved and label.startswith(prefix)
+        ]
+        assert stages, vc_name
+        chains[vc_name] = stages
+
+    init0 = And(spec.init, Eq(r, IntLit(0)))
+
+    return ProtocolSpec(
+        sig=sig,
+        rounds=spec.rounds,
+        init=init0,
+        # the SAFETY CORE only: F_k facts hold per boundary and must not
+        # strengthen the property hypotheses (review r03 soundness finding)
+        invariants=[lv["inv1"]],
+        properties=[
+            ("agreement", spec.properties[0][1]),
+            ("validity", spec.properties[1][1],
+             ClConfig(venn_bound=2, inst_depth=2)),
+        ],
+        config=spec.config,
+        staged=chains,
+        round_staged_inductiveness=list(vcs4),
+        round_staged_init=lv["stage0_at"](r),
+    )

@@ -12,10 +12,8 @@ from __future__ import annotations
 import time
 from typing import List, Optional, Sequence
 
-from round_tpu.verify.cl import ClConfig, ClDefault, ClReducer
+from round_tpu.verify.cl import ClConfig, ClDefault
 from round_tpu.verify.formula import And, Formula, Not, TRUE
-from round_tpu.verify.simplify import simplify
-from round_tpu.verify.solver import UNSAT
 
 
 class VC:
@@ -36,12 +34,14 @@ class SingleVC(VC):
         transition: Formula,
         conclusion: Formula,
         config: Optional[ClConfig] = None,
+        timeout_s: Optional[float] = None,
     ):
         self.name = name
         self.hypothesis = hypothesis
         self.transition = transition
         self.conclusion = conclusion
         self.config = config
+        self.timeout_s = timeout_s
         self.status: Optional[bool] = None
         self.solve_time_s: Optional[float] = None
 
@@ -52,12 +52,20 @@ class SingleVC(VC):
         self, config: ClConfig = ClDefault, timeout_s: float = 120.0
     ) -> bool:
         cfg = self.config or config
+        if self.timeout_s is not None:
+            timeout_s = self.timeout_s
         t0 = time.monotonic()
-        reducer = ClReducer(cfg)
         try:
-            self.status = (
-                reducer.check_sat(simplify(self.formula()), timeout_s=timeout_s)
-                == UNSAT
+            # the full entailment discipline (cl.entailment): hypothesis
+            # DNF × conclusion-conjunct decomposition + the effort ladder —
+            # a monolithic check_sat of the same formula is dramatically
+            # weaker on disjunctive invariants (measured: a 6 s proof via
+            # decomposition is a 450 s timeout as one query)
+            from round_tpu.verify.cl import entailment
+
+            self.status = entailment(
+                And(self.hypothesis, self.transition), self.conclusion,
+                cfg, timeout_s=timeout_s, total_timeout_s=timeout_s,
             )
         finally:
             self.solve_time_s = time.monotonic() - t0

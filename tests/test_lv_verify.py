@@ -159,3 +159,25 @@ def test_lv_stage_subvcs(k):
     if slow and os.environ.get("RUN_SLOW_VCS", "") != "1":
         pytest.skip(f"slow sub-VC (RUN_SLOW_VCS=1 to run): {label}")
     assert entailment(hyp, concl, cfg, timeout_s=400), label
+
+
+def test_lv_verifies_end_to_end():
+    """The FULL LastVoting check through the Verifier (roundInvariants
+    route): init => SC ∧ F0, all four round-staged inductiveness VCs
+    (rounds 1/3 via their decomposition chains), agreement + validity.
+    The reference ignores ALL FOUR inductiveness VCs
+    (LvExample.scala:262-291 "those completely blow-up").
+
+    ~7 min CPU — gated behind RUN_SLOW_VCS=1 like the slow matrix entries;
+    the per-entry coverage runs unconditionally above."""
+    import os
+
+    if os.environ.get("RUN_SLOW_VCS", "") != "1":
+        pytest.skip("full LV verification (~7 min): RUN_SLOW_VCS=1 to run")
+
+    from round_tpu.verify.protocols import lv_verifier_spec
+    from round_tpu.verify.verifier import Verifier
+
+    ver = Verifier(lv_verifier_spec())
+    assert ver.check(), "\n" + ver.report()
+    assert "✗" not in ver.report()
