@@ -1,0 +1,80 @@
+"""Names + Printer (verify/printer.py; reference psync.formula.Names +
+Printer): symbol/type mangling and priority-aware pretty/TeX/HTML
+rendering."""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+from round_tpu.verify import printer
+from round_tpu.verify.formula import (
+    And, Application, Bool, Card, Comprehension, Eq, Exists, ForAll, FSet,
+    FunT, Geq, Gt, Implies, In, Int, IntLit, Lt, NEQ, Not, Or, Times,
+    UnInterpretedFct, Variable, procType,
+)
+
+
+def test_names_symbols_and_types():
+    from round_tpu.verify.formula import AND, EQ, GEQ, IMPLIES
+
+    assert printer.symbol(AND) == "and"
+    assert printer.symbol(EQ) == "="
+    assert printer.symbol(IMPLIES) == "=>"
+    assert printer.tpe(Int) == "Int"
+    assert printer.tpe(FSet(procType)) == "Set_ProcessID_"
+    f = UnInterpretedFct("x!0", FunT([procType], Int))
+    assert printer.symbol(f.__class__("snd!vote!3", f.tpe)) == \
+        "snd_bang_vote_bang_3"
+    # the reference refuses to name ≠: it must be rewritten first
+    with pytest.raises(ValueError):
+        printer.symbol(NEQ)
+
+
+def test_names_overloaded_and_mangle():
+    from round_tpu.verify.formula import GEQ
+
+    assert printer.overloaded_symbol(GEQ, [Int, Int]) == ">="
+    assert printer.overloaded_symbol(GEQ, [procType, procType]) == \
+        ">=ProcessIDProcessID"
+    assert printer.mangle("1abc") == "n_1abc"
+    assert printer.type_decl(FunT([procType], Int)) == "(ProcessID) Int"
+
+
+def test_pretty_printer_priorities():
+    x = Variable("x", Int)
+    y = Variable("y", Int)
+    i = Variable("i", procType)
+    f = Implies(And(Gt(x, 0), Lt(y, 3)), Or(Eq(x, y), Not(Eq(x, 0))))
+    s = printer.pretty(f)
+    assert "∧" in s and "∨" in s and "→" in s and "¬" in s
+    # ∧ binds tighter than →: no parens needed around the antecedent
+    assert not s.startswith("(")
+
+    g = ForAll([i], Implies(In(i, Variable("S", FSet(procType))),
+                            Geq(x, IntLit(0))))
+    s2 = printer.pretty(g)
+    assert s2.startswith("∀i.") and "∈" in s2
+
+    comp = Comprehension([i], In(i, Variable("S", FSet(procType))))
+    s3 = printer.pretty(Gt(Times(2, Card(comp)), x))
+    assert "{ i |" in s3 and s3.count("|") >= 3  # card bars + set braces
+
+    # · (70) binds tighter than + (60): parens around the sum
+    from round_tpu.verify.formula import Plus
+
+    s4 = printer.pretty(Times(2, Plus(x, y)))
+    assert "(x + y)" in s4
+
+
+def test_tex_and_html_printers():
+    x = Variable("x_1", Int)
+    i = Variable("i", procType)
+    f = Exists([i], And(Eq(x, IntLit(1)), In(i, Variable("S", FSet(procType)))))
+    t = printer.tex(f)
+    assert r"\exists" in t and r"\land" in t and r"\in" in t
+    assert r"x\_1" in t
+    h = printer.html(f)
+    assert h.startswith("<math>") and "<mi>" in h and "<mn>1</mn>" in h
+    assert "<script" not in h  # identifiers are escaped
