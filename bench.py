@@ -109,6 +109,9 @@ def build_parser():
     ap.add_argument("--workload", choices=["mixed", "omission"], default="mixed")
     ap.add_argument("--rng", choices=["hw", "hash"], default="hw",
                     help="fused-engine per-link RNG: TPU hardware PRNG or the hash sampler")
+    ap.add_argument("--dot", choices=["bf16", "i8"], default="bf16",
+                    help="loop-kernel count-matmul dtype (i8 = int8 MXU, "
+                         "an A/B candidate on v5e-class chips)")
     ap.add_argument("--parity", type=int, default=0, metavar="K",
                     help="also run K scenarios through both engines and report agreement")
     ap.add_argument("--ladder", action="store_true",
@@ -294,7 +297,7 @@ def worker_main(args):
         if engine == "loop":
             return fast.run_otr_loop(
                 rnd, state0, mix, max_rounds=rounds, mode=mode, sb=args.sb,
-                interpret=interpret,
+                interpret=interpret, dot=args.dot,
             )
         return fast.run_hist(
             rnd, state0, lambda s: s.decided, mix,
@@ -452,6 +455,7 @@ def worker_main(args):
         "n": args.n,
         "scenarios": S,
         "engine": args.engine,
+        "dot": args.dot,
         "backend": jax.default_backend(),
         "workload": args.workload,
         "p_drop": args.p_drop,

@@ -374,6 +374,7 @@ def run_otr_loop(
     mode: str = "hw",
     sb: int = 8,
     interpret: bool = False,
+    dot: str = "bf16",
 ):
     """The flagship fast path: the whole OTR run as ONE Pallas kernel
     (ops.fused.otr_loop) — state stays in VMEM across rounds, so per-round
@@ -404,7 +405,7 @@ def run_otr_loop(
         mix.rotate_down, mix.p8, mix.salt0, mix.salt1,
         num_values=rnd.num_values, rounds=max_rounds,
         after_decision=rnd.after_decision, mode=mode, sb=sb,
-        interpret=interpret,
+        interpret=interpret, dot=dot,
     )
     state = OtrState(x=x, decided=dec, decision=decision, after=after)
     return state, done, dround

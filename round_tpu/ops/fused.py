@@ -83,6 +83,27 @@ def _fmix32(z):
     return z
 
 
+def _count_dot(oh, keep, dot: str):
+    """The count matmul in the requested MXU dtype.  Both are EXACT: the
+    operands are 0/1 (no rounding in either dtype) and the accumulator
+    (f32 up to 2^24 / int32) holds any count ≤ n.
+
+    bf16 (default): the universally-supported MXU path.
+    i8: int8 operands with an int32 accumulator — 2x MXU throughput on
+    v5e-class chips; an A/B candidate for the hardware session
+    (bench.py --dot i8), cast to f32 after so the in-kernel update math
+    is dtype-identical."""
+    if dot == "i8":
+        return jnp.dot(
+            oh.astype(jnp.int8), keep.astype(jnp.int8),
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32)
+    return jnp.dot(
+        oh.astype(jnp.bfloat16), keep.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def _pad_scenarios(sb: int, *arrays):
     """Zero-pad every array's leading (scenario) axis up to a multiple of
     the kernel's scenario-block size `sb`.  None entries pass through.
@@ -109,6 +130,7 @@ def _kernel(
     mode: str,
     sided: bool,
     rowmasked: bool,
+    dot: str = "bf16",
 ):
     # operand order mirrors hist_exchange: vals, senders, [rowmask], [side],
     # salt0, salt1r, p8 (SMEM), out.  rowmask/side refs exist only when the
@@ -139,11 +161,7 @@ def _kernel(
             vals_ref[s][None, :]
             == jax.lax.broadcasted_iota(jnp.int32, (num_values, n), 0)
         ) & (senders_ref[s] != 0)[None, :]
-        counts = jnp.dot(
-            onehot.astype(jnp.bfloat16),
-            keep.astype(jnp.bfloat16),
-            preferred_element_type=jnp.float32,
-        )
+        counts = _count_dot(onehot, keep, dot)
         if rowmasked:
             counts = counts * (rowmask_ref[s] != 0)[None, :].astype(jnp.float32)
         out_ref[s] = counts
@@ -154,7 +172,7 @@ def _kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_values", "mode", "sb", "interpret"),
+    static_argnames=("num_values", "mode", "sb", "interpret", "dot"),
 )
 def hist_exchange(
     vals: jnp.ndarray,      # [S, n] int32
@@ -169,6 +187,7 @@ def hist_exchange(
     mode: str = "hw",
     sb: int = 8,
     interpret: bool = False,
+    dot: str = "bf16",
 ) -> jnp.ndarray:
     """Fused masked exchange + per-value histogram.
 
@@ -207,7 +226,7 @@ def hist_exchange(
 
     kernel = functools.partial(
         _kernel, num_values=num_values, sb=sb, mode=mode,
-        sided=sided, rowmasked=rowmasked,
+        sided=sided, rowmasked=rowmasked, dot=dot,
     )
     # compiled-out operands (rowmask/side = None) are not streamed at all —
     # a dead [S, n] zeros array would still cost a VMEM DMA per grid step
@@ -491,6 +510,7 @@ def _loop_kernel(
     sb: int,
     rounds: int,
     mode: str,
+    dot: str = "bf16",
 ):
     """The whole-run kernel template: `rounds` rounds of any LoopAlgo for
     `sb` scenarios per grid step, state resident in VMEM.
@@ -552,11 +572,7 @@ def _loop_kernel(
                 # mailbox-size trick): shared by the matmul operand and the
                 # self-delivery correction
                 oh = (vals[None, :] == rows) | (rows == num_values)
-                counts = jnp.dot(
-                    (oh & senders[None, :]).astype(jnp.bfloat16),
-                    keep.astype(jnp.bfloat16),
-                    preferred_element_type=jnp.float32,
-                )
+                counts = _count_dot(oh & senders[None, :], keep, dot)
                 # self-delivery (ho | i == j): active lanes always hear
                 # themselves, independent of colmask/p8
                 counts = counts + (oh & active[None, :]).astype(jnp.float32)
@@ -593,7 +609,7 @@ def _loop_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("algo", "rounds", "mode", "sb", "interpret"),
+    static_argnames=("algo", "rounds", "mode", "sb", "interpret", "dot"),
 )
 def hist_loop(
     algo: LoopAlgo,
@@ -610,6 +626,7 @@ def hist_loop(
     mode: str = "hw",
     sb: int = 8,
     interpret: bool = False,
+    dot: str = "bf16",
 ):
     """Run a whole LoopAlgo workload in one Pallas kernel.
 
@@ -635,6 +652,7 @@ def hist_loop(
     smem = pl.BlockSpec((S,), lambda b: (0,), memory_space=pltpu.SMEM)
     kernel = functools.partial(
         _loop_kernel, algo=algo, v_pad=v_pad, sb=sb, rounds=rounds, mode=mode,
+        dot=dot,
     )
     n_out = n_state + 2
     outs = pl.pallas_call(
@@ -661,7 +679,7 @@ def hist_loop(
 @functools.partial(
     jax.jit,
     static_argnames=("num_values", "rounds", "after_decision", "mode", "sb",
-                     "interpret"),
+                     "interpret", "dot"),
 )
 def otr_loop(
     x0: jnp.ndarray,        # [S, n] int32 initial estimates
@@ -679,6 +697,7 @@ def otr_loop(
     mode: str = "hw",
     sb: int = 8,
     interpret: bool = False,
+    dot: str = "bf16",
 ):
     """Run the whole OTR flagship workload in one Pallas kernel (the OtrLoop
     instance of `hist_loop`; the historical entry point — bench.py's
@@ -692,6 +711,7 @@ def otr_loop(
     (x, dec, decision, after), done, dround = hist_loop(
         algo, x0, crashed, side, crash_round, heal_round, rotate_down, p8,
         salt0, salt1, rounds=rounds, mode=mode, sb=sb, interpret=interpret,
+        dot=dot,
     )
     return (x, dec.astype(bool), decision, after, done, dround)
 

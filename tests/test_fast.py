@@ -371,3 +371,31 @@ def test_benor_loop_parity_vs_run_hist():
         np.asarray(state2.decision), np.asarray(state.decision))
     np.testing.assert_array_equal(np.asarray(done2), np.asarray(done))
     np.testing.assert_array_equal(np.asarray(dround2), np.asarray(dround))
+
+
+def test_otr_loop_i8_dot_parity():
+    """The int8 count-matmul mode (the v5e MXU A/B candidate,
+    bench.py --dot i8) is bit-identical to the bf16 default — both are
+    exact integer counts, only the MXU dtype differs."""
+    n, rounds = N, 6
+    key = jax.random.PRNGKey(23)
+    mix = fast.standard_mix(key, S, n, p_drop=0.2, f=3, crash_round=1)
+    init_vals = jax.random.randint(
+        jax.random.fold_in(key, 5), (n,), 0, V, dtype=jnp.int32
+    )
+    rnd = fast.OtrHist(n_values=V, after_decision=2)
+
+    def state0():
+        return OtrState(
+            x=jnp.broadcast_to(init_vals, (S, n)).astype(jnp.int32),
+            decided=jnp.zeros((S, n), dtype=bool),
+            decision=jnp.full((S, n), -1, dtype=jnp.int32),
+            after=jnp.full((S, n), 2, dtype=jnp.int32),
+        )
+
+    a = fast.run_otr_loop(rnd, state0(), mix, max_rounds=rounds,
+                          mode="hash", interpret=True, dot="bf16")
+    b = fast.run_otr_loop(rnd, state0(), mix, max_rounds=rounds,
+                          mode="hash", interpret=True, dot="i8")
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
