@@ -115,15 +115,19 @@ def build_parser():
     ap.add_argument("--parity", type=int, default=0, metavar="K",
                     help="also run K scenarios through both engines and report agreement")
     ap.add_argument("--ladder", action="store_true",
-                    help="also run the 5-rung BASELINE config ladder (one JSON line each)")
+                    help="also run the 5-rung BASELINE config ladder (one JSON line each); "
+                         "DEFAULT ON when the backend is a real accelerator")
+    ap.add_argument("--no-ladder", action="store_true",
+                    help="skip the ladder even on a real accelerator")
     ap.add_argument("--ladder-only", type=str, default=None,
                     help="comma-separated rung names (implies --ladder)")
     # crash-proofing knobs (driver mode)
     ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--probe-timeout", type=float, default=240.0,
                     help="seconds before the backend-init probe is killed")
-    ap.add_argument("--watchdog", type=float, default=2400.0,
-                    help="seconds before the bench worker is killed")
+    ap.add_argument("--watchdog", type=float, default=3600.0,
+                    help="seconds before the bench worker is killed (the "
+                         "ladder, when it runs, is budgeted at 60%% of this)")
     ap.add_argument("--no-subprocess", action="store_true",
                     help="run the bench in-process (dev/tests; no hang protection)")
     return ap
@@ -301,7 +305,7 @@ def worker_main(args):
             )
         return fast.run_hist(
             rnd, state0, lambda s: s.decided, mix,
-            max_rounds=rounds, mode=mode, interpret=interpret,
+            max_rounds=rounds, mode=mode, interpret=interpret, dot=args.dot,
         )
 
     def make_fused_bench(S, engine="fused"):
@@ -384,7 +388,13 @@ def worker_main(args):
         return agree / max(total, 1)
 
     ladder_results = []
-    if args.ladder or args.ladder_only:
+    # the unattended end-of-round run must produce the ladder artifact too
+    # (BENCH_LADDER.json): on a real accelerator the ladder is on by
+    # default, each rung crash-isolated; the flagship line stays LAST
+    run_ladder_now = args.ladder or args.ladder_only or (
+        jax.default_backend() != "cpu" and not args.no_ladder
+    )
+    if run_ladder_now:
         from round_tpu.apps.ladder import RUNGS, run_ladder
 
         only = None
@@ -395,7 +405,12 @@ def worker_main(args):
                 raise SystemExit(
                     f"unknown ladder rung(s) {unknown}; valid: {sorted(RUNGS)}"
                 )
-        ladder_results = run_ladder(only=only)
+        # the ladder shares the driver's watchdog with the flagship: cap
+        # it at 60% so a slow ladder degrades to skipped rungs, never to a
+        # killed worker with no flagship line
+        ladder_results = run_ladder(
+            only=only, budget_s=args.watchdog * 0.6 if only is None else None
+        )
         for r in ladder_results:
             print(json.dumps(r), flush=True)
         if only is None:  # subset runs must not clobber the full record

@@ -455,11 +455,34 @@ RUNGS = {
 
 
 def run_ladder(
-    only: Optional[List[str]] = None, repeats: int = 2
+    only: Optional[List[str]] = None, repeats: int = 2,
+    budget_s: Optional[float] = None,
 ) -> List[Dict[str, Any]]:
+    """Run the rungs CRASH-ISOLATED and (optionally) time-budgeted: the
+    ladder runs unattended inside the driver's bench pass, so one rung's
+    failure must cost that rung's number — never the whole artifact — and
+    the ladder must not eat the flagship's watchdog (`budget_s`: remaining
+    rungs record "skipped" once exceeded)."""
+    import sys
+
     out = []
+    t0 = time.perf_counter()
     for name, fn in RUNGS.items():
         if only and name not in only:
             continue
-        out.append(fn(repeats=repeats))
+        if budget_s is not None and time.perf_counter() - t0 > budget_s:
+            print(f"warning: ladder budget ({budget_s:.0f}s) exhausted; "
+                  f"skipping rung {name}", file=sys.stderr)
+            out.append({"metric": f"ladder_{name}",
+                        "error": "skipped: ladder budget exhausted"})
+            continue
+        try:
+            out.append(fn(repeats=repeats))
+        except Exception as e:  # noqa: BLE001 - recorded, not fatal
+            print(f"warning: ladder rung {name} failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            out.append({
+                "metric": f"ladder_{name}",
+                "error": f"{type(e).__name__}: {e}"[:300],
+            })
     return out

@@ -301,6 +301,7 @@ def run_hist(
     mode: str = "hw",
     sb: int = 8,
     interpret: bool = False,
+    dot: str = "bf16",
 ):
     """Scan `max_rounds` fused rounds over the full scenario batch.
 
@@ -339,6 +340,7 @@ def run_hist(
                 mode=mode,
                 sb=sb,
                 interpret=interpret,
+                dot=dot,
             ).astype(jnp.int32)
             size = jnp.sum(counts, axis=1)
             return rnd.update_counts(state, counts, size, r, n, k=k, coin=coin)
