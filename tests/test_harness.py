@@ -355,3 +355,37 @@ def test_bench_error_line_shape(capsys):
     assert line["error"] == "backend-unavailable"
     assert line["metric"] == "otr_n1024_s10000_rounds_per_sec"
     assert line["value"] == 0.0 and line["unit"] == "rounds/sec"
+
+
+def test_ladder_crash_isolation_and_budget():
+    """run_ladder must survive a failing rung (error entry, not a crash —
+    it runs unattended inside the driver's bench pass) and must skip
+    rungs once the time budget is exhausted."""
+    from round_tpu.apps import ladder as lad
+
+    orig = dict(lad.RUNGS)
+    try:
+        lad.RUNGS.clear()
+        lad.RUNGS["boom"] = lambda repeats=2: (_ for _ in ()).throw(
+            RuntimeError("kaboom"))
+        lad.RUNGS["ok"] = lambda repeats=2: {"metric": "ladder_ok",
+                                             "extra": {}}
+        out = lad.run_ladder()
+        assert out[0]["metric"] == "ladder_boom"
+        assert "kaboom" in out[0]["error"]
+        assert out[1]["metric"] == "ladder_ok"
+
+        import time as _t
+
+        lad.RUNGS.clear()
+        lad.RUNGS["slow"] = lambda repeats=2: (_t.sleep(0.2),
+                                               {"metric": "ladder_slow",
+                                                "extra": {}})[1]
+        lad.RUNGS["late"] = lambda repeats=2: {"metric": "ladder_late",
+                                               "extra": {}}
+        out = lad.run_ladder(budget_s=0.05)
+        assert out[0]["metric"] == "ladder_slow"          # started in budget
+        assert out[1].get("error", "").startswith("skipped")
+    finally:
+        lad.RUNGS.clear()
+        lad.RUNGS.update(orig)
