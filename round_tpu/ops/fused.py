@@ -749,3 +749,209 @@ def hist_exchange_reference(
     return jax.vmap(one)(
         vals, active, colmask, rowmask, side, salt0, salt1r, p8
     )
+
+
+# ---------------------------------------------------------------------------
+# LastVoting whole-run kernel: coordinator-centric rounds are O(n) each
+# ---------------------------------------------------------------------------
+
+def _lv_keep(idx, s0, salt1r, p8):
+    """One hash-keep VECTOR (a row or column of the link mask) — bit-exact
+    with scenarios.link_bernoulli / from_fault_params at the same indices.
+    LastVoting's rounds each touch ONE receiver row (collect/ack at the
+    coordinator) or ONE sender column (the coordinator's broadcasts), so
+    the whole round costs O(n) hashes instead of the O(n²) mask the
+    general engine draws."""
+    z = idx.astype(jnp.uint32) * jnp.uint32(_GOLD) + s0.astype(jnp.uint32)
+    z = z ^ salt1r.astype(jnp.uint32)
+    keep = (_fmix32(z) & jnp.uint32(0xFF)) >= p8.astype(jnp.uint32)
+    return keep | (p8 <= 0)
+
+
+def _lv_kernel(
+    x0_ref, crashed_ref, side_ref,
+    crash_round_ref, heal_round_ref, rotate_ref, p8_ref,
+    salt0_ref, salt1_ref,
+    *outs,
+    sb: int,
+    rounds: int,
+):
+    """The whole LastVoting run (4-round phases, rotating coordinator,
+    LastVoting.scala:80-212) for `sb` scenarios per grid step, state in
+    VMEM.  Mask semantics replicate the general engine's hash mode exactly
+    (ho = (colmask ∧ side-eq ∧ keep) ∨ self; deliver = ho ∧ dest ∧ active)
+    — differential-pinned lane-for-lane by tests/test_fast.py."""
+    n = x0_ref.shape[1]
+    b = pl.program_id(0)
+    lane_ids = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)[0]
+    half = jnp.int32(n // 2)
+
+    def per_scenario(s, _):
+        g = b * sb + s
+        x0 = x0_ref[s]
+        crashed = crashed_ref[s] != 0
+        side = side_ref[s]
+        cr, hr = crash_round_ref[g], heal_round_ref[g]
+        rot, p8 = rotate_ref[g], p8_ref[g]
+        s0, s1 = salt0_ref[g], salt1_ref[g]
+        period = jnp.maximum(rot, 1)
+
+        def sc_at(vec, onehot, neutral):
+            """Scalar extraction by masked reduction (no dynamic gather)."""
+            return jnp.sum(jnp.where(onehot, vec, neutral))
+
+        def round_body(r, carry):
+            (x, ts, ready, commit, vote, decided, dec, done, dround) = carry
+            phase = r // 4
+            k = r % 4
+            coord = phase % n
+            coh = lane_ids == coord
+            alive = ~(crashed & (r >= cr))
+            victim = (r // period) % n
+            rotated = (lane_ids == victim) & (rot > 0)
+            colmask = alive & ~rotated
+            side_r = jnp.where(r < hr, side, 0)
+            salt1r = r * jnp.int32(_RMIX) + s1
+            active = ~done
+            side_c = sc_at(side_r, coh, 0)
+
+            def to_coord_mask(guard):
+                # mailbox mask at receiver = coord, senders guarded
+                keep = _lv_keep(coord * n + lane_ids, s0, salt1r, p8)
+                ho = (colmask & (side_r == side_c) & keep) | coh
+                return ho & active & guard
+
+            def from_coord(guard_c):
+                # per-receiver delivery of the coordinator's broadcast
+                keep = _lv_keep(lane_ids * n + coord, s0, salt1r, p8)
+                cm_c = jnp.any(coh & colmask)
+                act_c = jnp.any(coh & active)
+                ho = (cm_c & (side_r == side_c) & keep) | coh
+                return ho & act_c & guard_c
+
+            no_exit = jnp.zeros((n,), dtype=bool)
+
+            def b_collect(us):
+                x, ts, ready, commit, vote, decided, dec = us
+                mask = to_coord_mask(jnp.ones((n,), dtype=bool))
+                have = jnp.sum(mask.astype(jnp.int32))
+                ts_m = jnp.where(mask, ts, jnp.int32(-2))
+                best = jnp.max(ts_m)
+                cand = mask & (ts_m == best)
+                # first True = smallest sender id (Mailbox.arg_best)
+                bi = jnp.argmax(cand)
+                best_x = sc_at(x, lane_ids == bi, 0)
+                act = coh & ((have > half) | ((r == 0) & (have > 0)))
+                vote2 = jnp.where(act, best_x, vote)
+                commit2 = commit | act
+                return (x, ts, ready, commit2, vote2, decided, dec), no_exit
+
+            def b_propose(us):
+                x, ts, ready, commit, vote, decided, dec = us
+                commit_c = jnp.any(coh & commit)
+                got = from_coord(commit_c)
+                vote_c = sc_at(vote, coh, 0)
+                x2 = jnp.where(got, vote_c, x)
+                ts2 = jnp.where(got, phase, ts)
+                return (x2, ts2, ready, commit, vote, decided, dec), no_exit
+
+            def b_ack(us):
+                x, ts, ready, commit, vote, decided, dec = us
+                mask = to_coord_mask(ts == phase)
+                have = jnp.sum(mask.astype(jnp.int32))
+                ready2 = ready | (coh & (have > half))
+                return (x, ts, ready2, commit, vote, decided, dec), no_exit
+
+            def b_decide(us):
+                x, ts, ready, commit, vote, decided, dec = us
+                ready_c = jnp.any(coh & ready)
+                got = from_coord(ready_c)
+                vote_c = sc_at(vote, coh, 0)
+                newly = got & ~decided
+                decided2 = decided | got
+                dec2 = jnp.where(newly, vote_c, dec)
+                ready2 = jnp.zeros((n,), dtype=bool)
+                commit2 = jnp.zeros((n,), dtype=bool)
+                return (x, ts, ready2, commit2, vote, decided2, dec2), got
+
+            us = (x, ts, ready, commit, vote, decided, dec)
+            us2, exit_ = jax.lax.switch(
+                k, [b_collect, b_propose, b_ack, b_decide], us
+            )
+            us = tuple(jnp.where(active, a2, a) for a2, a in zip(us2, us))
+            done = done | (active & exit_)
+            dround = jnp.where(us[5] & (dround < 0), r, dround)
+            return (*us, done, dround)
+
+        init = (
+            x0,
+            jnp.full((n,), -1, jnp.int32),
+            jnp.zeros((n,), dtype=bool),
+            jnp.zeros((n,), dtype=bool),
+            jnp.zeros((n,), jnp.int32),
+            jnp.zeros((n,), dtype=bool),
+            jnp.full((n,), -1, jnp.int32),
+            jnp.zeros((n,), dtype=bool),
+            jnp.full((n,), -1, jnp.int32),
+        )
+        final = jax.lax.fori_loop(0, rounds, round_body, init)
+        for i, a in enumerate(final):
+            outs[i][s] = a.astype(jnp.int32)
+        return 0
+
+    jax.lax.fori_loop(0, sb, per_scenario, 0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("rounds", "sb", "interpret"),
+)
+def lv_loop(
+    x0: jnp.ndarray,        # [S, n] int32 initial estimates
+    crashed: jnp.ndarray,   # [S, n] bool
+    side: jnp.ndarray,      # [S, n] int32
+    crash_round: jnp.ndarray,   # [S] int32
+    heal_round: jnp.ndarray,    # [S] int32
+    rotate_down: jnp.ndarray,   # [S] int32
+    p8: jnp.ndarray,            # [S] int32
+    salt0: jnp.ndarray,         # [S] int32
+    salt1: jnp.ndarray,         # [S] int32 (UNmixed; rounds premix in-kernel)
+    rounds: int,
+    sb: int = 8,
+    interpret: bool = False,
+):
+    """The whole LastVoting run in one Pallas kernel — O(n) per round per
+    scenario (the coordinator-centric rounds never need the n×n mask).
+    Hash-sampler masks only: they are O(n) here AND bit-replayable in the
+    general engine (scenarios.from_mix_row), so every run is parity-capable.
+
+    Returns (x, ts, ready, commit, vote, decided, decision, done,
+    decided_round), each [S, n] (bools as bool)."""
+    S, n = x0.shape
+    orig_S = S
+    (x0, crashed, side, crash_round, heal_round, rotate_down, p8, salt0,
+     salt1), S = _pad_scenarios(
+        sb, x0, crashed, side, crash_round, heal_round, rotate_down, p8,
+        salt0, salt1,
+    )
+    grid = (S // sb,)
+    blk = pl.BlockSpec((sb, n), lambda b: (b, 0))
+    smem = pl.BlockSpec((S,), lambda b: (0,), memory_space=pltpu.SMEM)
+    kernel = functools.partial(_lv_kernel, sb=sb, rounds=rounds)
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[blk, blk, blk] + [smem] * 6,
+        out_specs=[blk] * 9,
+        out_shape=[jax.ShapeDtypeStruct((S, n), jnp.int32)] * 9,
+        interpret=interpret,
+    )(
+        x0.astype(jnp.int32), crashed.astype(jnp.int32),
+        side.astype(jnp.int32), crash_round.astype(jnp.int32),
+        heal_round.astype(jnp.int32), rotate_down.astype(jnp.int32),
+        p8.astype(jnp.int32), salt0.astype(jnp.int32),
+        salt1.astype(jnp.int32),
+    )
+    o = [a[:orig_S] for a in outs]
+    return (o[0], o[1], o[2].astype(bool), o[3].astype(bool), o[4],
+            o[5].astype(bool), o[6], o[7].astype(bool), o[8])

@@ -291,13 +291,16 @@ def test_ladder_benor_rung_smoke():
 
 
 def test_ladder_lv_rung_smoke():
-    """Third rung (LastVoting n=256, crash + coordinator-down families)
-    end-to-end on CPU with BOTH parity flags — the ladder's flagship
-    Paxos-shaped rung (testLV.sh analogue)."""
+    """Third rung (LastVoting on its whole-run kernel, crash family)
+    end-to-end on CPU: loop engine timed, lane-exact differential parity,
+    spec-checker invariants — the ladder's flagship Paxos-shaped rung
+    (testLV.sh analogue)."""
     from round_tpu.apps.ladder import rung_lv
 
-    r = rung_lv(repeats=1)
-    assert r["metric"] == "ladder_lv_n256"
+    r = rung_lv(repeats=1, n=32, S=24)
+    assert r["metric"] == "ladder_lv_n32"
+    assert r["extra"]["engine"] == "loop"
+    assert r["extra"]["parity_frac"] == 1.0
     assert r["extra"]["invariant_parity"] is True
     assert r["extra"]["property_parity"] is True
     assert r["extra"]["frac_lanes_decided"] == 1.0
