@@ -435,6 +435,7 @@ def run_floodmin_loop(
     mode: str = "hw",
     sb: int = 8,
     interpret: bool = False,
+    dot: str = "bf16",
 ):
     """FloodMin's whole run as ONE Pallas kernel (ops.fused.FloodMinLoop) —
     drop-in for run_hist(FloodMinHist(...), fresh state0, ...); same
@@ -447,7 +448,7 @@ def run_floodmin_loop(
     (x, dec, decision), done, dround = fused.hist_loop(
         fused.FloodMinLoop(num_values=rnd.num_values, f=rnd.f),
         state0.x, *_mix_args(mix),
-        rounds=max_rounds, mode=mode, sb=sb, interpret=interpret,
+        rounds=max_rounds, mode=mode, sb=sb, interpret=interpret, dot=dot,
     )
     state = FloodMinState(x=x, decided=dec.astype(bool), decision=decision)
     return state, done, dround
@@ -461,6 +462,7 @@ def run_benor_loop(
     mode: str = "hw",
     sb: int = 8,
     interpret: bool = False,
+    dot: str = "bf16",
 ):
     """Ben-Or's whole run as ONE Pallas kernel (ops.fused.BenOrLoop, two
     subrounds per phase dispatched in-kernel) — drop-in for
@@ -481,7 +483,7 @@ def run_benor_loop(
     (x, can, vote, dec, decision), done, dround = fused.hist_loop(
         fused.BenOrLoop(),
         state0.x.astype(jnp.int32), *_mix_args(mix),
-        rounds=max_rounds, mode=mode, sb=sb, interpret=interpret,
+        rounds=max_rounds, mode=mode, sb=sb, interpret=interpret, dot=dot,
     )
     state = BenOrState(
         x=x.astype(bool),

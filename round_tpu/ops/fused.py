@@ -526,7 +526,26 @@ def _loop_kernel(
     onehot operand is the senders mask), so mailbox SIZE falls out of the
     same MXU pass as the per-value counts.  Multi-subround algorithms
     (phase_len > 1) dispatch on r % phase_len with lax.switch; every branch
-    shares the same matmul structure so the kernel stays one fused loop."""
+    shares the same matmul structure so the kernel stays one fused loop.
+
+    v2 structure (PERF_MODEL.md): each scenario takes one of two compiled
+    round loops, selected by a scalar `lax.cond` on its drop rate:
+
+      * p8 > 0 — the random-mask path.  The (n, n) keep mask rides the
+        fori_loop carry pre-cast to the dot dtype: round r consumes the
+        carried mask while generating round r+1's (PRNG + compare, no
+        data dependency on the matmul), so Mosaic may overlap VPU
+        mask-gen with the MXU count pass.  The partition side-equality
+        compare runs only for scenarios that actually carry a partition.
+      * p8 = 0 — the structured path: no PRNG ever.  While the partition
+        is up the mask is the side-eq compare alone; once healed keep ≡ 1
+        off-diagonal and the matmul collapses to the O(n·V) identity
+        counts[v, j] = Σᵢ oh[v, i] − oh[v, j] (self re-added as always).
+
+    Both paths produce bit-identical counts to the v1 kernel (the mask
+    bits per (scenario, round) are unchanged in both hash and hw modes —
+    only where/whether they are computed moved), so the differential
+    parity pins carry over unchanged."""
     x0_ref, crashed_ref, side_ref = refs[0:3]
     (crash_round_ref, heal_round_ref, rotate_ref, p8_ref,
      salt0_ref, salt1_ref) = refs[3:9]
@@ -540,6 +559,7 @@ def _loop_kernel(
     ) != jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
     rows = jax.lax.broadcasted_iota(jnp.int32, (v_pad, n), 0)
     lane_ids = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)[0]
+    dot_dtype = jnp.int8 if dot == "i8" else jnp.bfloat16
 
     def per_scenario(s, _):
         g = b * sb + s
@@ -550,20 +570,21 @@ def _loop_kernel(
         rot, p8 = rotate_ref[g], p8_ref[g]
         s0, s1 = salt0_ref[g], salt1_ref[g]
         period = jnp.maximum(rot, 1)
+        # no scalar extraction in Mosaic: lane-0's side via masked reduction
+        side0 = jnp.sum(jnp.where(lane_ids == 0, side, 0))
+        has_side = jnp.any(side != side0)
 
-        def round_body(r, carry):
-            us, done, dround = carry[:-2], carry[-2], carry[-1]
+        def round_masks(r):
             alive = ~(crashed & (r >= cr))
             victim = (r // period) % n
             rotated = (lane_ids == victim) & (rot > 0)
             colmask = alive & ~rotated
-            side_r = jnp.where(r < hr, side, 0)
-            salt1r = r * jnp.int32(_RMIX) + s1
-            active = ~done
-            senders = colmask & active & (p8 < 256)
+            return colmask
 
-            keep = _keep_mask(n, mode, s0, salt1r, p8, notdiag)
-            keep = keep & (side_r[:, None] == side_r[None, :])
+        def subrounds(r, us, active, counts_of):
+            """Shared payload → counts → update dispatch.  counts_of maps
+            the masked value-indicator (v_pad, n) bool and the raw
+            indicator to the delivered counts."""
             coin = hash_coin(s0, s1, r, lane_ids) if algo.needs_coin else None
 
             def body_k(k, us):
@@ -572,7 +593,7 @@ def _loop_kernel(
                 # mailbox-size trick): shared by the matmul operand and the
                 # self-delivery correction
                 oh = (vals[None, :] == rows) | (rows == num_values)
-                counts = _count_dot(oh & senders[None, :], keep, dot)
+                counts = counts_of(oh)
                 # self-delivery (ho | i == j): active lanes always hear
                 # themselves, independent of colmask/p8
                 counts = counts + (oh & active[None, :]).astype(jnp.float32)
@@ -580,26 +601,96 @@ def _loop_kernel(
                 return algo.update(r, k, us, counts, size, n, coin)
 
             if K == 1:
-                us2, exit_ = body_k(0, us)
-            else:
-                us2, exit_ = jax.lax.switch(
-                    r % K,
-                    [functools.partial(body_k, k) for k in range(K)],
-                    us,
-                )
-            us = tuple(
-                jnp.where(active, a2, a) for a2, a in zip(us2, us)
+                return body_k(0, us)
+            return jax.lax.switch(
+                r % K,
+                [functools.partial(body_k, k) for k in range(K)],
+                us,
             )
+
+        def finish_round(r, us, us2, exit_, active, done, dround):
+            us = tuple(jnp.where(active, a2, a) for a2, a in zip(us2, us))
             done = done | (active & exit_)
             decided = us[algo.decided_slot]
             dround = jnp.where(decided & (dround < 0), r, dround)
-            return (*us, done, dround)
+            return us, done, dround
+
+        def gen_keep(r):
+            """Round-r delivery mask, pre-cast to the dot dtype.  Side-eq
+            only runs for partition-carrying scenarios (scalar cond)."""
+            salt1r = r * jnp.int32(_RMIX) + s1
+            keep = _keep_mask(n, mode, s0, salt1r, p8, notdiag)
+            keep = jax.lax.cond(
+                has_side & (r < hr),
+                lambda k: k & (side[:, None] == side[None, :]),
+                lambda k: k,
+                keep,
+            )
+            return keep.astype(dot_dtype)
 
         init = algo.init(x0) + (
             jnp.zeros((n,), dtype=bool),
             jnp.full((n,), -1, jnp.int32),
         )
-        final = jax.lax.fori_loop(0, rounds, round_body, init)
+
+        def run_random(_):
+            def round_body(r, carry):
+                keep = carry[-1]
+                us, done, dround = carry[:-3], carry[-3], carry[-2]
+                colmask = round_masks(r)
+                active = ~done
+                senders = colmask & active & (p8 < 256)
+                us2, exit_ = subrounds(
+                    r, us, active,
+                    lambda oh: _count_dot(oh & senders[None, :], keep, dot),
+                )
+                # next round's mask: depends only on (salts, r+1), never on
+                # round-r state — free to overlap with the matmul above
+                keep_next = gen_keep(r + 1)
+                us, done, dround = finish_round(
+                    r, us, us2, exit_, active, done, dround
+                )
+                return (*us, done, dround, keep_next)
+
+            final = jax.lax.fori_loop(
+                0, rounds, round_body, (*init, gen_keep(0))
+            )
+            return final[:-1]
+
+        def run_structured(_):
+            # loop-invariant: the partition mask never changes while up
+            side_keep = (
+                (side[:, None] == side[None, :]) & notdiag
+            ).astype(dot_dtype)
+
+            def round_body(r, carry):
+                us, done, dround = carry[:-2], carry[-2], carry[-1]
+                colmask = round_masks(r)
+                active = ~done
+                senders = colmask & active & (p8 < 256)
+
+                def counts_of(oh):
+                    ohs = oh & senders[None, :]
+
+                    def sided(o):
+                        return _count_dot(o, side_keep, dot)
+
+                    def healed(o):
+                        of = o.astype(jnp.float32)
+                        total = jnp.sum(of, axis=1, keepdims=True)
+                        return total - of
+
+                    return jax.lax.cond(has_side & (r < hr), sided, healed, ohs)
+
+                us2, exit_ = subrounds(r, us, active, counts_of)
+                us, done, dround = finish_round(
+                    r, us, us2, exit_, active, done, dround
+                )
+                return (*us, done, dround)
+
+            return jax.lax.fori_loop(0, rounds, round_body, init)
+
+        final = jax.lax.cond(p8 > 0, run_random, run_structured, 0)
         for i, a in enumerate(final):
             outs[i][s] = a.astype(jnp.int32)
         return 0

@@ -140,6 +140,7 @@ def sharded_hist_loop(
     mode: str = "hw",
     sb: int = 8,
     interpret: bool = False,
+    dot: str = "bf16",
 ):
     """The flagship engine on the mesh: the whole-run loop kernel
     (ops.fused.hist_loop) sharded over SCENARIO_AXIS — pure data
@@ -170,7 +171,7 @@ def sharded_hist_loop(
     def run(x0, crashed, side, cr, hr, rot, p8, s0, s1):
         return _fused.hist_loop(
             algo, x0, crashed, side, cr, hr, rot, p8, s0, s1,
-            rounds=rounds, mode=mode, sb=sb, interpret=interpret,
+            rounds=rounds, mode=mode, sb=sb, interpret=interpret, dot=dot,
         )
 
     return jax.jit(run)(

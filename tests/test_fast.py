@@ -401,6 +401,78 @@ def test_otr_loop_i8_dot_parity():
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+def test_otr_loop_drop_plus_partition_parity():
+    """The v2 loop kernel's random-mask path with a LIVE partition (p8 > 0
+    AND nonuniform side until heal) — a combination standard_mix never
+    produces (its only sided family has p8 = 0), so it needs its own pin.
+    Also covers side healing mid-run on both kernel paths."""
+    n, rounds = N, 6
+    key = jax.random.PRNGKey(31)
+    S_ = 6
+    side = (jnp.arange(n) % 2).astype(jnp.int32)
+    mix = fast.fault_free(key, S_, n)
+    mix = mix.replace(
+        side=jnp.broadcast_to(side, (S_, n)),
+        heal_round=jnp.asarray([3, 3, 0, 3, 2, 6], jnp.int32),
+        p8=jnp.asarray([64, 0, 64, 13, 128, 0], jnp.int32),
+    )
+    init_vals = jax.random.randint(
+        jax.random.fold_in(key, 2), (n,), 0, V, dtype=jnp.int32
+    )
+    state, done, dround = _fast_otr(mix, n, init_vals, rounds)
+    rnd = fast.OtrHist(n_values=V, after_decision=2)
+    state0 = OtrState(
+        x=jnp.broadcast_to(init_vals, (S_, n)).astype(jnp.int32),
+        decided=jnp.zeros((S_, n), dtype=bool),
+        decision=jnp.full((S_, n), -1, dtype=jnp.int32),
+        after=jnp.full((S_, n), 2, dtype=jnp.int32),
+    )
+    state2, done2, dround2 = fast.run_otr_loop(
+        rnd, state0, mix, max_rounds=rounds, mode="hash", sb=4,
+        interpret=True,
+    )
+    for got, want in (
+        (state2.x, state.x), (state2.decided, state.decided),
+        (state2.decision, state.decision), (done2, done),
+        (dround2, dround),
+    ):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_floodmin_benor_loop_i8_dot_parity():
+    """dot="i8" is plumbed through every hist_loop wrapper (ADVICE r03):
+    FloodMin and Ben-Or whole-run kernels are bit-identical across dot
+    dtypes."""
+    n = N
+    key = jax.random.PRNGKey(37)
+    mix = fast.standard_mix(key, S, n, p_drop=0.15, f=3, crash_round=1)
+
+    f = 3
+    init_vals = jax.random.randint(
+        jax.random.fold_in(key, 4), (n,), 0, V, dtype=jnp.int32
+    )
+    fm = fast.FloodMinHist(n_values=V, f=f)
+    a = fast.run_floodmin_loop(fm, _floodmin_state0(S, n, init_vals), mix,
+                               max_rounds=f + 2, mode="hash",
+                               interpret=True, dot="bf16")
+    b = fast.run_floodmin_loop(fm, _floodmin_state0(S, n, init_vals), mix,
+                               max_rounds=f + 2, mode="hash",
+                               interpret=True, dot="i8")
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    init_bits = (jnp.arange(n) % 2).astype(bool)
+    bo = fast.BenOrHist()
+    a = fast.run_benor_loop(bo, _benor_state0(S, n, init_bits), mix,
+                            max_rounds=8, mode="hash",
+                            interpret=True, dot="bf16")
+    b = fast.run_benor_loop(bo, _benor_state0(S, n, init_bits), mix,
+                            max_rounds=8, mode="hash",
+                            interpret=True, dot="i8")
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
 def test_lv_loop_parity_vs_general_engine():
     """The LastVoting whole-run kernel (ops.fused.lv_loop — O(n) per round,
     coordinator-centric mask rows/columns) is lane-exact vs
