@@ -112,8 +112,10 @@ def build_parser():
     ap.add_argument("--dot", choices=["bf16", "i8"], default="bf16",
                     help="loop-kernel count-matmul dtype (i8 = int8 MXU, "
                          "an A/B candidate on v5e-class chips)")
-    ap.add_argument("--parity", type=int, default=0, metavar="K",
-                    help="also run K scenarios through both engines and report agreement")
+    ap.add_argument("--parity", type=int, default=8, metavar="K",
+                    help="also run K scenarios through both engines and "
+                         "report agreement (0 = off; replay cost is trivial "
+                         "next to the timed run, so parity is ON by default)")
     ap.add_argument("--ladder", action="store_true",
                     help="also run the 5-rung BASELINE config ladder (one JSON line each); "
                          "DEFAULT ON when the backend is a real accelerator")
@@ -236,16 +238,20 @@ def driver_main(args, argv):
     # mid-run wedge this way), keeping the flagship/error line last
     lines = out.strip().splitlines() if out.strip() else []
     if status == "ok":
-        parseable = False
+        # success requires THE FLAGSHIP metric line, not just any JSON —
+        # a worker that printed ladder lines but died before the flagship
+        # must still record an error artifact (ADVICE r03)
+        flagship = flagship_metric_name(args)
+        has_flagship = False
         for ln in lines:
             print(ln, flush=True)
             if ln.startswith("{"):
                 try:
-                    json.loads(ln)
-                    parseable = True
+                    if json.loads(ln).get("metric") == flagship:
+                        has_flagship = True
                 except ValueError:
                     pass
-        if not parseable:
+        if not has_flagship:
             return _emit_error(args, "no-metric-line", {**info, **diag})
         return 0
     for ln in lines:

@@ -49,3 +49,26 @@ class Algorithm:
     def decision(self, state):
         """[n] values — the decided value per lane (garbage where undecided)."""
         raise NotImplementedError
+
+    def adopt_decision(self, state, decision):
+        """Adopt an out-of-band decision (the host runtime's FLAG_DECISION
+        recovery — a peer that already decided replies with the value when
+        it sees our late traffic, PerfTest.scala:40-60).  Default: set the
+        conventional `decided`/`decision` state fields.  Returns the
+        updated state, or None when this state cannot adopt (no such
+        fields, or a malformed value) — the runner then ignores the
+        message."""
+        import numpy as np
+
+        if not (hasattr(state, "replace") and hasattr(state, "decided")
+                and hasattr(state, "decision")):
+            return None
+        d = np.asarray(state.decided)
+        v = np.asarray(state.decision)
+        try:
+            val = np.asarray(decision, dtype=v.dtype).reshape(v.shape)
+        except Exception:  # noqa: BLE001 — byzantine value: ignore, run on
+            return None
+        return state.replace(
+            decided=np.full(d.shape, True, dtype=d.dtype), decision=val,
+        )

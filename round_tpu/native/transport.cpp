@@ -33,6 +33,17 @@
 //     rt_node_recv (the InstanceHandler's ArrayBlockingQueue analogue,
 //     InstanceHandler.scala:45).
 
+// TLS mode (rt_node_create_tls) is the reference's TCP_SSL
+// (TcpRuntime.scala:143-158, RuntimeOptions.scala:51-67): the same framed
+// protocol inside a TLS channel.  libssl is loaded with dlopen/dlsym — this
+// build environment ships the OpenSSL 3 runtime but not its headers — and
+// certificates are PEM paths supplied by the caller (runtime/transport.py
+// generates a self-signed pair when none is given, the SelfSignedCertificate
+// fallback of the reference).  Like the reference's insecure-trust default
+// for self-signed deployments, peers do not verify the certificate chain
+// (OpenSSL's SSL_VERIFY_NONE default) — TLS here provides channel privacy
+// and integrity, not peer authentication.
+
 #include <algorithm>
 #include <arpa/inet.h>
 #include <cerrno>
@@ -41,6 +52,7 @@
 #include <cstdint>
 #include <cstring>
 #include <deque>
+#include <dlfcn.h>
 #include <fcntl.h>
 #include <map>
 #include <memory>
@@ -57,6 +69,72 @@
 
 namespace {
 
+// ---------------------------------------------------------------------------
+// libssl via dlopen (no OpenSSL headers in this environment)
+// ---------------------------------------------------------------------------
+
+constexpr int kSslFiletypePem = 1;
+constexpr int kSslErrorWantRead = 2;
+constexpr int kSslErrorWantWrite = 3;
+
+struct TlsApi {
+  void *lib = nullptr;
+  const void *(*TLS_method)() = nullptr;
+  void *(*SSL_CTX_new)(const void *) = nullptr;
+  void (*SSL_CTX_free)(void *) = nullptr;
+  int (*SSL_CTX_use_certificate_chain_file)(void *, const char *) = nullptr;
+  int (*SSL_CTX_use_PrivateKey_file)(void *, const char *, int) = nullptr;
+  void *(*SSL_new)(void *) = nullptr;
+  void (*SSL_free)(void *) = nullptr;
+  int (*SSL_set_fd)(void *, int) = nullptr;
+  void (*SSL_set_accept_state)(void *) = nullptr;
+  void (*SSL_set_connect_state)(void *) = nullptr;
+  int (*SSL_read)(void *, void *, int) = nullptr;
+  int (*SSL_write)(void *, const void *, int) = nullptr;
+  int (*SSL_get_error)(const void *, int) = nullptr;
+  bool ok = false;
+};
+
+const TlsApi &tls_api() {
+  static TlsApi api = [] {
+    TlsApi a;
+    a.lib = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (!a.lib) a.lib = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+    if (!a.lib) return a;
+    auto sym = [&](const char *name) { return dlsym(a.lib, name); };
+    a.TLS_method = reinterpret_cast<const void *(*)()>(sym("TLS_method"));
+    a.SSL_CTX_new =
+        reinterpret_cast<void *(*)(const void *)>(sym("SSL_CTX_new"));
+    a.SSL_CTX_free = reinterpret_cast<void (*)(void *)>(sym("SSL_CTX_free"));
+    a.SSL_CTX_use_certificate_chain_file =
+        reinterpret_cast<int (*)(void *, const char *)>(
+            sym("SSL_CTX_use_certificate_chain_file"));
+    a.SSL_CTX_use_PrivateKey_file =
+        reinterpret_cast<int (*)(void *, const char *, int)>(
+            sym("SSL_CTX_use_PrivateKey_file"));
+    a.SSL_new = reinterpret_cast<void *(*)(void *)>(sym("SSL_new"));
+    a.SSL_free = reinterpret_cast<void (*)(void *)>(sym("SSL_free"));
+    a.SSL_set_fd = reinterpret_cast<int (*)(void *, int)>(sym("SSL_set_fd"));
+    a.SSL_set_accept_state =
+        reinterpret_cast<void (*)(void *)>(sym("SSL_set_accept_state"));
+    a.SSL_set_connect_state =
+        reinterpret_cast<void (*)(void *)>(sym("SSL_set_connect_state"));
+    a.SSL_read =
+        reinterpret_cast<int (*)(void *, void *, int)>(sym("SSL_read"));
+    a.SSL_write = reinterpret_cast<int (*)(void *, const void *, int)>(
+        sym("SSL_write"));
+    a.SSL_get_error =
+        reinterpret_cast<int (*)(const void *, int)>(sym("SSL_get_error"));
+    a.ok = a.TLS_method && a.SSL_CTX_new && a.SSL_CTX_free &&
+           a.SSL_CTX_use_certificate_chain_file &&
+           a.SSL_CTX_use_PrivateKey_file && a.SSL_new && a.SSL_free &&
+           a.SSL_set_fd && a.SSL_set_accept_state && a.SSL_set_connect_state &&
+           a.SSL_read && a.SSL_write && a.SSL_get_error;
+    return a;
+  }();
+  return api;
+}
+
 struct Msg {
   int from;
   uint64_t tag;
@@ -69,7 +147,40 @@ struct Conn {
   std::vector<uint8_t> rbuf;      // read accumulator (frames + handshake)
   bool handshaked = false;
   std::mutex wmu;                 // serializes writes from sender threads
+  // TLS state: `ssl` is the channel; an SSL object is NOT safe for
+  // concurrent SSL_read/SSL_write, so smu serializes the event loop's
+  // reads against sender-thread writes (plaintext conns never take it)
+  void *ssl = nullptr;
+  std::mutex smu;
+
+  ~Conn() {
+    if (ssl) tls_api().SSL_free(ssl);
+  }
 };
+
+// SSL_write with a NONBLOCKING fd: retry WANT_READ/WANT_WRITE with a short
+// poll until done or the deadline (TLS handshakes piggyback on the first
+// write — connect-state conns handshake here).  Caller holds c.smu.
+bool ssl_write_all(Conn &c, const uint8_t *p, size_t len, int timeout_ms) {
+  const TlsApi &api = tls_api();
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  size_t off = 0;
+  while (off < len) {
+    int k = api.SSL_write(c.ssl, p + off, static_cast<int>(len - off));
+    if (k > 0) {
+      off += static_cast<size_t>(k);
+      continue;
+    }
+    int err = api.SSL_get_error(c.ssl, k);
+    if (err != kSslErrorWantRead && err != kSslErrorWantWrite) return false;
+    if (std::chrono::steady_clock::now() >= deadline) return false;
+    pollfd pfd{c.fd, static_cast<short>(
+        err == kSslErrorWantRead ? POLLIN : POLLOUT), 0};
+    poll(&pfd, 1, 50);
+  }
+  return true;
+}
 
 bool write_all(int fd, const uint8_t *p, size_t len) {
   while (len > 0) {
@@ -102,6 +213,8 @@ struct Node {
   int id;
   int listen_fd = -1;             // TCP listen socket, or the UDP socket
   bool udp = false;
+  bool tls = false;
+  void *ssl_ctx = nullptr;        // shared SSL_CTX (server + client roles)
   int wake_pipe[2] = {-1, -1};    // poke the poll loop on shutdown/connect
   std::thread loop;
   bool running = false;
@@ -124,7 +237,10 @@ struct Node {
                                   // blocked receiver threads can unwind
                                   // BEFORE the node is destroyed
 
-  ~Node() { stop(); }
+  ~Node() {
+    stop();
+    if (ssl_ctx) tls_api().SSL_CTX_free(ssl_ctx);
+  }
 
   void stop() {
     {
@@ -302,6 +418,16 @@ struct Node {
           setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
           auto c = std::make_shared<Conn>();
           c->fd = fd;
+          if (tls) {
+            // nonblocking + server-side SSL; the handshake completes
+            // inside the SSL_read calls of the read path
+            fcntl(fd, F_SETFL, O_NONBLOCK);
+            const TlsApi &api = tls_api();
+            c->ssl = api.SSL_new(ssl_ctx);
+            if (!c->ssl) { close(fd); continue; }
+            api.SSL_set_fd(c->ssl, fd);
+            api.SSL_set_accept_state(c->ssl);
+          }
           std::lock_guard<std::mutex> l(mu);
           conns.push_back(c);
         }
@@ -309,11 +435,36 @@ struct Node {
       for (size_t k = 0; k < snapshot.size(); ++k) {
         if (!(pfds[2 + k].revents & (POLLIN | POLLHUP | POLLERR))) continue;
         auto &c = snapshot[k];
-        ssize_t got = recv(c->fd, tmp.data(), tmp.size(), 0);
-        bool healthy = got > 0;
-        if (healthy) {
-          c->rbuf.insert(c->rbuf.end(), tmp.data(), tmp.data() + got);
-          healthy = drain(*c);  // false: frame-size protocol violation
+        bool healthy = true;
+        if (tls) {
+          // drain every decrypted byte available now; WANT_READ = done.
+          // try_lock: a sender thread may hold smu for seconds inside
+          // ssl_write_all (slow peer) — blocking here would stall reads
+          // for EVERY connection; skipping leaves the bytes queued in the
+          // kernel and POLLIN re-fires on the next loop iteration
+          const TlsApi &api = tls_api();
+          std::unique_lock<std::mutex> ls(c->smu, std::try_to_lock);
+          if (!ls.owns_lock()) continue;
+          for (;;) {
+            int got = api.SSL_read(c->ssl, tmp.data(),
+                                   static_cast<int>(tmp.size()));
+            if (got > 0) {
+              c->rbuf.insert(c->rbuf.end(), tmp.data(), tmp.data() + got);
+              continue;
+            }
+            int err = api.SSL_get_error(c->ssl, got);
+            if (err == kSslErrorWantRead || err == kSslErrorWantWrite) break;
+            healthy = false;  // clean shutdown, EOF, or protocol error
+            break;
+          }
+          if (healthy) healthy = drain(*c);
+        } else {
+          ssize_t got = recv(c->fd, tmp.data(), tmp.size(), 0);
+          healthy = got > 0;
+          if (healthy) {
+            c->rbuf.insert(c->rbuf.end(), tmp.data(), tmp.data() + got);
+            healthy = drain(*c);  // false: frame-size protocol violation
+          }
         }
         if (!healthy) {
           {
@@ -369,17 +520,33 @@ struct Node {
     }
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    // handshake: our id first (TcpRuntime.scala:357-368's client hello)
-    std::vector<uint8_t> hello;
-    put_u32(hello, static_cast<uint32_t>(id));
-    if (!write_all(fd, hello.data(), hello.size())) {
-      close(fd);
-      return nullptr;
-    }
     auto c = std::make_shared<Conn>();
     c->fd = fd;
     c->peer = peer;
     c->handshaked = true;  // outbound: we know who we dialed
+    // handshake: our id first (TcpRuntime.scala:357-368's client hello);
+    // in TLS mode the hello travels INSIDE the channel (the first
+    // ssl_write_all also drives the TLS handshake, client role)
+    std::vector<uint8_t> hello;
+    put_u32(hello, static_cast<uint32_t>(id));
+    bool sent;
+    if (tls) {
+      const TlsApi &api = tls_api();
+      fcntl(fd, F_SETFL, O_NONBLOCK);
+      c->ssl = api.SSL_new(ssl_ctx);
+      if (!c->ssl) { close(fd); return nullptr; }
+      api.SSL_set_fd(c->ssl, fd);
+      api.SSL_set_connect_state(c->ssl);
+      std::lock_guard<std::mutex> ls(c->smu);
+      sent = ssl_write_all(*c, hello.data(), hello.size(), 10'000);
+    } else {
+      sent = write_all(fd, hello.data(), hello.size());
+    }
+    if (!sent) {
+      close(fd);
+      c->fd = -1;
+      return nullptr;
+    }
     {
       std::lock_guard<std::mutex> l(mu);
       conns.push_back(c);
@@ -404,7 +571,15 @@ struct Node {
     frame.insert(frame.end(), payload, payload + len);
     std::lock_guard<std::mutex> l(c->wmu);
     if (c->fd < 0) return false;
-    if (!write_all(c->fd, frame.data(), frame.size())) {
+    bool wrote;
+    if (tls) {
+      std::lock_guard<std::mutex> ls(c->smu);
+      wrote = c->fd >= 0 &&
+              ssl_write_all(*c, frame.data(), frame.size(), 10'000);
+    } else {
+      wrote = write_all(c->fd, frame.data(), frame.size());
+    }
+    if (!wrote) {
       // connection died mid-write: drop it, caller may retry (reconnect
       // semantics of TcpRuntime.scala:162-211)
       std::lock_guard<std::mutex> l2(mu);
@@ -420,10 +595,13 @@ struct Node {
 
 extern "C" {
 
-static void *node_create(int id, int listen_port, bool udp) {
+static void *node_create(int id, int listen_port, bool udp,
+                         void *tls_ctx = nullptr) {
   auto *n = new Node();
   n->id = id;
   n->udp = udp;
+  n->tls = tls_ctx != nullptr;   // before the loop thread starts: an early
+  n->ssl_ctx = tls_ctx;          // accept must already take the TLS path
   n->listen_fd = socket(AF_INET, udp ? SOCK_DGRAM : SOCK_STREAM, 0);
   if (n->listen_fd < 0) { delete n; return nullptr; }
   int one = 1;
@@ -455,6 +633,26 @@ void *rt_node_create(int id, int listen_port) {
 // datagram socket, drop-tolerant, one packet per message.
 void *rt_node_create_udp(int id, int listen_port) {
   return node_create(id, listen_port, true);
+}
+
+// TCP_SSL (TcpRuntime.scala:143-158): the framed protocol inside TLS.
+// cert/key are PEM paths (the Python layer generates a self-signed pair
+// when the caller supplies none).  Returns nullptr when libssl is
+// unavailable or the certificate does not load.
+void *rt_node_create_tls(int id, int listen_port, const char *cert_pem,
+                         const char *key_pem) {
+  const TlsApi &api = tls_api();
+  if (!api.ok) return nullptr;
+  void *ctx = api.SSL_CTX_new(api.TLS_method());
+  if (!ctx) return nullptr;
+  if (api.SSL_CTX_use_certificate_chain_file(ctx, cert_pem) != 1 ||
+      api.SSL_CTX_use_PrivateKey_file(ctx, key_pem, kSslFiletypePem) != 1) {
+    api.SSL_CTX_free(ctx);
+    return nullptr;
+  }
+  // on failure node_create already deleted the Node, whose destructor
+  // freed ctx — freeing it here again would be a double free
+  return node_create(id, listen_port, false, ctx);
 }
 
 int rt_node_port(void *node) {

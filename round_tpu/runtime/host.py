@@ -60,7 +60,7 @@ from round_tpu.core.progress import Progress
 from round_tpu.core.rounds import FoldRound, Round, RoundCtx
 from round_tpu.ops.mailbox import Mailbox
 from round_tpu.runtime.log import get_logger
-from round_tpu.runtime.oob import FLAG_NORMAL, Message, Tag
+from round_tpu.runtime.oob import FLAG_DECISION, FLAG_NORMAL, Message, Tag
 from round_tpu.runtime.transport import HostTransport
 
 log = get_logger("host")
@@ -73,6 +73,13 @@ class HostResult:
     decision: Any
     rounds_run: int
     dropped_messages: int
+    # wire messages discarded as garbage: undeserializable payloads,
+    # out-of-range sender ids, wrong payload structure.  The reference
+    # swallows deserialization errors and keeps running when byzantine
+    # replicas are configured (InstanceHandler.scala:392-399); this runner
+    # ALWAYS tolerates them — one garbage datagram on the unauthenticated
+    # socket must never kill a replica.
+    malformed_messages: int = 0
 
 
 def run_instance_loop(
@@ -97,14 +104,32 @@ def run_instance_loop(
     Returns the per-instance decision log (None where undecided)."""
     stash: Dict[int, Dict[int, Dict[int, Any]]] = {}
     current = {"inst": 0}
+    decisions: List[Optional[int]] = []
+    replied: Dict[Tuple[int, int], float] = {}
 
     def foreign(sender, tag, payload):
         if tag.instance <= current["inst"]:
+            # traffic for a COMPLETED instance: instead of dropping it
+            # (TooLate), reply with that instance's decision out-of-band —
+            # the lagging replica adopts it and exits instead of burning a
+            # timeout (PerfTest.scala:40-60, trySendDecision; essential on
+            # UDP where the round-4 decision broadcast can simply drop).
+            # RATE-LIMITED, not one-shot: the reply itself can drop on UDP,
+            # so the laggard's next retransmission re-arms it
+            idx = tag.instance - 1
+            now = _time.monotonic()
+            last = replied.get((sender, tag.instance), -1.0)
+            if (0 <= idx < len(decisions) and decisions[idx] is not None
+                    and now - last > 0.25):
+                replied[(sender, tag.instance)] = now
+                transport.send(
+                    sender, Tag(instance=tag.instance, flag=FLAG_DECISION),
+                    pickle.dumps(np.asarray(decisions[idx])),
+                )
             return
         stash.setdefault(tag.instance, {}).setdefault(
             tag.round, {})[sender] = payload
 
-    decisions: List[Optional[int]] = []
     for inst in range(1, instances + 1):
         current["inst"] = inst
         runner = HostRunner(
@@ -160,11 +185,28 @@ class HostRunner:
         # every instance (the reference solves this with defaultHandler's
         # lazy join, PerfTest2.scala:72-110)
         self.foreign = foreign
+        self.malformed = 0
         for pid, (host, port) in peers.items():
             if pid != my_id:
                 transport.add_peer(pid, host, port)
         # round -> {sender: payload}; early messages wait here
         self._pending: Dict[int, Dict[int, Any]] = dict(prefill or {})
+
+    def _loads(self, raw: bytes) -> Tuple[bool, Any]:
+        """Deserialize a wire payload, tolerating garbage: any failure
+        counts the message malformed and the caller drops it
+        (InstanceHandler.scala:392-399 semantics, applied unconditionally).
+        Same trust model as the reference otherwise — replicas deserialize
+        only from their own group."""
+        if not raw:
+            return True, None
+        try:
+            return True, pickle.loads(raw)
+        except Exception as e:  # noqa: BLE001 — any garbage must be survivable
+            self.malformed += 1
+            log.debug("node %d: dropping malformed payload (%d bytes): %s",
+                      self.id, len(raw), e)
+            return False, None
 
     def _ctx(self, r: int) -> RoundCtx:
         """Context for eager hooks (expected_nbr_messages).  No rng: the
@@ -277,8 +319,65 @@ class HostRunner:
                     ))
                 return len(inbox) >= min(self.n, int(expected))
 
+            oob_decided = False
+
+            def ingest(got, extend_deadline=True) -> bool:
+                """Route one received packet; True when THIS round's inbox
+                grew.  Shared by the blocking accumulate loop and the
+                GoAhead pre-update drain."""
+                nonlocal state, deadline, next_round, oob_decided
+                sender, tag, raw = got
+                if not 0 <= sender < self.n:
+                    # protocol garbage on the unauthenticated socket: an
+                    # out-of-range id would corrupt every downstream
+                    # sender-indexed structure (stash, mailbox stacking)
+                    self.malformed += 1
+                    return False
+                if tag.instance != self.instance_id or tag.flag != FLAG_NORMAL:
+                    if (tag.flag == FLAG_DECISION
+                            and tag.instance == self.instance_id):
+                        # out-of-band decision recovery (PerfTest.scala:
+                        # 40-60): a peer that already decided replies to
+                        # our late traffic with the value — adopt and exit
+                        # instead of burning this round's timeout
+                        ok, p = self._loads(raw)
+                        adopted = (self.algo.adopt_decision(state, p)
+                                   if ok else None)
+                        if adopted is not None:
+                            state = adopted
+                            oob_decided = True
+                    elif tag.flag == FLAG_NORMAL and self.foreign is not None:
+                        ok, p = self._loads(raw)
+                        if ok:
+                            self.foreign(sender, tag, p)
+                    elif self.default_handler is not None:
+                        ok, p = self._loads(raw)
+                        if ok:
+                            self.default_handler(Message(
+                                sender=sender, tag=tag, payload=p,
+                            ))
+                    return False
+                if tag.round > max_rnd[sender]:
+                    max_rnd[sender] = tag.round
+                if tag.round < r:
+                    return False  # late: the round is communication-closed
+                ok, payload = self._loads(raw)
+                if not ok:
+                    return False
+                if extend_deadline and not use_deadline:
+                    # the wait cap is an IDLE cap: any same-instance
+                    # message is progress and extends the deadline
+                    deadline = _time.monotonic() + self.wait_cap_ms / 1000.0
+                if tag.round > r:
+                    self._pending.setdefault(tag.round, {})[sender] = payload
+                    # benign catch-up: the furthest peer sets the target
+                    next_round = max(next_round, int(max_rnd.max()))
+                    return False
+                inbox[sender] = payload
+                return True
+
             dirty = True  # inbox changed since the last go probe
-            while not prog.is_go_ahead:
+            while not prog.is_go_ahead and not oob_decided:
                 if dirty and go_ahead():
                     break
                 dirty = False
@@ -311,40 +410,31 @@ class HostRunner:
                 got = self.transport.recv(left_ms)
                 if got is None:
                     continue  # re-check the deadline
-                sender, tag, raw = got
-                if tag.instance != self.instance_id or tag.flag != FLAG_NORMAL:
-                    if tag.flag == FLAG_NORMAL and self.foreign is not None:
-                        self.foreign(sender, tag,
-                                     pickle.loads(raw) if raw else None)
-                    elif self.default_handler is not None:
-                        self.default_handler(Message(
-                            sender=sender, tag=tag,
-                            payload=pickle.loads(raw) if raw else None,
-                        ))
-                    continue
-                if 0 <= sender < self.n and tag.round > max_rnd[sender]:
-                    max_rnd[sender] = tag.round
-                if tag.round < r:
-                    continue  # late: the round is communication-closed
-                payload = pickle.loads(raw)
-                if not use_deadline:
-                    # the wait cap is an IDLE cap: any same-instance
-                    # message is progress and extends the deadline
-                    deadline = _time.monotonic() + self.wait_cap_ms / 1000.0
-                if tag.round > r:
-                    self._pending.setdefault(tag.round, {})[sender] = payload
-                    # benign catch-up: the furthest peer sets the target
-                    next_round = max(next_round, int(max_rnd.max()))
-                    continue
-                inbox[sender] = payload
-                dirty = True
+                if ingest(got):
+                    dirty = True
+            if prog.is_go_ahead and not oob_decided:
+                # a GoAhead round still delivers messages ALREADY QUEUED in
+                # the transport before updating (the reference delivers
+                # pending messages before ending the round,
+                # InstanceHandler.scala:219-231): drain without blocking —
+                # same-round into the inbox, future rounds into the buffer
+                while True:
+                    got = self.transport.recv(0)
+                    if got is None:
+                        break
+                    ingest(got, extend_deadline=False)
+                    if oob_decided:
+                        break
 
             # -- update ---------------------------------------------------
-            mbox = self._mailbox(inbox, payload_np)
-            state, exit_flag = f_update(
-                rr, sid, seed, state, mbox.values, mbox.mask,
-            )
-            exited = bool(np.asarray(exit_flag))
+            if oob_decided:
+                exited = True
+            else:
+                mbox = self._mailbox(inbox, payload_np)
+                state, exit_flag = f_update(
+                    rr, sid, seed, state, mbox.values, mbox.mask,
+                )
+                exited = bool(np.asarray(exit_flag))
             log.debug("node %d round %d: heard %d/%d%s%s", self.id, r,
                       len(inbox), self.n, " TO" if timedout else "",
                       " exit" if exited else "")
@@ -357,11 +447,18 @@ class HostRunner:
         return HostResult(
             state=state, decided=decided, decision=decision, rounds_run=r,
             dropped_messages=self.transport.dropped,
+            malformed_messages=self.malformed,
         )
 
     def _mailbox(self, inbox: Dict[int, Any], like: Any) -> Mailbox:
         """Stack per-sender payloads into the [n, ...] arrays + mask the
-        Round DSL's update expects (the dense-mailbox view of the wire)."""
+        Round DSL's update expects (the dense-mailbox view of the wire).
+
+        A payload that unpickled fine but has the WRONG SHAPE for this
+        round (tree structure, leaf count, leaf shape/dtype) is byzantine
+        garbage too — dropped per sender + counted, never a crash (the
+        deserialize-failure tolerance of InstanceHandler.scala:392-399
+        extended to the structural layer pickle does not check)."""
         leaves_like, treedef = jax.tree_util.tree_flatten(like)
         stacked = [
             np.zeros((self.n,) + np.shape(l), dtype=np.asarray(l).dtype)
@@ -369,9 +466,23 @@ class HostRunner:
         ]
         mask = np.zeros((self.n,), dtype=bool)
         for sender, payload in inbox.items():
-            leaves = jax.tree_util.tree_flatten(payload)[0]
-            for slot, leaf in zip(stacked, leaves):
-                slot[sender] = leaf
+            try:
+                leaves = jax.tree_util.tree_flatten(payload)[0]
+                if len(leaves) != len(stacked):
+                    raise ValueError(
+                        f"{len(leaves)} leaves != {len(stacked)}")
+                for slot, leaf in zip(stacked, leaves):
+                    arr = np.asarray(leaf)
+                    if arr.shape != slot.shape[1:]:
+                        raise ValueError(
+                            f"leaf shape {arr.shape} != {slot.shape[1:]}")
+                    slot[sender] = arr.astype(slot.dtype, casting="same_kind")
+            except Exception as e:  # noqa: BLE001 — garbage must not kill us
+                self.malformed += 1
+                mask[sender] = False
+                log.debug("node %d: dropping structurally-malformed payload "
+                          "from %d: %s", self.id, sender, e)
+                continue
             mask[sender] = True
         values = jax.tree_util.tree_unflatten(treedef, stacked)
         return Mailbox(values, np.asarray(mask))

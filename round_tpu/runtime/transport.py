@@ -55,6 +55,10 @@ def _load() -> ctypes.CDLL:
         lib.rt_node_create.argtypes = [ctypes.c_int, ctypes.c_int]
         lib.rt_node_create_udp.restype = ctypes.c_void_p
         lib.rt_node_create_udp.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.rt_node_create_tls.restype = ctypes.c_void_p
+        lib.rt_node_create_tls.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p
+        ]
         lib.rt_node_port.restype = ctypes.c_int
         lib.rt_node_port.argtypes = [ctypes.c_void_p]
         lib.rt_node_add_peer.argtypes = [
@@ -90,19 +94,41 @@ class HostTransport:
     `proto="udp"` switches to the datagram transport — the reference's
     default perf transport shape (UdpRuntime.scala:19-96): drop-tolerant,
     no reconnect state, one datagram per message (payloads over ~64 KiB
-    fail at send)."""
+    fail at send).
 
-    def __init__(self, node_id: int, port: int = 0, proto: str = "tcp"):
-        if proto not in ("tcp", "udp"):
-            raise ValueError(f"proto must be tcp or udp, got {proto!r}")
+    `proto="tls"` runs the framed TCP protocol inside TLS — the
+    reference's TCP_SSL mode (TcpRuntime.scala:143-158).  Pass PEM paths
+    via `cert_file`/`key_file`, or leave both None for a per-process
+    SELF-SIGNED pair (the reference's SelfSignedCertificate fallback,
+    RuntimeOptions.scala:51-67).  Matching the reference's insecure-trust
+    default for self-signed deployments, peers do NOT verify certificate
+    chains: TLS provides channel privacy/integrity, not authentication."""
+
+    def __init__(self, node_id: int, port: int = 0, proto: str = "tcp",
+                 cert_file: Optional[str] = None,
+                 key_file: Optional[str] = None):
+        if proto not in ("tcp", "udp", "tls"):
+            raise ValueError(f"proto must be tcp, udp or tls, got {proto!r}")
         self._lib = _load()
         self.id = node_id
         self.proto = proto
-        create = (self._lib.rt_node_create_udp if proto == "udp"
-                  else self._lib.rt_node_create)
-        self._node = create(node_id, port)
+        if proto == "tls":
+            if (cert_file is None) != (key_file is None):
+                raise ValueError("supply both cert_file and key_file, "
+                                 "or neither (self-signed fallback)")
+            if cert_file is None:
+                cert_file, key_file = _self_signed_pair()
+            self._node = self._lib.rt_node_create_tls(
+                node_id, port, cert_file.encode(), key_file.encode(),
+            )
+        else:
+            create = (self._lib.rt_node_create_udp if proto == "udp"
+                      else self._lib.rt_node_create)
+            self._node = create(node_id, port)
         if not self._node:
-            raise OSError(f"could not bind node {node_id} on port {port}")
+            raise OSError(f"could not bind node {node_id} on port {port}"
+                          + (" (TLS: libssl or certificate unavailable)"
+                             if proto == "tls" else ""))
         self.port = self._lib.rt_node_port(self._node)
         self._buf = ctypes.create_string_buffer(1 << 20)
         self.closed = False  # set once recv observes the stopped node
@@ -175,6 +201,33 @@ def _to_signed64(v: int) -> int:
     return v - (1 << 64) if v >= (1 << 63) else v
 
 
+_SELF_SIGNED: Optional[Tuple[str, str]] = None
+_self_signed_lock = threading.Lock()
+
+
+def _self_signed_pair() -> Tuple[str, str]:
+    """Generate (once per process) a self-signed cert+key for TLS mode —
+    the reference's SelfSignedCertificate fallback (TcpRuntime.scala:
+    143-149).  Uses the openssl CLI (the runtime library is present in
+    this environment, its dev headers are not)."""
+    global _SELF_SIGNED
+    with _self_signed_lock:
+        if _SELF_SIGNED is not None:
+            return _SELF_SIGNED
+        import tempfile
+
+        d = tempfile.mkdtemp(prefix="round_tpu_tls_")
+        cert, key = os.path.join(d, "cert.pem"), os.path.join(d, "key.pem")
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", key, "-out", cert, "-days", "2",
+             "-subj", "/CN=round_tpu"],
+            check=True, capture_output=True,
+        )
+        _SELF_SIGNED = (cert, key)
+        return _SELF_SIGNED
+
+
 class HostBus:
     """LocalBus surface over HostTransport: Message objects (runtime/oob.py)
     cross process boundaries with their Tag on the wire and the payload
@@ -185,6 +238,7 @@ class HostBus:
     def __init__(self, transport: HostTransport):
         self.transport = transport
         self.node = None  # PoolNode, set by register()
+        self.malformed = 0  # garbage wire payloads dropped (never a crash)
 
     def register(self, node) -> None:
         self.node = node
@@ -206,7 +260,13 @@ class HostBus:
             if got is None:
                 break
             from_id, tag, raw = got
-            payload = pickle.loads(raw) if raw else None
+            try:
+                payload = pickle.loads(raw) if raw else None
+            except Exception:  # noqa: BLE001 — a garbage datagram on the
+                # unauthenticated socket must never kill the control plane
+                # (InstanceHandler.scala:392-399 tolerance)
+                self.malformed += 1
+                continue
             count += 1
             try:
                 self.node.default_handler(

@@ -55,6 +55,11 @@ class ClConfig:
     venn_bound: int = 2
     inst_depth: int = 1
     max_insts: int = 50_000
+    # entailment()'s bounded hypothesis-DNF expansion budget (branch cap):
+    # raise it for VCs whose proof IS a large propositional case analysis
+    # over opaque subformulas (the staged-chain final ∨-elims), where each
+    # branch is trivial but the combined refutation explodes the reducer
+    dnf_budget: int = 16
     # quantifier-instantiation strategy (QStrategy, ClConfig.scala:20-24):
     # "eager" = full type-correct product (Eager(depth)); "ematch" =
     # trigger-guided e-matching (logic/Matching.scala) — far fewer
@@ -759,12 +764,20 @@ def _hyp_disjuncts(f: Formula, budget: int = 16) -> List[Formula]:
     Mirrors the reference's decompose + optional DNF (VC.scala:76-96,
     logic/TestCommon.scala:42-49) — each branch is a much easier query than
     the combined disjunction, whose refutation the instantiation must find
-    for all branches at once."""
+    for all branches at once.  Implication conjuncts split as their Or
+    form (A→B ⇔ ¬A∨B): the staged-chain final VCs carry their case
+    analysis as closed conditionals, and leaving them packed forces the
+    reducer to distribute CNF over both bodies at once."""
     conj = get_conjuncts(f)
     branches: List[List[Formula]] = [[]]
     for c in conj:
-        if isinstance(c, Application) and c.fct == OR:
-            opts = c.args
+        opts = None
+        if isinstance(c, Application):
+            if c.fct == OR:
+                opts = list(c.args)
+            elif c.fct == IMPLIES and len(c.args) == 2:
+                opts = [Not(c.args[0]), c.args[1]]
+        if opts is not None:
             if len(branches) * len(opts) > budget:
                 for b in branches:
                     b.append(c)
@@ -827,7 +840,7 @@ def entailment(
 
     if not decompose:
         return _entailment_core(h, c, config, budget)
-    for hd in _hyp_disjuncts(h):
+    for hd in _hyp_disjuncts(h, budget=config.dnf_budget):
         for cc in _concl_conjuncts(c):
             if not _entailment_core(hd, cc, config, budget):
                 return False
@@ -846,6 +859,13 @@ def _entailment_core(
         if left is not None and left <= 0:
             return False
         red = ClReducer(cfg)
-        if solve_ground(red.reduce(f), timeout_s=left) == UNSAT:
+        ground = red.reduce(f)
+        # the reduction itself (canonicalize, venn enumeration, eager
+        # instantiation) can eat the whole budget on a pathological
+        # sub-VC — re-check before handing what remains to the solver
+        left = budget()
+        if left is not None and left <= 0:
+            return False
+        if solve_ground(ground, timeout_s=left) == UNSAT:
             return True
     return False

@@ -809,7 +809,7 @@ def lv_staged_vcs():
     return vcs, spec, lv
 
 
-def lv_stage_subvcs():
+def _lv_matrix_and_pieces():
     """VC.decompose (VC.scala:76-96) applied to the two hard LV
     inductiveness stages: hypothesis-disjunct (noDecision vs anchored) ×
     conclusion-conjunct sub-VCs, with Hoare-style drill-down chains for the
@@ -817,10 +817,12 @@ def lv_stage_subvcs():
     template-congruence symbolization landed (quantifiers.py:
     _comprehension_template — ground comprehensions share the symbol family
     of the ∀-quantified comprehensions they instantiate), EVERY case is
-    closed: the three remaining `proved=False` entries are the monolithic
-    forms, each tagged "(subsumed)" because the chain entries below it
-    discharge the same obligation piecewise with sound ∃-elim/case
-    chaining.
+    closed.  The monolithic forms of the three chained cases (collect-r1
+    anchored, collect-r1 vote_init′, ack-r3 noDecision) are no longer
+    carried as "(subsumed)" rows: their composition out of the chain rows
+    below is MACHINE-CHECKED by lv_staged_chains() / the StagedChain
+    machinery (verifier.py), which replaced the author-supplied subsumption
+    argument those rows documented.
 
       stage 0 (collect, round 1):  keep_init′ / stage flag / noDecision
         case PROVED directly; the anchored case closes via the
@@ -837,9 +839,10 @@ def lv_stage_subvcs():
         the no-ready′ branch preserves noDecision.
 
     The reference proves NONE of these (LvExample.scala:262-291 ignores
-    all four stages outright).  Returns [(label, hyp, concl, cfg, proved,
-    slow)] — `proved` is the pinned expectation, `slow` marks entries the
-    CI skips without RUN_SLOW_VCS=1."""
+    all four stages outright).  Returns ([(label, hyp, concl, cfg, proved,
+    slow)], pieces) — `proved` is the pinned expectation, `slow` marks
+    entries the CI skips without RUN_SLOW_VCS=1; `pieces` carries the
+    formula handles lv_staged_chains() composes from."""
     vcs, spec, lv = lv_staged_vcs()
     cfg = spec.config
     sig = spec.sig
@@ -866,10 +869,6 @@ def lv_stage_subvcs():
                 (f"{stage_tag}: stage flag", H(), conjs[3], cfg, True, False),
                 (f"{stage_tag}: anchor-disj, noDecision case",
                  H(nd_case), conjs[0], cfg, True, False),
-                (f"{stage_tag}: anchor-disj, anchored case (subsumed)",
-                 H(anchor_case), conjs[0], cfg, False, True),
-                (f"{stage_tag}: vote_init' (subsumed)",
-                 H(), conjs[2], cfg, False, True),
             ]
         else:
             out += [
@@ -886,8 +885,6 @@ def lv_stage_subvcs():
                 (f"{stage_tag}: anchor-disj, anchored case (2-option)",
                  H(anchor_case), Or(conjs[0].args[0], conjs[0].args[1]),
                  cfg, True, True),
-                (f"{stage_tag}: anchor-disj, noDecision case (subsumed)",
-                 H(nd_case), conjs[0], cfg, False, True),
             ]
 
     coord, maxx, x0 = lv["coord"], lv["maxx"], lv["x0"]
@@ -1000,7 +997,314 @@ def lv_stage_subvcs():
          And(nd2, *rest2, tr2, frame3), vc_anchor_post.args[2], cfg,
          True, True),
     ]
-    return out
+
+    pieces = {
+        "vcs": vcs, "spec": spec, "lv": lv, "cfg": cfg, "sig": sig,
+        "c01": c01, "c02": c02, "c12": c12,
+        "c1": {
+            "nd": _nd, "anchor": anchor_case, "rest": rest, "tr": tr,
+            "conjs": list(concl.args), "frame": frame, "bridge": bridge,
+            "act": act, "fa": fa, "anchored_post": anchored_post,
+            "vip": vip, "vi_conjs": vi_conjs, "kw": kw, "jw": jw,
+            "maxx_coord": maxx_coord, "x0_of": x0_of, "ki": ki, "vi": vi,
+        },
+        "a3": {
+            "nd": nd2, "anchor": _anchor2, "rest": rest2, "tr": tr2,
+            "conjs": list(concl2.args), "frame": frame3, "acked": acked,
+            "vc_anchor_post": vc_anchor_post, "iw": iw,
+            "no_ready_p": no_ready_p,
+        },
+    }
+    return out, pieces
+
+
+def lv_stage_subvcs():
+    """The LV decomposition matrix (see _lv_matrix_and_pieces)."""
+    return _lv_matrix_and_pieces()[0]
+
+
+def lv_staged_chains():
+    """The collect-r1 and ack-r3 decompositions as MACHINE-CHECKED
+    StagedChains — every arrow of the old author-composed argument is its
+    own VC (intro / stage / justification / final, verifier.py
+    _composed_vc), so `verifier_cli lv` carries NO composition caveat.
+
+    Shape of the argument (the assumption-scoped natural deduction the
+    StagedChain.assumes field provides):
+
+      collect-r1:  ∨-elim over H's noDecision-vs-anchored disjunction —
+        the nd case is one scoped stage; the anchored case re-derives the
+        anchor at (va, ta) piecewise (maxTS bridge under act, frame,
+        majority/phase transfer, the ∀-block per conjunct) and a scoped
+        assembly stage recombines them; vote_init′ goes through two
+        CONDITIONAL skolem witnesses (kw: a max-ts sender, jw: the initial
+        value it traces to — both exist only under the coordinator's
+        majority `act`), the traced commit′ part under act, a NEW
+        no-majority complement (¬act ⊨ nothing newly commits, and round 1
+        resets commit — LastVoting.scala:123-137), and an assembly doing
+        the excluded-middle split on act.  The final VC checks the ∨-elim.
+
+      ack-r3:  the direct conjuncts are unscoped stages; the anchored case
+        proves the 2-option disjunction (∨-weakening to the 3-option goal
+        is the final VC's); the noDecision case derives the re-anchor at
+        (vote(coord), phase) from a fresh ready′ witness (∀-closed over
+        it), and a scoped assembly refutes ¬goal by case analysis on the
+        skolemized ¬noDecision′ witness.
+
+    The reference ignores all four of these VCs outright
+    (LvExample.scala:262-291).  Returns ({vc name: StagedChain}, pieces):
+    the TR payload symbols are gensym'd, so the chains only match a spec
+    built from the SAME lv_staged_vcs instance — `pieces` carries it, and
+    lv_verifier_spec is the one assembler (a chains-only accessor would
+    invite pairing them with a foreign spec, which the prune membership
+    checks would reject)."""
+    from round_tpu.verify.futils import get_conjuncts
+    from round_tpu.verify.verifier import StagedChain
+
+    out, P = _lv_matrix_and_pieces()
+    cfg, c01, c02, c12 = P["cfg"], P["c01"], P["c02"], P["c12"]
+    sig = P["sig"]
+    coord = P["lv"]["coord"]
+    by_label = {row[0]: row for row in out}
+
+    def row(label):
+        _l, hyp, concl, rcfg, proved, _s = by_label[label]
+        assert proved, label
+        return hyp, concl, rcfg
+
+    from round_tpu.verify.futils import free_vars as free_vars_of
+
+    def build(vc_index, intros, intro_assumes, intro_prunes, stages,
+              assumes, manual_just, final_keep, final_cfg):
+        """Assemble a StagedChain; prune every justification VC whose
+        conjunct is VERBATIM available down to that single fact (cost: a
+        syntactic entailment).  The context/freshness evolution here
+        MIRRORS verifier._composed_vc exactly, so the closed facts
+        referenced by later prune lists are structurally identical to the
+        ones the verifier constructs.  Non-verbatim conjuncts must appear
+        in manual_just[(stage name, conjunct index)] = (keep, config)."""
+        _nm, vhyp, vtr, vconcl = P["vcs"][vc_index]
+        H, G = And(vhyp, vtr), vconcl
+        universe = list(get_conjuncts(H))
+        seen = free_vars_of(H) | free_vars_of(G)
+        prune: dict = dict(intro_prunes)
+        just_configs: dict = {}
+        for idx, (vars_, pf, _c) in enumerate(intros):
+            a = intro_assumes.get(f"intro:{idx}")
+            fact = pf if a is None else Implies(a, pf)
+            universe.extend(get_conjuncts(fact))
+            seen |= set(vars_) | free_vars_of(fact)
+        for sname, hyp, concl, _scfg in stages:
+            for ci, part in enumerate(get_conjuncts(hyp)):
+                key = f"justify:{sname}#{ci}"
+                manual = manual_just.get((sname, ci))
+                if manual is not None:
+                    prune[key], just_configs[key] = manual
+                elif any(part == c for c in universe):
+                    prune[key] = [part]
+                    just_configs[key] = c01
+                else:
+                    raise AssertionError(
+                        f"chain stage {sname!r} conjunct {ci} "
+                        f"({part!r}) is neither verbatim in context nor "
+                        "manually justified"
+                    )
+            a = assumes.get(sname)
+            stage_fv = free_vars_of(hyp) | free_vars_of(concl)
+            if a is not None:
+                stage_fv |= free_vars_of(a)
+            univ = sorted(stage_fv - seen, key=lambda v: v.name)
+            closed = concl if a is None else Implies(a, concl)
+            closed = ForAll(univ, closed) if univ else closed
+            universe.extend(get_conjuncts(closed))
+            seen |= set(univ)
+        prune["final"] = final_keep
+        return StagedChain(
+            stages=stages,
+            intros=intros,
+            assumes={**intro_assumes, **assumes},
+            prune=prune,
+            just_configs=just_configs,
+            final_config=final_cfg,
+        )
+
+    chains = {}
+
+    # ------------------------------------------------------- collect-r1 --
+    c1 = P["c1"]
+    nd, anchor, rest, tr = c1["nd"], c1["anchor"], c1["rest"], c1["tr"]
+    conjs, frame, bridge = c1["conjs"], c1["frame"], c1["bridge"]
+    act, fa, ap = c1["act"], c1["fa"], c1["anchored_post"]
+    vip, vi_conjs = c1["vip"], c1["vi_conjs"]
+    kw, jw = c1["kw"], c1["jw"]
+    maxx_coord, x0_of = c1["maxx_coord"], c1["x0_of"]
+    ki, vi = c1["ki"], c1["vi"]
+    base = And(*rest, tr)
+    anchor_act = And(anchor, act)
+
+    P1 = And(In(kw, ho_of(coord)), Eq(maxx_coord, sig.get("x", kw)))
+    P2 = Eq(maxx_coord, x0_of(jw))
+    fact1, fact2 = Implies(act, P1), Implies(act, P2)
+
+    _h, br_concl, br_cfg = row(
+        "collect-r1/anchored: maxTS bridge (act => maxx = va)")
+    closed_bridge = Implies(anchor_act, br_concl)
+    _h, c_kw, kw_cfg = row("collect-r1/vote_init: attainment witness under act")
+    _h, vi0_concl, vi0_cfg = row(
+        "collect-r1/vote_init: commit' part from the traced vote")
+    _h, vi1_concl, vi1_cfg = row(
+        "collect-r1/vote_init: decided' part from the frame")
+    nci = Variable("nci", procType)
+    no_commit_p = ForAll([nci], Not(sig.get_primed("commit", nci)))
+
+    rf = And(*rest, frame)
+    stages1 = [
+        ("nd case", base, conjs[0], cfg),
+        ("keep_init'", base, conjs[1], cfg),
+        ("stage flag", base, conjs[3], cfg),
+        ("frame", tr, frame, c01),
+        ("maxTS bridge", base, br_concl, br_cfg),
+        ("maj transfer", frame, ap.args[0], cfg),
+        ("phase bound", frame, ap.args[1], cfg),
+        ("fa0", rf, fa(0), cfg),
+        ("fa1", rf, fa(1), cfg),
+        # scoped under the bridge IMPLICATION itself (a derived fact, not a
+        # case hypothesis): the stage VC is then verbatim the proven matrix
+        # row; the assembly justification derives the bridge from
+        # closed_bridge ∧ anchor and discharges the conditional
+        ("fa2", And(*rest, tr), fa(2), cfg),
+        ("fa3", rf, fa(3), cfg),
+        ("fa4", rf, fa(4), cfg),
+        ("anchored assembly",
+         And(ap.args[0], ap.args[1], fa(0), fa(1), fa(2), fa(3), fa(4)),
+         conjs[0], c02),
+        ("vi commit part", And(tr, P2), vi0_concl, vi0_cfg),
+        ("vi no-majority complement", tr, no_commit_p, cfg),
+        ("vi decided part", And(vi, frame), vi1_concl, vi1_cfg),
+        ("vi assembly",
+         And(Implies(act, vi0_concl), Implies(Not(act), no_commit_p),
+             vi1_concl),
+         conjs[2], c02),
+    ]
+    assumes1 = {
+        "nd case": nd,
+        "maxTS bridge": anchor_act,
+        "maj transfer": anchor,
+        "phase bound": anchor,
+        "fa0": anchor,
+        "fa1": anchor,
+        "fa2": bridge,
+        "anchored assembly": anchor,
+        "vi commit part": act,
+        "vi no-majority complement": Not(act),
+    }
+    base_parts = get_conjuncts(base)
+    manual1 = {
+        # the traced equality under act, from the conditional intro fact
+        ("vi commit part", len(get_conjuncts(tr))): ([fact2], c01),
+        # assembly pieces: each from its conditional closed fact + anchor;
+        # the fa(2) piece chains bridge out of closed_bridge first
+        ("anchored assembly", 0): ([Implies(anchor, ap.args[0])], c01),
+        ("anchored assembly", 1): ([Implies(anchor, ap.args[1])], c01),
+        ("anchored assembly", 2): ([Implies(anchor, fa(0))], c01),
+        ("anchored assembly", 3): ([Implies(anchor, fa(1))], c01),
+        ("anchored assembly", 4): ([closed_bridge, Implies(bridge, fa(2))],
+                                   c01),
+    }
+    chains["stage 0 -> 1 via round 1"] = build(
+        0,
+        intros=[([kw], P1, kw_cfg), ([jw], P2, c02)],
+        intro_assumes={"intro:0": act, "intro:1": act},
+        intro_prunes={
+            "intro:0": base_parts,
+            "intro:1": [fact1, ki],
+        },
+        stages=stages1,
+        assumes=assumes1,
+        manual_just=manual1,
+        final_keep=[
+            Or(nd, anchor),
+            Implies(nd, conjs[0]),
+            Implies(anchor, conjs[0]),
+            conjs[1], conjs[2], conjs[3],
+        ],
+        final_cfg=c01,
+    )
+
+    # ---------------------------------------------------------- ack-r3 --
+    a3 = P["a3"]
+    nd3, anchor3, rest3, tr3 = a3["nd"], a3["anchor"], a3["rest"], a3["tr"]
+    conjs3, frame3 = a3["conjs"], a3["frame"]
+    vca, iw = a3["vc_anchor_post"], a3["iw"]
+    no_ready_p = a3["no_ready_p"]
+    base3 = And(*rest3, tr3)
+    iw2 = Variable("iw2", procType)
+
+    _h, maj_concl, maj_cfg = row(
+        "ack-r3/noDecision: ready' implies ack majority")
+    _h, anch_concl, anch_cfg = row(
+        "ack-r3/noDecision: ack majority anchors at phase")
+    _h, twoopt_concl, twoopt_cfg = row(
+        "ack-r3: anchor-disj, anchored case (2-option)")
+    ready_iw = sig.get_primed("ready", iw)
+    ready_iw2 = sig.get_primed("ready", iw2)
+    closed_ready_maj = ForAll([iw], Implies(ready_iw, maj_concl))
+    closed_ready_anchor = ForAll([iw2], Implies(ready_iw2, anch_concl))
+    nd_noready = And(nd3, no_ready_p)
+
+    stages3 = [
+        ("keep_init'", base3, conjs3[1], cfg),
+        ("vote_init'", base3, conjs3[2], cfg),
+        ("commit/ts obligations", base3, conjs3[3], cfg),
+        ("ready' majority", base3, conjs3[4], cfg),
+        ("anchored case (2-option)", base3, twoopt_concl, twoopt_cfg),
+        ("frame", tr3, frame3, c01),
+        ("no-ready preserves nd", frame3, conjs3[0].args[0], cfg),
+        ("ready' => ack majority", tr3, maj_concl, maj_cfg),
+        ("ack majority anchors", And(maj_concl, frame3), anch_concl,
+         anch_cfg),
+        # the bound is the tautology phase <= phase; any verbatim
+        # hypothesis serves (the matrix row used Literal(True), which the
+        # justification machinery cannot prune to)
+        ("anchor phase bound", frame3, vca.args[1], c01),
+        ("nd fa-block", And(*rest3, tr3, frame3), vca.args[2], cfg),
+        ("nd assembly",
+         And(closed_ready_anchor, vca.args[1], Implies(nd3, vca.args[2]),
+             frame3),
+         conjs3[0], c02),
+    ]
+    assumes3 = {
+        "anchored case (2-option)": anchor3,
+        "no-ready preserves nd": nd_noready,
+        "ready' => ack majority": ready_iw,
+        "ack majority anchors": ready_iw2,
+        "nd fa-block": nd3,
+        "nd assembly": nd3,
+    }
+    manual3 = {
+        # the ack majority under a (fresh) ready' witness, ∀-closed earlier
+        ("ack majority anchors", 0): ([closed_ready_maj], c01),
+    }
+    chains["stage 2 -> 3 via round 3"] = build(
+        2,
+        intros=[],
+        intro_assumes={},
+        intro_prunes={},
+        stages=stages3,
+        assumes=assumes3,
+        manual_just=manual3,
+        final_keep=[
+            Or(nd3, anchor3),
+            Implies(anchor3, twoopt_concl),
+            Implies(nd3, conjs3[0]),
+            conjs3[1], conjs3[2], conjs3[3], conjs3[4],
+        ],
+        # the surviving final conjunct is a pure ∨-elim over three big
+        # opaque cases: expand it to per-branch trivialities (dnf_budget)
+        # instead of one packed refutation (which blows the reducer)
+        final_cfg=ClConfig(venn_bound=0, inst_depth=1, dnf_budget=64),
+    )
+    return chains, P
 
 
 def _lv_maxx_axiom(sig: StateSig, coord, maxx) -> Formula:
@@ -1636,29 +1940,19 @@ def lv_verifier_spec() -> ProtocolSpec:
       the phase), and  SC ⊨ agreement / validity.
 
     Rounds 2 and 4 discharge monolithically; rounds 1 (collect) and 3
-    (ack) attach their lv_stage_subvcs decomposition chains.  The
+    (ack) attach their decompositions as MACHINE-CHECKED StagedChains
+    (lv_staged_chains — intro/justification/final VCs, assumption-scoped
+    case analysis), so the verdict carries no composition caveat.  The
     reference `ignore`s ALL FOUR of these inductiveness VCs
     ("those completely blow-up", LvExample.scala:262-291) — this spec
     discharges every one through the native reducer.
 
-    Run:  python -m round_tpu.apps.verifier_cli lv   (~8 min CPU)."""
-    vcs4, spec, lv = lv_staged_vcs()
+    Run:  python -m round_tpu.apps.verifier_cli lv   (~10 min CPU)."""
+    chains, P = lv_staged_chains()
+    vcs4, spec, lv = P["vcs"], P["spec"], P["lv"]
     sig = spec.sig
     r = lv["phase"]
-
-    # chains: every proved matrix entry of the two hard rounds, as the
-    # staged decomposition of that round's VC
-    chains: dict = {}
-    by_round = {vcs4[0][0]: "collect-r1", vcs4[2][0]: "ack-r3"}
-    matrix = lv_stage_subvcs()
-    for vc_name, prefix in by_round.items():
-        stages = [
-            (label, hyp, concl, cfg)
-            for label, hyp, concl, cfg, proved, _slow in matrix
-            if proved and label.startswith(prefix)
-        ]
-        assert stages, vc_name
-        chains[vc_name] = stages
+    assert set(chains) == {vcs4[0][0], vcs4[2][0]}, chains.keys()
 
     init0 = And(spec.init, Eq(r, IntLit(0)))
 

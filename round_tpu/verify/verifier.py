@@ -23,7 +23,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from round_tpu.verify.cl import ClConfig, ClDefault
 from round_tpu.verify.formula import (
-    And, Exists, ForAll, Formula, TRUE, Variable,
+    And, Exists, ForAll, Formula, Implies, TRUE, Variable,
 )
 from round_tpu.verify.futils import free_vars
 from round_tpu.verify.tr import RoundTR, StateSig
@@ -48,9 +48,9 @@ class StagedChain:
         ∀-generalized over them for later stages (∀-intro).
 
     The verifier discharges, per chain:
-      1. each intro VC          H ⊨ ∃vars. P                    (reducer)
-      2. each stage VC          h_i ⊨ c_i                        (reducer)
-      3. each justification VC  H ∧ P* ∧ ∀-closed c_{<i} ⊨ h_i   (reducer)
+      1. each intro VC          H ∧ P_{<k} [∧ A] ⊨ ∃vars. P      (reducer)
+      2. each stage VC          h_i [∧ A] ⊨ c_i                  (reducer)
+      3. each justification VC  H ∧ P* ∧ ∀-closed c_{<i} [∧ A] ⊨ h_i
       4. the final VC           H ∧ P* ∧ ∀-closed c_* ⊨ G        (reducer)
       5. freshness side conditions: witnesses/universals are fresh where
          introduced and witnesses do not occur in H or G (syntactic;
@@ -58,13 +58,33 @@ class StagedChain:
 
     Together these ARE the composition argument — nothing is left
     author-supplied.  `just_configs` / `final_config` tune the reducer for
-    the bookkeeping VCs (they default to the spec config)."""
+    the bookkeeping VCs (they default to the spec config).
+
+    ASSUMPTION SCOPING (`assumes`, implication introduction): an entry
+    under key "intro:<k>" or a stage name scopes that step under an
+    assumption A — the natural-deduction shape for case analysis (∨-elim
+    across stages) and for witnesses that exist only conditionally:
+
+      * scoped intro: the VC proves  context ∧ A ⊨ ∃vars. P  and the fact
+        entering the context is  A → P(w)  (conditional skolemization —
+        sound classically on the nonempty process domain:
+        A → ∃x.P  ⊨  ∃x.(A → P), name x as the fresh w).
+      * scoped stage: the stage VC proves  h_i ∧ A ⊨ c_i; its
+        justification VCs may use A (context ∧ A ⊨ each conjunct of h_i);
+        the closed fact entering later context is  ∀u.(A → c_i).
+        Soundness: context ∧ A ⊨ h_i and h_i ∧ A ⊨ c_i give
+        context ⊨ A → c_i; u are fresh, so ∀-intro applies.
+
+    The final VC sees only the conditional closed facts, so an ∨-elim
+    (e.g. H's noDecision-vs-anchored disjunction against the two cases'
+    A → c facts) is itself machine-checked there."""
 
     stages: List[Stage]
     intros: List[Tuple[List[Variable], Formula, Optional[ClConfig]]] = \
         dataclasses.field(default_factory=list)
     just_configs: Dict[str, ClConfig] = dataclasses.field(default_factory=dict)
     final_config: Optional[ClConfig] = None
+    assumes: Dict[str, Formula] = dataclasses.field(default_factory=dict)
     # hypothesis pruning for the bookkeeping VCs: key = "intro:<k>",
     # "justify:<stage name>" or "final"; value = the EXACT conjuncts of the
     # available context to keep.  Pruning is hypothesis WEAKENING (sound);
@@ -261,69 +281,98 @@ class Verifier:
         not a proof failure)."""
         from round_tpu.verify.futils import get_conjuncts
 
+        known = {f"intro:{i}" for i in range(len(chain.intros))} | {
+            s[0] for s in chain.stages
+        }
+        bad = set(chain.assumes) - known
+        if bad:
+            # a typo'd key would silently leave a step unscoped (and its
+            # case VC unsound to compose) — refuse instead
+            raise ValueError(
+                f"staged chain {name!r}: assumes keys match no intro/stage: "
+                f"{sorted(bad)}"
+            )
+
         base_fv = free_vars(H) | free_vars(G)
         h_conjuncts = get_conjuncts(H)
         children: List[VC] = []
 
-        def pruned_hyp(key: str, context: List[Formula]) -> Formula:
+        def pruned_hyp(key: str, context: List[Formula],
+                       assume: Optional[Formula] = None) -> Formula:
             """The VC's hypothesis: the full context, or — when the chain
             prunes this key — the listed conjuncts, each verified to BE a
-            conjunct of the context (weakening only)."""
+            conjunct of the context (weakening only).  A scoped step's
+            assumption is conjoined on top (and its conjuncts are legal
+            prune targets)."""
             if key not in chain.prune:
-                return And(*context)
-            keep = chain.prune[key]
-            universe = []
-            for c in context:
-                universe.extend(get_conjuncts(c))
-            for f in keep:
-                if not any(f == c for c in universe):
-                    raise ValueError(
-                        f"staged chain {name!r}, {key}: pruned hypothesis "
-                        f"lists a formula that is NOT a conjunct of the "
-                        f"available context: {f!r}"
-                    )
-            return And(*keep)
+                base = And(*context)
+            else:
+                universe = []
+                for c in context:
+                    universe.extend(get_conjuncts(c))
+                if assume is not None:
+                    universe.extend(get_conjuncts(assume))
+                keep = chain.prune[key]
+                for f in keep:
+                    if not any(f == c for c in universe):
+                        raise ValueError(
+                            f"staged chain {name!r}, {key}: pruned hypothesis "
+                            f"lists a formula that is NOT a conjunct of the "
+                            f"available context: {f!r}"
+                        )
+                base = And(*keep)
+            return base if assume is None else And(base, assume)
 
         witnesses: List[Variable] = []
         intro_facts: List[Formula] = []
         intro_seen = set(base_fv)
         for idx, (vars_, P, cfg) in enumerate(chain.intros):
+            A = chain.assumes.get(f"intro:{idx}")
             # fresh against the VC AND every earlier intro: reusing an
             # earlier witness would conjoin facts about two different
             # existential witnesses under one constant (unsound)
             clash = set(vars_) & intro_seen
+            if A is not None:
+                clash |= set(vars_) & free_vars(A)
             if clash:
                 raise ValueError(
                     f"staged chain {name!r}: witness(es) {sorted(str(v) for v in clash)} "
-                    "occur free in the VC or an earlier intro — not fresh"
+                    "occur free in the VC, an earlier intro, or this "
+                    "intro's assumption — not fresh"
                 )
             intro_seen |= set(vars_) | free_vars(P)
+            if A is not None:
+                intro_seen |= free_vars(A)
+            # later intros may consume earlier intro facts (iterated
+            # skolemization is conservative)
             children.append(SingleVC(
                 f"intro ∃{','.join(v.name for v in vars_)}",
-                pruned_hyp(f"intro:{idx}", h_conjuncts),
+                pruned_hyp(f"intro:{idx}", h_conjuncts + intro_facts, A),
                 TRUE, Exists(list(vars_), P), config=cfg,
             ))
             witnesses += list(vars_)
-            intro_facts.append(P)
+            intro_facts.append(P if A is None else Implies(A, P))
 
         seen = set(base_fv) | set(witnesses)
         for fact in intro_facts:
             seen |= free_vars(fact)
         closed_concls: List[Formula] = []
         for sname, hyp, concl, cfg in chain.stages:
+            A = chain.assumes.get(sname)
             # this stage's fresh universals: free in the stage, unseen
             # anywhere earlier — ∀-intro over them is sound by freshness
-            univ = sorted(
-                (free_vars(hyp) | free_vars(concl)) - seen,
-                key=lambda v: v.name,
-            )
+            stage_fv = free_vars(hyp) | free_vars(concl)
+            if A is not None:
+                stage_fv |= free_vars(A)
+            univ = sorted(stage_fv - seen, key=lambda v: v.name)
             context = h_conjuncts + intro_facts + closed_concls
             # justify each conjunct of the stage hypothesis separately
             # (sound: ⋀ goals ⇔ the conjunction) — the conjuncts have
             # different proof characters (a pure axiom instantiation wants
             # venn_bound 0; a majority fact wants the card machinery), and
             # per-conjunct prune/config keys ("justify:<name>#<k>") keep
-            # each tiny
+            # each tiny.  A scoped stage's justifications run under its
+            # assumption (context ∧ A ⊨ h-conjunct — see class docstring).
             h_parts = get_conjuncts(hyp)
             for ci, part in enumerate(h_parts):
                 key = f"justify:{sname}#{ci}"
@@ -331,24 +380,50 @@ class Verifier:
                 pkey = key if key in chain.prune else base
                 jcfg = chain.just_configs.get(
                     key, chain.just_configs.get(base, cfg))
+                jhyp = pruned_hyp(pkey, context, A)
+                if any(part == c for c in get_conjuncts(jhyp)):
+                    # ∧-elimination: the goal is VERBATIM a conjunct of the
+                    # (membership-checked) hypothesis — discharged
+                    # syntactically, no solver call.  Not just a speedup:
+                    # the reducer's bounded instantiation can FAIL to
+                    # re-prove X from X ∧ act when extra card atoms poison
+                    # trigger selection (observed on the LV chains).
+                    continue
                 label = (f"justify: {sname} [{ci + 1}/{len(h_parts)}]"
                          if len(h_parts) > 1 else f"justify: {sname}")
                 children.append(SingleVC(
                     label,
-                    pruned_hyp(pkey, context),
+                    jhyp,
                     TRUE, part, config=jcfg,
                 ))
-            children.append(SingleVC(sname, hyp, TRUE, concl, config=cfg))
+            # stage VCs carry the protocol's hardest obligations — same
+            # budget the legacy staged path gave them
+            children.append(SingleVC(
+                sname, hyp if A is None else And(hyp, A), TRUE, concl,
+                config=cfg, timeout_s=420.0,
+            ))
+            closed = concl if A is None else Implies(A, concl)
             closed_concls.append(
-                ForAll(univ, concl) if univ else concl
+                ForAll(univ, closed) if univ else closed
             )
             seen |= set(univ)
-        children.append(SingleVC(
-            "composition: chain entails the goal",
-            pruned_hyp("final", h_conjuncts + intro_facts + closed_concls),
-            TRUE, G,
-            config=chain.final_config,
-        ))
+        # the final VC, split per conjunct of G (sound AND complete for ∧,
+        # as the justification split): conjuncts that are verbatim closed
+        # facts discharge by ∧-elimination; typically only the ∨-elim
+        # piece (the invariant's case disjunction) needs the solver
+        fhyp = pruned_hyp("final", h_conjuncts + intro_facts + closed_concls)
+        fparts = get_conjuncts(fhyp)
+        g_parts = get_conjuncts(G)
+        for gi, gpart in enumerate(g_parts):
+            if any(gpart == c for c in fparts):
+                continue
+            label = ("composition: chain entails the goal"
+                     if len(g_parts) == 1 else
+                     f"composition: goal conjunct {gi + 1}/{len(g_parts)}")
+            children.append(SingleVC(
+                label, fhyp, TRUE, gpart,
+                config=chain.final_config, timeout_s=420.0,
+            ))
         return CompositeVC(
             f"{name} [staged, composition machine-checked]", True, children,
         )

@@ -139,10 +139,13 @@ def test_lv_subvc_labels_cover_both_open_stages():
     assert any(l.startswith("collect-r1") for l in labels)
     assert any(l.startswith("ack-r3") for l in labels)
     # growing the matrix must grow the parametrized range below with it
-    assert len(labels) == 30, "update test_lv_stage_subvcs's range"
+    # (27 = the round-3 matrix of 30 minus the three "(subsumed)" monolith
+    # rows, retired when lv_staged_chains made their composition
+    # machine-checked)
+    assert len(labels) == 27, "update test_lv_stage_subvcs's range"
 
 
-@pytest.mark.parametrize("k", range(30))
+@pytest.mark.parametrize("k", range(27))
 def test_lv_stage_subvcs(k):
     """The decomposed sub-VCs of the two open LV inductiveness stages:
     proved entries must discharge (fast ones in CI, slow with

@@ -12,8 +12,8 @@ import pytest
 from round_tpu.verify.cl import ClConfig, entailment
 from round_tpu.verify.formula import (
     And, Application, Bool, Card, Comprehension, Eq, Exists, ForAll, FSet,
-    FunT, Geq, Gt, Implies, In, Int, Neq, Not, Times, UnInterpretedFct,
-    Variable, procType,
+    FunT, Geq, Gt, Implies, In, Int, Literal, Neq, Not, Or, TRUE, Times,
+    UnInterpretedFct, Variable, procType,
 )
 from round_tpu.verify.protocols import otr_spec, tpc_spec
 from round_tpu.verify.tr import StateSig
@@ -202,6 +202,123 @@ def test_otr_staged_chain_broken_stage_rejected():
     )
     ver2 = Verifier(_dc.replace(spec2, staged={name: broken2}))
     assert not ver2.check()
+
+
+# ---------------------------------------------------------------------------
+# Assumption-scoped StagedChain machinery (the ∨-elim / conditional-witness
+# extension the LV chains compose through — verifier.py StagedChain.assumes)
+# ---------------------------------------------------------------------------
+
+def _case_split_spec(good: bool):
+    """A minimal invariant whose inductiveness needs ∨-elimination:
+    inv = (p ∨ q) ∧ (p → g) ∧ (q → g); TR trivially frames everything;
+    goal conjunct g′ follows per case.  `good=False` corrupts the q-case
+    stage's conclusion."""
+    from round_tpu.verify.verifier import StagedChain
+
+    sig = StateSig({"b": Bool})
+    i = Variable("i", procType)
+    pf = UnInterpretedFct("casp", FunT([], Bool))
+    qf = UnInterpretedFct("casq", FunT([], Bool))
+    gf = UnInterpretedFct("casg", FunT([], Bool))
+    p = Application(pf, []).with_type(Bool)
+    q = Application(qf, []).with_type(Bool)
+    g = Application(gf, []).with_type(Bool)
+    inv = And(Or(p, q), Implies(p, g), Implies(q, g), g)
+    tr = ForAll([i], Eq(sig.get_primed("b", i), sig.get("b", i)))
+
+    from round_tpu.verify.tr import RoundTR
+
+    rnd = RoundTR(
+        sig=sig,
+        payload_defs={"b": (Bool, lambda ii: sig.get("b", ii))},
+        dest_fn=lambda ii, jj: Literal(True),
+        update_fn=lambda mb, jj, s: Eq(
+            s.get_primed("b", jj), s.get("b", jj)
+        ),
+    )
+    cfg = ClConfig(venn_bound=0, inst_depth=1)
+    q_concl = g if good else Not(g)
+    chain = StagedChain(
+        stages=[
+            ("case p", Implies(p, g), g, cfg),
+            ("case q", Implies(q, g), q_concl, cfg),
+        ],
+        assumes={"case p": p, "case q": q},
+        prune={
+            "justify:case p": [Implies(p, g)],
+            "justify:case q": [Implies(q, g)],
+            "final": [Or(p, q), Implies(p, g), Implies(q, g), g],
+        },
+        final_config=cfg,
+    )
+    return ProtocolSpec(
+        sig=sig,
+        rounds=[rnd],
+        init=inv,
+        invariants=[inv],
+        config=cfg,
+        staged={"invariant 0 inductive at round 0": chain},
+    )
+
+
+def test_assumption_scoped_chain_case_split():
+    """A scoped StagedChain discharges an ∨-elimination with the
+    composition machine-checked: each case is a scoped stage (stage VC
+    h ∧ A ⊨ c, justification under A, closed fact A → c) and the final VC
+    performs the ∨-elim from the disjunction and the two conditionals."""
+    ver = Verifier(_case_split_spec(good=True))
+    assert ver.check(), "\n" + ver.report()
+    assert not ver.used_staged  # machine-checked: no composition caveat
+
+
+def test_assumption_scoped_chain_corrupted_case_fails():
+    """Negative control: corrupting one case's conclusion must fail the
+    chain — the final ∨-elim VC no longer closes (and the corrupted stage
+    VC itself fails)."""
+    ver = Verifier(_case_split_spec(good=False))
+    assert not ver.check()
+
+
+def test_assume_key_typo_rejected():
+    """An assumes key that names no intro/stage is a spec bug (the step
+    would silently run unscoped) — VC generation must refuse."""
+    import dataclasses as _dc
+
+    spec = _case_split_spec(good=True)
+    name = "invariant 0 inductive at round 0"
+    chain = spec.staged[name]
+    bad = _dc.replace(chain, assumes={**chain.assumes, "case r": TRUE})
+    ver = Verifier(_dc.replace(spec, staged={name: bad}))
+    with pytest.raises(ValueError, match="assumes keys"):
+        ver.generate_vcs()
+
+
+def test_scoped_intro_witness_clash_rejected():
+    """A conditional intro whose witness occurs in its own assumption is
+    not fresh — the skolemization A → P(w) would capture it; generation
+    must refuse."""
+    import dataclasses as _dc
+
+    from round_tpu.verify.verifier import StagedChain
+
+    spec = _case_split_spec(good=True)
+    name = "invariant 0 inductive at round 0"
+    w = Variable("w!c", procType)
+    chain = spec.staged[name]
+    bad = _dc.replace(
+        chain,
+        intros=[([w], In(w, Application(
+            UnInterpretedFct("S!c", FunT([], FSet(procType))), []
+        ).with_type(FSet(procType))), None)],
+        assumes={**chain.assumes,
+                 "intro:0": In(w, Application(
+                     UnInterpretedFct("S!c", FunT([], FSet(procType))), []
+                 ).with_type(FSet(procType)))},
+    )
+    ver = Verifier(_dc.replace(spec, staged={name: bad}))
+    with pytest.raises(ValueError, match="not fresh"):
+        ver.generate_vcs()
 
 
 # ---------------------------------------------------------------------------
