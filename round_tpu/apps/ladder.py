@@ -256,7 +256,7 @@ def rung_floodmin(repeats: int = 2, n: int = 64, S: int = 256) -> Dict[str, Any]
     )
     parity_frac = _diff_parity(
         state, dround, mix, lambda s: FloodMin(f), consensus_io(init), n,
-        rounds, ("x", "decided", "decision"), k=min(6, S),
+        rounds, ("x", "decided", "decision"), k=min(16, S),
     )
     decided = np.asarray(state.decided)
     dec = np.asarray(state.decision)
@@ -353,7 +353,7 @@ def rung_lv(repeats: int = 2, n: int = 256, S: int = 256) -> Dict[str, Any]:
             state, dround, mix, lambda s: LastVoting(), consensus_io(init),
             n, phases,
             ("x", "ts", "ready", "commit", "vote", "decided", "decision"),
-            k=min(4, S),
+            k=min(16, S),
         )
 
     inv_ok = prop_ok = True
@@ -456,7 +456,7 @@ def rung_benor(repeats: int = 2, n: int = 512, S: int = 4096) -> Dict[str, Any]:
         state, dround, mix,
         lambda s: BenOr(coin_salt=(int(mix.salt0[s]), int(mix.salt1[s]))),
         consensus_io(init), n, phases,
-        ("x", "can_decide", "vote", "decided", "decision"), k=min(4, S),
+        ("x", "can_decide", "vote", "decided", "decision"), k=min(16, S),
     )
     decided = np.asarray(state.decided)
     dec = np.asarray(state.decision)
@@ -484,8 +484,47 @@ def rung_benor(repeats: int = 2, n: int = 512, S: int = 4096) -> Dict[str, Any]:
     return {"metric": f"ladder_benor_n{n}", "extra": extra}
 
 
-def rung_epsilon(repeats: int = 2) -> Dict[str, Any]:
-    n, S, phases, f = 1024, 32, 8, 100
+def _sharded_keyed_runner(algo, io_fn, n, sampler, phases, S, mesh):
+    """The _chunked_runner computation scenario-sharded under shard_map —
+    pure data parallelism over the Mesh's scenario axis (each device runs
+    its slice of per-scenario keys through the general engine; values are
+    bit-identical to the single-device run on the same keys, which the
+    rung verifies).  Returns (bench, raw_run, rounds)."""
+    from functools import partial as _partial
+
+    from jax.sharding import PartitionSpec as _P
+
+    from round_tpu.parallel.mesh import SCENARIO_AXIS
+
+    rounds = phases * len(algo.rounds)
+
+    def one(k):
+        k_io, k_run = jax.random.split(k)
+        res = run_instance(
+            algo, io_fn(k_io), n, k_run, sampler, max_phases=phases
+        )
+        return (algo.decided(res.state), res.decided_round,
+                algo.decision(res.state))
+
+    @_partial(
+        jax.shard_map, mesh=mesh, in_specs=(_P(SCENARIO_AXIS),),
+        out_specs=(_P(SCENARIO_AXIS),) * 3, check_vma=False,
+    )
+    def run(keys_shard):
+        return jax.vmap(one)(keys_shard)
+
+    @jax.jit
+    def bench(key):
+        decided, dec_round, _dec = run(jax.random.split(key, S))
+        return decided_summary(decided, dec_round, phases)
+
+    # `one` is returned so the parity oracle compares the SAME per-scenario
+    # computation, never a drifted copy
+    return bench, jax.jit(run), rounds, one
+
+
+def rung_epsilon(repeats: int = 2, n: int = 1024, S: int = 32,
+                 phases: int = 8, f: int = 100) -> Dict[str, Any]:
     eps = 0.5
     algo = EpsilonConsensus(n, f=f, epsilon=eps)
     sampler = scenarios.byzantine_silence(n, f)
@@ -493,7 +532,41 @@ def rung_epsilon(repeats: int = 2) -> Dict[str, Any]:
     def io_fn(k):
         return {"initial_value": jax.random.uniform(k, (n,), jnp.float32) * 100.0}
 
-    bench, rounds = _chunked_runner(algo, io_fn, n, sampler, phases, S, 8)
+    # BASELINE rung 5 is "n=1024, multi-chip shard": when a mesh is
+    # available, the TIMED run is scenario-sharded over every device, with
+    # bit-parity against the single-device run pinned on the same keys
+    ndev = len(jax.devices())
+    shard_parity = None
+    if ndev > 1 and S % ndev == 0:
+        from round_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(ndev, proc_shards=1)
+        bench, raw_run, rounds, one = _sharded_keyed_runner(
+            algo, io_fn, n, sampler, phases, S, mesh,
+        )
+        # single-device oracle: the SAME per-scenario computation on the
+        # same keys (the scenario axis is pure data parallelism, so the
+        # sharded values must come out bit-identical).  Oracle batch size
+        # = the per-device shard size: float payloads (ε-agreement) are
+        # only bit-stable across identical vmap widths
+        keys = jax.random.split(jax.random.PRNGKey(0), S)
+        sh_dec, sh_dr, sh_val = jax.device_get(raw_run(keys))
+        per = S // ndev
+        ref_dec, ref_dr, ref_val = jax.device_get(jax.jit(
+            lambda ks: jax.lax.map(jax.vmap(one), ks.reshape(S // per, per, 2))
+        )(keys))
+
+        def bits_equal(a, b):
+            # RAW-BIT comparison: float decisions are NaN on undecided
+            # lanes (documented garbage), and NaN != NaN under ==
+            a, b = np.asarray(a), np.asarray(b).reshape(np.shape(a))
+            return bool((a.view(np.uint8) == b.view(np.uint8)).all())
+
+        shard_parity = (bits_equal(sh_dec, ref_dec)
+                        and bits_equal(sh_dr, ref_dr)
+                        and bits_equal(sh_val, ref_val))
+    else:
+        bench, rounds = _chunked_runner(algo, io_fn, n, sampler, phases, S, 8)
     best, (cnt, hist) = _time_best(
         bench, [jax.random.PRNGKey(i) for i in range(repeats)]
     )
@@ -520,9 +593,12 @@ def rung_epsilon(repeats: int = 2) -> Dict[str, Any]:
     extra = _speed_extra(best, rounds, cnt, hist, n, S)
     extra.update({
         "f": f, "eps": eps, "property_parity": ok,
-        "devices": len(jax.devices()),
+        "devices": ndev,
+        "sharded": ndev > 1 and S % ndev == 0,
     })
-    return {"metric": "ladder_epsilon_n1024", "extra": extra}
+    if shard_parity is not None:
+        extra["shard_parity"] = shard_parity
+    return {"metric": f"ladder_epsilon_n{n}", "extra": extra}
 
 
 RUNGS = {

@@ -57,7 +57,15 @@ class Algorithm:
         conventional `decided`/`decision` state fields.  Returns the
         updated state, or None when this state cannot adopt (no such
         fields, or a malformed value) — the runner then ignores the
-        message."""
+        message.
+
+        THREAT MODEL: this is BENIGN-fault recovery, exactly as in the
+        reference — the message is trusted like any group traffic, so a
+        byzantine peer (or a socket-level forger) could inject a decision.
+        The host path's byzantine tolerance is CRASH-safety (garbage never
+        kills a replica); byzantine *agreement* belongs to the PBFT layer
+        (models/pbft.py + utils/byzantine.py), not to this recovery
+        path."""
         import numpy as np
 
         if not (hasattr(state, "replace") and hasattr(state, "decided")

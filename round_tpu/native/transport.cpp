@@ -581,7 +581,15 @@ struct Node {
     }
     if (!wrote) {
       // connection died mid-write: drop it, caller may retry (reconnect
-      // semantics of TcpRuntime.scala:162-211)
+      // semantics of TcpRuntime.scala:162-211).  TLS write DEADLINES leave
+      // a live socket behind (the peer is slow, not gone) with a
+      // half-written frame — no read error will ever reap it, so close it
+      // here (we hold c->wmu, the same discipline as the loop's reaper;
+      // the loop's next poll snapshot skips fd < 0 and compacts the Conn)
+      if (tls && c->fd >= 0) {
+        close(c->fd);
+        c->fd = -1;
+      }
       std::lock_guard<std::mutex> l2(mu);
       auto it = by_peer.find(peer);
       if (it != by_peer.end() && it->second == c) by_peer.erase(it);

@@ -61,7 +61,7 @@ from round_tpu.core.rounds import FoldRound, Round, RoundCtx
 from round_tpu.ops.mailbox import Mailbox
 from round_tpu.runtime.log import get_logger
 from round_tpu.runtime.oob import FLAG_DECISION, FLAG_NORMAL, Message, Tag
-from round_tpu.runtime.transport import HostTransport
+from round_tpu.runtime.transport import HostTransport, wire_loads
 
 log = get_logger("host")
 
@@ -196,12 +196,14 @@ class HostRunner:
         """Deserialize a wire payload, tolerating garbage: any failure
         counts the message malformed and the caller drops it
         (InstanceHandler.scala:392-399 semantics, applied unconditionally).
-        Same trust model as the reference otherwise — replicas deserialize
-        only from their own group."""
+        Deserialization goes through the RESTRICTED unpickler
+        (transport.wire_loads): numpy/builtin payloads only, so a crafted
+        __reduce__ gadget cannot execute code — an exception guard alone
+        would run the attacker's payload before catching anything."""
         if not raw:
             return True, None
         try:
-            return True, pickle.loads(raw)
+            return True, wire_loads(raw)
         except Exception as e:  # noqa: BLE001 — any garbage must be survivable
             self.malformed += 1
             log.debug("node %d: dropping malformed payload (%d bytes): %s",

@@ -30,6 +30,38 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
+class _WireUnpickler(pickle.Unpickler):
+    """Restricted unpickler for wire payloads: numpy arrays/scalars and
+    plain builtin containers ONLY.  A stock pickle.loads on attacker bytes
+    EXECUTES attacker code (a __reduce__ gadget) before any exception
+    guard can contain it — so the byzantine-garbage tolerance of the host
+    path starts here, by refusing to even look up classes outside the
+    payload vocabulary.  (The reference's Kryo is similarly a
+    registered-class deserializer, not arbitrary-code.)"""
+
+    _ALLOWED_MODULES = ("numpy", "numpy.core.multiarray", "numpy._core",
+                        "numpy._core.multiarray")
+
+    def find_class(self, module, name):
+        if module == "builtins" and name in (
+                "complex", "bytearray", "frozenset", "set", "slice", "range"):
+            return super().find_class(module, name)
+        if any(module == m or module.startswith(m + ".")
+               for m in self._ALLOWED_MODULES):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"wire payload references forbidden class {module}.{name}"
+        )
+
+
+def wire_loads(raw: bytes):
+    """pickle.loads restricted to the wire-payload vocabulary (see
+    _WireUnpickler); raises pickle.UnpicklingError on anything else."""
+    import io
+
+    return _WireUnpickler(io.BytesIO(raw)).load()
+
+
 def _load() -> ctypes.CDLL:
     global _lib
     with _lib_lock:
@@ -261,10 +293,11 @@ class HostBus:
                 break
             from_id, tag, raw = got
             try:
-                payload = pickle.loads(raw) if raw else None
+                payload = wire_loads(raw) if raw else None
             except Exception:  # noqa: BLE001 — a garbage datagram on the
                 # unauthenticated socket must never kill the control plane
-                # (InstanceHandler.scala:392-399 tolerance)
+                # (InstanceHandler.scala:392-399 tolerance); wire_loads also
+                # refuses code-execution gadget classes outright
                 self.malformed += 1
                 continue
             count += 1

@@ -840,7 +840,8 @@ def entailment(
 
     if not decompose:
         return _entailment_core(h, c, config, budget)
-    for hd in _hyp_disjuncts(h, budget=config.dnf_budget):
+    dnf_budget = (config or ClDefault).dnf_budget
+    for hd in _hyp_disjuncts(h, budget=dnf_budget):
         for cc in _concl_conjuncts(c):
             if not _entailment_core(hd, cc, config, budget):
                 return False

@@ -31,9 +31,17 @@ from round_tpu.verify.verifier import ProtocolSpec, StagedChain
 # ---------------------------------------------------------------------------
 
 def tpc_spec() -> ProtocolSpec:
-    """2PC with coordinator 0: everyone sends its vote to the coordinator,
+    """2PC with coordinator: everyone sends its vote to the coordinator,
     which commits iff it heard ALL n yes-votes; round 2 broadcasts the
-    outcome.  Agreement: any two processes that decided agree."""
+    outcome.  Agreement: any two processes that decided agree.
+
+    BOTH rounds are verified (TpcExample.scala:142-178 proves round 1a/1b
+    AND 2a/2b entailments), via the roundInvariants route: F0 (fresh
+    state) ∧ TR₁ ⊨ F1′ (the vote round establishes the commit rule —
+    commit(coord) only under unanimous yes, nobody decided), and
+    F1 ∧ TR₂ ⊨ SC′ (the broadcast pins every decision to the
+    coordinator's outcome).  Agreement AND the atomic-commit validity
+    (a committed decision means everyone voted yes) follow from SC."""
     sig = StateSig({
         "vote": Bool,        # this process's yes/no vote (input)
         "decided": Bool,
@@ -43,13 +51,33 @@ def tpc_spec() -> ProtocolSpec:
 
     i = Variable("i", procType)
     j = Variable("j", procType)
+    k = Variable("k", procType)
 
-    # Round 2 of TPC: outcome broadcast from the coordinator.  (Round 1 —
-    # vote collection into the coordinator — precedes any decision, so its
-    # preservation argument needs phase-staged invariants; the verified
-    # slice here is the decision broadcast, which carries the agreement
-    # argument.  The runtime model checks both rounds on traces:
-    # round_tpu/models/tpc.py.)
+    # Round 1 of TPC: vote collection into the coordinator
+    # (TwoPhaseCommit.scala round 1: dest = coordinator; the coordinator
+    # commits iff its mailbox holds ALL n votes and every one is yes)
+    def r1_update(mb: Mailbox, jj, s: StateSig):
+        all_heard = Eq(mb.size(), N)
+        kk = Variable("tpk", procType)
+        all_yes = ForAll(
+            [kk], Implies(In(kk, mb.senders()), mb.payload("v", kk))
+        )
+        return And(
+            Eq(
+                s.get_primed("commit", jj),
+                And(Eq(jj, coord), all_heard, all_yes),
+            ),
+            s.frame_equal(["vote", "decided"], jj),
+        )
+
+    r1 = RoundTR(
+        sig=sig,
+        payload_defs={"v": (Bool, lambda ii: sig.get("vote", ii))},
+        dest_fn=lambda ii, jj: Eq(jj, coord),
+        update_fn=r1_update,
+    )
+
+    # Round 2 of TPC: outcome broadcast from the coordinator.
     def r2_update(mb: Mailbox, jj, s: StateSig):
         heard_coord = In(coord, mb.senders())
         return And(
@@ -78,8 +106,12 @@ def tpc_spec() -> ProtocolSpec:
         update_fn=r2_update,
     )
 
-    # Invariant: nobody decided yet, or everyone who decided carries the
-    # coordinator's commit value (the agreement core).
+    # Safety core: everyone who decided carries the coordinator's commit
+    # value (the agreement core), and a commit can only mean unanimous yes
+    # (the atomic-commit validity rule, established by round 1).
+    commit_rule = Implies(
+        sig.get("commit", coord), ForAll([k], sig.get("vote", k))
+    )
     inv = ForAll(
         [i],
         Implies(
@@ -87,6 +119,7 @@ def tpc_spec() -> ProtocolSpec:
             Eq(sig.get("commit", i), sig.get("commit", coord)),
         ),
     )
+    sc = And(inv, commit_rule)
     agreement = ForAll(
         [i, j],
         Implies(
@@ -94,15 +127,36 @@ def tpc_spec() -> ProtocolSpec:
             Eq(sig.get("commit", i), sig.get("commit", j)),
         ),
     )
+    validity = ForAll(
+        [i],
+        Implies(
+            And(sig.get("decided", i), sig.get("commit", i)),
+            ForAll([k], sig.get("vote", k)),
+        ),
+    )
 
-    init = ForAll([i], Not(sig.get("decided", i)))
+    nobody_decided = ForAll([i], Not(sig.get("decided", i)))
+    f0 = And(nobody_decided, ForAll([i], Not(sig.get("commit", i))))
+    f1 = And(nobody_decided, commit_rule)
+    init = f0
 
     return ProtocolSpec(
         sig=sig,
-        rounds=[r2],
+        rounds=[r1, r2],
         init=init,
-        invariants=[inv],
-        properties=[("agreement", agreement)],
+        invariants=[sc],
+        properties=[
+            ("agreement", agreement),
+            ("validity (commit => unanimous yes)", validity,
+             ClConfig(venn_bound=1, inst_depth=2)),
+        ],
+        round_staged_inductiveness=[
+            ("vote collection (round 1a/1b): commit rule established",
+             f0, r1.full_tr(), sig.prime(f1)),
+            ("outcome broadcast (round 2a/2b): decisions pin to the "
+             "coordinator", f1, r2.full_tr(), sig.prime(sc)),
+        ],
+        round_staged_init=f0,
     )
 
 

@@ -195,6 +195,32 @@ def _deploy(n, algo_name, make_io, algo_opts=None, timeout_ms=500, seed=0,
     return results
 
 
+def test_wire_unpickler_refuses_gadgets():
+    """The wire deserializer must REFUSE code-execution gadget classes
+    outright (a try/except around stock pickle.loads would run the
+    attacker's __reduce__ payload before catching anything): only
+    numpy/builtin payload classes resolve."""
+    import pickle as _pickle
+
+    from round_tpu.runtime.transport import wire_loads
+
+    class Evil:
+        def __reduce__(self):
+            return (print, ("pwned",))
+
+    evil = _pickle.dumps(Evil())
+    with pytest.raises(_pickle.UnpicklingError, match="forbidden"):
+        wire_loads(evil)
+    # the legitimate payload vocabulary round-trips
+    for obj in (np.int32(7), np.arange(5), {"a": (1, "x")}, [True, 2.5],
+                np.float32(1.5), None):
+        got = wire_loads(_pickle.dumps(obj))
+        if isinstance(obj, np.ndarray):
+            np.testing.assert_array_equal(got, obj)
+        else:
+            assert got == obj or (got is None and obj is None)
+
+
 def test_host_oob_decision_recovery():
     """FLAG_DECISION out-of-band recovery (PerfTest.scala:40-60): a replica
     that cannot reach quorum (both peers dead) adopts a peer-supplied

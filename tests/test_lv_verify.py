@@ -164,6 +164,35 @@ def test_lv_stage_subvcs(k):
     assert entailment(hyp, concl, cfg, timeout_s=400), label
 
 
+def test_lv_chain_generation_is_consistent():
+    """FAST CI guard for the chain/verifier coupling: protocols.py's chain
+    builder mirrors verifier._composed_vc's context/freshness evolution, so
+    any desynchronization (reordered context, changed closed-fact shape)
+    must surface HERE — VC GENERATION runs every prune-membership and
+    freshness check without solving anything — not ten minutes into the
+    RUN_SLOW_VCS-gated full run."""
+    from round_tpu.verify.protocols import lv_verifier_spec
+    from round_tpu.verify.verifier import Verifier
+
+    ver = Verifier(lv_verifier_spec())
+    vcs = ver.generate_vcs()  # raises on any prune/freshness mismatch
+    names = []
+
+    def walk(vc):
+        if hasattr(vc, "children"):
+            for c in vc.children:
+                walk(c)
+        else:
+            names.append(vc.name)
+
+    for vc in vcs:
+        walk(vc)
+    # both machine-checked chains produced their composition VCs
+    assert any("composition" in n for n in names)
+    assert any(n.startswith("intro") for n in names)
+    assert not ver.used_staged  # no legacy chains => no caveat in reports
+
+
 def test_lv_verifies_end_to_end():
     """The FULL LastVoting check through the Verifier (roundInvariants
     route): init => SC ∧ F0, all four round-staged inductiveness VCs

@@ -82,3 +82,21 @@ def test_sharded_loop_kernel_matches_single_device():
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert int(np.asarray(sharded[0][1]).sum()) > 0  # something decided
+
+
+def test_epsilon_rung_sharded_bit_parity():
+    """BASELINE rung 5 (byzantine ε-agreement, multi-chip shard): on a
+    multi-device mesh the rung times the scenario-sharded run and pins
+    bit-parity against the single-device general engine on the same keys
+    (small shapes here; the real rung runs n=1024)."""
+    from round_tpu.apps.ladder import rung_epsilon
+
+    assert len(jax.devices()) >= 2, "conftest provides the 8-device mesh"
+    # 8 phases as the real rung: ε-agreement halves the value range per
+    # phase, and 4 phases cannot take a range of 100 down to ε = 0.5
+    out = rung_epsilon(repeats=1, n=32, S=16, phases=8, f=3)
+    extra = out["extra"]
+    assert extra["devices"] == len(jax.devices())
+    assert extra["sharded"] is True
+    assert extra["shard_parity"] is True
+    assert extra["property_parity"] is True

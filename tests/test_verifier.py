@@ -43,6 +43,27 @@ def test_tpc_broken_invariant_rejected():
     assert not ver.check()
 
 
+def test_tpc_vote_round_negative_control():
+    """The vote-collection VC (round 1a/1b, TpcExample.scala:142-178
+    parity) is not vacuous: the CONVERSE commit claim — unanimous yes
+    forces a commit — must NOT follow from the round-1 TR, because the
+    coordinator may simply not have heard every vote (partial HO)."""
+    from round_tpu.verify.futils import free_vars
+
+    spec = tpc_spec()
+    sig = spec.sig
+    name, hyp, tr, _concl = spec.round_staged_inductiveness[0]
+    assert "vote collection" in name
+    coord = next(v for v in free_vars(tr) if v.name == "coord")
+    k = Variable("k", procType)
+    wrong = Implies(
+        ForAll([k], sig.get_primed("vote", k)),
+        sig.get_primed("commit", coord),
+    )
+    cfg = spec.config or ClConfig(venn_bound=2, inst_depth=1)
+    assert not entailment(And(hyp, tr), wrong, cfg, timeout_s=120)
+
+
 # ---------------------------------------------------------------------------
 # OTR / one-third rule: the hand-translated VCs (OtrExample.scala style)
 # ---------------------------------------------------------------------------
