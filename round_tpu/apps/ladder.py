@@ -489,7 +489,9 @@ def _sharded_keyed_runner(algo, io_fn, n, sampler, phases, S, mesh):
     pure data parallelism over the Mesh's scenario axis (each device runs
     its slice of per-scenario keys through the general engine; values are
     bit-identical to the single-device run on the same keys, which the
-    rung verifies).  Returns (bench, raw_run, rounds)."""
+    rung verifies).  Returns (bench, raw_run, rounds, one) — `one` is the
+    per-scenario computation, returned so the parity oracle compares the
+    SAME function, never a drifted copy."""
     from functools import partial as _partial
 
     from jax.sharding import PartitionSpec as _P

@@ -4,6 +4,10 @@ Reference parity: example/Verifier.scala:22-37 — a CLI that runs the
 verifier on example.OTR / LastVoting and writes report.html.
 
 Usage:  python -m round_tpu.apps.verifier_cli tpc [-r report.html] [-v]
+
+Per-VC wall budgets are tuned to an idle box; on a loaded one set
+ROUND_TPU_VC_TIMEOUT_SCALE (e.g. 2) to scale every budget uniformly
+instead of getting spurious timeouts reported as failures.
 """
 
 from __future__ import annotations

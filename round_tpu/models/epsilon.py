@@ -12,6 +12,15 @@ This is SURVEY.md §7's "order statistics + data-dependent round count" hard
 case: the sort is a masked sort over the [2n] (mailbox ∪ halted) value
 vector, and maxR is a per-lane tensor bounding participation under a global
 scan horizon.  Model requires n > 5f and f ≥ 1.
+
+Verification story: this round class EXTRACTS (verify/protocols.py
+epsilon_extracted_tr) — jnp.sort lowers through the declared
+order-statistics primitive of the jaxpr extractor (verify/extract.py
+_sort_site), with float payloads abstracted to their order; the round-0
+drop-2f selection lemmas prove from the extracted axioms
+(tests/test_event_extract.py).  The later rounds' trimmed MEAN stays an
+opaque site: its real arithmetic is outside the int/bool fragment by
+design (the reference cannot verify this example at all).
 """
 
 from __future__ import annotations

@@ -583,13 +583,13 @@ struct Node {
       // connection died mid-write: drop it, caller may retry (reconnect
       // semantics of TcpRuntime.scala:162-211).  TLS write DEADLINES leave
       // a live socket behind (the peer is slow, not gone) with a
-      // half-written frame — no read error will ever reap it, so close it
-      // here (we hold c->wmu, the same discipline as the loop's reaper;
-      // the loop's next poll snapshot skips fd < 0 and compacts the Conn)
-      if (tls && c->fd >= 0) {
-        close(c->fd);
-        c->fd = -1;
-      }
+      // half-written frame — no read error will ever reap it.  shutdown()
+      // (NOT close) from this sender thread: the event loop may hold the
+      // fd in an in-flight poll snapshot, and closing here would let the
+      // fd number be reused by a concurrent connect while the loop still
+      // reads the old SSL object through it.  shutdown makes the loop's
+      // next SSL_read fail, and the REAPER (loop thread) does the close.
+      if (tls && c->fd >= 0) shutdown(c->fd, SHUT_RDWR);
       std::lock_guard<std::mutex> l2(mu);
       auto it = by_peer.find(peer);
       if (it != by_peer.end() && it->second == c) by_peer.erase(it);

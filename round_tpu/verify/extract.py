@@ -81,18 +81,81 @@ class Vec2:
         self.fn = fn
 
 
+class RankVec:
+    """A vector indexed by POSITION/RANK (Int), not by process: the output
+    of `sort` and anything derived from a non-process-length iota (the
+    ε-model's selection indices over the sorted [2n] vector).  Reductions
+    over a RankVec have no senders-domain guard and are kept OPAQUE
+    (unaxiomatized sites) — the order-statistics axioms live on the sort
+    site itself."""
+
+    __slots__ = ("fn",)
+
+    def __init__(self, fn: Callable[[Formula], Formula]):
+        self.fn = fn
+
+
+class ConcatVec:
+    """concatenate([process-domain Vec, uniform pad]) — the ε-model's
+    mailbox ++ halted layout with the halted half constant: base(i) over
+    procType plus (symbolically) n copies of `pad`."""
+
+    __slots__ = ("fn", "pad")
+
+    def __init__(self, fn: Callable[[Formula], Formula], pad: Formula):
+        self.fn = fn
+        self.pad = pad
+
+
+_ABS = (Scalar, Vec, Vec2, RankVec, ConcatVec)
+
+# f32 ±inf mask sentinels, abstracted into the Int value order as opaque
+# constants; the sort site emits the (f32-sound) dominance axiom
+# ∀i. v(i) ≤ INF when it sees one as its padding
+_INF_F = Application(
+    UnInterpretedFct("float!inf", FunT([], Int)), []
+).with_type(Int)
+_NEG_INF_F = Application(
+    UnInterpretedFct("float!neginf", FunT([], Int)), []
+).with_type(Int)
+# float division routes through _BINOPS' DIVIDES like integer div: with a
+# non-constant divisor it stays uninterpreted (sound — the ε midpoint mean
+# is opaque downstream; cl's floor axioms only attach to constant divisors)
+
+
 def _lift(v) -> "Scalar | Vec":
-    if isinstance(v, (Scalar, Vec, Vec2)):
+    if isinstance(v, _ABS):
         return v
     if isinstance(v, (bool, np.bool_)):
         return Scalar(Literal(bool(v)))
     if isinstance(v, (int, np.integer)):
         return Scalar(IntLit(int(v)))
+    if isinstance(v, (float, np.floating)):
+        if np.isposinf(v):
+            return Scalar(_INF_F)
+        if np.isneginf(v):
+            return Scalar(_NEG_INF_F)
+        if float(v) == int(v):
+            return Scalar(IntLit(int(v)))
+        raise ExtractionError(
+            f"cannot lift non-integral float constant {v!r} (the int/bool "
+            "fragment abstracts float payloads to their order)"
+        )
     if isinstance(v, np.ndarray) and v.ndim == 0:
         if v.dtype == np.bool_:
             return Scalar(Literal(bool(v)))
+        if np.issubdtype(v.dtype, np.floating):
+            return _lift(float(v))
         return Scalar(IntLit(int(v)))
+    if isinstance(v, np.ndarray) and v.ndim == 1 and v.size > 0:
+        first = v[0]
+        if bool((v == first).all()):  # uniform constant vector
+            return Vec(lambda i, s=_lift(first): s.f)
     raise ExtractionError(f"cannot lift constant {v!r} into a formula")
+
+
+def _elem_fn(v):
+    return (lambda i: v.f) if isinstance(v, Scalar) else v.fn
 
 
 def _binop(mk, a, b):
@@ -103,6 +166,19 @@ def _binop(mk, a, b):
         fa = _as2(a)
         fb = _as2(b)
         return Vec2(lambda r, c: mk(fa(r, c), fb(r, c)))
+    if isinstance(a, ConcatVec) or isinstance(b, ConcatVec):
+        if isinstance(a, (Vec, RankVec)) or isinstance(b, (Vec, RankVec)):
+            raise ExtractionError("binop mixing concat and plain vectors")
+        pa = a.f if isinstance(a, Scalar) else a.pad
+        pb = b.f if isinstance(b, Scalar) else b.pad
+        fa, fb = _elem_fn(a), _elem_fn(b)
+        return ConcatVec(lambda i: mk(fa(i), fb(i)), mk(pa, pb))
+    if isinstance(a, RankVec) or isinstance(b, RankVec):
+        if isinstance(a, Vec) or isinstance(b, Vec):
+            raise ExtractionError(
+                "binop mixing rank-domain and process-domain vectors")
+        fa, fb = _elem_fn(a), _elem_fn(b)
+        return RankVec(lambda i: mk(fa(i), fb(i)))
     fa = (lambda i: a.f) if isinstance(a, Scalar) else a.fn
     fb = (lambda i: b.f) if isinstance(b, Scalar) else b.fn
     return Vec(lambda i: mk(fa(i), fb(i)))
@@ -111,7 +187,7 @@ def _binop(mk, a, b):
 def _orient2(v, s_in):
     """View an operand of a rank-2 result as a Vec2 using its own shape:
     (n,1)/(n,) → rows, (1,n) → cols, (n,n) → as-is, scalar → const."""
-    v = _lift(v) if not isinstance(v, (Scalar, Vec, Vec2)) else v
+    v = _lift(v) if not isinstance(v, _ABS) else v
     if isinstance(v, Vec):
         if len(s_in) == 2 and s_in[0] == 1:
             return Vec2(lambda r, c: v.fn(c))
@@ -139,6 +215,10 @@ def _unop(mk, a):
         return Scalar(mk(a.f))
     if isinstance(a, Vec2):
         return Vec2(lambda r, c: mk(a.fn(r, c)))
+    if isinstance(a, ConcatVec):
+        return ConcatVec(lambda i: mk(a.fn(i)), mk(a.pad))
+    if isinstance(a, RankVec):
+        return RankVec(lambda i: mk(a.fn(i)))
     return Vec(lambda i: mk(a.fn(i)))
 
 
@@ -217,6 +297,7 @@ class _Interpreter:
         self,
         senders_domain: Callable[[Formula], Formula],
         receiver: Optional[Formula] = None,
+        proc_len: Optional[int] = None,
     ):
         """senders_domain(i): the guard restricting mailbox reductions —
         i ∈ HO(j) ∧ dest(i, j) (the mailboxLink semantics).  Pass
@@ -231,6 +312,9 @@ class _Interpreter:
         self.senders = senders_domain
         self.receiver = receiver if receiver is not None else \
             Variable("extj", procType)
+        # the example trace's process-axis length: distinguishes the lane-id
+        # iota (process domain) from rank-domain index vectors
+        self.proc_len = proc_len
         self.axioms: List[Formula] = []
         # pre-condition obligations of @aux_method call sites: the verifier
         # must discharge these (invariants ⊢ pre), mirroring the
@@ -312,6 +396,78 @@ class _Interpreter:
         k = next(self._fresh)
         fct = UnInterpretedFct(f"ext!{tag}!{k}", FunT([procType], tpe))
         return Application(fct, [self.receiver]).with_type(tpe)
+
+    def _sort_site(self, op):
+        """Order statistics as a DECLARED primitive (the sort/drop-f/select
+        step of Epsilon.scala:34-62): the sorted vector becomes a fresh
+        rank-indexed function ord(j, k) pinned by the exact multiset
+        characterization —
+
+          S1 (sortedness)  k ≤ k' → ord(k) ≤ ord(k')
+          S2 (attainment)  ord(k) is an input element (or the pad)
+          S3 (rank bounds) |{v ≤ ord(k)}| ≥ k+1  ∧  |{v < ord(k)}| ≤ k
+                           (pads counted by their uniform value)
+
+        — over the input's process-domain elements plus, for a ConcatVec,
+        the symbolically-n uniform pad half.  An INF pad additionally emits
+        the (f32-total-order-sound) dominance fact ∀i. v(i) ≤ INF.  This
+        closes the sort extraction boundary that previously required
+        @aux_method contracts."""
+        from round_tpu.verify.venn import N_VAR
+
+        if not isinstance(op, (Vec, ConcatVec)):
+            raise ExtractionError("sort over a non-vector value")
+        uid = next(self._fresh)
+        fct = UnInterpretedFct(f"ext!sort!{uid}", FunT([procType, Int], Int))
+
+        def ord_at(r):
+            return Application(fct, [self.receiver, r]).with_type(Int)
+
+        total = Plus(N_VAR, N_VAR) if isinstance(op, ConcatVec) else N_VAR
+        pad = op.pad if isinstance(op, ConcatVec) else None
+        base = op.fn
+
+        def pad_count(rel, bound):
+            if pad is None:
+                return None
+            return Ite(rel(pad, bound), N_VAR, IntLit(0))
+
+        k1 = Variable(f"srk!{uid}a", Int)
+        k2 = Variable(f"srk!{uid}b", Int)
+
+        def in_range(kv):
+            return And(Leq(IntLit(0), kv), Lt(kv, total))
+
+        # S1
+        self.axioms.append(ForAll(
+            [k1, k2],
+            Implies(And(in_range(k1), in_range(k2), Leq(k1, k2)),
+                    Leq(ord_at(k1), ord_at(k2))),
+        ))
+        # S2
+        iv = self.var()
+        attained = Exists([iv], Eq(base(iv), ord_at(k1)))
+        if pad is not None:
+            attained = Or(attained, Eq(ord_at(k1), pad))
+        self.axioms.append(ForAll(
+            [k1], Implies(in_range(k1), attained),
+        ))
+        # S3 (≤ with k+1 lower bound; < with k upper bound)
+        for rel, mk_bound in (
+            (Leq, lambda kv, c: Geq(c, Plus(kv, IntLit(1)))),
+            (Lt, lambda kv, c: Leq(c, kv)),
+        ):
+            iw = self.var()
+            card = Card(Comprehension([iw], rel(base(iw), ord_at(k1))))
+            pc = pad_count(rel, ord_at(k1))
+            count = card if pc is None else Plus(card, pc)
+            self.axioms.append(ForAll(
+                [k1], Implies(in_range(k1), mk_bound(k1, count)),
+            ))
+        if pad is not None and pad == _INF_F:
+            ip = self.var()
+            self.axioms.append(ForAll([ip], Leq(base(ip), _INF_F)))
+        return RankVec(ord_at)
 
     def _extremum(self, body_fn, tpe: Type, is_max: bool,
                   guard_fn=None) -> Formula:
@@ -427,13 +583,47 @@ class _Interpreter:
             # v[idx] with a traced process index lowers to a size-1
             # dynamic_slice + squeeze (Mailbox._tree_pick / best_by)
             op, *idxs = ins
-            op = _lift(op) if not isinstance(op, (Scalar, Vec, Vec2)) else op
+            op = _lift(op) if not isinstance(op, _ABS) else op
             if isinstance(op, Vec) and len(idxs) == 1 \
                     and isinstance(idxs[0], Scalar) and out_shape() == (1,):
                 return Scalar(op.fn(idxs[0].f))
             raise ExtractionError("unsupported dynamic_slice pattern")
         if prim == "iota":
+            # a process-length iota is the lane-id vector; any other length
+            # (the ε-model's [2n] selection indices) lives in the RANK
+            # domain — its reductions must not get a senders guard
+            if self.proc_len is not None and out_shape() != (self.proc_len,):
+                return RankVec(lambda i: i)
             return Vec(lambda i: i)
+        if prim == "concatenate":
+            a = _lift(ins[0])
+            b = _lift(ins[1])
+            # the mailbox ++ halted layout with a constant second half
+            # (Epsilon.scala:55 with no prior halts): process-domain base
+            # plus a uniform pad of symbolically n entries
+            if len(eqn.invars) == 2 and isinstance(a, Vec) \
+                    and isinstance(b, Scalar) and in_shape(0) == in_shape(1):
+                return ConcatVec(a.fn, b.f)
+            raise ExtractionError(
+                "unsupported concatenate pattern (only [proc-vector, "
+                "uniform pad] of equal halves)"
+            )
+        if prim == "sort":
+            if len(eqn.invars) != 1:
+                raise ExtractionError("multi-operand sort")
+            return self._sort_site(_lift(ins[0]))
+        if prim == "slice":
+            op = _lift(ins[0])
+            starts = eqn.params.get("start_indices", ())
+            limits = eqn.params.get("limit_indices", ())
+            strides = eqn.params.get("strides") or (1,) * len(starts)
+            # static single-element pick of a RANK-indexed vector
+            # (sorted_v[2f] → slice+squeeze); process-domain slices would
+            # need an Int→proc coercion and have no use case
+            if isinstance(op, RankVec) and len(starts) == 1 \
+                    and strides == (1,) and limits[0] - starts[0] == 1:
+                return Scalar(op.fn(IntLit(starts[0])))
+            raise ExtractionError("unsupported slice pattern")
         if prim in ("pjit", "jit", "closed_call", "custom_jvp_call"):
             from round_tpu.verify.auxmethod import AUX_PREFIX, REGISTRY
             pname = eqn.params.get("name") or ""
@@ -463,7 +653,7 @@ class _Interpreter:
         )
 
     def _broadcast(self, v, s_in, s_out, bdims):
-        v = _lift(v) if not isinstance(v, (Scalar, Vec, Vec2)) else v
+        v = _lift(v) if not isinstance(v, _ABS) else v
         if len(s_out) <= 1 or (len(s_out) == 2 and 1 in s_out):
             return v  # vector-ish broadcast: same abstract value
         if len(s_out) == 2:
@@ -523,11 +713,18 @@ class _Interpreter:
     def _gather(self, operand, idx, s_op, s_out):
         operand = _lift(operand) if not isinstance(
             operand, (Scalar, Vec, Vec2)) else operand
-        idx = _lift(idx) if not isinstance(idx, (Scalar, Vec, Vec2)) else idx
+        idx = _lift(idx) if not isinstance(idx, _ABS) else idx
         if isinstance(operand, Vec) and isinstance(idx, Scalar) \
                 and len(s_out) <= 1:
             # v[i] with a traced process index (e.g. payload of argmax sender)
             return Scalar(operand.fn(idx.f))
+        if isinstance(operand, RankVec) and isinstance(idx, Scalar) \
+                and len(s_out) <= 1:
+            return Scalar(operand.fn(idx.f))
+        if isinstance(operand, RankVec) and isinstance(idx, RankVec):
+            # sorted_v[idx] with a rank-index vector (the ε selection) —
+            # composition stays in the rank domain
+            return RankVec(lambda k: operand.fn(idx.fn(k)))
         raise ExtractionError("unsupported gather pattern")
 
     def _reduce(self, operand, kind: str, axes, s_in):
@@ -583,6 +780,24 @@ class _Interpreter:
                     i, body = partial(rem)
                     return ForAll([i], body)
                 return Vec(mk_and)
+        if isinstance(operand, ConcatVec):
+            from round_tpu.verify.venn import N_VAR
+
+            if kind != "sum":
+                raise ExtractionError(
+                    f"reduce_{kind} over a concatenated vector")
+            ic = self.var()
+            bodyc = operand.fn(ic)
+            if not _is_boolish(bodyc):
+                raise ExtractionError("sum over non-indicator concat values")
+            base = Card(Comprehension([ic], And(self.senders(ic), bodyc)))
+            # the uniform pad half contributes all-or-nothing
+            return Scalar(Plus(base, Ite(operand.pad, N_VAR, IntLit(0))))
+        if isinstance(operand, RankVec):
+            # rank-domain reduction (the ε midpoint mean's numerator/count):
+            # OPAQUE site, no axioms — sound ("some value"); the round-0
+            # order-statistics lemmas never consume it
+            return Scalar(self._site(f"rank{kind}", Int))
         if not isinstance(operand, Vec):
             raise ExtractionError(f"reduce_{kind} over a non-mailbox value")
         i = self.var()
@@ -647,6 +862,12 @@ def _binop_3(which, on_false, on_true, mixed_to_int=False):
     parts = [which, a, b]
 
     def mk_ite(c, t, e):
+        if isinstance(c, Literal) and isinstance(c.value, bool):
+            # constant-condition fold — in particular the uniform PAD lane
+            # of a ConcatVec select, whose mask pad is a literal: folding
+            # keeps the pad recognizable (the sort site's INF-dominance
+            # axiom matches the INF constant, not an Ite around it)
+            return t if c.value else e
         if mixed_to_int:
             tt = getattr(t, "tpe", None)
             te = getattr(e, "tpe", None)
@@ -661,6 +882,21 @@ def _binop_3(which, on_false, on_true, mixed_to_int=False):
         return Vec2(
             lambda r, c: mk_ite(fns[0](r, c), fns[2](r, c), fns[1](r, c))
         )
+    if any(isinstance(p, ConcatVec) for p in parts):
+        if any(isinstance(p, (Vec, RankVec)) for p in parts):
+            raise ExtractionError("select mixing concat and plain vectors")
+        fns = [_elem_fn(p) for p in parts]
+        pads = [p.f if isinstance(p, Scalar) else p.pad for p in parts]
+        return ConcatVec(
+            lambda i: mk_ite(fns[0](i), fns[2](i), fns[1](i)),
+            mk_ite(pads[0], pads[2], pads[1]),
+        )
+    if any(isinstance(p, RankVec) for p in parts):
+        if any(isinstance(p, Vec) for p in parts):
+            raise ExtractionError(
+                "select mixing rank-domain and process-domain vectors")
+        fns = [_elem_fn(p) for p in parts]
+        return RankVec(lambda i: mk_ite(fns[0](i), fns[2](i), fns[1](i)))
     fns = [(lambda i, p=p: p.f) if isinstance(p, Scalar) else p.fn
            for p in parts]
     return Vec(lambda i: mk_ite(fns[0](i), fns[2](i), fns[1](i)))
@@ -669,6 +905,27 @@ def _binop_3(which, on_false, on_true, mixed_to_int=False):
 # ---------------------------------------------------------------------------
 # Entry point
 # ---------------------------------------------------------------------------
+
+def _dce(jaxpr):
+    """Backward dead-code elimination over a (flat) jaxpr: make_jaxpr keeps
+    equations whose outputs were pruned — the ε-model's float horizon
+    arithmetic (log/ceil over the spread) feeds only the max_r output, and
+    an extraction that only asks for x must not be forced to handle
+    primitives on that dead path."""
+    import jax.core as _jcore
+
+    drop = getattr(_jcore, "DropVar", ())
+    needed = {v for v in jaxpr.outvars if not isinstance(v, jax_core.Literal)}
+    keep = []
+    for eqn in reversed(jaxpr.eqns):
+        outs = [o for o in eqn.outvars if not isinstance(o, drop)]
+        if any(o in needed for o in outs):
+            keep.append(eqn)
+            for a in eqn.invars:
+                if not isinstance(a, jax_core.Literal):
+                    needed.add(a)
+    return jaxpr.replace(eqns=list(reversed(keep)))
+
 
 def extract_lane_fn(
     fn: Callable,
@@ -689,9 +946,16 @@ def extract_lane_fn(
     instead of Scala trees: same inputs (the executable round code), same
     output (formulas for the transition relation)."""
     closed = jax.make_jaxpr(fn)(*example_args)
-    interp = _Interpreter(senders_domain, receiver=receiver)
+    jaxpr = _dce(closed.jaxpr)
+    # the process-axis length, for rank-domain detection: the (single)
+    # 1-D length among the example args
+    lens = {np.shape(a)[0] for a in jax.tree_util.tree_leaves(
+        list(example_args)) if np.ndim(a) == 1}
+    proc_len = lens.pop() if len(lens) == 1 else None
+    interp = _Interpreter(senders_domain, receiver=receiver,
+                          proc_len=proc_len)
     flat_args, _ = jax.tree_util.tree_flatten(list(formula_args))
-    outs = interp.run(closed.jaxpr, closed.consts, flat_args)
+    outs = interp.run(jaxpr, closed.consts, flat_args)
     if interp.obligations and not return_obligations:
         # a dropped pre-condition would let the verifier assume the post of
         # a helper called outside its contract — refuse to extract unless
@@ -710,7 +974,7 @@ def extract_lane_fn(
             else (o.fn(probe) if isinstance(o, Vec)
                   else o.fn(probe, probe))
             for o in outs
-            if isinstance(o, (Scalar, Vec, Vec2))
+            if isinstance(o, _ABS)
         ]
 
         def uses_ptoid(t):

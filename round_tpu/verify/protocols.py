@@ -1565,6 +1565,131 @@ def lv_extracted_stage_vcs():
     return stages, meta
 
 
+def epsilon_extracted_tr():
+    """ε-agreement's round (the sort/drop-2f/select order-statistics step,
+    Epsilon.scala:34-62) extracted from the EXECUTABLE round class
+    models/epsilon.py:EpsilonRound — `jnp.sort` lowers through the
+    DECLARED order-statistics primitive (verify/extract.py _sort_site:
+    the sorted vector becomes a rank function ord(j, k) pinned by
+    sortedness / attainment / rank-bound axioms over the mailbox∪halted
+    multiset), not through @aux_method contracts — closing the last
+    documented extraction boundary.  Float payloads abstract to their
+    ORDER (Int-valued symbols; sound for the selection lemmas); the
+    midpoint mean of later rounds stays an opaque site — its real
+    arithmetic is genuinely outside the int/bool fragment, by design.
+
+    Extraction covers the full x′ update: round 0 picks ord(2f) (the
+    (2f+1)-smallest of mailbox ∪ halted, the Epsilon.scala:49 drop-2f
+    head), deciding rounds freeze x, inner rounds take the (opaque)
+    trimmed mean.
+
+    Returns (sig, j, r, x_update_eq, axioms, pieces)."""
+    import jax.numpy as jnp
+
+    from round_tpu.core.rounds import RoundCtx
+    from round_tpu.models.epsilon import EpsilonRound, EpsilonState
+    from round_tpu.ops.mailbox import Mailbox as RtMailbox
+    from round_tpu.verify.extract import Scalar, Vec, extract_lane_fn
+
+    ne, f = 11, 2
+    sig = StateSig({"x": Int, "max_r": Int})
+    j = Variable("epj", procType)
+    r = Variable("r", Int)
+    sndv = UnInterpretedFct("epsndv", FunT([procType], Int))
+    sndh = UnInterpretedFct("epsndh", FunT([procType], Bool))
+
+    def upd(nn, rr, jid, x, max_r, v_p, halt_p, mask):
+        ctx = RoundCtx(id=jid, n=nn, r=rr)
+        st = EpsilonState(
+            x=x, max_r=max_r,
+            halted_vals=jnp.zeros((ne,), jnp.float32),
+            halted_mask=jnp.zeros((ne,), bool),
+            decided=jnp.bool_(False), decision=jnp.float32(0),
+        )
+        st2 = EpsilonRound(ne, f, 0.5).update(
+            ctx, st, RtMailbox({"v": v_p, "halt": halt_p}, mask)
+        )
+        return st2.x
+
+    ex = [jnp.int32(ne), jnp.int32(0), jnp.int32(0), jnp.float32(0),
+          jnp.int32(5), jnp.zeros((ne,), jnp.float32),
+          jnp.zeros((ne,), bool), jnp.zeros((ne,), bool)]
+    fargs = [
+        Scalar(N), Scalar(r), Scalar(j),
+        Scalar(sig.get("x", j)), Scalar(sig.get("max_r", j)),
+        Vec(lambda i: Application(sndv, [i]).with_type(Int)),
+        Vec(lambda i: Application(sndh, [i]).with_type(Bool)),
+        Vec(lambda i: In(i, ho_of(j))),
+    ]
+    outs, axioms = extract_lane_fn(
+        upd, ex, fargs, lambda i: Literal(True), receiver=j,
+        return_axioms=True,
+    )
+    x_update_eq = Eq(sig.get_primed("x", j), outs[0].f)
+    # the round-0 branch's pick: ord(2f) of the sort site
+    ord_2f = outs[0].f.args[1]
+    pieces = {
+        "f": f, "sndv": sndv, "sndh": sndh, "ord_2f": ord_2f,
+        "sort_fct": ord_2f.fct,
+    }
+    return sig, j, r, x_update_eq, axioms, pieces
+
+
+def epsilon_extracted_stage_vcs():
+    """The round-0 selection lemmas of ε-agreement, proved from the
+    EXTRACTED order-statistics TR (the validity core: the drop-2f pick
+    lies weakly inside the heard values' range).  Axioms are instantiated
+    at the ranks the argument uses — the OTR mor-axiom-instance
+    discipline: the ∀-rank forms make the venn group explode, the
+    instances are what the argument needs.
+
+    The reference cannot verify ε-agreement at all (floats); these lemmas
+    hold in the order abstraction and discharge sub-second.  Returns
+    [(name, hyp, concl, cfg)]."""
+    from round_tpu.verify.futils import subst_vars
+
+    sig, j, r, x_eq, axioms, P = epsilon_extracted_tr()
+    s1, s2, s3a, s3b, dom = axioms
+    f = P["f"]
+    srt = P["sort_fct"]
+    ord_2f = P["ord_2f"]
+    sndv = P["sndv"]
+
+    def inst(ax, *ks):
+        vs = list(ax.vars)
+        assert len(vs) == len(ks), (vs, ks)
+        return subst_vars(
+            ax.body.args[-1], {v: IntLit(k) for v, k in zip(vs, ks)}
+        )
+
+    def ord_at(k):
+        return Application(srt, [j, IntLit(k)]).with_type(Int)
+
+    def sndv_of(i):
+        return Application(sndv, [i]).with_type(Int)
+
+    kk = Variable("lk", procType)
+    ho_card = Card(Comprehension([kk], In(kk, ho_of(j))))
+    i2 = Variable("li", procType)
+    n_big = Gt(N, IntLit(5 * f))   # the protocol's n > 5f assumption
+    c11 = ClConfig(venn_bound=1, inst_depth=1)
+    c21 = ClConfig(venn_bound=2, inst_depth=1)
+
+    return [
+        ("sortedness: ord(f) <= ord(2f)",
+         And(inst(s1, f, 2 * f), n_big),
+         Leq(ord_at(f), ord_2f), c11),
+        ("trim witness: some heard value >= the round-0 pick",
+         And(inst(s3b, 2 * f), n_big, Gt(ho_card, IntLit(2 * f))),
+         Exists([i2], And(In(i2, ho_of(j)),
+                          Geq(sndv_of(i2), ord_2f))), c21),
+        ("lower witness: some heard value <= the round-0 pick",
+         And(inst(s2, 2 * f), dom, n_big, Gt(ho_card, IntLit(0))),
+         Exists([i2], And(In(i2, ho_of(j)),
+                          Leq(sndv_of(i2), ord_2f))), c11),
+    ]
+
+
 def _mentions_fct(f: Formula, fct) -> bool:
     if isinstance(f, Application):
         return f.fct == fct or any(_mentions_fct(a, fct) for a in f.args)

@@ -54,6 +54,18 @@ class SingleVC(VC):
         cfg = self.config or config
         if self.timeout_s is not None:
             timeout_s = self.timeout_s
+        # per-VC budgets are tuned to an idle box; under CPU contention a
+        # blown wall clock flips ✓ to ✗ and short-circuits the composite
+        # (VERDICT r03 weak #4: a concurrent test suite turned a 9-minute
+        # VERIFIED into NOT PROVED).  Loaded environments scale ALL
+        # budgets with one knob instead of editing per-entry configs.
+        import os
+
+        try:
+            timeout_s *= float(os.environ.get("ROUND_TPU_VC_TIMEOUT_SCALE",
+                                              "1"))
+        except ValueError:
+            pass
         t0 = time.monotonic()
         try:
             # the full entailment discipline (cl.entailment): hypothesis

@@ -61,3 +61,64 @@ def test_lve_extracted_maxts_chain(k):
     stages, _meta = lve_extracted_stage_vcs()
     name, hyp, concl, cfg = stages[k]
     assert entailment(hyp, concl, cfg, timeout_s=240), name
+
+
+# ---------------------------------------------------------------------------
+# ε-agreement: the sort/order-statistics extraction frontier
+# ---------------------------------------------------------------------------
+
+def test_epsilon_tr_extracts_through_sort_primitive():
+    """ε-agreement's round extracts from the EXECUTABLE EpsilonRound:
+    jnp.sort lowers through the declared order-statistics primitive
+    (extract.py _sort_site) — the boundary that previously required
+    @aux_method contracts.  The round-0 branch of x′ is the drop-2f pick
+    ord(2f); the five site axioms (sortedness, attainment, two rank
+    bounds, INF-dominance of the mask sentinel) come out with it."""
+    from round_tpu.verify.protocols import epsilon_extracted_tr
+
+    sig, j, r, x_eq, axioms, P = epsilon_extracted_tr()
+    rep = repr(x_eq)
+    assert "x!prime" in rep
+    assert "ext!sort!" in rep
+    assert len(axioms) == 5
+    assert "float!inf" in repr(axioms[-1])
+    # the pick is rank 2f of the sort site
+    assert repr(P["ord_2f"]).endswith(f"{2 * P['f']})")
+
+
+@pytest.mark.parametrize("k", range(3))
+def test_epsilon_extracted_selection_lemmas(k):
+    """The round-0 selection lemmas (the ε validity core: the drop-2f pick
+    lies weakly inside the heard range) prove from the extracted
+    order-statistics axioms, sub-second each.  The reference cannot verify
+    ε-agreement at all (floats are outside its fragment too)."""
+    from round_tpu.verify.protocols import epsilon_extracted_stage_vcs
+
+    vcs = epsilon_extracted_stage_vcs()
+    name, hyp, concl, cfg = vcs[k]
+    assert entailment(hyp, concl, cfg, timeout_s=240), name
+
+
+def test_epsilon_extracted_negative_control():
+    """Non-vacuity: the FALSE universal claim — EVERY heard value ≥ the
+    round-0 pick — must not follow from the same hypotheses the trim
+    lemma uses (values below the pick exist whenever the mailbox is not
+    degenerate)."""
+    from round_tpu.verify.formula import (
+        Application, ForAll, Geq, Implies, In, IntT, Variable, procType,
+    )
+    from round_tpu.verify.protocols import (
+        epsilon_extracted_stage_vcs, epsilon_extracted_tr, ho_of,
+    )
+
+    vcs = epsilon_extracted_stage_vcs()
+    _name, hyp, _concl, cfg = vcs[1]
+    # the extraction is deterministic, so a second call reproduces the
+    # same site symbols structurally
+    _sig, j, _r, _xeq, _ax, P = epsilon_extracted_tr()
+    i = Variable("nc", procType)
+    wrong = ForAll([i], Implies(
+        In(i, ho_of(j)),
+        Geq(Application(P["sndv"], [i]).with_type(IntT()), P["ord_2f"]),
+    ))
+    assert not entailment(hyp, wrong, cfg, timeout_s=120)

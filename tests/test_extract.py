@@ -207,19 +207,37 @@ def test_extract_select_n():
 
 
 def test_extract_unsupported_primitive_message():
-    """Data-dependent gathers (the heart of min-most-often-received) are
-    outside the fragment — the error must say so and point at the
-    auxiliary-function mechanism (the reference's AuxiliaryMethod)."""
+    """Primitives outside the fragment must raise an error that points at
+    the auxiliary-function mechanism (the reference's AuxiliaryMethod).
+    (jnp.sort — the old canonical example — now EXTRACTS through the
+    declared order-statistics primitive; transcendentals remain outside.)"""
     def upd(vals):
-        return jnp.sort(vals)[0]
+        return jnp.sin(vals)[0] > 0
 
     with pytest.raises(ExtractionError) as e:
         extract_lane_fn(
-            upd, [jnp.zeros((N_EX,), jnp.int32)],
+            upd, [jnp.zeros((N_EX,), jnp.float32)],
             [Vec(lambda i: Variable("v", Int))],
             lambda i: Literal(True),
         )
     assert "aux" in str(e.value) or "primitive" in str(e.value)
+
+
+def test_extract_sort_now_supported():
+    """The flip side of the unsupported-primitive test: a plain sort of
+    mailbox values extracts to the rank function with its order-statistics
+    axioms (no @aux_method contract needed)."""
+    def upd(vals):
+        return jnp.sort(vals)[0]
+
+    outs, axioms = extract_lane_fn(
+        upd, [jnp.zeros((N_EX,), jnp.int32)],
+        [Vec(lambda i: Variable("v", Int))],
+        lambda i: Literal(True),
+        return_axioms=True,
+    )
+    assert "ext!sort!" in repr(outs[0].f)
+    assert len(axioms) == 4  # S1, S2, S3a, S3b (no pad => no dominance)
 
 
 def test_extract_true_sum_rejected():

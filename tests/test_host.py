@@ -211,6 +211,19 @@ def test_wire_unpickler_refuses_gadgets():
     evil = _pickle.dumps(Evil())
     with pytest.raises(_pickle.UnpicklingError, match="forbidden"):
         wire_loads(evil)
+
+    # gadgets INSIDE the numpy namespace must be refused too — the
+    # allowlist is exact (module, name) pairs, not a numpy prefix
+    # (numpy.testing._private.utils.runstring is literally exec)
+    import numpy.testing._private.utils as _nptu
+
+    if hasattr(_nptu, "runstring"):
+        class EvilNp:
+            def __reduce__(self):
+                return (_nptu.runstring, ("x = 1", {}))
+
+        with pytest.raises(_pickle.UnpicklingError, match="forbidden"):
+            wire_loads(_pickle.dumps(EvilNp()))
     # the legitimate payload vocabulary round-trips
     for obj in (np.int32(7), np.arange(5), {"a": (1, "x")}, [True, 2.5],
                 np.float32(1.5), None):
