@@ -112,6 +112,9 @@ def build_parser():
     ap.add_argument("--dot", choices=["bf16", "i8"], default="bf16",
                     help="loop-kernel count-matmul dtype (i8 = int8 MXU, "
                          "an A/B candidate on v5e-class chips)")
+    ap.add_argument("--no-ab", action="store_true",
+                    help="skip the automatic MXU-dtype (bf16 vs i8) A/B "
+                         "line on real accelerators")
     ap.add_argument("--parity", type=int, default=8, metavar="K",
                     help="also run K scenarios through both engines and "
                          "report agreement (0 = off; replay cost is trivial "
@@ -467,6 +470,41 @@ def worker_main(args):
 
     total_rounds = args.phases  # rounds per phase == 1 for OTR
     rounds_per_sec = total_rounds / best
+
+    # MXU-dtype A/B (PERF_MODEL.md predicts int8 is the config that clears
+    # the ≥100 r/s bar): on a real accelerator the unattended end-of-round
+    # run records the OTHER dot dtype too, as its own line BEFORE the
+    # flagship — the next hardware contact may well BE that unattended run,
+    # and the A/B must not depend on someone re-invoking by hand
+    if (jax.default_backend() != "cpu" and args.engine == "loop"
+            and engine_fallback is None and not args.no_ab):
+        other = "i8" if args.dot == "bf16" else "bf16"
+        saved = args.dot
+        try:
+            args.dot = other
+            bench2 = make_fused_bench(S, engine="loop")
+            jax.device_get(bench2(key))  # compile + warmup
+            best2 = None
+            for i in range(max(1, min(args.repeats, 2))):
+                t0 = time.perf_counter()
+                jax.device_get(bench2(jax.random.PRNGKey(i)))
+                dt = time.perf_counter() - t0
+                best2 = dt if best2 is None else min(best2, dt)
+            print(json.dumps({
+                "metric": f"{flagship_metric_name(args)}_dot_{other}",
+                "value": round(total_rounds / best2, 3),
+                "unit": "rounds/sec",
+                "vs_baseline": round(
+                    total_rounds / best2 / BASELINE_ROUNDS_PER_SEC, 3),
+                "extra": {"dot": other, "ab_of": saved, "n": args.n,
+                          "scenarios": S, "engine": "loop"},
+            }), flush=True)
+        except Exception as e:  # noqa: BLE001 — the A/B must never cost
+            # the flagship line
+            print(f"warning: dot A/B ({other}) failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+        finally:
+            args.dot = saved
 
     # health stats (not part of the metric line); OTR is 1 round/phase so
     # the flagship histogram is already in round units
