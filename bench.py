@@ -74,6 +74,7 @@ import sys
 import time
 
 BASELINE_ROUNDS_PER_SEC = 100.0
+_WORKER_T0 = time.monotonic()  # re-stamped at worker_main entry
 
 # Backend probe source, run via `python -c` in a killable subprocess.  It
 # must exercise an actual device computation (not just jax.devices()): the
@@ -271,6 +272,9 @@ def driver_main(args, argv):
 # --------------------------------------------------------------------------
 
 def worker_main(args):
+    global _WORKER_T0
+    _WORKER_T0 = time.monotonic()
+
     import jax
 
     if args.platform:
@@ -304,20 +308,22 @@ def worker_main(args):
             after=jnp.full((S, n), 2, dtype=jnp.int32),
         )
 
-    def run_fast_engine(engine, rnd, state0, mix, rounds, mode, interpret):
+    def run_fast_engine(engine, rnd, state0, mix, rounds, mode, interpret,
+                        dot=None):
         """Dispatch to the engine being benched — ONE site, shared by the
         timed bench and parity_check so they cannot drift apart."""
+        dot = args.dot if dot is None else dot
         if engine == "loop":
             return fast.run_otr_loop(
                 rnd, state0, mix, max_rounds=rounds, mode=mode, sb=args.sb,
-                interpret=interpret, dot=args.dot,
+                interpret=interpret, dot=dot,
             )
         return fast.run_hist(
             rnd, state0, lambda s: s.decided, mix,
-            max_rounds=rounds, mode=mode, interpret=interpret, dot=args.dot,
+            max_rounds=rounds, mode=mode, interpret=interpret, dot=dot,
         )
 
-    def make_fused_bench(S, engine="fused"):
+    def make_fused_bench(S, engine="fused", dot=None):
         n, V, rounds = args.n, args.values, args.phases
         rnd = fast.OtrHist(n_values=V, after_decision=2)
         interpret = jax.default_backend() == "cpu"
@@ -332,11 +338,23 @@ def worker_main(args):
             init = jax.random.randint(k_init, (n,), 0, V, dtype=jnp.int32)
             state0 = fresh_otr_state(init, S, n)
             state, done, decided_round = run_fast_engine(
-                engine, rnd, state0, mix, rounds, mode, interpret
+                engine, rnd, state0, mix, rounds, mode, interpret, dot=dot
             )
             return decided_summary(state.decided, decided_round, rounds, state.decision)
 
         return bench
+
+    def time_best(bench, repeats):
+        """min-over-repeats wall time; the caller warmed the bench up.
+        ONE definition so the flagship and its A/B cannot drift
+        methodologically."""
+        best = last = None
+        for i in range(repeats):
+            t0 = time.perf_counter()
+            last = jax.device_get(bench(jax.random.PRNGKey(i)))
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best, last
 
     def make_reference_bench(S):
         n, chunk, phases, V = args.n, args.chunk, args.phases, args.values
@@ -442,6 +460,7 @@ def worker_main(args):
 
     key = jax.random.PRNGKey(0)
     engine_fallback = None
+    t_compile0 = time.perf_counter()
     try:
         cnt, hist, _ck = jax.device_get(bench(key))  # compile + warmup
     except Exception as e:  # noqa: BLE001
@@ -460,13 +479,9 @@ def worker_main(args):
         engine_fallback = f"loop failed: {type(e).__name__}"
         bench = make_fused_bench(S, engine="fused")
         cnt, hist, _ck = jax.device_get(bench(key))
+    t_compile = time.perf_counter() - t_compile0
 
-    best = None
-    for i in range(args.repeats):
-        t0 = time.perf_counter()
-        cnt, hist, _ck = jax.device_get(bench(jax.random.PRNGKey(i)))
-        dt = time.perf_counter() - t0
-        best = dt if best is None else min(best, dt)
+    best, (cnt, hist, _ck) = time_best(bench, args.repeats)
 
     total_rounds = args.phases  # rounds per phase == 1 for OTR
     rounds_per_sec = total_rounds / best
@@ -475,36 +490,37 @@ def worker_main(args):
     # the ≥100 r/s bar): on a real accelerator the unattended end-of-round
     # run records the OTHER dot dtype too, as its own line BEFORE the
     # flagship — the next hardware contact may well BE that unattended run,
-    # and the A/B must not depend on someone re-invoking by hand
+    # and the A/B must not depend on someone re-invoking by hand.
+    # BUDGETED: the A/B is attempted only when the watchdog has comfortable
+    # room for another compile+run of the same shape, so a slow i8 compile
+    # can degrade to a skipped A/B but never to a watchdog kill that loses
+    # the already-measured flagship (the ladder's budget discipline).
+    ab_cost = 2 * (t_compile + 2 * best) + 120.0
+    ab_left = args.watchdog - (time.monotonic() - _WORKER_T0)
     if (jax.default_backend() != "cpu" and args.engine == "loop"
             and engine_fallback is None and not args.no_ab):
         other = "i8" if args.dot == "bf16" else "bf16"
-        saved = args.dot
-        try:
-            args.dot = other
-            bench2 = make_fused_bench(S, engine="loop")
-            jax.device_get(bench2(key))  # compile + warmup
-            best2 = None
-            for i in range(max(1, min(args.repeats, 2))):
-                t0 = time.perf_counter()
-                jax.device_get(bench2(jax.random.PRNGKey(i)))
-                dt = time.perf_counter() - t0
-                best2 = dt if best2 is None else min(best2, dt)
-            print(json.dumps({
-                "metric": f"{flagship_metric_name(args)}_dot_{other}",
-                "value": round(total_rounds / best2, 3),
-                "unit": "rounds/sec",
-                "vs_baseline": round(
-                    total_rounds / best2 / BASELINE_ROUNDS_PER_SEC, 3),
-                "extra": {"dot": other, "ab_of": saved, "n": args.n,
-                          "scenarios": S, "engine": "loop"},
-            }), flush=True)
-        except Exception as e:  # noqa: BLE001 — the A/B must never cost
-            # the flagship line
-            print(f"warning: dot A/B ({other}) failed: "
-                  f"{type(e).__name__}: {e}", file=sys.stderr)
-        finally:
-            args.dot = saved
+        if ab_left < ab_cost:
+            print(f"warning: skipping dot A/B ({other}): {ab_left:.0f}s of "
+                  f"watchdog left < {ab_cost:.0f}s budget", file=sys.stderr)
+        else:
+            try:
+                bench2 = make_fused_bench(S, engine="loop", dot=other)
+                jax.device_get(bench2(key))  # compile + warmup
+                best2, _ = time_best(bench2, max(1, min(args.repeats, 2)))
+                print(json.dumps({
+                    "metric": f"{flagship_metric_name(args)}_dot_{other}",
+                    "value": round(total_rounds / best2, 3),
+                    "unit": "rounds/sec",
+                    "vs_baseline": round(
+                        total_rounds / best2 / BASELINE_ROUNDS_PER_SEC, 3),
+                    "extra": {"dot": other, "ab_of": args.dot, "n": args.n,
+                              "scenarios": S, "engine": "loop"},
+                }), flush=True)
+            except Exception as e:  # noqa: BLE001 — the A/B must never
+                # cost the flagship line
+                print(f"warning: dot A/B ({other}) failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
 
     # health stats (not part of the metric line); OTR is 1 round/phase so
     # the flagship histogram is already in round units
