@@ -309,21 +309,21 @@ def worker_main(args):
         )
 
     def run_fast_engine(engine, rnd, state0, mix, rounds, mode, interpret,
-                        dot=None):
+                        dot=None, variant="v2"):
         """Dispatch to the engine being benched — ONE site, shared by the
         timed bench and parity_check so they cannot drift apart."""
         dot = args.dot if dot is None else dot
         if engine == "loop":
             return fast.run_otr_loop(
                 rnd, state0, mix, max_rounds=rounds, mode=mode, sb=args.sb,
-                interpret=interpret, dot=dot,
+                interpret=interpret, dot=dot, variant=variant,
             )
         return fast.run_hist(
             rnd, state0, lambda s: s.decided, mix,
             max_rounds=rounds, mode=mode, interpret=interpret, dot=dot,
         )
 
-    def make_fused_bench(S, engine="fused", dot=None):
+    def make_fused_bench(S, engine="fused", dot=None, variant="v2"):
         n, V, rounds = args.n, args.values, args.phases
         rnd = fast.OtrHist(n_values=V, after_decision=2)
         interpret = jax.default_backend() == "cpu"
@@ -338,7 +338,8 @@ def worker_main(args):
             init = jax.random.randint(k_init, (n,), 0, V, dtype=jnp.int32)
             state0 = fresh_otr_state(init, S, n)
             state, done, decided_round = run_fast_engine(
-                engine, rnd, state0, mix, rounds, mode, interpret, dot=dot
+                engine, rnd, state0, mix, rounds, mode, interpret, dot=dot,
+                variant=variant,
             )
             return decided_summary(state.decided, decided_round, rounds, state.decision)
 
@@ -380,10 +381,10 @@ def worker_main(args):
 
         return bench
 
-    def parity_check(k_scenarios: int) -> float:
-        """Fraction of lanes where the BENCHED fast engine (hash mode) and
-        the general engine agree on (decided, decision) over the first k
-        scenarios of the mix."""
+    def parity_check(k_scenarios: int, variant: str = "v2") -> float:
+        """Fraction of lanes where the BENCHED fast engine (hash mode, the
+        BENCHED kernel variant) and the general engine agree on
+        (decided, decision) over the first k scenarios of the mix."""
         n, V, rounds = args.n, args.values, min(args.phases, 10)
         key = jax.random.PRNGKey(0)
         mix = make_mix(key, k_scenarios)
@@ -395,7 +396,7 @@ def worker_main(args):
         interpret = jax.default_backend() == "cpu"
         state, _done, _dr = run_fast_engine(
             args.engine if args.engine != "reference" else "fused",
-            rnd, state0, mix, rounds, "hash", interpret,
+            rnd, state0, mix, rounds, "hash", interpret, variant=variant,
         )
         algo = OTR(after_decision=2, n_values=V)
         agree = 0
@@ -460,6 +461,7 @@ def worker_main(args):
 
     key = jax.random.PRNGKey(0)
     engine_fallback = None
+    bench_variant = "v2"
     t_compile0 = time.perf_counter()
     try:
         cnt, hist, _ck = jax.device_get(bench(key))  # compile + warmup
@@ -470,15 +472,30 @@ def worker_main(args):
         # this unattended)
         if args.engine != "loop":
             raise
-        print(
-            f"warning: loop engine failed ({type(e).__name__}: {e}); "
-            "falling back to --engine fused",
-            file=sys.stderr,
-        )
-        args.engine = "fused"
-        engine_fallback = f"loop failed: {type(e).__name__}"
-        bench = make_fused_bench(S, engine="fused")
-        cnt, hist, _ck = jax.device_get(bench(key))
+        # degradation ladder: the FLAT loop variant first (the proven r3
+        # body — a loop-kernel number still beats a per-round number),
+        # then the per-round fused engine
+        try:
+            print(
+                f"warning: loop v2 failed ({type(e).__name__}: {e}); "
+                "retrying the flat loop variant",
+                file=sys.stderr,
+            )
+            engine_fallback = f"loop v2 failed: {type(e).__name__}"
+            bench_variant = "flat"
+            bench = make_fused_bench(S, engine="loop", variant="flat")
+            cnt, hist, _ck = jax.device_get(bench(key))
+        except Exception as e2:  # noqa: BLE001
+            print(
+                f"warning: flat loop variant failed too "
+                f"({type(e2).__name__}: {e2}); falling back to "
+                "--engine fused",
+                file=sys.stderr,
+            )
+            args.engine = "fused"
+            engine_fallback += f"; flat failed: {type(e2).__name__}"
+            bench = make_fused_bench(S, engine="fused")
+            cnt, hist, _ck = jax.device_get(bench(key))
     t_compile = time.perf_counter() - t_compile0
 
     best, (cnt, hist, _ck) = time_best(bench, args.repeats)
@@ -530,6 +547,7 @@ def worker_main(args):
         "n": args.n,
         "scenarios": S,
         "engine": args.engine,
+        "variant": bench_variant,
         "dot": args.dot,
         "backend": jax.default_backend(),
         "workload": args.workload,
@@ -540,7 +558,14 @@ def worker_main(args):
         # from the fallback engine, not the one requested
         extra["engine_fallback"] = engine_fallback
     if args.parity > 0:
-        extra["parity_frac"] = round(parity_check(args.parity), 4)
+        # the parity replay must time the BENCHED variant and must never
+        # cost the flagship line (it runs after the timing, before the
+        # print) — a replay failure is recorded, not raised
+        try:
+            extra["parity_frac"] = round(
+                parity_check(args.parity, variant=bench_variant), 4)
+        except Exception as e:  # noqa: BLE001
+            extra["parity_error"] = f"{type(e).__name__}: {e}"[:200]
 
     result = {
         "metric": flagship_metric_name(args),

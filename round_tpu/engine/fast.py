@@ -377,6 +377,7 @@ def run_otr_loop(
     sb: int = 8,
     interpret: bool = False,
     dot: str = "bf16",
+    variant: str = "v2",
 ):
     """The flagship fast path: the whole OTR run as ONE Pallas kernel
     (ops.fused.otr_loop) — state stays in VMEM across rounds, so per-round
@@ -407,7 +408,7 @@ def run_otr_loop(
         mix.rotate_down, mix.p8, mix.salt0, mix.salt1,
         num_values=rnd.num_values, rounds=max_rounds,
         after_decision=rnd.after_decision, mode=mode, sb=sb,
-        interpret=interpret, dot=dot,
+        interpret=interpret, dot=dot, variant=variant,
     )
     state = OtrState(x=x, decided=dec, decision=decision, after=after)
     return state, done, dround

@@ -514,6 +514,7 @@ def _loop_kernel(
     rounds: int,
     mode: str,
     dot: str = "bf16",
+    variant: str = "v2",
 ):
     """The whole-run kernel template: `rounds` rounds of any LoopAlgo for
     `sb` scenarios per grid step, state resident in VMEM.
@@ -548,7 +549,14 @@ def _loop_kernel(
     Both paths produce bit-identical counts to the v1 kernel (the mask
     bits per (scenario, round) are unchanged in both hash and hw modes —
     only where/whether they are computed moved), so the differential
-    parity pins carry over unchanged."""
+    parity pins carry over unchanged.
+
+    variant="flat" compiles the round-3 body instead: one straight-line
+    round loop, no scenario/round conds, no pipelined mask carry — the
+    Mosaic-conservative INSURANCE variant the bench degrades to if the
+    v2 lowering fails on real hardware (slower by PERF_MODEL.md's v1
+    row, but a loop-kernel number beats a per-round-engine number).
+    Identical bits by construction."""
     x0_ref, crashed_ref, side_ref = refs[0:3]
     (crash_round_ref, heal_round_ref, rotate_ref, p8_ref,
      salt0_ref, salt1_ref) = refs[3:9]
@@ -693,7 +701,33 @@ def _loop_kernel(
 
             return jax.lax.fori_loop(0, rounds, round_body, init)
 
-        final = jax.lax.cond(p8 > 0, run_random, run_structured, 0)
+        def run_flat():
+            # the round-3 body: mask computed in-round, side-eq always,
+            # zero extra control flow (same bits as the split paths)
+            def round_body(r, carry):
+                us, done, dround = carry[:-2], carry[-2], carry[-1]
+                colmask = round_masks(r)
+                side_r = jnp.where(r < hr, side, 0)
+                salt1r = r * jnp.int32(_RMIX) + s1
+                active = ~done
+                senders = colmask & active & (p8 < 256)
+                keep = _keep_mask(n, mode, s0, salt1r, p8, notdiag)
+                keep = keep & (side_r[:, None] == side_r[None, :])
+                us2, exit_ = subrounds(
+                    r, us, active,
+                    lambda oh: _count_dot(oh & senders[None, :], keep, dot),
+                )
+                us, done, dround = finish_round(
+                    r, us, us2, exit_, active, done, dround
+                )
+                return (*us, done, dround)
+
+            return jax.lax.fori_loop(0, rounds, round_body, init)
+
+        if variant == "flat":
+            final = run_flat()
+        else:
+            final = jax.lax.cond(p8 > 0, run_random, run_structured, 0)
         for i, a in enumerate(final):
             outs[i][s] = a.astype(jnp.int32)
         return 0
@@ -703,7 +737,8 @@ def _loop_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("algo", "rounds", "mode", "sb", "interpret", "dot"),
+    static_argnames=("algo", "rounds", "mode", "sb", "interpret", "dot",
+                     "variant"),
 )
 def hist_loop(
     algo: LoopAlgo,
@@ -721,6 +756,7 @@ def hist_loop(
     sb: int = 8,
     interpret: bool = False,
     dot: str = "bf16",
+    variant: str = "v2",
 ):
     """Run a whole LoopAlgo workload in one Pallas kernel.
 
@@ -729,6 +765,10 @@ def hist_loop(
     decided_round [S, n] int32.  Mask/update semantics are bit-identical to
     run_hist on the algo's HistRound counterpart with the same FaultMix in
     the same mode — pinned by tests/test_fast.py."""
+    if variant not in ("v2", "flat"):
+        # a typo'd variant would silently bench v2 while every marker
+        # claims otherwise — refuse instead
+        raise ValueError(f"unknown loop-kernel variant {variant!r}")
     S, n = x0.shape
     orig_S = S
     (x0, crashed, side, crash_round, heal_round, rotate_down, p8, salt0,
@@ -746,7 +786,7 @@ def hist_loop(
     smem = pl.BlockSpec((S,), lambda b: (0,), memory_space=pltpu.SMEM)
     kernel = functools.partial(
         _loop_kernel, algo=algo, v_pad=v_pad, sb=sb, rounds=rounds, mode=mode,
-        dot=dot,
+        dot=dot, variant=variant,
     )
     n_out = n_state + 2
     outs = pl.pallas_call(
@@ -773,7 +813,7 @@ def hist_loop(
 @functools.partial(
     jax.jit,
     static_argnames=("num_values", "rounds", "after_decision", "mode", "sb",
-                     "interpret", "dot"),
+                     "interpret", "dot", "variant"),
 )
 def otr_loop(
     x0: jnp.ndarray,        # [S, n] int32 initial estimates
@@ -792,6 +832,7 @@ def otr_loop(
     sb: int = 8,
     interpret: bool = False,
     dot: str = "bf16",
+    variant: str = "v2",
 ):
     """Run the whole OTR flagship workload in one Pallas kernel (the OtrLoop
     instance of `hist_loop`; the historical entry point — bench.py's
@@ -805,7 +846,7 @@ def otr_loop(
     (x, dec, decision, after), done, dround = hist_loop(
         algo, x0, crashed, side, crash_round, heal_round, rotate_down, p8,
         salt0, salt1, rounds=rounds, mode=mode, sb=sb, interpret=interpret,
-        dot=dot,
+        dot=dot, variant=variant,
     )
     return (x, dec.astype(bool), decision, after, done, dround)
 

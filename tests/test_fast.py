@@ -401,6 +401,61 @@ def test_otr_loop_i8_dot_parity():
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+def test_otr_loop_flat_variant_parity():
+    """The "flat" loop-kernel variant (the Mosaic-conservative r3 body the
+    bench degrades to if the v2 lowering fails on hardware) is
+    lane-for-lane identical to the v2 family-split kernel on a mixed
+    batch."""
+    n, rounds = N, 6
+    key = jax.random.PRNGKey(41)
+    mix = fast.standard_mix(key, S, n, p_drop=0.15, f=3, crash_round=1)
+    init_vals = jax.random.randint(
+        jax.random.fold_in(key, 5), (n,), 0, V, dtype=jnp.int32
+    )
+    rnd = fast.OtrHist(n_values=V, after_decision=2)
+
+    def state0():
+        return OtrState(
+            x=jnp.broadcast_to(init_vals, (S, n)).astype(jnp.int32),
+            decided=jnp.zeros((S, n), dtype=bool),
+            decision=jnp.full((S, n), -1, dtype=jnp.int32),
+            after=jnp.full((S, n), 2, dtype=jnp.int32),
+        )
+
+    a = fast.run_otr_loop(rnd, state0(), mix, max_rounds=rounds,
+                          mode="hash", interpret=True, variant="v2")
+    b = fast.run_otr_loop(rnd, state0(), mix, max_rounds=rounds,
+                          mode="hash", interpret=True, variant="flat")
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    # drop+partition COMBINED (standard_mix never produces it): the one
+    # round shape where flat's unconditional keep∧side-eq line differs
+    # structurally from both v2 paths
+    S2 = 6
+    side = (jnp.arange(n) % 2).astype(jnp.int32)
+    mix2 = fast.fault_free(jax.random.fold_in(key, 9), S2, n).replace(
+        side=jnp.broadcast_to(side, (S2, n)),
+        heal_round=jnp.asarray([3, 3, 0, 3, 2, 6], jnp.int32),
+        p8=jnp.asarray([64, 0, 64, 13, 128, 0], jnp.int32),
+    )
+
+    def state0_2():
+        return OtrState(
+            x=jnp.broadcast_to(init_vals, (S2, n)).astype(jnp.int32),
+            decided=jnp.zeros((S2, n), dtype=bool),
+            decision=jnp.full((S2, n), -1, dtype=jnp.int32),
+            after=jnp.full((S2, n), 2, dtype=jnp.int32),
+        )
+
+    a = fast.run_otr_loop(rnd, state0_2(), mix2, max_rounds=rounds,
+                          mode="hash", interpret=True, variant="v2")
+    b = fast.run_otr_loop(rnd, state0_2(), mix2, max_rounds=rounds,
+                          mode="hash", interpret=True, variant="flat")
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
 def test_otr_loop_drop_plus_partition_parity():
     """The v2 loop kernel's random-mask path with a LIVE partition (p8 > 0
     AND nonuniform side until heal) — a combination standard_mix never
