@@ -494,6 +494,8 @@ def worker_main(args):
             )
             args.engine = "fused"
             engine_fallback += f"; flat failed: {type(e2).__name__}"
+            bench_variant = "n/a"  # the recorded number is the fused
+            # engine's — a stale "flat" would misattribute it
             bench = make_fused_bench(S, engine="fused")
             cnt, hist, _ck = jax.device_get(bench(key))
     t_compile = time.perf_counter() - t_compile0

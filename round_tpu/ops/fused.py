@@ -153,6 +153,12 @@ def _kernel(
     def per_scenario(s, _):
         g = b * sb + s
         p8 = p8_ref[g]
+        # DELIBERATELY no family-split conds here: this kernel is the
+        # degradation ladder's LAST accelerator rung (bench --engine fused,
+        # run_hist) — it must stay the most Mosaic-conservative lowering
+        # available, exactly like the loop kernel's variant="flat".  The
+        # v2 split lives in _loop_kernel, where v2-vs-flat gives a safe
+        # retreat; a cond regression here would leave no escape hatch.
         keep = _keep_mask(n, mode, salt0_ref[g], salt1_ref[g], p8, notdiag)
         if sided:
             side = side_ref[s]
