@@ -33,6 +33,7 @@ def spec_by_name(name: str):
         "tpc": protocols.tpc_spec,
         "otr": protocols.otr_spec,
         "lv": protocols.lv_verifier_spec,
+        "erb": protocols.erb_spec,
     }
     if name not in registry:
         raise SystemExit(
@@ -43,7 +44,7 @@ def spec_by_name(name: str):
 
 def main(argv=None) -> bool:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("protocol", help="tpc | otr | lv")
+    ap.add_argument("protocol", help="tpc | otr | lv | erb")
     ap.add_argument("-r", "--report", default=None,
                     help="write an HTML report to this path")
     ap.add_argument("-v", "--verbose", action="store_true")

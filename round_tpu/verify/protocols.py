@@ -1565,6 +1565,122 @@ def lv_extracted_stage_vcs():
     return stages, meta
 
 
+def erb_spec() -> ProtocolSpec:
+    """Eager reliable broadcast (EagerReliableBroadcast.scala:13-47,
+    models/erb.py): the originator's value floods; everyone who knows it
+    rebroadcasts once, delivers, exits.
+
+    Safety core: every defined estimate and every delivery carries THE
+    originator's value v0 (a ghost constant, SpecHelper-style) —
+    uniform agreement and validity follow directly.  Inductiveness is the
+    flooding argument: an adopted value is some heard sender's estimate,
+    senders only send when defined, and defined estimates are v0.
+    The mailbox pick (`Mailbox.any_value`) is axiomatized as SOME heard
+    payload — the weakest possible site axiom, and enough."""
+    sig = StateSig({
+        "x_val": Int,
+        "x_def": Bool,
+        "delivered": Bool,
+        "delivery": Int,
+    })
+    i = Variable("i", procType)
+    j = Variable("j", procType)
+    v0 = Application(
+        UnInterpretedFct("erb!v0", FunT([], Int)), []
+    ).with_type(Int)
+    adopt = UnInterpretedFct("erb!adopt", FunT([procType], Int))
+
+    def adopt_of(jj):
+        return Application(adopt, [jj]).with_type(Int)
+
+    def update(mb: Mailbox, jj, s: StateSig):
+        got = Gt(mb.size(), IntLit(0))
+        return And(
+            Eq(s.get_primed("x_def", jj), Or(s.get("x_def", jj), got)),
+            Implies(
+                And(Not(s.get("x_def", jj)), got),
+                Eq(s.get_primed("x_val", jj), adopt_of(jj)),
+            ),
+            Implies(
+                Or(s.get("x_def", jj), Not(got)),
+                Eq(s.get_primed("x_val", jj), s.get("x_val", jj)),
+            ),
+            Eq(s.get_primed("delivered", jj),
+               Or(s.get("delivered", jj), s.get("x_def", jj))),
+            Implies(
+                And(s.get("x_def", jj), Not(s.get("delivered", jj))),
+                Eq(s.get_primed("delivery", jj), s.get("x_val", jj)),
+            ),
+            Implies(
+                Or(Not(s.get("x_def", jj)), s.get("delivered", jj)),
+                Eq(s.get_primed("delivery", jj), s.get("delivery", jj)),
+            ),
+        )
+
+    def adopt_axiom():
+        # any_value: SOME heard payload (ops/mailbox.py any_value) — the
+        # jj-mailbox senders are exactly the defined processes it heard
+        kk = Variable("ek", procType)
+        mb_sender = And(In(kk, ho_of(j)), sig.get("x_def", kk))
+        return [ForAll(
+            [j],
+            Implies(
+                Exists([kk], mb_sender),
+                Exists([kk], And(mb_sender,
+                                 Eq(adopt_of(j), sig.get("x_val", kk)))),
+            ),
+        )]
+
+    rnd = RoundTR(
+        sig=sig,
+        payload_defs={"v": (Int, lambda ii: sig.get("x_val", ii))},
+        dest_fn=lambda ii, jj: sig.get("x_def", ii),  # send guard: only
+        # processes that KNOW the value broadcast (ErbRound.send's guard)
+        update_fn=update,
+        aux=adopt_axiom,
+    )
+
+    inv = ForAll(
+        [i],
+        And(
+            Implies(sig.get("x_def", i), Eq(sig.get("x_val", i), v0)),
+            Implies(sig.get("delivered", i),
+                    Eq(sig.get("delivery", i), v0)),
+        ),
+    )
+    agreement = ForAll(
+        [i, j],
+        Implies(
+            And(sig.get("delivered", i), sig.get("delivered", j)),
+            Eq(sig.get("delivery", i), sig.get("delivery", j)),
+        ),
+    )
+    validity = ForAll(
+        [i],
+        Implies(sig.get("delivered", i), Eq(sig.get("delivery", i), v0)),
+    )
+
+    init = ForAll(
+        [i],
+        And(
+            Not(sig.get("delivered", i)),
+            Implies(sig.get("x_def", i), Eq(sig.get("x_val", i), v0)),
+        ),
+    )
+
+    return ProtocolSpec(
+        sig=sig,
+        rounds=[rnd],
+        init=init,
+        invariants=[inv],
+        properties=[
+            ("uniform agreement", agreement),
+            ("validity (deliveries carry the originator's value)", validity),
+        ],
+        config=ClConfig(venn_bound=1, inst_depth=2),
+    )
+
+
 def epsilon_extracted_tr():
     """ε-agreement's round (the sort/drop-2f/select order-statistics step,
     Epsilon.scala:34-62) extracted from the EXECUTABLE round class

@@ -226,6 +226,44 @@ def test_otr_staged_chain_broken_stage_rejected():
 
 
 # ---------------------------------------------------------------------------
+# Eager reliable broadcast
+# ---------------------------------------------------------------------------
+
+def test_erb_verifies():
+    """Uniform reliable broadcast verifies end-to-end: the flooding
+    invariant (defined estimates and deliveries carry the originator's
+    value) is inductive, agreement + validity follow.  The reference has
+    no logic suite for ERB at all."""
+    from round_tpu.verify.protocols import erb_spec
+
+    ver = Verifier(erb_spec())
+    assert ver.check(), "\n" + ver.report()
+    assert "✗" not in ver.report()
+
+
+def test_erb_unguarded_send_rejected():
+    """Negative control: WITHOUT the send guard (only defined processes
+    broadcast, ErbRound.send), an undefined sender's garbage estimate
+    could be adopted — the invariant must NOT be inductive."""
+    import dataclasses as _dc
+
+    from round_tpu.verify.protocols import erb_spec
+    from round_tpu.verify.tr import RoundTR
+
+    spec = erb_spec()
+    rnd = spec.rounds[0]
+    unguarded = RoundTR(
+        sig=rnd.sig,
+        payload_defs=rnd.payload_defs,
+        dest_fn=lambda ii, jj: Literal(True),  # everyone "sends"
+        update_fn=rnd.update_fn,
+        aux=rnd.aux,
+    )
+    ver = Verifier(_dc.replace(spec, rounds=[unguarded]))
+    assert not ver.check()
+
+
+# ---------------------------------------------------------------------------
 # Assumption-scoped StagedChain machinery (the ∨-elim / conditional-witness
 # extension the LV chains compose through — verifier.py StagedChain.assumes)
 # ---------------------------------------------------------------------------
