@@ -141,6 +141,7 @@ def sharded_hist_loop(
     sb: int = 8,
     interpret: bool = False,
     dot: str = "bf16",
+    variant: str = "v2",
 ):
     """The flagship engine on the mesh: the whole-run loop kernel
     (ops.fused.hist_loop) sharded over SCENARIO_AXIS — pure data
@@ -172,6 +173,7 @@ def sharded_hist_loop(
         return _fused.hist_loop(
             algo, x0, crashed, side, cr, hr, rot, p8, s0, s1,
             rounds=rounds, mode=mode, sb=sb, interpret=interpret, dot=dot,
+            variant=variant,
         )
 
     return jax.jit(run)(
@@ -320,4 +322,19 @@ def _dryrun_cpu(n_devices: int) -> None:
         f"dryrun_multichip loop-engine ok: engine=loop scenario-sharded over "
         f"{n_devices} devices, n={n2} scenarios={S2}, bit-parity vs "
         f"single-device exact, decided_lanes={int(dec.sum())}/{S2 * n2}"
+    )
+
+    # the FLAT insurance variant (bench degradation rung) must shard and
+    # agree bit-for-bit too — the artifact evidences the whole ladder
+    with jax.default_device(devs[0]):
+        flat = sharded_hist_loop(
+            algo_loop, x0, mix, rounds=rounds2, mesh=loop_mesh,
+            mode="hash", interpret=True, variant="flat",
+        )
+        jax.block_until_ready(flat)
+    for a, b in zip(jax.tree_util.tree_leaves(flat), got):
+        assert bool(jnp.array_equal(jnp.asarray(a), jnp.asarray(b))),             "flat loop-kernel variant diverged from v2 under sharding"
+    print(
+        "dryrun_multichip loop-engine flat-variant ok: bit-parity with v2 "
+        f"over {n_devices} devices"
     )
