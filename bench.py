@@ -133,7 +133,8 @@ def build_parser():
                     help="seconds before the backend-init probe is killed")
     ap.add_argument("--watchdog", type=float, default=3600.0,
                     help="seconds before the bench worker is killed (the "
-                         "ladder, when it runs, is budgeted at 60%% of this)")
+                         "ladder runs after the flagship on whatever "
+                         "watchdog time remains)")
     ap.add_argument("--no-subprocess", action="store_true",
                     help="run the bench in-process (dev/tests; no hang protection)")
     return ap
@@ -238,30 +239,42 @@ def driver_main(args, argv):
         return _emit_error(args, "backend-unavailable", extra)
 
     status, out, diag = _run_worker(argv, timeout=args.watchdog)
-    # echo whatever the worker managed to print (ladder lines survive a
-    # mid-run wedge this way), keeping the flagship/error line last
+    # echo whatever the worker managed to print, reordering so the
+    # flagship line is LAST in the artifact.  The worker measures the
+    # flagship FIRST and the ladder after (round-4 restructure): a rung
+    # that wedges the tunnel costs ladder rungs, never the flagship — on
+    # a watchdog kill the already-printed flagship line is salvaged here.
     lines = out.strip().splitlines() if out.strip() else []
+    flagship = flagship_metric_name(args)
+    flag_line = None
+    others = []
+    for ln in lines:
+        if not (ln.startswith("{") and ln.endswith("}")):
+            continue  # suppress a half-written last line
+        try:
+            parsed = json.loads(ln)
+        except ValueError:
+            continue
+        if parsed.get("metric") == flagship and flag_line is None:
+            flag_line = ln
+        else:
+            others.append(ln)
+    for ln in others:
+        print(ln, flush=True)
+    if flag_line is not None:
+        if status != "ok":
+            sys.stderr.write(
+                f"bench: worker {status} AFTER the flagship was measured "
+                f"(ladder truncated): {diag}\n")
+        print(flag_line, flush=True)
+        return 0
+    if args.ladder_only and status == "ok":
+        return 0  # rung-subset runs have no flagship line by design
     if status == "ok":
         # success requires THE FLAGSHIP metric line, not just any JSON —
         # a worker that printed ladder lines but died before the flagship
         # must still record an error artifact (ADVICE r03)
-        flagship = flagship_metric_name(args)
-        has_flagship = False
-        for ln in lines:
-            print(ln, flush=True)
-            if ln.startswith("{"):
-                try:
-                    if json.loads(ln).get("metric") == flagship:
-                        has_flagship = True
-                except ValueError:
-                    pass
-        if not has_flagship:
-            return _emit_error(args, "no-metric-line", {**info, **diag})
-        return 0
-    for ln in lines:
-        # suppress a half-written last line
-        if ln.startswith("{") and ln.endswith("}"):
-            print(ln, flush=True)
+        return _emit_error(args, "no-metric-line", {**info, **diag})
     err = "bench-timeout" if status == "timeout" else "bench-crash"
     sys.stderr.write(f"bench: worker {status}: {diag}\n")
     return _emit_error(args, err, {**info, **diag})
@@ -270,6 +283,43 @@ def driver_main(args, argv):
 # --------------------------------------------------------------------------
 # Worker (all jax / round_tpu imports live below this line)
 # --------------------------------------------------------------------------
+
+def _run_ladder_block(args):
+    """Run the ladder (full, or the --ladder-only subset) and print one
+    JSON line per rung; full runs also write BENCH_LADDER.json.  Runs
+    AFTER the flagship measurement (round-4 restructure): a rung that
+    wedges the device can cost ladder rungs, never the flagship line —
+    the driver salvages the already-printed flagship on a watchdog kill."""
+    from round_tpu.apps.ladder import RUNGS, run_ladder
+
+    only = None
+    if args.ladder_only:
+        only = [s.strip() for s in args.ladder_only.split(",") if s.strip()]
+        unknown = [s for s in only if s not in RUNGS]
+        if unknown:
+            raise SystemExit(
+                f"unknown ladder rung(s) {unknown}; valid: {sorted(RUNGS)}"
+            )
+    budget = None
+    if only is None:
+        # whatever watchdog time the flagship left, minus a margin for the
+        # artifact write.  May be <= 0: run_ladder then records every rung
+        # as "skipped" and the worker still exits cleanly with a complete
+        # BENCH_LADDER.json, instead of starting a rung the watchdog would
+        # kill mid-flight.
+        budget = max(0.0, args.watchdog - (time.monotonic() - _WORKER_T0)
+                     - 30.0)
+    ladder_results = run_ladder(only=only, budget_s=budget)
+    for r in ladder_results:
+        print(json.dumps(r), flush=True)
+    if only is None:  # subset runs must not clobber the full record
+        try:
+            with open("BENCH_LADDER.json", "w") as f:
+                json.dump(ladder_results, f, indent=1)
+        except OSError as e:
+            print(f"warning: could not write BENCH_LADDER.json: {e}",
+                  file=sys.stderr)
+
 
 def worker_main(args):
     global _WORKER_T0
@@ -415,39 +465,10 @@ def worker_main(args):
             total += n
         return agree / max(total, 1)
 
-    ladder_results = []
-    # the unattended end-of-round run must produce the ladder artifact too
-    # (BENCH_LADDER.json): on a real accelerator the ladder is on by
-    # default, each rung crash-isolated; the flagship line stays LAST
-    run_ladder_now = args.ladder or args.ladder_only or (
-        jax.default_backend() != "cpu" and not args.no_ladder
-    )
-    if run_ladder_now:
-        from round_tpu.apps.ladder import RUNGS, run_ladder
-
-        only = None
-        if args.ladder_only:
-            only = [s.strip() for s in args.ladder_only.split(",") if s.strip()]
-            unknown = [s for s in only if s not in RUNGS]
-            if unknown:
-                raise SystemExit(
-                    f"unknown ladder rung(s) {unknown}; valid: {sorted(RUNGS)}"
-                )
-        # the ladder shares the driver's watchdog with the flagship: cap
-        # it at 60% so a slow ladder degrades to skipped rungs, never to a
-        # killed worker with no flagship line
-        ladder_results = run_ladder(
-            only=only, budget_s=args.watchdog * 0.6 if only is None else None
-        )
-        for r in ladder_results:
-            print(json.dumps(r), flush=True)
-        if only is None:  # subset runs must not clobber the full record
-            try:
-                with open("BENCH_LADDER.json", "w") as f:
-                    json.dump(ladder_results, f, indent=1)
-            except OSError as e:
-                print(f"warning: could not write BENCH_LADDER.json: {e}",
-                      file=sys.stderr)
+    # ladder-only invocations skip the flagship entirely
+    if args.ladder_only:
+        _run_ladder_block(args)
+        return
 
     if args.scenarios < 1:
         raise SystemExit("--scenarios must be >= 1")
@@ -577,6 +598,16 @@ def worker_main(args):
         "extra": extra,
     }
     print(json.dumps(result), flush=True)
+
+    # ladder AFTER the flagship (round-4 restructure: three rounds of
+    # missing hardware numbers were risked by a wedge-able ladder running
+    # first).  The driver reorders so the flagship line is still LAST in
+    # the recorded artifact.
+    run_ladder_now = args.ladder or (
+        jax.default_backend() != "cpu" and not args.no_ladder
+    )
+    if run_ladder_now:
+        _run_ladder_block(args)
 
 
 def main():

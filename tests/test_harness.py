@@ -306,18 +306,25 @@ def test_ladder_lv_rung_smoke():
     assert r["extra"]["frac_lanes_decided"] == 1.0
 
 
+def _load_bench(name):
+    """Load bench.py as a fresh module (it is a script, not a package)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def test_bench_driver_is_hang_proof():
     """bench.py's driver stage (round-2 verdict item 1): the top level must
     import no jax, classify backend failures via a killable subprocess
     probe, and always end with a parseable metric/error line + exit 0."""
     import ast
-    import importlib.util
 
-    spec = importlib.util.spec_from_file_location(
-        "bench_under_test", os.path.join(os.path.dirname(__file__), "..", "bench.py")
-    )
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
+    bench = _load_bench("bench_under_test")
 
     # structural guard: no module-level jax/round_tpu import may sneak back
     tree = ast.parse(open(bench.__file__).read())
@@ -342,14 +349,9 @@ def test_bench_driver_is_hang_proof():
 def test_bench_error_line_shape(capsys):
     """Every bench failure path must emit the flagship metric shape with an
     error field and return exit code 0 (the r02 rc=1 regression)."""
-    import importlib.util
     import json as _json
 
-    spec = importlib.util.spec_from_file_location(
-        "bench_under_test2", os.path.join(os.path.dirname(__file__), "..", "bench.py")
-    )
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
+    bench = _load_bench("bench_under_test2")
 
     args = bench.build_parser().parse_args([])
     rc = bench._emit_error(args, "backend-unavailable", {"probe": "hang"})
@@ -358,6 +360,43 @@ def test_bench_error_line_shape(capsys):
     assert line["error"] == "backend-unavailable"
     assert line["metric"] == "otr_n1024_s10000_rounds_per_sec"
     assert line["value"] == 0.0 and line["unit"] == "rounds/sec"
+
+
+def test_bench_driver_salvages_flagship_on_worker_timeout(capsys):
+    """Round-4 restructure: the worker measures the flagship FIRST and the
+    ladder after, so a rung that wedges the tunnel is killed by the
+    watchdog with the flagship line already on the pipe.  The driver must
+    (a) salvage that line on a timeout, exit 0, reordered last; (b) still
+    emit the error record when nothing was salvageable."""
+    import json as _json
+
+    bench = _load_bench("bench_under_test3")
+    args = bench.build_parser().parse_args([])
+    flag = bench.flagship_metric_name(args)
+    good = _json.dumps({"metric": flag, "value": 123.0,
+                        "unit": "rounds/sec", "vs_baseline": 1.23})
+    rung = _json.dumps({"metric": "ladder_otr_n4", "extra": {}})
+
+    bench._run_probe = lambda a: (True, {"platform": "tpu", "n_devices": 1})
+    bench._run_worker = lambda argv, timeout: (
+        "timeout", good + "\n" + rung + '\n{"half-written',
+        {"watchdog_s": timeout})
+    rc = bench.driver_main(args, [])
+    lines = [ln for ln in capsys.readouterr().out.strip().splitlines() if ln]
+    assert rc == 0
+    assert _json.loads(lines[-1])["metric"] == flag      # flagship LAST
+    assert _json.loads(lines[-1])["value"] == 123.0
+    assert _json.loads(lines[0])["metric"] == "ladder_otr_n4"
+    assert len(lines) == 2                               # half line dropped
+
+    # nothing salvageable -> the bench-timeout error record, exit 0
+    bench._run_worker = lambda argv, timeout: ("timeout", rung + "\n",
+                                               {"watchdog_s": timeout})
+    rc = bench.driver_main(args, [])
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert rc == 0
+    err = _json.loads(lines[-1])
+    assert err["error"] == "bench-timeout" and err["metric"] == flag
 
 
 def test_ladder_crash_isolation_and_budget():

@@ -1,0 +1,159 @@
+"""Bisect which device program wedges the axon tunnel.
+
+Each stage is a tiny self-contained program; run stages individually as
+subprocesses with hard timeouts (see __main__ at the bottom) so a hung
+stage costs its timeout, not the session.
+
+Usage: python tools/tpu_bisect.py <stage>   # run one stage in-process
+       python tools/tpu_bisect.py           # driver: run all, each killable
+"""
+import json
+import subprocess
+import sys
+import time
+
+STAGES = [
+    "probe",          # arange sum (known good this morning)
+    "pallas_min",     # minimal pallas kernel, no PRNG
+    "pallas_prng",    # pallas kernel with pltpu hardware PRNG seed/bits
+    "loop_tiny",      # hist_loop v2 tiny shape
+    "loop_flat_tiny", # flat variant tiny shape
+    "general_tiny",   # general engine rung-1 shape (what the ladder runs 1st)
+    "loop_mid",       # hist_loop v2 n=256 S=256
+]
+
+
+def stage_probe():
+    import jax.numpy as jnp
+    print("probe:", jnp.arange(8).sum())
+
+
+def stage_pallas_min():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def k(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    x = jnp.ones((128, 128), jnp.float32)
+    y = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((128, 128), jnp.float32))(x)
+    print("pallas_min:", float(y.sum()))
+
+
+def stage_pallas_prng():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def k(s_ref, o_ref):
+        pltpu.prng_seed(s_ref[0], s_ref[1])
+        bits = pltpu.prng_random_bits((128, 128))
+        o_ref[...] = bits.astype(jnp.int32)
+
+    s = jnp.array([1, 2], jnp.int32)
+    y = pl.pallas_call(
+        k,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_shape=jax.ShapeDtypeStruct((128, 128), jnp.int32),
+    )(s)
+    print("pallas_prng:", int(jnp.unique(y).shape[0] > 100))
+
+
+def _loop_tiny(variant):
+    import jax
+    import jax.numpy as jnp
+    from round_tpu.engine import fast
+    from round_tpu.models.otr import OtrState
+
+    n, S, V, rounds = 128, 8, 4, 5
+    rnd = fast.OtrHist(n_values=V, after_decision=2)
+    key = jax.random.PRNGKey(0)
+    mix = fast.standard_mix(key, S, n, p_drop=0.25)
+    init = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0, V,
+                              dtype=jnp.int32)
+    state0 = OtrState(
+        x=jnp.broadcast_to(init, (S, n)).astype(jnp.int32),
+        decided=jnp.zeros((S, n), dtype=bool),
+        decision=jnp.full((S, n), -1, jnp.int32),
+        after=jnp.full((S, n), 2, jnp.int32),
+    )
+    state, done, dr = fast.run_otr_loop(
+        rnd, state0, mix, max_rounds=rounds, mode="hw", sb=4,
+        variant=variant)
+    print(f"loop_{variant}: decided={int(state.decided.sum())}")
+
+
+def stage_loop_tiny():
+    _loop_tiny("v2")
+
+
+def stage_loop_flat_tiny():
+    _loop_tiny("flat")
+
+
+def stage_general_tiny():
+    import jax
+    import jax.numpy as jnp
+    from round_tpu.apps.ladder import rung_otr4
+    r = rung_otr4(repeats=1)
+    print("general_tiny:", json.dumps(r)[:200])
+
+
+def stage_loop_mid():
+    import jax
+    import jax.numpy as jnp
+    from round_tpu.engine import fast
+    from round_tpu.models.otr import OtrState
+
+    n, S, V, rounds = 256, 256, 8, 20
+    rnd = fast.OtrHist(n_values=V, after_decision=2)
+    key = jax.random.PRNGKey(0)
+    mix = fast.standard_mix(key, S, n, p_drop=0.25)
+    init = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0, V,
+                              dtype=jnp.int32)
+    state0 = OtrState(
+        x=jnp.broadcast_to(init, (S, n)).astype(jnp.int32),
+        decided=jnp.zeros((S, n), dtype=bool),
+        decision=jnp.full((S, n), -1, jnp.int32),
+        after=jnp.full((S, n), 2, jnp.int32),
+    )
+    t0 = time.perf_counter()
+    state, done, dr = fast.run_otr_loop(
+        rnd, state0, mix, max_rounds=rounds, mode="hw", sb=8)
+    jax.block_until_ready(state.x)
+    print(f"loop_mid: decided={int(state.decided.sum())} "
+          f"wall={time.perf_counter() - t0:.1f}s")
+
+
+def main_driver(timeout_s=240.0):
+    results = {}
+    for name in STAGES:
+        t0 = time.perf_counter()
+        try:
+            cp = subprocess.run(
+                [sys.executable, __file__, name],
+                capture_output=True, text=True, timeout=timeout_s)
+            dt = time.perf_counter() - t0
+            ok = cp.returncode == 0
+            results[name] = {
+                "ok": ok, "wall_s": round(dt, 1),
+                "out": cp.stdout.strip()[-200:],
+                **({} if ok else {"err": cp.stderr.strip()[-400:]}),
+            }
+        except subprocess.TimeoutExpired:
+            results[name] = {"ok": False, "wall_s": timeout_s,
+                             "err": "TIMEOUT (hang)"}
+        print(json.dumps({name: results[name]}), flush=True)
+        if not results[name]["ok"]:
+            print(f"stage {name} failed; continuing", file=sys.stderr)
+    return results
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1:
+        globals()[f"stage_{sys.argv[1]}"]()
+    else:
+        main_driver()
