@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from round_tpu.core.algorithm import Algorithm
 from round_tpu.core.rounds import Round, RoundCtx, broadcast
 from round_tpu.models.common import ghost_decide
+from round_tpu.ops.detsum import tree_sum
 from round_tpu.ops.mailbox import Mailbox
 
 _INF = jnp.float32(jnp.inf)
@@ -90,7 +91,12 @@ class EpsilonRound(Round):
         valid = idx < (cnt - f)
         idx = jnp.minimum(idx, 2 * self.n - 1)
         sel = jnp.where(valid, sorted_v[idx], 0.0)
-        x_mid = jnp.sum(sel) / jnp.maximum(jnp.sum(valid.astype(jnp.int32)), 1)
+        # tree_sum, not jnp.sum: the trimmed mean is protocol SEMANTICS
+        # (Epsilon.scala:56-60 computes it on Doubles), so its association
+        # order is pinned — the fused engine (engine/epsfast.py) computes
+        # the same sum from count-matmul selections and must get the same
+        # bits (ops/detsum.py)
+        x_mid = tree_sum(sel) / jnp.maximum(jnp.sum(valid.astype(jnp.int32)), 1)
 
         is_r0 = ctx.r == 0
         deciding = (~is_r0) & (ctx.r > state.max_r)

@@ -1,0 +1,62 @@
+"""Deterministic-order float reductions.
+
+`jnp.sum` lowers to an XLA reduce whose association order is a backend /
+fusion-context choice: the same logical [.., m] f32 sum can round
+differently depending on what it is fused with (observed: 1-ULP drift
+between the general ε-agreement engine and its count-matmul replacement,
+amplifying to ~1e-3 after a few convergence rounds as selection
+boundaries flip).  Protocols whose *semantics* include a float mean
+(ε-agreement's trimmed mean — the reference computes it on Scala Doubles,
+Epsilon.scala:56-60) therefore pin the association order explicitly:
+`tree_sum` is a balanced binary tree built from elementwise adds at fixed
+positions, which XLA cannot reassociate.  Any two call sites — engines,
+kernels, oracles — that sum the same values through `tree_sum` produce
+the same bits on every backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+
+import jax.numpy as jnp
+
+# set while tracing a round for TR extraction (use `extracting()`): the
+# add-tree exists for bit-parity of float EXECUTION, but the abstract
+# interpreter must see the sum as the single (opaque) reduce_sum site it
+# models — tracing the tree would manufacture a spurious non-opaque Plus
+# over order symbols
+_EXTRACTING = contextvars.ContextVar("detsum_extracting", default=False)
+
+
+@contextlib.contextmanager
+def extracting():
+    """Within this context, tree_sum traces as a plain jnp.sum (the
+    opaque-site form TR extraction models).  Owns the set/reset invariant
+    so call sites cannot leave the flag stuck."""
+    tok = _EXTRACTING.set(True)
+    try:
+        yield
+    finally:
+        _EXTRACTING.reset(tok)
+
+
+def tree_sum(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Sum along ``axis`` with a fixed balanced-tree association order.
+
+    Zero-pads to the next power of two (exact for finite floats) and
+    halves the axis with elementwise adds until one element remains."""
+    if _EXTRACTING.get():
+        return jnp.sum(x, axis=axis)
+    x = jnp.moveaxis(x, axis, -1)
+    m = x.shape[-1]
+    if m == 0:
+        return jnp.zeros(x.shape[:-1], x.dtype)
+    p = 1 << max(m - 1, 0).bit_length()
+    if p != m:
+        pad = jnp.zeros(x.shape[:-1] + (p - m,), x.dtype)
+        x = jnp.concatenate([x, pad], axis=-1)
+    while x.shape[-1] > 1:
+        h = x.shape[-1] // 2
+        x = x[..., :h] + x[..., h:]
+    return x[..., 0]

@@ -945,7 +945,16 @@ def extract_lane_fn(
     This is processSendUpdate (TrExtractor.scala:101-160) with jaxprs
     instead of Scala trees: same inputs (the executable round code), same
     output (formulas for the transition relation)."""
-    closed = jax.make_jaxpr(fn)(*example_args)
+    from round_tpu.ops import detsum
+
+    # under extraction, ops.detsum.tree_sum traces as a plain reduce_sum:
+    # the deterministic add-tree exists for cross-engine bit-parity of
+    # float sums, which the abstract interpreter cannot see anyway — such
+    # sites are OPAQUE in the order abstraction (RankVec reduce), and
+    # tracing the explicit tree would instead produce a spurious
+    # non-opaque Plus over order symbols (unsound)
+    with detsum.extracting():
+        closed = jax.make_jaxpr(fn)(*example_args)
     jaxpr = _dce(closed.jaxpr)
     # the process-axis length, for rank-domain detection: the (single)
     # 1-D length among the example args

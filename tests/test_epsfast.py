@@ -1,0 +1,96 @@
+"""Differential parity: the fused ε-agreement engine (epsfast) vs the
+general engine (run_instance) on identical ho masks and inputs.
+
+The fused path replaces per-receiver sorts with shared count-matmuls
+(engine/epsfast.py docstring); these tests pin that the replacement is
+OBSERVATIONALLY IDENTICAL — bit-exact on every state leaf, decided_round
+included — across receiver-dependent (byzantine silence, omission) and
+sender-determined (crash) fault families, plus the ε-agreement safety
+properties on the fused path itself.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from round_tpu.engine import scenarios
+from round_tpu.engine.epsfast import run_epsilon_fast
+from round_tpu.engine.executor import run_instance
+from round_tpu.models.epsilon import EpsilonConsensus
+
+
+def _run_both(n, f, eps, sampler, phases, seed, n_scen=3):
+    algo = EpsilonConsensus(n, f=f, epsilon=eps)
+
+    def one(runner):
+        def go(k):
+            k_io, k_run = jax.random.split(k)
+            io = {"initial_value":
+                  jax.random.uniform(k_io, (n,), jnp.float32) * 100.0}
+            return runner(algo, io, n, k_run, sampler, max_phases=phases)
+        return jax.vmap(go)(jax.random.split(jax.random.PRNGKey(seed), n_scen))
+
+    return one(run_instance), one(run_epsilon_fast)
+
+
+def _assert_bit_equal(ref, fast):
+    for name in ("x", "max_r", "halted_vals", "halted_mask",
+                 "decided", "decision"):
+        a = np.asarray(getattr(ref.state, name))
+        b = np.asarray(getattr(fast.state, name))
+        assert a.shape == b.shape, name
+        # raw-bit compare: NaN decisions on undecided lanes are documented
+        # garbage and NaN != NaN under ==
+        assert (a.view(np.uint8) == b.view(np.uint8)).all(), (
+            name, a, b)
+    assert (np.asarray(ref.decided_round)
+            == np.asarray(fast.decided_round)).all()
+    assert (np.asarray(ref.done) == np.asarray(fast.done)).all()
+
+
+@pytest.mark.parametrize("fam", ["silence", "omission", "crash"])
+def test_epsfast_bit_parity(fam):
+    n, f = 16, 2
+    sampler = {
+        "silence": scenarios.byzantine_silence(n, f),
+        "omission": scenarios.omission(n, 0.2),
+        "crash": scenarios.crash(n, f),
+    }[fam]
+    ref, fast = _run_both(n, f, 0.5, sampler, phases=8, seed=hash(fam) % 97)
+    _assert_bit_equal(ref, fast)
+    # non-vacuity: something actually decided and something halted
+    assert np.asarray(ref.state.decided).any()
+    assert np.asarray(ref.state.halted_mask).any()
+
+
+def test_epsfast_bit_parity_larger_f():
+    # a second (n, f) shape: deeper horizon, more trimmed-mean ranks
+    n, f = 32, 3
+    ref, fast = _run_both(n, f, 0.25, scenarios.byzantine_silence(n, f),
+                          phases=12, seed=5)
+    _assert_bit_equal(ref, fast)
+    assert np.asarray(ref.state.decided).any()
+
+
+def test_epsfast_safety_properties():
+    """ε-agreement's two safety properties checked on the FUSED path:
+    honest decisions within ε and inside the initial-value range."""
+    n, f, eps = 16, 2, 0.5
+    algo = EpsilonConsensus(n, f=f, epsilon=eps)
+    sampler = scenarios.byzantine_silence(n, f)
+    key = jax.random.PRNGKey(11)
+    init = jax.random.uniform(jax.random.fold_in(key, 7), (n,)) * 100.0
+    res = run_epsilon_fast(algo, {"initial_value": init}, n, key, sampler,
+                           max_phases=10)
+    from round_tpu.spec import replay_ho
+
+    ho = np.asarray(replay_ho(key, sampler, 1))
+    honest = ho[0].all(axis=0)
+    dec = np.asarray(res.state.decision)[honest]
+    got = np.asarray(res.state.decided)[honest]
+    assert got.all()
+    d = dec[got]
+    assert (d.max() - d.min()) <= eps + 1e-5
+    assert d.min() >= float(init.min()) - 1e-5
+    assert d.max() <= float(init.max()) + 1e-5

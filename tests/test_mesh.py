@@ -100,3 +100,8 @@ def test_epsilon_rung_sharded_bit_parity():
     assert extra["sharded"] is True
     assert extra["shard_parity"] is True
     assert extra["property_parity"] is True
+    # the timed path is now the fused count-matmul engine, bit-exact
+    # against the general engine (engine/epsfast.py); parity_exact is the
+    # all-lanes gate, not the (display-rounded) fraction
+    assert extra["engine"] == "eps_fused"
+    assert extra["parity_exact"] is True

@@ -44,22 +44,34 @@ def log(rec):
 
 
 def run(name, cmd, timeout):
+    """Run one step in its own PROCESS GROUP and kill the whole group on
+    timeout: bench.py spawns probe/worker grandchildren, and a plain
+    child-kill would orphan a wedged worker that then holds the tunnel
+    connection open forever (defeating every later probe)."""
+    import os as _os
+    import signal as _signal
+
     t0 = time.perf_counter()
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, env=ENV,
+                            cwd=REPO, start_new_session=True)
     try:
-        cp = subprocess.run(cmd, capture_output=True, text=True,
-                            timeout=timeout, env=ENV, cwd=REPO)
-        ok = cp.returncode == 0
+        out, err = proc.communicate(timeout=timeout)
+        ok = proc.returncode == 0
         log({"step": name, "ok": ok, "wall_s": round(time.perf_counter() - t0, 1),
-             "out": cp.stdout.strip()[-2000:],
-             **({} if ok else {"err": cp.stderr.strip()[-500:]})})
-        return ok, cp.stdout
-    except subprocess.TimeoutExpired as e:
-        # a wedged child holds the tunnel connection open; make sure it dies
+             "out": out.strip()[-2000:],
+             **({} if ok else {"err": err.strip()[-500:]})})
+        return ok, out
+    except subprocess.TimeoutExpired:
+        try:
+            _os.killpg(proc.pid, _signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        out, _ = proc.communicate()
         log({"step": name, "ok": False, "wall_s": round(timeout, 1),
-             "err": "TIMEOUT (hang)",
-             "out": ((e.stdout or b"").decode() if isinstance(e.stdout, bytes)
-                     else (e.stdout or ""))[-2000:]})
-        return False, ""
+             "err": "TIMEOUT (hang; process group killed)",
+             "out": (out or "")[-2000:]})
+        return False, out or ""
 
 
 def attempt_window():
@@ -73,8 +85,12 @@ def attempt_window():
         return False
     run("loop_mid", [py, bisect, "loop_mid"], 300)
 
+    # outer timeout must dominate bench's own worst case (probe-timeout +
+    # watchdog + teardown margin), or the watcher kills the driver before
+    # the driver can salvage the flagship line
     ok, out = run("flagship", [py, os.path.join(REPO, "bench.py"),
-                               "--repeats", "3", "--watchdog", "1500"], 1700)
+                               "--repeats", "3", "--probe-timeout", "120",
+                               "--watchdog", "1500"], 1500 + 120 + 120)
     if ok and '"error"' not in out.splitlines()[-1]:
         return True
     # scaled-down fallbacks: an honest smaller number beats nothing
@@ -82,7 +98,8 @@ def attempt_window():
         ok, out = run(f"flagship_n{n}", [
             py, os.path.join(REPO, "bench.py"), "--n", str(n),
             "--scenarios", str(s), "--repeats", "2", "--no-ladder",
-            "--watchdog", str(wd)], wd + 200)
+            "--probe-timeout", "120", "--watchdog", str(wd)],
+            wd + 120 + 120)
         if ok and '"error"' not in out.splitlines()[-1]:
             return False  # got a partial number; keep watching for a full one
     return False
