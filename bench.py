@@ -350,13 +350,7 @@ def worker_main(args):
             )
         return fast.standard_mix(key, S, args.n, p_drop=args.p_drop)
 
-    def fresh_otr_state(init, S, n):
-        return OtrState(
-            x=jnp.broadcast_to(init, (S, n)).astype(jnp.int32),
-            decided=jnp.zeros((S, n), dtype=bool),
-            decision=jnp.full((S, n), -1, dtype=jnp.int32),
-            after=jnp.full((S, n), 2, dtype=jnp.int32),
-        )
+    fresh_otr_state = OtrState.fresh  # the shared constructor (models/otr.py)
 
     def run_fast_engine(engine, rnd, state0, mix, rounds, mode, interpret,
                         dot=None, variant="v2"):

@@ -136,6 +136,55 @@ def rung_otr4(repeats: int = 2) -> Dict[str, Any]:
         prop_ok &= bool(rep.all_safety_properties_hold())
     extra = _speed_extra(best, rounds, cnt, hist, n, S)
     extra.update({"invariant_parity": inv_ok, "property_parity": prop_ok})
+
+    # the same testOTR.sh shape on the FLAGSHIP loop kernel (VERDICT r03
+    # weak #5's parenthetical: rung 1 timed only the general engine).
+    # The general-engine number stays THE rung metric — n=4×S=1 is the
+    # reference-shape semantics run — but the loop kernel's time on the
+    # same shape is recorded alongside, lane-exact-parity checked, so
+    # every rung evidences the engine family the flagship bench times.
+    from round_tpu.models.otr import OtrState
+
+    V = 3
+    rnd = fast.OtrHist(n_values=V, after_decision=2)
+    interpret = jax.default_backend() == "cpu"
+    mode = "hash" if interpret else "hw"
+    p8 = max(1, round(0.1 * 256))
+
+    loop_state0 = lambda init: OtrState.fresh(init, S, n)
+
+    def loop_run(key, run_mode):
+        mix = fast.fault_free(key, S, n).replace(
+            p8=jnp.full((S,), p8, jnp.int32))
+        init = jax.random.randint(
+            jax.random.fold_in(key, 1), (n,), 0, V, dtype=jnp.int32)
+        state, _done, dround = fast.run_otr_loop(
+            rnd, loop_state0(init), mix, max_rounds=phases, mode=run_mode,
+            interpret=interpret,
+        )
+        return state, dround, mix, init
+
+    @jax.jit
+    def loop_bench(key):
+        state, dround, _mix, _init = loop_run(key, mode)
+        return decided_summary(state.decided, dround, phases, state.decision)
+
+    try:
+        jax.device_get(loop_bench(jax.random.PRNGKey(0)))  # compile+warm
+        lbest, _ = _time_best(
+            loop_bench, [jax.random.PRNGKey(i) for i in range(repeats)],
+            warmed=True,
+        )
+        key = jax.random.PRNGKey(0)
+        state, dround, mix, init = jax.jit(
+            lambda k: loop_run(k, "hash"))(key)
+        extra["loop_rounds_per_sec"] = round(rounds / lbest, 1)
+        extra["loop_parity_frac"] = _diff_parity(
+            state, dround, mix, lambda s: OTR(), consensus_io(init), n,
+            phases, ("x", "decided", "decision"), k=S,
+        )
+    except Exception as e:  # noqa: BLE001 — recorded, never fatal to rung 1
+        extra["loop_error"] = f"{type(e).__name__}: {e}"[:200]
     return {"metric": "ladder_otr_n4", "extra": extra}
 
 
@@ -518,41 +567,11 @@ def rung_epsilon(repeats: int = 2, n: int = 1024, S: int = 32,
     sharded = ndev > 1 and S % ndev == 0
     shard_parity = None
     if sharded:
-        from functools import partial as _partial
+        from round_tpu.parallel.mesh import sharded_keyed_parity
 
-        from jax.sharding import PartitionSpec as _P
-
-        from round_tpu.parallel.mesh import SCENARIO_AXIS, make_mesh
-
-        mesh = make_mesh(ndev, proc_shards=1)
-
-        @_partial(
-            jax.shard_map, mesh=mesh, in_specs=(_P(SCENARIO_AXIS),),
-            out_specs=(_P(SCENARIO_AXIS),) * 3, check_vma=False,
+        run, _sh, shard_parity = sharded_keyed_parity(
+            one_fast, jax.random.split(jax.random.PRNGKey(0), S), ndev,
         )
-        def run(keys_shard):
-            return jax.vmap(one_fast)(keys_shard)
-
-        # single-device oracle: the SAME per-scenario computation on the
-        # same keys, at matched vmap widths (float payloads are only
-        # bit-stable across identical batch shapes)
-        keys = jax.random.split(jax.random.PRNGKey(0), S)
-        sh_dec, sh_dr, sh_val = jax.device_get(jax.jit(run)(keys))
-        per = S // ndev
-        ref_dec, ref_dr, ref_val = jax.device_get(jax.jit(
-            lambda ks: jax.lax.map(jax.vmap(one_fast),
-                                   ks.reshape(S // per, per, 2))
-        )(keys))
-
-        def bits_equal(a, b):
-            # RAW-BIT comparison: float decisions are NaN on undecided
-            # lanes (documented garbage), and NaN != NaN under ==
-            a, b = np.asarray(a), np.asarray(b).reshape(np.shape(a))
-            return bool((a.view(np.uint8) == b.view(np.uint8)).all())
-
-        shard_parity = (bits_equal(sh_dec, ref_dec)
-                        and bits_equal(sh_dr, ref_dr)
-                        and bits_equal(sh_val, ref_val))
     else:
         def run(keys):
             return jax.vmap(one_fast)(keys)

@@ -31,6 +31,19 @@ class OtrState:
     decision: jnp.ndarray  # int32, -1 until decided (ghost in the reference)
     after: jnp.ndarray     # rounds left before exiting once decided
 
+    @classmethod
+    def fresh(cls, init, S: int, n: int,
+              after_decision: int = 2) -> "OtrState":
+        """[S, n]-batched undecided state from an [n] initial-value vector —
+        the ONE constructor the flagship bench and every ladder/kernel call
+        site share, so they cannot drift on the initial layout."""
+        return cls(
+            x=jnp.broadcast_to(init, (S, n)).astype(jnp.int32),
+            decided=jnp.zeros((S, n), dtype=bool),
+            decision=jnp.full((S, n), -1, dtype=jnp.int32),
+            after=jnp.full((S, n), after_decision, dtype=jnp.int32),
+        )
+
 
 class OtrRound(Round):
     def __init__(self, n_values: int | None = None):

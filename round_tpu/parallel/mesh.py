@@ -52,6 +52,56 @@ def make_mesh(
     return Mesh(np.asarray(devs[:n_devices]).reshape(shape), (SCENARIO_AXIS, PROC_AXIS))
 
 
+def sharded_keyed_parity(one_fn, keys, n_devices, devices=None):
+    """Run a per-scenario keyed computation scenario-sharded over an
+    n_devices mesh AND through a single-device oracle at MATCHED vmap
+    widths, returning (sharded_outputs, raw_bit_parity).
+
+    The one parity discipline every scenario-DP call site shares (the
+    ε-agreement ladder rung, the multichip dryrun): the scenario axis is
+    pure data parallelism, so the sharded values must come out
+    bit-identical to the single-device run on the same keys — compared as
+    RAW BITS because float outputs are NaN on undecided lanes (documented
+    garbage, and NaN != NaN under ==).  The oracle batches at the
+    per-device shard width: float payloads are only bit-stable across
+    identical vmap widths.
+
+    one_fn: key -> tuple of arrays (one scenario's outputs).
+    keys:   [S, 2] scenario keys, S divisible by n_devices."""
+    import numpy as np
+
+    from jax.sharding import PartitionSpec as _P
+
+    S = keys.shape[0]
+    assert S % n_devices == 0
+    devs = devices if devices is not None else jax.devices()
+    mesh = Mesh(np.asarray(devs[:n_devices]), (SCENARIO_AXIS,))
+
+    @partial(
+        jax.shard_map, mesh=mesh, in_specs=(_P(SCENARIO_AXIS),),
+        out_specs=_P(SCENARIO_AXIS), check_vma=False,
+    )
+    def run(keys_shard):
+        return jax.vmap(one_fn)(keys_shard)
+
+    sh = jax.device_get(jax.jit(run)(keys))
+    per = S // n_devices
+    ref = jax.device_get(jax.jit(
+        lambda ks: jax.lax.map(jax.vmap(one_fn), ks.reshape(S // per, per, 2))
+    )(keys))
+
+    def bits_equal(a, b):
+        a, b = np.asarray(a), np.asarray(b).reshape(np.shape(a))
+        return bool((a.view(np.uint8) == b.view(np.uint8)).all())
+
+    parity = all(bits_equal(a, b) for a, b in
+                 zip(jax.tree_util.tree_leaves(sh),
+                     jax.tree_util.tree_leaves(ref)))
+    # `run` is returned so callers can TIME the very computation whose
+    # parity was just pinned, never a drifted copy
+    return run, sh, parity
+
+
 class ProcShardTopology:
     """Lane slice of one chip when the process axis is sharded over PROC_AXIS.
 
@@ -337,4 +387,35 @@ def _dryrun_cpu(n_devices: int) -> None:
     print(
         "dryrun_multichip loop-engine flat-variant ok: bit-parity with v2 "
         f"over {n_devices} devices"
+    )
+
+    # the fused ε-agreement engine (engine/epsfast.py) sharded over the
+    # scenario axis: BASELINE rung 5 is "n=1024, multi-chip shard", so the
+    # multichip artifact must evidence the count-matmul engine that rung
+    # times — through the SAME parity harness the rung uses
+    # (sharded_keyed_parity), raw-bit against a single device
+    from round_tpu.engine.epsfast import run_epsilon_fast
+    from round_tpu.models.epsilon import EpsilonConsensus
+
+    n3, f3, S3, ph3 = 16, 2, 2 * n_devices, 8
+    algo_eps = EpsilonConsensus(n3, f=f3, epsilon=0.5)
+    samp = scenarios.byzantine_silence(n3, f3)
+
+    def one_eps(k):
+        k_io, k_run = jax.random.split(k)
+        io = {"initial_value":
+              jax.random.uniform(k_io, (n3,), jnp.float32) * 100.0}
+        res = run_epsilon_fast(algo_eps, io, n3, k_run, samp, max_phases=ph3)
+        return res.state.decided, res.decided_round, res.state.decision
+
+    with jax.default_device(devs[0]):
+        _run, sh, parity = sharded_keyed_parity(
+            one_eps, jax.random.split(jax.random.PRNGKey(9), S3),
+            n_devices, devices=devs,
+        )
+    assert parity, "eps_fused sharded diverged from single-device"
+    assert np.asarray(sh[0]).any(), "eps_fused dryrun decided nothing"
+    print(
+        "dryrun_multichip eps-fused ok: count-matmul engine scenario-"
+        f"sharded over {n_devices} devices, raw-bit parity vs single-device"
     )

@@ -259,6 +259,9 @@ def test_ladder_first_rung_smoke():
     assert x["invariant_parity"] is True
     assert x["property_parity"] is True
     assert x["rounds_per_sec"] > 0
+    # rung 1 also evidences the flagship loop kernel on the same shape
+    assert x["loop_rounds_per_sec"] > 0
+    assert x["loop_parity_frac"] == 1.0
 
 
 def test_ladder_floodmin_rung_smoke():
