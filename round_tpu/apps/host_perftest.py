@@ -36,16 +36,23 @@ from round_tpu.runtime.transport import HostTransport  # noqa: E402
 
 
 def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
-             errors=None, proto="tcp"):
+             errors=None, proto="tcp", stats=None, algo=None):
     tr = HostTransport(my_id, peers[my_id][1], proto=proto)
     # ONE algorithm object across instances: the jitted round functions
-    # cache on its rounds, so instance 2+ skip compilation entirely
-    algo = select(algo_name)
+    # cache on its rounds, so instance 2+ skip compilation entirely.
+    # Thread mode passes ONE shared object for all replicas — the jitted
+    # fns are pure and jax's cache is thread-safe, so n replicas compile
+    # once instead of n times (profiled: compilation was ~35% of a
+    # 100-instance thread-mode run)
+    algo = select(algo_name) if algo is None else algo
     try:
+        node_stats: dict = {}
         results[my_id] = run_instance_loop(
             algo, my_id, peers, tr, instances, timeout_ms=timeout_ms,
-            seed=seed,
+            seed=seed, stats_out=node_stats,
         )
+        if stats is not None:
+            stats[my_id] = node_stats
     except Exception as e:  # noqa: BLE001 - surfaced by measure()
         if errors is not None:
             errors[my_id] = e
@@ -116,11 +123,13 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
     peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
     results: dict = {}
     errors: dict = {}
+    stats: dict = {}
+    shared_algo = select(algo)
     threads = [
         threading.Thread(
             target=run_node,
             args=(i, peers, algo, instances, timeout_ms, results, seed,
-                  errors, proto),
+                  errors, proto, stats, shared_algo),
         )
         for i in range(n)
     ]
@@ -143,8 +152,12 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
             f"replica(s) died: {sorted(set(range(n)) - set(results))}; "
             f"errors: {errors}"
         )
-    return _score(results, instances, wall, n, algo, timeout_ms,
-                  "thread-per-replica", proto=proto), results
+    score = _score(results, instances, wall, n, algo, timeout_ms,
+                   "thread-per-replica", proto=proto)
+    # per-node diagnostics: timeouts is the throughput killer (each one
+    # burned a full round deadline)
+    score["extra"]["node_stats"] = {i: stats.get(i, {}) for i in sorted(stats)}
+    return score, results
 
 
 def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,

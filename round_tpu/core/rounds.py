@@ -41,7 +41,16 @@ class RoundCtx:
         self.n = n
         self.r = r
         self.rng = rng
-        self._exit = jnp.asarray(False)
+        # lazily materialized: an eager jnp.asarray(False) here costs a
+        # full JAX dispatch per construction, which dominated the HOST
+        # round loop (one eager RoundCtx per round for the progress/
+        # expected hooks; profiled at ~45% of host wall).  None means
+        # "never signalled".
+        self._exit_acc = None
+
+    @property
+    def _exit(self):
+        return jnp.asarray(False) if self._exit_acc is None else self._exit_acc
 
     def exit_at_end_of_round(self, when=True):
         """Terminate this process's instance after the current round.
@@ -49,7 +58,10 @@ class RoundCtx:
         ``when`` may be a traced boolean (data-dependent exit becomes a lane
         mask, not control flow).  Mirrors Round.scala:42-44.
         """
-        self._exit = jnp.logical_or(self._exit, when)
+        self._exit_acc = (
+            jnp.asarray(when) if self._exit_acc is None
+            else jnp.logical_or(self._exit_acc, when)
+        )
 
 
 @jax.tree_util.register_pytree_node_class

@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import dataclasses
 import pickle
+import threading
 import time as _time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -65,6 +66,10 @@ from round_tpu.runtime.transport import HostTransport, wire_loads
 
 log = get_logger("host")
 
+# serializes jit-trio builds so thread-mode replicas sharing an Algorithm
+# compile each round class once (see HostRunner._round_fns)
+_JIT_BUILD_LOCK = threading.Lock()
+
 
 @dataclasses.dataclass
 class HostResult:
@@ -80,6 +85,9 @@ class HostResult:
     # ALWAYS tolerates them — one garbage datagram on the unauthenticated
     # socket must never kill a replica.
     malformed_messages: int = 0
+    # rounds that ended by deadline expiry rather than goAhead — the
+    # throughput diagnostic (every one burns a full round timeout)
+    timeouts: int = 0
 
 
 def run_instance_loop(
@@ -92,6 +100,7 @@ def run_instance_loop(
     seed: int = 0,
     base_value: int = 0,
     max_rounds: int = 32,
+    stats_out: Optional[Dict[str, int]] = None,
 ) -> List[Optional[int]]:
     """The PerfTest2 loop (PerfTest2.scala:19-110): `instances` consecutive
     consensus instances over one transport, with start-skew stashing —
@@ -143,6 +152,13 @@ def run_instance_loop(
         decisions.append(
             int(np.asarray(res.decision)) if res.decided else None
         )
+        if stats_out is not None:
+            # cumulative diagnostics across instances (timeouts is the
+            # throughput one: every entry burned a full round deadline)
+            for k, v in (("timeouts", res.timeouts),
+                         ("rounds_run", res.rounds_run),
+                         ("malformed", res.malformed_messages)):
+                stats_out[k] = stats_out.get(k, 0) + v
     return decisions
 
 
@@ -186,6 +202,7 @@ class HostRunner:
         # lazy join, PerfTest2.scala:72-110)
         self.foreign = foreign
         self.malformed = 0
+        self.timeouts = 0   # rounds ended by deadline expiry (diagnostics)
         for pid, (host, port) in peers.items():
             if pid != my_id:
                 transport.add_peer(pid, host, port)
@@ -232,6 +249,19 @@ class HostRunner:
         cached = getattr(rnd, "_host_jit", None)
         if cached is not None and cached[0] == self.n:
             return cached[1], cached[2], cached[3]
+        # double-checked module lock: thread-mode replicas share the
+        # Algorithm object and reach round 0 within milliseconds of each
+        # other — an unlocked check-then-set would have every thread
+        # trace+compile its own trio (n-way duplicate work; the cache
+        # still converged but the 'compile once per process' claim was
+        # false)
+        with _JIT_BUILD_LOCK:
+            cached = getattr(rnd, "_host_jit", None)
+            if cached is not None and cached[0] == self.n:
+                return cached[1], cached[2], cached[3]
+            return self._build_round_fns(rnd)
+
+    def _build_round_fns(self, rnd):
         n = self.n
 
         def mk_ctx(rr, sid, seed):
@@ -402,6 +432,7 @@ class HostRunner:
                 left_ms = int((deadline - _time.monotonic()) * 1000)
                 if left_ms <= 0:
                     timedout = True
+                    self.timeouts += 1
                     if not use_deadline:
                         log.warning(
                             "node %d round %d: %s was idle for "
@@ -450,6 +481,7 @@ class HostRunner:
             state=state, decided=decided, decision=decision, rounds_run=r,
             dropped_messages=self.transport.dropped,
             malformed_messages=self.malformed,
+            timeouts=self.timeouts,
         )
 
     def _mailbox(self, inbox: Dict[int, Any], like: Any) -> Mailbox:
