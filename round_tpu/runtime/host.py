@@ -233,12 +233,14 @@ class HostRunner:
         eager fold-ins per round would dominate host-round latency."""
         return RoundCtx(id=np.int32(self.id), n=self.n, r=np.int32(r))
 
-    def _round_fns(self, rnd):
+    def _round_fns(self, rnd, state):
         """Jitted (pre+send, update, go-probe) for one Round at this group
         size — eager per-op dispatch (including the per-round PRNG fold-in)
         dominates host-round latency otherwise.  The cache lives ON the
         round object so every instance over the same Algorithm (the
-        PerfTest2 loop) reuses the compiled trio.
+        PerfTest2 loop) reuses the compiled trio.  ``state`` is the live
+        state pytree, used as the exemplar for the under-lock warm-up
+        compile (see _build_round_fns).
 
         The go-probe is the per-receive Progress of the reference
         (InstanceHandler.scala:383-400): for a FoldRound it evaluates
@@ -259,9 +261,9 @@ class HostRunner:
             cached = getattr(rnd, "_host_jit", None)
             if cached is not None and cached[0] == self.n:
                 return cached[1], cached[2], cached[3]
-            return self._build_round_fns(rnd)
+            return self._build_round_fns(rnd, state)
 
-    def _build_round_fns(self, rnd):
+    def _build_round_fns(self, rnd, state):
         n = self.n
 
         def mk_ctx(rr, sid, seed):
@@ -291,6 +293,19 @@ class HostRunner:
             f_go = jax.jit(f_go)
 
         fns = (jax.jit(f_send), jax.jit(f_update), f_go)
+        # jax.jit is LAZY: trace+compile NOW, under the build lock, on
+        # exemplar args (results discarded) — returning un-traced wrappers
+        # would let every replica thread race into its own duplicate
+        # trace+compile at first call, which is exactly what the lock
+        # exists to prevent
+        rr0, sid0, seed0 = np.int32(0), np.int32(self.id), np.uint32(0)
+        st0, payload0, _dm = fns[0](rr0, sid0, seed0, state)
+        payload_np = jax.tree_util.tree_map(np.asarray, payload0)
+        mbox = self._mailbox({}, payload_np)
+        fns[1](rr0, sid0, seed0, state, mbox.values, mbox.mask)
+        if f_go is not None:
+            f_go(rr0, sid0, seed0, state, mbox.values, mbox.mask)
+        jax.block_until_ready(st0)
         rnd._host_jit = (n, *fns)
         return fns
 
@@ -318,7 +333,7 @@ class HostRunner:
             rnd = rounds[r % len(rounds)]
             rr, sid = np.int32(r), np.int32(self.id)
             seed = np.uint32(self.seed)
-            f_send, f_update, f_go = self._round_fns(rnd)
+            f_send, f_update, f_go = self._round_fns(rnd, state)
             state, payload, dest_mask = f_send(rr, sid, seed, state)
             dest = np.asarray(dest_mask)
             payload_np = jax.tree_util.tree_map(np.asarray, payload)
