@@ -292,56 +292,38 @@ class BenOrHist(HistRound):
         return state, jnp.zeros_like(frozen)
 
 
-def run_hist(
+def hist_scan(
     rnd: HistRound,
     state0,
     decided_fn: Callable,
-    mix: FaultMix,
     max_rounds: int,
-    mode: str = "hw",
-    sb: int = 8,
-    interpret: bool = False,
-    dot: str = "bf16",
+    n: int,
+    counts_fn: Callable,
+    coin_fn: Optional[Callable] = None,
 ):
-    """Scan `max_rounds` fused rounds over the full scenario batch.
+    """The round-step scaffolding every histogram engine shares: subround
+    dispatch (phase_len switch), exit/freeze bookkeeping (exited lanes stop
+    sending and their state freezes — executor.run_phases semantics), and
+    decided_round recording.  Engines differ ONLY in how counts are
+    produced:
 
-    state0 leaves are [S, n, ...].  Returns (state, done [S, n],
-    decided_round [S, n]).  Semantics mirror executor.run_phases: exited
-    lanes stop sending and freeze."""
-    S, n = mix.crashed.shape
-    V = rnd.num_values
+      counts_fn(state, k, done, r) -> counts [.., V, lanes] int32
+      coin_fn(r) -> per-lane coin matrix (rnd.needs_coin engines)
 
-    done0 = jnp.zeros((S, n), dtype=bool)
-    decided_round0 = jnp.full((S, n), -1, dtype=jnp.int32)
+    Shared by run_hist (single-device fused exchange) and
+    parallel.mesh.run_hist_proc_sharded (receiver-sharded count blocks), so
+    a semantics fix here propagates to every engine; `n` is the GLOBAL
+    group size (quorum thresholds), which may exceed the local lane axis."""
+    lanes_like = decided_fn(state0)
+    done0 = jnp.zeros(lanes_like.shape, dtype=bool)
+    decided_round0 = jnp.full(lanes_like.shape, -1, dtype=jnp.int32)
 
     def step(carry, r):
         state, done, decided_round = carry
-        colmask, side_r, p8, salt0, salt1r = round_params(mix, r)
-        coin = (
-            fused.hash_coin(
-                mix.salt0[:, None], mix.salt1[:, None], r,
-                jnp.arange(n, dtype=jnp.int32)[None, :],
-            )
-            if rnd.needs_coin
-            else None
-        )
+        coin = coin_fn(r) if coin_fn is not None else None
 
         def subround(k, state):
-            counts = fused.hist_exchange(
-                rnd.payload(state, k),
-                ~done,
-                colmask,
-                None,  # rowmask: broadcast rounds select every receiver
-                side_r,
-                salt0,
-                salt1r,
-                p8,
-                V,
-                mode=mode,
-                sb=sb,
-                interpret=interpret,
-                dot=dot,
-            ).astype(jnp.int32)
+            counts = counts_fn(state, k, done, r)
             size = jnp.sum(counts, axis=1)
             return rnd.update_counts(state, counts, size, r, n, k=k, coin=coin)
 
@@ -366,6 +348,61 @@ def run_hist(
         jnp.arange(max_rounds, dtype=jnp.int32),
     )
     return state, done, decided_round
+
+
+def hash_coin_fn(mix: FaultMix, lane_ids: jnp.ndarray) -> Callable:
+    """coin_fn for hist_scan: the deterministic per-(scenario, lane, round)
+    hash coin at the given GLOBAL lane ids (sliceable for sharded lanes)."""
+    def coin(r):
+        return fused.hash_coin(
+            mix.salt0[:, None], mix.salt1[:, None], r, lane_ids[None, :]
+        )
+    return coin
+
+
+def run_hist(
+    rnd: HistRound,
+    state0,
+    decided_fn: Callable,
+    mix: FaultMix,
+    max_rounds: int,
+    mode: str = "hw",
+    sb: int = 8,
+    interpret: bool = False,
+    dot: str = "bf16",
+):
+    """Scan `max_rounds` fused rounds over the full scenario batch.
+
+    state0 leaves are [S, n, ...].  Returns (state, done [S, n],
+    decided_round [S, n]).  Semantics mirror executor.run_phases: exited
+    lanes stop sending and freeze."""
+    S, n = mix.crashed.shape
+    V = rnd.num_values
+
+    def counts_fn(state, k, done, r):
+        colmask, side_r, p8, salt0, salt1r = round_params(mix, r)
+        return fused.hist_exchange(
+            rnd.payload(state, k),
+            ~done,
+            colmask,
+            None,  # rowmask: broadcast rounds select every receiver
+            side_r,
+            salt0,
+            salt1r,
+            p8,
+            V,
+            mode=mode,
+            sb=sb,
+            interpret=interpret,
+            dot=dot,
+        ).astype(jnp.int32)
+
+    coin_fn = (
+        hash_coin_fn(mix, jnp.arange(n, dtype=jnp.int32))
+        if rnd.needs_coin else None
+    )
+    return hist_scan(rnd, state0, decided_fn, max_rounds, n, counts_fn,
+                     coin_fn)
 
 
 def run_otr_loop(

@@ -302,9 +302,14 @@ class HostRunner:
         st0, payload0, _dm = fns[0](rr0, sid0, seed0, state)
         payload_np = jax.tree_util.tree_map(np.asarray, payload0)
         mbox = self._mailbox({}, payload_np)
-        fns[1](rr0, sid0, seed0, state, mbox.values, mbox.mask)
+        # warm f_update/f_go on the POST-send state st0 — that is the state
+        # the real loop passes them; a pre() that changes a leaf's
+        # dtype/weak-type would otherwise make this exemplar signature one
+        # that never recurs, and the first real call would race into
+        # duplicate compiles outside the lock after all
+        fns[1](rr0, sid0, seed0, st0, mbox.values, mbox.mask)
         if f_go is not None:
-            f_go(rr0, sid0, seed0, state, mbox.values, mbox.mask)
+            f_go(rr0, sid0, seed0, st0, mbox.values, mbox.mask)
         jax.block_until_ready(st0)
         rnd._host_jit = (n, *fns)
         return fns

@@ -105,3 +105,63 @@ def test_epsilon_rung_sharded_bit_parity():
     # all-lanes gate, not the (display-rounded) fraction
     assert extra["engine"] == "eps_fused"
     assert extra["parity_exact"] is True
+
+
+@pytest.mark.parametrize("proc_shards", [2, 4, 8])
+def test_hist_proc_sharded_bit_parity_otr(proc_shards):
+    """The FAST histogram path with the PROCESS axis sharded
+    (parallel/mesh.py run_hist_proc_sharded): per-device count blocks from
+    regenerated mask slices + O(n) ICI gathers must be bit-identical to
+    fast.run_hist(mode="hash") on the same mix."""
+    from round_tpu.engine import fast
+    from round_tpu.models.otr import OtrState
+    from round_tpu.parallel.mesh import run_hist_proc_sharded
+
+    n, S, rounds, V = 16, 8, 6, 4
+    key = jax.random.PRNGKey(3)
+    mix = fast.standard_mix(key, S, n, p_drop=0.25)
+    init = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0, V,
+                              dtype=jnp.int32)
+    rnd = fast.OtrHist(n_values=V, after_decision=2)
+    state0 = OtrState.fresh(init, S, n)
+
+    ref = fast.run_hist(rnd, state0, lambda s: s.decided, mix,
+                        max_rounds=rounds, mode="hash", interpret=True)
+    mesh = make_mesh(8, proc_shards=proc_shards)
+    got = run_hist_proc_sharded(rnd, state0, mix, rounds, mesh)
+
+    for a, b in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(ref)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert bool(np.asarray(got[0].decided).any())
+
+
+def test_hist_proc_sharded_bit_parity_benor():
+    """BenOr on the proc-sharded fast path: two subrounds per phase + the
+    deterministic hash coin at GLOBAL lane indices."""
+    from round_tpu.engine import fast
+    from round_tpu.models.benor import BenOrState
+    from round_tpu.parallel.mesh import run_hist_proc_sharded
+
+    n, S, rounds = 16, 8, 10
+    key = jax.random.PRNGKey(5)
+    mix = fast.standard_mix(key, S, n, p_drop=0.15)
+    init = jax.random.bernoulli(jax.random.fold_in(key, 1), 0.5, (n,))
+    rnd = fast.BenOrHist()
+    state0 = BenOrState(
+        x=jnp.broadcast_to(init, (S, n)),
+        vote=jnp.full((S, n), -1, jnp.int32),
+        can_decide=jnp.zeros((S, n), bool),
+        decided=jnp.zeros((S, n), bool),
+        decision=jnp.zeros((S, n), bool),
+    )
+
+    ref = fast.run_hist(rnd, state0, lambda s: s.decided, mix,
+                        max_rounds=rounds, mode="hash", interpret=True)
+    mesh = make_mesh(8, proc_shards=4)
+    got = run_hist_proc_sharded(rnd, state0, mix, rounds, mesh)
+
+    for a, b in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(ref)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert bool(np.asarray(got[0].decided).any())
