@@ -74,6 +74,23 @@ def run(name, cmd, timeout):
         return False, out or ""
 
 
+def _persist_window_artifact(step, out):
+    """A measured number from a brief tunnel window must survive even if
+    the tunnel is dead again when the end-of-round bench runs: append the
+    JSON lines to BENCH_WINDOW.jsonl (committed with the repo)."""
+    try:
+        with open(os.path.join(REPO, "BENCH_WINDOW.jsonl"), "a") as f:
+            for ln in out.strip().splitlines():
+                if ln.startswith("{") and ln.endswith("}"):
+                    rec = json.loads(ln)
+                    rec["window_step"] = step
+                    rec["ts"] = round(time.time(), 1)
+                    f.write(json.dumps(rec) + "\n")
+    except (OSError, ValueError) as e:
+        log({"step": f"{step}-persist", "ok": False, "wall_s": 0.0,
+             "out": "", "err": str(e)})
+
+
 def attempt_window():
     """The tunnel just answered a probe: escalate.  Returns True when the
     full flagship was recorded."""
@@ -92,6 +109,7 @@ def attempt_window():
                                "--repeats", "3", "--probe-timeout", "120",
                                "--watchdog", "1500"], 1500 + 120 + 120)
     if ok and '"error"' not in out.splitlines()[-1]:
+        _persist_window_artifact("flagship", out)
         return True
     # scaled-down fallbacks: an honest smaller number beats nothing
     for n, s, wd in ((512, 2500, 700), (256, 1000, 500)):
@@ -101,6 +119,7 @@ def attempt_window():
             "--probe-timeout", "120", "--watchdog", str(wd)],
             wd + 120 + 120)
         if ok and '"error"' not in out.splitlines()[-1]:
+            _persist_window_artifact(f"flagship_n{n}", out)
             return False  # got a partial number; keep watching for a full one
     return False
 
