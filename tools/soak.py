@@ -1,0 +1,182 @@
+"""Randomized differential soak: every engine family against the general
+engine across random shapes, until stopped or a divergence is found.
+
+Each iteration draws a random configuration (n, S, V, rounds, fault mix),
+then checks, with EXACT equality (int/bool protocols; ε uses the pinned
+tree_sum discipline so it is bit-exact too):
+
+  * per-round fused engine (run_hist, hash mode) vs the general engine
+    (run_instance over from_mix_row) on every scenario — decided/decision/x;
+  * whole-run loop kernels, v2 AND flat variants, vs run_hist — full state;
+  * the proc-sharded fast path (when >1 device) vs run_hist — full state;
+  * fused ε-agreement (epsfast) vs the general engine — every state leaf.
+
+One JSON line per iteration to SOAK.jsonl; a mismatch writes the full
+repro (seed, config) and exits nonzero.  Run under nice in the background:
+
+    nice -n 19 python tools/soak.py --minutes 120
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from round_tpu.engine import fast, scenarios  # noqa: E402
+from round_tpu.engine.executor import run_instance  # noqa: E402
+from round_tpu.models.common import consensus_io  # noqa: E402
+from round_tpu.models.otr import OTR, OtrState  # noqa: E402
+
+OUT = os.path.join(REPO, "SOAK.jsonl")
+
+
+def log(rec):
+    rec["ts"] = round(time.time(), 1)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def leaves_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        x, y = np.asarray(x), np.asarray(y)
+        if x.shape != y.shape or not (x.view(np.uint8) == y.view(np.uint8)).all():
+            return False
+    return True
+
+
+def check_otr_family(rng, it):
+    n = int(rng.choice([8, 16, 24, 32, 48]))
+    S = int(rng.choice([4, 8]))
+    V = int(rng.choice([2, 3, 4, 8]))
+    rounds = int(rng.integers(4, 12))
+    p_drop = float(rng.choice([0.0, 0.1, 0.25, 0.4]))
+    key = jax.random.PRNGKey(int(rng.integers(0, 2**31)))
+    mix = fast.standard_mix(key, S, n, p_drop=p_drop)
+    init = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0, V,
+                              dtype=jnp.int32)
+    rnd = fast.OtrHist(n_values=V, after_decision=2)
+    state0 = OtrState.fresh(init, S, n)
+    cfg = dict(kind="otr", n=n, S=S, V=V, rounds=rounds, p_drop=p_drop,
+               it=it)
+
+    ref = fast.run_hist(rnd, state0, lambda s: s.decided, mix,
+                        max_rounds=rounds, mode="hash", interpret=True)
+
+    # general engine, every scenario
+    algo = OTR(after_decision=2, n_values=V)
+    for s in range(S):
+        res = run_instance(
+            algo, consensus_io(init), n, jax.random.fold_in(key, 99 + s),
+            scenarios.from_mix_row(mix, s), max_phases=rounds,
+        )
+        for field in ("x", "decided", "decision"):
+            a = np.asarray(getattr(ref[0], field)[s])
+            b = np.asarray(getattr(res.state, field))
+            if not (a == b).all():
+                return {**cfg, "fail": f"general vs hist: {field}",
+                        "scenario": s}
+
+    # loop kernels, both variants
+    for variant in ("v2", "flat"):
+        got = fast.run_otr_loop(rnd, state0, mix, max_rounds=rounds,
+                                mode="hash", interpret=True, variant=variant)
+        if not leaves_equal(got, ref):
+            return {**cfg, "fail": f"loop {variant} vs hist"}
+
+    # proc-sharded fast path (virtual devices; n must divide)
+    ndev = len(jax.devices())
+    if ndev > 1:
+        from round_tpu.parallel.mesh import make_mesh, run_hist_proc_sharded
+
+        for ps in (2, 4):
+            if ndev % ps == 0 and n % ps == 0 and S % (ndev // ps) == 0:
+                mesh = make_mesh(ndev, proc_shards=ps)
+                got = run_hist_proc_sharded(rnd, state0, mix, rounds, mesh)
+                if not leaves_equal(got, ref):
+                    return {**cfg, "fail": f"proc-sharded ps={ps} vs hist"}
+    return cfg
+
+
+def check_epsilon(rng, it):
+    from round_tpu.engine.epsfast import run_epsilon_fast
+    from round_tpu.models.epsilon import EpsilonConsensus
+
+    f = int(rng.choice([1, 2, 3]))
+    n = int(rng.choice([max(5 * f + 1, 8), 16, 24, 32]))
+    if n <= 5 * f:
+        n = 5 * f + 3
+    phases = int(rng.integers(6, 12))
+    fam = str(rng.choice(["silence", "omission", "crash"]))
+    sampler = {
+        "silence": scenarios.byzantine_silence(n, f),
+        "omission": scenarios.omission(n, 0.2),
+        "crash": scenarios.crash(n, f),
+    }[fam]
+    eps = float(rng.choice([0.25, 0.5, 1.0]))
+    algo = EpsilonConsensus(n, f=f, epsilon=eps)
+    key = jax.random.PRNGKey(int(rng.integers(0, 2**31)))
+    cfg = dict(kind="epsilon", n=n, f=f, phases=phases, fam=fam, eps=eps,
+               it=it)
+
+    def go(runner, k):
+        k_io, k_run = jax.random.split(k)
+        io = {"initial_value":
+              jax.random.uniform(k_io, (n,), jnp.float32) * 100.0}
+        return runner(algo, io, n, k_run, sampler, max_phases=phases)
+
+    ref = go(run_instance, key)
+    got = go(run_epsilon_fast, key)
+    for name in ("x", "max_r", "halted_vals", "halted_mask",
+                 "decided", "decision"):
+        a = np.asarray(getattr(ref.state, name))
+        b = np.asarray(getattr(got.state, name))
+        if a.shape != b.shape or not (
+                a.view(np.uint8) == b.view(np.uint8)).all():
+            return {**cfg, "fail": f"epsfast vs general: {name}"}
+    if not (np.asarray(ref.decided_round) == np.asarray(got.decided_round)).all():
+        return {**cfg, "fail": "epsfast vs general: decided_round"}
+    return cfg
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=60.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(args.seed)
+    t_end = time.monotonic() + args.minutes * 60
+    it = ok = 0
+    log({"step": "soak-start", "seed": args.seed, "minutes": args.minutes})
+    while time.monotonic() < t_end:
+        check = check_epsilon if it % 4 == 3 else check_otr_family
+        t0 = time.perf_counter()
+        rec = check(rng, it)
+        rec["wall_s"] = round(time.perf_counter() - t0, 1)
+        if "fail" in rec:
+            rec["step"] = "DIVERGENCE"
+            log(rec)
+            print(json.dumps(rec), flush=True)
+            return 1
+        ok += 1
+        it += 1
+        if it % 10 == 0:
+            log({"step": "soak-progress", "iterations": it, "ok": ok})
+    log({"step": "soak-done", "iterations": it, "ok": ok,
+         "divergences": 0})
+    print(json.dumps({"soak": "done", "iterations": it, "ok": ok}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
