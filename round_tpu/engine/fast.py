@@ -292,6 +292,43 @@ class BenOrHist(HistRound):
         return state, jnp.zeros_like(frozen)
 
 
+class KSetESHist(HistRound):
+    """Early-stopping k-set agreement on the fused path
+    (KSetEarlyStopping.scala:8-46, after Mostefaoui-Raynal; general-engine
+    model models/kset.py:KSetESRound — parity test-pinned).
+
+    The (est, canDecide) broadcast rides ONE histogram over a doubled
+    domain: code = est·2 + can.  The update decodes straight off the
+    counts: est folds to min{code >> 1 : counts[code] > 0} (the mailbox
+    masked_min), canDecide to an any-odd-code test plus the
+    fewer-than-k-dropouts trigger (last_nb - size < k)."""
+
+    def __init__(self, n_values: int, t: int, k: int):
+        self.num_values = 2 * n_values
+        self.t = t
+        self.k = k
+
+    def payload(self, state, k: int = 0):
+        return state.est * 2 + state.can_decide.astype(jnp.int32)
+
+    def update_counts(self, state, counts, size, r, n, k: int = 0, coin=None):
+        imax = jnp.iinfo(jnp.int32).max
+        codes = jnp.arange(self.num_values, dtype=jnp.int32)[None, :, None]
+        deciding = (r > self.t // self.k) | state.can_decide
+        est_m = jnp.min(
+            jnp.where(counts > 0, codes >> 1, imax), axis=1
+        ).astype(state.est.dtype)
+        can_rx = jnp.any((counts > 0) & (codes % 2 == 1), axis=1)
+        can = can_rx | (state.last_nb - size < self.k)
+        state = ghost_decide(state, deciding, state.est)
+        state = state.replace(
+            est=jnp.where(deciding, state.est, est_m),
+            can_decide=jnp.where(deciding, state.can_decide, can),
+            last_nb=jnp.where(deciding, state.last_nb, size),
+        )
+        return state, deciding
+
+
 def hist_scan(
     rnd: HistRound,
     state0,

@@ -576,3 +576,66 @@ def test_lv_loop_parity_vs_general_engine():
             )
     # the mixed faults must not all be trivial: some scenario decides
     assert bool(np.asarray(decided).any())
+
+
+def test_kset_early_stopping_hist_parity():
+    """KSetEarlyStopping on the fused path (fast.KSetESHist, doubled
+    histogram domain) is lane-exact against the general engine on crash
+    mixes — another model family off the per-receiver mailbox path.  Also
+    pins the proc-sharded twin on the same mix."""
+    from round_tpu.engine import scenarios
+    from round_tpu.engine.executor import run_instance
+    from round_tpu.models.kset import KSetEarlyStopping, KSetESState
+
+    n, S, V, t, kk, rounds = 16, 8, 8, 3, 2, 6
+    key = jax.random.PRNGKey(9)
+    mix = fast.fault_free(key, S, n)
+    crashed = jax.vmap(
+        lambda k: jax.random.permutation(k, jnp.arange(n)) < t
+    )(jax.random.split(jax.random.fold_in(key, 0xCC), S))
+    mix = mix.replace(crashed=crashed)
+
+    init = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0, V,
+                              dtype=jnp.int32)
+    rnd = fast.KSetESHist(n_values=V, t=t, k=kk)
+    state0 = KSetESState(
+        est=jnp.broadcast_to(init, (S, n)).astype(jnp.int32),
+        can_decide=jnp.zeros((S, n), bool),
+        last_nb=jnp.full((S, n), n, jnp.int32),
+        decided=jnp.zeros((S, n), bool),
+        decision=jnp.full((S, n), -1, jnp.int32),
+    )
+    state, done, dround = fast.run_hist(
+        rnd, state0, lambda s: s.decided, mix, max_rounds=rounds,
+        mode="hash", interpret=True,
+    )
+
+    algo = KSetEarlyStopping(t=t, k=kk)
+    for s in range(S):
+        res = run_instance(
+            algo, {"initial_value": init}, n, jax.random.fold_in(key, 99 + s),
+            scenarios.from_mix_row(mix, s), max_phases=rounds,
+        )
+        for field in ("est", "can_decide", "decided", "decision"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(state, field)[s]),
+                np.asarray(getattr(res.state, field)), err_msg=field)
+        np.testing.assert_array_equal(
+            np.asarray(dround[s]), np.asarray(res.decided_round))
+    assert bool(np.asarray(state.decided).all())
+
+    # k-set agreement: over the NON-crashed lanes, at most k distinct
+    # decisions per scenario (crashed lanes are silent, not bound)
+    dec = np.asarray(state.decision)
+    live = ~np.asarray(mix.crashed)
+    for s in range(S):
+        assert len(set(dec[s][live[s]].tolist())) <= kk
+
+    if len(jax.devices()) >= 8:
+        from round_tpu.parallel.mesh import make_mesh, run_hist_proc_sharded
+
+        mesh = make_mesh(8, proc_shards=4)
+        got = run_hist_proc_sharded(rnd, state0, mix, rounds, mesh)
+        for a, b in zip(jax.tree_util.tree_leaves(got),
+                        jax.tree_util.tree_leaves((state, done, dround))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
