@@ -292,6 +292,88 @@ class BenOrHist(HistRound):
         return state, jnp.zeros_like(frozen)
 
 
+def mix_ho(mix: FaultMix, r) -> jnp.ndarray:
+    """[S, n(recv), n(send)] HO matrix for round r — the
+    scenarios.from_fault_params hash-mode formula vectorized over the
+    whole mix, for fused paths whose exchange is not histogram-shaped
+    (the bitset family).  Bit-identical to the per-scenario replay."""
+    S, n = mix.crashed.shape
+    colmask, side_r, p8, salt0, salt1r = round_params(mix, r)
+    i = jnp.arange(n, dtype=jnp.uint32)
+    idx = i[:, None] * jnp.uint32(n) + i[None, :]        # [recv j, send i]
+    z = idx[None] * jnp.uint32(0x9E3779B9) \
+        + salt0.astype(jnp.uint32)[:, None, None]
+    z = z ^ salt1r.astype(jnp.uint32)[:, None, None]
+    keep = (fused._fmix32(z) & jnp.uint32(0xFF)) \
+        >= p8.astype(jnp.uint32)[:, None, None]
+    keep = keep | (p8 <= 0)[:, None, None]
+    ho = (colmask[:, None, :]
+          & (side_r[:, :, None] == side_r[:, None, :]) & keep)
+    return ho | jnp.eye(n, dtype=bool)[None]
+
+
+class LatticeHist(HistRound):
+    """Lattice agreement on the fused path (models/lattice.py semantics,
+    LatticeAgreement.scala:32-67): the [m]-bit set payload rides bit-plane
+    matmuls instead of per-receiver mailbox folds.
+
+    counts layout ([S, m+1, n]): plane 0 = #heard senders whose proposal
+    EQUALS the receiver's (equality via a Hamming-distance matmul pair,
+    M = P·(1-P)ᵀ + (1-P)·Pᵀ, eq ⇔ M = 0); planes 1..m = per-bit heard
+    counts, whose >0 test is the join (union = OR across heard sets)."""
+
+    def __init__(self, m: int):
+        self.num_values = m + 1
+        self.m = m
+
+    def payload(self, state, k: int = 0):
+        return state.proposed                              # [S, n, m] bool
+
+    def update_counts(self, state, counts, size, r, n, k: int = 0, coin=None):
+        same = counts[:, 0, :]                             # [S, n]
+        or_any = counts[:, 1:, :] > 0                      # [S, m, n]
+        joined = state.proposed | jnp.moveaxis(or_any, 1, 2)
+        deciding = state.active & (same > n // 2)
+        newly = deciding & ~state.decided
+        grow = state.active & ~deciding
+        state = state.replace(
+            active=grow,
+            proposed=jnp.where(grow[..., None], joined, state.proposed),
+            decided=state.decided | deciding,
+            decision=jnp.where(newly[..., None], state.proposed,
+                               state.decision),
+        )
+        return state, deciding
+
+
+def run_lattice_fast(
+    state0,
+    mix: FaultMix,
+    max_rounds: int,
+):
+    """Lattice agreement over the fused bitset exchange: three [n, m]-class
+    matmuls per scenario-round (two Hamming halves + the OR-count pass),
+    through the shared hist_scan scaffolding.  Lane-exact vs the general
+    engine (tests/test_fast.py)."""
+    S, n = mix.crashed.shape
+    m = state0.proposed.shape[-1]
+    rnd = LatticeHist(m)
+
+    def counts_fn(state, k, done, r):
+        deliver = mix_ho(mix, r) & (~done)[:, None, :]    # [S, j, i]
+        P = state.proposed.astype(jnp.int32)              # [S, n, m]
+        Pn = 1 - P
+        ham = (jnp.einsum("sjb,sib->sji", P, Pn)
+               + jnp.einsum("sjb,sib->sji", Pn, P))
+        eq = ham == 0
+        same = jnp.sum((deliver & eq).astype(jnp.int32), axis=2)
+        orc = jnp.einsum("sji,sib->sbj", deliver.astype(jnp.int32), P)
+        return jnp.concatenate([same[:, None, :], orc], axis=1)
+
+    return hist_scan(rnd, state0, lambda s: s.decided, max_rounds, n,
+                     counts_fn)
+
+
 class KSetESHist(HistRound):
     """Early-stopping k-set agreement on the fused path
     (KSetEarlyStopping.scala:8-46, after Mostefaoui-Raynal; general-engine

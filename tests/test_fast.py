@@ -639,3 +639,52 @@ def test_kset_early_stopping_hist_parity():
         for a, b in zip(jax.tree_util.tree_leaves(got),
                         jax.tree_util.tree_leaves((state, done, dround))):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_lattice_fast_parity_and_chain():
+    """Lattice agreement on the fused bitset exchange (fast.run_lattice_fast)
+    is lane-exact against the general engine on mixed-fault mixes, and the
+    decided sets form a chain under subset-inclusion (the lattice-agreement
+    safety property)."""
+    from round_tpu.engine import scenarios
+    from round_tpu.engine.executor import run_instance
+    from round_tpu.models.lattice import LatticeAgreement, LatticeState, lattice_io
+
+    n, S, m, rounds = 12, 6, 10, 8
+    key = jax.random.PRNGKey(21)
+    mix = fast.standard_mix(key, S, n, p_drop=0.2)
+    sets = [[i % m, (3 * i + 1) % m] for i in range(n)]
+    io = lattice_io(sets, m)
+    init = jnp.asarray(io["initial_value"], bool)
+
+    state0 = LatticeState(
+        active=jnp.ones((S, n), bool),
+        proposed=jnp.broadcast_to(init, (S, n, m)),
+        decided=jnp.zeros((S, n), bool),
+        decision=jnp.zeros((S, n, m), bool),
+    )
+    state, done, dround = fast.run_lattice_fast(state0, mix, rounds)
+
+    algo = LatticeAgreement(universe=m)
+    for s in range(S):
+        res = run_instance(
+            algo, io, n, jax.random.fold_in(key, 99 + s),
+            scenarios.from_mix_row(mix, s), max_phases=rounds,
+        )
+        for field in ("active", "proposed", "decided", "decision"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(state, field)[s]),
+                np.asarray(getattr(res.state, field)), err_msg=field)
+        np.testing.assert_array_equal(
+            np.asarray(dround[s]), np.asarray(res.decided_round))
+
+    # chain property over decided lanes: decisions pairwise ⊆-comparable
+    dec = np.asarray(state.decision)
+    got = np.asarray(state.decided)
+    assert got.any()
+    for s in range(S):
+        ds = dec[s][got[s]]
+        for a in range(len(ds)):
+            for b in range(a + 1, len(ds)):
+                sub = (~ds[a] | ds[b]).all() or (~ds[b] | ds[a]).all()
+                assert sub, (s, a, b)

@@ -26,13 +26,18 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 # the proc-sharded checks need a virtual device mesh; this must be set
-# BEFORE jax initializes its backend (replace any inherited smaller value)
+# BEFORE jax initializes its backend.  An inherited count wins when it is
+# at least 8 (an operator asking for a wider mesh keeps it); anything
+# smaller is raised to 8.
 import re as _re
 
+_m = _re.search(r"--xla_force_host_platform_device_count=(\d+)",
+                os.environ.get("XLA_FLAGS", ""))
+_count = max(8, int(_m.group(1)) if _m else 0)
 _flags = _re.sub(r"--xla_force_host_platform_device_count=\d+", "",
                  os.environ.get("XLA_FLAGS", ""))
 os.environ["XLA_FLAGS"] = (
-    _flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags + f" --xla_force_host_platform_device_count={_count}").strip()
 
 import jax  # noqa: E402
 
