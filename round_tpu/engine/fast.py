@@ -292,24 +292,113 @@ class BenOrHist(HistRound):
         return state, jnp.zeros_like(frozen)
 
 
-def mix_ho(mix: FaultMix, r) -> jnp.ndarray:
-    """[S, n(recv), n(send)] HO matrix for round r — the
-    scenarios.from_fault_params hash-mode formula vectorized over the
-    whole mix, for fused paths whose exchange is not histogram-shaped
-    (the bitset family).  Bit-identical to the per-scenario replay."""
+class TpcHist(HistRound):
+    """Two-Phase Commit on the fused path (models/tpc.py semantics,
+    TwoPhaseCommit.scala:16-81): one 3-subround phase over a V=2
+    histogram.  The guarded sends become per-subround column masks
+    (prepare/commit: only the coordinator's column transmits); the vote
+    round's coordinator-only delivery needs no row mask — non-coordinator
+    receivers compute a discarded value, exactly as their general-engine
+    mailboxes are empty.
+
+      k=0 prepare: no state change.
+      k=1 vote:    coord decides commit iff all n votes heard and yes
+                   (size == n and yes-count == size).
+      k=2 commit:  receivers adopt the (present) decision and decide;
+                   an empty mailbox decides None = -1 (coord suspected)."""
+
+    num_values = 2
+    phase_len = 3
+
+    def payload(self, state, k: int = 0):
+        from round_tpu.models.tpc import DEC_COMMIT
+
+        if k == 1:
+            return state.vote.astype(jnp.int32)
+        if k == 2:
+            return (state.decision == DEC_COMMIT).astype(jnp.int32)
+        return jnp.zeros_like(state.decision)
+
+    def update_counts(self, state, counts, size, r, n, k: int = 0, coin=None):
+        from round_tpu.models.tpc import DEC_ABORT, DEC_COMMIT
+
+        no_exit = jnp.zeros(size.shape, dtype=bool)
+        if k == 0:
+            return state, no_exit
+        if k == 1:
+            is_coord = (jnp.arange(size.shape[1],
+                                   dtype=state.coord.dtype)[None, :]
+                        == state.coord)
+            yes = counts[:, 1, :]
+            all_yes = (size == n) & (yes == size)
+            dec = jnp.where(all_yes, DEC_COMMIT, DEC_ABORT).astype(jnp.int32)
+            return state.replace(
+                decision=jnp.where(is_coord, dec, state.decision)
+            ), no_exit
+        got = size > 0
+        v = jnp.where(counts[:, 1, :] > 0, DEC_COMMIT,
+                      DEC_ABORT).astype(jnp.int32)
+        state = state.replace(
+            decision=jnp.where(got, v, state.decision),
+            decided=jnp.ones_like(state.decided),
+        )
+        return state, jnp.ones(size.shape, dtype=bool)
+
+
+def run_tpc_fast(state0, mix: FaultMix, max_rounds: int = 3,
+                 mode: str = "hash", sb: int = 8, interpret: bool = False):
+    """TPC through the fused exchange: hist_scan with a per-subround
+    column mask (the coordinator's guarded broadcasts).  Lane-exact vs the
+    general engine on mixed-fault mixes (tests/test_fast.py), including
+    the coordinator-crash suspect path (decision None = -1)."""
     S, n = mix.crashed.shape
+    rnd = TpcHist()
+    coord_col = state0.coord[:, :1]                        # [S, 1] uniform
+
+    def counts_fn(state, k, done, r):
+        if k == 0:
+            # prepare consumes nothing (TwoPhaseCommit.scala:42-44): skip
+            # the exchange kernel entirely
+            return jnp.zeros((S, rnd.num_values, n), jnp.int32)
+        colmask, side_r, p8, salt0, salt1r = round_params(mix, r)
+        is_coord_col = (
+            jnp.arange(n, dtype=coord_col.dtype)[None, :] == coord_col)
+        if k == 2:
+            # guarded broadcast: only the coordinator's column sends
+            colmask = colmask & is_coord_col
+        counts = fused.hist_exchange(
+            rnd.payload(state, k), ~done, colmask, None, side_r,
+            salt0, salt1r, p8, rnd.num_values,
+            mode=mode, sb=sb, interpret=interpret,
+        ).astype(jnp.int32)
+        if k == 2:
+            # the exchange kernels hard-wire self-delivery (the eye term of
+            # the broadcast HO formula) even through colmask; a GUARDED
+            # send must not self-deliver on excluded lanes — subtract the
+            # own-payload count there, or a non-coordinator receiver with
+            # an otherwise-empty mailbox would hear itself and miss the
+            # coordinator-suspect path (decision None)
+            own = rnd.payload(state, k)
+            excl = (~done) & ~is_coord_col
+            onehot_own = (
+                own[:, None, :]
+                == jnp.arange(rnd.num_values, dtype=own.dtype)[None, :, None]
+            ) & excl[:, None, :]
+            counts = counts - onehot_own.astype(jnp.int32)
+        return counts
+
+    return hist_scan(rnd, state0, lambda s: s.decided, max_rounds, n,
+                     counts_fn)
+
+
+def mix_ho(mix: FaultMix, r) -> jnp.ndarray:
+    """[S, n(recv), n(send)] HO matrix for round r — the hash-mode link
+    formula (ops.fused.ho_link_mask, the one shared implementation)
+    vectorized over the whole mix, for fused paths whose exchange is not
+    histogram-shaped (the bitset family).  Bit-identical to the
+    per-scenario replay (scenarios.from_fault_params)."""
     colmask, side_r, p8, salt0, salt1r = round_params(mix, r)
-    i = jnp.arange(n, dtype=jnp.uint32)
-    idx = i[:, None] * jnp.uint32(n) + i[None, :]        # [recv j, send i]
-    z = idx[None] * jnp.uint32(0x9E3779B9) \
-        + salt0.astype(jnp.uint32)[:, None, None]
-    z = z ^ salt1r.astype(jnp.uint32)[:, None, None]
-    keep = (fused._fmix32(z) & jnp.uint32(0xFF)) \
-        >= p8.astype(jnp.uint32)[:, None, None]
-    keep = keep | (p8 <= 0)[:, None, None]
-    ho = (colmask[:, None, :]
-          & (side_r[:, :, None] == side_r[:, None, :]) & keep)
-    return ho | jnp.eye(n, dtype=bool)[None]
+    return fused.ho_link_mask(colmask, side_r, salt0, salt1r, p8)
 
 
 class LatticeHist(HistRound):

@@ -218,6 +218,8 @@ def from_fault_params(
     side = jnp.asarray(side, dtype=jnp.int32)
 
     def sample(key, r):  # key unused: the salts carry the randomness
+        from round_tpu.ops.fused import ho_link_mask  # local: no cycle
+
         r = jnp.asarray(r, dtype=jnp.int32)
         alive = ~(crashed & (r >= crash_round))
         period = jnp.maximum(rotate_down, 1)
@@ -225,14 +227,8 @@ def from_fault_params(
         rotated = (jnp.arange(n) == victim) & (rotate_down > 0)
         colmask = alive & ~rotated
         side_r = jnp.where(r < heal_round, side, 0)
-        i = jnp.arange(n, dtype=jnp.uint32)
-        idx = i[:, None] * jnp.uint32(n) + i[None, :]  # [recv j, sender i]
-        z = idx * jnp.uint32(0x9E3779B9) + jnp.asarray(salt0).astype(jnp.uint32)
-        z = z ^ (r * jnp.int32(0x7FEB352D) + jnp.asarray(salt1)).astype(jnp.uint32)
-        keep = (_mix32(z) & jnp.uint32(0xFF)) >= jnp.asarray(p8).astype(jnp.uint32)
-        keep = keep | (jnp.asarray(p8) <= 0)
-        ho = colmask[None, :] & (side_r[:, None] == side_r[None, :]) & keep
-        return _with_self(ho)
+        salt1r = r * jnp.int32(0x7FEB352D) + jnp.asarray(salt1)
+        return ho_link_mask(colmask, side_r, salt0, salt1r, p8)
 
     return sample
 

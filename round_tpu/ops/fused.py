@@ -857,6 +857,35 @@ def otr_loop(
     return (x, dec.astype(bool), decision, after, done, dround)
 
 
+def ho_link_mask(colmask, side, salt0, salt1r, p8) -> jnp.ndarray:
+    """[.., n(recv), n(send)] hash-mode HO matrix:
+
+        ho[j, i] = (colmask[i] ∧ side[j] = side[i] ∧ keep(j, i)) ∨ (i = j)
+
+    THE one dense implementation of the link-mask formula — the oracle
+    (hist_exchange_reference), the whole-mix form (engine.fast.mix_ho) and
+    the per-scenario replay (scenarios.from_fault_params) all call it, so
+    the hash stream cannot drift between them.  (_lv_keep stays separate:
+    the LV kernel computes single rows/columns, not the dense matrix.)
+    Leading batch dims broadcast; salts/p8 may be scalars or [..]."""
+    colmask = jnp.asarray(colmask)
+    n = colmask.shape[-1]
+    i = jnp.arange(n, dtype=jnp.uint32)
+    idx = i[:, None] * jnp.uint32(n) + i[None, :]      # [recv j, sender i]
+    s0 = jnp.asarray(salt0).astype(jnp.uint32)[..., None, None]
+    s1 = jnp.asarray(salt1r).astype(jnp.uint32)[..., None, None]
+    p8 = jnp.asarray(p8)
+    z = idx * jnp.uint32(_GOLD) + s0
+    z = z ^ s1
+    keep = (_fmix32(z) & jnp.uint32(0xFF)) \
+        >= p8.astype(jnp.uint32)[..., None, None]
+    keep = keep | (p8 <= 0)[..., None, None]
+    side = jnp.asarray(side)
+    ho = ((colmask != 0)[..., None, :]
+          & (side[..., :, None] == side[..., None, :]) & keep)
+    return ho | jnp.eye(n, dtype=bool)
+
+
 def hist_exchange_reference(
     vals, active, colmask, rowmask, side, salt0, salt1r, p8, num_values
 ) -> jnp.ndarray:
@@ -865,17 +894,7 @@ def hist_exchange_reference(
     S, n = vals.shape
 
     def one(v, act, cm, rm, sd, s0, s1, p):
-        i = jnp.arange(n, dtype=jnp.uint32)
-        idx = i[:, None] * jnp.uint32(n) + i[None, :]  # [recv j, sender i]
-        z = idx * jnp.uint32(_GOLD) + s0.astype(jnp.uint32)
-        z = z ^ s1.astype(jnp.uint32)
-        from round_tpu.engine.scenarios import _mix32
-
-        keep = (_mix32(z) & jnp.uint32(0xFF)) >= p.astype(jnp.uint32)
-        keep = keep | (p <= 0)
-        side_eq = sd[None, :] == sd[:, None]  # [j, i]
-        ho = (cm != 0)[None, :] & side_eq & keep
-        ho = ho | jnp.eye(n, dtype=bool)
+        ho = ho_link_mask(cm, sd, s0, s1, p)
         deliver = ho & (act != 0)[None, :] & (rm != 0)[:, None]
         onehot = v[:, None] == jnp.arange(num_values, dtype=v.dtype)[None, :]
         counts = jnp.dot(

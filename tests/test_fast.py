@@ -688,3 +688,53 @@ def test_lattice_fast_parity_and_chain():
             for b in range(a + 1, len(ds)):
                 sub = (~ds[a] | ds[b]).all() or (~ds[b] | ds[a]).all()
                 assert sub, (s, a, b)
+
+
+def test_tpc_fast_parity_including_suspect_path():
+    """TPC on the fused path (fast.run_tpc_fast, guarded sends as column
+    masks) is lane-exact against the general engine across mixed faults —
+    including coordinator-crash scenarios where receivers decide the
+    suspect value None (-1)."""
+    from round_tpu.engine import scenarios
+    from round_tpu.engine.executor import run_instance
+    from round_tpu.models.tpc import TwoPhaseCommit, TpcState, tpc_io
+
+    n, S, rounds = 12, 10, 3
+    key = jax.random.PRNGKey(31)
+    mix = fast.standard_mix(key, S, n, p_drop=0.25, f=3, crash_round=0)
+    votes = jax.random.bernoulli(jax.random.fold_in(key, 2), 0.8, (n,))
+    coord = 0
+    io = tpc_io(coord, votes)
+
+    state0 = TpcState(
+        coord=jnp.full((S, n), coord, jnp.int32),
+        vote=jnp.broadcast_to(votes, (S, n)),
+        decision=jnp.full((S, n), -1, jnp.int32),
+        decided=jnp.zeros((S, n), bool),
+    )
+    state, done, dround = fast.run_tpc_fast(
+        state0, mix, max_rounds=rounds, mode="hash", interpret=True)
+
+    algo = TwoPhaseCommit()
+    seen_suspect = seen_commit_or_abort = False
+    for s in range(S):
+        res = run_instance(
+            algo, io, n, jax.random.fold_in(key, 99 + s),
+            scenarios.from_mix_row(mix, s), max_phases=1,
+        )
+        for field in ("vote", "decision", "decided"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(state, field)[s]),
+                np.asarray(getattr(res.state, field)), err_msg=field)
+        np.testing.assert_array_equal(
+            np.asarray(dround[s]), np.asarray(res.decided_round))
+        d = np.asarray(res.state.decision)
+        live = ~np.asarray(mix.crashed[s])
+        seen_suspect |= bool((d[live] == -1).any())
+        seen_commit_or_abort |= bool((d[live] >= 0).any())
+        # 2PC safety on live lanes: no commit/abort disagreement (suspects
+        # aside, present decisions are the coordinator's one decision)
+        present = d[live][d[live] >= 0]
+        assert len(set(present.tolist())) <= 1, s
+    assert seen_commit_or_abort  # non-vacuity: some scenario concluded
+    assert seen_suspect          # and some live lane suspected the coord

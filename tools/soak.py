@@ -186,6 +186,11 @@ def main():
         log(rec)
         ok += 1
         it += 1
+        if it % 20 == 0:
+            # every random shape compiles fresh executables; an unbounded
+            # jit cache ran the process out of memory after ~100 configs
+            # (LLVM 'Cannot allocate memory')
+            jax.clear_caches()
     log({"step": "soak-done", "iterations": it, "ok": ok,
          "divergences": 0})
     print(json.dumps({"soak": "done", "iterations": it, "ok": ok}))
