@@ -2268,3 +2268,98 @@ def lv_verifier_spec() -> ProtocolSpec:
         round_staged_inductiveness=list(vcs4),
         round_staged_init=lv["stage0_at"](r),
     )
+
+
+# ---------------------------------------------------------------------------
+# FloodMin (example/FloodMin.scala) — extracted-TR lemmas
+# ---------------------------------------------------------------------------
+
+def floodmin_extracted_tr(f: int = 2):
+    """FloodMin's transition relation extracted from the EXECUTABLE round
+    (models/floodmin.py FloodMinRound.update: fold_min + decide after
+    round f) via the jaxpr abstract interpreter.  The reference has no
+    FloodMin logic suite at all — these lemmas have no upstream analogue.
+
+    Returns (sig, j, r, update_eqs, site_axioms, payload_def)."""
+    import jax.numpy as jnp
+
+    from round_tpu.ops.mailbox import Mailbox as RtMailbox
+    from round_tpu.verify.extract import Scalar, Vec, extract_lane_fn
+    from round_tpu.verify.formula import IN
+
+    sig = StateSig({"x": Int, "decided": Bool, "dec": Int})
+    j = Variable("fmj", procType)
+    r = Variable("fmr", Int)
+    snd = UnInterpretedFct("fmsnd", FunT([procType], Int))
+
+    def upd(n, rr, x, decided, dec, vals, mask):
+        # models/floodmin.py FloodMinRound.update, verbatim semantics
+        m = RtMailbox(vals, mask)
+        x2 = m.fold_min(x)
+        deciding = rr > f
+        decided2 = decided | deciding
+        dec2 = jnp.where(deciding & ~decided, x2, dec)
+        return x2, decided2, dec2
+
+    ne = 5
+    ex_args = [jnp.int32(ne), jnp.int32(0), jnp.int32(0), jnp.bool_(False),
+               jnp.int32(-1), jnp.zeros((ne,), jnp.int32),
+               jnp.zeros((ne,), bool)]
+    fargs = [
+        Scalar(N),
+        Scalar(r),
+        Scalar(sig.get("x", j)),
+        Scalar(sig.get("decided", j)),
+        Scalar(sig.get("dec", j)),
+        Vec(lambda i: Application(snd, [i]).with_type(Int)),
+        Vec(lambda i: Application(IN, [i, ho_of(j)]).with_type(Bool)),
+    ]
+    outs, axioms = extract_lane_fn(
+        upd, ex_args, fargs, lambda i: Literal(True), receiver=j,
+        return_axioms=True,
+    )
+    update_eqs = And(*[
+        Eq(sig.get_primed(name, j), out.f)
+        for name, out in zip(["x", "decided", "dec"], outs)
+    ])
+    i0 = Variable("fmi0", procType)
+    payload_def = ForAll([i0], Eq(Application(snd, [i0]).with_type(Int),
+                                  sig.get("x", i0)))
+    return sig, j, r, update_eqs, axioms, payload_def
+
+
+def floodmin_extracted_lemmas(f: int = 2):
+    """Provable consequences of the extracted FloodMin TR — the safety
+    skeleton of the f-crash min-flooding argument (FloodMin.scala:22-33):
+
+      lower-bound:  every estimate >= m stays >= m through the round
+                    (with validity init, decisions stay in the initial
+                    range — no value is invented);
+      monotone:     x'(j) <= x(j) (the fold includes the own estimate);
+      attainment:   the new estimate is SOME current estimate;
+      decide-pins:  a fresh round-(f+1) decision records exactly x'.
+
+    Returns (lemmas, meta): lemmas = [(name, hyp, concl, cfg)]."""
+    sig, j, r, update_eqs, axioms, payload_def = floodmin_extracted_tr(f)
+    tr = And(update_eqs, payload_def, *axioms)
+    mlb = Variable("fmlb", Int)
+    kq = Variable("fmk", procType)
+    cfg = ClConfig(venn_bound=2, inst_depth=2)
+
+    lemmas = [
+        ("lower-bound",
+         And(tr, ForAll([kq], Geq(sig.get("x", kq), mlb))),
+         Geq(sig.get_primed("x", j), mlb), cfg),
+        ("monotone",
+         tr, Leq(sig.get_primed("x", j), sig.get("x", j)), cfg),
+        ("attainment",
+         tr,
+         Exists([kq], Eq(sig.get_primed("x", j), sig.get("x", kq))), cfg),
+        ("decide-pins",
+         And(tr, Gt(r, IntLit(f)), Not(sig.get("decided", j))),
+         And(sig.get_primed("decided", j),
+             Eq(sig.get_primed("dec", j), sig.get_primed("x", j))), cfg),
+    ]
+    meta = dict(sig=sig, j=j, r=r, update_eqs=update_eqs, axioms=axioms,
+                payload_def=payload_def)
+    return lemmas, meta

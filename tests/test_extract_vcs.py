@@ -78,3 +78,31 @@ def test_extracted_negative_control_wrong_count():
         hyp, Eq(m["maxsite"], Card(m["C_pw"])),
         ClConfig(venn_bound=2, inst_depth=1), timeout_s=20,
     )
+
+
+def test_floodmin_extracted_lemmas():
+    """FloodMin's safety skeleton proved from the TR extracted from the
+    EXECUTABLE round (protocols.floodmin_extracted_lemmas) — the reference
+    has no FloodMin logic suite at all.  Controls: the monotonicity
+    converse and axiom-free attainment must NOT prove."""
+    from round_tpu.verify.formula import And, Eq, Exists, Geq, Variable, procType
+    from round_tpu.verify.protocols import floodmin_extracted_lemmas
+
+    lemmas, meta = floodmin_extracted_lemmas()
+    for name, hyp, concl, cfg in lemmas:
+        assert entailment(hyp, concl, cfg, timeout_s=120), name
+
+    sig, j = meta["sig"], meta["j"]
+    tr = And(meta["update_eqs"], meta["payload_def"], *meta["axioms"])
+    # converse of monotone: x' >= x must NOT follow (the fold can shrink x)
+    assert not entailment(
+        tr, Geq(sig.get_primed("x", j), sig.get("x", j)),
+        ClConfig(venn_bound=2, inst_depth=1), timeout_s=20,
+    )
+    # attainment must come FROM the extremum site axioms, not vacuity
+    kq = Variable("fmk2", procType)
+    assert not entailment(
+        And(meta["update_eqs"], meta["payload_def"]),
+        Exists([kq], Eq(sig.get_primed("x", j), sig.get("x", kq))),
+        ClConfig(venn_bound=2, inst_depth=1), timeout_s=20,
+    )
