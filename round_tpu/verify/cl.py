@@ -536,19 +536,23 @@ def _contains_binder(t: Formula) -> bool:
 
 
 def lift_quantified_ites(f: Formula) -> Formula:
-    """atom[Ite(c, t, e)] with a QUANTIFIER inside c →
+    """atom[Ite(c, t, e)] with a QUANTIFIER inside ANY of c/t/e →
     (c ∧ atom[t]) ∨ (¬c ∧ atom[e]).
 
     Term-level Ites with ground conditions are left for the solver's late
-    lifting (solver.lift_ite); a quantified condition must surface into
+    lifting (solver.lift_ite); a quantified operand must surface into
     boolean structure BEFORE nnf/skolemization/instantiation or QI never
-    sees it — the event-round extracted folds produce exactly this shape
-    (an AND-fold extracts as ∀ inside the decision Ite)."""
+    sees it.  Quantified CONDITIONS come from event-round extracted folds
+    (an AND-fold extracts as ∀ inside the decision Ite); quantified
+    BRANCHES from guarded boolean updates (KSetEarlyStopping's
+    canDecide' = Ite(deciding, can, ∃heard-can ∨ trigger) — without the
+    lift the ∃ stays buried in an opaque Bool-Eq atom and the
+    can-propagation lemma is unprovable)."""
     from round_tpu.verify.futils import replace as _replace
 
     def find_qite(t):
         if isinstance(t, Application):
-            if t.fct == ITE and _contains_binder(t.args[0]):
+            if t.fct == ITE and any(_contains_binder(a) for a in t.args):
                 return t
             for a in t.args:
                 r = find_qite(a)

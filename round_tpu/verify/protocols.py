@@ -17,7 +17,8 @@ from round_tpu.verify.cl import ClConfig
 from round_tpu.verify.formula import (
     And, Application, Binding, Bool, Card, Comprehension, Eq, Exists, FORALL,
     ForAll, FSet, Formula, FunT, Geq, Gt, Implies, In, Int, IntLit, Leq,
-    Literal, Lt, Not, OR, Or, Plus, Times, UnInterpretedFct, Variable,
+    Literal, Lt, Minus, Not, OR, Or, Plus, Times, UnInterpretedFct,
+    Variable,
     procType,
 )
 from round_tpu.verify.futils import get_conjuncts
@@ -2362,4 +2363,132 @@ def floodmin_extracted_lemmas(f: int = 2):
     ]
     meta = dict(sig=sig, j=j, r=r, update_eqs=update_eqs, axioms=axioms,
                 payload_def=payload_def)
+    return lemmas, meta
+
+
+# ---------------------------------------------------------------------------
+# KSetEarlyStopping (example/KSetEarlyStopping.scala) — extracted-TR lemmas
+# ---------------------------------------------------------------------------
+
+def kset_extracted_tr(t: int = 3, k: int = 2):
+    """KSetEarlyStopping's TR extracted from the EXECUTABLE round
+    (models/kset.py KSetESRound.update): est = masked min, canDecide =
+    heard-can ∨ fewer-than-k-dropouts, horizon r > t/k.  The est site
+    extracts as an extremum with bound/attainment axioms; |mailbox| as a
+    Cardinality comprehension over HO(j) — the dropout trigger is real
+    cardinality arithmetic.  No upstream logic-suite analogue.
+
+    Returns (sig, j, r, update_eqs, site_axioms, payload_defs)."""
+    import jax.numpy as jnp
+
+    from round_tpu.ops.mailbox import Mailbox as RtMailbox
+    from round_tpu.verify.extract import Scalar, Vec, extract_lane_fn
+    from round_tpu.verify.formula import IN
+
+    sig = StateSig({"est": Int, "can": Bool, "last_nb": Int,
+                    "decided": Bool, "dec": Int})
+    j = Variable("ksj", procType)
+    r = Variable("ksr", Int)
+    snde = UnInterpretedFct("kse", FunT([procType], Int))
+    sndc = UnInterpretedFct("ksc", FunT([procType], Bool))
+
+    def upd(n, rr, est, can, last_nb, decided, dec, v_est, v_can, mask):
+        # models/kset.py KSetESRound.update, verbatim semantics
+        m = RtMailbox({"est": v_est, "can": v_can}, mask)
+        curr_nb = m.size()
+        deciding = (rr > t // k) | can
+        est2 = m.masked_min(v_est)
+        can2 = m.exists(lambda mm: mm["can"]) | (last_nb - curr_nb < k)
+        decided2 = decided | deciding
+        dec2 = jnp.where(deciding & ~decided, est, dec)
+        return (jnp.where(deciding, est, est2),
+                jnp.where(deciding, can, can2),
+                jnp.where(deciding, last_nb, curr_nb), decided2, dec2)
+
+    ne = 5
+    ex_args = [jnp.int32(ne), jnp.int32(0), jnp.int32(0), jnp.bool_(False),
+               jnp.int32(ne), jnp.bool_(False), jnp.int32(-1),
+               jnp.zeros((ne,), jnp.int32), jnp.zeros((ne,), bool),
+               jnp.zeros((ne,), bool)]
+    fargs = [
+        Scalar(N), Scalar(r),
+        Scalar(sig.get("est", j)), Scalar(sig.get("can", j)),
+        Scalar(sig.get("last_nb", j)), Scalar(sig.get("decided", j)),
+        Scalar(sig.get("dec", j)),
+        Vec(lambda i: Application(snde, [i]).with_type(Int)),
+        Vec(lambda i: Application(sndc, [i]).with_type(Bool)),
+        Vec(lambda i: Application(IN, [i, ho_of(j)]).with_type(Bool)),
+    ]
+    outs, axioms = extract_lane_fn(
+        upd, ex_args, fargs, lambda i: Literal(True), receiver=j,
+        return_axioms=True,
+    )
+    update_eqs = And(*[
+        Eq(sig.get_primed(name, j), out.f)
+        for name, out in zip(["est", "can", "last_nb", "decided", "dec"],
+                             outs)
+    ])
+    i0 = Variable("ksi0", procType)
+    i1 = Variable("ksi1", procType)
+    payload_defs = And(
+        ForAll([i0], Eq(Application(snde, [i0]).with_type(Int),
+                        sig.get("est", i0))),
+        ForAll([i1], Eq(Application(sndc, [i1]).with_type(Bool),
+                        sig.get("can", i1))),
+    )
+    return sig, j, r, update_eqs, axioms, payload_defs
+
+
+def kset_extracted_lemmas(t: int = 3, k: int = 2):
+    """Provable consequences of the extracted KSetEarlyStopping TR
+    (KSetEarlyStopping.scala:8-46 semantics):
+
+      lower-bound:   estimates >= m stay >= m (validity skeleton; needs
+                     self-delivery and the int32-sentinel value bound,
+                     as OTR's mmor lemma does);
+      monotone:      est'(j) <= est(j) under self-delivery;
+      can-propagate: one heard canDecide infects the receiver;
+      dropout-trigger: last_nb - |HO(j)| < k flips canDecide — REAL
+                     cardinality arithmetic on the extracted |mailbox|
+                     comprehension;
+      decide-pins:   a fresh decision records exactly est(j).
+
+    Returns (lemmas, meta)."""
+    sig, j, r, update_eqs, axioms, payload_defs = kset_extracted_tr(t, k)
+    tr = And(update_eqs, payload_defs, *axioms)
+    not_deciding = And(Not(Gt(r, IntLit(t // k))), Not(sig.get("can", j)))
+    self_heard = In(j, ho_of(j))
+    mlb = Variable("kslb", Int)
+    kq = Variable("ksq", procType)
+    p0 = Variable("ksp0", procType)
+    imax = IntLit(2**31 - 1)
+    value_bound = ForAll([kq], Lt(sig.get("est", kq), imax))
+    cfg = ClConfig(venn_bound=2, inst_depth=2)
+
+    i2 = Variable("ksi2", procType)
+    ho_card = Card(Comprehension([i2], In(i2, ho_of(j))))
+
+    lemmas = [
+        ("lower-bound",
+         And(tr, self_heard, value_bound,
+             ForAll([kq], Geq(sig.get("est", kq), mlb))),
+         Geq(sig.get_primed("est", j), mlb), cfg),
+        ("monotone",
+         And(tr, self_heard),
+         Leq(sig.get_primed("est", j), sig.get("est", j)), cfg),
+        ("can-propagate",
+         And(tr, not_deciding, In(p0, ho_of(j)), sig.get("can", p0)),
+         sig.get_primed("can", j), cfg),
+        ("dropout-trigger",
+         And(tr, not_deciding,
+             Lt(Minus(sig.get("last_nb", j), ho_card), IntLit(k))),
+         sig.get_primed("can", j), cfg),
+        ("decide-pins",
+         And(tr, Gt(r, IntLit(t // k)), Not(sig.get("decided", j))),
+         And(sig.get_primed("decided", j),
+             Eq(sig.get_primed("dec", j), sig.get("est", j))), cfg),
+    ]
+    meta = dict(sig=sig, j=j, r=r, update_eqs=update_eqs, axioms=axioms,
+                payload_defs=payload_defs, not_deciding=not_deciding,
+                ho_card=ho_card, t=t, k=k)
     return lemmas, meta

@@ -106,3 +106,30 @@ def test_floodmin_extracted_lemmas():
         Exists([kq], Eq(sig.get_primed("x", j), sig.get("x", kq))),
         ClConfig(venn_bound=2, inst_depth=1), timeout_s=20,
     )
+
+
+def test_kset_extracted_lemmas():
+    """KSetEarlyStopping's safety skeleton proved from the extracted TR
+    (protocols.kset_extracted_lemmas): masked-min extremum site +
+    REAL cardinality arithmetic on the extracted |mailbox| comprehension
+    (the dropout trigger).  The can-propagation lemma exercises the
+    branch-quantified Ite lift (cl.lift_quantified_ites).  Controls: no
+    propagation without a heard canDecide; no trigger without the
+    cardinality gap."""
+    from round_tpu.verify.formula import And, IntLit, Lt, Minus, Not
+    from round_tpu.verify.protocols import kset_extracted_lemmas
+
+    lemmas, meta = kset_extracted_lemmas()
+    for name, hyp, concl, cfg in lemmas:
+        assert entailment(hyp, concl, cfg, timeout_s=180), name
+
+    sig, j = meta["sig"], meta["j"]
+    tr = And(meta["update_eqs"], meta["payload_defs"], *meta["axioms"])
+    # canDecide must NOT flip with neither a heard can nor the dropout gap
+    assert not entailment(
+        And(tr, meta["not_deciding"],
+            Not(Lt(Minus(sig.get("last_nb", j), meta["ho_card"]),
+                   IntLit(meta["k"])))),
+        sig.get_primed("can", j),
+        ClConfig(venn_bound=2, inst_depth=2), timeout_s=20,
+    )
