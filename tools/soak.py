@@ -68,6 +68,28 @@ def leaves_equal(a, b):
     return True
 
 
+def compare_scenarios(algo, io, got_state, mix, key, fields, phases, cfg):
+    """THE per-scenario general-engine comparison every check shares:
+    replay each FaultMix row through run_instance on the same key
+    discipline and require exact equality on the given state fields.
+    Returns None on success, a fail record otherwise."""
+    S = mix.crashed.shape[0]
+    n = mix.crashed.shape[1]
+    for s in range(S):
+        res = run_instance(
+            algo, io, n, jax.random.fold_in(key, 99 + s),
+            scenarios.from_mix_row(mix, s), max_phases=phases,
+        )
+        for field in fields:
+            a = np.asarray(getattr(got_state, field)[s])
+            b = np.asarray(getattr(res.state, field))
+            if a.shape != b.shape or not (
+                    a.view(np.uint8) == b.view(np.uint8)).all():
+                return {**cfg, "fail": f"{cfg['kind']} vs general: {field}",
+                        "scenario": s}
+    return None
+
+
 def check_otr_family(rng, it):
     n = int(rng.choice([8, 16, 24, 32, 48]))
     S = int(rng.choice([4, 8]))
@@ -88,17 +110,10 @@ def check_otr_family(rng, it):
 
     # general engine, every scenario
     algo = OTR(after_decision=2, n_values=V)
-    for s in range(S):
-        res = run_instance(
-            algo, consensus_io(init), n, jax.random.fold_in(key, 99 + s),
-            scenarios.from_mix_row(mix, s), max_phases=rounds,
-        )
-        for field in ("x", "decided", "decision"):
-            a = np.asarray(getattr(ref[0], field)[s])
-            b = np.asarray(getattr(res.state, field))
-            if not (a == b).all():
-                return {**cfg, "fail": f"general vs hist: {field}",
-                        "scenario": s}
+    fail = compare_scenarios(algo, consensus_io(init), ref[0], mix, key,
+                             ("x", "decided", "decision"), rounds, cfg)
+    if fail:
+        return fail
 
     # loop kernels, both variants
     for variant in ("v2", "flat"):
@@ -119,6 +134,94 @@ def check_otr_family(rng, it):
                 if not leaves_equal(got, ref):
                     return {**cfg, "fail": f"proc-sharded ps={ps} vs hist"}
     return cfg
+
+
+def check_lattice(rng, it):
+    from round_tpu.models.lattice import LatticeAgreement, LatticeState, lattice_io
+
+    n = int(rng.choice([8, 12, 16, 24]))
+    S = int(rng.choice([4, 6]))
+    m = int(rng.choice([6, 10, 16]))
+    rounds = int(rng.integers(5, 10))
+    p_drop = float(rng.choice([0.0, 0.1, 0.25]))
+    key = jax.random.PRNGKey(int(rng.integers(0, 2**31)))
+    mix = fast.standard_mix(key, S, n, p_drop=p_drop)
+    sets = [[int(v) for v in rng.choice(m, size=2)] for _ in range(n)]
+    io = lattice_io(sets, m)
+    init = jnp.asarray(io["initial_value"], bool)
+    cfg = dict(kind="lattice", n=n, S=S, m=m, rounds=rounds, p_drop=p_drop,
+               it=it)
+
+    state0 = LatticeState(
+        active=jnp.ones((S, n), bool),
+        proposed=jnp.broadcast_to(init, (S, n, m)),
+        decided=jnp.zeros((S, n), bool),
+        decision=jnp.zeros((S, n, m), bool),
+    )
+    got = fast.run_lattice_fast(state0, mix, rounds)
+    algo = LatticeAgreement(universe=m)
+    return compare_scenarios(
+        algo, io, got[0], mix, key,
+        ("active", "proposed", "decided", "decision"), rounds, cfg,
+    ) or cfg
+
+
+def check_tpc_kset(rng, it):
+    """Alternate TPC and KSetES fused-path checks (drawn from the rng, not
+    the global iteration parity — `it` strides by the rotation length, so
+    a parity test would silently pin one branch)."""
+    n = int(rng.choice([8, 12, 16]))
+    S = int(rng.choice([4, 8]))
+    key = jax.random.PRNGKey(int(rng.integers(0, 2**31)))
+    if int(rng.integers(0, 2)) == 0:
+        from round_tpu.models.tpc import TwoPhaseCommit, TpcState, tpc_io
+
+        p_drop = float(rng.choice([0.1, 0.25, 0.4]))
+        mix = fast.standard_mix(key, S, n, p_drop=p_drop, f=max(1, n // 4),
+                                crash_round=0)
+        votes = jax.random.bernoulli(jax.random.fold_in(key, 2), 0.8, (n,))
+        io = tpc_io(0, votes)
+        cfg = dict(kind="tpc", n=n, S=S, p_drop=p_drop, it=it)
+        state0 = TpcState(
+            coord=jnp.zeros((S, n), jnp.int32),
+            vote=jnp.broadcast_to(votes, (S, n)),
+            decision=jnp.full((S, n), -1, jnp.int32),
+            decided=jnp.zeros((S, n), bool),
+        )
+        got = fast.run_tpc_fast(state0, mix, max_rounds=3, mode="hash",
+                                interpret=True)
+        algo = TwoPhaseCommit()
+        fields = ("vote", "decision", "decided")
+        phases = 1
+    else:
+        from round_tpu.models.kset import KSetEarlyStopping, KSetESState
+
+        t_, k_ = int(rng.choice([2, 3])), 2
+        V = 8
+        mix = fast.fault_free(key, S, n)
+        crashed = jax.vmap(
+            lambda kk: jax.random.permutation(kk, jnp.arange(n)) < t_
+        )(jax.random.split(jax.random.fold_in(key, 0xCC), S))
+        mix = mix.replace(crashed=crashed)
+        init = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0, V,
+                                  dtype=jnp.int32)
+        cfg = dict(kind="kset", n=n, S=S, t=t_, k=k_, it=it)
+        rnd = fast.KSetESHist(n_values=V, t=t_, k=k_)
+        state0 = KSetESState(
+            est=jnp.broadcast_to(init, (S, n)).astype(jnp.int32),
+            can_decide=jnp.zeros((S, n), bool),
+            last_nb=jnp.full((S, n), n, jnp.int32),
+            decided=jnp.zeros((S, n), bool),
+            decision=jnp.full((S, n), -1, jnp.int32),
+        )
+        got = fast.run_hist(rnd, state0, lambda s: s.decided, mix,
+                            max_rounds=6, mode="hash", interpret=True)
+        algo = KSetEarlyStopping(t=t_, k=k_)
+        io = {"initial_value": init}
+        fields = ("est", "can_decide", "decided", "decision")
+        phases = 6
+    return compare_scenarios(algo, io, got[0], mix, key, fields, phases,
+                             cfg) or cfg
 
 
 def check_epsilon(rng, it):
@@ -170,8 +273,10 @@ def main():
     t_end = time.monotonic() + args.minutes * 60
     it = ok = 0
     log({"step": "soak-start", "seed": args.seed, "minutes": args.minutes})
+    rotation = [check_otr_family, check_otr_family, check_epsilon,
+                check_lattice, check_tpc_kset]
     while time.monotonic() < t_end:
-        check = check_epsilon if it % 4 == 3 else check_otr_family
+        check = rotation[it % len(rotation)]
         t0 = time.perf_counter()
         rec = check(rng, it)
         rec["wall_s"] = round(time.perf_counter() - t0, 1)
