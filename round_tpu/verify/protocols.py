@@ -2492,3 +2492,122 @@ def kset_extracted_lemmas(t: int = 3, k: int = 2):
                 payload_defs=payload_defs, not_deciding=not_deciding,
                 ho_card=ho_card, t=t, k=k)
     return lemmas, meta
+
+
+# ---------------------------------------------------------------------------
+# BenOr round 1 (example/BenOr.scala) — extracted-TR lemmas
+# ---------------------------------------------------------------------------
+
+def benor_extracted_tr(receiver: str = "boj"):
+    """BenOr's VOTE round extracted from the executable model
+    (models/benor.py BenOrRound1.update): vote = majority-or-heard-decider
+    over (x, canDecide) broadcasts; canDecide propagates; a canDecide
+    lane decides its estimate.  The counts extract as Card comprehensions
+    over HO(receiver), the decider tests as ∃ — nested in Ite BRANCHES,
+    exercising the branch-quantified lift.  The reference has no BenOr
+    logic suite.
+
+    Returns (sig, j, update_eqs, axioms, payload_defs) for the given
+    receiver name — the vote-exclusivity lemma instantiates TWO receivers
+    against the same payload functions."""
+    import jax.numpy as jnp
+
+    from round_tpu.ops.mailbox import Mailbox as RtMailbox
+    from round_tpu.verify.extract import Scalar, Vec, extract_lane_fn
+    from round_tpu.verify.formula import IN
+
+    sig = StateSig({"x": Bool, "can": Bool, "vote": Int,
+                    "decided": Bool, "dec": Bool})
+    j = Variable(receiver, procType)
+    sndx = UnInterpretedFct("box", FunT([procType], Bool))
+    sndc = UnInterpretedFct("boc", FunT([procType], Bool))
+
+    def upd(n, x, can, vote, decided, dec, v_x, v_can, mask):
+        # models/benor.py BenOrRound1.update, verbatim semantics
+        m = RtMailbox({"x": v_x, "can": v_can}, mask)
+        t_cnt = m.count(lambda mm: mm["x"])
+        f_cnt = m.count(lambda mm: ~mm["x"])
+        t_dec = m.exists(lambda mm: mm["x"] & mm["can"])
+        f_dec = m.exists(lambda mm: ~mm["x"] & mm["can"])
+        vote2 = jnp.where(
+            (t_cnt > n // 2) | t_dec, 1,
+            jnp.where((f_cnt > n // 2) | f_dec, 0, -1)).astype(jnp.int32)
+        can2 = m.exists(lambda mm: mm["can"])
+        deciding = can
+        decided2 = decided | deciding
+        dec2 = jnp.where(deciding & ~decided, x, dec)
+        return (jnp.where(deciding, vote, vote2),
+                jnp.where(deciding, can, can2), decided2, dec2)
+
+    ne = 5
+    ex_args = [jnp.int32(ne), jnp.bool_(False), jnp.bool_(False),
+               jnp.int32(-1), jnp.bool_(False), jnp.bool_(False),
+               jnp.zeros((ne,), bool), jnp.zeros((ne,), bool),
+               jnp.zeros((ne,), bool)]
+    fargs = [
+        Scalar(N),
+        Scalar(sig.get("x", j)), Scalar(sig.get("can", j)),
+        Scalar(sig.get("vote", j)), Scalar(sig.get("decided", j)),
+        Scalar(sig.get("dec", j)),
+        Vec(lambda i: Application(sndx, [i]).with_type(Bool)),
+        Vec(lambda i: Application(sndc, [i]).with_type(Bool)),
+        Vec(lambda i: Application(IN, [i, ho_of(j)]).with_type(Bool)),
+    ]
+    outs, axioms = extract_lane_fn(
+        upd, ex_args, fargs, lambda i: Literal(True), receiver=j,
+        return_axioms=True,
+    )
+    update_eqs = And(*[
+        Eq(sig.get_primed(name, j), out.f)
+        for name, out in zip(["vote", "can", "decided", "dec"], outs)
+    ])
+    i0 = Variable(f"{receiver}i0", procType)
+    i1 = Variable(f"{receiver}i1", procType)
+    payload_defs = And(
+        ForAll([i0], Eq(Application(sndx, [i0]).with_type(Bool),
+                        sig.get("x", i0))),
+        ForAll([i1], Eq(Application(sndc, [i1]).with_type(Bool),
+                        sig.get("can", i1))),
+    )
+    return sig, j, update_eqs, axioms, payload_defs
+
+
+def benor_extracted_lemmas():
+    """Provable consequences of the extracted BenOr vote round:
+
+      vote-exclusivity: in a phase where nobody canDecide yet, two
+        receivers cannot vote OPPOSITE values — the two >n/2 majorities
+        count DISJOINT payload classes (x vs ¬x), so their sum would
+        exceed n (the PODC'83 safety core, via Venn cardinalities over
+        two receivers' HO sets);
+      can-propagate: one heard canDecide infects the receiver;
+      decide-pins: a canDecide lane decides exactly its estimate.
+
+    Returns (lemmas, meta)."""
+    sig, j, eqs_j, ax_j, payload = benor_extracted_tr("boj")
+    _, jp, eqs_jp, ax_jp, _ = benor_extracted_tr("bok")
+    ks = Variable("boks", procType)
+    p0 = Variable("bop0", procType)
+    nobody_can = ForAll([ks], Not(sig.get("can", ks)))
+    tr2 = And(eqs_j, eqs_jp, payload, *(list(ax_j) + list(ax_jp)))
+    tr1 = And(eqs_j, payload, *ax_j)
+    cfg = ClConfig(venn_bound=3, inst_depth=2)
+
+    lemmas = [
+        ("vote-exclusivity",
+         And(tr2, nobody_can),
+         Not(And(Eq(sig.get_primed("vote", j), IntLit(1)),
+                 Eq(sig.get_primed("vote", jp), IntLit(0)))), cfg),
+        ("can-propagate",
+         And(tr1, Not(sig.get("can", j)), In(p0, ho_of(j)),
+             sig.get("can", p0)),
+         sig.get_primed("can", j), cfg),
+        ("decide-pins",
+         And(tr1, sig.get("can", j), Not(sig.get("decided", j))),
+         And(sig.get_primed("decided", j),
+             Eq(sig.get_primed("dec", j), sig.get("x", j))), cfg),
+    ]
+    meta = dict(sig=sig, j=j, jp=jp, payload=payload, eqs_j=eqs_j,
+                eqs_jp=eqs_jp, ax_j=ax_j, ax_jp=ax_jp,
+                nobody_can=nobody_can)
+    return lemmas, meta

@@ -133,3 +133,32 @@ def test_kset_extracted_lemmas():
         sig.get_primed("can", j),
         ClConfig(venn_bound=2, inst_depth=2), timeout_s=20,
     )
+
+
+def test_benor_extracted_lemmas():
+    """BenOr's vote round proved from the extracted TR
+    (protocols.benor_extracted_lemmas): can-propagation and decide-pins in
+    CI; the two-receiver vote-EXCLUSIVITY lemma (the PODC'83 safety core —
+    opposite >n/2 majorities count disjoint payload classes, so their sum
+    would exceed n) is a heavy Venn VC gated behind RUN_SLOW_VCS (proves
+    in ~2-5 min; recorded in STATUS.md).  Control: without the
+    nobody-canDecide hypothesis the exclusivity must NOT prove (a heard
+    decider bypasses the majority)."""
+    from round_tpu.verify.formula import And, Eq, IntLit, Not
+    from round_tpu.verify.protocols import benor_extracted_lemmas
+
+    lemmas, meta = benor_extracted_lemmas()
+    for name, hyp, concl, cfg in lemmas:
+        if name == "vote-exclusivity" and not RUN_SLOW:
+            continue
+        assert entailment(hyp, concl, cfg, timeout_s=600), name
+
+    sig, j, jp = meta["sig"], meta["j"], meta["jp"]
+    tr2 = And(meta["eqs_j"], meta["eqs_jp"], meta["payload"],
+              *(list(meta["ax_j"]) + list(meta["ax_jp"])))
+    assert not entailment(
+        tr2,
+        Not(And(Eq(sig.get_primed("vote", j), IntLit(1)),
+                Eq(sig.get_primed("vote", jp), IntLit(0)))),
+        ClConfig(venn_bound=2, inst_depth=1), timeout_s=25,
+    )

@@ -60,12 +60,15 @@ def log(rec):
         f.write(json.dumps(rec) + "\n")
 
 
+def arrays_equal(a, b):
+    """THE exact-equality discipline (shape + raw bits, NaN-proof)."""
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and (a.view(np.uint8) == b.view(np.uint8)).all()
+
+
 def leaves_equal(a, b):
-    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
-        x, y = np.asarray(x), np.asarray(y)
-        if x.shape != y.shape or not (x.view(np.uint8) == y.view(np.uint8)).all():
-            return False
-    return True
+    return all(arrays_equal(x, y) for x, y in
+               zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)))
 
 
 def compare_scenarios(algo, io, got_state, mix, key, fields, phases, cfg):
@@ -81,10 +84,8 @@ def compare_scenarios(algo, io, got_state, mix, key, fields, phases, cfg):
             scenarios.from_mix_row(mix, s), max_phases=phases,
         )
         for field in fields:
-            a = np.asarray(getattr(got_state, field)[s])
-            b = np.asarray(getattr(res.state, field))
-            if a.shape != b.shape or not (
-                    a.view(np.uint8) == b.view(np.uint8)).all():
+            if not arrays_equal(getattr(got_state, field)[s],
+                                getattr(res.state, field)):
                 return {**cfg, "fail": f"{cfg['kind']} vs general: {field}",
                         "scenario": s}
     return None
