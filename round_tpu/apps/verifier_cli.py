@@ -36,19 +36,71 @@ def spec_by_name(name: str):
         "erb": protocols.erb_spec,
     }
     if name not in registry:
+        valid = list(registry) + list(_LEMMA_SUITES)
         raise SystemExit(
-            f"unknown protocol {name!r} (expected {'|'.join(registry)})"
+            f"unknown protocol {name!r} (expected {'|'.join(valid)})"
         )
     return registry[name]()
 
 
+_LEMMA_SUITES = {
+    # extracted-TR lemma suites (no upstream analogue: the reference has
+    # no logic suite for any of these protocols)
+    "floodmin": ("round_tpu.verify.protocols", "floodmin_extracted_lemmas"),
+    "kset": ("round_tpu.verify.protocols", "kset_extracted_lemmas"),
+    "benor": ("round_tpu.verify.protocols", "benor_extracted_lemmas"),
+}
+
+
+def run_lemma_suite(name: str, verbose: bool) -> bool:
+    """Discharge an extracted-TR lemma suite (TRs extracted from the
+    executable round code; see each protocols.*_extracted_lemmas
+    docstring).  Prints one line per lemma and a verdict.  Budgets honor
+    ROUND_TPU_VC_TIMEOUT_SCALE like every other verifier path, and each
+    lemma's 600 s is a TOTAL budget (a failing lemma cannot burn it once
+    per decomposed sub-VC)."""
+    import importlib
+    import time
+
+    from round_tpu.verify.cl import entailment
+
+    budget = 600.0
+    try:
+        budget *= float(os.environ.get("ROUND_TPU_VC_TIMEOUT_SCALE", "1"))
+    except ValueError:
+        pass
+    mod, fn = _LEMMA_SUITES[name]
+    lemmas, _meta = getattr(importlib.import_module(mod), fn)()
+    ok = True
+    print(f"Extracted-TR lemma suite: {name}")
+    for lname, hyp, concl, cfg in lemmas:
+        if verbose:
+            print(f"  … {lname}: {cfg}")
+        t0 = time.monotonic()
+        good = entailment(hyp, concl, cfg, timeout_s=budget,
+                          total_timeout_s=budget)
+        ok &= good
+        mark = "✓" if good else "✗"
+        print(f"  {mark} {lname} ({time.monotonic() - t0:.2f}s)")
+    return ok
+
+
 def main(argv=None) -> bool:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("protocol", help="tpc | otr | lv | erb")
+    ap.add_argument("protocol",
+                    help="tpc | otr | lv | erb | floodmin | kset | benor")
     ap.add_argument("-r", "--report", default=None,
                     help="write an HTML report to this path")
     ap.add_argument("-v", "--verbose", action="store_true")
     ns = ap.parse_args(sys.argv[1:] if argv is None else argv)
+
+    if ns.protocol in _LEMMA_SUITES:
+        if ns.report:
+            print(f"note: -r/--report is not supported for lemma suites; "
+                  f"ignoring {ns.report}", file=sys.stderr)
+        ok = run_lemma_suite(ns.protocol, ns.verbose)
+        print("VERIFIED" if ok else "NOT PROVED")
+        return ok
 
     ver = Verifier(spec_by_name(ns.protocol))
     ok = ver.check()
