@@ -292,6 +292,19 @@ class BenOrHist(HistRound):
         return state, jnp.zeros_like(frozen)
 
 
+def subtract_self_delivery(counts, payload, excl, num_values):
+    """The exchange kernels hard-wire broadcast self-delivery (the eye
+    term of the HO formula) even through colmask; a GUARDED send must not
+    self-deliver on excluded lanes — subtract the own-payload count where
+    `excl` marks an active lane the guard excludes.  Shared by every
+    guarded-send fused path (TPC's commit round, ERB's flooding)."""
+    onehot_own = (
+        payload[:, None, :]
+        == jnp.arange(num_values, dtype=payload.dtype)[None, :, None]
+    ) & excl[:, None, :]
+    return counts - onehot_own.astype(jnp.int32)
+
+
 class TpcHist(HistRound):
     """Two-Phase Commit on the fused path (models/tpc.py semantics,
     TwoPhaseCommit.scala:16-81): one 3-subround phase over a V=2
@@ -372,22 +385,82 @@ def run_tpc_fast(state0, mix: FaultMix, max_rounds: int = 3,
             mode=mode, sb=sb, interpret=interpret,
         ).astype(jnp.int32)
         if k == 2:
-            # the exchange kernels hard-wire self-delivery (the eye term of
-            # the broadcast HO formula) even through colmask; a GUARDED
-            # send must not self-deliver on excluded lanes — subtract the
-            # own-payload count there, or a non-coordinator receiver with
-            # an otherwise-empty mailbox would hear itself and miss the
+            # without the subtraction a non-coordinator receiver with an
+            # otherwise-empty mailbox would hear itself and miss the
             # coordinator-suspect path (decision None)
-            own = rnd.payload(state, k)
-            excl = (~done) & ~is_coord_col
-            onehot_own = (
-                own[:, None, :]
-                == jnp.arange(rnd.num_values, dtype=own.dtype)[None, :, None]
-            ) & excl[:, None, :]
-            counts = counts - onehot_own.astype(jnp.int32)
+            counts = subtract_self_delivery(
+                counts, rnd.payload(state, k), (~done) & ~is_coord_col,
+                rnd.num_values)
         return counts
 
     return hist_scan(rnd, state0, lambda s: s.decided, max_rounds, n,
+                     counts_fn)
+
+
+class ErbHist(HistRound):
+    """Eager reliable broadcast on the fused path (models/erb.py
+    semantics, EagerReliableBroadcast.scala:13-47): the defined-senders
+    flooding as a guarded histogram exchange.
+
+    Adoption decodes as min{v : counts[v] > 0}.  The general engine
+    adopts the LOWEST-ID heard sender's value (Mailbox.any_value); the
+    two coincide exactly on ERB's protocol class — every defined sender
+    of one instance carries the ORIGINATOR's value (the flooding
+    invariant `verifier_cli erb` proves) — which is why the differential
+    parity below is still lane-exact on protocol-generated runs."""
+
+    def __init__(self, n_values: int):
+        from round_tpu.models.erb import GIVE_UP_ROUND
+
+        self.num_values = n_values
+        self.give_up_round = GIVE_UP_ROUND  # the model's constant: one source
+
+    def payload(self, state, k: int = 0):
+        return state.x_val
+
+    def update_counts(self, state, counts, size, r, n, k: int = 0, coin=None):
+        V = self.num_values
+        got_any = size > 0
+        rows = jnp.arange(V, dtype=jnp.int32)[None, :, None]
+        adopted = jnp.min(
+            jnp.where(counts > 0, rows, V), axis=1
+        ).astype(state.x_val.dtype)
+        delivering = state.x_def
+        give_up = ~state.x_def & ~got_any & (r > self.give_up_round)
+        newly = delivering & ~state.delivered
+        state = state.replace(
+            x_val=jnp.where(~state.x_def & got_any, adopted, state.x_val),
+            x_def=state.x_def | got_any,
+            delivered=state.delivered | delivering,
+            delivery=jnp.where(newly, state.x_val, state.delivery),
+        )
+        return state, delivering | give_up
+
+
+def run_erb_fast(state0, mix: FaultMix, max_rounds: int,
+                 n_values: int, mode: str = "hash", sb: int = 8,
+                 interpret: bool = False):
+    """ERB through the fused exchange: the send guard (only DEFINED lanes
+    broadcast, models/erb.py ErbRound.send) becomes a state-dependent
+    column mask, with the kernels' hard-wired self-delivery subtracted on
+    guard-excluded lanes (the run_tpc_fast discipline).  Lane-exact vs
+    the general engine on protocol-generated runs (tests/test_fast.py)."""
+    S, n = mix.crashed.shape
+    rnd = ErbHist(n_values)
+
+    def counts_fn(state, k, done, r):
+        colmask, side_r, p8, salt0, salt1r = round_params(mix, r)
+        colmask = colmask & state.x_def          # guarded broadcast
+        counts = fused.hist_exchange(
+            rnd.payload(state, k), ~done, colmask, None, side_r,
+            salt0, salt1r, p8, rnd.num_values,
+            mode=mode, sb=sb, interpret=interpret,
+        ).astype(jnp.int32)
+        return subtract_self_delivery(
+            counts, rnd.payload(state, k), (~done) & ~state.x_def,
+            rnd.num_values)
+
+    return hist_scan(rnd, state0, lambda s: s.delivered, max_rounds, n,
                      counts_fn)
 
 

@@ -20,6 +20,11 @@ from round_tpu.core.algorithm import Algorithm
 from round_tpu.core.rounds import Round, RoundCtx, broadcast
 from round_tpu.ops.mailbox import Mailbox
 
+# a process that hears nothing for this many rounds gives up (the
+# originator crashed before anyone got the value) — ONE constant shared
+# with the fused path (engine.fast.ErbHist) so the engines cannot drift
+GIVE_UP_ROUND = 10
+
 
 @flax.struct.dataclass
 class ErbState:
@@ -38,7 +43,7 @@ class ErbRound(Round):
         adopted = mbox.any_value()
 
         delivering = state.x_def
-        give_up = ~state.x_def & ~got_any & (ctx.r > 10)
+        give_up = ~state.x_def & ~got_any & (ctx.r > GIVE_UP_ROUND)
         ctx.exit_at_end_of_round(delivering | give_up)
         newly = delivering & ~state.delivered
         return state.replace(

@@ -738,3 +738,53 @@ def test_tpc_fast_parity_including_suspect_path():
         assert len(set(present.tolist())) <= 1, s
     assert seen_commit_or_abort  # non-vacuity: some scenario concluded
     assert seen_suspect          # and some live lane suspected the coord
+
+
+def test_erb_fast_parity_and_uniformity():
+    """ERB on the fused path (fast.run_erb_fast, state-dependent sender
+    guard) is lane-exact against the general engine across mixed faults —
+    including crashed-originator scenarios where nobody ever delivers —
+    and uniform agreement holds on delivered lanes."""
+    from round_tpu.engine import scenarios
+    from round_tpu.engine.executor import run_instance
+    from round_tpu.models.erb import (
+        EagerReliableBroadcast, ErbState, broadcast_io,
+    )
+
+    n, S, V, rounds = 12, 10, 8, 14
+    key = jax.random.PRNGKey(41)
+    mix = fast.standard_mix(key, S, n, p_drop=0.3, f=3, crash_round=0)
+    origin, value = 0, 5
+    io = broadcast_io(origin, value, n)
+
+    state0 = ErbState(
+        x_val=jnp.broadcast_to(jnp.asarray(io["value"], jnp.int32), (S, n)),
+        x_def=jnp.broadcast_to(jnp.asarray(io["is_origin"], bool), (S, n)),
+        delivered=jnp.zeros((S, n), bool),
+        delivery=jnp.full((S, n), -1, jnp.int32),
+    )
+    state, done, dround = fast.run_erb_fast(
+        state0, mix, max_rounds=rounds, n_values=V, mode="hash",
+        interpret=True)
+
+    algo = EagerReliableBroadcast()
+    saw_give_up = False
+    for s in range(S):
+        res = run_instance(
+            algo, io, n, jax.random.fold_in(key, 99 + s),
+            scenarios.from_mix_row(mix, s), max_phases=rounds,
+        )
+        for field in ("x_val", "x_def", "delivered", "delivery"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(state, field)[s]),
+                np.asarray(getattr(res.state, field)), err_msg=field)
+        np.testing.assert_array_equal(
+            np.asarray(dround[s]), np.asarray(res.decided_round))
+        saw_give_up |= not bool(np.asarray(res.state.delivered).all())
+
+    # uniform agreement: every delivered lane delivered the origin value
+    dv = np.asarray(state.delivery)
+    got = np.asarray(state.delivered)
+    assert got.any()
+    assert (dv[got] == value).all()
+    assert saw_give_up  # some crashed-origin scenario starved (non-vacuity)

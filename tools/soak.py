@@ -225,6 +225,36 @@ def check_tpc_kset(rng, it):
                              cfg) or cfg
 
 
+def check_erb(rng, it):
+    from round_tpu.models.erb import EagerReliableBroadcast, ErbState, broadcast_io
+
+    n = int(rng.choice([8, 12, 16, 24]))
+    S = int(rng.choice([4, 8]))
+    V = 8
+    rounds = int(rng.integers(12, 16))
+    p_drop = float(rng.choice([0.1, 0.25, 0.4]))
+    key = jax.random.PRNGKey(int(rng.integers(0, 2**31)))
+    mix = fast.standard_mix(key, S, n, p_drop=p_drop, f=max(1, n // 4),
+                            crash_round=0)
+    origin = int(rng.integers(0, n))
+    io = broadcast_io(origin, int(rng.integers(0, V)), n)
+    cfg = dict(kind="erb", n=n, S=S, rounds=rounds, p_drop=p_drop,
+               origin=origin, it=it)
+    state0 = ErbState(
+        x_val=jnp.broadcast_to(jnp.asarray(io["value"], jnp.int32), (S, n)),
+        x_def=jnp.broadcast_to(jnp.asarray(io["is_origin"], bool), (S, n)),
+        delivered=jnp.zeros((S, n), bool),
+        delivery=jnp.full((S, n), -1, jnp.int32),
+    )
+    got = fast.run_erb_fast(state0, mix, max_rounds=rounds, n_values=V,
+                            mode="hash", interpret=True)
+    algo = EagerReliableBroadcast()
+    return compare_scenarios(
+        algo, io, got[0], mix, key,
+        ("x_val", "x_def", "delivered", "delivery"), rounds, cfg,
+    ) or cfg
+
+
 def check_epsilon(rng, it):
     from round_tpu.engine.epsfast import run_epsilon_fast
     from round_tpu.models.epsilon import EpsilonConsensus
@@ -275,7 +305,7 @@ def main():
     it = ok = 0
     log({"step": "soak-start", "seed": args.seed, "minutes": args.minutes})
     rotation = [check_otr_family, check_otr_family, check_epsilon,
-                check_lattice, check_tpc_kset]
+                check_lattice, check_tpc_kset, check_erb]
     while time.monotonic() < t_end:
         check = rotation[it % len(rotation)]
         t0 = time.perf_counter()
