@@ -172,6 +172,13 @@ class HistRound:
     num_values: int
     phase_len: int = 1
     needs_coin: bool = False
+    # update_counts wants the GLOBAL lane ids of its local lanes (rounds
+    # that compare lane identity to state, e.g. TPC's coordinator test) —
+    # under proc-sharding local index != global id
+    needs_lane_ids: bool = False
+    # subrounds whose update consumes NO counts (TPC's prepare): engines
+    # skip the exchange entirely there
+    no_exchange_subrounds: tuple = ()
 
     def payload(self, state, k: int = 0) -> jnp.ndarray:
         raise NotImplementedError
@@ -322,6 +329,8 @@ class TpcHist(HistRound):
 
     num_values = 2
     phase_len = 3
+    needs_lane_ids = True  # the coordinator test is a lane-identity compare
+    no_exchange_subrounds = (0,)  # prepare consumes nothing
 
     def payload(self, state, k: int = 0):
         from round_tpu.models.tpc import DEC_COMMIT
@@ -332,15 +341,15 @@ class TpcHist(HistRound):
             return (state.decision == DEC_COMMIT).astype(jnp.int32)
         return jnp.zeros_like(state.decision)
 
-    def update_counts(self, state, counts, size, r, n, k: int = 0, coin=None):
+    def update_counts(self, state, counts, size, r, n, k: int = 0, coin=None,
+                      lane_ids=None):
         from round_tpu.models.tpc import DEC_ABORT, DEC_COMMIT
 
         no_exit = jnp.zeros(size.shape, dtype=bool)
         if k == 0:
             return state, no_exit
         if k == 1:
-            is_coord = (jnp.arange(size.shape[1],
-                                   dtype=state.coord.dtype)[None, :]
+            is_coord = (lane_ids.astype(state.coord.dtype)[None, :]
                         == state.coord)
             yes = counts[:, 1, :]
             all_yes = (size == n) & (yes == size)
@@ -369,7 +378,7 @@ def run_tpc_fast(state0, mix: FaultMix, max_rounds: int = 3,
     coord_col = state0.coord[:, :1]                        # [S, 1] uniform
 
     def counts_fn(state, k, done, r):
-        if k == 0:
+        if k in rnd.no_exchange_subrounds:
             # prepare consumes nothing (TwoPhaseCommit.scala:42-44): skip
             # the exchange kernel entirely
             return jnp.zeros((S, rnd.num_values, n), jnp.int32)
@@ -581,6 +590,7 @@ def hist_scan(
     n: int,
     counts_fn: Callable,
     coin_fn: Optional[Callable] = None,
+    lane_ids: Optional[jnp.ndarray] = None,
 ):
     """The round-step scaffolding every histogram engine shares: subround
     dispatch (phase_len switch), exit/freeze bookkeeping (exited lanes stop
@@ -594,7 +604,9 @@ def hist_scan(
     Shared by run_hist (single-device fused exchange) and
     parallel.mesh.run_hist_proc_sharded (receiver-sharded count blocks), so
     a semantics fix here propagates to every engine; `n` is the GLOBAL
-    group size (quorum thresholds), which may exceed the local lane axis."""
+    group size (quorum thresholds), which may exceed the local lane axis.
+    `lane_ids` are the global ids of the local lanes (default: arange),
+    passed to update_counts for rounds with needs_lane_ids."""
     lanes_like = decided_fn(state0)
     done0 = jnp.zeros(lanes_like.shape, dtype=bool)
     decided_round0 = jnp.full(lanes_like.shape, -1, dtype=jnp.int32)
@@ -606,7 +618,13 @@ def hist_scan(
         def subround(k, state):
             counts = counts_fn(state, k, done, r)
             size = jnp.sum(counts, axis=1)
-            return rnd.update_counts(state, counts, size, r, n, k=k, coin=coin)
+            extra = {}
+            if rnd.needs_lane_ids:
+                extra["lane_ids"] = (
+                    jnp.arange(size.shape[-1], dtype=jnp.int32)
+                    if lane_ids is None else lane_ids)
+            return rnd.update_counts(state, counts, size, r, n, k=k,
+                                     coin=coin, **extra)
 
         if rnd.phase_len == 1:
             new_state, exit_ = subround(0, state)

@@ -33,6 +33,18 @@ class ErbState:
     delivered: jnp.ndarray  # bool ghost (deliver callback fired)
     delivery: jnp.ndarray   # int32 ghost
 
+    @classmethod
+    def fresh(cls, io: dict, S: int, n: int) -> "ErbState":
+        """[S, n]-batched undelivered state from a broadcast_io dict — the
+        one constructor every fused/sharded/soak call site shares."""
+        return cls(
+            x_val=jnp.broadcast_to(
+                jnp.asarray(io["value"], jnp.int32), (S, n)),
+            x_def=jnp.broadcast_to(jnp.asarray(io["is_origin"], bool), (S, n)),
+            delivered=jnp.zeros((S, n), bool),
+            delivery=jnp.full((S, n), -1, jnp.int32),
+        )
+
 
 class ErbRound(Round):
     def send(self, ctx: RoundCtx, state: ErbState):

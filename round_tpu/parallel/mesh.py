@@ -188,6 +188,7 @@ def run_hist_proc_sharded(
     max_rounds: int,
     mesh: Mesh,
     decided_fn=None,
+    send_guard_fn=None,
 ):
     """engine.fast.run_hist with the PROCESS axis sharded over PROC_AXIS
     (and scenarios over SCENARIO_AXIS): the fast histogram path for groups
@@ -207,7 +208,15 @@ def run_hist_proc_sharded(
     state0 leaves are global [S, n, ...]; mix leaves [S] / [S, n] (the n
     axis of the mix replicates — it is O(n) metadata).  Returns
     (state, done, decided_round) with global shapes, sharded
-    P(scenario, proc)."""
+    P(scenario, proc).
+
+    ``send_guard_fn(state_local, k) -> [S_l, n_l] bool`` marks which LOCAL
+    lanes broadcast in subround k (guarded sends: TPC's coordinator
+    rounds, ERB's defined-senders flooding).  The guard is gathered with
+    the payload and ANDed into the delivery — note this sharded
+    formulation has NO hardwired self-delivery to correct (the eye term is
+    part of `ho` and the guard masks it like any sender), unlike the
+    kernel path's subtract_self_delivery discipline."""
     from functools import partial as _partial
 
     from round_tpu.engine import fast as _fast
@@ -236,6 +245,11 @@ def run_hist_proc_sharded(
         eye = jnp.arange(n, dtype=jnp.int32)[None, :] == jg[:, None]  # [n_l, n]
 
         def counts_fn(state, k, done, r):
+            if k in rnd.no_exchange_subrounds:
+                # the subround consumes no counts (TPC's prepare): skip
+                # the gathers and the count einsum entirely
+                return jnp.zeros(
+                    (done.shape[0], V, done.shape[1]), jnp.int32)
             colmask, side_r, p8, salt0, salt1r = _fast.round_params(mix_l, r)
             # this device's HO mask block at GLOBAL (j, i) indices — the
             # scenarios.from_fault_params formula row-sliced, through the
@@ -259,6 +273,10 @@ def run_hist_proc_sharded(
             active_full = jax.lax.all_gather(
                 ~done, PROC_AXIS, axis=1, tiled=True)             # [S_l, n]
             deliver = ho & active_full[:, None, :]         # [S_l, n_l, n]
+            if send_guard_fn is not None:
+                guard_full = jax.lax.all_gather(
+                    send_guard_fn(state, k), PROC_AXIS, axis=1, tiled=True)
+                deliver = deliver & guard_full[:, None, :]
             oh = (payload_full[:, None, :]
                   == jnp.arange(V, dtype=payload_full.dtype)[None, :, None])
             return jnp.einsum(
@@ -268,9 +286,47 @@ def run_hist_proc_sharded(
 
         coin_fn = _fast.hash_coin_fn(mix_l, jg) if rnd.needs_coin else None
         return _fast.hist_scan(
-            rnd, state0_l, decided_fn, max_rounds, n, counts_fn, coin_fn)
+            rnd, state0_l, decided_fn, max_rounds, n, counts_fn, coin_fn,
+            lane_ids=jg)
 
     return run(state0, mix)
+
+
+def run_tpc_proc_sharded(state0, mix, mesh: Mesh, max_rounds: int = 3):
+    """TPC on the proc-sharded fast path: the coordinator's guarded sends
+    become a send_guard_fn (prepare/commit: only the coordinator's lane
+    broadcasts).  Bit-identical to fast.run_tpc_fast on the same mix."""
+    from round_tpu.engine import fast as _fast
+
+    rnd = _fast.TpcHist()
+
+    def guard(state, k):
+        lane = jnp.arange(state.coord.shape[1], dtype=state.coord.dtype)
+        j0 = jax.lax.axis_index(PROC_AXIS) * state.coord.shape[1]
+        is_coord = (j0 + lane)[None, :] == state.coord
+        if k == 1:
+            return jnp.ones_like(is_coord)
+        return is_coord
+
+    return run_hist_proc_sharded(
+        rnd, state0, mix, max_rounds, mesh,
+        decided_fn=lambda s: s.decided, send_guard_fn=guard,
+    )
+
+
+def run_erb_proc_sharded(state0, mix, mesh: Mesh, max_rounds: int,
+                         n_values: int):
+    """ERB on the proc-sharded fast path: the defined-senders flooding
+    guard gathers with the payload.  Bit-identical to fast.run_erb_fast
+    on the same mix (protocol-generated runs)."""
+    from round_tpu.engine import fast as _fast
+
+    rnd = _fast.ErbHist(n_values)
+    return run_hist_proc_sharded(
+        rnd, state0, mix, max_rounds, mesh,
+        decided_fn=lambda s: s.delivered,
+        send_guard_fn=lambda s, k: s.x_def,
+    )
 
 
 def sharded_hist_loop(

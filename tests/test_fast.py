@@ -757,12 +757,7 @@ def test_erb_fast_parity_and_uniformity():
     origin, value = 0, 5
     io = broadcast_io(origin, value, n)
 
-    state0 = ErbState(
-        x_val=jnp.broadcast_to(jnp.asarray(io["value"], jnp.int32), (S, n)),
-        x_def=jnp.broadcast_to(jnp.asarray(io["is_origin"], bool), (S, n)),
-        delivered=jnp.zeros((S, n), bool),
-        delivery=jnp.full((S, n), -1, jnp.int32),
-    )
+    state0 = ErbState.fresh(io, S, n)
     state, done, dround = fast.run_erb_fast(
         state0, mix, max_rounds=rounds, n_values=V, mode="hash",
         interpret=True)

@@ -165,3 +165,48 @@ def test_hist_proc_sharded_bit_parity_benor():
                     jax.tree_util.tree_leaves(ref)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert bool(np.asarray(got[0].decided).any())
+
+
+def test_tpc_erb_proc_sharded_bit_parity():
+    """Guarded-send families on the proc-sharded fast path: the sender
+    guard gathers with the payload (run_hist_proc_sharded send_guard_fn),
+    bit-identical to the single-device fused runners."""
+    from round_tpu.engine import fast
+    from round_tpu.models.erb import ErbState, broadcast_io
+    from round_tpu.models.tpc import TpcState, tpc_io
+    from round_tpu.parallel.mesh import (
+        make_mesh, run_erb_proc_sharded, run_tpc_proc_sharded,
+    )
+
+    assert len(jax.devices()) >= 8
+    mesh = make_mesh(8, proc_shards=4)
+    n, S = 16, 8
+    key = jax.random.PRNGKey(51)
+
+    # TPC
+    mix = fast.standard_mix(key, S, n, p_drop=0.25, f=4, crash_round=0)
+    votes = jax.random.bernoulli(jax.random.fold_in(key, 2), 0.8, (n,))
+    state0 = TpcState(
+        coord=jnp.zeros((S, n), jnp.int32),
+        vote=jnp.broadcast_to(votes, (S, n)),
+        decision=jnp.full((S, n), -1, jnp.int32),
+        decided=jnp.zeros((S, n), bool),
+    )
+    ref = fast.run_tpc_fast(state0, mix, max_rounds=3, mode="hash",
+                            interpret=True)
+    got = run_tpc_proc_sharded(state0, mix, mesh)
+    for a, b in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(ref)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # ERB
+    V, rounds = 8, 14
+    io = broadcast_io(0, 5, n)
+    state0e = ErbState.fresh(io, S, n)
+    refe = fast.run_erb_fast(state0e, mix, max_rounds=rounds, n_values=V,
+                             mode="hash", interpret=True)
+    gote = run_erb_proc_sharded(state0e, mix, mesh, rounds, V)
+    for a, b in zip(jax.tree_util.tree_leaves(gote),
+                    jax.tree_util.tree_leaves(refe)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert bool(np.asarray(gote[0].delivered).any())

@@ -240,12 +240,7 @@ def check_erb(rng, it):
     io = broadcast_io(origin, int(rng.integers(0, V)), n)
     cfg = dict(kind="erb", n=n, S=S, rounds=rounds, p_drop=p_drop,
                origin=origin, it=it)
-    state0 = ErbState(
-        x_val=jnp.broadcast_to(jnp.asarray(io["value"], jnp.int32), (S, n)),
-        x_def=jnp.broadcast_to(jnp.asarray(io["is_origin"], bool), (S, n)),
-        delivered=jnp.zeros((S, n), bool),
-        delivery=jnp.full((S, n), -1, jnp.int32),
-    )
+    state0 = ErbState.fresh(io, S, n)
     got = fast.run_erb_fast(state0, mix, max_rounds=rounds, n_values=V,
                             mode="hash", interpret=True)
     algo = EagerReliableBroadcast()
