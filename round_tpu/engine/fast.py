@@ -517,6 +517,23 @@ class LatticeHist(HistRound):
         return state, deciding
 
 
+def lattice_counts(deliver, P_recv, P_send):
+    """The lattice count planes ([.., m+1, n_recv]) from a delivery mask
+    and the receiver/sender proposal matrices — ONE implementation shared
+    by the single-device runner (P_recv = P_send) and the receiver-sharded
+    path (P_recv = local slice, P_send = the gathered full matrix):
+    plane 0 = #heard equal proposals (Hamming matmul pair), planes 1..m =
+    per-bit heard counts (the join)."""
+    Pr = P_recv.astype(jnp.int32)
+    Ps = P_send.astype(jnp.int32)
+    ham = (jnp.einsum("sjb,sib->sji", Pr, 1 - Ps)
+           + jnp.einsum("sjb,sib->sji", 1 - Pr, Ps))
+    eq = ham == 0
+    same = jnp.sum((deliver & eq).astype(jnp.int32), axis=2)
+    orc = jnp.einsum("sji,sib->sbj", deliver.astype(jnp.int32), Ps)
+    return jnp.concatenate([same[:, None, :], orc], axis=1)
+
+
 def run_lattice_fast(
     state0,
     mix: FaultMix,
@@ -532,14 +549,7 @@ def run_lattice_fast(
 
     def counts_fn(state, k, done, r):
         deliver = mix_ho(mix, r) & (~done)[:, None, :]    # [S, j, i]
-        P = state.proposed.astype(jnp.int32)              # [S, n, m]
-        Pn = 1 - P
-        ham = (jnp.einsum("sjb,sib->sji", P, Pn)
-               + jnp.einsum("sjb,sib->sji", Pn, P))
-        eq = ham == 0
-        same = jnp.sum((deliver & eq).astype(jnp.int32), axis=2)
-        orc = jnp.einsum("sji,sib->sbj", deliver.astype(jnp.int32), P)
-        return jnp.concatenate([same[:, None, :], orc], axis=1)
+        return lattice_counts(deliver, state.proposed, state.proposed)
 
     return hist_scan(rnd, state0, lambda s: s.decided, max_rounds, n,
                      counts_fn)

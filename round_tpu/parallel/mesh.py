@@ -181,6 +181,31 @@ def sharded_simulate(
     return jax.jit(run)(io, keys)
 
 
+def _ho_block(mix_l, r, jg, n):
+    """This device's HO mask block at GLOBAL (receiver jg, sender i)
+    indices — the scenarios.from_fault_params formula row-sliced, through
+    the ONE shared hash finalizer (ops.fused._fmix32).  Shared by every
+    receiver-sharded counts_fn (histogram and bitset families)."""
+    from round_tpu.engine import fast as _fast
+
+    n_l = jg.shape[0]
+    j0 = jg[0]
+    colmask, side_r, p8, salt0, salt1r = _fast.round_params(mix_l, r)
+    idx = (jg.astype(jnp.uint32)[None, :, None] * jnp.uint32(n)
+           + jnp.arange(n, dtype=jnp.uint32)[None, None, :])
+    z = idx * jnp.uint32(0x9E3779B9) \
+        + salt0.astype(jnp.uint32)[:, None, None]
+    z = z ^ salt1r.astype(jnp.uint32)[:, None, None]
+    keep = ((_fast.fused._fmix32(z) & jnp.uint32(0xFF))
+            >= p8.astype(jnp.uint32)[:, None, None])
+    keep = keep | (p8 <= 0)[:, None, None]
+    side_l = jax.lax.dynamic_slice_in_dim(side_r, j0, n_l, axis=1)
+    eye = jnp.arange(n, dtype=jnp.int32)[None, :] == jg[:, None]
+    return (colmask[:, None, :]
+            & (side_l[:, :, None] == side_r[:, None, :])
+            & keep) | eye[None]
+
+
 def run_hist_proc_sharded(
     rnd,
     state0,
@@ -242,7 +267,6 @@ def run_hist_proc_sharded(
     def run(state0_l, mix_l):
         j0 = jax.lax.axis_index(PROC_AXIS) * n_l
         jg = j0 + jnp.arange(n_l, dtype=jnp.int32)        # global receiver ids
-        eye = jnp.arange(n, dtype=jnp.int32)[None, :] == jg[:, None]  # [n_l, n]
 
         def counts_fn(state, k, done, r):
             if k in rnd.no_exchange_subrounds:
@@ -250,33 +274,18 @@ def run_hist_proc_sharded(
                 # the gathers and the count einsum entirely
                 return jnp.zeros(
                     (done.shape[0], V, done.shape[1]), jnp.int32)
-            colmask, side_r, p8, salt0, salt1r = _fast.round_params(mix_l, r)
-            # this device's HO mask block at GLOBAL (j, i) indices — the
-            # scenarios.from_fault_params formula row-sliced, through the
-            # ONE shared hash finalizer (ops.fused._fmix32)
-            idx = (jg.astype(jnp.uint32)[None, :, None] * jnp.uint32(n)
-                   + jnp.arange(n, dtype=jnp.uint32)[None, None, :])
-            z = idx * jnp.uint32(0x9E3779B9) \
-                + salt0.astype(jnp.uint32)[:, None, None]
-            z = z ^ salt1r.astype(jnp.uint32)[:, None, None]
-            keep = ((_fast.fused._fmix32(z) & jnp.uint32(0xFF))
-                    >= p8.astype(jnp.uint32)[:, None, None])
-            keep = keep | (p8 <= 0)[:, None, None]
-            side_l = jax.lax.dynamic_slice_in_dim(side_r, j0, n_l, axis=1)
-            ho = (colmask[:, None, :]
-                  & (side_l[:, :, None] == side_r[:, None, :])
-                  & keep) | eye[None]
+            ho = _ho_block(mix_l, r, jg, n)
 
             payload = rnd.payload(state, k)                # [S_l, n_l]
             payload_full = jax.lax.all_gather(
                 payload, PROC_AXIS, axis=1, tiled=True)           # [S_l, n]
-            active_full = jax.lax.all_gather(
-                ~done, PROC_AXIS, axis=1, tiled=True)             # [S_l, n]
-            deliver = ho & active_full[:, None, :]         # [S_l, n_l, n]
-            if send_guard_fn is not None:
-                guard_full = jax.lax.all_gather(
-                    send_guard_fn(state, k), PROC_AXIS, axis=1, tiled=True)
-                deliver = deliver & guard_full[:, None, :]
+            # sender eligibility = active ∧ guard, fused into ONE gather
+            # (deliver only ever uses the conjunction)
+            sending = ~done if send_guard_fn is None \
+                else (~done) & send_guard_fn(state, k)
+            sending_full = jax.lax.all_gather(
+                sending, PROC_AXIS, axis=1, tiled=True)           # [S_l, n]
+            deliver = ho & sending_full[:, None, :]        # [S_l, n_l, n]
             oh = (payload_full[:, None, :]
                   == jnp.arange(V, dtype=payload_full.dtype)[None, :, None])
             return jnp.einsum(
@@ -312,6 +321,52 @@ def run_tpc_proc_sharded(state0, mix, mesh: Mesh, max_rounds: int = 3):
         rnd, state0, mix, max_rounds, mesh,
         decided_fn=lambda s: s.decided, send_guard_fn=guard,
     )
+
+
+def run_lattice_proc_sharded(state0, mix, mesh: Mesh, max_rounds: int):
+    """Lattice agreement on the receiver-sharded fast path: the bit-plane
+    exchange gathers the full [n, m] proposal matrix (O(n·m) ICI per
+    round) and computes this device's Hamming-equality and OR-count
+    blocks locally.  Bit-identical to fast.run_lattice_fast — counts are
+    exact int32 accumulations."""
+    from functools import partial as _partial
+
+    from round_tpu.engine import fast as _fast
+
+    s_shards = mesh.shape[SCENARIO_AXIS]
+    p_shards = mesh.shape[PROC_AXIS]
+    S, n = mix.crashed.shape
+    assert S % s_shards == 0 and n % p_shards == 0, (S, n, dict(mesh.shape))
+    n_l = n // p_shards
+    m = state0.proposed.shape[-1]
+    rnd = _fast.LatticeHist(m)
+
+    spec_state = P(SCENARIO_AXIS, PROC_AXIS)
+    spec_mix = P(SCENARIO_AXIS)
+
+    @_partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec_state, spec_mix),
+        out_specs=(spec_state, spec_state, spec_state),
+        check_vma=False,
+    )
+    def run(state0_l, mix_l):
+        jg = (jax.lax.axis_index(PROC_AXIS) * n_l
+              + jnp.arange(n_l, dtype=jnp.int32))
+
+        def counts_fn(state, k, done, r):
+            ho = _ho_block(mix_l, r, jg, n)
+            P_full = jax.lax.all_gather(
+                state.proposed, PROC_AXIS, axis=1, tiled=True)  # [S_l, n, m]
+            active_full = jax.lax.all_gather(
+                ~done, PROC_AXIS, axis=1, tiled=True)
+            deliver = ho & active_full[:, None, :]
+            return _fast.lattice_counts(deliver, state.proposed, P_full)
+
+        return _fast.hist_scan(
+            rnd, state0_l, lambda s: s.decided, max_rounds, n, counts_fn)
+
+    return run(state0, mix)
 
 
 def run_erb_proc_sharded(state0, mix, mesh: Mesh, max_rounds: int,

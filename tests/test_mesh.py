@@ -173,7 +173,7 @@ def test_tpc_erb_proc_sharded_bit_parity():
     bit-identical to the single-device fused runners."""
     from round_tpu.engine import fast
     from round_tpu.models.erb import ErbState, broadcast_io
-    from round_tpu.models.tpc import TpcState, tpc_io
+    from round_tpu.models.tpc import TpcState
     from round_tpu.parallel.mesh import (
         make_mesh, run_erb_proc_sharded, run_tpc_proc_sharded,
     )
@@ -210,3 +210,33 @@ def test_tpc_erb_proc_sharded_bit_parity():
                     jax.tree_util.tree_leaves(refe)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert bool(np.asarray(gote[0].delivered).any())
+
+
+def test_lattice_proc_sharded_bit_parity():
+    """The bitset family proc-shards too: lattice agreement's bit-plane
+    exchange on the receiver-sharded path (run_lattice_proc_sharded) is
+    bit-identical to the single-device fused runner."""
+    from round_tpu.engine import fast
+    from round_tpu.models.lattice import LatticeState, lattice_io
+    from round_tpu.parallel.mesh import make_mesh, run_lattice_proc_sharded
+
+    assert len(jax.devices()) >= 8
+    mesh = make_mesh(8, proc_shards=4)
+    n, S, m, rounds = 16, 8, 10, 8
+    key = jax.random.PRNGKey(61)
+    mix = fast.standard_mix(key, S, n, p_drop=0.2)
+    sets = [[i % m, (5 * i + 2) % m] for i in range(n)]
+    io = lattice_io(sets, m)
+    init = jnp.asarray(io["initial_value"], bool)
+    state0 = LatticeState(
+        active=jnp.ones((S, n), bool),
+        proposed=jnp.broadcast_to(init, (S, n, m)),
+        decided=jnp.zeros((S, n), bool),
+        decision=jnp.zeros((S, n, m), bool),
+    )
+    ref = fast.run_lattice_fast(state0, mix, rounds)
+    got = run_lattice_proc_sharded(state0, mix, mesh, rounds)
+    for a, b in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(ref)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert bool(np.asarray(got[0].decided).any())
