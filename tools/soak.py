@@ -124,17 +124,28 @@ def check_otr_family(rng, it):
             return {**cfg, "fail": f"loop {variant} vs hist"}
 
     # proc-sharded fast path (virtual devices; n must divide)
-    ndev = len(jax.devices())
-    if ndev > 1:
-        from round_tpu.parallel.mesh import make_mesh, run_hist_proc_sharded
+    from round_tpu.parallel.mesh import run_hist_proc_sharded
 
-        for ps in (2, 4):
-            if ndev % ps == 0 and n % ps == 0 and S % (ndev // ps) == 0:
-                mesh = make_mesh(ndev, proc_shards=ps)
-                got = run_hist_proc_sharded(rnd, state0, mix, rounds, mesh)
-                if not leaves_equal(got, ref):
-                    return {**cfg, "fail": f"proc-sharded ps={ps} vs hist"}
-    return cfg
+    fail = _sharded_twin_check(
+        lambda mesh: run_hist_proc_sharded(rnd, state0, mix, rounds, mesh),
+        ref, n, S, cfg)
+    return fail or cfg
+
+
+def _sharded_twin_check(run_sharded, ref, n, S, cfg):
+    """Compare a family's proc-sharded twin against the single-device
+    result when the mesh factorization divides (bit-exact)."""
+    ndev = len(jax.devices())
+    if ndev <= 1:
+        return None
+    from round_tpu.parallel.mesh import make_mesh
+
+    for ps in (2, 4):
+        if ndev % ps == 0 and n % ps == 0 and S % (ndev // ps) == 0:
+            got = run_sharded(make_mesh(ndev, proc_shards=ps))
+            if not leaves_equal(got, ref):
+                return {**cfg, "fail": f"proc-sharded ps={ps} twin"}
+    return None
 
 
 def check_lattice(rng, it):
@@ -160,6 +171,13 @@ def check_lattice(rng, it):
         decision=jnp.zeros((S, n, m), bool),
     )
     got = fast.run_lattice_fast(state0, mix, rounds)
+    from round_tpu.parallel.mesh import run_lattice_proc_sharded
+
+    fail = _sharded_twin_check(
+        lambda mesh: run_lattice_proc_sharded(state0, mix, mesh, rounds),
+        got, n, S, cfg)
+    if fail:
+        return fail
     algo = LatticeAgreement(universe=m)
     return compare_scenarios(
         algo, io, got[0], mix, key,
@@ -191,6 +209,13 @@ def check_tpc_kset(rng, it):
         )
         got = fast.run_tpc_fast(state0, mix, max_rounds=3, mode="hash",
                                 interpret=True)
+        from round_tpu.parallel.mesh import run_tpc_proc_sharded
+
+        fail = _sharded_twin_check(
+            lambda mesh: run_tpc_proc_sharded(state0, mix, mesh),
+            got, n, S, cfg)
+        if fail:
+            return fail
         algo = TwoPhaseCommit()
         fields = ("vote", "decision", "decided")
         phases = 1
@@ -243,6 +268,13 @@ def check_erb(rng, it):
     state0 = ErbState.fresh(io, S, n)
     got = fast.run_erb_fast(state0, mix, max_rounds=rounds, n_values=V,
                             mode="hash", interpret=True)
+    from round_tpu.parallel.mesh import run_erb_proc_sharded
+
+    fail = _sharded_twin_check(
+        lambda mesh: run_erb_proc_sharded(state0, mix, mesh, rounds, V),
+        got, n, S, cfg)
+    if fail:
+        return fail
     algo = EagerReliableBroadcast()
     return compare_scenarios(
         algo, io, got[0], mix, key,
