@@ -517,6 +517,56 @@ class LatticeHist(HistRound):
         return state, deciding
 
 
+class EsfdHist(HistRound):
+    """◇S failure detector on the fused path (models/failure_detector.py
+    semantics): the suspected-set broadcast rides bit-plane OR counts
+    (planes 0..n-1 = per-peer accusation counts) stacked with the raw
+    delivery planes (planes n..2n-1 = who this receiver heard — sender
+    identity as a one-hot 'value').  The update is three masked writes."""
+
+    def __init__(self, n: int, hysteresis: int):
+        self.num_values = 2 * n
+        self.h = hysteresis
+
+    # no payload() override: the counts_fn builds its planes directly,
+    # and a stray run_hist(EsfdHist) must hit the base NotImplementedError
+    # rather than feed a [S, n, n] matrix where [S, n] values are expected
+
+    def update_counts(self, state, counts, size, r, n, k: int = 0, coin=None):
+        h = self.h
+        accused = jnp.moveaxis(counts[:, :n, :] > 0, 1, 2)   # [S, j, p]
+        present = jnp.moveaxis(counts[:, n:, :] > 0, 1, 2)   # [S, j, p]
+        ls = jnp.minimum(state.last_seen + 1, h + 1)
+        ls = jnp.where(accused & ~present, h + 1, ls)
+        ls = jnp.where(present, 0, ls)
+        state = state.replace(last_seen=ls)
+        return state, jnp.zeros(size.shape, dtype=bool)
+
+
+def run_esfd_fast(state0, mix: FaultMix, max_rounds: int, hysteresis: int):
+    """◇S through the fused bitset exchange: per round, one bit-plane OR
+    pass for the accusations plus the delivery planes themselves (the
+    heard set IS the deliver matrix — no einsum needed for it).
+    Lane-exact vs the general engine (tests/test_fast.py).
+
+    `done` never fires (a failure detector runs forever); decided_fn
+    reports all-false lanes."""
+    S, n = mix.crashed.shape
+
+    def counts_fn(state, k, done, r):
+        deliver = mix_ho(mix, r) & (~done)[:, None, :]       # [S, j, i]
+        sus = state.last_seen > hysteresis                   # [S, i, p]
+        orc = jnp.einsum("sji,sip->spj", deliver.astype(jnp.int32),
+                         sus.astype(jnp.int32))              # [S, p, j]
+        heard = jnp.moveaxis(deliver.astype(jnp.int32), 1, 2)  # [S, i, j]
+        return jnp.concatenate([orc, heard], axis=1)         # [S, 2n, j]
+
+    rnd = EsfdHist(n, hysteresis)
+    return hist_scan(
+        rnd, state0, lambda s: jnp.zeros(s.last_seen.shape[:2], bool),
+        max_rounds, n, counts_fn)
+
+
 def lattice_counts(deliver, P_recv, P_send):
     """The lattice count planes ([.., m+1, n_recv]) from a delivery mask
     and the receiver/sender proposal matrices — ONE implementation shared

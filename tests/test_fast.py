@@ -783,3 +783,40 @@ def test_erb_fast_parity_and_uniformity():
     assert got.any()
     assert (dv[got] == value).all()
     assert saw_give_up  # some crashed-origin scenario starved (non-vacuity)
+
+
+def test_esfd_fast_parity_and_detection():
+    """The ◇S failure detector on the fused bitset path
+    (fast.run_esfd_fast) is lane-exact against the general engine across
+    mixed faults, and detects: after enough rounds every live lane
+    suspects the crashed processes in crash scenarios."""
+    from round_tpu.engine import scenarios
+    from round_tpu.engine.executor import run_instance
+    from round_tpu.models.failure_detector import Esfd, EsfdState
+
+    n, S, h, rounds = 12, 8, 3, 12
+    key = jax.random.PRNGKey(71)
+    mix = fast.standard_mix(key, S, n, p_drop=0.15, f=3, crash_round=0)
+    state0 = EsfdState(last_seen=jnp.zeros((S, n, n), jnp.int32))
+    state, done, _dr = fast.run_esfd_fast(state0, mix, rounds, hysteresis=h)
+
+    algo = Esfd(hysteresis=h)
+    for s in range(S):
+        res = run_instance(
+            algo, {}, n, jax.random.fold_in(key, 99 + s),
+            scenarios.from_mix_row(mix, s), max_phases=rounds,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(state.last_seen[s]), np.asarray(res.state.last_seen))
+
+    # detection: in the crash-family scenarios, every live lane suspects
+    # every crashed process (h+1 < rounds so counters saturate)
+    sus = np.asarray(state.last_seen) > h
+    crashed = np.asarray(mix.crashed)
+    hit = False
+    for s in range(S):
+        if crashed[s].any():
+            live = ~crashed[s]
+            assert sus[s][np.ix_(live, crashed[s])].all(), s
+            hit = True
+    assert hit

@@ -186,13 +186,29 @@ def check_lattice(rng, it):
 
 
 def check_tpc_kset(rng, it):
-    """Alternate TPC and KSetES fused-path checks (drawn from the rng, not
-    the global iteration parity — `it` strides by the rotation length, so
-    a parity test would silently pin one branch)."""
+    """Alternate TPC / KSetES / ESFD fused-path checks (drawn from the
+    rng, not the global iteration parity — `it` strides by the rotation
+    length, so a parity test would silently pin one branch)."""
     n = int(rng.choice([8, 12, 16]))
     S = int(rng.choice([4, 8]))
     key = jax.random.PRNGKey(int(rng.integers(0, 2**31)))
-    if int(rng.integers(0, 2)) == 0:
+    pick = int(rng.integers(0, 3))
+    if pick == 2:
+        from round_tpu.models.failure_detector import Esfd, EsfdState
+
+        h = int(rng.choice([2, 3, 5]))
+        rounds = int(rng.integers(8, 14))
+        p_drop = float(rng.choice([0.1, 0.25]))
+        mix = fast.standard_mix(key, S, n, p_drop=p_drop, f=max(1, n // 4),
+                                crash_round=0)
+        cfg = dict(kind="esfd", n=n, S=S, h=h, rounds=rounds,
+                   p_drop=p_drop, it=it)
+        state0 = EsfdState(last_seen=jnp.zeros((S, n, n), jnp.int32))
+        got = fast.run_esfd_fast(state0, mix, rounds, hysteresis=h)
+        algo = Esfd(hysteresis=h)
+        return compare_scenarios(algo, {}, got[0], mix, key,
+                                 ("last_seen",), rounds, cfg) or cfg
+    if pick == 0:
         from round_tpu.models.tpc import TwoPhaseCommit, TpcState, tpc_io
 
         p_drop = float(rng.choice([0.1, 0.25, 0.4]))
