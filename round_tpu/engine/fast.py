@@ -567,6 +567,55 @@ def run_esfd_fast(state0, mix: FaultMix, max_rounds: int, hysteresis: int):
         max_rounds, n, counts_fn)
 
 
+class ThetaHist(HistRound):
+    """Θ-model round synchronizer on the fused path
+    (models/theta.py semantics): the Some(round)/None broadcast rides
+    delivery-WEIGHTED planes — plane p carries round[p]+2 where sender p
+    fired and delivered, 0 otherwise — so the per-peer heard-max is one
+    masked maximum, no mailbox pytree."""
+
+    num_values = 1  # planes are sender-indexed, not value-indexed
+
+    def __init__(self, f: int, theta: float):
+        self.f = f
+        self.theta = float(theta)
+
+    def update_counts(self, state, counts, size, r, n, k: int = 0, coin=None):
+        from round_tpu.models.theta import _next_round_at
+
+        vals = jnp.moveaxis(counts, 1, 2)                   # [S, j, p]
+        heard = jnp.where(
+            vals > 0, jnp.maximum(state.heard, vals - 2), state.heard)
+        firing = r == state.next_round_at                   # [S, j]
+        new_round = jnp.where(firing, state.round + 1, state.round)
+        nra = jnp.where(firing, _next_round_at(self.theta, new_round),
+                        state.next_round_at)
+        state = state.replace(round=new_round, next_round_at=nra,
+                              heard=heard)
+        return state, jnp.zeros(firing.shape, dtype=bool)
+
+
+def run_theta_fast(state0, mix: FaultMix, max_rounds: int, f: int,
+                   theta: float):
+    """Θ-model through the fused exchange: one [S, j, p] weighted-plane
+    product per round (deliver ∧ sender-fired, weighted by the sender's
+    logical round).  Lane-exact vs the general engine
+    (tests/test_fast.py)."""
+    S, n = mix.crashed.shape
+    rnd = ThetaHist(f, theta)
+
+    def counts_fn(state, k, done, r):
+        deliver = mix_ho(mix, r) & (~done)[:, None, :]       # [S, j, p]
+        defined = (r == state.next_round_at)                 # [S, p] fired
+        w = jnp.where(defined, state.round + 2, 0)           # [S, p]
+        planes = deliver.astype(jnp.int32) * w[:, None, :]   # [S, j, p]
+        return jnp.moveaxis(planes, 2, 1)                    # [S, p, j]
+
+    return hist_scan(
+        rnd, state0, lambda s: jnp.zeros(s.round.shape, bool),
+        max_rounds, n, counts_fn)
+
+
 def lattice_counts(deliver, P_recv, P_send):
     """The lattice count planes ([.., m+1, n_recv]) from a delivery mask
     and the receiver/sender proposal matrices — ONE implementation shared

@@ -186,13 +186,35 @@ def check_lattice(rng, it):
 
 
 def check_tpc_kset(rng, it):
-    """Alternate TPC / KSetES / ESFD fused-path checks (drawn from the
-    rng, not the global iteration parity — `it` strides by the rotation
-    length, so a parity test would silently pin one branch)."""
+    """Alternate TPC / KSetES / ESFD / Θ fused-path checks (drawn from
+    the rng, not the global iteration parity — `it` strides by the
+    rotation length, so a parity test would silently pin one branch)."""
     n = int(rng.choice([8, 12, 16]))
     S = int(rng.choice([4, 8]))
     key = jax.random.PRNGKey(int(rng.integers(0, 2**31)))
-    pick = int(rng.integers(0, 3))
+    pick = int(rng.integers(0, 4))
+    if pick == 3:
+        from round_tpu.models.theta import ThetaModel, ThetaState, _next_round_at
+
+        theta = float(rng.choice([0.5, 1.5, 2.0]))
+        rounds = int(rng.integers(12, 22))
+        p_drop = float(rng.choice([0.1, 0.25]))
+        mix = fast.standard_mix(key, S, n, p_drop=p_drop, f=max(1, n // 4),
+                                crash_round=2)
+        cfg = dict(kind="theta", n=n, S=S, theta=theta, rounds=rounds,
+                   p_drop=p_drop, it=it)
+        state0 = ThetaState(
+            round=jnp.zeros((S, n), jnp.int32),
+            next_round_at=jnp.broadcast_to(jnp.asarray(
+                _next_round_at(theta, jnp.asarray(0, jnp.int32)),
+                jnp.int32), (S, n)),
+            heard=jnp.full((S, n, n), -1, jnp.int32),
+        )
+        got = fast.run_theta_fast(state0, mix, rounds, max(1, n // 4), theta)
+        algo = ThetaModel(f=max(1, n // 4), theta=theta)
+        return compare_scenarios(
+            algo, {}, got[0], mix, key,
+            ("round", "next_round_at", "heard"), rounds, cfg) or cfg
     if pick == 2:
         from round_tpu.models.failure_detector import Esfd, EsfdState
 
