@@ -616,6 +616,94 @@ def run_theta_fast(state0, mix: FaultMix, max_rounds: int, f: int,
         max_rounds, n, counts_fn)
 
 
+class PbftHist(HistRound):
+    """PBFT-style byzantine consensus on the fused path (models/pbft.py
+    Bcp semantics, byzantine/test/Consensus.scala:26-165): 3-subround
+    phases.
+
+      k=0 pre-prepare: three planes — heard-the-coordinator, the
+        coordinator's request and its claimed digest (adoption, digest
+        recheck, abort-to-null on silence/mismatch);
+      k=1 prepare: one plane — #heard senders whose (ok, digest) matches
+        the receiver's digest (outer scalar equality, no matmul);
+      k=2 commit: one plane — #heard PREPARED senders with a matching
+        digest; decide x or null, terminate either way."""
+
+    num_values = 3
+    phase_len = 3
+    needs_lane_ids = True  # the coordinator test is a lane-identity compare
+
+    def update_counts(self, state, counts, size, r, n, k: int = 0, coin=None,
+                      lane_ids=None):
+        from round_tpu.models.pbft import DECIDE_NULL, digest as _digest
+
+        no_exit = jnp.zeros(size.shape, dtype=bool)
+        if k == 0:
+            coord = (r // 3) % n
+            got = counts[:, 0, :] > 0
+            req = counts[:, 1, :]
+            claimed = counts[:, 2, :]
+            recomputed = _digest(req)
+            is_coord = lane_ids[None, :] == coord
+            adopt = got & ~is_coord
+            x = jnp.where(adopt, req, state.x)
+            dig = jnp.where(adopt, recomputed, state.dig)
+            valid = jnp.where(adopt, recomputed == claimed, state.valid)
+            fail = ~got | ~valid
+            state = ghost_decide(
+                state, fail,
+                jnp.full_like(state.decision, DECIDE_NULL))
+            return state.replace(x=x, dig=dig, valid=valid), fail
+        if k == 1:
+            confirmed = counts[:, 0, :]
+            return state.replace(prepared=confirmed > 2 * n // 3), no_exit
+        confirmed = counts[:, 0, :]
+        committed = confirmed > 2 * n // 3
+        state = ghost_decide(
+            state, jnp.ones_like(committed),
+            jnp.where(committed, state.x, DECIDE_NULL))
+        return state, jnp.ones(size.shape, dtype=bool)
+
+
+def run_pbft_fast(state0, mix: FaultMix, max_rounds: int = 3):
+    """PBFT through the fused exchange: guarded sends AND into the
+    delivery directly (the mask is explicit here, so there is no
+    hardwired self-delivery to correct), digest agreement as outer
+    scalar equality.  Lane-exact vs the general engine on FaultMix
+    families (tests/test_fast.py); byzantine-mask and payload-corruption
+    behavior is the general-engine suite's domain."""
+    S, n = mix.crashed.shape
+    rnd = PbftHist()
+
+    def counts_fn(state, k, done, r):
+        deliver = mix_ho(mix, r) & (~done)[:, None, :]       # [S, j, i]
+        if k == 0:
+            coord = (r // 3) % n
+            # only the coordinator's column is read, and its own send
+            # guard (id == coord) is trivially true — no column mask
+            got = jnp.take(deliver, coord, axis=2)           # [S, j]
+            req_c = jnp.take(state.x, coord, axis=1)         # [S]
+            dig_c = jnp.take(state.dig, coord, axis=1)       # [S]
+            g = got.astype(jnp.int32)
+            return jnp.stack(
+                [g,
+                 jnp.broadcast_to(req_c[:, None], g.shape),
+                 jnp.broadcast_to(dig_c[:, None], g.shape)], axis=1)
+        dig_eq = state.dig[:, :, None] == state.dig[:, None, :]  # [S, j, i]
+        if k == 1:
+            ok = state.valid[:, None, :]
+            conf = jnp.sum(
+                (deliver & ok & dig_eq).astype(jnp.int32), axis=2)
+        else:
+            prep = state.prepared[:, None, :]
+            conf = jnp.sum(
+                (deliver & prep & dig_eq).astype(jnp.int32), axis=2)
+        return conf[:, None, :]
+
+    return hist_scan(rnd, state0, lambda s: s.decided, max_rounds, n,
+                     counts_fn)
+
+
 def lattice_counts(deliver, P_recv, P_send):
     """The lattice count planes ([.., m+1, n_recv]) from a delivery mask
     and the receiver/sender proposal matrices — ONE implementation shared

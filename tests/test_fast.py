@@ -856,3 +856,52 @@ def test_theta_fast_parity():
                     err_msg=f"{field} theta={theta}")
         # the synchronizer actually advanced logical rounds
         assert int(np.asarray(state.round).max()) >= 1
+
+
+def test_pbft_fast_parity():
+    """PBFT-style byzantine consensus on the fused path
+    (fast.run_pbft_fast) is lane-exact against the general engine on
+    FaultMix families — including coordinator-crash scenarios aborting to
+    null and full-quorum scenarios committing the request."""
+    from round_tpu.engine import scenarios
+    from round_tpu.engine.executor import run_instance
+    from round_tpu.models.pbft import BcpState, PbftConsensus, digest
+
+    n, S, rounds = 12, 10, 3
+    key = jax.random.PRNGKey(91)
+    mix = fast.standard_mix(key, S, n, p_drop=0.2, f=3, crash_round=0)
+    x0 = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0, 1000,
+                            dtype=jnp.int32)
+    io = {"initial_value": x0}
+
+    state0 = BcpState(
+        x=jnp.broadcast_to(x0, (S, n)),
+        dig=jnp.broadcast_to(digest(x0), (S, n)),
+        valid=jnp.ones((S, n), bool),
+        prepared=jnp.zeros((S, n), bool),
+        decided=jnp.zeros((S, n), bool),
+        decision=jnp.full((S, n), -1, jnp.int32),
+    )
+    state, done, dround = fast.run_pbft_fast(state0, mix, max_rounds=rounds)
+
+    algo = PbftConsensus()
+    saw_commit = saw_null = False
+    for s in range(S):
+        res = run_instance(
+            algo, io, n, jax.random.fold_in(key, 99 + s),
+            scenarios.from_mix_row(mix, s), max_phases=1,
+        )
+        for field in ("x", "dig", "valid", "prepared", "decided", "decision"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(state, field)[s]),
+                np.asarray(getattr(res.state, field)), err_msg=field)
+        np.testing.assert_array_equal(
+            np.asarray(dround[s]), np.asarray(res.decided_round))
+        d = np.asarray(res.state.decision)
+        live = ~np.asarray(mix.crashed[s])
+        saw_commit |= bool((d[live] >= 0).any())
+        saw_null |= bool((d[live] == -1).any())
+        # agreement: non-null decisions of live lanes are one value
+        pos = d[live][d[live] >= 0]
+        assert len(set(pos.tolist())) <= 1, s
+    assert saw_commit and saw_null

@@ -186,13 +186,36 @@ def check_lattice(rng, it):
 
 
 def check_tpc_kset(rng, it):
-    """Alternate TPC / KSetES / ESFD / Θ fused-path checks (drawn from
+    """Alternate TPC / KSetES / ESFD / Θ / PBFT fused-path checks (drawn from
     the rng, not the global iteration parity — `it` strides by the
     rotation length, so a parity test would silently pin one branch)."""
     n = int(rng.choice([8, 12, 16]))
     S = int(rng.choice([4, 8]))
     key = jax.random.PRNGKey(int(rng.integers(0, 2**31)))
-    pick = int(rng.integers(0, 4))
+    pick = int(rng.integers(0, 5))
+    if pick == 4:
+        from round_tpu.models.pbft import BcpState, PbftConsensus, digest
+
+        p_drop = float(rng.choice([0.1, 0.25]))
+        mix = fast.standard_mix(key, S, n, p_drop=p_drop, f=max(1, n // 4),
+                                crash_round=0)
+        x0 = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0, 1000,
+                                dtype=jnp.int32)
+        cfg = dict(kind="pbft", n=n, S=S, p_drop=p_drop, it=it)
+        state0 = BcpState(
+            x=jnp.broadcast_to(x0, (S, n)),
+            dig=jnp.broadcast_to(digest(x0), (S, n)),
+            valid=jnp.ones((S, n), bool),
+            prepared=jnp.zeros((S, n), bool),
+            decided=jnp.zeros((S, n), bool),
+            decision=jnp.full((S, n), -1, jnp.int32),
+        )
+        got = fast.run_pbft_fast(state0, mix, max_rounds=3)
+        algo = PbftConsensus()
+        return compare_scenarios(
+            algo, {"initial_value": x0}, got[0], mix, key,
+            ("x", "dig", "valid", "prepared", "decided", "decision"),
+            1, cfg) or cfg
     if pick == 3:
         from round_tpu.models.theta import ThetaModel, ThetaState, _next_round_at
 
