@@ -704,6 +704,57 @@ def run_pbft_fast(state0, mix: FaultMix, max_rounds: int = 3):
                      counts_fn)
 
 
+class MutexHist(HistRound):
+    """Dijkstra's self-stabilizing token ring on the fused path
+    (models/mutex.py semantics): each lane reads exactly its LEFT
+    neighbour — one diagonal-shifted gather of the delivery matrix plus
+    the rolled value plane, no mailbox fold.  A lane that heard nothing
+    keeps x and holds no token (the EventRound timeout path)."""
+
+    num_values = 2
+    needs_lane_ids = True  # process 0's increment rule is identity-based
+
+    def update_counts(self, state, counts, size, r, n, k: int = 0, coin=None,
+                      lane_ids=None):
+        got = counts[:, 0, :] > 0
+        x_left = counts[:, 1, :]
+        is_zero = lane_ids[None, :] == 0
+        token = jnp.where(is_zero, state.x == x_left,
+                          state.x != x_left) & got
+        new_x = jnp.where(
+            is_zero,
+            jnp.where(token, (state.x + 1) % (n + 1), state.x),
+            jnp.where(token, x_left, state.x),
+        )
+        state = state.replace(
+            x=jnp.where(got, new_x, state.x),
+            has_token=token,
+        )
+        return state, jnp.zeros(size.shape, dtype=bool)
+
+
+def run_mutex_fast(state0, mix: FaultMix, max_rounds: int):
+    """The token ring through the fused exchange: plane 0 = heard the left
+    neighbour (one take_along_axis of the delivery matrix at the ring
+    shift), plane 1 = the left neighbour's value (a roll).  Lane-exact vs
+    the general engine's EventRound adapter (tests/test_fast.py)."""
+    S, n = mix.crashed.shape
+    rnd = MutexHist()
+    left = (jnp.arange(n, dtype=jnp.int32) - 1) % n
+
+    def counts_fn(state, k, done, r):
+        deliver = mix_ho(mix, r) & (~done)[:, None, :]       # [S, j, i]
+        got = jnp.take_along_axis(
+            deliver, jnp.broadcast_to(left[None, :, None], (S, n, 1)),
+            axis=2)[..., 0]                                  # [S, j]
+        x_left = state.x[:, left]                            # [S, j]
+        return jnp.stack([got.astype(jnp.int32), x_left], axis=1)
+
+    return hist_scan(
+        rnd, state0, lambda s: jnp.zeros(s.x.shape, bool), max_rounds, n,
+        counts_fn)
+
+
 def lattice_counts(deliver, P_recv, P_send):
     """The lattice count planes ([.., m+1, n_recv]) from a delivery mask
     and the receiver/sender proposal matrices — ONE implementation shared

@@ -905,3 +905,46 @@ def test_pbft_fast_parity():
         pos = d[live][d[live] >= 0]
         assert len(set(pos.tolist())) <= 1, s
     assert saw_commit and saw_null
+
+
+def test_mutex_fast_parity_and_stabilization():
+    """Dijkstra's token ring on the fused path (fast.run_mutex_fast) is
+    lane-exact against the general engine's EventRound adapter across
+    mixed faults, and on a clean ring it self-stabilizes to exactly one
+    token holder per round from an adversarial initial state."""
+    from round_tpu.engine import scenarios
+    from round_tpu.engine.executor import run_instance
+    from round_tpu.models.mutex import (
+        MutexState, SelfStabilizingMutualExclusion, mutex_io,
+    )
+
+    n, S, rounds = 10, 8, 12
+    key = jax.random.PRNGKey(101)
+    mix = fast.standard_mix(key, S, n, p_drop=0.2, f=2, crash_round=3)
+    init = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0, n + 1,
+                              dtype=jnp.int32)
+    io = mutex_io(init)
+
+    state0 = MutexState(
+        x=jnp.broadcast_to(init, (S, n)),
+        has_token=jnp.zeros((S, n), bool),
+    )
+    state, _done, _dr = fast.run_mutex_fast(state0, mix, rounds)
+
+    algo = SelfStabilizingMutualExclusion()
+    for s in range(S):
+        res = run_instance(
+            algo, io, n, jax.random.fold_in(key, 99 + s),
+            scenarios.from_mix_row(mix, s), max_phases=rounds,
+        )
+        for field in ("x", "has_token"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(state, field)[s]),
+                np.asarray(getattr(res.state, field)), err_msg=field)
+
+    # stabilization on the fault-free ring: exactly one token per round
+    clean = fast.fault_free(jax.random.fold_in(key, 7), 1, n)
+    st = MutexState(x=jnp.broadcast_to(init, (1, n)),
+                    has_token=jnp.zeros((1, n), bool))
+    st2, _d, _r = fast.run_mutex_fast(st, clean, 3 * n)
+    assert int(np.asarray(st2.has_token).sum()) == 1
