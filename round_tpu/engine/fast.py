@@ -755,6 +755,44 @@ def run_mutex_fast(state0, mix: FaultMix, max_rounds: int):
         counts_fn)
 
 
+class CgolHist(HistRound):
+    """Conway's Game of Life on the fused path (models/gameoflife.py):
+    one alive-neighbour count plane per round; the torus overlay is a
+    static dest mask ANDed into the delivery (its empty diagonal also
+    cancels the HO formula's self-loop — no correction needed)."""
+
+    num_values = 1
+
+    def update_counts(self, state, counts, size, r, n, k: int = 0, coin=None):
+        alive_nbrs = counts[:, 0, :]
+        survive = state.alive & ((alive_nbrs == 2) | (alive_nbrs == 3))
+        born = ~state.alive & (alive_nbrs == 3)
+        state = state.replace(alive=survive | born)
+        return state, jnp.zeros(size.shape, dtype=bool)
+
+
+def run_gol_fast(state0, mix: FaultMix, neighbours, max_rounds: int):
+    """Game of Life through the fused exchange: the overlay topology is
+    a point-to-multipoint dest mask (neither broadcast nor unicast —
+    the capability this example exists to exercise), applied as one AND
+    on the delivery; the B3/S23 count is a single [n, n] masked matvec.
+    Lane-exact vs the general engine incl. lossy-overlay mixes
+    (tests/test_fast.py)."""
+    S, n = mix.crashed.shape
+    rnd = CgolHist()
+    dest_t = jnp.asarray(neighbours).T[None]                 # [1, j, i]
+
+    def counts_fn(state, k, done, r):
+        deliver = mix_ho(mix, r) & (~done)[:, None, :] & dest_t
+        return jnp.einsum(
+            "sji,si->sj", deliver.astype(jnp.int32),
+            state.alive.astype(jnp.int32))[:, None, :]
+
+    return hist_scan(
+        rnd, state0, lambda s: jnp.zeros(s.alive.shape, bool), max_rounds,
+        n, counts_fn)
+
+
 def lattice_counts(deliver, P_recv, P_send):
     """The lattice count planes ([.., m+1, n_recv]) from a delivery mask
     and the receiver/sender proposal matrices — ONE implementation shared

@@ -948,3 +948,46 @@ def test_mutex_fast_parity_and_stabilization():
                     has_token=jnp.zeros((1, n), bool))
     st2, _d, _r = fast.run_mutex_fast(st, clean, 3 * n)
     assert int(np.asarray(st2.has_token).sum()) == 1
+
+
+def test_gol_fast_parity_and_glider():
+    """Game of Life on the fused path (fast.run_gol_fast): the torus
+    overlay as a point-to-multipoint dest mask.  Lane-exact vs the
+    general engine on both clean and lossy networks, and on the clean
+    torus a glider translates by (1, 1) every 4 generations."""
+    from round_tpu.engine import scenarios
+    from round_tpu.engine.executor import run_instance
+    from round_tpu.models.gameoflife import (
+        CgolState, ConwayGameOfLife, cgol_io, torus_neighbours,
+    )
+
+    rows = cols = 5
+    n, S, rounds = rows * cols, 6, 8
+    key = jax.random.PRNGKey(111)
+    mix = fast.standard_mix(key, S, n, p_drop=0.15, f=4, crash_round=2)
+    grid = np.zeros((rows, cols), dtype=bool)
+    for r_, c_ in ((0, 1), (1, 2), (2, 0), (2, 1), (2, 2)):  # glider
+        grid[r_, c_] = True
+    io = cgol_io(grid)
+    nb = torus_neighbours(rows, cols)
+
+    state0 = CgolState(
+        alive=jnp.broadcast_to(jnp.asarray(io["alive"], bool), (S, n)))
+    state, _d, _r = fast.run_gol_fast(state0, mix, nb, rounds)
+
+    algo = ConwayGameOfLife(rows, cols)
+    for s in range(S):
+        res = run_instance(
+            algo, io, n, jax.random.fold_in(key, 99 + s),
+            scenarios.from_mix_row(mix, s), max_phases=rounds,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(state.alive[s]), np.asarray(res.state.alive), s)
+
+    # clean torus: the glider translates by (1, 1) after 4 generations
+    clean = fast.fault_free(jax.random.fold_in(key, 7), 1, n)
+    st = CgolState(alive=jnp.asarray(io["alive"], bool)[None])
+    st2, _d2, _r2 = fast.run_gol_fast(st, clean, nb, 4)
+    got = np.asarray(st2.alive[0]).reshape(rows, cols)
+    want = np.roll(np.roll(grid, 1, axis=0), 1, axis=1)
+    np.testing.assert_array_equal(got, want)
