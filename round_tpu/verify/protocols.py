@@ -17,7 +17,7 @@ from round_tpu.verify.cl import ClConfig
 from round_tpu.verify.formula import (
     And, Application, Binding, Bool, Card, Comprehension, Eq, Exists, FORALL,
     ForAll, FSet, Formula, FunT, Geq, Gt, Implies, In, Int, IntLit, Leq,
-    Literal, Lt, Minus, Not, OR, Or, Plus, Times, UnInterpretedFct,
+    Literal, Lt, Minus, Not, OR, Or, Plus, TRUE, Times, UnInterpretedFct,
     Variable,
     procType,
 )
@@ -168,15 +168,32 @@ def tpc_spec() -> ProtocolSpec:
 def otr_spec() -> ProtocolSpec:
     """The one-third-rule consensus round.
 
-    State: x (current estimate), decided, dec.  Everyone broadcasts x; with
-    |HO(j)| > 2n/3 (the safety predicate, Otr.scala:28) process j sets
-    x′ = the most-often-received value (axiomatized function mor(j)), and
-    decides when some value fills more than 2n/3 of its mailbox.
+    State: x (current estimate), decided, dec.  Everyone broadcasts x; a
+    receiver whose mailbox holds more than 2n/3 payloads (for a broadcast
+    round |mb(j)| = |HO(j)| by mailboxLink) sets x′ = the most-often-received
+    value (axiomatized function mor(j)) and decides when some value fills
+    more than 2n/3 of its mailbox; a receiver WITHOUT the quorum keeps its
+    state unchanged — the guard of Otr.scala's update (the round-4 spec
+    baked the quorum into a standing safety predicate instead; the guard
+    restores the faithful model, which is what makes the liveness ladder's
+    no-magic negative control meaningful).
 
     Invariant (Otr.scala:95-120): ∃v with 3·|{i | x(i)=v}| > 2n and every
     decided process carries v.  Preservation is the one-third-rule argument:
-    under the invariant every receiver's most-often value IS v, so v's
-    support grows to n.
+    under the invariant every receiver that updates adopts v, so v's
+    support never shrinks.
+
+    LIVENESS (the magic-round ladder, logic/OtrExample.scala:50-57 +
+    verification/Verifier.scala:144-157): invariants[1] = invariantProgress1
+    (a value held unanimously, decisions pinned), invariants[2] =
+    invariantProgress2 (everyone decided, one value).  The liveness
+    predicate for both steps is the magic-round HO assumption — here the
+    per-receiver cardinality form ∀j. 3|HO(j)| > 2n, the exact hypothesis
+    the one-third-rule argument consumes (the reference's ∃A common-set
+    form implies it; the common set is not needed).  The reference
+    `ignore`s its magic-round tests ("z3 takes quite a bit of memory",
+    OtrExample.scala:155-174); here the progress VCs discharge through the
+    staged-chain machinery.
     """
     sig = StateSig({
         "x": Int,
@@ -202,6 +219,11 @@ def otr_spec() -> ProtocolSpec:
             [kk], And(In(kk, ho_of(jj)), Eq(sig.get("x", kk), val))
         )
 
+    def quorum(jj):
+        # the update guard: > 2n/3 payloads heard (Otr.scala's mailbox
+        # check; |mb| = |HO| for a broadcast round)
+        return Gt(Times(3, Card(ho_of(jj))), Times(2, N))
+
     def mor_axioms() -> List[Formula]:
         # mor(j) is most-often: its support in HO(j) is ≥ any value's support
         return [
@@ -215,7 +237,7 @@ def otr_spec() -> ProtocolSpec:
         newx = Eq(s.get_primed("x", jj), mor_of(jj))
         # decide when mor's support exceeds 2n/3 (Otr.scala decision rule)
         decide_cond = Gt(Times(3, Card(support(jj, mor_of(jj)))), Times(2, N))
-        return And(
+        act = And(
             newx,
             Implies(
                 decide_cond,
@@ -232,6 +254,11 @@ def otr_spec() -> ProtocolSpec:
                 ),
             ),
         )
+        return And(
+            Implies(quorum(jj), act),
+            Implies(Not(quorum(jj)),
+                    s.frame_equal(["x", "decided", "dec"], jj)),
+        )
 
     rnd = RoundTR(
         sig=sig,
@@ -241,8 +268,10 @@ def otr_spec() -> ProtocolSpec:
         aux=mor_axioms,
     )
 
-    # safety predicate: every round, every receiver hears > 2n/3 processes
-    safety = ForAll([j], Gt(Times(3, Card(ho_of(j))), Times(2, N)))
+    # no standing communication assumption: quorums are per-receiver (the
+    # update guard) and, for progress, supplied by the magic round
+    safety = TRUE
+    magic = ForAll([j], quorum(j))
 
     # the invariant: ∃v. 3|{i | x(i)=v}| > 2n ∧ ∀i. decided(i) → dec(i)=v
     def support_global(val):
@@ -272,27 +301,36 @@ def otr_spec() -> ProtocolSpec:
         Exists([v], ForAll([i], Eq(sig.get("x", i), v))),
     )
 
-    # -- staged inductiveness chain (the monolithic inv ∧ TR ⊨ inv′ blows
-    # up, exactly as the reference notes for its suites; the chain below is
-    # the one-third-rule preservation argument as ∃-elimination).
-    # Composition: v is the invariant's skolemized witness, j0 an arbitrary
-    # receiver (A's conclusion ∀-generalizes to B's hypothesis), and the
-    # hypotheses of B/C/D are subformulas of the TR (the x′/decide update
-    # equations and the mor axiom) plus earlier conclusions.  inv′'s
-    # witness is the same v.
+    # -- the liveness ladder invariants (OtrExample.scala:50-57) ----------
+    # invariantProgress1: one value held unanimously, decisions pinned.
+    # Stated pointwise (∀i x=v) rather than via Card(A)=n — equivalent on
+    # the process universe and what the reducer's instantiation consumes.
+    p1 = Exists([v], And(
+        ForAll([i], Eq(sig.get("x", i), v)),
+        ForAll([i], Implies(sig.get("decided", i),
+                            Eq(sig.get("dec", i), v))),
+    ))
+    # invariantProgress2: everyone decided one value (x pinned too — the
+    # update keeps rewriting x, so stability needs it in the rung)
+    p2 = Exists([v], ForAll([i], And(
+        sig.get("decided", i),
+        Eq(sig.get("dec", i), v),
+        Eq(sig.get("x", i), v),
+    )))
+    termination = ForAll([i], sig.get("decided", i))
+
+    # -- staged chains (the monolithic VCs blow up, exactly as the
+    # reference notes for its suites; each chain below is one argument as
+    # ∃-elimination with machine-checked composition).
+    # Composition: v is the rung's skolemized witness, j0 an arbitrary
+    # receiver whose quorum guard is an ASSUMPTION-SCOPED stage (∨-elim on
+    # the per-receiver guard happens at the consuming stages), and the
+    # hypotheses of later stages are subformulas of the TR plus ∀-closed
+    # earlier conclusions.
     vfree = Variable("v!w", Int)
     j0 = Variable("j0", procType)
     maj_Sv = Gt(Times(3, Card(support_global(vfree))), Times(2, N))
-    x_all = ForAll([j], Eq(sig.get_primed("x", j), mor_of(j)))
-    mor_all_v = ForAll([j], Eq(mor_of(j), vfree))
-    dec_cond = Gt(Times(3, Card(support(j, mor_of(j)))), Times(2, N))
-    tr_decide = ForAll([j], And(
-        Implies(dec_cond, And(sig.get_primed("decided", j),
-                              Eq(sig.get_primed("dec", j), mor_of(j)))),
-        Implies(Not(dec_cond),
-                And(Eq(sig.get_primed("decided", j), sig.get("decided", j)),
-                    Eq(sig.get_primed("dec", j), sig.get("dec", j)))),
-    ))
+    q_j0 = quorum(j0)
     # same bound-variable name as support_global so the final composition
     # VC's card terms line up with inv′'s comprehension syntactically
     sup_prime = Comprehension(
@@ -301,91 +339,247 @@ def otr_spec() -> ProtocolSpec:
     )
     c31 = ClConfig(venn_bound=3, inst_depth=1)
     c21 = ClConfig(venn_bound=2, inst_depth=1)
-    staged_inv0 = [
-        ("A: mor(j0) = v (one-third rule)",
-         # the mor axiom INSTANCE at (j0, v) — author-supplied
-         # instantiation of rnd.aux's ∀j,w clause (the full clause makes
-         # the venn group explode; the instance is what the argument uses)
-         And(maj_Sv, Gt(Times(3, Card(ho_of(j0))), Times(2, N)),
-             Geq(Card(support(j0, mor_of(j0))), Card(support(j0, vfree)))),
-         Eq(mor_of(j0), vfree), c31),
-        ("B: everyone adopts v",
-         And(mor_all_v, x_all),
-         ForAll([i], Eq(sig.get_primed("x", i), vfree)), c21),
-        ("C: v's new support is a supermajority",
-         And(ForAll([i], Eq(sig.get_primed("x", i), vfree)),
-             Gt(Times(3, Card(ho_of(j0))), Times(2, N))),
-         Gt(Times(3, Card(sup_prime)), Times(2, N)), c21),
-        ("D: decisions stay pinned to v",
-         And(mor_all_v,
-             ForAll([i], Implies(sig.get("decided", i),
-                                 Eq(sig.get("dec", i), vfree))),
-             tr_decide),
-         ForAll([i], Implies(sig.get_primed("decided", i),
-                             Eq(sig.get_primed("dec", i), vfree))), c21),
-    ]
+    c02 = ClConfig(venn_bound=0, inst_depth=2)
+    c01 = ClConfig(venn_bound=0, inst_depth=1)
 
-    # machine-checked composition (StagedChain): the invariant's ∃v is
-    # eliminated once up front (vfree carries majority + pinned decisions),
-    # each stage's hypothesis is then re-derived from the intro fact ∧ the
-    # ∀-closed earlier conclusions ∧ (pruned, membership-checked) conjuncts
-    # of H, and the closed chain must entail inv′ — every arrow of the old
-    # author-supplied argument is now its own VC
     pinned_v = ForAll([i], Implies(sig.get("decided", i),
                                    Eq(sig.get("dec", i), vfree)))
+    pinned_v_prime = ForAll([i], Implies(sig.get_primed("decided", i),
+                                         Eq(sig.get_primed("dec", i), vfree)))
+    x_all_v = ForAll([i], Eq(sig.get("x", i), vfree))
+    x_all_v_prime = ForAll([i], Eq(sig.get_primed("x", i), vfree))
+    # p2's body at the witness (shared by chain_p2_inductive and
+    # chain_progress_12 — ONE construction so prune membership and final
+    # ∧-elimination can never desynchronize)
+    dec_all = ForAll([i], And(
+        sig.get("decided", i),
+        Eq(sig.get("dec", i), vfree),
+        Eq(sig.get("x", i), vfree),
+    ))
+    dec_all_prime = ForAll([i], And(
+        sig.get_primed("decided", i),
+        Eq(sig.get_primed("dec", i), vfree),
+        Eq(sig.get_primed("x", i), vfree),
+    ))
     tr_parts = get_conjuncts(rnd.full_tr())
     payload_forall, update_forall, mor_ax = tr_parts
-    closure_A = ForAll([j0], Eq(mor_of(j0), vfree))  # as the verifier closes it
-    c_B = staged_inv0[1][2]
-    c_C = staged_inv0[2][2]
-    c_D = staged_inv0[3][2]
-    nA, nB, nC, nD = (s[0] for s in staged_inv0)
-    c01 = ClConfig(venn_bound=0, inst_depth=1)
-    chain = StagedChain(
-        stages=staged_inv0,
-        intros=[([vfree], And(maj_Sv, pinned_v), c21)],
-        prune={
-            "intro:0": [inv],
-            # A's hyp conjuncts: maj_Sv | HO majority | mor-axiom instance
-            f"justify:{nA}#0": [maj_Sv],
-            f"justify:{nA}#1": [safety],
-            f"justify:{nA}#2": [mor_ax],
-            # B: mor_all_v from A's ∀-closure; x_all from the update ∀
-            f"justify:{nB}#0": [closure_A],
-            f"justify:{nB}#1": [update_forall],
-            # C: the adopted-x fact is B's conclusion; HO majority
-            f"justify:{nC}#0": [c_B],
-            f"justify:{nC}#1": [safety],
-            # D: mor_all_v | pinned decisions (intro fact) | decide update
-            f"justify:{nD}#0": [closure_A],
-            f"justify:{nD}#1": [pinned_v],
-            f"justify:{nD}#2": [update_forall],
-            "final": [c_C, c_D],
-        },
-        just_configs={
-            f"justify:{nA}#0": c01,
-            f"justify:{nA}#1": c01,
-            f"justify:{nA}#2": c01,
-            f"justify:{nB}#0": c01,
-            f"justify:{nB}#1": c01,
-            f"justify:{nC}#0": ClConfig(venn_bound=2, inst_depth=1),
-            f"justify:{nC}#1": c01,
-            f"justify:{nD}#0": c01,
-            f"justify:{nD}#1": c01,
-            f"justify:{nD}#2": c01,
-        },
-        final_config=c01,
-    )
+    mor_inst = Geq(Card(support(j0, mor_of(j0))), Card(support(j0, vfree)))
+
+    # the scoped one-third-rule stage: under the receiver's quorum guard,
+    # a 2n/3-supported v forces mor(j0) = v.  Closure (the machinery's
+    # ∀-intro over the fresh j0 with the assumption as antecedent):
+    nA = "A: mor(j0) = v (one-third rule)"
+    stage_A = (nA, And(maj_Sv, mor_inst), Eq(mor_of(j0), vfree), c31)
+    closure_A = ForAll([j0], Implies(q_j0, Eq(mor_of(j0), vfree)))
+
+    # the unanimous twin: when EVERYONE holds v, a quorate receiver's mor
+    # is v (support(j0, v) fills HO(j0), so mor's support is all of a
+    # nonempty HO — attainment pins mor to a heard value)
+    nE = "E: mor(j0) = v (unanimous senders)"
+    stage_E = (nE, And(x_all_v, mor_inst), Eq(mor_of(j0), vfree), c31)
+    closure_E = closure_A  # same closed formula shape
+
+    def chain_inv0() -> StagedChain:
+        """inv ∧ TR ⊨ inv′: the one-third-rule preservation argument under
+        the guard — updaters adopt v (stage A), keepers keep x, so S_v
+        only grows (stage C) and decisions stay pinned (stage D)."""
+        nB = "B: updaters adopt v, keepers keep x"
+        c_B = ForAll([i], And(
+            Implies(quorum(i), Eq(sig.get_primed("x", i), vfree)),
+            Implies(Not(quorum(i)),
+                    Eq(sig.get_primed("x", i), sig.get("x", i))),
+        ))
+        nC = "C: v's support persists as a supermajority"
+        c_C = Gt(Times(3, Card(sup_prime)), Times(2, N))
+        nD = "D: decisions stay pinned to v"
+        stages = [
+            stage_A,
+            (nB, And(closure_A, update_forall), c_B, c02),
+            (nC, And(c_B, maj_Sv), c_C, c21),
+            (nD, And(closure_A, pinned_v, update_forall),
+             pinned_v_prime, c21),
+        ]
+        return StagedChain(
+            stages=stages,
+            intros=[([vfree], And(maj_Sv, pinned_v), c21)],
+            assumes={nA: q_j0},
+            prune={
+                "intro:0": [inv],
+                f"justify:{nA}#0": [maj_Sv],
+                f"justify:{nA}#1": [mor_ax],
+                f"justify:{nB}#0": [closure_A],
+                f"justify:{nB}#1": [update_forall],
+                f"justify:{nC}#0": [c_B],
+                f"justify:{nC}#1": [maj_Sv],
+                f"justify:{nD}#0": [closure_A],
+                f"justify:{nD}#1": [pinned_v],
+                f"justify:{nD}#2": [update_forall],
+                "final": [c_C, pinned_v_prime],
+            },
+            just_configs={
+                f"justify:{nA}#0": c01,
+                f"justify:{nA}#1": c01,
+            },
+            final_config=c01,
+        )
+
+    def chain_p1_inductive() -> StagedChain:
+        """p1 ∧ TR ⊨ p1′ (no liveness needed): updaters adopt v by the
+        unanimity argument (stage E), keepers already hold v."""
+        nB = "B: everyone still holds v"
+        stages = [
+            stage_E,
+            (nB, And(closure_E, x_all_v, update_forall), x_all_v_prime,
+             c02),
+            ("D: decisions stay pinned to v",
+             And(closure_E, pinned_v, update_forall), pinned_v_prime, c21),
+        ]
+        nD = stages[2][0]
+        return StagedChain(
+            stages=stages,
+            intros=[([vfree], And(x_all_v, pinned_v), c21)],
+            assumes={nE: q_j0},
+            prune={
+                "intro:0": [p1],
+                f"justify:{nE}#0": [x_all_v],
+                f"justify:{nE}#1": [mor_ax],
+                f"justify:{nB}#0": [closure_E],
+                f"justify:{nB}#1": [x_all_v],
+                f"justify:{nB}#2": [update_forall],
+                f"justify:{nD}#0": [closure_E],
+                f"justify:{nD}#1": [pinned_v],
+                f"justify:{nD}#2": [update_forall],
+                "final": [x_all_v_prime, pinned_v_prime],
+            },
+            just_configs={
+                f"justify:{nE}#0": c01,
+                f"justify:{nE}#1": c01,
+            },
+            final_config=c01,
+        )
+
+    def chain_p2_inductive() -> StagedChain:
+        """p2 ∧ TR ⊨ p2′: with everyone decided on v and holding v, a
+        quorate update re-adopts v (stage E) and any re-decision re-pins
+        v; keepers are framed."""
+        nG = "G: everyone stays decided on v"
+        stages = [
+            stage_E,
+            (nG, And(closure_E, dec_all, update_forall), dec_all_prime,
+             c02),
+        ]
+        return StagedChain(
+            stages=stages,
+            intros=[([vfree], dec_all, c21)],
+            assumes={nE: q_j0},
+            prune={
+                "intro:0": [p2],
+                # E's x_all_v hypothesis is derived from dec_all (its x
+                # conjunct), not pruned-verbatim — a real justification VC
+                f"justify:{nE}#0": [dec_all],
+                f"justify:{nE}#1": [mor_ax],
+                f"justify:{nG}#0": [closure_E],
+                f"justify:{nG}#1": [dec_all],
+                f"justify:{nG}#2": [update_forall],
+                "final": [dec_all_prime],
+            },
+            just_configs={
+                f"justify:{nE}#0": c01,
+                f"justify:{nE}#1": c01,
+            },
+            final_config=c01,
+        )
+
+    def chain_progress_01() -> StagedChain:
+        """inv ∧ magic ∧ TR ⊨ p1′ — the reference's "1st magic round"
+        (OtrExample.scala:155-165, `ignore`d there): with every receiver
+        quorate, every receiver updates and the one-third rule makes every
+        update adopt v."""
+        nB = "B: everyone adopts v under the magic round"
+        stages = [
+            stage_A,
+            (nB, And(closure_A, magic, update_forall), x_all_v_prime, c02),
+            ("D: decisions stay pinned to v",
+             And(closure_A, pinned_v, update_forall), pinned_v_prime, c21),
+        ]
+        nD = stages[2][0]
+        return StagedChain(
+            stages=stages,
+            intros=[([vfree], And(maj_Sv, pinned_v), c21)],
+            assumes={nA: q_j0},
+            prune={
+                "intro:0": [inv],
+                f"justify:{nA}#0": [maj_Sv],
+                f"justify:{nA}#1": [mor_ax],
+                f"justify:{nB}#0": [closure_A],
+                f"justify:{nB}#1": [magic],
+                f"justify:{nB}#2": [update_forall],
+                f"justify:{nD}#0": [closure_A],
+                f"justify:{nD}#1": [pinned_v],
+                f"justify:{nD}#2": [update_forall],
+                "final": [x_all_v_prime, pinned_v_prime],
+            },
+            just_configs={
+                f"justify:{nA}#0": c01,
+                f"justify:{nA}#1": c01,
+            },
+            final_config=c01,
+        )
+
+    def chain_progress_12() -> StagedChain:
+        """p1 ∧ magic ∧ TR ⊨ p2′ — the reference's "2nd magic round"
+        (OtrExample.scala:174-182, `ignore`d there): with unanimity and
+        every receiver quorate, every receiver's decide condition fires on
+        v (its support fills the quorate mailbox)."""
+        nF = "F: everyone decides v under the magic round"
+        stages = [
+            stage_E,
+            (nF, And(closure_E, magic, x_all_v, update_forall),
+             dec_all_prime, c31),
+        ]
+        return StagedChain(
+            stages=stages,
+            intros=[([vfree], And(x_all_v, pinned_v), c21)],
+            assumes={nE: q_j0},
+            prune={
+                "intro:0": [p1],
+                f"justify:{nE}#0": [x_all_v],
+                f"justify:{nE}#1": [mor_ax],
+                f"justify:{nF}#0": [closure_E],
+                f"justify:{nF}#1": [magic],
+                f"justify:{nF}#2": [x_all_v],
+                f"justify:{nF}#3": [update_forall],
+                "final": [dec_all_prime],
+            },
+            just_configs={
+                f"justify:{nE}#0": c01,
+                f"justify:{nE}#1": c01,
+            },
+            final_config=c01,
+        )
 
     return ProtocolSpec(
         sig=sig,
         rounds=[rnd],
         init=init,
-        invariants=[inv],
-        properties=[("agreement", agreement)],
+        invariants=[inv, p1, p2],
+        properties=[
+            # phase-indexed hypotheses (4th element): agreement must prove
+            # from the always-inductive rung 0 ALONE; termination is what
+            # rung 2 means (OtrExample.scala:119-121)
+            ("agreement", agreement, None, 0),
+            ("termination", termination, None, 2),
+        ],
         safety_predicate=safety,
+        liveness=[magic, magic],
         config=ClConfig(venn_bound=3, inst_depth=1),
-        staged={"invariant 0 inductive at round 0": chain},
+        staged={
+            "invariant 0 inductive at round 0": chain_inv0(),
+            "invariant 1 inductive at round 0": chain_p1_inductive(),
+            "invariant 2 inductive at round 0": chain_p2_inductive(),
+            "progress 0→1 via round 0": chain_progress_01(),
+            "progress 1→2 via round 0": chain_progress_12(),
+        },
     )
 
 

@@ -113,7 +113,11 @@ class ProtocolSpec:
     rounds: List[RoundTR]
     init: Formula                      # initial-state relation (over fields)
     invariants: List[Formula]          # invariants[k] holds from phase k on
-    properties: List[Tuple[str, Formula]] = dataclasses.field(default_factory=list)
+    # (name, formula[, ClConfig-or-None[, from_inv]]): from_inv picks the
+    # ONE ladder rung the property proves from; REQUIRED when the spec has
+    # more than one invariant (the later rungs only hold after magic
+    # rounds, so the all-rungs conjunction is not a sound default there)
+    properties: List[Tuple] = dataclasses.field(default_factory=list)
     safety_predicate: Formula = TRUE   # communication assumption, every round
     liveness: List[Formula] = dataclasses.field(default_factory=list)
     config: Optional[ClConfig] = None
@@ -138,6 +142,19 @@ class ProtocolSpec:
     # hypothesis (properties must hold at every reachable state, which the
     # safety-core invariant alone covers)
     round_staged_init: Optional[Formula] = None
+    # the multi-round liveness walk (the reference's checkProgress over the
+    # roundInvariants second elements, Verifier.scala:144-157 +
+    # LastVoting.scala:49-61): entries (name, hyp, tr, concl) chain through
+    # ONE phase under the liveness environment — hyp_{k+1} is concl_k's
+    # unprimed form, every hyp conjoins the phase's liveness predicate, and
+    # concl is primed by the author.  Soundness of the walk's composition
+    # is induction over the phase's round sequence: if the liveness env
+    # holds for all rounds of one phase, chaining the VCs yields the final
+    # conclusion at phase end.  Protocols whose single-round TRs can't
+    # realize progress (LastVoting: deciding takes the whole 4-round
+    # phase) use this instead of the invariants-ladder `liveness` path.
+    phase_progress: List[Tuple[str, Formula, Formula, Formula]] = \
+        dataclasses.field(default_factory=list)
 
 
 class Verifier:
@@ -218,26 +235,69 @@ class Verifier:
         # any round of the phase may realize it
         for k in range(len(spec.invariants) - 1):
             live = spec.liveness[k] if k < len(spec.liveness) else TRUE
-            children = [
-                SingleVC(
-                    f"progress {k}→{k + 1} via round {r_idx}",
-                    And(spec.invariants[k], live),
-                    And(spec.safety_predicate, rnd.full_tr()),
-                    sig.prime(spec.invariants[k + 1]),
-                )
-                for r_idx, rnd in enumerate(spec.rounds)
-            ]
+            children = []
+            for r_idx, rnd in enumerate(spec.rounds):
+                name = f"progress {k}→{k + 1} via round {r_idx}"
+                hyp = And(spec.invariants[k], live)
+                tr = And(spec.safety_predicate, rnd.full_tr())
+                concl = sig.prime(spec.invariants[k + 1])
+                if name in spec.staged:
+                    children.append(
+                        self._staged_vc(name, And(hyp, tr), concl)
+                    )
+                else:
+                    children.append(SingleVC(name, hyp, tr, concl))
             if children:
                 vcs.append(CompositeVC(
                     f"progress {k}→{k + 1}", False, children,
                 ))
 
+        # the phase-walk liveness ladder (see ProtocolSpec.phase_progress)
+        if spec.phase_progress:
+            children = []
+            for name, hyp, tr, concl in spec.phase_progress:
+                tr = And(spec.safety_predicate, tr)
+                if name in spec.staged:
+                    children.append(
+                        self._staged_vc(name, And(hyp, tr), concl)
+                    )
+                else:
+                    children.append(SingleVC(name, hyp, tr, concl,
+                                             timeout_s=420.0))
+            vcs.append(CompositeVC(
+                "progress (phase liveness walk)", True, children,
+            ))
+
         for prop in spec.properties:
             name, formula = prop[0], prop[1]
             pcfg = prop[2] if len(prop) > 2 else None
-            inv_all = And(*spec.invariants) if spec.invariants else TRUE
+            # optional 4th element: the index of the ONE invariant this
+            # property is proved from (the phase-indexed ladder semantics —
+            # invariants[k] holds from phase k on, so e.g. termination
+            # proves from the final rung while agreement must prove from
+            # the always-inductive rung 0 alone, not from the conjunction
+            # of rungs that only hold after magic rounds)
+            from_inv = prop[3] if len(prop) > 3 else None
+            if from_inv is None:
+                if len(spec.invariants) > 1:
+                    # rungs past 0 hold only after magic rounds — proving
+                    # a property from their conjunction would let a
+                    # formula false at reachable pre-magic states verify
+                    raise ValueError(
+                        f"property {name!r}: a phase-ladder spec "
+                        f"({len(spec.invariants)} invariants) requires an "
+                        "explicit from_inv (4th tuple element)"
+                    )
+                hyp = And(*spec.invariants) if spec.invariants else TRUE
+            else:
+                if not (0 <= from_inv < len(spec.invariants)):
+                    raise ValueError(
+                        f"property {name!r}: from_inv={from_inv} out of "
+                        f"range for {len(spec.invariants)} invariants"
+                    )
+                hyp = spec.invariants[from_inv]
             vcs.append(SingleVC(
-                f"property: {name}", inv_all, TRUE, formula, config=pcfg,
+                f"property: {name}", hyp, TRUE, formula, config=pcfg,
             ))
         if self._staged_unused:
             # an unconsumed staged key means a renamed/shifted VC would
@@ -254,6 +314,11 @@ class Verifier:
                     for k in range(len(spec.invariants))
                     for r in range(len(spec.rounds))
                 ]
+            matchable += [
+                f"progress {k}→{k + 1} via round {r}"
+                for k in range(len(spec.invariants) - 1)
+                for r in range(len(spec.rounds))
+            ] + [name for name, *_rest in spec.phase_progress]
             raise ValueError(
                 "staged chains matched no generated VC: "
                 f"{sorted(self._staged_unused)} (matchable: {matchable})"
