@@ -46,6 +46,16 @@ def main(argv=None) -> int:
     ap.add_argument("--proto", choices=["tcp", "udp"], default="tcp",
                     help="native transport: tcp (framed/reconnecting) or "
                          "udp (the reference's default perf transport)")
+    ap.add_argument("--no-send-when-catching-up", dest="send_when_catching_up",
+                    action="store_false", default=True,
+                    help="skip sending a round's messages when a peer was "
+                         "already observed past it (RuntimeOptions."
+                         "sendWhenCatchingUp=false)")
+    ap.add_argument("--delay-first-send", dest="delay_first_send_ms",
+                    type=int, default=-1, metavar="MS",
+                    help="sleep MS before the first round's send "
+                         "(RuntimeOptions.delayFirstSend; start-skew "
+                         "injection)")
     from round_tpu.runtime.log import add_verbosity_flags, configure_from_args
 
     add_verbosity_flags(ap)
@@ -69,6 +79,8 @@ def main(argv=None) -> int:
             runner = HostRunner(
                 algo, args.id, peers, tr, instance_id=args.instance,
                 timeout_ms=args.timeout_ms, seed=args.seed,
+                send_when_catching_up=args.send_when_catching_up,
+                delay_first_send_ms=args.delay_first_send_ms,
             )
             res = runner.run(
                 {"initial_value": np.int32(args.value)},
@@ -103,6 +115,8 @@ def main(argv=None) -> int:
             algo, args.id, peers, tr, args.instances,
             timeout_ms=args.timeout_ms, seed=args.seed,
             base_value=args.value, max_rounds=args.max_rounds,
+            send_when_catching_up=args.send_when_catching_up,
+            delay_first_send_ms=args.delay_first_send_ms,
         )
         wall = time.perf_counter() - t0
         ok = sum(1 for d in decisions if d is not None)

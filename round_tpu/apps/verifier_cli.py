@@ -49,6 +49,9 @@ _LEMMA_SUITES = {
     "floodmin": ("round_tpu.verify.protocols", "floodmin_extracted_lemmas"),
     "kset": ("round_tpu.verify.protocols", "kset_extracted_lemmas"),
     "benor": ("round_tpu.verify.protocols", "benor_extracted_lemmas"),
+    # the view-change selection safety skeleton (the reference ships only
+    # an unwired sketch, example/byzantine/pbft/ViewChange.scala)
+    "pbft": ("round_tpu.verify.protocols", "pbft_vc_extracted_lemmas"),
 }
 
 
@@ -88,7 +91,7 @@ def run_lemma_suite(name: str, verbose: bool) -> bool:
 def main(argv=None) -> bool:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("protocol",
-                    help="tpc | otr | lv | erb | floodmin | kset | benor")
+                    help="tpc | otr | lv | erb | floodmin | kset | benor | pbft")
     ap.add_argument("-r", "--report", default=None,
                     help="write an HTML report to this path")
     ap.add_argument("-v", "--verbose", action="store_true")

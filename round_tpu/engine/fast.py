@@ -416,7 +416,14 @@ class ErbHist(HistRound):
     two coincide exactly on ERB's protocol class — every defined sender
     of one instance carries the ORIGINATOR's value (the flooding
     invariant `verifier_cli erb` proves) — which is why the differential
-    parity below is still lane-exact on protocol-generated runs."""
+    parity below is still lane-exact on protocol-generated runs.
+
+    CONTRACT (do NOT reuse outside the flooding-invariant class): any
+    round family where concurrently-defined senders may broadcast
+    DIFFERENT values in the same exchange would make min-of-heard and
+    lowest-sender-id adoption diverge silently.  Multi-writer broadcast
+    needs its own HistRound with an explicit tie-break matching the
+    general engine, not this class."""
 
     def __init__(self, n_values: int):
         from round_tpu.models.erb import GIVE_UP_ROUND
@@ -453,7 +460,12 @@ def run_erb_fast(state0, mix: FaultMix, max_rounds: int,
     broadcast, models/erb.py ErbRound.send) becomes a state-dependent
     column mask, with the kernels' hard-wired self-delivery subtracted on
     guard-excluded lanes (the run_tpc_fast discipline).  Lane-exact vs
-    the general engine on protocol-generated runs (tests/test_fast.py)."""
+    the general engine on protocol-generated runs (tests/test_fast.py).
+
+    CONTRACT: valid only for single-instance ERB state0 (one originator
+    per instance), where every defined sender floods the originator's
+    value — see ErbHist's contract note; feeding multi-writer initial
+    states would diverge from the general engine silently."""
     S, n = mix.crashed.shape
     rnd = ErbHist(n_values)
 
@@ -702,6 +714,174 @@ def run_pbft_fast(state0, mix: FaultMix, max_rounds: int = 3):
 
     return hist_scan(rnd, state0, lambda s: s.decided, max_rounds, n,
                      counts_fn)
+
+
+def run_pbft_vc_fast(state0, mix: FaultMix, max_rounds: int):
+    """PBFT WITH primary rotation on the fused path (models/pbft.py
+    PbftViewChange semantics — pre-prepare/prepare/commit + the
+    ViewChange.scala round family): 6-round phases as batched plane ops
+    over the whole [S, n] scenario batch.  Per-lane views make the
+    coordinator a per-receiver GATHER (coord = view % n), the
+    distributedState accumulators ride [S, n, n] planes, and the
+    ack-confirmation count is one [S, j, i, m] reduction (n is small for
+    byzantine groups; the planes stay tiny).  Lane-exact vs the general
+    engine on FaultMix families and scripted schedules
+    (tests/test_fast.py::test_pbft_view_change_fast_parity).
+
+    Returns (state, done, decided_round) like hist_scan."""
+    from round_tpu.models.pbft import cert_digest, digest as _digest
+
+    S, n = mix.crashed.shape
+    lane = jnp.arange(n, dtype=jnp.int32)[None, :]          # [1, n]
+    maj23, maj13 = 2 * n // 3, n // 3
+
+    def w(mask, new, old):
+        """Rank-aware where: lane mask [S, n] against [S, n, ...] leaves."""
+        m = mask
+        while m.ndim < new.ndim:
+            m = m[..., None]
+        return jnp.where(m, new, old)
+
+    def gather(a, idx):
+        """a[s, idx[s, j]] for per-receiver indices idx [S, n]."""
+        return jnp.take_along_axis(a, idx, axis=1)
+
+    def pre_prepare(st, deliver):
+        cj = (st.view % n).astype(jnp.int32)                # receiver's coord
+        sguard = (lane == (st.view % n)) & ~st.vc_active    # at the sender
+        deliver_c = jnp.take_along_axis(
+            deliver, cj[:, :, None], axis=2)[..., 0]
+        got = deliver_c & gather(sguard, cj) \
+            & (gather(st.view, cj) == st.view)
+        req_c = gather(st.x, cj)
+        claimed = gather(st.dig, cj)
+        recomputed = _digest(req_c)
+
+        active = ~st.vc_active & ~st.decided
+        is_coord = lane == cj
+        adopt = got & ~is_coord & active
+        valid = jnp.where(adopt, recomputed == claimed, st.valid)
+        fail = active & (~got | ~valid)
+        return st.replace(
+            x=jnp.where(adopt, req_c, st.x),
+            dig=jnp.where(adopt, recomputed, st.dig),
+            valid=valid,
+            vc_active=st.vc_active | fail,
+            next_view=jnp.where(fail, st.view + 1, st.next_view),
+        ), jnp.zeros((S, n), bool)
+
+    def prepare(st, deliver):
+        sguard = ~st.vc_active
+        pred = st.valid[:, None, :] \
+            & (st.dig[:, :, None] == st.dig[:, None, :]) \
+            & (st.view[:, :, None] == st.view[:, None, :])
+        conf = jnp.sum(
+            (deliver & sguard[:, None, :] & pred).astype(jnp.int32), axis=2)
+        prepared = (conf > maj23) & ~st.vc_active & ~st.decided
+        return st.replace(
+            prepared=prepared,
+            prep_req=jnp.where(prepared, st.x, st.prep_req),
+            prep_view=jnp.where(prepared, st.view, st.prep_view),
+        ), jnp.zeros((S, n), bool)
+
+    def commit(st, deliver):
+        from round_tpu.models.common import ghost_decide
+
+        sguard = st.prepared & ~st.vc_active
+        pred = (st.dig[:, :, None] == st.dig[:, None, :]) \
+            & (st.view[:, :, None] == st.view[:, None, :])
+        conf = jnp.sum(
+            (deliver & sguard[:, None, :] & pred).astype(jnp.int32), axis=2)
+        active = ~st.vc_active & ~st.decided
+        committed = (conf > maj23) & active
+        st = ghost_decide(st, committed, st.x)
+        fail = active & ~committed
+        return st.replace(
+            vc_active=st.vc_active | fail,
+            next_view=jnp.where(fail, st.view + 1, st.next_view),
+        ), st.decided
+
+    def view_change(st, deliver):
+        match = deliver & st.vc_active[:, None, :] \
+            & (st.next_view[:, :, None] == st.next_view[:, None, :])
+        keep = st.vc_active & ~st.decided
+        pr_b = jnp.broadcast_to(st.prep_req[:, None, :], match.shape)
+        pv_b = jnp.broadcast_to(st.prep_view[:, None, :], match.shape)
+        return st.replace(
+            vc_heard=w(keep, match, jnp.zeros_like(st.vc_heard)),
+            vc_req=w(keep, pr_b, st.vc_req),
+            vc_pv=jnp.where(keep[:, :, None] & match, pv_b,
+                            jnp.full_like(st.vc_pv, -1)),
+        ), jnp.zeros((S, n), bool)
+
+    def view_change_ack(st, deliver):
+        my_cert = cert_digest(st.vc_req, st.vc_pv)          # [S, n, m]
+        ackd = jnp.where(st.vc_heard, my_cert, jnp.int32(-1))
+        acker_ok = deliver & st.vc_active[:, None, :] \
+            & (st.next_view[:, :, None] == st.next_view[:, None, :])
+        matches = acker_ok[:, :, :, None] \
+            & (ackd[:, None, :, :] == my_cert[:, :, None, :])  # [S,j,i,m]
+        confirm = jnp.sum(matches.astype(jnp.int32), axis=2)   # [S,j,m]
+        confirmed = st.vc_heard & (confirm > maj13)
+        quorum = jnp.sum(confirmed.astype(jnp.int32), axis=2) > maj23
+
+        has_prep = confirmed & (st.vc_pv >= 0)
+        key = jnp.where(has_prep, st.vc_pv, jnp.int32(-2))
+        best = jnp.argmax(
+            key == jnp.max(key, axis=2, keepdims=True), axis=2)
+        any_prep = jnp.any(has_prep, axis=2)
+        sel = jnp.where(
+            any_prep,
+            jnp.take_along_axis(st.vc_req, best[:, :, None], axis=2)[..., 0],
+            st.x,
+        )
+        keep = st.vc_active & ~st.decided
+        return st.replace(
+            sel_req=jnp.where(keep, sel, st.sel_req),
+            nv_ok=jnp.where(keep, quorum, st.nv_ok),
+        ), jnp.zeros((S, n), bool)
+
+    def new_view(st, deliver):
+        nc = (st.next_view % n).astype(jnp.int32)
+        sguard = st.vc_active & (lane == (st.next_view % n)) & st.nv_ok
+        deliver_nc = jnp.take_along_axis(
+            deliver, nc[:, :, None], axis=2)[..., 0]
+        got = deliver_nc & gather(sguard, nc) \
+            & (gather(st.next_view, nc) == st.next_view)
+        sel = gather(st.sel_req, nc)
+
+        keep = st.vc_active & ~st.decided
+        install = keep & got
+        retry = keep & ~got
+        return st.replace(
+            view=jnp.where(install, st.next_view, st.view),
+            x=jnp.where(install, sel, st.x),
+            dig=jnp.where(install, _digest(sel), st.dig),
+            valid=jnp.where(install, True, st.valid),
+            prepared=jnp.where(install, False, st.prepared),
+            vc_active=jnp.where(install, False, st.vc_active),
+            next_view=jnp.where(retry, st.next_view + 1, st.next_view),
+        ), jnp.zeros((S, n), bool)
+
+    bodies = [pre_prepare, prepare, commit,
+              view_change, view_change_ack, new_view]
+
+    @jax.jit
+    def run(state0):
+        state = state0
+        done = jnp.zeros((S, n), bool)
+        dround = jnp.full((S, n), -1, jnp.int32)
+        for r in range(max_rounds):       # static unroll: 6-round phases
+            deliver = mix_ho(mix, r) & (~done)[:, None, :]
+            new_state, exit_ = bodies[r % 6](state, deliver)
+            active = ~done
+            state = jax.tree_util.tree_map(
+                lambda nw, ol: w(active, nw, ol), new_state, state)
+            done = done | (active & exit_)
+            dround = jnp.where(state.decided & (dround < 0), r, dround)
+        return state, done, dround
+
+    return run(state0)
 
 
 class MutexHist(HistRound):

@@ -108,6 +108,280 @@ class BcpCommit(Round):
         )
 
 
+# ---------------------------------------------------------------------------
+# View change (example/byzantine/pbft/ViewChange.scala — the reference ships
+# only this unsigned SKETCH and never wires it to its consensus; here the
+# round family is executable and composed with the 3-phase decision)
+# ---------------------------------------------------------------------------
+
+def cert_digest(req: jnp.ndarray, pv: jnp.ndarray) -> jnp.ndarray:
+    """Digest of a (request, prepared-view) certificate — the
+    ViewChangeAck's per-sender confirmation token (ViewChange.scala:20-22:
+    `d` is the digest of the message being acknowledged)."""
+    return digest(req.astype(jnp.int32) * jnp.int32(31) + pv.astype(jnp.int32))
+
+
+@flax.struct.dataclass
+class PbftVcState:
+    # consensus core (BcpState semantics, at the CURRENT view)
+    x: jnp.ndarray          # int32 request
+    dig: jnp.ndarray        # int32 digest of x
+    valid: jnp.ndarray      # bool
+    prepared: jnp.ndarray   # bool (this view)
+    decided: jnp.ndarray
+    decision: jnp.ndarray
+    # view bookkeeping
+    view: jnp.ndarray       # int32 current view; coord = view % n
+    next_view: jnp.ndarray  # int32 target view while vc_active
+    vc_active: jnp.ndarray  # bool — participating in a view change
+    # prepared certificate (survives across views; ViewChange.scala 𝓟)
+    prep_req: jnp.ndarray   # int32
+    prep_view: jnp.ndarray  # int32, -1 = none
+    # the reference's distributedState (ViewChange.scala:73): the VC1
+    # messages this lane holds, as [n] vectors (every lane accumulates —
+    # the new primary selects from them, receivers confirm acks with them)
+    vc_heard: jnp.ndarray   # [n] bool
+    vc_req: jnp.ndarray     # [n] int32
+    vc_pv: jnp.ndarray      # [n] int32
+    # VC2 outcome at the would-be new primary
+    sel_req: jnp.ndarray    # int32 — the new view's request
+    nv_ok: jnp.ndarray      # bool — confirmed-certificate quorum reached
+
+
+def _vc_coord(state: PbftVcState, ctx: RoundCtx):
+    """Primary of the CURRENT view (PBFT rotation: view mod n)."""
+    return (state.view % ctx.n).astype(jnp.int32)
+
+
+class VcPrePrepare(Round):
+    """Pre-prepare at the current view; failure starts a view change
+    instead of deciding null (the composition the reference sketch never
+    does)."""
+
+    def send(self, ctx: RoundCtx, state: PbftVcState):
+        return broadcast(
+            ctx,
+            {"req": state.x, "dig": state.dig, "view": state.view},
+            guard=(ctx.id == _vc_coord(state, ctx)) & ~state.vc_active,
+        )
+
+    def update(self, ctx: RoundCtx, state: PbftVcState, mbox: Mailbox):
+        coord = _vc_coord(state, ctx)
+        got = mbox.contains(coord) & (mbox.values["view"][coord] == state.view)
+        req = mbox.values["req"][coord]
+        claimed = mbox.values["dig"][coord]
+        recomputed = digest(req)
+
+        active = ~state.vc_active & ~state.decided
+        is_coord = ctx.id == coord
+        adopt = got & ~is_coord & active
+        x = jnp.where(adopt, req, state.x)
+        dig = jnp.where(adopt, recomputed, state.dig)
+        valid = jnp.where(adopt, recomputed == claimed, state.valid)
+
+        # no/invalid request: this primary is suspect — trigger view change
+        fail = active & (~got | ~valid)
+        return state.replace(
+            x=x, dig=dig, valid=valid,
+            vc_active=state.vc_active | fail,
+            next_view=jnp.where(fail, state.view + 1, state.next_view),
+        )
+
+
+class VcPrepare(Round):
+    def send(self, ctx: RoundCtx, state: PbftVcState):
+        return broadcast(
+            ctx,
+            {"dig": state.dig, "ok": state.valid, "view": state.view},
+            guard=~state.vc_active,
+        )
+
+    def update(self, ctx: RoundCtx, state: PbftVcState, mbox: Mailbox):
+        confirmed = mbox.count(
+            lambda m: m["ok"] & (m["dig"] == state.dig)
+            & (m["view"] == state.view)
+        )
+        prepared = (confirmed > 2 * ctx.n // 3) & ~state.vc_active \
+            & ~state.decided
+        # the prepared CERTIFICATE outlives the view (ViewChange.scala 𝓟)
+        return state.replace(
+            prepared=prepared,
+            prep_req=jnp.where(prepared, state.x, state.prep_req),
+            prep_view=jnp.where(prepared, state.view, state.prep_view),
+        )
+
+
+class VcCommit(Round):
+    def send(self, ctx: RoundCtx, state: PbftVcState):
+        return broadcast(
+            ctx,
+            {"dig": state.dig, "view": state.view},
+            guard=state.prepared & ~state.vc_active,
+        )
+
+    def update(self, ctx: RoundCtx, state: PbftVcState, mbox: Mailbox):
+        confirmed = mbox.count(
+            lambda m: (m["dig"] == state.dig) & (m["view"] == state.view)
+        )
+        active = ~state.vc_active & ~state.decided
+        committed = (confirmed > 2 * ctx.n // 3) & active
+        state = ghost_decide(state, committed, state.x)
+        ctx.exit_at_end_of_round(state.decided)
+        # an uncommitted phase rotates the primary (PBFT liveness), it
+        # does NOT abort the instance like the reference's 3-phase test
+        fail = active & ~committed
+        return state.replace(
+            vc_active=state.vc_active | fail,
+            next_view=jnp.where(fail, state.view + 1, state.next_view),
+        )
+
+
+class VcViewChange(Round):
+    """ViewChange.scala round 1: broadcast the prepared certificate for
+    next_view; every lane accumulates certificates (distributedState)."""
+
+    def send(self, ctx: RoundCtx, state: PbftVcState):
+        return broadcast(
+            ctx,
+            {"nv": state.next_view, "pr": state.prep_req,
+             "pv": state.prep_view},
+            guard=state.vc_active,
+        )
+
+    def update(self, ctx: RoundCtx, state: PbftVcState, mbox: Mailbox):
+        match = mbox.mask & (mbox.values["nv"] == state.next_view)
+        keep = state.vc_active & ~state.decided
+        return state.replace(
+            vc_heard=jnp.where(keep, match, jnp.zeros_like(state.vc_heard)),
+            vc_req=jnp.where(keep, mbox.values["pr"], state.vc_req),
+            vc_pv=jnp.where(keep & match, mbox.values["pv"],
+                            jnp.full_like(state.vc_pv, -1)),
+        )
+
+
+class VcViewChangeAck(Round):
+    """ViewChange.scala round 2: ack the held certificates by digest; the
+    new primary keeps certificates confirmed by > n/3 acks (at least one
+    correct witness) and, on a > 2n/3 confirmed quorum, selects the
+    max-prepared-view request (the PBFT new-view computation collapsed to
+    the single-decision case: no checkpoints, L = 1)."""
+
+    def send(self, ctx: RoundCtx, state: PbftVcState):
+        ackd = jnp.where(
+            state.vc_heard, cert_digest(state.vc_req, state.vc_pv),
+            jnp.int32(-1),
+        )
+        return broadcast(
+            ctx,
+            {"nv": state.next_view, "ackd": ackd},
+            guard=state.vc_active,
+        )
+
+    def update(self, ctx: RoundCtx, state: PbftVcState, mbox: Mailbox):
+        n = ctx.n
+        my_cert = cert_digest(state.vc_req, state.vc_pv)        # [n]
+        acker_ok = mbox.mask & (mbox.values["nv"] == state.next_view)
+        # confirm[j] = #{ ackers i : ackd[i, j] matches my cert j }
+        matches = (mbox.values["ackd"] == my_cert[None, :]) \
+            & acker_ok[:, None]                                  # [n, n]
+        confirm = jnp.sum(matches.astype(jnp.int32), axis=0)
+        confirmed = state.vc_heard & (confirm > n // 3)
+        quorum = jnp.sum(confirmed.astype(jnp.int32)) > 2 * n // 3
+
+        # select max prepared view among confirmed certificates; ties go
+        # to the smallest sender id; no prepared certificate -> own x
+        # (the null-request branch of the new-view computation)
+        has_prep = confirmed & (state.vc_pv >= 0)
+        key = jnp.where(has_prep, state.vc_pv, jnp.int32(-2))
+        best = jnp.argmax(
+            key == jnp.max(key)
+        )
+        any_prep = jnp.any(has_prep)
+        sel = jnp.where(any_prep, state.vc_req[best], state.x)
+
+        keep = state.vc_active & ~state.decided
+        return state.replace(
+            sel_req=jnp.where(keep, sel, state.sel_req),
+            nv_ok=jnp.where(keep, quorum, state.nv_ok),
+        )
+
+
+class VcNewView(Round):
+    """ViewChange.scala round 3: the new primary broadcasts the new view;
+    receivers install it (view := nv, x := selected request) and resume
+    consensus; lanes that miss it retry at next_view + 1 (finishRound)."""
+
+    def send(self, ctx: RoundCtx, state: PbftVcState):
+        is_new_coord = ctx.id == (state.next_view % ctx.n).astype(jnp.int32)
+        return broadcast(
+            ctx,
+            {"nv": state.next_view, "sel": state.sel_req},
+            guard=state.vc_active & is_new_coord & state.nv_ok,
+        )
+
+    def update(self, ctx: RoundCtx, state: PbftVcState, mbox: Mailbox):
+        nc = (state.next_view % ctx.n).astype(jnp.int32)
+        got = mbox.contains(nc) & (mbox.values["nv"][nc] == state.next_view)
+        sel = mbox.values["sel"][nc]
+
+        keep = state.vc_active & ~state.decided
+        install = keep & got
+        retry = keep & ~got
+        return state.replace(
+            view=jnp.where(install, state.next_view, state.view),
+            x=jnp.where(install, sel, state.x),
+            dig=jnp.where(install, digest(sel), state.dig),
+            valid=jnp.where(install, True, state.valid),
+            prepared=jnp.where(install, False, state.prepared),
+            vc_active=jnp.where(install, False, state.vc_active),
+            next_view=jnp.where(retry, state.next_view + 1,
+                                state.next_view),
+        )
+
+
+class PbftViewChange(Algorithm):
+    """PBFT consensus WITH primary rotation: 6-round phases — pre-prepare /
+    prepare / commit (failure starts a view change instead of deciding
+    null), then view-change / ack / new-view (ViewChange.scala's three
+    EventRounds, executable and composed).  Decides through a faulty
+    primary; f < n/3."""
+
+    def __init__(self):
+        self.rounds = (
+            VcPrePrepare(), VcPrepare(), VcCommit(),
+            VcViewChange(), VcViewChangeAck(), VcNewView(),
+        )
+
+    def make_init_state(self, ctx: RoundCtx, io) -> PbftVcState:
+        x = jnp.asarray(io["initial_value"], dtype=jnp.int32)
+        n = ctx.n
+        i32 = jnp.int32
+        return PbftVcState(
+            x=x,
+            dig=digest(x),
+            valid=jnp.asarray(True),
+            prepared=jnp.asarray(False),
+            decided=jnp.asarray(False),
+            decision=jnp.asarray(DECIDE_NULL, dtype=i32),
+            view=jnp.asarray(0, dtype=i32),
+            next_view=jnp.asarray(0, dtype=i32),
+            vc_active=jnp.asarray(False),
+            prep_req=jnp.asarray(0, dtype=i32),
+            prep_view=jnp.asarray(-1, dtype=i32),
+            vc_heard=jnp.zeros((n,), dtype=bool),
+            vc_req=jnp.zeros((n,), dtype=i32),
+            vc_pv=jnp.full((n,), -1, dtype=i32),
+            sel_req=jnp.asarray(0, dtype=i32),
+            nv_ok=jnp.asarray(False),
+        )
+
+    def decided(self, state: PbftVcState):
+        return state.decided
+
+    def decision(self, state: PbftVcState):
+        return state.decision
+
+
 class PbftConsensus(Algorithm):
     """Single-decision PBFT-style consensus, f < n/3 byzantine."""
 

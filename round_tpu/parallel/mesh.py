@@ -55,7 +55,9 @@ def make_mesh(
 def sharded_keyed_parity(one_fn, keys, n_devices, devices=None):
     """Run a per-scenario keyed computation scenario-sharded over an
     n_devices mesh AND through a single-device oracle at MATCHED vmap
-    widths, returning (sharded_outputs, raw_bit_parity).
+    widths, returning (run, sharded_outputs, raw_bit_parity) — `run` is
+    the raw shard_map callable (jit it before timing) so callers can time
+    the very computation whose parity was just pinned.
 
     The one parity discipline every scenario-DP call site shares (the
     ε-agreement ladder rung, the multichip dryrun): the scenario axis is

@@ -37,6 +37,11 @@ class Options:
     timeout_ms: int = 10            # default round timeout (:33)
     max_phases: int = 64            # scan bound on phases
     nbr_byzantine: int = 0          # f for byzantine variants (:49)
+    # NB the catch-up send policy (RuntimeOptions.scala:31-32,
+    # sendWhenCatchingUp/delayFirstSend) lives on the HOST runner
+    # (runtime/host.py HostRunner kwargs + apps/host_replica.py CLI
+    # flags), not here: the lockstep engine path this record serves has
+    # no per-replica send loop to apply it to
 
     # engine scale (the TPU-native axes; replaces workers/dispatch knobs)
     n: int = 4                      # group size

@@ -101,6 +101,8 @@ def run_instance_loop(
     base_value: int = 0,
     max_rounds: int = 32,
     stats_out: Optional[Dict[str, int]] = None,
+    send_when_catching_up: bool = True,
+    delay_first_send_ms: int = -1,
 ) -> List[Optional[int]]:
     """The PerfTest2 loop (PerfTest2.scala:19-110): `instances` consecutive
     consensus instances over one transport, with start-skew stashing —
@@ -145,6 +147,11 @@ def run_instance_loop(
             algo, my_id, peers, transport, instance_id=inst,
             timeout_ms=timeout_ms, seed=seed + inst,
             foreign=foreign, prefill=stash.pop(inst, None),
+            send_when_catching_up=send_when_catching_up,
+            # start skew is a per-run experiment: only the first instance
+            # is delayed (the reference sleeps at instance start, and the
+            # point is skewING the replica, not slowing every instance)
+            delay_first_send_ms=delay_first_send_ms if inst == 1 else -1,
         )
         value = (base_value + my_id * 7 + inst) % 5
         res = runner.run({"initial_value": np.int32(value)},
@@ -184,6 +191,8 @@ class HostRunner:
         foreign=None,
         prefill: Optional[Dict[int, Dict[int, Any]]] = None,
         wait_cap_ms: int = 30_000,
+        send_when_catching_up: bool = True,
+        delay_first_send_ms: int = -1,
     ):
         self.algo = algo
         self.id = my_id
@@ -192,6 +201,16 @@ class HostRunner:
         self.instance_id = instance_id & 0xFFFF
         self.timeout_ms = timeout_ms
         self.wait_cap_ms = wait_cap_ms
+        # catch-up send policy (RuntimeOptions.scala:31-37 +
+        # InstanceHandler.scala:169-177): when a round is entered during
+        # catch-up (a peer was observed ahead of it), sending its messages
+        # is optional — they arrive communication-closed-late at peers that
+        # have moved on.  Default ON like the reference.
+        self.send_when_catching_up = send_when_catching_up
+        # stagger this replica's first send (delayFirstSend, used by the
+        # reference's tests to force start skew)
+        self.delay_first_send_ms = delay_first_send_ms
+        self.suppressed_sends = 0   # rounds whose send was skipped
         self.seed = seed
         self.default_handler = default_handler
         # sink for NORMAL messages of other instances: a consecutive-
@@ -334,25 +353,39 @@ class HostRunner:
         max_rnd = np.full(self.n, -1, dtype=np.int64)
         max_rnd[self.id] = 0
         next_round = 0
+        if self.delay_first_send_ms > 0:
+            # delayFirstSend (InstanceHandler.scala:169-171): sleep before
+            # the instance's first round — start-skew injection
+            _time.sleep(self.delay_first_send_ms / 1000.0)
         while r < max_rounds and not exited:
             rnd = rounds[r % len(rounds)]
             rr, sid = np.int32(r), np.int32(self.id)
             seed = np.uint32(self.seed)
             f_send, f_update, f_go = self._round_fns(rnd, state)
+            # the send TRANSITION always runs (it is part of the round's
+            # state semantics); whether the messages go out is the policy
             state, payload, dest_mask = f_send(rr, sid, seed, state)
             dest = np.asarray(dest_mask)
             payload_np = jax.tree_util.tree_map(np.asarray, payload)
-            wire = pickle.dumps(payload_np)
-            for d in range(self.n):
-                if d == self.id or not dest[d]:
-                    continue
-                self.transport.send(
-                    d, Tag(instance=self.instance_id, round=r), wire
-                )
+            # catching up = a peer was observed past this round
+            # (InstanceHandler.scala:176: msg pending ⇒ only send when
+            # sendWhenCatchingUp); our messages would arrive
+            # communication-closed-late at peers already beyond r
+            sending = self.send_when_catching_up or next_round <= r
+            if sending:
+                wire = pickle.dumps(payload_np)
+                for d in range(self.n):
+                    if d == self.id or not dest[d]:
+                        continue
+                    self.transport.send(
+                        d, Tag(instance=self.instance_id, round=r), wire
+                    )
+            else:
+                self.suppressed_sends += 1
 
             # -- accumulate (InstanceHandler.scala:164-353) ---------------
             inbox: Dict[int, Any] = dict(self._pending.pop(r, {}))
-            if dest[self.id]:
+            if dest[self.id] and sending:
                 inbox[self.id] = payload_np  # self-delivery off the wire
             prog = self._round_progress(rnd)
             block = prog.is_strict       # strict: no catch-up early-exit
@@ -373,10 +406,15 @@ class HostRunner:
 
             oob_decided = False
 
-            def ingest(got, extend_deadline=True) -> bool:
+            def ingest(got, extend_deadline=True, buffer_only=False) -> bool:
                 """Route one received packet; True when THIS round's inbox
                 grew.  Shared by the blocking accumulate loop and the
-                GoAhead pre-update drain."""
+                GoAhead pre-update drain.  With buffer_only, a
+                current-round message is dropped instead of joining the
+                inbox (it is late-for-the-quorum; under the default policy
+                it would have been read next round and dropped as late, so
+                this keeps the frontier drain behavior-neutral for the
+                current round's update)."""
                 nonlocal state, deadline, next_round, oob_decided
                 sender, tag, raw = got
                 if not 0 <= sender < self.n:
@@ -425,6 +463,9 @@ class HostRunner:
                     # benign catch-up: the furthest peer sets the target
                     next_round = max(next_round, int(max_rnd.max()))
                     return False
+                if buffer_only:
+                    return False  # post-quorum same-round: same fate as
+                    # arriving next round under the default policy (late)
                 inbox[sender] = payload
                 return True
 
@@ -465,6 +506,25 @@ class HostRunner:
                     continue  # re-check the deadline
                 if ingest(got):
                     dirty = True
+            if not self.send_when_catching_up and not oob_decided:
+                # frontier-aware accumulation: ingestion normally stops at
+                # the quorum break, so a replica replaying a long backlog
+                # never SEES the rounds ahead and the catch-up policy has
+                # nothing to act on (the reference's one-message-at-a-time
+                # loop reads ahead by construction).  Drain without
+                # blocking — future rounds land in the pending buffer
+                # (they would have anyway) and push next_round forward;
+                # buffer_only keeps the CURRENT round's mailbox exactly
+                # what the default policy would have given it, so the knob
+                # changes send suppression and nothing else.
+                while True:
+                    got = self.transport.recv(0)
+                    if got is None:
+                        break
+                    ingest(got, extend_deadline=False, buffer_only=True)
+                    if oob_decided:
+                        break
+
             if prog.is_go_ahead and not oob_decided:
                 # a GoAhead round still delivers messages ALREADY QUEUED in
                 # the transport before updating (the reference delivers

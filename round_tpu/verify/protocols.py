@@ -2437,14 +2437,65 @@ def lv_verifier_spec() -> ProtocolSpec:
     ("those completely blow-up", LvExample.scala:262-291) — this spec
     discharges every one through the native reducer.
 
+    LIVENESS (the phase walk): under the good-phase environment of
+    example/LastVoting.scala:19-22 — the coordinator hears a majority and
+    everyone hears the coordinator (the reference states ∀q. q ∈ coord.HO
+    ∧ |coord.HO| > n/2; each direction is consumed by the rounds that
+    need it: collect/ack need the coordinator's majority mailbox,
+    vote/decide need the coordinator in every mailbox) — the four rounds
+    of one phase chain to a universal decision:
+
+      live ∧ TR₁ ⊨ commit(coord)′                      (collect)
+      commit(coord) ∧ live ∧ TR₂ ⊨ (∀i ts=Φ ∧ x=vote)′ (vote)
+      … ∧ live ∧ TR₃ ⊨ ready(coord)′                   (ack)
+      ready(coord) ∧ live ∧ TR₄ ⊨ (∀i decided ∧ dec=vote(coord))′
+
+    Each VC's hypothesis is the previous conclusion unprimed; the walk's
+    composition is induction over the phase's round sequence
+    (Verifier.scala:144-157 checkProgress + the roundInvariants second
+    elements, LastVoting.scala:49-61).  The final conclusion is the
+    reference's invariants[1] (everyone decided, one value) in witnessed
+    form — termination proves from it.
+
     Run:  python -m round_tpu.apps.verifier_cli lv   (~10 min CPU)."""
     chains, P = lv_staged_chains()
     vcs4, spec, lv = P["vcs"], P["spec"], P["lv"]
     sig = spec.sig
     r = lv["phase"]
+    coord = lv["coord"]
+    r1, r2, r3, r4 = lv["rounds"]
     assert set(chains) == {vcs4[0][0], vcs4[2][0]}, chains.keys()
 
     init0 = And(spec.init, Eq(r, IntLit(0)))
+
+    i = Variable("i", procType)
+    # the good-phase environment (LastVoting.scala:19-22): HO is the
+    # per-round heard-of symbol, so conjoining `live` to each of the four
+    # VCs asserts the environment for all four rounds of the phase
+    live = And(
+        Gt(Times(2, Card(ho_of(coord))), N),
+        ForAll([i], In(coord, ho_of(i))),
+    )
+    c1 = sig.get("commit", coord)
+    c2 = And(c1, ForAll([i], And(
+        Eq(sig.get("x", i), sig.get("vote", coord)),
+        Eq(sig.get("ts", i), r),
+    )))
+    c3 = And(c2, sig.get("ready", coord))
+    c4 = ForAll([i], And(
+        sig.get("decided", i),
+        Eq(sig.get("dec", i), sig.get("vote", coord)),
+    ))
+    walk = [
+        ("progress: collect — the coordinator commits",
+         live, r1.full_tr(), sig.prime(c1)),
+        ("progress: vote — everyone adopts the vote at ts = phase",
+         And(c1, live), r2.full_tr(), sig.prime(c2)),
+        ("progress: ack — the coordinator becomes ready",
+         And(c2, live), r3.full_tr(), sig.prime(c3)),
+        ("progress: decide — everyone decides the vote",
+         And(c3, live), r4.full_tr(), sig.prime(c4)),
+    ]
 
     return ProtocolSpec(
         sig=sig,
@@ -2462,6 +2513,7 @@ def lv_verifier_spec() -> ProtocolSpec:
         staged=chains,
         round_staged_inductiveness=list(vcs4),
         round_staged_init=lv["stage0_at"](r),
+        phase_progress=walk,
     )
 
 
@@ -2804,4 +2856,123 @@ def benor_extracted_lemmas():
     meta = dict(sig=sig, j=j, jp=jp, payload=payload, eqs_j=eqs_j,
                 eqs_jp=eqs_jp, ax_j=ax_j, ax_jp=ax_jp,
                 nobody_can=nobody_can)
+    return lemmas, meta
+
+
+# ---------------------------------------------------------------------------
+# PBFT view change (example/byzantine/pbft/ViewChange.scala) — the new-view
+# selection extracted from the executable round
+# ---------------------------------------------------------------------------
+
+def pbft_vc_selection_extracted():
+    """The NEW-VIEW selection extracted from the EXECUTABLE
+    VcViewChangeAck update (models/pbft.py — ViewChange.scala:26-40's
+    "compute new view" collapsed to the single-decision case): among the
+    CONFIRMED view-change certificates, pick the request prepared at the
+    highest view; with no prepared certificate, fall back to the
+    primary's own request.
+
+    The jnp.argmax(key == jnp.max(key)) tie-break extracts as a
+    max-extremum site (bound + attainment) and a boolean argmax site
+    (any at-max candidate → the site is one) — the sound
+    over-approximation the safety lemmas need; the smallest-id tie-break
+    itself is abstracted away.
+
+    Returns (sel_term, anyp_term, axioms, meta)."""
+    import jax.numpy as jnp
+
+    from round_tpu.verify.extract import Scalar, Vec, extract_lane_fn
+
+    j = Variable("pvj", procType)
+    conf = UnInterpretedFct("pv!conf", FunT([procType], Bool))
+    vreq = UnInterpretedFct("pv!req", FunT([procType], Int))
+    vpv = UnInterpretedFct("pv!pv", FunT([procType], Int))
+    xv = Variable("pvx", Int)
+
+    def conf_of(i):
+        return Application(conf, [i]).with_type(Bool)
+
+    def vreq_of(i):
+        return Application(vreq, [i]).with_type(Int)
+
+    def vpv_of(i):
+        return Application(vpv, [i]).with_type(Int)
+
+    def sel_fn(n, x, confirmed, vr, vp):
+        # models/pbft.py VcViewChangeAck.update selection, verbatim
+        has_prep = confirmed & (vp >= 0)
+        key = jnp.where(has_prep, vp, jnp.int32(-2))
+        best = jnp.argmax(key == jnp.max(key))
+        any_prep = jnp.any(has_prep)
+        sel = jnp.where(any_prep, vr[best], x)
+        return sel, any_prep
+
+    ne = 5
+    ex_args = [jnp.int32(ne), jnp.int32(0), jnp.zeros((ne,), bool),
+               jnp.zeros((ne,), jnp.int32), jnp.zeros((ne,), jnp.int32)]
+    fargs = [
+        Scalar(N), Scalar(xv),
+        Vec(conf_of), Vec(vreq_of), Vec(vpv_of),
+    ]
+    outs, axioms = extract_lane_fn(
+        sel_fn, ex_args, fargs, lambda i: Literal(True), receiver=j,
+        return_axioms=True,
+    )
+    sel_t, anyp_t = outs[0].f, outs[1].f
+    meta = dict(j=j, x=xv, conf_of=conf_of, vreq_of=vreq_of,
+                vpv_of=vpv_of, axioms=axioms)
+    return sel_t, anyp_t, axioms, meta
+
+
+def pbft_vc_extracted_lemmas():
+    """Safety of the extracted new-view selection (the round-5 verdict's
+    "a prepared value survives into the new view"):
+
+      attainment: with any prepared certificate confirmed, the selection
+                  IS some confirmed certificate's request (prepared at a
+                  view >= 0) — the new primary cannot invent a value;
+      survival:   if every confirmed prepared certificate carries v (the
+                  post-commit situation: a >2n/3 commit quorum forces
+                  every intersecting certificate to v), the selection is
+                  v — the committed value survives the rotation;
+      max-view:   no confirmed certificate is prepared at a view above
+                  the selected one (the PBFT max-𝓟 rule);
+      fallback:   with NO prepared certificate the primary's own request
+                  is selected.
+
+    Returns (lemmas, meta); the no-axioms negative control lives in
+    tests/test_extract_vcs.py."""
+    sel_t, anyp_t, axioms, meta = pbft_vc_selection_extracted()
+    conf_of, vreq_of, vpv_of = (meta["conf_of"], meta["vreq_of"],
+                                meta["vpv_of"])
+    xv = meta["x"]
+    i = Variable("pvi", procType)
+    v = Variable("pvv", Int)
+    base = And(*axioms)
+    has_prep_i = And(conf_of(i), Geq(vpv_of(i), IntLit(0)))
+
+    c02 = ClConfig(venn_bound=0, inst_depth=2)
+    c03 = ClConfig(venn_bound=0, inst_depth=3)
+
+    lemmas = [
+        ("selection-attainment",
+         And(base, anyp_t),
+         Exists([i], And(has_prep_i, Eq(sel_t, vreq_of(i)))), c03),
+        ("prepared-value-survives",
+         And(base, anyp_t,
+             ForAll([i], Implies(has_prep_i, Eq(vreq_of(i), v)))),
+         Eq(sel_t, v), c03),
+        ("max-view-selected",
+         And(base, anyp_t),
+         Exists([i], And(has_prep_i, Eq(sel_t, vreq_of(i)),
+                         ForAll([Variable("pvk", procType)],
+                                Implies(And(conf_of(Variable("pvk", procType)),
+                                            Geq(vpv_of(Variable("pvk", procType)),
+                                                IntLit(0))),
+                                        Leq(vpv_of(Variable("pvk", procType)),
+                                            vpv_of(i)))))), c03),
+        ("no-certificate-fallback",
+         And(base, Not(anyp_t)),
+         Eq(sel_t, xv), c02),
+    ]
     return lemmas, meta

@@ -530,6 +530,14 @@ class Verifier:
                 "holds before round k+1, cyclically with the phase bump; "
                 "free anchor witnesses are universally quantified per VC"
             )
+        if self.spec.phase_progress and hasattr(self, "vcs"):
+            lines.append(
+                "note: phase liveness walk — each VC's hypothesis is the "
+                "previous VC's conclusion unprimed, under the good-phase "
+                "environment; their chaining over one phase's round "
+                "sequence is the checkProgress composition "
+                "(Verifier.scala:144-157)"
+            )
         return "\n".join(lines)
 
     def html_report(self) -> str:

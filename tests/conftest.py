@@ -18,3 +18,41 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+# -- test tiers (round-5 verdict item 4) ------------------------------------
+# The default `pytest tests/` run is the fast green gate; @pytest.mark.slow
+# tests (VC-heavy suites measured in minutes) are SKIPPED — visibly, so a
+# cold reviewer can tell a slow VC from a hang.  Two slow switches:
+#   * `pytest -m slow`   — ONLY the marker-level slow tests (note: tests
+#     that gate a heavy SUB-case via the slow_tier fixture carry no
+#     marker, so -m slow cannot select them);
+#   * RUN_SLOW_VCS=1     — EVERYTHING, including fixture-gated sub-cases
+#     (the end-of-round sweep switch).
+
+
+def _slow_enabled(config) -> bool:
+    if os.environ.get("RUN_SLOW_VCS", "") == "1":
+        return True
+    m = config.getoption("-m") or ""
+    return "slow" in m and "not slow" not in m
+
+
+@pytest.fixture
+def slow_tier() -> bool:
+    """True when slow SUB-cases should run — for tests that gate only a
+    heavy parameter row rather than the whole test.  Env-var-only by
+    design: `-m slow` deselects the (unmarked) host tests outright, so a
+    -m-based signal could never reach this fixture anyway."""
+    return os.environ.get("RUN_SLOW_VCS", "") == "1"
+
+
+def pytest_collection_modifyitems(config, items):
+    if _slow_enabled(config):
+        return
+    skip = pytest.mark.skip(reason="slow tier: RUN_SLOW_VCS=1 (or -m slow "
+                                   "for marker-level tests)")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

@@ -991,3 +991,76 @@ def test_gol_fast_parity_and_glider():
     got = np.asarray(st2.alive[0]).reshape(rows, cols)
     want = np.roll(np.roll(grid, 1, axis=0), 1, axis=1)
     np.testing.assert_array_equal(got, want)
+
+
+def test_pbft_view_change_fast_parity():
+    """PBFT with primary rotation on the fused path
+    (fast.run_pbft_vc_fast) is lane-exact against the general engine over
+    TWO 6-round phases of FaultMix families — including scenarios whose
+    decision happens in view > 0, i.e. THROUGH a view change."""
+    from round_tpu.engine import scenarios
+    from round_tpu.engine.executor import run_instance
+    from round_tpu.models.pbft import PbftVcState, PbftViewChange, digest
+
+    n, S, phases = 8, 8, 2
+    rounds = 6 * phases
+    key = jax.random.PRNGKey(17)
+    mix = fast.standard_mix(key, S, n, p_drop=0.15, f=2, crash_round=0)
+    # force scenario 0 to crash the view-0 primary at round 0 on clean
+    # links: the deterministic decide-through-a-rotation witness
+    mix = mix.replace(
+        crashed=mix.crashed.at[0].set(False).at[0, 0].set(True),
+        crash_round=mix.crash_round.at[0].set(0),
+        p8=mix.p8.at[0].set(0),
+        heal_round=mix.heal_round.at[0].set(0),
+    )
+    x0 = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0, 1000,
+                            dtype=jnp.int32)
+    io = {"initial_value": x0}
+    i32 = jnp.int32
+
+    state0 = PbftVcState(
+        x=jnp.broadcast_to(x0, (S, n)),
+        dig=jnp.broadcast_to(digest(x0), (S, n)),
+        valid=jnp.ones((S, n), bool),
+        prepared=jnp.zeros((S, n), bool),
+        decided=jnp.zeros((S, n), bool),
+        decision=jnp.full((S, n), -1, i32),
+        view=jnp.zeros((S, n), i32),
+        next_view=jnp.zeros((S, n), i32),
+        vc_active=jnp.zeros((S, n), bool),
+        prep_req=jnp.zeros((S, n), i32),
+        prep_view=jnp.full((S, n), -1, i32),
+        vc_heard=jnp.zeros((S, n, n), bool),
+        vc_req=jnp.zeros((S, n, n), i32),
+        vc_pv=jnp.full((S, n, n), -1, i32),
+        sel_req=jnp.zeros((S, n), i32),
+        nv_ok=jnp.zeros((S, n), bool),
+    )
+    state, done, dround = fast.run_pbft_vc_fast(state0, mix,
+                                                max_rounds=rounds)
+
+    algo = PbftViewChange()
+    saw_rotated_decision = False
+    fields = ("x", "dig", "valid", "prepared", "decided", "decision",
+              "view", "next_view", "vc_active", "prep_req", "prep_view",
+              "vc_heard", "vc_req", "vc_pv", "sel_req", "nv_ok")
+    for s in range(S):
+        res = run_instance(
+            algo, io, n, jax.random.fold_in(key, 99 + s),
+            scenarios.from_mix_row(mix, s), max_phases=phases,
+        )
+        for field in fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(state, field)[s]),
+                np.asarray(getattr(res.state, field)),
+                err_msg=f"scenario {s}, field {field}")
+        np.testing.assert_array_equal(
+            np.asarray(dround[s]), np.asarray(res.decided_round))
+        d = np.asarray(res.state.decision)
+        v = np.asarray(res.state.view)
+        live = ~np.asarray(mix.crashed[s])
+        pos = d[live][d[live] >= 0]
+        assert len(set(pos.tolist())) <= 1, s  # agreement among deciders
+        saw_rotated_decision |= bool(((d >= 0) & (v > 0) & live).any())
+    assert saw_rotated_decision, "no scenario decided through a view change"

@@ -278,6 +278,7 @@ def test_ladder_floodmin_rung_smoke():
     assert r["extra"]["frac_lanes_decided"] == 1.0
 
 
+@pytest.mark.slow  # ~25 s; the floodmin/first-rung smokes keep ladder coverage in the default tier
 def test_ladder_benor_rung_smoke():
     """Fourth rung (Ben-Or on the FUSED path, omission family) end-to-end on
     CPU: loop kernel timed, lane-exact differential parity (masks AND hash
@@ -293,6 +294,7 @@ def test_ladder_benor_rung_smoke():
     assert r["extra"]["property_parity"] is True
 
 
+@pytest.mark.slow  # ~30 s
 def test_ladder_lv_rung_smoke():
     """Third rung (LastVoting on its whole-run kernel, crash family)
     end-to-end on CPU: loop engine timed, lane-exact differential parity,

@@ -1032,3 +1032,78 @@ def test_host_perftest_udp_vs_tcp():
         assert x["transport"] == f"native {proto} (native/transport.cpp)"
         by_proto[proto] = result["value"]
     assert all(v > 0 for v in by_proto.values())
+
+
+def test_host_catch_up_send_policy_knobs():
+    """RuntimeOptions.sendWhenCatchingUp / delayFirstSend parity
+    (RuntimeOptions.scala:31-37, InstanceHandler.scala:169-177): a replica
+    whose first send is delayed enters its early rounds catching up; with
+    send_when_catching_up=False it suppresses exactly those stale-round
+    sends (wire sends == (n-1)·(rounds − suppressed)), with the default
+    policy it suppresses none — and consensus completes with agreement
+    either way."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from round_tpu.apps.selector import select
+    from round_tpu.runtime.host import HostRunner
+
+    # ONE shared Algorithm across every cluster run (the host_perftest
+    # discipline): the warm-up run below pays the jit compile once, so the
+    # measured runs' wall-clock skew is real skew — under a loaded box a
+    # per-run compile could otherwise eat the laggard's delay and no
+    # catch-up would happen (observed as a flake)
+    algo = select("otr")
+
+    def run_cluster(send_when_catching_up, delay_ms):
+        n = 3
+        ports = _free_ports(n)
+        peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
+        results, wire_sends = {}, {i: 0 for i in range(n)}
+
+        def node(my_id):
+            tr = HostTransport(my_id, peers[my_id][1])
+            real_send = tr.send
+
+            def counting_send(dest, tag, payload):
+                if tag.flag == FLAG_NORMAL:
+                    wire_sends[my_id] += 1
+                return real_send(dest, tag, payload)
+
+            tr.send = counting_send
+            try:
+                runner = HostRunner(
+                    algo, my_id, peers, tr, timeout_ms=150,
+                    send_when_catching_up=send_when_catching_up,
+                    delay_first_send_ms=delay_ms if my_id == n - 1 else -1,
+                )
+                res = runner.run({"initial_value": np.int32(my_id)},
+                                 max_rounds=48)
+                results[my_id] = (res, runner.suppressed_sends)
+            finally:
+                tr.close()
+
+        threads = [threading.Thread(target=node, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert len(results) == n
+        assert all(r.decided for r, _ in results.values())
+        decisions = {int(np.asarray(r.decision)) for r, _ in results.values()}
+        assert len(decisions) == 1
+        return results, wire_sends
+
+    run_cluster(send_when_catching_up=True, delay_ms=-1)  # jit warm-up
+
+    results, wire = run_cluster(send_when_catching_up=False, delay_ms=1200)
+    res_lag, suppressed = results[2]
+    assert suppressed > 0, "the delayed replica never caught up?"
+    # OTR broadcasts to the n-1 = 2 peers each UNsuppressed round — the
+    # structural invariant of the policy, load-independent
+    assert wire[2] == 2 * (res_lag.rounds_run - suppressed)
+
+    results, wire = run_cluster(send_when_catching_up=True, delay_ms=1200)
+    res_lag, suppressed = results[2]
+    assert suppressed == 0
+    assert wire[2] == 2 * res_lag.rounds_run

@@ -240,3 +240,31 @@ def test_lattice_proc_sharded_bit_parity():
                     jax.tree_util.tree_leaves(ref)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert bool(np.asarray(got[0].decided).any())
+
+
+def test_ho_block_is_a_row_slice_of_ho_link_mask():
+    """ADVICE r04: parallel/mesh.py::_ho_block re-derives the HO link-mask
+    formula for a row slice at global receiver indices; pin it bit-for-bit
+    against rows of ops.fused.ho_link_mask (THE one dense implementation)
+    so an edit to either cannot silently break the sharded path's claimed
+    bit-parity."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from round_tpu.engine import fast
+    from round_tpu.ops import fused
+    from round_tpu.parallel.mesh import _ho_block
+
+    n, S = 16, 6
+    mix = fast.standard_mix(jax.random.PRNGKey(3), S, n, p_drop=0.3)
+    for r in (0, 3, 7):
+        colmask, side_r, p8, salt0, salt1r = fast.round_params(mix, r)
+        dense = fused.ho_link_mask(colmask, side_r, salt0, salt1r, p8)
+        for jg in (jnp.arange(0, n // 2, dtype=jnp.int32),
+                   jnp.arange(n // 2, n, dtype=jnp.int32)):
+            block = _ho_block(mix, r, jg, n)
+            np.testing.assert_array_equal(
+                np.asarray(block), np.asarray(dense[:, jg, :]),
+                err_msg=f"round {r}, rows {jg[0]}..{jg[-1]}",
+            )

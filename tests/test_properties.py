@@ -1,0 +1,167 @@
+"""Property-based law tests (hypothesis) for the packed value types.
+
+Reference parity: the ScalaCheck suites — psync/ProgressTests.scala:9-31
+(Progress encode round-trips and lattice behavior under arbitrary values)
+and runtime/InstanceChecks.scala:9-40 (Time/Instance wrap-around
+comparison laws).  The example-based tests in test_progress.py /
+test_time.py / test_oob.py pin specific encodings; these pin the LAWS over
+the whole value space.
+"""
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from round_tpu.core.progress import Progress, timeout_in_bounds
+from round_tpu.core.time import Instance, Time
+from round_tpu.runtime.oob import Tag
+
+# -- strategies -------------------------------------------------------------
+
+# timeouts the encoding must round-trip (61-bit signed payload; the
+# reference stores JVM Long millis — exercise far past int32)
+timeouts = st.integers(min_value=0, max_value=(1 << 60) - 1)
+sync_ks = st.integers(min_value=0, max_value=1 << 20)
+
+progresses = st.one_of(
+    timeouts.map(Progress.timeout),
+    timeouts.map(Progress.strict_timeout),
+    sync_ks.map(Progress.sync),
+    st.just(Progress.WAIT_MESSAGE),
+    st.just(Progress.STRICT_WAIT_MESSAGE),
+    st.just(Progress.GO_AHEAD),
+)
+progresses_or_unchanged = st.one_of(progresses, st.just(Progress.UNCHANGED))
+
+
+# -- Progress: encode round-trips ------------------------------------------
+
+@given(timeouts)
+def test_progress_timeout_roundtrip(ms):
+    for ctor in (Progress.timeout, Progress.strict_timeout):
+        p = ctor(ms)
+        assert p.is_timeout and p.timeout_millis == ms
+        assert not (p.is_wait_message or p.is_go_ahead or p.is_sync
+                    or p.is_unchanged)
+    assert not Progress.timeout(ms).is_strict
+    assert Progress.strict_timeout(ms).is_strict
+    assert timeout_in_bounds(ms)
+
+
+@given(sync_ks)
+def test_progress_sync_roundtrip(k):
+    p = Progress.sync(k)
+    assert p.is_sync and p.k == k and p.is_strict
+    assert not (p.is_timeout or p.is_wait_message or p.is_go_ahead)
+
+
+@given(progresses_or_unchanged)
+def test_progress_kind_partition(p):
+    """Every value is exactly ONE of the five kinds (the predicates
+    partition the encoding space the constructors reach)."""
+    kinds = [p.is_timeout, p.is_wait_message, p.is_go_ahead, p.is_sync,
+             p.is_unchanged]
+    assert sum(map(bool, kinds)) == 1
+
+
+@given(progresses_or_unchanged, progresses_or_unchanged)
+def test_progress_or_else_left_bias(p, q):
+    r = p.or_else(q)
+    assert r == (q if p.is_unchanged else p)
+
+
+# -- Progress: lattice laws ------------------------------------------------
+
+@given(progresses)
+def test_progress_lattice_idempotent(p):
+    assert p.lub(p) == p
+    assert p.glb(p) == p
+
+
+@given(progresses, progresses)
+def test_progress_lattice_commutative(p, q):
+    assert p.lub(q) == q.lub(p)
+    assert p.glb(q) == q.glb(p)
+
+
+@settings(max_examples=300)
+@given(progresses, progresses, progresses)
+def test_progress_lattice_associative(p, q, r):
+    assert p.lub(q).lub(r) == p.lub(q.lub(r))
+    assert p.glb(q).glb(r) == p.glb(q.glb(r))
+
+
+@given(progresses, progresses)
+def test_progress_lattice_absorption(p, q):
+    """lub(p, glb(p, q)) == p and glb(p, lub(p, q)) == p — the pair of laws
+    that make (lub, glb) an actual lattice rather than two unrelated
+    merges."""
+    assert p.lub(p.glb(q)) == p
+    assert p.glb(p.lub(q)) == p
+
+
+# -- Time / Instance wrap-around -------------------------------------------
+
+i32s = st.integers(min_value=-(1 << 31), max_value=(1 << 31) - 1)
+# offsets that keep |a-b| < 2^31 (the documented validity window)
+small_i32 = st.integers(min_value=-(1 << 30), max_value=(1 << 30) - 1)
+i16s = st.integers(min_value=-(1 << 15), max_value=(1 << 15) - 1)
+small_i16 = st.integers(min_value=-(1 << 14), max_value=(1 << 14) - 1)
+
+
+@settings(deadline=None)  # jnp dispatch: first example pays compile time
+@given(i32s, small_i32)
+def test_time_wraparound_comparisons(a, k):
+    """Within the validity window, comparisons see through the wrap: the
+    ordering of a and a+k matches the sign of k even when a+k crosses the
+    int32 boundary (Time.scala:7-18)."""
+    b = Time.add(a, k)
+    assert bool(Time.lt(a, b)) == (k > 0)
+    assert bool(Time.gt(a, b)) == (k < 0)
+    assert bool(Time.leq(a, b)) == (k >= 0)
+    assert bool(Time.geq(a, b)) == (k <= 0)
+    assert int(Time.diff(b, a)) == k
+
+
+@settings(deadline=None)
+@given(i32s, small_i32)
+def test_time_max_min_pick_an_argument(a, k):
+    b = Time.add(a, k)
+    mx, mn = int(Time.max(a, b)), int(Time.min(a, b))
+    a32 = int(np.int32(((a + 2**31) % 2**32) - 2**31))
+    assert {mx, mn} == {a32, int(b)}
+    assert bool(Time.leq(mn, mx))
+
+
+@settings(deadline=None)
+@given(i16s, small_i16)
+def test_instance_wraparound_comparisons(a, k):
+    b = Instance.add(a, k)
+    assert bool(Instance.lt(a, b)) == (k > 0)
+    assert bool(Instance.leq(a, b)) == (k >= 0)
+    mx = int(Instance.max(a, b))
+    a16 = int(np.int16(((a + 2**15) % 2**16) - 2**15))
+    assert mx in (a16, int(b))
+
+
+# -- Tag pack/unpack --------------------------------------------------------
+
+@given(
+    st.integers(min_value=0, max_value=0xFFFF),
+    st.integers(min_value=0, max_value=0xFFFFFFFF),
+    st.integers(min_value=0, max_value=0xFF),
+    st.integers(min_value=0, max_value=0xFF),
+)
+def test_tag_pack_unpack_roundtrip(instance, rnd, flag, call_stack):
+    t = Tag(instance=instance, round=rnd, flag=flag, call_stack=call_stack)
+    word = t.pack()
+    assert 0 <= word < (1 << 64)
+    assert Tag.unpack(word) == t
+
+
+@given(st.integers(min_value=0, max_value=(1 << 64) - 1))
+def test_tag_unpack_pack_is_identity_on_words(word):
+    """Every 64-bit word is a valid header and survives unpack∘pack — the
+    receive path can never crash on a hostile header (the byzantine
+    tolerance the host tests exercise at the payload layer)."""
+    assert Tag.unpack(word).pack() == word
