@@ -232,3 +232,37 @@ class LastVoting(Algorithm):
 
     def decision(self, state: LVState):
         return state.decision
+
+
+class LastVotingBytes(LastVoting):
+    """LastVoting over OPAQUE fixed-width byte payloads — the LastVotingB
+    role (example/LastVotingB.scala: consensus on Array[Byte] command
+    batches).  The reference ships variable-length byte arrays through its
+    serializer; the TPU-first form is a FIXED lane width ``payload_bytes``
+    (uint8[B] vectors ride the engines as any vector payload; fixed width
+    is what keeps the batch jittable — pad short commands, the SMR's
+    batching already works in fixed-size batches).
+
+    The four rounds are inherited UNCHANGED: they touch the value only
+    through gathers and masked selects, which are payload-polymorphic.
+    The trace spec is int-domain and does not apply here."""
+
+    def __init__(self, payload_bytes: int = 16):
+        super().__init__()
+        self.payload_bytes = payload_bytes
+        self.spec = None
+
+    def make_init_state(self, ctx: RoundCtx, io) -> LVState:
+        x = jnp.asarray(io["initial_value"], dtype=jnp.uint8)
+        assert x.shape == (self.payload_bytes,), x.shape
+        zeros = jnp.zeros((self.payload_bytes,), dtype=jnp.uint8)
+        return LVState(
+            x=x,
+            ts=jnp.asarray(-1, dtype=jnp.int32),
+            ready=jnp.asarray(False),
+            commit=jnp.asarray(False),
+            vote=zeros,
+            decided=jnp.asarray(False),
+            # no -1 sentinel in the byte domain: `decided` is the truth
+            decision=zeros,
+        )

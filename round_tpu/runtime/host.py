@@ -385,8 +385,12 @@ class HostRunner:
 
             # -- accumulate (InstanceHandler.scala:164-353) ---------------
             inbox: Dict[int, Any] = dict(self._pending.pop(r, {}))
-            if dest[self.id] and sending:
-                inbox[self.id] = payload_np  # self-delivery off the wire
+            if dest[self.id]:
+                # self-delivery is NEVER suppressed: a replica's message to
+                # itself cannot be communication-closed-late, and dropping
+                # it would starve the full-mailbox go-ahead probe on every
+                # suppressed round — the knob suppresses WIRE sends only
+                inbox[self.id] = payload_np
             prog = self._round_progress(rnd)
             block = prog.is_strict       # strict: no catch-up early-exit
             use_deadline = prog.is_timeout
@@ -506,36 +510,29 @@ class HostRunner:
                     continue  # re-check the deadline
                 if ingest(got):
                     dirty = True
-            if not self.send_when_catching_up and not oob_decided:
-                # frontier-aware accumulation: ingestion normally stops at
-                # the quorum break, so a replica replaying a long backlog
-                # never SEES the rounds ahead and the catch-up policy has
-                # nothing to act on (the reference's one-message-at-a-time
-                # loop reads ahead by construction).  Drain without
-                # blocking — future rounds land in the pending buffer
-                # (they would have anyway) and push next_round forward;
-                # buffer_only keeps the CURRENT round's mailbox exactly
-                # what the default policy would have given it, so the knob
-                # changes send suppression and nothing else.
+            if (prog.is_go_ahead or not self.send_when_catching_up) \
+                    and not oob_decided:
+                # ONE non-blocking drain, two roles.  (a) A GoAhead round
+                # delivers messages ALREADY QUEUED in the transport before
+                # updating (the reference delivers pending messages before
+                # ending the round, InstanceHandler.scala:219-231):
+                # same-round into the inbox, future rounds into the
+                # buffer.  (b) The catch-up send policy needs the FRONTIER
+                # visible: ingestion normally stops at the quorum break,
+                # so a replica replaying a long backlog never sees the
+                # rounds ahead (the reference's one-message-at-a-time loop
+                # reads ahead by construction) — future rounds land in the
+                # pending buffer and push next_round forward.  In role (b)
+                # alone, post-quorum same-round payloads are DROPPED
+                # (buffer_only): under the default policy they would have
+                # been read next round and dropped as late, so the knob
+                # stays behavior-neutral for the current round's update.
                 while True:
                     got = self.transport.recv(0)
                     if got is None:
                         break
-                    ingest(got, extend_deadline=False, buffer_only=True)
-                    if oob_decided:
-                        break
-
-            if prog.is_go_ahead and not oob_decided:
-                # a GoAhead round still delivers messages ALREADY QUEUED in
-                # the transport before updating (the reference delivers
-                # pending messages before ending the round,
-                # InstanceHandler.scala:219-231): drain without blocking —
-                # same-round into the inbox, future rounds into the buffer
-                while True:
-                    got = self.transport.recv(0)
-                    if got is None:
-                        break
-                    ingest(got, extend_deadline=False)
+                    ingest(got, extend_deadline=False,
+                           buffer_only=not prog.is_go_ahead)
                     if oob_decided:
                         break
 

@@ -61,7 +61,19 @@ class ReplicatedStateMachine:
         batch_size: int = 8,
         max_phases: int = 6,
         window: int = 16,
+        payload: str = "index",
     ):
+        """payload="index" (default): consensus agrees on int batch
+        INDICES, the batch store resolves them (round-4 state).
+        payload="bytes": consensus agrees on the RAW uint8[batch_size]
+        command batch itself — the LastVotingB role
+        (example/LastVotingB.scala ships Array[Byte] through consensus;
+        pair with models.lastvoting.LastVotingBytes so the decided value
+        IS the replicated command bytes, end to end on-chip).  Commands
+        must be 0..255; the decided log carries byte rows and replays
+        them directly — no index indirection to desynchronize."""
+        assert payload in ("index", "bytes"), payload
+        self.payload = payload
         self.n = n
         self.apply_fn = apply_fn
         self.sm_init = sm_init
@@ -99,7 +111,11 @@ class ReplicatedStateMachine:
             self._queue[self.batch_size:],
         )
         idx = len(self.batch_store)
-        self.batch_store[idx] = np.asarray(cmds, dtype=np.int32)
+        if self.payload == "bytes":
+            assert all(0 <= c <= 255 for c in cmds), "byte commands only"
+            self.batch_store[idx] = np.asarray(cmds, dtype=np.uint8)
+        else:
+            self.batch_store[idx] = np.asarray(cmds, dtype=np.int32)
         return idx
 
     # -- consensus side ----------------------------------------------------
@@ -116,14 +132,22 @@ class ReplicatedStateMachine:
                 break
             inst = self.next_instance
             self.next_instance = (self.next_instance + 1) % (1 << 16)
-            # every lane proposes the batch index (in a real deployment each
-            # replica proposes the batch it heard; value-agreement on the
-            # index is what LastVotingB's byte payload gives)
-            self.pool.submit(inst, consensus_io([b] * self.n))
+            if self.payload == "bytes":
+                # every lane proposes the RAW command bytes; the decided
+                # value IS the replicated batch (LastVotingB semantics)
+                row = self.batch_store[b]
+                self.pool.submit(inst, consensus_io(
+                    np.broadcast_to(row, (self.n,) + row.shape).copy()))
+            else:
+                # every lane proposes the batch index (the round-4 state:
+                # value-agreement on an int, store-resolved)
+                self.pool.submit(inst, consensus_io([b] * self.n))
             count += 1
         for res in self.pool.run_all(key):
             if res.value is not None:
-                self.decided_batches[res.instance_id] = int(res.value)
+                self.decided_batches[res.instance_id] = (
+                    np.asarray(res.value, dtype=np.uint8)
+                    if self.payload == "bytes" else int(res.value))
         return count
 
     # -- apply / replay / recovery ----------------------------------------
@@ -138,22 +162,24 @@ class ReplicatedStateMachine:
         """Copy missing decisions (and their batches) from a peer — the
         askDecision/Decision round-trip of Recovery.scala.  Returns number
         of instances recovered."""
+        def copy_one(i) -> bool:
+            if i not in peer.decided_batches:
+                return False
+            b = peer.decided_batches[i]
+            self.decided_batches[i] = b
+            # byte rows ARE the commands — nothing to resolve; index
+            # decisions also need the referenced batch contents
+            if (self.payload == "index" and b not in self.batch_store
+                    and b in peer.batch_store):
+                self.batch_store[b] = peer.batch_store[b]
+            return True
+
         got = 0
         for i in self.log_gaps():
-            if i in peer.decided_batches:
-                b = peer.decided_batches[i]
-                self.decided_batches[i] = b
-                if b not in self.batch_store and b in peer.batch_store:
-                    self.batch_store[b] = peer.batch_store[b]
-                got += 1
+            got += copy_one(i)
         if self.next_instance < peer.next_instance:
             for i in range(self.next_instance, peer.next_instance):
-                if i in peer.decided_batches:
-                    b = peer.decided_batches[i]
-                    self.decided_batches[i] = b
-                    if b not in self.batch_store and b in peer.batch_store:
-                        self.batch_store[b] = peer.batch_store[b]
-                    got += 1
+                got += copy_one(i)
             self.next_instance = peer.next_instance
         return got
 
@@ -173,7 +199,9 @@ class ReplicatedStateMachine:
         upto = self._applied.upto
         batches = []
         while upto in self.decided_batches:
-            batches.append(self.batch_store[self.decided_batches[upto]])
+            d = self.decided_batches[upto]
+            batches.append(d if self.payload == "bytes"
+                           else self.batch_store[d])
             upto += 1
         if batches:
             new_state = self._replay(

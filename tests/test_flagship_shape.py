@@ -1,0 +1,78 @@
+"""Flagship-shape off-hardware CI (round-5 verdict item 5).
+
+Round 4 lost its only TPU window to a kernel compile; nothing off-hardware
+exercised the n=1024 shape, so an `ops/fused.py` VMEM/shape regression
+would first surface inside a precious tunnel window.  Two guards, neither
+needing a TPU:
+
+  1. interpret-mode execution of the v2 loop kernel at the flagship n
+     (tiny S / rounds), lane-exact against the per-round engine — the
+     SEMANTICS of the exact shape;
+  2. cross-platform `jax.export` of the UNmodified flagship benchmark
+     configuration (n=1024, hw-PRNG, sb=8, 50 rounds, both MXU dtypes and
+     the flat fallback variant) to platform "tpu" — this runs the actual
+     Pallas→Mosaic kernel-generation pipeline on the CPU box and fails on
+     layout/VMEM/shape errors that interpret mode cannot see.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from round_tpu.engine import fast
+from round_tpu.models.otr import OtrState
+
+N_FLAGSHIP, V = 1024, 16
+
+
+def _setup(S):
+    key = jax.random.PRNGKey(0)
+    mix = fast.standard_mix(key, S, N_FLAGSHIP, p_drop=0.25)
+    init = jax.random.randint(jax.random.fold_in(key, 1), (N_FLAGSHIP,),
+                              0, V, dtype=jnp.int32)
+    state0 = OtrState.fresh(init, S, N_FLAGSHIP)
+    rnd = fast.OtrHist(n_values=V, after_decision=2)
+    return rnd, state0, mix
+
+
+def test_flagship_n_interpret_parity():
+    """The v2 loop kernel EXECUTES at n=1024 (interpret mode) and is
+    lane-exact against the per-round engine on the same mix."""
+    rounds = 2
+    rnd, state0, mix = _setup(S=2)
+    state, done, dr = fast.run_otr_loop(
+        rnd, state0, mix, max_rounds=rounds, mode="hash", sb=1,
+        interpret=True, dot="i8", variant="v2")
+    ref, ref_done, ref_dr = fast.run_hist(
+        rnd, state0, lambda s: s.decided, mix,
+        max_rounds=rounds, mode="hash", interpret=True, dot="i8")
+    for name in ("x", "decided", "decision"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(state, name)),
+            np.asarray(getattr(ref, name)), err_msg=name)
+    np.testing.assert_array_equal(np.asarray(dr), np.asarray(ref_dr))
+
+
+@pytest.mark.parametrize("dot,variant", [("i8", "v2"), ("bf16", "v2"),
+                                         ("i8", "flat")])
+def test_flagship_kernel_lowers_for_tpu(dot, variant):
+    """The EXACT flagship benchmark configuration cross-lowers to a TPU
+    Mosaic kernel from this CPU-only box: jax.export(platforms=("tpu",))
+    runs the Pallas→Mosaic pipeline, so a kernel change that breaks the
+    n=1024 lowering fails HERE, not in a tunnel window.  S is a stand-in
+    (the scenario grid count doesn't change the kernel body)."""
+    from jax import export as jexport
+
+    rounds = 50
+    rnd, state0, mix = _setup(S=16)
+
+    def run(state0, mix):
+        return fast.run_otr_loop(
+            rnd, state0, mix, max_rounds=rounds, mode="hw", sb=8,
+            interpret=False, dot=dot, variant=variant)
+
+    exp = jexport.export(jax.jit(run), platforms=("tpu",))(state0, mix)
+    txt = exp.mlir_module()
+    assert "tpu_custom_call" in txt, \
+        f"no Mosaic kernel in the lowered module ({dot}/{variant})"

@@ -1107,3 +1107,45 @@ def test_host_catch_up_send_policy_knobs():
     res_lag, suppressed = results[2]
     assert suppressed == 0
     assert wire[2] == 2 * res_lag.rounds_run
+
+
+def test_host_byte_payload_consensus():
+    """Opaque byte payloads over the REAL wire (LastVotingB's deployment
+    role): four replicas propose four different uint8 command rows; the
+    framed transport ships the byte vectors, and everyone decides the
+    same raw bytes — one of the proposals, bit-for-bit."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from round_tpu.models.lastvoting import LastVotingBytes
+    from round_tpu.runtime.host import HostRunner
+
+    n, B = 4, 12
+    ports = _free_ports(n)
+    peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
+    proposals = [bytes([i * 16 + k for k in range(B)]) for i in range(n)]
+    algo = LastVotingBytes(payload_bytes=B)
+    results = {}
+
+    def node(my_id):
+        tr = HostTransport(my_id, peers[my_id][1])
+        try:
+            runner = HostRunner(algo, my_id, peers, tr, timeout_ms=500)
+            results[my_id] = runner.run(
+                {"initial_value": np.frombuffer(proposals[my_id],
+                                                dtype=np.uint8)},
+                max_rounds=24,
+            )
+        finally:
+            tr.close()
+
+    threads = [threading.Thread(target=node, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert len(results) == n
+    assert all(r.decided for r in results.values())
+    decided = {bytes(np.asarray(r.decision)) for r in results.values()}
+    assert len(decided) == 1
+    assert decided.pop() in set(proposals)

@@ -205,3 +205,47 @@ def test_smr_byzantine_decides_through_primary_failure():
     assert rsm.log_gaps() == []
     assert int(rsm.apply_decided()) == sum(range(1, 9))
     assert rsm.applied_upto == 2
+
+
+def test_smr_opaque_byte_payloads_replicate_commands():
+    """LastVotingB parity (round-5 verdict item 6): consensus carries the
+    RAW uint8 command batch — the decided log IS the byte commands, an
+    order-sensitive hash-chain state machine replays them, and a fresh
+    replica recovers the identical byte log and state."""
+    import numpy as np
+
+    from round_tpu.models.lastvoting import LastVotingBytes
+
+    n, B = 4, 8
+
+    def apply_fn(state, batch):
+        def step(s, c):
+            return s * jnp.uint32(31) + c.astype(jnp.uint32), None
+
+        out, _ = jax.lax.scan(step, state, batch)
+        return out
+
+    def make():
+        return ReplicatedStateMachine(
+            LastVotingBytes(payload_bytes=B), n, apply_fn,
+            jnp.asarray(7, jnp.uint32), scenarios.full(n),
+            batch_size=B, max_phases=4, payload="bytes",
+        )
+
+    rsm = make()
+    payload = b"hello, tpu-smr!!"   # 16 bytes = 2 batches
+    rsm.propose(payload)
+    assert rsm.run(jax.random.PRNGKey(0)) == 2
+    assert rsm.log_gaps() == []
+    # the decided log IS the byte commands, in order
+    log = [rsm.decided_batches[i] for i in range(2)]
+    assert all(l.dtype == np.uint8 for l in log)
+    assert bytes(np.concatenate(log)) == payload
+    expected = 7
+    for c in payload:
+        expected = (expected * 31 + c) % (1 << 32)
+    assert int(rsm.apply_decided()) == expected
+
+    fresh = make()
+    assert fresh.recover_from(rsm) == 2
+    assert int(fresh.apply_decided()) == expected
