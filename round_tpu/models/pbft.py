@@ -147,6 +147,31 @@ class PbftVcState:
     sel_req: jnp.ndarray    # int32 — the new view's request
     nv_ok: jnp.ndarray      # bool — confirmed-certificate quorum reached
 
+    @classmethod
+    def fresh(cls, x0: jnp.ndarray, S: int, n: int) -> "PbftVcState":
+        """The batched [S, n] initial state (the OtrState.fresh precedent):
+        ONE constructor shared by the fused engine's callers — tests, the
+        soak, benches — so a field added here cannot desynchronize them."""
+        i32 = jnp.int32
+        return cls(
+            x=jnp.broadcast_to(x0, (S, n)),
+            dig=jnp.broadcast_to(digest(x0), (S, n)),
+            valid=jnp.ones((S, n), bool),
+            prepared=jnp.zeros((S, n), bool),
+            decided=jnp.zeros((S, n), bool),
+            decision=jnp.full((S, n), DECIDE_NULL, i32),
+            view=jnp.zeros((S, n), i32),
+            next_view=jnp.zeros((S, n), i32),
+            vc_active=jnp.zeros((S, n), bool),
+            prep_req=jnp.zeros((S, n), i32),
+            prep_view=jnp.full((S, n), -1, i32),
+            vc_heard=jnp.zeros((S, n, n), bool),
+            vc_req=jnp.zeros((S, n, n), i32),
+            vc_pv=jnp.full((S, n, n), -1, i32),
+            sel_req=jnp.zeros((S, n), i32),
+            nv_ok=jnp.zeros((S, n), bool),
+        )
+
 
 def _vc_coord(state: PbftVcState, ctx: RoundCtx):
     """Primary of the CURRENT view (PBFT rotation: view mod n)."""

@@ -141,6 +141,32 @@ def tpc_spec() -> ProtocolSpec:
     f1 = And(nobody_decided, commit_rule)
     init = f0
 
+    # -- phase liveness walk (no upstream analogue: TpcExample.scala has
+    # no progress obligations at all).  Under the good-phase environment —
+    # the coordinator hears everyone and everyone hears the coordinator —
+    # one phase decides EVERYWHERE with the exact atomic-commit outcome:
+    #   live ∧ TR₁ ⊨ (commit(coord) ↔ unanimous yes)′   (collect)
+    #   that ∧ live ∧ TR₂ ⊨ (∀i decided ∧ commit(i) ↔ unanimous)′
+    # The ↔ is liveness-dependent: without all votes heard, a unanimous-yes
+    # run still aborts (the ← direction fails — the negative control in
+    # tests/test_tpc.py).
+    vote_all = ForAll([k], sig.get("vote", k))
+    live = And(
+        ForAll([i], In(i, ho_of(coord))),
+        ForAll([i], In(coord, ho_of(i))),
+    )
+    c1 = Eq(sig.get("commit", coord), vote_all)
+    c2 = ForAll([i], And(
+        sig.get("decided", i),
+        Eq(sig.get("commit", i), vote_all),
+    ))
+    walk = [
+        ("progress: collect — the outcome is exactly the unanimity test",
+         live, r1.full_tr(), sig.prime(c1)),
+        ("progress: broadcast — everyone decides the atomic outcome",
+         And(c1, live), r2.full_tr(), sig.prime(c2)),
+    ]
+
     return ProtocolSpec(
         sig=sig,
         rounds=[r1, r2],
@@ -158,6 +184,7 @@ def tpc_spec() -> ProtocolSpec:
              "coordinator", f1, r2.full_tr(), sig.prime(sc)),
         ],
         round_staged_init=f0,
+        phase_progress=walk,
     )
 
 

@@ -1019,24 +1019,7 @@ def test_pbft_view_change_fast_parity():
     io = {"initial_value": x0}
     i32 = jnp.int32
 
-    state0 = PbftVcState(
-        x=jnp.broadcast_to(x0, (S, n)),
-        dig=jnp.broadcast_to(digest(x0), (S, n)),
-        valid=jnp.ones((S, n), bool),
-        prepared=jnp.zeros((S, n), bool),
-        decided=jnp.zeros((S, n), bool),
-        decision=jnp.full((S, n), -1, i32),
-        view=jnp.zeros((S, n), i32),
-        next_view=jnp.zeros((S, n), i32),
-        vc_active=jnp.zeros((S, n), bool),
-        prep_req=jnp.zeros((S, n), i32),
-        prep_view=jnp.full((S, n), -1, i32),
-        vc_heard=jnp.zeros((S, n, n), bool),
-        vc_req=jnp.zeros((S, n, n), i32),
-        vc_pv=jnp.full((S, n, n), -1, i32),
-        sel_req=jnp.zeros((S, n), i32),
-        nv_ok=jnp.zeros((S, n), bool),
-    )
+    state0 = PbftVcState.fresh(x0, S, n)
     state, done, dround = fast.run_pbft_vc_fast(state0, mix,
                                                 max_rounds=rounds)
 

@@ -1,0 +1,99 @@
+"""Reducer completeness warts, tracked as REDUCER test cases (round-5
+verdict weak #5: each wart papered over by chain machinery must also be
+pinned where it lives, so a reducer change that closes — or widens — the
+gap is visible here, not only as a chain workaround's behavior).
+
+Two warts are tracked:
+
+  1. the ∧-elimination skip (verifier._composed_vc): a justification goal
+     that is VERBATIM a conjunct of its membership-checked hypothesis is
+     discharged syntactically, because the reducer's bounded instantiation
+     was observed (LV chains, round 4) to FAIL re-proving X from
+     X ∧ extra-card-atoms in some shapes.  The canary below pins the
+     SIMPLE shape as provable — the wart lives beyond it, so if this
+     canary ever fails the gap has WIDENED into basic territory and the
+     skip became load-bearing for trivial goals;
+  2. the branch-quantified Ite gap (fixed round 4): a quantifier buried in
+     an Ite operand inside a Bool-Eq atom stayed opaque until
+     cl.lift_quantified_ites learned to lift on binders in ANY Ite
+     operand.  The minimal reproduction is pinned positive here.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from round_tpu.verify.cl import ClConfig, entailment
+from round_tpu.verify.formula import (
+    And, Bool, Card, Comprehension, Eq, Exists, FALSE, ForAll, FunT, Geq,
+    Gt, Implies, In, Int, IntLit, Ite, Times, UnInterpretedFct,
+    Variable, procType,
+)
+from round_tpu.verify.tr import StateSig, ho_of
+from round_tpu.verify.venn import N_VAR as N
+
+
+def test_conjunct_reproval_canary():
+    """The SIMPLE verbatim-conjunct shape proves through the reducer even
+    with cardinality atoms alongside (wart 1's boundary): the ∧-elim skip
+    is an optimization here, not load-bearing.  The observed LV failures
+    involved deeper trigger poisoning that has no minimal reproduction
+    yet — if THIS starts failing, bounded instantiation regressed into
+    basic territory."""
+    sig = StateSig({"x": Int, "ts": Int})
+    i = Variable("i", procType)
+    k = Variable("k", procType)
+    v = Variable("v", Int)
+    t = Variable("t", Int)
+    X = ForAll([i], Implies(Geq(sig.get("ts", i), t),
+                            Eq(sig.get("x", i), v)))
+    aset = Comprehension([k], Geq(sig.get("ts", k), t))
+    bset = Comprehension([k], Eq(sig.get("x", k), v))
+    extras = [Gt(Times(2, Card(aset)), N), Gt(Times(2, Card(bset)), N),
+              Geq(Card(ho_of(i)), IntLit(1))]
+    cfg = ClConfig(venn_bound=2, inst_depth=1)
+    assert entailment(X, X, cfg, timeout_s=60)
+    assert entailment(And(X, *extras), X, cfg, timeout_s=60)
+
+
+def test_branch_quantified_ite_lift():
+    """Wart 2's minimal reproduction, pinned FIXED: a quantifier inside an
+    Ite branch inside a Bool-Eq atom must be lifted (cl.lift_quantified_
+    ites on binders in any Ite operand), or the existential stays buried
+    in an opaque atom and the witness never instantiates.  Surfaced by
+    the KSet can-propagation lemma (round 4)."""
+    j = Variable("j", procType)
+    k = Variable("k", procType)
+    S = Variable("S", ho_of(j).tpe)
+    p = UnInterpretedFct("gapP", FunT([procType], Bool))
+    cond = UnInterpretedFct("gapC", FunT([procType], Bool))
+
+    def p_of(x):
+        from round_tpu.verify.formula import Application
+
+        return Application(p, [x]).with_type(Bool)
+
+    def c_of(x):
+        from round_tpu.verify.formula import Application
+
+        return Application(cond, [x]).with_type(Bool)
+
+    a = Variable("a", procType)
+    hyp = And(
+        Eq(p_of(j), Ite(c_of(j),
+                        Exists([k], And(In(k, ho_of(j)), p_of(k))),
+                        FALSE)),
+        c_of(j),
+        In(a, ho_of(j)),
+        p_of(a),
+    )
+    cfg = ClConfig(venn_bound=1, inst_depth=2)
+    assert entailment(hyp, p_of(j), cfg, timeout_s=60)
+    # control: without the heard witness the entailment must fail
+    hyp_weak = And(
+        Eq(p_of(j), Ite(c_of(j),
+                        Exists([k], And(In(k, ho_of(j)), p_of(k))),
+                        FALSE)),
+        c_of(j),
+    )
+    assert not entailment(hyp_weak, p_of(j), cfg, timeout_s=20)

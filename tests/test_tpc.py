@@ -96,3 +96,36 @@ def test_uniform_agreement_under_omission():
         reached = set(v for v in decv[s].tolist() if v != DEC_NONE)
         assert len(reached) <= 1, f"scenario {s}: {reached}"
         assert DEC_COMMIT not in reached  # one vote was no
+
+
+def test_tpc_phase_walk_and_liveness_control():
+    """The TPC phase-liveness walk (round-5 continuation; TpcExample.scala
+    has no progress obligations at all): both good-phase VCs discharge,
+    and the no-liveness control refutes the collect step — without all
+    votes heard, a unanimous-yes run still aborts, so the outcome↔
+    unanimity biconditional must NOT prove."""
+    from round_tpu.verify.futils import collect, get_conjuncts
+    from round_tpu.verify.cl import ClDefault
+    from round_tpu.verify.protocols import tpc_spec
+    from round_tpu.verify.tr import HO_FN
+    from round_tpu.verify.vc import SingleVC
+    from round_tpu.verify.formula import And, Application, TRUE
+
+    spec = tpc_spec()
+    cfg = spec.config or ClDefault
+    walk = spec.phase_progress
+    assert len(walk) == 2
+    for name, hyp, tr, concl in walk:
+        assert SingleVC(name, hyp, tr, concl,
+                        timeout_s=240.0).solve(cfg), name
+
+    def has_ho(f):
+        return bool(collect(
+            lambda g: isinstance(g, Application) and g.fct == HO_FN, f))
+
+    name, hyp, tr, concl = walk[0]
+    parts = [p for p in get_conjuncts(hyp) if not has_ho(p)]
+    assert len(parts) < len(get_conjuncts(hyp))
+    assert not SingleVC(name + " [no-live control]",
+                        And(*parts) if parts else TRUE, tr, concl,
+                        timeout_s=45.0).solve(cfg)

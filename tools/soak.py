@@ -91,11 +91,25 @@ def compare_scenarios(algo, io, got_state, mix, key, fields, phases, cfg):
     return None
 
 
-def check_otr_family(rng, it):
-    n = int(rng.choice([8, 16, 24, 32, 48]))
-    S = int(rng.choice([4, 8]))
-    V = int(rng.choice([2, 3, 4, 8]))
-    rounds = int(rng.integers(4, 12))
+def check_otr_family(rng, it, scale=False):
+    """OTR differential check; scale=True is the NIGHTLY-WEIGHT rung
+    (round-5 verdict item 9): n >= 256 — between hardware windows, scale
+    bugs in the flagship family (mask generation, loop-kernel carries,
+    proc-axis blocks) must surface HERE on CPU, not inside a TPU window.
+    Costs ~30-90 s per iteration; the rotation runs it once per cycle."""
+    if scale:
+        n = int(rng.choice([256, 384, 512]))
+        # S=4 so standard_mix's arange(S) % 4 family assignment covers ALL
+        # FOUR fault families at scale — partition side/rowmask and the
+        # rotating victim included, not just iid omission and crash
+        S = 4
+        V = int(rng.choice([2, 4]))
+        rounds = int(rng.integers(4, 7))
+    else:
+        n = int(rng.choice([8, 16, 24, 32, 48]))
+        S = int(rng.choice([4, 8]))
+        V = int(rng.choice([2, 3, 4, 8]))
+        rounds = int(rng.integers(4, 12))
     p_drop = float(rng.choice([0.0, 0.1, 0.25, 0.4]))
     key = jax.random.PRNGKey(int(rng.integers(0, 2**31)))
     mix = fast.standard_mix(key, S, n, p_drop=p_drop)
@@ -103,8 +117,8 @@ def check_otr_family(rng, it):
                               dtype=jnp.int32)
     rnd = fast.OtrHist(n_values=V, after_decision=2)
     state0 = OtrState.fresh(init, S, n)
-    cfg = dict(kind="otr", n=n, S=S, V=V, rounds=rounds, p_drop=p_drop,
-               it=it)
+    cfg = dict(kind="otr-scale" if scale else "otr", n=n, S=S, V=V,
+               rounds=rounds, p_drop=p_drop, it=it)
 
     ref = fast.run_hist(rnd, state0, lambda s: s.decided, mix,
                         max_rounds=rounds, mode="hash", interpret=True)
@@ -192,7 +206,34 @@ def check_tpc_kset(rng, it):
     n = int(rng.choice([8, 12, 16]))
     S = int(rng.choice([4, 8]))
     key = jax.random.PRNGKey(int(rng.integers(0, 2**31)))
-    pick = int(rng.integers(0, 5))
+    pick = int(rng.integers(0, 6))
+    if pick == 5:
+        from round_tpu.models.pbft import PbftVcState, PbftViewChange, digest
+
+        p_drop = float(rng.choice([0.1, 0.25]))
+        S = 4  # two 6-round phases per scenario — keep the slot bounded
+        mix = fast.standard_mix(key, S, n, p_drop=p_drop, f=max(1, n // 4),
+                                crash_round=0)
+        if rng.integers(0, 2):
+            # half the draws force a primary-crash rotation witness
+            mix = mix.replace(
+                crashed=mix.crashed.at[0].set(False).at[0, 0].set(True),
+                crash_round=mix.crash_round.at[0].set(0),
+                p8=mix.p8.at[0].set(0),
+                heal_round=mix.heal_round.at[0].set(0),
+            )
+        x0 = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0, 1000,
+                                dtype=jnp.int32)
+        cfg = dict(kind="pbft-vc", n=n, S=S, p_drop=p_drop, it=it)
+        state0 = PbftVcState.fresh(x0, S, n)
+        got = fast.run_pbft_vc_fast(state0, mix, max_rounds=12)
+        algo = PbftViewChange()
+        return compare_scenarios(
+            algo, {"initial_value": x0}, got[0], mix, key,
+            ("x", "dig", "valid", "prepared", "decided", "decision",
+             "view", "next_view", "vc_active", "prep_req", "prep_view",
+             "vc_heard", "vc_req", "vc_pv", "sel_req", "nv_ok"),
+            2, cfg) or cfg
     if pick == 4:
         from round_tpu.models.pbft import BcpState, PbftConsensus, digest
 
@@ -393,7 +434,8 @@ def main():
     it = ok = 0
     log({"step": "soak-start", "seed": args.seed, "minutes": args.minutes})
     rotation = [check_otr_family, check_otr_family, check_epsilon,
-                check_lattice, check_tpc_kset, check_erb]
+                check_lattice, check_tpc_kset, check_erb,
+                lambda r, i: check_otr_family(r, i, scale=True)]
     while time.monotonic() < t_end:
         check = rotation[it % len(rotation)]
         t0 = time.perf_counter()
