@@ -1134,7 +1134,7 @@ def run_hist(
     mode: str = "hw",
     sb: int = 8,
     interpret: bool = False,
-    dot: str = "bf16",
+    dot: str = "i8",  # lane-exact (0/1 operands, i32 accumulate); 2x MXU on v5e
 ):
     """Scan `max_rounds` fused rounds over the full scenario batch.
 
@@ -1178,7 +1178,7 @@ def run_otr_loop(
     mode: str = "hw",
     sb: int = 8,
     interpret: bool = False,
-    dot: str = "bf16",
+    dot: str = "i8",  # lane-exact (0/1 operands, i32 accumulate); 2x MXU on v5e
     variant: str = "v2",
 ):
     """The flagship fast path: the whole OTR run as ONE Pallas kernel
@@ -1238,7 +1238,7 @@ def run_floodmin_loop(
     mode: str = "hw",
     sb: int = 8,
     interpret: bool = False,
-    dot: str = "bf16",
+    dot: str = "i8",  # lane-exact (0/1 operands, i32 accumulate); 2x MXU on v5e
 ):
     """FloodMin's whole run as ONE Pallas kernel (ops.fused.FloodMinLoop) —
     drop-in for run_hist(FloodMinHist(...), fresh state0, ...); same
@@ -1265,7 +1265,7 @@ def run_benor_loop(
     mode: str = "hw",
     sb: int = 8,
     interpret: bool = False,
-    dot: str = "bf16",
+    dot: str = "i8",  # lane-exact (0/1 operands, i32 accumulate); 2x MXU on v5e
 ):
     """Ben-Or's whole run as ONE Pallas kernel (ops.fused.BenOrLoop, two
     subrounds per phase dispatched in-kernel) — drop-in for

@@ -88,11 +88,11 @@ def _count_dot(oh, keep, dot: str):
     operands are 0/1 (no rounding in either dtype) and the accumulator
     (f32 up to 2^24 / int32) holds any count ≤ n.
 
-    bf16 (default): the universally-supported MXU path.
-    i8: int8 operands with an int32 accumulator — 2x MXU throughput on
-    v5e-class chips; an A/B candidate for the hardware session
-    (bench.py --dot i8), cast to f32 after so the in-kernel update math
-    is dtype-identical."""
+    i8 (the default everywhere since round 5): int8 operands with an
+    int32 accumulator — 2x MXU throughput on v5e-class chips, cast to
+    f32 after so the in-kernel update math is dtype-identical.
+    bf16: the universally-supported MXU path; the bench's unconditional
+    A/B records it as the other configuration (bench.py --dot bf16)."""
     if dot == "i8":
         return jnp.dot(
             oh.astype(jnp.int8), keep.astype(jnp.int8),
@@ -130,7 +130,7 @@ def _kernel(
     mode: str,
     sided: bool,
     rowmasked: bool,
-    dot: str = "bf16",
+    dot: str = "i8",  # lane-exact (0/1 operands, i32 accumulate); 2x MXU on v5e
 ):
     # operand order mirrors hist_exchange: vals, senders, [rowmask], [side],
     # salt0, salt1r, p8 (SMEM), out.  rowmask/side refs exist only when the
@@ -193,7 +193,7 @@ def hist_exchange(
     mode: str = "hw",
     sb: int = 8,
     interpret: bool = False,
-    dot: str = "bf16",
+    dot: str = "i8",  # lane-exact (0/1 operands, i32 accumulate); 2x MXU on v5e
 ) -> jnp.ndarray:
     """Fused masked exchange + per-value histogram.
 
@@ -519,7 +519,7 @@ def _loop_kernel(
     sb: int,
     rounds: int,
     mode: str,
-    dot: str = "bf16",
+    dot: str = "i8",  # lane-exact (0/1 operands, i32 accumulate); 2x MXU on v5e
     variant: str = "v2",
 ):
     """The whole-run kernel template: `rounds` rounds of any LoopAlgo for
@@ -761,7 +761,7 @@ def hist_loop(
     mode: str = "hw",
     sb: int = 8,
     interpret: bool = False,
-    dot: str = "bf16",
+    dot: str = "i8",  # lane-exact (0/1 operands, i32 accumulate); 2x MXU on v5e
     variant: str = "v2",
 ):
     """Run a whole LoopAlgo workload in one Pallas kernel.
@@ -837,7 +837,7 @@ def otr_loop(
     mode: str = "hw",
     sb: int = 8,
     interpret: bool = False,
-    dot: str = "bf16",
+    dot: str = "i8",  # lane-exact (0/1 operands, i32 accumulate); 2x MXU on v5e
     variant: str = "v2",
 ):
     """Run the whole OTR flagship workload in one Pallas kernel (the OtrLoop

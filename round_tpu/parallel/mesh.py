@@ -395,7 +395,7 @@ def sharded_hist_loop(
     mode: str = "hw",
     sb: int = 8,
     interpret: bool = False,
-    dot: str = "bf16",
+    dot: str = "i8",  # lane-exact (0/1 operands, i32 accumulate); 2x MXU on v5e
     variant: str = "v2",
 ):
     """The flagship engine on the mesh: the whole-run loop kernel
@@ -500,6 +500,13 @@ def _dryrun_subprocess(n_devices: int) -> None:
         )
 
 
+def _assert_tree_parity(got, want, msg):
+    """THE dryrun parity assertion: every leaf bit-identical."""
+    for a, b in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(want)):
+        assert bool(jnp.array_equal(jnp.asarray(a), jnp.asarray(b))), msg
+
+
 def _dryrun_cpu(n_devices: int) -> None:
     """The actual dryrun body, pinned to CPU devices end to end."""
     import numpy as np
@@ -566,11 +573,8 @@ def _dryrun_cpu(n_devices: int) -> None:
             rounds=rounds2, mode="hash", interpret=True,
         )
         jax.block_until_ready(sharded)
-    got = jax.tree_util.tree_leaves(sharded)
-    want = jax.tree_util.tree_leaves(single)
-    for a, b in zip(got, want):
-        assert bool(jnp.array_equal(jnp.asarray(a), jnp.asarray(b))), \
-            "sharded loop kernel diverged from single-device"
+    _assert_tree_parity(sharded, single,
+                        "sharded loop kernel diverged from single-device")
     dec = jnp.asarray(sharded[0][1])  # decided slot of OtrLoop state
     assert int(dec.sum()) > 0, "loop-kernel dryrun decided nothing"
     print(
@@ -587,8 +591,9 @@ def _dryrun_cpu(n_devices: int) -> None:
             mode="hash", interpret=True, variant="flat",
         )
         jax.block_until_ready(flat)
-    for a, b in zip(jax.tree_util.tree_leaves(flat), got):
-        assert bool(jnp.array_equal(jnp.asarray(a), jnp.asarray(b))),             "flat loop-kernel variant diverged from v2 under sharding"
+    _assert_tree_parity(flat, sharded,
+                        "flat loop-kernel variant diverged from v2 under "
+                        "sharding")
     print(
         "dryrun_multichip loop-engine flat-variant ok: bit-parity with v2 "
         f"over {n_devices} devices"
@@ -646,10 +651,8 @@ def _dryrun_cpu(n_devices: int) -> None:
         ref4 = _fastmod.run_hist(rnd4, st4, lambda s: s.decided, mix4,
                                  max_rounds=r4, mode="hash", interpret=True)
         jax.block_until_ready(got4)
-    for a, b in zip(jax.tree_util.tree_leaves(got4),
-                    jax.tree_util.tree_leaves(ref4)):
-        assert bool(jnp.array_equal(jnp.asarray(a), jnp.asarray(b))), \
-            "proc-sharded fast path diverged from single-device"
+    _assert_tree_parity(got4, ref4,
+                        "proc-sharded fast path diverged from single-device")
     print(
         "dryrun_multichip proc-sharded fast path ok: receiver-sharded "
         f"count blocks over mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}, "
@@ -673,13 +676,46 @@ def _dryrun_cpu(n_devices: int) -> None:
         ref5 = _fastmod.run_tpc_fast(st5, mix4, max_rounds=3, mode="hash",
                                      interpret=True)
         jax.block_until_ready(got5)
-    for a, b in zip(jax.tree_util.tree_leaves(got5),
-                    jax.tree_util.tree_leaves(ref5)):
-        assert bool(jnp.array_equal(jnp.asarray(a), jnp.asarray(b))), \
-            "guarded-send sharded path diverged from single-device"
+    _assert_tree_parity(got5, ref5,
+                        "guarded-send sharded path diverged from "
+                        "single-device")
     assert bool(jnp.asarray(got5[0].decided).any()), \
         "guarded-send dryrun decided nothing"
     print(
         "dryrun_multichip guarded-send sharded path ok: TPC coordinator "
         "guard gathered with the payload, bit-parity vs single-device"
+    )
+
+    # the PBFT VIEW-CHANGE family (round 5): the 6-round batched fused
+    # engine scenario-sharded over the mesh, bit-parity vs single-device —
+    # per-lane views make the coordinator a per-receiver gather and the
+    # distributedState accumulators [S, n, n] planes, all of which must
+    # shard transparently along the scenario axis
+    from round_tpu.models.pbft import PbftVcState as _PbftVcState
+
+    with jax.default_device(devs[0]):
+        S6 = 2 * n_devices
+        x6 = (jnp.arange(n4, dtype=jnp.int32) * 7 + 3) % 100
+        mix6 = _fastmod.standard_mix(jax.random.PRNGKey(19), S6, n4,
+                                     p_drop=0.15, f=3, crash_round=0)
+        st6 = _PbftVcState.fresh(x6, S6, n4)
+        sp = P(SCENARIO_AXIS)
+
+        @partial(jax.shard_map, mesh=loop_mesh, in_specs=(sp, sp),
+                 out_specs=(sp, sp, sp), check_vma=False)
+        def run_vc(st, mx):
+            return _fastmod.run_pbft_vc_fast(st, mx, max_rounds=12)
+
+        got6 = jax.jit(run_vc)(st6, mix6)
+        ref6 = _fastmod.run_pbft_vc_fast(st6, mix6, max_rounds=12)
+        jax.block_until_ready(got6)
+    _assert_tree_parity(got6, ref6,
+                        "scenario-sharded view-change engine diverged from "
+                        "single-device")
+    assert bool(jnp.asarray(got6[0].decided).any()), \
+        "view-change dryrun decided nothing"
+    print(
+        "dryrun_multichip view-change family ok: 6-round byzantine engine "
+        f"scenario-sharded over {n_devices} devices, bit-parity vs "
+        "single-device"
     )

@@ -1890,6 +1890,25 @@ def erb_spec() -> ProtocolSpec:
         ),
     )
 
+    # -- flood-liveness walk (no upstream analogue): with someone defined
+    # and every defined sender in everyone's HO, ONE round defines
+    # everyone and the NEXT round delivers everywhere (delivery needs no
+    # further communication — x_def'ed lanes deliver unconditionally, so
+    # the second step carries no liveness hypothesis at all)
+    k = Variable("k", procType)
+    live = And(
+        Exists([i], sig.get("x_def", i)),
+        ForAll([i, k], Implies(sig.get("x_def", k), In(k, ho_of(i)))),
+    )
+    c1 = ForAll([i], sig.get("x_def", i))
+    c2 = ForAll([i], sig.get("delivered", i))
+    walk = [
+        ("progress: flood — everyone learns the value",
+         live, rnd.full_tr(), sig.prime(c1)),
+        ("progress: deliver — everyone delivers",
+         c1, rnd.full_tr(), sig.prime(c2)),
+    ]
+
     return ProtocolSpec(
         sig=sig,
         rounds=[rnd],
@@ -1900,6 +1919,7 @@ def erb_spec() -> ProtocolSpec:
             ("validity (deliveries carry the originator's value)", validity),
         ],
         config=ClConfig(venn_bound=1, inst_depth=2),
+        phase_progress=walk,
     )
 
 

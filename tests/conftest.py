@@ -56,3 +56,20 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip)
+
+
+def drop_ho_conjuncts(hyp):
+    """Remove every hypothesis conjunct mentioning the HO symbol — the
+    shared no-liveness-control transform of the phase-walk tests (the
+    good-phase environment is the only HO talk in a walk hypothesis)."""
+    from round_tpu.verify.formula import And, Application, TRUE
+    from round_tpu.verify.futils import collect, get_conjuncts
+    from round_tpu.verify.tr import HO_FN
+
+    def has_ho(f):
+        return bool(collect(
+            lambda g: isinstance(g, Application) and g.fct == HO_FN, f))
+
+    parts = [p for p in get_conjuncts(hyp) if not has_ho(p)]
+    assert len(parts) < len(get_conjuncts(hyp)), "no HO conjunct to drop"
+    return And(*parts) if parts else TRUE

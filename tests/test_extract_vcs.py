@@ -134,6 +134,7 @@ def test_kset_extracted_lemmas():
     )
 
 
+@pytest.mark.slow  # ~24 s even without vote-exclusivity; verifier_cli benor is the canonical runner
 def test_benor_extracted_lemmas(slow_tier):
     """BenOr's vote round proved from the extracted TR
     (protocols.benor_extracted_lemmas): can-propagation and decide-pins in

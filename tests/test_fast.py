@@ -528,6 +528,7 @@ def test_floodmin_benor_loop_i8_dot_parity():
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+@pytest.mark.slow  # ~16 s
 def test_lv_loop_parity_vs_general_engine():
     """The LastVoting whole-run kernel (ops.fused.lv_loop — O(n) per round,
     coordinator-centric mask rows/columns) is lane-exact vs
@@ -858,6 +859,7 @@ def test_theta_fast_parity():
         assert int(np.asarray(state.round).max()) >= 1
 
 
+@pytest.mark.slow  # ~16 s
 def test_pbft_fast_parity():
     """PBFT-style byzantine consensus on the fused path
     (fast.run_pbft_fast) is lane-exact against the general engine on
@@ -907,6 +909,7 @@ def test_pbft_fast_parity():
     assert saw_commit and saw_null
 
 
+@pytest.mark.slow  # ~17 s
 def test_mutex_fast_parity_and_stabilization():
     """Dijkstra's token ring on the fused path (fast.run_mutex_fast) is
     lane-exact against the general engine's EventRound adapter across
@@ -950,6 +953,7 @@ def test_mutex_fast_parity_and_stabilization():
     assert int(np.asarray(st2.has_token).sum()) == 1
 
 
+@pytest.mark.slow  # ~10 s
 def test_gol_fast_parity_and_glider():
     """Game of Life on the fused path (fast.run_gol_fast): the torus
     overlay as a point-to-multipoint dest mask.  Lane-exact vs the
@@ -993,6 +997,7 @@ def test_gol_fast_parity_and_glider():
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow  # ~50 s; the model-level tests + the soak pbft-vc slot keep default coverage
 def test_pbft_view_change_fast_parity():
     """PBFT with primary rotation on the fused path
     (fast.run_pbft_vc_fast) is lane-exact against the general engine over

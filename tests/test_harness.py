@@ -264,6 +264,7 @@ def test_ladder_first_rung_smoke():
     assert x["loop_parity_frac"] == 1.0
 
 
+@pytest.mark.slow  # ~12 s; the first-rung smoke keeps ladder coverage in the default tier
 def test_ladder_floodmin_rung_smoke():
     """Second rung (FloodMin on the FUSED path, crash draws) end-to-end on
     CPU: loop kernel timed, lane-exact differential parity vs the general

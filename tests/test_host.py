@@ -450,7 +450,11 @@ def test_host_otr_four_replicas_threads():
     assert decisions == {3}
 
 
-@pytest.mark.parametrize("crashed", [None, 3])
+@pytest.mark.parametrize(
+    "crashed",
+    [None, pytest.param(3, marks=pytest.mark.slow)],  # crashed-replica
+    # variant ~10 s; the healthy-cluster variant keeps default coverage
+)
 def test_host_otr_subprocesses(crashed):
     """The testOTR.sh shape: 4 separate OS processes via the host_replica
     CLI; with `crashed`, that replica never starts (oneDownOTR.sh) and the

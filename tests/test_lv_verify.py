@@ -219,9 +219,8 @@ def test_lv_phase_walk_proves_and_requires_liveness():
     refute the collect and decide steps once the good-phase environment
     is dropped (no majority mailbox → the coordinator cannot commit; a
     receiver that misses the coordinator's broadcast stays undecided)."""
-    from round_tpu.verify.futils import collect, get_conjuncts
+    from conftest import drop_ho_conjuncts
     from round_tpu.verify.protocols import lv_verifier_spec
-    from round_tpu.verify.tr import HO_FN
     from round_tpu.verify.vc import SingleVC
 
     spec = lv_verifier_spec()
@@ -234,24 +233,12 @@ def test_lv_phase_walk_proves_and_requires_liveness():
         assert SingleVC(name, hyp, tr, concl,
                         timeout_s=420.0).solve(spec.config), name
 
-    def drop_live(hyp):
-        """Remove the good-phase conjuncts — exactly those mentioning the
-        HO symbol (the environment is the only HO talk in a walk hyp)."""
-        def has_ho(f):
-            return bool(collect(
-                lambda g: isinstance(g, Application) and g.fct == HO_FN, f))
-        parts = [p for p in get_conjuncts(hyp) if not has_ho(p)]
-        assert len(parts) < len(get_conjuncts(hyp))
-        return And(*parts) if parts else TRUE
-
-    from round_tpu.verify.formula import TRUE
-
     # collect without the environment: commit must not be provable
     name, hyp, tr, concl = walk[0]
-    assert not SingleVC(name + " [no-live control]", drop_live(hyp), tr,
-                        concl, timeout_s=60.0).solve(spec.config)
+    assert not SingleVC(name + " [no-live control]", drop_ho_conjuncts(hyp),
+                        tr, concl, timeout_s=60.0).solve(spec.config)
     # decide without the environment: universal decision must not be
     # provable
     name, hyp, tr, concl = walk[3]
-    assert not SingleVC(name + " [no-live control]", drop_live(hyp), tr,
-                        concl, timeout_s=60.0).solve(spec.config)
+    assert not SingleVC(name + " [no-live control]", drop_ho_conjuncts(hyp),
+                        tr, concl, timeout_s=60.0).solve(spec.config)

@@ -47,6 +47,7 @@ def test_sharded_matches_single_chip(proc_shards):
     )
 
 
+@pytest.mark.slow  # ~29 s; the round driver executes dryrun_multichip itself every round
 def test_dryrun_entrypoint():
     dryrun(8)
 
@@ -84,6 +85,7 @@ def test_sharded_loop_kernel_matches_single_device():
     assert int(np.asarray(sharded[0][1]).sum()) > 0  # something decided
 
 
+@pytest.mark.slow  # ~20 s; the dryrun eps segment keeps default coverage
 def test_epsilon_rung_sharded_bit_parity():
     """BASELINE rung 5 (byzantine ε-agreement, multi-chip shard): on a
     multi-device mesh the rung times the scenario-sharded run and pins

@@ -15,6 +15,7 @@ drop and |π| = n is the universe constraint the venn regions already
 carry.  The redundant bounds of the Scala axiom block (card ≥ 0, ≤ n on
 every set) are venn built-ins too."""
 
+import pytest
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -67,6 +68,7 @@ AXIOMS = And(
 CFG = ClConfig(venn_bound=2, inst_depth=1)
 
 
+@pytest.mark.slow  # ~10 s
 def test_multipraxos_mbox_axioms():
     """The reference's "test" (:101-110): a nonempty mailbox without the
     leader contradicts full-HO broadcast."""

@@ -104,12 +104,10 @@ def test_tpc_phase_walk_and_liveness_control():
     and the no-liveness control refutes the collect step — without all
     votes heard, a unanimous-yes run still aborts, so the outcome↔
     unanimity biconditional must NOT prove."""
-    from round_tpu.verify.futils import collect, get_conjuncts
+    from conftest import drop_ho_conjuncts
     from round_tpu.verify.cl import ClDefault
     from round_tpu.verify.protocols import tpc_spec
-    from round_tpu.verify.tr import HO_FN
     from round_tpu.verify.vc import SingleVC
-    from round_tpu.verify.formula import And, Application, TRUE
 
     spec = tpc_spec()
     cfg = spec.config or ClDefault
@@ -119,13 +117,6 @@ def test_tpc_phase_walk_and_liveness_control():
         assert SingleVC(name, hyp, tr, concl,
                         timeout_s=240.0).solve(cfg), name
 
-    def has_ho(f):
-        return bool(collect(
-            lambda g: isinstance(g, Application) and g.fct == HO_FN, f))
-
     name, hyp, tr, concl = walk[0]
-    parts = [p for p in get_conjuncts(hyp) if not has_ho(p)]
-    assert len(parts) < len(get_conjuncts(hyp))
-    assert not SingleVC(name + " [no-live control]",
-                        And(*parts) if parts else TRUE, tr, concl,
-                        timeout_s=45.0).solve(cfg)
+    assert not SingleVC(name + " [no-live control]", drop_ho_conjuncts(hyp),
+                        tr, concl, timeout_s=45.0).solve(cfg)

@@ -171,6 +171,7 @@ def test_otr_spec_generates_vcs():
     assert "staged" in rep
 
 
+@pytest.mark.slow  # ~14 s; `verifier_cli otr` is the canonical end-to-end runner
 def test_otr_verifies_end_to_end():
     """The FULL OTR check — init, staged inductiveness (the one-third-rule
     preservation chain), the magic-round liveness ladder
@@ -448,3 +449,27 @@ def test_staged_key_mismatch_rejected():
     spec = _dc.replace(spec, staged={"invariant 7 inductive at round 9": chain})
     with pytest.raises(ValueError, match="matched no generated VC"):
         Verifier(spec).generate_vcs()
+
+
+def test_erb_flood_walk_and_liveness_control():
+    """ERB's flood-liveness walk: one good round defines everyone, the
+    next delivers everywhere (its second step carries NO liveness
+    hypothesis — delivery is local).  Control: without the good-round
+    environment the flood step must NOT prove (an unheard originator
+    defines nobody)."""
+    from conftest import drop_ho_conjuncts
+    from round_tpu.verify.cl import ClDefault
+    from round_tpu.verify.protocols import erb_spec
+    from round_tpu.verify.vc import SingleVC
+
+    spec = erb_spec()
+    cfg = spec.config or ClDefault
+    walk = spec.phase_progress
+    assert len(walk) == 2
+    for name, hyp, tr, concl in walk:
+        assert SingleVC(name, hyp, tr, concl,
+                        timeout_s=240.0).solve(cfg), name
+
+    name, hyp, tr, concl = walk[0]
+    assert not SingleVC(name + " [no-live control]", drop_ho_conjuncts(hyp),
+                        tr, concl, timeout_s=45.0).solve(cfg)

@@ -208,7 +208,7 @@ def check_tpc_kset(rng, it):
     key = jax.random.PRNGKey(int(rng.integers(0, 2**31)))
     pick = int(rng.integers(0, 6))
     if pick == 5:
-        from round_tpu.models.pbft import PbftVcState, PbftViewChange, digest
+        from round_tpu.models.pbft import PbftVcState, PbftViewChange
 
         p_drop = float(rng.choice([0.1, 0.25]))
         S = 4  # two 6-round phases per scenario — keep the slot bounded
