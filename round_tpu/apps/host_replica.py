@@ -56,6 +56,10 @@ def main(argv=None) -> int:
                     help="sleep MS before the first round's send "
                          "(RuntimeOptions.delayFirstSend; start-skew "
                          "injection)")
+    ap.add_argument("--byzantine", dest="nbr_byzantine", type=int, default=0,
+                    help="f for the byzantine catch-up rule: the round "
+                         "catch-up target needs f+1 attestations "
+                         "(RuntimeOptions.nbrByzantine)")
     from round_tpu.runtime.log import add_verbosity_flags, configure_from_args
 
     add_verbosity_flags(ap)
@@ -81,6 +85,7 @@ def main(argv=None) -> int:
                 timeout_ms=args.timeout_ms, seed=args.seed,
                 send_when_catching_up=args.send_when_catching_up,
                 delay_first_send_ms=args.delay_first_send_ms,
+                nbr_byzantine=args.nbr_byzantine,
             )
             res = runner.run(
                 {"initial_value": np.int32(args.value)},
@@ -117,6 +122,7 @@ def main(argv=None) -> int:
             base_value=args.value, max_rounds=args.max_rounds,
             send_when_catching_up=args.send_when_catching_up,
             delay_first_send_ms=args.delay_first_send_ms,
+            nbr_byzantine=args.nbr_byzantine,
         )
         wall = time.perf_counter() - t0
         ok = sum(1 for d in decisions if d is not None)

@@ -103,6 +103,7 @@ def run_instance_loop(
     stats_out: Optional[Dict[str, int]] = None,
     send_when_catching_up: bool = True,
     delay_first_send_ms: int = -1,
+    nbr_byzantine: int = 0,
 ) -> List[Optional[int]]:
     """The PerfTest2 loop (PerfTest2.scala:19-110): `instances` consecutive
     consensus instances over one transport, with start-skew stashing —
@@ -152,6 +153,7 @@ def run_instance_loop(
             # is delayed (the reference sleeps at instance start, and the
             # point is skewING the replica, not slowing every instance)
             delay_first_send_ms=delay_first_send_ms if inst == 1 else -1,
+            nbr_byzantine=nbr_byzantine,
         )
         value = (base_value + my_id * 7 + inst) % 5
         res = runner.run({"initial_value": np.int32(value)},
@@ -193,6 +195,7 @@ class HostRunner:
         wait_cap_ms: int = 30_000,
         send_when_catching_up: bool = True,
         delay_first_send_ms: int = -1,
+        nbr_byzantine: int = 0,
     ):
         self.algo = algo
         self.id = my_id
@@ -211,6 +214,13 @@ class HostRunner:
         # reference's tests to force start skew)
         self.delay_first_send_ms = delay_first_send_ms
         self.suppressed_sends = 0   # rounds whose send was skipped
+        # f for the byzantine catch-up rule (InstanceHandler.scala:302-307):
+        # with f > 0 the catch-up target is the (f+1)-th highest observed
+        # round, so up to f lying peers cannot drag this replica forward
+        if not 0 <= nbr_byzantine < self.n:
+            raise ValueError(
+                f"nbr_byzantine={nbr_byzantine} must be in [0, n={self.n})")
+        self.nbr_byzantine = nbr_byzantine
         self.seed = seed
         self.default_handler = default_handler
         # sink for NORMAL messages of other instances: a consecutive-
@@ -464,8 +474,16 @@ class HostRunner:
                     deadline = _time.monotonic() + self.wait_cap_ms / 1000.0
                 if tag.round > r:
                     self._pending.setdefault(tag.round, {})[sender] = payload
-                    # benign catch-up: the furthest peer sets the target
-                    next_round = max(next_round, int(max_rnd.max()))
+                    if self.nbr_byzantine <= 0:
+                        # benign catch-up: the furthest peer sets the target
+                        next_round = max(next_round, int(max_rnd.max()))
+                    else:
+                        # byzantine catch-up (InstanceHandler.scala:302-307):
+                        # drop the f highest claims — a target needs f+1
+                        # attestations, so lying peers cannot drag us ahead
+                        srt = np.sort(max_rnd)
+                        next_round = max(
+                            next_round, int(srt[-(self.nbr_byzantine + 1)]))
                     return False
                 if buffer_only:
                     return False  # post-quorum same-round: same fate as
@@ -478,8 +496,12 @@ class HostRunner:
                 if dirty and go_ahead():
                     break
                 dirty = False
-                if prog.is_sync and int((max_rnd >= r).sum()) >= prog.k:
-                    break  # sync(k) barrier reached
+                if prog.is_sync and int((max_rnd >= r).sum()) \
+                        >= prog.k + self.nbr_byzantine:
+                    # sync(k) barrier: f of the attestations may be lies,
+                    # so the barrier needs k + f (computeSync,
+                    # InstanceHandler.scala:279-287)
+                    break
                 if next_round > r + 1 and not block:
                     # genuine round skew: a peer is MORE than one round
                     # ahead, so this round's window is over — fast-forward
