@@ -31,12 +31,14 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 from round_tpu.apps.selector import select  # noqa: E402
-from round_tpu.runtime.host import run_instance_loop  # noqa: E402
+from round_tpu.runtime.host import (  # noqa: E402
+    run_instance_loop, run_instance_loop_pipelined,
+)
 from round_tpu.runtime.transport import HostTransport  # noqa: E402
 
 
 def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
-             errors=None, proto="tcp", stats=None, algo=None):
+             errors=None, proto="tcp", stats=None, algo=None, rate=1):
     tr = HostTransport(my_id, peers[my_id][1], proto=proto)
     # ONE algorithm object across instances: the jitted round functions
     # cache on its rounds, so instance 2+ skip compilation entirely.
@@ -47,10 +49,18 @@ def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
     algo = select(algo_name) if algo is None else algo
     try:
         node_stats: dict = {}
-        results[my_id] = run_instance_loop(
-            algo, my_id, peers, tr, instances, timeout_ms=timeout_ms,
-            seed=seed, stats_out=node_stats,
-        )
+        if rate > 1:
+            # the in-flight window (PerfTest2 -rt): `rate` concurrent
+            # instances over one InstanceMux
+            results[my_id] = run_instance_loop_pipelined(
+                algo, my_id, peers, tr, instances, rate=rate,
+                timeout_ms=timeout_ms, seed=seed, stats_out=node_stats,
+            )
+        else:
+            results[my_id] = run_instance_loop(
+                algo, my_id, peers, tr, instances, timeout_ms=timeout_ms,
+                seed=seed, stats_out=node_stats,
+            )
         if stats is not None:
             stats[my_id] = node_stats
     except Exception as e:  # noqa: BLE001 - surfaced by measure()
@@ -113,7 +123,7 @@ def _score(logs, instances, wall, n, algo, timeout_ms, mode,
 
 
 def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
-            proto="tcp"):
+            proto="tcp", rate=1):
     """Run `instances` consecutive consensus instances over `n` replicas
     (threads, each with its own transport+sockets — on a single-vCPU box
     the GIL interleaving beats process-per-replica; see measure_processes
@@ -129,7 +139,7 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
         threading.Thread(
             target=run_node,
             args=(i, peers, algo, instances, timeout_ms, results, seed,
-                  errors, proto, stats, shared_algo),
+                  errors, proto, stats, shared_algo, rate),
         )
         for i in range(n)
     ]
@@ -152,8 +162,10 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
             f"replica(s) died: {sorted(set(range(n)) - set(results))}; "
             f"errors: {errors}"
         )
+    mode = ("thread-per-replica"
+            if rate <= 1 else f"thread-per-replica rate={rate}")
     score = _score(results, instances, wall, n, algo, timeout_ms,
-                   "thread-per-replica", proto=proto)
+                   mode, proto=proto)
     # per-node diagnostics: timeouts is the throughput killer (each one
     # burned a full round deadline)
     score["extra"]["node_stats"] = {i: stats.get(i, {}) for i in sorted(stats)}
@@ -252,12 +264,24 @@ def main(argv=None) -> int:
     ap.add_argument("--proto", choices=["tcp", "udp"], default="tcp",
                     help="native transport: tcp (framed/reconnecting) or "
                          "udp (the reference's default perf transport)")
+    ap.add_argument("-rt", "--rate", type=int, default=1,
+                    help="instances in flight per replica (PerfTest2 -rt; "
+                         "thread mode only): >1 pipelines burned round "
+                         "deadlines on lossy networks")
     args = ap.parse_args(argv)
-    fn = measure_processes if args.processes else measure
-    result, _logs = fn(
-        n=args.n, instances=args.instances, algo=args.algo,
-        timeout_ms=args.timeout_ms, proto=args.proto,
-    )
+    if args.processes:
+        if args.rate > 1:
+            print("warning: --rate applies to thread mode only",
+                  file=sys.stderr)
+        result, _logs = measure_processes(
+            n=args.n, instances=args.instances, algo=args.algo,
+            timeout_ms=args.timeout_ms, proto=args.proto,
+        )
+    else:
+        result, _logs = measure(
+            n=args.n, instances=args.instances, algo=args.algo,
+            timeout_ms=args.timeout_ms, proto=args.proto, rate=args.rate,
+        )
     print(json.dumps(result))
     return 0
 

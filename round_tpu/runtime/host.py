@@ -47,8 +47,10 @@ reference — replicas deserialize only from their own group).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import pickle
+import queue as _queue
 import threading
 import time as _time
 from typing import Any, Dict, List, Optional, Tuple
@@ -90,6 +92,220 @@ class HostResult:
     timeouts: int = 0
 
 
+def _try_send_decision(transport, replied: Dict[Tuple[int, int], float],
+                       sender: int, instance: int, decision) -> None:
+    """THE TooLate / trySendDecision reply (PerfTest.scala:40-60), shared
+    by the sequential loop's foreign sink and the pipelined mux: answer a
+    completed instance's late traffic with its decision, rate-limited per
+    (sender, instance) — the reply itself can drop on UDP, so the
+    laggard's next retransmission re-arms it."""
+    if decision is None:
+        return
+    now = _time.monotonic()
+    if now - replied.get((sender, instance), -1.0) <= 0.25:
+        return
+    replied[(sender, instance)] = now
+    transport.send(sender, Tag(instance=instance, flag=FLAG_DECISION),
+                   pickle.dumps(np.asarray(decision)))
+
+
+class MuxEndpoint:
+    """One instance's view of the shared transport: sends pass through,
+    receives come from the instance's routed queue."""
+
+    def __init__(self, mux: "InstanceMux", instance_id: int):
+        self._mux = mux
+        self._q = mux._queues[instance_id & 0xFFFF]
+
+    def add_peer(self, pid, host, port):
+        self._mux.transport.add_peer(pid, host, port)
+
+    def send(self, dest, tag, payload):
+        return self._mux.transport.send(dest, tag, payload)
+
+    def recv(self, timeout_ms: int):
+        try:
+            if timeout_ms <= 0:
+                return self._q.get_nowait()
+            return self._q.get(timeout=timeout_ms / 1000.0)
+        except _queue.Empty:
+            return None
+
+    @property
+    def dropped(self):
+        return self._mux.transport.dropped
+
+
+class InstanceMux:
+    """Tag-routed demultiplexer over ONE HostTransport — the host-side
+    InstanceDispatcher (InstanceDispatcher.scala:9-90): a single recv-loop
+    thread routes packets to per-instance endpoints, so `rate` instances
+    run CONCURRENTLY over one socket mesh (the reference's in-flight
+    PerfTest2 rate / processPool shape; the sequential loop runs them one
+    at a time).
+
+    Routing rules (the dispatcher + defaultHandler split):
+      * a registered instance's traffic → its queue (HostRunner consumes
+        through a MuxEndpoint facade);
+      * NORMAL traffic for a COMPLETED instance → rate-limited
+        FLAG_DECISION reply with that instance's decision (the TooLate /
+        trySendDecision path, PerfTest.scala:40-60);
+      * NORMAL traffic for a FUTURE instance → stashed raw and replayed
+        into its queue at register time (the lazy-join role);
+      * anything else is dropped (the reference's unknown-instance drop).
+    """
+
+    _STASH_CAP = 4096  # total stashed packets: when full the OLDEST entry
+    # is evicted FIFO, so garbage tagged with never-registering instance
+    # ids ages out instead of permanently exhausting the stash (the
+    # unauthenticated-socket hardening discipline of this module)
+
+    def __init__(self, transport: HostTransport):
+        self.transport = transport
+        self._lock = threading.Lock()
+        self._queues: Dict[int, Any] = {}
+        self._stash: Dict[int, List[Tuple[int, Tag, bytes]]] = {}
+        self._stash_order: collections.deque = collections.deque()
+        self._decisions: Dict[int, Optional[np.ndarray]] = {}
+        self._replied: Dict[Tuple[int, int], float] = {}
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def register(self, instance_id: int) -> MuxEndpoint:
+        iid = instance_id & 0xFFFF
+        with self._lock:
+            q = _queue.Queue()
+            self._queues[iid] = q
+            for got in self._stash.pop(iid, []):
+                q.put(got)
+        return MuxEndpoint(self, iid)
+
+    def complete(self, instance_id: int,
+                 decision: Optional[np.ndarray]) -> None:
+        iid = instance_id & 0xFFFF
+        with self._lock:
+            self._queues.pop(iid, None)
+            self._decisions[iid] = decision
+
+    def close(self) -> None:
+        self._stop = True
+        self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop:
+            got = self.transport.recv(50)
+            if got is None:
+                continue
+            sender, tag, raw = got
+            iid = tag.instance
+            reply_with = None
+            with self._lock:
+                # routing decision and stash append under ONE acquisition:
+                # a lookup in one critical section + append in another
+                # would race register() replaying the stash in between,
+                # silently losing the packet
+                q = self._queues.get(iid)
+                if q is not None:
+                    q.put(got)
+                elif iid in self._decisions:
+                    if tag.flag == FLAG_NORMAL:
+                        reply_with = self._decisions[iid]
+                elif tag.flag == FLAG_NORMAL:
+                    while len(self._stash_order) >= self._STASH_CAP:
+                        old = self._stash_order.popleft()
+                        bucket = self._stash.get(old)
+                        if bucket:
+                            bucket.pop(0)
+                            if not bucket:
+                                del self._stash[old]
+                    self._stash.setdefault(iid, []).append(got)
+                    self._stash_order.append(iid)
+            if reply_with is not None:
+                _try_send_decision(self.transport, self._replied,
+                                   sender, iid, reply_with)
+
+
+def run_instance_loop_pipelined(
+    algo: Algorithm,
+    my_id: int,
+    peers: Dict[int, Tuple[str, int]],
+    transport: HostTransport,
+    instances: int,
+    rate: int = 8,
+    timeout_ms: int = 300,
+    seed: int = 0,
+    base_value: int = 0,
+    max_rounds: int = 32,
+    stats_out: Optional[Dict[str, int]] = None,
+    nbr_byzantine: int = 0,
+) -> List[Optional[int]]:
+    """The PerfTest2 loop with `rate` instances IN FLIGHT (the reference's
+    `-rt` rate + InstanceDispatcher shape): a sliding window of concurrent
+    HostRunners over one InstanceMux.  An instance burning a round
+    timeout no longer stalls the pipeline — the win is largest on lossy
+    transports, where the sequential loop serializes every burned
+    deadline.  Same value schedule and seeds as run_instance_loop, so the
+    two modes are cross-checkable."""
+    mux = InstanceMux(transport)
+    decisions: List[Optional[int]] = [None] * instances
+    errors: List[Tuple[int, BaseException]] = []
+    stats_lock = threading.Lock()
+    sem = threading.Semaphore(rate)
+    threads: List[threading.Thread] = []
+
+    def worker(inst: int, ep: MuxEndpoint) -> None:
+        try:
+            runner = HostRunner(
+                algo, my_id, peers, ep, instance_id=inst,
+                timeout_ms=timeout_ms, seed=seed + inst,
+                nbr_byzantine=nbr_byzantine,
+            )
+            value = (base_value + my_id * 7 + inst) % 5
+            res = runner.run({"initial_value": np.int32(value)},
+                             max_rounds=max_rounds)
+            d = int(np.asarray(res.decision)) if res.decided else None
+            decisions[inst - 1] = d
+            mux.complete(
+                inst, np.asarray(res.decision) if res.decided else None)
+            if stats_out is not None:
+                with stats_lock:
+                    for k, v in (("timeouts", res.timeouts),
+                                 ("rounds_run", res.rounds_run),
+                                 ("malformed", res.malformed_messages)):
+                        stats_out[k] = stats_out.get(k, 0) + v
+        except BaseException as e:  # noqa: BLE001 — a worker-thread error
+            # must FAIL the run like the sequential path's would, not
+            # silently become a None decision; complete() so peer
+            # retransmissions stop queueing against a dead instance
+            with stats_lock:
+                errors.append((inst, e))
+            mux.complete(inst, None)
+        finally:
+            sem.release()
+
+    try:
+        for inst in range(1, instances + 1):
+            sem.acquire()
+            # register BEFORE the runner exists: a fast peer's first
+            # message may arrive the instant our previous one completes
+            ep = mux.register(inst)
+            t = threading.Thread(target=worker, args=(inst, ep))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+    finally:
+        mux.close()
+    if errors:
+        inst, err = errors[0]
+        raise RuntimeError(
+            f"{len(errors)} pipelined instance(s) failed, first: "
+            f"instance {inst}"
+        ) from err
+    return decisions
+
+
 def run_instance_loop(
     algo: Algorithm,
     my_id: int,
@@ -129,15 +345,9 @@ def run_instance_loop(
             # RATE-LIMITED, not one-shot: the reply itself can drop on UDP,
             # so the laggard's next retransmission re-arms it
             idx = tag.instance - 1
-            now = _time.monotonic()
-            last = replied.get((sender, tag.instance), -1.0)
-            if (0 <= idx < len(decisions) and decisions[idx] is not None
-                    and now - last > 0.25):
-                replied[(sender, tag.instance)] = now
-                transport.send(
-                    sender, Tag(instance=tag.instance, flag=FLAG_DECISION),
-                    pickle.dumps(np.asarray(decisions[idx])),
-                )
+            if 0 <= idx < len(decisions):
+                _try_send_decision(transport, replied, sender,
+                                   tag.instance, decisions[idx])
             return
         stash.setdefault(tag.instance, {}).setdefault(
             tag.round, {})[sender] = payload

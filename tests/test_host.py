@@ -1228,3 +1228,79 @@ def test_host_byzantine_catch_up_rule():
     assert deep > 35, f"the benign rule should have chased the lie ({deep})"
     assert shallow < 10, \
         f"the byzantine rule should have ignored the lie ({shallow})"
+
+
+def test_host_pipelined_instances_under_loss():
+    """The in-flight instance window (run_instance_loop_pipelined — the
+    reference's InstanceDispatcher + PerfTest2 rate): under injected
+    message loss, burned round deadlines dominate; the sequential loop
+    serializes every one, the rate-8 window overlaps them.  Decisions
+    must agree with full coverage in BOTH modes, and the pipelined wall
+    must be well under the sequential wall."""
+    import time as _time
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from round_tpu.apps.selector import select
+    from round_tpu.runtime.host import (
+        run_instance_loop, run_instance_loop_pipelined,
+    )
+
+    algo = select("otr")
+
+    def lossy(tr, my_id):
+        real_send = tr.send
+
+        def send(dest, tag, payload):
+            if tag.flag == FLAG_NORMAL:
+                # deterministic ~19% loss, round/instance/dest-dependent
+                h = (tag.instance * 7919 + tag.round * 104729
+                     + dest * 31 + my_id * 17) % 16
+                if h < 3:
+                    return True  # silently dropped
+            return real_send(dest, tag, payload)
+
+        tr.send = send
+        return tr
+
+    def cluster(rate):
+        n, instances = 4, 12
+        ports = _free_ports(n)
+        peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
+        results = {}
+
+        def node(my_id):
+            tr = lossy(HostTransport(my_id, peers[my_id][1], proto="udp"),
+                       my_id)
+            try:
+                if rate > 1:
+                    results[my_id] = run_instance_loop_pipelined(
+                        algo, my_id, peers, tr, instances, rate=rate,
+                        timeout_ms=400, max_rounds=24)
+                else:
+                    results[my_id] = run_instance_loop(
+                        algo, my_id, peers, tr, instances,
+                        timeout_ms=400, max_rounds=24)
+            finally:
+                tr.close()
+
+        t0 = _time.perf_counter()
+        threads = [threading.Thread(target=node, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+        wall = _time.perf_counter() - t0
+        assert len(results) == n
+        for inst in range(12):
+            vals = {results[i][inst] for i in range(n)}
+            assert len(vals) == 1 and None not in vals, (inst, vals)
+        return wall
+
+    sequential = cluster(rate=1)
+    pipelined = cluster(rate=8)
+    # with ~19% loss every instance burns deadlines; the window overlaps
+    # them (observed ~4x; 1.5x is a safe floor even on a loaded 1-cpu box)
+    assert pipelined * 1.5 < sequential, (pipelined, sequential)
