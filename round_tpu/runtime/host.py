@@ -179,6 +179,11 @@ class InstanceMux:
             self._queues[iid] = q
             for got in self._stash.pop(iid, []):
                 q.put(got)
+            # purge the replayed instance from the eviction order too, or
+            # its stale entries would inflate the cap check and evict LIVE
+            # buckets long before the stash is actually full
+            self._stash_order = collections.deque(
+                x for x in self._stash_order if x != iid)
         return MuxEndpoint(self, iid)
 
     def complete(self, instance_id: int,
@@ -247,6 +252,8 @@ def run_instance_loop_pipelined(
     transports, where the sequential loop serializes every burned
     deadline.  Same value schedule and seeds as run_instance_loop, so the
     two modes are cross-checkable."""
+    if rate < 1:
+        raise ValueError(f"rate must be >= 1, got {rate}")
     mux = InstanceMux(transport)
     decisions: List[Optional[int]] = [None] * instances
     errors: List[Tuple[int, BaseException]] = []

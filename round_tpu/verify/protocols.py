@@ -1154,13 +1154,15 @@ def _lv_matrix_and_pieces():
                  True, False),
                 (f"{stage_tag}: ready' => ts=phase majority", H(), conjs[4],
                  cfg, True, True),
-                # the anchored case proves the STRONGER 2-option disjunction
-                # (nd' ∨ anchor-at-(va,ta)'); the stage conclusion's third
-                # re-anchor option follows by ∨-weakening — including it in
-                # the goal only adds venn sets the case never needs
-                (f"{stage_tag}: anchor-disj, anchored case (2-option)",
-                 H(anchor_case), Or(conjs[0].args[0], conjs[0].args[1]),
-                 cfg, True, True),
+                # the anchored case re-establishes the anchor DIRECTLY:
+                # prove the single anchored-at-(va,ta)' disjunct — the
+                # full disjunction follows by ∨-weakening at the final
+                # composition.  A 2-option ∨ goal here made the reducer
+                # refute both branches against the case's venn sets
+                # (398 s measured); the single disjunct proves in ~12 s
+                (f"{stage_tag}: anchor-disj, anchored case (re-anchor)",
+                 H(anchor_case), conjs[0].args[1],
+                 cfg, True, False),  # ~12 s: back in the default tier
             ]
 
     coord, maxx, x0 = lv["coord"], lv["maxx"], lv["x0"]
@@ -1321,8 +1323,10 @@ def lv_staged_chains():
         the excluded-middle split on act.  The final VC checks the ∨-elim.
 
       ack-r3:  the direct conjuncts are unscoped stages; the anchored case
-        proves the 2-option disjunction (∨-weakening to the 3-option goal
-        is the final VC's); the noDecision case derives the re-anchor at
+        re-establishes the anchored-at-(va,ta) disjunct DIRECTLY
+        (∨-weakening to the 3-option goal is the final VC's — a 2-option
+        ∨ goal made the reducer refute both branches, 398 s vs ~12 s);
+        the noDecision case derives the re-anchor at
         (vote(coord), phase) from a fresh ready′ witness (∀-closed over
         it), and a scoped assembly refutes ¬goal by case analysis on the
         skolemized ¬noDecision′ witness.
@@ -1520,8 +1524,8 @@ def lv_staged_chains():
         "ack-r3/noDecision: ready' implies ack majority")
     _h, anch_concl, anch_cfg = row(
         "ack-r3/noDecision: ack majority anchors at phase")
-    _h, twoopt_concl, twoopt_cfg = row(
-        "ack-r3: anchor-disj, anchored case (2-option)")
+    _h, reanchor_concl, reanchor_cfg = row(
+        "ack-r3: anchor-disj, anchored case (re-anchor)")
     ready_iw = sig.get_primed("ready", iw)
     ready_iw2 = sig.get_primed("ready", iw2)
     closed_ready_maj = ForAll([iw], Implies(ready_iw, maj_concl))
@@ -1533,7 +1537,7 @@ def lv_staged_chains():
         ("vote_init'", base3, conjs3[2], cfg),
         ("commit/ts obligations", base3, conjs3[3], cfg),
         ("ready' majority", base3, conjs3[4], cfg),
-        ("anchored case (2-option)", base3, twoopt_concl, twoopt_cfg),
+        ("anchored case (re-anchor)", base3, reanchor_concl, reanchor_cfg),
         ("frame", tr3, frame3, c01),
         ("no-ready preserves nd", frame3, conjs3[0].args[0], cfg),
         ("ready' => ack majority", tr3, maj_concl, maj_cfg),
@@ -1550,7 +1554,7 @@ def lv_staged_chains():
          conjs3[0], c02),
     ]
     assumes3 = {
-        "anchored case (2-option)": anchor3,
+        "anchored case (re-anchor)": anchor3,
         "no-ready preserves nd": nd_noready,
         "ready' => ack majority": ready_iw,
         "ack majority anchors": ready_iw2,
@@ -1571,7 +1575,7 @@ def lv_staged_chains():
         manual_just=manual3,
         final_keep=[
             Or(nd3, anchor3),
-            Implies(anchor3, twoopt_concl),
+            Implies(anchor3, reanchor_concl),
             Implies(nd3, conjs3[0]),
             conjs3[1], conjs3[2], conjs3[3], conjs3[4],
         ],
