@@ -60,6 +60,10 @@ def main(argv=None) -> int:
                     help="f for the byzantine catch-up rule: the round "
                          "catch-up target needs f+1 attestations "
                          "(RuntimeOptions.nbrByzantine)")
+    ap.add_argument("-rt", "--rate", type=int, default=1,
+                    help="instances in flight (PerfTest2 -rt; applies "
+                         "with --instances > 1): >1 pipelines burned "
+                         "round deadlines over the InstanceMux")
     from round_tpu.runtime.log import add_verbosity_flags, configure_from_args
 
     add_verbosity_flags(ap)
@@ -110,20 +114,35 @@ def main(argv=None) -> int:
         # deterministic value schedule, --instance is single-run-only
         import time
 
-        from round_tpu.runtime.host import run_instance_loop
+        from round_tpu.runtime.host import (
+            run_instance_loop, run_instance_loop_pipelined,
+        )
 
         if args.instance != 1:
             print("warning: --instance is ignored with --instances > 1 "
                   "(instances are numbered 1..N)", file=sys.stderr)
         t0 = time.perf_counter()
-        decisions = run_instance_loop(
-            algo, args.id, peers, tr, args.instances,
-            timeout_ms=args.timeout_ms, seed=args.seed,
-            base_value=args.value, max_rounds=args.max_rounds,
-            send_when_catching_up=args.send_when_catching_up,
-            delay_first_send_ms=args.delay_first_send_ms,
-            nbr_byzantine=args.nbr_byzantine,
-        )
+        if args.rate > 1:
+            if (not args.send_when_catching_up
+                    or args.delay_first_send_ms > 0):
+                print("warning: --no-send-when-catching-up / "
+                      "--delay-first-send apply to the sequential loop "
+                      "only (ignored with --rate > 1)", file=sys.stderr)
+            decisions = run_instance_loop_pipelined(
+                algo, args.id, peers, tr, args.instances, rate=args.rate,
+                timeout_ms=args.timeout_ms, seed=args.seed,
+                base_value=args.value, max_rounds=args.max_rounds,
+                nbr_byzantine=args.nbr_byzantine,
+            )
+        else:
+            decisions = run_instance_loop(
+                algo, args.id, peers, tr, args.instances,
+                timeout_ms=args.timeout_ms, seed=args.seed,
+                base_value=args.value, max_rounds=args.max_rounds,
+                send_when_catching_up=args.send_when_catching_up,
+                delay_first_send_ms=args.delay_first_send_ms,
+                nbr_byzantine=args.nbr_byzantine,
+            )
         wall = time.perf_counter() - t0
         ok = sum(1 for d in decisions if d is not None)
         print(json.dumps({
