@@ -32,8 +32,14 @@ jax.config.update("jax_platforms", "cpu")
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--id", type=int, required=True)
-    ap.add_argument("--peers", type=str, required=True,
-                    help="comma-separated host:port, index = node id")
+    ap.add_argument("--peers", type=str, default=None,
+                    help="comma-separated host:port, index = node id "
+                         "(or use --conf)")
+    ap.add_argument("--conf", type=str, default=None,
+                    help="XML/JSON config with the replica list (the "
+                         "reference's shape, Config.scala:6-27); "
+                         "<param name= value=/> entries are applied as "
+                         "CLI defaults, explicit flags override them")
     ap.add_argument("--algo", type=str, default="otr")
     ap.add_argument("--value", type=int, default=0)
     ap.add_argument("--instance", type=int, default=1)
@@ -67,7 +73,40 @@ def main(argv=None) -> int:
     from round_tpu.runtime.log import add_verbosity_flags, configure_from_args
 
     add_verbosity_flags(ap)
-    args = ap.parse_args(argv)
+    argv_in = sys.argv[1:] if argv is None else list(argv)
+    args = ap.parse_args(argv_in)
+    conf_peers = None
+    if args.conf:
+        from round_tpu.runtime.config import parse_config_file
+
+        conf_peers, conf_args = parse_config_file(args.conf)
+        # normalize '--name value' pairs for NO-VALUE flags (XML params
+        # always carry a value attribute): truthy keeps the bare flag,
+        # falsy drops it — without this, '--no-send-when-catching-up true'
+        # would be a fatal unrecognized argument
+        flag_actions = {s: a for a in ap._actions for s in a.option_strings
+                        if a.nargs == 0}
+        norm: list = []
+        i = 0
+        while i < len(conf_args):
+            tok = conf_args[i]
+            if tok in flag_actions and i + 1 < len(conf_args) \
+                    and not conf_args[i + 1].startswith("--"):
+                if conf_args[i + 1].lower() in ("true", "1", "yes", "on"):
+                    norm.append(tok)
+                i += 2
+            else:
+                norm.append(tok)
+                i += 1
+        # the reference precedence (RTOptions.processConFile,
+        # RuntimeOptions.scala:94-102): file params first, explicit CLI
+        # flags override.  parse_KNOWN_args: a shared deployment config
+        # may carry params only the engine-side parser (runtime/config.py)
+        # declares — warn and continue, like that parser does
+        args, unknown = ap.parse_known_args(norm + argv_in)
+        if unknown:
+            print(f"warning: ignoring config params not used by "
+                  f"host_replica: {unknown}", file=sys.stderr)
     configure_from_args(args)
 
     import numpy as np
@@ -77,9 +116,14 @@ def main(argv=None) -> int:
     from round_tpu.runtime.transport import HostTransport
 
     peers = {}
-    for i, hp in enumerate(args.peers.split(",")):
-        host, port = hp.rsplit(":", 1)
-        peers[i] = (host, int(port))
+    if args.peers:
+        for i, hp in enumerate(args.peers.split(",")):
+            host, port = hp.rsplit(":", 1)
+            peers[i] = (host, int(port))
+    elif conf_peers:
+        peers = {i: (h, p) for i, (h, p) in enumerate(conf_peers)}
+    else:
+        ap.error("provide --peers or a --conf file with <replica> entries")
     algo = select(args.algo)
 
     with HostTransport(args.id, peers[args.id][1], proto=args.proto) as tr:

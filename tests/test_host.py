@@ -1369,3 +1369,40 @@ def test_instance_mux_routing_and_stash():
             assert ep10.recv(2000) is not None
         finally:
             mux.close()
+
+
+def test_host_replica_xml_conf_deployment():
+    """The reference's deployment shape end to end: replicas launched from
+    ONE XML config file (Config.scala:6-27 — <replica address= port=/>
+    entries plus <param name= value=/> defaults re-fed as CLI args, with
+    explicit flags overriding) — 3 OS processes, all decide, agreement."""
+    import os
+    import tempfile
+
+    n = 3
+    ports = _free_ports(n)
+    reps = "\n".join(
+        f'  <replica address="127.0.0.1" port="{p}"/>' for p in ports)
+    xml = (f"<config>\n{reps}\n"
+           '  <param name="timeout-ms" value="800"/>\n'
+           '  <param name="algo" value="otr"/>\n'
+           "</config>\n")
+    with tempfile.NamedTemporaryFile("w", suffix=".xml", delete=False) as f:
+        f.write(xml)
+        conf = f.name
+    try:
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "round_tpu.apps.host_replica",
+             "--id", str(i), "--conf", conf, "--value", str(i + 3)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            for i in range(n)]
+        outs = []
+        for i, p in enumerate(procs):
+            out, err = p.communicate(timeout=180)
+            assert p.returncode == 0, f"replica {i} failed: {err[-2000:]}"
+            outs.append(out)
+        logs = [json.loads(o.strip().splitlines()[-1]) for o in outs]
+        assert all(l["decided"] for l in logs), logs
+        assert len({l["decision"] for l in logs}) == 1
+    finally:
+        os.unlink(conf)
