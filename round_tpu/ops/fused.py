@@ -92,10 +92,24 @@ def _count_dot(oh, keep, dot: str):
     int32 accumulator — 2x MXU throughput on v5e-class chips, cast to
     f32 after so the in-kernel update math is dtype-identical.
     bf16: the universally-supported MXU path; the bench's unconditional
-    A/B records it as the other configuration (bench.py --dot bf16)."""
+    A/B records it as the other configuration (bench.py --dot bf16).
+
+    On the CPU backend the i8 path runs with int32 OPERANDS: XLA's CPU
+    int8 GEMM emits invalid LLVM IR ('add i32, i8') for some tiny-shape
+    fusion contexts (n=8 run_hist, caught by the differential soak within
+    hours of i8 becoming the default) — int32 operands with the same
+    int32 accumulate are value-identical and sidestep the buggy codegen;
+    TPU/accelerator lowering is untouched.  The switch is DELIBERATELY
+    trace-time `jax.default_backend()` (the repo's two process modes:
+    CPU-forced tools/tests vs accelerator bench), NOT
+    lax.platform_dependent — this helper runs inside Mosaic kernel
+    bodies, where a platform cond must not lower; a CPU-placed jit on an
+    accelerator host would still trace the int8 operands."""
     if dot == "i8":
+        operand = (jnp.int32 if jax.default_backend() == "cpu"
+                   else jnp.int8)
         return jnp.dot(
-            oh.astype(jnp.int8), keep.astype(jnp.int8),
+            oh.astype(operand), keep.astype(operand),
             preferred_element_type=jnp.int32,
         ).astype(jnp.float32)
     return jnp.dot(

@@ -1052,3 +1052,31 @@ def test_pbft_view_change_fast_parity():
         assert len(set(pos.tolist())) <= 1, s  # agreement among deciders
         saw_rotated_decision |= bool(((d >= 0) & (v > 0) & live).any())
     assert saw_rotated_decision, "no scenario decided through a view change"
+
+
+def test_run_hist_i8_dot_tiny_n_cpu_regression():
+    """XLA CPU's int8 GEMM emitted invalid LLVM IR ('add i32, i8') for
+    run_hist's fusion context at n=8 — caught by the soak within hours of
+    i8 becoming the default dot.  _count_dot's CPU path now uses int32
+    operands (value-identical); this pins the repro shape AND its parity
+    against the bf16 path."""
+    # the workaround keys on the trace-time backend: this regression only
+    # exercises the fixed path when the backend IS cpu (conftest forces
+    # it; assert so an accelerator-backend run cannot pass vacuously)
+    assert jax.default_backend() == "cpu"
+    n, V, S = 8, 3, 8
+    key = jax.random.PRNGKey(0)
+    mix = fast.standard_mix(key, S, n, p_drop=0.25)
+    init = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0, V,
+                              dtype=jnp.int32)
+    rnd = fast.OtrHist(n_values=V, after_decision=2)
+    state0 = OtrState.fresh(init, S, n)
+    out_i8 = fast.run_hist(rnd, state0, lambda s: s.decided, mix,
+                           max_rounds=4, mode="hash", interpret=True,
+                           dot="i8")
+    out_bf16 = fast.run_hist(rnd, state0, lambda s: s.decided, mix,
+                             max_rounds=4, mode="hash", interpret=True,
+                             dot="bf16")
+    for a, b in zip(jax.tree_util.tree_leaves(out_i8),
+                    jax.tree_util.tree_leaves(out_bf16)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
