@@ -31,14 +31,16 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 from round_tpu.apps.selector import select  # noqa: E402
+from round_tpu.runtime.chaos import alloc_ports, cluster_env  # noqa: E402
 from round_tpu.runtime.host import (  # noqa: E402
-    run_instance_loop, run_instance_loop_pipelined,
+    AdaptiveTimeout, run_instance_loop, run_instance_loop_pipelined,
 )
 from round_tpu.runtime.transport import HostTransport  # noqa: E402
 
 
 def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
-             errors=None, proto="tcp", stats=None, algo=None, rate=1):
+             errors=None, proto="tcp", stats=None, algo=None, rate=1,
+             adaptive_cap_ms=0):
     tr = HostTransport(my_id, peers[my_id][1], proto=proto)
     # ONE algorithm object across instances: the jitted round functions
     # cache on its rounds, so instance 2+ skip compilation entirely.
@@ -48,6 +50,13 @@ def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
     # 100-instance thread-mode run)
     algo = select(algo_name) if algo is None else algo
     try:
+        # one estimator PER REPLICA, shared across its instances: the EWMA
+        # models the wire, which does not reset between instances.  Built
+        # inside the try: a bad cap must land in `errors` (and close the
+        # transport), not silently score the run as zero agreement
+        adaptive = (AdaptiveTimeout(cap_ms=adaptive_cap_ms,
+                                    seed=seed * 31 + my_id)
+                    if adaptive_cap_ms > 0 else None)
         node_stats: dict = {}
         if rate > 1:
             # the in-flight window (PerfTest2 -rt): `rate` concurrent
@@ -55,11 +64,12 @@ def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
             results[my_id] = run_instance_loop_pipelined(
                 algo, my_id, peers, tr, instances, rate=rate,
                 timeout_ms=timeout_ms, seed=seed, stats_out=node_stats,
+                adaptive=adaptive,
             )
         else:
             results[my_id] = run_instance_loop(
                 algo, my_id, peers, tr, instances, timeout_ms=timeout_ms,
-                seed=seed, stats_out=node_stats,
+                seed=seed, stats_out=node_stats, adaptive=adaptive,
             )
         if stats is not None:
             stats[my_id] = node_stats
@@ -69,18 +79,6 @@ def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
         raise
     finally:
         tr.close()
-
-
-def _alloc_ports(n):
-    import socket
-
-    socks = [socket.socket() for _ in range(n)]
-    for s in socks:
-        s.bind(("127.0.0.1", 0))
-    ports = [s.getsockname()[1] for s in socks]
-    for s in socks:
-        s.close()
-    return ports
 
 
 def _score(logs, instances, wall, n, algo, timeout_ms, mode,
@@ -123,13 +121,13 @@ def _score(logs, instances, wall, n, algo, timeout_ms, mode,
 
 
 def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
-            proto="tcp", rate=1):
+            proto="tcp", rate=1, adaptive_cap_ms=0):
     """Run `instances` consecutive consensus instances over `n` replicas
     (threads, each with its own transport+sockets — on a single-vCPU box
     the GIL interleaving beats process-per-replica; see measure_processes
     for the reference's exact multi-process shape).  Returns (result dict,
     per-node decision logs)."""
-    ports = _alloc_ports(n)
+    ports = alloc_ports(n)
     peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
     results: dict = {}
     errors: dict = {}
@@ -139,7 +137,7 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
         threading.Thread(
             target=run_node,
             args=(i, peers, algo, instances, timeout_ms, results, seed,
-                  errors, proto, stats, shared_algo, rate),
+                  errors, proto, stats, shared_algo, rate, adaptive_cap_ms),
         )
         for i in range(n)
     ]
@@ -164,6 +162,8 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
         )
     mode = ("thread-per-replica"
             if rate <= 1 else f"thread-per-replica rate={rate}")
+    if adaptive_cap_ms > 0:
+        mode += f" adaptive(cap={adaptive_cap_ms}ms)"
     score = _score(results, instances, wall, n, algo, timeout_ms,
                    mode, proto=proto)
     # per-node diagnostics: timeouts is the throughput killer (each one
@@ -173,36 +173,36 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
 
 
 def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,
-                      proto="tcp"):
+                      proto="tcp", adaptive_cap_ms=0):
     """One OS PROCESS per replica (the reference's exact shape: 4 JVMs on
     localhost) via the host_replica CLI's --instances loop: no shared GIL,
     true parallel replicas.  Returns the same result dict as measure()."""
     import subprocess
 
-    ports = _alloc_ports(n)
+    ports = alloc_ports(n)
     peer_arg = ",".join(f"127.0.0.1:{p}" for p in ports)
-    # persistent compilation cache: every replica process jit-compiles the
-    # same round trios; with the cache, the first process to finish a
-    # compile serves it to the other n-1 (and to every later run) from
-    # disk — the process-mode analogue of thread mode's shared-object
-    # compile (measured: the cache is what lets 4 single-core processes
-    # not quadruple the compile bill)
-    env = dict(os.environ)
-    env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                   os.path.join(os.path.dirname(os.path.dirname(
-                       os.path.dirname(os.path.abspath(__file__)))),
-                       ".jax_cache"))
-    env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
-    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    # cluster_env's persistent compilation cache: every replica process
+    # jit-compiles the same round trios; with the cache, the first process
+    # to finish a compile serves it to the other n-1 (and to every later
+    # run) from disk — the process-mode analogue of thread mode's
+    # shared-object compile (measured: the cache is what lets 4
+    # single-core processes not quadruple the compile bill)
+    env = cluster_env()
     t0 = time.perf_counter()
+    base_argv = [
+        "--peers", peer_arg, "--algo", algo,
+        "--instances", str(instances),
+        "--timeout-ms", str(timeout_ms),
+        "--proto", proto,
+        "--max-rounds", "32",  # same per-instance cap as measure()
+    ]
+    if adaptive_cap_ms > 0:
+        base_argv += ["--adaptive-timeout",
+                      "--timeout-cap-ms", str(adaptive_cap_ms)]
     procs = [
         subprocess.Popen(
             [sys.executable, "-m", "round_tpu.apps.host_replica",
-             "--id", str(i), "--peers", peer_arg, "--algo", algo,
-             "--instances", str(instances),
-             "--timeout-ms", str(timeout_ms),
-             "--proto", proto,
-             "--max-rounds", "32"],  # same per-instance cap as measure()
+             "--id", str(i), *base_argv],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env,
         )
@@ -238,9 +238,14 @@ def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,
         default=harness_wall,
     )
     logs = {i: outs[i]["decisions"] for i in outs}
+    mode = "process-per-replica"
+    if adaptive_cap_ms > 0:
+        mode += f" adaptive(cap={adaptive_cap_ms}ms)"
     result = _score(logs, instances, wall, n, algo, timeout_ms,
-                    "process-per-replica", wall_basis="slowest-replica-loop",
+                    mode, wall_basis="slowest-replica-loop",
                     proto=proto)
+    result["extra"]["node_timeouts"] = {
+        i: outs[i].get("timeouts", 0) for i in outs}
 
     result["extra"]["harness_wall_s"] = round(harness_wall, 3)
     # also report the harness-wall-based rate so the two modes ARE
@@ -268,7 +273,15 @@ def main(argv=None) -> int:
                     help="instances in flight per replica (PerfTest2 -rt; "
                          "thread mode only): >1 pipelines burned round "
                          "deadlines on lossy networks")
+    ap.add_argument("--adaptive-timeout", action="store_true",
+                    help="EWMA + backoff round deadlines instead of the "
+                         "fixed --timeout-ms (runtime/host.py "
+                         "AdaptiveTimeout)")
+    ap.add_argument("--timeout-cap-ms", type=int, default=2000,
+                    help="adaptive-timeout backoff cap / initial deadline "
+                         "(with --adaptive-timeout)")
     args = ap.parse_args(argv)
+    cap = args.timeout_cap_ms if args.adaptive_timeout else 0
     if args.processes:
         if args.rate > 1:
             print("warning: --rate applies to thread mode only",
@@ -276,11 +289,13 @@ def main(argv=None) -> int:
         result, _logs = measure_processes(
             n=args.n, instances=args.instances, algo=args.algo,
             timeout_ms=args.timeout_ms, proto=args.proto,
+            adaptive_cap_ms=cap,
         )
     else:
         result, _logs = measure(
             n=args.n, instances=args.instances, algo=args.algo,
             timeout_ms=args.timeout_ms, proto=args.proto, rate=args.rate,
+            adaptive_cap_ms=cap,
         )
     print(json.dumps(result))
     return 0

@@ -57,6 +57,13 @@ def main(argv=None) -> int:
                     help="skip sending a round's messages when a peer was "
                          "already observed past it (RuntimeOptions."
                          "sendWhenCatchingUp=false)")
+    ap.add_argument("--send-when-catching-up", dest="send_when_catching_up",
+                    action="store_true",
+                    help="re-enable catch-up sends (the default); the "
+                         "paired positive flag exists so a --conf file "
+                         "that sets the store_false param can be "
+                         "overridden from the CLI — without it the "
+                         "file's choice was one-way")
     ap.add_argument("--delay-first-send", dest="delay_first_send_ms",
                     type=int, default=-1, metavar="MS",
                     help="sleep MS before the first round's send "
@@ -70,6 +77,46 @@ def main(argv=None) -> int:
                     help="instances in flight (PerfTest2 -rt; applies "
                          "with --instances > 1): >1 pipelines burned "
                          "round deadlines over the InstanceMux")
+    ap.add_argument("--value-schedule", choices=["mixed", "uniform"],
+                    default="mixed",
+                    help="per-instance proposal schedule: 'mixed' "
+                         "(distinct per replica, the PerfTest2 shape) or "
+                         "'uniform' (identical proposals, so by validity "
+                         "the decision log is fault-schedule-invariant — "
+                         "the chaos harness's diffable mode)")
+    ap.add_argument("--chaos", type=str, default=None, metavar="SPEC",
+                    help="wrap the transport in runtime/chaos.py's "
+                         "FaultyTransport with this seeded fault plan, "
+                         "e.g. 'drop=0.2,reorder=0.15,dup=0.05,seed=7' "
+                         "(families mirror engine/scenarios.py)")
+    ap.add_argument("--checkpoint-dir", type=str, default=None,
+                    help="durably checkpoint the decision list after "
+                         "every instance (runtime/checkpoint.py atomic "
+                         "npz+manifest+TSV) and RESUME from an existing "
+                         "checkpoint — the crash-restart recovery path "
+                         "(sequential --instances loop only)")
+    ap.add_argument("--decision-log", type=str, default=None, metavar="PATH",
+                    help="write the canonical instance\\tvalue decision "
+                         "TSV here at exit (atomic write-then-rename; "
+                         "the chaos harness's byte-diff artifact)")
+    ap.add_argument("--adaptive-timeout", action="store_true",
+                    help="replace the fixed --timeout-ms round deadline "
+                         "with the EWMA + exponential-backoff estimator "
+                         "(runtime/host.py AdaptiveTimeout; the adaptive "
+                         "form of the reference's RuntimeOptions.timeout)")
+    ap.add_argument("--timeout-cap-ms", type=int, default=2000,
+                    help="adaptive-timeout backoff cap and initial "
+                         "deadline (ignored without --adaptive-timeout)")
+    ap.add_argument("--timeout-floor-ms", type=int, default=10,
+                    help="adaptive-timeout lower bound (ignored without "
+                         "--adaptive-timeout)")
+    ap.add_argument("--linger-ms", type=int, default=0, metavar="MS",
+                    help="after the loop completes, keep answering peers' "
+                         "traffic with decision replies until the wire is "
+                         "idle for MS (runtime/host.py serve_decisions) — "
+                         "required by crash-restart recovery when a "
+                         "restarted peer's catch-up outlives this "
+                         "replica's own run")
     from round_tpu.runtime.log import add_verbosity_flags, configure_from_args
 
     add_verbosity_flags(ap)
@@ -112,7 +159,7 @@ def main(argv=None) -> int:
     import numpy as np
 
     from round_tpu.apps.selector import select
-    from round_tpu.runtime.host import HostRunner
+    from round_tpu.runtime.host import AdaptiveTimeout, HostRunner
     from round_tpu.runtime.transport import HostTransport
 
     peers = {}
@@ -126,20 +173,51 @@ def main(argv=None) -> int:
         ap.error("provide --peers or a --conf file with <replica> entries")
     algo = select(args.algo)
 
-    with HostTransport(args.id, peers[args.id][1], proto=args.proto) as tr:
+    adaptive = None
+    if args.adaptive_timeout:
+        # per-replica jitter seed: deadlines must NOT fire in lockstep
+        adaptive = AdaptiveTimeout(cap_ms=args.timeout_cap_ms,
+                                   floor_ms=args.timeout_floor_ms,
+                                   seed=args.seed * 31 + args.id)
+
+    def dump_decision_log(decisions):
+        if args.decision_log:
+            from round_tpu.runtime.decisions import DecisionLog
+
+            DecisionLog.from_values(decisions).dump_values_tsv(
+                args.decision_log)
+
+    with HostTransport(args.id, peers[args.id][1], proto=args.proto) as raw_tr:
+        tr = raw_tr
+        if args.chaos:
+            from round_tpu.runtime.chaos import FaultPlan, FaultyTransport
+
+            tr = FaultyTransport(raw_tr, FaultPlan.parse(args.chaos),
+                                 n=len(peers))
         if args.instances <= 1:
+            if args.checkpoint_dir:
+                print("warning: --checkpoint-dir applies to the "
+                      "sequential --instances loop only (ignored for a "
+                      "single-instance run — this replica is NOT durable)",
+                      file=sys.stderr)
             runner = HostRunner(
                 algo, args.id, peers, tr, instance_id=args.instance,
                 timeout_ms=args.timeout_ms, seed=args.seed,
                 send_when_catching_up=args.send_when_catching_up,
                 delay_first_send_ms=args.delay_first_send_ms,
                 nbr_byzantine=args.nbr_byzantine,
+                adaptive=adaptive,
             )
             res = runner.run(
                 {"initial_value": np.int32(args.value)},
                 max_rounds=args.max_rounds,
             )
             d = int(np.asarray(res.decision)) if res.decided else None
+            dump_decision_log([d])
+            if args.linger_ms > 0:
+                from round_tpu.runtime.host import serve_decisions
+
+                serve_decisions(tr, [d], idle_ms=args.linger_ms)
             print(json.dumps({
                 "id": args.id,
                 "decided": res.decided,
@@ -150,6 +228,11 @@ def main(argv=None) -> int:
                 "decided_instances": 1 if res.decided else 0,
                 "rounds": res.rounds_run,
                 "dropped": res.dropped_messages,
+                "timeouts": res.timeouts,
+                "timeout_trajectory": res.timeout_trajectory,
+                # the RESOLVED catch-up send policy (conf + CLI override),
+                # so deployments and tests can audit boolean precedence
+                "send_when_catching_up": args.send_when_catching_up,
             }))
             return 0
 
@@ -166,17 +249,24 @@ def main(argv=None) -> int:
             print("warning: --instance is ignored with --instances > 1 "
                   "(instances are numbered 1..N)", file=sys.stderr)
         t0 = time.perf_counter()
+        stats: dict = {}
         if args.rate > 1:
             if (not args.send_when_catching_up
                     or args.delay_first_send_ms > 0):
                 print("warning: --no-send-when-catching-up / "
                       "--delay-first-send apply to the sequential loop "
                       "only (ignored with --rate > 1)", file=sys.stderr)
+            if args.checkpoint_dir:
+                print("warning: --checkpoint-dir applies to the "
+                      "sequential loop only (ignored with --rate > 1)",
+                      file=sys.stderr)
             decisions = run_instance_loop_pipelined(
                 algo, args.id, peers, tr, args.instances, rate=args.rate,
                 timeout_ms=args.timeout_ms, seed=args.seed,
                 base_value=args.value, max_rounds=args.max_rounds,
                 nbr_byzantine=args.nbr_byzantine,
+                value_schedule=args.value_schedule,
+                adaptive=adaptive, stats_out=stats,
             )
         else:
             decisions = run_instance_loop(
@@ -186,10 +276,18 @@ def main(argv=None) -> int:
                 send_when_catching_up=args.send_when_catching_up,
                 delay_first_send_ms=args.delay_first_send_ms,
                 nbr_byzantine=args.nbr_byzantine,
+                value_schedule=args.value_schedule,
+                adaptive=adaptive, stats_out=stats,
+                checkpoint_dir=args.checkpoint_dir,
             )
         wall = time.perf_counter() - t0
+        dump_decision_log(decisions)
+        if args.linger_ms > 0:
+            from round_tpu.runtime.host import serve_decisions
+
+            serve_decisions(tr, decisions, idle_ms=args.linger_ms)
         ok = sum(1 for d in decisions if d is not None)
-        print(json.dumps({
+        summary = {
             "id": args.id,
             "instances": args.instances,
             "decided_instances": ok,
@@ -197,7 +295,12 @@ def main(argv=None) -> int:
             "decisions_per_sec": round(ok / wall, 2) if wall > 0 else 0.0,
             "decisions": decisions,
             "dropped": tr.dropped,
-        }))
+            "timeouts": stats.get("timeouts", 0),
+            "timeout_trajectory": stats.get("timeout_trajectory", []),
+        }
+        if args.chaos:
+            summary["chaos_injected"] = tr.injected
+        print(json.dumps(summary))
     return 0
 
 

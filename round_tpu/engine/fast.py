@@ -1141,6 +1141,9 @@ def run_hist(
     state0 leaves are [S, n, ...].  Returns (state, done [S, n],
     decided_round [S, n]).  Semantics mirror executor.run_phases: exited
     lanes stop sending and freeze."""
+    # eager (not trace-cached) check: CPU execution of the i8 path
+    # requires a CPU-backend process (fused.guard_cpu_i8_placement)
+    fused.guard_cpu_i8_placement(dot)
     S, n = mix.crashed.shape
     V = rnd.num_values
 

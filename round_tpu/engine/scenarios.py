@@ -57,6 +57,58 @@ def _mix32(z: jnp.ndarray) -> jnp.ndarray:
     return z
 
 
+# the link-hash stream constants, shared with the host chaos layer
+# (runtime/chaos.py) so a host FaultPlan and an engine sampler keyed by the
+# same PRNG key agree on WHICH (src, dst, round) links fault
+LINK_GOLD = 0x9E3779B9   # per-link stride
+LINK_RMIX = 0x7FEB352D   # per-round stride
+
+
+def mix32_host(z: int) -> int:
+    """Scalar numpy mirror of _mix32 for the host (per-message) path —
+    runtime/chaos.py decides one link event per wire send and cannot pay a
+    jnp dispatch each time.  MUST stay in lockstep with _mix32
+    (tests/test_chaos.py pins them against each other on a grid)."""
+    import numpy as np
+
+    with np.errstate(over="ignore"):
+        z = np.uint32(z & 0xFFFFFFFF)  # callers pass arbitrary-width ints
+        z ^= z >> np.uint32(16)
+        z *= np.uint32(0x85EBCA6B)
+        z ^= z >> np.uint32(13)
+        z *= np.uint32(0xC2B2AE35)
+        z ^= z >> np.uint32(16)
+    return int(z)
+
+
+def host_link_u32(salt0: int, salt1: int, r: int, src: int, dst: int,
+                  n: int, stream: int = 0) -> int:
+    """The scalar (one-link) value of the counter-based link hash: exactly
+    link_bernoulli's mix for link (dst hears src) at round r, plus an
+    optional `stream` constant so the host chaos layer can draw independent
+    events (drop vs duplicate vs reorder ...) from one seed.  With
+    stream=0, `host_link_u32(...) & 0xFF < p8` reproduces
+    link_bernoulli(key, r, n, p)[dst, src] bit-exactly for the same salts
+    (scenario masks index ho[receiver, sender])."""
+    import numpy as np
+
+    with np.errstate(over="ignore"):
+        idx = np.uint32(dst) * np.uint32(n) + np.uint32(src)
+        z = idx * np.uint32(LINK_GOLD) + np.uint32(salt0)
+        z ^= (np.uint32(r) * np.uint32(LINK_RMIX) + np.uint32(salt1)
+              + np.uint32(stream))
+    return mix32_host(int(z))
+
+
+def host_key_salts(seed: int):
+    """(salt0, salt1) for the host chaos layer from an integer seed — the
+    same two uint32 salts _key_salt extracts from PRNGKey(seed), so a
+    FaultPlan(seed=s) and an engine sampler over PRNGKey(s) share one
+    fault schedule."""
+    k0, k1 = _key_salt(jax.random.PRNGKey(seed))
+    return int(k0), int(k1)
+
+
 def link_bernoulli(key, r, n: int, p: float) -> jnp.ndarray:
     """[n, n] iid Bernoulli(p') mask, p' = round(p*256)/256 (clamped to at
     least 1/256 for any p > 0: a lossy network must stay lossy), keyed by
@@ -65,8 +117,8 @@ def link_bernoulli(key, r, n: int, p: float) -> jnp.ndarray:
     k0, k1 = _key_salt(key)
     i = jnp.arange(n, dtype=jnp.uint32)
     idx = i[:, None] * jnp.uint32(n) + i[None, :]
-    z = idx * jnp.uint32(0x9E3779B9) + k0
-    z = z ^ (jnp.asarray(r).astype(jnp.uint32) * jnp.uint32(0x7FEB352D) + k1)
+    z = idx * jnp.uint32(LINK_GOLD) + k0
+    z = z ^ (jnp.asarray(r).astype(jnp.uint32) * jnp.uint32(LINK_RMIX) + k1)
     z = _mix32(z)
     return (z & jnp.uint32(0xFF)) < thresh
 

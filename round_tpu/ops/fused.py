@@ -83,6 +83,33 @@ def _fmix32(z):
     return z
 
 
+def guard_cpu_i8_placement(dot: str) -> None:
+    """Refuse the one process mode where _count_dot's trace-time backend
+    switch is WRONG (ADVICE.md round-5): in an accelerator-backend
+    process, `jax.default_backend()` says the accelerator, so the i8 path
+    traces int8 operands — but a computation explicitly placed on CPU
+    (jax.config jax_default_device = a cpu Device) then EXECUTES those
+    int8 operands on the XLA-CPU backend, which miscompiles tiny-shape
+    int8 GEMMs (invalid 'add i32, i8' LLVM IR; caught by the differential
+    soak).  The two blessed modes are: a CPU-backend process
+    (JAX_PLATFORMS=cpu — every tool/test here) or accelerator placement.
+    Called at the public entry points (hist_exchange, hist_loop,
+    otr_loop, engine.fast.run_hist/run_otr_loop) so the unsupported mode
+    fails loudly at trace time instead of silently computing garbage."""
+    if dot != "i8" or jax.default_backend() == "cpu":
+        return
+    dev = getattr(jax.config, "jax_default_device", None)
+    if dev is not None and getattr(dev, "platform", None) == "cpu":
+        raise RuntimeError(
+            "dot='i8' computation placed on CPU inside a "
+            f"{jax.default_backend()!r}-backend process: _count_dot's "
+            "trace-time backend switch would trace int8 operands and hit "
+            "the XLA-CPU int8 GEMM miscompile.  Run CPU work in a "
+            "CPU-backend process (JAX_PLATFORMS=cpu), unset "
+            "jax_default_device, or pass dot='bf16'."
+        )
+
+
 def _count_dot(oh, keep, dot: str):
     """The count matmul in the requested MXU dtype.  Both are EXACT: the
     operands are 0/1 (no rounding in either dtype) and the accumulator
@@ -217,6 +244,7 @@ def hist_exchange(
     Pass side=None / rowmask=None to compile out the partition / dest-mask
     logic (the common case on the fast path).
     """
+    guard_cpu_i8_placement(dot)
     S, n = vals.shape
     orig_S = S
     (vals, active, colmask, rowmask, side, salt0, salt1r, p8), S = \
@@ -789,6 +817,7 @@ def hist_loop(
         # a typo'd variant would silently bench v2 while every marker
         # claims otherwise — refuse instead
         raise ValueError(f"unknown loop-kernel variant {variant!r}")
+    guard_cpu_i8_placement(dot)
     S, n = x0.shape
     orig_S = S
     (x0, crashed, side, crash_round, heal_round, rotate_down, p8, salt0,

@@ -1,0 +1,433 @@
+"""Chaos layer for the host runtime: deterministic wire-fault injection
+and the crash-restart cluster driver.
+
+The simulated engines carry their whole fault model as data
+(engine/scenarios.py HO families); the real multi-process path
+(runtime/host.py over runtime/transport.py) had none — it was only ever
+exercised on a clean localhost wire.  This module closes that gap:
+
+* ``FaultPlan`` — a seed-driven schedule of wire faults per
+  (src, dst, round), SHARING the engines' counter-based link hash
+  (scenarios.link_bernoulli: murmur3 fmix32 over
+  ``idx·GOLD + salt0 ^ (r·RMIX + salt1)``, probabilities quantized to
+  1/256).  ``FaultPlan(seed=s, drop=p)`` drops exactly the links
+  ``scenarios.omission(n, p, impl="hash")`` drops for ``PRNGKey(s)`` —
+  pinned by tests/test_chaos.py — so one fault mix can run against both
+  the fused engine and a real process cluster and the decisions diffed.
+  The extra families (duplicate / reorder / delay / truncate / garbage)
+  draw from the same hash under distinct stream constants: one seed, six
+  independent, REPLAYABLE schedules.
+
+* ``FaultyTransport`` — a wrapper implementing the HostTransport surface
+  (send/recv/add_peer/stop/close/dropped) that applies a FaultPlan:
+  sender-side faults (drop, crash-silence, partition, duplicate,
+  truncate, garbage bytes) perturb ``send``; receiver-side faults
+  (delay, reorder) hold packets back in ``recv``.  Only FLAG_NORMAL
+  data-plane frames are perturbed — the decision-reply control plane IS
+  the recovery machinery under test and keeps the wire semantics of the
+  underlying transport.
+
+* ``run_chaos_cluster`` — the crash-restart driver: n ``host_replica``
+  OS processes with a chaos spec, optionally SIGKILLing one replica
+  after it has durably checkpointed ``crash_after`` instances and
+  restarting it from the checkpoint (runtime/checkpoint.py).  Shared by
+  tests/test_chaos.py and the tools/soak.py ``host-chaos`` rotation
+  slot, which diffs the surviving decision logs byte-for-byte against a
+  clean run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+from round_tpu.engine.scenarios import (
+    LINK_GOLD,
+    host_key_salts,
+    host_link_u32,
+    mix32_host,
+)
+from round_tpu.runtime.oob import FLAG_NORMAL
+
+# Stream constants: each fault family draws an independent Bernoulli from
+# the one link hash by folding its stream into the round salt.  DROP is
+# stream 0 so the drop schedule is BIT-IDENTICAL to the engines'
+# scenarios.omission hash mask for the same seed.
+STREAM_DROP = 0x00000000
+STREAM_DUP = 0x5D0F00D1
+STREAM_REORDER = 0x6C1E55A7
+STREAM_DELAY = 0x7D2EAA93
+STREAM_TRUNCATE = 0x8E3F0189
+STREAM_GARBAGE = 0x9F4F56B5
+_PARTITION_SALT = 0x9A87  # matches scenarios.partition's fold-in constant
+
+
+def _p8(p: float) -> int:
+    """Probability → 8-bit threshold, exactly link_bernoulli's clamp: any
+    p > 0 keeps at least 1/256 (a lossy schedule must stay lossy)."""
+    return max(1, round(p * 256.0)) if p > 0 else 0
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Seed-driven per-(src, dst, round) wire-fault schedule.
+
+    Families and parameterisation mirror engine/scenarios.py:
+      drop          — scenarios.omission(n, drop): iid per-link loss;
+      crash_round   — scenarios.crash_at: from this round on, this
+                      replica's sends are swallowed (-1 = never; the
+                      process-level analogue is run_chaos_cluster's
+                      SIGKILL);
+      partition     — scenarios.partition: two seed-drawn halves cannot
+                      talk until heal_round;
+      dup/reorder/delay/truncate/garbage — wire-level families with no
+                      HO-mask counterpart (an HO set cannot express a
+                      duplicated or corrupted payload; the reference
+                      tolerates these via InstanceHandler.scala:392-399,
+                      which is exactly the machinery they exercise).
+    """
+
+    seed: int = 0
+    drop: float = 0.0
+    dup: float = 0.0
+    reorder: float = 0.0
+    reorder_hold_ms: int = 60
+    delay: float = 0.0
+    delay_ms: int = 40
+    truncate: float = 0.0
+    garbage: float = 0.0
+    crash_round: int = -1
+    heal_round: int = 0  # partition active while r < heal_round
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Build a plan from a compact CLI spec:
+        ``drop=0.2,reorder=0.15,dup=0.05,seed=7`` (keys are the dataclass
+        fields; ints and floats inferred).  Unknown keys are an error —
+        a typo'd family must not silently run fault-free."""
+        kwargs: Dict[str, object] = {}
+        fields = {f.name: f.type for f in dataclasses.fields(cls)}
+        for part in filter(None, (p.strip() for p in spec.split(","))):
+            if "=" not in part:
+                raise ValueError(f"chaos spec entry {part!r} is not key=value")
+            key, val = part.split("=", 1)
+            key = key.strip().replace("-", "_")
+            if key not in fields:
+                raise ValueError(
+                    f"unknown chaos family/field {key!r}; known: "
+                    f"{sorted(fields)}")
+            kwargs[key] = (int(val) if fields[key] == "int"
+                           or fields[key] is int else float(val))
+        return cls(**kwargs)
+
+    def spec(self) -> str:
+        """The canonical round-trippable spec string (non-default fields)."""
+        out = []
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if v != f.default:
+                out.append(f"{f.name}={v}")
+        return ",".join(out)
+
+
+class FaultyTransport:
+    """A HostTransport/HostBus-surface wrapper applying a FaultPlan.
+
+    Fault decisions are pure functions of (seed, src, dst, round): two
+    runs over the same plan see the same schedule (delivery TIMING of
+    delayed packets is wall-clock, the schedule of which packets fault is
+    not).  `injected` counts every applied fault for assertions and
+    stats.  Non-NORMAL (control-plane) frames pass through untouched."""
+
+    def __init__(self, inner, plan: FaultPlan, n: int):
+        self.inner = inner
+        self.plan = plan
+        self.n = n
+        self._salt0, self._salt1 = host_key_salts(plan.seed)
+        self.injected: Dict[str, int] = {}
+        self._held: list = []   # (release_t, seq, got) min-heap
+        self._seq = itertools.count()
+
+    # -- the seeded link hash ----------------------------------------------
+
+    def _u32(self, stream: int, src: int, dst: int, r: int) -> int:
+        return host_link_u32(self._salt0, self._salt1, r, src, dst,
+                             self.n, stream)
+
+    def _event(self, stream: int, src: int, dst: int, r: int,
+               p: float) -> bool:
+        p8 = _p8(p)
+        return p8 > 0 and (self._u32(stream, src, dst, r) & 0xFF) < p8
+
+    def _side(self, node: int) -> int:
+        """Seed-drawn partition side, constant per node (the
+        scenarios.partition per-scenario split role)."""
+        return mix32_host(node * LINK_GOLD + self._salt0
+                          + _PARTITION_SALT) & 1
+
+    def _count(self, family: str) -> None:
+        self.injected[family] = self.injected.get(family, 0) + 1
+
+    # -- HostTransport surface ---------------------------------------------
+
+    @property
+    def id(self):
+        return self.inner.id
+
+    @property
+    def port(self):
+        return self.inner.port
+
+    @property
+    def dropped(self):
+        return self.inner.dropped
+
+    @property
+    def closed(self):
+        return self.inner.closed
+
+    def add_peer(self, peer_id, host, port):
+        return self.inner.add_peer(peer_id, host, port)
+
+    def stop(self):
+        return self.inner.stop()
+
+    def close(self):
+        return self.inner.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def send(self, to, tag, payload: bytes = b"") -> bool:
+        plan, src = self.plan, self.inner.id
+        if tag.flag != FLAG_NORMAL:
+            return self.inner.send(to, tag, payload)
+        r = tag.round
+        if 0 <= plan.crash_round <= r:
+            self._count("crash_mute")
+            return True  # swallowed: the crashed sender is silent
+        if r < plan.heal_round and self._side(src) != self._side(to):
+            self._count("partition")
+            return True
+        if self._event(STREAM_DROP, src, to, r, plan.drop):
+            self._count("drop")
+            return True  # silent loss, UDP-style
+        if payload and self._event(STREAM_TRUNCATE, src, to, r,
+                                   plan.truncate):
+            u = self._u32(STREAM_TRUNCATE, src, to, r)
+            payload = payload[: (u >> 8) % len(payload)]
+            self._count("truncate")
+        if self._event(STREAM_GARBAGE, src, to, r, plan.garbage):
+            u = self._u32(STREAM_GARBAGE, src, to, r)
+            payload = (u.to_bytes(4, "big") * (1 + (u >> 8) % 16))
+            self._count("garbage")
+        ok = self.inner.send(to, tag, payload)
+        if self._event(STREAM_DUP, src, to, r, plan.dup):
+            self.inner.send(to, tag, payload)
+            self._count("dup")
+        return ok
+
+    def _maybe_hold(self, got):
+        """Receiver-side families: None when the packet was held back."""
+        sender, tag, _raw = got
+        if tag.flag != FLAG_NORMAL or not (0 <= sender < self.n):
+            return got
+        plan, dst, r = self.plan, self.inner.id, tag.round
+        hold_ms = 0
+        if self._event(STREAM_DELAY, sender, dst, r, plan.delay):
+            hold_ms += plan.delay_ms
+            self._count("delay")
+        if self._event(STREAM_REORDER, sender, dst, r, plan.reorder):
+            hold_ms += plan.reorder_hold_ms
+            self._count("reorder")
+        if hold_ms <= 0:
+            return got
+        heapq.heappush(
+            self._held,
+            (time.monotonic() + hold_ms / 1000.0, next(self._seq), got),
+        )
+        return None
+
+    def recv(self, timeout_ms: int):
+        deadline = time.monotonic() + max(timeout_ms, 0) / 1000.0
+        while True:
+            now = time.monotonic()
+            if self._held and self._held[0][0] <= now:
+                return heapq.heappop(self._held)[2]
+            remaining = deadline - now
+            if remaining <= 0:
+                # final non-blocking poll keeps recv(0) drain semantics
+                got = self.inner.recv(0)
+                if got is None:
+                    return None
+                return self._maybe_hold(got)
+            wait = remaining
+            if self._held:
+                wait = min(wait, self._held[0][0] - now)
+            got = self.inner.recv(max(0, int(wait * 1000)))
+            if got is None:
+                continue  # deadline or a held release came due
+            got = self._maybe_hold(got)
+            if got is not None:
+                return got
+
+
+# ---------------------------------------------------------------------------
+# Crash-restart cluster driver (host_replica subprocesses)
+# ---------------------------------------------------------------------------
+
+
+def alloc_ports(n: int):
+    """n free localhost ports (bind-then-close; the shared copy — also
+    used by apps/host_perftest.py and the cluster tests)."""
+    import socket
+
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def cluster_env() -> Dict[str, str]:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    # persistent jit cache: the clean run warms it for the chaos run (and
+    # the restarted replica re-pays only a disk load, not a compile)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(repo, ".jax_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    return env
+
+
+def _checkpoint_step(ckpt_dir: str) -> int:
+    """step recorded in a checkpoint manifest, -1 when absent/torn."""
+    try:
+        with open(os.path.join(ckpt_dir, "manifest.json")) as fh:
+            return int(json.load(fh).get("step", -1))
+    except (OSError, ValueError):
+        return -1
+
+
+def run_chaos_cluster(
+    workdir: str,
+    n: int = 3,
+    instances: int = 6,
+    *,
+    algo: str = "otr",
+    chaos: Optional[str] = None,
+    crash_replica: Optional[int] = None,
+    crash_after: int = 2,
+    crash_wait_s: float = 60.0,
+    timeout_ms: int = 250,
+    max_rounds: int = 32,
+    value_schedule: str = "uniform",
+    seed: int = 0,
+    adaptive: bool = False,
+    proto: str = "tcp",
+    join_timeout: float = 150.0,
+    linger_ms: int = 8000,
+):
+    """Run an n-process host cluster to completion, optionally under a
+    chaos spec and one forced crash-restart.
+
+    With ``crash_replica`` set, that replica is SIGKILLed once its
+    durable checkpoint records >= ``crash_after`` completed instances
+    (or after ``crash_wait_s``, whichever first) and immediately
+    restarted with the same argv — recovery must come from the
+    checkpoint plus the peers' decision-replay protocol.  The OTHER
+    replicas get ``--linger-ms`` so they outlive the restart: a replica
+    whose peers all exit before its interpreter even comes back up has
+    nobody left to serve the decision replies catch-up depends on
+    (host.serve_decisions).
+
+    Returns a dict with per-replica ``decisions`` (from the summary JSON
+    line), ``log_bytes`` (the byte-exact instance→value decision-log TSV
+    each replica wrote), ``outs`` (full summary JSONs) and ``restarts``.
+    """
+    os.makedirs(workdir, exist_ok=True)
+    ports = alloc_ports(n)
+    peer_arg = ",".join(f"127.0.0.1:{p}" for p in ports)
+    env = cluster_env()
+
+    def argv(i: int):
+        a = [sys.executable, "-m", "round_tpu.apps.host_replica",
+             "--id", str(i), "--peers", peer_arg, "--algo", algo,
+             "--instances", str(instances),
+             "--timeout-ms", str(timeout_ms),
+             "--max-rounds", str(max_rounds),
+             "--seed", str(seed), "--proto", proto,
+             "--value-schedule", value_schedule,
+             "--decision-log", os.path.join(workdir, f"decisions-{i}.tsv"),
+             "--checkpoint-dir", os.path.join(workdir, f"ckpt-{i}")]
+        if chaos:
+            a += ["--chaos", chaos]
+        if adaptive:
+            a += ["--adaptive-timeout"]
+        if (crash_replica is not None and i != crash_replica
+                and linger_ms > 0):
+            a += ["--linger-ms", str(linger_ms)]
+        return a
+
+    def launch(i: int):
+        return subprocess.Popen(argv(i), stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True, env=env)
+
+    procs = {i: launch(i) for i in range(n)}
+    restarts = 0
+    try:
+        if crash_replica is not None:
+            ckpt = os.path.join(workdir, f"ckpt-{crash_replica}")
+            t_end = time.monotonic() + crash_wait_s
+            while (time.monotonic() < t_end
+                   and _checkpoint_step(ckpt) < crash_after
+                   and procs[crash_replica].poll() is None):
+                time.sleep(0.05)
+            if procs[crash_replica].poll() is None:
+                # SIGKILL, not terminate: the point is an unclean death
+                procs[crash_replica].send_signal(signal.SIGKILL)
+                procs[crash_replica].wait(timeout=30)
+                restarts += 1
+                procs[crash_replica] = launch(crash_replica)
+        outs = {}
+        for i, p in enumerate(procs.values()):
+            stdout, stderr = p.communicate(timeout=join_timeout)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"replica {i} failed (rc={p.returncode}): "
+                    f"{stderr[-2000:]}")
+            outs[i] = json.loads(stdout.strip().splitlines()[-1])
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                try:
+                    p.communicate(timeout=10)
+                except Exception:  # noqa: BLE001 - best-effort reap
+                    pass
+    log_bytes = {}
+    for i in range(n):
+        with open(os.path.join(workdir, f"decisions-{i}.tsv"), "rb") as fh:
+            log_bytes[i] = fh.read()
+    return {
+        "decisions": {i: outs[i].get("decisions") for i in outs},
+        "log_bytes": log_bytes,
+        "outs": outs,
+        "restarts": restarts,
+    }

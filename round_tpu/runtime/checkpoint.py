@@ -1,10 +1,22 @@
-"""Checkpoint/restore of simulation state tensors.
+"""Checkpoint/restore of simulation state tensors + decision logs.
 
 The reference has no framework-level checkpointing (SURVEY.md §5: the
 closest is the batching example's snapshot/recovery); here it is native:
 the process-state pytree is arrays, so a checkpoint is an .npz plus a JSON
-manifest (step, instance, rng key, tree structure).  Uses orbax when
-available for large multi-host state; the .npz path has no dependencies.
+manifest (step, instance, rng key, tree structure), and a host replica's
+durable record additionally carries its decision log
+(runtime/decisions.py) as a TSV — the artifact crash-restart recovery
+resumes from (runtime/chaos.py, apps/host_replica.py --checkpoint-dir).
+
+Durability discipline: every file is write-then-rename, so a crash (or a
+SIGKILL from the chaos harness) mid-overwrite can never leave a valid
+manifest pointing at a torn state.npz; the manifest additionally rides
+inside the npz itself, so a crash BETWEEN the two renames (new state.npz,
+stale manifest.json) restores the newer consistent pair instead of
+pairing an old step watermark with new state.  Restore NEVER unpickles
+(allow_pickle=False) and raises ``CheckpointError`` on every corruption
+mode — truncated npz, missing/garbled manifest, leaf-count or treedef
+mismatch — instead of restoring garbage or swapped fields.
 """
 
 from __future__ import annotations
@@ -16,54 +28,123 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+from round_tpu.runtime.decisions import DecisionLog
 
-def save(path: str, state: Any, *, step: int = 0, meta: Optional[Dict] = None) -> None:
+
+class CheckpointError(ValueError):
+    """A checkpoint that must not be restored: missing, torn, or written
+    for a different state shape.  Subclasses ValueError so pre-existing
+    treedef-mismatch handlers keep working."""
+
+
+def save(path: str, state: Any, *, step: int = 0,
+         meta: Optional[Dict] = None,
+         decisions: Optional[DecisionLog] = None) -> None:
     """Write `state` (any pytree of arrays) + metadata.  `path` is a
-    directory; contents: state.npz + manifest.json."""
+    directory; contents: state.npz + manifest.json (+ decisions.tsv when
+    a DecisionLog is supplied).  Every file is written atomically, and
+    the manifest ALSO rides inside the npz — state and metadata then
+    share ONE rename, so a crash landing between the individual file
+    renames below still leaves a restorable, mutually-consistent pair
+    (see restore)."""
     os.makedirs(path, exist_ok=True)
     leaves, treedef = jax.tree_util.tree_flatten(state)
     arrays = {f"leaf{i}": np.asarray(v) for i, v in enumerate(leaves)}
+    manifest = {
+        "step": int(step),
+        "n_leaves": len(leaves),
+        "treedef": str(treedef),
+        "decisions": decisions is not None,
+        "meta": meta or {},
+    }
+    arrays["__manifest__"] = np.frombuffer(
+        json.dumps(manifest).encode(), dtype=np.uint8)
     # write-then-rename: a crash mid-overwrite must never leave a valid
     # manifest pointing at a torn state.npz
     tmp_npz = os.path.join(path, "state.npz.tmp")
     with open(tmp_npz, "wb") as fh:
         np.savez(fh, **arrays)
     os.replace(tmp_npz, os.path.join(path, "state.npz"))
-    manifest = {
-        "step": int(step),
-        "n_leaves": len(leaves),
-        "treedef": str(treedef),
-        "meta": meta or {},
-    }
+    if decisions is not None:
+        tmp_tsv = os.path.join(path, "decisions.tsv.tmp")
+        decisions.dump_tsv(tmp_tsv)
+        os.replace(tmp_tsv, os.path.join(path, "decisions.tsv"))
     tmp = os.path.join(path, "manifest.json.tmp")
     with open(tmp, "w") as fh:
         json.dump(manifest, fh)
     os.replace(tmp, os.path.join(path, "manifest.json"))
 
 
+def _read_manifest(path: str) -> Dict:
+    mpath = os.path.join(path, "manifest.json")
+    try:
+        with open(mpath) as fh:
+            return json.load(fh)
+    except FileNotFoundError:
+        raise CheckpointError(f"no checkpoint manifest at {mpath}") from None
+    except (OSError, ValueError) as e:
+        raise CheckpointError(f"unreadable checkpoint manifest "
+                              f"{mpath}: {e}") from e
+
+
 def restore(path: str, like: Any) -> Tuple[Any, int, Dict]:
     """Read a checkpoint written by `save`.  `like` supplies the pytree
     structure (same treedef as the saved state).  Returns
-    (state, step, meta)."""
-    with open(os.path.join(path, "manifest.json")) as fh:
-        manifest = json.load(fh)
-    data = np.load(os.path.join(path, "state.npz"))
-    leaves = [data[f"leaf{i}"] for i in range(manifest["n_leaves"])]
+    (state, step, meta).  Raises CheckpointError (a ValueError) on any
+    corruption: missing manifest, truncated/garbled state.npz, leaf
+    count or treedef mismatch — never unpickles, never restores swapped
+    fields."""
+    manifest = _read_manifest(path)
+    npz = os.path.join(path, "state.npz")
+    try:
+        # allow_pickle=False is load's default but the no-unpickling
+        # guarantee is part of this function's contract — keep it explicit
+        data = np.load(npz, allow_pickle=False)
+        if "__manifest__" in data:
+            embedded = json.loads(bytes(data["__manifest__"]).decode())
+            if embedded != manifest:
+                # a crash landed between save()'s state.npz and
+                # manifest.json renames: the npz + its embedded manifest
+                # are the newer CONSISTENT pair (one rename wrote both);
+                # honoring the stale manifest.json would pair its old
+                # step with the new state — an SMR restore would then
+                # re-apply already-applied instances
+                manifest = embedded
+        leaves = [data[f"leaf{i}"] for i in range(manifest["n_leaves"])]
+    except CheckpointError:
+        raise
+    except Exception as e:  # noqa: BLE001 — BadZipFile, zlib errors,
+        # KeyError on missing members, OSError on truncation: every
+        # corruption mode surfaces as one clean error class
+        raise CheckpointError(
+            f"corrupt or truncated checkpoint state at {npz}: {e}") from e
     _, treedef = jax.tree_util.tree_flatten(like)
-    assert treedef.num_leaves == len(leaves), (
-        f"checkpoint has {len(leaves)} leaves, template has "
-        f"{treedef.num_leaves}"
-    )
+    if treedef.num_leaves != len(leaves):
+        raise CheckpointError(
+            f"checkpoint has {len(leaves)} leaves, template has "
+            f"{treedef.num_leaves}"
+        )
     # leaf count alone lets a reordered pytree restore with fields swapped;
     # the recorded treedef string must match the template's exactly
     if manifest.get("treedef") is not None and manifest["treedef"] != str(treedef):
-        raise ValueError(
+        raise CheckpointError(
             "checkpoint treedef does not match the restore template:\n"
             f"  saved:    {manifest['treedef']}\n"
             f"  template: {treedef}"
         )
     state = jax.tree_util.tree_unflatten(treedef, leaves)
     return state, manifest["step"], manifest.get("meta", {})
+
+
+def restore_decisions(path: str) -> DecisionLog:
+    """The decision log saved alongside a checkpoint (save(...,
+    decisions=...)).  Raises CheckpointError when the checkpoint carries
+    none."""
+    manifest = _read_manifest(path)
+    tsv = os.path.join(path, "decisions.tsv")
+    if not manifest.get("decisions") or not os.path.exists(tsv):
+        raise CheckpointError(f"checkpoint at {path} has no decision log")
+    return DecisionLog.load_tsv(tsv)
 
 
 def exists(path: str) -> bool:

@@ -9,8 +9,10 @@ same column layout.
 
 from __future__ import annotations
 
+import hashlib
+import os
 import threading
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 
 class DecisionLog:
@@ -72,3 +74,44 @@ class DecisionLog:
             _rnd, val = self._log[inst]
             state = apply_fn(state, inst, val)
         return state
+
+    # -- canonical value log (chaos-diff artifact) --------------------------
+
+    @classmethod
+    def from_values(cls, values: Sequence[Optional[int]],
+                    start: int = 1) -> "DecisionLog":
+        """Log from an ordered per-instance decision list (the host
+        loops' return shape, runtime/host.py): instance ids start at
+        `start`, None entries (undecided) are simply absent — a diff of
+        two value logs then catches a missing decision as a byte
+        mismatch, not a silent gap."""
+        log = cls()
+        for k, v in enumerate(values):
+            if v is not None:
+                log.record(start + k, 0, int(v))
+        return log
+
+    def values_tsv(self) -> bytes:
+        """The canonical ``instance\\tvalue`` byte form, WITHOUT the round
+        column: the round an instance decided in is schedule-dependent
+        (timeouts, catch-up), the value is not — so this is the artifact
+        two runs of one workload must match byte-for-byte (the chaos
+        harness's agreement check, tools/soak.py host-chaos slot)."""
+        with self._lock:
+            return "".join(
+                f"{inst}\t{self._log[inst][1]}\n" for inst in sorted(self._log)
+            ).encode()
+
+    def dump_values_tsv(self, path: str) -> None:
+        """Atomically write values_tsv (write-then-rename, the checkpoint
+        durability discipline — a crash mid-dump must not leave a torn
+        log that diffs clean against nothing)."""
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(self.values_tsv())
+        os.replace(tmp, path)
+
+    def digest(self) -> str:
+        """sha256 of the canonical value log — the log-hash a recovered
+        replica must reproduce bit-for-bit against a never-crashed run."""
+        return hashlib.sha256(self.values_tsv()).hexdigest()

@@ -72,6 +72,11 @@ log = get_logger("host")
 # compile each round class once (see HostRunner._round_fns)
 _JIT_BUILD_LOCK = threading.Lock()
 
+# queue sentinel broadcast by InstanceMux._loop when the router thread
+# dies: endpoints must RAISE, not starve into round timeouts (ADVICE.md
+# round-5 finding)
+_ROUTER_DOWN = object()
+
 
 @dataclasses.dataclass
 class HostResult:
@@ -90,6 +95,102 @@ class HostResult:
     # rounds that ended by deadline expiry rather than goAhead — the
     # throughput diagnostic (every one burns a full round timeout)
     timeouts: int = 0
+    # the deadline (ms) each timeout-governed round actually waited on —
+    # with an AdaptiveTimeout this is the convergence trajectory (starts
+    # at the backoff cap, shrinks toward the observed round latency);
+    # with a fixed timeout it is flat
+    timeout_trajectory: List[int] = dataclasses.field(default_factory=list)
+
+
+class AdaptiveTimeout:
+    """EWMA round-latency estimator with exponential backoff, jitter and
+    a cap — the adaptive replacement for a fixed `timeout_ms` (the
+    reference drives InstanceHandler deadlines from a static
+    RuntimeOptions.timeout; an unattended deployment needs the deadline
+    to TRACK the wire).
+
+    Discipline:
+      * starts at `cap_ms` (pessimistic: a fresh replica knows nothing
+        about the wire, and a too-short first deadline burns rounds);
+      * every round that completes by goAhead feeds its latency into an
+        EWMA; the working deadline converges to `margin` x EWMA, floored
+        and capped;
+      * every round that EXPIRES backs the deadline off exponentially
+        (`backoff` x current, capped) — loss and stalls push it up fast;
+      * deterministic seeded jitter (±`jitter` fraction, murmur3 over the
+        observation counter) desynchronizes replicas so their deadlines
+        do not fire in lockstep.
+
+    One instance may be shared across consecutive/concurrent instances of
+    a replica (the host loops do): the estimator models the WIRE, which
+    does not reset between consensus instances.  Thread-safety relies on
+    the GIL (float stores); races only jitter the estimate."""
+
+    def __init__(self, cap_ms: int = 2000, floor_ms: int = 10,
+                 alpha: float = 0.3, margin: float = 3.0,
+                 backoff: float = 2.0, jitter: float = 0.1, seed: int = 0):
+        if not 0 < alpha <= 1:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        if not 0 < floor_ms <= cap_ms:
+            raise ValueError(
+                f"need 0 < floor_ms <= cap_ms, got {floor_ms}, {cap_ms}")
+        self.cap_ms, self.floor_ms = cap_ms, floor_ms
+        self.alpha, self.margin = alpha, margin
+        self.backoff, self.jitter, self.seed = backoff, jitter, seed
+        self._ewma: Optional[float] = None
+        self._current = float(cap_ms)
+        self._obs = 0
+
+    def current_ms(self) -> int:
+        """The deadline to use for the next timeout-governed round."""
+        return max(1, int(round(self._current)))
+
+    @property
+    def ewma_ms(self) -> Optional[float]:
+        return self._ewma
+
+    def observe(self, latency_ms: Optional[float], expired: bool) -> None:
+        """Feed one round outcome: its wall latency when it completed by
+        goAhead (expired=False), or a deadline expiry (expired=True,
+        latency ignored — an expired round's wall time measures the
+        deadline, not the wire)."""
+        from round_tpu.engine.scenarios import mix32_host
+
+        self._obs += 1
+        if expired:
+            target = self._current * self.backoff
+        else:
+            if latency_ms is None:
+                return
+            self._ewma = (latency_ms if self._ewma is None else
+                          self.alpha * latency_ms
+                          + (1.0 - self.alpha) * self._ewma)
+            target = self.margin * self._ewma
+        if self.jitter > 0:
+            u = mix32_host(self._obs * 0x9E3779B9 + self.seed)
+            frac = ((u & 0xFFFF) / 0xFFFF * 2.0 - 1.0) * self.jitter
+            target *= 1.0 + frac
+        self._current = min(max(target, float(self.floor_ms)),
+                            float(self.cap_ms))
+
+
+def _schedule_value(value_schedule: str, base_value: int, my_id: int,
+                    inst: int) -> int:
+    """The deterministic per-instance proposal schedule of the host loops.
+
+    "mixed" (default, the PerfTest2 shape): (base + id·7 + inst) mod 5 —
+    replicas propose DISTINCT values, so agreement is non-trivial but the
+    decided value is fault-schedule-dependent.  "uniform": (base + inst)
+    mod 5 for every replica — by validity the decision is then invariant
+    under ANY fault schedule, which is what lets the chaos harness diff a
+    faulty run's decision log byte-for-byte against a clean run's."""
+    if value_schedule == "uniform":
+        return (base_value + inst) % 5
+    if value_schedule != "mixed":
+        raise ValueError(
+            f"value_schedule must be 'mixed' or 'uniform', "
+            f"got {value_schedule!r}")
+    return (base_value + my_id * 7 + inst) % 5
 
 
 def _try_send_decision(transport, replied: Dict[Tuple[int, int], float],
@@ -126,10 +227,19 @@ class MuxEndpoint:
     def recv(self, timeout_ms: int):
         try:
             if timeout_ms <= 0:
-                return self._q.get_nowait()
-            return self._q.get(timeout=timeout_ms / 1000.0)
+                got = self._q.get_nowait()
+            else:
+                got = self._q.get(timeout=timeout_ms / 1000.0)
         except _queue.Empty:
             return None
+        if got is _ROUTER_DOWN:
+            # re-arm for any later recv on this endpoint, then surface the
+            # router failure instead of starving into None decisions
+            self._q.put(_ROUTER_DOWN)
+            raise RuntimeError(
+                "InstanceMux router thread died"
+            ) from self._mux.failure
+        return got
 
     @property
     def dropped(self):
@@ -169,6 +279,9 @@ class InstanceMux:
         self._decisions: Dict[int, Optional[np.ndarray]] = {}
         self._replied: Dict[Tuple[int, int], float] = {}
         self._stop = False
+        # set when the router thread dies on an unexpected exception; every
+        # endpoint raises and run_instance_loop_pipelined re-raises
+        self.failure: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -184,6 +297,10 @@ class InstanceMux:
             # buckets long before the stash is actually full
             self._stash_order = collections.deque(
                 x for x in self._stash_order if x != iid)
+            if self.failure is not None:
+                # the router is already dead: a fresh endpoint must fail
+                # fast, not wait out its whole run on an unserviced queue
+                q.put(_ROUTER_DOWN)
         return MuxEndpoint(self, iid)
 
     def complete(self, instance_id: int,
@@ -198,6 +315,19 @@ class InstanceMux:
         self._thread.join(timeout=5)
 
     def _loop(self) -> None:
+        try:
+            self._loop_body()
+        except BaseException as e:  # noqa: BLE001 — a dying router thread
+            # must not be silent: record the failure and wake every
+            # endpoint so in-flight instances raise instead of starving
+            # into timeout-by-timeout None decisions (ADVICE.md round-5)
+            self.failure = e
+            log.error("InstanceMux router thread died: %r", e)
+            with self._lock:
+                for q in self._queues.values():
+                    q.put(_ROUTER_DOWN)
+
+    def _loop_body(self) -> None:
         while not self._stop:
             got = self.transport.recv(50)
             if got is None:
@@ -244,6 +374,8 @@ def run_instance_loop_pipelined(
     max_rounds: int = 32,
     stats_out: Optional[Dict[str, int]] = None,
     nbr_byzantine: int = 0,
+    value_schedule: str = "mixed",
+    adaptive: Optional["AdaptiveTimeout"] = None,
 ) -> List[Optional[int]]:
     """The PerfTest2 loop with `rate` instances IN FLIGHT (the reference's
     `-rt` rate + InstanceDispatcher shape): a sliding window of concurrent
@@ -266,9 +398,9 @@ def run_instance_loop_pipelined(
             runner = HostRunner(
                 algo, my_id, peers, ep, instance_id=inst,
                 timeout_ms=timeout_ms, seed=seed + inst,
-                nbr_byzantine=nbr_byzantine,
+                nbr_byzantine=nbr_byzantine, adaptive=adaptive,
             )
-            value = (base_value + my_id * 7 + inst) % 5
+            value = _schedule_value(value_schedule, base_value, my_id, inst)
             res = runner.run({"initial_value": np.int32(value)},
                              max_rounds=max_rounds)
             d = int(np.asarray(res.decision)) if res.decided else None
@@ -281,6 +413,8 @@ def run_instance_loop_pipelined(
                                  ("rounds_run", res.rounds_run),
                                  ("malformed", res.malformed_messages)):
                         stats_out[k] = stats_out.get(k, 0) + v
+                    stats_out.setdefault("timeout_trajectory", []).extend(
+                        res.timeout_trajectory)
         except BaseException as e:  # noqa: BLE001 — a worker-thread error
             # must FAIL the run like the sequential path's would, not
             # silently become a None decision; complete() so peer
@@ -304,6 +438,12 @@ def run_instance_loop_pipelined(
             t.join()
     finally:
         mux.close()
+    if mux.failure is not None:
+        # the router thread died: every None in `decisions` is starvation,
+        # not a protocol outcome — fail the run (ADVICE.md round-5)
+        raise RuntimeError(
+            "InstanceMux router thread died mid-run"
+        ) from mux.failure
     if errors:
         inst, err = errors[0]
         raise RuntimeError(
@@ -327,6 +467,9 @@ def run_instance_loop(
     send_when_catching_up: bool = True,
     delay_first_send_ms: int = -1,
     nbr_byzantine: int = 0,
+    value_schedule: str = "mixed",
+    adaptive: Optional[AdaptiveTimeout] = None,
+    checkpoint_dir: Optional[str] = None,
 ) -> List[Optional[int]]:
     """The PerfTest2 loop (PerfTest2.scala:19-110): `instances` consecutive
     consensus instances over one transport, with start-skew stashing —
@@ -334,13 +477,46 @@ def run_instance_loop(
     prefilled into that instance's runner (the defaultHandler lazy-join
     role); traffic for completed instances is dropped (TooLate).  Initial
     values follow the deterministic schedule (base_value + id·7 + inst)
-    mod 5, so runs are reproducible across replicas and modes.
+    mod 5 (or the fault-invariant "uniform" schedule — _schedule_value),
+    so runs are reproducible across replicas and modes.
+
+    With `checkpoint_dir`, the decision list is DURABLY checkpointed
+    after every instance (runtime/checkpoint.py atomic npz + manifest +
+    decision-log TSV), and a fresh call over an existing checkpoint
+    RESUMES: restored instances are not re-run, and the first live
+    instance catches up over the wire via the peers' completed-instance
+    FLAG_DECISION replies (the lazy-join/decision-replay machinery).
+    This is the crash-restart story: SIGKILL a replica mid-run, start it
+    again with the same arguments, and its final decision log must be
+    byte-identical to a never-crashed run (tests/test_chaos.py).
 
     Returns the per-instance decision log (None where undecided)."""
     stash: Dict[int, Dict[int, Dict[int, Any]]] = {}
     current = {"inst": 0}
     decisions: List[Optional[int]] = []
     replied: Dict[Tuple[int, int], float] = {}
+    start = 1
+    if checkpoint_dir is not None:
+        from round_tpu.runtime import checkpoint as _ckpt
+
+        if _ckpt.exists(checkpoint_dir):
+            like = np.full(instances, _UNDECIDED, dtype=np.int64)
+            arr, step, meta = _ckpt.restore(checkpoint_dir, like)
+            if (meta.get("kind") != "host-decision-log"
+                    or meta.get("instances") != instances
+                    or not 0 <= int(step) <= instances):
+                raise _ckpt.CheckpointError(
+                    f"checkpoint at {checkpoint_dir} is not a host decision "
+                    f"log for an {instances}-instance run: meta={meta}, "
+                    f"step={step}")
+            arr = np.asarray(arr)
+            decisions = [None if int(v) == _UNDECIDED else int(v)
+                         for v in arr[: int(step)]]
+            start = int(step) + 1
+            log.info("node %d: resumed %d decided instance(s) from %s, "
+                     "continuing at instance %d", my_id,
+                     sum(d is not None for d in decisions),
+                     checkpoint_dir, start)
 
     def foreign(sender, tag, payload):
         if tag.instance <= current["inst"]:
@@ -359,7 +535,7 @@ def run_instance_loop(
         stash.setdefault(tag.instance, {}).setdefault(
             tag.round, {})[sender] = payload
 
-    for inst in range(1, instances + 1):
+    for inst in range(start, instances + 1):
         current["inst"] = inst
         runner = HostRunner(
             algo, my_id, peers, transport, instance_id=inst,
@@ -371,13 +547,17 @@ def run_instance_loop(
             # point is skewING the replica, not slowing every instance)
             delay_first_send_ms=delay_first_send_ms if inst == 1 else -1,
             nbr_byzantine=nbr_byzantine,
+            adaptive=adaptive,
         )
-        value = (base_value + my_id * 7 + inst) % 5
+        value = _schedule_value(value_schedule, base_value, my_id, inst)
         res = runner.run({"initial_value": np.int32(value)},
                          max_rounds=max_rounds)
         decisions.append(
             int(np.asarray(res.decision)) if res.decided else None
         )
+        if checkpoint_dir is not None:
+            _save_decision_checkpoint(checkpoint_dir, decisions, inst,
+                                      instances)
         if stats_out is not None:
             # cumulative diagnostics across instances (timeouts is the
             # throughput one: every entry burned a full round deadline)
@@ -385,7 +565,80 @@ def run_instance_loop(
                          ("rounds_run", res.rounds_run),
                          ("malformed", res.malformed_messages)):
                 stats_out[k] = stats_out.get(k, 0) + v
+            # concatenated per-round deadlines across instances: with an
+            # adaptive estimator this is the convergence trajectory
+            stats_out.setdefault("timeout_trajectory", []).extend(
+                res.timeout_trajectory)
     return decisions
+
+
+def serve_decisions(transport, decisions: List[Optional[int]],
+                    idle_ms: int = 4000, contact_idle_ms: int = 2000,
+                    max_ms: int = 120_000) -> int:
+    """Linger after a completed instance loop, answering peers' NORMAL
+    traffic with FLAG_DECISION replies (the trySendDecision machinery)
+    until the wire has been idle for `idle_ms` (hard cap `max_ms`).
+
+    The recovery protocol NEEDS this when replicas are short-lived CLI
+    processes: a crash-restarted replica catches up from its peers'
+    decision replies, but the reference's processes are long-running
+    services — ours exit when their own loop ends, and a replica whose
+    restart latency exceeds the peers' remaining run time finds nobody
+    left to answer (observed as a starved None on the last instance in
+    the chaos soak).  Two-phase idle clock: the full `idle_ms` window
+    only has to cover the laggard's silent RESTART latency; once the
+    laggard is seen working its FINAL instance (it retransmits every
+    round and adopts the reply within one), the re-armed window shrinks
+    to `contact_idle_ms` so a finished laggard releases this replica
+    quickly.  Earlier-instance traffic does NOT shrink the window —
+    stale pre-crash packets drained at linger start must not collapse
+    the restart window.  Returns the number of replies sent."""
+    replied: Dict[Tuple[int, int], float] = {}
+    served = 0
+    t_end = _time.monotonic() + max_ms / 1000.0
+    window = idle_ms / 1000.0
+    deadline = _time.monotonic() + window
+    while _time.monotonic() < min(deadline, t_end):
+        got = transport.recv(100)
+        if got is None:
+            continue
+        sender, tag, _raw = got
+        if (tag.flag == FLAG_NORMAL and 1 <= tag.instance <= len(decisions)
+                and decisions[tag.instance - 1] is not None):
+            _try_send_decision(transport, replied, sender, tag.instance,
+                               decisions[tag.instance - 1])
+            served += 1
+            if tag.instance == len(decisions):
+                window = min(window, contact_idle_ms / 1000.0)
+            deadline = _time.monotonic() + window
+    return served
+
+
+# undecided sentinel in checkpointed decision arrays (decisions are small
+# non-negative protocol values; the sentinel is unreachable)
+_UNDECIDED = -(1 << 62)
+
+
+def _save_decision_checkpoint(checkpoint_dir: str,
+                              decisions: List[Optional[int]],
+                              step: int, instances: int) -> None:
+    """Durably record the decision list after an instance completes:
+    atomic npz (fixed [instances] int64, _UNDECIDED where undecided) +
+    manifest + the canonical decision-log TSV (runtime/decisions.py) —
+    a SIGKILL between instances loses at most the in-flight instance,
+    which the restarted loop re-runs/recovers over the wire."""
+    from round_tpu.runtime import checkpoint as _ckpt
+    from round_tpu.runtime.decisions import DecisionLog
+
+    arr = np.full(instances, _UNDECIDED, dtype=np.int64)
+    for k, d in enumerate(decisions):
+        if d is not None:
+            arr[k] = d
+    _ckpt.save(
+        checkpoint_dir, arr, step=step,
+        meta={"kind": "host-decision-log", "instances": instances},
+        decisions=DecisionLog.from_values(decisions),
+    )
 
 
 class HostRunner:
@@ -413,6 +666,7 @@ class HostRunner:
         send_when_catching_up: bool = True,
         delay_first_send_ms: int = -1,
         nbr_byzantine: int = 0,
+        adaptive: Optional[AdaptiveTimeout] = None,
     ):
         self.algo = algo
         self.id = my_id
@@ -421,6 +675,12 @@ class HostRunner:
         self.instance_id = instance_id & 0xFFFF
         self.timeout_ms = timeout_ms
         self.wait_cap_ms = wait_cap_ms
+        # adaptive round deadline (EWMA + backoff, see AdaptiveTimeout):
+        # replaces the fixed timeout_ms for every round that DELEGATES its
+        # Progress to the runner (the RuntimeOptions role); rounds that
+        # declare their own Progress.timeout keep it — the algorithm knows
+        # better than the estimator
+        self.adaptive = adaptive
         # catch-up send policy (RuntimeOptions.scala:31-37 +
         # InstanceHandler.scala:169-177): when a round is entered during
         # catch-up (a peer was observed ahead of it), sending its messages
@@ -449,6 +709,8 @@ class HostRunner:
         self.foreign = foreign
         self.malformed = 0
         self.timeouts = 0   # rounds ended by deadline expiry (diagnostics)
+        self._trajectory: List[int] = []   # per-round deadline used (ms)
+        self._delegated_timeout = False    # set by _round_progress
         for pid, (host, port) in peers.items():
             if pid != my_id:
                 transport.add_peer(pid, host, port)
@@ -563,10 +825,17 @@ class HostRunner:
     def _round_progress(self, rnd) -> Progress:
         """The round's declared Progress policy; a round that keeps the
         Round-class default delegates to the runner's configured timeout
-        (the RuntimeOptions role)."""
+        (the RuntimeOptions role) — fixed `timeout_ms`, or the live
+        AdaptiveTimeout estimate when one is configured.  Sets
+        `_delegated_timeout` so the run loop knows whether this round's
+        outcome should feed the estimator."""
         p = rnd.init_progress
         if p is Round.init_progress:
+            self._delegated_timeout = True
+            if self.adaptive is not None:
+                return Progress.timeout(self.adaptive.current_ms())
             return Progress.timeout(self.timeout_ms)
+        self._delegated_timeout = False
         return p
 
     def run(self, io: Any, max_rounds: int = 64) -> HostResult:
@@ -626,6 +895,10 @@ class HostRunner:
                              else self.wait_cap_ms) / 1000.0
             expected = rnd.expected_nbr_messages(self._ctx(r), state)
             timedout = False
+            # deadline_expired ⊂ timedout: the catch-up fast-forward break
+            # also flags timedout but is round SKEW, not wire latency — only
+            # a true expiry may back the adaptive estimator off
+            deadline_expired = False
 
             def go_ahead() -> bool:
                 if f_go is not None:
@@ -736,6 +1009,7 @@ class HostRunner:
                 left_ms = int((deadline - _time.monotonic()) * 1000)
                 if left_ms <= 0:
                     timedout = True
+                    deadline_expired = True
                     self.timeouts += 1
                     if not use_deadline:
                         log.warning(
@@ -775,6 +1049,17 @@ class HostRunner:
                     if oob_decided:
                         break
 
+            if use_deadline:
+                self._trajectory.append(int(prog.timeout_millis))
+            if self.adaptive is not None and self._delegated_timeout:
+                if deadline_expired:
+                    self.adaptive.observe(None, expired=True)
+                elif not timedout:
+                    # goAhead/oob completion: the round's wall time IS the
+                    # wire latency sample (skew fast-forwards teach nothing)
+                    self.adaptive.observe(
+                        (_time.monotonic() - t0) * 1000.0, expired=False)
+
             # -- update ---------------------------------------------------
             if oob_decided:
                 exited = True
@@ -798,6 +1083,7 @@ class HostRunner:
             dropped_messages=self.transport.dropped,
             malformed_messages=self.malformed,
             timeouts=self.timeouts,
+            timeout_trajectory=list(self._trajectory),
         )
 
     def _mailbox(self, inbox: Dict[int, Any], like: Any) -> Mailbox:

@@ -12,6 +12,11 @@ The same `Message` objects that flow over the in-process `LocalBus`
 surface (send/deliver) over sockets, so a `PoolNode` — decision replay,
 lazy join, recovery — works across real processes too.  The lockstep
 round-execution path on top of this lives in runtime/host.py.
+
+Fault injection does NOT live here: wrap a HostTransport in
+runtime/chaos.py's `FaultyTransport` (same send/recv surface) for
+deterministic seed-driven drop/duplicate/reorder/delay/corruption
+schedules — the host-path analogue of engine/scenarios.py.
 """
 
 from __future__ import annotations
@@ -178,6 +183,8 @@ class HostTransport:
         self.closed = False  # set once recv observes the stopped node
 
     def add_peer(self, peer_id: int, host: str, port: int) -> None:
+        if not self._node:
+            return  # closed: nothing to register on
         self._lib.rt_node_add_peer(
             self._node, peer_id, host.encode(), port
         )
@@ -185,6 +192,9 @@ class HostTransport:
     def send(self, to: int, tag: Tag, payload: bytes = b"") -> bool:
         """False when the peer is unreachable (reconnect is retried on the
         next send, TcpRuntime.scala:162-211 semantics)."""
+        if not self._node:
+            return False  # closed: a racing late send must not deref the
+            # freed native node (crash-restart teardown hardening)
         rc = self._lib.rt_node_send(
             self._node, to, tag.pack() & 0xFFFFFFFFFFFFFFFF, payload,
             len(payload),
@@ -192,6 +202,8 @@ class HostTransport:
         return rc == 0
 
     def recv(self, timeout_ms: int) -> Optional[Tuple[int, Tag, bytes]]:
+        if not self._node:
+            return None  # closed (see send)
         from_id = ctypes.c_int()
         tagw = ctypes.c_uint64()
         n = self._lib.rt_node_recv(
@@ -215,6 +227,8 @@ class HostTransport:
 
     @property
     def dropped(self) -> int:
+        if not self._node:
+            return 0  # closed (see send)
         return int(self._lib.rt_node_dropped(self._node))
 
     def stop(self) -> None:

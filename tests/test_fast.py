@@ -401,6 +401,34 @@ def test_otr_loop_i8_dot_parity():
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+def test_i8_cpu_placement_guard(monkeypatch):
+    """The XLA-CPU int8 GEMM miscompile guard (ADVICE.md round-5): in an
+    accelerator-backend process, dot='i8' work explicitly PLACED on CPU
+    (jax_default_device = a cpu Device) must refuse at the entry points —
+    _count_dot's trace-time backend switch would trace int8 operands that
+    then miscompile on XLA-CPU.  The blessed modes stay silent: a
+    CPU-backend process (this test env), or accelerator placement."""
+    from round_tpu.ops import fused
+
+    # blessed mode 1: CPU-backend process — no-op regardless of placement
+    assert jax.default_backend() == "cpu"
+    fused.guard_cpu_i8_placement("i8")
+
+    # simulate the unsupported mode: accelerator process (faked backend)
+    # + explicit CPU placement (a REAL cpu Device in jax_default_device)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    try:
+        with pytest.raises(RuntimeError, match="int8 GEMM miscompile"):
+            fused.guard_cpu_i8_placement("i8")
+        fused.guard_cpu_i8_placement("bf16")  # non-i8 dots are unaffected
+    finally:
+        jax.config.update("jax_default_device", None)
+
+    # blessed mode 2: accelerator process without CPU placement
+    fused.guard_cpu_i8_placement("i8")
+
+
 def test_otr_loop_flat_variant_parity():
     """The "flat" loop-kernel variant (the Mosaic-conservative r3 body the
     bench degrades to if the v2 lowering fails on hardware) is

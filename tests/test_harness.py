@@ -163,6 +163,123 @@ def test_checkpoint_rejects_reordered_treedef(tmp_path):
         restore(path, {"b": jnp.zeros(3), "z": jnp.ones(3)})
 
 
+def test_checkpoint_corruption_raises_clean_errors(tmp_path):
+    """Every corruption mode must raise CheckpointError (a ValueError) —
+    never unpickle garbage, restore swapped fields, or surface a raw
+    BadZipFile/KeyError from numpy internals."""
+    from round_tpu.runtime.checkpoint import CheckpointError
+
+    state = {"x": jnp.arange(8), "y": jnp.ones(3)}
+
+    def fresh(name):
+        path = str(tmp_path / name)
+        save(path, state, step=3)
+        return path
+
+    # truncated state.npz (a torn write the atomic rename is meant to
+    # prevent — but a disk that lies must still fail cleanly)
+    path = fresh("truncated")
+    npz = os.path.join(path, "state.npz")
+    with open(npz, "r+b") as fh:
+        fh.truncate(os.path.getsize(npz) // 2)
+    with pytest.raises(CheckpointError, match="corrupt or truncated"):
+        restore(path, state)
+
+    # missing manifest = no checkpoint, not a FileNotFoundError leak
+    path = fresh("nomanifest")
+    os.remove(os.path.join(path, "manifest.json"))
+    with pytest.raises(CheckpointError, match="no checkpoint manifest"):
+        restore(path, state)
+
+    # garbled manifest JSON
+    path = fresh("badjson")
+    with open(os.path.join(path, "manifest.json"), "w") as fh:
+        fh.write("{not json")
+    with pytest.raises(CheckpointError, match="unreadable"):
+        restore(path, state)
+
+    # leaf-count mismatch
+    path = fresh("leafcount")
+    with pytest.raises(CheckpointError, match="leaves"):
+        restore(path, {"x": jnp.arange(8)})
+
+    # state.npz replaced by non-npz bytes
+    path = fresh("notazip")
+    with open(os.path.join(path, "state.npz"), "wb") as fh:
+        fh.write(b"\x00" * 64)
+    with pytest.raises(CheckpointError, match="corrupt or truncated"):
+        restore(path, state)
+
+
+def test_checkpoint_torn_save_restores_consistent_pair(tmp_path):
+    """A SIGKILL landing BETWEEN save()'s state.npz and manifest.json
+    renames leaves a stale manifest next to newer state.  restore() must
+    return the newer consistent (state, step) pair via the npz-embedded
+    manifest — pairing the old step watermark with the new state would
+    make an SMR restore re-apply already-applied instances."""
+    import shutil
+
+    from round_tpu.runtime.checkpoint import CheckpointError
+
+    path = str(tmp_path / "torn")
+    save(path, {"x": jnp.arange(4)}, step=1, meta={"gen": 1})
+    stale = str(tmp_path / "stale-manifest.json")
+    shutil.copy(os.path.join(path, "manifest.json"), stale)
+    save(path, {"x": jnp.arange(4) + 100}, step=2, meta={"gen": 2})
+    # simulate the crash window: new state.npz, previous manifest.json
+    shutil.copy(stale, os.path.join(path, "manifest.json"))
+
+    state, step, meta = restore(path, {"x": jnp.zeros(4)})
+    assert step == 2 and meta == {"gen": 2}
+    np.testing.assert_array_equal(np.asarray(state["x"]),
+                                  np.arange(4) + 100)
+    # a MISSING manifest is still a hard error (exists() keys off it):
+    # the embedded copy is a consistency tiebreaker, not a replacement
+    os.remove(os.path.join(path, "manifest.json"))
+    with pytest.raises(CheckpointError, match="no checkpoint manifest"):
+        restore(path, {"x": jnp.zeros(4)})
+
+
+def test_checkpoint_decision_log_sidecar(tmp_path):
+    """save(..., decisions=) persists the TSV atomically;
+    restore_decisions round-trips it and refuses a log-less checkpoint."""
+    from round_tpu.runtime.checkpoint import (
+        CheckpointError, restore_decisions,
+    )
+
+    state = {"x": jnp.arange(4)}
+    bare = str(tmp_path / "bare")
+    save(bare, state, step=1)
+    with pytest.raises(CheckpointError, match="no decision log"):
+        restore_decisions(bare)
+
+    log = DecisionLog()
+    log.record(1, 0, 5)
+    log.record(2, 1, 6)
+    path = str(tmp_path / "withlog")
+    save(path, state, step=2, decisions=log)
+    got = restore_decisions(path)
+    assert got.get(1) == (0, 5) and got.get(2) == (1, 6)
+    assert got.digest() == log.digest()
+
+
+def test_decision_log_values_tsv_canonical_form(tmp_path):
+    """The chaos-diff artifact: instance\\tvalue bytes WITHOUT the
+    schedule-dependent round column, undecided instances absent, digest
+    stable over the byte form."""
+    log = DecisionLog.from_values([4, None, 7])  # instance 2 undecided
+    assert log.values_tsv() == b"1\t4\n3\t7\n"
+    path = str(tmp_path / "d.tsv")
+    log.dump_values_tsv(path)
+    with open(path, "rb") as fh:
+        assert fh.read() == log.values_tsv()
+    # same values recorded in a different round order → same bytes
+    other = DecisionLog()
+    other.record(3, 9, 7)
+    other.record(1, 2, 4)
+    assert other.digest() == log.digest()
+
+
 # ---------------------------------------------------------------------------
 # Apps
 # ---------------------------------------------------------------------------

@@ -1406,3 +1406,38 @@ def test_host_replica_xml_conf_deployment():
         assert len({l["decision"] for l in logs}) == 1
     finally:
         os.unlink(conf)
+
+
+def test_host_replica_cli_overrides_conf_boolean_both_ways():
+    """ADVICE.md round-5: a --conf file that sets the store_false
+    no-send-when-catching-up param must be overridable back to the
+    default from the CLI — the paired --send-when-catching-up flag.
+    Without it, boolean config params were one-way doors."""
+    import os
+    import tempfile
+
+    port = _free_ports(1)[0]
+    xml = ("<config>\n"
+           f'  <replica address="127.0.0.1" port="{port}"/>\n'
+           '  <param name="no-send-when-catching-up" value="true"/>\n'
+           "</config>\n")
+    with tempfile.NamedTemporaryFile("w", suffix=".xml", delete=False) as f:
+        f.write(xml)
+        conf = f.name
+
+    def run(extra):
+        p = subprocess.run(
+            [sys.executable, "-m", "round_tpu.apps.host_replica",
+             "--id", "0", "--conf", conf, "--timeout-ms", "100", *extra],
+            capture_output=True, text=True, timeout=180)
+        assert p.returncode == 0, p.stderr[-2000:]
+        return json.loads(p.stdout.strip().splitlines()[-1])
+
+    try:
+        # the file's store_false param applies...
+        assert run([])["send_when_catching_up"] is False
+        # ...and the CLI can re-enable it (last-wins precedence)
+        assert run(["--send-when-catching-up"])["send_when_catching_up"] \
+            is True
+    finally:
+        os.unlink(conf)

@@ -175,6 +175,35 @@ def test_smr_snapshot_install():
     assert int(b.apply_decided()) == sum(range(1, 9))
 
 
+def test_smr_checkpoint_restart_matches_never_crashed_twin(tmp_path):
+    """Durable SMR crash-restart: checkpoint a replica, rebuild a fresh
+    one from disk — applied state, log hash, and next_instance all match
+    the never-crashed twin; a payload/batch-size mismatch refuses to
+    restore instead of replaying garbage."""
+    from round_tpu.runtime.checkpoint import (
+        CheckpointError, restore_decisions,
+    )
+
+    a = _make_rsm()
+    a.propose(list(range(1, 13)))  # 3 batches
+    a.run(jax.random.PRNGKey(0))
+    a.apply_decided()
+    path = str(tmp_path / "smr")
+    a.checkpoint(path)
+
+    b = _make_rsm()
+    assert b.restore_checkpoint(path) == a.applied_upto
+    assert int(b.apply_decided()) == sum(range(1, 13))
+    assert b.next_instance == a.next_instance
+    assert b.log_gaps() == []
+    # the sidecar decision log is the diffable log-hash artifact
+    assert len(restore_decisions(path)) == 3
+
+    wrong = _make_rsm(batch=8)
+    with pytest.raises(CheckpointError, match="not an SMR checkpoint"):
+        wrong.restore_checkpoint(path)
+
+
 def test_smr_byzantine_decides_through_primary_failure():
     """Byzantine SMR through a PRIMARY FAILURE (the round-5 verdict's
     acceptance test): the consensus engine under the SMR is

@@ -423,6 +423,47 @@ def check_epsilon(rng, it):
     return cfg
 
 
+def check_host_chaos(rng, it):
+    """The host-chaos rotation rung: a real 3-process cluster under a
+    seeded wire-fault schedule (runtime/chaos.py FaultyTransport: the
+    host-path analogue of the HO families every other rung exercises in
+    the engines) plus ONE forced SIGKILL + checkpoint-restart, decision
+    logs diffed byte-for-byte against a clean run of the same workload.
+    ~25-40 s per iteration (two clusters incl. subprocess startup); the
+    rotation runs it once per cycle, like the scale rung."""
+    import tempfile
+
+    from round_tpu.runtime.chaos import run_chaos_cluster
+
+    seed = int(rng.integers(0, 2**31))
+    drop = float(rng.choice([0.1, 0.2]))
+    reorder = float(rng.choice([0.0, 0.15]))
+    dup = float(rng.choice([0.0, 0.05]))
+    chaos = f"drop={drop},reorder={reorder},dup={dup},seed={seed}"
+    crash = int(rng.integers(0, 3))
+    instances = 5
+    cfg = dict(kind="host-chaos", chaos=chaos, crash_replica=crash,
+               instances=instances, it=it)
+    with tempfile.TemporaryDirectory() as d:
+        clean = run_chaos_cluster(
+            os.path.join(d, "clean"), n=3, instances=instances)
+        fault = run_chaos_cluster(
+            os.path.join(d, "chaos"), n=3, instances=instances,
+            chaos=chaos, crash_replica=crash, crash_after=2)
+    cfg["restarts"] = fault["restarts"]
+    want = clean["log_bytes"][0]
+    for i in range(3):
+        if clean["log_bytes"][i] != want:
+            return {**cfg, "fail": f"clean run disagrees: replica {i}"}
+        if fault["log_bytes"][i] != want:
+            return {**cfg, "fail": f"chaos decision log diverged from "
+                                   f"clean run: replica {i}"}
+    decided = want.count(b"\n")
+    if decided != instances:
+        return {**cfg, "fail": f"clean run decided {decided}/{instances}"}
+    return cfg
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=60.0)
@@ -435,7 +476,8 @@ def main():
     log({"step": "soak-start", "seed": args.seed, "minutes": args.minutes})
     rotation = [check_otr_family, check_otr_family, check_epsilon,
                 check_lattice, check_tpc_kset, check_erb,
-                lambda r, i: check_otr_family(r, i, scale=True)]
+                lambda r, i: check_otr_family(r, i, scale=True),
+                check_host_chaos]
     while time.monotonic() < t_end:
         check = rotation[it % len(rotation)]
         t0 = time.perf_counter()
