@@ -669,14 +669,21 @@ def worker_main(args):
             and engine_fallback is None and not args.no_ab):
         other = "i8" if args.dot == "bf16" else "bf16"
         try:
-            bench2 = make_fused_bench(S, engine="loop", dot=other)
+            # the A/B runs the SAME kernel variant the flagship measured
+            # (bench_variant; only ever "v2" here since a fallback skips
+            # the A/B) and threads it into mxu_stats — a hardcoded "v2"
+            # would apply the family-split MFU discount to a flat kernel
+            # that always runs the full matmul (ADVICE r5 #2)
+            bench2 = make_fused_bench(S, engine="loop", dot=other,
+                                      variant=bench_variant)
             jax.device_get(bench2(key))  # compile + warmup
             best2, _ = time_best(bench2, max(1, min(args.repeats, 2)))
             ab_extra = {"dot": other, "ab_of": args.dot, "n": args.n,
-                        "scenarios": S, "engine": "loop", "sb": args.sb}
+                        "scenarios": S, "engine": "loop", "sb": args.sb,
+                        "variant": bench_variant}
             ab_extra.update(mxu_stats(
                 args.n, args.values, S, total_rounds, best2, other,
-                args.workload, device_kind, "v2"))
+                args.workload, device_kind, bench_variant))
             print(json.dumps({
                 "metric": f"{flagship_metric_name(args)}_dot_{other}",
                 "value": round(total_rounds / best2, 3),

@@ -26,6 +26,7 @@ Layout (mirrors SURVEY.md §2's component inventory):
   spec/      the specification DSL (forall/exists/filter -> masked reductions)
   parallel/  device-mesh sharding of scenario and process axes
   runtime/   instances, config, stats, checkpointing, decision logs
+  obs/       round-level event tracing + the unified metrics registry
   verification/  formula AST + VC generation + SMT-LIB bridge (offline)
 """
 

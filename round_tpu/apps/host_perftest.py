@@ -173,10 +173,15 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
 
 
 def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,
-                      proto="tcp", adaptive_cap_ms=0):
+                      proto="tcp", adaptive_cap_ms=0, trace=None,
+                      metrics_json=None):
     """One OS PROCESS per replica (the reference's exact shape: 4 JVMs on
     localhost) via the host_replica CLI's --instances loop: no shared GIL,
-    true parallel replicas.  Returns the same result dict as measure()."""
+    true parallel replicas.  Returns the same result dict as measure().
+
+    ``trace``/``metrics_json`` name per-replica artifact prefixes: replica
+    i writes ``<trace>.<i>`` / ``<metrics_json>.<i>`` (one OS process
+    each owns its own tracer/registry); merge with tools/trace_view.py."""
     import subprocess
 
     ports = alloc_ports(n)
@@ -199,10 +204,19 @@ def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,
     if adaptive_cap_ms > 0:
         base_argv += ["--adaptive-timeout",
                       "--timeout-cap-ms", str(adaptive_cap_ms)]
+
+    def extra_argv(i):
+        a = []
+        if trace:
+            a += ["--trace", f"{trace}.{i}"]
+        if metrics_json:
+            a += ["--metrics-json", f"{metrics_json}.{i}"]
+        return a
+
     procs = [
         subprocess.Popen(
             [sys.executable, "-m", "round_tpu.apps.host_replica",
-             "--id", str(i), *base_argv],
+             "--id", str(i), *base_argv, *extra_argv(i)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env,
         )
@@ -280,6 +294,16 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout-cap-ms", type=int, default=2000,
                     help="adaptive-timeout backoff cap / initial deadline "
                          "(with --adaptive-timeout)")
+    ap.add_argument("--trace", type=str, default=None, metavar="FILE",
+                    help="record the round-level event trace "
+                         "(round_tpu/obs/trace.py) — one JSONL file in "
+                         "thread mode, FILE.<id> per replica in "
+                         "--processes mode; merge with "
+                         "tools/trace_view.py")
+    ap.add_argument("--metrics-json", type=str, default=None, metavar="FILE",
+                    help="write the unified metrics snapshot "
+                         "(round_tpu/obs/metrics.py) as JSON — FILE.<id> "
+                         "per replica in --processes mode")
     args = ap.parse_args(argv)
     cap = args.timeout_cap_ms if args.adaptive_timeout else 0
     if args.processes:
@@ -289,14 +313,27 @@ def main(argv=None) -> int:
         result, _logs = measure_processes(
             n=args.n, instances=args.instances, algo=args.algo,
             timeout_ms=args.timeout_ms, proto=args.proto,
-            adaptive_cap_ms=cap,
+            adaptive_cap_ms=cap, trace=args.trace,
+            metrics_json=args.metrics_json,
         )
     else:
+        if args.trace:
+            # thread mode: every replica shares the process tracer; events
+            # carry their emitter's node id, so one file merges cleanly
+            from round_tpu.obs.trace import TRACE
+
+            TRACE.enable()
         result, _logs = measure(
             n=args.n, instances=args.instances, algo=args.algo,
             timeout_ms=args.timeout_ms, proto=args.proto, rate=args.rate,
             adaptive_cap_ms=cap,
         )
+        if args.trace:
+            TRACE.dump_jsonl(args.trace)
+        if args.metrics_json:
+            from round_tpu.obs.metrics import METRICS
+
+            METRICS.dump_json(args.metrics_json)
     print(json.dumps(result))
     return 0
 

@@ -110,6 +110,18 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout-floor-ms", type=int, default=10,
                     help="adaptive-timeout lower bound (ignored without "
                          "--adaptive-timeout)")
+    ap.add_argument("--trace", type=str, default=None, metavar="FILE",
+                    help="record the round-level event trace "
+                         "(round start/end, sends/recvs, timeouts, "
+                         "adaptive-deadline moves, chaos faults, "
+                         "decisions — round_tpu/obs/trace.py) and dump "
+                         "it as JSONL at exit; merge replicas with "
+                         "tools/trace_view.py")
+    ap.add_argument("--metrics-json", type=str, default=None, metavar="FILE",
+                    help="write the unified metrics registry snapshot "
+                         "(round_tpu/obs/metrics.py: host.*/wire.*/"
+                         "chaos.*/ckpt.* counters and histograms) as "
+                         "JSON at exit")
     ap.add_argument("--linger-ms", type=int, default=0, metavar="MS",
                     help="after the loop completes, keep answering peers' "
                          "traffic with decision replies until the wire is "
@@ -155,6 +167,22 @@ def main(argv=None) -> int:
             print(f"warning: ignoring config params not used by "
                   f"host_replica: {unknown}", file=sys.stderr)
     configure_from_args(args)
+
+    if args.trace or args.metrics_json:
+        # dumped via atexit, not inline: both branches below and the
+        # linger path share one exit point, and a failed run still leaves
+        # whatever trace was recorded (SIGKILL loses it — that is the
+        # crash model; the restarted replica records its own)
+        import atexit
+
+        from round_tpu.obs.metrics import METRICS
+        from round_tpu.obs.trace import TRACE
+
+        if args.trace:
+            TRACE.enable(node=args.id)
+            atexit.register(lambda: TRACE.dump_jsonl(args.trace))
+        if args.metrics_json:
+            atexit.register(lambda: METRICS.dump_json(args.metrics_json))
 
     import numpy as np
 

@@ -21,6 +21,8 @@ import jax.numpy as jnp
 from round_tpu.apps.selector import select
 from round_tpu.engine import scenarios
 from round_tpu.models.common import consensus_io
+from round_tpu.obs.metrics import METRICS
+from round_tpu.obs.trace import TRACE
 from round_tpu.runtime.config import Options, parse_args
 from round_tpu.runtime.decisions import DecisionLog
 from round_tpu.runtime.instances import InstancePool
@@ -42,6 +44,34 @@ def run(
     log = DecisionLog()
     key = jax.random.PRNGKey(opts.seed)
 
+    if TRACE.enabled or stats.enabled:
+        # per-round HO-mask statistics of a schedule the run ACTUALLY
+        # executes (the shared reducer of engine.fast.mix_ho_stats):
+        # instance iid runs its sampler under the key the pool derives —
+        # fold_in(window_key, iid) with window_key = fold_in(key, last
+        # submitted iid) — so the diagnostic is computed for instance 0's
+        # executed schedule, not the base key no instance ever uses
+        from round_tpu.engine.fast import sampler_ho_stats
+
+        first_window_end = min(opts.rate, n_instances) - 1
+        k_inst0 = jax.random.fold_in(
+            jax.random.fold_in(key, first_window_end),
+            jnp.uint32(0))
+        # run_phases hands the sampler split(instance_key)[0] (the
+        # round-invariant ho_key discipline, engine/executor.py)
+        k_ho = jax.random.split(k_inst0)[0]
+        st = sampler_ho_stats(sampler, k_ho, opts.max_phases)
+        METRICS.gauge("engine.ho_density_mean").set(
+            float(st["density"].mean()))
+        METRICS.gauge("engine.ho_heard_min").set(
+            float(st["heard_min"].min()))
+        if TRACE.enabled:
+            TRACE.emit("ho_stats", rounds=opts.max_phases,
+                       density=[round(float(d), 4) for d in st["density"]],
+                       heard_mean=[round(float(h), 2)
+                                   for h in st["heard_mean"]],
+                       heard_min=[int(h) for h in st["heard_min"]])
+
     t0 = time.monotonic()
     for iid in range(n_instances):
         io = consensus_io(jnp.arange(opts.n, dtype=jnp.int32) % 5)
@@ -55,7 +85,13 @@ def run(
                         rnd = int(res.decided_round[res.decided.argmax()])
                         ok = log.record(res.instance_id, rnd, int(res.value))
                         assert ok, f"agreement violation at {res.instance_id}"
+                        if TRACE.enabled:
+                            TRACE.emit("decision", inst=res.instance_id,
+                                       round=rnd, decided=True,
+                                       value=int(res.value))
     wall = time.monotonic() - t0
+    METRICS.gauge("engine.decisions_per_sec").set(
+        len(log) / wall if wall > 0 else 0.0)
     if opts.log_file:
         log.dump_tsv(opts.log_file)
     return {
@@ -74,13 +110,31 @@ def main(argv=None) -> dict:
     extra.add_argument("--instances", type=int, default=64)
     extra.add_argument("--p-drop", type=float, default=0.05)
     extra.add_argument("--platform", type=str, default=None)
+    extra.add_argument("--trace", type=str, default=None, metavar="FILE",
+                       help="dump the engine-side event trace (decisions, "
+                            "per-round HO-mask stats) as JSONL at exit")
+    extra.add_argument("--metrics-json", type=str, default=None,
+                       metavar="FILE",
+                       help="write the unified metrics snapshot (engine "
+                            "compile/run timers, perftest counters) as "
+                            "JSON at exit")
     ns, rest = extra.parse_known_args(argv)
     if ns.platform:
         jax.config.update("jax_platforms", ns.platform)
     opts = parse_args(rest)
     if opts.stats:
         stats.enable()
+    elif ns.metrics_json:
+        # --metrics-json implies collection (no atexit report): the
+        # perftest.* timers are stats-gated
+        stats.enable(report_at_exit=False)
+    if ns.trace:
+        TRACE.enable()
     out = run(opts, n_instances=ns.instances, p_drop=ns.p_drop)
+    if ns.trace:
+        TRACE.dump_jsonl(ns.trace)
+    if ns.metrics_json:
+        METRICS.dump_json(ns.metrics_json)
     print(out)
     return out
 

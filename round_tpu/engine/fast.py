@@ -32,6 +32,7 @@ import jax.numpy as jnp
 
 from round_tpu.engine import scenarios
 from round_tpu.models.common import ghost_decide
+from round_tpu.obs.metrics import METRICS
 from round_tpu.ops import fused
 from round_tpu.utils.tree import tree_where
 
@@ -493,6 +494,58 @@ def mix_ho(mix: FaultMix, r) -> jnp.ndarray:
     per-scenario replay (scenarios.from_fault_params)."""
     colmask, side_r, p8, salt0, salt1r = round_params(mix, r)
     return fused.ho_link_mask(colmask, side_r, salt0, salt1r, p8)
+
+
+def _ho_round_stats(get_ho: Callable, max_rounds: int) -> dict:
+    """THE per-round HO-mask reducer both stat surfaces share (a mix and
+    a plain sampler must not drift apart): `get_ho(r)` returns the round-r
+    mask with receiver rows on the last-but-one axis and senders last."""
+    import numpy as np
+
+    def one(r):
+        ho = get_ho(r)
+        heard = ho.sum(axis=-1)  # per-receiver mailbox size
+        return (jnp.mean(ho), jnp.mean(heard),
+                jnp.min(heard).astype(jnp.int32))
+
+    def scan_all():
+        rs = jnp.arange(max_rounds, dtype=jnp.int32)
+        return jax.lax.map(one, rs)
+
+    density, heard_mean, heard_min = jax.jit(scan_all)()
+    return {
+        "density": np.asarray(density),
+        "heard_mean": np.asarray(heard_mean),
+        "heard_min": np.asarray(heard_min),
+    }
+
+
+def mix_ho_stats(mix: FaultMix, max_rounds: int) -> dict:
+    """Per-round statistics of the HO masks the fused path derives from
+    `mix` (hash mode — the bit-exact link formula, mix_ho): the
+    observability view of "who heard whom in round r" aggregated over the
+    scenario batch, without materializing the [T, S, n, n] mask tensor on
+    the host.
+
+    Returns numpy arrays, one entry per round:
+      density     [T] float — delivered-link fraction over all S·n·n links;
+      heard_mean  [T] float — mean mailbox size per receiver;
+      heard_min   [T] int32 — smallest mailbox any receiver saw (the
+                  quorum-risk diagnostic: a round whose min dips under the
+                  algorithm's quorum is where decisions stall).
+
+    hw-PRNG runs have no replayable mask, so the stats always describe
+    the hash-mode schedule of the same mix.  ``sampler_ho_stats`` is the
+    same reducer over a plain HO sampler — that is the form
+    apps/perftest.py banks behind --trace / --metrics-json."""
+    return _ho_round_stats(lambda r: mix_ho(mix, r), max_rounds)
+
+
+def sampler_ho_stats(sampler: Callable, key, max_rounds: int) -> dict:
+    """mix_ho_stats for a plain HO sampler ((key, r) -> [n, n] bool, the
+    engine/scenarios.py families): same per-round density / heard_mean /
+    heard_min dict, same shared reducer."""
+    return _ho_round_stats(lambda r: sampler(key, r), max_rounds)
 
 
 class LatticeHist(HistRound):
@@ -1144,6 +1197,10 @@ def run_hist(
     # eager (not trace-cached) check: CPU execution of the i8 path
     # requires a CPU-backend process (fused.guard_cpu_i8_placement)
     fused.guard_cpu_i8_placement(dot)
+    # counted at Python entry: under jit this is a trace/compile event,
+    # eager mode counts every call (the observability surface for "how
+    # often does this engine get built/run in-process")
+    METRICS.counter("engine.hist_runs").inc()
     S, n = mix.crashed.shape
     V = rnd.num_values
 
@@ -1198,6 +1255,7 @@ def run_otr_loop(
     (concrete arrays; under jit the precondition is the caller's)."""
     from round_tpu.models.otr import OtrState
 
+    METRICS.counter("engine.loop_runs").inc()  # see run_hist's counter note
     if not isinstance(state0.decided, jax.core.Tracer) and (
         bool(jnp.any(state0.decided))
         or bool(jnp.any(state0.after != rnd.after_decision))

@@ -1,0 +1,33 @@
+"""Observability: round-level structured tracing + unified typed metrics.
+
+The HO model makes "who heard whom in round r" the fundamental unit of
+execution (PAPERS.md: reducing asynchrony to synchronized rounds), so the
+debugging abstraction is round-granular too:
+
+* ``obs.trace`` — a low-overhead structured event tracer (ring buffer,
+  JSONL export, strictly zero-cost when disabled) emitting typed events:
+  round start/end, messages heard, send/recv at the transport, timeout
+  fired + AdaptiveTimeout adjustment, checkpoint save/restore, chaos
+  fault injection, decision.  ``tools/trace_view.py`` merges multi-replica
+  traces by (instance, round) and cross-references chaos faults against
+  the timeouts they caused.
+
+* ``obs.metrics`` — a typed registry (counter / gauge / histogram with
+  fixed buckets) with JSON and Prometheus-text snapshots.  The legacy
+  ``runtime.stats`` counters/timers surface (the reference's
+  utils/Stats.scala + --stat shutdown report) is implemented on top of
+  it, so there is exactly one counters/timers surface in the tree.
+
+Event schema and metric names are documented in docs/OBSERVABILITY.md.
+"""
+
+from round_tpu.obs.metrics import (  # noqa: F401
+    METRICS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    Stats,
+    stats,
+)
+from round_tpu.obs.trace import TRACE, Tracer, load_jsonl  # noqa: F401

@@ -55,6 +55,8 @@ from round_tpu.engine.scenarios import (
     host_link_u32,
     mix32_host,
 )
+from round_tpu.obs.metrics import METRICS
+from round_tpu.obs.trace import TRACE
 from round_tpu.runtime.oob import FLAG_NORMAL
 
 # Stream constants: each fault family draws an independent Bernoulli from
@@ -173,8 +175,18 @@ class FaultyTransport:
         return mix32_host(node * LINK_GOLD + self._salt0
                           + _PARTITION_SALT) & 1
 
-    def _count(self, family: str) -> None:
+    def _count(self, family: str, src: int, dst: int, r: int,
+               inst: int) -> None:
+        """Record one injected fault: the per-transport `injected` dict
+        (assertions), the unified chaos.* counter, and — when tracing —
+        a typed `fault` event carrying the (src, dst, round, instance)
+        coordinates tools/trace_view.py correlates against the timeouts
+        and catch-ups the fault caused downstream."""
         self.injected[family] = self.injected.get(family, 0) + 1
+        METRICS.counter(f"chaos.{family}").inc()
+        if TRACE.enabled:
+            TRACE.emit("fault", node=self.inner.id, family=family,
+                       src=src, dst=dst, round=r, inst=inst)
 
     # -- HostTransport surface ---------------------------------------------
 
@@ -213,29 +225,29 @@ class FaultyTransport:
         plan, src = self.plan, self.inner.id
         if tag.flag != FLAG_NORMAL:
             return self.inner.send(to, tag, payload)
-        r = tag.round
+        r, inst = tag.round, tag.instance
         if 0 <= plan.crash_round <= r:
-            self._count("crash_mute")
+            self._count("crash_mute", src, to, r, inst)
             return True  # swallowed: the crashed sender is silent
         if r < plan.heal_round and self._side(src) != self._side(to):
-            self._count("partition")
+            self._count("partition", src, to, r, inst)
             return True
         if self._event(STREAM_DROP, src, to, r, plan.drop):
-            self._count("drop")
+            self._count("drop", src, to, r, inst)
             return True  # silent loss, UDP-style
         if payload and self._event(STREAM_TRUNCATE, src, to, r,
                                    plan.truncate):
             u = self._u32(STREAM_TRUNCATE, src, to, r)
             payload = payload[: (u >> 8) % len(payload)]
-            self._count("truncate")
+            self._count("truncate", src, to, r, inst)
         if self._event(STREAM_GARBAGE, src, to, r, plan.garbage):
             u = self._u32(STREAM_GARBAGE, src, to, r)
             payload = (u.to_bytes(4, "big") * (1 + (u >> 8) % 16))
-            self._count("garbage")
+            self._count("garbage", src, to, r, inst)
         ok = self.inner.send(to, tag, payload)
         if self._event(STREAM_DUP, src, to, r, plan.dup):
             self.inner.send(to, tag, payload)
-            self._count("dup")
+            self._count("dup", src, to, r, inst)
         return ok
 
     def _maybe_hold(self, got):
@@ -247,10 +259,10 @@ class FaultyTransport:
         hold_ms = 0
         if self._event(STREAM_DELAY, sender, dst, r, plan.delay):
             hold_ms += plan.delay_ms
-            self._count("delay")
+            self._count("delay", sender, dst, r, tag.instance)
         if self._event(STREAM_REORDER, sender, dst, r, plan.reorder):
             hold_ms += plan.reorder_hold_ms
-            self._count("reorder")
+            self._count("reorder", sender, dst, r, tag.instance)
         if hold_ms <= 0:
             return got
         heapq.heappush(
@@ -343,6 +355,7 @@ def run_chaos_cluster(
     proto: str = "tcp",
     join_timeout: float = 150.0,
     linger_ms: int = 8000,
+    trace: bool = False,
 ):
     """Run an n-process host cluster to completion, optionally under a
     chaos spec and one forced crash-restart.
@@ -356,6 +369,12 @@ def run_chaos_cluster(
     whose peers all exit before its interpreter even comes back up has
     nobody left to serve the decision replies catch-up depends on
     (host.serve_decisions).
+
+    With ``trace``, every replica records a round-level event trace and a
+    metrics snapshot (apps/host_replica.py --trace / --metrics-json into
+    ``workdir/trace-<i>.jsonl`` / ``workdir/metrics-<i>.json``); the
+    returned dict then also carries ``trace_files`` / ``metrics_files``
+    for tools/trace_view.py to merge and correlate.
 
     Returns a dict with per-replica ``decisions`` (from the summary JSON
     line), ``log_bytes`` (the byte-exact instance→value decision-log TSV
@@ -378,6 +397,10 @@ def run_chaos_cluster(
              "--checkpoint-dir", os.path.join(workdir, f"ckpt-{i}")]
         if chaos:
             a += ["--chaos", chaos]
+        if trace:
+            a += ["--trace", os.path.join(workdir, f"trace-{i}.jsonl"),
+                  "--metrics-json", os.path.join(workdir,
+                                                 f"metrics-{i}.json")]
         if adaptive:
             a += ["--adaptive-timeout"]
         if (crash_replica is not None and i != crash_replica
@@ -425,9 +448,15 @@ def run_chaos_cluster(
     for i in range(n):
         with open(os.path.join(workdir, f"decisions-{i}.tsv"), "rb") as fh:
             log_bytes[i] = fh.read()
-    return {
+    out = {
         "decisions": {i: outs[i].get("decisions") for i in outs},
         "log_bytes": log_bytes,
         "outs": outs,
         "restarts": restarts,
     }
+    if trace:
+        out["trace_files"] = {
+            i: os.path.join(workdir, f"trace-{i}.jsonl") for i in range(n)}
+        out["metrics_files"] = {
+            i: os.path.join(workdir, f"metrics-{i}.json") for i in range(n)}
+    return out

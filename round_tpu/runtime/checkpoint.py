@@ -23,11 +23,14 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 
+from round_tpu.obs.metrics import METRICS
+from round_tpu.obs.trace import TRACE
 from round_tpu.runtime.decisions import DecisionLog
 
 
@@ -35,6 +38,20 @@ class CheckpointError(ValueError):
     """A checkpoint that must not be restored: missing, torn, or written
     for a different state shape.  Subclasses ValueError so pre-existing
     treedef-mismatch handlers keep working."""
+
+
+def _corruption(msg: str) -> CheckpointError:
+    """Build a CheckpointError for a DETECTED corruption, recording it on
+    the observability surface (ckpt.errors counter + ckpt_error trace
+    event).  Detection sites raise through this helper rather than the
+    constructor counting, so re-constructed instances (unpickling across
+    a process boundary, tests building synthetic errors, semantic
+    kind-mismatch raises elsewhere) cannot inflate the corruption metric.
+    """
+    METRICS.counter("ckpt.errors").inc()
+    if TRACE.enabled:
+        TRACE.emit("ckpt_error", error=msg[:200])
+    return CheckpointError(msg)
 
 
 def save(path: str, state: Any, *, step: int = 0,
@@ -47,6 +64,7 @@ def save(path: str, state: Any, *, step: int = 0,
     share ONE rename, so a crash landing between the individual file
     renames below still leaves a restorable, mutually-consistent pair
     (see restore)."""
+    t0 = time.monotonic()
     os.makedirs(path, exist_ok=True)
     leaves, treedef = jax.tree_util.tree_flatten(state)
     arrays = {f"leaf{i}": np.asarray(v) for i, v in enumerate(leaves)}
@@ -73,6 +91,11 @@ def save(path: str, state: Any, *, step: int = 0,
     with open(tmp, "w") as fh:
         json.dump(manifest, fh)
     os.replace(tmp, os.path.join(path, "manifest.json"))
+    METRICS.counter("ckpt.saves").inc()
+    METRICS.histogram("ckpt.save_s").observe(time.monotonic() - t0)
+    if TRACE.enabled:
+        TRACE.emit("ckpt_save", step=int(step), path=path,
+                   n_leaves=len(leaves))
 
 
 def _read_manifest(path: str) -> Dict:
@@ -81,10 +104,12 @@ def _read_manifest(path: str) -> Dict:
         with open(mpath) as fh:
             return json.load(fh)
     except FileNotFoundError:
+        # absence is not corruption: callers probe fresh directories
+        # (exists() races aside) — keep it off the ckpt.errors metric
         raise CheckpointError(f"no checkpoint manifest at {mpath}") from None
     except (OSError, ValueError) as e:
-        raise CheckpointError(f"unreadable checkpoint manifest "
-                              f"{mpath}: {e}") from e
+        raise _corruption(f"unreadable checkpoint manifest "
+                          f"{mpath}: {e}") from e
 
 
 def restore(path: str, like: Any) -> Tuple[Any, int, Dict]:
@@ -116,23 +141,26 @@ def restore(path: str, like: Any) -> Tuple[Any, int, Dict]:
     except Exception as e:  # noqa: BLE001 — BadZipFile, zlib errors,
         # KeyError on missing members, OSError on truncation: every
         # corruption mode surfaces as one clean error class
-        raise CheckpointError(
+        raise _corruption(
             f"corrupt or truncated checkpoint state at {npz}: {e}") from e
     _, treedef = jax.tree_util.tree_flatten(like)
     if treedef.num_leaves != len(leaves):
-        raise CheckpointError(
+        raise _corruption(
             f"checkpoint has {len(leaves)} leaves, template has "
             f"{treedef.num_leaves}"
         )
     # leaf count alone lets a reordered pytree restore with fields swapped;
     # the recorded treedef string must match the template's exactly
     if manifest.get("treedef") is not None and manifest["treedef"] != str(treedef):
-        raise CheckpointError(
+        raise _corruption(
             "checkpoint treedef does not match the restore template:\n"
             f"  saved:    {manifest['treedef']}\n"
             f"  template: {treedef}"
         )
     state = jax.tree_util.tree_unflatten(treedef, leaves)
+    METRICS.counter("ckpt.restores").inc()
+    if TRACE.enabled:
+        TRACE.emit("ckpt_restore", step=int(manifest["step"]), path=path)
     return state, manifest["step"], manifest.get("meta", {})
 
 

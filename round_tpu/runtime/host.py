@@ -61,12 +61,31 @@ import numpy as np
 from round_tpu.core.algorithm import Algorithm
 from round_tpu.core.progress import Progress
 from round_tpu.core.rounds import FoldRound, Round, RoundCtx
+from round_tpu.obs.metrics import METRICS, MS_BUCKETS
+from round_tpu.obs.trace import TRACE
 from round_tpu.ops.mailbox import Mailbox
 from round_tpu.runtime.log import get_logger
 from round_tpu.runtime.oob import FLAG_DECISION, FLAG_NORMAL, Message, Tag
 from round_tpu.runtime.transport import HostTransport, wire_loads
 
 log = get_logger("host")
+
+# unified metrics (obs/metrics.py; names in docs/OBSERVABILITY.md).  The
+# instruments are module-level so the per-event cost is one lock-guarded
+# add — no registry lookup on the hot path.
+_C_ROUNDS = METRICS.counter("host.rounds")
+_C_TIMEOUTS = METRICS.counter("host.timeouts")
+_C_SENDS = METRICS.counter("host.sends")
+_C_RECVS = METRICS.counter("host.recvs")
+_C_MALFORMED = METRICS.counter("host.malformed")
+_C_DECISIONS = METRICS.counter("host.decisions")
+_C_OOB = METRICS.counter("host.oob_decisions")
+_C_REPLIES = METRICS.counter("host.decision_replies")
+_C_CATCHUP = METRICS.counter("host.catch_ups")
+_H_ROUND_MS = METRICS.histogram("host.round_ms", MS_BUCKETS, unit="ms")
+_G_DEADLINE = METRICS.gauge("host.deadline_ms")
+_C_MUX_ROUTED = METRICS.counter("mux.routed")
+_C_MUX_STASHED = METRICS.counter("mux.stashed")
 
 # serializes jit-trio builds so thread-mode replicas sharing an Algorithm
 # compile each round class once (see HostRunner._round_fns)
@@ -194,20 +213,27 @@ def _schedule_value(value_schedule: str, base_value: int, my_id: int,
 
 
 def _try_send_decision(transport, replied: Dict[Tuple[int, int], float],
-                       sender: int, instance: int, decision) -> None:
+                       sender: int, instance: int, decision) -> bool:
     """THE TooLate / trySendDecision reply (PerfTest.scala:40-60), shared
     by the sequential loop's foreign sink and the pipelined mux: answer a
     completed instance's late traffic with its decision, rate-limited per
     (sender, instance) — the reply itself can drop on UDP, so the
-    laggard's next retransmission re-arms it."""
+    laggard's next retransmission re-arms it.  True iff a reply actually
+    went out (rate-limited/undecided calls return False, so reply
+    accounting counts wire sends, not answerable packets)."""
     if decision is None:
-        return
+        return False
     now = _time.monotonic()
     if now - replied.get((sender, instance), -1.0) <= 0.25:
-        return
+        return False
     replied[(sender, instance)] = now
     transport.send(sender, Tag(instance=instance, flag=FLAG_DECISION),
                    pickle.dumps(np.asarray(decision)))
+    _C_REPLIES.inc()
+    if TRACE.enabled:
+        TRACE.emit("decision_reply", node=getattr(transport, "id", None),
+                   inst=instance, dst=sender)
+    return True
 
 
 class MuxEndpoint:
@@ -323,6 +349,11 @@ class InstanceMux:
             # into timeout-by-timeout None decisions (ADVICE.md round-5)
             self.failure = e
             log.error("InstanceMux router thread died: %r", e)
+            METRICS.counter("mux.router_deaths").inc()
+            if TRACE.enabled:
+                TRACE.emit("mux_router_died",
+                           node=getattr(self.transport, "id", None),
+                           error=repr(e))
             with self._lock:
                 for q in self._queues.values():
                     q.put(_ROUTER_DOWN)
@@ -343,6 +374,7 @@ class InstanceMux:
                 q = self._queues.get(iid)
                 if q is not None:
                     q.put(got)
+                    _C_MUX_ROUTED.inc()
                 elif iid in self._decisions:
                     if tag.flag == FLAG_NORMAL:
                         reply_with = self._decisions[iid]
@@ -356,6 +388,7 @@ class InstanceMux:
                                 del self._stash[old]
                     self._stash.setdefault(iid, []).append(got)
                     self._stash_order.append(iid)
+                    _C_MUX_STASHED.inc()
             if reply_with is not None:
                 _try_send_decision(self.transport, self._replied,
                                    sender, iid, reply_with)
@@ -605,9 +638,9 @@ def serve_decisions(transport, decisions: List[Optional[int]],
         sender, tag, _raw = got
         if (tag.flag == FLAG_NORMAL and 1 <= tag.instance <= len(decisions)
                 and decisions[tag.instance - 1] is not None):
-            _try_send_decision(transport, replied, sender, tag.instance,
-                               decisions[tag.instance - 1])
-            served += 1
+            if _try_send_decision(transport, replied, sender, tag.instance,
+                                  decisions[tag.instance - 1]):
+                served += 1
             if tag.instance == len(decisions):
                 window = min(window, contact_idle_ms / 1000.0)
             deadline = _time.monotonic() + window
@@ -731,6 +764,7 @@ class HostRunner:
             return True, wire_loads(raw)
         except Exception as e:  # noqa: BLE001 — any garbage must be survivable
             self.malformed += 1
+            _C_MALFORMED.inc()
             log.debug("node %d: dropping malformed payload (%d bytes): %s",
                       self.id, len(raw), e)
             return False, None
@@ -855,6 +889,9 @@ class HostRunner:
             _time.sleep(self.delay_first_send_ms / 1000.0)
         while r < max_rounds and not exited:
             rnd = rounds[r % len(rounds)]
+            if TRACE.enabled:
+                TRACE.emit("round_start", node=self.id,
+                           inst=self.instance_id, round=r)
             rr, sid = np.int32(r), np.int32(self.id)
             seed = np.uint32(self.seed)
             f_send, f_update, f_go = self._round_fns(rnd, state)
@@ -870,12 +907,20 @@ class HostRunner:
             sending = self.send_when_catching_up or next_round <= r
             if sending:
                 wire = pickle.dumps(payload_np)
+                sent = 0
                 for d in range(self.n):
                     if d == self.id or not dest[d]:
                         continue
                     self.transport.send(
                         d, Tag(instance=self.instance_id, round=r), wire
                     )
+                    sent += 1
+                    if TRACE.enabled:
+                        TRACE.emit("send", node=self.id,
+                                   inst=self.instance_id, round=r, dst=d,
+                                   bytes=len(wire))
+                if sent:
+                    _C_SENDS.inc(sent)
             else:
                 self.suppressed_sends += 1
 
@@ -893,6 +938,8 @@ class HostRunner:
             t0 = _time.monotonic()
             deadline = t0 + (prog.timeout_millis if use_deadline
                              else self.wait_cap_ms) / 1000.0
+            if use_deadline:
+                _G_DEADLINE.set(prog.timeout_millis)
             expected = rnd.expected_nbr_messages(self._ctx(r), state)
             timedout = False
             # deadline_expired ⊂ timedout: the catch-up fast-forward break
@@ -926,6 +973,7 @@ class HostRunner:
                     # out-of-range id would corrupt every downstream
                     # sender-indexed structure (stash, mailbox stacking)
                     self.malformed += 1
+                    _C_MALFORMED.inc()
                     return False
                 if tag.instance != self.instance_id or tag.flag != FLAG_NORMAL:
                     if (tag.flag == FLAG_DECISION
@@ -940,6 +988,11 @@ class HostRunner:
                         if adopted is not None:
                             state = adopted
                             oob_decided = True
+                            _C_OOB.inc()
+                            if TRACE.enabled:
+                                TRACE.emit("recv_decision", node=self.id,
+                                           inst=self.instance_id, round=r,
+                                           src=sender)
                     elif tag.flag == FLAG_NORMAL and self.foreign is not None:
                         ok, p = self._loads(raw)
                         if ok:
@@ -957,6 +1010,10 @@ class HostRunner:
                     return False  # late: the round is communication-closed
                 ok, payload = self._loads(raw)
                 if not ok:
+                    if TRACE.enabled:
+                        TRACE.emit("malformed", node=self.id,
+                                   inst=self.instance_id, round=tag.round,
+                                   src=sender)
                     return False
                 if extend_deadline and not use_deadline:
                     # the wait cap is an IDLE cap: any same-instance
@@ -979,6 +1036,10 @@ class HostRunner:
                     return False  # post-quorum same-round: same fate as
                     # arriving next round under the default policy (late)
                 inbox[sender] = payload
+                _C_RECVS.inc()
+                if TRACE.enabled:
+                    TRACE.emit("recv", node=self.id, inst=self.instance_id,
+                               round=r, src=sender)
                 return True
 
             dirty = True  # inbox changed since the last go probe
@@ -1005,12 +1066,27 @@ class HostRunner:
                     # 1-round-behind replica self-heals within one round
                     # timeout anyway.
                     timedout = True
+                    _C_CATCHUP.inc()
+                    if TRACE.enabled:
+                        TRACE.emit("catch_up", node=self.id,
+                                   inst=self.instance_id, round=r,
+                                   next_round=int(next_round))
                     break
                 left_ms = int((deadline - _time.monotonic()) * 1000)
                 if left_ms <= 0:
                     timedout = True
                     deadline_expired = True
                     self.timeouts += 1
+                    _C_TIMEOUTS.inc()
+                    if TRACE.enabled:
+                        TRACE.emit(
+                            "timeout", node=self.id, inst=self.instance_id,
+                            round=r,
+                            deadline_ms=(int(prog.timeout_millis)
+                                         if use_deadline
+                                         else self.wait_cap_ms),
+                            kind="deadline" if use_deadline else "wait_cap",
+                            heard=len(inbox))
                     if not use_deadline:
                         log.warning(
                             "node %d round %d: %s was idle for "
@@ -1052,13 +1128,24 @@ class HostRunner:
             if use_deadline:
                 self._trajectory.append(int(prog.timeout_millis))
             if self.adaptive is not None and self._delegated_timeout:
+                adapted = False
                 if deadline_expired:
                     self.adaptive.observe(None, expired=True)
+                    adapted = True
                 elif not timedout:
                     # goAhead/oob completion: the round's wall time IS the
                     # wire latency sample (skew fast-forwards teach nothing)
                     self.adaptive.observe(
                         (_time.monotonic() - t0) * 1000.0, expired=False)
+                    adapted = True
+                if adapted and TRACE.enabled:
+                    ew = self.adaptive.ewma_ms
+                    TRACE.emit("adaptive", node=self.id,
+                               inst=self.instance_id, round=r,
+                               expired=deadline_expired,
+                               deadline_ms=self.adaptive.current_ms(),
+                               ewma_ms=None if ew is None
+                               else round(ew, 3))
 
             # -- update ---------------------------------------------------
             if oob_decided:
@@ -1069,6 +1156,17 @@ class HostRunner:
                     rr, sid, seed, state, mbox.values, mbox.mask,
                 )
                 exited = bool(np.asarray(exit_flag))
+            _C_ROUNDS.inc()
+            wall_ms = (_time.monotonic() - t0) * 1000.0
+            _H_ROUND_MS.observe(wall_ms)
+            if TRACE.enabled:
+                # ho = the senders heard this round — the HO set of the
+                # model, which is what trace_view merges across replicas
+                TRACE.emit("round_end", node=self.id, inst=self.instance_id,
+                           round=r, heard=len(inbox), n=self.n,
+                           ho=sorted(int(s) for s in inbox),
+                           timedout=timedout, exited=exited,
+                           oob=oob_decided, wall_ms=round(wall_ms, 3))
             log.debug("node %d round %d: heard %d/%d%s%s", self.id, r,
                       len(inbox), self.n, " TO" if timedout else "",
                       " exit" if exited else "")
@@ -1078,6 +1176,12 @@ class HostRunner:
 
         decided = bool(np.asarray(algo.decided(state)))
         decision = np.asarray(algo.decision(state))
+        if decided:
+            _C_DECISIONS.inc()
+        if TRACE.enabled:
+            TRACE.emit("decision", node=self.id, inst=self.instance_id,
+                       round=r, decided=decided,
+                       value=decision.tolist() if decided else None)
         return HostResult(
             state=state, decided=decided, decision=decision, rounds_run=r,
             dropped_messages=self.transport.dropped,
@@ -1115,6 +1219,7 @@ class HostRunner:
                     slot[sender] = arr.astype(slot.dtype, casting="same_kind")
             except Exception as e:  # noqa: BLE001 — garbage must not kill us
                 self.malformed += 1
+                _C_MALFORMED.inc()
                 mask[sender] = False
                 log.debug("node %d: dropping structurally-malformed payload "
                           "from %d: %s", self.id, sender, e)

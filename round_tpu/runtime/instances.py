@@ -22,6 +22,7 @@ import numpy as np
 
 from round_tpu.core.algorithm import Algorithm
 from round_tpu.engine.executor import run_instance
+from round_tpu.obs.metrics import METRICS
 
 MAX_INSTANCE = 1 << 16
 
@@ -65,6 +66,10 @@ class InstancePool:
         self._running: set = set()
         self.decision_log: Dict[int, InstanceResult] = {}
         self._batched_run = jax.jit(jax.vmap(self._one, in_axes=(0, 0)))
+        # io-batch signatures already jit-compiled: the compile-vs-run
+        # timer split below (a fresh signature's first call is dominated
+        # by trace+compile; later calls are pure execution)
+        self._warm_shapes: set = set()
 
     def _one(self, io, key):
         res = run_instance(
@@ -112,9 +117,20 @@ class InstancePool:
         keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
             jnp.asarray(ids, dtype=jnp.uint32)
         )
-        decided, decision, dec_round = jax.tree_util.tree_map(
-            np.asarray, self._batched_run(ios, keys)
-        )
+        # engine compile-vs-run observability (docs/OBSERVABILITY.md): a
+        # batch signature's first call lands in engine.compile (trace +
+        # compile + first run), warm signatures in engine.run — the
+        # np.asarray below forces completion so the timer measures the
+        # whole computation, not the dispatch
+        sig = tuple((jnp.shape(l), str(jnp.result_type(l)))
+                    for l in jax.tree_util.tree_leaves(ios))
+        timer = "engine.run" if sig in self._warm_shapes else "engine.compile"
+        with METRICS.timer(timer):
+            decided, decision, dec_round = jax.tree_util.tree_map(
+                np.asarray, self._batched_run(ios, keys)
+            )
+        self._warm_shapes.add(sig)
+        METRICS.counter("engine.instances").inc(len(ids))
         out = []
         for b, iid in enumerate(ids):
             first = int(np.argmax(decided[b])) if decided[b].any() else -1

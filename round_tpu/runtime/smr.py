@@ -240,6 +240,13 @@ class ReplicatedStateMachine:
                          "batch_size": self.batch_size,
                          "next_instance": self.next_instance},
                    decisions=dlog)
+        from round_tpu.obs.metrics import METRICS
+        from round_tpu.obs.trace import TRACE
+
+        METRICS.counter("smr.checkpoints").inc()
+        if TRACE.enabled:
+            TRACE.emit("smr_ckpt_save", step=self._applied.upto,
+                       instances=len(insts), batches=len(idxs), path=path)
 
     def restore_checkpoint(self, path: str) -> int:
         """Rebuild the SMR view from a `checkpoint` directory.  Returns
@@ -282,6 +289,13 @@ class ReplicatedStateMachine:
             jax.tree_util.tree_map(jnp.asarray, state["sm"]),
         )
         self.next_instance = int(meta["next_instance"])
+        from round_tpu.obs.metrics import METRICS
+        from round_tpu.obs.trace import TRACE
+
+        METRICS.counter("smr.restores").inc()
+        if TRACE.enabled:
+            TRACE.emit("smr_ckpt_restore", step=int(step),
+                       instances=len(self.decided_batches), path=path)
         return int(step)
 
     def apply_decided(self) -> Any:

@@ -1,73 +1,16 @@
-"""Named counters and phase timers with a shutdown report.
+"""Named counters and phase timers with a shutdown report (legacy shim).
 
 Reference parity: psync.utils.Stats (utils/Stats.scala:7-98) + the --stat
-shutdown-hook report (utils/Options.scala:16-25).  The reference uses these
-to profile the CL reducer phases (logic/CL.scala:199-261); here they wrap
-both the verifier pipeline and the engine (compile vs run time).
+shutdown-hook report (utils/Options.scala:16-25).
+
+The implementation moved to ``round_tpu.obs.metrics``: ``Stats`` is now a
+facade over the typed metrics registry (counter / gauge / histogram with
+JSON + Prometheus snapshots), so the verifier pipeline, the engines and
+the host runtime share exactly ONE counters/timers surface.  This module
+re-exports the same names — the API and the --stat report format are
+unchanged.
 """
 
 from __future__ import annotations
 
-import atexit
-import threading
-import time
-from contextlib import contextmanager
-from typing import Dict, Iterator, Tuple
-
-
-class Stats:
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counters: Dict[str, int] = {}
-        self._timers: Dict[str, Tuple[int, float]] = {}  # name -> (calls, total_s)
-        self.enabled = False
-
-    def counter(self, name: str, delta: int = 1) -> None:
-        if not self.enabled:
-            return
-        with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + delta
-
-    @contextmanager
-    def timer(self, name: str) -> Iterator[None]:
-        if not self.enabled:
-            yield
-            return
-        t0 = time.monotonic()
-        try:
-            yield
-        finally:
-            dt = time.monotonic() - t0
-            with self._lock:
-                calls, total = self._timers.get(name, (0, 0.0))
-                self._timers[name] = (calls + 1, total + dt)
-
-    def report(self) -> str:
-        with self._lock:
-            lines = ["# stats"]
-            for name in sorted(self._counters):
-                lines.append(f"counter {name}: {self._counters[name]}")
-            for name in sorted(self._timers):
-                calls, total = self._timers[name]
-                lines.append(
-                    f"timer {name}: {total:.3f}s over {calls} calls "
-                    f"({1000 * total / max(calls, 1):.2f} ms/call)"
-                )
-        return "\n".join(lines)
-
-    def reset(self) -> None:
-        with self._lock:
-            self._counters.clear()
-            self._timers.clear()
-
-    def enable(self, report_at_exit: bool = True) -> None:
-        """--stat: start collecting; print the report at interpreter exit
-        (the reference's shutdown hook, utils/Options.scala:16-25)."""
-        self.enabled = True
-        if report_at_exit and not getattr(self, "_hooked", False):
-            atexit.register(lambda: print(self.report()))
-            self._hooked = True
-
-
-# module-level singleton, like the reference's Stats object
-stats = Stats()
+from round_tpu.obs.metrics import METRICS, Stats, stats  # noqa: F401

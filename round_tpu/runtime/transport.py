@@ -28,7 +28,16 @@ import subprocess
 import threading
 from typing import Dict, Optional, Tuple
 
+from round_tpu.obs.metrics import METRICS
 from round_tpu.runtime.oob import Message, Tag
+
+# wire-level instruments (one lock-guarded add per message on a path that
+# is already a syscall): the transport's own view of traffic, below the
+# runner's semantic host.sends/host.recvs
+_C_WIRE_SENT = METRICS.counter("wire.sent_msgs")
+_C_WIRE_SENT_B = METRICS.counter("wire.sent_bytes")
+_C_WIRE_RECV = METRICS.counter("wire.recv_msgs")
+_C_WIRE_RECV_B = METRICS.counter("wire.recv_bytes")
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
 _lib = None
@@ -199,6 +208,9 @@ class HostTransport:
             self._node, to, tag.pack() & 0xFFFFFFFFFFFFFFFF, payload,
             len(payload),
         )
+        if rc == 0:
+            _C_WIRE_SENT.inc()
+            _C_WIRE_SENT_B.inc(len(payload))
         return rc == 0
 
     def recv(self, timeout_ms: int) -> Optional[Tuple[int, Tag, bytes]]:
@@ -222,6 +234,8 @@ class HostTransport:
             self._buf = ctypes.create_string_buffer(len(self._buf) * 4)
             return self.recv(0)
         tag = Tag.unpack(_to_signed64(tagw.value))
+        _C_WIRE_RECV.inc()
+        _C_WIRE_RECV_B.inc(n)
         # string_at copies exactly n bytes (.raw would copy the whole buffer)
         return from_id.value, tag, ctypes.string_at(self._buf, n)
 

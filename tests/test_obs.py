@@ -1,0 +1,553 @@
+"""Observability: round-level tracing + unified metrics (round_tpu/obs/).
+
+The acceptance spine:
+  * the tracer round-trips through JSONL, wraps its ring at capacity, and
+    the disabled path records zero events and allocates nothing;
+  * the metrics registry serves typed counters/gauges/histograms with
+    JSON + Prometheus snapshots, and the legacy runtime.stats surface is
+    a facade over it (same API, same --stat report format);
+  * a real 3-process chaos cluster's merged trace accounts for EVERY
+    injected wire fault, and tools/trace_view.py correlates at least one
+    injected fault to the round-level timeout it caused — the post-mortem
+    PR 1's black-box decision-log diff could not give.
+"""
+
+import gc
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import tracemalloc
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from round_tpu.obs.metrics import METRICS, MetricsRegistry, Stats
+from round_tpu.obs.trace import TRACE, Tracer, load_jsonl
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _trace_view():
+    spec = importlib.util.spec_from_file_location(
+        "trace_view", os.path.join(REPO, "tools", "trace_view.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Tracer
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_jsonl_roundtrip(tmp_path):
+    tr = Tracer(capacity=64, node=3, enabled=True)
+    tr.emit("round_start", inst=1, round=0)
+    tr.emit("round_end", inst=1, round=0, heard=2, ho=[0, 2],
+            timedout=False, wall_ms=1.25)
+    tr.emit("decision", inst=1, round=4, decided=True,
+            value=np.int32(7))  # numpy payloads must serialize
+    path = str(tmp_path / "t.jsonl")
+    assert tr.dump_jsonl(path) == 3
+    back = load_jsonl(path)
+    assert [e["ev"] for e in back] == ["round_start", "round_end", "decision"]
+    # the tracer's default node is stamped onto every event
+    assert all(e["node"] == 3 for e in back)
+    assert back[1]["ho"] == [0, 2] and back[1]["wall_ms"] == 1.25
+    assert back[2]["value"] == 7
+    # timestamps are monotone non-decreasing within one tracer
+    ts = [e["t"] for e in back]
+    assert ts == sorted(ts)
+
+
+def test_tracer_jsonl_tolerates_torn_tail(tmp_path):
+    path = str(tmp_path / "torn.jsonl")
+    with open(path, "w") as fh:
+        fh.write('{"t": 1.0, "ev": "a"}\n{"t": 2.0, "ev"')  # torn mid-write
+    assert [e["ev"] for e in load_jsonl(path)] == ["a"]
+
+
+def test_tracer_ring_wraparound():
+    tr = Tracer(capacity=8, enabled=True)
+    for i in range(20):
+        tr.emit("e", i=i)
+    assert len(tr) == 8
+    # oldest aged out, newest kept, order preserved
+    assert [e["i"] for e in tr.events()] == list(range(12, 20))
+
+
+def test_tracer_disabled_records_nothing_and_allocates_nothing():
+    import round_tpu.obs.trace as trace_mod
+
+    tr = Tracer()
+    assert not tr.enabled
+    # an UNGUARDED emit is still a no-op (just slower than the guard)
+    tr.emit("x", i=1)
+    assert len(tr) == 0
+    # the guarded pattern every hot instrumentation site uses must not
+    # allocate in trace.py at all: the module never executes
+    gc.collect()
+    tracemalloc.start()
+    snap1 = tracemalloc.take_snapshot()
+    for _ in range(256):
+        if tr.enabled:
+            tr.emit("x", i=1)
+    snap2 = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    grown = [s for s in snap2.compare_to(snap1, "filename")
+             if s.traceback[0].filename == trace_mod.__file__
+             and s.size_diff > 0]
+    assert not grown, grown
+    assert len(tr) == 0
+
+
+def test_tracer_explicit_node_wins_over_default():
+    tr = Tracer(node=0, enabled=True)
+    tr.emit("send", node=2, dst=1)
+    tr.emit("send", dst=1)
+    evs = tr.events()
+    assert evs[0]["node"] == 2 and evs[1]["node"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_counter_gauge_histogram_and_snapshot():
+    reg = MetricsRegistry()
+    reg.counter("host.rounds").inc()
+    reg.counter("host.rounds").inc(4)
+    reg.gauge("host.deadline_ms").set(250)
+    h = reg.histogram("host.round_ms", buckets=(1, 10, 100), unit="ms")
+    for v in (0.5, 5, 50, 500):
+        h.observe(v)
+    snap = reg.snapshot()
+    assert snap["counters"]["host.rounds"] == 5
+    assert snap["gauges"]["host.deadline_ms"] == 250.0
+    hs = snap["histograms"]["host.round_ms"]
+    assert hs["count"] == 4 and hs["sum"] == 555.5 and hs["unit"] == "ms"
+    # cumulative le buckets, +Inf last
+    assert hs["buckets"] == [[1.0, 1], [10.0, 2], [100.0, 3], ["+Inf", 4]]
+    # JSON round-trips
+    assert json.loads(reg.to_json()) == snap
+    # compact drops zero counters and never-written gauges — but a gauge
+    # EXPLICITLY set to 0.0 (a zero mailbox floor is the most alarming
+    # reading such a gauge exists for) must survive compaction
+    reg.counter("zero")
+    reg.gauge("never")
+    reg.gauge("floor").set(0.0)
+    compact = reg.snapshot(compact=True)
+    assert "zero" not in compact["counters"]
+    assert "never" not in compact["gauges"]
+    assert compact["gauges"]["floor"] == 0.0
+
+
+def test_metrics_prometheus_text():
+    reg = MetricsRegistry()
+    reg.counter("chaos.drop").inc(3)
+    reg.gauge("engine.ho_density_mean").set(0.75)
+    reg.histogram("ckpt.save_s", buckets=(0.1, 1.0)).observe(0.05)
+    text = reg.to_prometheus()
+    assert "# TYPE round_tpu_chaos_drop counter" in text
+    assert "round_tpu_chaos_drop 3" in text
+    assert "round_tpu_engine_ho_density_mean 0.75" in text
+    assert 'round_tpu_ckpt_save_s_bucket{le="0.1"} 1' in text
+    assert 'round_tpu_ckpt_save_s_bucket{le="+Inf"} 1' in text
+    assert "round_tpu_ckpt_save_s_count 1" in text
+
+
+def test_metrics_type_clash_raises():
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(ValueError):
+        reg.gauge("x")
+    with pytest.raises(ValueError):
+        reg.histogram("x")
+    # a shape clash on an existing histogram is a bug too: seconds
+    # observations must not silently land in millisecond buckets
+    reg.histogram("h", buckets=(1, 10), unit="ms")
+    with pytest.raises(ValueError):
+        reg.histogram("h", buckets=(0.1, 1.0), unit="s")
+    with pytest.raises(ValueError):
+        reg.histogram("h", buckets=(1, 10), unit="s")
+    assert reg.histogram("h", buckets=(1, 10), unit="ms") is not None
+
+
+def test_metrics_reset_keeps_cached_instruments_live():
+    """reset() zeroes in place: instrument objects cached at import time
+    (runtime/host.py's module-level counters) must keep feeding the same
+    registry afterwards — a dict clear would orphan them silently."""
+    reg = MetricsRegistry()
+    c = reg.counter("host.rounds")
+    h = reg.histogram("lat", buckets=(1, 10), unit="ms")
+    g = reg.gauge("deadline")
+    c.inc(5)
+    h.observe(3)
+    g.set(7)
+    reg.reset()
+    snap = reg.snapshot()
+    assert snap["counters"]["host.rounds"] == 0
+    assert snap["histograms"]["lat"]["count"] == 0
+    assert snap["gauges"]["deadline"] == 0.0
+    # the CACHED objects still feed the registry
+    c.inc(2)
+    h.observe(5)
+    assert reg.counter("host.rounds") is c
+    assert reg.snapshot()["counters"]["host.rounds"] == 2
+    assert reg.snapshot()["histograms"]["lat"]["count"] == 1
+
+
+def test_stats_facade_is_registry_backed():
+    """The legacy Stats surface (runtime/stats.py) stores into a
+    MetricsRegistry — one counters/timers surface — while keeping the
+    reference's report format and the opt-in enabled gate."""
+    s = Stats()
+    s.enabled = True
+    s.counter("msgs", 2)
+    with s.timer("phase"):
+        pass
+    snap = s.registry.snapshot()
+    assert snap["counters"]["msgs"] == 2
+    assert snap["histograms"]["phase"]["count"] == 1
+    rep = s.report()
+    assert "counter msgs: 2" in rep and "timer phase:" in rep
+    # the module singleton shares the PROCESS registry
+    from round_tpu.obs.metrics import stats as singleton
+    from round_tpu.runtime.stats import stats as via_shim
+
+    assert singleton is via_shim and singleton.registry is METRICS
+
+
+# ---------------------------------------------------------------------------
+# trace_view: percentiles + fault correlation (synthetic)
+# ---------------------------------------------------------------------------
+
+
+def _ev(ev, **kw):
+    return {"t": kw.pop("t", 0.0), "ev": ev, **kw}
+
+
+def test_trace_view_by_round_groups_on_the_merge_key():
+    tv = _trace_view()
+    events = [
+        _ev("round_start", node=0, inst=1, round=0),
+        _ev("round_end", node=1, inst=1, round=0, wall_ms=1.0),
+        _ev("send", node=0, inst=2, round=0, dst=1),
+        _ev("mux_router_died", node=0),  # no (inst, round): not grouped
+    ]
+    groups = tv.by_round(events)
+    assert set(groups) == {(1, 0), (2, 0)}
+    assert [e["ev"] for e in groups[(1, 0)]] == ["round_start", "round_end"]
+
+
+def test_trace_view_round_latency_percentiles():
+    tv = _trace_view()
+    events = [
+        _ev("round_end", node=n, inst=1, round=0, wall_ms=w, timedout=to)
+        for n, w, to in ((0, 10.0, False), (1, 20.0, False), (2, 250.0, True))
+    ] + [_ev("round_end", node=0, inst=1, round=1, wall_ms=5.0,
+             timedout=False)]
+    lat = tv.round_latencies(events)
+    assert lat[0]["count"] == 3 and lat[0]["timeouts"] == 1
+    assert lat[0]["p50"] == 20.0 and lat[0]["max"] == 250.0
+    assert lat[1] == {"count": 1, "p50": 5.0, "p90": 5.0, "p99": 5.0,
+                      "max": 5.0, "timeouts": 0}
+
+
+def test_trace_view_correlation_classification():
+    tv = _trace_view()
+    events = [
+        # drop whose receiver timed out THAT round -> matched (timeout)
+        _ev("fault", node=0, family="drop", src=0, dst=1, inst=1, round=2),
+        _ev("timeout", node=1, inst=1, round=2, deadline_ms=100,
+            kind="deadline"),
+        _ev("round_end", node=1, inst=1, round=2, timedout=True,
+            wall_ms=100.0),
+        # truncate -> receiver's malformed drop
+        _ev("fault", node=0, family="truncate", src=0, dst=2, inst=1,
+            round=0),
+        _ev("malformed", node=2, inst=1, round=0, src=0),
+        # dup with a clean receiver round -> benign (timing-only family)
+        _ev("fault", node=1, family="dup", src=1, dst=0, inst=1, round=1),
+        _ev("round_end", node=0, inst=1, round=1, timedout=False,
+            wall_ms=1.0),
+        # drop absorbed: the receiver's round completed by goAhead anyway
+        _ev("fault", node=2, family="drop", src=2, dst=0, inst=1, round=1),
+        # drop after the receiver already finished the instance -> benign
+        _ev("decision", node=1, inst=1, round=4, decided=True, value=3),
+        _ev("fault", node=0, family="drop", src=0, dst=1, inst=1, round=5),
+        # receiver left no trace for that instance -> unobserved
+        _ev("fault", node=0, family="drop", src=0, dst=2, inst=3, round=0),
+        # suppressing fault with a seen receiver but no downstream story
+        # -> UNMATCHED (the bucket that flags correlation anomalies)
+        _ev("fault", node=0, family="drop", src=0, dst=1, inst=1, round=3),
+    ]
+    corr = tv.correlate_faults(events)
+    assert len(corr["matched"]) == 2
+    caused = {(f["family"], f["caused"]["ev"]) for f in corr["matched"]}
+    assert caused == {("drop", "timeout"), ("truncate", "malformed")}
+    assert len(corr["benign"]) == 3
+    assert len(corr["unobserved"]) == 1
+    assert len(corr["unmatched"]) == 1
+    assert corr["unmatched"][0]["round"] == 3
+    # classification is deterministic on re-run
+    assert tv.correlate_faults(events)["matched"] == corr["matched"]
+
+
+def test_trace_view_catch_up_and_oob_count_as_downstream():
+    tv = _trace_view()
+    events = [
+        _ev("fault", node=0, family="drop", src=0, dst=1, inst=1, round=2),
+        _ev("catch_up", node=1, inst=1, round=2, next_round=5),
+        _ev("fault", node=0, family="partition", src=0, dst=2, inst=1,
+            round=1),
+        _ev("recv_decision", node=2, inst=1, round=3, src=0),
+    ]
+    corr = tv.correlate_faults(events)
+    assert not corr["unmatched"]
+    caused = {f["caused"]["ev"] for f in corr["matched"]}
+    assert caused == {"catch_up", "recv_decision"}
+
+
+# ---------------------------------------------------------------------------
+# Instrumentation: host runner, checkpoint, engines
+# ---------------------------------------------------------------------------
+
+
+def test_host_runner_emits_round_trace():
+    from round_tpu.apps.selector import select
+    from round_tpu.runtime.chaos import alloc_ports
+    from round_tpu.runtime.host import run_instance_loop
+    from round_tpu.runtime.transport import HostTransport
+
+    port = alloc_ports(1)[0]
+    base_rounds = METRICS.counter("host.rounds").value
+    base_dec = METRICS.counter("host.decisions").value
+    TRACE.clear()
+    TRACE.enable(node=None)
+    try:
+        with HostTransport(0, port) as tr:
+            decisions = run_instance_loop(
+                select("otr"), 0, {0: ("127.0.0.1", port)}, tr, 2,
+                timeout_ms=100, seed=0, max_rounds=8,
+                value_schedule="uniform",
+            )
+    finally:
+        TRACE.disable()
+    evs = TRACE.events()
+    TRACE.clear()
+    assert decisions == [1, 2]
+    kinds = {e["ev"] for e in evs}
+    assert {"round_start", "round_end", "decision"} <= kinds
+    re = next(e for e in evs if e["ev"] == "round_end")
+    # the HO set of a 1-group round is self-delivery only
+    assert re["ho"] == [0] and re["n"] == 1 and re["node"] == 0
+    assert "wall_ms" in re and re["wall_ms"] >= 0
+    decs = [e for e in evs if e["ev"] == "decision"]
+    assert len(decs) == 2 and all(d["decided"] for d in decs)
+    assert {d["value"] for d in decs} == {1, 2}
+    # unified metrics advanced alongside
+    assert METRICS.counter("host.rounds").value > base_rounds
+    assert METRICS.counter("host.decisions").value == base_dec + 2
+
+
+def test_checkpoint_save_restore_events_and_counters(tmp_path):
+    from round_tpu.runtime import checkpoint as ckpt
+
+    base_saves = METRICS.counter("ckpt.saves").value
+    base_restores = METRICS.counter("ckpt.restores").value
+    TRACE.clear()
+    TRACE.enable()
+    try:
+        state = {"a": np.arange(4), "b": np.ones((2, 2))}
+        ckpt.save(str(tmp_path / "c"), state, step=7)
+        got, step, _meta = ckpt.restore(str(tmp_path / "c"), state)
+    finally:
+        TRACE.disable()
+    evs = TRACE.events()
+    TRACE.clear()
+    assert step == 7 and np.array_equal(got["a"], state["a"])
+    kinds = [e["ev"] for e in evs]
+    assert "ckpt_save" in kinds and "ckpt_restore" in kinds
+    save_ev = next(e for e in evs if e["ev"] == "ckpt_save")
+    assert save_ev["step"] == 7 and save_ev["n_leaves"] == 2
+    assert METRICS.counter("ckpt.saves").value == base_saves + 1
+    assert METRICS.counter("ckpt.restores").value == base_restores + 1
+    assert METRICS.histogram("ckpt.save_s").count >= 1
+
+
+def test_checkpoint_corruption_is_counted_construction_is_not(tmp_path):
+    from round_tpu.runtime import checkpoint as ckpt
+
+    base = METRICS.counter("ckpt.errors").value
+    # constructing (or unpickling) the exception is NOT a corruption —
+    # only detection sites may move the metric
+    ckpt.CheckpointError("synthetic")
+    assert METRICS.counter("ckpt.errors").value == base
+    # a genuinely torn state.npz IS
+    d = tmp_path / "c"
+    ckpt.save(str(d), {"a": np.arange(3)}, step=1)
+    with open(d / "state.npz", "wb") as fh:
+        fh.write(b"not a zip")
+    with pytest.raises(ckpt.CheckpointError):
+        ckpt.restore(str(d), {"a": np.arange(3)})
+    assert METRICS.counter("ckpt.errors").value == base + 1
+    # a missing checkpoint (fresh start probe) is absence, not corruption
+    with pytest.raises(ckpt.CheckpointError):
+        ckpt.restore(str(tmp_path / "nope"), {"a": np.arange(3)})
+    assert METRICS.counter("ckpt.errors").value == base + 1
+
+
+def test_instance_pool_records_compile_vs_run_timers():
+    from round_tpu.apps.selector import select
+    from round_tpu.engine import scenarios
+    from round_tpu.models.common import consensus_io
+    from round_tpu.runtime.instances import InstancePool
+
+    h_compile = METRICS.histogram("engine.compile")
+    h_run = METRICS.histogram("engine.run")
+    c0, r0 = h_compile.count, h_run.count
+    pool = InstancePool(select("otr"), 4, scenarios.omission(4, 0.0),
+                        max_phases=4, window=2)
+    io = consensus_io(jnp.arange(4, dtype=jnp.int32) % 3)
+    for i in range(4):
+        pool.submit(i, io)
+    pool.run_all(jax.random.PRNGKey(0))
+    # first window = fresh signature -> engine.compile; second, warm ->
+    # engine.run
+    assert h_compile.count == c0 + 1
+    assert h_run.count == r0 + 1
+    assert METRICS.counter("engine.instances").value >= 4
+
+
+def test_mix_ho_stats_density_and_quorum_floor():
+    from round_tpu.engine import fast
+
+    key = jax.random.PRNGKey(0)
+    clean = fast.mix_ho_stats(fast.fault_free(key, 4, 8), 3)
+    assert clean["density"].shape == (3,)
+    assert np.allclose(clean["density"], 1.0)
+    assert (clean["heard_min"] == 8).all()
+    lossy = fast.fault_free(key, 4, 8).replace(
+        p8=jnp.full((4,), 64, jnp.int32))  # 25% iid drop
+    st = fast.mix_ho_stats(lossy, 5)
+    assert (st["density"] < 1.0).all() and (st["density"] > 0.5).all()
+    assert (st["heard_min"] <= st["heard_mean"]).all()
+    assert (st["heard_min"] >= 1).all()  # self-links always on
+
+
+def test_sampler_ho_stats_shares_the_reducer():
+    """The plain-sampler form (what apps/perftest.py banks) must agree
+    with the mix form on an equivalent schedule: same key, same iid-drop
+    hash — scenarios.omission vs a 1-scenario FaultMix with the salts
+    scenarios._key_salt extracts from the same PRNGKey."""
+    from round_tpu.engine import fast, scenarios
+
+    n, p, rounds = 8, 0.25, 4
+    key = jax.random.PRNGKey(5)
+    via_sampler = fast.sampler_ho_stats(
+        scenarios.omission(n, p, impl="hash"), key, rounds)
+    s0, s1 = scenarios._key_salt(key)
+    mix = fast.fault_free(key, 1, n).replace(
+        p8=jnp.full((1,), max(1, round(p * 256)), jnp.int32),
+        salt0=jnp.asarray(s0, jnp.int32).reshape(1),
+        salt1=jnp.asarray(s1, jnp.int32).reshape(1),
+    )
+    via_mix = fast.mix_ho_stats(mix, rounds)
+    for k in ("density", "heard_mean", "heard_min"):
+        assert np.allclose(via_sampler[k], via_mix[k]), k
+    assert (via_sampler["density"] < 1.0).all()
+
+
+# ---------------------------------------------------------------------------
+# CLI + cluster integration
+# ---------------------------------------------------------------------------
+
+
+def test_host_replica_cli_writes_trace_and_metrics(tmp_path):
+    from round_tpu.runtime.chaos import alloc_ports, cluster_env
+
+    port = alloc_ports(1)[0]
+    trace_f = tmp_path / "t.jsonl"
+    met_f = tmp_path / "m.json"
+    cp = subprocess.run(
+        [sys.executable, "-m", "round_tpu.apps.host_replica",
+         "--id", "0", "--peers", f"127.0.0.1:{port}", "--algo", "otr",
+         "--instances", "2", "--timeout-ms", "100", "--max-rounds", "8",
+         "--value-schedule", "uniform",
+         "--trace", str(trace_f), "--metrics-json", str(met_f)],
+        capture_output=True, text=True, timeout=180, env=cluster_env(),
+    )
+    assert cp.returncode == 0, cp.stderr[-2000:]
+    summary = json.loads(cp.stdout.strip().splitlines()[-1])
+    assert summary["decisions"] == [1, 2]
+    evs = load_jsonl(str(trace_f))
+    decs = [e for e in evs if e["ev"] == "decision"]
+    assert len(decs) == 2 and all(d["node"] == 0 for d in decs)
+    met = json.loads(met_f.read_text())
+    assert met["counters"]["host.decisions"] == 2
+    assert met["counters"]["host.rounds"] >= 2
+    assert met["histograms"]["host.round_ms"]["count"] >= 2
+
+
+def test_chaos_cluster_trace_accounts_for_every_fault(tmp_path):
+    """THE acceptance test: a 3-process cluster under a seeded drop
+    schedule, every replica tracing.  The merged trace must (a) contain
+    every injected fault the FaultyTransports counted, (b) explain each
+    one — matched to the downstream timeout/catch-up/recovery it caused,
+    or provably benign — with the UNMATCHED bucket empty, and (c)
+    correlate at least one injected fault to the round-level timeout it
+    caused (the ISSUE's acceptance criterion)."""
+    from round_tpu.runtime.chaos import run_chaos_cluster
+
+    # seed 1 is chosen so the deterministic (src, dst, round) drop
+    # schedule hits links of ALL THREE replicas in the rounds the run
+    # actually executes (the schedule repeats across instances, so a
+    # seed whose early-round links are clean injects nothing)
+    res = run_chaos_cluster(
+        str(tmp_path), n=3, instances=4, chaos="drop=0.25,seed=1",
+        timeout_ms=200, max_rounds=32, trace=True,
+    )
+    # deciders agree under the drop schedule (uniform values); a laggard's
+    # final instance may occasionally starve into None once its peers
+    # exit (no --linger-ms without a crash replica) — full byte-identical
+    # log agreement is test_chaos.py's claim, not this test's
+    logs = [res["outs"][i]["decisions"] for i in range(3)]
+    for inst in range(4):
+        vals = {log[inst] for log in logs if log[inst] is not None}
+        assert len(vals) <= 1, (inst, logs)
+    assert any(v is not None for log in logs for v in log)
+
+    tv = _trace_view()
+    paths = [res["trace_files"][i] for i in range(3)]
+    events = tv.load_traces(paths)
+    faults = [e for e in events if e.get("ev") == "fault"]
+    injected = sum(sum(o.get("chaos_injected", {}).values())
+                   for o in res["outs"].values())
+    assert injected > 0, "seeded 25% drop schedule injected nothing"
+    assert len(faults) == injected  # (a): no fault escaped the trace
+
+    corr = tv.correlate_faults(events)
+    assert not corr["unmatched"], corr["unmatched"][:5]  # (b)
+    to_timeout = [f for f in corr["matched"]
+                  if f["caused"]["ev"] in ("timeout", "round_end_timedout")]
+    assert to_timeout, "no fault correlated to a round-level timeout"  # (c)
+
+    # the latency table is computable from the same merged trace
+    lat = tv.round_latencies(events)
+    assert lat and all(st["count"] > 0 for st in lat.values())
+    # the text report renders end-to-end
+    rep = tv.report(paths)
+    assert "UNMATCHED" in rep and "per-round latency" in rep
+
+    # per-replica metrics snapshots rode along
+    for i in range(3):
+        with open(res["metrics_files"][i]) as fh:
+            met = json.load(fh)
+        assert met["counters"].get("chaos.drop", 0) > 0
+        assert met["counters"]["host.rounds"] > 0

@@ -50,6 +50,7 @@ from round_tpu.engine import fast, scenarios  # noqa: E402
 from round_tpu.engine.executor import run_instance  # noqa: E402
 from round_tpu.models.common import consensus_io  # noqa: E402
 from round_tpu.models.otr import OTR, OtrState  # noqa: E402
+from round_tpu.obs.metrics import METRICS  # noqa: E402
 
 OUT = os.path.join(REPO, "SOAK.jsonl")
 
@@ -481,8 +482,29 @@ def main():
     while time.monotonic() < t_end:
         check = rotation[it % len(rotation)]
         t0 = time.perf_counter()
-        rec = check(rng, it)
+        try:
+            rec = check(rng, it)
+        except Exception as e:  # noqa: BLE001 — a transient environment
+            # failure (subprocess timeout on a loaded box, a port-reuse
+            # bind race in the host-chaos rung) must cost ONE rotation
+            # slot and leave an auditable record, not abort hours of
+            # remaining coverage; real divergences come back as fail
+            # dicts, never exceptions
+            rec = {"kind": getattr(check, "__name__", repr(check)),
+                   "it": it, "error": f"{type(e).__name__}: {e}"[:300],
+                   "step": "check-error"}
+            rec["wall_s"] = round(time.perf_counter() - t0, 1)
+            rec["metrics"] = METRICS.snapshot(compact=True)
+            log(rec)
+            it += 1
+            continue
         rec["wall_s"] = round(time.perf_counter() - t0, 1)
+        # the unified metrics snapshot rides every soak record (obs/
+        # metrics.py; CUMULATIVE process counters — engine run counts,
+        # checkpoint saves/errors from the host-chaos rung's helpers),
+        # so the soak artifact banks the same surface the CLIs expose
+        # behind --metrics-json
+        rec["metrics"] = METRICS.snapshot(compact=True)
         if "fail" in rec:
             rec["step"] = "DIVERGENCE"
             log(rec)

@@ -82,7 +82,11 @@ def run(name, cmd, timeout):
 def _persist_window_artifact(step, out):
     """A measured number from a brief tunnel window must survive even if
     the tunnel is dead again when the end-of-round bench runs: append the
-    JSON lines to BENCH_WINDOW.jsonl (committed with the repo)."""
+    JSON lines to BENCH_WINDOW.jsonl (committed with the repo).  Each
+    metric line's engine compile/run numbers are ALSO banked as a compact
+    record in TPU_WATCH.jsonl, so the watch log carries the unified
+    observability surface (docs/OBSERVABILITY.md) alongside every bench
+    line without re-parsing the window artifact."""
     try:
         with open(os.path.join(REPO, "BENCH_WINDOW.jsonl"), "a") as f:
             for ln in out.strip().splitlines():
@@ -91,6 +95,16 @@ def _persist_window_artifact(step, out):
                     rec["window_step"] = step
                     rec["ts"] = round(time.time(), 1)
                     f.write(json.dumps(rec) + "\n")
+                    extra = rec.get("extra") or {}
+                    if "metric" in rec and "compile_s" in extra:
+                        log({"step": f"{step}-engine-metrics",
+                             "metric": rec["metric"],
+                             "rounds_per_sec": rec.get("value"),
+                             "compile_s": extra.get("compile_s"),
+                             "engine": extra.get("engine"),
+                             "variant": extra.get("variant"),
+                             "dot": extra.get("dot"),
+                             "mfu_effective": extra.get("mfu_effective")})
     except (OSError, ValueError) as e:
         log({"step": f"{step}-persist", "ok": False, "wall_s": 0.0,
              "out": "", "err": str(e)})

@@ -1,0 +1,293 @@
+"""Merge multi-replica round-level traces and read them as a post-mortem.
+
+Input: one JSONL trace per replica (apps/host_replica.py --trace,
+host_perftest --trace, or run_chaos_cluster(trace=True)).  The viewer
+
+  * merges the events into one timeline ordered by wall clock and groups
+    them by (instance, round) — the HO model's fundamental coordinate;
+  * prints per-round latency percentiles (p50/p90/p99 of the round_end
+    wall_ms across replicas and instances) plus the timeout count per
+    round index;
+  * cross-references chaos ``fault`` events (runtime/chaos.py
+    FaultyTransport) against the downstream events they caused at the
+    receiver: a drop/crash-mute/partition fault at (src→dst, inst, r)
+    matches dst's ``timeout`` at the same round, a timed-out round_end, a
+    ``catch_up`` fast-forward, or an out-of-band ``recv_decision``
+    recovery at a later round; truncate/garbage match the receiver's
+    ``malformed`` drop.  Faults that provably had no effect (the quorum
+    formed anyway, the receiver had already decided, duplicates) are
+    classified benign rather than unmatched — so "unmatched" is the
+    interesting bucket: an injected fault whose downstream story the
+    trace cannot explain.
+
+Usage:
+
+    python tools/trace_view.py trace-0.jsonl trace-1.jsonl trace-2.jsonl
+    python tools/trace_view.py --timeline --json out/trace-*.jsonl
+
+The event vocabulary is documented in docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from round_tpu.obs.trace import load_jsonl, merge  # noqa: E402
+
+# chaos families whose injection suppresses/perturbs delivery hard enough
+# that the receiver is expected to show a downstream timeout/catch-up
+_SUPPRESSING = ("drop", "crash_mute", "partition")
+# families that corrupt the payload: the downstream witness is the
+# receiver's malformed-drop
+_CORRUPTING = ("truncate", "garbage")
+# families that only reorder time: a downstream timeout is possible but
+# not implied — unmatched ones are benign by construction
+_TIMING = ("delay", "reorder", "dup")
+
+
+def load_traces(paths: Sequence[str]) -> List[Dict[str, Any]]:
+    return merge([load_jsonl(p) for p in paths])
+
+
+def by_round(events: Sequence[Dict[str, Any]]
+             ) -> Dict[Tuple[int, int], List[Dict[str, Any]]]:
+    """Group events by (instance, round) — the merge key of the HO model."""
+    out: Dict[Tuple[int, int], List[Dict[str, Any]]] = {}
+    for e in events:
+        if "inst" in e and "round" in e:
+            out.setdefault((e["inst"], e["round"]), []).append(e)
+    return out
+
+
+def percentile(xs: Sequence[float], p: float) -> float:
+    """Nearest-rank percentile (no numpy dependency in the viewer)."""
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    k = max(0, min(len(s) - 1, int(round(p / 100.0 * (len(s) - 1)))))
+    return s[k]
+
+
+def round_latencies(events: Sequence[Dict[str, Any]]
+                    ) -> Dict[int, Dict[str, float]]:
+    """Per round INDEX (across instances and replicas): count, p50/p90/
+    p99/max of round_end wall_ms, and how many of those rounds timed
+    out.  Round index is the right aggregation for lockstep protocols:
+    round 0 is always the warm-up/compile round, later indices are the
+    steady state."""
+    walls: Dict[int, List[float]] = {}
+    tos: Dict[int, int] = {}
+    for e in events:
+        if e.get("ev") != "round_end":
+            continue
+        r = int(e.get("round", -1))
+        walls.setdefault(r, []).append(float(e.get("wall_ms", 0.0)))
+        if e.get("timedout"):
+            tos[r] = tos.get(r, 0) + 1
+    out: Dict[int, Dict[str, float]] = {}
+    for r, xs in sorted(walls.items()):
+        out[r] = {
+            "count": len(xs),
+            "p50": round(percentile(xs, 50), 3),
+            "p90": round(percentile(xs, 90), 3),
+            "p99": round(percentile(xs, 99), 3),
+            "max": round(max(xs), 3),
+            "timeouts": tos.get(r, 0),
+        }
+    return out
+
+
+def correlate_faults(events: Sequence[Dict[str, Any]]) -> Dict[str, List]:
+    """Cross-reference every injected chaos fault against the downstream
+    event it caused at the receiver.
+
+    Returns {"matched": [...], "benign": [...], "unobserved": [...],
+    "unmatched": [...]}; matched entries carry a ``caused`` field naming
+    the downstream event.  ``unobserved`` holds faults whose receiver
+    left no trace for that instance (e.g. a SIGKILLed replica whose
+    pre-crash buffer died with it) — absence of evidence, not evidence
+    of absence.  ``unmatched`` is the bucket that should be EMPTY on a
+    complete trace: a suppressing fault with a healthy-looking receiver
+    round is a correlation bug or a torn trace."""
+    timeouts: Dict[Tuple[int, int], set] = {}
+    catchups: Dict[Tuple[int, int], List[int]] = {}
+    oob: Dict[Tuple[int, int], List[int]] = {}
+    malformed: Dict[Tuple[int, int], set] = {}
+    rend: Dict[Tuple[int, int, int], Dict[str, Any]] = {}
+    ended: Dict[Tuple[int, int], int] = {}  # (node, inst) -> rounds run
+    seen_key: set = set()
+    faults: List[Dict[str, Any]] = []
+    for e in events:
+        ev = e.get("ev")
+        if ev == "fault":
+            faults.append(e)
+            continue
+        node, inst = e.get("node"), e.get("inst")
+        if node is None or inst is None:
+            continue
+        key = (node, inst)
+        seen_key.add(key)
+        r = int(e.get("round", -1))
+        if ev == "timeout":
+            timeouts.setdefault(key, set()).add(r)
+        elif ev == "catch_up":
+            catchups.setdefault(key, []).append(r)
+        elif ev == "recv_decision":
+            oob.setdefault(key, []).append(r)
+        elif ev == "malformed":
+            malformed.setdefault(key, set()).add(r)
+        elif ev == "round_end":
+            rend[(node, inst, r)] = e
+        elif ev == "decision":
+            ended[key] = r
+
+    matched: List[Dict[str, Any]] = []
+    benign: List[Dict[str, Any]] = []
+    unobserved: List[Dict[str, Any]] = []
+    unmatched: List[Dict[str, Any]] = []
+
+    def _match(f) -> Optional[Dict[str, Any]]:
+        key = (f["dst"], f["inst"])
+        r = int(f["round"])
+        fam = f.get("family")
+        if fam in _CORRUPTING and r in malformed.get(key, ()):
+            return {"ev": "malformed", "round": r}
+        if r in timeouts.get(key, ()):
+            return {"ev": "timeout", "round": r}
+        re = rend.get((f["dst"], f["inst"], r))
+        if re is not None and re.get("timedout"):
+            return {"ev": "round_end_timedout", "round": r}
+        later_catch = [c for c in catchups.get(key, ()) if c >= r]
+        if later_catch:
+            return {"ev": "catch_up", "round": min(later_catch)}
+        later_oob = [c for c in oob.get(key, ()) if c >= r]
+        if later_oob:
+            return {"ev": "recv_decision", "round": min(later_oob)}
+        return None
+
+    for f in faults:
+        key = (f["dst"], f["inst"])
+        r = int(f["round"])
+        fam = f.get("family")
+        cause = _match(f)
+        if cause is not None:
+            matched.append({**f, "caused": cause})
+            continue
+        if fam in _TIMING:
+            benign.append({**f, "why": "timing-only family, tolerated"})
+            continue
+        re = rend.get((f["dst"], f["inst"], r))
+        if re is not None and not re.get("timedout"):
+            benign.append({**f, "why": "absorbed: quorum formed anyway"})
+            continue
+        if key in ended and r >= ended[key]:
+            benign.append({**f, "why": "receiver already finished instance"})
+            continue
+        if key not in seen_key:
+            unobserved.append(f)
+            continue
+        unmatched.append(f)
+    return {"matched": matched, "benign": benign,
+            "unobserved": unobserved, "unmatched": unmatched}
+
+
+def timeline(events: Sequence[Dict[str, Any]], limit: int = 0) -> List[str]:
+    """Human-readable merged timeline (offset seconds from first event)."""
+    evs = [e for e in events if "t" in e]
+    if not evs:
+        return []
+    t0 = min(e["t"] for e in evs)
+    lines = []
+    for e in evs if limit <= 0 else evs[:limit]:
+        bits = [f"+{e['t'] - t0:8.3f}s"]
+        if "node" in e:
+            bits.append(f"n{e['node']}")
+        if "inst" in e:
+            bits.append(f"i{e['inst']}")
+        if "round" in e:
+            bits.append(f"r{e['round']}")
+        bits.append(e.get("ev", "?"))
+        detail = {k: v for k, v in e.items()
+                  if k not in ("t", "node", "inst", "round", "ev")}
+        if detail:
+            bits.append(" ".join(f"{k}={v}" for k, v in sorted(
+                detail.items())))
+        lines.append(" ".join(bits))
+    return lines
+
+
+def report(paths: Sequence[str], show_timeline: bool = False,
+           as_json: bool = False, max_listed: int = 20) -> str:
+    events = load_traces(paths)
+    lat = round_latencies(events)
+    corr = correlate_faults(events)
+    if as_json:
+        return json.dumps({
+            "files": list(paths),
+            "events": len(events),
+            "round_latency_ms": lat,
+            "faults": {k: len(v) for k, v in corr.items()},
+            "correlation": corr,
+        }, indent=1)
+    nodes = sorted({e["node"] for e in events if "node" in e})
+    out = [f"# trace_view: {len(events)} events from {len(paths)} file(s), "
+           f"nodes {nodes}"]
+    if lat:
+        out.append("")
+        out.append("## per-round latency (ms, across instances and nodes)")
+        out.append("round  count    p50      p90      p99      max  timeouts")
+        for r, st in lat.items():
+            out.append(f"{r:5d}  {st['count']:5d}  {st['p50']:7.1f}  "
+                       f"{st['p90']:7.1f}  {st['p99']:7.1f}  "
+                       f"{st['max']:7.1f}  {st['timeouts']:8d}")
+    n_faults = sum(len(v) for v in corr.values())
+    out.append("")
+    out.append(f"## chaos faults: {n_faults} injected — "
+               f"{len(corr['matched'])} matched to downstream events, "
+               f"{len(corr['benign'])} benign, "
+               f"{len(corr['unobserved'])} unobserved, "
+               f"{len(corr['unmatched'])} UNMATCHED")
+    for f in corr["matched"][:max_listed]:
+        c = f["caused"]
+        out.append(f"  {f.get('family'):>10} {f.get('src')}->{f.get('dst')} "
+                   f"inst {f.get('inst')} round {f.get('round')}  =>  "
+                   f"{c['ev']} @ node {f.get('dst')} round {c['round']}")
+    if len(corr["matched"]) > max_listed:
+        out.append(f"  ... {len(corr['matched']) - max_listed} more")
+    for f in corr["unmatched"][:max_listed]:
+        out.append(f"  UNMATCHED {f.get('family')} {f.get('src')}->"
+                   f"{f.get('dst')} inst {f.get('inst')} "
+                   f"round {f.get('round')}")
+    if show_timeline:
+        out.append("")
+        out.append("## timeline")
+        out.extend(timeline(events))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge round-level traces; latency percentiles + "
+                    "chaos fault correlation")
+    ap.add_argument("traces", nargs="+", help="JSONL trace files "
+                    "(--trace output of host_replica / host_perftest)")
+    ap.add_argument("--timeline", action="store_true",
+                    help="also print the full merged event timeline")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report instead of text")
+    args = ap.parse_args(argv)
+    print(report(args.traces, show_timeline=args.timeline,
+                 as_json=args.json))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
