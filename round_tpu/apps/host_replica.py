@@ -122,6 +122,34 @@ def main(argv=None) -> int:
                          "(round_tpu/obs/metrics.py: host.*/wire.*/"
                          "chaos.*/ckpt.* counters and histograms) as "
                          "JSON at exit")
+    ap.add_argument("--view-change", type=str, default=None, metavar="SPEC",
+                    help="scripted live membership changes "
+                         "(runtime/view.py): comma-separated "
+                         "INST:add=PORT / INST:remove=PID entries — after "
+                         "data instance INST completes, propose that op "
+                         "by consensus over the current view and rewire "
+                         "the live peer table on decision "
+                         "(DynamicMembership.scala:231-245 on the real "
+                         "wire; every replica must carry the same script; "
+                         "sequential --instances loop only)")
+    ap.add_argument("--view-epoch", type=int, default=0,
+                    help="initial view epoch (default 0).  A replica "
+                         "ADDED by a view change is launched with the "
+                         "post-add peer list, its new --id and the "
+                         "post-add epoch")
+    ap.add_argument("--join-wait", dest="join_wait_ms", type=int,
+                    default=0, metavar="MS",
+                    help="hold this replica SILENT until traffic stamped "
+                         "with its epoch (or newer) arrives, up to MS — "
+                         "the added replica's guard: it must not leak its "
+                         "future-epoch view before the add is actually "
+                         "decided by the current members")
+    ap.add_argument("--reconnect-ms", type=int, default=200, metavar="MS",
+                    help="period of the transport auto-reconnect loop "
+                         "(dead peers re-dialed with per-peer exponential "
+                         "backoff, runtime/transport.py start_reconnect); "
+                         "0 disables — a dead peer is then only redialed "
+                         "when a send to it happens")
     ap.add_argument("--linger-ms", type=int, default=0, metavar="MS",
                     help="after the loop completes, keep answering peers' "
                          "traffic with decision replies until the wire is "
@@ -222,6 +250,62 @@ def main(argv=None) -> int:
 
             tr = FaultyTransport(raw_tr, FaultPlan.parse(args.chaos),
                                  n=len(peers))
+        if args.reconnect_ms > 0:
+            # churn tolerance: dead peers are re-dialed on a period with
+            # backoff (a restarted replica is re-admitted with NO manual
+            # redial; the reconnect loop runs on the raw transport — chaos
+            # faults are per-frame schedules and persist across reconnects)
+            raw_tr.start_reconnect(period_ms=args.reconnect_ms)
+
+        manager = None
+        view_schedule = None
+        if args.view_change is not None or args.view_epoch > 0 \
+                or args.join_wait_ms > 0:
+            from round_tpu.runtime.membership import Group, Replica
+            from round_tpu.runtime.view import (
+                View, ViewManager, epoch_behind, parse_view_schedule,
+            )
+
+            group = Group([Replica(i, h, p)
+                           for i, (h, p) in sorted(peers.items())])
+            manager = ViewManager(args.id, View(args.view_epoch, group), tr)
+            view_schedule = (parse_view_schedule(args.view_change)
+                             if args.view_change else {})
+            if args.instances <= 1 or args.rate > 1:
+                print("warning: --view-change/--view-epoch apply to the "
+                      "sequential --instances loop only", file=sys.stderr)
+
+        if manager is not None and args.join_wait_ms > 0:
+            # the added replica's silent join: consume (and discard) wire
+            # traffic until a frame stamped with OUR epoch or newer shows
+            # the add has decided — only then may we send, or our
+            # future-epoch stamps would leak the view to members still
+            # voting on it.  FLAG_VIEW catch-ups are adopted directly.
+            import time as _t
+
+            from round_tpu.runtime.oob import FLAG_NORMAL, FLAG_VIEW
+            from round_tpu.runtime.transport import wire_loads
+
+            t_end = _t.monotonic() + args.join_wait_ms / 1000.0
+            joined = False
+            while _t.monotonic() < t_end and not joined:
+                got = tr.recv(200)
+                if got is None:
+                    continue
+                _sender, tag, raw = got
+                if tag.flag == FLAG_VIEW:
+                    try:
+                        manager.adopt_wire(wire_loads(raw))
+                    except Exception:  # noqa: BLE001 — garbage tolerated
+                        pass
+                    joined = True
+                elif tag.flag == FLAG_NORMAL and not epoch_behind(
+                        tag.call_stack & 0xFF, manager.epoch_byte):
+                    joined = True
+            if not joined:
+                print(f"warning: --join-wait saw no epoch-"
+                      f"{args.view_epoch} traffic in {args.join_wait_ms} "
+                      f"ms; joining anyway", file=sys.stderr)
         if args.instances <= 1:
             if args.checkpoint_dir:
                 print("warning: --checkpoint-dir applies to the "
@@ -307,10 +391,12 @@ def main(argv=None) -> int:
                 value_schedule=args.value_schedule,
                 adaptive=adaptive, stats_out=stats,
                 checkpoint_dir=args.checkpoint_dir,
+                view=manager, view_schedule=view_schedule,
             )
         wall = time.perf_counter() - t0
         dump_decision_log(decisions)
-        if args.linger_ms > 0:
+        if args.linger_ms > 0 and not (manager is not None
+                                       and manager.removed):
             from round_tpu.runtime.host import serve_decisions
 
             serve_decisions(tr, decisions, idle_ms=args.linger_ms)
@@ -328,6 +414,20 @@ def main(argv=None) -> int:
         }
         if args.chaos:
             summary["chaos_injected"] = tr.injected
+        if manager is not None:
+            # the view trajectory: final epoch/n/id, the applied op
+            # history, and a clean `removed` marker — the harness's
+            # DynamicMembership.scala parity surface
+            summary.update({
+                "view_epoch": manager.epoch,
+                "view_n": manager.view.n,
+                "view_id": manager.my_id,
+                "view_history": [
+                    {"epoch": e, "op": "add" if k == 1 else "remove",
+                     "arg": a} for e, k, a in manager.history],
+                "removed": manager.removed,
+                "reconnects": raw_tr.reconnects,
+            })
         print(json.dumps(summary))
     return 0
 

@@ -7,10 +7,19 @@
 // Python over ctypes (runtime/transport.py):
 //
 //   frame     := u32_be length | u64_be tag | payload bytes
-//   handshake := u32_be node id, sent by the connecting side first
-//                (the reference sends "host:port"; an id is the same
-//                information under the Directory's id->address map,
-//                Replicas.scala:74-80)
+//   handshake := u32_be node id | u32_be listen port, sent by the
+//                connecting side first (the reference sends "host:port";
+//                id + listen port is the same information under the
+//                Directory's id->address map, Replicas.scala:74-80).  The
+//                listen port matters under LIVE RECONFIGURATION
+//                (runtime/view.py): ids are renamed to stay contiguous
+//                when the group changes, so an id alone no longer proves
+//                identity — a removed replica redialing with its stale id
+//                would hijack the by_peer slot of whichever CURRENT
+//                member inherited that id ("newest channel wins" routes
+//                its traffic to the wrong node).  The acceptor therefore
+//                validates the advertised listen port against its peer
+//                table and closes mismatched channels as stale.
 //
 // Differences from the reference, by design: 4-byte length framing instead
 // of 2 (no 64 KiB payload cap), connect-on-demand from either side instead
@@ -211,6 +220,7 @@ uint64_t get_u64(const uint8_t *p) {
 
 struct Node {
   int id;
+  int listen_port = 0;            // resolved at bind; advertised in hellos
   int listen_fd = -1;             // TCP listen socket, or the UDP socket
   bool udp = false;
   bool tls = false;
@@ -290,11 +300,25 @@ struct Node {
     bool ok = true;
     for (;;) {
       if (!c.handshaked) {
-        if (c.rbuf.size() - off < 4) break;
-        c.peer = static_cast<int>(get_u32(c.rbuf.data() + off));
+        if (c.rbuf.size() - off < 8) break;
+        int peer = static_cast<int>(get_u32(c.rbuf.data() + off));
+        uint32_t lport = get_u32(c.rbuf.data() + off + 4);
+        if (lport == 0 || lport > 65535) { ok = false; break; }
+        c.peer = peer;
         c.handshaked = true;
-        off += 4;
+        off += 8;
         std::lock_guard<std::mutex> l(mu);
+        auto ad = peer_addr.find(peer);
+        if (ad != peer_addr.end() &&
+            ad->second.second != static_cast<int>(lport)) {
+          // the dialer claims an id our peer table assigns to a DIFFERENT
+          // address: a stale replica from before a rename/remove (see the
+          // handshake comment at the top) — close, do NOT install it as
+          // the id's channel.  A peer we have no address for is accepted
+          // as before (asymmetric add_peer deployments).
+          ok = false;
+          break;
+        }
         by_peer[c.peer] = nullptr;  // placeholder; fixed below under lock
         for (auto &sp : conns)
           if (sp.get() == &c) by_peer[c.peer] = sp;
@@ -494,8 +518,9 @@ struct Node {
     }
   }
 
-  std::shared_ptr<Conn> connect_to(int peer) {
+  std::shared_ptr<Conn> connect_to(int peer, int timeout_ms = 10'000) {
     std::pair<std::string, int> addr;
+    int my_id;
     {
       std::lock_guard<std::mutex> l(mu);
       auto it = by_peer.find(peer);
@@ -504,6 +529,7 @@ struct Node {
       auto ad = peer_addr.find(peer);
       if (ad == peer_addr.end()) return nullptr;
       addr = ad->second;
+      my_id = id;  // snapshot under mu: rt_node_set_id may rename us
     }
     addrinfo hints{}, *res = nullptr;
     hints.ai_family = AF_INET;
@@ -512,11 +538,36 @@ struct Node {
     if (getaddrinfo(addr.first.c_str(), port.c_str(), &hints, &res) != 0)
       return nullptr;
     int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
-    int ok = fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0;
+    // nonblocking connect bounded by timeout_ms: a blocking connect(2) to
+    // an unreachable host stalls in SYN retries for seconds — the
+    // reconnect loop (rt_node_connect callers) must never hang the caller
+    // on a peer that is simply still dead
+    bool ok = fd >= 0;
+    if (ok) {
+      fcntl(fd, F_SETFL, O_NONBLOCK);
+      int rc = ::connect(fd, res->ai_addr, res->ai_addrlen);
+      if (rc != 0 && errno == EINPROGRESS) {
+        pollfd pfd{fd, POLLOUT, 0};
+        ok = poll(&pfd, 1, timeout_ms) > 0;
+        if (ok) {
+          int err = 0;
+          socklen_t elen = sizeof(err);
+          ok = getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) == 0 &&
+               err == 0;
+        }
+      } else {
+        ok = rc == 0;
+      }
+    }
     freeaddrinfo(res);
     if (!ok) {
       if (fd >= 0) close(fd);
       return nullptr;
+    }
+    if (!tls) {
+      // restore blocking mode: write_all treats EAGAIN as a dead socket
+      // (TLS conns stay nonblocking — ssl_write_all handles WANT_*)
+      fcntl(fd, F_SETFL, fcntl(fd, F_GETFL) & ~O_NONBLOCK);
     }
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
@@ -524,15 +575,16 @@ struct Node {
     c->fd = fd;
     c->peer = peer;
     c->handshaked = true;  // outbound: we know who we dialed
-    // handshake: our id first (TcpRuntime.scala:357-368's client hello);
-    // in TLS mode the hello travels INSIDE the channel (the first
-    // ssl_write_all also drives the TLS handshake, client role)
+    // handshake: our id + listen port first (TcpRuntime.scala:357-368's
+    // client hello); in TLS mode the hello travels INSIDE the channel
+    // (the first ssl_write_all also drives the TLS handshake, client
+    // role)
     std::vector<uint8_t> hello;
-    put_u32(hello, static_cast<uint32_t>(id));
+    put_u32(hello, static_cast<uint32_t>(my_id));
+    put_u32(hello, static_cast<uint32_t>(listen_port));
     bool sent;
     if (tls) {
       const TlsApi &api = tls_api();
-      fcntl(fd, F_SETFL, O_NONBLOCK);
       c->ssl = api.SSL_new(ssl_ctx);
       if (!c->ssl) { close(fd); return nullptr; }
       api.SSL_set_fd(c->ssl, fd);
@@ -554,6 +606,25 @@ struct Node {
     }
     if (wake_pipe[1] >= 0) { uint8_t b = 0; (void)!write(wake_pipe[1], &b, 1); }
     return c;
+  }
+
+  // Sever the live connection to `peer` (if any) without touching its
+  // address entry: shutdown(2) from this thread, the event loop reaps the
+  // fd on its next read error (the same no-close-outside-the-loop
+  // discipline as the send failure path — closing here could hand the fd
+  // number to a concurrent accept while the loop still polls it).
+  void drop_conn(int peer) {
+    std::shared_ptr<Conn> c;
+    {
+      std::lock_guard<std::mutex> l(mu);
+      auto it = by_peer.find(peer);
+      if (it == by_peer.end() || !it->second) return;
+      c = it->second;
+      by_peer.erase(it);
+    }
+    std::lock_guard<std::mutex> lw(c->wmu);
+    if (c->fd >= 0) shutdown(c->fd, SHUT_RDWR);
+    if (wake_pipe[1] >= 0) { uint8_t b = 0; (void)!write(wake_pipe[1], &b, 1); }
   }
 
   bool send_msg(int peer, uint64_t tag, const uint8_t *payload, int len) {
@@ -628,6 +699,15 @@ static void *node_create(int id, int listen_port, bool udp,
   // the drain blocks the event loop once empty
   fcntl(n->wake_pipe[0], F_SETFL, O_NONBLOCK);
   fcntl(n->wake_pipe[1], F_SETFL, O_NONBLOCK);
+  {
+    // resolve the bound port once (listen_port==0 binds ephemeral); it is
+    // advertised in every outbound hello as this node's wire identity
+    sockaddr_in bound{};
+    socklen_t blen = sizeof(bound);
+    if (getsockname(n->listen_fd, reinterpret_cast<sockaddr *>(&bound),
+                    &blen) == 0)
+      n->listen_port = ntohs(bound.sin_port);
+  }
   n->running = true;
   n->loop = std::thread([n] { n->loop_body(); });
   return n;
@@ -697,6 +777,55 @@ void rt_node_add_peer(void *node, int peer_id, const char *host, int port) {
   std::lock_guard<std::mutex> l(n->mu);
   n->peer_addr[peer_id] = {host, port};
   if (have_sa) n->peer_sa[peer_id] = sa;
+}
+
+// Forget a peer: sever its live connection and drop its address entry.
+// Sends to it fail from now on; the listen socket still ACCEPTS from it
+// (the unauthenticated-socket trust model is unchanged — the epoch stamp
+// in the Tag is what rejects a removed replica's traffic semantically).
+void rt_node_remove_peer(void *node, int peer_id) {
+  auto *n = static_cast<Node *>(node);
+  if (!n->udp) n->drop_conn(peer_id);
+  std::lock_guard<std::mutex> l(n->mu);
+  n->peer_addr.erase(peer_id);
+  n->peer_sa.erase(peer_id);
+}
+
+// Rename this node (Replicas.scala:136-142 renameReplica, the wire half):
+// future outbound handshakes and UDP sender headers carry the new id.
+// Peers holding connections handshaked under the OLD id keep attributing
+// in-flight frames to it until those channels are dropped — which is why
+// a view change that renames ids severs and re-dials the affected
+// channels (runtime/transport.py rewire) and stamps traffic with the view
+// epoch so stale attribution is detected, not trusted.
+void rt_node_set_id(void *node, int new_id) {
+  auto *n = static_cast<Node *>(node);
+  std::lock_guard<std::mutex> l(n->mu);
+  n->id = new_id;
+}
+
+// 1 when a live channel to `peer` exists (UDP: when its address is
+// registered — datagram sockets have no connection state), else 0.
+int rt_node_connected(void *node, int peer_id) {
+  auto *n = static_cast<Node *>(node);
+  std::lock_guard<std::mutex> l(n->mu);
+  if (n->udp) return n->peer_sa.count(peer_id) ? 1 : 0;
+  auto it = n->by_peer.find(peer_id);
+  return (it != n->by_peer.end() && it->second && it->second->fd >= 0)
+             ? 1 : 0;
+}
+
+// Dial `peer` now (bounded by timeout_ms) without sending anything:
+// the reconnect-loop primitive (runtime/transport.py drives period +
+// backoff).  0 = a channel exists (already or freshly connected),
+// -1 = could not connect.  UDP nodes are always "connected".
+int rt_node_connect(void *node, int peer_id, int timeout_ms) {
+  auto *n = static_cast<Node *>(node);
+  if (n->udp) {
+    std::lock_guard<std::mutex> l(n->mu);
+    return n->peer_sa.count(peer_id) ? 0 : -1;
+  }
+  return n->connect_to(peer_id, timeout_ms) ? 0 : -1;
 }
 
 int rt_node_send(void *node, int peer_id, uint64_t tag,

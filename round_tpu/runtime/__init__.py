@@ -13,6 +13,9 @@ top.  Here the same services are tensor-shaped:
     reference's consensus-on-membership example
   - SMR / batching             = ReplicatedStateMachine over a consensus
     algorithm with a device decision log + replay/recovery (smr.py)
+  - live reconfiguration       = versioned View + ViewManager: membership
+    ops decided by consensus over the real wire and applied to the
+    RUNNING peer table with epoch-stamped traffic (view.py)
 """
 
 from round_tpu.runtime.checkpoint import restore as restore_checkpoint
@@ -23,6 +26,7 @@ from round_tpu.runtime.instances import InstancePool, InstanceResult
 from round_tpu.runtime.membership import Directory, Group, Replica
 from round_tpu.runtime.smr import ReplicatedStateMachine
 from round_tpu.runtime.stats import Stats, stats
+from round_tpu.runtime.view import View, ViewManager
 
 __all__ = [
     "InstancePool",
@@ -30,6 +34,8 @@ __all__ = [
     "Directory",
     "Group",
     "Replica",
+    "View",
+    "ViewManager",
     "ReplicatedStateMachine",
     "Options",
     "parse_args",

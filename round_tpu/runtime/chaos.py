@@ -209,6 +209,30 @@ class FaultyTransport:
     def add_peer(self, peer_id, host, port):
         return self.inner.add_peer(peer_id, host, port)
 
+    def remove_peer(self, peer_id):
+        return self.inner.remove_peer(peer_id)
+
+    def connected(self, peer_id):
+        return self.inner.connected(peer_id)
+
+    def start_reconnect(self, **kw):
+        return self.inner.start_reconnect(**kw)
+
+    @property
+    def reconnects(self):
+        return self.inner.reconnects
+
+    def rewire(self, peers, my_id=None):
+        """View-change pass-through (runtime/view.py): the live peer table
+        swap happens on the inner transport; the fault schedules COMPOSE
+        with churn by construction — every family is a pure function of
+        (seed, src, dst, round), so reconnects and renames change which
+        physical channel carries a frame, never whether it faults.  Only
+        ``n`` (the sender-range/partition-side domain) tracks the group."""
+        out = self.inner.rewire(peers, my_id=my_id)
+        self.n = len(peers)
+        return out
+
     def stop(self):
         return self.inner.stop()
 

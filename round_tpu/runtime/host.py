@@ -65,7 +65,9 @@ from round_tpu.obs.metrics import METRICS, MS_BUCKETS
 from round_tpu.obs.trace import TRACE
 from round_tpu.ops.mailbox import Mailbox
 from round_tpu.runtime.log import get_logger
-from round_tpu.runtime.oob import FLAG_DECISION, FLAG_NORMAL, Message, Tag
+from round_tpu.runtime.oob import (
+    FLAG_DECISION, FLAG_NORMAL, FLAG_VIEW, Message, Tag,
+)
 from round_tpu.runtime.transport import HostTransport, wire_loads
 
 log = get_logger("host")
@@ -119,6 +121,11 @@ class HostResult:
     # at the backoff cap, shrinks toward the observed round latency);
     # with a fixed timeout it is flat
     timeout_trajectory: List[int] = dataclasses.field(default_factory=list)
+    # the instance was INTERRUPTED by a view move (runtime/view.py): the
+    # ViewManager adopted a newer view (or discovered our removal) while
+    # this instance ran over the old wire — the caller re-enters under the
+    # new view instead of trusting a decision reached across the boundary
+    stale_view: bool = False
 
 
 class AdaptiveTimeout:
@@ -503,6 +510,8 @@ def run_instance_loop(
     value_schedule: str = "mixed",
     adaptive: Optional[AdaptiveTimeout] = None,
     checkpoint_dir: Optional[str] = None,
+    view=None,
+    view_schedule: Optional[Dict[int, Tuple[int, int]]] = None,
 ) -> List[Optional[int]]:
     """The PerfTest2 loop (PerfTest2.scala:19-110): `instances` consecutive
     consensus instances over one transport, with start-skew stashing —
@@ -522,6 +531,18 @@ def run_instance_loop(
     This is the crash-restart story: SIGKILL a replica mid-run, start it
     again with the same arguments, and its final decision log must be
     byte-identical to a never-crashed run (tests/test_chaos.py).
+
+    With a ``view`` (runtime/view.py ViewManager), every instance runs
+    over the view's CURRENT group (pid + peer table re-read each
+    instance), and ``view_schedule`` — {data instance -> (kind, arg)} —
+    makes this replica propose that membership op by consensus right
+    after the instance completes (the DynamicMembership.scala:231-245
+    flow; all members carry the same script).  Schedule entries whose
+    epoch has already been applied (a late joiner handed a post-change
+    view at launch) are skipped.  An instance interrupted by a view move
+    (stale_view) is re-run on the new wire; a replica that discovers its
+    own removal returns its decision log immediately with the remaining
+    entries undecided — the CALLER exits it cleanly.
 
     Returns the per-instance decision log (None where undecided)."""
     stash: Dict[int, Dict[int, Dict[int, Any]]] = {}
@@ -568,23 +589,50 @@ def run_instance_loop(
         stash.setdefault(tag.instance, {}).setdefault(
             tag.round, {})[sender] = payload
 
+    # ordered view-change schedule: entry i moves the group from epoch i
+    # to i+1, so a replica only PROPOSES an entry its own epoch has not
+    # yet passed (a late joiner launched with a post-change view skips
+    # the entries that produced it)
+    sched_order = sorted(view_schedule) if view_schedule else []
     for inst in range(start, instances + 1):
         current["inst"] = inst
-        runner = HostRunner(
-            algo, my_id, peers, transport, instance_id=inst,
-            timeout_ms=timeout_ms, seed=seed + inst,
-            foreign=foreign, prefill=stash.pop(inst, None),
-            send_when_catching_up=send_when_catching_up,
-            # start skew is a per-run experiment: only the first instance
-            # is delayed (the reference sleeps at instance start, and the
-            # point is skewING the replica, not slowing every instance)
-            delay_first_send_ms=delay_first_send_ms if inst == 1 else -1,
-            nbr_byzantine=nbr_byzantine,
-            adaptive=adaptive,
-        )
-        value = _schedule_value(value_schedule, base_value, my_id, inst)
-        res = runner.run({"initial_value": np.int32(value)},
-                         max_rounds=max_rounds)
+        for _attempt in range(4):
+            vid, vpeers = my_id, peers
+            if view is not None:
+                if view.removed:
+                    break
+                vid, vpeers = view.my_id, view.view.peers()
+            runner = HostRunner(
+                algo, vid, vpeers, transport, instance_id=inst,
+                timeout_ms=timeout_ms, seed=seed + inst,
+                foreign=foreign, prefill=stash.pop(inst, None),
+                send_when_catching_up=send_when_catching_up,
+                # start skew is a per-run experiment: only the first
+                # instance is delayed (the reference sleeps at instance
+                # start, and the point is skewING the replica, not
+                # slowing every instance)
+                delay_first_send_ms=(delay_first_send_ms
+                                     if inst == 1 else -1),
+                nbr_byzantine=nbr_byzantine,
+                adaptive=adaptive,
+                view=view,
+            )
+            value = _schedule_value(value_schedule, base_value, vid, inst)
+            res = runner.run({"initial_value": np.int32(value)},
+                             max_rounds=max_rounds)
+            if view is not None and res.stale_view and not res.decided \
+                    and not view.removed:
+                # the view moved under this instance: clear the stale
+                # latch and re-run it over the NEW wire (bounded retries;
+                # epochs advance a handful of times per deployment)
+                view.stale = False
+                continue
+            break
+        if view is not None and view.removed:
+            # voted out: undecided placeholders for the un-run tail keep
+            # the decision-log length schedule-shaped for the harness
+            decisions.extend([None] * (instances - len(decisions)))
+            break
         decisions.append(
             int(np.asarray(res.decision)) if res.decided else None
         )
@@ -602,6 +650,24 @@ def run_instance_loop(
             # adaptive estimator this is the convergence trajectory
             stats_out.setdefault("timeout_trajectory", []).extend(
                 res.timeout_trajectory)
+        if view is not None and view_schedule and inst in view_schedule \
+                and view.epoch == sched_order.index(inst):
+            # the scripted membership change: consensus on the op over
+            # the CURRENT view, applied to the live wire on decision
+            # (runtime/view.py).  An undecided outcome leaves the view
+            # unchanged — if peers DID decide, their next stamped frames
+            # trigger the FLAG_VIEW catch-up and the next instance re-runs
+            # on the adopted view.
+            from round_tpu.runtime.view import view_instance
+
+            kind, arg = view_schedule[inst]
+            view.propose(
+                algo, kind, arg, seed=seed, timeout_ms=timeout_ms,
+                max_rounds=max_rounds, adaptive=adaptive, foreign=foreign,
+                prefill=stash.pop(view_instance(view.epoch), None),
+            )
+            view.stale = False  # any mid-change staleness was resolved
+            # by propose/adopt; the next data instance starts fresh
     return decisions
 
 
@@ -700,6 +766,7 @@ class HostRunner:
         delay_first_send_ms: int = -1,
         nbr_byzantine: int = 0,
         adaptive: Optional[AdaptiveTimeout] = None,
+        view=None,
     ):
         self.algo = algo
         self.id = my_id
@@ -731,6 +798,11 @@ class HostRunner:
             raise ValueError(
                 f"nbr_byzantine={nbr_byzantine} must be in [0, n={self.n})")
         self.nbr_byzantine = nbr_byzantine
+        # view subsystem hook (runtime/view.py ViewManager): stamps the
+        # view epoch onto outgoing NORMAL tags, guards incoming ones, and
+        # routes FLAG_VIEW catch-ups; None = the epoch-less single-view
+        # world every pre-view deployment ran in
+        self.view = view
         self.seed = seed
         self.default_handler = default_handler
         # sink for NORMAL messages of other instances: a consecutive-
@@ -878,6 +950,18 @@ class HostRunner:
         rounds = algo.rounds
         exited = False
         r = 0
+        # view interrupt: the ViewManager MOVED (a FLAG_VIEW catch-up was
+        # adopted, or our removal discovered) — this instance runs over a
+        # stale wire and must hand control back to the host loop.  Merely
+        # OBSERVING a peer ahead (view.stale) does NOT interrupt: the
+        # catch-up reply to our next stamped send is already on its way,
+        # and bailing before ingesting it would burn the host loop's
+        # bounded re-runs without ever adopting the new view
+        epoch0 = self.view.epoch if self.view is not None else 0
+
+        def view_int() -> bool:
+            v = self.view
+            return v is not None and (v.removed or v.epoch != epoch0)
         # benign catch-up state (InstanceHandler.scala:289-301): highest
         # round observed per peer; their max pulls this replica forward
         max_rnd = np.full(self.n, -1, dtype=np.int64)
@@ -905,6 +989,10 @@ class HostRunner:
             # sendWhenCatchingUp); our messages would arrive
             # communication-closed-late at peers already beyond r
             sending = self.send_when_catching_up or next_round <= r
+            # the view epoch rides the otherwise-unused callStack byte of
+            # every NORMAL frame (runtime/view.py; 0 in the epoch-less
+            # world, which IS epoch 0's stamp — fully backwards-compatible)
+            cs = self.view.epoch_byte if self.view is not None else 0
             if sending:
                 wire = pickle.dumps(payload_np)
                 sent = 0
@@ -912,7 +1000,8 @@ class HostRunner:
                     if d == self.id or not dest[d]:
                         continue
                     self.transport.send(
-                        d, Tag(instance=self.instance_id, round=r), wire
+                        d, Tag(instance=self.instance_id, round=r,
+                               call_stack=cs), wire
                     )
                     sent += 1
                     if TRACE.enabled:
@@ -968,6 +1057,33 @@ class HostRunner:
                 current round's update)."""
                 nonlocal state, deadline, next_round, oob_decided
                 sender, tag, raw = got
+                if self.view is not None:
+                    # the view guard runs BEFORE the sender-range check:
+                    # after a REMOVE shrinks n, a stale replica's old pid
+                    # can be >= n (it dials the member that inherited its
+                    # id, or — when the last pid was removed — anyone),
+                    # and dropping it as malformed would starve it of the
+                    # FLAG_VIEW catch-up forever.  Neither path indexes a
+                    # sender-sized structure: adoption validates the
+                    # payload structurally, and the reply rides the stale
+                    # peer's own inbound channel (by_peer), so an
+                    # arbitrary sender id is safe — at worst a garbage
+                    # frame reflects one rate-limited ~100-byte reply.
+                    if tag.flag == FLAG_VIEW:
+                        # catch-up from a peer ahead of our view: adopt
+                        # (rewire + epoch jump); view_int() then ends this
+                        # instance so the host loop re-enters on the new
+                        # wire
+                        ok, p = self._loads(raw)
+                        if ok:
+                            self.view.adopt_wire(p)
+                        return False
+                    if (tag.flag == FLAG_NORMAL
+                            and not self.view.check_epoch(sender, tag)):
+                        # cross-epoch data traffic is DROPPED, never
+                        # folded: a stale peer was just answered with
+                        # FLAG_VIEW; an ahead peer flagged us stale
+                        return False
                 if not 0 <= sender < self.n:
                     # protocol garbage on the unauthenticated socket: an
                     # out-of-range id would corrupt every downstream
@@ -1043,7 +1159,8 @@ class HostRunner:
                 return True
 
             dirty = True  # inbox changed since the last go probe
-            while not prog.is_go_ahead and not oob_decided:
+            while not prog.is_go_ahead and not oob_decided \
+                    and not view_int():
                 if dirty and go_ahead():
                     break
                 dirty = False
@@ -1122,7 +1239,7 @@ class HostRunner:
                         break
                     ingest(got, extend_deadline=False,
                            buffer_only=not prog.is_go_ahead)
-                    if oob_decided:
+                    if oob_decided or view_int():
                         break
 
             if use_deadline:
@@ -1148,7 +1265,13 @@ class HostRunner:
                                else round(ew, 3))
 
             # -- update ---------------------------------------------------
-            if oob_decided:
+            if view_int():
+                # view boundary: do NOT fold the partial old-epoch mailbox
+                # (a decision reached across the boundary could be over
+                # the wrong group) — hand back undecided-so-far, the host
+                # loop re-runs the instance on the new wire
+                exited = True
+            elif oob_decided:
                 exited = True
             else:
                 mbox = self._mailbox(inbox, payload_np)
@@ -1175,6 +1298,9 @@ class HostRunner:
             next_round = max(next_round, r)
 
         decided = bool(np.asarray(algo.decided(state)))
+        if view_int():
+            # never report a decision across a view boundary (see above)
+            decided = False
         decision = np.asarray(algo.decision(state))
         if decided:
             _C_DECISIONS.inc()
@@ -1188,6 +1314,7 @@ class HostRunner:
             malformed_messages=self.malformed,
             timeouts=self.timeouts,
             timeout_trajectory=list(self._trajectory),
+            stale_view=view_int(),
         )
 
     def _mailbox(self, inbox: Dict[int, Any], like: Any) -> Mailbox:

@@ -36,11 +36,21 @@ FLAG_ERROR = 2
 FLAG_DECISION = 4
 FLAG_TOO_LATE = 5
 FLAG_RECOVERY = 6
+# view-change catch-up (runtime/view.py): the reply a current-view replica
+# sends to traffic stamped with an OLD epoch — payload is the serialized
+# View (epoch + address list), the receiver adopts it and rewires
+FLAG_VIEW = 7
 
 
 @dataclasses.dataclass(frozen=True)
 class Tag:
-    """8-byte packet header (Tag.scala:22-62)."""
+    """8-byte packet header (Tag.scala:22-62).
+
+    The ``call_stack`` byte — unused by this runtime's protocols, like the
+    reference's — is REUSED by the view subsystem (runtime/view.py) to
+    stamp the sender's view epoch (mod 256) onto every NORMAL frame, so a
+    replica still running an old view is detected from its very first
+    packet and answered with a FLAG_VIEW catch-up."""
 
     instance: int
     round: int = 0

@@ -29,6 +29,7 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from round_tpu.obs.metrics import METRICS
+from round_tpu.obs.trace import TRACE
 from round_tpu.runtime.oob import Message, Tag
 
 # wire-level instruments (one lock-guarded add per message on a path that
@@ -38,6 +39,11 @@ _C_WIRE_SENT = METRICS.counter("wire.sent_msgs")
 _C_WIRE_SENT_B = METRICS.counter("wire.sent_bytes")
 _C_WIRE_RECV = METRICS.counter("wire.recv_msgs")
 _C_WIRE_RECV_B = METRICS.counter("wire.recv_bytes")
+# churn instruments (the view subsystem's wire half, runtime/view.py):
+# reconnects = channels re-established by the auto-reconnect loop,
+# rewires = peer-table swaps applied by a view change
+_C_WIRE_RECONNECT = METRICS.counter("wire.reconnects")
+_C_WIRE_REWIRE = METRICS.counter("wire.rewires")
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
 _lib = None
@@ -122,6 +128,14 @@ def _load() -> ctypes.CDLL:
         lib.rt_node_add_peer.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int
         ]
+        lib.rt_node_remove_peer.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.rt_node_set_id.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.rt_node_connected.restype = ctypes.c_int
+        lib.rt_node_connected.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.rt_node_connect.restype = ctypes.c_int
+        lib.rt_node_connect.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int
+        ]
         lib.rt_node_send.restype = ctypes.c_int
         lib.rt_node_send.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64,
@@ -190,13 +204,175 @@ class HostTransport:
         self.port = self._lib.rt_node_port(self._node)
         self._buf = ctypes.create_string_buffer(1 << 20)
         self.closed = False  # set once recv observes the stopped node
+        # live peer table mirror (pid -> (host, port)): the native layer
+        # keeps its own map, but rewire() needs to DIFF old vs new and the
+        # reconnect loop needs something to iterate — one lock guards both
+        self._peers: Dict[int, Tuple[str, int]] = {}
+        self._peers_lock = threading.Lock()
+        self.reconnects = 0           # channels re-established by the loop
+        self._reconn_stop: Optional[threading.Event] = None
+        self._reconn_thread: Optional[threading.Thread] = None
+        # serializes rewire() against the reconnect loop's dials: a dial
+        # that READS a pid's address before rewire and INSTALLS the
+        # channel after it would permanently wire that pid to the old
+        # replica (observed: a renamed replica's reconnect thread redialed
+        # severed peers mid-rewire and resurrected the pre-change mapping)
+        self._churn_lock = threading.Lock()
 
     def add_peer(self, peer_id: int, host: str, port: int) -> None:
         if not self._node:
             return  # closed: nothing to register on
+        with self._peers_lock:
+            self._peers[peer_id] = (host, port)
         self._lib.rt_node_add_peer(
             self._node, peer_id, host.encode(), port
         )
+
+    def remove_peer(self, peer_id: int) -> None:
+        """Forget a peer: sever its channel and drop its address.  The
+        reconnect loop stops dialing it; sends to it fail."""
+        if not self._node:
+            return
+        with self._peers_lock:
+            self._peers.pop(peer_id, None)
+        self._lib.rt_node_remove_peer(self._node, peer_id)
+
+    def connected(self, peer_id: int) -> bool:
+        """True when a live channel to the peer exists (UDP: when its
+        address is registered — datagrams have no channel state)."""
+        if not self._node:
+            return False
+        return bool(self._lib.rt_node_connected(self._node, peer_id))
+
+    def rewire(self, peers: Dict[int, Tuple[str, int]],
+               my_id: Optional[int] = None) -> Dict[str, int]:
+        """Swap the live peer table to ``peers`` (pid -> (host, port), our
+        own entry skipped) on a RUNNING node — the wire half of a view
+        change (TcpRuntime.scala:75-110 rewiring when the group changes).
+
+        Unchanged (pid, address) pairs keep their connections; added peers
+        are registered (the reconnect loop or the next send dials them);
+        removed pids are severed; a pid whose address changed — which is
+        what an id-compaction rename looks like from the outside
+        (Replicas.scala:136-142) — is severed and re-registered so the
+        fresh channel handshakes under the NEW ids.  ``my_id`` renames
+        this node itself — and that severs EVERY existing channel, even to
+        address-unchanged peers: their inbound attribution of this node
+        was fixed by the handshake at connect time, so a kept channel
+        would stamp our frames with the OLD id forever (observed as one
+        renamed replica wire-isolated after a remove: its traffic folded
+        into another pid's mailbox slot and its catch-up replies routed to
+        that other replica).  Returns the {added, removed, readdressed,
+        rehandshaked} counts for callers' trace events."""
+        stats = {"added": 0, "removed": 0, "readdressed": 0,
+                 "rehandshaked": 0}
+        if not self._node:
+            return stats
+        self._churn_lock.acquire()
+        try:
+            return self._rewire_locked(peers, my_id, stats)
+        finally:
+            self._churn_lock.release()
+
+    def _rewire_locked(self, peers, my_id, stats):
+        renamed = my_id is not None and my_id != self.id
+        if renamed:
+            self._lib.rt_node_set_id(self._node, my_id)
+            self.id = my_id
+        with self._peers_lock:
+            old = dict(self._peers)
+        me = self.id
+        for pid in old:
+            if pid not in peers or pid == me:
+                self.remove_peer(pid)
+                stats["removed"] += 1
+        for pid, (host, port) in peers.items():
+            if pid == me:
+                continue
+            cur = old.get(pid)
+            if cur == (host, port) and not renamed:
+                continue
+            if cur is not None:
+                # sever before re-registering: the old channel either
+                # points at a DIFFERENT replica now (readdressed pid) or
+                # carries our OLD handshake id (we were renamed) — both
+                # mis-attribute every frame sent on them
+                self._lib.rt_node_remove_peer(self._node, pid)
+                stats["rehandshaked" if cur == (host, port)
+                      else "readdressed"] += 1
+            else:
+                stats["added"] += 1
+            self.add_peer(pid, host, port)
+        _C_WIRE_REWIRE.inc()
+        if TRACE.enabled:
+            TRACE.emit("wire_rewire", node=self.id, **stats)
+        return stats
+
+    def start_reconnect(self, period_ms: int = 200, backoff: float = 2.0,
+                        max_backoff_ms: int = 3200,
+                        connect_timeout_ms: int = 250) -> None:
+        """Start the periodic auto-reconnect loop: every ``period_ms`` each
+        registered peer without a live channel is re-dialed, failures
+        backing off exponentially per peer up to ``max_backoff_ms`` (the
+        reference redials dead peers on a period, TcpRuntime.scala:
+        162-211; without this a peer that only ever LISTENS — it has no
+        send to piggyback the redial on — stays dark forever after a
+        restart).  Idempotent; stop()/close() ends the loop."""
+        if self._reconn_thread is not None and self._reconn_thread.is_alive():
+            return
+        self._reconn_stop = threading.Event()
+        self._reconn_thread = threading.Thread(
+            target=self._reconnect_loop,
+            args=(self._reconn_stop, period_ms / 1000.0, backoff,
+                  max_backoff_ms / 1000.0, connect_timeout_ms),
+            daemon=True,
+        )
+        self._reconn_thread.start()
+
+    def _reconnect_loop(self, stop: threading.Event, period: float,
+                        backoff: float, max_wait: float,
+                        connect_timeout_ms: int) -> None:
+        import time as _time
+
+        next_try: Dict[int, float] = {}
+        wait: Dict[int, float] = {}
+        while not stop.wait(period):
+            if not self._node or self.closed:
+                return
+            with self._peers_lock:
+                peers = list(self._peers)
+            now = _time.monotonic()
+            for pid in peers:
+                # per-peer churn-lock scope: the check-then-dial must not
+                # SPAN a rewire (it would install a channel to the pid's
+                # pre-rewire address), but rewire may interleave between
+                # peers — a dial blocks it for at most connect_timeout_ms
+                with self._churn_lock:
+                    with self._peers_lock:
+                        if pid not in self._peers:
+                            continue  # rewired away since the snapshot
+                    if self.connected(pid):
+                        next_try.pop(pid, None)
+                        wait.pop(pid, None)
+                        continue
+                    if now < next_try.get(pid, 0.0):
+                        continue
+                    node = self._node
+                    if not node:
+                        return
+                    ok = self._lib.rt_node_connect(
+                        node, pid, connect_timeout_ms) == 0
+                if ok:
+                    self.reconnects += 1
+                    _C_WIRE_RECONNECT.inc()
+                    if TRACE.enabled:
+                        TRACE.emit("wire_reconnect", node=self.id, dst=pid)
+                    next_try.pop(pid, None)
+                    wait.pop(pid, None)
+                else:
+                    w = min(max_wait, wait.get(pid, period) * backoff)
+                    wait[pid] = w
+                    next_try[pid] = _time.monotonic() + w
 
     def send(self, to: int, tag: Tag, payload: bytes = b"") -> bool:
         """False when the peer is unreachable (reconnect is retried on the
@@ -249,13 +425,23 @@ class HostTransport:
         """Stop the node without freeing it: blocked recv() calls in other
         threads return None (and flag `closed`) so they can unwind before
         close() frees the native object.  Idempotent."""
+        self._stop_reconnect()
         if self._node:
             self._lib.rt_node_stop(self._node)
+
+    def _stop_reconnect(self) -> None:
+        if self._reconn_stop is not None:
+            self._reconn_stop.set()
+        t = self._reconn_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+        self._reconn_thread = None
 
     def close(self) -> None:
         """Free the node.  Callers with receiver threads must stop() and
         join them first (tests/test_host.py::test_lock_manager_service is
         the pattern)."""
+        self._stop_reconnect()
         if self._node:
             self._lib.rt_node_stop(self._node)
             self._lib.rt_node_destroy(self._node)

@@ -54,6 +54,38 @@ def test_flagship_n_interpret_parity():
     np.testing.assert_array_equal(np.asarray(dr), np.asarray(ref_dr))
 
 
+def test_flagship_proc_sharded_lowers_for_tpu():
+    """Multi-chip CI lowering guard (round-5 verdict next #7): the
+    proc-sharded fast path (parallel/mesh.py run_hist_proc_sharded — the
+    distribution recipe for groups wider than one chip's lanes) is
+    jax.export'ed for the TPU platform at the flagship n from this
+    CPU-only box, so a shard_map/collective change that breaks the
+    multi-chip lowering fails HERE, not in a tunnel window.  Skipped
+    (not failed) where the jax build lacks jax.shard_map — the same
+    environments where the sharded path itself cannot run."""
+    if not hasattr(jax, "shard_map"):
+        pytest.skip("this jax build has no jax.shard_map")
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh (conftest XLA_FLAGS)")
+    from jax import export as jexport
+
+    from round_tpu.parallel.mesh import make_mesh, run_hist_proc_sharded
+
+    rnd, state0, mix = _setup(S=8)
+    mesh = make_mesh(8, proc_shards=2)
+
+    def run(state0, mix):
+        return run_hist_proc_sharded(rnd, state0, mix, 4, mesh)
+
+    exp = jexport.export(jax.jit(run), platforms=("tpu",))(state0, mix)
+    assert exp.nr_devices == 8, exp.nr_devices
+    txt = exp.mlir_module()
+    # the receiver-sharded recipe all_gathers the O(n) payload vectors
+    # over ICI; the lowered module must actually contain the collective
+    assert "all_gather" in txt or "all-gather" in txt, \
+        "no all_gather in the proc-sharded lowering"
+
+
 @pytest.mark.parametrize("dot,variant", [("i8", "v2"), ("bf16", "v2"),
                                          ("i8", "flat")])
 def test_flagship_kernel_lowers_for_tpu(dot, variant):

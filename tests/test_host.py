@@ -347,9 +347,13 @@ def _spray_garbage(ports, proto, stop, instance=1):
                                 # spoof a NON-replica id in the hello: a
                                 # replica id would hijack by_peer routing
                                 # (a different, byzantine-liveness attack);
-                                # the bounds guard is what is under test
+                                # the bounds guard is what is under test.
+                                # The hello is id + listen port since the
+                                # view subsystem (an unknown id's port is
+                                # not validated, any legal value passes)
                                 sender = max(sender, 7)
-                                s.sendall(sender.to_bytes(4, "big"))
+                                s.sendall(sender.to_bytes(4, "big")
+                                          + (1).to_bytes(4, "big"))
                                 w = tag.pack() & 0xFFFFFFFFFFFFFFFF
                                 frame = (8 + len(payload)).to_bytes(4, "big") \
                                     + w.to_bytes(8, "big") + payload

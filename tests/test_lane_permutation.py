@@ -1,0 +1,198 @@
+"""Lane-permutation metamorphic tests for the fused engine families
+(VERDICT r5 weak #7).
+
+The claim: relabeling processes is a symmetry of the histogram-round
+protocols.  The fused families consume the mailbox only through per-value
+COUNTS, which are sender-symmetric — so running a permuted world
+(initial state, crash sets and partition sides gathered by the same lane
+permutation) must produce exactly the permuted result, decisions
+included.  Sender-id tie-breaks exist in the stack (ops/mailbox.py
+``argmax_by``/``first_present`` break toward the smallest sender id, and
+core/rounds.py FoldRound.reduce folds in sender-id order) — but the
+count-based fused payloads never reach them, which is precisely what
+this metamorphic suite pins: a future fused family that DOES leak lane
+ids into its decision would break equivariance here.
+
+Equivariance needs the fault model to be label-free data: crash sets and
+partition sides are per-lane ARRAYS (gatherable), but the iid-omission
+hash samples at absolute (src, dst) indices and the rotating victim is
+picked by lane index — so those two families are held off (p8 = 0,
+rotate_down = 0).  The hash-mode kernels still run; their Bernoulli
+threshold is just zero.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from round_tpu.engine import fast
+from round_tpu.models.erb import ErbState, broadcast_io
+from round_tpu.models.failure_detector import EsfdState
+from round_tpu.models.kset import KSetESState
+from round_tpu.models.otr import OtrState
+
+N, S, V = 12, 6, 4
+PERMS = [
+    np.roll(np.arange(N), 5),
+    np.random.default_rng(7).permutation(N),
+]
+
+
+def _mix(key):
+    """Crash + partition families only (see module docstring): scenario 0
+    fault-free, 1-2 crash sets, 3-4 partitions, 5 both."""
+    mix = fast.fault_free(key, S, N)
+    rng = np.random.default_rng(3)
+    crashed = np.zeros((S, N), bool)
+    crashed[1, rng.choice(N, 3, replace=False)] = True
+    crashed[2, rng.choice(N, 2, replace=False)] = True
+    crashed[5, rng.choice(N, 2, replace=False)] = True
+    side = np.zeros((S, N), np.int32)
+    side[3] = rng.integers(0, 2, N)
+    side[4] = rng.integers(0, 2, N)
+    side[5] = rng.integers(0, 2, N)
+    return mix.replace(
+        crashed=jnp.asarray(crashed),
+        crash_round=jnp.asarray([0, 0, 1, 0, 0, 1], jnp.int32),
+        side=jnp.asarray(side),
+        heal_round=jnp.asarray([0, 0, 0, 3, 2, 2], jnp.int32),
+    )
+
+
+def _permute_mix(mix, p):
+    return mix.replace(crashed=mix.crashed[:, p], side=mix.side[:, p])
+
+
+def _permute_state(state, p):
+    """Gather every per-lane axis: [S, n] leaves on axis 1, [S, n, n]
+    leaves (per-receiver-per-sender matrices) on both."""
+
+    def go(leaf):
+        if leaf.ndim >= 2 and leaf.shape[1] == N:
+            leaf = leaf[:, p]
+        if leaf.ndim >= 3 and leaf.shape[2] == N:
+            leaf = leaf[:, :, p]
+        return leaf
+
+    return jax.tree_util.tree_map(go, state)
+
+
+def _assert_equivariant(got_perm, want, p, msg):
+    for (ga, wa), path in zip(
+        zip(jax.tree_util.tree_leaves(got_perm),
+            jax.tree_util.tree_leaves(_permute_state(want, p))),
+        range(10**6),
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(ga), np.asarray(wa), err_msg=f"{msg} leaf {path}")
+
+
+@pytest.mark.parametrize("p", PERMS, ids=["roll", "random"])
+def test_otr_hist_and_loop_kernels_equivariant(p):
+    key = jax.random.PRNGKey(0)
+    mix = _mix(key)
+    init = jax.random.randint(jax.random.fold_in(key, 1), (N,), 0, V,
+                              dtype=jnp.int32)
+    rnd = fast.OtrHist(n_values=V, after_decision=2)
+    rounds = 6
+
+    def run_all(state0, m):
+        hist = fast.run_hist(rnd, state0, lambda s: s.decided, m,
+                             max_rounds=rounds, mode="hash",
+                             interpret=True)
+        loops = {
+            variant: fast.run_otr_loop(rnd, state0, m, max_rounds=rounds,
+                                       mode="hash", interpret=True,
+                                       variant=variant)
+            for variant in ("v2", "flat")
+        }
+        return hist, loops
+
+    base_state = OtrState.fresh(init, S, N)
+    hist, loops = run_all(base_state, mix)
+    hist_p, loops_p = run_all(OtrState.fresh(init[p], S, N),
+                              _permute_mix(mix, p))
+
+    # every scenario must actually decide somewhere or the test is vacuous
+    assert np.asarray(hist[0].decided).any(axis=1).all()
+    _assert_equivariant(hist_p[0], hist[0], p, "run_hist state")
+    np.testing.assert_array_equal(np.asarray(hist_p[2]),
+                                  np.asarray(hist[2])[:, p],
+                                  err_msg="run_hist decided_round")
+    for variant in ("v2", "flat"):
+        _assert_equivariant(loops_p[variant][0], loops[variant][0], p,
+                            f"loop {variant} state")
+        np.testing.assert_array_equal(
+            np.asarray(loops_p[variant][2]),
+            np.asarray(loops[variant][2])[:, p],
+            err_msg=f"loop {variant} decided_round")
+    # and the DECISION VALUES are identical per scenario (relabeling
+    # must not change what the group decides, only who sits where)
+    for s in range(S):
+        dec = np.asarray(hist[0].decision[s])[np.asarray(hist[0].decided[s])]
+        dec_p = np.asarray(hist_p[0].decision[s])[
+            np.asarray(hist_p[0].decided[s])]
+        assert set(dec.tolist()) == set(dec_p.tolist()), s
+
+
+@pytest.mark.parametrize("p", PERMS, ids=["roll", "random"])
+def test_kset_floodmin_style_hist_equivariant(p):
+    key = jax.random.PRNGKey(1)
+    mix = _mix(key)
+    init = jax.random.randint(jax.random.fold_in(key, 1), (N,), 0, 8,
+                              dtype=jnp.int32)
+    t_, k_ = 2, 2
+    rnd = fast.KSetESHist(n_values=8, t=t_, k=k_)
+
+    def state0(iv):
+        return KSetESState(
+            est=jnp.broadcast_to(iv, (S, N)).astype(jnp.int32),
+            can_decide=jnp.zeros((S, N), bool),
+            last_nb=jnp.full((S, N), N, jnp.int32),
+            decided=jnp.zeros((S, N), bool),
+            decision=jnp.full((S, N), -1, jnp.int32),
+        )
+
+    def run(st, m):
+        return fast.run_hist(rnd, st, lambda s: s.decided, m,
+                             max_rounds=6, mode="hash", interpret=True)
+
+    got = run(state0(init), mix)
+    got_p = run(state0(init[p]), _permute_mix(mix, p))
+    _assert_equivariant(got_p[0], got[0], p, "kset state")
+
+
+@pytest.mark.parametrize("p", PERMS, ids=["roll", "random"])
+def test_erb_flood_equivariant(p):
+    key = jax.random.PRNGKey(2)
+    mix = _mix(key)
+    origin = 4
+    io = broadcast_io(origin, 5, N)
+
+    def run(st, m):
+        return fast.run_erb_fast(st, m, max_rounds=8, n_values=8,
+                                 mode="hash", interpret=True)
+
+    got = run(ErbState.fresh(io, S, N), mix)
+    # the permuted world's origin is wherever lane `origin` landed
+    io_p = {k: (np.asarray(v)[p] if np.ndim(v) else v)
+            for k, v in io.items()}
+    got_p = run(ErbState.fresh(io_p, S, N), _permute_mix(mix, p))
+    _assert_equivariant(got_p[0], got[0], p, "erb state")
+
+
+@pytest.mark.parametrize("p", PERMS[:1], ids=["roll"])
+def test_esfd_matrix_state_equivariant(p):
+    """ESFD's last_seen is [S, receiver, sender] — both lane axes must
+    gather, the matrix-state case of the symmetry."""
+    key = jax.random.PRNGKey(3)
+    mix = _mix(key)
+
+    def run(st, m):
+        return fast.run_esfd_fast(st, m, 8, hysteresis=3)
+
+    got = run(EsfdState(last_seen=jnp.zeros((S, N, N), jnp.int32)), mix)
+    got_p = run(EsfdState(last_seen=jnp.zeros((S, N, N), jnp.int32)),
+                _permute_mix(mix, p))
+    _assert_equivariant(got_p[0], got[0], p, "esfd last_seen")

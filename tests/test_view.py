@@ -1,0 +1,457 @@
+"""Live cluster reconfiguration (runtime/view.py + the transport churn
+layer underneath it).
+
+The acceptance spine (ISSUE 3 / DynamicMembership.scala:231-245 parity):
+  * a 4-process host_replica cluster decides ADD and REMOVE MembershipOps
+    by consensus mid-stream, rewires the live wire, and keeps deciding
+    with the new n — agreement checked across both view changes;
+  * a killed-and-restarted replica is re-admitted by the transport
+    auto-reconnect loop (no manual redial), including under a
+    FaultyTransport drop schedule, with wire.reconnect trace events;
+  * a removed replica's stale-id redial cannot hijack a renamed member's
+    channel (the handshake advertises the listen port and the acceptor
+    validates it);
+  * trace_view renders the epoch boundaries.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from round_tpu.runtime.chaos import FaultPlan, FaultyTransport, alloc_ports
+from round_tpu.runtime.membership import Group, Replica
+from round_tpu.runtime.oob import FLAG_NORMAL, FLAG_VIEW, Tag
+from round_tpu.runtime.transport import HostTransport, wire_loads
+from round_tpu.runtime.view import (
+    ADD,
+    REMOVE,
+    View,
+    ViewManager,
+    decode,
+    encode,
+    epoch_behind,
+    parse_view_schedule,
+    view_instance,
+)
+
+
+def _local_group(ports):
+    return Group([Replica(i, "127.0.0.1", p) for i, p in enumerate(ports)])
+
+
+# ---------------------------------------------------------------------------
+# View / op-encoding semantics
+# ---------------------------------------------------------------------------
+
+
+def test_op_encoding_roundtrip_and_range():
+    assert decode(encode(ADD, 7004)) == (ADD, 7004)
+    assert decode(encode(REMOVE, 3)) == (REMOVE, 3)
+    with pytest.raises(ValueError):
+        encode(ADD, 1 << 24)
+
+
+def test_view_apply_add_remove_renames_contiguously():
+    v = View(0, _local_group([7000, 7001, 7002, 7003]))
+    v1 = v.apply(ADD, 7004)
+    assert (v1.epoch, v1.n) == (1, 5)
+    assert (v1.group.get(4).address, v1.group.get(4).port) == \
+        ("127.0.0.1", 7004)
+    v2 = v1.apply(REMOVE, 1)
+    assert (v2.epoch, v2.n) == (2, 4)
+    # compaction rename (Replicas.scala:136-142): old 2,3,4 -> 1,2,3
+    assert [r.port for r in v2.group.replicas] == [7000, 7002, 7003, 7004]
+    ren = v2.group.renaming_from(v1.group)
+    assert ren == {0: 0, 1: None, 2: 1, 3: 2, 4: 3}
+    with pytest.raises(ValueError):
+        v.apply(9, 0)
+
+
+def test_view_wire_roundtrip_and_garbage():
+    v = View(3, _local_group([7000, 7001]))
+    rt = View.from_wire(v.wire())
+    assert rt is not None and rt.epoch == 3 and rt.n == 2
+    assert rt.group.inet_to_id("127.0.0.1", 7001) == 1
+    # the FLAG_VIEW payload crosses the restricted wire unpickler
+    import pickle
+
+    assert View.from_wire(wire_loads(pickle.dumps(v.wire()))).epoch == 3
+    for junk in (None, 42, "x", (1,), (-1, ()), (1, ((1, 2, 3),))):
+        assert View.from_wire(junk) is None
+
+
+def test_epoch_behind_mod256():
+    assert epoch_behind(0, 1)
+    assert epoch_behind(255, 1)     # wraparound: 255 is 2 behind 1
+    assert not epoch_behind(1, 1)
+    assert not epoch_behind(2, 1)   # ahead, not behind
+    assert not epoch_behind(1, 0)
+
+
+def test_parse_view_schedule():
+    s = parse_view_schedule("2:add=7005, 4:remove=1")
+    assert s == {2: (ADD, 7005), 4: (REMOVE, 1)}
+    with pytest.raises(ValueError, match="bad --view-change"):
+        parse_view_schedule("2:grow=1")
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_view_schedule("2:add=1,2:remove=0")
+    assert view_instance(0) == 0xFF01  # reserved: above any data instance
+
+
+# ---------------------------------------------------------------------------
+# ViewManager over a stub transport
+# ---------------------------------------------------------------------------
+
+
+class _StubTransport:
+    def __init__(self, my_id=0):
+        self.id = my_id
+        self.sent = []
+        self.rewired = []
+
+    def send(self, to, tag, payload=b""):
+        self.sent.append((to, tag, payload))
+        return True
+
+    def rewire(self, peers, my_id=None):
+        self.rewired.append((dict(peers), my_id))
+        if my_id is not None:
+            self.id = my_id
+        return {}
+
+
+def test_manager_apply_op_renames_and_rewires():
+    tr = _StubTransport(2)
+    mgr = ViewManager(2, View(0, _local_group([7000, 7001, 7002])), tr)
+    mgr.apply_op(REMOVE, 1)
+    assert (mgr.epoch, mgr.my_id, mgr.view.n) == (1, 1, 2)
+    peers, my_id = tr.rewired[-1]
+    assert my_id == 1 and peers[1] == ("127.0.0.1", 7002)
+    assert mgr.history == [(1, REMOVE, 1)]
+
+
+def test_manager_removal_quiesces_wire():
+    tr = _StubTransport(1)
+    mgr = ViewManager(1, View(0, _local_group([7000, 7001])), tr)
+    mgr.apply_op(REMOVE, 1)
+    assert mgr.removed and mgr.my_id is None
+    # the quiesce: an empty rewire severs every channel, so neither the
+    # reconnect loop nor a late send dials back into the group
+    assert tr.rewired[-1] == ({}, None)
+
+
+def test_manager_epoch_guard_replies_and_flags():
+    tr = _StubTransport(0)
+    mgr = ViewManager(0, View(2, _local_group([7000, 7001])), tr)
+    # matching epoch passes silently
+    assert mgr.check_epoch(1, Tag(instance=1, call_stack=2))
+    assert not tr.sent
+    # a stale peer is answered with FLAG_VIEW carrying the serialized view
+    assert not mgr.check_epoch(1, Tag(instance=1, call_stack=1))
+    to, tag, payload = tr.sent[-1]
+    assert (to, tag.flag) == (1, FLAG_VIEW)
+    assert View.from_wire(wire_loads(payload)).epoch == 2
+    # rate-limited: the immediate repeat does not send again
+    n_sent = len(tr.sent)
+    assert not mgr.check_epoch(1, Tag(instance=1, call_stack=1))
+    assert len(tr.sent) == n_sent
+    # a peer AHEAD flags us stale (the adopt comes via FLAG_VIEW later)
+    assert not mgr.check_epoch(1, Tag(instance=1, call_stack=3))
+    assert mgr.stale
+
+
+def test_manager_adopt_wire_moves_and_detects_removal():
+    tr = _StubTransport(1)
+    mgr = ViewManager(1, View(0, _local_group([7000, 7001, 7002])), tr)
+    # stale/equal epochs are refused
+    assert not mgr.adopt_wire(View(0, _local_group([7000, 7001])).wire())
+    # a newer view renames us by our address (keeps us, drops 7002)
+    assert mgr.adopt_wire((1, (("127.0.0.1", 7000), ("127.0.0.1", 7001))))
+    assert (mgr.epoch, mgr.my_id, mgr.removed) == (1, 1, False)
+    # a view without our address marks us removed and quiesces
+    assert mgr.adopt_wire((2, (("127.0.0.1", 7000),)))
+    assert mgr.removed and tr.rewired[-1] == ({}, None)
+
+
+def test_manager_apply_op_farewells_removed_pid():
+    """The survivor side of a REMOVE sends one FLAG_VIEW to the removed
+    pid BEFORE severing its channel, so a replica that missed the remove
+    decision learns of its exile immediately (review finding: without
+    this, its only path back is the slower redial-to-id-inheritor
+    fallback)."""
+    tr = _StubTransport(0)
+    mgr = ViewManager(0, View(0, _local_group([7000, 7001, 7002])), tr)
+    mgr.apply_op(REMOVE, 2)
+    farewells = [(to, tag) for to, tag, _p in tr.sent
+                 if tag.flag == FLAG_VIEW]
+    assert farewells and farewells[0][0] == 2
+    _to, _tag, payload = tr.sent[0]
+    assert View.from_wire(wire_loads(payload)).epoch == 1
+
+
+def test_removed_replica_that_missed_the_decision_learns_and_exits():
+    """Finding-2 regression: the to-be-removed replica does NOT run the
+    view-change consensus (it 'missed' the decision entirely — no
+    view_schedule), keeps sending old-epoch traffic, and must still
+    discover its removal through the FLAG_VIEW catch-up (farewell, or
+    its redial reaching the member that inherited its id) and exit
+    cleanly instead of burning every instance to max_rounds."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from round_tpu.apps.selector import select
+    from round_tpu.runtime.host import run_instance_loop, serve_decisions
+
+    n, instances = 4, 4
+    algo = select("otr")
+    trs = [HostTransport(i) for i in range(n)]
+    peers = {i: ("127.0.0.1", trs[i].port) for i in range(n)}
+    group = Group([Replica(i, *peers[i]) for i in range(n)])
+    results = {}
+
+    def run(i):
+        mgr = ViewManager(i, View(0, group), trs[i])
+        trs[i].start_reconnect(period_ms=100)
+        # the victim carries NO schedule: it never proposes the remove
+        sched = {2: (REMOVE, 1)} if i != 1 else {}
+        d = run_instance_loop(
+            algo, i, peers, trs[i], instances, timeout_ms=300,
+            value_schedule="uniform", view=mgr, view_schedule=sched)
+        if not mgr.removed:
+            serve_decisions(trs[i], d, idle_ms=1500, max_ms=20000)
+        results[i] = (d, mgr.epoch, mgr.removed)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    for tr in trs:
+        tr.close()
+    assert len(results) == n
+    # the victim adopted the view it never voted on and exited removed
+    d1, epoch1, removed1 = results[1]
+    assert removed1 and epoch1 == 1
+    assert d1[:2] == [1, 2]
+    # survivors agreed on the pre-change instances (the post-change tail
+    # is n=3 OTR — zero fault slack — so only the boundary is asserted)
+    for i in (0, 2, 3):
+        assert results[i][0][:2] == [1, 2], results[i]
+        assert results[i][2] is False
+
+
+# ---------------------------------------------------------------------------
+# Transport churn layer
+# ---------------------------------------------------------------------------
+
+
+def test_auto_reconnect_readmits_restarted_peer_no_manual_redial():
+    """A restarted peer is re-dialed by the reconnect LOOP (backoff),
+    not by a send — the receiver-only node's lifeline; wire.reconnect
+    appears in the trace (acceptance bullet 2)."""
+    from round_tpu.obs.trace import TRACE
+
+    TRACE.enable(node=None, capacity=4096)
+    try:
+        with HostTransport(0) as a:
+            b = HostTransport(1)
+            port = b.port
+            a.add_peer(1, "127.0.0.1", port)
+            assert a.send(1, Tag(instance=1), b"pre")
+            assert b.recv(2000)[2] == b"pre"
+            b.close()
+            a.start_reconnect(period_ms=50)
+            time.sleep(0.25)  # the loop observes the dead channel
+            b = HostTransport(1, port)
+            deadline = time.time() + 10
+            while not a.connected(1) and time.time() < deadline:
+                time.sleep(0.05)
+            assert a.connected(1), "reconnect loop never re-dialed"
+            assert a.reconnects >= 1
+            assert a.send(1, Tag(instance=2), b"post")
+            got = b.recv(2000)
+            assert got is not None and got[2] == b"post"
+            b.close()
+            assert any(e["ev"] == "wire_reconnect"
+                       for e in TRACE.events())
+    finally:
+        TRACE.disable()
+        TRACE.clear()
+
+
+def test_auto_reconnect_composes_with_chaos_drop_schedule():
+    """Churn x wire faults: the FaultyTransport drop schedule keeps
+    faulting across a peer restart + auto-reconnect — fault decisions are
+    pure functions of (seed, src, dst, round), so the restart changes the
+    physical channel, never the schedule."""
+    plan = FaultPlan(seed=5, drop=0.5)
+    with HostTransport(0) as raw:
+        ft = FaultyTransport(raw, plan, n=2)
+        b = HostTransport(1)
+        port = b.port
+        ft.add_peer(1, "127.0.0.1", port)
+        raw.start_reconnect(period_ms=50)
+
+        def dropped_rounds(upto):
+            return {r for r in range(upto)
+                    if ft._event(0x00000000, 0, 1, r, plan.drop)}
+
+        before = dropped_rounds(64)
+        for r in range(8):
+            ft.send(1, Tag(instance=1, round=r), b"x")
+        got_rounds = set()
+        while True:
+            got = b.recv(500)
+            if got is None:
+                break
+            got_rounds.add(got[1].round)
+        assert got_rounds == {r for r in range(8) if r not in before}
+        b.close()
+        b = HostTransport(1, port)  # restart on the same port
+        deadline = time.time() + 10
+        while not raw.connected(1) and time.time() < deadline:
+            time.sleep(0.05)
+        assert raw.connected(1)
+        # the schedule is unchanged post-reconnect
+        assert dropped_rounds(64) == before
+        for r in range(8, 16):
+            ft.send(1, Tag(instance=1, round=r), b"y")
+        got_rounds = set()
+        while True:
+            got = b.recv(500)
+            if got is None:
+                break
+            got_rounds.add(got[1].round)
+        assert got_rounds == {r for r in range(8, 16) if r not in before}
+        b.close()
+
+
+def test_rewire_rename_rehandshakes_kept_channels():
+    """After an id rename, EVERY channel re-handshakes: a kept channel
+    would stamp the renamed node's frames with its old id forever."""
+    with HostTransport(0) as a, HostTransport(2) as b:
+        a.add_peer(2, "127.0.0.1", b.port)
+        b.add_peer(0, "127.0.0.1", a.port)
+        assert a.send(2, Tag(instance=1), b"x")
+        assert b.recv(2000)[0] == 0
+        stats = b.rewire({0: ("127.0.0.1", a.port),
+                          1: ("127.0.0.1", b.port)}, my_id=1)
+        assert stats["rehandshaked"] == 1
+        a.rewire({0: ("127.0.0.1", a.port), 1: ("127.0.0.1", b.port)})
+        deadline = time.time() + 5
+        got = None
+        while time.time() < deadline and got is None:
+            b.send(0, Tag(instance=2), b"renamed")
+            got = a.recv(300)
+        assert got is not None and got[0] == 1 and got[2] == b"renamed"
+
+
+def test_stale_id_redial_cannot_hijack_renamed_channel():
+    """The channel-hijack the handshake listen-port check exists for: a
+    REMOVED replica redialing with its stale id must not capture the
+    by_peer slot of the member that inherited the id."""
+    with HostTransport(0) as a, HostTransport(2) as survivor:
+        removed = HostTransport(1)
+        # post-remove view at a: pid 1 is the SURVIVOR's address
+        a.add_peer(1, "127.0.0.1", survivor.port)
+        # the removed replica (id 1, its own listen port) dials a
+        removed.add_peer(0, "127.0.0.1", a.port)
+        removed.send(0, Tag(instance=1), b"stale-hello")
+        time.sleep(0.3)  # let a's event loop process + reject the channel
+        # a's frames for pid 1 must reach the survivor, not the zombie
+        deadline = time.time() + 5
+        got = None
+        while time.time() < deadline and got is None:
+            a.send(1, Tag(instance=3), b"for-survivor")
+            got = survivor.recv(300)
+        assert got is not None and got[2] == b"for-survivor"
+        assert removed.recv(200) is None  # the zombie heard nothing
+        removed.close()
+
+
+# ---------------------------------------------------------------------------
+# The end-to-end acceptance pin: 4-process cluster, consensus ADD then
+# REMOVE on the live wire, agreement across both view changes
+# ---------------------------------------------------------------------------
+
+
+def test_host_cluster_add_and_remove_by_consensus():
+    """DynamicMembership.scala:231-245 on the real wire: four
+    host_replica OS processes decide an ADD (a fifth, silently-waiting
+    replica joins via the catch-up path) and then a REMOVE (pid 1 exits
+    cleanly, ids compact) by consensus mid-stream, and every surviving
+    decision log agrees.  Trace files must carry the view.change /
+    wire.reconnect story and trace_view must render the epoch
+    boundaries."""
+    import tempfile
+
+    from round_tpu.runtime.chaos import cluster_env
+
+    instances = 6
+    d = tempfile.mkdtemp(prefix="round_tpu_view_")
+    ports = alloc_ports(5)
+    member_peers = ",".join(f"127.0.0.1:{p}" for p in ports[:4])
+    all_peers = ",".join(f"127.0.0.1:{p}" for p in ports)
+    sched = f"2:add={ports[4]},4:remove=1"
+    env = cluster_env()
+
+    def argv(i, peers, extra):
+        return [sys.executable, "-m", "round_tpu.apps.host_replica",
+                "--id", str(i), "--peers", peers, "--algo", "otr",
+                "--instances", str(instances), "--timeout-ms", "300",
+                "--value-schedule", "uniform", "--view-change", sched,
+                "--linger-ms", "4000", "--seed", "3",
+                "--trace", os.path.join(d, f"trace-{i}.jsonl")] + extra
+
+    procs = [subprocess.Popen(
+        argv(i, member_peers, []), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=env) for i in range(4)]
+    procs.append(subprocess.Popen(
+        argv(4, all_peers, ["--view-epoch", "1", "--join-wait", "120000"]),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env))
+    outs = {}
+    for i, p in enumerate(procs):
+        stdout, stderr = p.communicate(timeout=240)
+        assert p.returncode == 0, f"replica {i}: {stderr[-2000:]}"
+        outs[i] = json.loads(stdout.strip().splitlines()[-1])
+
+    # uniform schedule: decision for instance k is (base + k) % 5 with
+    # base 0 (no --value given) regardless of faults or membership
+    want = [inst % 5 for inst in range(1, instances + 1)]
+    for i in (0, 2, 3, 4):
+        o = outs[i]
+        assert o["decisions"] == want, (i, o["decisions"], want)
+        assert o["view_epoch"] == 2 and o["view_n"] == 4
+        assert not o["removed"]
+    # survivors' renamed ids are the contiguous compaction
+    assert sorted(outs[i]["view_id"] for i in (0, 2, 3, 4)) == [0, 1, 2, 3]
+    # the removed replica decided everything BEFORE the remove, agreed
+    # with the group, and exited cleanly
+    o1 = outs[1]
+    assert o1["removed"] and o1["view_id"] is None
+    assert o1["decisions"][:4] == want[:4]
+    assert o1["decisions"][4:] == [None, None]
+
+    # the observability story: view changes + rewires are in the traces,
+    # and trace_view renders both epoch boundaries
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import trace_view
+
+    paths = [os.path.join(d, f"trace-{i}.jsonl") for i in range(5)]
+    events = trace_view.load_traces(paths)
+    assert any(e["ev"] == "view_change" and e.get("op") == "add"
+               for e in events)
+    assert any(e["ev"] == "view_change" and e.get("op") == "remove"
+               for e in events)
+    assert any(e["ev"] == "wire_rewire" for e in events)
+    epochs = trace_view.view_epochs(events)
+    assert [ep["epoch"] for ep in epochs] == [1, 2]
+    assert epochs[0]["n"] == 5 and epochs[1]["n"] == 4
+    report = trace_view.report(paths)
+    assert "epoch boundaries" in report and "epoch 2" in report

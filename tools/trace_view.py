@@ -105,6 +105,34 @@ def round_latencies(events: Sequence[Dict[str, Any]]
     return out
 
 
+def view_epochs(events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Epoch boundaries of the view subsystem (runtime/view.py): one
+    record per epoch that appears in ``view_change`` (consensus-applied)
+    or ``view_adopt`` (FLAG_VIEW catch-up) events — when the epoch first
+    existed, the op that created it, the group size after it, and which
+    nodes crossed the boundary by which mechanism."""
+    out: Dict[int, Dict[str, Any]] = {}
+    for e in events:
+        ev = e.get("ev")
+        if ev not in ("view_change", "view_adopt"):
+            continue
+        ep = int(e.get("epoch", -1))
+        rec = out.setdefault(ep, {
+            "epoch": ep, "t": e.get("t", 0.0), "op": None, "n": None,
+            "applied": [], "adopted": [],
+        })
+        rec["t"] = min(rec["t"], e.get("t", rec["t"]))
+        if ev == "view_change":
+            if rec["op"] is None:
+                rec["op"] = f"{e.get('op')}({e.get('arg')})"
+            rec["applied"].append(e.get("node"))
+        else:
+            rec["adopted"].append(e.get("node"))
+        if e.get("n") is not None:
+            rec["n"] = e.get("n")
+    return [out[k] for k in sorted(out)]
+
+
 def correlate_faults(events: Sequence[Dict[str, Any]]) -> Dict[str, List]:
     """Cross-reference every injected chaos fault against the downstream
     event it caused at the receiver.
@@ -229,17 +257,32 @@ def report(paths: Sequence[str], show_timeline: bool = False,
     events = load_traces(paths)
     lat = round_latencies(events)
     corr = correlate_faults(events)
+    epochs = view_epochs(events)
     if as_json:
         return json.dumps({
             "files": list(paths),
             "events": len(events),
             "round_latency_ms": lat,
+            "view_epochs": epochs,
             "faults": {k: len(v) for k, v in corr.items()},
             "correlation": corr,
         }, indent=1)
     nodes = sorted({e["node"] for e in events if "node" in e})
     out = [f"# trace_view: {len(events)} events from {len(paths)} file(s), "
            f"nodes {nodes}"]
+    if epochs:
+        t0 = min(e["t"] for e in events if "t" in e)
+        out.append("")
+        out.append("## view changes (epoch boundaries)")
+        for ep in epochs:
+            out.append(
+                f"  +{ep['t'] - t0:8.3f}s epoch {ep['epoch']}: "
+                f"op={ep['op'] or 'adopted-only'} n={ep['n']} "
+                f"applied-by {sorted(x for x in ep['applied'] if x is not None)} "
+                f"adopted-by {sorted(x for x in ep['adopted'] if x is not None)}")
+        n_reconn = sum(1 for e in events if e.get("ev") == "wire_reconnect")
+        n_rewire = sum(1 for e in events if e.get("ev") == "wire_rewire")
+        out.append(f"  wire: {n_rewire} rewires, {n_reconn} reconnects")
     if lat:
         out.append("")
         out.append("## per-round latency (ms, across instances and nodes)")
