@@ -1,0 +1,39 @@
+"""Static analysis over round code — the macro-time gate.
+
+The reference inspects round closures before they run and rejects
+ill-formed protocols statically (SURVEY §1, Verifier.scala); this package
+is that gate for the tensor port: every registered model's send/update is
+abstractly traced on CPU (jax.eval_shape / jax.make_jaxpr — nothing
+executes, no accelerator backend initializes) and its source is scanned by
+AST passes, producing typed findings across five rule families:
+
+  comm-closure, tpu-lowerability, recompile-hazard, purity, spec-coherence
+
+CLI: ``python -m round_tpu.apps.lint [--all|MODEL] [--json] [--baseline …]``
+Catalog + suppression workflow: docs/ANALYSIS.md.
+"""
+
+from round_tpu.analysis.findings import (
+    FAMILIES,
+    Finding,
+    Suppression,
+    apply_baseline,
+    default_baseline_path,
+    load_baseline,
+)
+from round_tpu.analysis.lint import lint_all, lint_model
+from round_tpu.analysis.registry import BY_NAME, REGISTRY, ModelEntry
+
+__all__ = [
+    "FAMILIES",
+    "Finding",
+    "Suppression",
+    "apply_baseline",
+    "default_baseline_path",
+    "load_baseline",
+    "lint_all",
+    "lint_model",
+    "BY_NAME",
+    "REGISTRY",
+    "ModelEntry",
+]

@@ -1,0 +1,389 @@
+"""AST passes over round code: purity and recompile-hazard rules.
+
+These run on the *source* of every round method (send/update/pre and the
+EventRound/FoldRound slots) plus the algorithm's traced entry points
+(make_init_state, decided, decision).  They catch the defects abstract
+tracing cannot see or sees too late:
+
+  purity/*            — effects inside traced code: unseeded host RNG and
+                        clock reads become trace-time constants (silent
+                        nondeterminism across recompiles), host callbacks
+                        and prints break the pure-function contract, and
+                        mutation of closure state (self.x = ...) leaks
+                        across vmap lanes and jit caches.
+  recompile-hazard/*  — Python-value-dependent control flow on traced
+                        values (``if mbox.size() > 0:``) and forced
+                        concretization (int()/float()/.item()/np.* on a
+                        tracer): either a trace-time crash or a fresh jit
+                        compile per concrete value.
+
+The pass is deliberately shallow — one function body at a time, a
+fixed-point taint of local names fed from traced parameters (everything
+but ``self``/``ctx``) and the traced ``ctx.r``/``ctx.id``/``ctx.rng``
+attributes.  Statements guarded by an ``isinstance(..., Tracer)`` test are
+host-only by construction and are skipped (the make_init_state eager-check
+idiom, models/otr.py).  Module-level helpers called from round code are
+outside its scope; the jaxpr rules (tracerules.py) cover what they compute.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Iterable, List, Optional, Sequence, Set, Tuple
+
+from round_tpu.analysis.findings import Finding, relpath
+
+#: the Round/EventRound/FoldRound slots the engines trace
+ROUND_METHODS = (
+    "pre", "send", "update", "receive", "finish_round",
+    "zero", "lift", "combine", "post", "go_ahead", "reduce",
+    "expected_nbr_messages",
+)
+
+#: Algorithm entry points traced by init_lanes / the engines
+ALGO_METHODS = ("make_init_state", "decided", "decision")
+
+#: modules whose classes are framework plumbing, never scanned
+_FRAMEWORK_PREFIXES = ("round_tpu.core.", "round_tpu.ops.")
+
+_TRACED_CTX_ATTRS = ("ctx.r", "ctx.id", "ctx.rng")
+
+_CLOCK_CALLS = {
+    "time", "time_ns", "monotonic", "monotonic_ns",
+    "perf_counter", "perf_counter_ns", "process_time", "process_time_ns",
+}
+
+_CONCRETIZE_METHODS = {"item", "tolist", "__index__", "__int__", "__float__"}
+
+#: wide-dtype names checked at the AST level.  This mirrors
+#: engine.fast.TPU_WIDE_DTYPES but must be caught in SOURCE: with
+#: jax_enable_x64 off (every path in this repo) jax silently truncates
+#: f64/i64 to f32/i32 before they ever reach a jaxpr, so the jaxpr walk in
+#: tracerules can only see creep when x64 is on — the written intent is
+#: what the rule polices.
+_WIDE_DTYPE_NAMES = {"float64", "int64", "uint64", "complex64", "complex128",
+                     "double", "longdouble"}
+
+
+def _dotted(node) -> Optional[str]:
+    """'np.random.rand' for an Attribute chain rooted at a Name, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_isinstance_call(node) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "isinstance")
+
+
+def _has_tracer_guard(test) -> bool:
+    """True when an if-test dispatches on isinstance(..., Tracer) — the
+    sanctioned host-only-branch idiom; its guarded body never traces."""
+    for sub in ast.walk(test):
+        if _is_isinstance_call(sub):
+            for arg in sub.args[1:]:
+                d = _dotted(arg) or ""
+                if "Tracer" in d:
+                    return True
+    return False
+
+
+#: attributes that are host-static even on a tracer (branching on them is
+#: shape dispatch, not value-dependent control flow).  NOTE: `.size` is
+#: deliberately absent — Mailbox.size() is the traced message count.
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "weak_type"}
+
+
+def _traced(node, tainted: Set[str]) -> bool:
+    """Does this expression (transitively) read a traced value?"""
+    if _is_isinstance_call(node):
+        return False  # isinstance is a host-side type test even on tracers
+    if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+        return False  # x.shape/x.dtype are static attributes of a tracer
+    if isinstance(node, ast.Name) and node.id in tainted:
+        return True
+    if isinstance(node, ast.Attribute) and _dotted(node) in _TRACED_CTX_ATTRS:
+        return True
+    return any(_traced(c, tainted) for c in ast.iter_child_nodes(node))
+
+
+def _target_names(target) -> Iterable[str]:
+    for sub in ast.walk(target):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+
+
+def _collect_taint(fn: ast.FunctionDef) -> Set[str]:
+    """Traced parameters + locals assigned from traced expressions, to a
+    fixed point (order-free over-approximation)."""
+    tainted = {
+        a.arg
+        for a in (fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs)
+        if a.arg not in ("self", "ctx")
+    }
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            targets, value = None, None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.NamedExpr):
+                targets, value = [node.target], node.value
+            if value is None or targets is None:
+                continue
+            if _traced(value, tainted):
+                for t in targets:
+                    for name in _target_names(t):
+                        if name not in tainted:
+                            tainted.add(name)
+                            changed = True
+    return tainted
+
+
+class _Scanner:
+    def __init__(self, model: str, file: str, tainted: Set[str]):
+        self.model = model
+        self.file = file
+        self.tainted = tainted
+        self.findings: List[Finding] = []
+
+    def _emit(self, rule, severity, node, message, hint):
+        self.findings.append(Finding(
+            rule=rule, severity=severity, model=self.model, file=self.file,
+            line=getattr(node, "lineno", 0), message=message, hint=hint,
+        ))
+
+    # -- one node's checks --------------------------------------------------
+
+    def _check_call(self, node: ast.Call):
+        d = _dotted(node.func) or ""
+        root = d.split(".", 1)[0]
+        if d.startswith(("np.random.", "numpy.random.")) or root == "random":
+            self._emit(
+                "purity/unseeded-random", "error", node,
+                f"host RNG call {d}() inside traced round code — the draw "
+                f"happens once at trace time and is baked into the "
+                f"compiled program as a constant",
+                "use the per-(scenario, lane, round) key on ctx.rng "
+                "(jax.random.*) or the deterministic hash coin "
+                "(ops.fused.hash_coin)",
+            )
+        elif root in ("time", "datetime") and (
+                root == "datetime" or d.split(".")[-1] in _CLOCK_CALLS):
+            self._emit(
+                "purity/time", "error", node,
+                f"clock read {d}() inside traced round code — evaluated "
+                f"once at trace time, constant thereafter",
+                "thread time through the state pytree or ctx.r; wall-clock "
+                "belongs to the host runtime, not round code",
+            )
+        elif d in ("jax.random.PRNGKey", "jax.random.key"):
+            self._emit(
+                "purity/hardcoded-key", "warn", node,
+                f"{d}(...) inside traced round code — a fresh key literal "
+                f"per round gives every lane and round the same stream",
+                "derive randomness from ctx.rng (already unique per "
+                "scenario/lane/round)",
+            )
+        elif (d.startswith(("jax.debug.", "host_callback.", "hcb."))
+              or d.split(".")[-1] in ("io_callback", "pure_callback")):
+            self._emit(
+                "purity/host-callback", "warn", node,
+                f"host callback {d}() inside traced round code — a host "
+                f"round-trip per invocation; on TPU this stalls the step",
+                "keep round code pure; record into the state pytree and "
+                "inspect post-run (obs/trace.py)",
+            )
+        elif isinstance(node.func, ast.Name) and node.func.id == "print":
+            self._emit(
+                "purity/host-callback", "warn", node,
+                "print() inside traced round code runs at trace time only "
+                "(never per execution) — it is not doing what it looks like",
+                "use jax.debug.print for traced values during debugging, "
+                "and remove before shipping",
+            )
+        elif (isinstance(node.func, ast.Name)
+              and node.func.id in ("int", "float", "bool")
+              and any(_traced(a, self.tainted) for a in node.args)):
+            self._emit(
+                "recompile-hazard/concretize", "error", node,
+                f"{node.func.id}() on a traced value — forces "
+                f"concretization: a trace-time error under jit, or a fresh "
+                f"compile per concrete value outside it",
+                "keep the value symbolic (jnp.where / .astype); only "
+                "static config (self.*, ctx.n) may be concretized",
+            )
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr in _CONCRETIZE_METHODS
+              and _traced(node.func.value, self.tainted)):
+            self._emit(
+                "recompile-hazard/concretize", "error", node,
+                f".{node.func.attr}() on a traced value forces a host "
+                f"transfer/concretization inside round code",
+                "keep the value on-device and symbolic",
+            )
+        elif (root in ("np", "numpy")
+              and any(_traced(a, self.tainted) for a in node.args)):
+            self._emit(
+                "recompile-hazard/concretize", "error", node,
+                f"{d}() applied to a traced value — numpy eagerly "
+                f"concretizes its arguments (trace-time error under jit)",
+                "use the jnp equivalent so the op stays in the traced "
+                "program",
+            )
+
+    def _check_wide_dtype(self, node):
+        """Wide-dtype creep as WRITTEN (jnp.float64 / astype('int64') …) —
+        with x64 off jax truncates these before the jaxpr, so the source
+        mention is the only reliable signal (tpu-lowerability family)."""
+        named = None
+        if isinstance(node, ast.Attribute) and node.attr in _WIDE_DTYPE_NAMES:
+            root = (_dotted(node) or "").split(".", 1)[0]
+            if root in ("np", "numpy", "jnp", "jax"):
+                named = f"{_dotted(node)}"
+        elif isinstance(node, ast.Constant) and node.value in _WIDE_DTYPE_NAMES:
+            named = f"{node.value!r}"
+        if named:
+            self._emit(
+                "tpu-lowerability/wide-dtype", "error", node,
+                f"round code asks for the wide dtype {named} — past the "
+                f"engine's bf16/i8 design points "
+                f"(engine.fast.TPU_WIDE_DTYPES); with jax_enable_x64 off "
+                f"it silently truncates, with it on it forces wide TPU "
+                f"layouts",
+                "keep payloads and state in i32/f32-or-narrower; the fused "
+                "paths carry counts in i8/bf16",
+            )
+
+    def _check_stmt(self, node):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                        and t.value.id in ("self", "ctx"):
+                    self._emit(
+                        "purity/closure-mutation", "error", t,
+                        f"assignment to {t.value.id}.{t.attr} inside traced "
+                        f"round code — closure state mutates at trace time "
+                        f"and leaks across vmap lanes and jit cache entries",
+                        "round state lives in the state pytree "
+                        "(state.replace(...)); signal exit via "
+                        "ctx.exit_at_end_of_round",
+                    )
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            self._emit(
+                "purity/closure-mutation", "error", node,
+                f"{'global' if isinstance(node, ast.Global) else 'nonlocal'} "
+                f"statement inside traced round code",
+                "round functions must be pure per-lane functions of "
+                "(ctx, state, mailbox)",
+            )
+
+    def _branch_finding(self, kind: str, node):
+        self._emit(
+            "recompile-hazard/traced-branch", "error", node,
+            f"Python {kind} on a traced value — under jit this is a "
+            f"trace-time TracerBoolConversionError; eagerly it forces a "
+            f"fresh compile per concrete value",
+            "express the branch as data: jnp.where / lax.select on the "
+            "condition (a lane mask, not control flow)",
+        )
+
+    # -- recursive walk (skips Tracer-guarded host-only bodies) -------------
+
+    def visit(self, node):
+        if isinstance(node, ast.If) and _has_tracer_guard(node.test):
+            for child in node.orelse:
+                self.visit(child)
+            return
+        if isinstance(node, ast.If) and _traced(node.test, self.tainted):
+            self._branch_finding("if", node)
+        elif isinstance(node, ast.While) and _traced(node.test, self.tainted):
+            self._branch_finding("while", node)
+        elif isinstance(node, ast.IfExp) and _traced(node.test, self.tainted):
+            self._branch_finding("conditional expression", node)
+        elif isinstance(node, ast.Assert) and _traced(node.test, self.tainted):
+            self._branch_finding("assert", node)
+        elif isinstance(node, ast.For) and _traced(node.iter, self.tainted):
+            self._branch_finding("for-loop bound", node)
+        if isinstance(node, ast.Call):
+            self._check_call(node)
+        self._check_wide_dtype(node)
+        self._check_stmt(node)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+
+
+def _scannable(cls) -> bool:
+    mod = getattr(cls, "__module__", "")
+    return not any(mod.startswith(p) for p in _FRAMEWORK_PREFIXES)
+
+
+def _class_methods(cls, names: Sequence[str]):
+    """(method name, function object) for methods *defined on* cls (not
+    inherited) whose name is in `names`."""
+    for name in names:
+        fn = cls.__dict__.get(name)
+        if fn is None:
+            continue
+        fn = getattr(fn, "__func__", fn)
+        if callable(fn):
+            yield name, fn
+
+
+def scan_function(model: str, fn) -> List[Finding]:
+    """Run the purity/recompile passes over one traced function."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        path = inspect.getsourcefile(fn)
+        first = fn.__code__.co_firstlineno
+    except (OSError, TypeError):
+        return []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+    ast.increment_lineno(tree, first - 1)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return []
+    scanner = _Scanner(model, relpath(path), _collect_taint(fdef))
+    for stmt in fdef.body:
+        scanner.visit(stmt)
+    return scanner.findings
+
+
+def ast_rules(model: str, algo) -> List[Finding]:
+    """Purity + recompile-hazard findings for every traced method of the
+    algorithm: its rounds' DSL slots and its own traced entry points."""
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, str]] = set()  # (qualified class, method) dedupe
+
+    def scan(cls, names):
+        if not _scannable(cls):
+            return
+        for name, fn in _class_methods(cls, names):
+            key = (f"{cls.__module__}.{cls.__qualname__}", name)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.extend(scan_function(model, fn))
+
+    for rnd in getattr(algo, "rounds", ()):
+        for cls in type(rnd).__mro__:
+            scan(cls, ROUND_METHODS)
+    for cls in type(algo).__mro__:
+        scan(cls, ALGO_METHODS)
+    return findings

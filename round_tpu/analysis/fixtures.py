@@ -1,0 +1,236 @@
+"""The linter's self-test corpus: deliberately broken toy algorithms.
+
+One tiny model per rule family, each carrying exactly the defect its rule
+catches (plus one clean model that must produce ZERO findings).  These are
+NOT in the main registry — tests/test_analysis.py lints them directly and
+pins the golden (rule, file:line) findings; docs/ANALYSIS.md quotes them as
+the example finding per rule.
+
+Every `# lint:` comment marks the defect line the golden test anchors on.
+"""
+
+from __future__ import annotations
+
+import time
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.analysis.registry import ModelEntry
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import Round, RoundCtx, broadcast
+from round_tpu.ops.mailbox import Mailbox
+from round_tpu.spec.dsl import Spec
+
+
+@flax.struct.dataclass
+class ToyState:
+    x: jnp.ndarray        # int32
+    decided: jnp.ndarray  # bool
+    decision: jnp.ndarray
+
+
+class _ToyBase(Algorithm):
+    def make_init_state(self, ctx: RoundCtx, io) -> ToyState:
+        return ToyState(
+            x=jnp.asarray(io["initial_value"], dtype=jnp.int32),
+            decided=jnp.asarray(False),
+            decision=jnp.asarray(-1, dtype=jnp.int32),
+        )
+
+    def decided(self, state):
+        return state.decided
+
+    def decision(self, state):
+        return state.decision
+
+
+# -- comm-closure: send/update dtype mismatch -------------------------------
+
+
+class DtypeDriftRound(Round):
+    def send(self, ctx: RoundCtx, state: ToyState):
+        return broadcast(ctx, state.x)
+
+    def update(self, ctx: RoundCtx, state: ToyState, mbox: Mailbox):
+        mean = mbox.masked_sum(mbox.values.astype(jnp.float32)) / ctx.n
+        return state.replace(x=mean)  # lint: comm-closure/state-drift
+
+
+class DtypeDrift(_ToyBase):
+    """x silently becomes float32 after one round — breaks the scan carry."""
+
+    def __init__(self):
+        self.rounds = (DtypeDriftRound(),)
+
+
+# -- comm-closure: update consumes a payload key send never produced --------
+
+
+class MailboxMisuseRound(Round):
+    def send(self, ctx: RoundCtx, state: ToyState):
+        return broadcast(ctx, {"est": state.x})
+
+    def update(self, ctx: RoundCtx, state: ToyState, mbox: Mailbox):
+        got = mbox.values["vote"]  # lint: comm-closure/mailbox
+        return state.replace(x=jnp.max(jnp.where(mbox.mask, got, 0)))
+
+
+class MailboxMisuse(_ToyBase):
+    """update reads mbox.values['vote'] but send broadcast {'est': ...}."""
+
+    def __init__(self):
+        self.rounds = (MailboxMisuseRound(),)
+
+
+# -- purity: unseeded host randomness + clock reads -------------------------
+
+
+class ImpureRound(Round):
+    def send(self, ctx: RoundCtx, state: ToyState):
+        return broadcast(ctx, state.x)
+
+    def update(self, ctx: RoundCtx, state: ToyState, mbox: Mailbox):
+        coin = np.random.rand()  # lint: purity/unseeded-random
+        t0 = time.time()  # lint: purity/time
+        self.last_round = t0  # lint: purity/closure-mutation
+        x = jnp.where(coin > 0.5, state.x + 1, state.x)
+        return state.replace(x=x.astype(jnp.int32))
+
+
+class ImpureToy(_ToyBase):
+    """Host RNG / clock / closure mutation inside traced round code."""
+
+    def __init__(self):
+        self.rounds = (ImpureRound(),)
+
+
+# -- spec-coherence: formula references a field that does not exist ---------
+
+
+class TypoSpec(Spec):
+    def __init__(self):
+        self.properties = (
+            ("Agreement",
+             # lint: spec-coherence/missing-field ('decidedd' is a typo)
+             lambda e: e.P.forall(lambda i: ~i.decidedd | (i.decision >= 0))),
+        )
+
+
+class SpecTypoRound(Round):
+    def send(self, ctx: RoundCtx, state: ToyState):
+        return broadcast(ctx, state.x)
+
+    def update(self, ctx: RoundCtx, state: ToyState, mbox: Mailbox):
+        return state.replace(x=mbox.masked_sum().astype(jnp.int32))
+
+
+class SpecTypo(_ToyBase):
+    """Well-formed rounds; the spec formula typos a state field."""
+
+    def __init__(self):
+        self.rounds = (SpecTypoRound(),)
+        self.spec = TypoSpec()
+
+
+# -- tpu-lowerability: integer reduction on the TPU path --------------------
+
+
+class IntReduceRound(Round):
+    def send(self, ctx: RoundCtx, state: ToyState):
+        return broadcast(ctx, state.x)
+
+    def update(self, ctx: RoundCtx, state: ToyState, mbox: Mailbox):
+        lo = mbox.masked_min()  # lint: tpu-lowerability/int-reduce
+        wide = lo.astype(jnp.float64)  # lint: tpu-lowerability/wide-dtype
+        return state.replace(x=wide.astype(jnp.int32))
+
+
+class IntReduceOnTpu(_ToyBase):
+    """min-reduction over int32 (the known TPU lowering failure class) plus
+    f64 creep — which jax silently truncates with x64 off, so only the
+    source-level rule can see it."""
+
+    def __init__(self):
+        self.rounds = (IntReduceRound(),)
+
+
+# -- recompile-hazard: Python branching on a traced value -------------------
+
+
+class TracedBranchRound(Round):
+    def send(self, ctx: RoundCtx, state: ToyState):
+        return broadcast(ctx, state.x)
+
+    def update(self, ctx: RoundCtx, state: ToyState, mbox: Mailbox):
+        if mbox.size() > 0:  # lint: recompile-hazard/traced-branch
+            return state.replace(x=state.x + 1)
+        return state
+
+
+class TracedBranch(_ToyBase):
+    """`if` on a traced mailbox count: trace-time crash under jit."""
+
+    def __init__(self):
+        self.rounds = (TracedBranchRound(),)
+
+
+# -- the clean control: must produce ZERO findings --------------------------
+
+
+class FloodOrRound(Round):
+    """Bool-OR flooding: pure, bool/sum reductions only, fixed-point state."""
+
+    def send(self, ctx: RoundCtx, state: ToyState):
+        return broadcast(ctx, state.x)
+
+    def update(self, ctx: RoundCtx, state: ToyState, mbox: Mailbox):
+        heard = mbox.count(lambda v: v > 0)
+        x = jnp.where(heard > 0, jnp.asarray(1, state.x.dtype), state.x)
+        deciding = ctx.r >= 2
+        ctx.exit_at_end_of_round(deciding)
+        return state.replace(
+            x=x,
+            decided=state.decided | deciding,
+            decision=jnp.where(deciding & ~state.decided, x, state.decision),
+        )
+
+
+class CleanSpec(Spec):
+    def __init__(self):
+        self.properties = (
+            ("Irrevocability",
+             lambda e: e.P.forall(
+                 lambda i: ~i.old.decided | (i.decided & (i.decision == i.old.decision))
+             )),
+        )
+
+
+class CleanToy(_ToyBase):
+    """The zero-findings control model."""
+
+    def __init__(self):
+        self.rounds = (FloodOrRound(),)
+        self.spec = CleanSpec()
+
+
+def _entry(name, cls, note):
+    def build(cls=cls):
+        return cls(), {"initial_value": np.arange(4, dtype=np.int32) % 2}
+
+    return ModelEntry(name, build, n=4, note=note)
+
+
+FIXTURES = (
+    _entry("fixture-dtype-drift", DtypeDrift, "comm-closure/state-drift demo"),
+    _entry("fixture-mailbox-misuse", MailboxMisuse, "comm-closure/mailbox demo"),
+    _entry("fixture-impure", ImpureToy, "purity demos (rng/clock/mutation)"),
+    _entry("fixture-spec-typo", SpecTypo, "spec-coherence/missing-field demo"),
+    _entry("fixture-int-reduce", IntReduceOnTpu, "tpu-lowerability/int-reduce demo"),
+    _entry("fixture-traced-branch", TracedBranch, "recompile-hazard demo"),
+    _entry("fixture-clean", CleanToy, "the zero-findings control"),
+)
+
+FIXTURES_BY_NAME = {e.name: e for e in FIXTURES}

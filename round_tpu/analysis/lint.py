@@ -1,0 +1,58 @@
+"""The lint driver: run every rule family over registered models.
+
+``lint_model`` combines the AST passes (astrules.py) with the abstract
+tracing passes (tracerules.py) for one registry entry; ``lint_all`` sweeps
+the registry.  Pure CPU, no accelerator, no execution — the whole sweep
+over round_tpu/models is a few seconds of tracing.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+from round_tpu.analysis.astrules import ast_rules
+from round_tpu.analysis.findings import Finding, relpath
+from round_tpu.analysis.registry import REGISTRY, ModelEntry, get
+from round_tpu.analysis.tracerules import trace_rules
+
+
+def _dedupe_sorted(findings: Iterable[Finding]) -> List[Finding]:
+    seen, out = set(), []
+    for f in findings:
+        key = (f.rule, f.model, f.file, f.line, f.message)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(f)
+    out.sort(key=lambda f: (f.model, f.file, f.line, f.rule))
+    return out
+
+
+def lint_model(entry: ModelEntry) -> List[Finding]:
+    """All findings for one registered model."""
+    try:
+        algo, io = entry.build()
+    except Exception as e:  # noqa: BLE001 — a broken registry entry IS a finding
+        return [Finding(
+            rule="comm-closure/init", severity="error", model=entry.name,
+            file=relpath(__file__), line=0,
+            message=f"registry build() for {entry.name!r} raised "
+                    f"{type(e).__name__}: {e}",
+            hint="fix the ModelEntry in analysis/registry.py (or the model "
+                 "constructor it calls)",
+        )]
+    findings = list(ast_rules(entry.name, algo))
+    findings += trace_rules(entry.name, entry.n, algo, io)
+    return _dedupe_sorted(findings)
+
+
+def lint_all(
+    names: Optional[Sequence[str]] = None,
+    registry: Sequence[ModelEntry] = REGISTRY,
+) -> List[Finding]:
+    """Findings across models (the whole registry by default)."""
+    entries = [get(n) for n in names] if names else list(registry)
+    findings: List[Finding] = []
+    for entry in entries:
+        findings.extend(lint_model(entry))
+    return findings

@@ -1,0 +1,219 @@
+"""The lintable-model registry: every algorithm in round_tpu/models, paired
+with a representative (algorithm, io) constructor at a small static n.
+
+The linter never *runs* a model — the io built here is only abstractified
+(``jax.eval_shape``) so the round functions can be traced on CPU.  The n is
+deliberately tiny: every shape in round code is a function of n, so n=8
+exercises the same jaxpr structure as the flagship n=1024 without the cost.
+
+Adding a model to ``round_tpu/models`` without registering it here is
+itself caught: ``tests/test_analysis.py`` cross-checks the registry against
+the package's exported Algorithm subclasses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelEntry:
+    """One registered model.
+
+    name:  registry key (CLI argument, baseline `model` field).
+    build: () -> (Algorithm, io pytree) at n = entry.n.
+    n:     static group size used for abstract tracing.
+    note:  one-liner shown by ``lint --list``.
+    """
+
+    name: str
+    build: Callable[[], Tuple[Any, Any]]
+    n: int = 8
+    note: str = ""
+
+
+def _consensus_int(n, v=4):
+    from round_tpu.models.common import consensus_io
+
+    return consensus_io(np.arange(n, dtype=np.int32) % v)
+
+
+def _otr():
+    from round_tpu.models.otr import OTR
+
+    return OTR(), _consensus_int(8)
+
+
+def _otr_hist():
+    from round_tpu.models.otr import OTR
+
+    return OTR(n_values=4), _consensus_int(8)
+
+
+def _floodmin():
+    from round_tpu.models.floodmin import FloodMin
+
+    return FloodMin(f=2), _consensus_int(8)
+
+
+def _benor():
+    from round_tpu.models.benor import BenOr
+    from round_tpu.models.common import consensus_io
+
+    return BenOr(), consensus_io(np.arange(8) % 2 == 0)
+
+
+def _lastvoting():
+    from round_tpu.models.lastvoting import LastVoting
+
+    return LastVoting(), _consensus_int(8)
+
+
+def _lastvoting_bytes():
+    from round_tpu.models.lastvoting import LastVotingBytes
+
+    algo = LastVotingBytes(payload_bytes=16)
+    io = {"initial_value": np.zeros((8, 16), dtype=np.uint8)}
+    return algo, io
+
+
+def _slv():
+    from round_tpu.models.lastvoting_variants import ShortLastVoting
+
+    return ShortLastVoting(), _consensus_int(8)
+
+
+def _mlv():
+    from round_tpu.models.lastvoting_variants import MultiLastVoting, mlv_io
+
+    return MultiLastVoting(), mlv_io(8, {0: 5, 3: 9}, {1: 0})
+
+
+def _lv_event():
+    from round_tpu.models.lastvoting_event import LastVotingEvent
+
+    return LastVotingEvent(), _consensus_int(8)
+
+
+def _tpc():
+    from round_tpu.models.tpc import TwoPhaseCommit, tpc_io
+
+    return TwoPhaseCommit(), tpc_io(0, np.ones(8, dtype=bool))
+
+
+def _tpc_event():
+    from round_tpu.models.tpc_event import TwoPhaseCommitEvent
+    from round_tpu.models.tpc import tpc_io
+
+    return TwoPhaseCommitEvent(), tpc_io(0, np.ones(8, dtype=bool))
+
+
+def _kset():
+    from round_tpu.models.kset import KSetAgreement
+
+    return KSetAgreement(k=2), _consensus_int(8)
+
+
+def _kset_es():
+    from round_tpu.models.kset import KSetEarlyStopping
+
+    return KSetEarlyStopping(t=2, k=2), _consensus_int(8)
+
+
+def _epsilon():
+    from round_tpu.models.epsilon import EpsilonConsensus, real_consensus_io
+
+    n = 8
+    return (EpsilonConsensus(n, f=1, epsilon=0.5),
+            real_consensus_io(np.linspace(0.0, 10.0, n)))
+
+
+def _lattice():
+    from round_tpu.models.lattice import LatticeAgreement, lattice_io
+
+    return (LatticeAgreement(universe=6),
+            lattice_io([[i % 6] for i in range(8)], 6))
+
+
+def _erb():
+    from round_tpu.models.erb import EagerReliableBroadcast, broadcast_io
+
+    return EagerReliableBroadcast(), broadcast_io(0, 3, 8)
+
+
+def _esfd():
+    from round_tpu.models.failure_detector import Esfd
+
+    return Esfd(hysteresis=5), {}
+
+
+def _mutex():
+    from round_tpu.models.mutex import SelfStabilizingMutualExclusion, mutex_io
+
+    return (SelfStabilizingMutualExclusion(),
+            mutex_io(np.arange(8, dtype=np.int32) % 9))
+
+
+def _cgol():
+    from round_tpu.models.gameoflife import ConwayGameOfLife, cgol_io
+
+    grid = np.zeros((2, 4), dtype=bool)
+    grid[0, 1] = grid[1, 2] = True
+    return ConwayGameOfLife(rows=2, cols=4), cgol_io(grid)
+
+
+def _theta():
+    from round_tpu.models.theta import ThetaModel
+
+    return ThetaModel(f=1, theta=2.0), {}
+
+
+def _pbft():
+    from round_tpu.models.pbft import PbftConsensus
+
+    return PbftConsensus(), {"initial_value": np.arange(8, dtype=np.int32)}
+
+
+def _pbft_vc():
+    from round_tpu.models.pbft import PbftViewChange
+
+    return PbftViewChange(), {"initial_value": np.arange(8, dtype=np.int32)}
+
+
+REGISTRY: Tuple[ModelEntry, ...] = (
+    ModelEntry("otr", _otr, note="one-third-rule consensus (generic mmor path)"),
+    ModelEntry("otr-hist", _otr_hist, note="OTR with the static value-domain histogram path"),
+    ModelEntry("floodmin", _floodmin, note="FloodMin f-crash consensus"),
+    ModelEntry("benor", _benor, note="Ben-Or randomized binary consensus"),
+    ModelEntry("lastvoting", _lastvoting, note="LastVoting (Paxos in HO), 4-round phases"),
+    ModelEntry("lastvoting-bytes", _lastvoting_bytes, note="LastVoting over opaque byte payloads"),
+    ModelEntry("slv", _slv, note="ShortLastVoting variant"),
+    ModelEntry("mlv", _mlv, note="MultiLastVoting (proposer/acceptor split)"),
+    ModelEntry("lastvoting-event", _lv_event, note="LastVoting as FoldRounds (OOPSLA'20 event rounds)"),
+    ModelEntry("tpc", _tpc, note="Two-phase commit"),
+    ModelEntry("tpc-event", _tpc_event, note="Two-phase commit as FoldRounds"),
+    ModelEntry("kset", _kset, note="k-set agreement by map merging"),
+    ModelEntry("kset-es", _kset_es, note="early-stopping k-set agreement"),
+    ModelEntry("epsilon", _epsilon, note="approximate (epsilon) real-valued consensus"),
+    ModelEntry("lattice", _lattice, note="lattice agreement over bitset joins"),
+    ModelEntry("erb", _erb, note="eager reliable broadcast"),
+    ModelEntry("esfd", _esfd, note="eventually-strong failure detector"),
+    ModelEntry("mutex", _mutex, note="Dijkstra self-stabilizing token ring (EventRound)"),
+    ModelEntry("cgol", _cgol, note="Conway life on the torus wire (stress model)"),
+    ModelEntry("theta", _theta, note="Theta-model round synchronizer"),
+    ModelEntry("pbft", _pbft, note="PBFT agreement rounds (benign-execution slice)"),
+    ModelEntry("pbft-vc", _pbft_vc, note="PBFT view-change selection rounds"),
+)
+
+BY_NAME = {e.name: e for e in REGISTRY}
+
+
+def get(name: str) -> ModelEntry:
+    if name not in BY_NAME:
+        raise KeyError(
+            f"unknown model {name!r}; registered: {', '.join(sorted(BY_NAME))}"
+        )
+    return BY_NAME[name]

@@ -1,0 +1,500 @@
+"""Abstract-trace rules: comm-closure, tpu-lowerability, spec-coherence.
+
+Everything here runs on CPU via ``jax.eval_shape``/``jax.make_jaxpr`` —
+round code is traced with abstract operands exactly as the engine would
+trace it (same vmap shape, same RoundCtx, same Mailbox view), but no
+accelerator backend is ever initialized and nothing executes.
+
+  comm-closure      — the phase must be communication-closed as a typed
+                      program: round r's ``update`` consumes precisely the
+                      payload pytree round r's ``send`` produced, and the
+                      state pytree is a fixed point across the phase
+                      (shape/dtype/structure), because the engine scans it
+                      (executor.run_phases) — any drift is a lax.scan
+                      carry error three layers deeper.
+  tpu-lowerability  — the traced round's jaxpr must stay inside the
+                      engine's TPU dtype-path contract
+                      (engine.fast.TPU_INT_REDUCE_PRIMS / TPU_WIDE_DTYPES /
+                      DOT_DTYPE_PATHS): integer min/max/arg reductions and
+                      sorts are the documented "TPU integer-reduction
+                      lowering" failure class; f64/i64 creep forces wide
+                      layouts past the bf16/i8 design points.
+  spec-coherence    — every field a Spec formula reads must exist in the
+                      state pytree: each formula is eval_shape'd against
+                      the abstract state, so a typo surfaces here as a
+                      SpecFieldError naming the formula, not as a tracer
+                      blow-up inside check_trace after a full run.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from round_tpu.analysis.findings import Finding, relpath
+from round_tpu.core.rounds import RoundCtx
+from round_tpu.ops.mailbox import Mailbox
+from round_tpu.spec.dsl import Env, SpecFieldError
+
+_CONCRETIZATION_ERRORS = (
+    jax.errors.TracerBoolConversionError,
+    jax.errors.TracerArrayConversionError,
+    jax.errors.TracerIntegerConversionError,
+    jax.errors.ConcretizationTypeError,
+)
+
+
+def _short(exc: BaseException, limit: int = 300) -> str:
+    msg = str(exc).strip().split("\n")[0]
+    return msg[:limit] + ("…" if len(msg) > limit else "")
+
+
+def _fn_anchor(fn) -> Tuple[str, int]:
+    fn = getattr(fn, "__func__", fn)
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return "<unknown>", 0
+    return relpath(code.co_filename), code.co_firstlineno
+
+
+def _leaf_sig(x) -> str:
+    return f"{jnp.result_type(x).name}[{', '.join(map(str, jnp.shape(x)))}]"
+
+
+def _tree_sig(tree) -> dict:
+    leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {jax.tree_util.keystr(path): _leaf_sig(leaf)
+            for path, leaf in leaves}
+
+
+def _abstract(tree):
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)), tree
+    )
+
+
+class _RoundTracer:
+    """Traces one model's phase round-by-round, mirroring executor.run_round
+    (pre → send → exchange → update) with abstract operands."""
+
+    def __init__(self, model: str, n: int, algo):
+        self.model = model
+        self.n = n
+        self.algo = algo
+        self.ids = jnp.arange(n, dtype=jnp.int32)
+        self.r_sds = jax.ShapeDtypeStruct((), jnp.int32)
+        self.ho_sds = jax.ShapeDtypeStruct((n, n), jnp.bool_)
+        self.keys_sds = jax.ShapeDtypeStruct((n, 2), jnp.uint32)
+        self.findings: List[Finding] = []
+
+    def _emit(self, rule, severity, anchor, message, hint=""):
+        file, line = anchor
+        self.findings.append(Finding(
+            rule=rule, severity=severity, model=self.model,
+            file=file, line=line, message=message, hint=hint,
+        ))
+
+    def _classify_trace_failure(self, exc, rule, anchor, what, hint):
+        if isinstance(exc, _CONCRETIZATION_ERRORS):
+            self._emit(
+                "recompile-hazard/concretize", "error", anchor,
+                f"{what} concretizes a traced value while tracing "
+                f"abstractly (the engine jits this code): {_short(exc)}",
+                "express the branch/value as data (jnp.where, .astype); "
+                "see recompile-hazard in docs/ANALYSIS.md",
+            )
+        else:
+            self._emit(rule, "error", anchor,
+                       f"{what} failed to trace: "
+                       f"{type(exc).__name__}: {_short(exc)}", hint)
+
+    # -- per-round tracing --------------------------------------------------
+
+    def _send_fn(self, rnd):
+        n, ids = self.n, self.ids
+
+        def f(state, r):
+            def per_lane(i, s):
+                ctx = RoundCtx(id=i, n=n, r=r)
+                s = rnd.pre(ctx, s)
+                spec = rnd.send(ctx, s)
+                return s, spec.payload, spec.dest_mask
+
+            return jax.vmap(per_lane)(ids, state)
+
+        return f
+
+    def _update_fn(self, rnd):
+        n, ids = self.n, self.ids
+
+        def f(state, payload, deliver, keys, r):
+            def per_lane(i, s, mbox_mask, k):
+                ctx = RoundCtx(id=i, n=n, r=r, rng=k)
+                s2 = rnd.update(ctx, s, Mailbox(payload, mbox_mask))
+                return s2, ctx._exit
+
+            return jax.vmap(per_lane)(ids, state, deliver, keys)
+
+        return f
+
+    def trace_round(self, j: int, rnd, state_sds):
+        """Returns the post-round state sds, or None when tracing stopped."""
+        send_anchor = _fn_anchor(type(rnd).send)
+        upd_anchor = _fn_anchor(type(rnd).update)
+
+        try:
+            state1_sds, payload_sds, dest_sds = jax.eval_shape(
+                self._send_fn(rnd), state_sds, self.r_sds
+            )
+        except Exception as e:  # noqa: BLE001 — every failure is a finding
+            self._classify_trace_failure(
+                e, "comm-closure/send", send_anchor,
+                f"round {j}'s send (abstract state, traced ids)",
+                "send must be a pure per-lane function "
+                "(ctx, state) -> SendSpec over the state pytree",
+            )
+            return None
+
+        if jnp.shape(dest_sds) != (self.n, self.n) or \
+                jnp.result_type(dest_sds) != jnp.bool_:
+            self._emit(
+                "comm-closure/dest-mask", "error", send_anchor,
+                f"round {j}'s send produced a dest_mask of "
+                f"{_leaf_sig(dest_sds)}; the wire contract is bool[n] per "
+                f"lane (bool[{self.n}, {self.n}] after the engine's vmap)",
+                "build the mask with broadcast()/unicast()/silence() "
+                "(core/rounds.py) instead of hand-rolling shapes",
+            )
+            return None
+
+        try:
+            new_state_sds, exit_sds = jax.eval_shape(
+                self._update_fn(rnd), state1_sds, payload_sds,
+                self.ho_sds, self.keys_sds, self.r_sds,
+            )
+        except Exception as e:  # noqa: BLE001
+            self._classify_trace_failure(
+                e, "comm-closure/mailbox", upd_anchor,
+                f"round {j}'s update, consuming the mailbox built from its "
+                f"own send's payload "
+                f"(payload leaves: {_tree_sig(payload_sds)})",
+                "update may only consume the payload pytree send produced "
+                "— same keys, same leaf shapes/dtypes",
+            )
+            return None
+
+        if jnp.result_type(exit_sds) != jnp.bool_:
+            self._emit(
+                "comm-closure/exit-flag", "error", upd_anchor,
+                f"round {j}'s exit_at_end_of_round mask has dtype "
+                f"{jnp.result_type(exit_sds).name}, expected bool",
+                "pass a bool lane mask to ctx.exit_at_end_of_round",
+            )
+
+        before, after = _tree_sig(state_sds), _tree_sig(new_state_sds)
+        if before != after:
+            drift = []
+            for key in sorted(set(before) | set(after)):
+                a, b = before.get(key), after.get(key)
+                if a != b:
+                    drift.append(f"{key}: {a or '<absent>'} -> {b or '<absent>'}")
+            self._emit(
+                "comm-closure/state-drift", "error", upd_anchor,
+                f"round {j}'s update changed the state pytree's typed "
+                f"structure — the engine scans the phase, so the state must "
+                f"be a shape/dtype fixed point; drift: {'; '.join(drift)}",
+                "cast the offending field back to its declared dtype "
+                "(.astype) or fix the field's construction in "
+                "make_init_state",
+            )
+            return None
+        return new_state_sds
+
+    def trace_phase(self, state_sds):
+        for j, rnd in enumerate(self.algo.rounds):
+            nxt = self.trace_round(j, rnd, state_sds)
+            if nxt is None:
+                return None
+            state_sds = nxt
+        return state_sds
+
+    # -- decided/decision accessors ----------------------------------------
+
+    def check_accessors(self, state_sds):
+        for name, want in (("decided", jnp.bool_), ("decision", None)):
+            fn = getattr(self.algo, name)
+            try:
+                out = jax.eval_shape(fn, state_sds)
+            except NotImplementedError:
+                continue  # the engine tolerates missing accessors
+            except Exception as e:  # noqa: BLE001
+                self._emit(
+                    "comm-closure/accessor", "error",
+                    _fn_anchor(type(self.algo).__dict__.get(name, fn)),
+                    f"{name}(state) failed to trace on the abstract state: "
+                    f"{type(e).__name__}: {_short(e)}",
+                    "accessors are traced by the engine every round; they "
+                    "must be pure functions of the state pytree",
+                )
+                continue
+            leaves = jax.tree_util.tree_leaves(out)
+            # decided must be exactly [n] bool; decision is per-lane values
+            # of any width ([n], or [n, B] byte/bitset payloads) — only the
+            # leading lane axis is the contract
+            bad = len(leaves) != 1 or (
+                jnp.shape(leaves[0]) != (self.n,)
+                if want is jnp.bool_
+                else (jnp.ndim(leaves[0]) < 1
+                      or jnp.shape(leaves[0])[0] != self.n)
+            ) or (want is not None and jnp.result_type(leaves[0]) != want)
+            if bad:
+                self._emit(
+                    "comm-closure/accessor", "warn",
+                    _fn_anchor(type(self.algo).__dict__.get(name, fn)),
+                    f"{name}(state) returned "
+                    f"{[_leaf_sig(l) for l in leaves]}; the engine expects "
+                    f"one [{self.n}{', …' if want is None else ''}]-shaped"
+                    f"{' bool' if want is jnp.bool_ else ''} vector",
+                    "return a per-lane vector over the vmapped state",
+                )
+
+
+# -- tpu-lowerability -------------------------------------------------------
+
+
+def _walk_jaxpr(jaxpr, seen=None):
+    """Yield every eqn, recursing into call/scan/cond/pjit sub-jaxprs."""
+    if seen is None:
+        seen = set()
+    if id(jaxpr) in seen:
+        return
+    seen.add(id(jaxpr))
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from _walk_jaxpr(sub, seen)
+
+
+try:
+    from jax.extend import core as _jcore
+except ImportError:  # older jax: the classes still live on jax.core
+    from jax import core as _jcore
+
+_JAXPR_TYPES = tuple(
+    t for t in (getattr(_jcore, "Jaxpr", None),
+                getattr(_jcore, "ClosedJaxpr", None)) if t
+)
+
+
+def _sub_jaxprs(v):
+    if isinstance(v, _JAXPR_TYPES):
+        yield v.jaxpr if hasattr(v, "jaxpr") else v
+    elif isinstance(v, (list, tuple)):
+        for item in v:
+            yield from _sub_jaxprs(item)
+
+
+def _eqn_anchor(eqn, prefer_files: Sequence[str]) -> Optional[Tuple[str, int]]:
+    try:
+        from jax._src import source_info_util
+
+        frames = list(source_info_util.user_frames(eqn.source_info))
+    except Exception:  # noqa: BLE001 — source info is best-effort
+        return None
+    for fr in frames:
+        if any(fr.file_name.endswith(p) for p in prefer_files):
+            return relpath(fr.file_name), fr.start_line
+    for fr in frames:
+        fn = fr.file_name
+        if "round_tpu" in fn and "/analysis/" not in fn:
+            return relpath(fn), fr.start_line
+    return None
+
+
+def tpu_lowerability(model: str, tracer: _RoundTracer, state_sds) -> None:
+    """Jaxpr scan of each full round against the engine's dtype-path
+    contract (engine.fast).  Emits onto the tracer's findings list."""
+    from round_tpu.engine import fast
+
+    n = tracer.n
+    model_files = []
+    for rnd in tracer.algo.rounds:
+        try:
+            model_files.append(inspect.getsourcefile(type(rnd)))
+        except TypeError:
+            pass
+    model_files = [f for f in model_files if f]
+
+    def round_fn(rnd):
+        def f(state, r, ho, keys):
+            state1, payload, dest = tracer._send_fn(rnd)(state, r)
+            deliver = ho & dest.T
+            return tracer._update_fn(rnd)(state1, payload, deliver, keys, r)
+
+        return f
+
+    seen = set()
+    for j, rnd in enumerate(tracer.algo.rounds):
+        try:
+            jx = jax.make_jaxpr(round_fn(rnd))(
+                state_sds, tracer.r_sds, tracer.ho_sds, tracer.keys_sds
+            )
+        except Exception:  # noqa: BLE001 — already reported by comm-closure
+            continue
+        fallback = _fn_anchor(type(rnd).update)
+        for eqn in _walk_jaxpr(jx.jaxpr):
+            prim = eqn.primitive.name
+            if prim in fast.TPU_INT_REDUCE_PRIMS:
+                in_dt = jnp.result_type(eqn.invars[0].aval.dtype)
+                if jnp.issubdtype(in_dt, jnp.integer):
+                    anchor = _eqn_anchor(eqn, model_files) or fallback
+                    key = ("tpu-lowerability/int-reduce", anchor, prim)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    tracer._emit(
+                        "tpu-lowerability/int-reduce", "warn", anchor,
+                        f"round {j} lowers {prim} over {in_dt.name} — the "
+                        f"known TPU integer-reduction lowering failure "
+                        f"class (engine.fast.TPU_INT_REDUCE_PRIMS)",
+                        "run this model on TPU through the fused "
+                        "histogram/count paths (engine/fast.py, i8/bf16 "
+                        "dot per fast.DOT_DTYPE_PATHS), or baseline with "
+                        "a reason if it is CPU/host-path only",
+                    )
+            elif prim == "scatter":
+                anchor = _eqn_anchor(eqn, model_files) or fallback
+                key = ("tpu-lowerability/scatter", anchor, prim)
+                if key in seen:
+                    continue
+                seen.add(key)
+                tracer._emit(
+                    "tpu-lowerability/scatter", "warn", anchor,
+                    f"round {j} lowers a plain scatter — arbitrary-update "
+                    f"scatters serialize on TPU and are a known lowering "
+                    f"trouble spot",
+                    "prefer masked jnp.where writes or one-hot matmuls "
+                    "(the engines' histogram trick)",
+                )
+            for var in eqn.outvars:
+                dt = getattr(getattr(var, "aval", None), "dtype", None)
+                if dt is not None and str(dt) in fast.TPU_WIDE_DTYPES:
+                    anchor = _eqn_anchor(eqn, model_files) or fallback
+                    key = ("tpu-lowerability/wide-dtype", anchor, str(dt))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    tracer._emit(
+                        "tpu-lowerability/wide-dtype", "error", anchor,
+                        f"round {j} materializes a {dt} value — wider than "
+                        f"the engine's bf16/i8 design points "
+                        f"(engine.fast.TPU_WIDE_DTYPES)",
+                        "keep payloads and state in i32/f32-or-narrower; "
+                        "the fused paths carry counts in i8/bf16",
+                    )
+
+
+# -- spec-coherence ---------------------------------------------------------
+
+
+def _spec_formulas(spec):
+    """(label, formula, has_old): has_old mirrors the Env check_trace will
+    actually build — the safety_predicate is evaluated on a pre-state Env
+    with NO old snapshot (spec/check.py), so a safety formula touching
+    ``i.old`` must fail the lint, not just the run."""
+    from round_tpu.spec.check import formula_label
+
+    if spec is None:
+        return
+    for i, f in enumerate(getattr(spec, "invariants", ()) or ()):
+        yield formula_label(f, f"invariants[{i}]"), f, True
+    for name, f in getattr(spec, "properties", ()) or ():
+        yield f"property {name!r}", f, True
+    sp = getattr(spec, "safety_predicate", None)
+    if sp is not None:
+        yield formula_label(sp, "safety_predicate"), sp, False
+    for i, f in enumerate(getattr(spec, "liveness_predicate", ()) or ()):
+        yield formula_label(f, f"liveness_predicate[{i}]"), f, True
+    for j, group in enumerate(getattr(spec, "round_invariants", ()) or ()):
+        for m, f in enumerate(group):
+            yield formula_label(f, f"round_invariants[{j}][{m}]"), f, True
+
+
+def spec_coherence(model: str, tracer: _RoundTracer, state_sds) -> None:
+    spec = getattr(tracer.algo, "spec", None)
+    if spec is None:
+        return
+    n = tracer.n
+
+    for label, f, has_old in _spec_formulas(spec):
+        anchor = _fn_anchor(f)
+
+        def run(st, init0, ho, r, _f=f, _old=has_old):
+            return _f(Env(state=st, n=n, old=st if _old else None,
+                          init0=init0, ho=ho, r=r))
+
+        try:
+            out = jax.eval_shape(
+                run, state_sds, state_sds, tracer.ho_sds, tracer.r_sds,
+            )
+        except SpecFieldError as e:
+            e = e.with_formula(label)
+            tracer._emit(
+                "spec-coherence/missing-field", "error", anchor,
+                str(e),
+                "fix the field name in the formula (or add the field to "
+                "the state pytree); state fields listed in the message",
+            )
+            continue
+        except Exception as e:  # noqa: BLE001
+            tracer._emit(
+                "spec-coherence/trace-error", "error", anchor,
+                f"{label} failed to evaluate on the abstract state: "
+                f"{type(e).__name__}: {_short(e)}",
+                "spec formulas must be Env -> bool-scalar reductions over "
+                "existing state fields (spec/dsl.py)",
+            )
+            continue
+        if jnp.shape(out) != () or jnp.result_type(out) != jnp.bool_:
+            tracer._emit(
+                "spec-coherence/nonbool", "warn", anchor,
+                f"{label} evaluates to {_leaf_sig(out)}; the checker "
+                f"expects a scalar bool per step",
+                "finish the formula with a quantifier/reduction "
+                "(P.forall / jnp.all)",
+            )
+
+
+# -- entry point ------------------------------------------------------------
+
+
+def trace_rules(model: str, n: int, algo, io) -> List[Finding]:
+    """All abstract-trace findings for one model."""
+    tracer = _RoundTracer(model, n, algo)
+
+    from round_tpu.engine.executor import LocalTopology, init_lanes
+
+    topo = LocalTopology(n)
+    try:
+        state_sds = jax.eval_shape(
+            lambda io_: init_lanes(algo, io_, n, topo), _abstract(io)
+        )
+    except Exception as e:  # noqa: BLE001
+        tracer._classify_trace_failure(
+            e, "comm-closure/init",
+            _fn_anchor(type(algo).make_init_state),
+            "make_init_state (vmapped over the io pytree)",
+            "make_init_state must build the per-lane state from the "
+            "per-lane io slice without concretizing it",
+        )
+        return tracer.findings
+
+    tracer.trace_phase(state_sds)
+    tracer.check_accessors(state_sds)
+    tpu_lowerability(model, tracer, state_sds)
+    spec_coherence(model, tracer, state_sds)
+    return tracer.findings

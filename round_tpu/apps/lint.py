@@ -1,0 +1,112 @@
+"""roundlint CLI: the static gate over round code.
+
+Usage:
+    python -m round_tpu.apps.lint --all                 # whole registry
+    python -m round_tpu.apps.lint otr lastvoting        # named models
+    python -m round_tpu.apps.lint --all --json          # machine output
+    python -m round_tpu.apps.lint --all --baseline round_tpu/analysis/baseline.json
+    python -m round_tpu.apps.lint --list                # registry contents
+
+Exit status: 0 when every finding is baselined (or none exist), 1 when any
+non-baselined finding remains, 2 on usage errors.  Rule catalog and the
+suppression workflow: docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# the linter is a CPU tool: never let an import chain initialize an
+# accelerator backend (a wedged TPU tunnel would hang, not error) — the
+# same guard as verifier_cli
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from round_tpu import analysis  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="round_tpu.apps.lint",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("models", nargs="*",
+                    help="registry names to lint (see --list)")
+    ap.add_argument("--all", action="store_true",
+                    help="lint every registered model")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit one JSON document instead of text")
+    ap.add_argument("--baseline", default=analysis.default_baseline_path(),
+                    help="suppression baseline (JSON; 'none' disables); "
+                         "default: round_tpu/analysis/baseline.json")
+    ap.add_argument("--fixtures", action="store_true",
+                    help="lint the broken self-test corpus "
+                         "(round_tpu/analysis/fixtures.py) instead of the "
+                         "registry — demo/debugging aid")
+    ap.add_argument("--list", action="store_true", dest="list_models",
+                    help="list registered models and exit")
+    ns = ap.parse_args(sys.argv[1:] if argv is None else argv)
+
+    if ns.list_models:
+        try:
+            for e in analysis.REGISTRY:
+                print(f"{e.name:18s} n={e.n:<4d} {e.note}")
+        except BrokenPipeError:  # `lint --list | head` closed the pipe
+            os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+
+    if ns.fixtures:
+        from round_tpu.analysis.fixtures import FIXTURES
+
+        findings = analysis.lint_all(registry=FIXTURES)
+        baseline = []
+    else:
+        if not ns.all and not ns.models:
+            ap.error("name at least one model, or pass --all (see --list)")
+        try:
+            findings = analysis.lint_all(ns.models or None)
+        except KeyError as e:
+            print(e.args[0], file=sys.stderr)
+            return 2
+        baseline = ([] if ns.baseline in ("none", "")
+                    else analysis.load_baseline(ns.baseline))
+
+    gating, suppressed, stale = analysis.apply_baseline(findings, baseline)
+    if not (ns.all or ns.fixtures):
+        # a partial lint cannot tell which OTHER models' entries are stale
+        stale = []
+
+    if ns.as_json:
+        counts = {}
+        for f in findings:
+            counts[f.family] = counts.get(f.family, 0) + 1
+        print(json.dumps({
+            "findings": [f.to_dict() for f in gating],
+            "suppressed": [f.to_dict() for f in suppressed],
+            "stale_baseline": [vars(s).copy() for s in stale],
+            "counts_by_family": counts,
+            "total": len(findings),
+            "gating": len(gating),
+        }, indent=2))
+    else:
+        for f in gating:
+            print(f.render())
+        if suppressed:
+            print(f"{len(suppressed)} finding(s) suppressed by baseline "
+                  f"({ns.baseline})")
+        for s in stale:
+            print(f"note: stale baseline entry matched nothing: "
+                  f"{s.model} {s.rule} {s.file} — remove it", file=sys.stderr)
+        verdict = "CLEAN" if not gating else f"{len(gating)} gating finding(s)"
+        print(verdict)
+    return 0 if not gating else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,6 +4,11 @@ Reference parity: example/Verifier.scala:22-37 — a CLI that runs the
 verifier on example.OTR / LastVoting and writes report.html.
 
 Usage:  python -m round_tpu.apps.verifier_cli tpc [-r report.html] [-v]
+        python -m round_tpu.apps.verifier_cli --all
+
+``--all`` sweeps every registered spec AND every extracted-TR lemma suite,
+printing one line per protocol and exiting nonzero if any is NOT PROVED —
+the CI-friendly form of what used to take eight separate invocations.
 
 Per-VC wall budgets are tuned to an idle box; on a loaded one set
 ROUND_TPU_VC_TIMEOUT_SCALE (e.g. 2) to scale every budget uniformly
@@ -26,15 +31,19 @@ jax.config.update("jax_platforms", "cpu")
 from round_tpu.verify.verifier import Verifier  # noqa: E402
 
 
-def spec_by_name(name: str):
+def _spec_registry():
     from round_tpu.verify import protocols
 
-    registry = {
+    return {
         "tpc": protocols.tpc_spec,
         "otr": protocols.otr_spec,
         "lv": protocols.lv_verifier_spec,
         "erb": protocols.erb_spec,
     }
+
+
+def spec_by_name(name: str):
+    registry = _spec_registry()
     if name not in registry:
         valid = list(registry) + list(_LEMMA_SUITES)
         raise SystemExit(
@@ -55,7 +64,7 @@ _LEMMA_SUITES = {
 }
 
 
-def run_lemma_suite(name: str, verbose: bool) -> bool:
+def run_lemma_suite(name: str, verbose: bool, quiet: bool = False) -> bool:
     """Discharge an extracted-TR lemma suite (TRs extracted from the
     executable round code; see each protocols.*_extracted_lemmas
     docstring).  Prints one line per lemma and a verdict.  Budgets honor
@@ -75,7 +84,8 @@ def run_lemma_suite(name: str, verbose: bool) -> bool:
     mod, fn = _LEMMA_SUITES[name]
     lemmas, _meta = getattr(importlib.import_module(mod), fn)()
     ok = True
-    print(f"Extracted-TR lemma suite: {name}")
+    if not quiet:
+        print(f"Extracted-TR lemma suite: {name}")
     for lname, hyp, concl, cfg in lemmas:
         if verbose:
             print(f"  … {lname}: {cfg}")
@@ -84,18 +94,72 @@ def run_lemma_suite(name: str, verbose: bool) -> bool:
                           total_timeout_s=budget)
         ok &= good
         mark = "✓" if good else "✗"
-        print(f"  {mark} {lname} ({time.monotonic() - t0:.2f}s)")
+        if not quiet or not good:
+            print(f"  {mark} {lname} ({time.monotonic() - t0:.2f}s)")
     return ok
+
+
+def run_all(verbose: bool) -> bool:
+    """The CI sweep: every registered spec, then every lemma suite, one
+    summary line per protocol.  Returns True iff everything PROVED."""
+    import time
+
+    def _short(e: BaseException, limit: int = 200) -> str:
+        # keep the one-line-per-protocol contract: jax/solver errors are
+        # routinely multi-kilobyte and multi-line
+        msg = f"{type(e).__name__}: {e}".strip().split("\n")[0]
+        return msg[:limit] + ("…" if len(msg) > limit else "")
+
+    all_ok = True
+    results = []
+    for name, make_spec in _spec_registry().items():
+        t0 = time.monotonic()
+        try:
+            ver = Verifier(make_spec())
+            ok = ver.check()
+            note = " (staged)" if ok and ver.used_staged else ""
+            if verbose and not ok:
+                print(ver.report())
+        except Exception as e:  # noqa: BLE001 — one crash must not hide the rest
+            ok, note = False, f" ({_short(e)})"
+        results.append((name, ok, time.monotonic() - t0, note))
+        all_ok &= ok
+    for name in _LEMMA_SUITES:
+        t0 = time.monotonic()
+        try:
+            ok, note = run_lemma_suite(name, verbose, quiet=not verbose), ""
+        except Exception as e:  # noqa: BLE001
+            ok, note = False, f" ({_short(e)})"
+        results.append((name, ok, time.monotonic() - t0, note))
+        all_ok &= ok
+    for name, ok, dt, note in results:
+        verdict = "VERIFIED" if ok else "NOT PROVED"
+        print(f"{name:10s} {verdict:10s} ({dt:6.2f}s){note}")
+    print("ALL VERIFIED" if all_ok else "SWEEP FAILED: see NOT PROVED lines")
+    return all_ok
 
 
 def main(argv=None) -> bool:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("protocol",
+    ap.add_argument("protocol", nargs="?", default=None,
                     help="tpc | otr | lv | erb | floodmin | kset | benor | pbft")
+    ap.add_argument("--all", action="store_true", dest="all_protocols",
+                    help="sweep every registered spec and lemma suite; one "
+                         "line per protocol, nonzero exit if any NOT PROVED")
     ap.add_argument("-r", "--report", default=None,
                     help="write an HTML report to this path")
     ap.add_argument("-v", "--verbose", action="store_true")
     ns = ap.parse_args(sys.argv[1:] if argv is None else argv)
+
+    if ns.all_protocols:
+        if ns.protocol:
+            ap.error("--all takes no protocol argument")
+        if ns.report:
+            print("note: -r/--report is not supported with --all; "
+                  f"ignoring {ns.report}", file=sys.stderr)
+        return run_all(ns.verbose)
+    if not ns.protocol:
+        ap.error("name a protocol, or pass --all")
 
     if ns.protocol in _LEMMA_SUITES:
         if ns.report:

@@ -38,6 +38,33 @@ from round_tpu.utils.tree import tree_where
 
 _RMIX = 0x7FEB352D
 
+# -- the dtype-path contract (consumed by round_tpu/analysis) ---------------
+# The fused paths carry every count matmul through one of two MXU dtype
+# pairs (ops.fused._count_dot): operand int8 with int32 accumulation
+# (lane-exact, 2x MXU on v5e) or operand bfloat16 with float32 accumulation
+# (exact for 0/1 operands up to n < 2^24).  Round code headed for the TPU
+# paths must stay inside these design points — the static linter
+# (round_tpu/analysis) checks models against the constants below instead of
+# hardcoding its own copy of the contract.
+
+#: dot mode -> (operand dtype, accumulation dtype) of the fused count paths
+DOT_DTYPE_PATHS = {"i8": ("int8", "int32"), "bf16": ("bfloat16", "float32")}
+
+#: jaxpr reduction primitives that are known NOT to lower reliably on TPU
+#: over integer operands (the tier-1 suite's "TPU integer-reduction
+#: lowering" environmental failures): min/max/prod-style reductions,
+#: arg-reductions and sorts.  Plain integer sums lower fine (they are the
+#: accumulation dtype of the i8 path) and are deliberately absent.
+TPU_INT_REDUCE_PRIMS = (
+    "reduce_min", "reduce_max", "reduce_prod",
+    "argmin", "argmax", "cummax", "cummin", "sort",
+)
+
+#: dtypes wider than the engine's design points — f64/i64 creep past the
+#: bf16/i8 paths forces wide layouts on TPU (and silently degrades to
+#: f32/i32 when jax_enable_x64 is off); round code must never introduce them
+TPU_WIDE_DTYPES = ("float64", "int64", "uint64", "complex64", "complex128")
+
 
 @flax.struct.dataclass
 class FaultMix:

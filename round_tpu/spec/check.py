@@ -22,7 +22,27 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from round_tpu.spec.dsl import Env, Spec
+from round_tpu.spec.dsl import Env, Spec, SpecFieldError
+
+
+def formula_label(f, fallback: str) -> str:
+    """Human-readable name for a spec formula: named properties keep their
+    name; plain methods/functions use their qualname; lambdas fall back to
+    the structural position (e.g. ``invariants[1]``)."""
+    name = getattr(f, "__qualname__", "") or getattr(f, "__name__", "")
+    if not name or "<lambda>" in name:
+        return fallback
+    return f"{fallback} ({name})"
+
+
+def _eval_formula(f, env, label):
+    """Evaluate one formula, re-raising SpecFieldError with the formula's
+    name attached — a typo'd state field names the formula instead of
+    surfacing as a bare AttributeError from inside the vmap/jit stack."""
+    try:
+        return jnp.asarray(f(env))
+    except SpecFieldError as e:
+        raise e.with_formula(label) from None
 
 
 def replay_ho(key: jax.Array, ho_sampler, rounds: int) -> jnp.ndarray:
@@ -118,16 +138,25 @@ def check_trace(
     def at_step(state_t, old_t, ho_t, r_t):
         env = Env(state=state_t, n=n, old=old_t, init0=init_state, ho=ho_t, r=r_t)
         inv = (
-            jnp.stack([jnp.asarray(f(env)) for f in spec.invariants])
+            jnp.stack([
+                _eval_formula(f, env, formula_label(f, f"invariants[{i}]"))
+                for i, f in enumerate(spec.invariants)
+            ])
             if spec.invariants
             else jnp.ones((0,), dtype=bool)
         )
-        props = {name: jnp.asarray(f(env)) for name, f in spec.properties}
+        props = {
+            name: _eval_formula(f, env, f"property {name!r}")
+            for name, f in spec.properties
+        }
         if spec.safety_predicate is not None:
             pre_env = Env(
                 state=old_t, n=n, old=None, init0=init_state, ho=ho_t, r=r_t - 1
             )
-            safe = jnp.asarray(spec.safety_predicate(pre_env))
+            safe = _eval_formula(
+                spec.safety_predicate, pre_env,
+                formula_label(spec.safety_predicate, "safety_predicate"),
+            )
         else:
             safe = jnp.asarray(True)
         if spec.round_invariants:
@@ -136,7 +165,13 @@ def check_trace(
                 [
                     jnp.where(
                         phase_round == j,
-                        jnp.all(jnp.stack([jnp.asarray(f(env)) for f in group]))
+                        jnp.all(jnp.stack([
+                            _eval_formula(
+                                f, env,
+                                formula_label(f, f"round_invariants[{j}][{m}]"),
+                            )
+                            for m, f in enumerate(group)
+                        ]))
                         if group
                         else jnp.asarray(True),
                         True,

@@ -39,17 +39,71 @@ def implies(a, b):
     return jnp.logical_or(jnp.logical_not(a), b)
 
 
+class SpecFieldError(AttributeError):
+    """A spec formula referenced a state field that does not exist.
+
+    Raised by the Env accessors (``i.x``, ``i.old.x``, ``i.init.x``) instead
+    of the bare ``AttributeError``/tracer ``KeyError`` that used to surface
+    from deep inside ``check_trace``'s vmap/jit stack.  Carries the missing
+    field, the fields that do exist, and — once the checker attaches it via
+    :meth:`with_formula` — the formula being evaluated."""
+
+    def __init__(self, field, available, where="state", formula=None):
+        self.field = field
+        self.available = tuple(available)
+        self.where = where
+        self.formula = formula
+        at = f" (while evaluating {formula})" if formula else ""
+        super().__init__(
+            f"spec formula references unknown {where} field {field!r}{at}; "
+            f"the state pytree has fields: {', '.join(self.available) or '<none>'}"
+        )
+
+    def with_formula(self, name: str) -> "SpecFieldError":
+        """A copy of this error naming the formula it came from (the trace
+        checker and the static linter both use this to anchor the report)."""
+        return SpecFieldError(self.field, self.available, self.where, name)
+
+
+def _state_fields(state) -> tuple:
+    """Best-effort field names of a state pytree (flax.struct dataclass in
+    this codebase; fall back to non-private instance attrs)."""
+    if dataclasses.is_dataclass(state):
+        return tuple(f.name for f in dataclasses.fields(state))
+    if isinstance(state, dict):
+        return tuple(state)
+    return tuple(k for k in vars(state) if not k.startswith("_")) \
+        if hasattr(state, "__dict__") else ()
+
+
+def _field(state, name, where):
+    """getattr with the friendly error (dict states get the same message
+    instead of a tracer KeyError)."""
+    if isinstance(state, dict):
+        try:
+            return state[name]
+        except KeyError:
+            raise SpecFieldError(name, _state_fields(state), where) from None
+    try:
+        return getattr(state, name)
+    except AttributeError:
+        raise SpecFieldError(name, _state_fields(state), where) from None
+
+
 class _Snapshot:
     """Field accessor over a state snapshot at a fixed lane index."""
 
-    __slots__ = ("_state", "_idx")
+    __slots__ = ("_state", "_idx", "_where")
 
-    def __init__(self, state, idx):
+    def __init__(self, state, idx, where="state"):
         self._state = state
         self._idx = idx
+        self._where = where
 
     def __getattr__(self, name):
-        return getattr(self._state, name)[self._idx]
+        if name.startswith("__"):
+            raise AttributeError(name)
+        return _field(self._state, name, self._where)[self._idx]
 
 
 class ProcView:
@@ -76,16 +130,18 @@ class ProcView:
     def old(self) -> _Snapshot:
         if self._env.old is None:
             raise ValueError("this Env carries no previous-round snapshot")
-        return _Snapshot(self._env.old, self._idx)
+        return _Snapshot(self._env.old, self._idx, where="old-snapshot")
 
     @property
     def init(self) -> _Snapshot:
         if self._env.init0 is None:
             raise ValueError("this Env carries no init snapshot")
-        return _Snapshot(self._env.init0, self._idx)
+        return _Snapshot(self._env.init0, self._idx, where="init-snapshot")
 
     def __getattr__(self, name):
-        return getattr(self._env.state, name)[self._idx]
+        if name.startswith("__"):
+            raise AttributeError(name)
+        return _field(self._env.state, name, "state")[self._idx]
 
     def __eq__(self, other):
         if isinstance(other, ProcView):

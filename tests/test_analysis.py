@@ -1,0 +1,271 @@
+"""The static-analysis gate (roundlint): golden findings on the broken
+fixture corpus, zero non-baselined findings across round_tpu/models, and
+the SpecFieldError satellite.
+
+Run this gate alone with `pytest -m lint`.
+"""
+
+import inspect
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from round_tpu import analysis
+from round_tpu.analysis import fixtures
+from round_tpu.spec.dsl import SpecFieldError
+
+pytestmark = pytest.mark.lint
+
+FIXTURE_FILE = "round_tpu/analysis/fixtures.py"
+
+
+def _lint(name):
+    return analysis.lint_model(fixtures.FIXTURES_BY_NAME[name])
+
+
+def _marker_line(rule):
+    """Line number of the `# lint: <rule>` marker in the fixture source."""
+    src = inspect.getsource(fixtures).splitlines()
+    for i, line in enumerate(src, start=1):
+        if f"# lint: {rule}" in line:
+            return i
+    raise AssertionError(f"no marker for {rule} in fixtures.py")
+
+
+def _def_line(fn):
+    return fn.__code__.co_firstlineno
+
+
+# -- every rule family fires on the broken corpus, with correct anchors -----
+
+
+def test_every_family_fires_on_fixtures():
+    found = {}
+    for entry in fixtures.FIXTURES:
+        if entry.name == "fixture-clean":
+            continue
+        for f in analysis.lint_model(entry):
+            assert f.file.endswith(FIXTURE_FILE), f
+            assert f.line > 0, f
+            found.setdefault(f.family, []).append(f)
+    missing = set(analysis.FAMILIES) - set(found)
+    assert not missing, f"rule families with no fixture finding: {missing}"
+
+
+def test_golden_anchor_state_drift():
+    fs = _lint("fixture-dtype-drift")
+    (f,) = [x for x in fs if x.rule == "comm-closure/state-drift"]
+    assert f.line == _def_line(fixtures.DtypeDriftRound.update)
+    assert "int32[4] -> float32[4]" in f.message
+    assert f.severity == "error"
+
+
+def test_golden_anchor_mailbox_misuse():
+    fs = _lint("fixture-mailbox-misuse")
+    (f,) = [x for x in fs if x.rule == "comm-closure/mailbox"]
+    assert f.line == _def_line(fixtures.MailboxMisuseRound.update)
+    assert "'vote'" in f.message and "est" in f.message
+
+
+def test_golden_anchors_purity():
+    fs = _lint("fixture-impure")
+    by_rule = {f.rule: f for f in fs}
+    assert by_rule["purity/unseeded-random"].line == \
+        _marker_line("purity/unseeded-random")
+    assert by_rule["purity/time"].line == _marker_line("purity/time")
+    assert by_rule["purity/closure-mutation"].line == \
+        _marker_line("purity/closure-mutation")
+    assert all(f.severity == "error" for f in by_rule.values())
+
+
+def test_golden_anchor_spec_typo():
+    fs = _lint("fixture-spec-typo")
+    (f,) = [x for x in fs if x.rule == "spec-coherence/missing-field"]
+    lam = fixtures.TypoSpec().properties[0][1]
+    assert f.line == lam.__code__.co_firstlineno
+    assert "decidedd" in f.message          # the typo'd field
+    assert "Agreement" in f.message         # the formula's name
+    assert "x, decided, decision" in f.message  # the fields that DO exist
+
+
+def test_golden_anchor_int_reduce():
+    fs = _lint("fixture-int-reduce")
+    (f,) = [x for x in fs if x.rule == "tpu-lowerability/int-reduce"]
+    assert f.line == _marker_line("tpu-lowerability/int-reduce")
+    assert "reduce_min" in f.message and "int32" in f.message
+
+
+def test_golden_anchor_wide_dtype():
+    """f64 creep must be caught at the SOURCE level: with jax_enable_x64
+    off (every path in this repo) the jaxpr only ever sees f32."""
+    fs = _lint("fixture-int-reduce")
+    (f,) = [x for x in fs if x.rule == "tpu-lowerability/wide-dtype"]
+    assert f.line == _marker_line("tpu-lowerability/wide-dtype")
+    assert "float64" in f.message
+    assert f.severity == "error"
+
+
+def test_spec_coherence_safety_predicate_has_no_old():
+    """check_trace evaluates safety_predicate on a pre-state Env with
+    old=None (spec/check.py); a safety formula touching i.old must fail
+    the lint, not first blow up mid-run."""
+    from round_tpu.analysis.registry import ModelEntry
+    from round_tpu.spec.dsl import Spec
+
+    class OldInSafety(Spec):
+        def __init__(self):
+            self.safety_predicate = \
+                lambda e: e.P.forall(lambda i: i.old.x == i.x)
+
+    class Algo(fixtures.CleanToy):
+        def __init__(self):
+            super().__init__()
+            self.spec = OldInSafety()
+
+    import numpy as np
+
+    entry = ModelEntry(
+        "old-in-safety",
+        lambda: (Algo(), {"initial_value": np.arange(4, dtype=np.int32)}),
+        n=4,
+    )
+    fs = analysis.lint_model(entry)
+    (f,) = [x for x in fs if x.rule == "spec-coherence/trace-error"]
+    assert "safety_predicate" in f.message
+    assert "previous-round snapshot" in f.message
+
+
+def test_golden_anchor_traced_branch():
+    fs = _lint("fixture-traced-branch")
+    rules = {f.rule for f in fs}
+    assert "recompile-hazard/traced-branch" in rules
+    (f,) = [x for x in fs if x.rule == "recompile-hazard/traced-branch"]
+    assert f.line == _marker_line("recompile-hazard/traced-branch")
+    # the abstract trace independently confirms the hazard
+    assert "recompile-hazard/concretize" in rules
+
+
+def test_clean_fixture_has_zero_findings():
+    assert _lint("fixture-clean") == []
+
+
+# -- the shipped tree is clean modulo the documented baseline ---------------
+
+
+def test_models_gate_zero_nonbaselined_findings():
+    t0 = time.monotonic()
+    findings = analysis.lint_all()
+    wall = time.monotonic() - t0
+    gating, suppressed, stale = analysis.apply_baseline(
+        findings, analysis.load_baseline()
+    )
+    assert not gating, "non-baselined findings:\n" + "\n".join(
+        f.render() for f in gating
+    )
+    assert not stale, f"stale baseline entries (fixed findings?): {stale}"
+    for f in suppressed:
+        assert f.family == "tpu-lowerability", (
+            "only the documented TPU integer-reduction class is baselined; "
+            f"got {f.render()}"
+        )
+    # acceptance: the full sweep stays comfortably inside the 60 s budget
+    assert wall < 60, f"lint --all took {wall:.1f}s"
+
+
+def test_registry_covers_exported_models():
+    """Every Algorithm the models package exports is lintable via the
+    registry (adding a model without registering it fails here)."""
+    import round_tpu.models as M
+    from round_tpu.core.algorithm import Algorithm
+
+    exported = {
+        name for name in M.__all__
+        if isinstance(getattr(M, name), type)
+        and issubclass(getattr(M, name), Algorithm)
+    }
+    registered = set()
+    for entry in analysis.REGISTRY:
+        algo, _io = entry.build()
+        registered.add(type(algo).__name__)
+    missing = {n for n in exported if n not in registered}
+    assert not missing, f"models exported but not in the lint registry: {missing}"
+
+
+def test_baseline_entries_require_reasons(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"suppressions": [
+        {"model": "otr", "rule": "tpu-lowerability/int-reduce",
+         "file": "round_tpu/models/otr.py", "reason": ""}
+    ]}))
+    from round_tpu.analysis.findings import BaselineError
+
+    with pytest.raises(BaselineError):
+        analysis.load_baseline(str(p))
+
+
+def test_cli_json_clean_without_accelerator_env():
+    """End-to-end: the CLI exits 0 on the shipped tree, emits valid JSON,
+    and never needs a preset JAX_PLATFORMS (it pins cpu itself — the
+    verifier_cli guard)."""
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "round_tpu.apps.lint", "--all", "--json"],
+        capture_output=True, text=True, env=env, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["gating"] == 0
+    assert doc["total"] == len(doc["suppressed"])
+    assert set(doc["counts_by_family"]) <= set(analysis.FAMILIES)
+
+
+# -- satellite: SpecFieldError replaces the opaque AttributeError -----------
+
+
+def _toy_state(n, T=None):
+    shape = (n,) if T is None else (T, n)
+    return fixtures.ToyState(
+        x=jnp.zeros(shape, jnp.int32),
+        decided=jnp.zeros(shape, bool),
+        decision=jnp.full(shape, -1, jnp.int32),
+    )
+
+
+def test_check_trace_names_missing_field_and_formula():
+    from round_tpu.spec.check import check_trace
+
+    n = 4
+    with pytest.raises(SpecFieldError) as ei:
+        check_trace(fixtures.TypoSpec(), _toy_state(n, T=2), _toy_state(n), n)
+    msg = str(ei.value)
+    assert "decidedd" in msg                     # the missing field
+    assert "Agreement" in msg                    # which formula
+    assert "x, decided, decision" in msg         # what exists instead
+
+
+def test_procview_old_snapshot_field_error():
+    from round_tpu.spec.dsl import Env, ProcView
+
+    n = 4
+    env = Env(state=_toy_state(n), n=n, old=_toy_state(n))
+    view = ProcView(env, 0)
+    with pytest.raises(SpecFieldError) as ei:
+        _ = view.old.nope
+    assert "old-snapshot" in str(ei.value) and "nope" in str(ei.value)
+    # well-formed access still works
+    assert view.decided.shape == ()
+
+
+def test_verifier_cli_all_arg_handling():
+    from round_tpu.apps import verifier_cli
+
+    with pytest.raises(SystemExit):
+        verifier_cli.main([])                 # no protocol, no --all
+    with pytest.raises(SystemExit):
+        verifier_cli.main(["--all", "tpc"])   # --all takes no protocol

@@ -491,6 +491,40 @@ def check_otr_flagship_shape(rng, it):
     return cfg
 
 
+def check_lint(rng, it):
+    """The static-analysis rung: run roundlint's full sweep through the
+    actual CLI (`python -m round_tpu.apps.lint --all --json`) and bank the
+    per-family finding counts — a finding-count regression (or a stale
+    baseline entry) shows up in the SOAK.jsonl trajectory the same way a
+    differential divergence would.  Fast (~10 s: pure CPU abstract
+    tracing, nothing executes)."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "round_tpu.apps.lint", "--all", "--json"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    cfg = dict(kind="lint", it=it, exit=proc.returncode)
+    try:
+        doc = json.loads(proc.stdout)
+    except ValueError:
+        return {**cfg, "fail": "lint CLI emitted no JSON",
+                "stderr": proc.stderr[-300:]}
+    cfg.update(
+        total=doc["total"], gating=doc["gating"],
+        suppressed=len(doc["suppressed"]),
+        stale_baseline=len(doc["stale_baseline"]),
+        by_family=doc["counts_by_family"],
+    )
+    if proc.returncode != 0 or doc["gating"]:
+        first = doc["findings"][0] if doc["findings"] else {}
+        return {**cfg, "fail": f"{doc['gating']} non-baselined lint "
+                               f"finding(s)",
+                "first": f"{first.get('file')}:{first.get('line')} "
+                         f"{first.get('rule')} ({first.get('model')})"}
+    return cfg
+
+
 def check_host_chaos(rng, it):
     """The host-chaos rotation rung: a real 3-process cluster under a
     seeded wire-fault schedule (runtime/chaos.py FaultyTransport: the
@@ -545,7 +579,7 @@ def main():
     rotation = [check_otr_family, check_otr_family, check_epsilon,
                 check_lattice, check_tpc_kset, check_erb,
                 lambda r, i: check_otr_family(r, i, scale=True),
-                check_otr_flagship_shape, check_host_chaos]
+                check_otr_flagship_shape, check_host_chaos, check_lint]
     while time.monotonic() < t_end:
         check = rotation[it % len(rotation)]
         t0 = time.perf_counter()
