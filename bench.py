@@ -146,7 +146,30 @@ def build_parser():
                          "watchdog time remains)")
     ap.add_argument("--no-subprocess", action="store_true",
                     help="run the bench in-process (dev/tests; no hang protection)")
+    ap.add_argument("--compile-cache", type=str, default=None, metavar="DIR",
+                    help="enable the JAX persistent compilation cache in "
+                         "DIR: repeat runs of the same shapes load "
+                         "compiled executables from disk instead of "
+                         "re-tracing (the CPU-degraded flagship spends "
+                         "~5.6 s of a ~4.5 ms run in compile, "
+                         "BENCH_r05.json — with the cache only the first "
+                         "run pays it)")
     return ap
+
+
+def enable_compile_cache(path):
+    """Opt-in persistent compilation cache (shared by bench.py and
+    tools/soak.py --compile-cache, and the subprocess env of
+    chaos.cluster_env): min-size/min-time floors zeroed so even the tiny
+    CPU-proxy kernels cache."""
+    import os as _os
+
+    import jax as _jax
+
+    _os.makedirs(path, exist_ok=True)
+    _jax.config.update("jax_compilation_cache_dir", _os.path.abspath(path))
+    _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
 
 
 def apply_lite(args):
@@ -410,6 +433,12 @@ def worker_main(args):
         # unreliable when an accelerator PJRT plugin is pre-registered by
         # sitecustomize
         jax.config.update("jax_platforms", args.platform)
+    if args.compile_cache:
+        # opt-in persistent compilation cache: repeat runs of the fixed
+        # flagship/ladder shapes load executables from disk (the worker
+        # re-parses the driver's argv, so the flag reaches it here —
+        # before the first trace)
+        enable_compile_cache(args.compile_cache)
 
     import jax.numpy as jnp
     import numpy as np

@@ -40,7 +40,7 @@ from round_tpu.runtime.transport import HostTransport  # noqa: E402
 
 def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
              errors=None, proto="tcp", stats=None, algo=None, rate=1,
-             adaptive_cap_ms=0):
+             adaptive_cap_ms=0, wire="binary"):
     tr = HostTransport(my_id, peers[my_id][1], proto=proto)
     # ONE algorithm object across instances: the jitted round functions
     # cache on its rounds, so instance 2+ skip compilation entirely.
@@ -64,12 +64,13 @@ def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
             results[my_id] = run_instance_loop_pipelined(
                 algo, my_id, peers, tr, instances, rate=rate,
                 timeout_ms=timeout_ms, seed=seed, stats_out=node_stats,
-                adaptive=adaptive,
+                adaptive=adaptive, wire=wire,
             )
         else:
             results[my_id] = run_instance_loop(
                 algo, my_id, peers, tr, instances, timeout_ms=timeout_ms,
                 seed=seed, stats_out=node_stats, adaptive=adaptive,
+                wire=wire,
             )
         if stats is not None:
             stats[my_id] = node_stats
@@ -121,12 +122,22 @@ def _score(logs, instances, wall, n, algo, timeout_ms, mode,
 
 
 def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
-            proto="tcp", rate=1, adaptive_cap_ms=0):
+            proto="tcp", rate=1, adaptive_cap_ms=0, wire="binary"):
     """Run `instances` consecutive consensus instances over `n` replicas
     (threads, each with its own transport+sockets — on a single-vCPU box
     the GIL interleaving beats process-per-replica; see measure_processes
     for the reference's exact multi-process shape).  Returns (result dict,
     per-node decision logs)."""
+    # thread-mode scheduling: n replicas in lockstep rounds over ONE GIL —
+    # with CPython's default 5 ms switch interval, a replica waiting for
+    # the round's last message can stall a full interval behind a peer's
+    # dispatch burst (measured: the transport-only round floor is ~2 ms
+    # while host rounds sat at ~8 ms).  0.5 ms bounds the convoy; applies
+    # to the whole process, i.e. identically to both arms of the wire A/B
+    # — and is RESTORED on exit so an embedding process (the soak
+    # rotation, a test run) keeps its own interval
+    prev_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.0005)
     ports = alloc_ports(n)
     peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
     results: dict = {}
@@ -137,17 +148,21 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
         threading.Thread(
             target=run_node,
             args=(i, peers, algo, instances, timeout_ms, results, seed,
-                  errors, proto, stats, shared_algo, rate, adaptive_cap_ms),
+                  errors, proto, stats, shared_algo, rate, adaptive_cap_ms,
+                  wire),
         )
         for i in range(n)
     ]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    join_timeout = max(60.0, instances * n * timeout_ms / 1000.0)
-    for t in threads:
-        t.join(timeout=join_timeout)
-    wall = time.perf_counter() - t0
+    try:
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        join_timeout = max(60.0, instances * n * timeout_ms / 1000.0)
+        for t in threads:
+            t.join(timeout=join_timeout)
+        wall = time.perf_counter() - t0
+    finally:
+        sys.setswitchinterval(prev_switch)
     if any(t.is_alive() for t in threads):
         raise RuntimeError(
             f"replica thread(s) wedged after {join_timeout:.0f}s; "
@@ -164,6 +179,7 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
             if rate <= 1 else f"thread-per-replica rate={rate}")
     if adaptive_cap_ms > 0:
         mode += f" adaptive(cap={adaptive_cap_ms}ms)"
+    mode += f" wire={wire}"
     score = _score(results, instances, wall, n, algo, timeout_ms,
                    mode, proto=proto)
     # per-node diagnostics: timeouts is the throughput killer (each one
@@ -174,7 +190,7 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
 
 def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,
                       proto="tcp", adaptive_cap_ms=0, trace=None,
-                      metrics_json=None):
+                      metrics_json=None, wire="binary"):
     """One OS PROCESS per replica (the reference's exact shape: 4 JVMs on
     localhost) via the host_replica CLI's --instances loop: no shared GIL,
     true parallel replicas.  Returns the same result dict as measure().
@@ -199,6 +215,7 @@ def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,
         "--instances", str(instances),
         "--timeout-ms", str(timeout_ms),
         "--proto", proto,
+        "--wire", wire,
         "--max-rounds", "32",  # same per-instance cap as measure()
     ]
     if adaptive_cap_ms > 0:
@@ -255,6 +272,7 @@ def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,
     mode = "process-per-replica"
     if adaptive_cap_ms > 0:
         mode += f" adaptive(cap={adaptive_cap_ms}ms)"
+    mode += f" wire={wire}"
     result = _score(logs, instances, wall, n, algo, timeout_ms,
                     mode, wall_basis="slowest-replica-loop",
                     proto=proto)
@@ -269,6 +287,57 @@ def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,
         agreed / harness_wall if harness_wall > 0 else 0.0, 2
     )
     return result, logs
+
+
+def measure_wire_ab(n=4, instances=20, algo="otr", timeout_ms=300,
+                    proto="tcp", rate=1, pairs=9, warmup=1,
+                    processes=False):
+    """The wire old-vs-new interleaved A/B (apps/perf_ab.py): arm A is
+    the seed path (``wire="pickle"``: pickle payloads, one native send
+    per message, dict-inbox mailbox), arm B the rebuilt hot path
+    (``wire="binary"``: codec + per-peer coalescing + batched receive +
+    in-place mailbox).  Same ports discipline, same schedules; the
+    warmup pass absorbs the shared jit compile so the pairs measure the
+    WIRE, not XLA.  Returns one result dict (the ``host-perf`` soak rung
+    banks it; ``ratio`` >= 1 is the regression gate)."""
+    from round_tpu.apps.perf_ab import interleaved_ab
+
+    def arm(wire):
+        def run():
+            if processes:
+                res, _ = measure_processes(
+                    n=n, instances=instances, algo=algo,
+                    timeout_ms=timeout_ms, proto=proto, wire=wire)
+            else:
+                res, _ = measure(n=n, instances=instances, algo=algo,
+                                 timeout_ms=timeout_ms, proto=proto,
+                                 rate=rate, wire=wire)
+            return res["value"]
+        return run
+
+    ab = interleaved_ab(arm("pickle"), arm("binary"), pairs=pairs,
+                        warmup=warmup)
+    return {
+        "metric": f"host_{algo}_n{n}_wire_ab_speedup",
+        "value": ab["ratio"],
+        "unit": "x (binary/pickle decisions-per-sec)",
+        "extra": {
+            "dps_pickle": ab["mean_a"],
+            "dps_binary": ab["mean_b"],
+            "median_pickle": ab["median_a"],
+            "median_binary": ab["median_b"],
+            "samples_pickle": ab["a"],
+            "samples_binary": ab["b"],
+            "pairs": pairs,
+            "warmup": warmup,
+            "instances": instances,
+            "n": n,
+            "timeout_ms": timeout_ms,
+            "mode": ("process-per-replica" if processes
+                     else "thread-per-replica"
+                     + (f" rate={rate}" if rate > 1 else "")),
+        },
+    }
 
 
 def main(argv=None) -> int:
@@ -304,8 +373,27 @@ def main(argv=None) -> int:
                     help="write the unified metrics snapshot "
                          "(round_tpu/obs/metrics.py) as JSON — FILE.<id> "
                          "per replica in --processes mode")
+    ap.add_argument("--wire", choices=["binary", "pickle"],
+                    default="binary",
+                    help="payload path: 'binary' (codec + per-peer frame "
+                         "coalescing + batched receive, the hot path) or "
+                         "'pickle' (the pre-rebuild baseline)")
+    ap.add_argument("--ab-wire", action="store_true",
+                    help="run the interleaved wire A/B (pickle vs binary, "
+                         "apps/perf_ab.py) and report the speedup instead "
+                         "of a single measurement")
+    ap.add_argument("--ab-pairs", type=int, default=9,
+                    help="interleaved pairs for --ab-wire")
     args = ap.parse_args(argv)
     cap = args.timeout_cap_ms if args.adaptive_timeout else 0
+    if args.ab_wire:
+        result = measure_wire_ab(
+            n=args.n, instances=args.instances, algo=args.algo,
+            timeout_ms=args.timeout_ms, proto=args.proto, rate=args.rate,
+            pairs=args.ab_pairs, processes=args.processes,
+        )
+        print(json.dumps(result))
+        return 0
     if args.processes:
         if args.rate > 1:
             print("warning: --rate applies to thread mode only",
@@ -314,7 +402,7 @@ def main(argv=None) -> int:
             n=args.n, instances=args.instances, algo=args.algo,
             timeout_ms=args.timeout_ms, proto=args.proto,
             adaptive_cap_ms=cap, trace=args.trace,
-            metrics_json=args.metrics_json,
+            metrics_json=args.metrics_json, wire=args.wire,
         )
     else:
         if args.trace:
@@ -326,7 +414,7 @@ def main(argv=None) -> int:
         result, _logs = measure(
             n=args.n, instances=args.instances, algo=args.algo,
             timeout_ms=args.timeout_ms, proto=args.proto, rate=args.rate,
-            adaptive_cap_ms=cap,
+            adaptive_cap_ms=cap, wire=args.wire,
         )
         if args.trace:
             TRACE.dump_jsonl(args.trace)

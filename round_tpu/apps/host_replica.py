@@ -150,6 +150,13 @@ def main(argv=None) -> int:
                          "backoff, runtime/transport.py start_reconnect); "
                          "0 disables — a dead peer is then only redialed "
                          "when a send to it happens")
+    ap.add_argument("--wire", choices=["binary", "pickle"],
+                    default="binary",
+                    help="payload path (runtime/host.py HostRunner): "
+                         "'binary' = the codec + frame-coalescing hot "
+                         "path; 'pickle' = the pre-rebuild baseline "
+                         "(receiving is always bilingual, so mixed "
+                         "clusters interoperate)")
     ap.add_argument("--linger-ms", type=int, default=0, metavar="MS",
                     help="after the loop completes, keep answering peers' "
                          "traffic with decision replies until the wire is "
@@ -318,7 +325,7 @@ def main(argv=None) -> int:
                 send_when_catching_up=args.send_when_catching_up,
                 delay_first_send_ms=args.delay_first_send_ms,
                 nbr_byzantine=args.nbr_byzantine,
-                adaptive=adaptive,
+                adaptive=adaptive, wire=args.wire,
             )
             res = runner.run(
                 {"initial_value": np.int32(args.value)},
@@ -378,7 +385,7 @@ def main(argv=None) -> int:
                 base_value=args.value, max_rounds=args.max_rounds,
                 nbr_byzantine=args.nbr_byzantine,
                 value_schedule=args.value_schedule,
-                adaptive=adaptive, stats_out=stats,
+                adaptive=adaptive, stats_out=stats, wire=args.wire,
             )
         else:
             decisions = run_instance_loop(
@@ -392,6 +399,7 @@ def main(argv=None) -> int:
                 adaptive=adaptive, stats_out=stats,
                 checkpoint_dir=args.checkpoint_dir,
                 view=manager, view_schedule=view_schedule,
+                wire=args.wire,
             )
         wall = time.perf_counter() - t0
         dump_decision_log(decisions)

@@ -854,6 +854,52 @@ int rt_node_recv(void *node, int *from, uint64_t *tag, uint8_t *buf,
   return len;
 }
 
+// Batched drain: pack EVERY queued message (up to buflen) into buf as
+// consecutive records
+//
+//   i32 from | u64 tag | u32 len | payload[len]        (native endianness)
+//
+// waiting up to timeout_ms for the first one.  One ctypes call + one
+// Python-side copy replaces a copy-out call per message — the hot-path
+// receive of runtime/transport.py (messages stay queued when they don't
+// fit, so a partial drain just means another call).  *nbytes gets the
+// total bytes packed.  Returns the number of messages packed, 0 on
+// timeout, -2 if the FIRST message cannot fit buflen (call again with a
+// bigger buf), -3 once the node was stopped and the inbox is empty.
+int rt_node_recv_many(void *node, uint8_t *buf, int buflen, int timeout_ms,
+                      int *nbytes) {
+  auto *n = static_cast<Node *>(node);
+  *nbytes = 0;
+  std::unique_lock<std::mutex> l(n->inbox_mu);
+  n->inbox_cv.wait_for(l, std::chrono::milliseconds(timeout_ms),
+                       [n] { return !n->inbox.empty() || n->recv_stopped; });
+  if (n->inbox.empty()) return n->recv_stopped ? -3 : 0;
+  constexpr size_t kHdr = sizeof(int32_t) + sizeof(uint64_t) +
+                          sizeof(uint32_t);
+  size_t off = 0;
+  int count = 0;
+  while (!n->inbox.empty()) {
+    Msg &m = n->inbox.front();
+    size_t need = kHdr + m.payload.size();
+    if (off + need > static_cast<size_t>(buflen)) {
+      if (count == 0) return -2;  // first message alone overflows the buf
+      break;                      // the rest stays queued for the next call
+    }
+    int32_t from = m.from;
+    uint64_t tag = m.tag;
+    uint32_t len = static_cast<uint32_t>(m.payload.size());
+    std::memcpy(buf + off, &from, sizeof(from));
+    std::memcpy(buf + off + 4, &tag, sizeof(tag));
+    std::memcpy(buf + off + 12, &len, sizeof(len));
+    if (len) std::memcpy(buf + off + kHdr, m.payload.data(), len);
+    off += need;
+    ++count;
+    n->inbox.pop_front();
+  }
+  *nbytes = static_cast<int>(off);
+  return count;
+}
+
 // Stop the node (event loop joined, sockets closed, blocked rt_node_recv
 // calls return -3) WITHOUT freeing it: lets receiver threads unwind before
 // rt_node_destroy.  Idempotent.

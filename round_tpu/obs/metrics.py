@@ -20,7 +20,12 @@ metrics surface soak/bench/watch tooling can bank uniformly):
 directly.  Instruments are always-on (a lock-guarded int add per event on
 paths that are already wire- or ms-scale); the *legacy* ``Stats`` surface
 keeps its opt-in ``enabled`` gate because the reference's --stat is
-opt-in.
+opt-in.  Hot paths that process message BATCHES (the coalesced wire,
+runtime/transport.py) increment once per batch with a delta, not once
+per message — the instrument cost must not scale with the coalescing
+factor.  The full name vocabulary (host.*, wire.* incl. the batch/codec
+family, mux.*, chaos.*, view.*, ckpt.*, engine.*) lives in
+docs/OBSERVABILITY.md.
 """
 
 from __future__ import annotations

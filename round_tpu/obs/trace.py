@@ -27,6 +27,12 @@ merge into one timeline without a shared monotonic epoch; per-round
 latencies come from the ``wall_ms`` field of round_end events, which IS
 measured monotonically by the emitter.  The full event vocabulary is
 documented in docs/OBSERVABILITY.md.
+
+Batched wire paths (runtime/transport.py coalesced frames, the mux's
+drained routing loop) emit per LOGICAL frame, not per container — a
+trace consumer never sees framing, only protocol events, so
+tools/trace_view.py's fault correlation is framing-invariant (the same
+property tests/test_chaos.py pins for the chaos schedules).
 """
 
 from __future__ import annotations

@@ -16,6 +16,9 @@ top.  Here the same services are tensor-shaped:
   - live reconfiguration       = versioned View + ViewManager: membership
     ops decided by consensus over the real wire and applied to the
     RUNNING peer table with epoch-stamped traffic (view.py)
+  - the wire codec             = typed binary payload serialization with
+    a restricted-pickle fallback (codec.py; the Kryo registered-class
+    role) feeding the coalesced zero-copy hot path of transport.py
 """
 
 from round_tpu.runtime.checkpoint import restore as restore_checkpoint

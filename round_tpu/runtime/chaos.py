@@ -245,20 +245,24 @@ class FaultyTransport:
     def __exit__(self, *exc):
         self.close()
 
-    def send(self, to, tag, payload: bytes = b"") -> bool:
+    def _send_faults(self, to, tag, payload):
+        """Apply the sender-side fault families to ONE logical frame.
+        Returns (deliver, payload, dup): the frame's fate is a pure
+        function of (seed, src, dst, round) regardless of HOW it then
+        travels — a direct send and a coalesced batch member fault
+        IDENTICALLY, which is what keeps per-(seed,src,dst,round)
+        schedules framing-invariant (pinned by tests/test_chaos.py)."""
         plan, src = self.plan, self.inner.id
-        if tag.flag != FLAG_NORMAL:
-            return self.inner.send(to, tag, payload)
         r, inst = tag.round, tag.instance
         if 0 <= plan.crash_round <= r:
             self._count("crash_mute", src, to, r, inst)
-            return True  # swallowed: the crashed sender is silent
+            return False, payload, False  # swallowed: crashed = silent
         if r < plan.heal_round and self._side(src) != self._side(to):
             self._count("partition", src, to, r, inst)
-            return True
+            return False, payload, False
         if self._event(STREAM_DROP, src, to, r, plan.drop):
             self._count("drop", src, to, r, inst)
-            return True  # silent loss, UDP-style
+            return False, payload, False  # silent loss, UDP-style
         if payload and self._event(STREAM_TRUNCATE, src, to, r,
                                    plan.truncate):
             u = self._u32(STREAM_TRUNCATE, src, to, r)
@@ -268,11 +272,43 @@ class FaultyTransport:
             u = self._u32(STREAM_GARBAGE, src, to, r)
             payload = (u.to_bytes(4, "big") * (1 + (u >> 8) % 16))
             self._count("garbage", src, to, r, inst)
-        ok = self.inner.send(to, tag, payload)
-        if self._event(STREAM_DUP, src, to, r, plan.dup):
-            self.inner.send(to, tag, payload)
+        dup = self._event(STREAM_DUP, src, to, r, plan.dup)
+        if dup:
             self._count("dup", src, to, r, inst)
+        return True, payload, dup
+
+    def send(self, to, tag, payload: bytes = b"") -> bool:
+        if tag.flag != FLAG_NORMAL:
+            return self.inner.send(to, tag, payload)
+        deliver, payload, dup = self._send_faults(to, tag, payload)
+        if not deliver:
+            return True
+        ok = self.inner.send(to, tag, payload)
+        if dup:
+            self.inner.send(to, tag, payload)
         return ok
+
+    def send_buffered(self, to, tag, payload=b"") -> bool:
+        """The coalescing surface (runtime/transport.py): faults apply
+        PER LOGICAL FRAME before the frame joins its destination batch,
+        so a batch member drops/corrupts/duplicates exactly when its
+        direct-send twin would (duplicates ride the same batch)."""
+        inner_sb = getattr(self.inner, "send_buffered", None)
+        if inner_sb is None:
+            return self.send(to, tag, payload)
+        if tag.flag != FLAG_NORMAL:
+            return inner_sb(to, tag, payload)
+        deliver, payload, dup = self._send_faults(to, tag, payload)
+        if not deliver:
+            return True
+        ok = inner_sb(to, tag, payload)
+        if dup:
+            inner_sb(to, tag, payload)
+        return ok
+
+    def flush(self, to=None) -> int:
+        f = getattr(self.inner, "flush", None)
+        return 0 if f is None else f(to)
 
     def _maybe_hold(self, got):
         """Receiver-side families: None when the packet was held back."""
@@ -317,6 +353,17 @@ class FaultyTransport:
             got = self._maybe_hold(got)
             if got is not None:
                 return got
+
+    def recv_many(self, timeout_ms: int):
+        """Batched-drain surface: repeated recv() so the receiver-side
+        hold/release schedules (delay, reorder) apply per logical frame
+        exactly as they do frame-by-frame."""
+        out = []
+        got = self.recv(timeout_ms)
+        while got is not None:
+            out.append(got)
+            got = self.recv(0)
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -41,8 +41,16 @@ progress for `wait_cap_ms` (default 30 s) is force-timed-out with a warning
 — the reference blocks forever (buffer.take()), which an unattended
 deployment of THIS framework must not.
 
-Payloads cross the wire pickled (the Kryo role; same trust model as the
-reference — replicas deserialize only from their own group).
+Payloads cross the wire in the binary codec (runtime/codec.py — the Kryo
+registered-class-codec role; same trust model as the reference: replicas
+deserialize only from their own group, and the tagged pickle fallback
+stays behind the restricted unpickler).  The send path encodes ONCE per
+round into a pooled scratch, coalesces per-destination frames into
+FLAG_BATCH containers flushed at the round boundary, and the receive
+path drains every queued frame in one native call; the mailbox is
+assembled IN PLACE into preallocated [n, ...] arrays (_RoundMailbox).
+``HostRunner(wire="pickle")`` keeps the seed path alive as the A/B
+baseline (apps/perf_ab.py).
 """
 
 from __future__ import annotations
@@ -64,11 +72,12 @@ from round_tpu.core.rounds import FoldRound, Round, RoundCtx
 from round_tpu.obs.metrics import METRICS, MS_BUCKETS
 from round_tpu.obs.trace import TRACE
 from round_tpu.ops.mailbox import Mailbox
+from round_tpu.runtime import codec
 from round_tpu.runtime.log import get_logger
 from round_tpu.runtime.oob import (
     FLAG_DECISION, FLAG_NORMAL, FLAG_VIEW, Message, Tag,
 )
-from round_tpu.runtime.transport import HostTransport, wire_loads
+from round_tpu.runtime.transport import HostTransport
 
 log = get_logger("host")
 
@@ -220,22 +229,33 @@ def _schedule_value(value_schedule: str, base_value: int, my_id: int,
 
 
 def _try_send_decision(transport, replied: Dict[Tuple[int, int], float],
-                       sender: int, instance: int, decision) -> bool:
+                       sender: int, instance: int, decision,
+                       enc_cache: Optional[Dict[int, bytes]] = None) -> bool:
     """THE TooLate / trySendDecision reply (PerfTest.scala:40-60), shared
     by the sequential loop's foreign sink and the pipelined mux: answer a
     completed instance's late traffic with its decision, rate-limited per
     (sender, instance) — the reply itself can drop on UDP, so the
     laggard's next retransmission re-arms it.  True iff a reply actually
     went out (rate-limited/undecided calls return False, so reply
-    accounting counts wire sends, not answerable packets)."""
+    accounting counts wire sends, not answerable packets).
+
+    ``enc_cache`` ({instance: wire bytes}) makes the encode once-per-
+    instance: without it every laggard probe — and every DESTINATION peer
+    in the linger loop — re-serialized the same decision payload (the
+    per-peer re-encode audit of this module; see also ViewManager.
+    reply_view)."""
     if decision is None:
         return False
     now = _time.monotonic()
     if now - replied.get((sender, instance), -1.0) <= 0.25:
         return False
     replied[(sender, instance)] = now
-    transport.send(sender, Tag(instance=instance, flag=FLAG_DECISION),
-                   pickle.dumps(np.asarray(decision)))
+    wire = enc_cache.get(instance) if enc_cache is not None else None
+    if wire is None:
+        wire = codec.encode(np.asarray(decision))
+        if enc_cache is not None:
+            enc_cache[instance] = wire
+    transport.send(sender, Tag(instance=instance, flag=FLAG_DECISION), wire)
     _C_REPLIES.inc()
     if TRACE.enabled:
         TRACE.emit("decision_reply", node=getattr(transport, "id", None),
@@ -274,6 +294,27 @@ class MuxEndpoint:
             ) from self._mux.failure
         return got
 
+    def recv_many(self, timeout_ms: int):
+        """Drain every routed frame currently queued (the HostRunner
+        batched-drain surface over a mux queue)."""
+        out = []
+        got = self.recv(timeout_ms)
+        while got is not None:
+            out.append(got)
+            got = self.recv(0)
+        return out
+
+    def send_buffered(self, dest, tag, payload):
+        t = self._mux.transport
+        f = getattr(t, "send_buffered", None)
+        if f is None:  # bare test doubles: degrade to a direct send
+            return t.send(dest, tag, bytes(payload))
+        return f(dest, tag, payload)
+
+    def flush(self, to=None):
+        f = getattr(self._mux.transport, "flush", None)
+        return 0 if f is None else f(to)
+
     @property
     def dropped(self):
         return self._mux.transport.dropped
@@ -289,7 +330,11 @@ class InstanceMux:
 
     Routing rules (the dispatcher + defaultHandler split):
       * a registered instance's traffic → its queue (HostRunner consumes
-        through a MuxEndpoint facade);
+        through a MuxEndpoint facade).  Routing is by TAG HEADER PEEK
+        only — payload bytes are never decoded here (they stay raw
+        memoryviews from the transport's batched drain until the owning
+        runner's _loads), and a whole drain is routed under one lock
+        acquisition;
       * NORMAL traffic for a COMPLETED instance → rate-limited
         FLAG_DECISION reply with that instance's decision (the TooLate /
         trySendDecision path, PerfTest.scala:40-60);
@@ -311,6 +356,9 @@ class InstanceMux:
         self._stash_order: collections.deque = collections.deque()
         self._decisions: Dict[int, Optional[np.ndarray]] = {}
         self._replied: Dict[Tuple[int, int], float] = {}
+        self._enc_cache: Dict[int, bytes] = {}  # instance -> encoded
+        # decision wire bytes (encode once, reply to every laggard/peer
+        # with the shared buffer)
         self._stop = False
         # set when the router thread dies on an unexpected exception; every
         # endpoint raises and run_instance_loop_pipelined re-raises
@@ -366,39 +414,57 @@ class InstanceMux:
                     q.put(_ROUTER_DOWN)
 
     def _loop_body(self) -> None:
+        # batched drain when the transport offers it: every queued frame
+        # in one native call, routed (by tag header peek — payloads are
+        # never decoded here) under ONE lock acquisition per drain instead
+        # of one per packet
+        recv_many = getattr(self.transport, "recv_many", None)
         while not self._stop:
-            got = self.transport.recv(50)
-            if got is None:
+            if recv_many is not None:
+                got_list = recv_many(50)
+            else:
+                got = self.transport.recv(50)
+                got_list = [got] if got is not None else []
+            if not got_list:
                 continue
-            sender, tag, raw = got
-            iid = tag.instance
-            reply_with = None
+            replies: List[Tuple[int, int, Any]] = []
             with self._lock:
                 # routing decision and stash append under ONE acquisition:
                 # a lookup in one critical section + append in another
                 # would race register() replaying the stash in between,
                 # silently losing the packet
-                q = self._queues.get(iid)
-                if q is not None:
-                    q.put(got)
-                    _C_MUX_ROUTED.inc()
-                elif iid in self._decisions:
-                    if tag.flag == FLAG_NORMAL:
-                        reply_with = self._decisions[iid]
-                elif tag.flag == FLAG_NORMAL:
-                    while len(self._stash_order) >= self._STASH_CAP:
-                        old = self._stash_order.popleft()
-                        bucket = self._stash.get(old)
-                        if bucket:
-                            bucket.pop(0)
-                            if not bucket:
-                                del self._stash[old]
-                    self._stash.setdefault(iid, []).append(got)
-                    self._stash_order.append(iid)
-                    _C_MUX_STASHED.inc()
-            if reply_with is not None:
-                _try_send_decision(self.transport, self._replied,
-                                   sender, iid, reply_with)
+                for got in got_list:
+                    sender, tag, _raw = got
+                    iid = tag.instance
+                    q = self._queues.get(iid)
+                    if q is not None:
+                        q.put(got)
+                        _C_MUX_ROUTED.inc()
+                    elif iid in self._decisions:
+                        if tag.flag == FLAG_NORMAL:
+                            replies.append(
+                                (sender, iid, self._decisions[iid]))
+                    elif tag.flag == FLAG_NORMAL:
+                        while len(self._stash_order) >= self._STASH_CAP:
+                            old = self._stash_order.popleft()
+                            bucket = self._stash.get(old)
+                            if bucket:
+                                bucket.pop(0)
+                                if not bucket:
+                                    del self._stash[old]
+                        if not isinstance(got[2], bytes):
+                            # stash entries are LONG-LIVED (until the
+                            # instance registers); a memoryview here would
+                            # pin its whole drain copy — own the bytes
+                            got = (got[0], got[1], bytes(got[2]))
+                        self._stash.setdefault(iid, []).append(got)
+                        self._stash_order.append(iid)
+                        _C_MUX_STASHED.inc()
+            for sender, iid, reply_with in replies:
+                if reply_with is not None:
+                    _try_send_decision(self.transport, self._replied,
+                                       sender, iid, reply_with,
+                                       enc_cache=self._enc_cache)
 
 
 def run_instance_loop_pipelined(
@@ -416,6 +482,7 @@ def run_instance_loop_pipelined(
     nbr_byzantine: int = 0,
     value_schedule: str = "mixed",
     adaptive: Optional["AdaptiveTimeout"] = None,
+    wire: str = "binary",
 ) -> List[Optional[int]]:
     """The PerfTest2 loop with `rate` instances IN FLIGHT (the reference's
     `-rt` rate + InstanceDispatcher shape): a sliding window of concurrent
@@ -439,6 +506,7 @@ def run_instance_loop_pipelined(
                 algo, my_id, peers, ep, instance_id=inst,
                 timeout_ms=timeout_ms, seed=seed + inst,
                 nbr_byzantine=nbr_byzantine, adaptive=adaptive,
+                wire=wire,
             )
             value = _schedule_value(value_schedule, base_value, my_id, inst)
             res = runner.run({"initial_value": np.int32(value)},
@@ -512,6 +580,7 @@ def run_instance_loop(
     checkpoint_dir: Optional[str] = None,
     view=None,
     view_schedule: Optional[Dict[int, Tuple[int, int]]] = None,
+    wire: str = "binary",
 ) -> List[Optional[int]]:
     """The PerfTest2 loop (PerfTest2.scala:19-110): `instances` consecutive
     consensus instances over one transport, with start-skew stashing —
@@ -549,6 +618,7 @@ def run_instance_loop(
     current = {"inst": 0}
     decisions: List[Optional[int]] = []
     replied: Dict[Tuple[int, int], float] = {}
+    enc_cache: Dict[int, bytes] = {}
     start = 1
     if checkpoint_dir is not None:
         from round_tpu.runtime import checkpoint as _ckpt
@@ -584,7 +654,8 @@ def run_instance_loop(
             idx = tag.instance - 1
             if 0 <= idx < len(decisions):
                 _try_send_decision(transport, replied, sender,
-                                   tag.instance, decisions[idx])
+                                   tag.instance, decisions[idx],
+                                   enc_cache=enc_cache)
             return
         stash.setdefault(tag.instance, {}).setdefault(
             tag.round, {})[sender] = payload
@@ -616,6 +687,7 @@ def run_instance_loop(
                 nbr_byzantine=nbr_byzantine,
                 adaptive=adaptive,
                 view=view,
+                wire=wire,
             )
             value = _schedule_value(value_schedule, base_value, vid, inst)
             res = runner.run({"initial_value": np.int32(value)},
@@ -693,6 +765,7 @@ def serve_decisions(transport, decisions: List[Optional[int]],
     stale pre-crash packets drained at linger start must not collapse
     the restart window.  Returns the number of replies sent."""
     replied: Dict[Tuple[int, int], float] = {}
+    enc_cache: Dict[int, bytes] = {}
     served = 0
     t_end = _time.monotonic() + max_ms / 1000.0
     window = idle_ms / 1000.0
@@ -705,7 +778,8 @@ def serve_decisions(transport, decisions: List[Optional[int]],
         if (tag.flag == FLAG_NORMAL and 1 <= tag.instance <= len(decisions)
                 and decisions[tag.instance - 1] is not None):
             if _try_send_decision(transport, replied, sender, tag.instance,
-                                  decisions[tag.instance - 1]):
+                                  decisions[tag.instance - 1],
+                                  enc_cache=enc_cache):
                 served += 1
             if tag.instance == len(decisions):
                 window = min(window, contact_idle_ms / 1000.0)
@@ -740,6 +814,120 @@ def _save_decision_checkpoint(checkpoint_dir: str,
     )
 
 
+class _RoundMailbox:
+    """One round's mailbox, assembled IN PLACE: decoded payloads write
+    directly into preallocated ``[n, ...]`` per-round arrays + mask — the
+    exact buffers the jitted update consumes — replacing the per-message
+    dict insert + per-probe restack of the old path (a FoldRound's
+    go-probe used to re-flatten and re-stack the whole inbox on EVERY
+    received message).  The arrays are REUSED across rounds (reset zeros
+    them), so the steady state allocates nothing.
+
+    ``legacy=True`` keeps the seed behavior byte-for-byte (dict inbox,
+    stacked per values_mask call) — the "old path" arm of the wire A/B
+    (apps/perf_ab.py).
+
+    A payload that decoded fine but has the WRONG SHAPE for this round
+    (tree structure, leaf count, leaf shape/dtype) is byzantine garbage —
+    dropped per sender + counted via the runner, never a crash (the
+    deserialize-failure tolerance of InstanceHandler.scala:392-399
+    extended to the structural layer the codec does not check)."""
+
+    __slots__ = ("runner", "legacy", "n", "treedef", "stacked", "mask",
+                 "like", "count", "_sig", "_inbox")
+
+    def __init__(self, runner: "HostRunner", legacy: bool):
+        self.runner = runner
+        self.legacy = legacy
+        self.n = runner.n
+        self.treedef = None
+        self.stacked: List[np.ndarray] = []
+        self.mask = np.zeros((self.n,), dtype=bool)
+        self.like = None
+        self.count = 0
+        self._sig = None
+        self._inbox: Dict[int, Any] = {}
+
+    def reset(self, like: Any) -> None:
+        """Arm for a new round whose payload exemplar is ``like`` (the
+        just-computed send payload: every peer runs the same round class,
+        so its shape IS the mailbox slot shape)."""
+        self.like = like
+        self.count = 0
+        if self.legacy:
+            self._inbox = {}
+            return
+        leaves, treedef = jax.tree_util.tree_flatten(like)
+        sig = (treedef, tuple((np.shape(l), np.asarray(l).dtype)
+                              for l in leaves))
+        if sig != self._sig:
+            self._sig = sig
+            self.treedef = treedef
+            self.stacked = [
+                np.zeros((self.n,) + np.shape(l),
+                         dtype=np.asarray(l).dtype)
+                for l in leaves
+            ]
+            self.mask = np.zeros((self.n,), dtype=bool)
+        else:
+            for a in self.stacked:
+                a.fill(0)
+            self.mask.fill(False)
+
+    def insert(self, sender: int, payload: Any) -> bool:
+        """Write one sender's payload into its slot; True when the round's
+        heard-set grew (duplicates overwrite, structural garbage drops)."""
+        if self.legacy:
+            grew = sender not in self._inbox
+            self._inbox[sender] = payload
+            if grew:
+                self.count += 1
+            return True  # legacy semantics: structure checked at stacking
+        try:
+            leaves = jax.tree_util.tree_flatten(payload)[0]
+            if len(leaves) != len(self.stacked):
+                raise ValueError(
+                    f"{len(leaves)} leaves != {len(self.stacked)}")
+            for slot, leaf in zip(self.stacked, leaves):
+                arr = np.asarray(leaf)
+                if arr.shape != slot.shape[1:]:
+                    raise ValueError(
+                        f"leaf shape {arr.shape} != {slot.shape[1:]}")
+                slot[sender] = arr.astype(slot.dtype, casting="same_kind")
+        except Exception as e:  # noqa: BLE001 — garbage must not kill us
+            r = self.runner
+            r.malformed += 1
+            _C_MALFORMED.inc()
+            if self.mask[sender]:
+                self.mask[sender] = False
+                self.count -= 1
+            for slot in self.stacked:
+                slot[sender] = 0  # a half-written slot must not leak
+            log.debug("node %d: dropping structurally-malformed payload "
+                      "from %d: %s", r.id, sender, e)
+            return False
+        if not self.mask[sender]:
+            self.mask[sender] = True
+            self.count += 1
+            return True
+        return False  # duplicate: overwritten, heard-set unchanged
+
+    def senders(self) -> List[int]:
+        if self.legacy:
+            return sorted(int(s) for s in self._inbox)
+        return [int(i) for i in np.nonzero(self.mask)[0]]
+
+    def values_mask(self):
+        """The (values pytree, mask) pair the jitted update/go-probe
+        consume.  Binary mode: zero-work (the arrays already ARE the
+        mailbox).  Legacy mode: stack now, exactly like the seed did."""
+        if self.legacy:
+            m = self.runner._mailbox(self._inbox, self.like)
+            return m.values, m.mask
+        return jax.tree_util.tree_unflatten(self.treedef, self.stacked), \
+            self.mask
+
+
 class HostRunner:
     """Run one replica of an Algorithm instance over the host transport.
 
@@ -767,6 +955,7 @@ class HostRunner:
         nbr_byzantine: int = 0,
         adaptive: Optional[AdaptiveTimeout] = None,
         view=None,
+        wire: str = "binary",
     ):
         self.algo = algo
         self.id = my_id
@@ -775,6 +964,27 @@ class HostRunner:
         self.instance_id = instance_id & 0xFFFF
         self.timeout_ms = timeout_ms
         self.wait_cap_ms = wait_cap_ms
+        # wire mode: "binary" (the hot path — codec payloads, per-peer
+        # frame coalescing with a round-boundary flush, preallocated
+        # in-place mailbox) or "pickle" (the seed path, kept as the A/B
+        # baseline: pickle.dumps + one native send per message + dict
+        # inbox).  RECEIVING is always bilingual — codec.loads routes on
+        # the first byte — so mixed-mode clusters interoperate.
+        if wire not in ("binary", "pickle"):
+            raise ValueError(f"wire must be 'binary' or 'pickle', "
+                             f"got {wire!r}")
+        self.wire = wire
+        self._scratch = codec.Scratch() if wire == "binary" else None
+        self._sendb = (getattr(transport, "send_buffered", None)
+                       if wire == "binary" else None)
+        self._flushfn = (getattr(transport, "flush", None)
+                         if wire == "binary" else None)
+        if self._flushfn is None:
+            # buffering without a flush would queue every hot-path frame
+            # forever: the pair resolves TOGETHER or not at all
+            self._sendb = None
+        self._recv_many = getattr(transport, "recv_many", None)
+        self._mbox = _RoundMailbox(self, legacy=(wire == "pickle"))
         # adaptive round deadline (EWMA + backoff, see AdaptiveTimeout):
         # replaces the fixed timeout_ms for every round that DELEGATES its
         # Progress to the runner (the RuntimeOptions role); rounds that
@@ -822,18 +1032,20 @@ class HostRunner:
         # round -> {sender: payload}; early messages wait here
         self._pending: Dict[int, Dict[int, Any]] = dict(prefill or {})
 
-    def _loads(self, raw: bytes) -> Tuple[bool, Any]:
+    def _loads(self, raw) -> Tuple[bool, Any]:
         """Deserialize a wire payload, tolerating garbage: any failure
         counts the message malformed and the caller drops it
         (InstanceHandler.scala:392-399 semantics, applied unconditionally).
-        Deserialization goes through the RESTRICTED unpickler
-        (transport.wire_loads): numpy/builtin payloads only, so a crafted
-        __reduce__ gadget cannot execute code — an exception guard alone
-        would run the attacker's payload before catching anything."""
+        Codec frames decode zero-copy (runtime/codec.py — array leaves are
+        views into the receive buffer); anything else goes through the
+        RESTRICTED unpickler (transport.wire_loads): numpy/builtin
+        payloads only, so a crafted __reduce__ gadget cannot execute code
+        — an exception guard alone would run the attacker's payload before
+        catching anything."""
         if not raw:
             return True, None
         try:
-            return True, wire_loads(raw)
+            return True, codec.loads(raw)
         except Exception as e:  # noqa: BLE001 — any garbage must be survivable
             self.malformed += 1
             _C_MALFORMED.inc()
@@ -994,33 +1206,50 @@ class HostRunner:
             # world, which IS epoch 0's stamp — fully backwards-compatible)
             cs = self.view.epoch_byte if self.view is not None else 0
             if sending:
-                wire = pickle.dumps(payload_np)
+                # encode ONCE per round into the pooled scratch (binary)
+                # or a pickle bytes (legacy); every destination ships the
+                # same buffer.  Binary sends coalesce into per-peer
+                # FLAG_BATCH frames, flushed at the end of the send loop —
+                # the round boundary of comm-closure makes this safe.
+                if self._scratch is not None:
+                    wire = self._scratch.encode(payload_np)
+                else:
+                    wire = pickle.dumps(payload_np)
+                tag = Tag(instance=self.instance_id, round=r, call_stack=cs)
+                sendb = self._sendb
                 sent = 0
                 for d in range(self.n):
                     if d == self.id or not dest[d]:
                         continue
-                    self.transport.send(
-                        d, Tag(instance=self.instance_id, round=r,
-                               call_stack=cs), wire
-                    )
+                    if sendb is not None:
+                        sendb(d, tag, wire)
+                    else:
+                        self.transport.send(
+                            d, tag, wire if isinstance(wire, bytes)
+                            else bytes(wire))
                     sent += 1
                     if TRACE.enabled:
                         TRACE.emit("send", node=self.id,
                                    inst=self.instance_id, round=r, dst=d,
                                    bytes=len(wire))
                 if sent:
+                    if sendb is not None:  # __init__ guarantees flush too
+                        self._flushfn()
                     _C_SENDS.inc(sent)
             else:
                 self.suppressed_sends += 1
 
             # -- accumulate (InstanceHandler.scala:164-353) ---------------
-            inbox: Dict[int, Any] = dict(self._pending.pop(r, {}))
+            mbox = self._mbox
+            mbox.reset(payload_np)
+            for _sender, _payload in self._pending.pop(r, {}).items():
+                mbox.insert(_sender, _payload)
             if dest[self.id]:
                 # self-delivery is NEVER suppressed: a replica's message to
                 # itself cannot be communication-closed-late, and dropping
                 # it would starve the full-mailbox go-ahead probe on every
                 # suppressed round — the knob suppresses WIRE sends only
-                inbox[self.id] = payload_np
+                mbox.insert(self.id, payload_np)
             prog = self._round_progress(rnd)
             block = prog.is_strict       # strict: no catch-up early-exit
             use_deadline = prog.is_timeout
@@ -1038,11 +1267,11 @@ class HostRunner:
 
             def go_ahead() -> bool:
                 if f_go is not None:
-                    mbox = self._mailbox(inbox, payload_np)
+                    vals, mask = mbox.values_mask()
                     return bool(np.asarray(
-                        f_go(rr, sid, seed, state, mbox.values, mbox.mask)
+                        f_go(rr, sid, seed, state, vals, mask)
                     ))
-                return len(inbox) >= min(self.n, int(expected))
+                return mbox.count >= min(self.n, int(expected))
 
             oob_decided = False
 
@@ -1151,12 +1380,12 @@ class HostRunner:
                 if buffer_only:
                     return False  # post-quorum same-round: same fate as
                     # arriving next round under the default policy (late)
-                inbox[sender] = payload
+                grew = mbox.insert(sender, payload)
                 _C_RECVS.inc()
                 if TRACE.enabled:
                     TRACE.emit("recv", node=self.id, inst=self.instance_id,
                                round=r, src=sender)
-                return True
+                return grew
 
             dirty = True  # inbox changed since the last go probe
             while not prog.is_go_ahead and not oob_decided \
@@ -1203,7 +1432,7 @@ class HostRunner:
                                          if use_deadline
                                          else self.wait_cap_ms),
                             kind="deadline" if use_deadline else "wait_cap",
-                            heard=len(inbox))
+                            heard=mbox.count)
                     if not use_deadline:
                         log.warning(
                             "node %d round %d: %s was idle for "
@@ -1233,12 +1462,20 @@ class HostRunner:
                 # (buffer_only): under the default policy they would have
                 # been read next round and dropped as late, so the knob
                 # stays behavior-neutral for the current round's update.
+                # recv_many pulls EVERY queued frame in one batched native
+                # drain (transport.recv_many); transports without it (bare
+                # test doubles) fall back to the per-frame poll
                 while True:
-                    got = self.transport.recv(0)
-                    if got is None:
+                    if self._recv_many is not None:
+                        got_list = self._recv_many(0)
+                    else:
+                        got = self.transport.recv(0)
+                        got_list = [got] if got is not None else []
+                    if not got_list:
                         break
-                    ingest(got, extend_deadline=False,
-                           buffer_only=not prog.is_go_ahead)
+                    for got in got_list:
+                        ingest(got, extend_deadline=False,
+                               buffer_only=not prog.is_go_ahead)
                     if oob_decided or view_int():
                         break
 
@@ -1274,9 +1511,9 @@ class HostRunner:
             elif oob_decided:
                 exited = True
             else:
-                mbox = self._mailbox(inbox, payload_np)
+                vals, mask = mbox.values_mask()
                 state, exit_flag = f_update(
-                    rr, sid, seed, state, mbox.values, mbox.mask,
+                    rr, sid, seed, state, vals, mask,
                 )
                 exited = bool(np.asarray(exit_flag))
             _C_ROUNDS.inc()
@@ -1286,12 +1523,12 @@ class HostRunner:
                 # ho = the senders heard this round — the HO set of the
                 # model, which is what trace_view merges across replicas
                 TRACE.emit("round_end", node=self.id, inst=self.instance_id,
-                           round=r, heard=len(inbox), n=self.n,
-                           ho=sorted(int(s) for s in inbox),
+                           round=r, heard=mbox.count, n=self.n,
+                           ho=mbox.senders(),
                            timedout=timedout, exited=exited,
                            oob=oob_decided, wall_ms=round(wall_ms, 3))
             log.debug("node %d round %d: heard %d/%d%s%s", self.id, r,
-                      len(inbox), self.n, " TO" if timedout else "",
+                      mbox.count, self.n, " TO" if timedout else "",
                       " exit" if exited else "")
             r += 1
             max_rnd[self.id] = r

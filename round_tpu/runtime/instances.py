@@ -168,6 +168,11 @@ class InstancePool:
         iid = instance_id % MAX_INSTANCE
         if iid in self.decision_log:
             return False
+        if isinstance(value, np.ndarray):
+            # wire decisions decode ZERO-COPY (runtime/codec.py): the array
+            # is a view into a receive-drain buffer, and a decision log is
+            # long-lived — own the 4 bytes instead of pinning the drain
+            value = np.array(value)
         self.decision_log[iid] = InstanceResult(
             instance_id=iid,
             decided=np.ones((self.n,), dtype=bool),

@@ -36,6 +36,15 @@ FLAG_ERROR = 2
 FLAG_DECISION = 4
 FLAG_TOO_LATE = 5
 FLAG_RECOVERY = 6
+# transport-internal frame coalescing (runtime/transport.py): the payload
+# is a sequence of `u64 tag | u32 len | payload` sub-frames accumulated
+# for one destination and flushed as ONE wire frame (the Netty
+# write-coalescing role).  Split back into logical frames by header peek
+# inside HostTransport.recv — this flag never reaches a HostRunner.
+# 0xB7, far from the user-flag range apps allocate from (lock_manager
+# already took 8/9; a collision here would make the transport shred an
+# app's frames as containers).
+FLAG_BATCH = 0xB7
 # view-change catch-up (runtime/view.py): the reply a current-view replica
 # sends to traffic stamped with an OLD epoch — payload is the serialized
 # View (epoch + address list), the receiver adopts it and rewires

@@ -13,6 +13,19 @@ surface (send/deliver) over sockets, so a `PoolNode` — decision replay,
 lazy join, recovery — works across real processes too.  The lockstep
 round-execution path on top of this lives in runtime/host.py.
 
+Hot-path framing (the Netty-tuning parity of the reference: pooled
+buffers, registered-class codec, write coalescing):
+
+  * payloads are encoded by the binary codec (runtime/codec.py), not
+    pickle — `wire_loads` stays as the tagged fallback decoder;
+  * `send_buffered`/`flush` coalesce the frames of one round into ONE
+    FLAG_BATCH container per destination (one native send per peer per
+    flush, regardless of frame count);
+  * `recv` drains the native inbox in ONE ctypes call
+    (rt_node_recv_many), copies the whole drain once, and splits
+    containers into logical frames by header peek — payload slices are
+    memoryviews, never re-copied.
+
 Fault injection does NOT live here: wrap a HostTransport in
 runtime/chaos.py's `FaultyTransport` (same send/recv surface) for
 deterministic seed-driven drop/duplicate/reorder/delay/corruption
@@ -21,16 +34,18 @@ schedules — the host-path analogue of engine/scenarios.py.
 
 from __future__ import annotations
 
+import collections
 import ctypes
 import os
 import pickle
+import struct
 import subprocess
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from round_tpu.obs.metrics import METRICS
 from round_tpu.obs.trace import TRACE
-from round_tpu.runtime.oob import Message, Tag
+from round_tpu.runtime.oob import FLAG_BATCH, Message, Tag
 
 # wire-level instruments (one lock-guarded add per message on a path that
 # is already a syscall): the transport's own view of traffic, below the
@@ -39,6 +54,13 @@ _C_WIRE_SENT = METRICS.counter("wire.sent_msgs")
 _C_WIRE_SENT_B = METRICS.counter("wire.sent_bytes")
 _C_WIRE_RECV = METRICS.counter("wire.recv_msgs")
 _C_WIRE_RECV_B = METRICS.counter("wire.recv_bytes")
+# frame-coalescing instruments (docs/OBSERVABILITY.md): logical frames
+# that traveled inside FLAG_BATCH container frames, and the container
+# payload bytes — wire.sent_msgs/recv_msgs keep counting LOGICAL frames,
+# so batches/frames is the coalescing factor
+_C_BATCHES = METRICS.counter("wire.batches")
+_C_BATCH_FRAMES = METRICS.counter("wire.batch_frames")
+_C_BATCH_BYTES = METRICS.counter("wire.batch_bytes")
 # churn instruments (the view subsystem's wire half, runtime/view.py):
 # reconnects = channels re-established by the auto-reconnect loop,
 # rewires = peer-table swaps applied by a view change
@@ -137,15 +159,23 @@ def _load() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_int, ctypes.c_int
         ]
         lib.rt_node_send.restype = ctypes.c_int
+        # POINTER(c_char), not c_char_p: flush() passes the per-dest batch
+        # bytearray via from_buffer (no bytes copy); plain bytes still
+        # convert implicitly
         lib.rt_node_send.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64,
-            ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_char), ctypes.c_int,
         ]
         lib.rt_node_recv.restype = ctypes.c_int
         lib.rt_node_recv.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int),
             ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p, ctypes.c_int,
             ctypes.c_int,
+        ]
+        lib.rt_node_recv_many.restype = ctypes.c_int
+        lib.rt_node_recv_many.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
         ]
         lib.rt_node_dropped.restype = ctypes.c_uint64
         lib.rt_node_dropped.argtypes = [ctypes.c_void_p]
@@ -204,6 +234,22 @@ class HostTransport:
         self.port = self._lib.rt_node_port(self._node)
         self._buf = ctypes.create_string_buffer(1 << 20)
         self.closed = False  # set once recv observes the stopped node
+        # logical frames already pulled off the native inbox (a batched
+        # drain copies EVERY queued native message out in one ctypes call
+        # and splits FLAG_BATCH containers by header peek; payload slices
+        # are memoryviews into that one immutable copy — zero per-frame
+        # copies).  deque ops are atomic under the GIL; concurrent recv
+        # callers interleave exactly like they did on the native inbox.
+        self._rx: collections.deque = collections.deque()
+        # per-destination coalescing buffers: send_buffered() accumulates
+        # `u64 tag | u32 len | payload` entries, flush() ships each as ONE
+        # FLAG_BATCH wire frame (the Netty write-coalescing role;
+        # comm-closure makes round-boundary flushing safe).  The size cap
+        # bounds a batch (UDP: a datagram must hold it); the LATENCY cap
+        # is structural — HostRunner flushes at every round boundary.
+        self.batch_cap = (48 << 10) if proto == "udp" else (1 << 20)
+        self._out: Dict[int, list] = {}  # dest -> [bytearray, frame count]
+        self._out_lock = threading.Lock()
         # live peer table mirror (pid -> (host, port)): the native layer
         # keeps its own map, but rewire() needs to DIFF old vs new and the
         # reconnect loop needs something to iterate — one lock guards both
@@ -381,39 +427,190 @@ class HostTransport:
             return False  # closed: a racing late send must not deref the
             # freed native node (crash-restart teardown hardening)
         rc = self._lib.rt_node_send(
-            self._node, to, tag.pack() & 0xFFFFFFFFFFFFFFFF, payload,
-            len(payload),
+            self._node, to, tag.pack() & 0xFFFFFFFFFFFFFFFF, bytes(payload)
+            if not isinstance(payload, bytes) else payload, len(payload),
         )
         if rc == 0:
             _C_WIRE_SENT.inc()
             _C_WIRE_SENT_B.inc(len(payload))
         return rc == 0
 
-    def recv(self, timeout_ms: int) -> Optional[Tuple[int, Tag, bytes]]:
+    # -- frame coalescing (the hot-path send of runtime/host.py) -----------
+
+    def send_buffered(self, to: int, tag: Tag, payload=b"") -> bool:
+        """Queue one logical frame for ``to``; it travels inside the next
+        flush()'s FLAG_BATCH container (one native send + one syscall for
+        every frame queued to that destination since the last flush).
+        ``payload`` may be any bytes-like (the hot path hands the SAME
+        scratch memoryview to every destination — encode once, copy once
+        per destination, no intermediate bytes objects).  A buffer that
+        would outgrow ``batch_cap`` is flushed first (UDP: a datagram must
+        carry the whole batch).  Returns False when the node is closed."""
         if not self._node:
-            return None  # closed (see send)
-        from_id = ctypes.c_int()
-        tagw = ctypes.c_uint64()
-        n = self._lib.rt_node_recv(
-            self._node, ctypes.byref(from_id), ctypes.byref(tagw),
-            self._buf, len(self._buf), timeout_ms,
+            return False
+        entry_len = 12 + len(payload)
+        with self._out_lock:
+            ent = self._out.get(to)
+            if ent is None:
+                ent = self._out[to] = [bytearray(), 0]
+            if ent[1] and len(ent[0]) + entry_len > self.batch_cap:
+                self._flush_one(to, ent)
+            ent[0] += _BATCH_HDR.pack(tag.pack() & 0xFFFFFFFFFFFFFFFF,
+                                      len(payload))
+            ent[0] += payload
+            ent[1] += 1
+        return True
+
+    def flush(self, to: Optional[int] = None) -> int:
+        """Ship every buffered frame (or only ``to``'s) as FLAG_BATCH
+        container frames — the round-boundary call of HostRunner.  Returns
+        the number of logical frames flushed."""
+        if not self._node:
+            return 0
+        total = 0
+        with self._out_lock:
+            for dest, ent in (self._out.items() if to is None
+                              else [(to, self._out.get(to))]):
+                if ent is None or not ent[1]:
+                    continue
+                total += ent[1]
+                self._flush_one(dest, ent)
+        return total
+
+    def _flush_one(self, dest: int, ent: list) -> None:
+        """Send one destination's batch (caller holds _out_lock — sends
+        are serialized per destination, preserving frame order).  A
+        single queued frame ships as a PLAIN frame — the container only
+        pays for itself from two frames up (a sequential round queues
+        exactly one frame per peer; the pipelined window and
+        retransmission bursts are what coalesce).  The container tag
+        carries the frame count in its round field (a recv-side sanity
+        cross-check and a free stat)."""
+        buf, count = ent
+        if count == 1:
+            subtag, ln = _BATCH_HDR.unpack_from(buf, 0)
+            rc = self._lib.rt_node_send(
+                self._node, dest, subtag,
+                (ctypes.c_char * ln).from_buffer(buf, 12), ln,
+            )
+            if rc == 0:
+                _C_WIRE_SENT.inc()
+                _C_WIRE_SENT_B.inc(ln)
+        else:
+            tag = Tag(instance=0, round=count, flag=FLAG_BATCH)
+            rc = self._lib.rt_node_send(
+                self._node, dest, tag.pack() & 0xFFFFFFFFFFFFFFFF,
+                (ctypes.c_char * len(buf)).from_buffer(buf), len(buf),
+            )
+            if rc == 0:
+                _C_WIRE_SENT.inc(count)
+                _C_WIRE_SENT_B.inc(len(buf) - 12 * count)
+                _C_BATCHES.inc()
+                _C_BATCH_FRAMES.inc(count)
+                _C_BATCH_BYTES.inc(len(buf))
+        ent[0] = bytearray()
+        ent[1] = 0
+
+    # -- receive -----------------------------------------------------------
+
+    def recv(self, timeout_ms: int) -> Optional[Tuple[int, Tag, bytes]]:
+        """One logical frame: (sender, tag, payload).  Payloads of frames
+        that traveled in a batched drain are memoryviews into the drain's
+        single copy (compare equal to bytes; hand to np.frombuffer for
+        zero-copy decode)."""
+        rx = self._rx
+        while True:
+            try:
+                return rx.popleft()
+            except IndexError:
+                pass
+            if not self._fill(timeout_ms):
+                return None
+            timeout_ms = 0  # only loop again for an all-garbage drain
+
+    def recv_many(self, timeout_ms: int) -> List[Tuple[int, Tag, bytes]]:
+        """Every logical frame currently available, in one batched native
+        drain (plus anything already split): the HostRunner/mux drain
+        primitive.  Waits up to ``timeout_ms`` only when nothing is
+        pending; an empty list means timeout/closed."""
+        rx = self._rx
+        if not rx:
+            self._fill(timeout_ms)
+        elif self._node:
+            self._fill(0)  # opportunistic: append what is already queued
+        out = list(rx)
+        rx.clear()
+        return out
+
+    def _fill(self, timeout_ms: int) -> bool:
+        """One native batched drain into the rx deque: EVERY queued native
+        message copies out in ONE ctypes call, FLAG_BATCH containers are
+        split by header peek (memoryview slices — payload bytes are never
+        re-copied).  False when nothing arrived (timeout/closed)."""
+        if not self._node:
+            return False
+        nb = ctypes.c_int()
+        k = self._lib.rt_node_recv_many(
+            self._node, self._buf, len(self._buf), timeout_ms,
+            ctypes.byref(nb),
         )
-        if n == -1:
-            return None
-        if n == -3:  # node stopped: no more messages will ever arrive
+        if k == 0:
+            return False
+        if k == -3:  # node stopped: no more messages will ever arrive
             self.closed = True
-            return None
-        if n == -2:  # grow and retry (message stays queued, so retry with
+            return False
+        if k == -2:  # grow and retry (message stays queued, so retry with
             # timeout 0: it is returned immediately — a full-timeout retry
             # would let one logical recv block up to 2x the requested
             # deadline and skew HostRunner's round accounting)
             self._buf = ctypes.create_string_buffer(len(self._buf) * 4)
-            return self.recv(0)
-        tag = Tag.unpack(_to_signed64(tagw.value))
-        _C_WIRE_RECV.inc()
-        _C_WIRE_RECV_B.inc(n)
-        # string_at copies exactly n bytes (.raw would copy the whole buffer)
-        return from_id.value, tag, ctypes.string_at(self._buf, n)
+            return self._fill(0)
+        mv = memoryview(ctypes.string_at(self._buf, nb.value))
+        rx = self._rx
+        off = 0
+        frames = payload_b = 0
+        for _ in range(k):
+            from_id, tagw, ln = _RECV_HDR.unpack_from(mv, off)
+            off += 16
+            payload = mv[off:off + ln]
+            off += ln
+            word = _to_signed64(tagw)
+            if (word & 0xFF) == FLAG_BATCH:
+                n_sub = self._split_batch(from_id, payload, rx)
+                frames += n_sub
+                payload_b += len(payload) - 12 * n_sub
+            else:
+                rx.append((from_id, Tag.unpack(word), payload))
+                frames += 1
+                payload_b += ln
+        if frames:
+            _C_WIRE_RECV.inc(frames)
+            _C_WIRE_RECV_B.inc(payload_b)
+        return True
+
+    @staticmethod
+    def _split_batch(from_id: int, mv, rx) -> int:
+        """Split one FLAG_BATCH container into logical frames by header
+        peek (no payload copy — sub-slices of the drain's memoryview).
+        A malformed container (truncated header/length from a byzantine
+        peer; honest senders can't produce one) keeps its parseable
+        prefix and drops the rest — the per-message garbage tolerance of
+        this wire, applied at the framing layer."""
+        off, end = 0, len(mv)
+        n = 0
+        while off + 12 <= end:
+            subtag, ln = _BATCH_HDR.unpack_from(mv, off)
+            off += 12
+            if off + ln > end:
+                METRICS.counter("wire.batch_malformed").inc()
+                return n
+            rx.append((from_id, Tag.unpack(_to_signed64(subtag)),
+                       mv[off:off + ln]))
+            off += ln
+            n += 1
+        if off != end:  # trailing bytes shorter than a sub-frame header
+            METRICS.counter("wire.batch_malformed").inc()
+        return n
 
     @property
     def dropped(self) -> int:
@@ -459,6 +656,14 @@ def _to_signed64(v: int) -> int:
     return v - (1 << 64) if v >= (1 << 63) else v
 
 
+# batched-drain record header (native/transport.cpp rt_node_recv_many:
+# i32 from | u64 tag | u32 len, memcpy'd field-by-field — little-endian
+# standard sizes match the x86-64 layout exactly) and the FLAG_BATCH
+# sub-frame header (u64 tag | u32 len)
+_RECV_HDR = struct.Struct("<iQI")
+_BATCH_HDR = struct.Struct("<QI")
+
+
 _SELF_SIGNED: Optional[Tuple[str, str]] = None
 _self_signed_lock = threading.Lock()
 
@@ -488,10 +693,12 @@ def _self_signed_pair() -> Tuple[str, str]:
 
 class HostBus:
     """LocalBus surface over HostTransport: Message objects (runtime/oob.py)
-    cross process boundaries with their Tag on the wire and the payload
-    pickled (the Kryo role, utils/serialization in the reference — pytree
-    payloads on the hot path never come through here; this is the control
-    plane: decisions, probes, recovery)."""
+    cross process boundaries with their Tag on the wire and the payload in
+    the binary wire codec (runtime/codec.py; the Kryo role,
+    utils/serialization in the reference — pytree payloads on the hot path
+    never come through here; this is the control plane: decisions, probes,
+    recovery).  Delivery decodes codec AND legacy pickle frames
+    (codec.loads auto-detects), so mixed-version peers interoperate."""
 
     def __init__(self, transport: HostTransport):
         self.transport = transport
@@ -503,7 +710,9 @@ class HostBus:
         node.bus = self
 
     def send(self, to: int, msg: Message) -> None:
-        self.transport.send(to, msg.tag, pickle.dumps(msg.payload))
+        from round_tpu.runtime import codec
+
+        self.transport.send(to, msg.tag, codec.encode(msg.payload))
 
     def deliver(self, node_id: Optional[int] = None,
                 limit: Optional[int] = None, timeout_ms: int = 0) -> int:
@@ -511,6 +720,8 @@ class HostBus:
         default_handler (LocalBus.deliver semantics: a handler error does
         not discard the rest of the batch).  `node_id` is accepted for
         LocalBus signature compatibility — a HostBus has exactly one node."""
+        from round_tpu.runtime import codec
+
         count = 0
         first_err: Optional[Exception] = None
         while limit is None or count < limit:
@@ -519,7 +730,7 @@ class HostBus:
                 break
             from_id, tag, raw = got
             try:
-                payload = wire_loads(raw) if raw else None
+                payload = codec.loads(raw) if raw else None
             except Exception:  # noqa: BLE001 — a garbage datagram on the
                 # unauthenticated socket must never kill the control plane
                 # (InstanceHandler.scala:392-399 tolerance); wire_loads also

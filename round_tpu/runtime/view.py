@@ -182,6 +182,10 @@ class ViewManager:
         self.stale = False       # a peer was observed AHEAD of our epoch
         self.history: List[Tuple[int, int, int]] = []  # (epoch, kind, arg)
         self._replied: Dict[int, float] = {}  # FLAG_VIEW rate limiter
+        # encoded current view, cached per epoch: reply_view used to
+        # re-serialize the SAME view for every stale peer it answered
+        # (the per-peer re-encode audit of runtime/host.py)
+        self._wire_cache: Optional[Tuple[int, bytes]] = None
 
     @property
     def epoch(self) -> int:
@@ -303,10 +307,12 @@ class ViewManager:
         if now - self._replied.get(sender, -1.0) <= 0.25:
             return False
         self._replied[sender] = now
+        if self._wire_cache is None or self._wire_cache[0] != self.epoch:
+            self._wire_cache = (self.epoch, pickle.dumps(self.view.wire()))
         self.transport.send(
             sender, Tag(instance=0, flag=FLAG_VIEW,
                         call_stack=self.epoch_byte),
-            pickle.dumps(self.view.wire()),
+            self._wire_cache[1],
         )
         _C_REPLIES.inc()
         if TRACE.enabled:

@@ -148,6 +148,70 @@ def test_faulty_transport_families_on_stub():
     assert tr3.injected["crash_mute"] == 2
 
 
+class _BufferedNullInner(_NullInner):
+    """Stub with the coalescing surface: buffered frames record into
+    per-dest batches so the test can compare delivered bytes."""
+
+    def __init__(self, my_id):
+        super().__init__(my_id)
+        self.buffered = []
+        self.flushes = 0
+
+    def send_buffered(self, to, tag, payload=b""):
+        self.buffered.append((to, tag.round, bytes(payload)))
+        return True
+
+    def flush(self, to=None):
+        self.flushes += 1
+        return len(self.buffered)
+
+
+def test_chaos_schedule_is_framing_invariant():
+    """THE batching-safety pin: one scripted frame sequence pushed
+    through (a) per-message send and (b) send_buffered+flush must
+    produce IDENTICAL fault-event sequences (family, src, dst, round,
+    instance — trace events compared verbatim) and identical surviving
+    frame bytes.  Fault schedules are pure in (seed, src, dst, round),
+    so coalescing frames into FLAG_BATCH containers must change HOW
+    surviving frames travel, never WHICH frames fault."""
+    from round_tpu.obs.trace import TRACE
+
+    plan = FaultPlan(seed=11, drop=0.3, dup=0.25, truncate=0.2,
+                     garbage=0.15)
+    script = [(dst, Tag(instance=inst, round=r),
+               bytes([inst, r, dst]) * 5)
+              for r in range(12) for dst in (1, 2, 3) for inst in (1, 2)]
+
+    def run(batched):
+        inner = _BufferedNullInner(0)
+        tr = FaultyTransport(inner, plan, n=4)
+        TRACE.clear()
+        TRACE.enable(capacity=65536)
+        try:
+            for dst, tag, payload in script:
+                if batched:
+                    tr.send_buffered(dst, tag, payload)
+                else:
+                    tr.send(dst, tag, payload)
+            if batched:
+                tr.flush()
+        finally:
+            TRACE.disable()
+        faults = [(e["family"], e["src"], e["dst"], e["round"], e["inst"])
+                  for e in TRACE.events() if e["ev"] == "fault"]
+        delivered = [(to, r, bytes(p)) for (to, r, p) in
+                     (inner.buffered if batched else inner.sent)]
+        return faults, delivered, dict(tr.injected)
+
+    faults_a, delivered_a, injected_a = run(batched=False)
+    faults_b, delivered_b, injected_b = run(batched=True)
+    assert faults_a == faults_b
+    assert injected_a == injected_b
+    assert delivered_a == delivered_b  # incl. dup copies + corrupted bytes
+    assert any(f[0] == "drop" for f in faults_a)      # schedule non-trivial
+    assert any(f[0] == "dup" for f in faults_a)
+
+
 def test_faulty_transport_on_real_wire_garbage_survivable():
     """garbage=1.0 over the real transport: every data payload is junk
     bytes; the tags still frame and the receiver sees the corruption —
@@ -427,7 +491,9 @@ def test_serve_decisions_lingers_until_idle():
         assert got is not None
         sender, tag, raw = got
         assert (sender, tag.instance, tag.flag) == (0, 1, FLAG_DECISION)
-        assert int(np.asarray(pickle.loads(raw))) == 7
+        from round_tpu.runtime import codec
+
+        assert int(np.asarray(codec.loads(raw))) == 7
         # undecided instances draw no reply
         assert laggard.send(0, Tag(instance=2, round=0), b"x")
         assert laggard.recv(400) is None

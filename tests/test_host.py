@@ -1078,13 +1078,21 @@ def test_host_catch_up_send_policy_knobs():
         def node(my_id):
             tr = HostTransport(my_id, peers[my_id][1])
             real_send = tr.send
+            real_send_buffered = tr.send_buffered
 
             def counting_send(dest, tag, payload):
                 if tag.flag == FLAG_NORMAL:
                     wire_sends[my_id] += 1
                 return real_send(dest, tag, payload)
 
+            def counting_send_buffered(dest, tag, payload):
+                # the coalescing surface carries the hot-path sends now
+                if tag.flag == FLAG_NORMAL:
+                    wire_sends[my_id] += 1
+                return real_send_buffered(dest, tag, payload)
+
             tr.send = counting_send
+            tr.send_buffered = counting_send_buffered
             try:
                 runner = HostRunner(
                     algo, my_id, peers, tr, timeout_ms=150,
@@ -1255,17 +1263,30 @@ def test_host_pipelined_instances_under_loss():
 
     def lossy(tr, my_id):
         real_send = tr.send
+        real_send_buffered = tr.send_buffered
+
+        def dropped(dest, tag):
+            if tag.flag != FLAG_NORMAL:
+                return False
+            # deterministic ~19% loss, round/instance/dest-dependent
+            h = (tag.instance * 7919 + tag.round * 104729
+                 + dest * 31 + my_id * 17) % 16
+            return h < 3
 
         def send(dest, tag, payload):
-            if tag.flag == FLAG_NORMAL:
-                # deterministic ~19% loss, round/instance/dest-dependent
-                h = (tag.instance * 7919 + tag.round * 104729
-                     + dest * 31 + my_id * 17) % 16
-                if h < 3:
-                    return True  # silently dropped
+            if dropped(dest, tag):
+                return True  # silently dropped
             return real_send(dest, tag, payload)
 
+        def send_buffered(dest, tag, payload):
+            # the coalescing surface must see the SAME per-frame loss
+            # (the FaultyTransport framing-invariance contract)
+            if dropped(dest, tag):
+                return True
+            return real_send_buffered(dest, tag, payload)
+
         tr.send = send
+        tr.send_buffered = send_buffered
         return tr
 
     def cluster(rate):
@@ -1349,9 +1370,11 @@ def test_instance_mux_routing_and_stash():
             reply = a.recv(2000)
             assert reply is not None
             assert reply[1].flag == FLAG_DECISION and reply[1].instance == 5
-            from round_tpu.runtime.transport import wire_loads
+            # decision replies are codec-encoded now; codec.loads is the
+            # bilingual wire decoder (codec frames + legacy pickle)
+            from round_tpu.runtime import codec
 
-            assert int(wire_loads(reply[2])) == 42
+            assert int(np.asarray(codec.loads(reply[2]))) == 42
             # stale-order purge: stash K packets for instance 9, register
             # it (entries purged), then verify a later small stash for
             # instance 10 still replays (nothing was evicted)

@@ -525,6 +525,44 @@ def check_lint(rng, it):
     return cfg
 
 
+def check_host_perf(rng, it):
+    """The host-perf rotation rung: the interleaved wire A/B
+    (apps/host_perftest.measure_wire_ab — old pickle path vs the binary
+    codec + coalescing + batched-receive path, apps/perf_ab.py pair
+    discipline) banked into SOAK.jsonl.  Gate: new/old >= 1.0 — the
+    rebuilt wire must never REGRESS decisions/sec; the trajectory of
+    dps_binary across soak records is the drift monitor.  ~20-30 s
+    (thread mode, in-process; the jit compile is shared warmup)."""
+    from round_tpu.apps.host_perftest import measure_wire_ab
+
+    res = measure_wire_ab(n=4, instances=20, timeout_ms=300, pairs=3,
+                          warmup=1)
+    med_ratio = (res["extra"]["median_binary"]
+                 / max(res["extra"]["median_pickle"], 1e-9))
+    cfg = dict(kind="host-perf", it=it, ratio=res["value"],
+               median_ratio=round(med_ratio, 3),
+               dps_pickle=res["extra"]["dps_pickle"],
+               dps_binary=res["extra"]["dps_binary"],
+               samples_pickle=res["extra"]["samples_pickle"],
+               samples_binary=res["extra"]["samples_binary"],
+               instances=res["extra"]["instances"],
+               wire_counters={
+                   k: v for k, v in
+                   METRICS.snapshot(compact=True)["counters"].items()
+                   if k.startswith("wire.")})
+    # gate with a noise margin: the measured run-to-run spread of this
+    # harness is +/-30-40% per arm (PERF_MODEL.md host-wire roofline), so
+    # a hard >= 1.0 cut at pairs=3 would cry wolf on scheduler noise.
+    # A REAL regression (the binary path losing decisively) trips both
+    # the mean and the median; the banked ratio trajectory across soak
+    # records is the fine-grained drift monitor.
+    if res["value"] < 0.85 and med_ratio < 0.85:
+        return {**cfg, "fail": f"wire A/B regression: binary/pickle mean "
+                               f"{res['value']} and median "
+                               f"{round(med_ratio, 3)} both < 0.85"}
+    return cfg
+
+
 def check_host_chaos(rng, it):
     """The host-chaos rotation rung: a real 3-process cluster under a
     seeded wire-fault schedule (runtime/chaos.py FaultyTransport: the
@@ -570,7 +608,18 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=60.0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--compile-cache", type=str, default=None,
+                    metavar="DIR",
+                    help="enable the JAX persistent compilation cache in "
+                         "DIR (bench.enable_compile_cache): the rotation "
+                         "re-compiles the same fixed-shape rungs every "
+                         "run — with the cache, repeat soaks hit disk "
+                         "instead of XLA")
     args = ap.parse_args()
+    if args.compile_cache:
+        from bench import enable_compile_cache
+
+        enable_compile_cache(args.compile_cache)
 
     rng = np.random.default_rng(args.seed)
     t_end = time.monotonic() + args.minutes * 60
@@ -579,7 +628,8 @@ def main():
     rotation = [check_otr_family, check_otr_family, check_epsilon,
                 check_lattice, check_tpc_kset, check_erb,
                 lambda r, i: check_otr_family(r, i, scale=True),
-                check_otr_flagship_shape, check_host_chaos, check_lint]
+                check_otr_flagship_shape, check_host_chaos, check_lint,
+                check_host_perf]
     while time.monotonic() < t_end:
         check = rotation[it % len(rotation)]
         t0 = time.perf_counter()
