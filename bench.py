@@ -79,18 +79,61 @@ _WORKER_T0 = time.monotonic()  # re-stamped at worker_main entry
 # Backend probe source, run via `python -c` in a killable subprocess.  It
 # must exercise an actual device computation (not just jax.devices()): the
 # r02 failure surfaced only at the first array op.
+#
+# The probe narrates its progress with PROBE_STAGE markers on unbuffered
+# stderr: when it HANGS (killed by the driver's timeout), the partial
+# stderr names the LAST stage reached — which is the diagnosis the BENCH
+# artifact has been missing since the r03 `{'probe': 'hang'}` records
+# (a bare "hang" cannot distinguish a wedged TPU tunnel during backend
+# init from a hung device op or a stuck import).
 _PROBE_SRC = """
 import json, sys
+def _stage(s):
+    sys.stderr.write("PROBE_STAGE " + s + chr(10)); sys.stderr.flush()
+_stage("start")
 import jax
+_stage("import-jax")
 platform = {platform!r}
 if platform:
     jax.config.update("jax_platforms", platform)
 import jax.numpy as jnp
+_stage("backend-init")
+ds = jax.devices()
+_stage("devices:" + ds[0].platform + "x" + str(len(ds)))
 x = int(jax.device_get(jnp.arange(8).sum()))
 assert x == 28, x
-ds = jax.devices()
+_stage("device-op")
 print(json.dumps({{"platform": ds[0].platform, "n_devices": len(ds)}}))
 """
+
+
+def _probe_env_diag():
+    """Environment facts that explain most probe hangs/raises, recorded
+    into the BENCH artifact so a `backend-unavailable` line is actionable
+    without shell access to the (possibly long-gone) box."""
+    import importlib.util
+
+    keys = ("JAX_PLATFORMS", "TPU_NAME", "TPU_SKIP_MDS_QUERY",
+            "TPU_LIBRARY_PATH", "PJRT_DEVICE", "CLOUD_TPU_TASK_ID")
+    return {
+        "env": {k: os.environ[k] for k in keys if k in os.environ},
+        "libtpu": importlib.util.find_spec("libtpu") is not None,
+    }
+
+
+def probe_src(platform: str = "") -> str:
+    """The staged probe source (shared: tools/tpu_watch.py runs the same
+    probe, so the PROBE_STAGE marker format has exactly one owner)."""
+    return _PROBE_SRC.format(platform=platform)
+
+
+def last_probe_stage(stderr_text) -> str:
+    """The last PROBE_STAGE marker in (possibly partial) probe stderr."""
+    stage = "none"
+    for ln in (stderr_text or "").splitlines():
+        if ln.startswith("PROBE_STAGE "):
+            stage = ln[len("PROBE_STAGE "):].strip()
+    return stage
 
 
 def build_parser():
@@ -255,20 +298,36 @@ def _emit_error(args, error, extra):
 
 
 def _run_probe(args):
-    """Backend-init probe in a killable subprocess.  Returns (ok, info)."""
-    src = _PROBE_SRC.format(platform=args.platform or "")
+    """Backend-init probe in a killable subprocess.  Returns (ok, info).
+
+    A hang is DIAGNOSED, not just declared: subprocess.run kills the
+    child on timeout and hands back whatever it already wrote, so the
+    last PROBE_STAGE marker names where it wedged (r03-r05 recorded bare
+    `{'probe': 'hang'}` lines; every one of those was this path with the
+    stage discarded) and the env diagnosis rides along."""
+    src = probe_src(args.platform or "")
     try:
         cp = subprocess.run(
             [sys.executable, "-c", src],
             capture_output=True, text=True, timeout=args.probe_timeout,
         )
-    except subprocess.TimeoutExpired:
-        return False, {"probe": "hang", "probe_timeout_s": args.probe_timeout}
+    except subprocess.TimeoutExpired as e:
+        err = e.stderr
+        if isinstance(err, bytes):  # TimeoutExpired ignores text=True
+            err = err.decode("utf-8", "replace")
+        return False, {
+            "probe": "hang",
+            "probe_timeout_s": args.probe_timeout,
+            "probe_stage": last_probe_stage(err),
+            **_probe_env_diag(),
+        }
     if cp.returncode != 0:
         return False, {
             "probe": "raise",
             "probe_rc": cp.returncode,
+            "probe_stage": last_probe_stage(cp.stderr),
             "probe_stderr_tail": cp.stderr[-800:],
+            **_probe_env_diag(),
         }
     try:
         info = json.loads(cp.stdout.strip().splitlines()[-1])

@@ -40,7 +40,7 @@ from round_tpu.runtime.transport import HostTransport  # noqa: E402
 
 def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
              errors=None, proto="tcp", stats=None, algo=None, rate=1,
-             adaptive_cap_ms=0, wire="binary"):
+             adaptive_cap_ms=0, wire="binary", lanes=0):
     tr = HostTransport(my_id, peers[my_id][1], proto=proto)
     # ONE algorithm object across instances: the jitted round functions
     # cache on its rounds, so instance 2+ skip compilation entirely.
@@ -58,7 +58,18 @@ def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
                                     seed=seed * 31 + my_id)
                     if adaptive_cap_ms > 0 else None)
         node_stats: dict = {}
-        if rate > 1:
+        if lanes > 1:
+            # the lane-batched driver (runtime/lanes.py): `lanes`
+            # concurrent instances advanced by one vmapped mega-step per
+            # round class instead of one Python round loop per instance
+            from round_tpu.runtime.lanes import run_instance_loop_lanes
+
+            results[my_id] = run_instance_loop_lanes(
+                algo, my_id, peers, tr, instances, lanes=lanes,
+                timeout_ms=timeout_ms, seed=seed, stats_out=node_stats,
+                adaptive=adaptive, wire=wire,
+            )
+        elif rate > 1:
             # the in-flight window (PerfTest2 -rt): `rate` concurrent
             # instances over one InstanceMux
             results[my_id] = run_instance_loop_pipelined(
@@ -121,8 +132,13 @@ def _score(logs, instances, wall, n, algo, timeout_ms, mode,
     }
 
 
+def _algo_opts(payload_bytes):
+    return {"payload_bytes": payload_bytes} if payload_bytes > 0 else {}
+
+
 def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
-            proto="tcp", rate=1, adaptive_cap_ms=0, wire="binary"):
+            proto="tcp", rate=1, adaptive_cap_ms=0, wire="binary",
+            lanes=0, payload_bytes=0):
     """Run `instances` consecutive consensus instances over `n` replicas
     (threads, each with its own transport+sockets — on a single-vCPU box
     the GIL interleaving beats process-per-replica; see measure_processes
@@ -143,13 +159,13 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
     results: dict = {}
     errors: dict = {}
     stats: dict = {}
-    shared_algo = select(algo)
+    shared_algo = select(algo, _algo_opts(payload_bytes))
     threads = [
         threading.Thread(
             target=run_node,
             args=(i, peers, algo, instances, timeout_ms, results, seed,
                   errors, proto, stats, shared_algo, rate, adaptive_cap_ms,
-                  wire),
+                  wire, lanes),
         )
         for i in range(n)
     ]
@@ -175,11 +191,16 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
             f"replica(s) died: {sorted(set(range(n)) - set(results))}; "
             f"errors: {errors}"
         )
-    mode = ("thread-per-replica"
-            if rate <= 1 else f"thread-per-replica rate={rate}")
+    mode = "thread-per-replica"
+    if lanes > 1:
+        mode += f" lanes={lanes}"
+    elif rate > 1:
+        mode += f" rate={rate}"
     if adaptive_cap_ms > 0:
         mode += f" adaptive(cap={adaptive_cap_ms}ms)"
     mode += f" wire={wire}"
+    if payload_bytes > 0:
+        mode += f" payload={payload_bytes}B"
     score = _score(results, instances, wall, n, algo, timeout_ms,
                    mode, proto=proto)
     # per-node diagnostics: timeouts is the throughput killer (each one
@@ -190,7 +211,8 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
 
 def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,
                       proto="tcp", adaptive_cap_ms=0, trace=None,
-                      metrics_json=None, wire="binary"):
+                      metrics_json=None, wire="binary", lanes=0, rate=1,
+                      payload_bytes=0):
     """One OS PROCESS per replica (the reference's exact shape: 4 JVMs on
     localhost) via the host_replica CLI's --instances loop: no shared GIL,
     true parallel replicas.  Returns the same result dict as measure().
@@ -221,6 +243,12 @@ def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,
     if adaptive_cap_ms > 0:
         base_argv += ["--adaptive-timeout",
                       "--timeout-cap-ms", str(adaptive_cap_ms)]
+    if lanes > 1:
+        base_argv += ["--lanes", str(lanes)]
+    elif rate > 1:
+        base_argv += ["--rate", str(rate)]
+    if payload_bytes > 0:
+        base_argv += ["--payload-bytes", str(payload_bytes)]
 
     def extra_argv(i):
         a = []
@@ -270,9 +298,15 @@ def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,
     )
     logs = {i: outs[i]["decisions"] for i in outs}
     mode = "process-per-replica"
+    if lanes > 1:
+        mode += f" lanes={lanes}"
+    elif rate > 1:
+        mode += f" rate={rate}"
     if adaptive_cap_ms > 0:
         mode += f" adaptive(cap={adaptive_cap_ms}ms)"
     mode += f" wire={wire}"
+    if payload_bytes > 0:
+        mode += f" payload={payload_bytes}B"
     result = _score(logs, instances, wall, n, algo, timeout_ms,
                     mode, wall_basis="slowest-replica-loop",
                     proto=proto)
@@ -291,7 +325,7 @@ def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,
 
 def measure_wire_ab(n=4, instances=20, algo="otr", timeout_ms=300,
                     proto="tcp", rate=1, pairs=9, warmup=1,
-                    processes=False):
+                    processes=False, payload_bytes=0):
     """The wire old-vs-new interleaved A/B (apps/perf_ab.py): arm A is
     the seed path (``wire="pickle"``: pickle payloads, one native send
     per message, dict-inbox mailbox), arm B the rebuilt hot path
@@ -307,11 +341,13 @@ def measure_wire_ab(n=4, instances=20, algo="otr", timeout_ms=300,
             if processes:
                 res, _ = measure_processes(
                     n=n, instances=instances, algo=algo,
-                    timeout_ms=timeout_ms, proto=proto, wire=wire)
+                    timeout_ms=timeout_ms, proto=proto, wire=wire,
+                    payload_bytes=payload_bytes)
             else:
                 res, _ = measure(n=n, instances=instances, algo=algo,
                                  timeout_ms=timeout_ms, proto=proto,
-                                 rate=rate, wire=wire)
+                                 rate=rate, wire=wire,
+                                 payload_bytes=payload_bytes)
             return res["value"]
         return run
 
@@ -336,6 +372,67 @@ def measure_wire_ab(n=4, instances=20, algo="otr", timeout_ms=300,
             "mode": ("process-per-replica" if processes
                      else "thread-per-replica"
                      + (f" rate={rate}" if rate > 1 else "")),
+            "payload_bytes": payload_bytes,
+        },
+    }
+
+
+def measure_lanes_ab(n=4, instances=64, algo="otr", timeout_ms=300,
+                     proto="tcp", lanes=64, rate=1, pairs=3, warmup=1,
+                     processes=False, payload_bytes=0, seed=0):
+    """The driver A/B (ROADMAP item 1 acceptance): arm A is the
+    per-instance driver (the sequential loop, or the pipelined
+    InstanceMux window when ``rate`` > 1), arm B the lane-batched driver
+    (runtime/lanes.py) with ``lanes`` instances multiplexed onto the
+    mega-step's lane axis.  Same ports discipline, same schedules/seeds,
+    interleaved pairs (apps/perf_ab.py) so drift hits both arms; the
+    warmup absorbs the jit compiles so the pairs measure the DRIVER.
+    The ``host-lanes`` soak rung banks this (ratio >= margin gate)."""
+    from round_tpu.apps.perf_ab import interleaved_ab
+
+    if lanes < 2:
+        # lanes<=1 selects the per-instance driver in run_node: arm B
+        # would silently re-measure arm A
+        raise ValueError(f"lanes must be >= 2 for the driver A/B, "
+                         f"got {lanes}")
+
+    def arm(use_lanes):
+        def run():
+            kw = dict(n=n, instances=instances, algo=algo,
+                      timeout_ms=timeout_ms, proto=proto,
+                      payload_bytes=payload_bytes,
+                      lanes=lanes if use_lanes else 0)
+            if processes:
+                res, _ = measure_processes(
+                    rate=1 if use_lanes else rate, **kw)
+            else:
+                res, _ = measure(seed=seed,
+                                 rate=1 if use_lanes else rate, **kw)
+            return res["value"]
+        return run
+
+    ab = interleaved_ab(arm(False), arm(True), pairs=pairs, warmup=warmup)
+    return {
+        "metric": f"host_{algo}_n{n}_lanes_ab_speedup",
+        "value": ab["ratio"],
+        "unit": "x (lane-batched/per-instance decisions-per-sec)",
+        "extra": {
+            "dps_per_instance": ab["mean_a"],
+            "dps_lanes": ab["mean_b"],
+            "median_per_instance": ab["median_a"],
+            "median_lanes": ab["median_b"],
+            "samples_per_instance": ab["a"],
+            "samples_lanes": ab["b"],
+            "pairs": pairs,
+            "warmup": warmup,
+            "instances": instances,
+            "lanes": lanes,
+            "rate": rate,
+            "n": n,
+            "timeout_ms": timeout_ms,
+            "payload_bytes": payload_bytes,
+            "mode": ("process-per-replica" if processes
+                     else "thread-per-replica"),
         },
     }
 
@@ -353,9 +450,21 @@ def main(argv=None) -> int:
                     help="native transport: tcp (framed/reconnecting) or "
                          "udp (the reference's default perf transport)")
     ap.add_argument("-rt", "--rate", type=int, default=1,
-                    help="instances in flight per replica (PerfTest2 -rt; "
-                         "thread mode only): >1 pipelines burned round "
-                         "deadlines on lossy networks")
+                    help="instances in flight per replica (PerfTest2 -rt): "
+                         ">1 pipelines burned round deadlines on lossy "
+                         "networks (per-instance driver; one thread per "
+                         "in-flight instance)")
+    ap.add_argument("--lanes", type=int, default=0, metavar="L",
+                    help="lane-batched driver (runtime/lanes.py): L "
+                         "concurrent instances multiplexed onto the "
+                         "engine's lane axis, ONE vmapped mega-step per "
+                         "round class instead of one Python round loop "
+                         "per instance; 0/1 = per-instance driver")
+    ap.add_argument("--payload-bytes", type=int, default=0, metavar="B",
+                    help="with --algo lvb: consensus over opaque uint8[B] "
+                         "payloads (the KB-scale wire-fraction workload "
+                         "of PERF_MODEL.md; default 1024 when --algo lvb "
+                         "is given without this flag)")
     ap.add_argument("--adaptive-timeout", action="store_true",
                     help="EWMA + backoff round deadlines instead of the "
                          "fixed --timeout-ms (runtime/host.py "
@@ -382,27 +491,50 @@ def main(argv=None) -> int:
                     help="run the interleaved wire A/B (pickle vs binary, "
                          "apps/perf_ab.py) and report the speedup instead "
                          "of a single measurement")
+    ap.add_argument("--ab-lanes", action="store_true",
+                    help="run the interleaved DRIVER A/B (per-instance vs "
+                         "lane-batched with --lanes, apps/perf_ab.py) and "
+                         "report the speedup instead of a single "
+                         "measurement")
     ap.add_argument("--ab-pairs", type=int, default=9,
-                    help="interleaved pairs for --ab-wire")
+                    help="interleaved pairs for --ab-wire/--ab-lanes")
     args = ap.parse_args(argv)
     cap = args.timeout_cap_ms if args.adaptive_timeout else 0
+    if args.algo in ("lvb", "lastvoting-bytes", "lastvotingbytes") \
+            and args.payload_bytes <= 0:
+        args.payload_bytes = 1024
+    if args.ab_lanes:
+        if args.lanes == 1:
+            # lanes<=1 routes run_node to the per-instance driver, which
+            # would silently measure per-instance vs per-instance
+            ap.error("--ab-lanes needs --lanes >= 2 (1 IS the "
+                     "per-instance driver)")
+        result = measure_lanes_ab(
+            n=args.n, instances=args.instances, algo=args.algo,
+            timeout_ms=args.timeout_ms, proto=args.proto,
+            lanes=args.lanes if args.lanes > 1 else 64, rate=args.rate,
+            pairs=args.ab_pairs, processes=args.processes,
+            payload_bytes=args.payload_bytes,
+        )
+        print(json.dumps(result))
+        return 0
     if args.ab_wire:
         result = measure_wire_ab(
             n=args.n, instances=args.instances, algo=args.algo,
             timeout_ms=args.timeout_ms, proto=args.proto, rate=args.rate,
             pairs=args.ab_pairs, processes=args.processes,
+            payload_bytes=args.payload_bytes,
         )
         print(json.dumps(result))
         return 0
     if args.processes:
-        if args.rate > 1:
-            print("warning: --rate applies to thread mode only",
-                  file=sys.stderr)
         result, _logs = measure_processes(
             n=args.n, instances=args.instances, algo=args.algo,
             timeout_ms=args.timeout_ms, proto=args.proto,
             adaptive_cap_ms=cap, trace=args.trace,
             metrics_json=args.metrics_json, wire=args.wire,
+            lanes=args.lanes, rate=args.rate,
+            payload_bytes=args.payload_bytes,
         )
     else:
         if args.trace:
@@ -414,7 +546,8 @@ def main(argv=None) -> int:
         result, _logs = measure(
             n=args.n, instances=args.instances, algo=args.algo,
             timeout_ms=args.timeout_ms, proto=args.proto, rate=args.rate,
-            adaptive_cap_ms=cap, wire=args.wire,
+            adaptive_cap_ms=cap, wire=args.wire, lanes=args.lanes,
+            payload_bytes=args.payload_bytes,
         )
         if args.trace:
             TRACE.dump_jsonl(args.trace)

@@ -77,6 +77,18 @@ def main(argv=None) -> int:
                     help="instances in flight (PerfTest2 -rt; applies "
                          "with --instances > 1): >1 pipelines burned "
                          "round deadlines over the InstanceMux")
+    ap.add_argument("--lanes", type=int, default=0, metavar="L",
+                    help="lane-batched driver (runtime/lanes.py; applies "
+                         "with --instances > 1): L concurrent instances "
+                         "multiplexed onto the engine's lane axis — one "
+                         "vmapped mega-step per round class advances all "
+                         "of them, the Python loop only feeds mailboxes "
+                         "in and decisions out.  0/1 = the per-instance "
+                         "driver")
+    ap.add_argument("--payload-bytes", type=int, default=0, metavar="B",
+                    help="with --algo lvb: consensus over opaque uint8[B] "
+                         "payloads (the KB-scale wire-fraction workload; "
+                         "defaults to 1024 for --algo lvb)")
     ap.add_argument("--value-schedule", choices=["mixed", "uniform"],
                     default="mixed",
                     help="per-instance proposal schedule: 'mixed' "
@@ -219,10 +231,10 @@ def main(argv=None) -> int:
         if args.metrics_json:
             atexit.register(lambda: METRICS.dump_json(args.metrics_json))
 
-    import numpy as np
-
     from round_tpu.apps.selector import select
-    from round_tpu.runtime.host import AdaptiveTimeout, HostRunner
+    from round_tpu.runtime.host import (
+        AdaptiveTimeout, HostRunner, decision_scalar, instance_io,
+    )
     from round_tpu.runtime.transport import HostTransport
 
     peers = {}
@@ -234,7 +246,12 @@ def main(argv=None) -> int:
         peers = {i: (h, p) for i, (h, p) in enumerate(conf_peers)}
     else:
         ap.error("provide --peers or a --conf file with <replica> entries")
-    algo = select(args.algo)
+    if args.algo in ("lvb", "lastvoting-bytes", "lastvotingbytes") \
+            and args.payload_bytes <= 0:
+        args.payload_bytes = 1024
+    algo = select(args.algo,
+                  {"payload_bytes": args.payload_bytes}
+                  if args.payload_bytes > 0 else {})
 
     adaptive = None
     if args.adaptive_timeout:
@@ -328,15 +345,17 @@ def main(argv=None) -> int:
                 adaptive=adaptive, wire=args.wire,
             )
             res = runner.run(
-                {"initial_value": np.int32(args.value)},
+                instance_io(algo, args.value),
                 max_rounds=args.max_rounds,
             )
-            d = int(np.asarray(res.decision)) if res.decided else None
+            d = decision_scalar(res.decision) if res.decided else None
             dump_decision_log([d])
             if args.linger_ms > 0:
                 from round_tpu.runtime.host import serve_decisions
 
-                serve_decisions(tr, [d], idle_ms=args.linger_ms)
+                serve_decisions(
+                    tr, [d], idle_ms=args.linger_ms,
+                    adoptable=getattr(algo, "payload_bytes", None) is None)
             print(json.dumps({
                 "id": args.id,
                 "decided": res.decided,
@@ -369,7 +388,29 @@ def main(argv=None) -> int:
                   "(instances are numbered 1..N)", file=sys.stderr)
         t0 = time.perf_counter()
         stats: dict = {}
-        if args.rate > 1:
+        if args.lanes > 1:
+            from round_tpu.runtime.lanes import run_instance_loop_lanes
+
+            if manager is not None:
+                print("warning: --view-change/--view-epoch apply to the "
+                      "sequential loop only (ignored with --lanes)",
+                      file=sys.stderr)
+            if (not args.send_when_catching_up
+                    or args.delay_first_send_ms > 0):
+                print("warning: --no-send-when-catching-up / "
+                      "--delay-first-send apply to the sequential loop "
+                      "only (ignored with --lanes)", file=sys.stderr)
+            decisions = run_instance_loop_lanes(
+                algo, args.id, peers, tr, args.instances,
+                lanes=args.lanes, timeout_ms=args.timeout_ms,
+                seed=args.seed, base_value=args.value,
+                max_rounds=args.max_rounds,
+                nbr_byzantine=args.nbr_byzantine,
+                value_schedule=args.value_schedule,
+                adaptive=adaptive, stats_out=stats,
+                checkpoint_dir=args.checkpoint_dir, wire=args.wire,
+            )
+        elif args.rate > 1:
             if (not args.send_when_catching_up
                     or args.delay_first_send_ms > 0):
                 print("warning: --no-send-when-catching-up / "
@@ -407,7 +448,9 @@ def main(argv=None) -> int:
                                        and manager.removed):
             from round_tpu.runtime.host import serve_decisions
 
-            serve_decisions(tr, decisions, idle_ms=args.linger_ms)
+            serve_decisions(
+                tr, decisions, idle_ms=args.linger_ms,
+                adoptable=getattr(algo, "payload_bytes", None) is None)
         ok = sum(1 for d in decisions if d is not None)
         summary = {
             "id": args.id,

@@ -14,9 +14,10 @@ socket buildup), and report per-arm samples + means + the ratio.
                          pairs=9)
     res["ratio"]   # mean_b / mean_a
 
-Used by apps/host_perftest.py --ab-wire and the tools/soak.py host-perf
-rung; bench.py's dtype A/B keeps its own artifact plumbing but follows
-the same pair discipline.
+Used by apps/host_perftest.py --ab-wire (pickle vs binary wire),
+--ab-lanes (per-instance vs lane-batched driver, runtime/lanes.py) and
+the tools/soak.py host-perf / host-lanes rungs; bench.py's dtype A/B
+keeps its own artifact plumbing but follows the same pair discipline.
 """
 
 from __future__ import annotations

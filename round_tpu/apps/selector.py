@@ -12,7 +12,8 @@ from round_tpu.core.algorithm import Algorithm
 
 
 def select(name: str, options: Optional[Dict[str, Any]] = None) -> Algorithm:
-    """otr / lv / slv / mlv / benor / floodmin / kset / tpc → Algorithm."""
+    """otr / lv / lvb / slv / mlv / benor / floodmin / kset / tpc →
+    Algorithm."""
     options = options or {}
     name = name.lower()
     if name == "otr":
@@ -23,6 +24,14 @@ def select(name: str, options: Optional[Dict[str, Any]] = None) -> Algorithm:
         from round_tpu.models.lastvoting import LastVoting
 
         return LastVoting()
+    if name in ("lvb", "lastvoting-bytes", "lastvotingbytes"):
+        # the KB-scale-payload workload (LastVotingB role): consensus on
+        # opaque uint8[payload_bytes] vectors — the wire-fraction regime
+        # of PERF_MODEL.md, exercisable from every host harness
+        from round_tpu.models.lastvoting import LastVotingBytes
+
+        return LastVotingBytes(
+            payload_bytes=options.get("payload_bytes", 1024))
     if name in ("lve", "lastvotingevent"):
         from round_tpu.models.lastvoting_event import LastVotingEvent
 
@@ -53,5 +62,5 @@ def select(name: str, options: Optional[Dict[str, Any]] = None) -> Algorithm:
         return TwoPhaseCommit()
     raise ValueError(
         f"unknown algorithm {name!r} "
-        "(expected otr|lv|lve|slv|mlv|benor|floodmin|kset|tpc)"
+        "(expected otr|lv|lvb|lve|slv|mlv|benor|floodmin|kset|tpc)"
     )

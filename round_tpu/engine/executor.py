@@ -27,14 +27,16 @@ algorithm randomness (BenOr's coin).
 from __future__ import annotations
 
 import dataclasses
+import threading
 from functools import partial
 from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from round_tpu.core.algorithm import Algorithm
-from round_tpu.core.rounds import RoundCtx
+from round_tpu.core.rounds import FoldRound, RoundCtx
 from round_tpu.ops.mailbox import Mailbox
 from round_tpu.utils.tree import tree_where
 
@@ -290,3 +292,173 @@ def simulate(
     if jit:
         fn = jax.jit(fn)
     return fn(io, keys)
+
+
+# ---------------------------------------------------------------------------
+# Host-side lane batching: many live instances as ONE vmapped lane axis
+# ---------------------------------------------------------------------------
+#
+# The engine above batches *scenarios* of one instance; the lane entry point
+# below batches *live instances* of one deployed replica — the serving-tier
+# inversion (ROADMAP item 1): instead of every instance running its own
+# Python round loop with per-round jitted dispatches, the runtime packs the
+# InstanceMux's concurrent instances onto this lane axis and advances all of
+# them with one jitted mega-step per round class (runtime/lanes.py drives
+# it).  The functions live HERE, next to run_round, because they are the
+# same send → exchange → update semantics with the wire outside instead of
+# inside: comm-closed rounds are what make "one round of L instances" a
+# single batch operation.
+
+# serializes mega-step trace+compile: thread-mode replicas share Round
+# objects and reach a round class within milliseconds of each other (same
+# discipline as runtime/host.py's _JIT_BUILD_LOCK)
+_LANE_BUILD_LOCK = threading.Lock()
+
+
+def make_host_round_fns(rnd, n: int):
+    """The per-lane (send, update, go) pure functions of one Round at group
+    size ``n`` — the SINGLE source of truth for both the per-instance
+    HostRunner jit trio (runtime/host.py) and the lane-batched mega-step
+    (LaneStep below).  The lane-equivalence contract (byte-identical
+    decisions from both drivers, tests/test_lanes.py) depends on the two
+    drivers tracing EXACTLY this math, PRNG derivation included — neither
+    may keep its own copy.
+
+    Signatures (``rr``/``sid`` int32, ``seed`` uint32; state/vals pytrees):
+      f_send(rr, sid, seed, state)               -> (state', payload, dest)
+      f_update(rr, sid, seed, state, vals, mask) -> (state', exit_flag)
+      f_go(rr, sid, seed, state, vals, mask)     -> go   (FoldRound only,
+                                                          else None)
+    """
+
+    def mk_ctx(rr, sid, seed):
+        rng = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(seed), rr), sid
+        )
+        return RoundCtx(id=sid, n=n, r=rr, rng=rng)
+
+    def f_send(rr, sid, seed, state):
+        ctx = mk_ctx(rr, sid, seed)
+        st = rnd.pre(ctx, state)
+        spec = rnd.send(ctx, st)
+        return st, spec.payload, spec.dest_mask
+
+    def f_update(rr, sid, seed, state, vals, mask):
+        ctx = mk_ctx(rr, sid, seed)
+        st2 = rnd.update(ctx, state, Mailbox(vals, mask))
+        return st2, ctx._exit
+
+    f_go = None
+    if isinstance(rnd, FoldRound):
+        def f_go(rr, sid, seed, state, vals, mask):  # noqa: E306
+            ctx = mk_ctx(rr, sid, seed)
+            m, count = rnd.fold(ctx, state, Mailbox(vals, mask))
+            return rnd.go_ahead(ctx, state, m, count)
+
+    return f_send, f_update, f_go
+
+
+class LaneStep:
+    """One Round's jitted lane-axis mega-step at (n, lanes): vmapped
+    send/update/go over a ``[L, ...]`` state pytree with a RAGGED lane mask.
+
+    Ragged lanes: ``rr`` is a per-lane int32 vector and ``active`` masks
+    lanes out (free slots, lanes parked in another round class, lanes still
+    accumulating), so instances at DIFFERENT rounds batch into one dispatch
+    as long as they share the round CLASS (``rounds[r % k]`` — the traced
+    code); the driver buckets by class.  Inactive lanes keep their state
+    bit-for-bit (tree_where) and never assert exit, so a padding slot can
+    carry a retired instance's stale state harmlessly.
+
+    The vals/mask mailbox arguments are the ``[L, n, ...]`` batched form of
+    the host runner's in-place ``[n, ...]`` mailbox (runtime/lanes.py
+    assembles them from the same FLAG_BATCH wire drains).
+    """
+
+    __slots__ = ("rnd", "n", "lanes", "send", "update", "go")
+
+    def __init__(self, rnd, n: int, lanes: int):
+        self.rnd, self.n, self.lanes = rnd, n, lanes
+        f_send, f_update, f_go = make_host_round_fns(rnd, n)
+        in_lane = (0, None, 0, 0)  # rr, sid (shared: ONE replica), seed, st
+
+        def send_masked(rr, sid, seeds, state, active):
+            st, payload, dest = jax.vmap(f_send, in_axes=in_lane)(
+                rr, sid, seeds, state)
+            st = tree_where(active, st, state)
+            dest = jnp.logical_and(dest, active[:, None])
+            return st, payload, dest
+
+        def update_masked(rr, sid, seeds, state, vals, mask, active):
+            st2, ex = jax.vmap(f_update, in_axes=in_lane + (0, 0))(
+                rr, sid, seeds, state, vals, mask)
+            st2 = tree_where(active, st2, state)
+            return st2, jnp.logical_and(ex, active)
+
+        self.send = jax.jit(send_masked)
+        self.update = jax.jit(update_masked)
+        self.go = None
+        if f_go is not None:
+            def go_all(rr, sid, seeds, state, vals, mask):  # noqa: E306
+                return jax.vmap(f_go, in_axes=in_lane + (0, 0))(
+                    rr, sid, seeds, state, vals, mask)
+
+            self.go = jax.jit(go_all)
+
+
+def lane_step(rnd, n: int, lanes: int, sid, seeds, state) -> LaneStep:
+    """Cached LaneStep for ``rnd`` at (n, lanes), trace+compiled NOW under
+    the module build lock on the given exemplar args (results discarded) —
+    the warm-up discipline of HostRunner._build_round_fns: returning
+    un-traced wrappers would let thread-mode replicas sharing the Round
+    object race into duplicate compiles.  ``state`` is the live batched
+    ``[L, ...]`` pytree (numpy leaves), ``seeds`` the per-lane uint32
+    vector, ``sid`` this replica's int32 id."""
+    cache = getattr(rnd, "_lane_jit", None)
+    key = (n, lanes)
+    if cache is not None and key in cache:
+        return cache[key]
+    with _LANE_BUILD_LOCK:
+        cache = getattr(rnd, "_lane_jit", None)
+        if cache is None:
+            cache = rnd._lane_jit = {}
+        if key in cache:
+            return cache[key]
+        step = LaneStep(rnd, n, lanes)
+        rr0 = np.zeros((lanes,), dtype=np.int32)
+        act0 = np.zeros((lanes,), dtype=bool)
+        st0, payload0, _dest = step.send(rr0, sid, seeds, state, act0)
+        # warm update/go on the POST-send state (the state the real loop
+        # passes them) and a zero mailbox shaped from the send payload —
+        # the lane form of the per-instance warm-up exemplar
+        vals0 = jax.tree_util.tree_map(
+            lambda a: np.zeros((lanes, n) + np.shape(a)[1:],
+                               dtype=np.asarray(a).dtype), payload0)
+        mask0 = np.zeros((lanes, n), dtype=bool)
+        st0 = jax.tree_util.tree_map(np.asarray, st0)
+        step.update(rr0, sid, seeds, st0, vals0, mask0, act0)
+        if step.go is not None:
+            step.go(rr0, sid, seeds, st0, vals0, mask0)
+        jax.block_until_ready(jax.tree_util.tree_leaves(st0))
+        cache[key] = step
+        return step
+
+
+def lane_decide(algo: Algorithm, lanes: int, state):
+    """Cached jitted ``state[L, ...] -> (decided[L], decision[L, ...])``
+    for the lane driver's retire path (one dispatch per update wave that
+    had exits, instead of 2 eager accessor chains per finished lane).
+    Warm-compiled under the build lock on the exemplar ``state``."""
+    cache = getattr(algo, "_lane_decide_jit", None)
+    if cache is not None and lanes in cache:
+        return cache[lanes]
+    with _LANE_BUILD_LOCK:
+        cache = getattr(algo, "_lane_decide_jit", None)
+        if cache is None:
+            cache = algo._lane_decide_jit = {}
+        if lanes in cache:
+            return cache[lanes]
+        fn = jax.jit(jax.vmap(lambda s: (algo.decided(s), algo.decision(s))))
+        jax.block_until_ready(fn(state))
+        cache[lanes] = fn
+        return fn

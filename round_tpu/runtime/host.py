@@ -68,7 +68,7 @@ import numpy as np
 
 from round_tpu.core.algorithm import Algorithm
 from round_tpu.core.progress import Progress
-from round_tpu.core.rounds import FoldRound, Round, RoundCtx
+from round_tpu.core.rounds import Round, RoundCtx
 from round_tpu.obs.metrics import METRICS, MS_BUCKETS
 from round_tpu.obs.trace import TRACE
 from round_tpu.ops.mailbox import Mailbox
@@ -226,6 +226,42 @@ def _schedule_value(value_schedule: str, base_value: int, my_id: int,
             f"value_schedule must be 'mixed' or 'uniform', "
             f"got {value_schedule!r}")
     return (base_value + my_id * 7 + inst) % 5
+
+
+def instance_io(algo: Algorithm, value: int) -> Dict[str, Any]:
+    """The io pytree for one instance's scheduled proposal ``value``.
+
+    Scalar-domain algorithms get the PerfTest2 shape ({"initial_value":
+    int32}); a byte-payload algorithm (models/lastvoting.LastVotingBytes,
+    detected by its ``payload_bytes`` attribute) gets a deterministic
+    uint8[B] vector expanded from the value — distinct values map to
+    distinct vectors (agreement stays non-trivial under the "mixed"
+    schedule) and equal values to equal vectors (the "uniform" schedule
+    stays fault-invariant by validity).  This is what lets the KB-payload
+    wire-fraction workload (PERF_MODEL.md) run through the SAME host
+    loops as the scalar protocols."""
+    b = getattr(algo, "payload_bytes", None)
+    if b is None:
+        return {"initial_value": np.int32(value)}
+    vec = ((np.arange(b, dtype=np.int64) * 131 + value * 31 + 7) % 256)
+    return {"initial_value": vec.astype(np.uint8)}
+
+
+def decision_scalar(decision) -> int:
+    """Collapse a decision to the int the decision logs store: scalar
+    decisions pass through unchanged (the seed behavior); a VECTOR
+    decision (LastVotingBytes) becomes a 7-byte blake2s digest — equal
+    vectors hash equal across replicas, and the digest fits the
+    checkpoint's int64 array with the _UNDECIDED sentinel unreachable.
+    Replies to laggards must ship the RAW decision, not this digest
+    (callers keep the raw array beside the log for that)."""
+    arr = np.asarray(decision)
+    if arr.ndim == 0:
+        return int(arr)
+    import hashlib
+
+    return int.from_bytes(
+        hashlib.blake2s(arr.tobytes(), digest_size=7).digest(), "big")
 
 
 def _try_send_decision(transport, replied: Dict[Tuple[int, int], float],
@@ -509,9 +545,9 @@ def run_instance_loop_pipelined(
                 wire=wire,
             )
             value = _schedule_value(value_schedule, base_value, my_id, inst)
-            res = runner.run({"initial_value": np.int32(value)},
+            res = runner.run(instance_io(algo, value),
                              max_rounds=max_rounds)
-            d = int(np.asarray(res.decision)) if res.decided else None
+            d = decision_scalar(res.decision) if res.decided else None
             decisions[inst - 1] = d
             mux.complete(
                 inst, np.asarray(res.decision) if res.decided else None)
@@ -617,6 +653,10 @@ def run_instance_loop(
     stash: Dict[int, Dict[int, Dict[int, Any]]] = {}
     current = {"inst": 0}
     decisions: List[Optional[int]] = []
+    # instance -> raw decision array: laggard replies must carry the value
+    # a peer can ADOPT — for vector-decision algorithms (LastVotingBytes)
+    # the log stores the digest (decision_scalar), which is not adoptable
+    raw_decisions: Dict[int, np.ndarray] = {}
     replied: Dict[Tuple[int, int], float] = {}
     enc_cache: Dict[int, bytes] = {}
     start = 1
@@ -653,9 +693,19 @@ def run_instance_loop(
             # so the laggard's next retransmission re-arms it
             idx = tag.instance - 1
             if 0 <= idx < len(decisions):
-                _try_send_decision(transport, replied, sender,
-                                   tag.instance, decisions[idx],
-                                   enc_cache=enc_cache)
+                reply = raw_decisions.get(tag.instance)
+                if reply is None and getattr(algo, "payload_bytes",
+                                             None) is None:
+                    # scalar-decision log values ARE the raw decision
+                    # (checkpoint-resumed instances have no raw entry);
+                    # a vector algorithm's log holds digests, which a
+                    # laggard cannot adopt — better no reply than a
+                    # garbage one it must discard every round
+                    reply = decisions[idx]
+                if reply is not None:
+                    _try_send_decision(transport, replied, sender,
+                                       tag.instance, reply,
+                                       enc_cache=enc_cache)
             return
         stash.setdefault(tag.instance, {}).setdefault(
             tag.round, {})[sender] = payload
@@ -690,7 +740,7 @@ def run_instance_loop(
                 wire=wire,
             )
             value = _schedule_value(value_schedule, base_value, vid, inst)
-            res = runner.run({"initial_value": np.int32(value)},
+            res = runner.run(instance_io(algo, value),
                              max_rounds=max_rounds)
             if view is not None and res.stale_view and not res.decided \
                     and not view.removed:
@@ -705,9 +755,11 @@ def run_instance_loop(
             # the decision-log length schedule-shaped for the harness
             decisions.extend([None] * (instances - len(decisions)))
             break
-        decisions.append(
-            int(np.asarray(res.decision)) if res.decided else None
-        )
+        if res.decided:
+            decisions.append(decision_scalar(res.decision))
+            raw_decisions[inst] = np.asarray(res.decision)
+        else:
+            decisions.append(None)
         if checkpoint_dir is not None:
             _save_decision_checkpoint(checkpoint_dir, decisions, inst,
                                       instances)
@@ -745,7 +797,7 @@ def run_instance_loop(
 
 def serve_decisions(transport, decisions: List[Optional[int]],
                     idle_ms: int = 4000, contact_idle_ms: int = 2000,
-                    max_ms: int = 120_000) -> int:
+                    max_ms: int = 120_000, adoptable: bool = True) -> int:
     """Linger after a completed instance loop, answering peers' NORMAL
     traffic with FLAG_DECISION replies (the trySendDecision machinery)
     until the wire has been idle for `idle_ms` (hard cap `max_ms`).
@@ -763,7 +815,12 @@ def serve_decisions(transport, decisions: List[Optional[int]],
     to `contact_idle_ms` so a finished laggard releases this replica
     quickly.  Earlier-instance traffic does NOT shrink the window —
     stale pre-crash packets drained at linger start must not collapse
-    the restart window.  Returns the number of replies sent."""
+    the restart window.  Returns the number of replies sent.
+
+    ``adoptable=False`` lingers WITHOUT replying (the idle clock still
+    runs): callers whose decision entries are digests rather than raw
+    decisions (a vector-decision algorithm's log, decision_scalar) must
+    not ship values a laggard's adopt_decision can only discard."""
     replied: Dict[Tuple[int, int], float] = {}
     enc_cache: Dict[int, bytes] = {}
     served = 0
@@ -775,7 +832,8 @@ def serve_decisions(transport, decisions: List[Optional[int]],
         if got is None:
             continue
         sender, tag, _raw = got
-        if (tag.flag == FLAG_NORMAL and 1 <= tag.instance <= len(decisions)
+        if (adoptable and tag.flag == FLAG_NORMAL
+                and 1 <= tag.instance <= len(decisions)
                 and decisions[tag.instance - 1] is not None):
             if _try_send_decision(transport, replied, sender, tag.instance,
                                   decisions[tag.instance - 1],
@@ -1090,35 +1148,16 @@ class HostRunner:
             return self._build_round_fns(rnd, state)
 
     def _build_round_fns(self, rnd, state):
+        # the raw per-lane functions are SHARED with the lane-batched
+        # driver (engine/executor.py make_host_round_fns): byte-identical
+        # lane-batched decisions depend on both drivers tracing exactly
+        # the same math, PRNG derivation included
+        from round_tpu.engine.executor import make_host_round_fns
+
         n = self.n
-
-        def mk_ctx(rr, sid, seed):
-            rng = jax.random.fold_in(
-                jax.random.fold_in(jax.random.PRNGKey(seed), rr), sid
-            )
-            return RoundCtx(id=sid, n=n, r=rr, rng=rng)
-
-        def f_send(rr, sid, seed, state):
-            ctx = mk_ctx(rr, sid, seed)
-            st = rnd.pre(ctx, state)
-            spec = rnd.send(ctx, st)
-            return st, spec.payload, spec.dest_mask
-
-        def f_update(rr, sid, seed, state, vals, mask):
-            ctx = mk_ctx(rr, sid, seed)
-            st2 = rnd.update(ctx, state, Mailbox(vals, mask))
-            return st2, ctx._exit
-
-        f_go = None
-        if isinstance(rnd, FoldRound):
-            def f_go(rr, sid, seed, state, vals, mask):  # noqa: E306
-                ctx = mk_ctx(rr, sid, seed)
-                m, count = rnd.fold(ctx, state, Mailbox(vals, mask))
-                return rnd.go_ahead(ctx, state, m, count)
-
-            f_go = jax.jit(f_go)
-
-        fns = (jax.jit(f_send), jax.jit(f_update), f_go)
+        raw_send, raw_update, raw_go = make_host_round_fns(rnd, n)
+        f_go = jax.jit(raw_go) if raw_go is not None else None
+        fns = (jax.jit(raw_send), jax.jit(raw_update), f_go)
         # jax.jit is LAZY: trace+compile NOW, under the build lock, on
         # exemplar args (results discarded) — returning un-traced wrappers
         # would let every replica thread race into its own duplicate

@@ -26,6 +26,88 @@ from round_tpu.obs.metrics import METRICS
 
 MAX_INSTANCE = 1 << 16
 
+# the lane-count buckets the lane-batched host driver pads to (runtime/
+# lanes.py): a jitted mega-step is compiled per (round class, bucket, n),
+# so admission/retire churn between dispatches NEVER recompiles — a new
+# instance lands in a free padded slot, and only crossing a bucket
+# boundary (a different --lanes request) costs a fresh trace.  Small set
+# by design: each bucket is one more compile per round class.
+LANE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def lane_bucket(k: int) -> int:
+    """Smallest lane bucket >= k (capped at the largest bucket)."""
+    if k < 1:
+        raise ValueError(f"need k >= 1, got {k}")
+    for b in LANE_BUCKETS:
+        if b >= k:
+            return b
+    return LANE_BUCKETS[-1]
+
+
+class LaneTable:
+    """Slot table mapping live instance ids onto lane indices — the
+    dispatcher role of InstanceMux (InstanceDispatcher.scala:84-89) turned
+    into lane admission for the lane-batched driver: ``admit`` hands a new
+    instance the lowest free padded slot, ``retire`` frees it between
+    dispatches, and the padded width (``lane_bucket``) is what keeps the
+    compiled mega-step signature stable across churn.
+
+    Deterministic by construction (lowest-free-slot, no hashing): lane
+    placement never affects per-instance math, but determinism keeps runs
+    reproducible and the equivalence suite's failures replayable.
+
+    ``limit`` is the REQUESTED concurrency and ``width`` the padded
+    compile width: a ``lanes=5`` request compiles an 8-wide mega-step but
+    admits at most 5 instances in flight — what the harness reports as
+    "lanes=5" is what actually ran (padding slots stay masked-inactive).
+    A request above the largest bucket is clamped to it."""
+
+    __slots__ = ("width", "limit", "_free", "_lane_of", "_inst_of")
+
+    def __init__(self, lanes: int):
+        self.width = lane_bucket(lanes)
+        self.limit = min(lanes, self.width)
+        self._free = list(range(self.width - 1, -1, -1))  # pop() -> lowest
+        self._lane_of: Dict[int, int] = {}
+        self._inst_of: List[Optional[int]] = [None] * self.width
+
+    @property
+    def occupancy(self) -> int:
+        return self.width - len(self._free)
+
+    def can_admit(self) -> bool:
+        return bool(self._free) and self.occupancy < self.limit
+
+    def admit(self, instance_id: int) -> int:
+        iid = instance_id % MAX_INSTANCE
+        if iid in self._lane_of:
+            raise ValueError(f"instance {iid} already admitted")
+        if not self._free:
+            raise ValueError("no free lane")
+        lane = self._free.pop()
+        self._lane_of[iid] = lane
+        self._inst_of[lane] = iid
+        return lane
+
+    def retire(self, instance_id: int) -> int:
+        iid = instance_id % MAX_INSTANCE
+        lane = self._lane_of.pop(iid)
+        self._inst_of[lane] = None
+        self._free.append(lane)
+        # keep pop() == lowest free slot after arbitrary churn
+        self._free.sort(reverse=True)
+        return lane
+
+    def lane_of(self, instance_id: int) -> Optional[int]:
+        return self._lane_of.get(instance_id % MAX_INSTANCE)
+
+    def instance_of(self, lane: int) -> Optional[int]:
+        return self._inst_of[lane]
+
+    def live_instances(self) -> List[int]:
+        return sorted(self._lane_of)
+
 
 @dataclasses.dataclass
 class InstanceResult:

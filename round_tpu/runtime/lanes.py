@@ -1,0 +1,910 @@
+"""Lane-batched instance serving: live consensus instances as ONE lane axis.
+
+PR 5's host-wire roofline (PERF_MODEL.md) showed the host runtime is
+round-DRIVER-bound, not wire-bound: the batched engine simulates thousands
+of rounds/sec while the per-instance drivers decide ~25-45/sec, because
+every live instance runs its own Python round loop with per-round jitted
+dispatches.  Comm-closed rounds are the license to collapse that gap
+("reducing asynchrony to synchronized rounds"): a whole round's traffic for
+MANY instances is one batch operation, so this module inverts the driver's
+control flow — the unit of work becomes "one round of L instances" instead
+of "one instance's round".
+
+Shape:
+  * instances are LANES of the engine's batch axis
+    (engine/executor.py LaneStep): one jitted mega-step — vmapped
+    send/update over a ``[L, ...]`` state pytree with a ragged per-lane
+    round vector + active mask — advances every ready instance per
+    dispatch; instances at different rounds batch together when they share
+    the round CLASS (``rounds[r % k]``), else bucket by class;
+  * the Python host loop is reduced to draining FLAG_BATCH frames into
+    per-lane ``[L, n, ...]`` mailboxes (the in-place PR-5 arrays grown a
+    lane axis), launching the mega-step, and flushing per-lane sends —
+    which coalesce ACROSS lanes into one container per peer per wave;
+  * admission: instances join/retire lanes between dispatches with NO
+    recompile (runtime/instances.py LaneTable pads to a small set of
+    lane-count buckets; the compiled signature never changes mid-run).
+
+Equivalence contract (tests/test_lanes.py): for the same seeds this driver
+produces BYTE-IDENTICAL per-instance decisions to the per-instance
+drivers — both trace exactly the same per-lane math
+(engine/executor.py make_host_round_fns, PRNG derivation included), heard
+sets match under the same fault schedule (chaos faults are per LOGICAL
+frame, so lane packing never changes which frames fault), and
+checkpoint/resume keeps the decision-log format of run_instance_loop.
+
+Not supported here: live view changes (runtime/view.py — the sequential
+loop remains the membership-change driver) and the
+``send_when_catching_up=False`` experiment.
+"""
+
+from __future__ import annotations
+
+import collections
+import pickle
+import time as _time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import Round, RoundCtx
+from round_tpu.engine.executor import lane_decide, lane_step
+from round_tpu.obs.metrics import METRICS
+from round_tpu.obs.trace import TRACE
+from round_tpu.runtime import codec
+from round_tpu.runtime.host import (
+    _UNDECIDED, AdaptiveTimeout, _save_decision_checkpoint, _schedule_value,
+    _try_send_decision, decision_scalar, instance_io,
+)
+from round_tpu.runtime.instances import LaneTable
+from round_tpu.runtime.log import get_logger
+from round_tpu.runtime.oob import FLAG_DECISION, FLAG_NORMAL, Tag
+
+log = get_logger("lanes")
+
+# lanes.* vocabulary (docs/OBSERVABILITY.md).  host.* counters (rounds,
+# sends, recvs, timeouts, decisions, malformed) are shared with the
+# per-instance drivers — same names resolve to the same instruments — so
+# dashboards see one host runtime regardless of driver.
+_C_DISPATCH = METRICS.counter("lanes.dispatches")
+_C_SEND_D = METRICS.counter("lanes.send_dispatches")
+_C_UPD_D = METRICS.counter("lanes.update_dispatches")
+_C_GO_D = METRICS.counter("lanes.go_dispatches")
+_C_ADMIT = METRICS.counter("lanes.admitted")
+_C_RETIRE = METRICS.counter("lanes.retired")
+_C_LANE_OOB = METRICS.counter("lanes.oob_decisions")
+_G_OCC = METRICS.gauge("lanes.occupancy")
+_G_WIDTH = METRICS.gauge("lanes.width")
+_H_IPD = METRICS.histogram(
+    "lanes.instances_per_dispatch",
+    (1, 2, 4, 8, 16, 32, 64, 128, 256, 512), unit="instances")
+_C_ROUNDS = METRICS.counter("host.rounds")
+_C_SENDS = METRICS.counter("host.sends")
+_C_RECVS = METRICS.counter("host.recvs")
+_C_TIMEOUTS = METRICS.counter("host.timeouts")
+_C_MALFORMED = METRICS.counter("host.malformed")
+_C_DECISIONS = METRICS.counter("host.decisions")
+_C_CATCHUP = METRICS.counter("host.catch_ups")
+
+_STASH_CAP = 4096  # same eviction discipline as InstanceMux._STASH_CAP
+
+# per-class progress kinds (parsed once from Round.init_progress)
+_P_TIMEOUT, _P_WAIT, _P_GOAHEAD, _P_SYNC = range(4)
+
+
+class _ClassBox:
+    """One round class's lane mailboxes: decoded payloads write IN PLACE
+    into preallocated ``[L, n, ...]`` arrays + an ``[L, n]`` mask — the
+    PR-5 _RoundMailbox grown a lane axis, and exactly the vals/mask the
+    mega-step update consumes with ZERO restacking.  Rows are reset as
+    lanes enter the class's round; the arrays live for the driver's
+    lifetime, so the steady state allocates nothing."""
+
+    __slots__ = ("n", "width", "treedef", "vals", "mask", "count", "_sig",
+                 "on_malformed")
+
+    def __init__(self, n: int, width: int, on_malformed=None):
+        self.n, self.width = n, width
+        self.treedef = None
+        self.vals: List[np.ndarray] = []
+        self.mask = np.zeros((width, n), dtype=bool)
+        self.count = np.zeros((width,), dtype=np.int64)
+        self._sig = None
+        # structural-garbage sink: keeps the driver's malformed counters
+        # in parity with _RoundMailbox.insert (host.malformed must read
+        # the same whichever driver served the run)
+        self.on_malformed = on_malformed
+
+    def reset_row(self, lane: int, like: Any) -> None:
+        leaves, treedef = jax.tree_util.tree_flatten(like)
+        sig = (treedef, tuple((np.shape(x), np.asarray(x).dtype)
+                              for x in leaves))
+        if self._sig is None:
+            self._sig = sig
+            self.treedef = treedef
+            self.vals = [
+                np.zeros((self.width, self.n) + np.shape(x),
+                         dtype=np.asarray(x).dtype)
+                for x in leaves
+            ]
+        elif sig != self._sig:
+            # payload shape is static per (algorithm, round class, n) —
+            # a mismatch is a driver bug, not wire garbage
+            raise RuntimeError(
+                f"round-class payload signature changed mid-run: {sig} "
+                f"!= {self._sig}")
+        for a in self.vals:
+            a[lane] = 0
+        self.mask[lane] = False
+        self.count[lane] = 0
+
+    def insert(self, lane: int, sender: int, payload: Any) -> bool:
+        """Write one sender's payload into (lane, sender); True when the
+        lane's heard-set grew.  Structural garbage (wrong tree/leaf
+        shape/dtype) drops per sender — same byzantine tolerance as
+        _RoundMailbox.insert."""
+        try:
+            leaves = jax.tree_util.tree_flatten(payload)[0]
+            if len(leaves) != len(self.vals):
+                raise ValueError(
+                    f"{len(leaves)} leaves != {len(self.vals)}")
+            for slot, leaf in zip(self.vals, leaves):
+                arr = np.asarray(leaf)
+                if arr.shape != slot.shape[2:]:
+                    raise ValueError(
+                        f"leaf shape {arr.shape} != {slot.shape[2:]}")
+                slot[lane, sender] = arr.astype(slot.dtype,
+                                                casting="same_kind")
+        except Exception as e:  # noqa: BLE001 — garbage must not kill us
+            if self.mask[lane, sender]:
+                self.mask[lane, sender] = False
+                self.count[lane] -= 1
+            for slot in self.vals:
+                slot[lane, sender] = 0
+            if self.on_malformed is not None:
+                self.on_malformed()
+            log.debug("lane %d: dropping structurally-malformed payload "
+                      "from %d: %s", lane, sender, e)
+            return False
+        if not self.mask[lane, sender]:
+            self.mask[lane, sender] = True
+            self.count[lane] += 1
+            return True
+        return False
+
+    def values_mask(self):
+        return (jax.tree_util.tree_unflatten(self.treedef, self.vals),
+                self.mask)
+
+
+class LaneDriver:
+    """Drive up to ``lanes`` concurrent consensus instances of ONE replica
+    as lanes of the engine's batch axis (module docstring).  The driver is
+    single-threaded and owns the transport drain — the InstanceMux router
+    thread and per-instance worker threads of the pipelined driver are
+    replaced by mailbox routing inside the tick loop."""
+
+    def __init__(
+        self,
+        algo: Algorithm,
+        my_id: int,
+        peers: Dict[int, Tuple[str, int]],
+        transport,
+        lanes: int = 16,
+        timeout_ms: int = 300,
+        seed: int = 0,
+        base_value: int = 0,
+        max_rounds: int = 32,
+        nbr_byzantine: int = 0,
+        value_schedule: str = "mixed",
+        adaptive: Optional[AdaptiveTimeout] = None,
+        wire: str = "binary",
+        wait_cap_ms: int = 30_000,
+    ):
+        if wire not in ("binary", "pickle"):
+            raise ValueError(f"wire must be 'binary' or 'pickle', "
+                             f"got {wire!r}")
+        self.algo = algo
+        self.id = my_id
+        self.n = len(peers)
+        self.transport = transport
+        self.timeout_ms = timeout_ms
+        self.seed = seed
+        self.base_value = base_value
+        self.max_rounds = max_rounds
+        self.value_schedule = value_schedule
+        self.adaptive = adaptive
+        self.wire = wire
+        self.wait_cap_ms = wait_cap_ms
+        if not 0 <= nbr_byzantine < self.n:
+            raise ValueError(
+                f"nbr_byzantine={nbr_byzantine} must be in [0, n={self.n})")
+        self.nbr_byzantine = nbr_byzantine
+        for pid, (host, port) in peers.items():
+            if pid != my_id:
+                transport.add_peer(pid, host, port)
+
+        self.k = len(algo.rounds)
+        self.table = LaneTable(lanes)
+        self.L = self.table.width
+        _G_WIDTH.set(self.L)
+        n, L = self.n, self.L
+
+        # batched lane state (numpy leaves, ALWAYS writable: admission
+        # writes init rows in place between dispatches)
+        self._treedef = None
+        self._state: List[np.ndarray] = []
+        self._sid = np.int32(my_id)
+        self._seeds = np.zeros((L,), dtype=np.uint32)
+        self._rr = np.zeros((L,), dtype=np.int32)
+
+        # per-lane control plane
+        self._inst = np.zeros((L,), dtype=np.int64)       # 0 = free slot
+        self._live = np.zeros((L,), dtype=bool)
+        self._need_send = np.zeros((L,), dtype=bool)
+        self._waiting = np.zeros((L,), dtype=bool)
+        self._dirty = np.zeros((L,), dtype=bool)
+        self._deadline = np.full((L,), np.inf)
+        self._t0 = np.zeros((L,))
+        self._use_deadline = np.zeros((L,), dtype=bool)
+        self._delegated = np.zeros((L,), dtype=bool)
+        self._expected = np.full((L,), n, dtype=np.int64)
+        self._max_rnd = np.full((L, n), -1, dtype=np.int64)
+        self._next_round = np.zeros((L,), dtype=np.int64)
+        self._oob_done = np.zeros((L,), dtype=bool)
+        self._pending: List[Dict[int, Dict[int, Any]]] = [
+            {} for _ in range(L)]
+
+        # per-class machinery
+        self._boxes = [_ClassBox(n, L, on_malformed=self._note_malformed)
+                       for _ in range(self.k)]
+        self._steps: List[Optional[Any]] = [None] * self.k
+        self._prog = [self._parse_progress(rnd) for rnd in algo.rounds]
+        self._expected_static = [
+            type(rnd).expected_nbr_messages is Round.expected_nbr_messages
+            for rnd in algo.rounds
+        ]
+        self._decide_fn = None
+
+        # wire plumbing (the PR-5 hot path, shared with HostRunner)
+        self._scratch = codec.Scratch() if wire == "binary" else None
+        self._sendb = (getattr(transport, "send_buffered", None)
+                       if wire == "binary" else None)
+        self._flushfn = (getattr(transport, "flush", None)
+                         if wire == "binary" else None)
+        if self._flushfn is None:
+            self._sendb = None
+        self._recv_many = getattr(transport, "recv_many", None)
+
+        # instance-level bookkeeping
+        self._done: Dict[int, Optional[np.ndarray]] = {}  # iid -> raw
+        self._replied: Dict[Tuple[int, int], float] = {}
+        self._enc_cache: Dict[int, bytes] = {}
+        self._stash: Dict[int, List[Tuple[int, Tag, bytes]]] = {}
+        self._stash_order: collections.deque = collections.deque()
+        self._stash_count = 0  # LIVE stashed entries (the order deque may
+        # carry stale ids for already-admitted instances; they age out in
+        # the eviction loop — the cap gates on this count, not deque len)
+        self._init_cache: Dict[bytes, List[np.ndarray]] = {}
+        self.malformed = 0
+        self.timeouts = 0
+        self.rounds_run = 0   # cumulative across every lane and instance
+        self._trajectory: List[int] = []
+
+    # -- static per-class progress ----------------------------------------
+
+    def _parse_progress(self, rnd) -> Tuple[int, bool, int]:
+        """(kind, strict, millis_or_k).  A round that keeps the Round-class
+        default DELEGATES to the runner's configured timeout (fixed or
+        adaptive) — the _round_progress rule of the per-instance driver."""
+        p = rnd.init_progress
+        if p is Round.init_progress:
+            return (_P_TIMEOUT, False, -1)  # -1: resolve per lane at entry
+        if p.is_timeout:
+            return (_P_TIMEOUT, p.is_strict, int(p.timeout_millis))
+        if p.is_go_ahead:
+            return (_P_GOAHEAD, False, 0)
+        if p.is_sync:
+            return (_P_SYNC, True, int(p.k))
+        return (_P_WAIT, p.is_strict, 0)
+
+    # -- state pytree helpers ----------------------------------------------
+
+    def _state_tree(self):
+        return jax.tree_util.tree_unflatten(self._treedef, self._state)
+
+    def _copy_back(self, tree) -> None:
+        self._state = [np.array(x) for x in jax.tree_util.tree_leaves(tree)]
+
+    def _state_row(self, lane: int):
+        return jax.tree_util.tree_unflatten(
+            self._treedef, [leaf[lane] for leaf in self._state])
+
+    def _write_row(self, lane: int, leaves: List[np.ndarray]) -> None:
+        for dst, src in zip(self._state, leaves):
+            dst[lane] = src
+
+    # -- admission ---------------------------------------------------------
+
+    def _init_leaves(self, value: int) -> List[np.ndarray]:
+        """Per-lane init state leaves for a scheduled proposal value —
+        cached by value bytes (the schedule draws from a tiny domain, so
+        admission is an array write, not an eager trace)."""
+        io = instance_io(self.algo, value)
+        key = np.asarray(io["initial_value"]).tobytes()
+        got = self._init_cache.get(key)
+        if got is None:
+            ctx = RoundCtx(id=np.int32(self.id), n=self.n, r=np.int32(0))
+            st = self.algo.make_init_state(ctx, io)
+            got = [np.asarray(x) for x in jax.tree_util.tree_leaves(st)]
+            if self._treedef is None:
+                self._treedef = jax.tree_util.tree_structure(st)
+                self._state = [
+                    np.zeros((self.L,) + x.shape, dtype=x.dtype)
+                    for x in got
+                ]
+            self._init_cache[key] = got
+        return got
+
+    def _admit(self, inst: int) -> None:
+        iid = inst & 0xFFFF
+        lane = self.table.admit(iid)
+        value = _schedule_value(self.value_schedule, self.base_value,
+                                self.id, inst)
+        self._write_row(lane, self._init_leaves(value))
+        self._inst[lane] = inst
+        self._seeds[lane] = np.uint32(self.seed + inst)
+        self._rr[lane] = 0
+        self._live[lane] = True
+        self._need_send[lane] = True
+        self._waiting[lane] = False
+        self._dirty[lane] = False
+        self._oob_done[lane] = False
+        self._max_rnd[lane] = -1
+        self._max_rnd[lane, self.id] = 0
+        self._next_round[lane] = 0
+        self._pending[lane] = {}
+        _C_ADMIT.inc()
+        _G_OCC.set(self.table.occupancy)
+        if TRACE.enabled:
+            TRACE.emit("lane_admit", node=self.id, inst=iid, lane=lane)
+        # replay start-skew traffic stashed before admission (the
+        # defaultHandler lazy-join role) — it lands in pending[0].  The
+        # order deque keeps its now-stale iid entries; eviction skips them
+        replay = self._stash.pop(iid, [])
+        self._stash_count -= len(replay)
+        for got in replay:
+            self._ingest(got)
+
+    # -- wire in -----------------------------------------------------------
+
+    def _note_malformed(self) -> None:
+        self.malformed += 1
+        _C_MALFORMED.inc()
+
+    def _loads(self, raw) -> Tuple[bool, Any]:
+        if not raw:
+            return True, None
+        try:
+            return True, codec.loads(raw)
+        except Exception as e:  # noqa: BLE001 — any garbage must survive
+            self.malformed += 1
+            _C_MALFORMED.inc()
+            log.debug("node %d: dropping malformed payload (%d bytes): %s",
+                      self.id, len(raw), e)
+            return False, None
+
+    def _ingest(self, got) -> None:
+        sender, tag, raw = got
+        if not 0 <= sender < self.n:
+            self.malformed += 1
+            _C_MALFORMED.inc()
+            return
+        iid = tag.instance
+        lane = self.table.lane_of(iid)
+        if lane is None:
+            if tag.flag != FLAG_NORMAL:
+                return
+            if iid in self._done:
+                # TooLate: answer a completed instance's traffic with its
+                # decision (rate-limited; encode-once via the cache)
+                d = self._done[iid]
+                if d is not None:
+                    _try_send_decision(self.transport, self._replied,
+                                       sender, iid, d,
+                                       enc_cache=self._enc_cache)
+                return
+            # future instance: stash raw until admission (FIFO-capped —
+            # garbage instance ids age out instead of pinning the stash;
+            # stale order heads for admitted instances are skipped here)
+            while self._stash_count >= _STASH_CAP and self._stash_order:
+                old = self._stash_order.popleft()
+                bucket = self._stash.get(old)
+                if bucket:
+                    bucket.pop(0)
+                    self._stash_count -= 1
+                    if not bucket:
+                        del self._stash[old]
+            if not isinstance(got[2], bytes):
+                got = (got[0], got[1], bytes(got[2]))
+            self._stash.setdefault(iid, []).append(got)
+            self._stash_order.append(iid)
+            self._stash_count += 1
+            return
+        if tag.flag == FLAG_DECISION:
+            ok, p = self._loads(raw)
+            adopted = (self.algo.adopt_decision(self._state_row(lane), p)
+                       if ok else None)
+            if adopted is not None:
+                self._write_row(lane, [
+                    np.asarray(x)
+                    for x in jax.tree_util.tree_leaves(adopted)])
+                self._oob_done[lane] = True
+                _C_LANE_OOB.inc()
+                if TRACE.enabled:
+                    TRACE.emit("recv_decision", node=self.id, inst=iid,
+                               round=int(self._rr[lane]), src=sender)
+            return
+        if tag.flag != FLAG_NORMAL:
+            return
+        r = int(self._rr[lane])
+        if tag.round > self._max_rnd[lane, sender]:
+            self._max_rnd[lane, sender] = tag.round
+        if tag.round < r:
+            return  # late: the round is communication-closed
+        ok, payload = self._loads(raw)
+        if not ok:
+            return
+        if self._waiting[lane] and not self._use_deadline[lane]:
+            # WaitForMessage/Sync cap is an IDLE cap: progress extends it
+            self._deadline[lane] = _time.monotonic() + \
+                self.wait_cap_ms / 1000.0
+        if tag.round > r or not self._waiting[lane]:
+            # future round — or current round but OUR send has not run yet
+            # (the per-instance driver's transport queue plays this role:
+            # frames received before the send land in the mailbox only
+            # after reset): buffer, prefilled at round entry
+            self._pending[lane].setdefault(tag.round, {})[sender] = payload
+            if tag.round > r:
+                if self.nbr_byzantine <= 0:
+                    self._next_round[lane] = max(
+                        int(self._next_round[lane]),
+                        int(self._max_rnd[lane].max()))
+                else:
+                    srt = np.sort(self._max_rnd[lane])
+                    self._next_round[lane] = max(
+                        int(self._next_round[lane]),
+                        int(srt[-(self.nbr_byzantine + 1)]))
+            return
+        grew = self._boxes[r % self.k].insert(lane, sender, payload)
+        _C_RECVS.inc()
+        if grew:
+            self._dirty[lane] = True
+
+    def _drain(self, timeout_ms: int) -> int:
+        if self._recv_many is not None:
+            got_list = self._recv_many(timeout_ms)
+        else:
+            got = self.transport.recv(timeout_ms)
+            got_list = [got] if got is not None else []
+        for got in got_list:
+            self._ingest(got)
+        return len(got_list)
+
+    # -- send wave ---------------------------------------------------------
+
+    def _send_wave(self) -> None:
+        lanes = np.nonzero(self._need_send & self._live)[0]
+        if lanes.size == 0:
+            return
+        shipped = 0
+        for c in sorted({int(self._rr[l]) % self.k for l in lanes}):
+            group = [int(l) for l in lanes if int(self._rr[l]) % self.k == c]
+            shipped += self._send_class(c, group)
+        if shipped and self._sendb is not None:
+            self._flushfn()
+
+    def _send_class(self, c: int, group: List[int]) -> int:
+        step = self._step(c)
+        active = np.zeros((self.L,), dtype=bool)
+        active[group] = True
+        st, payload, dest = step.send(
+            self._rr, self._sid, self._seeds, self._state_tree(), active)
+        self._copy_back(st)
+        _C_SEND_D.inc()
+        _C_DISPATCH.inc()
+        _H_IPD.observe(len(group))
+        _G_OCC.set(self.table.occupancy)
+        pl_leaves, pl_tree = jax.tree_util.tree_flatten(payload)
+        pl_leaves = [np.asarray(x) for x in pl_leaves]
+        dest_np = np.asarray(dest)
+        now = _time.monotonic()
+        shipped = 0
+        for lane in group:
+            shipped += self._begin_round(
+                c, lane,
+                jax.tree_util.tree_unflatten(
+                    pl_tree, [x[lane] for x in pl_leaves]),
+                dest_np[lane], now)
+        return shipped
+
+    def _begin_round(self, c: int, lane: int, payload_row, dest_row,
+                     now: float) -> int:
+        r = int(self._rr[lane])
+        iid = int(self._inst[lane]) & 0xFFFF
+        kind, strict, millis = self._prog[c]
+        self._delegated[lane] = millis < 0 and kind == _P_TIMEOUT
+        if self._delegated[lane]:
+            millis = (self.adaptive.current_ms()
+                      if self.adaptive is not None else self.timeout_ms)
+        self._use_deadline[lane] = kind == _P_TIMEOUT
+        if kind == _P_TIMEOUT:
+            self._deadline[lane] = now + millis / 1000.0
+            self._trajectory.append(int(millis))
+        else:
+            self._deadline[lane] = now + self.wait_cap_ms / 1000.0
+        self._t0[lane] = now
+        if self._expected_static[c]:
+            self._expected[lane] = self.n
+        else:
+            ctx = RoundCtx(id=np.int32(self.id), n=self.n, r=np.int32(r))
+            self._expected[lane] = int(np.asarray(
+                self.algo.rounds[c].expected_nbr_messages(
+                    ctx, self._state_row(lane))))
+        box = self._boxes[c]
+        box.reset_row(lane, payload_row)
+        for sender, payload in self._pending[lane].pop(r, {}).items():
+            box.insert(lane, sender, payload)
+        if TRACE.enabled:
+            TRACE.emit("round_start", node=self.id, inst=iid, round=r)
+        sent = 0
+        if dest_row.any():
+            if self._scratch is not None:
+                wire = self._scratch.encode(payload_row)
+            else:
+                wire = pickle.dumps(jax.tree_util.tree_map(
+                    np.asarray, payload_row))
+            tag = Tag(instance=iid, round=r)
+            sendb = self._sendb
+            for d in range(self.n):
+                if d == self.id or not dest_row[d]:
+                    continue
+                if sendb is not None:
+                    sendb(d, tag, wire)
+                else:
+                    self.transport.send(
+                        d, tag, wire if isinstance(wire, bytes)
+                        else bytes(wire))
+                sent += 1
+                if TRACE.enabled:
+                    TRACE.emit("send", node=self.id, inst=iid, round=r,
+                               dst=d, bytes=len(wire))
+            if sent:
+                _C_SENDS.inc(sent)
+        if dest_row[self.id]:
+            # self-delivery short-circuits the wire (Round.scala:114-117)
+            box.insert(lane, self.id, payload_row)
+        self._need_send[lane] = False
+        self._waiting[lane] = True
+        self._dirty[lane] = True
+        return sent
+
+    def _step(self, c: int):
+        step = self._steps[c]
+        if step is None:
+            step = lane_step(self.algo.rounds[c], self.n, self.L,
+                             self._sid, self._seeds, self._state_tree())
+            self._steps[c] = step
+        return step
+
+    # -- probe / update ----------------------------------------------------
+
+    def _probe_go(self) -> Dict[int, np.ndarray]:
+        """Batched FoldRound go probes: ONE dispatch per round class that
+        has dirty waiting lanes — the per-receive probe of the reference
+        amortized across the lane axis."""
+        out: Dict[int, np.ndarray] = {}
+        for c in range(self.k):
+            step = self._steps[c]
+            if step is None or step.go is None:
+                continue
+            lanes = [l for l in np.nonzero(self._waiting & self._dirty)[0]
+                     if int(self._rr[l]) % self.k == c]
+            if not lanes:
+                continue
+            vals, mask = self._boxes[c].values_mask()
+            go = np.asarray(step.go(self._rr, self._sid, self._seeds,
+                                    self._state_tree(), vals, mask))
+            _C_GO_D.inc()
+            _C_DISPATCH.inc()
+            out[c] = go
+        return out
+
+    def _ready(self) -> Tuple[List[int], List[int]]:
+        """(ready lanes to update, oob lanes to finish) this tick; marks
+        timedout/expired per lane via self._lane_timedout."""
+        now = _time.monotonic()
+        go_by_class = self._probe_go()
+        ready: List[int] = []
+        oob: List[int] = []
+        self._lane_timedout: Dict[int, Tuple[bool, bool]] = {}
+        for lane in np.nonzero(self._waiting)[0]:
+            lane = int(lane)
+            if self._oob_done[lane]:
+                oob.append(lane)
+                continue
+            c = int(self._rr[lane]) % self.k
+            kind, strict, _millis = self._prog[c]
+            step = self._steps[c]
+            go = False
+            if self._dirty[lane]:
+                if step is not None and step.go is not None:
+                    g = go_by_class.get(c)
+                    go = bool(g[lane]) if g is not None else False
+                else:
+                    go = (self._boxes[c].count[lane]
+                          >= min(self.n, int(self._expected[lane])))
+                self._dirty[lane] = False
+            timedout = expired = False
+            if not go:
+                if kind == _P_GOAHEAD:
+                    go = True  # queued messages were delivered this tick
+                elif kind == _P_SYNC and int(
+                        (self._max_rnd[lane] >= self._rr[lane]).sum()
+                ) >= self._prog[c][2] + self.nbr_byzantine:
+                    go = True
+                elif (self._next_round[lane] > self._rr[lane] + 1
+                        and not strict):
+                    timedout = True  # genuine round skew: fast-forward
+                    _C_CATCHUP.inc()
+                    if TRACE.enabled:
+                        TRACE.emit(
+                            "catch_up", node=self.id,
+                            inst=int(self._inst[lane]) & 0xFFFF,
+                            round=int(self._rr[lane]),
+                            next_round=int(self._next_round[lane]))
+                elif now >= self._deadline[lane]:
+                    timedout = expired = True
+                    self.timeouts += 1
+                    _C_TIMEOUTS.inc()
+                    if TRACE.enabled:
+                        TRACE.emit(
+                            "timeout", node=self.id,
+                            inst=int(self._inst[lane]) & 0xFFFF,
+                            round=int(self._rr[lane]),
+                            kind=("deadline" if self._use_deadline[lane]
+                                  else "wait_cap"),
+                            heard=int(self._boxes[c].count[lane]))
+            if go or timedout:
+                ready.append(lane)
+                self._lane_timedout[lane] = (timedout, expired)
+        return ready, oob
+
+    def _update_wave(self, ready: List[int]) -> List[Tuple[int, bool]]:
+        """One mega-step update per round class with ready lanes; returns
+        [(lane, exited)]."""
+        out: List[Tuple[int, bool]] = []
+        for c in sorted({int(self._rr[l]) % self.k for l in ready}):
+            group = [l for l in ready if int(self._rr[l]) % self.k == c]
+            active = np.zeros((self.L,), dtype=bool)
+            active[group] = True
+            vals, mask = self._boxes[c].values_mask()
+            st, ex = self._step(c).update(
+                self._rr, self._sid, self._seeds, self._state_tree(),
+                vals, mask, active)
+            self._copy_back(st)
+            ex = np.asarray(ex)
+            _C_UPD_D.inc()
+            _C_DISPATCH.inc()
+            _H_IPD.observe(len(group))
+            for lane in group:
+                out.append((lane, bool(ex[lane])))
+        return out
+
+    # -- lane lifecycle ----------------------------------------------------
+
+    def _observe_adaptive(self, lane: int, expired: bool,
+                          timedout: bool) -> None:
+        if self.adaptive is None or not self._delegated[lane]:
+            return
+        if expired:
+            self.adaptive.observe(None, expired=True)
+        elif not timedout:
+            self.adaptive.observe(
+                (_time.monotonic() - self._t0[lane]) * 1000.0,
+                expired=False)
+
+    def _finish_lane(self, lane: int, decided: bool, decision,
+                     results: List[Optional[int]],
+                     checkpoint_dir: Optional[str],
+                     completed: set, instances: int) -> None:
+        inst = int(self._inst[lane])
+        iid = inst & 0xFFFF
+        raw = np.array(np.asarray(decision)) if decided else None
+        results[inst - 1] = decision_scalar(decision) if decided else None
+        self._done[iid] = raw
+        completed.add(inst)
+        self.table.retire(iid)
+        self._live[lane] = False
+        self._waiting[lane] = False
+        self._need_send[lane] = False
+        self._pending[lane] = {}
+        self._deadline[lane] = np.inf
+        _C_RETIRE.inc()
+        _G_OCC.set(self.table.occupancy)
+        if decided:
+            _C_DECISIONS.inc()
+        if TRACE.enabled:
+            TRACE.emit("decision", node=self.id, inst=iid,
+                       round=int(self._rr[lane]), decided=decided,
+                       value=(np.asarray(decision).tolist()
+                              if decided else None))
+            TRACE.emit("lane_retire", node=self.id, inst=iid, lane=lane,
+                       decided=decided)
+        if checkpoint_dir is not None:
+            step = 0
+            while (step + 1) in completed:
+                step += 1
+            _save_decision_checkpoint(checkpoint_dir, results, step,
+                                      instances)
+
+    # -- the serving loop --------------------------------------------------
+
+    def run(self, instances: int, checkpoint_dir: Optional[str] = None,
+            stats_out: Optional[Dict[str, int]] = None,
+            ) -> List[Optional[int]]:
+        """Run ``instances`` consecutive consensus instances (numbered
+        1..instances, the PerfTest2 schedule) with up to the lane width in
+        flight; returns the per-instance decision log like
+        run_instance_loop.  With ``checkpoint_dir``, the log is durably
+        checkpointed as instances complete and an existing checkpoint
+        RESUMES (completed instances are not re-run)."""
+        results: List[Optional[int]] = [None] * instances
+        completed: set = set()
+        next_admit = 1
+        if checkpoint_dir is not None:
+            from round_tpu.runtime import checkpoint as _ckpt
+
+            if _ckpt.exists(checkpoint_dir):
+                like = np.full(instances, _UNDECIDED, dtype=np.int64)
+                arr, step, meta = _ckpt.restore(checkpoint_dir, like)
+                if (meta.get("kind") != "host-decision-log"
+                        or meta.get("instances") != instances
+                        or not 0 <= int(step) <= instances):
+                    raise _ckpt.CheckpointError(
+                        f"checkpoint at {checkpoint_dir} is not a host "
+                        f"decision log for an {instances}-instance run: "
+                        f"meta={meta}, step={step}")
+                arr = np.asarray(arr)
+                vector = getattr(self.algo, "payload_bytes",
+                                 None) is not None
+                for i in range(1, instances + 1):
+                    v = int(arr[i - 1])
+                    if v != _UNDECIDED:
+                        # completed AND decided.  Scalar log values ARE
+                        # the raw decision, so laggard replies stay
+                        # adoptable across a resume; a vector algorithm's
+                        # log holds digests a peer could only discard —
+                        # store None (reply suppressed) instead
+                        results[i - 1] = v
+                        completed.add(i)
+                        self._done[i & 0xFFFF] = (
+                            None if vector else np.asarray(v))
+                    elif i <= int(step):
+                        # inside the contiguous prefix: completed but
+                        # undecided — do not re-run (the sequential loop's
+                        # restore semantics)
+                        completed.add(i)
+                        self._done[i & 0xFFFF] = None
+                log.info("node %d: resumed %d completed instance(s) from "
+                         "%s", self.id, len(completed), checkpoint_dir)
+        while len(completed) < instances:
+            while next_admit <= instances and self.table.can_admit():
+                if next_admit in completed:
+                    next_admit += 1
+                    continue
+                self._admit(next_admit)
+                next_admit += 1
+            self._send_wave()
+            now = _time.monotonic()
+            live_deadlines = self._deadline[self._waiting]
+            if live_deadlines.size:
+                wait_s = max(0.0, float(live_deadlines.min()) - now)
+                timeout_ms = int(min(wait_s * 1000.0, 50.0))
+            else:
+                timeout_ms = 0
+            self._drain(timeout_ms)
+            ready, oob = self._ready()
+            for lane in oob:
+                # oob adoption skips the update (the per-instance driver
+                # exits the accumulate loop without folding the mailbox)
+                self.rounds_run += 1
+                _C_ROUNDS.inc()
+                row = self._state_row(lane)
+                self._finish_lane(
+                    lane, True, np.asarray(self.algo.decision(row)),
+                    results, checkpoint_dir, completed, instances)
+            if not ready:
+                continue
+            exits = self._update_wave(ready)
+            finishing = []
+            for lane, exited in exits:
+                timedout, expired = self._lane_timedout.get(
+                    lane, (False, False))
+                self._observe_adaptive(lane, expired, timedout)
+                self.rounds_run += 1
+                _C_ROUNDS.inc()
+                r = int(self._rr[lane])
+                if TRACE.enabled:
+                    c = r % self.k
+                    TRACE.emit(
+                        "round_end", node=self.id,
+                        inst=int(self._inst[lane]) & 0xFFFF, round=r,
+                        heard=int(self._boxes[c].count[lane]), n=self.n,
+                        timedout=timedout, exited=exited,
+                        wall_ms=round(
+                            (_time.monotonic() - self._t0[lane]) * 1e3, 3))
+                if exited or r + 1 >= self.max_rounds:
+                    finishing.append(lane)
+                else:
+                    self._rr[lane] = r + 1
+                    self._max_rnd[lane, self.id] = r + 1
+                    self._next_round[lane] = max(
+                        int(self._next_round[lane]), r + 1)
+                    self._waiting[lane] = False
+                    self._need_send[lane] = True
+            if finishing:
+                dec_fn = self._decide_fn
+                if dec_fn is None:
+                    dec_fn = self._decide_fn = lane_decide(
+                        self.algo, self.L, self._state_tree())
+                decided_v, decision_v = dec_fn(self._state_tree())
+                decided_v = np.asarray(decided_v)
+                decision_v = np.asarray(decision_v)
+                for lane in finishing:
+                    self._finish_lane(
+                        lane, bool(decided_v[lane]), decision_v[lane],
+                        results, checkpoint_dir, completed, instances)
+        if stats_out is not None:
+            for key, v in (("timeouts", self.timeouts),
+                           ("rounds_run", self.rounds_run),
+                           ("malformed", self.malformed)):
+                stats_out[key] = stats_out.get(key, 0) + v
+            stats_out.setdefault("timeout_trajectory", []).extend(
+                self._trajectory)
+        return results
+
+
+def run_instance_loop_lanes(
+    algo: Algorithm,
+    my_id: int,
+    peers: Dict[int, Tuple[str, int]],
+    transport,
+    instances: int,
+    lanes: int = 16,
+    timeout_ms: int = 300,
+    seed: int = 0,
+    base_value: int = 0,
+    max_rounds: int = 32,
+    stats_out: Optional[Dict[str, int]] = None,
+    nbr_byzantine: int = 0,
+    value_schedule: str = "mixed",
+    adaptive: Optional[AdaptiveTimeout] = None,
+    checkpoint_dir: Optional[str] = None,
+    wire: str = "binary",
+) -> List[Optional[int]]:
+    """The lane-batched form of run_instance_loop: same schedule, same
+    seeds, same decision-log shape — the work just flows through one
+    vmapped mega-step per round class instead of one Python round loop per
+    instance (module docstring).  Cross-checkable against the per-instance
+    drivers byte-for-byte (tests/test_lanes.py)."""
+    driver = LaneDriver(
+        algo, my_id, peers, transport, lanes=lanes, timeout_ms=timeout_ms,
+        seed=seed, base_value=base_value, max_rounds=max_rounds,
+        nbr_byzantine=nbr_byzantine, value_schedule=value_schedule,
+        adaptive=adaptive, wire=wire,
+    )
+    return driver.run(instances, checkpoint_dir=checkpoint_dir,
+                      stats_out=stats_out)

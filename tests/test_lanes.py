@@ -1,0 +1,302 @@
+"""Lane-batched instance driver (runtime/lanes.py) — the equivalence suite.
+
+The lane driver's contract is BYTE-IDENTICAL per-instance decisions to the
+per-instance drivers for the same seeds (ISSUE 6 / ROADMAP item 1): both
+trace the same per-lane math (engine/executor.py make_host_round_fns), so
+any divergence is a driver bug, not protocol noise.  Pinned here:
+
+  * clean-run equality (OTR mixed schedule; LVE's FoldRound go probes and
+    LastVotingBytes' wide payloads under the uniform schedule, where the
+    decision is arrival-order-invariant by validity);
+  * framing-invariant chaos: a seeded FaultyTransport drop schedule yields
+    the SAME decision log from both drivers (faults are per logical frame —
+    lane packing must not change which frames fault);
+  * checkpoint/resume: a lane run resumed from a prefix checkpoint ends
+    byte-identical to a never-interrupted run;
+  * admission/retire churn: instances >> lanes recycle slots with NO
+    recompile (one compiled mega-step per (round class, bucket, n));
+  * decision recovery: a late-starting lane replica catches up through the
+    FLAG_DECISION replies (the TooLate path) instead of starving.
+
+The `-m perf` microbenchmark pins the point of the tentpole: one lane-axis
+mega-step dispatch is decisively cheaper than L per-instance dispatches.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from round_tpu.apps.selector import select
+from round_tpu.obs.metrics import METRICS
+from round_tpu.runtime.chaos import FaultPlan, FaultyTransport, alloc_ports
+from round_tpu.runtime.host import run_instance_loop
+from round_tpu.runtime.instances import LaneTable, lane_bucket
+from round_tpu.runtime.lanes import run_instance_loop_lanes
+from round_tpu.runtime.transport import HostTransport
+
+
+@functools.lru_cache(maxsize=None)
+def _algo(name: str, payload_bytes: int = 0):
+    """One Algorithm object per (name, payload) for the whole module: the
+    jitted round trios and lane mega-steps cache on its Round objects, so
+    later tests skip compilation entirely (the host_perftest discipline)."""
+    return select(name, {"payload_bytes": payload_bytes}
+                  if payload_bytes else {})
+
+
+def _cluster(driver, algo, n=3, instances=6, lanes=4, seed=7,
+             timeout_ms=2000, schedule="mixed", chaos=None,
+             checkpoint_dirs=None, start_delay=None, max_rounds=32):
+    """Run one in-thread cluster with the given driver ("seq" = the
+    per-instance sequential loop, "lanes" = the lane-batched driver) and
+    return {replica: decision log}.  Any replica error fails the test."""
+    ports = alloc_ports(n)
+    peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
+    results, errors = {}, {}
+
+    def node(i):
+        if start_delay and i in start_delay:
+            time.sleep(start_delay[i])
+        tr0 = HostTransport(i, peers[i][1])
+        tr = (FaultyTransport(tr0, FaultPlan.parse(chaos), n)
+              if chaos else tr0)
+        ck = checkpoint_dirs[i] if checkpoint_dirs else None
+        try:
+            if driver == "lanes":
+                results[i] = run_instance_loop_lanes(
+                    algo, i, peers, tr, instances, lanes=lanes,
+                    timeout_ms=timeout_ms, seed=seed,
+                    value_schedule=schedule, checkpoint_dir=ck,
+                    max_rounds=max_rounds)
+            else:
+                results[i] = run_instance_loop(
+                    algo, i, peers, tr, instances, timeout_ms=timeout_ms,
+                    seed=seed, value_schedule=schedule, checkpoint_dir=ck,
+                    max_rounds=max_rounds)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors[i] = e
+            raise
+        finally:
+            tr0.close()
+
+    threads = [threading.Thread(target=node, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not any(t.is_alive() for t in threads), "replica thread wedged"
+    assert not errors, errors
+    return results
+
+
+# ---------------------------------------------------------------------------
+# admission plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_lane_bucket_rounds_up_to_the_bucket_set():
+    assert lane_bucket(1) == 1
+    assert lane_bucket(3) == 4
+    assert lane_bucket(8) == 8
+    assert lane_bucket(9) == 16
+    assert lane_bucket(1000) == 1024
+    assert lane_bucket(4096) == 1024  # capped at the largest bucket
+    with pytest.raises(ValueError):
+        lane_bucket(0)
+
+
+def test_lane_table_admit_retire_churn():
+    t = LaneTable(3)  # pads to bucket 4
+    assert t.width == 4
+    assert [t.admit(i) for i in (10, 11, 12, 13)] == [0, 1, 2, 3]
+    assert not t.can_admit()
+    assert t.retire(11) == 1
+    assert t.retire(10) == 0
+    # lowest free slot first, deterministically, after arbitrary churn
+    assert t.admit(14) == 0
+    assert t.lane_of(14) == 0 and t.instance_of(1) is None
+    assert t.occupancy == 3
+    with pytest.raises(ValueError):
+        t.admit(14)  # already admitted
+    assert t.live_instances() == [12, 13, 14]
+
+
+# ---------------------------------------------------------------------------
+# equivalence: lane-batched == per-instance, byte for byte
+# ---------------------------------------------------------------------------
+
+
+def test_lanes_equivalence_otr_mixed_schedule():
+    algo = _algo("otr")
+    a = _cluster("seq", algo, instances=6)
+    b = _cluster("lanes", algo, instances=6, lanes=4)
+    assert a == b
+    assert all(d is not None for log in b.values() for d in log)
+
+
+def test_lanes_equivalence_foldround_go_probes():
+    # LastVotingEvent: the FoldRound per-receive go probe runs as a
+    # BATCHED lane dispatch — uniform schedule, where the decision is
+    # arrival-order-invariant (the probe can cross its threshold at
+    # different mailbox sizes in the two drivers; validity pins the value)
+    algo = _algo("lve")
+    a = _cluster("seq", algo, instances=3, schedule="uniform")
+    b = _cluster("lanes", algo, instances=3, lanes=3, schedule="uniform")
+    assert a == b
+    assert all(d is not None for log in b.values() for d in log)
+
+
+def test_lanes_equivalence_bytes_payload():
+    # LastVotingBytes: KB-regime payload vectors ride the lane mailboxes;
+    # logs store the blake2s digest, which must agree across replicas AND
+    # drivers.  timeout_ms paces the non-coordinator rounds (they hear
+    # nothing by design), so keep it small.
+    algo = _algo("lvb", payload_bytes=64)
+    a = _cluster("seq", algo, instances=3, timeout_ms=200)
+    b = _cluster("lanes", algo, instances=3, lanes=3, timeout_ms=200)
+    assert a == b
+    assert all(d is not None for log in b.values() for d in log)
+
+
+def test_lanes_equivalence_under_chaos_drop_schedule():
+    # seeded per-(seed,src,dst,round) drop schedule: the SAME logical
+    # frames fault in both drivers regardless of lane packing/coalescing
+    # (chaos applies per logical frame before batching), and under the
+    # uniform schedule the decision log is fault-invariant by validity —
+    # so the two drivers must produce the identical, fully-decided log
+    algo = _algo("otr")
+    kw = dict(instances=4, schedule="uniform", chaos="drop=0.12,seed=5",
+              timeout_ms=600)
+    a = _cluster("seq", algo, **kw)
+    b = _cluster("lanes", algo, lanes=4, **kw)
+    assert a == b
+    assert all(d is not None for log in b.values() for d in log)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint/resume
+# ---------------------------------------------------------------------------
+
+
+def test_lanes_checkpoint_resume_byte_identical(tmp_path):
+    from round_tpu.runtime.host import _save_decision_checkpoint
+
+    algo = _algo("otr")
+    instances = 6
+    # reference: a never-interrupted lane run (no checkpointing)
+    ref = _cluster("lanes", algo, instances=instances, schedule="uniform")
+    # crash model: every replica restarts owning only the first 3
+    # decisions — pre-seed the checkpoints with exactly that prefix
+    dirs = {i: str(tmp_path / f"ck{i}") for i in range(3)}
+    for i in range(3):
+        _save_decision_checkpoint(dirs[i], ref[i][:3], 3, instances)
+    out = _cluster("lanes", algo, instances=instances, schedule="uniform",
+                   checkpoint_dirs=dirs)
+    assert out == ref
+    assert all(d is not None for log in out.values() for d in log)
+
+
+# ---------------------------------------------------------------------------
+# churn, recompile guard, counters
+# ---------------------------------------------------------------------------
+
+
+def test_lane_admission_churn_no_recompile():
+    algo = _algo("otr")
+    snap0 = METRICS.snapshot(compact=True)["counters"]
+    b = _cluster("lanes", algo, instances=20, lanes=4)
+    a = _cluster("seq", algo, instances=20)
+    assert a == b
+    # every instance cycled through the 4-wide lane table...
+    snap = METRICS.snapshot(compact=True)["counters"]
+
+    def delta(name):
+        return snap.get(name, 0) - snap0.get(name, 0)
+
+    assert delta("lanes.admitted") == 3 * 20
+    assert delta("lanes.retired") == 3 * 20
+    assert delta("lanes.dispatches") > 0
+    # ...with ONE compiled mega-step per (round class, n, bucket): churn
+    # re-uses padded slots, it never re-traces
+    for rnd in algo.rounds:
+        keys = set(getattr(rnd, "_lane_jit", {}).keys())
+        assert keys == {(3, 4)}, keys
+
+
+def test_lanes_late_replica_adopts_decision_replies():
+    # a lane replica that starts late finds its peers' early instances
+    # already retired: its round-0 traffic must be answered with
+    # FLAG_DECISION replies (the TooLate path) that the lanes adopt
+    # out-of-band — byte-identical log, no starvation
+    algo = _algo("otr")
+    out = _cluster("lanes", algo, instances=6, lanes=2,
+                   schedule="uniform", timeout_ms=400,
+                   start_delay={2: 0.8})
+    vals = {tuple(log) for log in out.values()}
+    assert len(vals) == 1
+    assert all(d is not None for log in out.values() for d in log)
+
+
+# ---------------------------------------------------------------------------
+# the point of the tentpole, pinned: one mega-step dispatch beats L
+# per-instance dispatches (-m perf; slow keeps it out of tier-1)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.perf
+@pytest.mark.slow
+def test_megastep_dispatch_amortization():
+    import jax
+
+    from round_tpu.engine.executor import lane_step, make_host_round_fns
+
+    n, L = 4, 64
+    algo = select("otr")
+    rnd = algo.rounds[0]
+    sid = np.int32(0)
+    seeds = np.arange(L, dtype=np.uint32)
+    io = {"initial_value": np.int32(1)}
+    from round_tpu.core.rounds import RoundCtx
+
+    st_one = algo.make_init_state(
+        RoundCtx(id=np.int32(0), n=n, r=np.int32(0)), io)
+    leaves = [np.broadcast_to(np.asarray(x), (L,) + np.shape(x)).copy()
+              for x in jax.tree_util.tree_leaves(st_one)]
+    st_l = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(st_one), leaves)
+    step = lane_step(rnd, n, L, sid, seeds, st_l)
+    rr = np.zeros((L,), dtype=np.int32)
+    active = np.ones((L,), dtype=bool)
+
+    f_send, _u, _g = make_host_round_fns(rnd, n)
+    f_send = jax.jit(f_send)
+    st_np = jax.tree_util.tree_map(np.asarray, st_one)
+    jax.block_until_ready(f_send(np.int32(0), sid, np.uint32(1), st_np))
+
+    reps = 30
+
+    def timed(f):
+        best = np.inf
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _i in range(reps):
+                f()
+            best = min(best, (time.perf_counter() - t0) / reps)
+        return best
+
+    t_mega = timed(lambda: jax.block_until_ready(
+        step.send(rr, sid, seeds, st_l, active)))
+    t_one = timed(lambda: jax.block_until_ready(
+        f_send(np.int32(0), sid, np.uint32(1), st_np)))
+    per_instance_total = t_one * L
+    speedup = per_instance_total / t_mega
+    print(f"\nmega-step send dispatch: {t_mega*1e6:.0f} us for L={L} vs "
+          f"{t_one*1e6:.0f} us x {L} per-instance = {speedup:.1f}x")
+    # the amortization claim, with a wide noise margin: one lane dispatch
+    # must beat L per-instance dispatches by at least 4x
+    assert speedup > 4.0, (t_mega, t_one)

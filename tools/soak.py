@@ -525,21 +525,37 @@ def check_lint(rng, it):
     return cfg
 
 
-def check_host_perf(rng, it):
+def check_host_perf(rng, it, payload=False):
     """The host-perf rotation rung: the interleaved wire A/B
     (apps/host_perftest.measure_wire_ab — old pickle path vs the binary
     codec + coalescing + batched-receive path, apps/perf_ab.py pair
     discipline) banked into SOAK.jsonl.  Gate: new/old >= 1.0 — the
     rebuilt wire must never REGRESS decisions/sec; the trajectory of
     dps_binary across soak records is the drift monitor.  ~20-30 s
-    (thread mode, in-process; the jit compile is shared warmup)."""
+    (thread mode, in-process; the jit compile is shared warmup).
+
+    ``payload=True`` is the KB-scale variant: LastVotingBytes over 1 KiB
+    opaque payloads (apps/selector.py "lvb") — the wire-FRACTION regime
+    of PERF_MODEL.md, where codec + coalescing wins are largest, kept
+    honest by the same interleaved gate."""
     from round_tpu.apps.host_perftest import measure_wire_ab
 
-    res = measure_wire_ab(n=4, instances=20, timeout_ms=300, pairs=3,
-                          warmup=1)
+    if payload:
+        # timeout_ms=150: LastVoting's non-coordinator rounds END at the
+        # deadline by design (only the coord hears traffic in rounds
+        # 0/2), so the deadline IS the pace — 150 ms keeps the rung
+        # ~60 s without starving localhost delivery
+        res = measure_wire_ab(n=4, instances=8, algo="lvb",
+                              payload_bytes=1024, timeout_ms=150,
+                              pairs=3, warmup=1)
+    else:
+        res = measure_wire_ab(n=4, instances=20, timeout_ms=300, pairs=3,
+                              warmup=1)
     med_ratio = (res["extra"]["median_binary"]
                  / max(res["extra"]["median_pickle"], 1e-9))
     cfg = dict(kind="host-perf", it=it, ratio=res["value"],
+               algo="lvb" if payload else "otr",
+               payload_bytes=1024 if payload else 0,
                median_ratio=round(med_ratio, 3),
                dps_pickle=res["extra"]["dps_pickle"],
                dps_binary=res["extra"]["dps_binary"],
@@ -560,6 +576,44 @@ def check_host_perf(rng, it):
         return {**cfg, "fail": f"wire A/B regression: binary/pickle mean "
                                f"{res['value']} and median "
                                f"{round(med_ratio, 3)} both < 0.85"}
+    return cfg
+
+
+def check_host_lanes(rng, it):
+    """The host-lanes rotation rung: the interleaved DRIVER A/B
+    (apps/host_perftest.measure_lanes_ab — the per-instance sequential
+    loop vs the lane-batched mega-step driver, runtime/lanes.py) banked
+    into SOAK.jsonl with L, lane occupancy and per-arm decisions/sec.
+    Gate: lane-batched decisions/sec >= per-instance x margin — the lane
+    driver must never fall back under the baseline it exists to beat
+    (the full 2x acceptance ran at >= 64 concurrent instances in
+    processes mode; this rung is the fast thread-mode regression guard).
+    ~20-30 s in-process."""
+    from round_tpu.apps.host_perftest import measure_lanes_ab
+
+    res = measure_lanes_ab(n=4, instances=24, lanes=8, timeout_ms=300,
+                           pairs=3, warmup=1)
+    med_ratio = (res["extra"]["median_lanes"]
+                 / max(res["extra"]["median_per_instance"], 1e-9))
+    lanes_m = {k: v for k, v in
+               METRICS.snapshot(compact=True)["counters"].items()
+               if k.startswith("lanes.")}
+    cfg = dict(kind="host-lanes", it=it, ratio=res["value"],
+               median_ratio=round(med_ratio, 3),
+               lanes=res["extra"]["lanes"],
+               instances=res["extra"]["instances"],
+               dps_per_instance=res["extra"]["dps_per_instance"],
+               dps_lanes=res["extra"]["dps_lanes"],
+               samples_per_instance=res["extra"]["samples_per_instance"],
+               samples_lanes=res["extra"]["samples_lanes"],
+               lane_counters=lanes_m)
+    # same noise-margin discipline as the host-perf rung: the harness
+    # spread is +/-30-40% per arm at pairs=3, so gate on mean AND median
+    # both losing decisively before crying regression
+    if res["value"] < 1.0 and med_ratio < 1.0:
+        return {**cfg, "fail": f"driver A/B regression: lanes/per-instance "
+                               f"mean {res['value']} and median "
+                               f"{round(med_ratio, 3)} both < 1.0"}
     return cfg
 
 
@@ -629,7 +683,8 @@ def main():
                 check_lattice, check_tpc_kset, check_erb,
                 lambda r, i: check_otr_family(r, i, scale=True),
                 check_otr_flagship_shape, check_host_chaos, check_lint,
-                check_host_perf]
+                check_host_perf, check_host_lanes,
+                lambda r, i: check_host_perf(r, i, payload=True)]
     while time.monotonic() < t_end:
         check = rotation[it % len(rotation)]
         t0 = time.perf_counter()

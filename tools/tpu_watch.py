@@ -30,8 +30,16 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "TPU_WATCH.jsonl")
-PROBE_SRC = ("import jax, jax.numpy as jnp; "
-             "print(int(jax.device_get(jnp.arange(8).sum())))")
+# staged probe shared with the bench driver (bench.probe_src): on a hang
+# the killed child's partial stderr names the last stage reached (import /
+# backend-init / device-op), which the timeout log record banks — a bare
+# "TIMEOUT" taught us nothing about WHERE the tunnel wedged (the r03+
+# flagship `backend-unavailable` mystery).  ONE source for the marker
+# format: bench.py owns it, both tools parse it with the same helper.
+sys.path.insert(0, REPO)
+from bench import last_probe_stage, probe_src  # noqa: E402
+
+PROBE_SRC = probe_src()
 
 # persistent compilation cache: if the tunnel dies mid-session, a later
 # window can reuse any executable that finished compiling in an earlier one
@@ -72,9 +80,12 @@ def run(name, cmd, timeout):
             _os.killpg(proc.pid, _signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             pass
-        out, _ = proc.communicate()
+        out, err = proc.communicate()
         log({"step": name, "ok": False, "wall_s": round(timeout, 1),
              "err": "TIMEOUT (hang; process group killed)",
+             # where it wedged: the killed child's partial stderr carries
+             # the PROBE_STAGE markers (probe steps) / any worker output
+             "hang_stage": last_probe_stage(err),
              "out": (out or "")[-2000:]})
         return False, out or ""
 
