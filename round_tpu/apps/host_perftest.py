@@ -40,7 +40,7 @@ from round_tpu.runtime.transport import HostTransport  # noqa: E402
 
 def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
              errors=None, proto="tcp", stats=None, algo=None, rate=1,
-             adaptive_cap_ms=0, wire="binary", lanes=0):
+             adaptive_cap_ms=0, wire="binary", lanes=0, pump=True):
     tr = HostTransport(my_id, peers[my_id][1], proto=proto)
     # ONE algorithm object across instances: the jitted round functions
     # cache on its rounds, so instance 2+ skip compilation entirely.
@@ -67,7 +67,7 @@ def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
             results[my_id] = run_instance_loop_lanes(
                 algo, my_id, peers, tr, instances, lanes=lanes,
                 timeout_ms=timeout_ms, seed=seed, stats_out=node_stats,
-                adaptive=adaptive, wire=wire,
+                adaptive=adaptive, wire=wire, use_pump=pump,
             )
         elif rate > 1:
             # the in-flight window (PerfTest2 -rt): `rate` concurrent
@@ -81,7 +81,7 @@ def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
             results[my_id] = run_instance_loop(
                 algo, my_id, peers, tr, instances, timeout_ms=timeout_ms,
                 seed=seed, stats_out=node_stats, adaptive=adaptive,
-                wire=wire,
+                wire=wire, pump=pump,
             )
         if stats is not None:
             stats[my_id] = node_stats
@@ -138,7 +138,7 @@ def _algo_opts(payload_bytes):
 
 def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
             proto="tcp", rate=1, adaptive_cap_ms=0, wire="binary",
-            lanes=0, payload_bytes=0):
+            lanes=0, payload_bytes=0, pump=True):
     """Run `instances` consecutive consensus instances over `n` replicas
     (threads, each with its own transport+sockets — on a single-vCPU box
     the GIL interleaving beats process-per-replica; see measure_processes
@@ -165,7 +165,7 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
             target=run_node,
             args=(i, peers, algo, instances, timeout_ms, results, seed,
                   errors, proto, stats, shared_algo, rate, adaptive_cap_ms,
-                  wire, lanes),
+                  wire, lanes, pump),
         )
         for i in range(n)
     ]
@@ -199,6 +199,8 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
     if adaptive_cap_ms > 0:
         mode += f" adaptive(cap={adaptive_cap_ms}ms)"
     mode += f" wire={wire}"
+    if not pump:
+        mode += " pump=python"
     if payload_bytes > 0:
         mode += f" payload={payload_bytes}B"
     score = _score(results, instances, wall, n, algo, timeout_ms,
@@ -212,7 +214,7 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
 def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,
                       proto="tcp", adaptive_cap_ms=0, trace=None,
                       metrics_json=None, wire="binary", lanes=0, rate=1,
-                      payload_bytes=0):
+                      payload_bytes=0, pump=True):
     """One OS PROCESS per replica (the reference's exact shape: 4 JVMs on
     localhost) via the host_replica CLI's --instances loop: no shared GIL,
     true parallel replicas.  Returns the same result dict as measure().
@@ -240,6 +242,8 @@ def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,
         "--wire", wire,
         "--max-rounds", "32",  # same per-instance cap as measure()
     ]
+    if not pump:
+        base_argv += ["--no-pump"]
     if adaptive_cap_ms > 0:
         base_argv += ["--adaptive-timeout",
                       "--timeout-cap-ms", str(adaptive_cap_ms)]
@@ -305,6 +309,8 @@ def measure_processes(n=4, instances=100, algo="otr", timeout_ms=300,
     if adaptive_cap_ms > 0:
         mode += f" adaptive(cap={adaptive_cap_ms}ms)"
     mode += f" wire={wire}"
+    if not pump:
+        mode += " pump=python"
     if payload_bytes > 0:
         mode += f" payload={payload_bytes}B"
     result = _score(logs, instances, wall, n, algo, timeout_ms,
@@ -373,6 +379,60 @@ def measure_wire_ab(n=4, instances=20, algo="otr", timeout_ms=300,
                      else "thread-per-replica"
                      + (f" rate={rate}" if rate > 1 else "")),
             "payload_bytes": payload_bytes,
+        },
+    }
+
+
+def measure_pump_ab(n=4, instances=20, algo="otr", timeout_ms=300,
+                    proto="tcp", rate=1, lanes=0, pairs=9, warmup=1,
+                    processes=False, payload_bytes=0, seed=0):
+    """The NATIVE-ROUND-PUMP interleaved A/B (ISSUE 7 acceptance): arm A
+    is the Python pump (the per-message recv loop / 50 ms lane drain
+    tick), arm B the native pump (native/transport.cpp rt_pump_*: round
+    state machine in the transport event loop, one blocking wait + one
+    flush crossing per round wave).  Same binary wire, same schedules and
+    seeds in both arms — the A/B isolates the PUMP, i.e. the
+    GIL/scheduler-convoy share of the round wall that PERF_MODEL.md's
+    corrected roofline identified.  ``lanes`` > 1 runs both arms through
+    the lane-batched driver.  The ``host-pump`` soak rung banks this."""
+    from round_tpu.apps.perf_ab import interleaved_ab
+
+    def arm(pump):
+        def run():
+            kw = dict(n=n, instances=instances, algo=algo,
+                      timeout_ms=timeout_ms, proto=proto, lanes=lanes,
+                      payload_bytes=payload_bytes, pump=pump)
+            if processes:
+                res, _ = measure_processes(rate=rate, **kw)
+            else:
+                res, _ = measure(rate=rate, seed=seed, **kw)
+            return res["value"]
+        return run
+
+    ab = interleaved_ab(arm(False), arm(True), pairs=pairs, warmup=warmup)
+    return {
+        "metric": f"host_{algo}_n{n}_pump_ab_speedup",
+        "value": ab["ratio"],
+        "unit": "x (native-pump/python-pump decisions-per-sec)",
+        "extra": {
+            "dps_python_pump": ab["mean_a"],
+            "dps_native_pump": ab["mean_b"],
+            "median_python_pump": ab["median_a"],
+            "median_native_pump": ab["median_b"],
+            "samples_python_pump": ab["a"],
+            "samples_native_pump": ab["b"],
+            "pairs": pairs,
+            "warmup": warmup,
+            "instances": instances,
+            "lanes": lanes,
+            "rate": rate,
+            "n": n,
+            "timeout_ms": timeout_ms,
+            "payload_bytes": payload_bytes,
+            "mode": (("process-per-replica" if processes
+                      else "thread-per-replica")
+                     + (f" lanes={lanes}" if lanes > 1 else "")
+                     + (f" rate={rate}" if rate > 1 else "")),
         },
     }
 
@@ -487,6 +547,18 @@ def main(argv=None) -> int:
                     help="payload path: 'binary' (codec + per-peer frame "
                          "coalescing + batched receive, the hot path) or "
                          "'pickle' (the pre-rebuild baseline)")
+    ap.add_argument("--pump", dest="pump", action="store_true",
+                    default=True,
+                    help="use the NATIVE round pump when available "
+                         "(native/transport.cpp rt_pump_*; the default)")
+    ap.add_argument("--no-pump", dest="pump", action="store_false",
+                    help="pin the Python round pump (the --ab-pump "
+                         "baseline arm)")
+    ap.add_argument("--ab-pump", action="store_true",
+                    help="run the interleaved PUMP A/B (Python pump vs "
+                         "native pump, apps/perf_ab.py) and report the "
+                         "speedup instead of a single measurement; "
+                         "composes with --lanes and --rate")
     ap.add_argument("--ab-wire", action="store_true",
                     help="run the interleaved wire A/B (pickle vs binary, "
                          "apps/perf_ab.py) and report the speedup instead "
@@ -518,6 +590,15 @@ def main(argv=None) -> int:
         )
         print(json.dumps(result))
         return 0
+    if args.ab_pump:
+        result = measure_pump_ab(
+            n=args.n, instances=args.instances, algo=args.algo,
+            timeout_ms=args.timeout_ms, proto=args.proto, rate=args.rate,
+            lanes=args.lanes, pairs=args.ab_pairs,
+            processes=args.processes, payload_bytes=args.payload_bytes,
+        )
+        print(json.dumps(result))
+        return 0
     if args.ab_wire:
         result = measure_wire_ab(
             n=args.n, instances=args.instances, algo=args.algo,
@@ -534,7 +615,7 @@ def main(argv=None) -> int:
             adaptive_cap_ms=cap, trace=args.trace,
             metrics_json=args.metrics_json, wire=args.wire,
             lanes=args.lanes, rate=args.rate,
-            payload_bytes=args.payload_bytes,
+            payload_bytes=args.payload_bytes, pump=args.pump,
         )
     else:
         if args.trace:
@@ -547,7 +628,7 @@ def main(argv=None) -> int:
             n=args.n, instances=args.instances, algo=args.algo,
             timeout_ms=args.timeout_ms, proto=args.proto, rate=args.rate,
             adaptive_cap_ms=cap, wire=args.wire, lanes=args.lanes,
-            payload_bytes=args.payload_bytes,
+            payload_bytes=args.payload_bytes, pump=args.pump,
         )
         if args.trace:
             TRACE.dump_jsonl(args.trace)

@@ -169,6 +169,29 @@ def main(argv=None) -> int:
                          "path; 'pickle' = the pre-rebuild baseline "
                          "(receiving is always bilingual, so mixed "
                          "clusters interoperate)")
+    ap.add_argument("--pump", dest="pump", action="store_true",
+                    default=True,
+                    help="use the NATIVE round pump when available "
+                         "(native/transport.cpp rt_pump_*: the per-round "
+                         "receive state machine runs in the transport "
+                         "event loop, Python blocks in one wait per "
+                         "round) — the default; falls back to the Python "
+                         "pump automatically when the native surface is "
+                         "missing")
+    ap.add_argument("--no-pump", dest="pump", action="store_false",
+                    help="pin the Python round pump (the A/B baseline "
+                         "arm; also what chaos plans with receiver-side "
+                         "families and --trace select automatically)")
+    ap.add_argument("--switch-interval-ms", type=float, default=0.5,
+                    metavar="MS",
+                    help="sys.setswitchinterval for this replica process "
+                         "(default 0.5 ms; 0 keeps CPython's 5 ms "
+                         "default).  PERF_MODEL.md's host-wire roofline "
+                         "measured the default interval costing a full "
+                         "round of scheduler convoy per round on small "
+                         "boxes — the perf harness has set 0.5 ms since "
+                         "PR 5, and this flag gives DEPLOYED replicas "
+                         "the same behavior the A/Bs measure")
     ap.add_argument("--linger-ms", type=int, default=0, metavar="MS",
                     help="after the loop completes, keep answering peers' "
                          "traffic with decision replies until the wire is "
@@ -214,6 +237,12 @@ def main(argv=None) -> int:
             print(f"warning: ignoring config params not used by "
                   f"host_replica: {unknown}", file=sys.stderr)
     configure_from_args(args)
+
+    if args.switch_interval_ms > 0:
+        # scheduler hardening (PERF_MODEL.md): bound the GIL convoy the
+        # same way the perf harness does, so deployed replicas measure
+        # like the A/Bs.  Applied before any worker thread starts.
+        sys.setswitchinterval(args.switch_interval_ms / 1000.0)
 
     if args.trace or args.metrics_json:
         # dumped via atexit, not inline: both branches below and the
@@ -409,6 +438,7 @@ def main(argv=None) -> int:
                 value_schedule=args.value_schedule,
                 adaptive=adaptive, stats_out=stats,
                 checkpoint_dir=args.checkpoint_dir, wire=args.wire,
+                use_pump=args.pump,
             )
         elif args.rate > 1:
             if (not args.send_when_catching_up
@@ -440,7 +470,7 @@ def main(argv=None) -> int:
                 adaptive=adaptive, stats_out=stats,
                 checkpoint_dir=args.checkpoint_dir,
                 view=manager, view_schedule=view_schedule,
-                wire=args.wire,
+                wire=args.wire, pump=args.pump,
             )
         wall = time.perf_counter() - t0
         dump_decision_log(decisions)

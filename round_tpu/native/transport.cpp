@@ -53,8 +53,36 @@
 // (OpenSSL's SSL_VERIFY_NONE default) — TLS here provides channel privacy
 // and integrity, not peer authentication.
 
+// NATIVE ROUND PUMP (rt_pump_*): the per-round wire state machine, moved
+// out of Python.  PERF_MODEL.md's host-wire roofline showed rounds are
+// GIL/scheduler-convoy-bound — the wire work is ~2% of round wall, but
+// every received message used to wake a Python thread.  The pump runs the
+// RECEIVER side of a communication-closed round inside this event loop:
+// FLAG_BATCH containers are split here, payloads are matched against a
+// per-(lane, round-class) codec TEMPLATE (runtime/codec.py emits a fixed
+// byte layout per payload signature: every structural byte — tags, dtype
+// codes, dims, counts, dict keys — is static, only array data varies), and
+// matching frames memcpy their array leaves straight into the mailbox
+// buffers Python registered BY POINTER (the in-place [n, ...] / [L, n, ...]
+// arrays of runtime/host.py::_RoundMailbox / runtime/lanes.py::_ClassBox),
+// updating the shared arrival bitmask + count.  Python blocks in ONE call,
+// rt_pump_wait, which returns only when some lane crossed its progress
+// threshold, its (adaptive) deadline expired, round skew demands catch-up,
+// or non-fast-path traffic landed in the regular inbox (misc).  Frames the
+// fast path cannot prove safe — unknown instances, non-NORMAL flags,
+// template mismatches (legacy-pickle peers, byzantine garbage) — fall back
+// to the inbox for the bilingual Python path, so mixed clusters
+// interoperate and garbage tolerance is unchanged.  Symmetrically,
+// rt_pump_flush ships a whole send wave (encode-once scratch + per-peer
+// offset plan) with per-destination FLAG_BATCH coalescing in one ctypes
+// crossing.  Ownership discipline: Python writes a lane's mailbox buffers
+// only while the lane is DISARMED (reset/self-delivery/prefill before
+// rt_pump_arm, update after); while armed, all writes happen here under
+// the pump mutex — the two sides never race on the shared buffers.
+
 #include <algorithm>
 #include <arpa/inet.h>
+#include <atomic>
 #include <cerrno>
 #include <chrono>
 #include <condition_variable>
@@ -148,6 +176,232 @@ struct Msg {
   int from;
   uint64_t tag;
   std::vector<uint8_t> payload;
+};
+
+// ---------------------------------------------------------------------------
+// round pump (see the file-top comment)
+// ---------------------------------------------------------------------------
+
+constexpr uint8_t kFlagNormal = 0x00;
+constexpr uint8_t kFlagBatch = 0xB7;   // runtime/oob.py FLAG_BATCH
+
+// arm() flags
+constexpr uint32_t kPumpGrowth = 1;    // wake on every accepted frame
+                                       // (FoldRound go-probes, Sync
+                                       // barriers re-check in Python)
+constexpr uint32_t kPumpExtend = 2;    // progress extends the deadline
+                                       // (the WaitForMessage idle cap)
+constexpr uint32_t kPumpStrict = 4;    // no round-skew fast-forward
+
+// ready reason bits (rt_pump_wait reasons_out)
+constexpr uint8_t kReadyThresh = 1;    // count >= progress threshold
+constexpr uint8_t kReadyGrowth = 2;    // heard-set / attestation progress
+constexpr uint8_t kReadySkew = 4;      // next_round > round + 1
+constexpr uint8_t kReadyDeadline = 8;  // armed deadline expired
+constexpr uint8_t kReadyPoke = 16;     // rt_pump_poke (mux router nudge)
+
+// stats slots (shared u64[16] registered at enable; Python folds deltas
+// into the pump.* metrics vocabulary, docs/OBSERVABILITY.md)
+enum {
+  kStFast = 0,         // template-matched inserts that grew the heard set
+  kStDup = 1,          // duplicate overwrites (heard set unchanged)
+  kStPending = 2,      // future-round frames buffered natively
+  kStApplied = 3,      // buffered frames applied at arm
+  kStFallback = 4,     // frames handed to the inbox (template miss)
+  kStLate = 5,         // communication-closed-late drops
+  kStMalformed = 6,    // out-of-range sender drops
+  kStWaits = 7,        // rt_pump_wait calls
+  kStWakesReady = 8,   // waits returning with >= 1 ready lane
+  kStWakesMisc = 9,    // waits returning with inbox traffic
+  kStBatchSplit = 10,  // FLAG_BATCH containers split natively
+  kStBatchMalformed = 11,  // containers with a truncated tail
+};
+
+struct PumpHole {
+  uint32_t off, len, leaf;
+};
+
+struct PumpLeafDst {
+  uint8_t *base = nullptr;  // mailbox row base; slot = base + sender*nbytes
+  uint32_t nbytes = 0;
+};
+
+struct PumpSlot {
+  std::vector<uint8_t> tmpl;      // exemplar encoding; holes = array data
+  std::vector<PumpHole> holes;    // ascending, non-overlapping
+  std::vector<PumpLeafDst> leaves;
+  uint8_t *mask = nullptr;        // [n] bool, shared with Python
+  long long *count = nullptr;     // &count[lane], shared with Python
+};
+
+struct PumpLane {
+  int iid = -1;
+  bool open_ = false;
+  bool armed = false;
+  long long round_ = 0;
+  int cls = 0;
+  long long threshold = 0;        // 0 = never ready by count
+  uint32_t flags = 0;
+  uint8_t auto_disarm = 0;        // reasons that end the round: reporting
+                                  // one of these disarms atomically, so no
+                                  // frame can join the mailbox between the
+                                  // wait returning and the jitted update
+  bool has_deadline = false;
+  std::chrono::steady_clock::time_point deadline{};
+  int extend_ms = 0;
+  uint8_t ready = 0;
+  // future-round frames buffered raw per (round, sender); applied (with
+  // the full template check) when Python arms that round — the native
+  // form of the drivers' `_pending` dicts.  Bounded like the stash.
+  std::map<long long, std::map<int, std::vector<uint8_t>>> pending;
+  size_t pending_frames = 0;
+  std::vector<PumpSlot> slots;    // [k] round classes
+};
+
+constexpr size_t kPumpPendingCap = 4096;  // per lane
+
+struct Pump {
+  std::mutex mu;
+  std::condition_variable cv;
+  int L = 0, n = 0, k = 0, nbz = 0;
+  std::vector<PumpLane> lanes;
+  std::vector<int32_t> iid2lane;       // [65536], -1 = not mapped
+  long long *max_rnd = nullptr;        // [L, n] shared with Python
+  long long *next_round = nullptr;     // [L] shared with Python
+  unsigned long long *stats = nullptr; // [16] shared with Python
+  std::atomic<bool> misc{false};       // inbox gained a frame
+  std::atomic<bool> stopped{false};
+
+  void configure(int L_, int n_, int k_, int nbz_, long long *mr,
+                 long long *nr, unsigned long long *st) {
+    std::lock_guard<std::mutex> l(mu);
+    L = L_; n = n_; k = k_; nbz = nbz_;
+    max_rnd = mr; next_round = nr; stats = st;
+    lanes.assign(L, PumpLane{});
+    for (auto &ln : lanes) ln.slots.resize(k);
+    iid2lane.assign(1 << 16, -1);
+    misc.store(false);
+    stopped.store(false);
+  }
+
+  // template match + in-place leaf copy; 1 = heard set grew, 0 = duplicate
+  // overwrite, -1 = template mismatch (caller falls back to Python)
+  int slot_insert(PumpSlot &s, int from, const uint8_t *p, size_t len) {
+    if (s.tmpl.empty() || len != s.tmpl.size()) return -1;
+    size_t pos = 0;
+    for (const auto &h : s.holes) {
+      if (h.off > pos &&
+          std::memcmp(p + pos, s.tmpl.data() + pos, h.off - pos) != 0)
+        return -1;
+      pos = h.off + h.len;
+    }
+    if (pos < len &&
+        std::memcmp(p + pos, s.tmpl.data() + pos, len - pos) != 0)
+      return -1;
+    for (const auto &h : s.holes) {
+      const PumpLeafDst &lf = s.leaves[h.leaf];
+      std::memcpy(lf.base + static_cast<size_t>(from) * lf.nbytes,
+                  p + h.off, h.len);
+    }
+    if (!s.mask[from]) {
+      s.mask[from] = 1;
+      ++*s.count;
+      return 1;
+    }
+    return 0;
+  }
+
+  void recompute_next_round(int lane_i) {
+    long long *mr = max_rnd + static_cast<size_t>(lane_i) * n;
+    long long v;
+    if (nbz <= 0) {
+      v = mr[0];
+      for (int i = 1; i < n; ++i) v = std::max(v, mr[i]);
+    } else {
+      // byzantine catch-up: the (f+1)-th highest claim — f liars cannot
+      // drag the lane forward (InstanceHandler.scala:302-307)
+      std::vector<long long> row(mr, mr + n);
+      std::nth_element(row.begin(), row.begin() + (n - 1 - nbz), row.end());
+      v = row[n - 1 - nbz];
+    }
+    if (v > next_round[lane_i]) next_round[lane_i] = v;
+  }
+
+  // caller holds mu.  kind: 0 = wire (template miss -> inbox fallback,
+  // return false), 1 = feed from Python (template miss -> return -2, the
+  // caller decodes + re-encodes canonically + rt_pump_insert).
+  // Returns: 1 consumed, 0 not pump-routable (unknown iid / non-NORMAL),
+  // -2 template miss at the armed current round.
+  int route_locked(int from, uint64_t tagw, const uint8_t *p, size_t len) {
+    if ((tagw & 0xFF) != kFlagNormal) return 0;
+    int iid = static_cast<int>((tagw >> 16) & 0xFFFF);
+    long long r = static_cast<long long>((tagw >> 32) & 0xFFFFFFFFull);
+    int lane_i = iid2lane[iid];
+    if (lane_i < 0) return 0;  // unknown instance: stash/TooLate in Python
+    PumpLane &ln = lanes[lane_i];
+    if (from < 0 || from >= n) {
+      // protocol garbage on the unauthenticated socket: an out-of-range
+      // id would corrupt every sender-indexed structure
+      ++stats[kStMalformed];
+      return 1;
+    }
+    long long *mr = max_rnd + static_cast<size_t>(lane_i) * n;
+    if (r > mr[from]) mr[from] = r;
+    if (r < ln.round_) {
+      ++stats[kStLate];
+      return 1;  // late: the round is communication-closed
+    }
+    bool accepted = false;
+    uint8_t newly = 0;
+    if (r > ln.round_ || !ln.armed) {
+      auto &mp = ln.pending[r];
+      auto it = mp.find(from);
+      if (it != mp.end()) {
+        it->second.assign(p, p + len);  // latest-wins, like the dicts
+        accepted = true;
+      } else if (ln.pending_frames < kPumpPendingCap) {
+        mp.emplace(from, std::vector<uint8_t>(p, p + len));
+        ++ln.pending_frames;
+        accepted = true;
+      }
+      if (accepted) ++stats[kStPending];
+      if (r > ln.round_) {
+        recompute_next_round(lane_i);
+        if (ln.armed && !(ln.flags & kPumpStrict) &&
+            next_round[lane_i] > ln.round_ + 1)
+          newly |= kReadySkew;
+      }
+    } else {
+      int rc = slot_insert(ln.slots[ln.cls], from, p, len);
+      if (rc < 0) {
+        // the WIRE path counts kStFallback (deliver_one_locked) — not
+        // here, or the rt_pump_feed retry of the same frame would count
+        // it twice
+        return -2;
+      }
+      accepted = true;
+      if (rc == 1) {
+        ++stats[kStFast];
+        if (ln.threshold > 0 && *ln.slots[ln.cls].count >= ln.threshold)
+          newly |= kReadyThresh;
+      } else {
+        ++stats[kStDup];
+      }
+    }
+    if (accepted && ln.armed) {
+      if (ln.flags & kPumpGrowth) newly |= kReadyGrowth;
+      if ((ln.flags & kPumpExtend) && ln.extend_ms > 0) {
+        ln.deadline = std::chrono::steady_clock::now() +
+                      std::chrono::milliseconds(ln.extend_ms);
+        ln.has_deadline = true;
+      }
+    }
+    if (newly) {
+      ln.ready |= newly;
+      cv.notify_all();
+    }
+    return 1;
+  }
 };
 
 struct Conn {
@@ -247,8 +501,15 @@ struct Node {
                                   // blocked receiver threads can unwind
                                   // BEFORE the node is destroyed
 
+  // round pump: allocated once at first rt_pump_enable, torn down only in
+  // ~Node (the event loop reads `pump_on` without the node lock, so the
+  // object must outlive any loop iteration that observed it enabled)
+  Pump *pump = nullptr;
+  std::atomic<bool> pump_on{false};
+
   ~Node() {
     stop();
+    delete pump;
     if (ssl_ctx) tls_api().SSL_CTX_free(ssl_ctx);
   }
 
@@ -263,6 +524,10 @@ struct Node {
       recv_stopped = true;
     }
     inbox_cv.notify_all();
+    if (pump) {
+      pump->stopped.store(true);
+      pump->cv.notify_all();  // blocked rt_pump_wait callers unwind
+    }
     if (wake_pipe[1] >= 0) { uint8_t b = 0; (void)!write(wake_pipe[1], &b, 1); }
     if (loop.joinable()) loop.join();
     // close each fd under ITS write mutex without holding `mu` (senders
@@ -291,6 +556,74 @@ struct Node {
       inbox.push_back(std::move(m));
     }
     inbox_cv.notify_one();
+    if (pump_on.load(std::memory_order_acquire)) {
+      // misc traffic (decisions, foreign instances, template-miss
+      // fallbacks) must interrupt a blocked rt_pump_wait: the Python side
+      // drains the inbox on the misc flag
+      pump->misc.store(true);
+      pump->cv.notify_all();
+    }
+  }
+
+  // frame delivery: the pump fast path when enabled (FLAG_BATCH containers
+  // split HERE so sub-frames route without a Python wakeup), the plain
+  // inbox otherwise.  Runs on the event-loop thread.  pump_on is
+  // RE-CHECKED under the pump mutex: rt_pump_disable clears the flag and
+  // then takes/releases that mutex, so once disable returns no event-loop
+  // write can touch the Python-owned mailbox buffers (they are about to
+  // be freed) — without the re-check a thread that loaded pump_on just
+  // before the clear could still memcpy into freed memory.
+  void deliver(int from, uint64_t tag, const uint8_t *p, size_t len) {
+    if (pump_on.load(std::memory_order_acquire)) {
+      std::unique_lock<std::mutex> l(pump->mu);
+      if (pump_on.load(std::memory_order_relaxed)) {
+        if ((tag & 0xFF) == kFlagBatch) {
+          // sub-frame header: u64 tag | u32 len, little-endian
+          // (runtime/transport.py _BATCH_HDR) — memcpy is exact on
+          // x86-64
+          ++pump->stats[kStBatchSplit];
+          size_t off = 0;
+          while (off + 12 <= len) {
+            uint64_t sub;
+            uint32_t l2;
+            std::memcpy(&sub, p + off, 8);
+            std::memcpy(&l2, p + off + 8, 4);
+            off += 12;
+            if (off + l2 > len) {
+              ++pump->stats[kStBatchMalformed];
+              return;  // truncated container: keep the parseable prefix
+            }
+            deliver_one_locked(from, sub, p + off, l2);
+            off += l2;
+          }
+          if (off != len) ++pump->stats[kStBatchMalformed];
+          return;
+        }
+        deliver_one_locked(from, tag, p, len);
+        return;
+      }
+      // disabled while we waited for the mutex: fall through to the inbox
+    }
+    Msg m;
+    m.from = from;
+    m.tag = tag;
+    m.payload.assign(p, p + len);
+    enqueue(std::move(m));
+  }
+
+  // caller holds pump->mu
+  void deliver_one_locked(int from, uint64_t tag, const uint8_t *p,
+                          size_t len) {
+    int rc = pump->route_locked(from, tag, p, len);
+    if (rc == 1) return;
+    if (rc == -2) ++pump->stats[kStFallback];  // wire-path template miss
+    // non-NORMAL / unknown instance / template miss: the bilingual
+    // Python path owns it (enqueue sets the misc wake)
+    Msg m;
+    m.from = from;
+    m.tag = tag;
+    m.payload.assign(p, p + len);
+    enqueue(std::move(m));
   }
 
   // parse as many complete frames as rbuf holds; false = protocol
@@ -335,12 +668,8 @@ struct Node {
       // iterator math below overruns rbuf (advisor r02, medium)
       if (c.rbuf.size() - off < 4 + static_cast<size_t>(len)) break;
       if (len < 8) { off += 4 + len; continue; }  // malformed: skip frame
-      Msg m;
-      m.from = c.peer;
-      m.tag = get_u64(c.rbuf.data() + off + 4);
-      m.payload.assign(c.rbuf.begin() + off + 12,
-                       c.rbuf.begin() + off + 4 + len);
-      enqueue(std::move(m));
+      deliver(c.peer, get_u64(c.rbuf.data() + off + 4),
+              c.rbuf.data() + off + 12, len - 8);
       off += 4 + len;
     }
     if (off > 0) c.rbuf.erase(c.rbuf.begin(), c.rbuf.begin() + off);
@@ -373,11 +702,9 @@ struct Node {
                                MSG_DONTWAIT, nullptr, nullptr);
         if (got < 0) break;
         if (got < 12) continue;  // malformed datagram: drop
-        Msg m;
-        m.from = static_cast<int>(get_u32(tmp.data()));
-        m.tag = get_u64(tmp.data() + 4);
-        m.payload.assign(tmp.data() + 12, tmp.data() + got);
-        enqueue(std::move(m));
+        deliver(static_cast<int>(get_u32(tmp.data())),
+                get_u64(tmp.data() + 4), tmp.data() + 12,
+                static_cast<size_t>(got) - 12);
       }
     }
   }
@@ -917,6 +1244,511 @@ void rt_node_destroy(void *node) {
   auto *n = static_cast<Node *>(node);
   n->stop();
   delete n;
+}
+
+// ---------------------------------------------------------------------------
+// round pump API (see the file-top comment).  All pointers passed here are
+// Python-owned numpy buffers that MUST outlive the pump (the Python
+// wrapper, runtime/transport.py RoundPump, pins them).
+// ---------------------------------------------------------------------------
+
+// Enable (or reconfigure) the pump: L lanes, n processes, k round classes,
+// nbz byzantine tolerance for the catch-up rule.  max_rnd = int64[L*n],
+// next_round = int64[L], stats = u64[16].  Reconfiguring drops all lane
+// state; callers do it only between runs (no concurrent waiters).
+int rt_pump_enable(void *node, int L, int n, int k, int nbz,
+                   long long *max_rnd, long long *next_round,
+                   unsigned long long *stats) {
+  auto *nd = static_cast<Node *>(node);
+  if (L <= 0 || n <= 0 || k <= 0 || nbz < 0 || nbz >= n) return -1;
+  nd->pump_on.store(false, std::memory_order_release);
+  if (!nd->pump) nd->pump = new Pump();
+  nd->pump->configure(L, n, k, nbz, max_rnd, next_round, stats);
+  {
+    // frames that arrived BEFORE the pump existed are sitting in the
+    // inbox with no misc flag: seed it, or the first armed round would
+    // burn its whole deadline blind to them (observed: exactly one
+    // burned deadline per replica in process mode, where peers start
+    // seconds apart and the early ones' traffic predates the enable)
+    std::lock_guard<std::mutex> l(nd->inbox_mu);
+    if (!nd->inbox.empty()) nd->pump->misc.store(true);
+  }
+  nd->pump_on.store(true, std::memory_order_release);
+  return 0;
+}
+
+// Disable the fast path: frames flow to the inbox again.  Lane state and
+// registered buffers are retired (a later enable reconfigures).  The
+// mutex acquisition after the clear FENCES in-flight deliveries: the
+// event loop re-checks pump_on under the same mutex, so once this
+// returns no native write can touch the (about to be freed) Python
+// mailbox buffers.
+void rt_pump_disable(void *node) {
+  auto *nd = static_cast<Node *>(node);
+  nd->pump_on.store(false, std::memory_order_release);
+  if (nd->pump) {
+    { std::lock_guard<std::mutex> l(nd->pump->mu); }
+    nd->pump->cv.notify_all();
+  }
+}
+
+// Register one (lane, class) slot: the payload TEMPLATE (tlen bytes, the
+// codec encoding of the class's exemplar payload), its holes (packed
+// u32 off | u32 len | u32 leaf, ascending), the leaf destinations (packed
+// u64 base_ptr | u32 nbytes), and the lane's shared mask/count.  Returns
+// 0, or -1 on a malformed registration (overlapping/oversized holes,
+// hole/leaf size mismatch).
+int rt_pump_set_class(void *node, int lane, int cls, const uint8_t *tmpl,
+                      int tlen, const uint8_t *holes, int nholes,
+                      const uint8_t *leaves, int nleaves, uint8_t *mask,
+                      long long *count) {
+  auto *nd = static_cast<Node *>(node);
+  Pump *P = nd->pump;
+  if (!P) return -1;
+  std::lock_guard<std::mutex> l(P->mu);
+  if (lane < 0 || lane >= P->L || cls < 0 || cls >= P->k || tlen < 0)
+    return -1;
+  PumpSlot s;
+  s.tmpl.assign(tmpl, tmpl + tlen);
+  s.leaves.resize(nleaves);
+  for (int i = 0; i < nleaves; ++i) {
+    uint64_t base;
+    uint32_t nb;
+    std::memcpy(&base, leaves + i * 12, 8);
+    std::memcpy(&nb, leaves + i * 12 + 8, 4);
+    s.leaves[i].base = reinterpret_cast<uint8_t *>(base);
+    s.leaves[i].nbytes = nb;
+  }
+  uint32_t prev_end = 0;
+  s.holes.resize(nholes);
+  for (int i = 0; i < nholes; ++i) {
+    PumpHole h;
+    std::memcpy(&h.off, holes + i * 12, 4);
+    std::memcpy(&h.len, holes + i * 12 + 4, 4);
+    std::memcpy(&h.leaf, holes + i * 12 + 8, 4);
+    if (h.off < prev_end ||
+        static_cast<uint64_t>(h.off) + h.len > static_cast<uint64_t>(tlen) ||
+        h.leaf >= static_cast<uint32_t>(nleaves) ||
+        h.len != s.leaves[h.leaf].nbytes)
+      return -1;
+    prev_end = h.off + h.len;
+    s.holes[i] = h;
+  }
+  s.mask = mask;
+  s.count = count;
+  P->lanes[lane].slots[cls] = std::move(s);
+  return 0;
+}
+
+// Map instance id -> lane.  Python resets the shared max_rnd/next_round
+// rows BEFORE opening; pending/ready state is cleared here.
+int rt_pump_open_lane(void *node, int lane, int iid) {
+  auto *nd = static_cast<Node *>(node);
+  Pump *P = nd->pump;
+  if (!P) return -1;
+  std::lock_guard<std::mutex> l(P->mu);
+  if (lane < 0 || lane >= P->L || iid < 0 || iid >= (1 << 16)) return -1;
+  PumpLane &ln = P->lanes[lane];
+  if (ln.iid >= 0 && P->iid2lane[ln.iid] == lane) P->iid2lane[ln.iid] = -1;
+  ln.iid = iid;
+  ln.open_ = true;
+  ln.armed = false;
+  ln.round_ = 0;
+  ln.ready = 0;
+  ln.has_deadline = false;
+  ln.pending.clear();
+  ln.pending_frames = 0;
+  P->iid2lane[iid] = lane;
+  return 0;
+}
+
+// Retire the lane: its instance's frames flow to the inbox again (the
+// TooLate decision-reply path in Python).
+void rt_pump_close_lane(void *node, int lane) {
+  auto *nd = static_cast<Node *>(node);
+  Pump *P = nd->pump;
+  if (!P) return;
+  std::lock_guard<std::mutex> l(P->mu);
+  if (lane < 0 || lane >= P->L) return;
+  PumpLane &ln = P->lanes[lane];
+  if (ln.iid >= 0 && P->iid2lane[ln.iid] == lane) P->iid2lane[ln.iid] = -1;
+  ln.iid = -1;
+  ln.open_ = false;
+  ln.armed = false;
+  ln.ready = 0;
+  ln.has_deadline = false;
+  ln.pending.clear();
+  ln.pending_frames = 0;
+}
+
+namespace {
+
+// caller holds P->mu.  The arm transition: adopt the round, apply the
+// natively-buffered pending frames (full template check — a mismatch goes
+// to the inbox for the bilingual Python path), then evaluate readiness.
+void pump_arm_locked(Node *nd, Pump *P, int lane, long long round, int cls,
+                     long long threshold, uint32_t flags, int deadline_ms,
+                     int extend_ms, uint8_t auto_disarm) {
+  PumpLane &ln = P->lanes[lane];
+  ln.round_ = round;
+  ln.cls = cls;
+  ln.threshold = threshold;
+  ln.flags = flags;
+  ln.auto_disarm = auto_disarm;
+  ln.extend_ms = extend_ms;
+  ln.ready = 0;
+  ln.armed = true;
+  if (deadline_ms > 0) {
+    ln.deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(deadline_ms);
+    ln.has_deadline = true;
+  } else {
+    ln.has_deadline = false;
+  }
+  // garbage-collect pending rounds the lane has moved past
+  while (!ln.pending.empty() && ln.pending.begin()->first < round) {
+    ln.pending_frames -= ln.pending.begin()->second.size();
+    ln.pending.erase(ln.pending.begin());
+  }
+  auto it = ln.pending.find(round);
+  if (it != ln.pending.end()) {
+    for (auto &kv : it->second) {
+      ++P->stats[kStApplied];
+      int rc = P->slot_insert(ln.slots[cls], kv.first, kv.second.data(),
+                              kv.second.size());
+      if (rc == 1) {
+        ++P->stats[kStFast];
+      } else if (rc == 0) {
+        ++P->stats[kStDup];
+      } else {
+        // legacy-pickle / structurally-alien payload: Python decodes it
+        ++P->stats[kStFallback];
+        Msg m;
+        m.from = kv.first;
+        m.tag = (static_cast<uint64_t>(round) << 32) |
+                (static_cast<uint64_t>(ln.iid & 0xFFFF) << 16);
+        m.payload = std::move(kv.second);
+        nd->enqueue(std::move(m));
+      }
+    }
+    ln.pending_frames -= it->second.size();
+    ln.pending.erase(it);
+  }
+  uint8_t newly = 0;
+  if (ln.threshold > 0 && ln.slots[cls].count &&
+      *ln.slots[cls].count >= ln.threshold)
+    newly |= kReadyThresh;
+  if (!(flags & kPumpStrict) && P->next_round[lane] > round + 1)
+    newly |= kReadySkew;
+  if (newly) {
+    ln.ready |= newly;
+    P->cv.notify_all();
+  }
+}
+
+}  // namespace
+
+// Arm one lane for (round, cls).  Python has already reset the mailbox
+// row, inserted self-delivery/prefill, and set count accordingly.
+int rt_pump_arm(void *node, int lane, long long round, int cls,
+                long long threshold, uint32_t flags, int deadline_ms,
+                int extend_ms, uint8_t auto_disarm) {
+  auto *nd = static_cast<Node *>(node);
+  Pump *P = nd->pump;
+  if (!P) return -1;
+  std::lock_guard<std::mutex> l(P->mu);
+  if (lane < 0 || lane >= P->L || cls < 0 || cls >= P->k) return -1;
+  pump_arm_locked(nd, P, lane, round, cls, threshold, flags, deadline_ms,
+                  extend_ms, auto_disarm);
+  return 0;
+}
+
+// Batched arm: one ctypes crossing per send WAVE (the lane driver arms up
+// to L lanes per wave).  specs = packed records of
+//   i32 lane | i32 round | i32 cls | i64 threshold | u32 flags |
+//   i32 deadline_ms | i32 extend_ms | u8 auto_disarm        (33 bytes)
+int rt_pump_arm_many(void *node, const uint8_t *specs, int count) {
+  auto *nd = static_cast<Node *>(node);
+  Pump *P = nd->pump;
+  if (!P) return -1;
+  std::lock_guard<std::mutex> l(P->mu);
+  for (int i = 0; i < count; ++i) {
+    const uint8_t *p = specs + static_cast<size_t>(i) * 33;
+    int32_t lane, round32, cls, dl, ext;
+    int64_t thr;
+    uint32_t flags;
+    uint8_t ad;
+    std::memcpy(&lane, p, 4);
+    std::memcpy(&round32, p + 4, 4);
+    std::memcpy(&cls, p + 8, 4);
+    std::memcpy(&thr, p + 12, 8);
+    std::memcpy(&flags, p + 20, 4);
+    std::memcpy(&dl, p + 24, 4);
+    std::memcpy(&ext, p + 28, 4);
+    ad = p[32];
+    if (lane < 0 || lane >= P->L || cls < 0 || cls >= P->k) return -1;
+    pump_arm_locked(nd, P, lane, round32, cls, thr, flags, dl, ext, ad);
+  }
+  return 0;
+}
+
+// Disarm: after this returns, the event loop buffers the lane's frames as
+// pending instead of writing its mailbox — Python may read/reset freely.
+void rt_pump_disarm(void *node, int lane) {
+  auto *nd = static_cast<Node *>(node);
+  Pump *P = nd->pump;
+  if (!P) return;
+  std::lock_guard<std::mutex> l(P->mu);
+  if (lane < 0 || lane >= P->L) return;
+  P->lanes[lane].armed = false;
+  P->lanes[lane].ready = 0;
+  P->lanes[lane].has_deadline = false;
+}
+
+// THE blocking wait: returns when >= 1 lane is ready (reasons_out[lane]
+// gets the reason bits, which are consumed; auto_disarm reasons disarm
+// atomically), when misc inbox traffic arrived (*misc_out = 1, flag
+// consumed), on timeout (0 with *misc_out = 0), or -3 once the node
+// stopped.  Lane deadlines are evaluated HERE against steady_clock — no
+// Python-side polling tick exists in pump mode.
+int rt_pump_wait(void *node, uint8_t *reasons_out, int timeout_ms,
+                 int *misc_out) {
+  auto *nd = static_cast<Node *>(node);
+  Pump *P = nd->pump;
+  *misc_out = 0;
+  if (!P) return -1;
+  auto t_end = std::chrono::steady_clock::now() +
+               std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
+  std::unique_lock<std::mutex> l(P->mu);
+  ++P->stats[kStWaits];
+  for (;;) {
+    if (P->stopped.load()) return -3;
+    auto now = std::chrono::steady_clock::now();
+    bool have_dl = false;
+    std::chrono::steady_clock::time_point min_dl{};
+    for (int i = 0; i < P->L; ++i) {
+      PumpLane &ln = P->lanes[i];
+      if (!ln.armed || !ln.has_deadline) continue;
+      if (now >= ln.deadline) {
+        ln.ready |= kReadyDeadline;
+        ln.has_deadline = false;  // report an expiry exactly once
+      } else if (!have_dl || ln.deadline < min_dl) {
+        min_dl = ln.deadline;
+        have_dl = true;
+      }
+    }
+    int nready = 0;
+    for (int i = 0; i < P->L; ++i)
+      if (P->lanes[i].ready) ++nready;
+    bool misc = P->misc.load();
+    if (nready > 0 || misc) {
+      for (int i = 0; i < P->L; ++i) {
+        PumpLane &ln = P->lanes[i];
+        reasons_out[i] = ln.ready;
+        if (ln.ready) {
+          if (ln.ready & ln.auto_disarm) {
+            ln.armed = false;
+            ln.has_deadline = false;
+          }
+          ln.ready = 0;
+        }
+      }
+      if (misc) {
+        P->misc.store(false);
+        *misc_out = 1;
+        ++P->stats[kStWakesMisc];
+      }
+      if (nready) ++P->stats[kStWakesReady];
+      return nready;
+    }
+    if (now >= t_end) {
+      std::memset(reasons_out, 0, P->L);
+      return 0;
+    }
+    auto wake_t = t_end;
+    if (have_dl && min_dl < wake_t) wake_t = min_dl;
+    P->cv.wait_until(l, wake_t);
+  }
+}
+
+// Single-lane wait (per-instance runners multiplexed over one transport):
+// returns the lane's reason bits (consumed; auto_disarm honored), 0 on
+// timeout, -3 once stopped.  Does NOT consume the misc flag — a router
+// thread owns the inbox in that deployment and pokes lanes explicitly.
+int rt_pump_wait_lane(void *node, int lane, int timeout_ms) {
+  auto *nd = static_cast<Node *>(node);
+  Pump *P = nd->pump;
+  if (!P || lane < 0 || lane >= P->L) return -1;
+  auto t_end = std::chrono::steady_clock::now() +
+               std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
+  std::unique_lock<std::mutex> l(P->mu);
+  PumpLane &ln = P->lanes[lane];
+  for (;;) {
+    if (P->stopped.load()) return -3;
+    auto now = std::chrono::steady_clock::now();
+    if (ln.armed && ln.has_deadline && now >= ln.deadline) {
+      ln.ready |= kReadyDeadline;
+      ln.has_deadline = false;
+    }
+    if (ln.ready) {
+      int r = ln.ready;
+      if (ln.ready & ln.auto_disarm) {
+        ln.armed = false;
+        ln.has_deadline = false;
+      }
+      ln.ready = 0;
+      return r;
+    }
+    if (now >= t_end) return 0;
+    auto wake_t = t_end;
+    if (ln.armed && ln.has_deadline && ln.deadline < wake_t)
+      wake_t = ln.deadline;
+    P->cv.wait_until(l, wake_t);
+  }
+}
+
+// Nudge one lane's waiter (kReadyPoke): the mux router thread queued
+// out-of-band traffic for that lane's runner.
+void rt_pump_poke(void *node, int lane) {
+  auto *nd = static_cast<Node *>(node);
+  Pump *P = nd->pump;
+  if (!P) return;
+  std::lock_guard<std::mutex> l(P->mu);
+  if (lane < 0 || lane >= P->L) return;
+  P->lanes[lane].ready |= kReadyPoke;
+  P->cv.notify_all();
+}
+
+// Feed one frame from Python (stash replay at admission, inbox-fallback
+// re-routing): the same state machine as the wire path, but a template
+// miss at the armed current round returns -2 instead of re-queuing to the
+// inbox (the caller decodes and uses rt_pump_insert).  Returns 1 consumed,
+// 0 not pump-routable, -2 template miss.
+int rt_pump_feed(void *node, int from, uint64_t tag, const uint8_t *buf,
+                 int len) {
+  auto *nd = static_cast<Node *>(node);
+  Pump *P = nd->pump;
+  if (!P) return 0;
+  std::lock_guard<std::mutex> l(P->mu);
+  return P->route_locked(from, tag, buf, len);
+}
+
+// Canonical insert under the pump lock (the Python fallback path after
+// decoding a legacy/pickle payload and re-encoding it in slot dtypes):
+// 1 = grew, 0 = duplicate overwrite, -1 = template mismatch (structurally
+// alien payload — the caller marks the sender malformed).
+int rt_pump_insert(void *node, int lane, int sender, const uint8_t *buf,
+                   int len) {
+  auto *nd = static_cast<Node *>(node);
+  Pump *P = nd->pump;
+  if (!P) return -1;
+  std::lock_guard<std::mutex> l(P->mu);
+  if (lane < 0 || lane >= P->L || sender < 0 || sender >= P->n) return -1;
+  PumpLane &ln = P->lanes[lane];
+  int rc = P->slot_insert(ln.slots[ln.cls], sender, buf,
+                          static_cast<size_t>(len));
+  if (rc < 0) return -1;
+  uint8_t newly = 0;
+  if (rc == 1 && ln.armed) {
+    ++P->stats[kStFast];
+    if (ln.threshold > 0 && *ln.slots[ln.cls].count >= ln.threshold)
+      newly |= kReadyThresh;
+  } else if (rc == 0) {
+    ++P->stats[kStDup];  // host.recvs parity: banked like wire dups
+  }
+  if (ln.armed && (ln.flags & kPumpGrowth)) newly |= kReadyGrowth;
+  if (newly) {
+    ln.ready |= newly;
+    P->cv.notify_all();
+  }
+  return rc;
+}
+
+// Structural-garbage semantics of the Python mailboxes: clear the
+// sender's heard bit, zero its slots (a half-written slot must not leak).
+void rt_pump_mark_malformed(void *node, int lane, int sender) {
+  auto *nd = static_cast<Node *>(node);
+  Pump *P = nd->pump;
+  if (!P) return;
+  std::lock_guard<std::mutex> l(P->mu);
+  if (lane < 0 || lane >= P->L || sender < 0 || sender >= P->n) return;
+  PumpLane &ln = P->lanes[lane];
+  PumpSlot &s = ln.slots[ln.cls];
+  if (s.mask && s.mask[sender]) {
+    s.mask[sender] = 0;
+    --*s.count;
+  }
+  for (const auto &lf : s.leaves)
+    std::memset(lf.base + static_cast<size_t>(sender) * lf.nbytes, 0,
+                lf.nbytes);
+}
+
+// Ship one send WAVE in a single ctypes crossing: entries reference the
+// encode-once scratch (base) as packed records
+//   i32 dest | u64 tag | u32 off | u32 len                   (20 bytes)
+// and coalesce per destination into FLAG_BATCH containers (byte-identical
+// framing to runtime/transport.py send_buffered/flush: one entry ships
+// PLAIN, containers carry the frame count in the tag's round field,
+// batch_cap bounds a container).  stats_out u64[5] gets
+// {frames, payload_bytes, batches, batch_frames, batch_bytes} for the
+// Python-side wire.* counters.  Returns logical frames sent.
+int rt_pump_flush(void *node, const uint8_t *base, const uint8_t *entries,
+                  int count, int batch_cap,
+                  unsigned long long *stats_out) {
+  auto *nd = static_cast<Node *>(node);
+  for (int i = 0; i < 5; ++i) stats_out[i] = 0;
+  // dest -> accumulated `u64 tag | u32 len | payload` entries + count
+  std::map<int, std::pair<std::vector<uint8_t>, int>> out;
+  auto flush_one = [&](int dest, std::pair<std::vector<uint8_t>, int> &e) {
+    std::vector<uint8_t> &buf = e.first;
+    int cnt = e.second;
+    if (cnt <= 0) return;
+    if (cnt == 1) {
+      uint64_t subtag;
+      uint32_t ln;
+      std::memcpy(&subtag, buf.data(), 8);
+      std::memcpy(&ln, buf.data() + 8, 4);
+      if (nd->send_msg(dest, subtag, buf.data() + 12, ln)) {
+        stats_out[0] += 1;
+        stats_out[1] += ln;
+      }
+    } else {
+      uint64_t tag = (static_cast<uint64_t>(cnt) << 32) |
+                     static_cast<uint64_t>(kFlagBatch);
+      if (nd->send_msg(dest, tag, buf.data(),
+                       static_cast<int>(buf.size()))) {
+        stats_out[0] += cnt;
+        stats_out[1] += buf.size() - 12ull * cnt;
+        stats_out[2] += 1;
+        stats_out[3] += cnt;
+        stats_out[4] += buf.size();
+      }
+    }
+    buf.clear();
+    e.second = 0;
+  };
+  for (int i = 0; i < count; ++i) {
+    const uint8_t *p = entries + static_cast<size_t>(i) * 20;
+    int32_t dest;
+    uint64_t tag;
+    uint32_t off, len;
+    std::memcpy(&dest, p, 4);
+    std::memcpy(&tag, p + 4, 8);
+    std::memcpy(&off, p + 12, 4);
+    std::memcpy(&len, p + 16, 4);
+    auto &e = out[dest];
+    if (e.second > 0 &&
+        e.first.size() + 12ull + len > static_cast<uint64_t>(batch_cap))
+      flush_one(dest, e);
+    size_t at = e.first.size();
+    e.first.resize(at + 12 + len);
+    std::memcpy(e.first.data() + at, &tag, 8);
+    std::memcpy(e.first.data() + at + 8, &len, 4);
+    std::memcpy(e.first.data() + at + 12, base + off, len);
+    e.second += 1;
+  }
+  for (auto &kv : out) flush_one(kv.first, kv.second);
+  return static_cast<int>(stats_out[0]);
 }
 
 }  // extern "C"

@@ -222,6 +222,22 @@ class FaultyTransport:
     def reconnects(self):
         return self.inner.reconnects
 
+    def enable_pump(self, L, n, k, nbz=0):
+        """Native-round-pump pass-through: the pump RECEIVE path is safe
+        under any plan whose families are all sender-side (drop, crash,
+        partition, dup, truncate, garbage apply in send/send_buffered
+        before the wire, so the native receiver sees exactly the faulted
+        frame stream).  The receiver-side hold/release families (delay,
+        reorder) live in THIS wrapper's recv() — frames the native pump
+        ingests would bypass them — so such plans refuse the pump and the
+        drivers keep the Python pump.  The pump SEND path is never
+        offered here (no ``pump_send_ok``): sends must keep flowing
+        through send_buffered so faults stay per logical frame."""
+        if self.plan.delay > 0 or self.plan.reorder > 0:
+            return None
+        f = getattr(self.inner, "enable_pump", None)
+        return None if f is None else f(L, n, k, nbz)
+
     def rewire(self, peers, my_id=None):
         """View-change pass-through (runtime/view.py): the live peer table
         swap happens on the inner transport; the fault schedules COMPOSE

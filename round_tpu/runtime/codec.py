@@ -349,6 +349,97 @@ def _decode_array(mv: memoryview, off: int):
 
 
 # ---------------------------------------------------------------------------
+# fixed-layout templates (the native round pump's parse contract)
+# ---------------------------------------------------------------------------
+
+
+def array_layout(obj):
+    """The native-pump template for a payload exemplar: (template_bytes,
+    holes) where holes = [(offset, nbytes, flat_leaf_index), ...] in
+    template order, or None when the payload is outside the closed
+    hot-path vocabulary (dict-with-str-keys / tuple / list containers
+    over ndarray leaves — exactly what the jitted send produces after
+    ``tree_map(np.asarray, ...)``).
+
+    The contract this encodes (and tests/test_codec.py pins): for a FIXED
+    payload signature, ``encode_into`` emits a FIXED byte layout — every
+    structural byte (node tags, dtype codes, ndim, dims, counts, dict
+    keys) is static, and only the raw array data (the holes) varies.  The
+    C parser (native/transport.cpp rt_pump_set_class) therefore validates
+    a frame by memcmp of the static regions and ingests it by memcpy of
+    the holes into the mailbox slot — one comparison + one copy replace
+    the whole Python decode + tree-flatten + astype path.  ``flat_leaf_
+    index`` maps each hole to its jax tree_flatten position (dict keys
+    SORTED, the jax convention — encode order keeps insertion order, so
+    the two orders differ and must be reconciled here), i.e. to the slot
+    array the drivers preallocated for that leaf."""
+    out = bytearray()
+    holes: list = []
+    if not _layout_walk(obj, out, holes, []):
+        return None
+    flat: list = []
+    _flat_paths(obj, [], flat)
+    index = {path: i for i, path in enumerate(flat)}
+    return bytes(out), [(off, nbytes, index[path])
+                        for off, nbytes, path in holes]
+
+
+def _layout_walk(o, out: bytearray, holes: list, path: list) -> bool:
+    """Mirror encode_into's traversal, recording each array's data region.
+    Returns False on anything the fixed-layout contract cannot cover
+    (scalars and bools change tag bytes or data with the VALUE; pickle
+    fallbacks have no fixed layout at all)."""
+    if isinstance(o, (np.ndarray, np.generic)):
+        arr = np.asarray(o)
+        code = _DTYPE_CODE.get(arr.dtype)
+        if code is None or arr.ndim > _MAX_NDIM:
+            return False
+        if not arr.flags.c_contiguous:
+            arr = np.ascontiguousarray(arr)
+        out.append(T_ARRAY)
+        out.append(code)
+        out.append(arr.ndim)
+        for d in arr.shape:
+            out += _U32.pack(d)
+        off = len(out)
+        out += arr.tobytes()
+        holes.append((off, arr.nbytes, tuple(path)))
+        return True
+    if type(o) in (tuple, list):
+        out.append(T_TUPLE if type(o) is tuple else T_LIST)
+        out += _U32.pack(len(o))
+        return all(_layout_walk(x, out, holes, path + [i])
+                   for i, x in enumerate(o))
+    if type(o) is dict:
+        if not all(type(k) is str for k in o):
+            return False
+        out.append(T_DICT)
+        out += _U32.pack(len(o))
+        for k, v in o.items():
+            kb = k.encode()
+            if len(kb) > 0xFFFF:
+                return False
+            out += _U16.pack(len(kb))
+            out += kb
+            if not _layout_walk(v, out, holes, path + [k]):
+                return False
+        return True
+    return False
+
+
+def _flat_paths(o, path: list, acc: list) -> None:
+    """Leaf paths in jax tree_flatten order (dicts by sorted key)."""
+    if isinstance(o, (np.ndarray, np.generic)):
+        acc.append(tuple(path))
+    elif type(o) in (tuple, list):
+        for i, x in enumerate(o):
+            _flat_paths(x, path + [i], acc)
+    elif type(o) is dict:
+        for k in sorted(o):
+            _flat_paths(o[k], path + [k], acc)
+
+
+# ---------------------------------------------------------------------------
 # scratch-buffer pool
 # ---------------------------------------------------------------------------
 

@@ -77,7 +77,7 @@ from round_tpu.runtime.log import get_logger
 from round_tpu.runtime.oob import (
     FLAG_DECISION, FLAG_NORMAL, FLAG_VIEW, Message, Tag,
 )
-from round_tpu.runtime.transport import HostTransport
+from round_tpu.runtime.transport import HostTransport, RoundPump
 
 log = get_logger("host")
 
@@ -617,6 +617,7 @@ def run_instance_loop(
     view=None,
     view_schedule: Optional[Dict[int, Tuple[int, int]]] = None,
     wire: str = "binary",
+    pump: bool = True,
 ) -> List[Optional[int]]:
     """The PerfTest2 loop (PerfTest2.scala:19-110): `instances` consecutive
     consensus instances over one transport, with start-skew stashing —
@@ -710,6 +711,42 @@ def run_instance_loop(
         stash.setdefault(tag.instance, {}).setdefault(
             tag.round, {})[sender] = payload
 
+    # NATIVE ROUND PUMP (native/transport.cpp): one state for ALL the
+    # loop's consecutive runners — class mailboxes registered once, one
+    # pump lane re-opened per instance.  The Python pump stays the
+    # baseline/fallback: views (epoch guards), the catch-up-send
+    # experiment and per-frame tracing all live there, and a transport
+    # without the surface (bare doubles, receiver-side chaos families,
+    # ROUND_TPU_PUMP=0, stale .so) simply returns None.
+    import os as _os
+
+    pump_state = None
+    if (pump and wire == "binary" and view is None
+            and send_when_catching_up and not TRACE.enabled
+            and _os.environ.get("ROUND_TPU_PUMP", "1") != "0"):
+        pump_state = _make_runner_pump(transport, algo, my_id,
+                                       len(peers), nbr_byzantine)
+    try:
+        return _run_instance_loop_body(
+            algo, my_id, peers, transport, instances, timeout_ms, seed,
+            base_value, max_rounds, stats_out, send_when_catching_up,
+            delay_first_send_ms, nbr_byzantine, value_schedule, adaptive,
+            checkpoint_dir, view, view_schedule, wire, pump_state,
+            decisions, raw_decisions, replied, enc_cache, stash, current,
+            foreign, start)
+    finally:
+        if pump_state is not None:
+            pump_state.close()
+
+
+def _run_instance_loop_body(
+    algo, my_id, peers, transport, instances, timeout_ms, seed,
+    base_value, max_rounds, stats_out, send_when_catching_up,
+    delay_first_send_ms, nbr_byzantine, value_schedule, adaptive,
+    checkpoint_dir, view, view_schedule, wire, pump_state,
+    decisions, raw_decisions, replied, enc_cache, stash, current,
+    foreign, start,
+) -> List[Optional[int]]:
     # ordered view-change schedule: entry i moves the group from epoch i
     # to i+1, so a replica only PROPOSES an entry its own epoch has not
     # yet passed (a late joiner launched with a post-change view skips
@@ -738,6 +775,7 @@ def run_instance_loop(
                 adaptive=adaptive,
                 view=view,
                 wire=wire,
+                pump_state=pump_state,
             )
             value = _schedule_value(value_schedule, base_value, vid, inst)
             res = runner.run(instance_io(algo, value),
@@ -892,26 +930,39 @@ class _RoundMailbox:
     extended to the structural layer the codec does not check)."""
 
     __slots__ = ("runner", "legacy", "n", "treedef", "stacked", "mask",
-                 "like", "count", "_sig", "_inbox")
+                 "like", "count_arr", "_sig", "_inbox", "pinned")
 
     def __init__(self, runner: "HostRunner", legacy: bool):
         self.runner = runner
         self.legacy = legacy
         self.n = runner.n
+        # pinned = the native pump holds raw pointers into stacked/mask/
+        # count_arr: a signature change (which would REALLOCATE them) is
+        # a driver bug, not wire input — fail loudly, never dangle
+        self.pinned = False
         self.treedef = None
         self.stacked: List[np.ndarray] = []
         self.mask = np.zeros((self.n,), dtype=bool)
         self.like = None
-        self.count = 0
+        # the heard count lives in a shareable int64 cell: the native
+        # round pump registers it by pointer and increments it with no
+        # GIL held (runtime/transport.py RoundPump.set_class); the Python
+        # pump updates the same cell, so `count` reads one source of
+        # truth either way
+        self.count_arr = np.zeros((1,), dtype=np.int64)
         self._sig = None
         self._inbox: Dict[int, Any] = {}
+
+    @property
+    def count(self) -> int:
+        return int(self.count_arr[0])
 
     def reset(self, like: Any) -> None:
         """Arm for a new round whose payload exemplar is ``like`` (the
         just-computed send payload: every peer runs the same round class,
         so its shape IS the mailbox slot shape)."""
         self.like = like
-        self.count = 0
+        self.count_arr[0] = 0
         if self.legacy:
             self._inbox = {}
             return
@@ -919,6 +970,10 @@ class _RoundMailbox:
         sig = (treedef, tuple((np.shape(l), np.asarray(l).dtype)
                               for l in leaves))
         if sig != self._sig:
+            if self.pinned and self._sig is not None:
+                raise RuntimeError(
+                    f"payload signature changed under a pump-registered "
+                    f"mailbox: {sig} != {self._sig}")
             self._sig = sig
             self.treedef = treedef
             self.stacked = [
@@ -939,7 +994,7 @@ class _RoundMailbox:
             grew = sender not in self._inbox
             self._inbox[sender] = payload
             if grew:
-                self.count += 1
+                self.count_arr[0] += 1
             return True  # legacy semantics: structure checked at stacking
         try:
             leaves = jax.tree_util.tree_flatten(payload)[0]
@@ -958,7 +1013,7 @@ class _RoundMailbox:
             _C_MALFORMED.inc()
             if self.mask[sender]:
                 self.mask[sender] = False
-                self.count -= 1
+                self.count_arr[0] -= 1
             for slot in self.stacked:
                 slot[sender] = 0  # a half-written slot must not leak
             log.debug("node %d: dropping structurally-malformed payload "
@@ -966,7 +1021,7 @@ class _RoundMailbox:
             return False
         if not self.mask[sender]:
             self.mask[sender] = True
-            self.count += 1
+            self.count_arr[0] += 1
             return True
         return False  # duplicate: overwritten, heard-set unchanged
 
@@ -984,6 +1039,141 @@ class _RoundMailbox:
             return m.values, m.mask
         return jax.tree_util.tree_unflatten(self.treedef, self.stacked), \
             self.mask
+
+
+def pump_coerce_encode(payload, slot_specs, treedef) -> bytes:
+    """The SHARED coercion rule of the pump-mode bilingual slow path
+    (HostRunner._pump_coerce_insert and LaneDriver._pump_fallback_insert
+    must never drift apart — they gate the same equivalence contract):
+    flatten, validate leaf count + shapes against ``slot_specs``
+    [(shape, dtype), ...], cast same-kind into the slot dtypes (astype
+    copies into a fresh C-contiguous array and — unlike
+    ascontiguousarray — keeps 0-d payloads 0-d), and re-encode
+    canonically.  Raises on any structural mismatch; the caller applies
+    its driver's malformed semantics."""
+    leaves = jax.tree_util.tree_flatten(payload)[0]
+    if len(leaves) != len(slot_specs):
+        raise ValueError(f"{len(leaves)} leaves != {len(slot_specs)}")
+    coerced = []
+    for (shape, dtype), leaf in zip(slot_specs, leaves):
+        arr = np.asarray(leaf)
+        if arr.shape != shape:
+            raise ValueError(f"leaf shape {arr.shape} != {shape}")
+        coerced.append(arr.astype(dtype, casting="same_kind", copy=True))
+    return codec.encode(jax.tree_util.tree_unflatten(treedef, coerced))
+
+
+class _RunnerPumpState:
+    """Native-pump plumbing SHARED by the consecutive HostRunners of one
+    instance loop: the one-lane RoundPump, per-round-class in-place
+    mailboxes registered by pointer once per loop (not per instance), and
+    the reusable send-wave buffers.  Built by _make_runner_pump; None
+    anywhere in the chain keeps the Python pump."""
+
+    __slots__ = ("pump", "send_ok", "boxes", "wave", "entries",
+                 "entry_count")
+
+    def __init__(self, pump: RoundPump, transport,
+                 boxes: Dict[int, "_RoundMailbox"]):
+        self.pump = pump
+        self.send_ok = bool(getattr(transport, "pump_send_ok", False))
+        self.boxes = boxes
+        self.wave = bytearray()
+        self.entries = bytearray()
+        self.entry_count = 0
+
+    def close(self) -> None:
+        """Bank the native fast-path stats into the unified metrics
+        (pump.* + host.recvs/host.malformed parity) and detach the pump
+        so the plain inbox path owns the wire again (serve_decisions,
+        next loop)."""
+        d = self.pump.bank_metrics()
+        if d[0] or d[1]:
+            _C_RECVS.inc(int(d[0] + d[1]))
+        if d[6]:
+            # out-of-range-sender drops the event loop counted natively:
+            # host.malformed must read the same whichever pump served
+            _C_MALFORMED.inc(int(d[6]))
+        self.pump.close()
+
+
+def _payload_layouts(algo: Algorithm, my_id: int, n: int):
+    """Per-round-class (payload exemplar, codec template) for the native
+    pump, or None when any class's payload is outside the fixed-layout
+    vocabulary.  Shapes come from ``jax.eval_shape`` over the un-jitted
+    send (ABSTRACT tracing, ~ms — an eager evaluation here cost 240 ms of
+    process startup, half a 40-instance loop's wall): payload shapes are
+    a fixed point across rounds (the lax.scan carry contract roundlint
+    enforces), and the template's hole CONTENT is never compared, so
+    zero-filled exemplars template identically to live traffic.  Cached
+    on the round objects (keyed by n), like the jitted trios."""
+    layouts = []
+    ctx = RoundCtx(id=np.int32(my_id), n=n, r=np.int32(0))
+    state0 = None
+    for rnd in algo.rounds:
+        cached = getattr(rnd, "_pump_layout", None)
+        if cached is not None and cached[0] == n:
+            if cached[1] is None:
+                return None
+            layouts.append(cached[1])
+            continue
+        from round_tpu.engine.executor import make_host_round_fns
+
+        if state0 is None:
+            state0 = algo.make_init_state(ctx, instance_io(algo, 0))
+        raw_send, _u, _g = make_host_round_fns(rnd, n)
+        try:
+            _st, payload, _d = jax.eval_shape(
+                raw_send, np.int32(0), np.int32(my_id), np.uint32(0),
+                state0)
+        except Exception:  # noqa: BLE001 — an untraceable send keeps the
+            # Python pump (never break a working driver for a fast path)
+            rnd._pump_layout = (n, None)
+            return None
+        exemplar = jax.tree_util.tree_map(
+            lambda s: np.zeros(s.shape, dtype=s.dtype), payload)
+        lay = codec.array_layout(exemplar)
+        if lay is None:
+            rnd._pump_layout = (n, None)
+            return None
+        rnd._pump_layout = (n, (exemplar, lay))
+        layouts.append((exemplar, lay))
+    return layouts
+
+
+def _make_runner_pump(transport, algo: Algorithm, my_id: int, n: int,
+                      nbr_byzantine: int) -> Optional[_RunnerPumpState]:
+    """Attach the native round pump for a sequential instance loop, or
+    None (Python-pump fallback) when the transport has no pump surface or
+    a round class's payload is outside the fixed-byte-layout vocabulary.
+    Each class's payload exemplar is computed EAGERLY (one un-jitted send
+    on the init state — payload shapes are a fixed point across rounds,
+    the lax.scan carry contract roundlint enforces), its codec template
+    derived, and the class mailboxes registered by pointer."""
+    mk = getattr(transport, "enable_pump", None)
+    if mk is None:
+        return None
+    layouts = _payload_layouts(algo, my_id, n)
+    if layouts is None:
+        return None  # outside the fixed-layout vocabulary
+    pump = mk(1, n, len(algo.rounds), nbr_byzantine)
+    if pump is None:
+        return None
+    import types as _types
+
+    stub = _types.SimpleNamespace(n=n, id=my_id, malformed=0)
+    boxes: Dict[int, _RoundMailbox] = {}
+    for c, (exemplar, (tmpl, holes)) in enumerate(layouts):
+        box = _RoundMailbox(stub, legacy=False)
+        box.reset(exemplar)  # allocate [n, ...] arrays + fix the sig
+        for a in box.stacked:
+            a.fill(0)
+        box.count_arr[0] = 0
+        box.pinned = True
+        pump.set_class(0, c, tmpl, holes, box.stacked, mask=box.mask,
+                       count=box.count_arr, per_lane=False)
+        boxes[c] = box
+    return _RunnerPumpState(pump, transport, boxes)
 
 
 class HostRunner:
@@ -1014,6 +1204,7 @@ class HostRunner:
         adaptive: Optional[AdaptiveTimeout] = None,
         view=None,
         wire: str = "binary",
+        pump_state: Optional["_RunnerPumpState"] = None,
     ):
         self.algo = algo
         self.id = my_id
@@ -1043,6 +1234,13 @@ class HostRunner:
             self._sendb = None
         self._recv_many = getattr(transport, "recv_many", None)
         self._mbox = _RoundMailbox(self, legacy=(wire == "pickle"))
+        # native round pump plumbing (run_instance_loop builds ONE
+        # _RunnerPumpState for all its consecutive runners; None = the
+        # Python pump below, which stays the A/B baseline and fallback).
+        # Views and the catch-up-send experiment keep the Python pump:
+        # epoch stamping/guarding and send suppression live there.
+        self._ps = (pump_state if wire == "binary" and view is None
+                    and send_when_catching_up else None)
         # adaptive round deadline (EWMA + backoff, see AdaptiveTimeout):
         # replaces the fixed timeout_ms for every round that DELEGATES its
         # Progress to the runner (the RuntimeOptions role); rounds that
@@ -1179,6 +1377,249 @@ class HostRunner:
         rnd._host_jit = (n, *fns)
         return fns
 
+    def _pump_coerce_insert(self, mbox: "_RoundMailbox", sender: int,
+                            raw) -> None:
+        """Pump-mode bilingual slow path: a frame that missed the native
+        template (legacy-pickle peer, byzantine bytes) decodes here, gets
+        coerced to the slot dtypes with the mailbox's same-kind cast rule
+        and re-inserted CANONICALLY under the pump lock — byte-for-byte
+        the _RoundMailbox.insert semantics."""
+        ok, payload = self._loads(raw)
+        if not ok:
+            return
+        pump = self._ps.pump
+        try:
+            enc = pump_coerce_encode(
+                payload, [(s.shape[1:], s.dtype) for s in mbox.stacked],
+                mbox.treedef)
+            if pump.insert(0, sender, enc) < 0:
+                raise ValueError("canonical re-encode missed the template")
+        except Exception as e:  # noqa: BLE001 — garbage must not kill us
+            self.malformed += 1
+            _C_MALFORMED.inc()
+            pump.mark_malformed(0, sender)
+            log.debug("node %d: dropping structurally-malformed payload "
+                      "from %d: %s", self.id, sender, e)
+        # host.recvs accounting rides the pump stats bank (rt_pump_insert
+        # ticked fast/dup) — an inline inc here would double-count
+
+    def _pump_round(self, r, rr, sid, seed, state, payload_np, dest, f_go,
+                    max_rnd):
+        """One round's send + accumulate through the native pump: reset/
+        prefill/self-deliver the class mailbox while DISARMED, arm (which
+        applies natively-buffered pending frames for this round), ship
+        the whole send fan-out in one rt_pump_flush crossing, then block
+        in rt_pump_wait until goAhead / deadline / skew / misc.  Returns
+        the accumulate outcome tuple of the Python path."""
+        P = RoundPump
+        ps = self._ps
+        pump = ps.pump
+        rounds = self.algo.rounds
+        ci = r % len(rounds)
+        rnd = rounds[ci]
+        mbox = ps.boxes[ci]
+        mbox.reset(payload_np)
+        for _sender, _payload in self._pending.pop(r, {}).items():
+            mbox.insert(_sender, _payload)
+        if dest[self.id]:
+            # self-delivery is never suppressed (Round.scala:114-117)
+            mbox.insert(self.id, payload_np)
+        prog = self._round_progress(rnd)
+        use_deadline = prog.is_timeout
+        if use_deadline:
+            _G_DEADLINE.set(prog.timeout_millis)
+        expected = rnd.expected_nbr_messages(self._ctx(r), state)
+        t0 = _time.monotonic()
+        timedout = deadline_expired = False
+        oob_decided = False
+
+        # -- arm ------------------------------------------------------------
+        thr, flags, dl, ext = 0, 0, 0, 0
+        if not prog.is_go_ahead:
+            if f_go is not None or prog.is_sync:
+                flags |= P.F_GROWTH
+            else:
+                thr = min(self.n, int(expected))
+            if prog.is_strict or prog.is_sync:
+                flags |= P.F_STRICT
+            if use_deadline:
+                dl = int(prog.timeout_millis)
+            else:
+                dl = ext = self.wait_cap_ms
+                flags |= P.F_EXTEND
+        # a zero threshold with no growth wake is an already-satisfied
+        # quorum (expected <= 0): same instant-end semantics as GoAhead
+        instant = prog.is_go_ahead or (thr <= 0 and not flags)
+        if instant:
+            pump.arm(0, r, ci, 0, 0, 0, 0)  # applies pending only
+        else:
+            pump.arm(0, r, ci, thr, flags, dl, ext)
+
+        # -- send (after arm: a fast peer's reply races only into the
+        # native pending buffer, never into a torn mailbox) ---------------
+        sent = 0
+        if ps.send_ok:
+            del ps.wave[:]
+            del ps.entries[:]
+            ps.entry_count = 0
+            codec.encode_into(payload_np, ps.wave)
+            ln = len(ps.wave)
+            tagw = Tag(instance=self.instance_id,
+                       round=r).pack() & 0xFFFFFFFFFFFFFFFF
+            for d in range(self.n):
+                if d == self.id or not dest[d]:
+                    continue
+                ps.entries += P._ENTRY.pack(d, tagw, 0, ln)
+                ps.entry_count += 1
+                sent += 1
+            if sent:
+                pump.flush(ps.wave, ps.entries, ps.entry_count)
+        else:
+            # chaos wrapper in the way: faults apply per logical frame on
+            # the send_buffered surface, exactly like the Python pump
+            wire = self._scratch.encode(payload_np)
+            tag = Tag(instance=self.instance_id, round=r)
+            for d in range(self.n):
+                if d == self.id or not dest[d]:
+                    continue
+                if self._sendb is not None:
+                    self._sendb(d, tag, wire)
+                else:
+                    self.transport.send(d, tag, bytes(wire))
+                sent += 1
+            if sent and self._sendb is not None:
+                self._flushfn()
+        if sent:
+            _C_SENDS.inc(sent)
+
+        # -- accumulate -----------------------------------------------------
+        def go_ahead() -> bool:
+            if f_go is not None:
+                vals, mask = mbox.values_mask()
+                return bool(np.asarray(
+                    f_go(rr, sid, seed, state, vals, mask)))
+            return mbox.count >= min(self.n, int(expected))
+
+        def drain_misc() -> None:
+            nonlocal state, oob_decided
+            while True:
+                if self._recv_many is not None:
+                    got_list = self._recv_many(0)
+                else:
+                    got = self.transport.recv(0)
+                    got_list = [got] if got is not None else []
+                if not got_list:
+                    return
+                for got in got_list:
+                    sender, tg, raw = got
+                    if not 0 <= sender < self.n:
+                        self.malformed += 1
+                        _C_MALFORMED.inc()
+                        continue
+                    if tg.instance == self.instance_id \
+                            and tg.flag == FLAG_NORMAL:
+                        if pump.feed(sender, tg, raw) == -2:
+                            self._pump_coerce_insert(mbox, sender, raw)
+                    elif tg.flag == FLAG_DECISION \
+                            and tg.instance == self.instance_id:
+                        ok, p = self._loads(raw)
+                        adopted = (self.algo.adopt_decision(state, p)
+                                   if ok else None)
+                        if adopted is not None:
+                            state = adopted
+                            oob_decided = True
+                            _C_OOB.inc()
+                            if TRACE.enabled:
+                                TRACE.emit("recv_decision", node=self.id,
+                                           inst=self.instance_id, round=r,
+                                           src=sender)
+                    elif tg.flag == FLAG_NORMAL and self.foreign is not None:
+                        ok, p = self._loads(raw)
+                        if ok:
+                            self.foreign(sender, tg, p)
+                    elif self.default_handler is not None:
+                        ok, p = self._loads(raw)
+                        if ok:
+                            self.default_handler(Message(
+                                sender=sender, tag=tg, payload=p))
+
+        if instant:
+            # queued frames were applied at arm; one misc sweep mirrors
+            # the Python path's pre-update drain, then the round ends
+            _n, misc = pump.wait(0)
+            if misc:
+                drain_misc()
+            pump.disarm(0)
+            return (state, mbox, prog, use_deadline, t0, timedout,
+                    deadline_expired, oob_decided)
+
+        if flags & P.F_GROWTH:
+            # initial probe, mirroring the Python loop's dirty=True first
+            # iteration: prefill/self-delivery/natively-applied pending
+            # may ALREADY satisfy the go condition or sync barrier, and
+            # the native side raises no GROWTH wake for frames applied at
+            # arm — without this a satisfied round would sit out its
+            # whole deadline
+            go = f_go is not None and go_ahead()
+            if not go and prog.is_sync and int((max_rnd >= r).sum()) \
+                    >= prog.k + self.nbr_byzantine:
+                go = True
+            if go:
+                pump.disarm(0)
+                return (state, mbox, prog, use_deadline, t0, timedout,
+                        deadline_expired, oob_decided)
+
+        while not oob_decided:
+            nready, misc = pump.wait(10_000)
+            if nready < 0:
+                break  # transport stopped under us; unwind like a timeout
+            if misc:
+                drain_misc()
+                if oob_decided:
+                    pump.disarm(0)
+                    break
+            rs = int(pump.reasons[0])
+            if rs & P.R_THRESH:
+                break
+            if rs & P.R_DEADLINE:
+                timedout = True
+                deadline_expired = True
+                self.timeouts += 1
+                _C_TIMEOUTS.inc()
+                if TRACE.enabled:
+                    TRACE.emit(
+                        "timeout", node=self.id, inst=self.instance_id,
+                        round=r,
+                        deadline_ms=(int(prog.timeout_millis)
+                                     if use_deadline else self.wait_cap_ms),
+                        kind="deadline" if use_deadline else "wait_cap",
+                        heard=mbox.count)
+                if not use_deadline:
+                    log.warning(
+                        "node %d round %d: %s was idle for %d ms; forcing "
+                        "timeout (the reference would block forever)",
+                        self.id, r, prog, self.wait_cap_ms)
+                break
+            if rs & P.R_SKEW:
+                timedout = True
+                _C_CATCHUP.inc()
+                if TRACE.enabled:
+                    TRACE.emit("catch_up", node=self.id,
+                               inst=self.instance_id, round=r,
+                               next_round=int(pump.next_round[0]))
+                break
+            if rs & P.R_GROWTH:
+                go = f_go is not None and go_ahead()
+                if not go and prog.is_sync and int(
+                        (max_rnd >= r).sum()) \
+                        >= prog.k + self.nbr_byzantine:
+                    go = True
+                if go:
+                    pump.disarm(0)
+                    break
+        return (state, mbox, prog, use_deadline, t0, timedout,
+                deadline_expired, oob_decided)
+
     def _round_progress(self, rnd) -> Progress:
         """The round's declared Progress policy; a round that keeps the
         Round-class default delegates to the runner's configured timeout
@@ -1214,8 +1655,17 @@ class HostRunner:
             v = self.view
             return v is not None and (v.removed or v.epoch != epoch0)
         # benign catch-up state (InstanceHandler.scala:289-301): highest
-        # round observed per peer; their max pulls this replica forward
-        max_rnd = np.full(self.n, -1, dtype=np.int64)
+        # round observed per peer; their max pulls this replica forward.
+        # In pump mode the array is the SHARED native row — the event
+        # loop writes peers' claims with no GIL held, this side only ever
+        # writes its own element
+        if self._ps is not None:
+            for box in self._ps.boxes.values():
+                box.runner = self
+            self._ps.pump.open_lane(0, self.instance_id)
+            max_rnd = self._ps.pump.max_rnd[0]
+        else:
+            max_rnd = np.full(self.n, -1, dtype=np.int64)
         max_rnd[self.id] = 0
         next_round = 0
         if self.delay_first_send_ms > 0:
@@ -1235,288 +1685,301 @@ class HostRunner:
             state, payload, dest_mask = f_send(rr, sid, seed, state)
             dest = np.asarray(dest_mask)
             payload_np = jax.tree_util.tree_map(np.asarray, payload)
-            # catching up = a peer was observed past this round
-            # (InstanceHandler.scala:176: msg pending ⇒ only send when
-            # sendWhenCatchingUp); our messages would arrive
-            # communication-closed-late at peers already beyond r
-            sending = self.send_when_catching_up or next_round <= r
-            # the view epoch rides the otherwise-unused callStack byte of
-            # every NORMAL frame (runtime/view.py; 0 in the epoch-less
-            # world, which IS epoch 0's stamp — fully backwards-compatible)
-            cs = self.view.epoch_byte if self.view is not None else 0
-            if sending:
-                # encode ONCE per round into the pooled scratch (binary)
-                # or a pickle bytes (legacy); every destination ships the
-                # same buffer.  Binary sends coalesce into per-peer
-                # FLAG_BATCH frames, flushed at the end of the send loop —
-                # the round boundary of comm-closure makes this safe.
-                if self._scratch is not None:
-                    wire = self._scratch.encode(payload_np)
-                else:
-                    wire = pickle.dumps(payload_np)
-                tag = Tag(instance=self.instance_id, round=r, call_stack=cs)
-                sendb = self._sendb
-                sent = 0
-                for d in range(self.n):
-                    if d == self.id or not dest[d]:
-                        continue
-                    if sendb is not None:
-                        sendb(d, tag, wire)
-                    else:
-                        self.transport.send(
-                            d, tag, wire if isinstance(wire, bytes)
-                            else bytes(wire))
-                    sent += 1
-                    if TRACE.enabled:
-                        TRACE.emit("send", node=self.id,
-                                   inst=self.instance_id, round=r, dst=d,
-                                   bytes=len(wire))
-                if sent:
-                    if sendb is not None:  # __init__ guarantees flush too
-                        self._flushfn()
-                    _C_SENDS.inc(sent)
+            if self._ps is not None:
+                # NATIVE PUMP round (native/transport.cpp rt_pump_*):
+                # mailbox reset + prefill + self-delivery while the
+                # lane is disarmed, ONE arm (applies natively-buffered
+                # pending), one flush crossing for the whole send
+                # fan-out, then ONE blocking wait per wake — the
+                # per-message recv loop below is the Python-pump
+                # baseline arm of the A/B (apps/host_perftest --ab-pump)
+                (state, mbox, prog, use_deadline, t0, timedout,
+                 deadline_expired, oob_decided) = self._pump_round(
+                    r, rr, sid, seed, state, payload_np, dest, f_go,
+                    max_rnd)
             else:
-                self.suppressed_sends += 1
-
-            # -- accumulate (InstanceHandler.scala:164-353) ---------------
-            mbox = self._mbox
-            mbox.reset(payload_np)
-            for _sender, _payload in self._pending.pop(r, {}).items():
-                mbox.insert(_sender, _payload)
-            if dest[self.id]:
-                # self-delivery is NEVER suppressed: a replica's message to
-                # itself cannot be communication-closed-late, and dropping
-                # it would starve the full-mailbox go-ahead probe on every
-                # suppressed round — the knob suppresses WIRE sends only
-                mbox.insert(self.id, payload_np)
-            prog = self._round_progress(rnd)
-            block = prog.is_strict       # strict: no catch-up early-exit
-            use_deadline = prog.is_timeout
-            t0 = _time.monotonic()
-            deadline = t0 + (prog.timeout_millis if use_deadline
-                             else self.wait_cap_ms) / 1000.0
-            if use_deadline:
-                _G_DEADLINE.set(prog.timeout_millis)
-            expected = rnd.expected_nbr_messages(self._ctx(r), state)
-            timedout = False
-            # deadline_expired ⊂ timedout: the catch-up fast-forward break
-            # also flags timedout but is round SKEW, not wire latency — only
-            # a true expiry may back the adaptive estimator off
-            deadline_expired = False
-
-            def go_ahead() -> bool:
-                if f_go is not None:
-                    vals, mask = mbox.values_mask()
-                    return bool(np.asarray(
-                        f_go(rr, sid, seed, state, vals, mask)
-                    ))
-                return mbox.count >= min(self.n, int(expected))
-
-            oob_decided = False
-
-            def ingest(got, extend_deadline=True, buffer_only=False) -> bool:
-                """Route one received packet; True when THIS round's inbox
-                grew.  Shared by the blocking accumulate loop and the
-                GoAhead pre-update drain.  With buffer_only, a
-                current-round message is dropped instead of joining the
-                inbox (it is late-for-the-quorum; under the default policy
-                it would have been read next round and dropped as late, so
-                this keeps the frontier drain behavior-neutral for the
-                current round's update)."""
-                nonlocal state, deadline, next_round, oob_decided
-                sender, tag, raw = got
-                if self.view is not None:
-                    # the view guard runs BEFORE the sender-range check:
-                    # after a REMOVE shrinks n, a stale replica's old pid
-                    # can be >= n (it dials the member that inherited its
-                    # id, or — when the last pid was removed — anyone),
-                    # and dropping it as malformed would starve it of the
-                    # FLAG_VIEW catch-up forever.  Neither path indexes a
-                    # sender-sized structure: adoption validates the
-                    # payload structurally, and the reply rides the stale
-                    # peer's own inbound channel (by_peer), so an
-                    # arbitrary sender id is safe — at worst a garbage
-                    # frame reflects one rate-limited ~100-byte reply.
-                    if tag.flag == FLAG_VIEW:
-                        # catch-up from a peer ahead of our view: adopt
-                        # (rewire + epoch jump); view_int() then ends this
-                        # instance so the host loop re-enters on the new
-                        # wire
-                        ok, p = self._loads(raw)
-                        if ok:
-                            self.view.adopt_wire(p)
-                        return False
-                    if (tag.flag == FLAG_NORMAL
-                            and not self.view.check_epoch(sender, tag)):
-                        # cross-epoch data traffic is DROPPED, never
-                        # folded: a stale peer was just answered with
-                        # FLAG_VIEW; an ahead peer flagged us stale
-                        return False
-                if not 0 <= sender < self.n:
-                    # protocol garbage on the unauthenticated socket: an
-                    # out-of-range id would corrupt every downstream
-                    # sender-indexed structure (stash, mailbox stacking)
-                    self.malformed += 1
-                    _C_MALFORMED.inc()
-                    return False
-                if tag.instance != self.instance_id or tag.flag != FLAG_NORMAL:
-                    if (tag.flag == FLAG_DECISION
-                            and tag.instance == self.instance_id):
-                        # out-of-band decision recovery (PerfTest.scala:
-                        # 40-60): a peer that already decided replies to
-                        # our late traffic with the value — adopt and exit
-                        # instead of burning this round's timeout
-                        ok, p = self._loads(raw)
-                        adopted = (self.algo.adopt_decision(state, p)
-                                   if ok else None)
-                        if adopted is not None:
-                            state = adopted
-                            oob_decided = True
-                            _C_OOB.inc()
-                            if TRACE.enabled:
-                                TRACE.emit("recv_decision", node=self.id,
-                                           inst=self.instance_id, round=r,
-                                           src=sender)
-                    elif tag.flag == FLAG_NORMAL and self.foreign is not None:
-                        ok, p = self._loads(raw)
-                        if ok:
-                            self.foreign(sender, tag, p)
-                    elif self.default_handler is not None:
-                        ok, p = self._loads(raw)
-                        if ok:
-                            self.default_handler(Message(
-                                sender=sender, tag=tag, payload=p,
-                            ))
-                    return False
-                if tag.round > max_rnd[sender]:
-                    max_rnd[sender] = tag.round
-                if tag.round < r:
-                    return False  # late: the round is communication-closed
-                ok, payload = self._loads(raw)
-                if not ok:
-                    if TRACE.enabled:
-                        TRACE.emit("malformed", node=self.id,
-                                   inst=self.instance_id, round=tag.round,
-                                   src=sender)
-                    return False
-                if extend_deadline and not use_deadline:
-                    # the wait cap is an IDLE cap: any same-instance
-                    # message is progress and extends the deadline
-                    deadline = _time.monotonic() + self.wait_cap_ms / 1000.0
-                if tag.round > r:
-                    self._pending.setdefault(tag.round, {})[sender] = payload
-                    if self.nbr_byzantine <= 0:
-                        # benign catch-up: the furthest peer sets the target
-                        next_round = max(next_round, int(max_rnd.max()))
+                # catching up = a peer was observed past this round
+                # (InstanceHandler.scala:176: msg pending ⇒ only send when
+                # sendWhenCatchingUp); our messages would arrive
+                # communication-closed-late at peers already beyond r
+                sending = self.send_when_catching_up or next_round <= r
+                # the view epoch rides the otherwise-unused callStack byte of
+                # every NORMAL frame (runtime/view.py; 0 in the epoch-less
+                # world, which IS epoch 0's stamp — fully backwards-compatible)
+                cs = self.view.epoch_byte if self.view is not None else 0
+                if sending:
+                    # encode ONCE per round into the pooled scratch (binary)
+                    # or a pickle bytes (legacy); every destination ships the
+                    # same buffer.  Binary sends coalesce into per-peer
+                    # FLAG_BATCH frames, flushed at the end of the send loop —
+                    # the round boundary of comm-closure makes this safe.
+                    if self._scratch is not None:
+                        wire = self._scratch.encode(payload_np)
                     else:
-                        # byzantine catch-up (InstanceHandler.scala:302-307):
-                        # drop the f highest claims — a target needs f+1
-                        # attestations, so lying peers cannot drag us ahead
-                        srt = np.sort(max_rnd)
-                        next_round = max(
-                            next_round, int(srt[-(self.nbr_byzantine + 1)]))
-                    return False
-                if buffer_only:
-                    return False  # post-quorum same-round: same fate as
-                    # arriving next round under the default policy (late)
-                grew = mbox.insert(sender, payload)
-                _C_RECVS.inc()
-                if TRACE.enabled:
-                    TRACE.emit("recv", node=self.id, inst=self.instance_id,
-                               round=r, src=sender)
-                return grew
+                        wire = pickle.dumps(payload_np)
+                    tag = Tag(instance=self.instance_id, round=r, call_stack=cs)
+                    sendb = self._sendb
+                    sent = 0
+                    for d in range(self.n):
+                        if d == self.id or not dest[d]:
+                            continue
+                        if sendb is not None:
+                            sendb(d, tag, wire)
+                        else:
+                            self.transport.send(
+                                d, tag, wire if isinstance(wire, bytes)
+                                else bytes(wire))
+                        sent += 1
+                        if TRACE.enabled:
+                            TRACE.emit("send", node=self.id,
+                                       inst=self.instance_id, round=r, dst=d,
+                                       bytes=len(wire))
+                    if sent:
+                        if sendb is not None:  # __init__ guarantees flush too
+                            self._flushfn()
+                        _C_SENDS.inc(sent)
+                else:
+                    self.suppressed_sends += 1
 
-            dirty = True  # inbox changed since the last go probe
-            while not prog.is_go_ahead and not oob_decided \
-                    and not view_int():
-                if dirty and go_ahead():
-                    break
-                dirty = False
-                if prog.is_sync and int((max_rnd >= r).sum()) \
-                        >= prog.k + self.nbr_byzantine:
-                    # sync(k) barrier: f of the attestations may be lies,
-                    # so the barrier needs k + f (computeSync,
-                    # InstanceHandler.scala:279-287)
-                    break
-                if next_round > r + 1 and not block:
-                    # genuine round skew: a peer is MORE than one round
-                    # ahead, so this round's window is over — fast-forward
-                    # (counts as TO, :245).  A one-round lead is normal
-                    # pipelining (the peer finished the round we are in and
-                    # sent its next message, which can overtake a slower
-                    # peer's current-round packet on another socket);
-                    # breaking on it would truncate rounds to partial
-                    # mailboxes microseconds before completion — measured
-                    # 20x throughput loss on the PerfTest2 harness — and a
-                    # 1-round-behind replica self-heals within one round
-                    # timeout anyway.
-                    timedout = True
-                    _C_CATCHUP.inc()
+                # -- accumulate (InstanceHandler.scala:164-353) ---------------
+                mbox = self._mbox
+                mbox.reset(payload_np)
+                for _sender, _payload in self._pending.pop(r, {}).items():
+                    mbox.insert(_sender, _payload)
+                if dest[self.id]:
+                    # self-delivery is NEVER suppressed: a replica's message to
+                    # itself cannot be communication-closed-late, and dropping
+                    # it would starve the full-mailbox go-ahead probe on every
+                    # suppressed round — the knob suppresses WIRE sends only
+                    mbox.insert(self.id, payload_np)
+                prog = self._round_progress(rnd)
+                block = prog.is_strict       # strict: no catch-up early-exit
+                use_deadline = prog.is_timeout
+                t0 = _time.monotonic()
+                deadline = t0 + (prog.timeout_millis if use_deadline
+                                 else self.wait_cap_ms) / 1000.0
+                if use_deadline:
+                    _G_DEADLINE.set(prog.timeout_millis)
+                expected = rnd.expected_nbr_messages(self._ctx(r), state)
+                timedout = False
+                # deadline_expired ⊂ timedout: the catch-up fast-forward break
+                # also flags timedout but is round SKEW, not wire latency — only
+                # a true expiry may back the adaptive estimator off
+                deadline_expired = False
+
+                def go_ahead() -> bool:
+                    if f_go is not None:
+                        vals, mask = mbox.values_mask()
+                        return bool(np.asarray(
+                            f_go(rr, sid, seed, state, vals, mask)
+                        ))
+                    return mbox.count >= min(self.n, int(expected))
+
+                oob_decided = False
+
+                def ingest(got, extend_deadline=True, buffer_only=False) -> bool:
+                    """Route one received packet; True when THIS round's inbox
+                    grew.  Shared by the blocking accumulate loop and the
+                    GoAhead pre-update drain.  With buffer_only, a
+                    current-round message is dropped instead of joining the
+                    inbox (it is late-for-the-quorum; under the default policy
+                    it would have been read next round and dropped as late, so
+                    this keeps the frontier drain behavior-neutral for the
+                    current round's update)."""
+                    nonlocal state, deadline, next_round, oob_decided
+                    sender, tag, raw = got
+                    if self.view is not None:
+                        # the view guard runs BEFORE the sender-range check:
+                        # after a REMOVE shrinks n, a stale replica's old pid
+                        # can be >= n (it dials the member that inherited its
+                        # id, or — when the last pid was removed — anyone),
+                        # and dropping it as malformed would starve it of the
+                        # FLAG_VIEW catch-up forever.  Neither path indexes a
+                        # sender-sized structure: adoption validates the
+                        # payload structurally, and the reply rides the stale
+                        # peer's own inbound channel (by_peer), so an
+                        # arbitrary sender id is safe — at worst a garbage
+                        # frame reflects one rate-limited ~100-byte reply.
+                        if tag.flag == FLAG_VIEW:
+                            # catch-up from a peer ahead of our view: adopt
+                            # (rewire + epoch jump); view_int() then ends this
+                            # instance so the host loop re-enters on the new
+                            # wire
+                            ok, p = self._loads(raw)
+                            if ok:
+                                self.view.adopt_wire(p)
+                            return False
+                        if (tag.flag == FLAG_NORMAL
+                                and not self.view.check_epoch(sender, tag)):
+                            # cross-epoch data traffic is DROPPED, never
+                            # folded: a stale peer was just answered with
+                            # FLAG_VIEW; an ahead peer flagged us stale
+                            return False
+                    if not 0 <= sender < self.n:
+                        # protocol garbage on the unauthenticated socket: an
+                        # out-of-range id would corrupt every downstream
+                        # sender-indexed structure (stash, mailbox stacking)
+                        self.malformed += 1
+                        _C_MALFORMED.inc()
+                        return False
+                    if tag.instance != self.instance_id or tag.flag != FLAG_NORMAL:
+                        if (tag.flag == FLAG_DECISION
+                                and tag.instance == self.instance_id):
+                            # out-of-band decision recovery (PerfTest.scala:
+                            # 40-60): a peer that already decided replies to
+                            # our late traffic with the value — adopt and exit
+                            # instead of burning this round's timeout
+                            ok, p = self._loads(raw)
+                            adopted = (self.algo.adopt_decision(state, p)
+                                       if ok else None)
+                            if adopted is not None:
+                                state = adopted
+                                oob_decided = True
+                                _C_OOB.inc()
+                                if TRACE.enabled:
+                                    TRACE.emit("recv_decision", node=self.id,
+                                               inst=self.instance_id, round=r,
+                                               src=sender)
+                        elif tag.flag == FLAG_NORMAL and self.foreign is not None:
+                            ok, p = self._loads(raw)
+                            if ok:
+                                self.foreign(sender, tag, p)
+                        elif self.default_handler is not None:
+                            ok, p = self._loads(raw)
+                            if ok:
+                                self.default_handler(Message(
+                                    sender=sender, tag=tag, payload=p,
+                                ))
+                        return False
+                    if tag.round > max_rnd[sender]:
+                        max_rnd[sender] = tag.round
+                    if tag.round < r:
+                        return False  # late: the round is communication-closed
+                    ok, payload = self._loads(raw)
+                    if not ok:
+                        if TRACE.enabled:
+                            TRACE.emit("malformed", node=self.id,
+                                       inst=self.instance_id, round=tag.round,
+                                       src=sender)
+                        return False
+                    if extend_deadline and not use_deadline:
+                        # the wait cap is an IDLE cap: any same-instance
+                        # message is progress and extends the deadline
+                        deadline = _time.monotonic() + self.wait_cap_ms / 1000.0
+                    if tag.round > r:
+                        self._pending.setdefault(tag.round, {})[sender] = payload
+                        if self.nbr_byzantine <= 0:
+                            # benign catch-up: the furthest peer sets the target
+                            next_round = max(next_round, int(max_rnd.max()))
+                        else:
+                            # byzantine catch-up (InstanceHandler.scala:302-307):
+                            # drop the f highest claims — a target needs f+1
+                            # attestations, so lying peers cannot drag us ahead
+                            srt = np.sort(max_rnd)
+                            next_round = max(
+                                next_round, int(srt[-(self.nbr_byzantine + 1)]))
+                        return False
+                    if buffer_only:
+                        return False  # post-quorum same-round: same fate as
+                        # arriving next round under the default policy (late)
+                    grew = mbox.insert(sender, payload)
+                    _C_RECVS.inc()
                     if TRACE.enabled:
-                        TRACE.emit("catch_up", node=self.id,
-                                   inst=self.instance_id, round=r,
-                                   next_round=int(next_round))
-                    break
-                left_ms = int((deadline - _time.monotonic()) * 1000)
-                if left_ms <= 0:
-                    timedout = True
-                    deadline_expired = True
-                    self.timeouts += 1
-                    _C_TIMEOUTS.inc()
-                    if TRACE.enabled:
-                        TRACE.emit(
-                            "timeout", node=self.id, inst=self.instance_id,
-                            round=r,
-                            deadline_ms=(int(prog.timeout_millis)
-                                         if use_deadline
-                                         else self.wait_cap_ms),
-                            kind="deadline" if use_deadline else "wait_cap",
-                            heard=mbox.count)
-                    if not use_deadline:
-                        log.warning(
-                            "node %d round %d: %s was idle for "
-                            "%d ms; forcing timeout (the reference would "
-                            "block forever)", self.id, r, prog,
-                            self.wait_cap_ms)
-                    break
-                got = self.transport.recv(left_ms)
-                if got is None:
-                    continue  # re-check the deadline
-                if ingest(got):
-                    dirty = True
-            if (prog.is_go_ahead or not self.send_when_catching_up) \
-                    and not oob_decided:
-                # ONE non-blocking drain, two roles.  (a) A GoAhead round
-                # delivers messages ALREADY QUEUED in the transport before
-                # updating (the reference delivers pending messages before
-                # ending the round, InstanceHandler.scala:219-231):
-                # same-round into the inbox, future rounds into the
-                # buffer.  (b) The catch-up send policy needs the FRONTIER
-                # visible: ingestion normally stops at the quorum break,
-                # so a replica replaying a long backlog never sees the
-                # rounds ahead (the reference's one-message-at-a-time loop
-                # reads ahead by construction) — future rounds land in the
-                # pending buffer and push next_round forward.  In role (b)
-                # alone, post-quorum same-round payloads are DROPPED
-                # (buffer_only): under the default policy they would have
-                # been read next round and dropped as late, so the knob
-                # stays behavior-neutral for the current round's update.
-                # recv_many pulls EVERY queued frame in one batched native
-                # drain (transport.recv_many); transports without it (bare
-                # test doubles) fall back to the per-frame poll
-                while True:
-                    if self._recv_many is not None:
-                        got_list = self._recv_many(0)
-                    else:
-                        got = self.transport.recv(0)
-                        got_list = [got] if got is not None else []
-                    if not got_list:
+                        TRACE.emit("recv", node=self.id, inst=self.instance_id,
+                                   round=r, src=sender)
+                    return grew
+
+                dirty = True  # inbox changed since the last go probe
+                while not prog.is_go_ahead and not oob_decided \
+                        and not view_int():
+                    if dirty and go_ahead():
                         break
-                    for got in got_list:
-                        ingest(got, extend_deadline=False,
-                               buffer_only=not prog.is_go_ahead)
-                    if oob_decided or view_int():
+                    dirty = False
+                    if prog.is_sync and int((max_rnd >= r).sum()) \
+                            >= prog.k + self.nbr_byzantine:
+                        # sync(k) barrier: f of the attestations may be lies,
+                        # so the barrier needs k + f (computeSync,
+                        # InstanceHandler.scala:279-287)
                         break
+                    if next_round > r + 1 and not block:
+                        # genuine round skew: a peer is MORE than one round
+                        # ahead, so this round's window is over — fast-forward
+                        # (counts as TO, :245).  A one-round lead is normal
+                        # pipelining (the peer finished the round we are in and
+                        # sent its next message, which can overtake a slower
+                        # peer's current-round packet on another socket);
+                        # breaking on it would truncate rounds to partial
+                        # mailboxes microseconds before completion — measured
+                        # 20x throughput loss on the PerfTest2 harness — and a
+                        # 1-round-behind replica self-heals within one round
+                        # timeout anyway.
+                        timedout = True
+                        _C_CATCHUP.inc()
+                        if TRACE.enabled:
+                            TRACE.emit("catch_up", node=self.id,
+                                       inst=self.instance_id, round=r,
+                                       next_round=int(next_round))
+                        break
+                    left_ms = int((deadline - _time.monotonic()) * 1000)
+                    if left_ms <= 0:
+                        timedout = True
+                        deadline_expired = True
+                        self.timeouts += 1
+                        _C_TIMEOUTS.inc()
+                        if TRACE.enabled:
+                            TRACE.emit(
+                                "timeout", node=self.id, inst=self.instance_id,
+                                round=r,
+                                deadline_ms=(int(prog.timeout_millis)
+                                             if use_deadline
+                                             else self.wait_cap_ms),
+                                kind="deadline" if use_deadline else "wait_cap",
+                                heard=mbox.count)
+                        if not use_deadline:
+                            log.warning(
+                                "node %d round %d: %s was idle for "
+                                "%d ms; forcing timeout (the reference would "
+                                "block forever)", self.id, r, prog,
+                                self.wait_cap_ms)
+                        break
+                    got = self.transport.recv(left_ms)
+                    if got is None:
+                        continue  # re-check the deadline
+                    if ingest(got):
+                        dirty = True
+                if (prog.is_go_ahead or not self.send_when_catching_up) \
+                        and not oob_decided:
+                    # ONE non-blocking drain, two roles.  (a) A GoAhead round
+                    # delivers messages ALREADY QUEUED in the transport before
+                    # updating (the reference delivers pending messages before
+                    # ending the round, InstanceHandler.scala:219-231):
+                    # same-round into the inbox, future rounds into the
+                    # buffer.  (b) The catch-up send policy needs the FRONTIER
+                    # visible: ingestion normally stops at the quorum break,
+                    # so a replica replaying a long backlog never sees the
+                    # rounds ahead (the reference's one-message-at-a-time loop
+                    # reads ahead by construction) — future rounds land in the
+                    # pending buffer and push next_round forward.  In role (b)
+                    # alone, post-quorum same-round payloads are DROPPED
+                    # (buffer_only): under the default policy they would have
+                    # been read next round and dropped as late, so the knob
+                    # stays behavior-neutral for the current round's update.
+                    # recv_many pulls EVERY queued frame in one batched native
+                    # drain (transport.recv_many); transports without it (bare
+                    # test doubles) fall back to the per-frame poll
+                    while True:
+                        if self._recv_many is not None:
+                            got_list = self._recv_many(0)
+                        else:
+                            got = self.transport.recv(0)
+                            got_list = [got] if got is not None else []
+                        if not got_list:
+                            break
+                        for got in got_list:
+                            ingest(got, extend_deadline=False,
+                                   buffer_only=not prog.is_go_ahead)
+                        if oob_decided or view_int():
+                            break
 
             if use_deadline:
                 self._trajectory.append(int(prog.timeout_millis))

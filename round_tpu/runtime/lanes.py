@@ -41,6 +41,7 @@ loop remains the membership-change driver) and the
 from __future__ import annotations
 
 import collections
+import os
 import pickle
 import time as _time
 from typing import Any, Dict, List, Optional, Tuple
@@ -56,11 +57,12 @@ from round_tpu.obs.trace import TRACE
 from round_tpu.runtime import codec
 from round_tpu.runtime.host import (
     _UNDECIDED, AdaptiveTimeout, _save_decision_checkpoint, _schedule_value,
-    _try_send_decision, decision_scalar, instance_io,
+    _try_send_decision, decision_scalar, instance_io, pump_coerce_encode,
 )
 from round_tpu.runtime.instances import LaneTable
 from round_tpu.runtime.log import get_logger
 from round_tpu.runtime.oob import FLAG_DECISION, FLAG_NORMAL, Tag
+from round_tpu.runtime.transport import RoundPump
 
 log = get_logger("lanes")
 
@@ -202,6 +204,7 @@ class LaneDriver:
         adaptive: Optional[AdaptiveTimeout] = None,
         wire: str = "binary",
         wait_cap_ms: int = 30_000,
+        use_pump: bool = True,
     ):
         if wire not in ("binary", "pickle"):
             raise ValueError(f"wire must be 'binary' or 'pickle', "
@@ -278,6 +281,28 @@ class LaneDriver:
             self._sendb = None
         self._recv_many = getattr(transport, "recv_many", None)
 
+        # NATIVE ROUND PUMP (native/transport.cpp rt_pump_*): the receive
+        # state machine — batch split, codec-template parse, in-place
+        # mailbox fill, arrival counts, deadlines, catch-up bookkeeping —
+        # runs inside the transport event loop, and this driver blocks in
+        # ONE pump.wait per wave instead of the 50 ms drain tick.  The
+        # Python pump above stays as the A/B baseline and the automatic
+        # fallback (no native support, ROUND_TPU_PUMP=0, tracing — the
+        # per-frame send/recv trace vocabulary needs the Python path —
+        # receiver-side chaos families, pickle wire, or a payload outside
+        # the fixed-layout vocabulary).
+        self._pump = None
+        self._pump_send = False
+        self._arm_specs = bytearray()
+        self._arm_count = 0
+        self._wave = bytearray()
+        self._entries = bytearray()
+        self._entry_count = 0
+        self._goahead_armed: set = set()
+        if (use_pump and wire == "binary" and not TRACE.enabled
+                and os.environ.get("ROUND_TPU_PUMP", "1") != "0"):
+            self._setup_pump()
+
         # instance-level bookkeeping
         self._done: Dict[int, Optional[np.ndarray]] = {}  # iid -> raw
         self._replied: Dict[Tuple[int, int], float] = {}
@@ -292,6 +317,47 @@ class LaneDriver:
         self.timeouts = 0
         self.rounds_run = 0   # cumulative across every lane and instance
         self._trajectory: List[int] = []
+
+    # -- native pump setup -------------------------------------------------
+
+    def _setup_pump(self) -> None:
+        """Try to attach the native round pump: derive each round class's
+        fixed-byte-layout template (host._payload_layouts — abstract
+        eval_shape over the send, cached on the round objects),
+        pre-allocate the class boxes and register every (lane, class)
+        mailbox slot by pointer.  Any miss — transport without the pump
+        surface, a payload outside the fixed-layout vocabulary — leaves
+        the driver on the Python pump (the fallback contract)."""
+        mk = getattr(self.transport, "enable_pump", None)
+        if mk is None:
+            return
+        from round_tpu.runtime.host import _payload_layouts
+
+        layouts = _payload_layouts(self.algo, self.id, self.n)
+        if layouts is None:
+            return  # outside the fixed-layout vocabulary
+        pump = mk(self.L, self.n, self.k, self.nbr_byzantine)
+        if pump is None:
+            return
+        for c, (exemplar, (tmpl, holes)) in enumerate(layouts):
+            box = self._boxes[c]
+            box.reset_row(0, exemplar)  # allocate [L, n, ...] + fix sig
+            for a in box.vals:
+                a[0] = 0
+            for lane in range(self.L):
+                pump.set_class(lane, c, tmpl, holes, box.vals,
+                               lane_index=lane, mask=box.mask,
+                               count=box.count, per_lane=True)
+        self._pump = pump
+        # the catch-up bookkeeping arrays are now SHARED with the native
+        # side (max_rnd written per frame there, next_round recomputed on
+        # future-round arrivals; Python keeps writing its own row/slot at
+        # round advance — disjoint elements, monotone values)
+        self._max_rnd = pump.max_rnd
+        self._max_rnd.fill(-1)
+        self._next_round = pump.next_round
+        self._pump_send = bool(getattr(self.transport, "pump_send_ok",
+                                       False))
 
     # -- static per-class progress ----------------------------------------
 
@@ -362,6 +428,10 @@ class LaneDriver:
         self._waiting[lane] = False
         self._dirty[lane] = False
         self._oob_done[lane] = False
+        if self._pump is not None:
+            # maps iid -> lane natively and resets the shared catch-up
+            # rows; frames for this instance now take the fast path
+            self._pump.open_lane(lane, iid)
         self._max_rnd[lane] = -1
         self._max_rnd[lane, self.id] = 0
         self._next_round[lane] = 0
@@ -449,6 +519,18 @@ class LaneDriver:
             return
         if tag.flag != FLAG_NORMAL:
             return
+        if self._pump is not None:
+            # pump mode: this frame reached Python because the fast path
+            # could not prove it safe (stash replay at admission, or a
+            # template miss — a legacy-pickle peer or byzantine bytes).
+            # Run it through the native state machine; a current-round
+            # template miss comes back -2 and takes the bilingual decode
+            # + canonical re-insert below.
+            rc = self._pump.feed(sender, tag, raw)
+            if rc != -2:
+                return
+            self._pump_fallback_insert(lane, sender, raw)
+            return
         r = int(self._rr[lane])
         if tag.round > self._max_rnd[lane, sender]:
             self._max_rnd[lane, sender] = tag.round
@@ -483,6 +565,34 @@ class LaneDriver:
         if grew:
             self._dirty[lane] = True
 
+    def _pump_fallback_insert(self, lane: int, sender: int, raw) -> None:
+        """The bilingual slow path of pump mode: decode (codec or the
+        restricted unpickler), coerce leaves to the slot dtypes with the
+        mailbox's own same-kind cast rule, re-encode CANONICALLY and
+        insert under the pump lock — byte-for-byte the _ClassBox.insert
+        semantics, including the malformed-sender slot clear."""
+        ok, payload = self._loads(raw)
+        if not ok:
+            return
+        box = self._boxes[int(self._rr[lane]) % self.k]
+        try:
+            enc = pump_coerce_encode(
+                payload, [(s.shape[2:], s.dtype) for s in box.vals],
+                box.treedef)
+            rc = self._pump.insert(lane, sender, enc)
+            if rc < 0:
+                raise ValueError("canonical re-encode missed the template")
+        except Exception as e:  # noqa: BLE001 — garbage must not kill us
+            self._note_malformed()
+            self._pump.mark_malformed(lane, sender)
+            log.debug("lane %d: dropping structurally-malformed payload "
+                      "from %d: %s", lane, sender, e)
+            return
+        # host.recvs accounting rides the pump stats bank (rt_pump_insert
+        # ticked fast/dup) — an inline inc here would double-count
+        if rc == 1:
+            self._dirty[lane] = True
+
     def _drain(self, timeout_ms: int) -> int:
         if self._recv_many is not None:
             got_list = self._recv_many(timeout_ms)
@@ -499,11 +609,31 @@ class LaneDriver:
         lanes = np.nonzero(self._need_send & self._live)[0]
         if lanes.size == 0:
             return
+        if self._pump is not None:
+            del self._wave[:]
+            del self._entries[:]
+            self._entry_count = 0
+            del self._arm_specs[:]
+            self._arm_count = 0
         shipped = 0
         for c in sorted({int(self._rr[l]) % self.k for l in lanes}):
             group = [int(l) for l in lanes if int(self._rr[l]) % self.k == c]
             shipped += self._send_class(c, group)
-        if shipped and self._sendb is not None:
+        if self._pump is not None:
+            # arm BEFORE the frames hit the wire: a fast peer's reply can
+            # only race into the lane's native pending buffer, never into
+            # a torn mailbox.  Then ONE crossing ships the whole wave
+            # (encode-once buffer + per-peer offsets, coalesced and sent
+            # natively) — or the per-frame Python path under chaos, where
+            # faults must keep applying per logical frame.
+            if self._arm_count:
+                self._pump.arm_specs(self._arm_specs, self._arm_count)
+            if self._entry_count and self._pump_send:
+                self._pump.flush(self._wave, self._entries,
+                                 self._entry_count)
+            elif shipped and self._sendb is not None:
+                self._flushfn()
+        elif shipped and self._sendb is not None:
             self._flushfn()
 
     def _send_class(self, c: int, group: List[int]) -> int:
@@ -561,26 +691,42 @@ class LaneDriver:
             TRACE.emit("round_start", node=self.id, inst=iid, round=r)
         sent = 0
         if dest_row.any():
-            if self._scratch is not None:
-                wire = self._scratch.encode(payload_row)
+            if self._pump is not None and self._pump_send:
+                # encode ONCE into the wave buffer; destinations become
+                # 20-byte plan entries for the single rt_pump_flush
+                # crossing at the end of the wave
+                off = len(self._wave)
+                codec.encode_into(payload_row, self._wave)
+                ln = len(self._wave) - off
+                tagw = Tag(instance=iid,
+                           round=r).pack() & 0xFFFFFFFFFFFFFFFF
+                for d in range(self.n):
+                    if d == self.id or not dest_row[d]:
+                        continue
+                    self._entries += RoundPump._ENTRY.pack(d, tagw, off, ln)
+                    self._entry_count += 1
+                    sent += 1
             else:
-                wire = pickle.dumps(jax.tree_util.tree_map(
-                    np.asarray, payload_row))
-            tag = Tag(instance=iid, round=r)
-            sendb = self._sendb
-            for d in range(self.n):
-                if d == self.id or not dest_row[d]:
-                    continue
-                if sendb is not None:
-                    sendb(d, tag, wire)
+                if self._scratch is not None:
+                    wire = self._scratch.encode(payload_row)
                 else:
-                    self.transport.send(
-                        d, tag, wire if isinstance(wire, bytes)
-                        else bytes(wire))
-                sent += 1
-                if TRACE.enabled:
-                    TRACE.emit("send", node=self.id, inst=iid, round=r,
-                               dst=d, bytes=len(wire))
+                    wire = pickle.dumps(jax.tree_util.tree_map(
+                        np.asarray, payload_row))
+                tag = Tag(instance=iid, round=r)
+                sendb = self._sendb
+                for d in range(self.n):
+                    if d == self.id or not dest_row[d]:
+                        continue
+                    if sendb is not None:
+                        sendb(d, tag, wire)
+                    else:
+                        self.transport.send(
+                            d, tag, wire if isinstance(wire, bytes)
+                            else bytes(wire))
+                    sent += 1
+                    if TRACE.enabled:
+                        TRACE.emit("send", node=self.id, inst=iid, round=r,
+                                   dst=d, bytes=len(wire))
             if sent:
                 _C_SENDS.inc(sent)
         if dest_row[self.id]:
@@ -589,7 +735,46 @@ class LaneDriver:
         self._need_send[lane] = False
         self._waiting[lane] = True
         self._dirty[lane] = True
+        if self._pump is not None:
+            self._queue_arm(lane, r, c, kind, strict, millis)
         return sent
+
+    def _queue_arm(self, lane: int, r: int, c: int, kind: int,
+                   strict: bool, millis: int) -> None:
+        """Append this lane's arm spec for the wave's single
+        rt_pump_arm_many crossing: progress threshold / growth-wake
+        flags / native deadline, mirroring _parse_progress semantics."""
+        P = RoundPump
+        thr, flags, dl, ext = 0, 0, 0, 0
+        has_go = (self._steps[c] is not None
+                  and self._steps[c].go is not None)
+        if kind == _P_TIMEOUT:
+            dl = int(millis)
+            if has_go:
+                flags |= P.F_GROWTH
+            else:
+                thr = min(self.n, int(self._expected[lane]))
+            if strict:
+                flags |= P.F_STRICT
+        elif kind == _P_GOAHEAD:
+            # arm applies the natively-buffered pending frames; the lane
+            # is ready THIS tick (queued messages delivered, then update)
+            self._goahead_armed.add(lane)
+        elif kind == _P_SYNC:
+            flags |= P.F_GROWTH | P.F_STRICT | P.F_EXTEND
+            dl = ext = self.wait_cap_ms
+        else:  # _P_WAIT
+            flags |= P.F_EXTEND
+            dl = ext = self.wait_cap_ms
+            if has_go:
+                flags |= P.F_GROWTH
+            else:
+                thr = min(self.n, int(self._expected[lane]))
+            if strict:
+                flags |= P.F_STRICT
+        self._arm_specs += P._ARM.pack(lane, r, c, thr, flags, dl, ext,
+                                       P.R_ROUND_END)
+        self._arm_count += 1
 
     def _step(self, c: int):
         step = self._steps[c]
@@ -682,6 +867,93 @@ class LaneDriver:
                 self._lane_timedout[lane] = (timedout, expired)
         return ready, oob
 
+    def _ready_pump(self) -> Tuple[List[int], List[int]]:
+        """Pump-mode readiness: translate the consumed native reason bits
+        (threshold / skew / deadline auto-disarm the lane atomically, so
+        no frame joins a mailbox between the wait returning and the
+        update dispatch) plus the Python-side probes (FoldRound go,
+        Sync barriers) into the (ready, oob) lists of _ready."""
+        ready: List[int] = []
+        oob: List[int] = []
+        self._lane_timedout = {}
+        pump = self._pump
+        reasons = pump.reasons
+        P = RoundPump
+        for lane in np.nonzero(self._waiting)[0]:
+            lane = int(lane)
+            if not self._live[lane]:
+                continue
+            if self._oob_done[lane]:
+                pump.disarm(lane)
+                oob.append(lane)
+                continue
+            if lane in self._goahead_armed:
+                self._goahead_armed.discard(lane)
+                pump.disarm(lane)
+                ready.append(lane)
+                self._lane_timedout[lane] = (False, False)
+                continue
+            rs = int(reasons[lane])
+            if not rs:
+                continue
+            if rs & P.R_THRESH:
+                ready.append(lane)
+                self._lane_timedout[lane] = (False, False)
+                continue
+            if rs & P.R_SKEW:
+                _C_CATCHUP.inc()
+                if TRACE.enabled:
+                    TRACE.emit(
+                        "catch_up", node=self.id,
+                        inst=int(self._inst[lane]) & 0xFFFF,
+                        round=int(self._rr[lane]),
+                        next_round=int(self._next_round[lane]))
+                ready.append(lane)
+                self._lane_timedout[lane] = (True, False)
+                continue
+            if rs & P.R_DEADLINE:
+                self.timeouts += 1
+                _C_TIMEOUTS.inc()
+                if TRACE.enabled:
+                    c = int(self._rr[lane]) % self.k
+                    TRACE.emit(
+                        "timeout", node=self.id,
+                        inst=int(self._inst[lane]) & 0xFFFF,
+                        round=int(self._rr[lane]),
+                        kind=("deadline" if self._use_deadline[lane]
+                              else "wait_cap"),
+                        heard=int(self._boxes[
+                            int(self._rr[lane]) % self.k].count[lane]))
+                ready.append(lane)
+                self._lane_timedout[lane] = (True, True)
+                continue
+            if rs & (P.R_GROWTH | P.R_POKE):
+                self._dirty[lane] = True
+        # FoldRound go probes (one batched dispatch per class) + Sync
+        # barriers for the grown lanes
+        go_by_class = self._probe_go()
+        for lane in np.nonzero(self._waiting & self._dirty)[0]:
+            lane = int(lane)
+            if lane in self._lane_timedout or self._oob_done[lane] \
+                    or not self._live[lane]:
+                continue
+            c = int(self._rr[lane]) % self.k
+            kind, _strict, kparam = self._prog[c]
+            step = self._steps[c]
+            go = False
+            if step is not None and step.go is not None:
+                g = go_by_class.get(c)
+                go = bool(g[lane]) if g is not None else False
+            elif kind == _P_SYNC:
+                go = int((self._max_rnd[lane] >= self._rr[lane]).sum()) \
+                    >= kparam + self.nbr_byzantine
+            self._dirty[lane] = False
+            if go:
+                pump.disarm(lane)
+                ready.append(lane)
+                self._lane_timedout[lane] = (False, False)
+        return ready, oob
+
     def _update_wave(self, ready: List[int]) -> List[Tuple[int, bool]]:
         """One mega-step update per round class with ready lanes; returns
         [(lane, exited)]."""
@@ -726,6 +998,11 @@ class LaneDriver:
         results[inst - 1] = decision_scalar(decision) if decided else None
         self._done[iid] = raw
         completed.add(inst)
+        if self._pump is not None:
+            # retire the fast-path mapping: the instance's late traffic
+            # flows to the inbox again, where the TooLate reply lives
+            self._pump.close_lane(lane)
+            self._goahead_armed.discard(lane)
         self.table.retire(iid)
         self._live[lane] = False
         self._waiting[lane] = False
@@ -808,15 +1085,38 @@ class LaneDriver:
                 self._admit(next_admit)
                 next_admit += 1
             self._send_wave()
-            now = _time.monotonic()
-            live_deadlines = self._deadline[self._waiting]
-            if live_deadlines.size:
-                wait_s = max(0.0, float(live_deadlines.min()) - now)
-                timeout_ms = int(min(wait_s * 1000.0, 50.0))
+            if self._pump is not None:
+                # ONE blocking native wait per wave: deadlines, progress
+                # thresholds and skew are evaluated inside the event loop
+                # with no GIL held — the 50 ms Python drain tick is gone.
+                # Misc traffic (decisions, foreign instances, template
+                # misses) interrupts the wait and drains via the inbox.
+                # non-blocking when a lane needs immediate service: a
+                # GoAhead lane, or a freshly-armed lane whose dirty flag
+                # is set (self-delivery/prefill may ALREADY satisfy a go
+                # probe or sync barrier, and the native side raises no
+                # GROWTH wake for frames applied at arm — the probe in
+                # _ready_pump must run this tick, not after a full wait)
+                nready, misc = self._pump.wait(
+                    0 if (self._goahead_armed
+                          or bool(np.any(self._waiting & self._dirty)))
+                    else 2000)
+                if nready < 0:
+                    raise RuntimeError(
+                        "transport stopped under the lane driver")
+                if misc:
+                    self._drain(0)
+                ready, oob = self._ready_pump()
             else:
-                timeout_ms = 0
-            self._drain(timeout_ms)
-            ready, oob = self._ready()
+                now = _time.monotonic()
+                live_deadlines = self._deadline[self._waiting]
+                if live_deadlines.size:
+                    wait_s = max(0.0, float(live_deadlines.min()) - now)
+                    timeout_ms = int(min(wait_s * 1000.0, 50.0))
+                else:
+                    timeout_ms = 0
+                self._drain(timeout_ms)
+                ready, oob = self._ready()
             for lane in oob:
                 # oob adoption skips the update (the per-instance driver
                 # exits the accumulate loop without folding the mailbox)
@@ -867,6 +1167,15 @@ class LaneDriver:
                     self._finish_lane(
                         lane, bool(decided_v[lane]), decision_v[lane],
                         results, checkpoint_dir, completed, instances)
+        if self._pump is not None:
+            # fold the native fast-path stats into the unified metrics:
+            # pump.* vocabulary plus host.recvs/host.malformed parity (a
+            # message C++ ingested counts exactly like one Python did)
+            d = self._pump.bank_metrics()
+            _C_RECVS.inc(int(d[0] + d[1]))
+            if d[6]:
+                self.malformed += int(d[6])
+                _C_MALFORMED.inc(int(d[6]))
         if stats_out is not None:
             for key, v in (("timeouts", self.timeouts),
                            ("rounds_run", self.rounds_run),
@@ -894,17 +1203,19 @@ def run_instance_loop_lanes(
     adaptive: Optional[AdaptiveTimeout] = None,
     checkpoint_dir: Optional[str] = None,
     wire: str = "binary",
+    use_pump: bool = True,
 ) -> List[Optional[int]]:
     """The lane-batched form of run_instance_loop: same schedule, same
     seeds, same decision-log shape — the work just flows through one
     vmapped mega-step per round class instead of one Python round loop per
     instance (module docstring).  Cross-checkable against the per-instance
-    drivers byte-for-byte (tests/test_lanes.py)."""
+    drivers byte-for-byte (tests/test_lanes.py).  ``use_pump=False`` pins
+    the Python pump (the native-pump A/B baseline, tests/test_pump.py)."""
     driver = LaneDriver(
         algo, my_id, peers, transport, lanes=lanes, timeout_ms=timeout_ms,
         seed=seed, base_value=base_value, max_rounds=max_rounds,
         nbr_byzantine=nbr_byzantine, value_schedule=value_schedule,
-        adaptive=adaptive, wire=wire,
+        adaptive=adaptive, wire=wire, use_pump=use_pump,
     )
     return driver.run(instances, checkpoint_dir=checkpoint_dir,
                       stats_out=stats_out)

@@ -43,6 +43,8 @@ import subprocess
 import threading
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from round_tpu.obs.metrics import METRICS
 from round_tpu.obs.trace import TRACE
 from round_tpu.runtime.oob import FLAG_BATCH, Message, Tag
@@ -181,8 +183,82 @@ def _load() -> ctypes.CDLL:
         lib.rt_node_dropped.argtypes = [ctypes.c_void_p]
         lib.rt_node_stop.argtypes = [ctypes.c_void_p]
         lib.rt_node_destroy.argtypes = [ctypes.c_void_p]
+        # round pump API (native round state machine; tolerate an older
+        # .so without it — enable_pump then reports unavailable and the
+        # drivers keep the Python pump)
+        try:
+            lib.rt_pump_enable.restype = ctypes.c_int
+            lib.rt_pump_enable.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
+            lib.rt_pump_disable.argtypes = [ctypes.c_void_p]
+            lib.rt_pump_set_class.restype = ctypes.c_int
+            lib.rt_pump_set_class.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_char), ctypes.c_int,
+                ctypes.POINTER(ctypes.c_char), ctypes.c_int,
+                ctypes.POINTER(ctypes.c_char), ctypes.c_int,
+                ctypes.c_void_p, ctypes.c_void_p,
+            ]
+            lib.rt_pump_open_lane.restype = ctypes.c_int
+            lib.rt_pump_open_lane.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+            lib.rt_pump_close_lane.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.rt_pump_arm.restype = ctypes.c_int
+            lib.rt_pump_arm.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong,
+                ctypes.c_int, ctypes.c_longlong, ctypes.c_uint32,
+                ctypes.c_int, ctypes.c_int, ctypes.c_uint8,
+            ]
+            lib.rt_pump_arm_many.restype = ctypes.c_int
+            lib.rt_pump_arm_many.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_char), ctypes.c_int]
+            lib.rt_pump_disarm.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.rt_pump_wait.restype = ctypes.c_int
+            lib.rt_pump_wait.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int),
+            ]
+            lib.rt_pump_wait_lane.restype = ctypes.c_int
+            lib.rt_pump_wait_lane.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+            lib.rt_pump_poke.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.rt_pump_feed.restype = ctypes.c_int
+            lib.rt_pump_feed.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_char), ctypes.c_int,
+            ]
+            lib.rt_pump_insert.restype = ctypes.c_int
+            lib.rt_pump_insert.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_char), ctypes.c_int,
+            ]
+            lib.rt_pump_mark_malformed.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+            lib.rt_pump_flush.restype = ctypes.c_int
+            lib.rt_pump_flush.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_char),
+                ctypes.POINTER(ctypes.c_char), ctypes.c_int, ctypes.c_int,
+                ctypes.c_void_p,
+            ]
+            lib._has_pump = True
+        except AttributeError:  # pragma: no cover - stale prebuilt .so
+            lib._has_pump = False
         _lib = lib
         return lib
+
+
+def native_available() -> bool:
+    """True when the native transport library builds/loads in this
+    environment — the skip-not-fail guard for toolchain-less CI boxes
+    (tests skip native-path suites instead of failing tier-1)."""
+    try:
+        _load()
+        return True
+    except Exception:  # noqa: BLE001 — missing toolchain, broken gcc, ...
+        return False
 
 
 class HostTransport:
@@ -264,6 +340,40 @@ class HostTransport:
         # replica (observed: a renamed replica's reconnect thread redialed
         # severed peers mid-rewire and resurrected the pre-change mapping)
         self._churn_lock = threading.Lock()
+        self._pump: Optional["RoundPump"] = None
+
+    # the native rt_pump_flush send path may be used on THIS transport —
+    # but only while its Python send surface is the stock one: a fault
+    # wrapper (chaos.FaultyTransport does not re-export this property), a
+    # subclass override, or a monkey-patched send/send_buffered (the
+    # loss-injecting test doubles) must keep seeing every frame, so the
+    # drivers then stay on the per-frame send_buffered surface
+    @property
+    def pump_send_ok(self) -> bool:
+        return ("send" not in self.__dict__
+                and "send_buffered" not in self.__dict__
+                and type(self).send_buffered is HostTransport.send_buffered
+                and type(self).send is HostTransport.send)
+
+    def enable_pump(self, L: int, n: int, k: int,
+                    nbz: int = 0) -> Optional["RoundPump"]:
+        """Attach (or reconfigure) the native round pump: L lanes over n
+        processes and k round classes.  Returns None — and callers keep
+        the Python pump — when the native side lacks the pump API (stale
+        prebuilt .so) or ``ROUND_TPU_PUMP=0`` disables it."""
+        if os.environ.get("ROUND_TPU_PUMP", "1") == "0":
+            return None
+        if not self._node or not getattr(self._lib, "_has_pump", False):
+            return None
+        if self._pump is not None:
+            self._pump.close()
+        self._pump = RoundPump(self, L, n, k, nbz)
+        return self._pump
+
+    def disable_pump(self) -> None:
+        if self._pump is not None:
+            self._pump.close()
+            self._pump = None
 
     def add_peer(self, peer_id: int, host: str, port: int) -> None:
         if not self._node:
@@ -640,6 +750,7 @@ class HostTransport:
         the pattern)."""
         self._stop_reconnect()
         if self._node:
+            self.disable_pump()
             self._lib.rt_node_stop(self._node)
             self._lib.rt_node_destroy(self._node)
             self._node = None
@@ -650,6 +761,225 @@ class HostTransport:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class RoundPump:
+    """Python handle on the NATIVE round pump (native/transport.cpp
+    rt_pump_*): the per-round receive state machine — FLAG_BATCH split,
+    codec-template parse, in-place mailbox fill, arrival bitmasks,
+    deadline bookkeeping — runs inside the transport event loop with no
+    GIL held, and the driver blocks in ONE call (`wait`) per round wave.
+
+    The pump exposes SHARED numpy buffers by pointer: ``max_rnd`` [L, n]
+    and ``next_round`` [L] (the catch-up bookkeeping the drivers used to
+    maintain per message), and ``stats`` (folded into the ``pump.*``
+    metrics by :meth:`bank_metrics`).  Mailbox buffers are registered per
+    (lane, round-class) via :meth:`set_class` — they are the drivers' own
+    preallocated arrays, written natively only while the lane is ARMED.
+
+    Obtain one via ``HostTransport.enable_pump`` (or through
+    ``FaultyTransport``, which delegates when its fault plan has no
+    receiver-side families).  ``None`` from those calls means the pump is
+    unavailable (older .so, ``ROUND_TPU_PUMP=0``) and callers keep the
+    Python pump — the automatic-fallback contract."""
+
+    # arm flags (native kPump*)
+    F_GROWTH, F_EXTEND, F_STRICT = 1, 2, 4
+    # ready reasons (native kReady*)
+    R_THRESH, R_GROWTH, R_SKEW, R_DEADLINE, R_POKE = 1, 2, 4, 8, 16
+    R_ROUND_END = R_THRESH | R_SKEW | R_DEADLINE  # default auto-disarm set
+
+    _ARM = struct.Struct("<iiiqIiiB")
+    _ENTRY = struct.Struct("<iQII")
+    _LEAF = struct.Struct("<QI")
+    _HOLE = struct.Struct("<III")
+
+    def __init__(self, transport: "HostTransport", L: int, n: int, k: int,
+                 nbz: int = 0):
+        self._tr = transport
+        self._lib = transport._lib
+        self.L, self.n, self.k, self.nbz = L, n, k, nbz
+        self.max_rnd = np.full((L, n), -1, dtype=np.int64)
+        self.next_round = np.zeros((L,), dtype=np.int64)
+        self.stats = np.zeros(16, dtype=np.uint64)
+        self._banked = np.zeros(16, dtype=np.uint64)
+        self.reasons = np.zeros(L, dtype=np.uint8)
+        self._misc = ctypes.c_int()
+        self._flush_stats = np.zeros(5, dtype=np.uint64)
+        # registered mailbox arrays, pinned against GC: the native side
+        # holds RAW pointers into them for the pump's lifetime
+        self._pinned: list = []
+        rc = self._lib.rt_pump_enable(
+            transport._node, L, n, k, nbz,
+            self.max_rnd.ctypes.data, self.next_round.ctypes.data,
+            self.stats.ctypes.data)
+        if rc != 0:
+            raise OSError(f"rt_pump_enable failed (rc={rc})")
+
+    def _node(self):
+        node = self._tr._node
+        if not node:
+            raise RuntimeError("transport closed under the pump")
+        return node
+
+    def set_class(self, lane: int, cls: int, template: bytes, holes,
+                  leaf_arrays, lane_index: int = 0, mask=None,
+                  count=None, per_lane: bool = False) -> None:
+        """Register one (lane, class) slot.  ``leaf_arrays`` are the
+        driver's preallocated mailbox arrays in tree_flatten leaf order —
+        ``[n, ...]`` (per_lane=False: a per-instance runner's own
+        mailbox, mask ``[n]``, count ``[1]``) or ``[L, n, ...]``
+        (per_lane=True: the lane driver's class box, row ``lane_index``,
+        mask ``[L, n]``, count ``[L]``)."""
+        leaves = bytearray()
+        for arr in leaf_arrays:
+            row_nbytes = arr.nbytes // arr.shape[0]
+            if per_lane:
+                base = arr.ctypes.data + lane_index * row_nbytes
+                nbytes = row_nbytes // self.n
+            else:
+                base = arr.ctypes.data
+                nbytes = row_nbytes
+            leaves += self._LEAF.pack(base, nbytes)
+        hb = bytearray()
+        for off, nbytes, leaf in holes:
+            hb += self._HOLE.pack(off, nbytes, leaf)
+        if per_lane:
+            mask_addr = mask.ctypes.data + lane_index * mask.shape[1]
+            count_addr = count.ctypes.data + lane_index * 8
+        else:
+            mask_addr = mask.ctypes.data
+            count_addr = count.ctypes.data
+        self._pinned.append((mask, count, tuple(leaf_arrays)))
+        rc = self._lib.rt_pump_set_class(
+            self._node(), lane, cls,
+            (ctypes.c_char * len(template)).from_buffer_copy(template),
+            len(template),
+            (ctypes.c_char * len(hb)).from_buffer(hb), len(hb) // 12,
+            (ctypes.c_char * len(leaves)).from_buffer(leaves),
+            len(leaves) // 12, mask_addr, count_addr)
+        if rc != 0:
+            raise ValueError("rt_pump_set_class rejected the registration")
+
+    def open_lane(self, lane: int, iid: int) -> None:
+        self.max_rnd[lane] = -1
+        self.next_round[lane] = 0
+        self._lib.rt_pump_open_lane(self._node(), lane, iid & 0xFFFF)
+
+    def close_lane(self, lane: int) -> None:
+        self._lib.rt_pump_close_lane(self._node(), lane)
+
+    def arm(self, lane: int, rnd: int, cls: int, threshold: int,
+            flags: int = 0, deadline_ms: int = 0, extend_ms: int = 0,
+            auto_disarm: Optional[int] = None) -> None:
+        self._lib.rt_pump_arm(
+            self._node(), lane, rnd, cls, threshold, flags, deadline_ms,
+            extend_ms,
+            self.R_ROUND_END if auto_disarm is None else auto_disarm)
+
+    def arm_specs(self, specs: bytearray, count: int) -> None:
+        """Batched arm — one crossing per send wave.  ``specs`` is
+        ``count`` packed ``_ARM`` records (lane, round, cls, threshold,
+        flags, deadline_ms, extend_ms, auto_disarm)."""
+        rc = self._lib.rt_pump_arm_many(
+            self._node(), (ctypes.c_char * len(specs)).from_buffer(specs),
+            count)
+        if rc != 0:
+            raise ValueError("rt_pump_arm_many rejected a spec")
+
+    def disarm(self, lane: int) -> None:
+        self._lib.rt_pump_disarm(self._node(), lane)
+
+    def wait(self, timeout_ms: int) -> Tuple[int, bool]:
+        """Block until a lane is ready, misc inbox traffic arrived, or
+        the timeout; reasons land in ``self.reasons`` (consumed bits —
+        round-ending reasons disarm atomically).  Returns
+        (ready_lane_count, misc).  -3 (node stopped) returns (-1, False)
+        so callers unwind."""
+        rc = self._lib.rt_pump_wait(
+            self._node(), self.reasons.ctypes.data, timeout_ms,
+            ctypes.byref(self._misc))
+        if rc == -3:
+            return -1, False
+        return rc, bool(self._misc.value)
+
+    def wait_lane(self, lane: int, timeout_ms: int) -> int:
+        """Single-lane wait (mux runners): the lane's consumed reason
+        bits, 0 on timeout, -3 once the node stopped."""
+        return self._lib.rt_pump_wait_lane(self._node(), lane, timeout_ms)
+
+    def poke(self, lane: int) -> None:
+        self._lib.rt_pump_poke(self._node(), lane)
+
+    def feed(self, sender: int, tag: Tag, raw) -> int:
+        """Run one frame through the native state machine from Python
+        (stash replay, inbox-fallback re-routing): 1 consumed, 0 not
+        pump-routable, -2 template miss at the armed current round."""
+        b = raw if isinstance(raw, bytes) else bytes(raw)
+        return self._lib.rt_pump_feed(
+            self._node(), sender, tag.pack() & 0xFFFFFFFFFFFFFFFF,
+            b, len(b))
+
+    def insert(self, lane: int, sender: int, encoded: bytes) -> int:
+        """Template-checked canonical insert under the pump lock (the
+        bilingual fallback after a Python decode): 1 grew, 0 duplicate,
+        -1 structural mismatch."""
+        return self._lib.rt_pump_insert(
+            self._node(), lane, sender, encoded, len(encoded))
+
+    def mark_malformed(self, lane: int, sender: int) -> None:
+        self._lib.rt_pump_mark_malformed(self._node(), lane, sender)
+
+    def flush(self, base, entries: bytearray, count: int) -> int:
+        """Ship one send wave: ``entries`` = ``count`` packed ``_ENTRY``
+        records (dest, tag, off, len) into ``base`` (the wave's
+        encode-once buffer).  One ctypes crossing coalesces per-peer
+        FLAG_BATCH containers and does every syscall natively; wire.*
+        counters are fed from the returned stats."""
+        node = self._tr._node
+        if not node:
+            return 0
+        frames = self._lib.rt_pump_flush(
+            node, (ctypes.c_char * len(base)).from_buffer(base),
+            (ctypes.c_char * len(entries)).from_buffer(entries), count,
+            self._tr.batch_cap, self._flush_stats.ctypes.data)
+        st = self._flush_stats
+        if frames > 0:
+            _C_WIRE_SENT.inc(int(st[0]))
+            _C_WIRE_SENT_B.inc(int(st[1]))
+            if st[2]:
+                _C_BATCHES.inc(int(st[2]))
+                _C_BATCH_FRAMES.inc(int(st[3]))
+                _C_BATCH_BYTES.inc(int(st[4]))
+        return frames
+
+    # -- observability ------------------------------------------------------
+
+    _STAT_NAMES = (
+        "pump.fast_frames", "pump.dup_frames", "pump.pending_buffered",
+        "pump.pending_applied", "pump.fallbacks", "pump.late_drops",
+        "pump.malformed", "pump.waits", "pump.ready_wakes",
+        "pump.misc_wakes", "pump.batches_split", "pump.batch_malformed",
+    )
+
+    def delta(self) -> np.ndarray:
+        """Native stat deltas since the last bank_metrics() call."""
+        return (self.stats - self._banked).astype(np.int64)
+
+    def bank_metrics(self) -> np.ndarray:
+        """Fold the native stat deltas into the unified ``pump.*``
+        counters (docs/OBSERVABILITY.md); returns the deltas."""
+        d = self.delta()
+        for i, name in enumerate(self._STAT_NAMES):
+            if d[i]:
+                METRICS.counter(name).inc(int(d[i]))
+        self._banked = self.stats.copy()
+        return d
+
+    def close(self) -> None:
+        node = self._tr._node
+        if node:
+            self._lib.rt_pump_disable(node)
 
 
 def _to_signed64(v: int) -> int:

@@ -431,3 +431,70 @@ def test_perf_batched_drain_beats_per_message_recv():
     t_batch = min(run(True) for _ in range(3))
     t_plain = min(run(False) for _ in range(3))
     assert t_batch < t_plain * 1.10, (t_batch, t_plain)
+
+
+# ---------------------------------------------------------------------------
+# the NATIVE PARSER's header contract (ISSUE 7 satellite): the C round
+# pump (native/transport.cpp rt_pump_*) parses codec payloads by memcmp
+# of the structural bytes against a template and memcpy of the array-data
+# holes.  These golden-bytes pins make a Python-side codec edit that
+# would desync the C parser fail LOUDLY here, not corrupt mailboxes.
+# ---------------------------------------------------------------------------
+
+
+def test_golden_tag_bytes_pinned():
+    # the 0xA0.. node-tag vocabulary is shared with the C parser (and
+    # chosen to never collide with a pickle stream's first byte)
+    assert (codec.T_NONE, codec.T_TRUE, codec.T_FALSE) == (0xA0, 0xA1, 0xA2)
+    assert (codec.T_INT, codec.T_FLOAT, codec.T_ARRAY) == (0xA3, 0xA4, 0xA5)
+    assert (codec.T_TUPLE, codec.T_LIST, codec.T_DICT) == (0xA6, 0xA7, 0xA8)
+    assert (codec.T_STR, codec.T_BYTES, codec.T_PICKLE) == (0xA9, 0xAA, 0xAF)
+
+
+def test_golden_dtype_vocabulary_pinned():
+    # dtype CODES are table indices: reordering or inserting mid-table
+    # changes every wire byte after it — append-only, pinned here
+    want = ["bool", "int8", "int16", "int32", "int64", "uint8", "uint16",
+            "uint32", "uint64", "float16", "float32", "float64",
+            "complex64", "complex128"]
+    names = [dt.name for dt in codec._DTYPES]
+    assert names[:14] == want, names
+    assert len(codec._DTYPES) <= 16  # bf16 may append; codes stay 1 byte
+
+
+def test_golden_payload_bytes_pinned():
+    # a representative hot-path payload, byte for byte.  int32 code = 3,
+    # float64 code = 11 (table indices above); little-endian fixed-width
+    # fields throughout — exactly what the C parser memcmp/memcpys.
+    payload = {"x": np.arange(2, dtype=np.int32), "y": np.float64(2.5)}
+    got = codec.encode(payload)
+    want = bytes(
+        [0xA8, 2, 0, 0, 0]              # DICT count=2
+        + [1, 0] + list(b"x")           # klen=1 "x"
+        + [0xA5, 3, 1, 2, 0, 0, 0]      # ARRAY int32 ndim=1 dim=2
+        + list(np.arange(2, dtype="<i4").tobytes())   # data @ 15
+        + [1, 0] + list(b"y")           # klen=1 "y"
+        + [0xA5, 11, 0]                 # ARRAY float64 ndim=0
+        + list(np.float64(2.5).tobytes()))            # data @ 29
+    assert got == want, got.hex()
+    # the layout contract: template == encoding, holes are exactly the
+    # two raw-data regions, flat indices follow SORTED dict keys
+    tmpl, holes = codec.array_layout(payload)
+    assert tmpl == want
+    assert holes == [(15, 8, 0), (29, 8, 1)], holes
+
+
+def test_golden_batch_framing_pinned():
+    # FLAG_BATCH container framing shared with the C splitter/builder:
+    # sub-frame header u64 tag | u32 len (little-endian), container tag
+    # = FLAG_BATCH | count << 32, batched-drain record i32|u64|u32
+    from round_tpu.runtime.oob import FLAG_BATCH
+    from round_tpu.runtime.transport import _BATCH_HDR, _RECV_HDR
+
+    assert FLAG_BATCH == 0xB7
+    assert _BATCH_HDR.format == "<QI" and _BATCH_HDR.size == 12
+    assert _RECV_HDR.format == "<iQI" and _RECV_HDR.size == 16
+    container_tag = Tag(instance=0, round=3, flag=FLAG_BATCH).pack()
+    assert container_tag == (3 << 32) | 0xB7
+    sub = _BATCH_HDR.pack(Tag(instance=7, round=1).pack(), 4) + b"\x01\x02\x03\x04"
+    assert sub[:12] == (Tag(instance=7, round=1).pack()).to_bytes(8, "little") + (4).to_bytes(4, "little")

@@ -617,6 +617,55 @@ def check_host_lanes(rng, it):
     return cfg
 
 
+def check_host_pump(rng, it):
+    """The host-pump rotation rung: the interleaved PUMP A/B
+    (apps/host_perftest.measure_pump_ab — Python round pump vs the
+    native round pump, native/transport.cpp rt_pump_*) banked into
+    SOAK.jsonl together with the host.round_ms histogram buckets of the
+    rotation slot, so the round-wall distribution's distance to the ~2 ms
+    transport floor (PERF_MODEL.md "native round pump") is a trajectory,
+    not a one-off.  Gate: native/python >= 1.0 with the same noise margin
+    as the other host rungs — the pump must never REGRESS decisions/sec.
+    ~20-30 s (thread mode, in-process)."""
+    from round_tpu.apps.host_perftest import measure_pump_ab
+
+    before = {
+        k: v for k, v in METRICS.snapshot(compact=True)["counters"].items()
+        if k.startswith("pump.")}
+    res = measure_pump_ab(n=4, instances=20, timeout_ms=300, pairs=3,
+                          warmup=1)
+    med_ratio = (res["extra"]["median_native_pump"]
+                 / max(res["extra"]["median_python_pump"], 1e-9))
+    after = METRICS.snapshot(compact=True)
+    pump_counters = {
+        k: v - before.get(k, 0) for k, v in after["counters"].items()
+        if k.startswith("pump.")}
+    # the round-wall histogram: cumulative process buckets — the banked
+    # record carries the full bucket vector so trajectories can diff
+    round_ms = after.get("histograms", {}).get("host.round_ms")
+    cfg = dict(kind="host-pump", it=it, ratio=res["value"],
+               median_ratio=round(med_ratio, 3),
+               dps_python_pump=res["extra"]["dps_python_pump"],
+               dps_native_pump=res["extra"]["dps_native_pump"],
+               samples_python_pump=res["extra"]["samples_python_pump"],
+               samples_native_pump=res["extra"]["samples_native_pump"],
+               instances=res["extra"]["instances"],
+               pump_counters=pump_counters,
+               round_ms_histogram=round_ms)
+    if pump_counters.get("pump.fast_frames", 0) <= 0:
+        return {**cfg, "fail": "native pump never engaged (fast_frames "
+                               "== 0): the A/B silently measured "
+                               "python-vs-python"}
+    # same noise-margin discipline as host-perf/host-lanes: per-arm
+    # spread is +/-30-40% at pairs=3, so gate on mean AND median both
+    # losing decisively; the banked ratio trajectory is the fine monitor
+    if res["value"] < 0.85 and med_ratio < 0.85:
+        return {**cfg, "fail": f"pump A/B regression: native/python mean "
+                               f"{res['value']} and median "
+                               f"{round(med_ratio, 3)} both < 0.85"}
+    return cfg
+
+
 def check_host_chaos(rng, it):
     """The host-chaos rotation rung: a real 3-process cluster under a
     seeded wire-fault schedule (runtime/chaos.py FaultyTransport: the
@@ -683,7 +732,7 @@ def main():
                 check_lattice, check_tpc_kset, check_erb,
                 lambda r, i: check_otr_family(r, i, scale=True),
                 check_otr_flagship_shape, check_host_chaos, check_lint,
-                check_host_perf, check_host_lanes,
+                check_host_perf, check_host_lanes, check_host_pump,
                 lambda r, i: check_host_perf(r, i, payload=True)]
     while time.monotonic() < t_end:
         check = rotation[it % len(rotation)]
