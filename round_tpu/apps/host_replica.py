@@ -101,6 +101,14 @@ def main(argv=None) -> int:
                          "FaultyTransport with this seeded fault plan, "
                          "e.g. 'drop=0.2,reorder=0.15,dup=0.05,seed=7' "
                          "(families mirror engine/scenarios.py)")
+    ap.add_argument("--chaos-schedule", type=str, default=None,
+                    metavar="ARTIFACT",
+                    help="wrap the transport in FaultyTransport's "
+                         "EXPLICIT-schedule mode: drop exactly the "
+                         "(src,dst,round) links a fuzz schedule artifact "
+                         "(round_tpu/fuzz, docs/FUZZING.md) names — the "
+                         "deterministic replay of an engine finding on "
+                         "the real wire (mutually exclusive with --chaos)")
     ap.add_argument("--checkpoint-dir", type=str, default=None,
                     help="durably checkpoint the decision list after "
                          "every instance (runtime/checkpoint.py atomic "
@@ -296,6 +304,9 @@ def main(argv=None) -> int:
             DecisionLog.from_values(decisions).dump_values_tsv(
                 args.decision_log)
 
+    if args.chaos and args.chaos_schedule:
+        ap.error("--chaos and --chaos-schedule are mutually exclusive "
+                 "(an explicit schedule replaces the hash families)")
     with HostTransport(args.id, peers[args.id][1], proto=args.proto) as raw_tr:
         tr = raw_tr
         if args.chaos:
@@ -303,6 +314,16 @@ def main(argv=None) -> int:
 
             tr = FaultyTransport(raw_tr, FaultPlan.parse(args.chaos),
                                  n=len(peers))
+        elif args.chaos_schedule:
+            from round_tpu.runtime.chaos import FaultyTransport
+
+            tr = FaultyTransport.from_schedule_file(
+                raw_tr, args.chaos_schedule)
+            if tr.n != len(peers):
+                ap.error(f"--chaos-schedule artifact is for n={tr.n} "
+                         f"but the cluster has {len(peers)} replicas — "
+                         "a partial replay would silently diverge from "
+                         "the engine finding")
         if args.reconnect_ms > 0:
             # churn tolerance: dead peers are re-dialed on a period with
             # backoff (a restarted replica is re-admitted with NO manual
@@ -493,7 +514,7 @@ def main(argv=None) -> int:
             "timeouts": stats.get("timeouts", 0),
             "timeout_trajectory": stats.get("timeout_trajectory", []),
         }
-        if args.chaos:
+        if args.chaos or args.chaos_schedule:
             summary["chaos_injected"] = tr.injected
         if manager is not None:
             # the view trajectory: final epoch/n/id, the applied op

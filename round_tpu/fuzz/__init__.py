@@ -1,0 +1,24 @@
+"""Coverage-guided fault-schedule search on the batched engine.
+
+The ROADMAP item-5 subsystem: treat the per-(seed, src, dst, round) fault
+schedule as a GENOME, evaluate thousands of candidate schedules per jitted
+dispatch as engine scenario lanes, score them by spec-derived objectives
+(undecided-at-horizon, agreement margin, rounds-to-decide, validity slack),
+and evolve toward the schedules that hurt.  A winning schedule is
+delta-debugged down to a minimal reproducer and exported as a portable JSON
+artifact that replays byte-identically on the real multi-process host wire
+(runtime/chaos.FaultyTransport explicit-schedule mode) — a finding made on
+TPU/CPU-sim becomes a deterministic host regression test.
+
+Modules:
+  genome     — schedule tensors + per-family mutation/crossover operators
+  objectives — lane scores computed inside the jitted evaluation step
+  search     — the generational loop with coverage/novelty bookkeeping
+  minimize   — batched delta-debugging down to a minimal link set
+  replay     — artifact schema + engine / host-wire replay harnesses
+
+Entry point: ``python -m round_tpu.apps.fuzz_cli`` (docs/FUZZING.md).
+"""
+
+from round_tpu.fuzz.genome import Population  # noqa: F401
+from round_tpu.fuzz.search import FuzzResult, make_target, search  # noqa: F401

@@ -1,0 +1,333 @@
+"""Fault-schedule genomes: the fault model as an evolvable tensor.
+
+A genome is one structured fault schedule — exactly the per-scenario
+parameterisation the fused engine already batches (`engine.fast.FaultMix`:
+crash sets, partition sides, a rotating suppressed coordinator, an
+iid-omission threshold, hash salts) plus a byzantine-silence membership
+mask.  Because every field is data, a POPULATION of genomes is one pytree
+with a leading [P] axis, and evaluating all P candidates is one vmapped
+engine dispatch over the scenario axis (fuzz/search.py).
+
+Three invariants make any genome portable across the whole system:
+
+  * engine-runnable: `row_sampler` extends `scenarios.from_fault_params`
+    (the FaultMix replay bridge) with the byzantine-silence term, so a
+    genome runs under the general engine's `run_phases` unchanged;
+  * schedule-expressible: `row_schedule` materializes the genome into an
+    explicit ``[T, n, n]`` HO schedule, bit-identical to what the sampler
+    draws (`scenarios.from_schedule` replays it) — the form fuzz/minimize.py
+    delta-debugs and fuzz/replay.py exports;
+  * host-replayable: the materialized schedule drives
+    `runtime.chaos.FaultyTransport` in explicit-schedule mode, dropping the
+    same (src, dst, round) frames on a real multi-process wire.
+
+Mutation/crossover operate PER FAULT FAMILY (omission, crash, partition,
+coordinator-down, byzantine-silence, link salts) so recombination keeps
+families coherent instead of splicing unrelated tensor rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools as _functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.engine import scenarios
+from round_tpu.engine.fast import FaultMix
+
+# Byzantine-silence stream constant: the per-(round, link) "is this
+# receiver in the silenced half" Bernoulli(1/2) draws from the SAME
+# counter-based link hash as every other family (scenarios.link_bernoulli's
+# mix), under a stream constant disjoint from runtime/chaos.py's so one
+# salt pair yields independent schedules per family.
+STREAM_BYZ = 0xB5F0D1E3
+
+# omission mutation cap: p8 < 232 (~91% loss) keeps the all-drop schedule
+# out of the search space — "drop everything" degrades every protocol and
+# teaches nothing; the interesting schedules are sparse (see severity)
+P8_CAP = 232
+
+#: the family blocks crossover inherits wholesale (field name -> leaves)
+FAMILIES: Dict[str, tuple] = {
+    "omission": ("p8",),
+    "crash": ("crashed", "crash_round"),
+    "partition": ("side", "heal_round"),
+    "rotate": ("rotate_down",),
+    "byz": ("byz",),
+    "salts": ("salt0", "salt1"),
+}
+
+_FIELDS = ("crashed", "crash_round", "side", "heal_round", "rotate_down",
+           "p8", "salt0", "salt1", "byz")
+
+
+@dataclasses.dataclass
+class Population:
+    """[P] fault-schedule genomes as host-side numpy arrays.
+
+    Leaves mirror engine.fast.FaultMix (leading axis [P]) plus
+    ``byz [P, n] bool`` — byzantine-silence membership (a byzantine process
+    is silent toward a hash-drawn half of the receivers each round:
+    scenarios.byzantine_silence's mask family, made replayable).
+    Genetic operators live host-side (numpy); evaluation converts to jnp
+    leaves once per dispatch (`leaves()`).
+    """
+
+    crashed: np.ndarray      # [P, n] bool
+    crash_round: np.ndarray  # [P] int32
+    side: np.ndarray         # [P, n] int32
+    heal_round: np.ndarray   # [P] int32
+    rotate_down: np.ndarray  # [P] int32
+    p8: np.ndarray           # [P] int32
+    salt0: np.ndarray        # [P] int32
+    salt1: np.ndarray        # [P] int32
+    byz: np.ndarray          # [P, n] bool
+
+    @property
+    def size(self) -> int:
+        return self.crashed.shape[0]
+
+    @property
+    def n(self) -> int:
+        return self.crashed.shape[1]
+
+    def mix(self) -> FaultMix:
+        """The FaultMix view (drops byz) — what engine.fast consumes."""
+        return FaultMix(
+            crashed=jnp.asarray(self.crashed),
+            crash_round=jnp.asarray(self.crash_round),
+            side=jnp.asarray(self.side),
+            heal_round=jnp.asarray(self.heal_round),
+            rotate_down=jnp.asarray(self.rotate_down),
+            p8=jnp.asarray(self.p8),
+            salt0=jnp.asarray(self.salt0),
+            salt1=jnp.asarray(self.salt1),
+        )
+
+    def leaves(self) -> tuple:
+        """The per-field tuple vmapped evaluation maps over (axis 0)."""
+        return tuple(getattr(self, f) for f in _FIELDS)
+
+    def row(self, i: int) -> Dict[str, np.ndarray]:
+        """Genome i as a field dict (artifact/minimizer currency)."""
+        return {f: np.asarray(getattr(self, f)[i]) for f in _FIELDS}
+
+    def take(self, idx) -> "Population":
+        idx = np.asarray(idx)
+        return Population(**{f: np.asarray(getattr(self, f))[idx]
+                             for f in _FIELDS})
+
+    @classmethod
+    def from_rows(cls, rows) -> "Population":
+        return cls(**{f: np.stack([np.asarray(r[f]) for r in rows])
+                      for f in _FIELDS})
+
+    @classmethod
+    def from_mix(cls, mix: FaultMix, byz: Optional[np.ndarray] = None
+                 ) -> "Population":
+        # np.array(copy=True): jax device arrays view as read-only numpy,
+        # and the genetic operators mutate in place
+        kw = {f: np.array(getattr(mix, f))
+              for f in _FIELDS if f != "byz"}
+        P, n = kw["crashed"].shape
+        kw["byz"] = (np.zeros((P, n), dtype=bool) if byz is None
+                     else np.asarray(byz, dtype=bool))
+        return cls(**kw)
+
+
+# ---------------------------------------------------------------------------
+# Engine bridge: genome -> HO sampler / explicit schedule
+# ---------------------------------------------------------------------------
+
+
+def byz_silence(byz, salt0, salt1, r, n: int) -> jnp.ndarray:
+    """[n(recv), n(send)] bool — True where a byzantine sender is silent
+    toward this receiver in round r: byz membership AND the per-(round,
+    link) hash half (p = 1/2), diagonal excluded (a process always hears
+    itself — the engines' self-delivery convention)."""
+    i = jnp.arange(n, dtype=jnp.uint32)
+    idx = i[:, None] * jnp.uint32(n) + i[None, :]
+    z = idx * jnp.uint32(scenarios.LINK_GOLD) + jnp.asarray(salt0).astype(
+        jnp.uint32)
+    z = z ^ (jnp.asarray(r).astype(jnp.uint32)
+             * jnp.uint32(scenarios.LINK_RMIX)
+             + jnp.asarray(salt1).astype(jnp.uint32)
+             + jnp.uint32(STREAM_BYZ))
+    half = (scenarios._mix32(z) & jnp.uint32(0xFF)) < jnp.uint32(128)
+    eye = jnp.eye(n, dtype=bool)
+    return jnp.asarray(byz)[None, :] & half & ~eye
+
+
+def row_sampler(n: int, crashed, crash_round, side, heal_round, rotate_down,
+                p8, salt0, salt1, byz=None):
+    """HO sampler ``(key, r) -> [n, n] bool`` for ONE genome — the
+    engine-runnable form.  Exactly `scenarios.from_fault_params` (the
+    FaultMix hash-mode replay formula) with the byzantine-silence term
+    ANDed in; every argument may be a traced leaf, so `jax.vmap` over a
+    population's leaves evaluates all P genomes in one dispatch."""
+    base = scenarios.from_fault_params(
+        n, crashed, crash_round, side, heal_round, rotate_down, p8,
+        salt0, salt1)
+
+    def sample(key, r):
+        ho = base(key, r)
+        if byz is not None:
+            ho = ho & ~byz_silence(byz, salt0, salt1, r, n)
+        return ho
+
+    return sample
+
+
+def schedule_fn(n: int, rounds: int):
+    """Jittable ``leaves -> [rounds, n, n] bool`` materializer: the genome
+    as an explicit HO schedule (what `scenarios.from_schedule` replays and
+    fuzz/minimize.py shrinks).  Bit-identical to `row_sampler`'s draws —
+    both go through the one ho_link_mask formula."""
+
+    def materialize(crashed, crash_round, side, heal_round, rotate_down,
+                    p8, salt0, salt1, byz):
+        samp = row_sampler(n, crashed, crash_round, side, heal_round,
+                           rotate_down, p8, salt0, salt1, byz)
+        return jax.vmap(lambda r: samp(None, r))(
+            jnp.arange(rounds, dtype=jnp.int32))
+
+    return materialize
+
+
+@_functools.lru_cache(maxsize=None)
+def _jitted_schedule_fn(n: int, rounds: int):
+    return jax.jit(schedule_fn(n, rounds))
+
+
+def row_schedule(row: Dict[str, np.ndarray], rounds: int) -> np.ndarray:
+    """Materialize one genome row dict into a numpy [rounds, n, n] bool
+    deliver schedule (jit cached per (n, rounds))."""
+    n = int(np.asarray(row["crashed"]).shape[-1])
+    out = _jitted_schedule_fn(n, rounds)(
+        *[jnp.asarray(row[f]) for f in _FIELDS])
+    return np.asarray(out)
+
+
+# ---------------------------------------------------------------------------
+# Severity: how much fault a genome spends
+# ---------------------------------------------------------------------------
+
+
+def severity(pop: Population, horizon: int) -> np.ndarray:
+    """[P] float — normalized fault intensity, the search's spending
+    meter.  The objective subtracts a small multiple of this, so of two
+    schedules that hurt equally the search prefers the SPARSER one (and
+    the trivial "break everything" corner scores below a surgical
+    schedule) — the same pressure fuzz/minimize.py applies exhaustively."""
+    h = max(1, horizon)
+    n = pop.n
+    crash_frac = pop.crashed.mean(axis=1) * np.clip(
+        (h - pop.crash_round) / h, 0.0, 1.0)
+    # a partition only costs while it is active and actually splits
+    split = (pop.side.max(axis=1) != pop.side.min(axis=1))
+    part_frac = split * np.clip(pop.heal_round / h, 0.0, 1.0)
+    return (pop.p8 / 256.0
+            + crash_frac
+            + 0.5 * part_frac
+            + 0.25 * (pop.rotate_down > 0)
+            + 0.5 * pop.byz.mean(axis=1)).astype(np.float64)
+
+
+# ---------------------------------------------------------------------------
+# Seeding, mutation, crossover
+# ---------------------------------------------------------------------------
+
+
+def seed_population(seed: int, P: int, n: int, horizon: int,
+                    p_drop: float = 0.25) -> Population:
+    """The initial population: `engine.fast.standard_mix`'s four-family
+    split (the hardened flagship workload) with byz off and every 8th row
+    zeroed to fault-free — elites must EARN their faults against a clean
+    baseline present in every generation's gene pool."""
+    from round_tpu.engine.fast import standard_mix
+
+    key = jax.random.PRNGKey(seed)
+    mix = standard_mix(key, P, n, p_drop=p_drop,
+                       heal_round=min(5, max(1, horizon // 2)))
+    pop = Population.from_mix(mix)
+    clean = np.arange(P) % 8 == 7
+    pop.crashed[clean] = False
+    pop.side[clean] = 0
+    pop.heal_round[clean] = 0
+    pop.rotate_down[clean] = 0
+    pop.p8[clean] = 0
+    return pop
+
+
+def _flip_one_capped(rng: np.random.Generator, mask_rows: np.ndarray,
+                     rows: np.ndarray, cap: int) -> None:
+    """Toggle one random bit per selected row of a [P, n] bool matrix,
+    refusing toggles that would push the row's popcount past `cap` (the
+    resilience envelope: mass-crash/mass-byzantine rows are trivial
+    findings, not interesting ones)."""
+    n = mask_rows.shape[1]
+    for i in rows:
+        j = int(rng.integers(n))
+        if mask_rows[i, j] or mask_rows[i].sum() < cap:
+            mask_rows[i, j] = ~mask_rows[i, j]
+
+
+def mutate(rng: np.random.Generator, pop: Population, horizon: int,
+           rate: float = 0.9) -> Population:
+    """Per-family point mutations: each row draws ~1-2 of the six family
+    operators.  Returns a NEW population (inputs untouched)."""
+    P, n = pop.size, pop.n
+    out = pop.take(np.arange(P))  # deep copy via fancy-index
+    h = max(1, horizon)
+    ops = rng.random((P, 6)) < (rate / 3.0)
+
+    r = np.flatnonzero(ops[:, 0])      # omission intensity
+    out.p8[r] = np.clip(out.p8[r] + rng.integers(-48, 49, r.size),
+                        0, P8_CAP).astype(np.int32)
+
+    r = np.flatnonzero(ops[:, 1])      # crash set / onset
+    _flip_one_capped(rng, out.crashed, r, cap=max(1, n // 3))
+    out.crash_round[r] = np.clip(
+        out.crash_round[r] + rng.integers(-2, 3, r.size), 0, h - 1
+    ).astype(np.int32)
+
+    r = np.flatnonzero(ops[:, 2])      # partition side / heal horizon
+    for i in r:
+        out.side[i, int(rng.integers(n))] ^= 1
+    out.heal_round[r] = np.clip(
+        out.heal_round[r] + rng.integers(-3, 4, r.size), 0, h
+    ).astype(np.int32)
+
+    r = np.flatnonzero(ops[:, 3])      # coordinator-down period
+    choices = np.array([0, 1, 2, 4], dtype=np.int32)
+    out.rotate_down[r] = rng.choice(choices, r.size)
+
+    r = np.flatnonzero(ops[:, 4])      # byzantine-silence membership
+    _flip_one_capped(rng, out.byz, r, cap=max(1, n // 3))
+
+    r = np.flatnonzero(ops[:, 5])      # link-pattern reroll
+    out.salt0[r] = rng.integers(0, 2**32, r.size, dtype=np.uint32) \
+        .astype(np.int64).astype(np.int32)
+    out.salt1[r] = rng.integers(0, 2**32, r.size, dtype=np.uint32) \
+        .astype(np.int64).astype(np.int32)
+    return out
+
+
+def crossover(rng: np.random.Generator, pop: Population,
+              parents_a: np.ndarray, parents_b: np.ndarray) -> Population:
+    """Family-block recombination: each child inherits every leaf of a
+    fault family wholesale from parent A or B (coin per family) — the
+    partition's (side, heal_round) pair, the crash family's (set, onset)
+    pair etc. stay coherent across recombination."""
+    a, b = pop.take(parents_a), pop.take(parents_b)
+    child = a.take(np.arange(a.size))
+    for fam, fields in FAMILIES.items():
+        from_b = rng.random(a.size) < 0.5
+        for f in fields:
+            arr = getattr(child, f)
+            arr[from_b] = getattr(b, f)[from_b]
+    return child

@@ -1,0 +1,206 @@
+"""Delta-debugging a winning schedule down to a minimal reproducer.
+
+Two shrinking stages, both batching EVERY candidate of an iteration into
+one engine dispatch (FuzzTarget.evaluate / evaluate_schedules — the
+minimizer never runs one candidate at a time):
+
+  1. genome-level: drop or halve whole fault families (omission off,
+     partition healed earlier, fewer crashed processes, byz cleared...)
+     while the predicate still reproduces — big strides first;
+  2. link-level ddmin: materialize the explicit [T, n, n] deliver
+     schedule and re-enable chunks of dropped (round, dst, src) link
+     events, halving chunk size down to singletons.  The result is
+     1-MINIMAL: re-enabling any single remaining dropped link loses the
+     finding (verified by one final batched pass).
+
+The minimal schedule is what fuzz/replay.py exports: small artifacts that
+name exactly the links that matter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from round_tpu.fuzz import genome
+from round_tpu.fuzz.search import FuzzTarget
+from round_tpu.obs.metrics import METRICS
+from round_tpu.obs.trace import TRACE
+
+Predicate = Callable[[Dict[str, np.ndarray]], np.ndarray]
+
+
+@dataclasses.dataclass
+class MinimizeResult:
+    schedule: np.ndarray            # [T, n, n] bool deliver — minimal
+    outcome: Dict[str, np.ndarray]  # its engine outcome (per-process)
+    dropped_initial: int            # dropped off-diagonal link events before
+    dropped_final: int              # ... and after shrinking
+    genome_row: Dict[str, np.ndarray]   # the family-shrunk genome
+    iterations: int
+
+
+def _family_candidates(row: Dict[str, np.ndarray]) -> List[Dict]:
+    """Simplification moves, simplest-first: each candidate removes or
+    halves one fault family of the genome."""
+    cands = []
+
+    def variant(**patch):
+        c = {k: np.array(v, copy=True) for k, v in row.items()}
+        c.update({k: np.asarray(v) for k, v in patch.items()})
+        return c
+
+    if row["p8"] > 0:
+        cands.append(variant(p8=np.int32(0)))
+        cands.append(variant(p8=np.int32(int(row["p8"]) // 2)))
+    if row["crashed"].any():
+        cands.append(variant(crashed=np.zeros_like(row["crashed"])))
+        fewer = np.array(row["crashed"], copy=True)
+        fewer[np.argmax(fewer)] = False
+        cands.append(variant(crashed=fewer))
+    if row["heal_round"] > 0:
+        cands.append(variant(heal_round=np.int32(0),
+                             side=np.zeros_like(row["side"])))
+        cands.append(variant(heal_round=np.int32(
+            int(row["heal_round"]) // 2)))
+    if row["rotate_down"] > 0:
+        cands.append(variant(rotate_down=np.int32(0)))
+    if row["byz"].any():
+        cands.append(variant(byz=np.zeros_like(row["byz"])))
+    return cands
+
+
+def shrink_genome(target: FuzzTarget, row: Dict[str, np.ndarray],
+                  predicate: Predicate, max_iters: int = 32
+                  ) -> Dict[str, np.ndarray]:
+    """Greedy family-level shrink to a fixed point: per iteration, batch
+    every one-family simplification into one dispatch and adopt the FIRST
+    (simplest-first order) that still reproduces."""
+    row = {k: np.asarray(v) for k, v in row.items()}
+    for _ in range(max_iters):
+        cands = _family_candidates(row)
+        if not cands:
+            break
+        pop = genome.Population.from_rows(cands)
+        ok = predicate(target.evaluate(pop))
+        METRICS.counter("fuzz.minimize_dispatches").inc()
+        hit = np.flatnonzero(ok)
+        if hit.size == 0:
+            break
+        row = cands[int(hit[0])]
+    return row
+
+
+def _dropped_events(schedule: np.ndarray) -> np.ndarray:
+    """[D, 3] int (r, dst, src) of every OFF-diagonal undelivered link
+    event — the atoms ddmin shrinks over (self-delivery is pinned True by
+    the engine convention and never counted)."""
+    miss = ~schedule
+    T, n, _ = schedule.shape
+    eye = np.eye(n, dtype=bool)
+    miss = miss & ~eye[None, :, :]
+    return np.argwhere(miss)
+
+
+def _with_events(base: np.ndarray, events: np.ndarray) -> np.ndarray:
+    """Full-delivery schedule with exactly `events` (r, dst, src) dropped."""
+    out = np.ones_like(base)
+    if events.size:
+        out[events[:, 0], events[:, 1], events[:, 2]] = False
+    return out
+
+
+def shrink_schedule(target: FuzzTarget, schedule: np.ndarray,
+                    predicate: Predicate, max_batch: int = 64,
+                    max_iters: int = 200) -> tuple:
+    """Link-level ddmin: repeatedly try re-ENABLING chunks of the dropped
+    link events (complement testing, chunk size halving to 1), batching
+    all of an iteration's candidates into one dispatch.  Returns
+    (schedule, outcome, iterations) with the schedule 1-minimal under the
+    predicate."""
+    schedule = np.asarray(schedule, dtype=bool)
+    events = _dropped_events(schedule)
+    chunk = max(1, events.shape[0] // 2)
+    iters = 0
+    while iters < max_iters:
+        D = events.shape[0]
+        if D == 0:
+            break
+        chunk = min(chunk, D)
+        # candidate per chunk = all events EXCEPT that chunk (re-enabled),
+        # evaluated in batches of max_batch so EVERY chunk gets tried at
+        # this granularity before giving up on it
+        starts = list(range(0, D, chunk))
+        adopted = False
+        for b in range(0, len(starts), max_batch):
+            if iters >= max_iters:
+                break
+            window = starts[b:b + max_batch]
+            keep_sets = [np.concatenate([events[:s], events[s + chunk:]])
+                         for s in window]
+            cands = np.stack([_with_events(schedule, k)
+                              for k in keep_sets])
+            ok = predicate(target.evaluate_schedules(cands))
+            METRICS.counter("fuzz.minimize_dispatches").inc()
+            iters += 1
+            hit = np.flatnonzero(ok)
+            if hit.size:
+                events = keep_sets[int(hit[0])]
+                adopted = True
+                break
+        if adopted:
+            continue  # retry at the same granularity over the new set
+        if chunk == 1:
+            break
+        chunk = max(1, chunk // 2)
+    minimal = _with_events(schedule, events)
+    out = target.evaluate_schedules(minimal[None])
+    outcome = {k: v[0] for k, v in out.items()}
+    return minimal, outcome, iters
+
+
+def verify_one_minimal(target: FuzzTarget, schedule: np.ndarray,
+                       predicate: Predicate) -> bool:
+    """True iff re-enabling ANY single dropped link loses the finding —
+    one batched pass over all singles (the ddmin postcondition)."""
+    events = _dropped_events(np.asarray(schedule, dtype=bool))
+    if events.shape[0] == 0:
+        return True
+    cands = []
+    for i in range(events.shape[0]):
+        keep = np.delete(events, i, axis=0)
+        cands.append(_with_events(schedule, keep))
+    ok = predicate(target.evaluate_schedules(np.stack(cands)))
+    return not bool(np.any(ok))
+
+
+def minimize(target: FuzzTarget, row: Dict[str, np.ndarray],
+             predicate: Predicate,
+             log_fn: Optional[Callable[[str], None]] = None
+             ) -> MinimizeResult:
+    """The full pipeline: family shrink -> materialize -> link ddmin.
+
+    Raises ValueError if `row` does not reproduce under `predicate` to
+    begin with (a minimizer fed a non-finding would silently 'minimize'
+    to the empty schedule)."""
+    pop = genome.Population.from_rows([row])
+    if not bool(predicate(target.evaluate(pop))[0]):
+        raise ValueError(
+            f"genome does not reproduce under {getattr(predicate, '__name__', predicate)!r}; "
+            "nothing to minimize")
+    shrunk = shrink_genome(target, row, predicate)
+    sched0 = genome.row_schedule(shrunk, target.horizon)
+    d0 = int(_dropped_events(sched0).shape[0])
+    minimal, outcome, iters = shrink_schedule(target, sched0, predicate)
+    d1 = int(_dropped_events(minimal).shape[0])
+    if log_fn:
+        log_fn(f"minimized: {d0} -> {d1} dropped link events "
+               f"({iters} ddmin iterations)")
+    if TRACE.enabled:
+        TRACE.emit("fuzz_minimize", dropped_initial=d0, dropped_final=d1,
+                   iterations=iters)
+    return MinimizeResult(
+        schedule=minimal, outcome=outcome, dropped_initial=d0,
+        dropped_final=d1, genome_row=shrunk, iterations=iters)

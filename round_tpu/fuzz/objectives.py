@@ -1,0 +1,138 @@
+"""Spec-derived lane objectives: how much a fault schedule hurt.
+
+Every score is computed INSIDE the jitted evaluation step over the whole
+[P]-candidate batch (fuzz/search.py calls `lane_objectives` from within the
+same `jax.jit` that ran the engine), so scoring adds zero extra dispatches.
+The objective catalog is the runtime mirror of the reference's Spec
+properties (Specs.scala:9-19) — the same formulas `spec/check.py` evaluates
+over traces, reduced to per-candidate scalars:
+
+  undecided        — Termination's failure mass: fraction of processes
+                     undecided at the horizon;
+  decide_round     — rounds-to-decide: the LAST process's decision round
+                     (horizon where undecided) — decision delay;
+  agreement_viol   — Agreement's margin: # unordered pairs of decided
+                     processes with differing decisions (>0 = SAFETY BUG);
+  validity_viol    — Validity's slack: # decided processes whose decision
+                     is no process's initial value (>0 = SAFETY BUG).
+
+Arbitrary spec/dsl.py formulas plug in through `spec_holds` (formula-as-
+objective): any ``Env -> bool`` property evaluates vmapped over the final
+state batch, so a protocol's own Spec drives the search without
+re-stating it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from round_tpu.spec.dsl import Env
+
+# weight of a safety violation in the combined score: any schedule that
+# BREAKS agreement/validity must dominate every schedule that merely
+# degrades liveness, whatever the liveness terms add up to
+SAFETY_WEIGHT = 100.0
+
+
+def lane_objectives(decided: jnp.ndarray, decision: jnp.ndarray,
+                    decided_round: jnp.ndarray, init_values: jnp.ndarray,
+                    horizon: int) -> Dict[str, jnp.ndarray]:
+    """Per-candidate objective components from a batched engine outcome.
+
+    Args (all leading axis [P]): decided [P, n] bool, decision [P, n],
+    decided_round [P, n] int32 (-1 = never), init_values [n] (the
+    proposals — Validity's witness set), horizon = rounds simulated.
+    Returns a dict of [P] arrays (floats/int32) — jit-safe.
+    """
+    und = 1.0 - jnp.mean(decided.astype(jnp.float32), axis=1)
+    dr = jnp.where(decided_round < 0, horizon, decided_round)
+    decide_round = jnp.max(dr, axis=1).astype(jnp.int32)
+
+    both = decided[:, :, None] & decided[:, None, :]
+    diff = decision[:, :, None] != decision[:, None, :]
+    agreement_viol = (jnp.sum((both & diff).astype(jnp.int32), axis=(1, 2))
+                      // 2)
+
+    valid = jnp.any(
+        decision[:, :, None] == init_values[None, None, :], axis=2)
+    validity_viol = jnp.sum((decided & ~valid).astype(jnp.int32), axis=1)
+
+    return {
+        "undecided": und,
+        "decide_round": decide_round,
+        "agreement_viol": agreement_viol,
+        "validity_viol": validity_viol,
+    }
+
+
+def combined_score(obj: Dict[str, jnp.ndarray], severity: jnp.ndarray,
+                   horizon: int,
+                   severity_weight: float = 0.25) -> jnp.ndarray:
+    """The scalar the search maximizes: liveness damage (undecided mass +
+    normalized decision delay) + safety violations at SAFETY_WEIGHT, minus
+    a small severity rent (genome.severity) so sparse schedules win ties —
+    the evolutionary pre-echo of fuzz/minimize.py."""
+    viol = (obj["agreement_viol"] + obj["validity_viol"]) > 0
+    return (obj["undecided"]
+            + obj["decide_round"].astype(jnp.float32) / max(1, horizon)
+            + SAFETY_WEIGHT * viol.astype(jnp.float32)
+            - severity_weight * jnp.asarray(severity, jnp.float32))
+
+
+def spec_holds(formula: Callable[[Env], Any], state: Any, n: int
+               ) -> jnp.ndarray:
+    """[P] bool — evaluate one spec/dsl.py formula on every candidate's
+    final state (check_trace's per-step evaluation, batched over the
+    population axis instead of the round axis).  Compose the result into a
+    custom score, or use it as a minimizer predicate."""
+    return jax.vmap(lambda st: jnp.asarray(formula(Env(state=st, n=n))))(
+        state)
+
+
+# ---------------------------------------------------------------------------
+# Minimizer predicates (host-side, over numpy outcome dicts)
+# ---------------------------------------------------------------------------
+#
+# A predicate maps the batched outcome of candidate schedules to a [K] bool
+# "does this candidate still reproduce the finding" — fuzz/minimize.py's
+# oracle.  They work on the numpy outcome dict fuzz/search.FuzzTarget
+# returns so the same predicate drives search early-stops, shrinking and
+# artifact verification.
+
+
+def undecided_at_horizon(min_lanes: int = 1):
+    """≥ min_lanes processes still undecided when the horizon hits."""
+    import numpy as np
+
+    def pred(out):
+        return (~np.asarray(out["decided"])).sum(axis=1) >= min_lanes
+
+    pred.__name__ = f"undecided_at_horizon(min_lanes={min_lanes})"
+    return pred
+
+
+def decision_delayed(min_round: int):
+    """Decision delay: the last decider's round ≥ min_round (undecided
+    counts as the horizon)."""
+    import numpy as np
+
+    def pred(out):
+        return np.asarray(out["decide_round"]) >= min_round
+
+    pred.__name__ = f"decision_delayed(min_round={min_round})"
+    return pred
+
+
+def safety_violated():
+    """Agreement or validity broken — the jackpot predicate."""
+    import numpy as np
+
+    def pred(out):
+        return (np.asarray(out["agreement_viol"])
+                + np.asarray(out["validity_viol"])) > 0
+
+    pred.__name__ = "safety_violated()"
+    return pred

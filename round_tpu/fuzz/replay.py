@@ -1,0 +1,351 @@
+"""Schedule artifacts: a TPU/CPU-sim finding as a portable, replayable file.
+
+The artifact is a small JSON document naming exactly the (round, dst, src)
+link events a minimized schedule drops, the proposals, and the RECORDED
+outcome on both worlds:
+
+  {
+    "kind": "round_tpu.fuzz.schedule", "version": 1,
+    "protocol": "otr", "n": 4, "rounds": 12, "seed": 0,
+    "values": [0, 1, 2, 3],
+    "drops": [[r, dst, src], ...],          # off-diagonal, deliver=False
+    "expected": {
+      "engine": {"decided": [...], "decision": [...],
+                 "decided_round": [...]},
+      "host":   {"decided": [...], "decision": [...], "rounds": [...]}
+    },
+    "meta": {...}                            # provenance (free-form)
+  }
+
+Replay surfaces:
+  * engine — `scenarios.from_schedule` through the SAME batched evaluator
+    the search used (bit-exact by construction);
+  * host   — `runtime.chaos.FaultyTransport` in explicit-schedule mode
+    over real sockets: in-process thread clusters (replay_host_threads,
+    the fast regression form) or true multi-process clusters of
+    apps/host_replica subprocesses (run_schedule_cluster).
+
+Rounds past the schedule clamp to the LAST row on every surface (the
+`from_schedule` convention), so a short artifact pins a steady-state tail.
+"""
+
+from __future__ import annotations
+
+import functools as _functools
+import json
+import os
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from round_tpu.obs.metrics import METRICS
+
+ARTIFACT_KIND = "round_tpu.fuzz.schedule"
+ARTIFACT_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# Schema
+# ---------------------------------------------------------------------------
+
+
+def make_artifact(*, protocol: str, schedule: np.ndarray,
+                  values: np.ndarray, seed: int = 0,
+                  engine_outcome: Optional[Dict[str, Any]] = None,
+                  host_outcome: Optional[Dict[str, Any]] = None,
+                  meta: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    schedule = np.asarray(schedule, dtype=bool)
+    T, n, n2 = schedule.shape
+    if n != n2:
+        raise ValueError(f"schedule must be [T, n, n], got {schedule.shape}")
+    eye = np.eye(n, dtype=bool)
+    if not schedule[:, eye].all():
+        raise ValueError("self-delivery must be True in every round "
+                         "(the engines' HO convention)")
+    drops = np.argwhere(~schedule & ~eye[None, :, :])
+    art: Dict[str, Any] = {
+        "kind": ARTIFACT_KIND,
+        "version": ARTIFACT_VERSION,
+        "protocol": protocol,
+        "n": int(n),
+        "rounds": int(T),
+        "seed": int(seed),
+        "values": [int(v) for v in np.asarray(values).reshape(-1)],
+        "drops": [[int(r), int(d), int(s)] for r, d, s in drops],
+        "expected": {},
+    }
+    if engine_outcome is not None:
+        art["expected"]["engine"] = engine_outcome
+    if host_outcome is not None:
+        art["expected"]["host"] = host_outcome
+    if meta:
+        art["meta"] = meta
+    return art
+
+
+def dump_artifact(path: str, art: Dict[str, Any]) -> None:
+    if art.get("kind") != ARTIFACT_KIND:
+        raise ValueError(f"not a fuzz schedule artifact: {art.get('kind')!r}")
+    with open(path, "w") as fh:
+        json.dump(art, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    METRICS.counter("fuzz.exports").inc()
+
+
+def load_artifact(path: str) -> Dict[str, Any]:
+    with open(path) as fh:
+        art = json.load(fh)
+    if art.get("kind") != ARTIFACT_KIND:
+        raise ValueError(
+            f"{path}: kind {art.get('kind')!r} != {ARTIFACT_KIND!r}")
+    if int(art.get("version", -1)) > ARTIFACT_VERSION:
+        raise ValueError(f"{path}: artifact version {art['version']} is "
+                         f"newer than this tree ({ARTIFACT_VERSION})")
+    n, T = int(art["n"]), int(art["rounds"])
+    if len(art.get("values", [])) != n:
+        raise ValueError(f"{path}: values must have n={n} entries")
+    for r, d, s in art.get("drops", []):
+        if not (0 <= r < T and 0 <= d < n and 0 <= s < n and d != s):
+            raise ValueError(f"{path}: bad drop event {(r, d, s)}")
+    return art
+
+
+def schedule_from_artifact(art: Dict[str, Any]) -> np.ndarray:
+    """[rounds, n, n] bool deliver schedule (deliver[r, dst, src])."""
+    n, T = int(art["n"]), int(art["rounds"])
+    sched = np.ones((T, n, n), dtype=bool)
+    for r, d, s in art.get("drops", []):
+        sched[r, d, s] = False
+    return sched
+
+
+def _outcome_json(decided, decision, rounds_key: str, rounds) -> Dict:
+    """Normalize an outcome to the artifact form: decision is null where
+    undecided (never state garbage)."""
+    decided = [bool(x) for x in decided]
+    return {
+        "decided": decided,
+        "decision": [int(v) if d else None
+                     for d, v in zip(decided, decision)],
+        rounds_key: [int(x) for x in rounds],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Engine replay
+# ---------------------------------------------------------------------------
+
+
+def _target_for(art: Dict[str, Any], seed: Optional[int] = None):
+    from round_tpu.fuzz.search import make_target
+
+    return make_target(
+        art["protocol"], n=int(art["n"]), horizon=int(art["rounds"]),
+        seed=int(art["seed"] if seed is None else seed),
+        values=np.asarray(art["values"], dtype=np.int32))
+
+
+def replay_engine(art: Dict[str, Any]) -> Dict[str, Any]:
+    """Run the artifact's schedule through the batched engine; returns the
+    outcome in artifact form (expected.engine's schema)."""
+    target = _target_for(art)
+    out = target.evaluate_schedules(schedule_from_artifact(art)[None])
+    METRICS.counter("fuzz.replays").inc()
+    return _outcome_json(
+        np.asarray(out["decided"][0]), np.asarray(out["decision"][0]),
+        "decided_round", np.asarray(out["decided_round"][0]))
+
+
+def check_engine(art: Dict[str, Any]) -> tuple:
+    """(ok, got): engine replay vs the recorded expected.engine outcome —
+    EXACT equality; a banked artifact that stops reproducing is a
+    regression (tools/soak.py fuzz rung gates on this)."""
+    got = replay_engine(art)
+    want = art.get("expected", {}).get("engine")
+    return (want is not None and got == want), got
+
+
+# ---------------------------------------------------------------------------
+# Host-wire replay
+# ---------------------------------------------------------------------------
+
+
+@_functools.lru_cache(maxsize=None)
+def _shared_algo(protocol: str):
+    """ONE Algorithm object per protocol for every in-process replay: the
+    host jit trio caches on the Round objects (HostRunner._round_fns), so
+    sharing the instance shares the compiles across replay calls."""
+    from round_tpu.apps.selector import select
+
+    return select(protocol)
+
+
+def _warm_host_round_fns(algo, n: int) -> None:
+    """Compile every round class's host jit trio BEFORE the replay
+    cluster starts.  In-thread replicas burning their first round
+    deadlines on first-use jit compiles (serialized by the shared build
+    lock) skew the early rounds, and a timing-SENSITIVE schedule then
+    replays unfaithfully — observed: a 2-link LastVoting schedule that
+    decides at round 7 on the engine decided at round 11 in a cold
+    thread cluster.  One clean mini-cluster (one phase, generous
+    deadline) pays the compiles; the jits cache on the shared Round
+    objects, so the replay proper starts warm and rounds run at wire
+    latency."""
+    import threading as _threading
+
+    from round_tpu.runtime.chaos import alloc_ports
+    from round_tpu.runtime.host import HostRunner
+    from round_tpu.runtime.transport import HostTransport
+
+    # warm = every round class's cached trio was built at THIS group size
+    # (the cache on a Round object holds one n at a time)
+    if all((getattr(r, "_host_jit", None) or (None,))[0] == n
+           for r in algo.rounds):
+        return
+    ports = alloc_ports(n)
+    peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
+
+    def node(i):
+        with HostTransport(i, peers[i][1]) as tr:
+            HostRunner(algo, i, peers, tr, timeout_ms=2000).run(
+                {"initial_value": np.int32(0)},
+                max_rounds=algo.rounds_per_phase)
+
+    threads = [_threading.Thread(target=node, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+
+
+def replay_host_threads(art: Dict[str, Any], *, timeout_ms: int = 250,
+                        proto: str = "tcp") -> Dict[str, Any]:
+    """Replay on REAL sockets in-process: n HostRunner threads, each
+    behind a FaultyTransport carrying the artifact's explicit schedule.
+    Returns the outcome in artifact form (expected.host's schema: per-
+    replica decided / decision / rounds-to-exit)."""
+    from round_tpu.runtime.chaos import FaultPlan, FaultyTransport, alloc_ports
+    from round_tpu.runtime.host import HostRunner
+    from round_tpu.runtime.transport import HostTransport
+
+    n = int(art["n"])
+    schedule = schedule_from_artifact(art)
+    algo = _shared_algo(art["protocol"])
+    _warm_host_round_fns(algo, n)
+    ports = alloc_ports(n)
+    peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
+    results: Dict[int, Any] = {}
+    errors: Dict[int, BaseException] = {}
+
+    def node(i):
+        tr0 = HostTransport(i, peers[i][1], proto=proto)
+        tr = FaultyTransport(tr0, FaultPlan(), n, schedule=schedule)
+        try:
+            runner = HostRunner(algo, i, peers, tr, timeout_ms=timeout_ms)
+            results[i] = runner.run(
+                {"initial_value": np.int32(art["values"][i])},
+                max_rounds=int(art["rounds"]))
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors[i] = e
+            raise
+        finally:
+            tr0.close()
+
+    threads = [threading.Thread(target=node, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+    if any(t.is_alive() for t in threads):
+        raise RuntimeError("schedule-replay replica thread wedged")
+    if errors:
+        raise RuntimeError(f"schedule-replay replica errors: {errors}")
+    METRICS.counter("fuzz.replays").inc()
+    decided = [bool(results[i].decided) for i in range(n)]
+    decision = [int(np.asarray(results[i].decision).reshape(-1)[0])
+                for i in range(n)]
+    rounds = [int(results[i].rounds_run) for i in range(n)]
+    return _outcome_json(decided, decision, "rounds", rounds)
+
+
+def run_schedule_cluster(workdir: str, artifact_path: str, *,
+                         timeout_ms: int = 250, proto: str = "tcp",
+                         join_timeout: float = 150.0) -> Dict[str, Any]:
+    """Replay on a REAL MULTI-PROCESS cluster: n apps/host_replica
+    subprocesses, each wrapping its wire in the explicit-schedule
+    FaultyTransport (--chaos-schedule).  Returns the outcome in artifact
+    form plus the raw per-replica summaries."""
+    import subprocess
+
+    from round_tpu.runtime.chaos import alloc_ports, cluster_env
+
+    art = load_artifact(artifact_path)
+    n = int(art["n"])
+    os.makedirs(workdir, exist_ok=True)
+    ports = alloc_ports(n)
+    peer_arg = ",".join(f"127.0.0.1:{p}" for p in ports)
+    env = cluster_env()
+
+    def argv(i: int):
+        return [sys.executable, "-m", "round_tpu.apps.host_replica",
+                "--id", str(i), "--peers", peer_arg,
+                "--algo", art["protocol"],
+                "--value", str(int(art["values"][i])),
+                "--timeout-ms", str(timeout_ms),
+                "--max-rounds", str(int(art["rounds"])),
+                "--proto", proto,
+                "--chaos-schedule", artifact_path]
+
+    procs = [subprocess.Popen(argv(i), stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True, env=env)
+             for i in range(n)]
+    outs = []
+    try:
+        for i, p in enumerate(procs):
+            stdout, stderr = p.communicate(timeout=join_timeout)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"replica {i} failed (rc={p.returncode}): "
+                    f"{stderr[-2000:]}")
+            outs.append(json.loads(stdout.strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                try:
+                    p.communicate(timeout=10)
+                except Exception:  # noqa: BLE001 - best-effort reap
+                    pass
+    METRICS.counter("fuzz.replays").inc()
+    got = _outcome_json(
+        [o["decided"] for o in outs],
+        [o["decision"] if o["decision"] is not None else -1 for o in outs],
+        "rounds", [o["rounds"] for o in outs])
+    got_raw: Dict[str, Any] = dict(got)
+    got_raw["summaries"] = outs
+    return got_raw
+
+
+def check_host(art: Dict[str, Any], *, threads: bool = True,
+               workdir: Optional[str] = None, timeout_ms: int = 250
+               ) -> tuple:
+    """(ok, got): host-wire replay vs the recorded expected.host outcome —
+    EXACT equality on decided/decision/rounds."""
+    if threads:
+        got = replay_host_threads(art, timeout_ms=timeout_ms)
+    else:
+        if workdir is None:
+            raise ValueError("multi-process replay needs a workdir")
+        res = run_schedule_cluster(
+            workdir, _artifact_tmp(art, workdir), timeout_ms=timeout_ms)
+        got = {k: res[k] for k in ("decided", "decision", "rounds")}
+    want = art.get("expected", {}).get("host")
+    return (want is not None and got == want), got
+
+
+def _artifact_tmp(art: Dict[str, Any], workdir: str) -> str:
+    path = os.path.join(workdir, "artifact.json")
+    dump_artifact(path, art)
+    return path

@@ -1,0 +1,332 @@
+"""The generational loop: the batched engine as a search engine.
+
+One generation = ONE jitted vmapped dispatch evaluating the whole
+population as engine scenario lanes (1k-10k candidate schedules per
+dispatch; the Python between dispatches is selection bookkeeping over
+numpy arrays).  Coverage cells — which (round, link-pattern, phase)
+signatures a schedule exercises — are computed inside the same dispatch;
+a global coverage map feeds a novelty bonus so the population keeps
+probing new failure shapes instead of collapsing onto the first one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.engine.executor import LocalTopology, init_lanes, run_phases
+from round_tpu.fuzz import genome, objectives
+from round_tpu.obs.metrics import METRICS
+from round_tpu.obs.trace import TRACE
+
+# coverage quantization: per round, 3 min-mailbox buckets (below n/3 /
+# below 2n/3 / quorum-safe) x 4 delivered-link-density quarters.  The
+# min-mailbox bucket is the quorum-risk diagnostic (fast.mix_ho_stats'
+# heard_min); density separates sparse surgical schedules from blankets.
+_MH_BUCKETS = 3
+_DB_BUCKETS = 4
+CELLS_PER_ROUND = _MH_BUCKETS * _DB_BUCKETS
+
+
+@dataclasses.dataclass
+class FuzzTarget:
+    """One protocol wired for batched genome evaluation.
+
+    `evaluate(pop)` runs every genome as an engine lane; co-resident
+    `evaluate_schedules(schedules)` runs explicit [K, T, n, n] HO
+    schedules (the minimizer's oracle) through the SAME engine + key
+    discipline, so genome-eval and schedule-eval are bit-comparable.
+    Both return numpy outcome dicts (decided/decision/decided_round +
+    objective components + per-candidate coverage bits).
+    """
+
+    name: str
+    algo: Any
+    n: int
+    horizon: int                       # rounds simulated (phases * k)
+    phases: int
+    rounds_per_phase: int
+    init_values: np.ndarray            # [n] proposals
+    seed: int
+    _eval: Callable = dataclasses.field(repr=False, default=None)
+    _eval_sched: Dict[int, Callable] = dataclasses.field(
+        repr=False, default_factory=dict)
+
+    @property
+    def n_cells(self) -> int:
+        return self.horizon * CELLS_PER_ROUND
+
+    # -- batched evaluation -------------------------------------------------
+
+    def evaluate(self, pop: genome.Population) -> Dict[str, np.ndarray]:
+        sev = genome.severity(pop, self.horizon)
+        out = self._eval(*[jnp.asarray(x) for x in pop.leaves()],
+                         jnp.asarray(sev, jnp.float32))
+        METRICS.counter("fuzz.dispatches").inc()
+        METRICS.counter("fuzz.candidates").inc(pop.size)
+        res = {k: np.asarray(v) for k, v in out.items()}
+        res["severity"] = sev
+        return res
+
+    def evaluate_schedules(self, schedules: np.ndarray
+                           ) -> Dict[str, np.ndarray]:
+        """Outcomes of explicit deliver schedules [K, T, n, n] bool.  K is
+        padded up to a power of two (repeating the last row) so the
+        minimizer's shrinking batches hit a handful of compiled shapes
+        instead of one per K."""
+        schedules = np.asarray(schedules, dtype=bool)
+        K, T = schedules.shape[0], schedules.shape[1]
+        if T != self.horizon:
+            raise ValueError(
+                f"schedule length {T} != target horizon {self.horizon}")
+        K_pad = 1 << max(0, (K - 1).bit_length())
+        if K_pad != K:
+            pad = np.repeat(schedules[-1:], K_pad - K, axis=0)
+            schedules = np.concatenate([schedules, pad], axis=0)
+        fn = self._eval_sched.get(K_pad)
+        if fn is None:
+            fn = jax.jit(self._make_schedule_eval())
+            self._eval_sched[K_pad] = fn
+        out = fn(jnp.asarray(schedules))
+        METRICS.counter("fuzz.dispatches").inc()
+        METRICS.counter("fuzz.candidates").inc(int(schedules.shape[0]))
+        return {k: np.asarray(v)[:K] for k, v in out.items()}
+
+    # -- construction helpers ----------------------------------------------
+
+    def _run_one(self, sampler):
+        topo = LocalTopology(self.n)
+        io = {"initial_value": jnp.asarray(self.init_values)}
+        state0 = init_lanes(self.algo, io, self.n, topo)
+        key = jax.random.PRNGKey(self.seed)
+        st, done, dround, _ = run_phases(
+            self.algo, state0, key, sampler, self.phases, topo)
+        return st, done, dround
+
+    def _outcome(self, st, done, dround):
+        decided = self.algo.decided(st)
+        decision = jnp.asarray(self.algo.decision(st))
+        obj = objectives.lane_objectives(
+            decided, decision, dround,
+            jnp.asarray(self.init_values), self.horizon)
+        return {
+            "decided": decided,
+            "decision": decision,
+            "decided_round": dround,
+            **obj,
+        }
+
+    def _coverage_bits(self, sampler) -> jnp.ndarray:
+        """[horizon * CELLS_PER_ROUND] bool — which cells this schedule
+        exercises.  The round index carries the phase (r % k) implicitly;
+        the per-round pattern class is (min-mailbox bucket, density
+        quarter)."""
+        n = self.n
+
+        def cell(r):
+            ho = sampler(None, r)
+            heard = jnp.sum(ho.astype(jnp.int32), axis=1)       # [n]
+            mh = jnp.min(heard)
+            links = jnp.sum(ho.astype(jnp.int32))
+            mh_b = jnp.where(mh * 3 <= n, 0,
+                             jnp.where(mh * 3 <= 2 * n, 1, 2))
+            db = jnp.clip((links * _DB_BUCKETS) // (n * n + 1), 0,
+                          _DB_BUCKETS - 1)
+            return jax.nn.one_hot(mh_b * _DB_BUCKETS + db,
+                                  CELLS_PER_ROUND, dtype=jnp.bool_)
+
+        bits = jax.vmap(cell)(jnp.arange(self.horizon, dtype=jnp.int32))
+        return bits.reshape(-1)
+
+    def _make_genome_eval(self):
+        def one(crashed, crash_round, side, heal_round, rotate_down, p8,
+                salt0, salt1, byz):
+            samp = genome.row_sampler(
+                self.n, crashed, crash_round, side, heal_round,
+                rotate_down, p8, salt0, salt1, byz)
+            st, done, dround = self._run_one(samp)
+            return st, done, dround, self._coverage_bits(samp)
+
+        def ev(crashed, crash_round, side, heal_round, rotate_down, p8,
+               salt0, salt1, byz, sev):
+            st, done, dround, cov = jax.vmap(one)(
+                crashed, crash_round, side, heal_round, rotate_down, p8,
+                salt0, salt1, byz)
+            out = self._outcome(st, done, dround)
+            out["coverage"] = cov
+            # the combined objective rides the same dispatch (the ISSUE's
+            # "lane scores computed inside the jitted step")
+            out["score"] = objectives.combined_score(
+                out, sev, self.horizon)
+            return out
+
+        return ev
+
+    def _make_schedule_eval(self):
+        def one(sched):
+            samp = lambda key, r: sched[  # noqa: E731
+                jnp.minimum(r, sched.shape[0] - 1)]
+            st, done, dround = self._run_one(samp)
+            return st, done, dround
+
+        def ev(schedules):
+            st, done, dround = jax.vmap(one)(schedules)
+            return self._outcome(st, done, dround)
+
+        return ev
+
+
+def make_target(algo_name: str, n: int, horizon: int, seed: int = 0,
+                values: Optional[np.ndarray] = None,
+                algo_options: Optional[dict] = None) -> FuzzTarget:
+    """Build a FuzzTarget for a selector-registered protocol.
+
+    `horizon` is rounded UP to whole phases.  Default proposals are the
+    "mixed" shape (i % 4 + distinctness) so agreement is non-trivial; pass
+    `values` to pin them (they are recorded in exported artifacts)."""
+    from round_tpu.apps.selector import select
+
+    algo = select(algo_name, algo_options or {})
+    k = algo.rounds_per_phase
+    phases = max(1, -(-horizon // k))
+    if values is None:
+        values = (np.arange(n, dtype=np.int32) % 4).astype(np.int32)
+    else:
+        values = np.asarray(values, dtype=np.int32)
+        if values.shape != (n,):
+            raise ValueError(f"values must be [n={n}], got {values.shape}")
+    t = FuzzTarget(name=algo_name, algo=algo, n=n, horizon=phases * k,
+                   phases=phases, rounds_per_phase=k,
+                   init_values=values, seed=seed)
+    t._eval = jax.jit(t._make_genome_eval())
+    return t
+
+
+# ---------------------------------------------------------------------------
+# The generational loop
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FuzzResult:
+    target: FuzzTarget
+    population: genome.Population
+    outcome: Dict[str, np.ndarray]      # last generation's batched outcome
+    best_row: Dict[str, np.ndarray]     # best genome ever seen
+    best_score: float
+    best_outcome: Dict[str, float]      # its scalar objective components
+    coverage_map: np.ndarray            # [n_cells] bool, global
+    generations: int
+    evaluated: int
+    wall_s: float
+    history: List[Dict[str, float]]
+
+    @property
+    def schedules_per_sec(self) -> float:
+        return self.evaluated / self.wall_s if self.wall_s > 0 else 0.0
+
+
+def _scalar_outcome(out: Dict[str, np.ndarray], i: int) -> Dict[str, float]:
+    return {
+        "undecided": float(out["undecided"][i]),
+        "decide_round": int(out["decide_round"][i]),
+        "agreement_viol": int(out["agreement_viol"][i]),
+        "validity_viol": int(out["validity_viol"][i]),
+        "score": float(out["score"][i]),
+        "severity": float(out["severity"][i]),
+    }
+
+
+def search(target: FuzzTarget, pop_size: int, generations: int, *,
+           seed: int = 0, elite_frac: float = 0.125, tournament: int = 3,
+           novelty_weight: float = 0.5, time_box_s: Optional[float] = None,
+           stop_when: Optional[Callable[[Dict[str, np.ndarray]],
+                                        np.ndarray]] = None,
+           log_fn: Optional[Callable[[str], None]] = None) -> FuzzResult:
+    """Evolve `pop_size` fault schedules for up to `generations`
+    generations (or until `time_box_s` wall-clock runs out, or some
+    candidate satisfies `stop_when` — a fuzz/objectives predicate).
+
+    Selection pressure = combined objective score + novelty_weight x the
+    fraction of a candidate's coverage cells the global map had not seen
+    before this generation.  Elites survive verbatim; the rest of the next
+    generation is family-block crossover of tournament winners plus
+    per-family point mutations.
+    """
+    rng = np.random.default_rng(seed)
+    pop = genome.seed_population(seed, pop_size, target.n, target.horizon)
+    n_elite = max(1, int(pop_size * elite_frac))
+    coverage = np.zeros(target.n_cells, dtype=bool)
+    best_score, best_row, best_out = -np.inf, None, None
+    history: List[Dict[str, float]] = []
+    evaluated = 0
+    t0 = time.perf_counter()
+    gen = 0
+    out = None
+    for gen in range(1, generations + 1):
+        out = target.evaluate(pop)
+        evaluated += pop.size
+        METRICS.counter("fuzz.generations").inc()
+
+        cov = out["coverage"]                       # [P, C] bool
+        new_cells = (cov & ~coverage[None, :]).sum(axis=1)
+        novelty = new_cells / max(1, CELLS_PER_ROUND)
+        coverage |= cov.any(axis=0)
+        METRICS.gauge("fuzz.coverage_cells").set(int(coverage.sum()))
+
+        score = out["score"].astype(np.float64)
+        sel_score = score + novelty_weight * novelty
+
+        gi = int(np.argmax(score))
+        if score[gi] > best_score:
+            best_score = float(score[gi])
+            best_row = pop.row(gi)
+            best_out = _scalar_outcome(out, gi)
+        rec = {
+            "gen": gen,
+            "best": round(float(score.max()), 4),
+            "mean": round(float(score.mean()), 4),
+            "best_ever": round(best_score, 4),
+            "coverage_cells": int(coverage.sum()),
+            "new_cells": int(new_cells.sum()),
+        }
+        history.append(rec)
+        if TRACE.enabled:
+            TRACE.emit("fuzz_gen", **rec)
+        if log_fn:
+            log_fn(f"gen {gen}: best {rec['best']} mean {rec['mean']} "
+                   f"coverage {rec['coverage_cells']}/{target.n_cells}")
+
+        hit = stop_when is not None and bool(np.any(stop_when(out)))
+        out_of_time = (time_box_s is not None
+                       and time.perf_counter() - t0 > time_box_s)
+        if hit or out_of_time or gen == generations:
+            break
+
+        # -- selection ------------------------------------------------------
+        order = np.argsort(-sel_score)
+        elites = pop.take(order[:n_elite])
+        n_child = pop_size - n_elite
+        # tournament over the whole population, novelty included
+        cand = rng.integers(0, pop_size, (2, n_child, tournament))
+        pa = cand[0][np.arange(n_child),
+                     np.argmax(sel_score[cand[0]], axis=1)]
+        pb = cand[1][np.arange(n_child),
+                     np.argmax(sel_score[cand[1]], axis=1)]
+        children = genome.mutate(
+            rng, genome.crossover(rng, pop, pa, pb), target.horizon)
+        pop = genome.Population(**{
+            f: np.concatenate([getattr(elites, f), getattr(children, f)])
+            for f in genome._FIELDS})
+
+    wall = time.perf_counter() - t0
+    return FuzzResult(
+        target=target, population=pop, outcome=out,
+        best_row=best_row, best_score=best_score, best_outcome=best_out,
+        coverage_map=coverage, generations=gen, evaluated=evaluated,
+        wall_s=wall, history=history)

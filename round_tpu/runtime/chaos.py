@@ -149,7 +149,7 @@ class FaultyTransport:
     not).  `injected` counts every applied fault for assertions and
     stats.  Non-NORMAL (control-plane) frames pass through untouched."""
 
-    def __init__(self, inner, plan: FaultPlan, n: int):
+    def __init__(self, inner, plan: FaultPlan, n: int, schedule=None):
         self.inner = inner
         self.plan = plan
         self.n = n
@@ -157,6 +157,39 @@ class FaultyTransport:
         self.injected: Dict[str, int] = {}
         self._held: list = []   # (release_t, seq, got) min-heap
         self._seq = itertools.count()
+        # explicit-schedule mode (the fuzzer's replay surface): a
+        # [T, n, n] bool DELIVER tensor — schedule[r, dst, src] — REPLACES
+        # the hash-derived families wholesale (rounds >= T clamp to the
+        # last row, matching engine/scenarios.from_schedule).  Purely
+        # sender-side, so the native round pump stays safe to engage.
+        self.schedule = None
+        if schedule is not None:
+            import numpy as np
+
+            sched = np.asarray(schedule, dtype=bool)
+            if sched.ndim != 3 or sched.shape[1] != sched.shape[2] \
+                    or sched.shape[0] < 1:
+                raise ValueError(
+                    f"schedule must be [T, n, n] bool, got {sched.shape}")
+            if sched.shape[1] != n:
+                raise ValueError(
+                    f"schedule n={sched.shape[1]} != transport n={n}")
+            self.schedule = sched
+
+    @classmethod
+    def from_schedule_file(cls, inner, path: str) -> "FaultyTransport":
+        """Explicit per-(src, dst, round) schedule from a fuzz artifact
+        (round_tpu/fuzz/replay.py schema) instead of hash-derived
+        families — the constructor that turns a minimized engine finding
+        into a deterministic host-wire regression: the SAME link events
+        the engine mask suppressed are dropped on the real wire
+        (delivery equivalence pinned by tests/test_fuzz.py)."""
+        from round_tpu.fuzz.replay import load_artifact, schedule_from_artifact
+
+        art = load_artifact(path)
+        return cls(inner, FaultPlan(seed=int(art.get("seed", 0))),
+                   n=int(art["n"]),
+                   schedule=schedule_from_artifact(art))
 
     # -- the seeded link hash ----------------------------------------------
 
@@ -232,7 +265,9 @@ class FaultyTransport:
         ingests would bypass them — so such plans refuse the pump and the
         drivers keep the Python pump.  The pump SEND path is never
         offered here (no ``pump_send_ok``): sends must keep flowing
-        through send_buffered so faults stay per logical frame."""
+        through send_buffered so faults stay per logical frame.
+        Explicit-schedule mode is sender-side by construction, so it
+        passes through like any drop-only plan."""
         if self.plan.delay > 0 or self.plan.reorder > 0:
             return None
         f = getattr(self.inner, "enable_pump", None)
@@ -270,6 +305,21 @@ class FaultyTransport:
         schedules framing-invariant (pinned by tests/test_chaos.py)."""
         plan, src = self.plan, self.inner.id
         r, inst = tag.round, tag.instance
+        if self.schedule is not None:
+            # explicit schedule: one lookup decides the frame's fate; the
+            # hash families are OFF in this mode.  Out-of-range peers
+            # pass through — bounded by the SCHEDULE's own group size,
+            # not self.n, which rewire() retargets on view churn (a
+            # schedule pins a fixed-n world; members past it are unfaulted
+            # rather than an IndexError killing the sender).
+            sn = self.schedule.shape[1]
+            if not (0 <= src < sn and 0 <= to < sn):
+                return True, payload, False
+            T = self.schedule.shape[0]
+            if not self.schedule[min(r, T - 1), to, src]:
+                self._count("drop", src, to, r, inst)
+                return False, payload, False
+            return True, payload, False
         if 0 <= plan.crash_round <= r:
             self._count("crash_mute", src, to, r, inst)
             return False, payload, False  # swallowed: crashed = silent

@@ -41,6 +41,10 @@ def _slow_enabled(config) -> bool:
         # keeps them out of the tier-1 gate); an explicit `-m perf` IS
         # the opt-in, so it must not be skipped right back out
         return True
+    if "fuzz" in m and "not fuzz" not in m:
+        # same discipline for the fuzzer: heavy searches are fuzz+slow,
+        # and an explicit `-m fuzz` opts into them
+        return True
     return "slow" in m and "not slow" not in m
 
 

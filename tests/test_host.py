@@ -1048,6 +1048,75 @@ def test_host_perftest_udp_vs_tcp():
     assert all(v > 0 for v in by_proto.values())
 
 
+def test_host_catch_up_send_policy_scripted():
+    """The send_when_catching_up policy pinned DETERMINISTICALLY: one
+    HostRunner against a scripted peer whose round-9 frame is already in
+    the socket queue when the run starts, so the runner is catching up
+    from round 1 on by construction — no wall-clock start-skew race (the
+    cluster form of this test was a known load-timing flake; it rides
+    -m slow below).  With the policy off, rounds 1..8 suppress their wire
+    sends (wire == (n-1)·(rounds − suppressed)); with the default policy
+    nothing suppresses.  The runner never decides (its two peers are
+    scripted), which is irrelevant to the policy under test."""
+    import pickle as _pickle
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from round_tpu.apps.selector import select
+    from round_tpu.runtime.host import HostRunner
+
+    algo = select("otr")
+    claimed_round, max_rounds = 9, 11
+
+    def run_one(send_when_catching_up):
+        n = 3
+        ports = _free_ports(n)
+        peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
+        wire_sends = 0
+        with HostTransport(1, ports[1]) as peer, \
+                HostTransport(2, ports[2]), \
+                HostTransport(0, ports[0]) as tr:
+            peer.add_peer(0, "127.0.0.1", ports[0])
+            # a well-formed OTR payload claiming a FUTURE round, queued
+            # BEFORE the runner starts: round 0 ingests it, so rounds
+            # 1..claimed_round-1 run in catch-up deterministically
+            assert peer.send(0, Tag(instance=1, round=claimed_round),
+                             _pickle.dumps(np.int32(1)))
+            real_send, real_sendb = tr.send, tr.send_buffered
+
+            def counting_send(dest, tag, payload):
+                nonlocal wire_sends
+                if tag.flag == FLAG_NORMAL:
+                    wire_sends += 1
+                return real_send(dest, tag, payload)
+
+            def counting_send_buffered(dest, tag, payload):
+                nonlocal wire_sends
+                if tag.flag == FLAG_NORMAL:
+                    wire_sends += 1
+                return real_sendb(dest, tag, payload)
+
+            tr.send = counting_send
+            tr.send_buffered = counting_send_buffered
+            runner = HostRunner(
+                algo, 0, peers, tr, timeout_ms=50,
+                send_when_catching_up=send_when_catching_up)
+            res = runner.run({"initial_value": np.int32(0)},
+                             max_rounds=max_rounds)
+            return res, runner.suppressed_sends, wire_sends
+
+    res, suppressed, wire = run_one(send_when_catching_up=False)
+    # rounds 1..8: next_round=9 > r — suppressed, exactly
+    assert suppressed == claimed_round - 1, (suppressed, res.rounds_run)
+    assert wire == 2 * (res.rounds_run - suppressed)
+
+    res, suppressed, wire = run_one(send_when_catching_up=True)
+    assert suppressed == 0
+    assert wire == 2 * res.rounds_run
+
+
+@pytest.mark.slow
 def test_host_catch_up_send_policy_knobs():
     """RuntimeOptions.sendWhenCatchingUp / delayFirstSend parity
     (RuntimeOptions.scala:31-37, InstanceHandler.scala:169-177): a replica
@@ -1055,7 +1124,13 @@ def test_host_catch_up_send_policy_knobs():
     send_when_catching_up=False it suppresses exactly those stale-round
     sends (wire sends == (n-1)·(rounds − suppressed)), with the default
     policy it suppresses none — and consensus completes with agreement
-    either way."""
+    either way.
+
+    `slow`: the catch-up here is manufactured by a REAL 1.2 s start skew
+    across racing replica threads, which is a wall-clock assumption a
+    loaded box can break (a known tier-1 load-timing flake after PR 7).
+    The deterministic scripted-peer form above pins the policy in tier-1;
+    this cluster form keeps end-to-end coverage in the nightly lane."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -1245,10 +1320,20 @@ def test_host_byzantine_catch_up_rule():
 def test_host_pipelined_instances_under_loss():
     """The in-flight instance window (run_instance_loop_pipelined — the
     reference's InstanceDispatcher + PerfTest2 rate): under injected
-    message loss, burned round deadlines dominate; the sequential loop
-    serializes every one, the rate-8 window overlaps them.  Decisions
-    must agree with full coverage in BOTH modes, and the pipelined wall
-    must be well under the sequential wall."""
+    message loss, decisions must agree with full instance coverage in
+    BOTH the sequential and the rate-8 pipelined mode.  This tier-1 form
+    asserts CORRECTNESS only — the wall-clock overlap ratio is a
+    load-sensitive claim (a known tier-1 flake after PR 7: the native
+    pump + switch-interval work made the sequential arm deadline-paced
+    too) and rides -m slow below."""
+    _pipelined_loss_cluster(rate=1)
+    _pipelined_loss_cluster(rate=8)
+
+
+def _pipelined_loss_cluster(rate, pump=True):
+    """One 4-replica thread cluster under deterministic ~19% loss; asserts
+    agreement + full instance coverage, returns the wall-clock (shared by
+    the tier-1 correctness test and the -m slow overlap-ratio test)."""
     import time as _time
 
     import jax
@@ -1289,50 +1374,62 @@ def test_host_pipelined_instances_under_loss():
         tr.send_buffered = send_buffered
         return tr
 
-    def cluster(rate):
-        n, instances = 4, 12
-        ports = _free_ports(n)
-        peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
-        results = {}
+    n, instances = 4, 12
+    ports = _free_ports(n)
+    peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
+    results = {}
 
-        def node(my_id):
-            tr = lossy(HostTransport(my_id, peers[my_id][1], proto="udp"),
-                       my_id)
-            try:
-                if rate > 1:
-                    results[my_id] = run_instance_loop_pipelined(
-                        algo, my_id, peers, tr, instances, rate=rate,
-                        timeout_ms=400, max_rounds=24)
-                else:
-                    results[my_id] = run_instance_loop(
-                        algo, my_id, peers, tr, instances,
-                        timeout_ms=400, max_rounds=24)
-            finally:
-                tr.close()
+    def node(my_id):
+        tr = lossy(HostTransport(my_id, peers[my_id][1], proto="udp"),
+                   my_id)
+        try:
+            if rate > 1:
+                results[my_id] = run_instance_loop_pipelined(
+                    algo, my_id, peers, tr, instances, rate=rate,
+                    timeout_ms=400, max_rounds=24)
+            else:
+                results[my_id] = run_instance_loop(
+                    algo, my_id, peers, tr, instances,
+                    timeout_ms=400, max_rounds=24, pump=pump)
+        finally:
+            tr.close()
 
-        t0 = _time.perf_counter()
-        threads = [threading.Thread(target=node, args=(i,))
-                   for i in range(n)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=240)
-        wall = _time.perf_counter() - t0
-        assert len(results) == n
-        for inst in range(12):
-            vals = {results[i][inst] for i in range(n)}
-            assert len(vals) == 1 and None not in vals, (inst, vals)
-        return wall
+    t0 = _time.perf_counter()
+    threads = [threading.Thread(target=node, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+    wall = _time.perf_counter() - t0
+    assert len(results) == n
+    for inst in range(12):
+        vals = {results[i][inst] for i in range(n)}
+        assert len(vals) == 1 and None not in vals, (inst, vals)
+    return wall
 
-    sequential = cluster(rate=1)
-    pipelined = cluster(rate=8)
-    # with ~19% loss every instance burns deadlines; the window overlaps
-    # them (observed ~4x).  Timing ratios on a shared box can flake: on a
-    # miss, re-measure once and require the better ratio — correctness
-    # (agreement, full coverage) was already asserted unconditionally
+
+@pytest.mark.slow
+def test_host_pipelined_overlap_beats_sequential():
+    """The wall-clock half of the pipelining claim: under ~19% loss,
+    burned round deadlines dominate; the sequential loop serializes every
+    one, the rate-8 window overlaps them (observed ~4x).
+
+    `slow`: this is a timing-ratio assertion between two schedulers on a
+    shared box — a known tier-1 load-timing flake after PR 7, where the
+    native pump made the sequential arm deadline-paced too.  The
+    sequential arm therefore runs the PYTHON pump (pump=False, the
+    documented baseline the pipelined mux also drives), and the ratio
+    keeps the one-re-measure discipline.  Correctness (agreement + full
+    coverage, both modes) stays pinned unconditionally in tier-1 above."""
+    sequential = _pipelined_loss_cluster(rate=1, pump=False)
+    pipelined = _pipelined_loss_cluster(rate=8)
+    # Timing ratios on a shared box can flake: on a miss, re-measure once
+    # and require the better ratio
     if not pipelined * 1.5 < sequential:
-        sequential = max(sequential, cluster(rate=1))
-        pipelined = min(pipelined, cluster(rate=8))
+        sequential = max(sequential, _pipelined_loss_cluster(rate=1,
+                                                             pump=False))
+        pipelined = min(pipelined, _pipelined_loss_cluster(rate=8))
     assert pipelined * 1.5 < sequential, (pipelined, sequential)
 
 

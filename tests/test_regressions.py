@@ -1,0 +1,84 @@
+"""The fuzzer's regression bank: every artifact under tests/regressions/
+must keep reproducing its RECORDED outcome, exactly.
+
+Each artifact is a fuzzer-found, delta-debugged minimal fault schedule
+(round_tpu/fuzz, docs/FUZZING.md) with the outcome banked at find time on
+both worlds.  Three gates, from cheap to heavy:
+
+  * engine replay — the batched engine under `scenarios.from_schedule`
+    must reproduce expected.engine (also run continuously by the
+    tools/soak.py fuzz rung);
+  * host-wire replay — an in-process cluster of HostRunners over real
+    sockets, each behind FaultyTransport's explicit-schedule mode, must
+    reproduce expected.host (decision values, decided flags AND the
+    decision delay / undecided horizon in rounds);
+  * one artifact additionally replays on a true MULTI-PROCESS cluster of
+    apps/host_replica subprocesses (--chaos-schedule) — the acceptance
+    pin that a TPU/CPU-sim finding is a deterministic deployment-shaped
+    regression test.
+"""
+
+import glob
+import os
+
+import pytest
+
+from round_tpu.fuzz import replay
+
+pytestmark = pytest.mark.fuzz
+
+REG_DIR = os.path.join(os.path.dirname(__file__), "regressions")
+ARTIFACTS = sorted(glob.glob(os.path.join(REG_DIR, "*.json")))
+_IDS = [os.path.splitext(os.path.basename(p))[0] for p in ARTIFACTS]
+
+
+def test_regression_bank_is_seeded():
+    """>= 2 protocols' minimized schedules are banked (the PR-8 seed:
+    OTR undecided-at-horizon + LastVoting decide starvation)."""
+    protos = {replay.load_artifact(p)["protocol"] for p in ARTIFACTS}
+    assert len(protos) >= 2, f"bank holds only {protos}"
+
+
+@pytest.mark.parametrize("path", ARTIFACTS, ids=_IDS)
+def test_banked_artifact_replays_on_engine(path):
+    art = replay.load_artifact(path)
+    assert art["expected"].get("engine"), "artifact banked without outcome"
+    ok, got = replay.check_engine(art)
+    assert ok, (f"{os.path.basename(path)} stopped reproducing on the "
+                f"engine: {got} != {art['expected']['engine']}")
+
+
+@pytest.mark.parametrize("path", ARTIFACTS, ids=_IDS)
+def test_banked_artifact_replays_on_host_wire(path):
+    art = replay.load_artifact(path)
+    assert art["expected"].get("host"), "artifact banked without host run"
+    # 400 ms deadline: generous vs warm localhost round walls (~1-3 ms),
+    # so a full-suite scheduler stall cannot turn a delivered frame into
+    # a phantom drop; burned-deadline rounds (the drops themselves) pace
+    # the replay, so the cost is rounds x 0.4 s worst case
+    ok, got = replay.check_host(art, timeout_ms=400)
+    assert ok, (f"{os.path.basename(path)} stopped reproducing on the "
+                f"host wire: {got} != {art['expected']['host']}")
+
+
+def test_banked_artifact_replays_on_multiprocess_cluster(tmp_path):
+    """The heavyweight acceptance pin, run on ONE banked artifact: a real
+    multi-process FaultyTransport cluster (host_replica subprocesses with
+    --chaos-schedule) reproduces the recorded outcome byte-for-byte —
+    decisions AND decision delay / undecided horizon.
+
+    The pinned artifact is the ALL-UNDECIDED one deliberately: subprocess
+    replicas pay first-use jit compile against live round deadlines, and
+    a box-load stall can only make frames LATE (remove deliveries, never
+    add them) — an all-undecided outcome is therefore load-invariant
+    (undecided runs always run exactly max_rounds), where a
+    decides-at-round-k artifact could record a later decision under load
+    (the PR-7 load-timing-flake lesson, applied to the new suite)."""
+    path = next(p for p in ARTIFACTS
+                if replay.load_artifact(p)["protocol"] == "otr")
+    art = replay.load_artifact(path)
+    res = replay.run_schedule_cluster(str(tmp_path), path, timeout_ms=400)
+    got = {k: res[k] for k in ("decided", "decision", "rounds")}
+    assert got == art["expected"]["host"], \
+        (f"{os.path.basename(path)} multi-process replay diverged: "
+         f"{got} != {art['expected']['host']}")

@@ -707,6 +707,44 @@ def check_host_chaos(rng, it):
     return cfg
 
 
+def check_fuzz(rng, it):
+    """The fuzz rotation rung: a time-boxed (~60 s) coverage-guided
+    fault-schedule search on one protocol (round_tpu/fuzz, docs/FUZZING.md)
+    banking generations, schedules/sec, best objective score and
+    coverage-cell count into SOAK.jsonl — the trajectory of
+    schedules_per_sec is the batched-evaluation drift monitor.  The rung
+    then replays EVERY banked regression artifact (tests/regressions/)
+    on the engine and fails if one stops reproducing its recorded
+    outcome — the same gate tests/test_regressions.py applies, run
+    continuously."""
+    import glob
+
+    from round_tpu.fuzz import replay as freplay
+    from round_tpu.fuzz.search import make_target, search
+
+    seed = int(rng.integers(0, 2**31))
+    algo = str(rng.choice(["otr", "lastvoting"]))
+    target = make_target(algo, n=4, horizon=12, seed=seed)
+    res = search(target, pop_size=512, generations=500, seed=seed,
+                 time_box_s=45.0)
+    cfg = dict(kind="fuzz", it=it, algo=algo, seed=seed,
+               generations=res.generations, evaluated=res.evaluated,
+               schedules_per_sec=round(res.schedules_per_sec, 1),
+               best_score=round(res.best_score, 4),
+               best_outcome=res.best_outcome,
+               coverage_cells=int(res.coverage_map.sum()),
+               coverage_total=target.n_cells)
+    for path in sorted(glob.glob(
+            os.path.join(REPO, "tests", "regressions", "*.json"))):
+        ok, got = freplay.check_engine(freplay.load_artifact(path))
+        if not ok:
+            return {**cfg,
+                    "fail": f"banked regression artifact stopped "
+                            f"reproducing: {os.path.basename(path)}",
+                    "got": got}
+    return cfg
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=60.0)
@@ -733,7 +771,8 @@ def main():
                 lambda r, i: check_otr_family(r, i, scale=True),
                 check_otr_flagship_shape, check_host_chaos, check_lint,
                 check_host_perf, check_host_lanes, check_host_pump,
-                lambda r, i: check_host_perf(r, i, payload=True)]
+                lambda r, i: check_host_perf(r, i, payload=True),
+                check_fuzz]
     while time.monotonic() < t_end:
         check = rotation[it % len(rotation)]
         t0 = time.perf_counter()
