@@ -5,9 +5,10 @@ ill-formed protocols statically (SURVEY §1, Verifier.scala); this package
 is that gate for the tensor port: every registered model's send/update is
 abstractly traced on CPU (jax.eval_shape / jax.make_jaxpr — nothing
 executes, no accelerator backend initializes) and its source is scanned by
-AST passes, producing typed findings across five rule families:
+AST passes, producing typed findings across six rule families:
 
-  comm-closure, tpu-lowerability, recompile-hazard, purity, spec-coherence
+  comm-closure, tpu-lowerability, recompile-hazard, purity,
+  spec-coherence, threshold-extractable
 
 CLI: ``python -m round_tpu.apps.lint [--all|MODEL] [--json] [--baseline …]``
 Catalog + suppression workflow: docs/ANALYSIS.md.

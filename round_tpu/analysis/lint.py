@@ -43,6 +43,9 @@ def lint_model(entry: ModelEntry) -> List[Finding]:
         )]
     findings = list(ast_rules(entry.name, algo))
     findings += trace_rules(entry.name, entry.n, algo, io)
+    from round_tpu.analysis.threshold import threshold_rules
+
+    findings += threshold_rules(entry)
     return _dedupe_sorted(findings)
 
 

@@ -14,7 +14,7 @@ the package's exported Algorithm subclasses.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import numpy as np
 
@@ -27,12 +27,21 @@ class ModelEntry:
     build: () -> (Algorithm, io pytree) at n = entry.n.
     n:     static group size used for abstract tracing.
     note:  one-liner shown by ``lint --list``.
+    build_at: optional (n) -> (Algorithm, io) constructor at an ARBITRARY
+      group size.  Threshold-automaton extraction (analysis/threshold.py)
+      traces the same round code at several n samples and fits the quorum
+      constants as affine functions of n — impossible from one fixed-n
+      trace, where ``(2*n)//3`` is just the literal 5.  Models whose io
+      shape is not parametric in n (the fixed-grid cgol) or whose value
+      domain is outside the int/bool threshold fragment (epsilon's reals)
+      leave it None and are out of the parameterized pass's scope.
     """
 
     name: str
     build: Callable[[], Tuple[Any, Any]]
     n: int = 8
     note: str = ""
+    build_at: Optional[Callable[[int], Tuple[Any, Any]]] = None
 
 
 def _consensus_int(n, v=4):
@@ -41,86 +50,86 @@ def _consensus_int(n, v=4):
     return consensus_io(np.arange(n, dtype=np.int32) % v)
 
 
-def _otr():
+def _otr(n=8):
     from round_tpu.models.otr import OTR
 
-    return OTR(), _consensus_int(8)
+    return OTR(), _consensus_int(n)
 
 
-def _otr_hist():
+def _otr_hist(n=8):
     from round_tpu.models.otr import OTR
 
-    return OTR(n_values=4), _consensus_int(8)
+    return OTR(n_values=4), _consensus_int(n)
 
 
-def _floodmin():
+def _floodmin(n=8):
     from round_tpu.models.floodmin import FloodMin
 
-    return FloodMin(f=2), _consensus_int(8)
+    return FloodMin(f=2), _consensus_int(n)
 
 
-def _benor():
+def _benor(n=8):
     from round_tpu.models.benor import BenOr
     from round_tpu.models.common import consensus_io
 
-    return BenOr(), consensus_io(np.arange(8) % 2 == 0)
+    return BenOr(), consensus_io(np.arange(n) % 2 == 0)
 
 
-def _lastvoting():
+def _lastvoting(n=8):
     from round_tpu.models.lastvoting import LastVoting
 
-    return LastVoting(), _consensus_int(8)
+    return LastVoting(), _consensus_int(n)
 
 
-def _lastvoting_bytes():
+def _lastvoting_bytes(n=8):
     from round_tpu.models.lastvoting import LastVotingBytes
 
     algo = LastVotingBytes(payload_bytes=16)
-    io = {"initial_value": np.zeros((8, 16), dtype=np.uint8)}
+    io = {"initial_value": np.zeros((n, 16), dtype=np.uint8)}
     return algo, io
 
 
-def _slv():
+def _slv(n=8):
     from round_tpu.models.lastvoting_variants import ShortLastVoting
 
-    return ShortLastVoting(), _consensus_int(8)
+    return ShortLastVoting(), _consensus_int(n)
 
 
-def _mlv():
+def _mlv(n=8):
     from round_tpu.models.lastvoting_variants import MultiLastVoting, mlv_io
 
-    return MultiLastVoting(), mlv_io(8, {0: 5, 3: 9}, {1: 0})
+    return MultiLastVoting(), mlv_io(n, {0: 5, 3: 9}, {1: 0})
 
 
-def _lv_event():
+def _lv_event(n=8):
     from round_tpu.models.lastvoting_event import LastVotingEvent
 
-    return LastVotingEvent(), _consensus_int(8)
+    return LastVotingEvent(), _consensus_int(n)
 
 
-def _tpc():
+def _tpc(n=8):
     from round_tpu.models.tpc import TwoPhaseCommit, tpc_io
 
-    return TwoPhaseCommit(), tpc_io(0, np.ones(8, dtype=bool))
+    return TwoPhaseCommit(), tpc_io(0, np.ones(n, dtype=bool))
 
 
-def _tpc_event():
+def _tpc_event(n=8):
     from round_tpu.models.tpc_event import TwoPhaseCommitEvent
     from round_tpu.models.tpc import tpc_io
 
-    return TwoPhaseCommitEvent(), tpc_io(0, np.ones(8, dtype=bool))
+    return TwoPhaseCommitEvent(), tpc_io(0, np.ones(n, dtype=bool))
 
 
-def _kset():
+def _kset(n=8):
     from round_tpu.models.kset import KSetAgreement
 
-    return KSetAgreement(k=2), _consensus_int(8)
+    return KSetAgreement(k=2), _consensus_int(n)
 
 
-def _kset_es():
+def _kset_es(n=8):
     from round_tpu.models.kset import KSetEarlyStopping
 
-    return KSetEarlyStopping(t=2, k=2), _consensus_int(8)
+    return KSetEarlyStopping(t=2, k=2), _consensus_int(n)
 
 
 def _epsilon():
@@ -138,23 +147,23 @@ def _lattice():
             lattice_io([[i % 6] for i in range(8)], 6))
 
 
-def _erb():
+def _erb(n=8):
     from round_tpu.models.erb import EagerReliableBroadcast, broadcast_io
 
-    return EagerReliableBroadcast(), broadcast_io(0, 3, 8)
+    return EagerReliableBroadcast(), broadcast_io(0, 3, n)
 
 
-def _esfd():
+def _esfd(n=8):
     from round_tpu.models.failure_detector import Esfd
 
     return Esfd(hysteresis=5), {}
 
 
-def _mutex():
+def _mutex(n=8):
     from round_tpu.models.mutex import SelfStabilizingMutualExclusion, mutex_io
 
     return (SelfStabilizingMutualExclusion(),
-            mutex_io(np.arange(8, dtype=np.int32) % 9))
+            mutex_io(np.arange(n, dtype=np.int32) % (n + 1)))
 
 
 def _cgol():
@@ -165,47 +174,47 @@ def _cgol():
     return ConwayGameOfLife(rows=2, cols=4), cgol_io(grid)
 
 
-def _theta():
+def _theta(n=8):
     from round_tpu.models.theta import ThetaModel
 
     return ThetaModel(f=1, theta=2.0), {}
 
 
-def _pbft():
+def _pbft(n=8):
     from round_tpu.models.pbft import PbftConsensus
 
-    return PbftConsensus(), {"initial_value": np.arange(8, dtype=np.int32)}
+    return PbftConsensus(), {"initial_value": np.arange(n, dtype=np.int32)}
 
 
-def _pbft_vc():
+def _pbft_vc(n=8):
     from round_tpu.models.pbft import PbftViewChange
 
-    return PbftViewChange(), {"initial_value": np.arange(8, dtype=np.int32)}
+    return PbftViewChange(), {"initial_value": np.arange(n, dtype=np.int32)}
 
 
 REGISTRY: Tuple[ModelEntry, ...] = (
-    ModelEntry("otr", _otr, note="one-third-rule consensus (generic mmor path)"),
-    ModelEntry("otr-hist", _otr_hist, note="OTR with the static value-domain histogram path"),
-    ModelEntry("floodmin", _floodmin, note="FloodMin f-crash consensus"),
-    ModelEntry("benor", _benor, note="Ben-Or randomized binary consensus"),
-    ModelEntry("lastvoting", _lastvoting, note="LastVoting (Paxos in HO), 4-round phases"),
-    ModelEntry("lastvoting-bytes", _lastvoting_bytes, note="LastVoting over opaque byte payloads"),
-    ModelEntry("slv", _slv, note="ShortLastVoting variant"),
-    ModelEntry("mlv", _mlv, note="MultiLastVoting (proposer/acceptor split)"),
-    ModelEntry("lastvoting-event", _lv_event, note="LastVoting as FoldRounds (OOPSLA'20 event rounds)"),
-    ModelEntry("tpc", _tpc, note="Two-phase commit"),
-    ModelEntry("tpc-event", _tpc_event, note="Two-phase commit as FoldRounds"),
-    ModelEntry("kset", _kset, note="k-set agreement by map merging"),
-    ModelEntry("kset-es", _kset_es, note="early-stopping k-set agreement"),
+    ModelEntry("otr", _otr, note="one-third-rule consensus (generic mmor path)", build_at=_otr),
+    ModelEntry("otr-hist", _otr_hist, note="OTR with the static value-domain histogram path", build_at=_otr_hist),
+    ModelEntry("floodmin", _floodmin, note="FloodMin f-crash consensus", build_at=_floodmin),
+    ModelEntry("benor", _benor, note="Ben-Or randomized binary consensus", build_at=_benor),
+    ModelEntry("lastvoting", _lastvoting, note="LastVoting (Paxos in HO), 4-round phases", build_at=_lastvoting),
+    ModelEntry("lastvoting-bytes", _lastvoting_bytes, note="LastVoting over opaque byte payloads", build_at=_lastvoting_bytes),
+    ModelEntry("slv", _slv, note="ShortLastVoting variant", build_at=_slv),
+    ModelEntry("mlv", _mlv, note="MultiLastVoting (proposer/acceptor split)", build_at=_mlv),
+    ModelEntry("lastvoting-event", _lv_event, note="LastVoting as FoldRounds (OOPSLA'20 event rounds)", build_at=_lv_event),
+    ModelEntry("tpc", _tpc, note="Two-phase commit", build_at=_tpc),
+    ModelEntry("tpc-event", _tpc_event, note="Two-phase commit as FoldRounds", build_at=_tpc_event),
+    ModelEntry("kset", _kset, note="k-set agreement by map merging", build_at=_kset),
+    ModelEntry("kset-es", _kset_es, note="early-stopping k-set agreement", build_at=_kset_es),
     ModelEntry("epsilon", _epsilon, note="approximate (epsilon) real-valued consensus"),
     ModelEntry("lattice", _lattice, note="lattice agreement over bitset joins"),
-    ModelEntry("erb", _erb, note="eager reliable broadcast"),
-    ModelEntry("esfd", _esfd, note="eventually-strong failure detector"),
-    ModelEntry("mutex", _mutex, note="Dijkstra self-stabilizing token ring (EventRound)"),
+    ModelEntry("erb", _erb, note="eager reliable broadcast", build_at=_erb),
+    ModelEntry("esfd", _esfd, note="eventually-strong failure detector", build_at=_esfd),
+    ModelEntry("mutex", _mutex, note="Dijkstra self-stabilizing token ring (EventRound)", build_at=_mutex),
     ModelEntry("cgol", _cgol, note="Conway life on the torus wire (stress model)"),
-    ModelEntry("theta", _theta, note="Theta-model round synchronizer"),
-    ModelEntry("pbft", _pbft, note="PBFT agreement rounds (benign-execution slice)"),
-    ModelEntry("pbft-vc", _pbft_vc, note="PBFT view-change selection rounds"),
+    ModelEntry("theta", _theta, note="Theta-model round synchronizer", build_at=_theta),
+    ModelEntry("pbft", _pbft, note="PBFT agreement rounds (benign-execution slice)", build_at=_pbft),
+    ModelEntry("pbft-vc", _pbft_vc, note="PBFT view-change selection rounds", build_at=_pbft_vc),
 )
 
 BY_NAME = {e.name: e for e in REGISTRY}

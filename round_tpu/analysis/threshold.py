@@ -1,0 +1,1332 @@
+"""Threshold-automaton extraction from round jaxpr traces.
+
+The Byzantine Model Checker line of work proves round-based fault-tolerant
+algorithms safe/live for ALL n by abstracting each process into a
+*threshold automaton*: a finite control graph whose transition rules are
+guarded by linear threshold expressions over message counts ("heard more
+than 2n/3 estimates", "a majority of acks").  This module recovers that
+automaton from the SAME abstract traces roundlint already computes
+(tracerules._RoundTracer shape discipline, jax.make_jaxpr on CPU — nothing
+executes):
+
+  locations  = reachable valuations of the model's boolean state fields
+               (decided / commit / ready / ...), per process;
+  rules      = per-round transitions between locations, guarded by cubes
+               over extracted guard atoms;
+  thresholds = comparisons whose one side is a *message count* (a
+               reduce_sum / count-matmul over the mailbox mask) and whose
+               other side is a function of n alone.
+
+The count thresholds are recovered as affine-in-n expressions by MULTI-n
+SAMPLING: round code computes ``(2 * ctx.n) // 3`` in Python, so a single
+trace only ever sees the literal 5 — tracing the same code at several
+group sizes and fitting ``floor((a*n + b) / d)`` against the observed
+constants recovers the symbolic threshold (and rejects guards that are
+not affine in n, the `threshold-extractable` lint family).
+
+The resilience condition (``n > 3f`` / ``n > 2f``) is taken from the
+model's DECLARED fault envelope (Algorithm.fault_envelope) — extraction
+recovers the guards, the model author states what faults they are meant
+to survive, and verify/param.py proves the two consistent for all n.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.extend import core as jax_core
+
+from round_tpu.analysis.findings import Finding, relpath
+from round_tpu.analysis.tracerules import _RoundTracer, _fn_anchor
+
+#: default group sizes for the affine fit.  Chosen to break floor-form
+#: aliasing: e.g. floor(2n/3) and floor((3n-3)/4) agree on {5,7,9,12} and
+#: are split by 16.  Residues cover 0,1,2 mod 3 and 0,1,3 mod 4.
+DEFAULT_SAMPLES = (5, 7, 9, 12, 16)
+
+#: the cheaper sample set the lint rule uses (extractability does not need
+#: a canonical fit, only *a* fit)
+LINT_SAMPLES = (5, 7, 9)
+
+
+class ThresholdExtractionError(Exception):
+    """The model's guards cannot be recovered as threshold expressions.
+    Carries the offending guard's description so the refusal is actionable
+    (the extractor must REFUSE rather than mis-extract)."""
+
+
+# ---------------------------------------------------------------------------
+# Abstract values: taint + linear-combination-of-counts + boolean expressions
+# ---------------------------------------------------------------------------
+
+#: taint tags
+T_MASK = "mask"        # derived from the delivery mask (HO & dest)
+T_PAYLOAD = "payload"  # derived from a received payload / sent value
+T_RNG = "rng"          # derived from the per-lane PRNG key
+T_ROUND = "round"      # derived from the round number r
+T_ID = "id"            # derived from the lane-id iota
+
+
+class Opaque:
+    """A value the automaton does not model: carries taint tags plus the
+    contributing state-field names, and whether it is a 0/1 indicator."""
+
+    __slots__ = ("taint", "fields", "is01")
+
+    def __init__(self, taint=frozenset(), fields=frozenset(), is01=False):
+        self.taint = frozenset(taint)
+        self.fields = frozenset(fields)
+        self.is01 = bool(is01)
+
+    def __repr__(self):
+        return f"Opaque({sorted(self.taint)}, {sorted(self.fields)})"
+
+
+class CountVec(Opaque):
+    """A vector of message counts (the histogram/equality count-matmul
+    output): reductions over it yield count atoms."""
+
+
+@dataclasses.dataclass(frozen=True)
+class CountAtom:
+    """One message-count expression: a reduce_sum (or count-matmul + max)
+    over the mailbox mask, possibly conjoined with payload/state
+    predicates.
+
+    kind:   "size" (mask alone), "support" (mask ∧ value predicate) or
+            "max_support" (max over a histogram of supports).
+    fields: the state fields feeding the predicate (empty for "size") —
+            e.g. {"x"} for OTR's value-support count, {"ts"} for the LV
+            ack count (the sender guard rides the dest mask).
+    idx:    per-round registration order — the cross-sample matching key.
+    """
+
+    round: int
+    idx: int
+    kind: str
+    fields: Tuple[str, ...]
+
+    @property
+    def label(self) -> str:
+        return (self.kind if not self.fields
+                else f"{self.kind}[{','.join(self.fields)}]")
+
+
+class Lin:
+    """An integer value that is a linear combination of count atoms plus a
+    constant (known concretely for the current n sample):
+    ``sum(coeffs[atom] * atom) + const``."""
+
+    __slots__ = ("coeffs", "const")
+
+    def __init__(self, coeffs: Optional[Dict[CountAtom, int]] = None,
+                 const: int = 0):
+        self.coeffs = {a: c for a, c in (coeffs or {}).items() if c != 0}
+        self.const = int(const)
+
+    @property
+    def is_const(self) -> bool:
+        return not self.coeffs
+
+    def add(self, other: "Lin", sign: int = 1) -> "Lin":
+        coeffs = dict(self.coeffs)
+        for a, c in other.coeffs.items():
+            coeffs[a] = coeffs.get(a, 0) + sign * c
+        return Lin(coeffs, self.const + sign * other.const)
+
+    def scale(self, k: int) -> "Lin":
+        return Lin({a: c * k for a, c in self.coeffs.items()}, self.const * k)
+
+    def __repr__(self):
+        parts = [f"{c}*{a.label}" for a, c in self.coeffs.items()]
+        parts.append(str(self.const))
+        return " + ".join(parts)
+
+
+# -- boolean expressions over guard atoms -----------------------------------
+
+class BExpr:
+    def atoms(self) -> frozenset:
+        raise NotImplementedError
+
+    def ev(self, env: Dict[str, bool]) -> bool:
+        raise NotImplementedError
+
+
+class BConst(BExpr):
+    __slots__ = ("v",)
+
+    def __init__(self, v: bool):
+        self.v = bool(v)
+
+    def atoms(self):
+        return frozenset()
+
+    def ev(self, env):
+        return self.v
+
+
+class BAtom(BExpr):
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def atoms(self):
+        return frozenset([self.name])
+
+    def ev(self, env):
+        return env[self.name]
+
+
+class BNot(BExpr):
+    __slots__ = ("a",)
+
+    def __init__(self, a: BExpr):
+        self.a = a
+
+    def atoms(self):
+        return self.a.atoms()
+
+    def ev(self, env):
+        return not self.a.ev(env)
+
+
+class BOp(BExpr):
+    __slots__ = ("op", "a", "b")
+
+    def __init__(self, op: str, a: BExpr, b: BExpr):
+        self.op, self.a, self.b = op, a, b
+
+    def atoms(self):
+        return self.a.atoms() | self.b.atoms()
+
+    def ev(self, env):
+        x, y = self.a.ev(env), self.b.ev(env)
+        if self.op == "and":
+            return x and y
+        if self.op == "or":
+            return x or y
+        return x != y  # xor
+
+
+class BIte(BExpr):
+    __slots__ = ("c", "t", "e")
+
+    def __init__(self, c: BExpr, t: BExpr, e: BExpr):
+        self.c, self.t, self.e = c, t, e
+
+    def atoms(self):
+        return self.c.atoms() | self.t.atoms() | self.e.atoms()
+
+    def ev(self, env):
+        return self.t.ev(env) if self.c.ev(env) else self.e.ev(env)
+
+
+# ---------------------------------------------------------------------------
+# Guard atoms
+# ---------------------------------------------------------------------------
+
+#: guard-atom kinds
+G_THRESHOLD = "threshold"  # linear-in-counts vs affine-in-n
+G_RECEIVE = "receive"      # heard a specific sender (mask point lookup)
+G_PHASE = "phase"          # predicate over the round number r
+G_ROLE = "role"            # lane-id vs round-derived coordinator arithmetic
+G_STATE = "state"          # a boolean state field read as a guard
+G_DATA = "data"            # data-/rng-dependent — NOT threshold-extractable
+
+
+@dataclasses.dataclass
+class GuardAtom:
+    """One boolean guard atom of a round, registered in trace order (the
+    cross-sample matching key is (round, idx))."""
+
+    round: int
+    idx: int
+    kind: str
+    #: "gt" | "ge" | "eq" | "ne" (thresholds; negations normalize on use)
+    op: str = ""
+    #: THRESHOLD: coefficients per count atom of (lhs - rhs)
+    coeffs: Dict[CountAtom, int] = dataclasses.field(default_factory=dict)
+    #: THRESHOLD: the constant part of (lhs - rhs) at THIS n sample
+    const: int = 0
+    #: human-readable description (receive/phase/role/data atoms)
+    detail: str = ""
+    #: DATA: why it is not a threshold (taint tags + fields)
+    taint: Tuple[str, ...] = ()
+
+    @property
+    def name(self) -> str:
+        return f"g{self.round}.{self.idx}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Threshold:
+    """A fitted threshold guard: ``sum(coeff_i * count_i)  op
+    floor((a*n + b) / d)`` — e.g. OTR's quorum is size > (2n+0)/3 and a
+    majority ack is support[ts] > (n+0)/2."""
+
+    op: str                      # "gt" | "ge" | "eq" | "ne"
+    counts: Tuple[str, ...]      # count labels, fit order
+    coeffs: Tuple[int, ...]      # coefficients per count
+    a: int                       # numerator n-coefficient
+    b: int                       # numerator constant
+    d: int                       # denominator (>= 1)
+
+    def render(self) -> str:
+        lhs = " + ".join(
+            (f"{c}*{l}" if c != 1 else l)
+            for c, l in zip(self.coeffs, self.counts)
+        )
+        sym = {"gt": ">", "ge": ">=", "eq": "==", "ne": "!="}[self.op]
+        if self.d == 1:
+            rhs = f"{self.a}n{self.b:+d}" if self.b else f"{self.a}n"
+            if self.a == 0:
+                rhs = str(self.b)
+        else:
+            rhs = f"({self.a}n{self.b:+d})//{self.d}" if self.b \
+                else f"({self.a}n)//{self.d}"
+        return f"{lhs} {sym} {rhs}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One automaton rule: in round `round`, a process at `src` moves to
+    `dst` when the guard cube holds.  The guard is a tuple of
+    (atom_name, polarity) literals; atom_name indexes the automaton's
+    guard table."""
+
+    round: int
+    src: Tuple[Tuple[str, bool], ...]   # location as sorted (field, value)
+    dst: Tuple[Tuple[str, bool], ...]
+    guard: Tuple[Tuple[str, bool], ...]
+
+    def render(self, guards: Dict[str, "GuardInfo"]) -> str:
+        def loc(v):
+            on = [f for f, b in v if b]
+            return "{" + ",".join(on) + "}" if on else "{}"
+
+        if not self.guard:
+            g = "true"
+        else:
+            g = " & ".join(
+                ("" if pol else "!") + guards[a].render()
+                for a, pol in self.guard
+            )
+        return f"r{self.round}: {loc(self.src)} -> {loc(self.dst)} when {g}"
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardInfo:
+    """A fitted guard in the automaton's guard table."""
+
+    name: str
+    kind: str
+    threshold: Optional[Threshold] = None
+    detail: str = ""
+
+    def render(self) -> str:
+        if self.threshold is not None:
+            return self.threshold.render()
+        return self.detail or self.name
+
+
+@dataclasses.dataclass
+class ThresholdAutomaton:
+    """The extracted automaton for one protocol."""
+
+    protocol: str
+    n_samples: Tuple[int, ...]
+    fields: Tuple[str, ...]                       # boolean control fields
+    locations: Tuple[Tuple[Tuple[str, bool], ...], ...]
+    init_locations: Tuple[Tuple[Tuple[str, bool], ...], ...]
+    rules: Tuple[Rule, ...]
+    guards: Dict[str, GuardInfo]
+    resilience: Optional[Tuple[int, str]]         # (K, "n > Kf") or None
+    rounds_per_phase: int
+
+    def thresholds(self) -> List[GuardInfo]:
+        return [g for g in self.guards.values() if g.kind == G_THRESHOLD]
+
+    def to_dict(self) -> dict:
+        return {
+            "protocol": self.protocol,
+            "n_samples": list(self.n_samples),
+            "fields": list(self.fields),
+            "locations": [dict(l) for l in self.locations],
+            "init_locations": [dict(l) for l in self.init_locations],
+            "rules": [
+                {"round": r.round, "src": dict(r.src), "dst": dict(r.dst),
+                 "guard": [("" if pol else "!") +
+                           self.guards[a].render()
+                           for a, pol in r.guard]}
+                for r in self.rules
+            ],
+            "guards": {name: {"kind": g.kind, "expr": g.render()}
+                       for name, g in self.guards.items()},
+            "resilience": self.resilience[1] if self.resilience else None,
+            "rounds_per_phase": self.rounds_per_phase,
+        }
+
+    def render(self) -> str:
+        lines = [f"threshold automaton: {self.protocol} "
+                 f"(fit over n in {list(self.n_samples)})"]
+        if self.resilience:
+            lines.append(f"  resilience: {self.resilience[1]}")
+        lines.append(f"  control fields: {', '.join(self.fields) or '-'}")
+        for name, g in sorted(self.guards.items()):
+            lines.append(f"  guard {name} [{g.kind}]: {g.render()}")
+        for r in self.rules:
+            lines.append("  rule " + r.render(self.guards))
+        return "\n".join(lines)
+
+
+def parse_envelope(envelope: Optional[str]) -> Optional[Tuple[int, str]]:
+    """Parse a declared fault envelope ``"n > Kf"`` into (K, canonical)."""
+    if not envelope:
+        return None
+    import re
+
+    m = re.fullmatch(r"\s*n\s*>\s*(\d*)\s*\*?\s*f\s*", envelope)
+    if not m:
+        raise ThresholdExtractionError(
+            f"unparseable fault envelope {envelope!r} (expected 'n > Kf')"
+        )
+    k = int(m.group(1) or "1")
+    return k, f"n > {k}f"
+
+
+# ---------------------------------------------------------------------------
+# The taint/linear interpreter (one round, one n sample)
+# ---------------------------------------------------------------------------
+
+_CMP = {"lt": "lt", "le": "le", "gt": "gt", "ge": "ge", "eq": "eq", "ne": "ne"}
+_FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq", "ne": "ne"}
+_BOOLOPS = {"and": "and", "or": "or", "xor": "xor"}
+
+
+class _RoundInterp:
+    """Abstractly interprets one round's jaxpr (send + exchange + update,
+    vmapped over lanes) over the taint/Lin/BExpr domain, registering count
+    atoms and guard atoms as it goes.  TOTAL by construction: primitives
+    outside the modeled fragment produce Opaque values, never errors."""
+
+    def __init__(self, round_idx: int, n: int):
+        self.round_idx = round_idx
+        self.n = n
+        self.counts: List[CountAtom] = []
+        self.guards: List[GuardAtom] = []
+        self._guard_keys: Dict[Any, GuardAtom] = {}
+
+    # -- registration -------------------------------------------------------
+
+    def _count(self, kind: str, fields) -> Lin:
+        atom = CountAtom(self.round_idx, len(self.counts), kind,
+                         tuple(sorted(fields)))
+        self.counts.append(atom)
+        return Lin({atom: 1})
+
+    def _guard(self, key, **kw) -> BAtom:
+        """Register (or reuse) a guard atom; `key` dedupes structurally
+        identical comparisons within the round."""
+        if key in self._guard_keys:
+            return BAtom(self._guard_keys[key].name)
+        atom = GuardAtom(self.round_idx, len(self.guards), **kw)
+        self.guards.append(atom)
+        self._guard_keys[key] = atom
+        return BAtom(atom.name)
+
+    # -- value coercion -----------------------------------------------------
+
+    def _lift(self, v):
+        if isinstance(v, (Opaque, Lin)) or isinstance(v, BExpr):
+            return v
+        arr = np.asarray(v)
+        if arr.dtype == np.bool_:
+            vals = np.unique(arr)
+            if vals.size == 1:
+                return BConst(bool(vals[0]))
+            return Opaque(is01=True)
+        if np.issubdtype(arr.dtype, np.integer) or np.issubdtype(
+                arr.dtype, np.floating):
+            vals = np.unique(arr)
+            if vals.size == 1 and float(vals[0]) == int(vals[0]):
+                return Lin(const=int(vals[0]))
+            if arr.ndim == 1 and np.array_equal(
+                    arr, np.arange(arr.shape[0])):
+                # the tracer's closure-constant lane-id vector (vmapped
+                # ctx.id): the coordinator-role comparisons need the tag
+                return Opaque(frozenset([T_ID]))
+        return Opaque()
+
+    @staticmethod
+    def _taint(v) -> frozenset:
+        if isinstance(v, Opaque):
+            return v.taint
+        return frozenset()
+
+    @staticmethod
+    def _fields(v) -> frozenset:
+        if isinstance(v, Opaque):
+            return v.fields
+        return frozenset()
+
+    def _opaque_of(self, ins, is01=False, cls=Opaque):
+        taint = frozenset().union(*[self._taint(self._lift(v)) for v in ins]) \
+            if ins else frozenset()
+        fields = frozenset().union(
+            *[self._fields(self._lift(v)) for v in ins]) if ins else frozenset()
+        return cls(taint, fields, is01=is01)
+
+    def _is01(self, v) -> bool:
+        v = self._lift(v)
+        if isinstance(v, BExpr):
+            return True
+        if isinstance(v, Opaque):
+            return v.is01
+        if isinstance(v, Lin):
+            return v.is_const and v.const in (0, 1)
+        return False
+
+    # -- the walk -----------------------------------------------------------
+
+    def run(self, jaxpr, consts, args):
+        env: Dict[Any, Any] = {}
+
+        def read(a):
+            if isinstance(a, jax_core.Literal):
+                return self._lift(np.asarray(a.val))
+            return env.get(a, Opaque())
+
+        for v, c in zip(jaxpr.constvars, consts):
+            env[v] = self._lift(np.asarray(c))
+        for v, a in zip(jaxpr.invars, args):
+            env[v] = a
+
+        for eqn in jaxpr.eqns:
+            ins = [read(x) for x in eqn.invars]
+            outs = self.eval_prim(eqn, ins)
+            if not isinstance(outs, (list, tuple)):
+                outs = [outs]
+            if len(outs) != len(eqn.outvars):
+                outs = [self._opaque_of(ins)] * len(eqn.outvars)
+            for var, out in zip(eqn.outvars, outs):
+                env[var] = out
+
+        return [read(v) for v in jaxpr.outvars]
+
+    # -- primitive semantics -------------------------------------------------
+
+    def eval_prim(self, eqn, ins):
+        prim = eqn.primitive.name
+        lifted = [self._lift(v) for v in ins]
+
+        if prim in ("convert_element_type", "copy", "stop_gradient",
+                    "squeeze", "reshape", "broadcast_in_dim", "transpose",
+                    "rev", "expand_dims"):
+            return lifted[0]
+        if prim == "iota":
+            return Opaque(frozenset([T_ID]))
+        if prim in ("add", "sub"):
+            a, b = lifted
+            if isinstance(a, Lin) and isinstance(b, Lin):
+                return a.add(b, 1 if prim == "add" else -1)
+            return self._opaque_of(lifted)
+        if prim == "mul":
+            a, b = lifted
+            if isinstance(a, Lin) and isinstance(b, Lin):
+                if a.is_const:
+                    return b.scale(a.const)
+                if b.is_const:
+                    return a.scale(b.const)
+            if self._is01(a) and self._is01(b):
+                # indicator product = conjunction: keep 01-ness so a later
+                # reduce_sum still reads as a count
+                return self._opaque_of(lifted, is01=True)
+            return self._opaque_of(lifted)
+        if prim in ("div", "rem", "pow", "max", "min", "neg", "sign", "abs",
+                    "floor", "ceil", "round"):
+            out = self._opaque_of(lifted)
+            # constant arithmetic stays constant (e.g. (2*n)//3 folding
+            # inside a floor_divide sub-jaxpr)
+            if all(isinstance(v, Lin) and v.is_const for v in lifted):
+                return self._const_fold(prim, lifted)
+            return out
+        if prim == "not":
+            a = lifted[0]
+            if isinstance(a, BExpr):
+                return BNot(a)
+            return self._opaque_of(lifted, is01=self._is01(a))
+        if prim in _BOOLOPS:
+            a, b = lifted
+            if isinstance(a, BExpr) and isinstance(b, BExpr):
+                return BOp(_BOOLOPS[prim], a, b)
+            return self._opaque_of(lifted, is01=True)
+        if prim in _CMP:
+            return self._compare(_CMP[prim], lifted)
+        if prim == "select_n":
+            which, *cases = lifted
+            if len(cases) == 2:
+                # select_n(pred, on_false, on_true)
+                a, b = cases
+                if isinstance(which, BConst):
+                    return b if which.v else a
+                if isinstance(which, BExpr) and isinstance(a, BExpr) \
+                        and isinstance(b, BExpr):
+                    return BIte(which, b, a)
+            return self._opaque_of(lifted, is01=all(
+                self._is01(c) for c in cases))
+        if prim in ("reduce_sum",):
+            return self._reduce_sum(eqn, lifted[0])
+        if prim in ("reduce_max", "reduce_min"):
+            op = lifted[0]
+            if isinstance(op, CountVec):
+                return self._count("max_support", op.fields)
+            return self._opaque_of(lifted, is01=self._is01(op))
+        if prim in ("reduce_or", "reduce_and"):
+            op = lifted[0]
+            if isinstance(op, BConst):
+                return op
+            return self._opaque_of(lifted, is01=True)
+        if prim in ("argmax", "argmin"):
+            return self._opaque_of(lifted)
+        if prim == "dot_general":
+            a, b = lifted
+            if self._is01(a) and self._is01(b) and (
+                    T_MASK in self._taint(a) | self._taint(b)):
+                taint = self._taint(a) | self._taint(b)
+                fields = self._fields(a) | self._fields(b)
+                return CountVec(taint, fields, is01=False)
+            return self._opaque_of(lifted)
+        if prim in ("gather", "dynamic_slice"):
+            return self._point_lookup(lifted)
+        if prim in ("pjit", "jit", "closed_call", "custom_jvp_call",
+                    "custom_vjp_call", "remat", "checkpoint"):
+            inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+            if inner is None:
+                return [self._opaque_of(lifted)] * len(eqn.outvars)
+            sub = inner.jaxpr if hasattr(inner, "jaxpr") else inner
+            consts = getattr(inner, "consts", [])
+            try:
+                return self.run(sub, consts, lifted)
+            except Exception:  # noqa: BLE001 — totality over exactness
+                return [self._opaque_of(lifted)] * len(eqn.outvars)
+        # anything else (scan/while/sort/scatter/random bits/...):
+        # taint-union the inputs; random generators taint rng
+        if "random" in prim or prim.startswith("threefry"):
+            return [Opaque(frozenset([T_RNG]))] * len(eqn.outvars)
+        return [self._opaque_of(lifted)] * len(eqn.outvars)
+
+    def _const_fold(self, prim, lifted):
+        a = lifted[0].const
+        if prim == "neg":
+            return Lin(const=-a)
+        if prim in ("sign",):
+            return Lin(const=int(np.sign(a)))
+        if prim in ("abs",):
+            return Lin(const=abs(a))
+        if len(lifted) < 2:
+            return Opaque()
+        b = lifted[1].const
+        try:
+            if prim == "div":
+                return Lin(const=int(a / b)) if a % b == 0 else Opaque()
+            if prim == "rem":
+                return Lin(const=int(np.fmod(a, b)))
+            if prim == "max":
+                return Lin(const=max(a, b))
+            if prim == "min":
+                return Lin(const=min(a, b))
+            if prim == "pow":
+                return Lin(const=int(a ** b))
+        except Exception:  # noqa: BLE001
+            return Opaque()
+        return Opaque()
+
+    def _reduce_sum(self, eqn, op):
+        axes = eqn.params.get("axes", ())
+        if isinstance(op, Lin):
+            # summing a constant/linear over an axis multiplies by its
+            # length — length is a concrete int here, fine for consts
+            shape = tuple(getattr(eqn.invars[0].aval, "shape", ()))
+            k = 1
+            for ax in axes:
+                if ax < len(shape):
+                    k *= shape[ax]
+            return op.scale(k)
+        if isinstance(op, CountVec):
+            # summing the whole histogram = total count (size-like)
+            return self._count("size", op.fields)
+        taint = self._taint(op)
+        if self._is01(op) and T_MASK in taint:
+            kind = "size" if not (self._fields(op)
+                                  or T_PAYLOAD in taint) else "support"
+            return self._count(kind, self._fields(op))
+        if isinstance(op, BExpr):
+            return self._opaque_of([op])
+        return self._opaque_of([op])
+
+    def _point_lookup(self, lifted):
+        """v[idx]: a mask point-lookup is a RECEIVE guard (heard a specific
+        sender); anything else keeps taint."""
+        op = lifted[0]
+        taint = self._taint(op)
+        idx_taints = frozenset().union(
+            *[self._taint(v) for v in lifted[1:]]) if len(lifted) > 1 \
+            else frozenset()
+        if T_MASK in taint and not self._fields(op) and self._is01(op):
+            who = "coord(r)" if T_ROUND in idx_taints else (
+                "self" if T_ID in idx_taints and not idx_taints - {T_ID}
+                else "expr")
+            return self._guard(
+                ("receive", who), kind=G_RECEIVE,
+                detail=f"heard({who})",
+            )
+        return self._opaque_of(lifted, is01=self._is01(op))
+
+    def _compare(self, op, lifted):
+        a, b = lifted
+        # Lin vs Lin with at least one genuine count → threshold guard
+        if isinstance(a, Lin) and isinstance(b, Lin):
+            diff = a.add(b, -1)
+            if diff.is_const:
+                return BConst(self._eval_const_cmp(op, diff.const))
+            if op in ("lt", "le"):
+                # normalize to gt/ge by flipping the difference: the
+                # downstream vocabulary (render, threshold_applied) only
+                # speaks gt/ge/eq/ne, and `a < b` IS `b > a`
+                diff = diff.scale(-1)
+                op = _FLIP[op]
+            key = ("thr", op,
+                   tuple(sorted(((c.idx, k) for c, k in diff.coeffs.items()))),
+                   diff.const)
+            return self._guard(
+                key, kind=G_THRESHOLD, op=op,
+                coeffs=dict(diff.coeffs), const=diff.const,
+            )
+        ta, tb = self._taint(a), self._taint(b)
+        taint = ta | tb
+        fields = self._fields(a) | self._fields(b)
+        count_side = isinstance(a, Lin) and not a.is_const or \
+            isinstance(b, Lin) and not b.is_const
+        if count_side:
+            # a message count compared against data / rng / state — the
+            # canonical NON-extractable threshold
+            return self._guard(
+                ("data", op, tuple(sorted(taint)), tuple(sorted(fields))),
+                kind=G_DATA, op=op,
+                detail=f"count {op} non-constant "
+                       f"({', '.join(sorted(taint | fields)) or 'data'})",
+                taint=tuple(sorted(taint | fields)),
+            )
+        if T_ID in ta and (T_ROUND in tb or isinstance(b, Lin)) or \
+                T_ID in tb and (T_ROUND in ta or isinstance(a, Lin)):
+            return self._guard(
+                ("role", op, tuple(sorted(taint))), kind=G_ROLE,
+                detail="id == coord(r)" if op == "eq" else f"id {op} coord",
+            )
+        if T_ROUND in taint and not (taint - {T_ROUND}) and (
+                isinstance(a, Lin) or isinstance(b, Lin)
+                or (T_ROUND in ta and T_ROUND in tb)):
+            c = a if isinstance(a, Lin) else (b if isinstance(b, Lin) else None)
+            cval = c.const if c is not None and c.is_const else "?"
+            return self._guard(
+                ("phase", op, str(cval)), kind=G_PHASE,
+                detail=f"r {op} {cval}",
+            )
+        if isinstance(a, BExpr) or isinstance(b, BExpr):
+            # comparing booleans: eq/ne over BExprs
+            if isinstance(a, BExpr) and isinstance(b, BExpr) and op in (
+                    "eq", "ne"):
+                e = BOp("xor", a, b)
+                return BNot(e) if op == "eq" else e
+        # payload-vs-payload and friends: an indicator, not a guard
+        return self._opaque_of(lifted, is01=True)
+
+    @staticmethod
+    def _eval_const_cmp(op, diff):
+        return {"lt": diff < 0, "le": diff <= 0, "gt": diff > 0,
+                "ge": diff >= 0, "eq": diff == 0, "ne": diff != 0}[op]
+
+
+# ---------------------------------------------------------------------------
+# Per-sample round summaries + cross-sample matching
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _RoundSample:
+    """One round's interpretation at one n."""
+
+    n: int
+    counts: List[CountAtom]
+    guards: List[GuardAtom]
+    bool_outs: Dict[str, Any]       # field -> BExpr | Opaque
+
+
+def _flatten_fields(tree) -> List[Tuple[str, Any]]:
+    """(dot-path field name, leaf) pairs — '.x', '.decided' → 'x', 'decided'."""
+    leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in leaves:
+        name = jax.tree_util.keystr(path).lstrip(".")
+        out.append((name, leaf))
+    return out
+
+
+def _is_control(leaf) -> bool:
+    """Control bit = a per-lane boolean SCALAR ([n] after the lane vmap).
+    Boolean vectors (kset's bitset maps, lattice joins) are data."""
+    return jnp.result_type(leaf) == jnp.bool_ and jnp.ndim(leaf) == 1
+
+
+def _trace_round(model: str, n: int, algo, io, round_idx: int,
+                 rnd) -> _RoundSample:
+    """Trace round `round_idx` at group size n and interpret its jaxpr."""
+    tracer = _RoundTracer(model, n, algo)
+    from round_tpu.engine.executor import LocalTopology, init_lanes
+
+    topo = LocalTopology(n)
+    state_sds = jax.eval_shape(
+        lambda io_: init_lanes(algo, io_, n, topo),
+        jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)),
+            io,
+        ),
+    )
+    # roll the state shape forward through earlier rounds (shape fixed
+    # point per comm-closure, but EventRound phases may reshape between
+    # rounds of a phase in principle — mirror trace_phase)
+    for j in range(round_idx):
+        nxt = tracer.trace_round(j, algo.rounds[j], state_sds)
+        if nxt is None:
+            raise ThresholdExtractionError(
+                f"{model}: round {j} does not trace "
+                f"(roundlint findings: {[f.rule for f in tracer.findings]})"
+            )
+        state_sds = nxt
+
+    def round_fn(state, r, ho, keys):
+        state1, payload, dest = tracer._send_fn(rnd)(state, r)
+        deliver = ho & dest.T
+        new_state, _exit = tracer._update_fn(rnd)(
+            state1, payload, deliver, keys, r)
+        return new_state
+
+    closed = jax.make_jaxpr(round_fn)(
+        state_sds, tracer.r_sds, tracer.ho_sds, tracer.keys_sds
+    )
+
+    interp = _RoundInterp(round_idx, n)
+    # tag the flat inputs: state leaves by field name, then r, ho, keys
+    state_leaves = _flatten_fields(state_sds)
+    args: List[Any] = []
+    for name, leaf in state_leaves:
+        if _is_control(leaf):
+            args.append(BAtom(f"state:{name}"))
+        else:
+            args.append(Opaque(frozenset([T_PAYLOAD]),
+                               frozenset([name])))
+    args.append(Opaque(frozenset([T_ROUND])))    # r
+    args.append(Opaque(frozenset([T_MASK]), is01=True))  # ho
+    args.append(Opaque(frozenset([T_RNG])))      # keys
+    outs = interp.run(closed.jaxpr, closed.consts, args)
+
+    out_fields = _flatten_fields(state_sds)
+    bool_outs: Dict[str, Any] = {}
+    for (name, leaf), out in zip(out_fields, outs):
+        if _is_control(leaf):
+            bool_outs[name] = out
+    return _RoundSample(n=n, counts=interp.counts, guards=interp.guards,
+                        bool_outs=bool_outs)
+
+
+# ---------------------------------------------------------------------------
+# Affine fit
+# ---------------------------------------------------------------------------
+
+def fit_affine(ns: Sequence[int], ts: Sequence[int],
+               max_d: int = 4) -> Optional[Tuple[int, int, int]]:
+    """Fit t(n) = floor((a*n + b) / d) over the samples.  Returns (a, b, d)
+    with the smallest d (then |b|), or None when no small-coefficient
+    affine form fits — the non-affine refusal."""
+    best = None
+    for d in range(1, max_d + 1):
+        for a in range(-2 * d, 2 * d + 1):
+            lo, hi = -(10 ** 9), 10 ** 9
+            ok = True
+            for n, t in zip(ns, ts):
+                # d*t <= a*n + b <= d*t + d - 1
+                lo = max(lo, d * t - a * n)
+                hi = min(hi, d * t - a * n + d - 1)
+                if lo > hi:
+                    ok = False
+                    break
+            if not ok:
+                continue
+            b = min(range(lo, hi + 1), key=abs)
+            cand = (d, a, b)
+            if best is None or (cand[0], abs(cand[2]), abs(cand[1])) < (
+                    best[0], abs(best[2]), abs(best[1])):
+                best = cand
+        if best is not None and best[0] == d:
+            break  # smallest denominator wins; no need to try larger
+    if best is None:
+        return None
+    d, a, b = best
+    return a, b, d
+
+
+# ---------------------------------------------------------------------------
+# Location/rule construction
+# ---------------------------------------------------------------------------
+
+def _loc_key(valuation: Dict[str, bool]) -> Tuple[Tuple[str, bool], ...]:
+    return tuple(sorted(valuation.items()))
+
+
+def _cube_expand(cube: Dict[str, bool],
+                 atoms: List[str]) -> List[Tuple[Tuple[str, bool], ...]]:
+    """All full assignments a cube covers."""
+    free = [x for x in atoms if x not in cube]
+    out = []
+    for bits in itertools.product([False, True], repeat=len(free)):
+        full = dict(cube)
+        full.update(zip(free, bits))
+        out.append(tuple(sorted(full.items())))
+    return out
+
+
+def _cube_reduce(assigns: List[Dict[str, bool]],
+                 atoms: List[str]) -> List[Tuple[Tuple[str, bool], ...]]:
+    """Greedy don't-care elimination: merge the guard assignments that
+    produce one transition into a small set of cubes (not guaranteed
+    minimal — stability across runs is what the goldens need)."""
+    full = {tuple(sorted(a.items())) for a in assigns}
+    cubes: List[Tuple[Tuple[str, bool], ...]] = []
+    covered: set = set()
+    for a in sorted(full):
+        if a in covered:
+            continue
+        cube = dict(a)
+        for atom in atoms:
+            if atom not in cube:
+                continue
+            trial = {k: v for k, v in cube.items() if k != atom}
+            if all(p in full for p in _cube_expand(trial, atoms)):
+                cube = trial
+        covered.update(_cube_expand(cube, atoms))
+        cubes.append(tuple(sorted(cube.items())))
+    return cubes
+
+
+# ---------------------------------------------------------------------------
+# Cross-sample matching + automaton assembly
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _Problem:
+    """One reason a guard is not threshold-extractable (becomes a lint
+    finding in the rule pass, a refusal in strict extraction)."""
+
+    rule: str          # finding rule suffix
+    round: int
+    message: str
+    hint: str
+
+
+def _match_round(samples: List[_RoundSample], round_idx: int,
+                 problems: List[_Problem]) -> Tuple[
+                     Dict[str, GuardInfo], Dict[str, Any], List[str]]:
+    """Fit one round's guards across the n samples.  Returns
+    (guard_table, bool_outs of the first sample, data_guard_names)."""
+    first = samples[0]
+    table: Dict[str, GuardInfo] = {}
+    data_guards: List[str] = []
+
+    aligned = all(
+        len(s.guards) == len(first.guards)
+        and all(a.kind == b.kind and a.op == b.op
+                for a, b in zip(s.guards, first.guards))
+        for s in samples[1:]
+    )
+    if not aligned:
+        problems.append(_Problem(
+            "sample-inconsistent", round_idx,
+            f"round {round_idx}'s guard structure differs across group "
+            f"sizes ({[s.n for s in samples]}): the round's control flow "
+            "is not a fixed function of n",
+            "make guard structure independent of the concrete n (no "
+            "n-dependent Python branching in round code)",
+        ))
+        return table, first.bool_outs, data_guards
+
+    for gi, g in enumerate(first.guards):
+        name = g.name
+        if g.kind == G_THRESHOLD:
+            coeff_key = tuple(sorted(
+                (c.idx, k) for c, k in g.coeffs.items()))
+            same = all(
+                tuple(sorted((c.idx, k)
+                             for c, k in s.guards[gi].coeffs.items()))
+                == coeff_key
+                for s in samples[1:]
+            )
+            if not same:
+                problems.append(_Problem(
+                    "sample-inconsistent", round_idx,
+                    f"round {round_idx} guard #{gi}: count coefficients "
+                    "differ across group sizes",
+                    "quorum arithmetic must use the same count expression "
+                    "at every n",
+                ))
+                continue
+            # guard is  sum(coeff*count) + const(n)  op  0, i.e.
+            # sum(coeff*count)  op  t(n) := -const(n)
+            ns = [s.n for s in samples]
+            ts = [-s.guards[gi].const for s in samples]
+            fit = fit_affine(ns, ts)
+            if fit is None:
+                problems.append(_Problem(
+                    "non-affine", round_idx,
+                    f"round {round_idx} guard #{gi}: threshold constant "
+                    f"{dict(zip(ns, ts))} fits no floor((a*n+b)/d) with "
+                    "d <= 4 — not a threshold expression",
+                    "express the quorum bound as integer arithmetic affine "
+                    "in ctx.n (e.g. (2*n)//3, n//2 + 1)",
+                ))
+                continue
+            a, b, d = fit
+            counts = sorted(g.coeffs.items(), key=lambda kv: kv[0].idx)
+            table[name] = GuardInfo(
+                name=name, kind=G_THRESHOLD,
+                threshold=Threshold(
+                    op=g.op,
+                    counts=tuple(c.label for c, _k in counts),
+                    coeffs=tuple(k for _c, k in counts),
+                    a=a, b=b, d=d,
+                ),
+            )
+        elif g.kind == G_DATA:
+            data_guards.append(name)
+            table[name] = GuardInfo(name=name, kind=G_DATA, detail=g.detail)
+        else:
+            table[name] = GuardInfo(name=name, kind=g.kind, detail=g.detail)
+    return table, first.bool_outs, data_guards
+
+
+def _truth_tables_consistent(samples: List[_RoundSample]) -> bool:
+    """The per-field boolean update functions must agree across samples
+    (same atoms, same table) — the control structure is n-independent."""
+    first = samples[0]
+    for s in samples[1:]:
+        if set(s.bool_outs) != set(first.bool_outs):
+            return False
+        for field, expr in first.bool_outs.items():
+            other = s.bool_outs[field]
+            if isinstance(expr, BExpr) != isinstance(other, BExpr):
+                return False
+            if not isinstance(expr, BExpr):
+                continue
+            atoms = sorted(expr.atoms() | other.atoms())
+            if len(atoms) > 14:
+                return False
+            for bits in itertools.product([False, True], repeat=len(atoms)):
+                env = dict(zip(atoms, bits))
+                if expr.ev(env) != other.ev(env):
+                    return False
+    return True
+
+
+def _init_locations(build_at, n: int) -> List[Dict[str, bool]]:
+    """Concrete per-lane boolean valuations of the initial state."""
+    from round_tpu.engine.executor import LocalTopology, init_lanes
+
+    algo, io = build_at(n)
+    state = init_lanes(algo, io, n, LocalTopology(n))
+    vals: List[Dict[str, bool]] = []
+    bool_fields = [(name, leaf) for name, leaf in _flatten_fields(state)
+                   if _is_control(leaf)]
+    for lane in range(n):
+        v = {name: bool(np.asarray(leaf)[lane])
+             for name, leaf in bool_fields}
+        if v not in vals:
+            vals.append(v)
+    return vals
+
+
+def _build_rules(per_round: List[Tuple[Dict[str, GuardInfo], Dict[str, Any]]],
+                 init_locs: List[Dict[str, bool]],
+                 fields: List[str],
+                 problems: List[_Problem]) -> Tuple[List[Rule], List[Dict]]:
+    """Close the init locations under the per-round boolean transition
+    functions (round-robin over the phase) and emit location-changing
+    rules with cube-reduced guards."""
+    reachable: List[Dict[str, bool]] = [dict(v) for v in init_locs]
+    rules: Dict[Tuple, List[Dict[str, bool]]] = {}
+
+    def transition(round_idx, loc: Dict[str, bool]):
+        table, outs = per_round[round_idx]
+        guard_atoms = sorted(set().union(*[
+            expr.atoms() for expr in outs.values()
+            if isinstance(expr, BExpr)
+        ]) - {f"state:{f}" for f in fields}) if outs else []
+        if len(guard_atoms) > 10:
+            problems.append(_Problem(
+                "guard-explosion", round_idx,
+                f"round {round_idx} control depends on {len(guard_atoms)} "
+                "guard atoms — beyond the enumerable automaton fragment",
+                "factor the round's decision logic into fewer guards",
+            ))
+            return
+        opaque = [f for f, e in outs.items() if not isinstance(e, BExpr)]
+        if opaque:
+            problems.append(_Problem(
+                "opaque-control", round_idx,
+                f"round {round_idx}: boolean state field(s) "
+                f"{', '.join(sorted(opaque))} are not a recoverable "
+                "function of guards (sequential fold / data-dependent "
+                "control)",
+                "use vectorized masked updates (jnp.where / |) over "
+                "explicit quorum guards, or baseline with a reason",
+            ))
+            return
+        base_env = {f"state:{f}": loc.get(f, False) for f in fields}
+        for bits in itertools.product([False, True],
+                                      repeat=len(guard_atoms)):
+            env = dict(base_env)
+            env.update(zip(guard_atoms, bits))
+            new = {f: outs[f].ev(env) if f in outs else loc.get(f, False)
+                   for f in fields}
+            if new != loc:
+                key = (round_idx, _loc_key(loc), _loc_key(new),
+                       tuple(guard_atoms))
+                rules.setdefault(key, []).append(dict(zip(guard_atoms, bits)))
+            if new not in reachable:
+                reachable.append(new)
+
+    # fixpoint over the cyclic round structure
+    changed = True
+    iterations = 0
+    while changed and iterations < 32:
+        changed = False
+        snapshot = [dict(v) for v in reachable]
+        before = len(reachable)
+        for round_idx in range(len(per_round)):
+            for loc in snapshot:
+                transition(round_idx, loc)
+        if len(reachable) != before:
+            changed = True
+        iterations += 1
+    if changed:
+        # non-convergence would silently drop reachable locations/rules
+        # and let param VCs "prove" over an incomplete automaton — refuse
+        # instead (the extractor's contract)
+        problems.append(_Problem(
+            "guard-explosion", 0,
+            f"location reachability did not converge in {iterations} "
+            f"sweeps ({len(reachable)} locations and growing)",
+            "the boolean control space is beyond the enumerable "
+            "automaton fragment",
+        ))
+
+    out_rules: List[Rule] = []
+    for (round_idx, src, dst, atoms), assigns in sorted(rules.items()):
+        for cube in _cube_reduce(assigns, list(atoms)):
+            out_rules.append(Rule(round=round_idx, src=src, dst=dst,
+                                  guard=cube))
+    return out_rules, reachable
+
+
+def extract_automaton_from(
+    build_at: Callable[[int], Tuple[Any, Any]],
+    name: str,
+    samples: Sequence[int] = DEFAULT_SAMPLES,
+    strict: bool = True,
+) -> Tuple[Optional[ThresholdAutomaton], List[_Problem]]:
+    """Extract the threshold automaton for a model.  With strict=True any
+    extraction problem raises ThresholdExtractionError (the refuse-rather-
+    than-mis-extract contract); with strict=False problems are returned
+    for the lint rule to report."""
+    problems: List[_Problem] = []
+    algo0, _io0 = build_at(samples[0])
+    n_rounds = len(algo0.rounds)
+    envelope = parse_envelope(getattr(algo0, "fault_envelope", None))
+
+    # trace every round at every sample
+    per_round_samples: List[List[_RoundSample]] = []
+    for j in range(n_rounds):
+        row: List[_RoundSample] = []
+        for n in samples:
+            algo, io = build_at(n)
+            try:
+                row.append(_trace_round(name, n, algo, io, j,
+                                        algo.rounds[j]))
+            except ThresholdExtractionError:
+                raise
+            except Exception as e:  # noqa: BLE001 — refuse with context
+                problems.append(_Problem(
+                    "trace", j,
+                    f"round {j} failed to trace at n={n}: "
+                    f"{type(e).__name__}: {str(e).splitlines()[0][:200]}",
+                    "fix the roundlint comm-closure findings first",
+                ))
+                row = []
+                break
+        if not row:
+            if strict:
+                _raise_problems(name, problems)
+            return None, problems
+        per_round_samples.append(row)
+
+    guards: Dict[str, GuardInfo] = {}
+    per_round: List[Tuple[Dict[str, GuardInfo], Dict[str, Any]]] = []
+    data_guard_names: List[str] = []
+    for j, row in enumerate(per_round_samples):
+        if not _truth_tables_consistent(row):
+            problems.append(_Problem(
+                "sample-inconsistent", j,
+                f"round {j}'s boolean control function differs across "
+                f"group sizes ({[s.n for s in row]})",
+                "control flow must be a fixed function of the guards, "
+                "independent of the concrete n",
+            ))
+        table, outs, data = _match_round(row, j, problems)
+        guards.update(table)
+        data_guard_names.extend(data)
+        per_round.append((table, outs))
+
+    # data-dependent guards only matter when they steer CONTROL
+    control_atoms = set().union(*[
+        expr.atoms()
+        for _t, outs in per_round
+        for expr in outs.values() if isinstance(expr, BExpr)
+    ]) if per_round else set()
+    for gname in data_guard_names:
+        if gname in control_atoms:
+            rnd = int(gname[1:].split(".", 1)[0])
+            problems.append(_Problem(
+                "data-dependent", rnd,
+                f"round {rnd}: control is guarded by {gname} — a message "
+                f"count compared against a data-dependent bound "
+                f"({guards[gname].detail})",
+                "threshold automata need count-vs-affine(n) guards; make "
+                "the bound a function of ctx.n, or baseline with a reason",
+            ))
+
+    fields = sorted(set().union(*[set(outs) for _t, outs in per_round])
+                    ) if per_round else []
+    init_locs = _init_locations(build_at, samples[0])
+    rule_list, reachable = _build_rules(per_round, init_locs, fields,
+                                        problems)
+
+    if problems and strict:
+        _raise_problems(name, problems)
+    if problems:
+        return None, problems
+
+    # drop guard-table entries no rule references (mask-construction
+    # artifacts like the unicast dest compare)
+    used = set()
+    for r in rule_list:
+        used.update(a for a, _pol in r.guard)
+    guards = {k: v for k, v in guards.items()
+              if k in used or v.kind == G_THRESHOLD}
+
+    automaton = ThresholdAutomaton(
+        protocol=name,
+        n_samples=tuple(samples),
+        fields=tuple(fields),
+        locations=tuple(_loc_key(v) for v in reachable),
+        init_locations=tuple(_loc_key(v) for v in init_locs),
+        rules=tuple(rule_list),
+        guards=guards,
+        resilience=envelope,
+        rounds_per_phase=n_rounds,
+    )
+    return automaton, []
+
+
+def _raise_problems(name: str, problems: List[_Problem]):
+    lines = [f"{name}: threshold extraction refused "
+             f"({len(problems)} problem(s)):"]
+    lines += [f"  [{p.rule}] {p.message}" for p in problems]
+    raise ThresholdExtractionError("\n".join(lines))
+
+
+def extract_automaton(
+    model: str,
+    samples: Sequence[int] = DEFAULT_SAMPLES,
+) -> ThresholdAutomaton:
+    """Extract the threshold automaton of a REGISTERED model (the model
+    must declare build_at — see analysis/registry.py).  Memoized per
+    (model, samples): extraction is deterministic over the registry's
+    code, and callers treat the automaton as read-only (the CLI extracts
+    twice per suite — once for the VC hash, once for the run)."""
+    return _extract_cached(model, tuple(samples))
+
+
+@functools.lru_cache(maxsize=64)
+def _extract_cached(model: str, samples: Tuple[int, ...]):
+    from round_tpu.analysis.registry import get
+
+    entry = get(model)
+    if entry.build_at is None:
+        raise ThresholdExtractionError(
+            f"{model}: registry entry has no build_at constructor — the "
+            "model is outside the parameterized pass's scope"
+        )
+    automaton, _problems = extract_automaton_from(
+        entry.build_at, model, samples, strict=True)
+    assert automaton is not None
+    return automaton
+
+
+# ---------------------------------------------------------------------------
+# The `threshold-extractable` lint rule family
+# ---------------------------------------------------------------------------
+
+def threshold_rules(entry) -> List[Finding]:
+    """Lint findings for one registry entry: every reason the extractor
+    cannot recover the model's quorum guards as threshold expressions.
+    Models without build_at are out of scope (no findings)."""
+    if getattr(entry, "build_at", None) is None:
+        return []
+    algo, _io = entry.build()
+    findings: List[Finding] = []
+    try:
+        _automaton, problems = extract_automaton_from(
+            entry.build_at, entry.name, LINT_SAMPLES, strict=False)
+    except ThresholdExtractionError as e:
+        problems = [_Problem("trace", 0, str(e).splitlines()[0], "")]
+    except Exception as e:  # noqa: BLE001 — an extractor crash IS a finding
+        problems = [_Problem(
+            "trace", 0,
+            f"extractor crashed: {type(e).__name__}: "
+            f"{str(e).splitlines()[0][:200]}",
+            "report/fix analysis/threshold.py",
+        )]
+    seen = set()
+    for p in problems:
+        rnd = algo.rounds[p.round] if p.round < len(algo.rounds) else None
+        anchor = _fn_anchor(type(rnd).update) if rnd is not None \
+            else (relpath(__file__), 0)
+        key = (p.rule, anchor, p.message)
+        if key in seen:
+            continue
+        seen.add(key)
+        findings.append(Finding(
+            rule=f"threshold-extractable/{p.rule}",
+            severity="warn",
+            model=entry.name,
+            file=anchor[0],
+            line=anchor[1],
+            message=p.message,
+            hint=p.hint,
+        ))
+    return findings

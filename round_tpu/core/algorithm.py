@@ -28,10 +28,19 @@ class Algorithm:
       decided(state) / decision(state): accessors the engine and spec layer
         use to extract decision traces (reference: the decide callback).
       spec: optional Spec object (spec/dsl.py) for invariant checking.
+      fault_envelope: the protocol's declared resilience condition as a
+        string ``"n > K*f"`` (e.g. ``"n > 3f"`` for the one-third rule,
+        ``"n > 2f"`` for majority protocols), or None when the algorithm
+        makes no parameterized fault claim.  The threshold-automaton
+        extractor (analysis/threshold.py) attaches it to the extracted
+        automaton, and the parameterized verifier (verify/param.py) proves
+        the quorum lemmas UNDER this condition — so it is a spec-level
+        declaration, not documentation.
     """
 
     rounds: Tuple[Round, ...] = ()
     spec = None
+    fault_envelope: Optional[str] = None
 
     @property
     def rounds_per_phase(self) -> int:

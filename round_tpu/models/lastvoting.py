@@ -212,6 +212,11 @@ class LVSpec(Spec):
 class LastVoting(Algorithm):
     """Paxos-style consensus with rotating coordinator (4-round phases)."""
 
+    # Paxos resilience: majority quorums intersect, and a correct majority
+    # exists whenever n > 2f (LastVoting.scala's benign-crash envelope;
+    # verify/param.py proves both for all n under this condition)
+    fault_envelope = "n > 2f"
+
     def __init__(self):
         self.rounds = (LVCollect(), LVPropose(), LVAck(), LVDecide())
         self.spec = LVSpec()

@@ -160,6 +160,11 @@ class OtrSpec(Spec):
 class OTR(Algorithm):
     """One-Third-Rule consensus over int payloads."""
 
+    # the one-third rule: both quorums are > 2n/3, so any two intersect in
+    # more than n/3 > f processes under this envelope (Otr.scala's standing
+    # assumption; verify/param.py proves the intersection lemma for all n)
+    fault_envelope = "n > 3f"
+
     def __init__(self, after_decision: int = 2, n_values: int | None = None):
         self.after_decision = after_decision
         self.rounds = (OtrRound(n_values=n_values),)

@@ -45,6 +45,10 @@ def _slow_enabled(config) -> bool:
         # same discipline for the fuzzer: heavy searches are fuzz+slow,
         # and an explicit `-m fuzz` opts into them
         return True
+    if "verify" in m and "not verify" not in m:
+        # and for the parameterized-verification suite: the federated
+        # dispatch A/B is verify+slow, `-m verify` is the opt-in
+        return True
     return "slow" in m and "not slow" not in m
 
 

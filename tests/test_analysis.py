@@ -53,6 +53,15 @@ def test_every_family_fires_on_fixtures():
             assert f.file.endswith(FIXTURE_FILE), f
             assert f.line > 0, f
             found.setdefault(f.family, []).append(f)
+    # the threshold-extractable family has its own corpus
+    # (threshold_fixtures.py; goldens in tests/test_threshold.py) — its
+    # negative fixture is what fires the family
+    from round_tpu.analysis import threshold_fixtures as tfx
+
+    for f in analysis.lint_model(
+            tfx.THRESHOLD_FIXTURES_BY_NAME["tfix-data-bound"]):
+        if f.family == "threshold-extractable":
+            found.setdefault(f.family, []).append(f)
     missing = set(analysis.FAMILIES) - set(found)
     assert not missing, f"rule families with no fixture finding: {missing}"
 
@@ -169,8 +178,9 @@ def test_models_gate_zero_nonbaselined_findings():
     )
     assert not stale, f"stale baseline entries (fixed findings?): {stale}"
     for f in suppressed:
-        assert f.family == "tpu-lowerability", (
-            "only the documented TPU integer-reduction class is baselined; "
+        assert f.family in ("tpu-lowerability", "threshold-extractable"), (
+            "only the documented TPU integer-reduction and outside-the-"
+            "threshold-fragment classes are baselined; "
             f"got {f.render()}"
         )
     # acceptance: the full sweep stays comfortably inside the 60 s budget

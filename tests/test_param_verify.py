@@ -1,0 +1,182 @@
+"""Parameterized verification (verify/param.py) + federated dispatch
+(apps/verifier_cli --jobs/--json/--cache).
+
+The tier-1 arms pin the generated VC matrix's shape and discharge both
+full parameterized suites (param-otr, param-lv run in seconds — every
+verdict holds for ALL n under the declared resilience condition).  The
+end-to-end federated-dispatch subprocess A/B rides ``-m verify`` (heavy:
+three CLI sweeps), double-marked slow so tier-1 is unchanged."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from round_tpu.verify.param import (
+    PARAM_SUITES, build_param_suite, generate_param_vcs, run_param_suite,
+    threshold_applied,
+)
+
+pytestmark = pytest.mark.verify
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- VC generation shape ----------------------------------------------------
+
+def test_generated_vc_matrix_shape_otr():
+    automaton, vcs = build_param_suite("param-otr")
+    names = [vc.name for vc in vcs]
+    # two quorum guards → 2 enabledness pairs, 3 intersection pairs
+    # (each with the >f byzantine form under n > 3f), 2 no-faulty-quorum,
+    # 1 counter rule, 2 structural, 4 cross-checks
+    assert sum("correct processes fire" in n for n in names) == 2
+    assert sum("good-HO round enables" in n for n in names) == 2
+    assert sum("quorums intersect" in n for n in names) == 3
+    assert sum("exceeds the fault budget" in n for n in names) == 3
+    assert sum("no faulty-only quorum" in n for n in names) == 2
+    assert sum(n.startswith("counters:") for n in names) == 1
+    assert sum(n.startswith("structure:") for n in names) == 2
+    assert sum(n.startswith("cross-check:") for n in names) == 4
+
+
+def test_generated_vc_matrix_shape_lv():
+    automaton, vcs = build_param_suite("param-lv")
+    names = [vc.name for vc in vcs]
+    # majority envelope (n > 2f): intersection lemmas are the >= 1 form
+    # only — no byzantine >f rows
+    assert sum("quorums intersect" in n for n in names) == 3
+    assert sum("exceeds the fault budget" in n for n in names) == 0
+    assert sum(n.startswith("cross-check:") for n in names) == 3
+    # every (src, dst) location move gets one conservation VC
+    assert sum(n.startswith("counters:") for n in names) == len(
+        {(r.src, r.dst) for r in automaton.rules if r.src != r.dst})
+
+
+def test_threshold_applied_floor_elimination():
+    """count > floor((2n)/3) must export as 3*count > 2n (integrality)."""
+    from round_tpu.analysis.threshold import Threshold
+    from round_tpu.verify.printer import pretty
+
+    thr = Threshold(op="gt", counts=("size",), coeffs=(1,), a=2, b=0, d=3)
+    from round_tpu.verify.formula import Card, FSet, Variable, procType
+
+    A = Variable("A", FSet(procType))
+    s = pretty(threshold_applied(thr, [Card(A)]))
+    assert "3" in s and "2" in s and "|A|" in s
+
+
+def test_missing_envelope_is_an_error():
+    from round_tpu.analysis.threshold import extract_automaton
+    import dataclasses
+
+    automaton = extract_automaton("otr", samples=(5, 7, 9))
+    stripped = dataclasses.replace(automaton, resilience=None)
+    with pytest.raises(ValueError, match="fault envelope"):
+        generate_param_vcs(stripped)
+
+
+# -- the all-n proofs (the acceptance surface) ------------------------------
+
+def test_param_otr_all_n_proved():
+    """OTR safe/live lemmas for ALL n under n > 3f, from the extracted
+    automaton, cross-checked against protocols.otr_spec's proven
+    invariant (both entailment directions)."""
+    ok, results = run_param_suite("param-otr", quiet=True)
+    failed = [r.name for r in results if not r.ok]
+    assert ok, f"NOT PROVED: {failed}"
+    assert any("cross-check" in r.name for r in results)
+
+
+def test_param_lv_all_n_proved():
+    """LastVoting majority lemmas for ALL n under n > 2f, cross-checked
+    against the lv_spec anchor/stamp majorities the staged chains use."""
+    ok, results = run_param_suite("param-lv", quiet=True)
+    failed = [r.name for r in results if not r.ok]
+    assert ok, f"NOT PROVED: {failed}"
+
+
+def test_lv_cross_check_rejects_misfitted_threshold():
+    """The LV cross-checks anchor against the LITERAL protocols.py
+    formulas, so a mis-extracted threshold must FAIL them — the negative
+    control that keeps the cross-check from being self-referential."""
+    import dataclasses
+
+    from round_tpu.analysis.threshold import extract_automaton
+    from round_tpu.verify.param import _lv_cross_vcs, solve_param_vc
+
+    auto = extract_automaton("lastvoting")
+    bad_guards = {}
+    for name, g in auto.guards.items():
+        if g.threshold and any("ts" in c for c in g.threshold.counts):
+            g = dataclasses.replace(
+                g, threshold=dataclasses.replace(g.threshold, d=3, a=1))
+        bad_guards[name] = g
+    bad = dataclasses.replace(auto, guards=bad_guards)
+    vcs = _lv_cross_vcs(bad)
+    r = solve_param_vc(vcs[0])  # ack guard weakened to > n/3
+    assert not r.ok, "a > n/3 ack fit must not entail the stamp majority"
+
+
+# -- federated dispatch -----------------------------------------------------
+
+def test_suite_vc_hash_stable_across_builds():
+    """Rebuilding a spec creates fresh payload-fn symbols (id-derived
+    suffixes); the hash must normalize them or the cache never hits."""
+    from round_tpu.apps.verifier_cli import suite_vc_hash
+
+    assert suite_vc_hash("tpc") == suite_vc_hash("tpc")
+
+
+def test_param_suites_registered_in_cli():
+    from round_tpu.apps import verifier_cli
+
+    assert set(PARAM_SUITES) == set(verifier_cli._PARAM_SUITES)
+    for s in PARAM_SUITES:
+        assert s in verifier_cli.ALL_SUITES
+
+
+def test_cli_rejects_unknown_suites():
+    from round_tpu.apps import verifier_cli
+
+    with pytest.raises(SystemExit):
+        verifier_cli.main(["--suites", "nope"])
+    with pytest.raises(SystemExit):
+        verifier_cli.main(["--all", "tpc"])
+
+
+@pytest.mark.slow
+def test_federated_dispatch_end_to_end(tmp_path):
+    """The CLI A/B the soak rung runs continuously: jobs=1 vs jobs=2 over
+    a real suite subset, identical verdicts, JSON report shape, and a
+    100% cache hit rate on the warm rerun."""
+    cache = str(tmp_path / "cache")
+
+    def sweep(jobs, use_cache):
+        out = str(tmp_path / f"rep-{jobs}-{use_cache}.json")
+        cmd = [sys.executable, "-m", "round_tpu.apps.verifier_cli",
+               "--suites", "tpc,param-otr,param-lv", "--jobs", str(jobs),
+               "--json", out]
+        if use_cache:
+            cmd += ["--cache", cache]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=600, cwd=REPO)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        with open(out) as fh:
+            return json.load(fh)
+
+    seq = sweep(1, use_cache=False)
+    par = sweep(2, use_cache=True)    # cold cache: fills
+    warm = sweep(2, use_cache=True)   # warm: must hit
+
+    def verdicts(doc):
+        return {s["name"]: s["ok"] for s in doc["suites"]}
+
+    assert seq["all_ok"] and par["all_ok"] and warm["all_ok"]
+    assert verdicts(seq) == verdicts(par) == verdicts(warm)
+    assert warm["cache"]["hits"] == len(warm["suites"])
+    for s in seq["suites"]:
+        assert s["stages"], f"suite {s['name']} reported no stages"
+        assert all("seconds" in st for st in s["stages"])

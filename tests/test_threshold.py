@@ -1,0 +1,182 @@
+"""Threshold-automaton extraction (analysis/threshold.py): golden automata
+for the fixture corpus and the two flagship protocols, the affine fit, the
+refusal contract, and the `threshold-extractable` lint rule family."""
+
+import pytest
+
+from round_tpu.analysis.threshold import (
+    DEFAULT_SAMPLES, LINT_SAMPLES, ThresholdExtractionError,
+    extract_automaton, extract_automaton_from, fit_affine, parse_envelope,
+    threshold_rules,
+)
+from round_tpu.analysis.threshold_fixtures import THRESHOLD_FIXTURES_BY_NAME
+
+pytestmark = pytest.mark.lint
+
+
+def _fixture(name):
+    return THRESHOLD_FIXTURES_BY_NAME[name]
+
+
+def _extract_fixture(name, samples=LINT_SAMPLES):
+    a, problems = extract_automaton_from(
+        _fixture(name).build_at, name, samples, strict=True)
+    assert not problems
+    return a
+
+
+def _guard_exprs(automaton):
+    return sorted(g.render() for g in automaton.thresholds())
+
+
+# -- the affine fit ---------------------------------------------------------
+
+def test_fit_affine_recovers_floor_forms():
+    ns = list(DEFAULT_SAMPLES)
+    assert fit_affine(ns, [(2 * n) // 3 for n in ns]) == (2, 0, 3)
+    assert fit_affine(ns, [n // 2 for n in ns]) == (1, 0, 2)
+    assert fit_affine(ns, [n for n in ns]) == (1, 0, 1)
+    assert fit_affine(ns, [0 for _ in ns]) == (0, 0, 1)
+    assert fit_affine(ns, [n - 2 for n in ns]) == (1, -2, 1)
+
+
+def test_fit_affine_refuses_nonaffine():
+    ns = list(DEFAULT_SAMPLES)
+    assert fit_affine(ns, [(n * n) // 4 for n in ns]) is None
+
+
+def test_fit_affine_disambiguates_aliased_forms():
+    """floor(2n/3) and floor((3n-3)/4) agree on {5,7,9,12}; the default
+    sample set must pick the true form."""
+    assert fit_affine([5, 7, 9, 12], [(2 * n) // 3 for n in [5, 7, 9, 12]],
+                      ) in ((2, 0, 3), (3, -3, 4))  # ambiguous on 4 points
+    assert fit_affine(list(DEFAULT_SAMPLES),
+                      [(2 * n) // 3 for n in DEFAULT_SAMPLES]) == (2, 0, 3)
+
+
+def test_parse_envelope():
+    assert parse_envelope("n > 3f") == (3, "n > 3f")
+    assert parse_envelope("n > 2*f") == (2, "n > 2f")
+    assert parse_envelope(None) is None
+    with pytest.raises(ThresholdExtractionError):
+        parse_envelope("n >= 3f + 1")
+
+
+# -- fixture corpus goldens -------------------------------------------------
+
+def test_majority_fixture_golden():
+    a = _extract_fixture("tfix-majority")
+    assert _guard_exprs(a) == ["size > (1n)//2"]
+    assert a.fields == ("decided",)
+    assert [r.render(a.guards) for r in a.rules] == [
+        "r0: {} -> {decided} when size > (1n)//2"
+    ]
+    assert a.resilience == (2, "n > 2f")
+
+
+def test_two_thirds_fixture_golden():
+    a = _extract_fixture("tfix-two-thirds")
+    assert _guard_exprs(a) == ["size > (2n)//3"]
+    assert a.resilience == (3, "n > 3f")
+    assert len(a.rules) == 1
+
+
+def test_plurality_fixture_golden():
+    """Relative threshold: two counts, coefficients (2, -1), bound 0."""
+    a = _extract_fixture("tfix-plurality")
+    (thr,) = [g.threshold for g in a.thresholds()]
+    assert thr.op == "gt"
+    assert sorted(zip(thr.counts, thr.coeffs)) == [
+        ("size", -1), ("support[x]", 2)]
+    assert (thr.a, thr.b, thr.d) == (0, 0, 1)
+
+
+def test_fold_probe_fixture_golden():
+    """The FoldRound go_ahead probe extracts like a plain majority round."""
+    a = _extract_fixture("tfix-fold-probe")
+    assert _guard_exprs(a) == ["size > (1n)//2"]
+    assert [r.render(a.guards) for r in a.rules] == [
+        "r0: {} -> {decided} when size > (1n)//2"
+    ]
+
+
+def test_negative_fixture_refused_not_misextracted():
+    with pytest.raises(ThresholdExtractionError) as ei:
+        extract_automaton_from(
+            _fixture("tfix-data-bound").build_at, "tfix-data-bound",
+            LINT_SAMPLES, strict=True)
+    assert "data-dependent" in str(ei.value)
+
+
+def test_lint_rule_flags_negative_and_passes_positive():
+    assert threshold_rules(_fixture("tfix-majority")) == []
+    findings = threshold_rules(_fixture("tfix-data-bound"))
+    assert findings, "the data-dependent fixture must produce findings"
+    assert all(f.rule.startswith("threshold-extractable/")
+               for f in findings)
+    assert any("data-dependent" in f.rule for f in findings)
+    # anchored to the round's update (actionable), with a fix hint
+    assert all(f.file.endswith("threshold_fixtures.py") for f in findings)
+    assert all(f.hint for f in findings)
+
+
+# -- flagship protocol goldens (extracted from the LIVE jaxpr traces) ------
+
+def test_otr_automaton_golden():
+    a = extract_automaton("otr")
+    assert a.resilience == (3, "n > 3f")
+    assert a.fields == ("decided",)
+    # the one-third rule, recovered from the traces: both the update
+    # quorum and the decision support threshold are > 2n/3
+    assert _guard_exprs(a) == ["size > (2n)//3", "support[x] > (2n)//3"]
+    assert [r.render(a.guards) for r in a.rules] == [
+        "r0: {} -> {decided} when size > (2n)//3 & support[x] > (2n)//3"
+    ]
+
+
+def test_otr_hist_automaton_golden():
+    """The histogram fast path decides on the max of the value-support
+    histogram — same thresholds, max_support count kind."""
+    a = extract_automaton("otr-hist", samples=LINT_SAMPLES)
+    assert _guard_exprs(a) == ["max_support[x] > (2n)//3",
+                               "size > (2n)//3"]
+
+
+def test_lastvoting_automaton_golden():
+    a = extract_automaton("lastvoting")
+    assert a.resilience == (2, "n > 2f")
+    assert a.fields == ("commit", "decided", "ready")
+    exprs = _guard_exprs(a)
+    # collect majority, ack majority over phase-stamped senders, and the
+    # first-phase bootstrap
+    assert "size > (1n)//2" in exprs
+    assert "support[ts] > (1n)//2" in exprs
+    assert "size > 0" in exprs
+    rendered = [r.render(a.guards) for r in a.rules]
+    assert ("r0: {} -> {commit} when id == coord(r) & size > (1n)//2"
+            in rendered)
+    assert ("r2: {commit} -> {commit,ready} when id == coord(r) & "
+            "support[ts] > (1n)//2" in rendered)
+    assert "r3: {commit,ready} -> {decided} when heard(coord(r))" in rendered
+    # round 1 (propose/adopt) changes only data fields — no control rules
+    assert not any(r.round == 1 for r in a.rules)
+    # decided is absorbing in every rule
+    for r in a.rules:
+        if dict(r.src).get("decided"):
+            assert dict(r.dst).get("decided")
+
+
+def test_unregistered_model_is_refused():
+    with pytest.raises(ThresholdExtractionError) as ei:
+        extract_automaton("cgol")  # no build_at: out of scope
+    assert "build_at" in str(ei.value)
+
+
+def test_automaton_to_dict_roundtrips_render():
+    a = extract_automaton("otr", samples=LINT_SAMPLES)
+    d = a.to_dict()
+    assert d["protocol"] == "otr"
+    assert d["resilience"] == "n > 3f"
+    assert d["rules"][0]["src"] == {"decided": False}
+    assert d["rules"][0]["dst"] == {"decided": True}
+    assert all("//3" in g for g in d["rules"][0]["guard"])
