@@ -183,6 +183,15 @@ def build_parser():
     ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--probe-timeout", type=float, default=240.0,
                     help="seconds before the backend-init probe is killed")
+    ap.add_argument("--probe-retries", type=int, default=2,
+                    help="extra probe attempts after a hang/raise, with "
+                         "doubling backoff; the per-attempt trajectory "
+                         "(outcome + PROBE_STAGE + wall) is banked into "
+                         "the artifact so a backend-unavailable line is "
+                         "stage-attributed, not a bare verdict")
+    ap.add_argument("--probe-retry-backoff", type=float, default=10.0,
+                    help="seconds before the first probe retry "
+                         "(doubles per attempt)")
     ap.add_argument("--watchdog", type=float, default=3600.0,
                     help="seconds before the bench worker is killed (the "
                          "ladder runs after the flagship on whatever "
@@ -297,14 +306,9 @@ def _emit_error(args, error, extra):
     return 0
 
 
-def _run_probe(args):
-    """Backend-init probe in a killable subprocess.  Returns (ok, info).
-
-    A hang is DIAGNOSED, not just declared: subprocess.run kills the
-    child on timeout and hands back whatever it already wrote, so the
-    last PROBE_STAGE marker names where it wedged (r03-r05 recorded bare
-    `{'probe': 'hang'}` lines; every one of those was this path with the
-    stage discarded) and the env diagnosis rides along."""
+def _probe_once(args):
+    """One probe attempt.  Returns (ok, info) — info carries the
+    stage-attributed outcome either way."""
     src = probe_src(args.platform or "")
     try:
         cp = subprocess.run(
@@ -334,6 +338,54 @@ def _run_probe(args):
     except (ValueError, IndexError):
         return False, {"probe": "unparseable", "probe_stdout_tail": cp.stdout[-400:]}
     return True, info
+
+
+def _run_probe(args):
+    """Backend-init probe in a killable subprocess, with BOUNDED
+    retry-with-backoff around the hung stage.  Returns (ok, info).
+
+    A hang is DIAGNOSED, not just declared: subprocess.run kills the
+    child on timeout and hands back whatever it already wrote, so the
+    last PROBE_STAGE marker names where it wedged (r03-r05 recorded bare
+    `{'probe': 'hang'}` lines; every one of those was this path with the
+    stage discarded) and the env diagnosis rides along.
+
+    The retry exists because the r03+ flagship `backend-unavailable`
+    stage diagnosis points at TRANSIENT tunnel wedges (backend-init on a
+    TPU that answers the next window): one hang used to burn the whole
+    bench window.  Each failed attempt backs off (--probe-retry-backoff,
+    doubling), and the per-attempt trajectory — outcome, stage, wall —
+    is banked into the artifact either way, so a `backend-unavailable`
+    line now reads "hung at backend-init twice, raised at device-op
+    once", not a bare verdict."""
+    trajectory = []
+    backoff = max(0.0, args.probe_retry_backoff)
+    attempts = max(1, args.probe_retries + 1)
+    for attempt in range(attempts):
+        t0 = time.perf_counter()
+        ok, info = _probe_once(args)
+        trajectory.append({
+            "attempt": attempt,
+            "outcome": "ok" if ok else info.get("probe", "?"),
+            "stage": info.get("probe_stage", "device-op" if ok else "?"),
+            "wall_s": round(time.perf_counter() - t0, 1),
+        })
+        if ok:
+            if len(trajectory) > 1:
+                # a retry RESOLVED it: the artifact must say so — a
+                # flaky tunnel that heals on retry is a different
+                # diagnosis from a healthy one
+                info["probe_attempts"] = trajectory
+            return True, info
+        if attempt + 1 < attempts:
+            sys.stderr.write(
+                f"bench: probe attempt {attempt} failed "
+                f"({trajectory[-1]['outcome']} at "
+                f"{trajectory[-1]['stage']}); retrying in {backoff:.0f}s\n")
+            time.sleep(backoff)
+            backoff = backoff * 2 if backoff > 0 else 0.0
+    info["probe_attempts"] = trajectory
+    return False, info
 
 
 def _run_worker(argv, timeout):

@@ -6,6 +6,7 @@ Usage:
       [--minimize] [--out artifact.json] [--host-record] [--time-box-s 60]
   python -m round_tpu.apps.fuzz_cli replay --artifact artifact.json \\
       [--engine] [--host] [--processes]
+  python -m round_tpu.apps.fuzz_cli hostile [--frames 10000] [--seed 0]
 
 `search` evolves fault schedules against one protocol on the batched
 engine (round_tpu/fuzz, docs/FUZZING.md), optionally delta-debugs the best
@@ -15,6 +16,11 @@ With --host-record the exported artifact also banks the real-wire outcome
 
 `replay` re-runs an artifact and exits nonzero if any recorded outcome
 stops reproducing — the regression-bank check (tests/regressions/).
+
+`hostile` runs the hostile-wire fuzz gate (round_tpu/fuzz/hostile.py):
+structure-aware mutated frames against the Python codec, the FLAG_BATCH
+splitter and the C pump parser, exiting nonzero unless every frame is
+accounted (consumed or counted in wire.hostile_rejected) with no crash.
 """
 
 from __future__ import annotations
@@ -134,6 +140,14 @@ def _cmd_replay(args) -> int:
     return rc
 
 
+def _cmd_hostile(args) -> int:
+    from round_tpu.fuzz.hostile import run_gate
+
+    out = run_gate(args.frames, seed=args.seed)
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="fuzz_cli", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -166,6 +180,11 @@ def main(argv=None) -> int:
     s.add_argument("--host-timeout-ms", type=int, default=250)
     s.add_argument("--quiet", action="store_true")
     s.set_defaults(fn=_cmd_search)
+
+    h = sub.add_parser("hostile", help="hostile-wire fuzz gate")
+    h.add_argument("--frames", type=int, default=10_000)
+    h.add_argument("--seed", type=int, default=0)
+    h.set_defaults(fn=_cmd_hostile)
 
     r = sub.add_parser("replay", help="re-run an artifact, verify outcomes")
     r.add_argument("--artifact", required=True)

@@ -497,6 +497,240 @@ def measure_lanes_ab(n=4, instances=64, algo="otr", timeout_ms=300,
     }
 
 
+def _overload_cluster(n, instances, algo, timeout_ms, lanes_by_id,
+                      hardened_ids, quarantine_ids, seed,
+                      admission_bytes_per_lane, shed_deadline_ms=250,
+                      hung_ids=frozenset()):
+    """One degraded-capacity process cluster for the overload A/B:
+    per-replica lane counts, optional --admission on ``hardened_ids``
+    and --quarantine on ``quarantine_ids``, peers lingering so the
+    strapped replica catches up via decision replies.  ``hung_ids``
+    replicas model an OVERLOADED/HUNG group member: they run only the
+    first two instances, then hold their port and linger — live on the
+    wire, silent in every later round wave, so an unhardened peer burns
+    a full deadline per round waiting for them.  Returns (participant
+    summaries, wall_s, replica0_peak_rss_kb)."""
+    import subprocess
+    import threading
+
+    ports = alloc_ports(n)
+    peer_arg = ",".join(f"127.0.0.1:{p}" for p in ports)
+    env = cluster_env()
+
+    def argv_for(i):
+        hung = i in hung_ids
+        a = [sys.executable, "-m", "round_tpu.apps.host_replica",
+             "--id", str(i), "--peers", peer_arg, "--algo", algo,
+             "--instances", "2" if hung else str(instances),
+             "--timeout-ms", str(timeout_ms),
+             "--max-rounds", "32", "--value-schedule", "uniform",
+             "--seed", str(seed), "--lanes",
+             "1" if hung else str(lanes_by_id[i]),
+             # the deployed serving posture: adaptive deadlines, so a
+             # stray expiry (the strapped replica's lag) costs the EWMA
+             # estimate, not the full configured timeout — while the
+             # baseline's every-round expiry still pays the backoff
+             "--adaptive-timeout", "--timeout-cap-ms", str(timeout_ms),
+             # peers must outlive the strapped replica's deferred tail:
+             # its catch-up runs on their decision replies (serve_decisions)
+             "--linger-ms", "180000" if hung else "6000"]
+        if i in hardened_ids:
+            a += ["--admission", "--admission-bytes-per-lane",
+                  str(admission_bytes_per_lane),
+                  "--shed-deadline-ms", str(shed_deadline_ms)]
+        if i in quarantine_ids:
+            # two evidence rounds suffice against a HUNG peer (it is
+            # silent in every wave — the score only ever grows), and the
+            # probe backoff starts past the run tail so the measured
+            # ratio is the steady state, not the probe transient
+            a += ["--quarantine", "--quarantine-after", "2",
+                  "--probe-backoff-ms", "15000"]
+        return a
+
+    t0 = time.perf_counter()
+    procs = [subprocess.Popen(argv_for(i), stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True, env=env)
+             for i in range(n)]
+    # peak RSS of the STRAPPED replica: poll VmRSS and keep the max
+    # (VmHWM is absent on stripped /proc implementations — gVisor-style
+    # sandboxes — so the sampled peak is the portable form)
+    peak_kb = [0]
+    stop = threading.Event()
+
+    def poll_rss():
+        path = f"/proc/{procs[0].pid}/status"
+        while not stop.is_set():
+            try:
+                with open(path) as f:
+                    for line in f:
+                        if line.startswith(("VmHWM:", "VmRSS:")):
+                            peak_kb[0] = max(peak_kb[0],
+                                             int(line.split()[1]))
+                            break
+            except OSError:
+                return
+            stop.wait(0.05)
+
+    poller = threading.Thread(target=poll_rss, daemon=True)
+    poller.start()
+    join_timeout = max(180.0, instances * n * timeout_ms / 1000.0)
+    outs = {}
+    try:
+        # participants first: the hung replicas deliberately linger far
+        # past the run and are reaped by kill below
+        for i, p in enumerate(procs):
+            if i in hung_ids:
+                continue
+            stdout, stderr = p.communicate(timeout=join_timeout)
+            if p.returncode != 0:
+                raise RuntimeError(f"replica {i} failed: {stderr[-2000:]}")
+            outs[i] = json.loads(stdout.strip().splitlines()[-1])
+    finally:
+        stop.set()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                try:
+                    p.communicate(timeout=10)
+                except Exception:  # noqa: BLE001 - best-effort reap
+                    pass
+    wall = max((o["wall_s"] for o in outs.values() if "wall_s" in o),
+               default=time.perf_counter() - t0)
+    return outs, wall, peak_kb[0]
+
+
+def measure_overload_ab(n=4, algo="otr", timeout_ms=150, lanes_slow=2,
+                        overload=3, instances=432, seed=0,
+                        admission_bytes_per_lane=2048):
+    """The overload degradation A/B (docs/HOST_FAULT_MODEL.md).  The
+    overloaded world has two coordinated pressures, matching the module
+    story: (1) replica ``n-1`` is HUNG — live on the wire (port held,
+    lingering, answering nothing) but silent in every round wave, the
+    canonical overloaded group member; every unhardened round burns a
+    full deadline waiting for it.  (2) the surviving peers run
+    ``overload x lanes_slow`` lanes against replica 0's ``lanes_slow``
+    — collectively offering ~overload x the concurrency replica 0 can
+    hold, so its stash/pending bytes are under continuous pressure.
+    Three process clusters, same seeds and instance universe:
+
+      capacity:  every replica healthy at lanes_slow (the at-capacity
+                 run — the denominator)
+      baseline:  the hung-peer + lane flood on the PRE-hardening driver
+                 (no admission, no quarantine: degradation = wedge-style
+                 deadline burn, the ISSUE's polite-world failure mode)
+      hardened:  the same world with --quarantine on the survivors and
+                 --admission on the strapped replica 0
+      shedding:  the lane flood WITHOUT the hung peer, admission budget
+                 tightened to ``shed_bytes_per_lane`` so replica 0
+                 demonstrably SHEDS under the flood — kept separate from
+                 the hung-peer world on purpose: with one peer already
+                 hung at n=4, a shed on replica 0 drops the shed
+                 instance below the protocol quorum (3 of 4), so the
+                 composed world cannot both shed and decide — the
+                 resilience envelope is one fault wide, and the A/B
+                 respects it
+
+    Throughput = decided entries per participating replica per second
+    (total decided / slowest participant wall / participants), so the
+    hung replica's absence is not itself a throughput change.  The
+    ``host-overload`` soak rung gates hardened/capacity >= 0.9, the
+    shedding arm actually shedding with every shed NACK-accounted, peak
+    RSS bounded per arm, and the baseline still DEGRADING (< 0.7x — an
+    A/B that lost its pressure must fail, not reassure); the shedding
+    arm's own dps ratio is banked ungated (a shed-heavy run's wall is
+    dominated by how fast the flood drains, which is noisy on a shared
+    2-vCPU box), and the baseline run is banked as the degradation
+    curve's other arm."""
+    fast = max(2, overload * lanes_slow)
+    hung = frozenset({n - 1})
+    lanes_cap = {i: lanes_slow for i in range(n)}
+    lanes_over = {0: lanes_slow, **{i: fast for i in range(1, n)}}
+    shed_bytes_per_lane = 64
+
+    def dps(outs, wall):
+        decided = sum(o.get("decided_instances", 0) for o in outs.values())
+        return decided / wall / max(1, len(outs)) if wall > 0 else 0.0
+
+    runs = {}
+    for name, lanes_by_id, hardened_ids, quar_ids, hung_ids, bpl, inst in (
+            ("capacity", lanes_cap, frozenset(), frozenset(), frozenset(),
+             admission_bytes_per_lane, instances),
+            # the baseline arm burns a deadline per round: a third of the
+            # instances measures the same degraded RATE in a third of the
+            # wall (dps is a rate; instances only set the averaging span)
+            ("baseline", lanes_over, frozenset(), frozenset(), hung,
+             admission_bytes_per_lane, max(24, instances // 3)),
+            ("hardened", lanes_over, frozenset({0}),
+             frozenset(range(n)) - hung, hung, admission_bytes_per_lane,
+             instances),
+            ("shedding", lanes_over, frozenset({0}),
+             frozenset(range(n)), frozenset(), shed_bytes_per_lane,
+             instances)):
+        outs, wall, rss_kb = _overload_cluster(
+            n, inst, algo, timeout_ms, lanes_by_id, hardened_ids,
+            quar_ids, seed, bpl, hung_ids=hung_ids)
+        entry = {
+            "dps": round(dps(outs, wall), 2),
+            "wall_s": round(wall, 3),
+            "decided": {i: outs[i].get("decided_instances", 0)
+                        for i in outs},
+            "timeouts": {i: outs[i].get("timeouts", 0) for i in outs},
+            "replica0_peak_rss_kb": rss_kb,
+        }
+        if "overload" in outs.get(0, {}):
+            entry["overload"] = outs[0]["overload"]
+        if "quarantine" in outs.get(0, {}):
+            entry["quarantine_r0"] = {
+                k: outs[0]["quarantine"][k]
+                for k in ("quarantines", "probes", "rejoins")}
+        runs[name] = entry
+    # shed accounting is gated on the SHEDDING arm (the hung-peer arms
+    # shed only incidentally); the accounting invariant covers both
+    accounted = True
+    for r in runs.values():
+        ov = r.get("overload", {})
+        if ov.get("shed_frames", 0) != ov.get("nacks_sent", 0) \
+                + ov.get("nacks_suppressed", 0):
+            accounted = False
+    sheds = runs["shedding"].get("overload", {})
+    cap_dps = runs["capacity"]["dps"] or 1e-9
+    # RSS is only gateable when /proc yielded samples in EVERY arm; on a
+    # stripped-/proc sandbox the ratios become None (and the soak rung
+    # skips clause (c) with the gap RECORDED) instead of 0.0 — a vacuous
+    # "bounded" verdict with memory entirely unmeasured is worse than an
+    # honest "unavailable"
+    cap_rss = runs["capacity"]["replica0_peak_rss_kb"]
+    rss_ok = all(runs[a]["replica0_peak_rss_kb"] > 0 for a in runs)
+
+    def _rss_ratio(arm: str):
+        if not rss_ok:
+            return None
+        return round(runs[arm]["replica0_peak_rss_kb"] / cap_rss, 3)
+
+    return {
+        "metric": f"host_{algo}_n{n}_overload{overload}x_hardened_ratio",
+        "value": round(runs["hardened"]["dps"] / cap_dps, 3),
+        "unit": "x (hardened-at-overload / at-capacity decided-per-sec)",
+        "extra": {
+            "runs": runs,
+            "baseline_ratio": round(runs["baseline"]["dps"] / cap_dps, 3),
+            "shedding_ratio": round(runs["shedding"]["dps"] / cap_dps, 3),
+            "rss_ratio_hardened": _rss_ratio("hardened"),
+            "rss_ratio_baseline": _rss_ratio("baseline"),
+            "rss_ratio_shedding": _rss_ratio("shedding"),
+            "rss_unavailable": not rss_ok,
+            "shed_accounting_ok": accounted,
+            "sheds": sheds,
+            "lanes_slow": lanes_slow,
+            "overload": overload,
+            "instances": instances,
+            "n": n,
+            "timeout_ms": timeout_ms,
+            "mode": "process-per-replica hung-peer + asymmetric-lanes",
+        },
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=4)
@@ -570,11 +804,26 @@ def main(argv=None) -> int:
                          "measurement")
     ap.add_argument("--ab-pairs", type=int, default=9,
                     help="interleaved pairs for --ab-wire/--ab-lanes")
+    ap.add_argument("--ab-overload", action="store_true",
+                    help="run the overload degradation A/B (at-capacity "
+                         "vs ~3x offered load, pre- vs post-hardening — "
+                         "measure_overload_ab; process mode always)")
+    ap.add_argument("--overload", type=int, default=3, metavar="X",
+                    help="offered-load multiple for --ab-overload "
+                         "(peers run X*--lanes lanes; default 3)")
     args = ap.parse_args(argv)
     cap = args.timeout_cap_ms if args.adaptive_timeout else 0
     if args.algo in ("lvb", "lastvoting-bytes", "lastvotingbytes") \
             and args.payload_bytes <= 0:
         args.payload_bytes = 1024
+    if args.ab_overload:
+        result = measure_overload_ab(
+            n=args.n, algo=args.algo, timeout_ms=args.timeout_ms,
+            lanes_slow=args.lanes if args.lanes > 1 else 4,
+            overload=args.overload, instances=args.instances,
+        )
+        print(json.dumps(result))
+        return 0
     if args.ab_lanes:
         if args.lanes == 1:
             # lanes<=1 routes run_node to the per-instance driver, which

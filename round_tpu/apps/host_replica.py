@@ -200,6 +200,39 @@ def main(argv=None) -> int:
                          "boxes — the perf harness has set 0.5 ms since "
                          "PR 5, and this flag gives DEPLOYED replicas "
                          "the same behavior the A/Bs measure")
+    ap.add_argument("--admission", action="store_true",
+                    help="overload hardening (docs/HOST_FAULT_MODEL.md): "
+                         "admission control + load shedding on the lane "
+                         "loop — a per-driver byte budget (live lanes x "
+                         "--admission-bytes-per-lane over stash + pending "
+                         "+ native inbox backlog) defers, then sheds, new "
+                         "instances, and refuses future-instance frames "
+                         "with accounted FLAG_NACK replies instead of "
+                         "queueing unboundedly")
+    ap.add_argument("--admission-bytes-per-lane", type=int,
+                    default=256 << 10, metavar="BYTES",
+                    help="admission high watermark per live lane "
+                         "(default 256 KiB; shedding clears at half)")
+    ap.add_argument("--shed-deadline-ms", type=int, default=2000,
+                    metavar="MS",
+                    help="how long an admission may stay deferred before "
+                         "the instance is shed outright (default 2000)")
+    ap.add_argument("--quarantine", action="store_true",
+                    help="peer quarantine (runtime/health.py): score "
+                         "peers by timeout contribution / malformed-frame "
+                         "rate / reconnect churn, excuse quarantined "
+                         "peers from the round-progress threshold, and "
+                         "probe them back in with exponential backoff.  "
+                         "NOT a membership change: their frames still "
+                         "count when they arrive")
+    ap.add_argument("--quarantine-after", type=float, default=3.0,
+                    metavar="SCORE",
+                    help="health score at which a peer is quarantined "
+                         "(default 3.0 — three expired deadlines)")
+    ap.add_argument("--probe-backoff-ms", type=int, default=1000,
+                    metavar="MS",
+                    help="initial quarantine probe backoff (doubles per "
+                         "requarantine, capped at 60 s; default 1000)")
     ap.add_argument("--linger-ms", type=int, default=0, metavar="MS",
                     help="after the loop completes, keep answering peers' "
                          "traffic with decision replies until the wire is "
@@ -324,12 +357,43 @@ def main(argv=None) -> int:
                          f"but the cluster has {len(peers)} replicas — "
                          "a partial replay would silently diverge from "
                          "the engine finding")
+        admission = None
+        health = None
+        if args.admission:
+            from round_tpu.runtime.instances import AdmissionControl
+
+            admission = AdmissionControl(
+                high_bytes_per_lane=args.admission_bytes_per_lane,
+                shed_deadline_ms=args.shed_deadline_ms)
+            if args.lanes <= 1:
+                print("warning: --admission applies to the lane loop "
+                      "(--lanes L) only; the sequential loop admits one "
+                      "instance at a time and cannot overload itself",
+                      file=sys.stderr)
+        if args.quarantine:
+            if args.lanes <= 1 and args.rate > 1:
+                # the pipelined mux has no health hook yet; a silent
+                # all-zero quarantine summary would read as "ran,
+                # nothing happened" rather than "not active"
+                print("warning: --quarantine applies to the sequential "
+                      "and lane loops only (ignored with --rate > 1)",
+                      file=sys.stderr)
+            else:
+                from round_tpu.runtime.health import PeerHealth
+
+                health = PeerHealth(
+                    len(peers), args.id,
+                    quarantine_after=args.quarantine_after,
+                    probe_backoff_ms=args.probe_backoff_ms)
         if args.reconnect_ms > 0:
             # churn tolerance: dead peers are re-dialed on a period with
             # backoff (a restarted replica is re-admitted with NO manual
             # redial; the reconnect loop runs on the raw transport — chaos
             # faults are per-frame schedules and persist across reconnects)
-            raw_tr.start_reconnect(period_ms=args.reconnect_ms)
+            raw_tr.start_reconnect(
+                period_ms=args.reconnect_ms,
+                on_reconnect=(health.note_reconnect if health is not None
+                              else None))
 
         manager = None
         view_schedule = None
@@ -343,6 +407,13 @@ def main(argv=None) -> int:
             group = Group([Replica(i, h, p)
                            for i, (h, p) in sorted(peers.items())])
             manager = ViewManager(args.id, View(args.view_epoch, group), tr)
+            if health is not None:
+                # quarantine composes with membership changes: per-peer
+                # scores remap through the renames, the (n-1)//3 envelope
+                # re-derives for the new n (a view change is NOT an
+                # amnesty — runtime/health.py resize)
+                manager.on_change = health.resize_from_view
+
             view_schedule = (parse_view_schedule(args.view_change)
                              if args.view_change else {})
             if args.instances <= 1 or args.rate > 1:
@@ -392,7 +463,7 @@ def main(argv=None) -> int:
                 send_when_catching_up=args.send_when_catching_up,
                 delay_first_send_ms=args.delay_first_send_ms,
                 nbr_byzantine=args.nbr_byzantine,
-                adaptive=adaptive, wire=args.wire,
+                adaptive=adaptive, wire=args.wire, health=health,
             )
             res = runner.run(
                 instance_io(algo, args.value),
@@ -459,7 +530,7 @@ def main(argv=None) -> int:
                 value_schedule=args.value_schedule,
                 adaptive=adaptive, stats_out=stats,
                 checkpoint_dir=args.checkpoint_dir, wire=args.wire,
-                use_pump=args.pump,
+                use_pump=args.pump, admission=admission, health=health,
             )
         elif args.rate > 1:
             if (not args.send_when_catching_up
@@ -478,6 +549,7 @@ def main(argv=None) -> int:
                 nbr_byzantine=args.nbr_byzantine,
                 value_schedule=args.value_schedule,
                 adaptive=adaptive, stats_out=stats, wire=args.wire,
+                pump=args.pump,
             )
         else:
             decisions = run_instance_loop(
@@ -491,7 +563,7 @@ def main(argv=None) -> int:
                 adaptive=adaptive, stats_out=stats,
                 checkpoint_dir=args.checkpoint_dir,
                 view=manager, view_schedule=view_schedule,
-                wire=args.wire, pump=args.pump,
+                wire=args.wire, pump=args.pump, health=health,
             )
         wall = time.perf_counter() - t0
         dump_decision_log(decisions)
@@ -516,6 +588,17 @@ def main(argv=None) -> int:
         }
         if args.chaos or args.chaos_schedule:
             summary["chaos_injected"] = tr.injected
+        if admission is not None:
+            summary["overload"] = {
+                "shed_instances": stats.get("shed_instances", 0),
+                "shed_frames": stats.get("shed_frames", 0),
+                "nacks_sent": stats.get("nacks_sent", 0),
+                "nacks_suppressed": stats.get("nacks_suppressed", 0),
+                "backpressure_events": raw_tr.backpressure_events,
+            }
+        if health is not None:
+            summary["quarantine"] = stats.get(
+                "quarantine", health.summary())
         if manager is not None:
             # the view trajectory: final epoch/n/id, the applied op
             # history, and a clean `removed` marker — the harness's

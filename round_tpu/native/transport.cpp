@@ -199,6 +199,10 @@ constexpr uint8_t kReadyGrowth = 2;    // heard-set / attestation progress
 constexpr uint8_t kReadySkew = 4;      // next_round > round + 1
 constexpr uint8_t kReadyDeadline = 8;  // armed deadline expired
 constexpr uint8_t kReadyPoke = 16;     // rt_pump_poke (mux router nudge)
+constexpr uint8_t kReadyBackpr = 32;   // inbox crossed its byte high
+                                       // watermark: the waiter must drain
+                                       // (never in the auto-disarm set —
+                                       // backpressure is not a round end)
 
 // stats slots (shared u64[16] registered at enable; Python folds deltas
 // into the pump.* metrics vocabulary, docs/OBSERVABILITY.md)
@@ -271,6 +275,8 @@ struct Pump {
   unsigned long long *stats = nullptr; // [16] shared with Python
   std::atomic<bool> misc{false};       // inbox gained a frame
   std::atomic<bool> stopped{false};
+  uint64_t bp_seen = 0;                // backpressure edges already
+                                       // reported (guarded by mu)
 
   void configure(int L_, int n_, int k_, int nbz_, long long *mr,
                  long long *nr, unsigned long long *st) {
@@ -489,11 +495,40 @@ struct Node {
   std::map<int, std::pair<std::string, int>> peer_addr;
   std::map<int, sockaddr_in> peer_sa;          // UDP: resolved at add_peer
 
+  // per-peer send PAUSE (overload hardening, the native mirror of
+  // runtime/transport.py's Python-surface pause — the pump's
+  // rt_pump_flush sends land HERE, so without it a dead peer is
+  // re-dialed on every round flush): after `pause_after` consecutive
+  // send_msg failures to one peer, sends to it drop-with-count for
+  // `pause_ms` instead of dialing.  A successful dial (send path OR the
+  // reconnect loop's rt_node_connect) clears the pause.  Guarded by mu;
+  // the counters are atomics so the Python drain path can fold them
+  // into wire.peer_pauses / wire.backpressure_drops lock-free.
+  int pause_after = 16;
+  int pause_ms = 250;
+  std::map<int, int> send_fails;
+  std::map<int, std::chrono::steady_clock::time_point> send_pause;
+  std::atomic<uint64_t> send_pauses{0};
+  std::atomic<uint64_t> send_pause_drops{0};
+
   std::mutex inbox_mu;
   std::condition_variable inbox_cv;
   std::deque<Msg> inbox;
   size_t max_inbox = 1 << 16;     // drop + count when full (bufferSize
   size_t dropped = 0;             // semantics, InstanceHandler.scala:85-90)
+  // BOUNDED inbox bytes + backpressure watermarks (overload hardening,
+  // docs/HOST_FAULT_MODEL.md): the message-count cap alone let 65536
+  // near-64 MiB frames queue ~4 TiB — the byte cap makes the inbox a
+  // fixed-memory structure (drop + count beyond it, like the count cap),
+  // and the high/low watermarks raise a BACKPRESSURE signal the drivers
+  // drain on (kReadyBackpr reason bit / rt_node_backpressure) well
+  // before anything is dropped.
+  size_t inbox_bytes = 0;                       // guarded by inbox_mu
+  size_t max_inbox_bytes = 256ull << 20;        // hard drop cap
+  size_t bp_high = 32ull << 20;                 // raise backpressure
+  size_t bp_low = 8ull << 20;                   // clear backpressure
+  std::atomic<bool> backpressure{false};
+  std::atomic<uint64_t> bp_edges{0};            // rising-edge counter
   static constexpr uint32_t kMaxFrame = 64u << 20;  // sane frame-size cap:
                                   // a larger claimed len closes the
                                   // connection (protocol violation)
@@ -549,11 +584,35 @@ struct Node {
     inbox_cv.notify_all();
   }
 
+  // caller holds inbox_mu: account one popped message and clear the
+  // backpressure flag once the drain reaches the low watermark
+  void note_popped_locked(size_t nbytes) {
+    inbox_bytes -= nbytes;
+    if (backpressure.load(std::memory_order_relaxed) &&
+        inbox_bytes <= bp_low)
+      backpressure.store(false, std::memory_order_release);
+  }
+
   void enqueue(Msg &&m) {
     {
       std::lock_guard<std::mutex> l(inbox_mu);
-      if (inbox.size() >= max_inbox) { ++dropped; return; }
+      if (inbox.size() >= max_inbox ||
+          inbox_bytes + m.payload.size() > max_inbox_bytes) {
+        ++dropped;
+        return;
+      }
+      inbox_bytes += m.payload.size();
       inbox.push_back(std::move(m));
+      if (!backpressure.load(std::memory_order_relaxed) &&
+          inbox_bytes >= bp_high) {
+        // rising edge: flag it (rt_node_backpressure level) and count it
+        // (bp_edges — rt_pump_wait translates unseen edges into the
+        // kReadyBackpr reason bit on armed lanes).  The pump mutex is
+        // NOT taken here: deliver() already holds it when it calls
+        // enqueue, and the misc notify below wakes any waiter anyway.
+        backpressure.store(true, std::memory_order_release);
+        bp_edges.fetch_add(1, std::memory_order_release);
+      }
     }
     inbox_cv.notify_one();
     if (pump_on.load(std::memory_order_acquire)) {
@@ -930,6 +989,10 @@ struct Node {
       std::lock_guard<std::mutex> l(mu);
       conns.push_back(c);
       by_peer[peer] = c;
+      // a successful dial proves the peer is back: clear its send pause
+      // (covers both the send path and the reconnect loop's probes)
+      send_fails.erase(peer);
+      send_pause.erase(peer);
     }
     if (wake_pipe[1] >= 0) { uint8_t b = 0; (void)!write(wake_pipe[1], &b, 1); }
     return c;
@@ -954,13 +1017,50 @@ struct Node {
     if (wake_pipe[1] >= 0) { uint8_t b = 0; (void)!write(wake_pipe[1], &b, 1); }
   }
 
+  // Consecutive-failure bookkeeping for the send pause; mu must be held.
+  void note_send_fail_locked(int peer) {
+    int f = ++send_fails[peer];
+    if (f >= pause_after && !send_pause.count(peer)) {
+      send_pause[peer] = std::chrono::steady_clock::now() +
+                         std::chrono::milliseconds(pause_ms);
+      send_pauses.fetch_add(1, std::memory_order_relaxed);
+    }
+  }
+
   bool send_msg(int peer, uint64_t tag, const uint8_t *payload, int len) {
     if (udp) return udp_send(peer, tag, payload, len);
     // mirror the receiver's frame cap: an oversized frame would report
     // send success while the peer severs the link as a protocol violation
     if (len < 0 || static_cast<uint32_t>(len) > kMaxFrame - 8) return false;
-    auto c = connect_to(peer);
-    if (!c) return false;
+    {
+      // paused peer: drop-with-count instead of dialing (bounded-memory
+      // discipline — the reconnect loop keeps probing in the background)
+      std::lock_guard<std::mutex> lp(mu);
+      auto it = send_pause.find(peer);
+      if (it != send_pause.end()) {
+        if (std::chrono::steady_clock::now() < it->second) {
+          send_pause_drops.fetch_add(1, std::memory_order_relaxed);
+          return false;
+        }
+        send_pause.erase(it);
+        // probe posture past expiry: ONE failed dial re-engages the
+        // pause (resetting to zero would put the flush back on the
+        // dial treadmill for a full pause_after streak per window); a
+        // success still clears the count entirely
+        send_fails[peer] = pause_after - 1;
+      }
+    }
+    // the send path is the round hot path (one rt_pump_flush per wave):
+    // bound the dial far below connect_to's reconnect-loop default so a
+    // black-holed peer (SYNs dropped, no RST) cannot stall a flush for
+    // seconds — the failed dial feeds the pause, so the steady-state
+    // cost of a dead peer is one bounded dial per pause window
+    auto c = connect_to(peer, /*timeout_ms=*/250);
+    if (!c) {
+      std::lock_guard<std::mutex> lp(mu);
+      note_send_fail_locked(peer);
+      return false;
+    }
     std::vector<uint8_t> frame;
     frame.reserve(12 + len);
     put_u32(frame, static_cast<uint32_t>(8 + len));
@@ -968,7 +1068,11 @@ struct Node {
     put_u32(frame, static_cast<uint32_t>(tag & 0xFFFFFFFFu));
     frame.insert(frame.end(), payload, payload + len);
     std::lock_guard<std::mutex> l(c->wmu);
-    if (c->fd < 0) return false;
+    if (c->fd < 0) {
+      std::lock_guard<std::mutex> l2(mu);
+      note_send_fail_locked(peer);
+      return false;
+    }
     bool wrote;
     if (tls) {
       std::lock_guard<std::mutex> ls(c->smu);
@@ -991,7 +1095,12 @@ struct Node {
       std::lock_guard<std::mutex> l2(mu);
       auto it = by_peer.find(peer);
       if (it != by_peer.end() && it->second == c) by_peer.erase(it);
+      note_send_fail_locked(peer);
       return false;
+    }
+    {
+      std::lock_guard<std::mutex> l2(mu);
+      send_fails.erase(peer);
     }
     return true;
   }
@@ -1177,6 +1286,7 @@ int rt_node_recv(void *node, int *from, uint64_t *tag, uint8_t *buf,
   *tag = m.tag;
   std::memcpy(buf, m.payload.data(), m.payload.size());
   int len = static_cast<int>(m.payload.size());
+  n->note_popped_locked(m.payload.size());
   n->inbox.pop_front();
   return len;
 }
@@ -1221,6 +1331,7 @@ int rt_node_recv_many(void *node, uint8_t *buf, int buflen, int timeout_ms,
     if (len) std::memcpy(buf + off + kHdr, m.payload.data(), len);
     off += need;
     ++count;
+    n->note_popped_locked(m.payload.size());
     n->inbox.pop_front();
   }
   *nbytes = static_cast<int>(off);
@@ -1238,6 +1349,68 @@ uint64_t rt_node_dropped(void *node) {
   auto *n = static_cast<Node *>(node);
   std::lock_guard<std::mutex> l(n->inbox_mu);
   return n->dropped;
+}
+
+// 1 while the inbox sits above its byte high watermark (cleared once a
+// drain reaches the low watermark) — the level form of the kReadyBackpr
+// reason bit, for pump-less callers and harness assertions.
+int rt_node_backpressure(void *node) {
+  return static_cast<Node *>(node)->backpressure.load() ? 1 : 0;
+}
+
+unsigned long long rt_node_inbox_bytes(void *node) {
+  auto *n = static_cast<Node *>(node);
+  std::lock_guard<std::mutex> l(n->inbox_mu);
+  return n->inbox_bytes;
+}
+
+// Configure the bounded-inbox caps and backpressure watermarks; any
+// argument <= 0 keeps the current value.  Requires lo <= hi <= max_bytes
+// (rejected with -1, the caps must stay a coherent ladder).
+int rt_node_set_inbox_limits(void *node, long long max_msgs,
+                             long long max_bytes, long long hi,
+                             long long lo) {
+  auto *n = static_cast<Node *>(node);
+  std::lock_guard<std::mutex> l(n->inbox_mu);
+  size_t mm = max_msgs > 0 ? static_cast<size_t>(max_msgs) : n->max_inbox;
+  size_t mb = max_bytes > 0 ? static_cast<size_t>(max_bytes)
+                            : n->max_inbox_bytes;
+  size_t h = hi > 0 ? static_cast<size_t>(hi) : n->bp_high;
+  size_t lw = lo > 0 ? static_cast<size_t>(lo) : n->bp_low;
+  if (lw > h || h > mb) return -1;
+  n->max_inbox = mm;
+  n->max_inbox_bytes = mb;
+  n->bp_high = h;
+  n->bp_low = lw;
+  // re-evaluate the level against the new ladder so a tightened
+  // watermark takes effect without waiting for the next enqueue
+  if (!n->backpressure.load() && n->inbox_bytes >= n->bp_high) {
+    n->backpressure.store(true);
+    n->bp_edges.fetch_add(1);
+  } else if (n->backpressure.load() && n->inbox_bytes <= n->bp_low) {
+    n->backpressure.store(false);
+  }
+  return 0;
+}
+
+// Per-peer send-pause counters: out[0] = pauses engaged, out[1] = frames
+// dropped while paused.  The Python drain path diffs these into the
+// shared wire.peer_pauses / wire.backpressure_drops counters so pump-path
+// drops are accounted in the same vocabulary as Python-surface drops.
+int rt_node_send_pause_stats(void *node, unsigned long long *out) {
+  auto *n = static_cast<Node *>(node);
+  out[0] = n->send_pauses.load(std::memory_order_relaxed);
+  out[1] = n->send_pause_drops.load(std::memory_order_relaxed);
+  return 0;
+}
+
+// Configure the native send pause (any argument <= 0 keeps the value).
+int rt_node_set_send_pause(void *node, int after, int ms) {
+  auto *n = static_cast<Node *>(node);
+  std::lock_guard<std::mutex> l(n->mu);
+  if (after > 0) n->pause_after = after;
+  if (ms > 0) n->pause_ms = ms;
+  return 0;
 }
 
 void rt_node_destroy(void *node) {
@@ -1523,6 +1696,15 @@ int rt_pump_wait(void *node, uint8_t *reasons_out, int timeout_ms,
   ++P->stats[kStWaits];
   for (;;) {
     if (P->stopped.load()) return -3;
+    // inbox backpressure edge -> kReadyBackpr on every armed lane: the
+    // waiter must drain the inbox NOW, not after a full deadline (the
+    // bit is never in auto_disarm, so the round itself keeps running)
+    uint64_t bpe = nd->bp_edges.load(std::memory_order_acquire);
+    if (bpe != P->bp_seen) {
+      P->bp_seen = bpe;
+      for (int i = 0; i < P->L; ++i)
+        if (P->lanes[i].armed) P->lanes[i].ready |= kReadyBackpr;
+    }
     auto now = std::chrono::steady_clock::now();
     bool have_dl = false;
     std::chrono::steady_clock::time_point min_dl{};
@@ -1585,6 +1767,15 @@ int rt_pump_wait_lane(void *node, int lane, int timeout_ms) {
   PumpLane &ln = P->lanes[lane];
   for (;;) {
     if (P->stopped.load()) return -3;
+    // backpressure edge: the FIRST lane waiter to observe it gets the
+    // bit (draining the shared inbox is a global act — one drainer
+    // suffices; in the mux deployment the router thread is the primary
+    // drainer and this bit is advisory)
+    uint64_t bpe = nd->bp_edges.load(std::memory_order_acquire);
+    if (bpe != P->bp_seen) {
+      P->bp_seen = bpe;
+      if (ln.armed) ln.ready |= kReadyBackpr;
+    }
     auto now = std::chrono::steady_clock::now();
     if (ln.armed && ln.has_deadline && now >= ln.deadline) {
       ln.ready |= kReadyDeadline;

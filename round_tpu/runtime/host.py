@@ -75,7 +75,7 @@ from round_tpu.ops.mailbox import Mailbox
 from round_tpu.runtime import codec
 from round_tpu.runtime.log import get_logger
 from round_tpu.runtime.oob import (
-    FLAG_DECISION, FLAG_NORMAL, FLAG_VIEW, Message, Tag,
+    FLAG_DECISION, FLAG_NACK, FLAG_NORMAL, FLAG_VIEW, Message, Tag,
 )
 from round_tpu.runtime.transport import HostTransport, RoundPump
 
@@ -93,6 +93,10 @@ _C_DECISIONS = METRICS.counter("host.decisions")
 _C_OOB = METRICS.counter("host.oob_decisions")
 _C_REPLIES = METRICS.counter("host.decision_replies")
 _C_CATCHUP = METRICS.counter("host.catch_ups")
+# overload vocabulary shared with runtime/lanes.py (same name = same
+# instrument): NACKs observed from overloaded peers — purely diagnostic,
+# the protocol's own retransmission is the retry
+_C_NACKS_SEEN = METRICS.counter("overload.nacks_seen")
 _H_ROUND_MS = METRICS.histogram("host.round_ms", MS_BUCKETS, unit="ms")
 _G_DEADLINE = METRICS.gauge("host.deadline_ms")
 _C_MUX_ROUTED = METRICS.counter("mux.routed")
@@ -388,6 +392,12 @@ class InstanceMux:
         self.transport = transport
         self._lock = threading.Lock()
         self._queues: Dict[int, Any] = {}
+        # native round pump (run_instance_loop_pipelined pump mode): the
+        # router stays the shared-inbox drainer, but a frame routed to a
+        # lane-bound instance's queue must WAKE that lane's runner out of
+        # rt_pump_wait_lane — rt_pump_poke is that nudge
+        self.pump: Optional[RoundPump] = None
+        self._lanes: Dict[int, int] = {}   # iid -> pump lane
         self._stash: Dict[int, List[Tuple[int, Tag, bytes]]] = {}
         self._stash_order: collections.deque = collections.deque()
         self._decisions: Dict[int, Optional[np.ndarray]] = {}
@@ -420,11 +430,21 @@ class InstanceMux:
                 q.put(_ROUTER_DOWN)
         return MuxEndpoint(self, iid)
 
+    def bind_lane(self, instance_id: int, lane: int) -> None:
+        """Route rt_pump_poke nudges for this instance to ``lane``."""
+        with self._lock:
+            self._lanes[instance_id & 0xFFFF] = lane
+
+    def unbind_lane(self, instance_id: int) -> None:
+        with self._lock:
+            self._lanes.pop(instance_id & 0xFFFF, None)
+
     def complete(self, instance_id: int,
                  decision: Optional[np.ndarray]) -> None:
         iid = instance_id & 0xFFFF
         with self._lock:
             self._queues.pop(iid, None)
+            self._lanes.pop(iid, None)
             self._decisions[iid] = decision
 
     def close(self) -> None:
@@ -464,6 +484,7 @@ class InstanceMux:
             if not got_list:
                 continue
             replies: List[Tuple[int, int, Any]] = []
+            pokes: set = set()
             with self._lock:
                 # routing decision and stash append under ONE acquisition:
                 # a lookup in one critical section + append in another
@@ -476,6 +497,9 @@ class InstanceMux:
                     if q is not None:
                         q.put(got)
                         _C_MUX_ROUTED.inc()
+                        lane = self._lanes.get(iid)
+                        if lane is not None:
+                            pokes.add(lane)
                     elif iid in self._decisions:
                         if tag.flag == FLAG_NORMAL:
                             replies.append(
@@ -496,6 +520,10 @@ class InstanceMux:
                         self._stash.setdefault(iid, []).append(got)
                         self._stash_order.append(iid)
                         _C_MUX_STASHED.inc()
+            pump = self.pump
+            if pump is not None:
+                for lane in pokes:
+                    pump.poke(lane)
             for sender, iid, reply_with in replies:
                 if reply_with is not None:
                     _try_send_decision(self.transport, self._replied,
@@ -519,6 +547,7 @@ def run_instance_loop_pipelined(
     value_schedule: str = "mixed",
     adaptive: Optional["AdaptiveTimeout"] = None,
     wire: str = "binary",
+    pump: bool = True,
 ) -> List[Optional[int]]:
     """The PerfTest2 loop with `rate` instances IN FLIGHT (the reference's
     `-rt` rate + InstanceDispatcher shape): a sliding window of concurrent
@@ -526,27 +555,52 @@ def run_instance_loop_pipelined(
     timeout no longer stalls the pipeline — the win is largest on lossy
     transports, where the sequential loop serializes every burned
     deadline.  Same value schedule and seeds as run_instance_loop, so the
-    two modes are cross-checkable."""
+    two modes are cross-checkable.
+
+    With ``pump`` (and a pump-capable binary-wire transport), each
+    in-flight instance occupies one NATIVE pump lane (_make_mux_pump):
+    its frames are parsed/ingested in the C event loop, its runner blocks
+    in rt_pump_wait_lane, and the router thread — still the shared-inbox
+    drainer for out-of-band traffic — nudges the lane with rt_pump_poke
+    when it routes to that instance's endpoint queue.  ``pump=False``
+    pins the Python-pump baseline (the A/B arm of tests/test_pump.py)."""
     if rate < 1:
         raise ValueError(f"rate must be >= 1, got {rate}")
+    import os as _os
+
     mux = InstanceMux(transport)
+    pump_states = None
+    if (pump and wire == "binary" and not TRACE.enabled
+            and _os.environ.get("ROUND_TPU_PUMP", "1") != "0"):
+        pump_states = _make_mux_pump(transport, algo, my_id, len(peers),
+                                     nbr_byzantine, rate)
+    if pump_states is not None:
+        mux.pump = pump_states[0].pump
     decisions: List[Optional[int]] = [None] * instances
     errors: List[Tuple[int, BaseException]] = []
     stats_lock = threading.Lock()
     sem = threading.Semaphore(rate)
+    lane_pool: collections.deque = collections.deque(range(rate))
     threads: List[threading.Thread] = []
 
-    def worker(inst: int, ep: MuxEndpoint) -> None:
+    def worker(inst: int, ep: MuxEndpoint,
+               ps: Optional[_RunnerPumpState]) -> None:
         try:
             runner = HostRunner(
                 algo, my_id, peers, ep, instance_id=inst,
                 timeout_ms=timeout_ms, seed=seed + inst,
                 nbr_byzantine=nbr_byzantine, adaptive=adaptive,
-                wire=wire,
+                wire=wire, pump_state=ps,
             )
             value = _schedule_value(value_schedule, base_value, my_id, inst)
             res = runner.run(instance_io(algo, value),
                              max_rounds=max_rounds)
+            if ps is not None:
+                # retire the lane BEFORE complete(): frames for this
+                # instance flow to the inbox again, where the router's
+                # TooLate decision-reply path answers them
+                ps.pump.close_lane(ps.lane)
+                mux.unbind_lane(inst)
             d = decision_scalar(res.decision) if res.decided else None
             decisions[inst - 1] = d
             mux.complete(
@@ -567,21 +621,36 @@ def run_instance_loop_pipelined(
                 errors.append((inst, e))
             mux.complete(inst, None)
         finally:
+            if ps is not None:
+                ps.pump.close_lane(ps.lane)   # idempotent
+                with stats_lock:
+                    lane_pool.append(ps.lane)
             sem.release()
 
     try:
         for inst in range(1, instances + 1):
             sem.acquire()
+            ps = None
+            if pump_states is not None:
+                with stats_lock:
+                    # the semaphore bounds in-flight workers by rate, and
+                    # every worker returns its lane before releasing, so
+                    # the pool is never empty here
+                    ps = pump_states[lane_pool.popleft()]
+                mux.bind_lane(inst, ps.lane)
             # register BEFORE the runner exists: a fast peer's first
             # message may arrive the instant our previous one completes
             ep = mux.register(inst)
-            t = threading.Thread(target=worker, args=(inst, ep))
+            t = threading.Thread(target=worker, args=(inst, ep, ps))
             t.start()
             threads.append(t)
         for t in threads:
             t.join()
     finally:
         mux.close()
+        if pump_states is not None:
+            mux.pump = None
+            pump_states[0].close()  # banks pump stats + detaches once
     if mux.failure is not None:
         # the router thread died: every None in `decisions` is starvation,
         # not a protocol outcome — fail the run (ADVICE.md round-5)
@@ -618,6 +687,7 @@ def run_instance_loop(
     view_schedule: Optional[Dict[int, Tuple[int, int]]] = None,
     wire: str = "binary",
     pump: bool = True,
+    health=None,
 ) -> List[Optional[int]]:
     """The PerfTest2 loop (PerfTest2.scala:19-110): `instances` consecutive
     consensus instances over one transport, with start-skew stashing —
@@ -733,7 +803,7 @@ def run_instance_loop(
             delay_first_send_ms, nbr_byzantine, value_schedule, adaptive,
             checkpoint_dir, view, view_schedule, wire, pump_state,
             decisions, raw_decisions, replied, enc_cache, stash, current,
-            foreign, start)
+            foreign, start, health)
     finally:
         if pump_state is not None:
             pump_state.close()
@@ -745,7 +815,7 @@ def _run_instance_loop_body(
     delay_first_send_ms, nbr_byzantine, value_schedule, adaptive,
     checkpoint_dir, view, view_schedule, wire, pump_state,
     decisions, raw_decisions, replied, enc_cache, stash, current,
-    foreign, start,
+    foreign, start, health=None,
 ) -> List[Optional[int]]:
     # ordered view-change schedule: entry i moves the group from epoch i
     # to i+1, so a replica only PROPOSES an entry its own epoch has not
@@ -776,6 +846,7 @@ def _run_instance_loop_body(
                 view=view,
                 wire=wire,
                 pump_state=pump_state,
+                health=health,
             )
             value = _schedule_value(value_schedule, base_value, vid, inst)
             res = runner.run(instance_io(algo, value),
@@ -812,6 +883,8 @@ def _run_instance_loop_body(
             # adaptive estimator this is the convergence trajectory
             stats_out.setdefault("timeout_trajectory", []).extend(
                 res.timeout_trajectory)
+            if health is not None:
+                stats_out["quarantine"] = health.summary()
         if view is not None and view_schedule and inst in view_schedule \
                 and view.epoch == sched_order.index(inst):
             # the scripted membership change: consensus on the op over
@@ -1071,16 +1144,26 @@ class _RunnerPumpState:
     anywhere in the chain keeps the Python pump."""
 
     __slots__ = ("pump", "send_ok", "boxes", "wave", "entries",
-                 "entry_count")
+                 "entry_count", "lane", "mux")
 
     def __init__(self, pump: RoundPump, transport,
-                 boxes: Dict[int, "_RoundMailbox"]):
+                 boxes: Dict[int, "_RoundMailbox"],
+                 lane: int = 0, mux: bool = False):
         self.pump = pump
         self.send_ok = bool(getattr(transport, "pump_send_ok", False))
         self.boxes = boxes
         self.wave = bytearray()
         self.entries = bytearray()
         self.entry_count = 0
+        # pump lane this runner occupies (the sequential loop always
+        # lane 0; the pipelined mux hands each in-flight instance its
+        # own lane) and the wait discipline that goes with it: mux=True
+        # blocks in rt_pump_wait_lane — the single-waiter rt_pump_wait
+        # consumes EVERY lane's reason bits, which is exactly wrong with
+        # concurrent runner threads — and treats R_POKE as the router's
+        # "your endpoint queue has traffic" nudge.
+        self.lane = lane
+        self.mux = mux
 
     def close(self) -> None:
         """Bank the native fast-path stats into the unified metrics
@@ -1176,6 +1259,49 @@ def _make_runner_pump(transport, algo: Algorithm, my_id: int, n: int,
     return _RunnerPumpState(pump, transport, boxes)
 
 
+def _make_mux_pump(transport, algo: Algorithm, my_id: int, n: int,
+                   nbr_byzantine: int, rate: int
+                   ) -> Optional[List[_RunnerPumpState]]:
+    """The pipelined-mux form of _make_runner_pump: ONE native pump with
+    ``rate`` lanes, each in-flight instance occupying its own lane with
+    its own per-class mailboxes (registered per (lane, class) — the
+    runner's plain ``[n, ...]`` arrays, not the LaneDriver's ``[L, n,
+    ...]`` boxes, because each mux runner still thinks per-instance).
+    Runners block in rt_pump_wait_lane; the router thread stays the
+    shared-inbox drainer and nudges a lane with rt_pump_poke when it
+    routes out-of-band traffic to that lane's endpoint queue.  Returns
+    one _RunnerPumpState per lane, or None for the Python-pump world."""
+    mk = getattr(transport, "enable_pump", None)
+    if mk is None:
+        return None
+    layouts = _payload_layouts(algo, my_id, n)
+    if layouts is None:
+        return None  # outside the fixed-layout vocabulary
+    pump = mk(rate, n, len(algo.rounds), nbr_byzantine)
+    if pump is None:
+        return None
+    import types as _types
+
+    states: List[_RunnerPumpState] = []
+    for lane in range(rate):
+        stub = _types.SimpleNamespace(n=n, id=my_id, malformed=0)
+        boxes: Dict[int, _RoundMailbox] = {}
+        for c, (exemplar, (tmpl, holes)) in enumerate(layouts):
+            box = _RoundMailbox(stub, legacy=False)
+            box.reset(exemplar)
+            for a in box.stacked:
+                a.fill(0)
+            box.count_arr[0] = 0
+            box.pinned = True
+            pump.set_class(lane, c, tmpl, holes, box.stacked,
+                           mask=box.mask, count=box.count_arr,
+                           per_lane=False)
+            boxes[c] = box
+        states.append(_RunnerPumpState(pump, transport, boxes,
+                                       lane=lane, mux=True))
+    return states
+
+
 class HostRunner:
     """Run one replica of an Algorithm instance over the host transport.
 
@@ -1205,6 +1331,7 @@ class HostRunner:
         view=None,
         wire: str = "binary",
         pump_state: Optional["_RunnerPumpState"] = None,
+        health=None,
     ):
         self.algo = algo
         self.id = my_id
@@ -1278,6 +1405,11 @@ class HostRunner:
         # every instance (the reference solves this with defaultHandler's
         # lazy join, PerfTest2.scala:72-110)
         self.foreign = foreign
+        # peer quarantine scorer (runtime/health.py PeerHealth): shared
+        # across consecutive runners like AdaptiveTimeout — a peer's
+        # health does not reset between instances.  None = the polite
+        # pre-overload world (zero behavior change).
+        self._health = health
         self.malformed = 0
         self.timeouts = 0   # rounds ended by deadline expiry (diagnostics)
         self._trajectory: List[int] = []   # per-round deadline used (ms)
@@ -1308,6 +1440,18 @@ class HostRunner:
             log.debug("node %d: dropping malformed payload (%d bytes): %s",
                       self.id, len(raw), e)
             return False, None
+
+    def _progress_goal(self, expected) -> int:
+        """The round-PROGRESS threshold: the protocol's expected message
+        count capped at n, with quarantined peers excused
+        (runtime/health.py) — they stop pacing the round wave; their
+        frames, when they DO arrive, still land in the mailbox and still
+        count toward the protocol's own quorums (which are computed
+        inside the jitted update over the full mailbox, untouched)."""
+        goal = min(self.n, int(expected))
+        if self._health is not None:
+            goal = self._health.effective_threshold(goal)
+        return goal
 
     def _ctx(self, r: int) -> RoundCtx:
         """Context for eager hooks (expected_nbr_messages).  No rng: the
@@ -1386,18 +1530,22 @@ class HostRunner:
         the _RoundMailbox.insert semantics."""
         ok, payload = self._loads(raw)
         if not ok:
+            if self._health is not None:
+                self._health.note_malformed(sender)
             return
         pump = self._ps.pump
         try:
             enc = pump_coerce_encode(
                 payload, [(s.shape[1:], s.dtype) for s in mbox.stacked],
                 mbox.treedef)
-            if pump.insert(0, sender, enc) < 0:
+            if pump.insert(self._ps.lane, sender, enc) < 0:
                 raise ValueError("canonical re-encode missed the template")
         except Exception as e:  # noqa: BLE001 — garbage must not kill us
             self.malformed += 1
             _C_MALFORMED.inc()
-            pump.mark_malformed(0, sender)
+            if self._health is not None:
+                self._health.note_malformed(sender)
+            pump.mark_malformed(self._ps.lane, sender)
             log.debug("node %d: dropping structurally-malformed payload "
                       "from %d: %s", self.id, sender, e)
         # host.recvs accounting rides the pump stats bank (rt_pump_insert
@@ -1410,10 +1558,12 @@ class HostRunner:
         applies natively-buffered pending frames for this round), ship
         the whole send fan-out in one rt_pump_flush crossing, then block
         in rt_pump_wait until goAhead / deadline / skew / misc.  Returns
-        the accumulate outcome tuple of the Python path."""
+        the accumulate outcome tuple of the Python path (plus the raw
+        expected-message count, for quarantine blame attribution)."""
         P = RoundPump
         ps = self._ps
         pump = ps.pump
+        lane = ps.lane
         rounds = self.algo.rounds
         ci = r % len(rounds)
         rnd = rounds[ci]
@@ -1439,7 +1589,7 @@ class HostRunner:
             if f_go is not None or prog.is_sync:
                 flags |= P.F_GROWTH
             else:
-                thr = min(self.n, int(expected))
+                thr = self._progress_goal(expected)
             if prog.is_strict or prog.is_sync:
                 flags |= P.F_STRICT
             if use_deadline:
@@ -1451,9 +1601,9 @@ class HostRunner:
         # quorum (expected <= 0): same instant-end semantics as GoAhead
         instant = prog.is_go_ahead or (thr <= 0 and not flags)
         if instant:
-            pump.arm(0, r, ci, 0, 0, 0, 0)  # applies pending only
+            pump.arm(lane, r, ci, 0, 0, 0, 0)  # applies pending only
         else:
-            pump.arm(0, r, ci, thr, flags, dl, ext)
+            pump.arm(lane, r, ci, thr, flags, dl, ext)
 
         # -- send (after arm: a fast peer's reply races only into the
         # native pending buffer, never into a torn mailbox) ---------------
@@ -1498,7 +1648,7 @@ class HostRunner:
                 vals, mask = mbox.values_mask()
                 return bool(np.asarray(
                     f_go(rr, sid, seed, state, vals, mask)))
-            return mbox.count >= min(self.n, int(expected))
+            return mbox.count >= self._progress_goal(expected)
 
         def drain_misc() -> None:
             nonlocal state, oob_decided
@@ -1533,6 +1683,11 @@ class HostRunner:
                                 TRACE.emit("recv_decision", node=self.id,
                                            inst=self.instance_id, round=r,
                                            src=sender)
+                    elif tg.flag == FLAG_NACK:
+                        _C_NACKS_SEEN.inc()
+                        if TRACE.enabled:
+                            TRACE.emit("nack_seen", node=self.id,
+                                       inst=tg.instance, src=sender)
                     elif tg.flag == FLAG_NORMAL and self.foreign is not None:
                         ok, p = self._loads(raw)
                         if ok:
@@ -1546,12 +1701,19 @@ class HostRunner:
         if instant:
             # queued frames were applied at arm; one misc sweep mirrors
             # the Python path's pre-update drain, then the round ends
-            _n, misc = pump.wait(0)
-            if misc:
+            if ps.mux:
+                # mux mode: the router thread owns the shared inbox; our
+                # misc traffic is whatever it routed to the endpoint
+                # queue (drain unconditionally — a nowait queue poll)
+                pump.wait_lane(lane, 0)
                 drain_misc()
-            pump.disarm(0)
+            else:
+                _n, misc = pump.wait(0)
+                if misc:
+                    drain_misc()
+            pump.disarm(lane)
             return (state, mbox, prog, use_deadline, t0, timedout,
-                    deadline_expired, oob_decided)
+                    deadline_expired, oob_decided, expected)
 
         if flags & P.F_GROWTH:
             # initial probe, mirroring the Python loop's dirty=True first
@@ -1565,20 +1727,46 @@ class HostRunner:
                     >= prog.k + self.nbr_byzantine:
                 go = True
             if go:
-                pump.disarm(0)
+                pump.disarm(lane)
                 return (state, mbox, prog, use_deadline, t0, timedout,
-                        deadline_expired, oob_decided)
+                        deadline_expired, oob_decided, expected)
+
+        if ps.mux:
+            # frames routed to the endpoint queue between our rounds
+            # (while this lane was disarmed) raised pokes we may have
+            # consumed at arm: one nowait sweep closes the race
+            drain_misc()
+            if oob_decided:
+                # same discipline as every oob exit below: stop native
+                # mailbox writes before Python touches the mailbox (the
+                # wait loop is skipped, so IT can't disarm for us)
+                pump.disarm(lane)
 
         while not oob_decided:
-            nready, misc = pump.wait(10_000)
-            if nready < 0:
-                break  # transport stopped under us; unwind like a timeout
-            if misc:
-                drain_misc()
-                if oob_decided:
-                    pump.disarm(0)
-                    break
-            rs = int(pump.reasons[0])
+            if ps.mux:
+                # per-lane wait: rt_pump_wait_lane consumes only THIS
+                # lane's reason bits (the global rt_pump_wait would
+                # steal every concurrent runner's wakes); R_POKE is the
+                # router's out-of-band nudge — our endpoint queue has
+                # traffic (FLAG_DECISION, template misses) to drain
+                rs = pump.wait_lane(lane, 10_000)
+                if rs < 0:
+                    break  # transport stopped under us
+                if rs & P.R_POKE:
+                    drain_misc()
+                    if oob_decided:
+                        pump.disarm(lane)
+                        break
+            else:
+                nready, misc = pump.wait(10_000)
+                if nready < 0:
+                    break  # transport stopped; unwind like a timeout
+                if misc:
+                    drain_misc()
+                    if oob_decided:
+                        pump.disarm(lane)
+                        break
+                rs = int(pump.reasons[lane])
             if rs & P.R_THRESH:
                 break
             if rs & P.R_DEADLINE:
@@ -1606,7 +1794,7 @@ class HostRunner:
                 if TRACE.enabled:
                     TRACE.emit("catch_up", node=self.id,
                                inst=self.instance_id, round=r,
-                               next_round=int(pump.next_round[0]))
+                               next_round=int(pump.next_round[lane]))
                 break
             if rs & P.R_GROWTH:
                 go = f_go is not None and go_ahead()
@@ -1615,10 +1803,10 @@ class HostRunner:
                         >= prog.k + self.nbr_byzantine:
                     go = True
                 if go:
-                    pump.disarm(0)
+                    pump.disarm(lane)
                     break
         return (state, mbox, prog, use_deadline, t0, timedout,
-                deadline_expired, oob_decided)
+                deadline_expired, oob_decided, expected)
 
     def _round_progress(self, rnd) -> Progress:
         """The round's declared Progress policy; a round that keeps the
@@ -1662,8 +1850,8 @@ class HostRunner:
         if self._ps is not None:
             for box in self._ps.boxes.values():
                 box.runner = self
-            self._ps.pump.open_lane(0, self.instance_id)
-            max_rnd = self._ps.pump.max_rnd[0]
+            self._ps.pump.open_lane(self._ps.lane, self.instance_id)
+            max_rnd = self._ps.pump.max_rnd[self._ps.lane]
         else:
             max_rnd = np.full(self.n, -1, dtype=np.int64)
         max_rnd[self.id] = 0
@@ -1694,7 +1882,7 @@ class HostRunner:
                 # per-message recv loop below is the Python-pump
                 # baseline arm of the A/B (apps/host_perftest --ab-pump)
                 (state, mbox, prog, use_deadline, t0, timedout,
-                 deadline_expired, oob_decided) = self._pump_round(
+                 deadline_expired, oob_decided, expected) = self._pump_round(
                     r, rr, sid, seed, state, payload_np, dest, f_go,
                     max_rnd)
             else:
@@ -1773,7 +1961,7 @@ class HostRunner:
                         return bool(np.asarray(
                             f_go(rr, sid, seed, state, vals, mask)
                         ))
-                    return mbox.count >= min(self.n, int(expected))
+                    return mbox.count >= self._progress_goal(expected)
 
                 oob_decided = False
 
@@ -1840,6 +2028,16 @@ class HostRunner:
                                     TRACE.emit("recv_decision", node=self.id,
                                                inst=self.instance_id, round=r,
                                                src=sender)
+                        elif tag.flag == FLAG_NACK:
+                            # a peer SHED our frame under admission overload
+                            # (runtime/lanes.py _shed_frame): accounted, not
+                            # actionable — the protocol's own retransmission
+                            # is the retry, the decision-reply path the
+                            # catch-up
+                            _C_NACKS_SEEN.inc()
+                            if TRACE.enabled:
+                                TRACE.emit("nack_seen", node=self.id,
+                                           inst=tag.instance, src=sender)
                         elif tag.flag == FLAG_NORMAL and self.foreign is not None:
                             ok, p = self._loads(raw)
                             if ok:
@@ -1857,6 +2055,8 @@ class HostRunner:
                         return False  # late: the round is communication-closed
                     ok, payload = self._loads(raw)
                     if not ok:
+                        if self._health is not None:
+                            self._health.note_malformed(sender)
                         if TRACE.enabled:
                             TRACE.emit("malformed", node=self.id,
                                        inst=self.instance_id, round=tag.round,
@@ -2018,6 +2218,14 @@ class HostRunner:
                     rr, sid, seed, state, vals, mask,
                 )
                 exited = bool(np.asarray(exit_flag))
+            if self._health is not None:
+                # one completed round wave of quarantine evidence: heard
+                # peers decay/rejoin, unheard peers accrue timeout score
+                # only when the deadline actually EXPIRED (a goAhead round
+                # that didn't need peer p teaches nothing about p)
+                self._health.note_round(
+                    mbox.senders(), deadline_expired,
+                    goal=min(self.n, int(expected)))
             _C_ROUNDS.inc()
             wall_ms = (_time.monotonic() - t0) * 1000.0
             _H_ROUND_MS.observe(wall_ms)

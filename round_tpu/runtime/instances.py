@@ -45,6 +45,66 @@ def lane_bucket(k: int) -> int:
     return LANE_BUCKETS[-1]
 
 
+class AdmissionControl:
+    """Per-driver admission budget + load-shedding policy (the overload
+    half of docs/HOST_FAULT_MODEL.md).
+
+    The budget is ``live_lanes × high_bytes_per_lane`` over the driver's
+    QUEUED bytes — future-instance stash, per-lane pending buffers, and
+    the native inbox backlog — with hysteresis (shedding starts at the
+    high watermark and ends at ``low_frac`` of it, so the driver does not
+    flap at the boundary).  While shedding:
+
+      * new instances are NOT admitted (``admit_ok`` is False); an
+        instance whose admission has been deferred longer than
+        ``shed_deadline_ms`` is SHED — recorded undecided + counted,
+        never silently retried forever;
+      * future-instance frames are REFUSED with a FLAG_NACK reply
+        (runtime/oob.py) instead of stashed — the sender learns its frame
+        was shed, not lost, and the driver's memory stays bounded.
+
+    Deliberately DUMB: pure watermark arithmetic, no wall-clock inside —
+    the driver feeds it observed byte counts between dispatches and reads
+    back one bit.  Disabled (None) everywhere by default; the hardened
+    serving path opts in (host_replica --admission)."""
+
+    __slots__ = ("high_bytes_per_lane", "low_frac", "shed_deadline_ms",
+                 "shedding", "shed_started", "sheds", "_high", "_low")
+
+    def __init__(self, high_bytes_per_lane: int = 256 << 10,
+                 low_frac: float = 0.5, shed_deadline_ms: int = 2000):
+        if high_bytes_per_lane <= 0:
+            raise ValueError("high_bytes_per_lane must be > 0")
+        if not 0.0 < low_frac < 1.0:
+            raise ValueError(f"low_frac must be in (0, 1), got {low_frac}")
+        self.high_bytes_per_lane = high_bytes_per_lane
+        self.low_frac = low_frac
+        self.shed_deadline_ms = shed_deadline_ms
+        self.shedding = False
+        self.shed_started: Optional[float] = None  # driver-stamped
+        self.sheds = 0
+        self._high = self._low = 0
+
+    def update(self, live_lanes: int, queued_bytes: int,
+               backpressure: bool = False) -> bool:
+        """Re-evaluate the watermark; returns the (possibly new) shedding
+        state.  ``backpressure`` (the transport's inbox watermark) forces
+        shedding regardless of the driver-visible bytes — the native
+        inbox IS queued memory the driver has not drained yet."""
+        self._high = max(1, live_lanes) * self.high_bytes_per_lane
+        self._low = int(self._high * self.low_frac)
+        if not self.shedding:
+            self.shedding = backpressure or queued_bytes >= self._high
+        else:
+            self.shedding = backpressure or queued_bytes > self._low
+        if not self.shedding:
+            self.shed_started = None
+        return self.shedding
+
+    def admit_ok(self) -> bool:
+        return not self.shedding
+
+
 class LaneTable:
     """Slot table mapping live instance ids onto lane indices — the
     dispatcher role of InstanceMux (InstanceDispatcher.scala:84-89) turned

@@ -59,9 +59,9 @@ from round_tpu.runtime.host import (
     _UNDECIDED, AdaptiveTimeout, _save_decision_checkpoint, _schedule_value,
     _try_send_decision, decision_scalar, instance_io, pump_coerce_encode,
 )
-from round_tpu.runtime.instances import LaneTable
+from round_tpu.runtime.instances import AdmissionControl, LaneTable
 from round_tpu.runtime.log import get_logger
-from round_tpu.runtime.oob import FLAG_DECISION, FLAG_NORMAL, Tag
+from round_tpu.runtime.oob import FLAG_DECISION, FLAG_NACK, FLAG_NORMAL, Tag
 from round_tpu.runtime.transport import RoundPump
 
 log = get_logger("lanes")
@@ -89,6 +89,21 @@ _C_TIMEOUTS = METRICS.counter("host.timeouts")
 _C_MALFORMED = METRICS.counter("host.malformed")
 _C_DECISIONS = METRICS.counter("host.decisions")
 _C_CATCHUP = METRICS.counter("host.catch_ups")
+# stash visibility (docs/OBSERVABILITY.md): capped eviction used to be
+# SILENT, which read as frame loss in trace_view — now every evicted
+# entry counts and the live depth is a gauge
+_C_STASH_EVICT = METRICS.counter("lanes.stash_evictions")
+_G_STASH_DEPTH = METRICS.gauge("lanes.stash_depth")
+# overload vocabulary (docs/HOST_FAULT_MODEL.md "overload, shedding and
+# quarantine"): every shed is accounted — shed_frames == nacks_sent +
+# nacks_suppressed is the invariant the host-overload soak rung gates
+_C_SHED_FRAMES = METRICS.counter("overload.shed_frames")
+_C_SHED_INSTANCES = METRICS.counter("overload.shed_instances")
+_C_NACKS_SENT = METRICS.counter("overload.nacks_sent")
+_C_NACKS_SUPP = METRICS.counter("overload.nacks_suppressed")
+_C_NACKS_SEEN = METRICS.counter("overload.nacks_seen")
+_G_QUEUED = METRICS.gauge("overload.queued_bytes")
+_G_SHEDDING = METRICS.gauge("overload.shedding")
 
 _STASH_CAP = 4096  # same eviction discipline as InstanceMux._STASH_CAP
 
@@ -166,7 +181,7 @@ class _ClassBox:
             for slot in self.vals:
                 slot[lane, sender] = 0
             if self.on_malformed is not None:
-                self.on_malformed()
+                self.on_malformed(sender)
             log.debug("lane %d: dropping structurally-malformed payload "
                       "from %d: %s", lane, sender, e)
             return False
@@ -205,6 +220,8 @@ class LaneDriver:
         wire: str = "binary",
         wait_cap_ms: int = 30_000,
         use_pump: bool = True,
+        admission: Optional[AdmissionControl] = None,
+        health=None,
     ):
         if wire not in ("binary", "pickle"):
             raise ValueError(f"wire must be 'binary' or 'pickle', "
@@ -254,6 +271,9 @@ class LaneDriver:
         self._use_deadline = np.zeros((L,), dtype=bool)
         self._delegated = np.zeros((L,), dtype=bool)
         self._expected = np.full((L,), n, dtype=np.int64)
+        # the RAW pre-quarantine threshold, kept for health blame
+        # attribution (runtime/health.py note_round goal=)
+        self._expected_raw = np.full((L,), n, dtype=np.int64)
         self._max_rnd = np.full((L, n), -1, dtype=np.int64)
         self._next_round = np.zeros((L,), dtype=np.int64)
         self._oob_done = np.zeros((L,), dtype=bool)
@@ -317,6 +337,21 @@ class LaneDriver:
         self.timeouts = 0
         self.rounds_run = 0   # cumulative across every lane and instance
         self._trajectory: List[int] = []
+        # overload hardening (docs/HOST_FAULT_MODEL.md): admission budget
+        # + load shedding (None = the polite pre-overload world, zero
+        # behavior change) and the peer-quarantine health scorer
+        # (runtime/health.py; shrinks the round-progress threshold so a
+        # quarantined peer stops pacing every round wave)
+        self._admission = admission
+        self._health = health
+        self._stash_bytes = 0
+        self._pending_bytes = 0   # live bytes across all lanes' pending
+        self._nacked: Dict[Tuple[int, int], float] = {}
+        self._pending_sizes: List[Dict[int, int]] = [{} for _ in range(L)]
+        self.shed_frames = 0
+        self.shed_instances = 0
+        self.nacks_sent = 0
+        self.nacks_suppressed = 0
 
     # -- native pump setup -------------------------------------------------
 
@@ -440,37 +475,77 @@ class LaneDriver:
         _G_OCC.set(self.table.occupancy)
         if TRACE.enabled:
             TRACE.emit("lane_admit", node=self.id, inst=iid, lane=lane)
+        self._pending_bytes -= sum(self._pending_sizes[lane].values())
+        self._pending_sizes[lane] = {}
         # replay start-skew traffic stashed before admission (the
         # defaultHandler lazy-join role) — it lands in pending[0].  The
         # order deque keeps its now-stale iid entries; eviction skips them
         replay = self._stash.pop(iid, [])
         self._stash_count -= len(replay)
+        self._stash_bytes -= sum(len(r[2]) for r in replay)
+        _G_STASH_DEPTH.set(self._stash_count)
         for got in replay:
             self._ingest(got)
 
     # -- wire in -----------------------------------------------------------
 
-    def _note_malformed(self) -> None:
+    def _note_malformed(self, sender: Optional[int] = None) -> None:
         self.malformed += 1
         _C_MALFORMED.inc()
+        if self._health is not None and sender is not None:
+            # hostile-frame rate is a quarantine signal (runtime/health.py)
+            self._health.note_malformed(sender)
 
-    def _loads(self, raw) -> Tuple[bool, Any]:
+    def _loads(self, raw, sender: Optional[int] = None) -> Tuple[bool, Any]:
         if not raw:
             return True, None
         try:
             return True, codec.loads(raw)
         except Exception as e:  # noqa: BLE001 — any garbage must survive
-            self.malformed += 1
-            _C_MALFORMED.inc()
+            self._note_malformed(sender)
             log.debug("node %d: dropping malformed payload (%d bytes): %s",
                       self.id, len(raw), e)
             return False, None
+
+    def _shed_frame(self, sender: int, iid: int) -> None:
+        """Refuse one future-instance frame under load shedding: counted,
+        and answered with a rate-limited FLAG_NACK so the sender can tell
+        a shed from wire loss.  Accounting invariant (the host-overload
+        soak rung gates it): every shed ticks exactly one of nacks_sent /
+        nacks_suppressed."""
+        self.shed_frames += 1
+        _C_SHED_FRAMES.inc()
+        now = _time.monotonic()
+        if now - self._nacked.get((sender, iid), -1.0) <= 0.25:
+            self.nacks_suppressed += 1
+            _C_NACKS_SUPP.inc()
+            return
+        if len(self._nacked) >= 8192:
+            # the rate-limit map must not become its own overload vector
+            # (cleared BEFORE the insert so the entry recorded for this
+            # NACK survives to suppress its own repeats)
+            self._nacked.clear()
+        self._nacked[(sender, iid)] = now
+        self.transport.send(sender, Tag(instance=iid, flag=FLAG_NACK))
+        self.nacks_sent += 1
+        _C_NACKS_SENT.inc()
+        if TRACE.enabled:
+            TRACE.emit("shed", node=self.id, inst=iid, src=sender)
 
     def _ingest(self, got) -> None:
         sender, tag, raw = got
         if not 0 <= sender < self.n:
             self.malformed += 1
             _C_MALFORMED.inc()
+            return
+        if tag.flag == FLAG_NACK:
+            # a peer SHED our frame (admission overload, not wire loss):
+            # purely informational — the protocol's own retransmission is
+            # the retry, and the decision-reply path is the catch-up
+            _C_NACKS_SEEN.inc()
+            if TRACE.enabled:
+                TRACE.emit("nack_seen", node=self.id, inst=tag.instance,
+                           src=sender)
             return
         iid = tag.instance
         lane = self.table.lane_of(iid)
@@ -486,6 +561,11 @@ class LaneDriver:
                                        sender, iid, d,
                                        enc_cache=self._enc_cache)
                 return
+            if self._admission is not None and self._admission.shedding:
+                # load shedding: refuse the frame with an accounted NACK
+                # instead of queueing unboundedly (module overload story)
+                self._shed_frame(sender, iid)
+                return
             # future instance: stash raw until admission (FIFO-capped —
             # garbage instance ids age out instead of pinning the stash;
             # stale order heads for admitted instances are skipped here)
@@ -493,8 +573,10 @@ class LaneDriver:
                 old = self._stash_order.popleft()
                 bucket = self._stash.get(old)
                 if bucket:
-                    bucket.pop(0)
+                    ev = bucket.pop(0)
                     self._stash_count -= 1
+                    self._stash_bytes -= len(ev[2])
+                    _C_STASH_EVICT.inc()
                     if not bucket:
                         del self._stash[old]
             if not isinstance(got[2], bytes):
@@ -502,9 +584,11 @@ class LaneDriver:
             self._stash.setdefault(iid, []).append(got)
             self._stash_order.append(iid)
             self._stash_count += 1
+            self._stash_bytes += len(got[2])
+            _G_STASH_DEPTH.set(self._stash_count)
             return
         if tag.flag == FLAG_DECISION:
-            ok, p = self._loads(raw)
+            ok, p = self._loads(raw, sender)
             adopted = (self.algo.adopt_decision(self._state_row(lane), p)
                        if ok else None)
             if adopted is not None:
@@ -536,7 +620,7 @@ class LaneDriver:
             self._max_rnd[lane, sender] = tag.round
         if tag.round < r:
             return  # late: the round is communication-closed
-        ok, payload = self._loads(raw)
+        ok, payload = self._loads(raw, sender)
         if not ok:
             return
         if self._waiting[lane] and not self._use_deadline[lane]:
@@ -548,7 +632,13 @@ class LaneDriver:
             # (the per-instance driver's transport queue plays this role:
             # frames received before the send land in the mailbox only
             # after reset): buffer, prefilled at round entry
-            self._pending[lane].setdefault(tag.round, {})[sender] = payload
+            bucket = self._pending[lane].setdefault(tag.round, {})
+            if sender not in bucket:
+                sz = len(raw) if raw else 0
+                self._pending_bytes += sz
+                self._pending_sizes[lane][tag.round] = \
+                    self._pending_sizes[lane].get(tag.round, 0) + sz
+            bucket[sender] = payload
             if tag.round > r:
                 if self.nbr_byzantine <= 0:
                     self._next_round[lane] = max(
@@ -571,7 +661,7 @@ class LaneDriver:
         mailbox's own same-kind cast rule, re-encode CANONICALLY and
         insert under the pump lock — byte-for-byte the _ClassBox.insert
         semantics, including the malformed-sender slot clear."""
-        ok, payload = self._loads(raw)
+        ok, payload = self._loads(raw, sender)
         if not ok:
             return
         box = self._boxes[int(self._rr[lane]) % self.k]
@@ -583,7 +673,7 @@ class LaneDriver:
             if rc < 0:
                 raise ValueError("canonical re-encode missed the template")
         except Exception as e:  # noqa: BLE001 — garbage must not kill us
-            self._note_malformed()
+            self._note_malformed(sender)
             self._pump.mark_malformed(lane, sender)
             log.debug("lane %d: dropping structurally-malformed payload "
                       "from %d: %s", lane, sender, e)
@@ -683,8 +773,16 @@ class LaneDriver:
             self._expected[lane] = int(np.asarray(
                 self.algo.rounds[c].expected_nbr_messages(
                     ctx, self._state_row(lane))))
+        self._expected_raw[lane] = min(self.n, int(self._expected[lane]))
+        if self._health is not None:
+            # quarantined peers are excused from the PROGRESS threshold
+            # (they stop pacing the round wave); their frames, when they
+            # arrive, still land in the mailbox and still count
+            self._expected[lane] = self._health.effective_threshold(
+                int(self._expected_raw[lane]))
         box = self._boxes[c]
         box.reset_row(lane, payload_row)
+        self._pending_bytes -= self._pending_sizes[lane].pop(r, 0)
         for sender, payload in self._pending[lane].pop(r, {}).items():
             box.insert(lane, sender, payload)
         if TRACE.enabled:
@@ -1008,6 +1106,8 @@ class LaneDriver:
         self._waiting[lane] = False
         self._need_send[lane] = False
         self._pending[lane] = {}
+        self._pending_bytes -= sum(self._pending_sizes[lane].values())
+        self._pending_sizes[lane] = {}
         self._deadline[lane] = np.inf
         _C_RETIRE.inc()
         _G_OCC.set(self.table.occupancy)
@@ -1078,9 +1178,82 @@ class LaneDriver:
                 log.info("node %d: resumed %d completed instance(s) from "
                          "%s", self.id, len(completed), checkpoint_dir)
         while len(completed) < instances:
+            if self._admission is not None:
+                # the admission budget: live lanes × watermark over every
+                # byte this driver has QUEUED but not consumed — stash,
+                # per-lane pending buffers, and the native inbox backlog
+                # (the transport's backpressure level forces shedding
+                # regardless: that backlog is ours too)
+                queued = (self._stash_bytes + self._pending_bytes
+                          + int(getattr(self.transport, "inbox_bytes", 0)))
+                shedding = self._admission.update(
+                    max(1, self.table.occupancy), queued,
+                    bool(getattr(self.transport, "backpressure", False)))
+                _G_QUEUED.set(queued)
+                _G_SHEDDING.set(1 if shedding else 0)
             while next_admit <= instances and self.table.can_admit():
                 if next_admit in completed:
                     next_admit += 1
+                    continue
+                if self._admission is not None \
+                        and not self._admission.admit_ok():
+                    now = _time.monotonic()
+                    if self._admission.shed_started is None:
+                        # defer first: overload is often a burst, and a
+                        # deferred admission costs latency, not work
+                        self._admission.shed_started = now
+                        break
+                    if (now - self._admission.shed_started) * 1000.0 \
+                            < self._admission.shed_deadline_ms:
+                        break
+                    # deadline-shed: refused outright — an explicit
+                    # undecided entry + counters, never an unbounded
+                    # queue of deferred admissions (its traffic now gets
+                    # the TooLate/NACK treatment, and peers that DID run
+                    # it serve the decision reply if we ever need it).
+                    # The expired deadline sheds the whole CURRENT
+                    # backlog, legitimately: every deferred admission
+                    # blocked at the same watermark crossing, so all of
+                    # them have aged the full window — but the purge
+                    # re-evaluation below ends the sweep the moment
+                    # memory clears, and update() resets shed_started
+                    # when the episode ends, so the NEXT burst gets a
+                    # fresh defer-first window.  Only under continuously
+                    # latched overload do later arrivals shed without
+                    # their own grace — fail-fast with a NACK is the
+                    # deliberate serving posture there, not an accident
+                    inst = next_admit
+                    completed.add(inst)
+                    self._done[inst & 0xFFFF] = None
+                    # purge the refused instance's stash NOW: its frames
+                    # will never be replayed (it has no lane to join),
+                    # and holding them would LATCH the byte budget above
+                    # the watermark — shedding one instance must free
+                    # its memory, or one burst sheds everything after it
+                    purged = self._stash.pop(inst & 0xFFFF, [])
+                    self._stash_count -= len(purged)
+                    self._stash_bytes -= sum(len(r[2]) for r in purged)
+                    _G_STASH_DEPTH.set(self._stash_count)
+                    self.shed_instances += 1
+                    self._admission.sheds += 1
+                    _C_SHED_INSTANCES.inc()
+                    if TRACE.enabled:
+                        TRACE.emit("shed_instance", node=self.id,
+                                   inst=inst)
+                    next_admit += 1
+                    # the purge may have drained the budget: re-evaluate
+                    # NOW, so one transient burst sheds only as many
+                    # instances as it takes to clear the watermark — not
+                    # every admission pending when the deadline expired
+                    queued = (self._stash_bytes + self._pending_bytes
+                              + int(getattr(self.transport,
+                                            "inbox_bytes", 0)))
+                    still = self._admission.update(
+                        max(1, self.table.occupancy), queued,
+                        bool(getattr(self.transport, "backpressure",
+                                     False)))
+                    _G_QUEUED.set(queued)
+                    _G_SHEDDING.set(1 if still else 0)
                     continue
                 self._admit(next_admit)
                 next_admit += 1
@@ -1097,14 +1270,24 @@ class LaneDriver:
                 # probe or sync barrier, and the native side raises no
                 # GROWTH wake for frames applied at arm — the probe in
                 # _ready_pump must run this tick, not after a full wait)
+                # while admission is DEFERRING pending work the wait must
+                # stay short: a 2 s block would stretch every shed
+                # deadline and admission re-check by the full wait
+                deferring = (self._admission is not None
+                             and self._admission.shedding
+                             and next_admit <= instances)
                 nready, misc = self._pump.wait(
                     0 if (self._goahead_armed
                           or bool(np.any(self._waiting & self._dirty)))
-                    else 2000)
+                    else (50 if deferring else 2000))
                 if nready < 0:
                     raise RuntimeError(
                         "transport stopped under the lane driver")
-                if misc:
+                if misc or bool(
+                        (self._pump.reasons & RoundPump.R_BACKPR).any()):
+                    # misc traffic — or the inbox crossed its byte high
+                    # watermark (R_BACKPR): drain NOW, that backlog is
+                    # what the admission budget sheds against
                     self._drain(0)
                 ready, oob = self._ready_pump()
             else:
@@ -1134,6 +1317,14 @@ class LaneDriver:
                 timedout, expired = self._lane_timedout.get(
                     lane, (False, False))
                 self._observe_adaptive(lane, expired, timedout)
+                if self._health is not None:
+                    # one completed round wave of quarantine evidence:
+                    # heard peers decay/rejoin, unheard peers only accrue
+                    # score when the deadline actually EXPIRED
+                    c0 = int(self._rr[lane]) % self.k
+                    self._health.note_round(
+                        np.nonzero(self._boxes[c0].mask[lane])[0], expired,
+                        goal=int(self._expected_raw[lane]))
                 self.rounds_run += 1
                 _C_ROUNDS.inc()
                 r = int(self._rr[lane])
@@ -1179,10 +1370,16 @@ class LaneDriver:
         if stats_out is not None:
             for key, v in (("timeouts", self.timeouts),
                            ("rounds_run", self.rounds_run),
-                           ("malformed", self.malformed)):
+                           ("malformed", self.malformed),
+                           ("shed_frames", self.shed_frames),
+                           ("shed_instances", self.shed_instances),
+                           ("nacks_sent", self.nacks_sent),
+                           ("nacks_suppressed", self.nacks_suppressed)):
                 stats_out[key] = stats_out.get(key, 0) + v
             stats_out.setdefault("timeout_trajectory", []).extend(
                 self._trajectory)
+            if self._health is not None:
+                stats_out["quarantine"] = self._health.summary()
         return results
 
 
@@ -1204,18 +1401,23 @@ def run_instance_loop_lanes(
     checkpoint_dir: Optional[str] = None,
     wire: str = "binary",
     use_pump: bool = True,
+    admission: Optional[AdmissionControl] = None,
+    health=None,
 ) -> List[Optional[int]]:
     """The lane-batched form of run_instance_loop: same schedule, same
     seeds, same decision-log shape — the work just flows through one
     vmapped mega-step per round class instead of one Python round loop per
     instance (module docstring).  Cross-checkable against the per-instance
     drivers byte-for-byte (tests/test_lanes.py).  ``use_pump=False`` pins
-    the Python pump (the native-pump A/B baseline, tests/test_pump.py)."""
+    the Python pump (the native-pump A/B baseline, tests/test_pump.py).
+    ``admission``/``health`` opt in to the overload hardening
+    (docs/HOST_FAULT_MODEL.md): load shedding + peer quarantine."""
     driver = LaneDriver(
         algo, my_id, peers, transport, lanes=lanes, timeout_ms=timeout_ms,
         seed=seed, base_value=base_value, max_rounds=max_rounds,
         nbr_byzantine=nbr_byzantine, value_schedule=value_schedule,
         adaptive=adaptive, wire=wire, use_pump=use_pump,
+        admission=admission, health=health,
     )
     return driver.run(instances, checkpoint_dir=checkpoint_dir,
                       stats_out=stats_out)

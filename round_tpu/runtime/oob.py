@@ -49,6 +49,17 @@ FLAG_BATCH = 0xB7
 # sends to traffic stamped with an OLD epoch — payload is the serialized
 # View (epoch + address list), the receiver adopts it and rewires
 FLAG_VIEW = 7
+# admission NACK (overload hardening, docs/HOST_FAULT_MODEL.md): the reply
+# an overloaded replica sends instead of stashing a future-instance frame
+# it cannot afford to hold — "your frame was SHED, not lost to the wire".
+# Empty payload; the instance id in the Tag names what was refused.  The
+# retry contract is the protocol's own retransmission: every live round
+# re-sends, and a shed replica catches up via the decision-reply path once
+# pressure clears, so a NACK never needs (or gets) an explicit client
+# retry loop — it exists so shedding is ACCOUNTED (overload.* counters,
+# trace_view classification) instead of indistinguishable from loss.
+# 10: clear of lock_manager's 8/9 and the reserved 0..2 range.
+FLAG_NACK = 10
 
 
 @dataclasses.dataclass(frozen=True)

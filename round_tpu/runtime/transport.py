@@ -41,6 +41,7 @@ import pickle
 import struct
 import subprocess
 import threading
+import time as _time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -68,6 +69,14 @@ _C_BATCH_BYTES = METRICS.counter("wire.batch_bytes")
 # rewires = peer-table swaps applied by a view change
 _C_WIRE_RECONNECT = METRICS.counter("wire.reconnects")
 _C_WIRE_REWIRE = METRICS.counter("wire.rewires")
+# overload instruments (docs/HOST_FAULT_MODEL.md "overload, shedding and
+# quarantine"): backpressure = rising edges of the bounded native inbox's
+# byte high watermark; peer_pauses = send paths paused after consecutive
+# send failures; backpressure_drops = frames dropped-with-count while a
+# peer's send path is paused (bounded memory instead of unbounded retry)
+_C_BACKPRESSURE = METRICS.counter("wire.backpressure")
+_C_PEER_PAUSES = METRICS.counter("wire.peer_pauses")
+_C_BP_DROPS = METRICS.counter("wire.backpressure_drops")
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
 _lib = None
@@ -100,8 +109,14 @@ class _WireUnpickler(pickle.Unpickler):
     })
 
     def find_class(self, module, name):
+        # bytearray is deliberately ABSENT: no legitimate wire payload
+        # pickles one (numpy array states are bytes), and a hostile
+        # pickle could otherwise build an ndarray BACKED by a bytearray
+        # inside a reference cycle — the GC then deallocates the
+        # bytearray while its buffer is still exported, an unraisable
+        # SystemError per frame (found by fuzz/hostile.py)
         if module == "builtins" and name in (
-                "complex", "bytearray", "frozenset", "set", "slice", "range"):
+                "complex", "frozenset", "set", "slice", "range"):
             return super().find_class(module, name)
         if (module, name) in self._ALLOWED:
             return super().find_class(module, name)
@@ -110,11 +125,42 @@ class _WireUnpickler(pickle.Unpickler):
         )
 
 
+# opcodes that construct buffer-backed objects WITHOUT any class lookup
+# (protocol 5): find_class can't see them, so they are refused by opcode
+# pre-scan.  A hostile BYTEARRAY8 stream can otherwise build an ndarray
+# BACKED by a bytearray inside a memo cycle — the GC then deallocates the
+# bytearray while its buffer is still exported, an unraisable SystemError
+# per frame (found by fuzz/hostile.py).
+_FORBIDDEN_PICKLE_OPS = frozenset(
+    {"BYTEARRAY8", "NEXT_BUFFER", "READONLY_BUFFER"})
+
+
 def wire_loads(raw: bytes):
     """pickle.loads restricted to the wire-payload vocabulary (see
-    _WireUnpickler); raises pickle.UnpicklingError on anything else."""
+    _WireUnpickler); raises pickle.UnpicklingError on anything else.
+    The stream is opcode-scanned (pickletools.genops — parse only, zero
+    execution) BEFORE the unpickler runs: buffer-constructing opcodes
+    bypass find_class entirely and are rejected here."""
     import io
 
+    if b"\x96" in raw or b"\x97" in raw or b"\x98" in raw:
+        # cheap prefilter: the three forbidden opcodes are these exact
+        # bytes, so a clean frame (no 0x96/0x97/0x98 anywhere, the vast
+        # majority) skips the pure-Python genops walk entirely; a hit —
+        # possibly a false positive inside string/bytes data — pays the
+        # exact opcode-level scan
+        import pickletools
+
+        try:
+            for op, _arg, _pos in pickletools.genops(raw):
+                if op.name in _FORBIDDEN_PICKLE_OPS:
+                    raise pickle.UnpicklingError(
+                        f"wire payload uses forbidden opcode {op.name}")
+        except pickle.UnpicklingError:
+            raise
+        except Exception as e:  # noqa: BLE001 — unparseable stream
+            raise pickle.UnpicklingError(
+                f"unparseable pickle stream: {e}") from e
     return _WireUnpickler(io.BytesIO(raw)).load()
 
 
@@ -181,6 +227,34 @@ def _load() -> ctypes.CDLL:
         ]
         lib.rt_node_dropped.restype = ctypes.c_uint64
         lib.rt_node_dropped.argtypes = [ctypes.c_void_p]
+        # bounded-inbox / backpressure API (overload hardening; tolerate
+        # a stale prebuilt .so — the surface then reports no backpressure
+        # and the default caps stay native-side)
+        try:
+            lib.rt_node_backpressure.restype = ctypes.c_int
+            lib.rt_node_backpressure.argtypes = [ctypes.c_void_p]
+            lib.rt_node_inbox_bytes.restype = ctypes.c_uint64
+            lib.rt_node_inbox_bytes.argtypes = [ctypes.c_void_p]
+            lib.rt_node_set_inbox_limits.restype = ctypes.c_int
+            lib.rt_node_set_inbox_limits.argtypes = [
+                ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
+                ctypes.c_longlong, ctypes.c_longlong,
+            ]
+            lib._has_bp = True
+        except AttributeError:  # pragma: no cover - stale prebuilt .so
+            lib._has_bp = False
+        # native per-peer send-pause API (the pump-flush mirror of the
+        # Python-surface pause below; same stale-.so tolerance)
+        try:
+            lib.rt_node_send_pause_stats.restype = ctypes.c_int
+            lib.rt_node_send_pause_stats.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_ulonglong)]
+            lib.rt_node_set_send_pause.restype = ctypes.c_int
+            lib.rt_node_set_send_pause.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+            lib._has_pause = True
+        except AttributeError:  # pragma: no cover - stale prebuilt .so
+            lib._has_pause = False
         lib.rt_node_stop.argtypes = [ctypes.c_void_p]
         lib.rt_node_destroy.argtypes = [ctypes.c_void_p]
         # round pump API (native round state machine; tolerate an older
@@ -334,6 +408,7 @@ class HostTransport:
         self.reconnects = 0           # channels re-established by the loop
         self._reconn_stop: Optional[threading.Event] = None
         self._reconn_thread: Optional[threading.Thread] = None
+        self._on_reconnect = None     # churn observer (start_reconnect)
         # serializes rewire() against the reconnect loop's dials: a dial
         # that READS a pid's address before rewire and INSTALLS the
         # channel after it would permanently wire that pid to the old
@@ -341,6 +416,29 @@ class HostTransport:
         # severed peers mid-rewire and resurrected the pre-change mapping)
         self._churn_lock = threading.Lock()
         self._pump: Optional["RoundPump"] = None
+        # overload hardening (docs/HOST_FAULT_MODEL.md): per-peer send
+        # PAUSE — after `pause_after` consecutive send failures to one
+        # peer, sends to it drop-with-count for `pause_ms` instead of
+        # re-dialing on every frame (the reconnect loop keeps probing in
+        # the background; a successful send or reconnect resumes
+        # immediately).  The bookkeeping is deliberately UNLOCKED:
+        # every individual dict op is GIL-atomic, and the worst a racing
+        # pair of senders can do is under-count a consecutive failure or
+        # briefly clear a just-installed pause — either delays the pause
+        # by a frame, never corrupts state.  Taking _out_lock here would
+        # put a lock acquisition on every hot-path send for a pathology
+        # that only matters while a peer is already dead.  The native
+        # pump-flush path keeps its own mirror of this pause (transport
+        # .cpp send_msg), folded into the same counters by the drain
+        # path's _poll_backpressure.
+        self.pause_after = 16
+        self.pause_ms = 250
+        self._send_fails: Dict[int, int] = {}
+        self._paused_until: Dict[int, float] = {}
+        self.backpressure_events = 0   # rising edges observed (wire.
+        self._bp_last = False          # backpressure counts the same)
+        self._np_pauses = 0            # native send-pause counters last
+        self._np_drops = 0             # folded into METRICS (drain path)
 
     # the native rt_pump_flush send path may be used on THIS transport —
     # but only while its Python send surface is the stock one: a fault
@@ -374,6 +472,101 @@ class HostTransport:
         if self._pump is not None:
             self._pump.close()
             self._pump = None
+
+    # -- overload / backpressure surface -----------------------------------
+
+    @property
+    def backpressure(self) -> bool:
+        """True while the native inbox sits above its byte high watermark
+        (the level form of the pump's kReadyBackpr reason bit)."""
+        if not self._node or not getattr(self._lib, "_has_bp", False):
+            return False
+        return bool(self._lib.rt_node_backpressure(self._node))
+
+    @property
+    def inbox_bytes(self) -> int:
+        if not self._node or not getattr(self._lib, "_has_bp", False):
+            return 0
+        return int(self._lib.rt_node_inbox_bytes(self._node))
+
+    def set_inbox_limits(self, max_msgs: int = 0, max_bytes: int = 0,
+                         high: int = 0, low: int = 0) -> bool:
+        """Configure the bounded native inbox (0 keeps a value).  The
+        ladder low <= high <= max_bytes is enforced natively."""
+        if not self._node or not getattr(self._lib, "_has_bp", False):
+            return False
+        return self._lib.rt_node_set_inbox_limits(
+            self._node, max_msgs, max_bytes, high, low) == 0
+
+    def _poll_backpressure(self) -> bool:
+        """Edge-detect the native backpressure level into the
+        ``wire.backpressure`` counter, and fold the NATIVE send-pause
+        counters (pump-flush sends to a dead peer pause inside
+        transport.cpp's send_msg) into the shared ``wire.peer_pauses`` /
+        ``wire.backpressure_drops`` vocabulary (called from the drain
+        path — the only place these can change without us noticing)."""
+        cur = self.backpressure
+        if cur and not self._bp_last:
+            self.backpressure_events += 1
+            _C_BACKPRESSURE.inc()
+            if TRACE.enabled:
+                TRACE.emit("wire_backpressure", node=self.id,
+                           inbox_bytes=self.inbox_bytes)
+        self._bp_last = cur
+        if self._node and getattr(self._lib, "_has_pause", False):
+            out = (ctypes.c_ulonglong * 2)()
+            self._lib.rt_node_send_pause_stats(self._node, out)
+            dp = int(out[0]) - self._np_pauses
+            dd = int(out[1]) - self._np_drops
+            if dp > 0:
+                _C_PEER_PAUSES.inc(dp)
+            if dd > 0:
+                _C_BP_DROPS.inc(dd)
+            self._np_pauses, self._np_drops = int(out[0]), int(out[1])
+        return cur
+
+    def _send_paused(self, dest: int) -> bool:
+        """True while dest's send path is paused (caller holds _out_lock
+        or tolerates a stale read — a stray frame either way)."""
+        until = self._paused_until.get(dest)
+        if until is None:
+            return False
+        if _time.monotonic() >= until:
+            self._paused_until.pop(dest, None)
+            # probe posture past expiry: ONE failed send re-engages the
+            # pause (a success clears the count via _note_send)
+            self._send_fails[dest] = self.pause_after - 1
+            return False
+        return True
+
+    def _note_send(self, dest: int, ok: bool) -> None:
+        if ok:
+            if self._send_fails.pop(dest, 0):
+                self._paused_until.pop(dest, None)
+            return
+        fails = self._send_fails.get(dest, 0) + 1
+        self._send_fails[dest] = fails
+        if fails >= self.pause_after and dest not in self._paused_until:
+            self._paused_until[dest] = _time.monotonic() + self.pause_ms / 1e3
+            _C_PEER_PAUSES.inc()
+            if TRACE.enabled:
+                TRACE.emit("peer_pause", node=self.id, dst=dest,
+                           fails=fails, pause_ms=self.pause_ms)
+
+    def resume_peer(self, dest: int) -> None:
+        """Clear a peer's send pause (a successful reconnect proves it is
+        back — called by the reconnect loop; the NATIVE mirror clears
+        itself on any successful dial)."""
+        self._send_fails.pop(dest, None)
+        self._paused_until.pop(dest, None)
+
+    def set_send_pause(self, after: int = 0, ms: int = 0) -> bool:
+        """Configure the NATIVE per-peer send pause (0 keeps a value);
+        the Python-surface ``pause_after``/``pause_ms`` fields above are
+        an independent mirror guarding the Python send entry points."""
+        if not self._node or not getattr(self._lib, "_has_pause", False):
+            return False
+        return self._lib.rt_node_set_send_pause(self._node, after, ms) == 0
 
     def add_peer(self, peer_id: int, host: str, port: int) -> None:
         if not self._node:
@@ -466,7 +659,8 @@ class HostTransport:
 
     def start_reconnect(self, period_ms: int = 200, backoff: float = 2.0,
                         max_backoff_ms: int = 3200,
-                        connect_timeout_ms: int = 250) -> None:
+                        connect_timeout_ms: int = 250,
+                        on_reconnect=None) -> None:
         """Start the periodic auto-reconnect loop: every ``period_ms`` each
         registered peer without a live channel is re-dialed, failures
         backing off exponentially per peer up to ``max_backoff_ms`` (the
@@ -476,6 +670,9 @@ class HostTransport:
         restart).  Idempotent; stop()/close() ends the loop."""
         if self._reconn_thread is not None and self._reconn_thread.is_alive():
             return
+        # optional churn observer (pid -> None), e.g. PeerHealth.
+        # note_reconnect: reconnect churn is a health signal
+        self._on_reconnect = on_reconnect
         self._reconn_stop = threading.Event()
         self._reconn_thread = threading.Thread(
             target=self._reconnect_loop,
@@ -488,8 +685,6 @@ class HostTransport:
     def _reconnect_loop(self, stop: threading.Event, period: float,
                         backoff: float, max_wait: float,
                         connect_timeout_ms: int) -> None:
-        import time as _time
-
         next_try: Dict[int, float] = {}
         wait: Dict[int, float] = {}
         while not stop.wait(period):
@@ -521,6 +716,13 @@ class HostTransport:
                 if ok:
                     self.reconnects += 1
                     _C_WIRE_RECONNECT.inc()
+                    self.resume_peer(pid)  # a live channel ends the pause
+                    cb = self._on_reconnect
+                    if cb is not None:
+                        try:
+                            cb(pid)
+                        except Exception:  # noqa: BLE001 — an observer
+                            pass           # must never kill the loop
                     if TRACE.enabled:
                         TRACE.emit("wire_reconnect", node=self.id, dst=pid)
                     next_try.pop(pid, None)
@@ -536,10 +738,14 @@ class HostTransport:
         if not self._node:
             return False  # closed: a racing late send must not deref the
             # freed native node (crash-restart teardown hardening)
+        if self._send_paused(to):
+            _C_BP_DROPS.inc()
+            return False
         rc = self._lib.rt_node_send(
             self._node, to, tag.pack() & 0xFFFFFFFFFFFFFFFF, bytes(payload)
             if not isinstance(payload, bytes) else payload, len(payload),
         )
+        self._note_send(to, rc == 0)
         if rc == 0:
             _C_WIRE_SENT.inc()
             _C_WIRE_SENT_B.inc(len(payload))
@@ -557,6 +763,12 @@ class HostTransport:
         would outgrow ``batch_cap`` is flushed first (UDP: a datagram must
         carry the whole batch).  Returns False when the node is closed."""
         if not self._node:
+            return False
+        if self._send_paused(to):
+            # bounded-memory discipline: a paused peer's frames drop with
+            # a count instead of accumulating (re-dial on every frame is
+            # exactly what the pause exists to stop)
+            _C_BP_DROPS.inc()
             return False
         entry_len = 12 + len(payload)
         with self._out_lock:
@@ -618,6 +830,7 @@ class HostTransport:
                 _C_BATCHES.inc()
                 _C_BATCH_FRAMES.inc(count)
                 _C_BATCH_BYTES.inc(len(buf))
+        self._note_send(dest, rc == 0)
         ent[0] = bytearray()
         ent[1] = 0
 
@@ -659,6 +872,10 @@ class HostTransport:
         re-copied).  False when nothing arrived (timeout/closed)."""
         if not self._node:
             return False
+        # edge-count wire.backpressure BEFORE the drain: the pop path
+        # clears the level at the low watermark, so polling after would
+        # never observe the rising edge it exists to record
+        self._poll_backpressure()
         nb = ctypes.c_int()
         k = self._lib.rt_node_recv_many(
             self._node, self._buf, len(self._buf), timeout_ms,
@@ -787,6 +1004,7 @@ class RoundPump:
     F_GROWTH, F_EXTEND, F_STRICT = 1, 2, 4
     # ready reasons (native kReady*)
     R_THRESH, R_GROWTH, R_SKEW, R_DEADLINE, R_POKE = 1, 2, 4, 8, 16
+    R_BACKPR = 32  # inbox byte high watermark: the waiter must drain
     R_ROUND_END = R_THRESH | R_SKEW | R_DEADLINE  # default auto-disarm set
 
     _ARM = struct.Struct("<iiiqIiiB")

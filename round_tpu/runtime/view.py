@@ -186,6 +186,23 @@ class ViewManager:
         # re-serialize the SAME view for every stale peer it answered
         # (the per-peer re-encode audit of runtime/host.py)
         self._wire_cache: Optional[Tuple[int, bytes]] = None
+        # optional observer (renames: {old_pid: new_pid | None}, new_n;
+        # None = that member was removed) called
+        # after every SURVIVING view move — apply_op and adopt_wire —
+        # so per-peer state keyed by pid (runtime/health.py PeerHealth)
+        # remaps through membership changes instead of silently scoring
+        # the wrong peers.  Exceptions are swallowed: an observer must
+        # never wedge a view change.
+        self.on_change = None
+
+    def _notify_change(self, renames: Dict[int, int], n: int) -> None:
+        cb = self.on_change
+        if cb is None:
+            return
+        try:
+            cb(renames, n)
+        except Exception:  # noqa: BLE001 — observer must not kill the move
+            log.warning("view on_change observer failed", exc_info=True)
 
     @property
     def epoch(self) -> int:
@@ -276,6 +293,7 @@ class ViewManager:
         self.my_id = new_id
         self.view = new
         self._replied.clear()
+        self._notify_change(dict(renaming), new.n)
 
     # -- the epoch guard (HostRunner per-frame hook) ---------------------
 
@@ -345,11 +363,22 @@ class ViewManager:
             log.info("view catch-up: removed from the group at epoch %d",
                      v.epoch)
             return True
+        old_view = self.view
         self.transport.rewire(v.peers(), my_id=new_id)
         self.my_id = new_id
         self.view = v
         self.stale = False
         self._replied.clear()
+        # identity is ADDRESS here (the consensus we missed renamed pids):
+        # remap per-peer state by looking each old member up in the new
+        # group, exactly how our own new_id was found
+        renames = {}
+        for rep in old_view.group.replicas:
+            # None = the member left the group: the observer must DROP
+            # its state, not let an identity fallback leak it onto
+            # whichever survivor inherits the pid
+            renames[rep.id] = v.group.inet_to_id(rep.address, rep.port)
+        self._notify_change(renames, v.n)
         return True
 
 

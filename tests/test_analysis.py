@@ -218,6 +218,8 @@ def test_baseline_entries_require_reasons(tmp_path):
         analysis.load_baseline(str(p))
 
 
+@pytest.mark.slow  # ~16 s subprocess sweep; the in-process
+# zero-nonbaselined gate stays tier-1
 def test_cli_json_clean_without_accelerator_env():
     """End-to-end: the CLI exits 0 on the shipped tree, emits valid JSON,
     and never needs a preset JAX_PLATFORMS (it pins cpu itself — the

@@ -501,6 +501,8 @@ def test_serve_decisions_lingers_until_idle():
         assert not t.is_alive() and out["served"] >= 1
 
 
+@pytest.mark.slow  # ~30 s 3-proc cluster; tier-1 keeps the test_obs
+# chaos cluster + host-wire regression replays as the fast pins
 def test_chaos_cluster_crash_restart_agreement(tmp_path):
     """THE acceptance test: a 3-process host cluster under ~20% drop +
     reorder, with one replica SIGKILLed after its durable checkpoint

@@ -8,6 +8,7 @@ LastVotingEvent.scala:77-81.
 """
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -113,6 +114,7 @@ def _run(algo, io, n, ho_np, phases, key=0):
     )
 
 
+@pytest.mark.slow  # ~15 s; the reduced/tree-fold parity pins stay tier-1
 def test_foldround_matches_sequential_adapter():
     """LVE via FoldRound == LVE via the sequential EventRound adapter,
     bit-for-bit, over random lossy schedules (incl. ts ties)."""

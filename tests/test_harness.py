@@ -364,6 +364,7 @@ def test_log_levels_and_hide(capsys):
     rlog.unhide("noisy")
 
 
+@pytest.mark.slow  # ~10 s: end-to-end rung subprocess
 def test_ladder_first_rung_smoke():
     """The BASELINE ladder's first rung (OTR n=4, the testOTR.sh shape)
     runs end-to-end on CPU and reports the JSON fields the driver records,
@@ -441,6 +442,7 @@ def _load_bench(name):
     return mod
 
 
+@pytest.mark.slow  # ~38 s: subprocess classification ladder
 def test_bench_driver_is_hang_proof():
     """bench.py's driver stage (round-2 verdict item 1): the top level must
     import no jax, classify backend failures via a killable subprocess

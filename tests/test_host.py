@@ -1317,6 +1317,7 @@ def test_host_byzantine_catch_up_rule():
         f"the byzantine rule should have ignored the lie ({shallow})"
 
 
+@pytest.mark.slow  # ~20 s; pump/lanes chaos equivalence stay tier-1
 def test_host_pipelined_instances_under_loss():
     """The in-flight instance window (run_instance_loop_pipelined — the
     reference's InstanceDispatcher + PerfTest2 rate): under injected
@@ -1495,6 +1496,7 @@ def test_instance_mux_routing_and_stash():
             mux.close()
 
 
+@pytest.mark.slow  # ~12 s; the CLI-override conf test stays tier-1
 def test_host_replica_xml_conf_deployment():
     """The reference's deployment shape end to end: replicas launched from
     ONE XML config file (Config.scala:6-27 — <replica address= port=/>

@@ -36,7 +36,9 @@ import pytest
 from round_tpu.apps.selector import select
 from round_tpu.runtime import codec
 from round_tpu.runtime.chaos import FaultPlan, FaultyTransport, alloc_ports
-from round_tpu.runtime.host import run_instance_loop
+from round_tpu.runtime.host import (
+    run_instance_loop, run_instance_loop_pipelined,
+)
 from round_tpu.runtime.lanes import run_instance_loop_lanes
 from round_tpu.runtime.transport import (
     HostTransport, RoundPump, native_available,
@@ -55,7 +57,7 @@ def _algo(name: str, payload_bytes: int = 0):
 
 def _cluster(algo, driver="seq", pump=True, n=3, instances=5, lanes=4,
              seed=7, timeout_ms=2000, schedule="mixed", chaos=None,
-             checkpoint_dirs=None, max_rounds=32):
+             checkpoint_dirs=None, max_rounds=32, rate=4):
     """One in-thread cluster; returns {replica: decision log}."""
     ports = alloc_ports(n)
     peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
@@ -73,6 +75,12 @@ def _cluster(algo, driver="seq", pump=True, n=3, instances=5, lanes=4,
                     timeout_ms=timeout_ms, seed=seed,
                     value_schedule=schedule, checkpoint_dir=ck,
                     max_rounds=max_rounds, use_pump=pump)
+            elif driver == "pipelined":
+                results[i] = run_instance_loop_pipelined(
+                    algo, i, peers, tr, instances, rate=rate,
+                    timeout_ms=timeout_ms, seed=seed,
+                    value_schedule=schedule, max_rounds=max_rounds,
+                    pump=pump)
             else:
                 results[i] = run_instance_loop(
                     algo, i, peers, tr, instances, timeout_ms=timeout_ms,
@@ -111,6 +119,27 @@ def test_pump_equivalence_lane_driver():
     algo = _algo("otr")
     a = _cluster(algo, driver="lanes", pump=False, instances=6)
     b = _cluster(algo, driver="lanes", pump=True, instances=6)
+    assert a == b
+    assert all(d is not None for log in b.values() for d in log)
+
+
+def test_pump_equivalence_pipelined_mux():
+    # the PR-7 follow-up: the pipelined InstanceMux no longer forces the
+    # Python-pump fallback — each in-flight instance occupies a native
+    # pump lane (_make_mux_pump), its runner blocks in rt_pump_wait_lane,
+    # and the router thread nudges lanes with rt_pump_poke when it routes
+    # out-of-band traffic to their endpoint queues.  Decision logs must
+    # be identical to the Python-pump arm, and the native fast path must
+    # actually ENGAGE (pump.fast_frames grows — without the counter check
+    # a silent fallback would vacuously pass the equality).
+    from round_tpu.obs.metrics import METRICS
+
+    algo = _algo("otr")
+    a = _cluster(algo, driver="pipelined", pump=False, instances=6)
+    before = METRICS.counter("pump.fast_frames").value
+    b = _cluster(algo, driver="pipelined", pump=True, instances=6)
+    assert METRICS.counter("pump.fast_frames").value > before, \
+        "native pump never engaged under the mux"
     assert a == b
     assert all(d is not None for log in b.values() for d in log)
 

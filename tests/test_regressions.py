@@ -61,6 +61,8 @@ def test_banked_artifact_replays_on_host_wire(path):
                 f"host wire: {got} != {art['expected']['host']}")
 
 
+@pytest.mark.slow  # ~15 s subprocess cluster; engine + host-wire
+# replays of every banked artifact stay tier-1
 def test_banked_artifact_replays_on_multiprocess_cluster(tmp_path):
     """The heavyweight acceptance pin, run on ONE banked artifact: a real
     multi-process FaultyTransport cluster (host_replica subprocesses with

@@ -11,6 +11,7 @@ an unbounded key domain), so the non-vacuity check runs on the
 quantifier-free prefix — the honest subset of upstream's proven pair."""
 
 import jax
+import pytest
 
 jax.config.update("jax_platforms", "cpu")
 
@@ -74,6 +75,7 @@ def _invariant():
 CFG = ClConfig(venn_bound=2, inst_depth=1)
 
 
+@pytest.mark.slow  # ~18 s native-reducer sat check
 def test_shaz_invariant_sat():
     """ShazExample "Sanity check 1": the allocator invariant is
     satisfiable."""

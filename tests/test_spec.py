@@ -7,6 +7,7 @@ violations on corrupted traces.
 """
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -129,6 +130,7 @@ def test_benor_invariants_and_safety_predicate():
         assert bool(rep.all_safety_properties_hold())
 
 
+@pytest.mark.slow  # ~20 s trace replay; otr/benor spec pins stay tier-1
 def test_lastvoting_phase_invariants():
     n = 5
     algo = LastVoting()

@@ -716,6 +716,68 @@ def check_host_chaos(rng, it):
     return cfg
 
 
+def check_host_overload(rng, it):
+    """The host-overload rotation rung: the overload degradation A/B
+    (apps/host_perftest.measure_overload_ab — four process clusters:
+    at-capacity, hung-peer flood on the PRE-hardening driver, the same
+    world hardened with --quarantine/--admission, and the lane-flood
+    shedding arm; docs/HOST_FAULT_MODEL.md "overload, shedding, and
+    quarantine").  Banks the whole degradation curve into SOAK.jsonl.
+    Gates:
+
+      (a) hardened-at-overload >= 0.9x of at-capacity decided/sec
+          (the serving tier survives ~3x offered load);
+      (b) the shedding arm actually SHEDS (> 0 frames) and every shed
+          is NACK-accounted (shed_frames == nacks_sent + suppressed);
+      (c) replica-0 peak RSS bounded: every arm within 1.25x of the
+          at-capacity run (overload must cost latency/sheds, not
+          memory);
+      (d) the baseline arm still DEGRADES (< 0.7x): if the unhardened
+          driver stops collapsing under the hung-peer flood, the A/B
+          has lost its pressure and must be re-tuned, not trusted.
+
+    ~60-90 s per iteration (four process clusters incl. startup)."""
+    from round_tpu.apps.host_perftest import measure_overload_ab
+
+    res = measure_overload_ab(seed=int(rng.integers(0, 2**31)))
+    ex = res["extra"]
+    cfg = dict(kind="host-overload", it=it, ratio=res["value"],
+               baseline_ratio=ex["baseline_ratio"],
+               shedding_ratio=ex["shedding_ratio"],
+               rss_ratio_hardened=ex["rss_ratio_hardened"],
+               rss_ratio_baseline=ex["rss_ratio_baseline"],
+               rss_ratio_shedding=ex["rss_ratio_shedding"],
+               rss_unavailable=ex.get("rss_unavailable", False),
+               sheds=ex["sheds"], runs=ex["runs"],
+               instances=ex["instances"], overload=ex["overload"],
+               timeout_ms=ex["timeout_ms"], mode=ex["mode"])
+    if res["value"] < 0.9:
+        return {**cfg, "fail": f"hardened driver below the degradation "
+                               f"gate: {res['value']} < 0.9x of "
+                               f"at-capacity decided/sec"}
+    if ex["sheds"].get("shed_frames", 0) <= 0:
+        return {**cfg, "fail": "shedding arm never shed: the admission "
+                               "budget no longer binds under the flood"}
+    if not ex["shed_accounting_ok"]:
+        return {**cfg, "fail": f"shed accounting broken: "
+                               f"{ex['sheds']} (shed_frames != "
+                               f"nacks_sent + nacks_suppressed)"}
+    for arm in ("hardened", "baseline", "shedding"):
+        ratio = ex[f"rss_ratio_{arm}"]
+        # None = /proc unreadable (stripped sandbox): clause (c) cannot
+        # be evaluated — the gap rides the banked record as
+        # rss_unavailable instead of passing as a vacuous 0.0 ratio
+        if ratio is not None and ratio > 1.25:
+            return {**cfg, "fail": f"replica-0 peak RSS unbounded in the "
+                                   f"{arm} arm: {ratio}x capacity"}
+    if ex["baseline_ratio"] >= 0.7:
+        return {**cfg, "fail": f"baseline no longer degrades "
+                               f"({ex['baseline_ratio']}x): the A/B has "
+                               f"lost its overload pressure — re-tune "
+                               f"the flood before trusting the gate"}
+    return cfg
+
+
 #: the verify-param rung's suite subset: the two parameterized
 #: threshold-automaton suites plus enough fixed-spec suites that the
 #: federated --jobs dispatch has real work to overlap on 2 vCPUs
@@ -902,7 +964,7 @@ def main():
                 check_otr_flagship_shape, check_host_chaos, check_lint,
                 check_host_perf, check_host_lanes, check_host_pump,
                 lambda r, i: check_host_perf(r, i, payload=True),
-                check_fuzz, check_verify_param]
+                check_fuzz, check_verify_param, check_host_overload]
     while time.monotonic() < t_end:
         check = rotation[it % len(rotation)]
         t0 = time.perf_counter()
