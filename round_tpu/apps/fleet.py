@@ -1,0 +1,382 @@
+"""Fleet front-door CLI: serve one shard, bench a whole fleet, fit the
+capacity model (docs/SERVING.md).
+
+    # one shard process (the deployment unit: an n-replica lane-driver
+    # group in client-serving mode, runtime/fleet.py DriverServer)
+    python -m round_tpu.apps.fleet serve --ports 7101,7102,7103 \
+        --lanes 32 --admission-bytes-per-lane 262144
+
+    # spawn a 4-driver fleet + open-loop loadgen, report the curve
+    python -m round_tpu.apps.fleet bench --drivers 4 --rate 300 \
+        --instances 600
+
+    # fit the capacity model from banked knee samples
+    python -m round_tpu.apps.fleet fit --samples knees.json \
+        --out capacity.json
+
+``run_fleet_bench`` is the programmatic core: apps/loadgen.py,
+apps/host_perftest.py (--open-loop / --ab-fleet) and the tools/soak.py
+``host-fleet`` rung all drive it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time as _time
+from typing import Any, Dict, List, Optional
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _select_algo(algo: str, payload_bytes: int):
+    from round_tpu.apps.selector import select
+
+    if algo in ("lvb", "lastvoting-bytes", "lastvotingbytes") \
+            and payload_bytes <= 0:
+        payload_bytes = 1024
+    return select(algo, {"payload_bytes": payload_bytes}
+                  if payload_bytes > 0 else {}), payload_bytes
+
+
+def _aggregate_server_stats(stats: List[Dict[str, Any]]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for st in stats:
+        for k in ("timeouts", "rounds_run", "malformed", "shed_frames",
+                  "shed_instances", "nacks_sent", "nacks_suppressed",
+                  "client_proposals", "client_streams"):
+            out[k] = out.get(k, 0) + int(st.get(k, 0))
+    return out
+
+
+def serve_main(args) -> int:
+    """One shard process: bind the given ports, serve until idle."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from round_tpu.runtime.fleet import DriverServer
+
+    if args.switch_interval_ms > 0:
+        sys.setswitchinterval(args.switch_interval_ms / 1000.0)
+    algo, payload_bytes = _select_algo(args.algo, args.payload_bytes)
+    ports = [int(p) for p in args.ports.split(",")]
+    # fixed ports: the bench parent announced them to the router
+    srv = DriverServer(
+        algo, n=len(ports), lanes=args.lanes,
+        timeout_ms=args.timeout_ms, seed=args.seed,
+        max_rounds=args.max_rounds, proto=args.proto,
+        idle_ms=args.idle_ms, max_ms=args.max_ms,
+        use_pump=not args.no_pump,
+        admission_bytes_per_lane=args.admission_bytes_per_lane,
+        shed_deadline_ms=args.shed_deadline_ms,
+        adaptive_cap_ms=args.adaptive_cap_ms, ports=ports)
+    srv.start()
+    try:
+        srv.join(timeout_s=args.max_ms / 1000.0 + 30.0)
+    finally:
+        served = sorted(set().union(*[set(r) for r in srv.results]))
+        agg = _aggregate_server_stats(srv.stats)
+        print(json.dumps({
+            "shard": args.shard,
+            "n": srv.n,
+            "lanes": args.lanes,
+            "served_instances": len(served),
+            # decided on ANY replica: one replica idling out (or
+            # finishing undecided) must not under-report a shard whose
+            # sibling replica decided and streamed the instance
+            "decided_instances": sum(
+                1 for i in served
+                if any(r.get(i) is not None for r in srv.results)),
+            **agg,
+        }))
+    return 0
+
+
+def _spawn_fleet(drivers: int, n: int, lanes: int, algo: str,
+                 payload_bytes: int, timeout_ms: int, seed: int,
+                 proto: str, idle_ms: int, max_ms: int,
+                 admission_bytes_per_lane: int, shed_deadline_ms: int,
+                 no_pump: bool, adaptive_cap_ms: int = 0):
+    """D shard processes (the deployment shape) + their address lists."""
+    import subprocess
+    import tempfile
+
+    from round_tpu.runtime.chaos import alloc_ports, cluster_env
+
+    ports = alloc_ports(drivers * n)
+    env = cluster_env()
+    procs = []
+    addrs = []
+    for d in range(drivers):
+        p = ports[d * n:(d + 1) * n]
+        argv = [sys.executable, "-m", "round_tpu.apps.fleet", "serve",
+                "--shard", f"s{d}", "--ports",
+                ",".join(str(x) for x in p),
+                "--algo", algo, "--lanes", str(lanes),
+                "--timeout-ms", str(timeout_ms),
+                "--seed", str(seed + d), "--proto", proto,
+                "--idle-ms", str(idle_ms), "--max-ms", str(max_ms),
+                "--payload-bytes", str(payload_bytes),
+                "--shed-deadline-ms", str(shed_deadline_ms)]
+        if admission_bytes_per_lane > 0:
+            argv += ["--admission-bytes-per-lane",
+                     str(admission_bytes_per_lane)]
+        if adaptive_cap_ms > 0:
+            argv += ["--adaptive-cap-ms", str(adaptive_cap_ms)]
+        if no_pump:
+            argv += ["--no-pump"]
+        # stderr goes to an unbuffered temp FILE, not a pipe: the bench
+        # only reaps output after the whole open-loop run, and a shard
+        # logging more than the OS pipe buffer mid-run would block on
+        # write() and wedge — read as a serving regression.  stdout
+        # stays a pipe (one small summary JSON line at exit).
+        errf = tempfile.TemporaryFile(mode="w+")
+        proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=errf, text=True, env=env)
+        proc._fleet_errf = errf
+        procs.append(proc)
+        addrs.append([("127.0.0.1", x) for x in p])
+    return procs, addrs
+
+
+def bank_and_maybe_fit(samples_path: str, model_path: Optional[str],
+                       sample: Dict[str, Any]) -> Dict[str, Any]:
+    """Append one measured knee sample and re-fit the capacity model
+    when enough samples exist (runtime/capacity.py needs >= 3 with real
+    axis variation).  Returns {"banked": N, "fitted": bool, ...}."""
+    from round_tpu.runtime.capacity import CapacityFitError, fit_capacity
+
+    samples: List[Dict[str, Any]] = []
+    if os.path.exists(samples_path):
+        with open(samples_path) as f:
+            samples = json.load(f)
+    samples.append(sample)
+    tmp = samples_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(samples, f, indent=1)
+    os.replace(tmp, samples_path)
+    out: Dict[str, Any] = {"banked": len(samples), "fitted": False}
+    if model_path:
+        try:
+            model = fit_capacity(samples)
+            model.save(model_path)
+            out.update(fitted=True, r2=model.r2,
+                       b_drivers=model.b_drivers, b_lanes=model.b_lanes,
+                       b_payload=model.b_payload)
+        except CapacityFitError as e:
+            out["fit_pending"] = str(e)
+    return out
+
+
+def run_fleet_bench(*, drivers: int = 4, rate: float = 100.0,
+                    rates: Optional[List[float]] = None,
+                    instances: int = 200, n: int = 3, lanes: int = 16,
+                    algo: str = "otr", skew: float = 0.0,
+                    payload_bytes: int = 0, timeout_ms: int = 300,
+                    seed: int = 0, warmup: int = 8,
+                    deadline_s: float = 180.0,
+                    proto: str = "tcp", idle_ms: int = 3000,
+                    admission_bytes_per_lane: int = 0,
+                    shed_deadline_ms: int = 250,
+                    no_pump: bool = False,
+                    adaptive_cap_ms: int = 0,
+                    capacity_out: Optional[str] = None,
+                    capacity_samples: Optional[str] = None,
+                    ) -> Dict[str, Any]:
+    """Spawn a ``drivers``-shard fleet (one OS process per shard), drive
+    it open-loop at ``rate`` (or walk the ``rates`` ladder to the knee),
+    collect the per-shard server summaries and gate the end-to-end
+    NACK/shed accounting invariant.  The measurement core of
+    --open-loop, --ab-fleet and the host-fleet soak rung."""
+    from round_tpu.apps.loadgen import open_loop, sweep
+    from round_tpu.runtime.fleet import FleetRouter
+
+    _algo, payload_bytes = _select_algo(algo, payload_bytes)
+    max_ms = int(deadline_s * 1000) + 120_000
+    procs, addrs = _spawn_fleet(
+        drivers, n, lanes, algo, payload_bytes, timeout_ms, seed, proto,
+        idle_ms, max_ms, admission_bytes_per_lane, shed_deadline_ms,
+        no_pump, adaptive_cap_ms=adaptive_cap_ms)
+    report: Dict[str, Any] = {
+        "drivers": drivers, "n": n, "lanes": lanes, "algo": algo,
+        "payload_bytes": payload_bytes, "skew": skew,
+        "timeout_ms": timeout_ms, "seed": seed,
+        "mode": "process-per-shard",
+    }
+    router = FleetRouter(proto=proto)
+    try:
+        for d, a in enumerate(addrs):
+            router.add_shard(f"s{d}", a)
+        start_id = [1]
+
+        def run_point(r):
+            rep = open_loop(
+                router, r, instances, seed=seed, skew=skew,
+                payload_bytes=payload_bytes, start_id=start_id[0],
+                warmup=warmup if start_id[0] == 1 else 0,
+                deadline_s=deadline_s)
+            # advance past the HIGHEST id the point consumed: a skewed
+            # plan scans ids beyond start+instances to fill hot-shard
+            # pools, and re-proposing a consumed id raises
+            start_id[0] = rep["last_id"] + 1
+            return rep
+
+        if rates:
+            report["sweep"] = sweep(run_point, rates)
+        else:
+            report["open_loop"] = run_point(rate)
+    finally:
+        router.close()
+        outs: Dict[int, Any] = {}
+        for d, p in enumerate(procs):
+            errf = getattr(p, "_fleet_errf", None)
+
+            def err_tail():
+                if errf is None:
+                    return ""
+                try:
+                    errf.seek(0, 2)
+                    errf.seek(max(0, errf.tell() - 500))
+                    return errf.read()
+                except Exception:  # noqa: BLE001 - diagnostics only
+                    return ""
+
+            try:
+                stdout, _ = p.communicate(
+                    timeout=idle_ms / 1000.0 + 60.0)
+                if p.returncode == 0 and stdout.strip():
+                    outs[d] = json.loads(
+                        stdout.strip().splitlines()[-1])
+                else:
+                    outs[d] = {"error": err_tail()}
+            except Exception:  # noqa: BLE001 — wedged shard: kill + mark
+                p.kill()
+                try:
+                    p.communicate(timeout=10)
+                except Exception:  # noqa: BLE001 - best-effort reap
+                    pass
+                outs[d] = {"error": "wedged", "stderr": err_tail()}
+            finally:
+                if errf is not None:
+                    errf.close()
+        report["servers"] = outs
+    # the PR-10 invariant, extended THROUGH the router: every shed frame
+    # any shard counted is NACK-accounted, fleet client traffic included
+    shed = sum(o.get("shed_frames", 0) for o in outs.values())
+    nacks = sum(o.get("nacks_sent", 0) + o.get("nacks_suppressed", 0)
+                for o in outs.values())
+    report["shed_frames"] = shed
+    report["nacks_accounted"] = nacks
+    report["shed_accounting_ok"] = shed == nacks
+    if capacity_samples and report.get("sweep", {}).get("knee_dps"):
+        report["capacity"] = bank_and_maybe_fit(
+            capacity_samples, capacity_out, {
+                "drivers": drivers, "lanes": lanes, "n": n,
+                "payload_bytes": payload_bytes,
+                "knee_dps": report["sweep"]["knee_dps"],
+                "knee_rate": report["sweep"]["knee_rate"],
+                "knee_p99_ms": report["sweep"]["knee_p99_ms"],
+            })
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sv = sub.add_parser("serve", help="one shard: an n-replica "
+                                      "client-serving lane-driver group")
+    sv.add_argument("--shard", type=str, default="s0",
+                    help="stable shard name (the ring key)")
+    sv.add_argument("--ports", type=str, required=True,
+                    help="comma-separated replica ports; index = "
+                         "replica id, count = group size n")
+    sv.add_argument("--algo", type=str, default="otr")
+    sv.add_argument("--lanes", type=int, default=16)
+    sv.add_argument("--timeout-ms", type=int, default=300)
+    sv.add_argument("--max-rounds", type=int, default=32)
+    sv.add_argument("--seed", type=int, default=0)
+    sv.add_argument("--proto", choices=["tcp", "udp"], default="tcp")
+    sv.add_argument("--idle-ms", type=int, default=8000,
+                    help="exit after this long with no live lanes, no "
+                         "queued proposals and no traffic")
+    sv.add_argument("--max-ms", type=int, default=600_000)
+    sv.add_argument("--payload-bytes", type=int, default=0)
+    sv.add_argument("--admission-bytes-per-lane", type=int, default=0,
+                    help="> 0 opts into admission control + NACK load "
+                         "shedding (PR 10) on every replica")
+    sv.add_argument("--shed-deadline-ms", type=int, default=250)
+    sv.add_argument("--adaptive-cap-ms", type=int, default=0,
+                    help="> 0 replaces the fixed --timeout-ms deadline "
+                         "with EWMA+backoff adaptive deadlines capped "
+                         "here (the deployed serving posture)")
+    sv.add_argument("--no-pump", action="store_true")
+    sv.add_argument("--switch-interval-ms", type=float, default=0.5)
+
+    bn = sub.add_parser("bench", help="spawn a fleet + open-loop loadgen")
+    bn.add_argument("--drivers", type=int, default=4)
+    bn.add_argument("--rate", type=float, default=100.0)
+    bn.add_argument("--sweep", type=str, default=None,
+                    metavar="R1,R2,..")
+    bn.add_argument("--instances", type=int, default=200)
+    bn.add_argument("--n", type=int, default=3)
+    bn.add_argument("--lanes", type=int, default=16)
+    bn.add_argument("--algo", type=str, default="otr")
+    bn.add_argument("--skew", type=float, default=0.0)
+    bn.add_argument("--payload-bytes", type=int, default=0)
+    bn.add_argument("--timeout-ms", type=int, default=300)
+    bn.add_argument("--seed", type=int, default=0)
+    bn.add_argument("--warmup", type=int, default=8)
+    bn.add_argument("--deadline-s", type=float, default=180.0)
+    bn.add_argument("--admission-bytes-per-lane", type=int, default=0)
+    bn.add_argument("--adaptive-cap-ms", type=int, default=0)
+    bn.add_argument("--no-pump", action="store_true")
+    bn.add_argument("--capacity-samples", type=str, default=None,
+                    help="append the measured knee (with --sweep) to "
+                         "this JSON sample bank")
+    bn.add_argument("--capacity-out", type=str, default=None,
+                    help="with --capacity-samples: (re)fit and write "
+                         "the capacity model artifact here")
+
+    ft = sub.add_parser("fit", help="fit the capacity model from banked "
+                                    "knee samples")
+    ft.add_argument("--samples", type=str, required=True)
+    ft.add_argument("--out", type=str, required=True)
+
+    args = ap.parse_args(argv)
+    if args.cmd == "serve":
+        return serve_main(args)
+    if args.cmd == "fit":
+        from round_tpu.runtime.capacity import fit_capacity
+
+        with open(args.samples) as f:
+            model = fit_capacity(json.load(f))
+        model.save(args.out)
+        print(json.dumps({"fitted": True, "r2": model.r2,
+                          "n_samples": model.n_samples,
+                          "b_drivers": model.b_drivers,
+                          "b_lanes": model.b_lanes,
+                          "b_payload": model.b_payload}))
+        return 0
+    rates = ([float(r) for r in args.sweep.split(",")]
+             if args.sweep else None)
+    t0 = _time.perf_counter()
+    report = run_fleet_bench(
+        drivers=args.drivers, rate=args.rate, rates=rates,
+        instances=args.instances, n=args.n, lanes=args.lanes,
+        algo=args.algo, skew=args.skew,
+        payload_bytes=args.payload_bytes, timeout_ms=args.timeout_ms,
+        seed=args.seed, warmup=args.warmup, deadline_s=args.deadline_s,
+        admission_bytes_per_lane=args.admission_bytes_per_lane,
+        adaptive_cap_ms=args.adaptive_cap_ms,
+        no_pump=args.no_pump, capacity_samples=args.capacity_samples,
+        capacity_out=args.capacity_out)
+    report["harness_wall_s"] = round(_time.perf_counter() - t0, 3)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -200,7 +200,8 @@ def main(argv=None) -> int:
                          "boxes — the perf harness has set 0.5 ms since "
                          "PR 5, and this flag gives DEPLOYED replicas "
                          "the same behavior the A/Bs measure")
-    ap.add_argument("--admission", action="store_true",
+    ap.add_argument("--admission", nargs="?", const="on", default=None,
+                    choices=["on", "auto"],
                     help="overload hardening (docs/HOST_FAULT_MODEL.md): "
                          "admission control + load shedding on the lane "
                          "loop — a per-driver byte budget (live lanes x "
@@ -208,7 +209,20 @@ def main(argv=None) -> int:
                          "+ native inbox backlog) defers, then sheds, new "
                          "instances, and refuses future-instance frames "
                          "with accounted FLAG_NACK replies instead of "
-                         "queueing unboundedly")
+                         "queueing unboundedly.  '--admission auto' "
+                         "derives the watermark AND the lane count from "
+                         "a fitted capacity model (--capacity-model, "
+                         "runtime/capacity.py; PERF_MODEL.md 'serving "
+                         "capacity model') instead of fixed defaults")
+    ap.add_argument("--capacity-model", type=str, default=None,
+                    metavar="FILE",
+                    help="fitted capacity-model artifact (apps/fleet.py "
+                         "fit / bench --capacity-out) consumed by "
+                         "--admission auto")
+    ap.add_argument("--admission-slo-ms", type=float, default=1000.0,
+                    help="latency SLO the auto-derived admission "
+                         "watermark budgets for (Little's-law queue "
+                         "bound; ignored without --admission auto)")
     ap.add_argument("--admission-bytes-per-lane", type=int,
                     default=256 << 10, metavar="BYTES",
                     help="admission high watermark per live lane "
@@ -362,8 +376,31 @@ def main(argv=None) -> int:
         if args.admission:
             from round_tpu.runtime.instances import AdmissionControl
 
+            bytes_per_lane = args.admission_bytes_per_lane
+            if args.admission == "auto":
+                # model-derived admission (PERF_MODEL.md "serving
+                # capacity model"): the watermark is the byte depth one
+                # lane can DRAIN within the SLO, and the lane count (when
+                # not forced) the smallest bucket at the amortization
+                # knee — set by measurement, not by default
+                if not args.capacity_model:
+                    ap.error("--admission auto needs --capacity-model "
+                             "(fit one with apps/fleet.py bench --sweep "
+                             "--capacity-samples/--capacity-out)")
+                from round_tpu.runtime.capacity import derive_admission
+
+                derived = derive_admission(
+                    args.capacity_model, len(peers), args.lanes,
+                    payload_bytes=args.payload_bytes,
+                    slo_ms=args.admission_slo_ms)
+                bytes_per_lane = derived["bytes_per_lane"]
+                if args.lanes <= 1:
+                    args.lanes = derived["lanes"]
+                print(f"admission auto: bytes_per_lane={bytes_per_lane} "
+                      f"lanes={args.lanes} "
+                      f"(model {args.capacity_model})", file=sys.stderr)
             admission = AdmissionControl(
-                high_bytes_per_lane=args.admission_bytes_per_lane,
+                high_bytes_per_lane=bytes_per_lane,
                 shed_deadline_ms=args.shed_deadline_ms)
             if args.lanes <= 1:
                 print("warning: --admission applies to the lane loop "

@@ -1,0 +1,273 @@
+"""Open-loop load generator for the fleet serving tier (docs/SERVING.md).
+
+Every pre-fleet A/B was CLOSED-loop: drivers pace themselves, so a
+saturated system just takes longer and queueing collapse is invisible.
+Production traffic is open-loop — arrivals do not slow down because the
+server is behind — so this generator offers load on a Poisson clock
+(seeded, reproducible), with optional HOT-KEY SKEW (a Zipf-weighted
+shard choice: consistent hashing spreads sequential ids near-uniformly,
+and skew is exactly what a real key distribution does to that) and
+KB-scale payloads (the LastVotingBytes workload: the proposal IS the
+uint8[B] vector, so the client leg carries the bytes too).
+
+Per-request decision latency is measured propose→decision at the
+router; the report carries p50/p95/p99, offered vs achieved throughput,
+and the full NACK/give-up accounting.  ``sweep`` walks a rate ladder to
+the KNEE — the last offered rate still served without falling behind —
+which is the measurement the capacity model (runtime/capacity.py) fits.
+
+    python -m round_tpu.apps.loadgen --drivers 2 --rate 200 \
+        --instances 400            # spawns a fleet, offers 200 req/s
+
+Programmatic use (apps/fleet.py bench, tools/soak.py host-fleet rung):
+``open_loop(router, ...)`` drives an existing FleetRouter.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time as _time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from round_tpu.obs.metrics import METRICS
+
+_H_ARRIVAL_LAG = METRICS.histogram(
+    "fleet.arrival_lag_ms", (1, 2, 5, 10, 20, 50, 100, 500), unit="ms")
+
+
+def payload_value(value: int, payload_bytes: int) -> np.ndarray:
+    """The deterministic uint8[B] proposal vector for the byte-payload
+    workload — the SAME expansion as runtime.host.instance_io, so a
+    fleet client and a scheduled driver proposing `value` agree byte for
+    byte (equal values ⇒ equal vectors ⇒ validity pins the decision)."""
+    vec = ((np.arange(payload_bytes, dtype=np.int64) * 131
+            + value * 31 + 7) % 256)
+    return vec.astype(np.uint8)
+
+
+def plan_arrivals(rate: float, instances: int, seed: int,
+                  skew: float, ring, start_id: int = 1
+                  ) -> List[Dict[str, Any]]:
+    """The offered-load schedule: Poisson arrival times (exponential
+    inter-arrivals at ``rate``/s) over ``instances`` NEW instance ids.
+
+    ``skew`` > 0 biases WHICH SHARD each arrival lands on with Zipf
+    weights ``(rank+1)^-skew`` over the ring's shards (rank order is the
+    sorted shard-name order, deterministic): each arrival draws a shard,
+    then takes the next unused instance id that hashes to it — hot-key
+    pressure without fabricating ids outside the 16-bit space.  skew=0
+    keeps natural sequential placement."""
+    rng = np.random.default_rng(seed)
+    t = np.cumsum(rng.exponential(1.0 / max(rate, 1e-9), size=instances))
+    shards = ring.shards
+    if skew <= 0 or len(shards) <= 1:
+        ids = list(range(start_id, start_id + instances))
+        return [{"t": float(t[i]), "inst": ids[i]}
+                for i in range(instances)]
+    w = np.array([(r + 1) ** -skew for r in range(len(shards))])
+    w /= w.sum()
+    choice = rng.choice(len(shards), size=instances, p=w)
+    need = {s: int((choice == i).sum()) for i, s in enumerate(shards)}
+    pools: Dict[str, List[int]] = {s: [] for s in shards}
+    cand = start_id
+    from round_tpu.runtime.fleet import MAX_FLEET_INSTANCE
+
+    while any(len(pools[s]) < need[s] for s in shards):
+        if cand > MAX_FLEET_INSTANCE:
+            raise ValueError(
+                f"instance id space exhausted planning {instances} "
+                f"skewed arrivals from {start_id}")
+        owner = ring.owner(cand)
+        if owner in pools and len(pools[owner]) < need[owner]:
+            pools[owner].append(cand)
+        cand += 1
+    cursors = {s: 0 for s in shards}
+    out = []
+    for i in range(instances):
+        s = shards[int(choice[i])]
+        out.append({"t": float(t[i]), "inst": pools[s][cursors[s]],
+                    "shard": s})
+        cursors[s] += 1
+    return out
+
+
+def open_loop(router, rate: float, instances: int, *, seed: int = 0,
+              skew: float = 0.0, payload_bytes: int = 0,
+              value_base: int = 0, start_id: int = 1,
+              warmup: int = 0, deadline_s: float = 120.0,
+              value_fn: Optional[Callable[[int], Any]] = None
+              ) -> Dict[str, Any]:
+    """Offer ``instances`` arrivals at ``rate``/s through ``router`` and
+    report per-request decision latency + offered-vs-achieved
+    throughput.  ``warmup`` proposals (closed-loop, excluded from the
+    stats) absorb the fleet's jit compiles so the measured window sees a
+    warm fabric — the same discipline as every perf_ab harness."""
+    if value_fn is None:
+        if payload_bytes > 0:
+            def value_fn(i):
+                return payload_value(value_base + i, payload_bytes)
+        else:
+            def value_fn(i):
+                return value_base + i
+    next_id = start_id
+    base = {k: getattr(router, k) for k in
+            ("nack_retries", "give_ups", "reproposals", "dup_decisions")}
+    carried_inflight = len(router._inflight)
+    if warmup > 0:
+        for i in range(warmup):
+            router.propose(next_id, value_fn(next_id))
+            next_id += 1
+        router.drain(deadline_s)
+    plan = plan_arrivals(rate, instances, seed, skew, router.ring,
+                         start_id=next_id)
+    measured = [p["inst"] for p in plan]
+    t0 = _time.monotonic()
+    i = 0
+    t_hard = t0 + deadline_s
+    while (i < len(plan) or router._inflight) \
+            and _time.monotonic() < t_hard:
+        now = _time.monotonic() - t0
+        while i < len(plan) and plan[i]["t"] <= now:
+            lag_ms = (now - plan[i]["t"]) * 1000.0
+            _H_ARRIVAL_LAG.observe(lag_ms)
+            router.propose(plan[i]["inst"], value_fn(plan[i]["inst"]))
+            i += 1
+        if i < len(plan):
+            gap_ms = max(0.0, (plan[i]["t"] - (_time.monotonic() - t0))
+                         * 1000.0)
+            router.pump(int(min(20.0, gap_ms)))
+        else:
+            router.pump(20)
+    wall = _time.monotonic() - t0
+    lats = sorted(router.latency_ms[m] for m in measured
+                  if m in router.latency_ms)
+    decided = sum(1 for m in measured
+                  if router.results.get(m) is not None)
+    resolved_t = [router.decide_t[m] for m in measured
+                  if m in router.decide_t]
+    span = (max(resolved_t) - t0) if resolved_t else wall
+
+    def pct(p):
+        if not lats:
+            return None
+        return round(lats[min(len(lats) - 1,
+                              int(math.ceil(p / 100.0 * len(lats))) - 1)],
+                     2)
+
+    return {
+        "offered_rate": rate,
+        "instances": instances,
+        "decided": decided,
+        "undecided": sum(1 for m in measured
+                         if router.results.get(m) is None
+                         and m in router.results),
+        "unresolved": sum(1 for m in measured
+                          if m not in router.results),
+        "achieved_dps": round(decided / span, 2) if span > 0 else 0.0,
+        "wall_s": round(wall, 3),
+        "p50_ms": pct(50), "p95_ms": pct(95), "p99_ms": pct(99),
+        "mean_ms": round(float(np.mean(lats)), 2) if lats else None,
+        "skew": skew,
+        "payload_bytes": payload_bytes,
+        "seed": seed,
+        "warmup": warmup,
+        # id-space high watermark: a skewed plan consumes ids past
+        # start_id + instances to fill hot-shard pools — the NEXT
+        # measurement point must start above everything proposed here
+        "last_id": max([next_id - 1] + measured),
+        # per-POINT deltas (the router's counters are lifetime totals —
+        # a sweep's later points must not inherit earlier overload) +
+        # the backlog this point started with, so a curve reader can
+        # see when a point serviced a previous point's leftovers
+        "carried_inflight": carried_inflight,
+        "nack_retries": router.nack_retries - base["nack_retries"],
+        "give_ups": router.give_ups - base["give_ups"],
+        "reproposals": router.reproposals - base["reproposals"],
+        "dup_decisions": router.dup_decisions - base["dup_decisions"],
+    }
+
+
+def sweep(make_run, rates: List[float], *, p99_cap_ms: float = 2000.0,
+          min_served: float = 0.9) -> Dict[str, Any]:
+    """Walk a rate ladder to the knee: ``make_run(rate)`` measures one
+    open-loop point (a fresh id range per point), and the KNEE is the
+    last rate that (a) decided >= ``min_served`` of its offered
+    instances and (b) kept p99 under ``p99_cap_ms``.  Returns the full
+    curve — the capacity model fits knees, the soak rung banks curves."""
+    curve = []
+    knee = None
+    for rate in rates:
+        rep = make_run(rate)
+        ok = (rep["decided"] >= min_served * rep["instances"]
+              and (rep["p99_ms"] is None or rep["p99_ms"] <= p99_cap_ms))
+        rep["within_slo"] = ok
+        curve.append(rep)
+        if ok:
+            knee = rep
+        elif knee is not None:
+            break  # past the knee: the ladder only gets worse
+    return {
+        "curve": curve,
+        "knee_rate": knee["offered_rate"] if knee else None,
+        "knee_dps": knee["achieved_dps"] if knee else None,
+        "knee_p99_ms": knee["p99_ms"] if knee else None,
+    }
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--drivers", type=int, default=2,
+                    help="fleet size: one DriverServer process per "
+                         "driver (apps/fleet.py serve)")
+    ap.add_argument("--rate", type=float, default=100.0,
+                    help="offered load, requests/sec (Poisson)")
+    ap.add_argument("--sweep", type=str, default=None, metavar="R1,R2,..",
+                    help="rate ladder to the knee instead of one point")
+    ap.add_argument("--instances", type=int, default=200)
+    ap.add_argument("--n", type=int, default=3,
+                    help="replicas per shard (consensus group size)")
+    ap.add_argument("--lanes", type=int, default=16)
+    ap.add_argument("--algo", type=str, default="otr")
+    ap.add_argument("--skew", type=float, default=0.0,
+                    help="Zipf hot-shard exponent (0 = uniform)")
+    ap.add_argument("--payload-bytes", type=int, default=0,
+                    help="propose uint8[B] vectors (LastVotingBytes "
+                         "workload; selects --algo lvb)")
+    ap.add_argument("--timeout-ms", type=int, default=300)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--warmup", type=int, default=8)
+    ap.add_argument("--deadline-s", type=float, default=180.0)
+    ap.add_argument("--capacity-out", type=str, default=None,
+                    metavar="FILE",
+                    help="with --sweep: bank the measured knee into "
+                         "FILE.samples.json and (re)fit the capacity "
+                         "model into FILE once >= 3 samples with real "
+                         "axis variation exist (runtime/capacity.py; "
+                         "--admission auto reads FILE)")
+    args = ap.parse_args(argv)
+    from round_tpu.apps.fleet import run_fleet_bench
+
+    rates = ([float(r) for r in args.sweep.split(",")]
+             if args.sweep else None)
+    report = run_fleet_bench(
+        drivers=args.drivers, rate=args.rate, rates=rates,
+        instances=args.instances, n=args.n, lanes=args.lanes,
+        algo=args.algo, skew=args.skew,
+        payload_bytes=args.payload_bytes, timeout_ms=args.timeout_ms,
+        seed=args.seed, warmup=args.warmup, deadline_s=args.deadline_s,
+        capacity_samples=(args.capacity_out + ".samples.json"
+                          if args.capacity_out else None),
+        capacity_out=args.capacity_out)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -945,6 +945,25 @@ struct Node {
         ok = rc == 0;
       }
     }
+    if (ok) {
+      // LOCALHOST SELF-CONNECT guard: dialing a not-yet-listening port
+      // on 127.0.0.1 can land a TCP *simultaneous open* when the kernel
+      // assigns our ephemeral source port equal to the destination port
+      // — the socket connects to ITSELF, the handshake below echoes
+      // back, and the "channel" is cached as live while the real peer
+      // stays unreachable forever (observed: a fleet router dialing
+      // shard replicas during their ~5 s interpreter startup wedged a
+      // whole shard).  getsockname == getpeername is the signature.
+      sockaddr_in self{}, peer_sa{};
+      socklen_t slen = sizeof(self), plen = sizeof(peer_sa);
+      if (getsockname(fd, reinterpret_cast<sockaddr *>(&self),
+                      &slen) == 0 &&
+          getpeername(fd, reinterpret_cast<sockaddr *>(&peer_sa),
+                      &plen) == 0 &&
+          self.sin_port == peer_sa.sin_port &&
+          self.sin_addr.s_addr == peer_sa.sin_addr.s_addr)
+        ok = false;
+    }
     freeaddrinfo(res);
     if (!ok) {
       if (fd >= 0) close(fd);

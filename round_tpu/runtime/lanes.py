@@ -61,7 +61,10 @@ from round_tpu.runtime.host import (
 )
 from round_tpu.runtime.instances import AdmissionControl, LaneTable
 from round_tpu.runtime.log import get_logger
-from round_tpu.runtime.oob import FLAG_DECISION, FLAG_NACK, FLAG_NORMAL, Tag
+from round_tpu.runtime.oob import (
+    FLAG_DECISION, FLAG_NACK, FLAG_NORMAL, FLAG_PROPOSE, FLAG_SUBSCRIBE,
+    FLAG_TOO_LATE, FLEET_MAX_INSTANCE, FLEET_MIN_INSTANCE, Tag,
+)
 from round_tpu.runtime.transport import RoundPump
 
 log = get_logger("lanes")
@@ -94,6 +97,12 @@ _C_CATCHUP = METRICS.counter("host.catch_ups")
 # entry counts and the live depth is a gauge
 _C_STASH_EVICT = METRICS.counter("lanes.stash_evictions")
 _G_STASH_DEPTH = METRICS.gauge("lanes.stash_depth")
+# client-serving vocabulary (runtime/fleet.py, docs/SERVING.md): the
+# driver side of the fleet protocol — proposals accepted off the wire
+# and decisions streamed back to clients/subscribers
+_C_CLIENT_PROPS = METRICS.counter("lanes.client_proposals")
+_C_CLIENT_STREAM = METRICS.counter("lanes.client_streams")
+_G_CLIENT_QUEUE = METRICS.gauge("lanes.client_queue")
 # overload vocabulary (docs/HOST_FAULT_MODEL.md "overload, shedding and
 # quarantine"): every shed is accounted — shed_frames == nacks_sent +
 # nacks_suppressed is the invariant the host-overload soak rung gates
@@ -106,6 +115,7 @@ _G_QUEUED = METRICS.gauge("overload.queued_bytes")
 _G_SHEDDING = METRICS.gauge("overload.shedding")
 
 _STASH_CAP = 4096  # same eviction discipline as InstanceMux._STASH_CAP
+_DONE_CAP = 8192   # client-serving decision-bank cap (_retire_lane)
 
 # per-class progress kinds (parsed once from Round.init_progress)
 _P_TIMEOUT, _P_WAIT, _P_GOAHEAD, _P_SYNC = range(4)
@@ -222,6 +232,7 @@ class LaneDriver:
         use_pump: bool = True,
         admission: Optional[AdmissionControl] = None,
         health=None,
+        clients=None,
     ):
         if wire not in ("binary", "pickle"):
             raise ValueError(f"wire must be 'binary' or 'pickle', "
@@ -332,7 +343,7 @@ class LaneDriver:
         self._stash_count = 0  # LIVE stashed entries (the order deque may
         # carry stale ids for already-admitted instances; they age out in
         # the eviction loop — the cap gates on this count, not deque len)
-        self._init_cache: Dict[bytes, List[np.ndarray]] = {}
+        self._init_cache: Dict[Tuple, List[np.ndarray]] = {}
         self.malformed = 0
         self.timeouts = 0
         self.rounds_run = 0   # cumulative across every lane and instance
@@ -352,6 +363,31 @@ class LaneDriver:
         self.shed_instances = 0
         self.nacks_sent = 0
         self.nacks_suppressed = 0
+        # fleet client protocol (runtime/fleet.py, docs/SERVING.md):
+        # ``clients`` names transport sender ids OUTSIDE the consensus
+        # group (the front-door router) whose frames speak
+        # FLAG_PROPOSE / FLAG_SUBSCRIBE instead of the round protocol.
+        # Proposals queue here until a lane frees; decisions stream back
+        # to the proposer (and any subscriber) as FLAG_DECISION, with
+        # FLAG_TOO_LATE for an instance that finished undecided and the
+        # accounted FLAG_NACK while shedding.  Empty set = the
+        # pre-fleet driver, byte-identical behavior.
+        self._clients = frozenset(clients or ())
+        self._proposals: collections.deque = collections.deque()
+        self._proposed: set = set()
+        self._client_of: Dict[int, int] = {}
+        self._subscribers: set = set()
+        self.client_proposals = 0
+        self.client_streams = 0
+        # the canonical proposal shape/dtype (instance_io's contract for
+        # this algorithm): client values are validated against it AT THE
+        # PROTOCOL BOUNDARY — several algorithms' make_init_state happily
+        # broadcasts an alien-shaped array, and the first admission
+        # defines the driver's state-tree shapes, so an unvalidated
+        # garbage proposal would poison the whole shard (or crash the
+        # serve loop at the next jitted dispatch)
+        self._io_proto = (np.asarray(instance_io(algo, 0)["initial_value"])
+                          if self._clients else None)
 
     # -- native pump setup -------------------------------------------------
 
@@ -429,12 +465,21 @@ class LaneDriver:
 
     # -- admission ---------------------------------------------------------
 
-    def _init_leaves(self, value: int) -> List[np.ndarray]:
-        """Per-lane init state leaves for a scheduled proposal value —
-        cached by value bytes (the schedule draws from a tiny domain, so
-        admission is an array write, not an eager trace)."""
-        io = instance_io(self.algo, value)
-        key = np.asarray(io["initial_value"]).tobytes()
+    def _init_leaves(self, io) -> List[np.ndarray]:
+        """Per-lane init state leaves for one instance's io pytree —
+        cached by initial-value bytes (schedules draw from a tiny domain
+        and clients re-propose the same values, so admission is an array
+        write, not an eager trace).  The key carries dtype+shape: a
+        client byte vector must never collide with a scalar whose raw
+        bytes happen to match."""
+        v = np.asarray(io["initial_value"])
+        key = (v.dtype.str, v.shape, v.tobytes())
+        if len(self._init_cache) >= 512:
+            # scheduled values draw from a ~5-value domain, but client-
+            # driven serving (serve()) proposes arbitrary values — a
+            # long-lived shard must not cache one init state per
+            # instance it ever served (the _nacked map discipline)
+            self._init_cache.clear()
         got = self._init_cache.get(key)
         if got is None:
             ctx = RoundCtx(id=np.int32(self.id), n=self.n, r=np.int32(0))
@@ -449,12 +494,14 @@ class LaneDriver:
             self._init_cache[key] = got
         return got
 
-    def _admit(self, inst: int) -> None:
+    def _admit(self, inst: int, io=None) -> None:
         iid = inst & 0xFFFF
         lane = self.table.admit(iid)
-        value = _schedule_value(self.value_schedule, self.base_value,
-                                self.id, inst)
-        self._write_row(lane, self._init_leaves(value))
+        if io is None:
+            value = _schedule_value(self.value_schedule, self.base_value,
+                                    self.id, inst)
+            io = instance_io(self.algo, value)
+        self._write_row(lane, self._init_leaves(io))
         self._inst[lane] = inst
         self._seeds[lane] = np.uint32(self.seed + inst)
         self._rr[lane] = 0
@@ -532,9 +579,105 @@ class LaneDriver:
         if TRACE.enabled:
             TRACE.emit("shed", node=self.id, inst=iid, src=sender)
 
+    # -- fleet client protocol (runtime/fleet.py, docs/SERVING.md) ---------
+
+    def _client_frame(self, sender: int, tag: Tag, raw) -> None:
+        """One frame from a CLIENT peer (the fleet front door).  PROPOSE
+        is idempotent — that is what makes the client's retry loop and
+        its decision catch-up the same message: live/queued instances
+        absorb it, completed ones answer with the (possibly re-)missed
+        FLAG_DECISION / FLAG_TOO_LATE, and shedding answers with the
+        accounted FLAG_NACK (the same shed_frames == nacks_sent +
+        nacks_suppressed invariant as peer shedding)."""
+        if tag.flag == FLAG_SUBSCRIBE:
+            self._subscribers.add(sender)
+            return
+        if tag.flag != FLAG_PROPOSE:
+            return  # decisions/NACKs are client->driver only downstream
+        iid = tag.instance
+        if not FLEET_MIN_INSTANCE <= iid <= FLEET_MAX_INSTANCE:
+            # reserved-id proposals are refused at the UNTRUSTED shard
+            # boundary too (the router enforces the same range): id 0
+            # is the free-slot marker and 0xFF00.. belongs to view-
+            # change consensus — a hostile client must not run data
+            # rounds on a membership id
+            self._note_malformed(sender)
+            self.transport.send(sender,
+                                Tag(instance=iid, flag=FLAG_TOO_LATE))
+            return
+        if iid in self._done:
+            d = self._done[iid]
+            if d is not None:
+                _try_send_decision(self.transport, self._replied, sender,
+                                   iid, d, enc_cache=self._enc_cache)
+            else:
+                self.transport.send(sender,
+                                    Tag(instance=iid, flag=FLAG_TOO_LATE))
+            return
+        if self.table.lane_of(iid) is not None or iid in self._proposed:
+            return  # running or queued: the retry is absorbed
+        if ((self._admission is not None and self._admission.shedding)
+                or len(self._proposals) >= _STASH_CAP):
+            self._shed_frame(sender, iid)
+            return
+        ok, payload = self._loads(raw, sender)
+        if not ok or payload is None:
+            if payload is None and ok:
+                self._note_malformed(sender)  # empty proposal: no value
+            return
+        arr = np.asarray(payload)
+        proto = self._io_proto
+        if arr.shape != proto.shape or not np.can_cast(
+                arr.dtype, proto.dtype, casting="same_kind"):
+            # a proposal that can never become THIS algorithm's initial
+            # value: refuse with the give-up signal (a NACK would make
+            # the client retry something unservable forever)
+            self._note_malformed(sender)
+            self.transport.send(sender,
+                                Tag(instance=iid, flag=FLAG_TOO_LATE))
+            return
+        # own the bytes: decode is zero-copy into the receive drain
+        # buffer, and a queued proposal outlives the drain (the
+        # adopt_decision discipline)
+        arr = (arr.astype(proto.dtype) if arr.dtype != proto.dtype
+               else np.array(arr))
+        self._proposals.append((iid, {"initial_value": arr}, sender))
+        self._proposed.add(iid)
+        self._client_of[iid] = sender
+        self.client_proposals += 1
+        _C_CLIENT_PROPS.inc()
+        _G_CLIENT_QUEUE.set(len(self._proposals))
+        if TRACE.enabled:
+            TRACE.emit("client_propose", node=self.id, inst=iid,
+                       src=sender)
+
+    def _stream_decision(self, iid: int, decided: bool, raw) -> None:
+        """Stream one completed instance to its proposer + subscribers:
+        FLAG_DECISION with the raw decision, FLAG_TOO_LATE when it
+        finished undecided (the value is unrecoverable — the client's
+        give-up signal)."""
+        targets = list(self._subscribers)
+        c = self._client_of.pop(iid, None)
+        if c is not None and c not in self._subscribers:
+            targets.append(c)
+        for t in targets:
+            if decided and raw is not None:
+                _try_send_decision(self.transport, self._replied, t, iid,
+                                   raw, enc_cache=self._enc_cache)
+            else:
+                self.transport.send(t, Tag(instance=iid,
+                                           flag=FLAG_TOO_LATE))
+            self.client_streams += 1
+            _C_CLIENT_STREAM.inc()
+
     def _ingest(self, got) -> None:
         sender, tag, raw = got
         if not 0 <= sender < self.n:
+            if sender in self._clients:
+                # fleet client protocol: the front-door router's frames
+                # ride the same wire but are NOT round traffic
+                self._client_frame(sender, tag, raw)
+                return
             self.malformed += 1
             _C_MALFORMED.inc()
             return
@@ -1086,16 +1229,30 @@ class LaneDriver:
                 (_time.monotonic() - self._t0[lane]) * 1000.0,
                 expired=False)
 
-    def _finish_lane(self, lane: int, decided: bool, decision,
-                     results: List[Optional[int]],
-                     checkpoint_dir: Optional[str],
-                     completed: set, instances: int) -> None:
+    def _retire_lane(self, lane: int, decided: bool, decision
+                     ) -> Tuple[int, Optional[np.ndarray]]:
+        """Release one finished lane — the loop-agnostic half of lane
+        completion: record the raw decision in the TooLate/reply bank,
+        retire the slot, tick the counters/traces.  Returns (inst, raw)
+        so the caller (run's results list, serve's client streams) does
+        its own bookkeeping."""
         inst = int(self._inst[lane])
         iid = inst & 0xFFFF
         raw = np.array(np.asarray(decision)) if decided else None
-        results[inst - 1] = decision_scalar(decision) if decided else None
         self._done[iid] = raw
-        completed.add(inst)
+        if self._clients and len(self._done) > _DONE_CAP:
+            # client-serving shards live indefinitely: the TooLate/
+            # catch-up decision bank evicts oldest-first past the cap
+            # (with its encode cache), the _init_cache discipline.  The
+            # scheduled run() keeps the full bank — its size is bounded
+            # by the run's own instance count, and crash-restart
+            # laggards may legitimately ask for its oldest entries.
+            while len(self._done) > _DONE_CAP:
+                old = next(iter(self._done))
+                del self._done[old]
+                self._enc_cache.pop(old, None)
+        if len(self._replied) > 8192:
+            self._replied.clear()  # rate-limit map, same cap as _nacked
         if self._pump is not None:
             # retire the fast-path mapping: the instance's late traffic
             # flows to the inbox again, where the TooLate reply lives
@@ -1120,6 +1277,15 @@ class LaneDriver:
                               if decided else None))
             TRACE.emit("lane_retire", node=self.id, inst=iid, lane=lane,
                        decided=decided)
+        return inst, raw
+
+    def _finish_lane(self, lane: int, decided: bool, decision,
+                     results: List[Optional[int]],
+                     checkpoint_dir: Optional[str],
+                     completed: set, instances: int) -> None:
+        inst, _raw = self._retire_lane(lane, decided, decision)
+        results[inst - 1] = decision_scalar(decision) if decided else None
+        completed.add(inst)
         if checkpoint_dir is not None:
             step = 0
             while (step + 1) in completed:
@@ -1128,6 +1294,161 @@ class LaneDriver:
                                       instances)
 
     # -- the serving loop --------------------------------------------------
+
+    def _admission_update(self) -> bool:
+        """Re-evaluate the admission budget: live lanes × watermark over
+        every byte this driver has QUEUED but not consumed — stash,
+        per-lane pending buffers, and the native inbox backlog (the
+        transport's backpressure level forces shedding regardless: that
+        backlog is ours too)."""
+        queued = (self._stash_bytes + self._pending_bytes
+                  + int(getattr(self.transport, "inbox_bytes", 0)))
+        shedding = self._admission.update(
+            max(1, self.table.occupancy), queued,
+            bool(getattr(self.transport, "backpressure", False)))
+        _G_QUEUED.set(queued)
+        _G_SHEDDING.set(1 if shedding else 0)
+        return shedding
+
+    def _tick(self, deferring: bool) -> List[Tuple[int, bool, Any]]:
+        """ONE serving tick, shared by the scheduled loop (run) and the
+        client-driven loop (serve): ship the send wave, block in the
+        pump wait (or the Python drain), translate readiness, run the
+        update mega-steps and advance rounds.  Returns the lanes that
+        finished this tick as (lane, decided, decision-row) — the caller
+        owns their bookkeeping via _finish_lane / _retire_lane."""
+        self._send_wave()
+        if self._pump is not None:
+            # ONE blocking native wait per wave: deadlines, progress
+            # thresholds and skew are evaluated inside the event loop
+            # with no GIL held — the 50 ms Python drain tick is gone.
+            # Misc traffic (decisions, foreign instances, template
+            # misses) interrupts the wait and drains via the inbox.
+            # non-blocking when a lane needs immediate service: a
+            # GoAhead lane, or a freshly-armed lane whose dirty flag
+            # is set (self-delivery/prefill may ALREADY satisfy a go
+            # probe or sync barrier, and the native side raises no
+            # GROWTH wake for frames applied at arm — the probe in
+            # _ready_pump must run this tick, not after a full wait)
+            # while admission is DEFERRING pending work the wait must
+            # stay short: a 2 s block would stretch every shed
+            # deadline and admission re-check by the full wait
+            nready, misc = self._pump.wait(
+                0 if (self._goahead_armed
+                      or bool(np.any(self._waiting & self._dirty)))
+                else (50 if deferring else 2000))
+            if nready < 0:
+                raise RuntimeError(
+                    "transport stopped under the lane driver")
+            if misc or bool(
+                    (self._pump.reasons & RoundPump.R_BACKPR).any()):
+                # misc traffic — or the inbox crossed its byte high
+                # watermark (R_BACKPR): drain NOW, that backlog is
+                # what the admission budget sheds against
+                self._drain(0)
+            ready, oob = self._ready_pump()
+        else:
+            now = _time.monotonic()
+            live_deadlines = self._deadline[self._waiting]
+            if live_deadlines.size:
+                wait_s = max(0.0, float(live_deadlines.min()) - now)
+                timeout_ms = int(min(wait_s * 1000.0, 50.0))
+            else:
+                # no armed deadline: nothing to do but listen (an idle
+                # serve loop, or a deferred-admission stall) — a short
+                # bounded wait keeps shed deadlines and stop checks at
+                # a 50 ms cadence without busy-spinning the drain
+                timeout_ms = 50
+            self._drain(timeout_ms)
+            ready, oob = self._ready()
+        finished: List[Tuple[int, bool, Any]] = []
+        for lane in oob:
+            # oob adoption skips the update (the per-instance driver
+            # exits the accumulate loop without folding the mailbox)
+            self.rounds_run += 1
+            _C_ROUNDS.inc()
+            row = self._state_row(lane)
+            finished.append((lane, True,
+                             np.asarray(self.algo.decision(row))))
+        if not ready:
+            return finished
+        exits = self._update_wave(ready)
+        finishing = []
+        for lane, exited in exits:
+            timedout, expired = self._lane_timedout.get(
+                lane, (False, False))
+            self._observe_adaptive(lane, expired, timedout)
+            if self._health is not None:
+                # one completed round wave of quarantine evidence:
+                # heard peers decay/rejoin, unheard peers only accrue
+                # score when the deadline actually EXPIRED
+                c0 = int(self._rr[lane]) % self.k
+                self._health.note_round(
+                    np.nonzero(self._boxes[c0].mask[lane])[0], expired,
+                    goal=int(self._expected_raw[lane]))
+            self.rounds_run += 1
+            _C_ROUNDS.inc()
+            r = int(self._rr[lane])
+            if TRACE.enabled:
+                c = r % self.k
+                TRACE.emit(
+                    "round_end", node=self.id,
+                    inst=int(self._inst[lane]) & 0xFFFF, round=r,
+                    heard=int(self._boxes[c].count[lane]), n=self.n,
+                    timedout=timedout, exited=exited,
+                    wall_ms=round(
+                        (_time.monotonic() - self._t0[lane]) * 1e3, 3))
+            if exited or r + 1 >= self.max_rounds:
+                finishing.append(lane)
+            else:
+                self._rr[lane] = r + 1
+                self._max_rnd[lane, self.id] = r + 1
+                self._next_round[lane] = max(
+                    int(self._next_round[lane]), r + 1)
+                self._waiting[lane] = False
+                self._need_send[lane] = True
+        if finishing:
+            dec_fn = self._decide_fn
+            if dec_fn is None:
+                dec_fn = self._decide_fn = lane_decide(
+                    self.algo, self.L, self._state_tree())
+            decided_v, decision_v = dec_fn(self._state_tree())
+            decided_v = np.asarray(decided_v)
+            decision_v = np.asarray(decision_v)
+            finished.extend(
+                (lane, bool(decided_v[lane]), decision_v[lane])
+                for lane in finishing)
+        return finished
+
+    def _bank_pump_stats(self) -> None:
+        if self._pump is None:
+            return
+        # fold the native fast-path stats into the unified metrics:
+        # pump.* vocabulary plus host.recvs/host.malformed parity (a
+        # message C++ ingested counts exactly like one Python did)
+        d = self._pump.bank_metrics()
+        _C_RECVS.inc(int(d[0] + d[1]))
+        if d[6]:
+            self.malformed += int(d[6])
+            _C_MALFORMED.inc(int(d[6]))
+
+    def _fill_stats(self, stats_out: Optional[Dict[str, int]]) -> None:
+        if stats_out is None:
+            return
+        for key, v in (("timeouts", self.timeouts),
+                       ("rounds_run", self.rounds_run),
+                       ("malformed", self.malformed),
+                       ("shed_frames", self.shed_frames),
+                       ("shed_instances", self.shed_instances),
+                       ("nacks_sent", self.nacks_sent),
+                       ("nacks_suppressed", self.nacks_suppressed),
+                       ("client_proposals", self.client_proposals),
+                       ("client_streams", self.client_streams)):
+            stats_out[key] = stats_out.get(key, 0) + v
+        stats_out.setdefault("timeout_trajectory", []).extend(
+            self._trajectory)
+        if self._health is not None:
+            stats_out["quarantine"] = self._health.summary()
 
     def run(self, instances: int, checkpoint_dir: Optional[str] = None,
             stats_out: Optional[Dict[str, int]] = None,
@@ -1179,18 +1500,7 @@ class LaneDriver:
                          "%s", self.id, len(completed), checkpoint_dir)
         while len(completed) < instances:
             if self._admission is not None:
-                # the admission budget: live lanes × watermark over every
-                # byte this driver has QUEUED but not consumed — stash,
-                # per-lane pending buffers, and the native inbox backlog
-                # (the transport's backpressure level forces shedding
-                # regardless: that backlog is ours too)
-                queued = (self._stash_bytes + self._pending_bytes
-                          + int(getattr(self.transport, "inbox_bytes", 0)))
-                shedding = self._admission.update(
-                    max(1, self.table.occupancy), queued,
-                    bool(getattr(self.transport, "backpressure", False)))
-                _G_QUEUED.set(queued)
-                _G_SHEDDING.set(1 if shedding else 0)
+                self._admission_update()
             while next_admit <= instances and self.table.can_admit():
                 if next_admit in completed:
                     next_admit += 1
@@ -1245,141 +1555,112 @@ class LaneDriver:
                     # NOW, so one transient burst sheds only as many
                     # instances as it takes to clear the watermark — not
                     # every admission pending when the deadline expired
-                    queued = (self._stash_bytes + self._pending_bytes
-                              + int(getattr(self.transport,
-                                            "inbox_bytes", 0)))
-                    still = self._admission.update(
-                        max(1, self.table.occupancy), queued,
-                        bool(getattr(self.transport, "backpressure",
-                                     False)))
-                    _G_QUEUED.set(queued)
-                    _G_SHEDDING.set(1 if still else 0)
+                    self._admission_update()
                     continue
                 self._admit(next_admit)
                 next_admit += 1
-            self._send_wave()
-            if self._pump is not None:
-                # ONE blocking native wait per wave: deadlines, progress
-                # thresholds and skew are evaluated inside the event loop
-                # with no GIL held — the 50 ms Python drain tick is gone.
-                # Misc traffic (decisions, foreign instances, template
-                # misses) interrupts the wait and drains via the inbox.
-                # non-blocking when a lane needs immediate service: a
-                # GoAhead lane, or a freshly-armed lane whose dirty flag
-                # is set (self-delivery/prefill may ALREADY satisfy a go
-                # probe or sync barrier, and the native side raises no
-                # GROWTH wake for frames applied at arm — the probe in
-                # _ready_pump must run this tick, not after a full wait)
-                # while admission is DEFERRING pending work the wait must
-                # stay short: a 2 s block would stretch every shed
-                # deadline and admission re-check by the full wait
-                deferring = (self._admission is not None
-                             and self._admission.shedding
-                             and next_admit <= instances)
-                nready, misc = self._pump.wait(
-                    0 if (self._goahead_armed
-                          or bool(np.any(self._waiting & self._dirty)))
-                    else (50 if deferring else 2000))
-                if nready < 0:
-                    raise RuntimeError(
-                        "transport stopped under the lane driver")
-                if misc or bool(
-                        (self._pump.reasons & RoundPump.R_BACKPR).any()):
-                    # misc traffic — or the inbox crossed its byte high
-                    # watermark (R_BACKPR): drain NOW, that backlog is
-                    # what the admission budget sheds against
-                    self._drain(0)
-                ready, oob = self._ready_pump()
-            else:
+            deferring = (self._admission is not None
+                         and self._admission.shedding
+                         and next_admit <= instances)
+            for lane, decided, decision in self._tick(deferring):
+                self._finish_lane(lane, decided, decision, results,
+                                  checkpoint_dir, completed, instances)
+        self._bank_pump_stats()
+        self._fill_stats(stats_out)
+        return results
+
+    def _admit_proposals(self) -> None:
+        """Admit queued client proposals into free lanes, under the same
+        admission defer/shed discipline as the scheduled loop."""
+        while self._proposals and self.table.can_admit():
+            if self._admission is not None \
+                    and not self._admission.admit_ok():
                 now = _time.monotonic()
-                live_deadlines = self._deadline[self._waiting]
-                if live_deadlines.size:
-                    wait_s = max(0.0, float(live_deadlines.min()) - now)
-                    timeout_ms = int(min(wait_s * 1000.0, 50.0))
-                else:
-                    timeout_ms = 0
-                self._drain(timeout_ms)
-                ready, oob = self._ready()
-            for lane in oob:
-                # oob adoption skips the update (the per-instance driver
-                # exits the accumulate loop without folding the mailbox)
-                self.rounds_run += 1
-                _C_ROUNDS.inc()
-                row = self._state_row(lane)
-                self._finish_lane(
-                    lane, True, np.asarray(self.algo.decision(row)),
-                    results, checkpoint_dir, completed, instances)
-            if not ready:
+                if self._admission.shed_started is None:
+                    # defer first: overload is often a burst, and a
+                    # deferred proposal costs latency, not work
+                    self._admission.shed_started = now
+                elif (now - self._admission.shed_started) * 1000.0 \
+                        >= self._admission.shed_deadline_ms:
+                    # deadline-shed the deferred backlog: every
+                    # queued proposal gets an accounted NACK (the
+                    # client's cue to back off and retry) instead of
+                    # aging in an unbounded queue
+                    while self._proposals:
+                        iid, _io, sender = self._proposals.popleft()
+                        self._proposed.discard(iid)
+                        self._client_of.pop(iid, None)
+                        self.shed_instances += 1
+                        self._admission.sheds += 1
+                        _C_SHED_INSTANCES.inc()
+                        self._shed_frame(sender, iid)
+                    _G_CLIENT_QUEUE.set(0)
+                    self._admission_update()
+                return
+            iid, io, sender = self._proposals.popleft()
+            self._proposed.discard(iid)
+            _G_CLIENT_QUEUE.set(len(self._proposals))
+            if iid in self._done \
+                    or self.table.lane_of(iid) is not None:
                 continue
-            exits = self._update_wave(ready)
-            finishing = []
-            for lane, exited in exits:
-                timedout, expired = self._lane_timedout.get(
-                    lane, (False, False))
-                self._observe_adaptive(lane, expired, timedout)
-                if self._health is not None:
-                    # one completed round wave of quarantine evidence:
-                    # heard peers decay/rejoin, unheard peers only accrue
-                    # score when the deadline actually EXPIRED
-                    c0 = int(self._rr[lane]) % self.k
-                    self._health.note_round(
-                        np.nonzero(self._boxes[c0].mask[lane])[0], expired,
-                        goal=int(self._expected_raw[lane]))
-                self.rounds_run += 1
-                _C_ROUNDS.inc()
-                r = int(self._rr[lane])
-                if TRACE.enabled:
-                    c = r % self.k
-                    TRACE.emit(
-                        "round_end", node=self.id,
-                        inst=int(self._inst[lane]) & 0xFFFF, round=r,
-                        heard=int(self._boxes[c].count[lane]), n=self.n,
-                        timedout=timedout, exited=exited,
-                        wall_ms=round(
-                            (_time.monotonic() - self._t0[lane]) * 1e3, 3))
-                if exited or r + 1 >= self.max_rounds:
-                    finishing.append(lane)
-                else:
-                    self._rr[lane] = r + 1
-                    self._max_rnd[lane, self.id] = r + 1
-                    self._next_round[lane] = max(
-                        int(self._next_round[lane]), r + 1)
-                    self._waiting[lane] = False
-                    self._need_send[lane] = True
-            if finishing:
-                dec_fn = self._decide_fn
-                if dec_fn is None:
-                    dec_fn = self._decide_fn = lane_decide(
-                        self.algo, self.L, self._state_tree())
-                decided_v, decision_v = dec_fn(self._state_tree())
-                decided_v = np.asarray(decided_v)
-                decision_v = np.asarray(decision_v)
-                for lane in finishing:
-                    self._finish_lane(
-                        lane, bool(decided_v[lane]), decision_v[lane],
-                        results, checkpoint_dir, completed, instances)
-        if self._pump is not None:
-            # fold the native fast-path stats into the unified metrics:
-            # pump.* vocabulary plus host.recvs/host.malformed parity (a
-            # message C++ ingested counts exactly like one Python did)
-            d = self._pump.bank_metrics()
-            _C_RECVS.inc(int(d[0] + d[1]))
-            if d[6]:
-                self.malformed += int(d[6])
-                _C_MALFORMED.inc(int(d[6]))
-        if stats_out is not None:
-            for key, v in (("timeouts", self.timeouts),
-                           ("rounds_run", self.rounds_run),
-                           ("malformed", self.malformed),
-                           ("shed_frames", self.shed_frames),
-                           ("shed_instances", self.shed_instances),
-                           ("nacks_sent", self.nacks_sent),
-                           ("nacks_suppressed", self.nacks_suppressed)):
-                stats_out[key] = stats_out.get(key, 0) + v
-            stats_out.setdefault("timeout_trajectory", []).extend(
-                self._trajectory)
-            if self._health is not None:
-                stats_out["quarantine"] = self._health.summary()
+            try:
+                self._admit(iid, io=io)
+            except Exception:  # noqa: BLE001 — a garbage proposal
+                # (wrong dtype/shape for the algorithm) must not
+                # wedge the serving loop: counted, client told — and
+                # the lane slot _admit claimed before failing is
+                # RELEASED, or L garbage proposals would permanently
+                # exhaust the table and wedge the shard
+                if self.table.lane_of(iid) is not None:
+                    self.table.retire(iid)
+                self._note_malformed(sender)
+                self._client_of.pop(iid, None)
+                self.transport.send(
+                    sender, Tag(instance=iid, flag=FLAG_TOO_LATE))
+
+    def serve(self, idle_ms: int = 4000, max_ms: int = 600_000,
+              stop=None, stats_out: Optional[Dict[str, int]] = None,
+              ) -> Dict[int, Optional[int]]:
+        """CLIENT-DRIVEN serving (the fleet tier, runtime/fleet.py):
+        instead of a preset 1..instances schedule, instances are admitted
+        from FLAG_PROPOSE frames sent by ``clients`` peers (the front
+        door), each carrying the client's initial value; completed
+        instances stream back as FLAG_DECISION / FLAG_TOO_LATE.  The
+        same admission control applies — while shedding, proposals are
+        refused with the accounted FLAG_NACK and the client's
+        capped-backoff retry is the recovery path (docs/SERVING.md).
+
+        Runs until ``stop()`` returns True, ``max_ms`` elapses, or the
+        driver has been idle — no live lanes, no queued proposals, no
+        finished work — for ``idle_ms``.  Returns {instance: scalar
+        decision-log entry} for every instance served (None =
+        finished undecided)."""
+        results: Dict[int, Optional[int]] = {}
+        t_end = _time.monotonic() + max_ms / 1000.0
+        last_active = _time.monotonic()
+        while True:
+            now = _time.monotonic()
+            if now >= t_end or (stop is not None and stop()):
+                break
+            if self._admission is not None:
+                self._admission_update()
+            self._admit_proposals()
+            deferring = (self._admission is not None
+                         and self._admission.shedding
+                         and bool(self._proposals))
+            finished = self._tick(deferring)
+            for lane, decided, decision in finished:
+                inst, raw = self._retire_lane(lane, decided, decision)
+                iid = inst & 0xFFFF
+                results[iid] = (decision_scalar(decision) if decided
+                                else None)
+                self._stream_decision(iid, decided, raw)
+            if finished or self.table.occupancy or self._proposals:
+                last_active = _time.monotonic()
+            elif _time.monotonic() - last_active >= idle_ms / 1000.0:
+                break
+        self._bank_pump_stats()
+        self._fill_stats(stats_out)
         return results
 
 

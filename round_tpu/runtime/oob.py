@@ -60,6 +60,31 @@ FLAG_VIEW = 7
 # trace_view classification) instead of indistinguishable from loss.
 # 10: clear of lock_manager's 8/9 and the reserved 0..2 range.
 FLAG_NACK = 10
+# the fleet client protocol (runtime/fleet.py, docs/SERVING.md): frames
+# from CLIENT peers — transport senders OUTSIDE the consensus group
+# (LaneDriver(clients=...)), the front-door router's id space.
+#   PROPOSE: "start instance tag.instance with this payload as the
+#   initial value" — payload is a codec-encoded scalar (int32) or byte
+#   vector (uint8[B], the LastVotingBytes workload).  Idempotent by
+#   design, which is what makes it the retry AND the catch-up: re-sent
+#   for a live instance it is ignored, for a completed one it is
+#   answered with the FLAG_DECISION (or FLAG_TOO_LATE if undecided)
+#   the client may have missed, and under admission shedding it gets
+#   the accounted FLAG_NACK — the client backs off and retries
+#   (FleetRouter's capped-backoff state machine).
+#   SUBSCRIBE: "stream me every decision this driver completes from
+#   now on" (empty payload; the sender id is the subscription).
+# 11/12: clear of lock_manager's 8/9, FLAG_NACK 10, and FLAG_BATCH.
+FLAG_PROPOSE = 11
+FLAG_SUBSCRIBE = 12
+# the serveable instance-id range for fleet clients: 0 is the lane
+# driver's free-slot marker and 0xFF00.. is reserved for view-change
+# consensus (runtime/view.py view_instance) — BOTH the trusted router
+# (FleetRouter.propose) and the untrusted shard boundary
+# (LaneDriver._client_frame) enforce it, so a hostile front-door peer
+# cannot run data-plane rounds on a membership-consensus id.
+FLEET_MIN_INSTANCE = 1
+FLEET_MAX_INSTANCE = 0xFEFF
 
 
 @dataclasses.dataclass(frozen=True)

@@ -192,17 +192,29 @@ class ViewManager:
         # so per-peer state keyed by pid (runtime/health.py PeerHealth)
         # remaps through membership changes instead of silently scoring
         # the wrong peers.  Exceptions are swallowed: an observer must
-        # never wedge a view change.
+        # never wedge a view change.  ``on_change`` is the original
+        # single-slot hook (kept: host_replica assigns it directly);
+        # ``add_observer`` registers any number of additional watchers —
+        # the fleet router's shard-map rebalance (runtime/fleet.py)
+        # composes with PeerHealth.resize on the same view move.
         self.on_change = None
+        self._observers: List[Any] = []
+
+    def add_observer(self, cb) -> None:
+        """Register an additional (renames, n) observer beside
+        ``on_change`` — every registered callback fires on every
+        surviving view move, each isolated from the others' failures."""
+        self._observers.append(cb)
 
     def _notify_change(self, renames: Dict[int, int], n: int) -> None:
-        cb = self.on_change
-        if cb is None:
-            return
-        try:
-            cb(renames, n)
-        except Exception:  # noqa: BLE001 — observer must not kill the move
-            log.warning("view on_change observer failed", exc_info=True)
+        cbs = ([self.on_change] if self.on_change is not None else []) \
+            + list(self._observers)
+        for cb in cbs:
+            try:
+                cb(renames, n)
+            except Exception:  # noqa: BLE001 — an observer must not kill
+                log.warning("view on_change observer failed",
+                            exc_info=True)  # the move (or its siblings)
 
     @property
     def epoch(self) -> int:

@@ -1,0 +1,623 @@
+"""Sharded serving fabric (runtime/fleet.py) — the fleet suite.
+
+The fleet contract (ISSUE 11 / docs/SERVING.md), pinned here:
+
+  * ShardMap: deterministic consistent hashing over stable names —
+    balanced arcs, and a removal moves ONLY the departed shard's keys;
+  * end-to-end serving: a router + DriverServer fleet decides every
+    proposed instance with the proposed value (uniform proposals ⇒
+    validity pins the decision), routed per the ring;
+  * rebalance-no-loss (the acceptance pin): a live shard removal
+    mid-run migrates its unresolved instances to their new owners and
+    the fleet's decision log is BYTE-IDENTICAL to an unrebalanced
+    control's;
+  * NACK-retry: an overloaded shard's accounted FLAG_NACKs drive the
+    router's capped-backoff retry; exhaustion surfaces as FleetGiveUp,
+    never silent loss; the shed accounting invariant holds through the
+    router;
+  * serve == run: the client-driven serve loop produces the SAME
+    decision log as the scheduled run loop for the same instance/value
+    universe (the lane-equivalence discipline, extended to the fleet
+    intake path);
+  * the capacity model: the power-law fit recovers known exponents,
+    refuses degenerate sweeps, and its admission/lane derivations are
+    monotone the right way.
+
+Heavy arms — the 10k-instance ≥4-process open-loop soak and the
+fleet-vs-single-driver scale-out A/B — ride ``-m slow``/``-m perf``
+(tier-1 budget discipline, ROADMAP budget note).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import numpy as np
+import pytest
+
+from round_tpu.apps.loadgen import open_loop, payload_value, plan_arrivals
+from round_tpu.apps.selector import select
+from round_tpu.runtime import codec
+from round_tpu.runtime.capacity import (
+    CapacityFitError, CapacityModel, fit_capacity,
+)
+from round_tpu.runtime.fleet import (
+    DriverServer, FleetGiveUp, FleetRouter, ShardMap,
+)
+from round_tpu.runtime.oob import FLAG_NACK, FLAG_PROPOSE, Tag
+
+
+@functools.lru_cache(maxsize=None)
+def _algo(name: str, payload_bytes: int = 0):
+    return select(name, {"payload_bytes": payload_bytes}
+                  if payload_bytes else {})
+
+
+# ---------------------------------------------------------------------------
+# ShardMap
+# ---------------------------------------------------------------------------
+
+
+def test_shard_map_deterministic_balanced_and_minimal_motion():
+    ring = ShardMap([f"s{i}" for i in range(4)])
+    keys = list(range(1, 4001))
+    owners = {k: ring.owner(k) for k in keys}
+    # deterministic: a freshly built ring with the same names agrees
+    ring2 = ShardMap(["s3", "s1", "s0", "s2"])  # order-independent
+    assert all(ring2.owner(k) == owners[k] for k in keys[:512])
+    # balanced: every shard owns a real share (vnode smoothing)
+    share = {s: sum(1 for o in owners.values() if o == s)
+             for s in ring.shards}
+    assert min(share.values()) > 0.4 * len(keys) / 4
+    assert max(share.values()) < 2.0 * len(keys) / 4
+    # minimal motion: removing s2 moves ONLY s2's keys
+    ring.remove("s2")
+    for k in keys:
+        if owners[k] != "s2":
+            assert ring.owner(k) == owners[k]
+        else:
+            assert ring.owner(k) != "s2"
+    with pytest.raises(ValueError):
+        ShardMap(["a", "a"])
+
+
+# ---------------------------------------------------------------------------
+# end-to-end serving (in-process fleets)
+# ---------------------------------------------------------------------------
+
+
+def _fleet(shards, n=3, lanes=8, timeout_ms=1500, **kw):
+    """Start `shards` in-process DriverServers + a router over them."""
+    servers = {}
+    router = FleetRouter(**kw)
+    for name in shards:
+        srv = DriverServer(_algo("otr"), n=n, lanes=lanes,
+                           timeout_ms=timeout_ms, idle_ms=60_000)
+        router.add_shard(name, srv.start())
+        servers[name] = srv
+    return servers, router
+
+
+def _shutdown(servers, router):
+    for srv in servers.values():
+        srv.stop()
+    for srv in servers.values():
+        srv.join(60)
+    router.close()
+
+
+def test_fleet_serves_proposed_values_across_shards():
+    servers, router = _fleet(["s0", "s1"])
+    try:
+        K = 12
+        for i in range(1, K + 1):
+            router.propose(i, 40 + i)
+        assert router.drain(90)
+        assert router.results == {i: 40 + i for i in range(1, K + 1)}
+        assert router.give_ups == 0
+        # latency was measured per request
+        assert len(router.latency_ms) == K
+    finally:
+        _shutdown(servers, router)
+    # routing followed the ring: each shard served exactly its keys
+    # (DriverServer.results fills when serve() returns, i.e. post-join)
+    for name, srv in servers.items():
+        mine = {i for i in range(1, K + 1)
+                if router.ring.owner(i) == name}
+        assert set(srv.results[0]) == mine
+
+
+def test_fleet_rebalance_loses_no_decisions_vs_control():
+    # the ISSUE 11 acceptance pin: a live membership change mid-run,
+    # byte-identical decision logs vs an unrebalanced control
+    K = 18
+
+    def run(rebalance: bool):
+        servers, router = _fleet(["s0", "s1", "s2"])
+        try:
+            for i in range(1, K + 1):
+                router.propose(i, 100 + i)
+            # let a prefix resolve, then drop s2 live: its unresolved
+            # instances must migrate to their new ring owners
+            t0 = time.monotonic()
+            while len(router.results) < 6 \
+                    and time.monotonic() - t0 < 60:
+                router.pump(20)
+            if rebalance:
+                router.remove_shard("s2")
+                servers.pop("s2").stop()
+            assert router.drain(90)
+            assert router.give_ups == 0
+            return json.dumps(sorted(router.results.items())).encode()
+        finally:
+            _shutdown(servers, router)
+
+    control = run(rebalance=False)
+    moved = run(rebalance=True)
+    assert moved == control  # byte-identical: no decision lost or bent
+    assert control == json.dumps(
+        [(i, 100 + i) for i in range(1, K + 1)]).encode()
+
+
+def test_fleet_view_observer_drives_rebalance():
+    # the ViewManager on_change glue (the PeerHealth.resize pattern):
+    # scripted renames — shard pid 1 removed — must remove its shard
+    # from the ring and migrate its in-flight instances
+    class _StubLink:
+        def __init__(self, n):
+            self.n = n
+            self.sent = []
+
+        def add_peer(self, *a):
+            pass
+
+        def send_buffered(self, j, tag, payload=b""):
+            self.sent.append((j, tag))
+
+        def flush(self, to=None):
+            return 0
+
+        def recv_many(self, timeout_ms):
+            return []
+
+        def close(self):
+            pass
+
+    links = []
+
+    def factory(n):
+        link = _StubLink(n)
+        links.append(link)
+        return link
+
+    router = FleetRouter(transport_factory=factory)
+    router.add_shard("alpha", [("h", 1), ("h", 2)])
+    router.add_shard("beta", [("h", 3), ("h", 4)])
+    insts = list(range(1, 41))
+    for i in insts:
+        router.propose(i, i)
+    beta_insts = [i for i in insts if router.ring.owner(i) == "beta"]
+    assert beta_insts, "hash spread should hit both shards"
+    names_by_pid = {0: "alpha", 1: "beta"}
+    observer = router.view_observer(names_by_pid)
+    observer({0: 0, 1: None}, 1)  # the view REMOVED member 1 (beta)
+    assert router.ring.shards == ["alpha"]
+    assert names_by_pid == {0: "alpha"}
+    assert router.migrations == len(beta_insts)
+    # every migrated instance was re-proposed on the surviving link
+    alpha_link = links[0]
+    reproposed = {t.instance for _j, t in alpha_link.sent
+                  if t.flag == FLAG_PROPOSE}
+    assert set(beta_insts) <= reproposed
+
+
+def test_fleet_nack_retry_backoff_and_give_up():
+    # a shard that NACKs every proposal: the router must retry with
+    # capped backoff and exhaust into FleetGiveUp — never silent loss
+    class _NackLink:
+        def __init__(self, n):
+            self.n = n
+            self.pending = []
+            self.proposes = 0
+
+        def add_peer(self, *a):
+            pass
+
+        def send_buffered(self, j, tag, payload=b""):
+            if tag.flag == FLAG_PROPOSE and j == 0:
+                self.proposes += 1
+                self.pending.append(
+                    (0, Tag(instance=tag.instance, flag=FLAG_NACK),
+                     b""))
+
+        def flush(self, to=None):
+            return 0
+
+        def recv_many(self, timeout_ms):
+            out, self.pending = self.pending, []
+            return out
+
+        def close(self):
+            pass
+
+    link_box = []
+
+    def factory(n):
+        link = _NackLink(n)
+        link_box.append(link)
+        return link
+
+    router = FleetRouter(transport_factory=factory, give_up=4,
+                         nack_backoff_ms=1.0, nack_backoff_cap_ms=4.0)
+    router.add_shard("s0", [("h", 1)])
+    router.propose(7, 3)
+    t0 = time.monotonic()
+    while 7 not in router.results and time.monotonic() - t0 < 10:
+        router.pump(1)
+    assert router.results.get(7, "unresolved") is None
+    assert router.give_ups == 1
+    assert router.nack_retries == 4        # the capped retry budget
+    assert link_box[0].proposes == 5       # initial + 4 retries
+    assert "retry cap" in router.errors[7]
+    with pytest.raises(FleetGiveUp):
+        router.raise_if_gave_up()
+
+
+def test_too_late_needs_every_replica_of_the_shard():
+    # one undecided replica answering successive re-proposes must NOT
+    # outvote a sibling that decides: the undecided resolution needs a
+    # DISTINCT (shard, replica) tally covering the whole group (review
+    # finding, PR 11)
+    from round_tpu.runtime.oob import FLAG_TOO_LATE
+
+    class _Link:
+        def __init__(self, n):
+            self.n = n
+
+        def add_peer(self, *a):
+            pass
+
+        def send_buffered(self, j, tag, payload=b""):
+            pass
+
+        def flush(self, to=None):
+            return 0
+
+        def recv_many(self, timeout_ms):
+            return []
+
+        def close(self):
+            pass
+
+    router = FleetRouter(transport_factory=lambda n: _Link(n))
+    router.add_shard("s0", [("h", 1), ("h", 2), ("h", 3)])
+    router.propose(4, 9)
+    tl = Tag(instance=4, flag=FLAG_TOO_LATE)
+    for _ in range(5):  # replica 0 re-answers every catch-up re-ask
+        router._on_frame("s0", (0, tl, b""))
+    assert 4 not in router.results  # one replica is not the shard
+    router._on_frame("s0", (1, tl, b""))
+    router._on_frame("s0", (2, tl, b""))
+    assert router.results[4] is None  # all three said so: honest None
+
+
+def test_fleet_shed_accounting_holds_through_router():
+    # a REAL overloaded shard: starve it with a tiny admission budget so
+    # proposals shed with accounted NACKs; the retry loop must still
+    # land every instance, and shed_frames == nacks_sent + suppressed
+    algo = _algo("otr")
+    srv = DriverServer(algo, n=3, lanes=2, timeout_ms=1500,
+                       idle_ms=60_000, admission_bytes_per_lane=1,
+                       shed_deadline_ms=100)
+    router = FleetRouter(give_up=40, nack_backoff_ms=20,
+                         nack_backoff_cap_ms=200, repropose_ms=500)
+    try:
+        router.add_shard("s0", srv.start())
+        K = 10
+        for i in range(1, K + 1):
+            router.propose(i, i)
+        router.drain(120)
+        stats = srv.stats  # live snapshot (serve() fills at exit; the
+        # counters below are read off the driver objects via stats_out
+        # once serve returns in _shutdown — so assert after join)
+    finally:
+        srv.stop()
+        srv.join(60)
+        router.close()
+    decided = sum(1 for i in range(1, K + 1)
+                  if router.results.get(i) is not None)
+    assert decided >= 1  # forward progress despite the 1-byte budget
+    agg = {}
+    for st in stats:
+        for k in ("shed_frames", "nacks_sent", "nacks_suppressed"):
+            agg[k] = agg.get(k, 0) + int(st.get(k, 0))
+    assert agg["shed_frames"] == agg["nacks_sent"] \
+        + agg["nacks_suppressed"]
+    # NOT asserted: router.nack_retries > 0.  A shed on one replica does
+    # not imply a router retry — the NACK can be suppressed driver-side
+    # (counted above) or arrive after a sibling replica's decision
+    # already resolved the instance.  The retry state machine itself is
+    # pinned deterministically by test_fleet_nack_retry_backoff_and_give_up.
+
+
+def test_garbage_proposal_rejected_and_slot_released():
+    # two layers of defense (review findings, PR 11): (a) a proposal
+    # whose shape/dtype can never be THIS algorithm's initial value is
+    # refused AT THE PROTOCOL BOUNDARY (several make_init_state impls
+    # happily broadcast alien shapes, and the FIRST admission defines
+    # the driver's state-tree shapes — unvalidated garbage would poison
+    # the shard); (b) if an admission still fails, the lane slot
+    # table.admit claimed is RELEASED — L failures must not exhaust the
+    # table and wedge the shard permanently
+    from round_tpu.runtime.chaos import alloc_ports
+    from round_tpu.runtime.lanes import LaneDriver
+    from round_tpu.runtime.transport import HostTransport
+
+    algo = _algo("otr")
+    ports = alloc_ports(1)
+    peers = {0: ("127.0.0.1", ports[0])}
+    tr = HostTransport(0, ports[0])
+    try:
+        driver = LaneDriver(algo, 0, peers, tr, lanes=2,
+                            timeout_ms=200, clients={1})
+        # (a) boundary validation: a float matrix never queues
+        bad = np.ones((3, 3), dtype=np.float32)
+        for iid in (5, 6, 7):
+            driver._client_frame(1, Tag(instance=iid,
+                                        flag=FLAG_PROPOSE),
+                                 codec.encode(bad))
+        assert len(driver._proposals) == 0
+        assert driver.malformed >= 3
+        # reserved ids are refused at the shard boundary too: 0 is the
+        # free-slot marker, 0xFF01 is view-change consensus
+        for iid in (0, 0xFF01):
+            driver._client_frame(1, Tag(instance=iid,
+                                        flag=FLAG_PROPOSE),
+                                 codec.encode(np.int32(1)))
+        assert len(driver._proposals) == 0
+        # a good proposal admits into a clean slot
+        driver._client_frame(1, Tag(instance=9, flag=FLAG_PROPOSE),
+                             codec.encode(np.int32(4)))
+        driver._admit_proposals()
+        assert driver.table.occupancy == 1
+        assert driver.table.lane_of(9) is not None
+        # (b) slot release: force an admission failure past the
+        # boundary (a shape the established state tree cannot take)
+        driver._proposals.append(
+            (11, {"initial_value": np.ones((2, 2), np.float32)}, 1))
+        driver._proposed.add(11)
+        driver._admit_proposals()
+        assert driver.table.lane_of(11) is None
+        assert driver.table.occupancy == 1
+        assert driver.table.can_admit()
+    finally:
+        tr.close()
+
+
+def test_serve_equivalence_with_scheduled_run():
+    # the client-driven serve loop must produce the SAME decision log as
+    # the scheduled run loop over the same instance/value universe: the
+    # uniform schedule's value for instance i is (0 + i) % 5, so a
+    # router proposing exactly those values is the same workload
+    from round_tpu.runtime.chaos import alloc_ports
+    from round_tpu.runtime.lanes import run_instance_loop_lanes
+    from round_tpu.runtime.transport import HostTransport
+
+    algo = _algo("otr")
+    K = 8
+
+    # scheduled arm (the pre-fleet driver, uniform schedule)
+    import threading
+
+    ports = alloc_ports(3)
+    peers = {i: ("127.0.0.1", ports[i]) for i in range(3)}
+    logs, errs = {}, {}
+
+    def node(i):
+        tr = HostTransport(i, ports[i])
+        try:
+            logs[i] = run_instance_loop_lanes(
+                algo, i, peers, tr, K, lanes=4, timeout_ms=1500,
+                seed=0, value_schedule="uniform")
+        except Exception as e:  # noqa: BLE001
+            errs[i] = e
+        finally:
+            tr.close()
+
+    ts = [threading.Thread(target=node, args=(i,)) for i in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(90)
+    assert not errs and len(logs) == 3
+    scheduled = {i + 1: logs[0][i] for i in range(K)}
+
+    # served arm: the same universe through the client protocol
+    srv = DriverServer(algo, n=3, lanes=4, timeout_ms=1500,
+                       idle_ms=60_000)
+    router = FleetRouter()
+    try:
+        router.add_shard("s0", srv.start())
+        for i in range(1, K + 1):
+            router.propose(i, i % 5)
+        assert router.drain(90)
+    finally:
+        srv.stop()
+        srv.join(60)
+        router.close()
+    assert router.results == scheduled
+    assert srv.results[0] == scheduled
+
+
+# ---------------------------------------------------------------------------
+# loadgen
+# ---------------------------------------------------------------------------
+
+
+def test_loadgen_plan_deterministic_and_skewed():
+    ring = ShardMap(["s0", "s1", "s2"])
+    a = plan_arrivals(500.0, 300, seed=9, skew=0.0, ring=ring)
+    b = plan_arrivals(500.0, 300, seed=9, skew=0.0, ring=ring)
+    assert a == b  # seeded: byte-for-byte reproducible
+    ids = [p["inst"] for p in a]
+    assert len(set(ids)) == len(ids)
+    assert all(a[i]["t"] <= a[i + 1]["t"] for i in range(len(a) - 1))
+    # skew concentrates load on the rank-0 (hot) shard
+    hot = plan_arrivals(500.0, 300, seed=9, skew=1.5, ring=ring)
+    hot_share = sum(1 for p in hot
+                    if ring.owner(p["inst"]) == ring.shards[0]) / 300
+    flat_share = sum(1 for p in a
+                     if ring.owner(p["inst"]) == ring.shards[0]) / 300
+    assert hot_share > flat_share + 0.15
+    assert len({p["inst"] for p in hot}) == 300
+
+
+def test_loadgen_payload_vector_matches_instance_io():
+    from round_tpu.runtime.host import instance_io
+
+    algo = _algo("lvb", payload_bytes=96)
+    v = payload_value(13, 96)
+    assert np.array_equal(v, instance_io(algo, 13)["initial_value"])
+
+
+def test_open_loop_reports_latency_and_throughput():
+    servers, router = _fleet(["s0"], lanes=8)
+    try:
+        rep = open_loop(router, rate=400.0, instances=20, seed=3,
+                        warmup=2, deadline_s=90.0)
+        assert rep["decided"] == 20
+        assert rep["unresolved"] == 0
+        assert rep["p50_ms"] is not None
+        assert rep["p99_ms"] >= rep["p50_ms"]
+        assert rep["achieved_dps"] > 0
+        assert rep["give_ups"] == 0
+    finally:
+        _shutdown(servers, router)
+
+
+# ---------------------------------------------------------------------------
+# capacity model
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_samples():
+    true = dict(b0=3.0, b_drivers=0.85, b_lanes=0.4, b_payload=-0.5)
+    out = []
+    for d in (1, 2, 4):
+        for lanes in (8, 32, 128):
+            for payload in (0, 1024, 4096):
+                dps = np.exp(true["b0"]
+                             + true["b_drivers"] * np.log(d)
+                             + true["b_lanes"] * np.log(lanes)
+                             + true["b_payload"]
+                             * np.log1p(payload / 1024.0))
+                out.append(dict(drivers=d, lanes=lanes,
+                                payload_bytes=payload,
+                                knee_dps=float(dps)))
+    return true, out
+
+
+def test_capacity_fit_recovers_exponents_and_round_trips(tmp_path):
+    true, samples = _synthetic_samples()
+    model = fit_capacity(samples)
+    assert abs(model.b_drivers - true["b_drivers"]) < 1e-6
+    assert abs(model.b_lanes - true["b_lanes"]) < 1e-6
+    assert abs(model.b_payload - true["b_payload"]) < 1e-6
+    assert model.r2 > 0.999
+    p = tmp_path / "cap.json"
+    model.save(str(p))
+    loaded = CapacityModel.load(str(p))
+    assert loaded.predict_dps(4, 64, 1024) == pytest.approx(
+        model.predict_dps(4, 64, 1024))
+
+
+def test_capacity_fit_refusals_and_pinning():
+    with pytest.raises(CapacityFitError):
+        fit_capacity([{"drivers": 1, "lanes": 8, "knee_dps": 10.0}])
+    # payload never varied: its exponent PINS to 0 instead of smearing
+    samples = [dict(drivers=d, lanes=lanes, payload_bytes=0,
+                    knee_dps=float(10 * d * lanes ** 0.5))
+               for d in (1, 2, 4) for lanes in (8, 32)]
+    model = fit_capacity(samples)
+    assert model.b_payload == 0.0
+    assert abs(model.b_drivers - 1.0) < 1e-6
+    # no variation at all beyond the intercept: degenerate, refused
+    with pytest.raises(CapacityFitError):
+        fit_capacity([{"drivers": 1, "lanes": 8, "knee_dps": 10.0}] * 4)
+
+
+def test_capacity_derivations_monotone():
+    _true, samples = _synthetic_samples()
+    model = fit_capacity(samples)
+    # Little's-law watermark: a heavier payload round queues MORE bytes
+    # per decision, so the budget grows with payload...
+    b0 = model.admission_bytes_per_lane(4, 64, payload_bytes=0)
+    b4k = model.admission_bytes_per_lane(4, 64, payload_bytes=4096)
+    assert b4k > b0
+    # ...and a tighter SLO shrinks it
+    assert model.admission_bytes_per_lane(4, 64, slo_ms=100) \
+        <= model.admission_bytes_per_lane(4, 64, slo_ms=2000)
+    assert 4 << 10 <= b0 <= 1 << 20
+    lanes = model.recommended_lanes()
+    from round_tpu.runtime.instances import LANE_BUCKETS
+
+    assert lanes in LANE_BUCKETS
+
+
+def test_admission_auto_derivation(tmp_path):
+    from round_tpu.runtime.capacity import derive_admission
+
+    _true, samples = _synthetic_samples()
+    model = fit_capacity(samples)
+    p = tmp_path / "cap.json"
+    model.save(str(p))
+    d = derive_admission(str(p), n=4, lanes=0, payload_bytes=1024)
+    assert d["lanes"] == model.recommended_lanes(payload_bytes=1024)
+    assert d["bytes_per_lane"] == model.admission_bytes_per_lane(
+        4, d["lanes"], payload_bytes=1024)
+    # an explicit lane count always wins
+    assert derive_admission(str(p), n=4, lanes=16)["lanes"] == 16
+
+
+# ---------------------------------------------------------------------------
+# heavy arms: -m slow / -m perf (tier-1 budget discipline)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.perf
+def test_fleet_10k_open_loop_four_drivers():
+    # the ISSUE 11 scale acceptance: 10k instances, open loop, >= 4
+    # driver PROCESSES, completing with reported p50/p99 and nothing
+    # silently lost (rides -m slow: ~3-6 min of wall on a small box).
+    # Paced at ~70% of the fleet's measured otr capacity (~123 dps,
+    # PERF_MODEL.md): open-loop means arrivals do not wait for the
+    # server, not that the whole universe lands at t=0 — a 10k
+    # instantaneous blast measures the re-propose pathology, not
+    # serving (the 1k+ saturation blast is the A/B's job)
+    from round_tpu.apps.fleet import run_fleet_bench
+
+    rep = run_fleet_bench(drivers=4, rate=85.0, instances=10_000, n=3,
+                          lanes=64, algo="otr", timeout_ms=300,
+                          warmup=16, deadline_s=480.0, idle_ms=4000)
+    ol = rep["open_loop"]
+    assert ol["decided"] + ol["undecided"] + ol["give_ups"] == 10_000 \
+        or ol["unresolved"] == 0
+    assert ol["decided"] >= 9_900
+    assert ol["p50_ms"] is not None and ol["p99_ms"] is not None
+    assert rep["shed_accounting_ok"]
+
+
+@pytest.mark.slow
+@pytest.mark.perf
+def test_fleet_scale_out_ab():
+    # the interleaved 1-vs-4-driver A/B at saturation; the >= 2.5x
+    # acceptance gate is enforced by the host-fleet soak rung where the
+    # box is idle — here we pin that the fleet WINS and the harness
+    # composes (a shared CI box's ratio is banked, not gated)
+    from round_tpu.apps.host_perftest import measure_fleet_ab
+
+    res = measure_fleet_ab(pairs=1)
+    assert res["extra"]["dps_fleet"] > res["extra"]["dps_single"]

@@ -135,6 +135,30 @@ def test_manager_apply_op_renames_and_rewires():
     assert mgr.history == [(1, REMOVE, 1)]
 
 
+def test_manager_observer_fanout_and_isolation():
+    # add_observer (PR 11): PeerHealth.resize AND the fleet router's
+    # rebalance watch the SAME view move — every registered observer
+    # fires with the renames, and one observer's failure neither kills
+    # the move nor its siblings
+    tr = _StubTransport(0)
+    mgr = ViewManager(0, View(0, _local_group([7000, 7001, 7002])), tr)
+    calls = []
+    mgr.on_change = lambda renames, n: calls.append(("legacy", renames, n))
+
+    def boom(renames, n):
+        calls.append(("boom", renames, n))
+        raise RuntimeError("observer crash")
+
+    mgr.add_observer(boom)
+    mgr.add_observer(lambda renames, n: calls.append(("fleet", renames,
+                                                      n)))
+    mgr.apply_op(REMOVE, 1)
+    assert [c[0] for c in calls] == ["legacy", "boom", "fleet"]
+    renames, n = calls[-1][1], calls[-1][2]
+    assert n == 2 and renames == {0: 0, 1: None, 2: 1}
+    assert (mgr.epoch, mgr.view.n) == (1, 2)  # the move itself survived
+
+
 def test_manager_removal_quiesces_wire():
     tr = _StubTransport(1)
     mgr = ViewManager(1, View(0, _local_group([7000, 7001])), tr)

@@ -778,6 +778,89 @@ def check_host_overload(rng, it):
     return cfg
 
 
+def check_host_fleet(rng, it):
+    """The host-fleet rotation rung (ISSUE 11): open-loop loadgen vs a
+    4-driver fleet (apps/fleet.py: one shard process per driver, each an
+    n=3 lane-driver group in client-serving mode behind the
+    consistent-hash router), banked as a TRAJECTORY per soak record:
+
+      * a saturation blast A/B at equal offered load — the 4-driver
+        fleet vs ONE driver, gated fleet >= 2x single (the scale-out
+        must stay real; the idle-box acceptance measured higher, and
+        the banked ratio is the drift monitor);
+      * a paced open-loop point banking achieved dps + p50/p99 decision
+        latency at ~80% of the measured single-driver capacity (ROADMAP
+        item 2's knee-curve trajectory: p99-at-80%-load per PR);
+      * the PR-10 accounting invariant END-TO-END through the router:
+        shed_frames == nacks_sent + nacks_suppressed summed over every
+        shard process, fleet client traffic included.
+
+    The workload is the capacity-bound regime the fleet exists for
+    (PERF_MODEL.md "sharded serving fabric"): LastVotingBytes @ 1 KiB,
+    deadline-paced rounds, standard lanes=16 — a single driver is
+    CONCURRENCY-starved (its lane pool caps how many deadline waits
+    overlap) while the fleet holds drivers x lanes in flight.  The
+    all-fast-round otr blast is deliberately NOT the gate workload: a
+    2-vCPU box pins both of its arms at the core ceiling (~1.1x,
+    measured) and would gate nothing but the box size.
+
+    ~2-3 min per iteration (three fleets incl. subprocess startup)."""
+    from round_tpu.apps.fleet import run_fleet_bench
+
+    seed = int(rng.integers(0, 2**31))
+    kw = dict(n=3, lanes=16, algo="lvb", payload_bytes=1024,
+              timeout_ms=150, seed=seed, warmup=8,
+              deadline_s=300.0, idle_ms=2500)
+    # saturation blast: all arrivals at t~0, achieved dps = capacity
+    single = run_fleet_bench(drivers=1, rate=1e9, instances=512, **kw)
+    fleet = run_fleet_bench(drivers=4, rate=1e9, instances=512, **kw)
+    dps_1 = single["open_loop"]["achieved_dps"]
+    dps_4 = fleet["open_loop"]["achieved_dps"]
+    ratio = round(dps_4 / max(dps_1, 1e-9), 3)
+    # the knee-trajectory point: 80% of measured single-driver capacity,
+    # offered open-loop to the 4-driver fleet (well inside its knee, so
+    # p99 here is a latency trajectory, not a collapse detector)
+    rate80 = max(10.0, 0.8 * dps_1)
+    paced = run_fleet_bench(drivers=4, rate=rate80, instances=150, **kw)
+    pol = paced["open_loop"]
+    cfg = dict(kind="host-fleet", it=it, seed=seed, ratio=ratio,
+               dps_single=dps_1, dps_fleet=dps_4,
+               rate80=round(rate80, 1),
+               p50_ms_at_80pct=pol["p50_ms"],
+               p99_ms_at_80pct=pol["p99_ms"],
+               achieved_dps_at_80pct=pol["achieved_dps"],
+               decided_at_80pct=pol["decided"],
+               give_ups=(single["open_loop"]["give_ups"]
+                         + fleet["open_loop"]["give_ups"]
+                         + pol["give_ups"]),
+               nack_retries=pol["nack_retries"],
+               shed_frames=sum(r["shed_frames"]
+                               for r in (single, fleet, paced)),
+               nacks_accounted=sum(r["nacks_accounted"]
+                                   for r in (single, fleet, paced)),
+               servers_fleet=fleet["servers"])
+    for name, rep in (("single", single), ("fleet", fleet),
+                      ("paced", paced)):
+        if not rep["shed_accounting_ok"]:
+            return {**cfg, "fail": f"shed accounting broken through the "
+                                   f"router in the {name} arm: "
+                                   f"shed_frames != nacks_sent + "
+                                   f"suppressed across the shards"}
+    if cfg["give_ups"] > 0:
+        return {**cfg, "fail": f"router gave up on {cfg['give_ups']} "
+                               f"instance(s): retries exhausted means "
+                               f"lost client work, not noise"}
+    if pol["decided"] < 0.95 * 150:
+        return {**cfg, "fail": f"fleet fell behind at 80% of single-"
+                               f"driver load: {pol['decided']}/150 "
+                               f"decided"}
+    if ratio < 2.0:
+        return {**cfg, "fail": f"fleet scale-out regressed: 4-driver/"
+                               f"single {ratio} < 2.0x at equal "
+                               f"offered load"}
+    return cfg
+
+
 #: the verify-param rung's suite subset: the two parameterized
 #: threshold-automaton suites plus enough fixed-spec suites that the
 #: federated --jobs dispatch has real work to overlap on 2 vCPUs
@@ -964,7 +1047,8 @@ def main():
                 check_otr_flagship_shape, check_host_chaos, check_lint,
                 check_host_perf, check_host_lanes, check_host_pump,
                 lambda r, i: check_host_perf(r, i, payload=True),
-                check_fuzz, check_verify_param, check_host_overload]
+                check_fuzz, check_verify_param, check_host_overload,
+                check_host_fleet]
     while time.monotonic() < t_end:
         check = rotation[it % len(rotation)]
         t0 = time.perf_counter()
