@@ -371,11 +371,12 @@ def _run_probe(args):
             "wall_s": round(time.perf_counter() - t0, 1),
         })
         if ok:
-            if len(trajectory) > 1:
-                # a retry RESOLVED it: the artifact must say so — a
-                # flaky tunnel that heals on retry is a different
-                # diagnosis from a healthy one
-                info["probe_attempts"] = trajectory
+            # ALWAYS bank the attempt trajectory — a first-try pass
+            # (stage + wall) is as much a diagnosis as a retry-resolved
+            # flake or a hang: the r03-r05 `backend-unavailable` lines
+            # went stale precisely because a passing probe left no
+            # stage-attributed record to compare against
+            info["probe_attempts"] = trajectory
             return True, info
         if attempt + 1 < attempts:
             sys.stderr.write(

@@ -62,6 +62,12 @@ def serve_main(args) -> int:
         sys.setswitchinterval(args.switch_interval_ms / 1000.0)
     algo, payload_bytes = _select_algo(args.algo, args.payload_bytes)
     ports = [int(p) for p in args.ports.split(",")]
+    rv = None
+    if args.rv:
+        from round_tpu.rv.dump import RvConfig
+
+        rv = RvConfig(policy=args.rv, protocol=args.algo,
+                      dump_dir=args.rv_dir or "rv_dumps")
     # fixed ports: the bench parent announced them to the router
     srv = DriverServer(
         algo, n=len(ports), lanes=args.lanes,
@@ -71,14 +77,24 @@ def serve_main(args) -> int:
         use_pump=not args.no_pump,
         admission_bytes_per_lane=args.admission_bytes_per_lane,
         shed_deadline_ms=args.shed_deadline_ms,
-        adaptive_cap_ms=args.adaptive_cap_ms, ports=ports)
+        adaptive_cap_ms=args.adaptive_cap_ms, ports=ports, rv=rv)
     srv.start()
+    rc = 0
     try:
-        srv.join(timeout_s=args.max_ms / 1000.0 + 30.0)
+        try:
+            srv.join(timeout_s=args.max_ms / 1000.0 + 30.0)
+        except RuntimeError:
+            # an rv-halted replica surfaces through rv_summary below;
+            # anything else keeps the loud failure
+            if not (rv is not None and srv.errors and all(
+                    type(e).__name__ == "RvViolation"
+                    for e in srv.errors.values())):
+                raise
+            rc = 3
     finally:
         served = sorted(set().union(*[set(r) for r in srv.results]))
         agg = _aggregate_server_stats(srv.stats)
-        print(json.dumps({
+        summary = {
             "shard": args.shard,
             "n": srv.n,
             "lanes": args.lanes,
@@ -90,8 +106,11 @@ def serve_main(args) -> int:
                 1 for i in served
                 if any(r.get(i) is not None for r in srv.results)),
             **agg,
-        }))
-    return 0
+        }
+        if rv is not None:
+            summary["rv"] = srv.rv_summary()
+        print(json.dumps(summary))
+    return rc
 
 
 def _spawn_fleet(drivers: int, n: int, lanes: int, algo: str,
@@ -314,6 +333,13 @@ def main(argv=None) -> int:
                          "here (the deployed serving posture)")
     sv.add_argument("--no-pump", action="store_true")
     sv.add_argument("--switch-interval-ms", type=float, default=0.5)
+    sv.add_argument("--rv", choices=["halt", "shed", "log"], default=None,
+                    help="runtime verification for this shard's drivers "
+                         "(round_tpu/rv, docs/RUNTIME_VERIFICATION.md); "
+                         "a 'halt' violation stops the shard (exit 3) "
+                         "with clients failed fast via FLAG_TOO_LATE")
+    sv.add_argument("--rv-dir", type=str, default=None, metavar="DIR",
+                    help="violation dump directory (default rv_dumps/)")
 
     bn = sub.add_parser("bench", help="spawn a fleet + open-loop loadgen")
     bn.add_argument("--drivers", type=int, default=4)

@@ -40,7 +40,8 @@ from round_tpu.runtime.transport import HostTransport  # noqa: E402
 
 def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
              errors=None, proto="tcp", stats=None, algo=None, rate=1,
-             adaptive_cap_ms=0, wire="binary", lanes=0, pump=True):
+             adaptive_cap_ms=0, wire="binary", lanes=0, pump=True,
+             rv=None):
     tr = HostTransport(my_id, peers[my_id][1], proto=proto)
     # ONE algorithm object across instances: the jitted round functions
     # cache on its rounds, so instance 2+ skip compilation entirely.
@@ -67,7 +68,7 @@ def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
             results[my_id] = run_instance_loop_lanes(
                 algo, my_id, peers, tr, instances, lanes=lanes,
                 timeout_ms=timeout_ms, seed=seed, stats_out=node_stats,
-                adaptive=adaptive, wire=wire, use_pump=pump,
+                adaptive=adaptive, wire=wire, use_pump=pump, rv=rv,
             )
         elif rate > 1:
             # the in-flight window (PerfTest2 -rt): `rate` concurrent
@@ -81,7 +82,7 @@ def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
             results[my_id] = run_instance_loop(
                 algo, my_id, peers, tr, instances, timeout_ms=timeout_ms,
                 seed=seed, stats_out=node_stats, adaptive=adaptive,
-                wire=wire, pump=pump,
+                wire=wire, pump=pump, rv=rv,
             )
         if stats is not None:
             stats[my_id] = node_stats
@@ -138,7 +139,8 @@ def _algo_opts(payload_bytes):
 
 def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
             proto="tcp", rate=1, adaptive_cap_ms=0, wire="binary",
-            lanes=0, payload_bytes=0, pump=True):
+            lanes=0, payload_bytes=0, pump=True, rv=None,
+            algo_obj=None):
     """Run `instances` consecutive consensus instances over `n` replicas
     (threads, each with its own transport+sockets — on a single-vCPU box
     the GIL interleaving beats process-per-replica; see measure_processes
@@ -159,13 +161,17 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
     results: dict = {}
     errors: dict = {}
     stats: dict = {}
-    shared_algo = select(algo, _algo_opts(payload_bytes))
+    # ``algo_obj`` lets a repeated-measurement harness (measure_rv_ab)
+    # share ONE Algorithm across runs so the cached jits amortize and
+    # the pairs measure the HOT PATH, not per-run recompiles
+    shared_algo = algo_obj if algo_obj is not None \
+        else select(algo, _algo_opts(payload_bytes))
     threads = [
         threading.Thread(
             target=run_node,
             args=(i, peers, algo, instances, timeout_ms, results, seed,
                   errors, proto, stats, shared_algo, rate, adaptive_cap_ms,
-                  wire, lanes, pump),
+                  wire, lanes, pump, rv),
         )
         for i in range(n)
     ]
@@ -493,6 +499,73 @@ def measure_lanes_ab(n=4, instances=64, algo="otr", timeout_ms=300,
             "payload_bytes": payload_bytes,
             "mode": ("process-per-replica" if processes
                      else "thread-per-replica"),
+        },
+    }
+
+
+def measure_rv_ab(n=4, instances=64, algo="otr", timeout_ms=300,
+                  proto="tcp", lanes=16, pairs=3, warmup=1, seed=0,
+                  payload_bytes=0):
+    """The monitor-overhead A/B (round_tpu/rv acceptance): arm A is the
+    lane driver with monitors OFF, arm B the SAME driver with the rv
+    monitor term fused into its update mega-step (policy 'log', no
+    dumps).  Interleaved pairs; the gate is overhead <= 5% dps AND
+    byte-identical decision logs AND zero violations on the clean run —
+    the ``host-rv`` soak rung banks this per rotation.
+
+    The algorithm must CARRY monitors (a Spec naming the decision-plane
+    properties — rv/compile.py's spec-is-the-contract rule): lvb sets
+    spec=None, so the deadline-paced gate workload is plain ``lv``
+    (4-round coordinator phases), not the byte variant."""
+    from round_tpu.apps.perf_ab import interleaved_ab
+    from round_tpu.rv.dump import RvConfig
+
+    logs = {"off": None, "on": None}
+    violations = {"count": 0, "checks": 0}
+    shared = select(algo, {"payload_bytes": payload_bytes}
+                    if payload_bytes else {})
+
+    def arm(monitors_on):
+        def run():
+            rv = RvConfig(policy="log") if monitors_on else None
+            res, res_logs = measure(
+                n=n, instances=instances, algo=algo,
+                timeout_ms=timeout_ms, proto=proto, lanes=lanes,
+                payload_bytes=payload_bytes, seed=seed, rv=rv,
+                algo_obj=shared)
+            logs["on" if monitors_on else "off"] = res_logs
+            if monitors_on:
+                for st in res["extra"]["node_stats"].values():
+                    violations["count"] += len(
+                        st.get("rv_violations", []))
+                    violations["checks"] += st.get("rv_checks", 0)
+            return res["value"]
+        return run
+
+    ab = interleaved_ab(arm(False), arm(True), pairs=pairs,
+                        warmup=warmup)
+    return {
+        "metric": f"host_{algo}_n{n}_rv_overhead",
+        "value": ab["ratio"],
+        "unit": "x (monitors-on/monitors-off decisions-per-sec)",
+        "extra": {
+            "dps_off": ab["mean_a"],
+            "dps_on": ab["mean_b"],
+            "median_off": ab["median_a"],
+            "median_on": ab["median_b"],
+            "samples_off": ab["a"],
+            "samples_on": ab["b"],
+            "pairs": pairs,
+            "warmup": warmup,
+            "instances": instances,
+            "lanes": lanes,
+            "n": n,
+            "rv_checks": violations["checks"],
+            "rv_violations": violations["count"],
+            # byte-identity of the LAST pair's decision logs (same
+            # seeds both arms — the fused monitor must be a pure
+            # observer)
+            "logs_identical": logs["off"] == logs["on"],
         },
     }
 

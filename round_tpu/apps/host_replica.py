@@ -247,6 +247,46 @@ def main(argv=None) -> int:
                     metavar="MS",
                     help="initial quarantine probe backoff (doubles per "
                          "requarantine, capped at 60 s; default 1000)")
+    ap.add_argument("--rv", choices=["halt", "shed", "log"], default=None,
+                    help="runtime verification (round_tpu/rv, docs/"
+                         "RUNTIME_VERIFICATION.md): fuse the protocol's "
+                         "monitors into serving — 'halt' stops the "
+                         "replica on a violation (exit 3, artifact path "
+                         "in the summary), 'shed' retires the violating "
+                         "instance undecided, 'log' records and keeps "
+                         "serving")
+    ap.add_argument("--rv-dir", type=str, default=None, metavar="DIR",
+                    help="violation dump directory (default: "
+                         "rv_dumps/ beside the cwd); artifacts are "
+                         "fuzz/replay.py schedule JSON, replayable via "
+                         "fuzz_cli replay")
+    ap.add_argument("--rv-gossip", dest="rv_gossip",
+                    action="store_true", default=False,
+                    help="broadcast FLAG_DECISION on every local decide "
+                         "so decided replicas cross-check each other's "
+                         "values (adversarial posture; costs an n² "
+                         "decision fan-out — by default the agreement "
+                         "monitor taps only the decision-reply/catch-up "
+                         "traffic that already flows)")
+    ap.add_argument("--view-license", action="store_true",
+                    help="proof-licensed reconfiguration (rv/license.py "
+                         "+ docs/MEMBERSHIP.md): membership ops are "
+                         "proposed only when the parameterized-proof "
+                         "registry licenses the target group size — "
+                         "refused otherwise")
+    ap.add_argument("--view-unlicensed-ok", action="store_true",
+                    help="escape hatch: an unlicensed membership op "
+                         "proceeds anyway, with this replica flagged "
+                         "DEGRADED (obs event + summary JSON)")
+    ap.add_argument("--license-cache", type=str, default=None,
+                    metavar="DIR",
+                    help="VC-hash proof cache directory (verifier_cli "
+                         "--cache): a nightly proof run makes every "
+                         "license check a warm hit")
+    ap.add_argument("--no-license-solve", dest="license_solve",
+                    action="store_false", default=True,
+                    help="never run the solver from the license gate — "
+                         "cache hits only (a cold cache then refuses)")
     ap.add_argument("--linger-ms", type=int, default=0, metavar="MS",
                     help="after the loop completes, keep answering peers' "
                          "traffic with decision replies until the wire is "
@@ -443,7 +483,17 @@ def main(argv=None) -> int:
 
             group = Group([Replica(i, h, p)
                            for i, (h, p) in sorted(peers.items())])
-            manager = ViewManager(args.id, View(args.view_epoch, group), tr)
+            license = None
+            if args.view_license:
+                from round_tpu.rv.license import ProofLicenseRegistry
+
+                license = ProofLicenseRegistry(
+                    cache_dir=args.license_cache,
+                    solve=args.license_solve)
+            manager = ViewManager(
+                args.id, View(args.view_epoch, group), tr,
+                license=license, license_model=args.algo,
+                unlicensed_ok=args.view_unlicensed_ok)
             if health is not None:
                 # quarantine composes with membership changes: per-peer
                 # scores remap through the renames, the (n-1)//3 envelope
@@ -488,7 +538,32 @@ def main(argv=None) -> int:
                 print(f"warning: --join-wait saw no epoch-"
                       f"{args.view_epoch} traffic in {args.join_wait_ms} "
                       f"ms; joining anyway", file=sys.stderr)
+        rv_cfg = None
+        if args.rv:
+            from round_tpu.rv.dump import RvConfig
+
+            if args.lanes <= 1 and args.rate > 1:
+                # --lanes wins the loop dispatch below, so rv only
+                # loses when the PIPELINED mux actually runs (the
+                # admission gate's own guard pattern)
+                print("warning: --rv applies to the sequential and lane "
+                      "loops only (ignored with --rate > 1)",
+                      file=sys.stderr)
+            else:
+                rv_cfg = RvConfig(
+                    policy=args.rv, protocol=args.algo,
+                    dump_dir=args.rv_dir or "rv_dumps",
+                    schedule_path=args.chaos_schedule,
+                    gossip=args.rv_gossip)
         if args.instances <= 1:
+            if rv_cfg is not None:
+                # single-instance proposals are per-CLI --value flags:
+                # the validity witness set (every replica's proposal) is
+                # not derivable here, unlike the loops' shared
+                # deterministic schedule
+                print("warning: --rv applies to the --instances loops "
+                      "(ignored for a single-instance run)",
+                      file=sys.stderr)
             if args.checkpoint_dir:
                 print("warning: --checkpoint-dir applies to the "
                       "sequential --instances loop only (ignored for a "
@@ -546,6 +621,7 @@ def main(argv=None) -> int:
                   "(instances are numbered 1..N)", file=sys.stderr)
         t0 = time.perf_counter()
         stats: dict = {}
+        halt = None
         if args.lanes > 1:
             from round_tpu.runtime.lanes import run_instance_loop_lanes
 
@@ -558,17 +634,25 @@ def main(argv=None) -> int:
                 print("warning: --no-send-when-catching-up / "
                       "--delay-first-send apply to the sequential loop "
                       "only (ignored with --lanes)", file=sys.stderr)
-            decisions = run_instance_loop_lanes(
-                algo, args.id, peers, tr, args.instances,
-                lanes=args.lanes, timeout_ms=args.timeout_ms,
-                seed=args.seed, base_value=args.value,
-                max_rounds=args.max_rounds,
-                nbr_byzantine=args.nbr_byzantine,
-                value_schedule=args.value_schedule,
-                adaptive=adaptive, stats_out=stats,
-                checkpoint_dir=args.checkpoint_dir, wire=args.wire,
-                use_pump=args.pump, admission=admission, health=health,
-            )
+            try:
+                decisions = run_instance_loop_lanes(
+                    algo, args.id, peers, tr, args.instances,
+                    lanes=args.lanes, timeout_ms=args.timeout_ms,
+                    seed=args.seed, base_value=args.value,
+                    max_rounds=args.max_rounds,
+                    nbr_byzantine=args.nbr_byzantine,
+                    value_schedule=args.value_schedule,
+                    adaptive=adaptive, stats_out=stats,
+                    checkpoint_dir=args.checkpoint_dir, wire=args.wire,
+                    use_pump=args.pump, admission=admission,
+                    health=health, rv=rv_cfg,
+                )
+            except Exception as e:
+                from round_tpu.rv.dump import RvViolation
+
+                if not isinstance(e, RvViolation):
+                    raise
+                halt, decisions = e, [None] * args.instances
         elif args.rate > 1:
             if (not args.send_when_catching_up
                     or args.delay_first_send_ms > 0):
@@ -589,19 +673,27 @@ def main(argv=None) -> int:
                 pump=args.pump,
             )
         else:
-            decisions = run_instance_loop(
-                algo, args.id, peers, tr, args.instances,
-                timeout_ms=args.timeout_ms, seed=args.seed,
-                base_value=args.value, max_rounds=args.max_rounds,
-                send_when_catching_up=args.send_when_catching_up,
-                delay_first_send_ms=args.delay_first_send_ms,
-                nbr_byzantine=args.nbr_byzantine,
-                value_schedule=args.value_schedule,
-                adaptive=adaptive, stats_out=stats,
-                checkpoint_dir=args.checkpoint_dir,
-                view=manager, view_schedule=view_schedule,
-                wire=args.wire, pump=args.pump, health=health,
-            )
+            try:
+                decisions = run_instance_loop(
+                    algo, args.id, peers, tr, args.instances,
+                    timeout_ms=args.timeout_ms, seed=args.seed,
+                    base_value=args.value, max_rounds=args.max_rounds,
+                    send_when_catching_up=args.send_when_catching_up,
+                    delay_first_send_ms=args.delay_first_send_ms,
+                    nbr_byzantine=args.nbr_byzantine,
+                    value_schedule=args.value_schedule,
+                    adaptive=adaptive, stats_out=stats,
+                    checkpoint_dir=args.checkpoint_dir,
+                    view=manager, view_schedule=view_schedule,
+                    wire=args.wire, pump=args.pump, health=health,
+                    rv=rv_cfg,
+                )
+            except Exception as e:
+                from round_tpu.rv.dump import RvViolation
+
+                if not isinstance(e, RvViolation):
+                    raise
+                halt, decisions = e, [None] * args.instances
         wall = time.perf_counter() - t0
         dump_decision_log(decisions)
         if args.linger_ms > 0 and not (manager is not None
@@ -636,6 +728,18 @@ def main(argv=None) -> int:
         if health is not None:
             summary["quarantine"] = stats.get(
                 "quarantine", health.summary())
+        if rv_cfg is not None:
+            summary["rv"] = {
+                "policy": rv_cfg.policy,
+                "checks": stats.get("rv_checks", 0),
+                "violations": stats.get("rv_violations", []),
+                "artifacts": stats.get("rv_artifacts", []),
+            }
+            if halt is not None:
+                summary["rv"]["halted"] = str(halt)
+                if halt.artifact:
+                    summary["rv"]["artifacts"] = list(set(
+                        summary["rv"]["artifacts"] + [halt.artifact]))
         if manager is not None:
             # the view trajectory: final epoch/n/id, the applied op
             # history, and a clean `removed` marker — the harness's
@@ -650,7 +754,16 @@ def main(argv=None) -> int:
                 "removed": manager.removed,
                 "reconnects": raw_tr.reconnects,
             })
+            if manager.license is not None:
+                # the licensing verdict surface (docs/MEMBERSHIP.md):
+                # refused ops with their License records, and whether
+                # this replica is serving DEGRADED (an unlicensed move
+                # proceeded — escape hatch or adopted from peers)
+                summary["view_refused"] = manager.refusals
+                summary["view_degraded"] = manager.degraded
         print(json.dumps(summary))
+        if halt is not None:
+            return 3
     return 0
 
 

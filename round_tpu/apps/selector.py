@@ -60,6 +60,15 @@ def select(name: str, options: Optional[Dict[str, Any]] = None) -> Algorithm:
         from round_tpu.models.tpc import TwoPhaseCommit
 
         return TwoPhaseCommit()
+    if name.startswith("rv-broken-"):
+        # runtime-verification TEST FIXTURES (round_tpu/rv/fixtures.py):
+        # deliberately broken rounds whose violation dumps must be
+        # replayable through the standard fuzz_cli surfaces — never a
+        # deployment protocol
+        from round_tpu.rv.fixtures import FIXTURES, select_fixture
+
+        if name in FIXTURES:
+            return select_fixture(name)
     raise ValueError(
         f"unknown algorithm {name!r} "
         "(expected otr|lv|lvb|lve|slv|mlv|benor|floodmin|kset|tpc)"

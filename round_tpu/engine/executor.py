@@ -373,12 +373,22 @@ class LaneStep:
     The vals/mask mailbox arguments are the ``[L, n, ...]`` batched form of
     the host runner's in-place ``[n, ...]`` mailbox (runtime/lanes.py
     assembles them from the same FLAG_BATCH wire drains).
+
+    RUNTIME VERIFICATION (round_tpu/rv): with a ``monitor``
+    (rv/compile.py MonitorProgram), the update mega-step additionally
+    evaluates the per-lane monitor term FUSED into the same jitted
+    dispatch — verdicts are one extra output alongside the updated
+    state, never a second dispatch (the wire-speed contract the
+    ``lanes.update_dispatches`` pin in tests/test_rv.py gates).  The
+    update math itself is UNCHANGED: the monitor reads the post-update
+    state, so decision logs are byte-identical monitors-on vs off.
     """
 
-    __slots__ = ("rnd", "n", "lanes", "send", "update", "go")
+    __slots__ = ("rnd", "n", "lanes", "monitor", "send", "update", "go")
 
-    def __init__(self, rnd, n: int, lanes: int):
+    def __init__(self, rnd, n: int, lanes: int, monitor=None):
         self.rnd, self.n, self.lanes = rnd, n, lanes
+        self.monitor = monitor
         f_send, f_update, f_go = make_host_round_fns(rnd, n)
         in_lane = (0, None, 0, 0)  # rr, sid (shared: ONE replica), seed, st
 
@@ -396,7 +406,27 @@ class LaneStep:
             return st2, jnp.logical_and(ex, active)
 
         self.send = jax.jit(send_masked)
-        self.update = jax.jit(update_masked)
+        if monitor is None:
+            self.update = jax.jit(update_masked)
+        else:
+            check = monitor.check_lane
+
+            def update_monitored(rr, sid, seeds, state, vals, mask,
+                                 active, prev_dec, prev_val, ext_dec,
+                                 ext_val, init_vals):
+                st2, ex = update_masked(rr, sid, seeds, state, vals,
+                                        mask, active)
+                ok, dec, val = jax.vmap(check)(
+                    st2, prev_dec, prev_val, ext_dec, ext_val, init_vals)
+                # inactive lanes hold stale retired state: vacuously OK,
+                # and their carried monitor state is frozen
+                ok = jnp.logical_or(ok, jnp.logical_not(active)[:, None])
+                new_prev_dec = jnp.where(active, dec, prev_dec)
+                act = active.reshape((-1,) + (1,) * (prev_val.ndim - 1))
+                new_prev_val = jnp.where(act, val, prev_val)
+                return st2, ex, ok, new_prev_dec, new_prev_val
+
+            self.update = jax.jit(update_monitored)
         self.go = None
         if f_go is not None:
             def go_all(rr, sid, seeds, state, vals, mask):  # noqa: E306
@@ -406,16 +436,21 @@ class LaneStep:
             self.go = jax.jit(go_all)
 
 
-def lane_step(rnd, n: int, lanes: int, sid, seeds, state) -> LaneStep:
+def lane_step(rnd, n: int, lanes: int, sid, seeds, state,
+              monitor=None) -> LaneStep:
     """Cached LaneStep for ``rnd`` at (n, lanes), trace+compiled NOW under
     the module build lock on the given exemplar args (results discarded) —
     the warm-up discipline of HostRunner._build_round_fns: returning
     un-traced wrappers would let thread-mode replicas sharing the Round
     object race into duplicate compiles.  ``state`` is the live batched
     ``[L, ...]`` pytree (numpy leaves), ``seeds`` the per-lane uint32
-    vector, ``sid`` this replica's int32 id."""
+    vector, ``sid`` this replica's int32 id.  A ``monitor``
+    (rv/compile.py MonitorProgram) fuses the rv verdict term into the
+    update jit; monitored and unmonitored steps cache separately, and
+    thread-mode replicas monitoring the same algorithm share the
+    monitored compile (the term is a pure function of the algorithm)."""
     cache = getattr(rnd, "_lane_jit", None)
-    key = (n, lanes)
+    key = (n, lanes, monitor is not None)
     if cache is not None and key in cache:
         return cache[key]
     with _LANE_BUILD_LOCK:
@@ -424,7 +459,7 @@ def lane_step(rnd, n: int, lanes: int, sid, seeds, state) -> LaneStep:
             cache = rnd._lane_jit = {}
         if key in cache:
             return cache[key]
-        step = LaneStep(rnd, n, lanes)
+        step = LaneStep(rnd, n, lanes, monitor=monitor)
         rr0 = np.zeros((lanes,), dtype=np.int32)
         act0 = np.zeros((lanes,), dtype=bool)
         st0, payload0, _dest = step.send(rr0, sid, seeds, state, act0)
@@ -436,7 +471,11 @@ def lane_step(rnd, n: int, lanes: int, sid, seeds, state) -> LaneStep:
                                dtype=np.asarray(a).dtype), payload0)
         mask0 = np.zeros((lanes, n), dtype=bool)
         st0 = jax.tree_util.tree_map(np.asarray, st0)
-        step.update(rr0, sid, seeds, st0, vals0, mask0, act0)
+        if monitor is None:
+            step.update(rr0, sid, seeds, st0, vals0, mask0, act0)
+        else:
+            step.update(rr0, sid, seeds, st0, vals0, mask0, act0,
+                        *monitor.zeros(lanes))
         if step.go is not None:
             step.go(rr0, sid, seeds, st0, vals0, mask0)
         jax.block_until_ready(jax.tree_util.tree_leaves(st0))

@@ -223,6 +223,11 @@ class FleetRouter:
         self.dup_decisions = 0
         self.migrations = 0
         self.reproposals = 0
+        # per-shard health counters (docs/SERVING.md "shard rv status"):
+        # an rv-halted shard drains as a TOO_LATE burst + undecided
+        # resolutions, which is how the router — which never sees the
+        # shard's process — observes runtime-verification trouble
+        self.shard_health: Dict[str, Dict[str, int]] = {}
 
     # -- shard membership --------------------------------------------------
 
@@ -420,6 +425,9 @@ class FleetRouter:
             if TRACE.enabled:
                 TRACE.emit("fleet_nack", node=None, inst=inst,
                            shard=shard, src=sender)
+            self.shard_health.setdefault(
+                shard, {"too_late": 0, "nacks": 0, "undecided": 0}
+            )["nacks"] += 1
             if f.next_retry > 0:
                 return  # already backing off; one NACK per window counts
             if f.retries >= self.give_up:
@@ -437,6 +445,9 @@ class FleetRouter:
             # past recovery): keep asking — a sibling replica may still
             # decide — and record the undecided outcome honestly only
             # once EVERY replica of the current shard has said so
+            h = self.shard_health.setdefault(
+                shard, {"too_late": 0, "nacks": 0, "undecided": 0})
+            h["too_late"] += 1
             f = self._inflight.get(inst)
             if f is None:
                 return
@@ -446,6 +457,7 @@ class FleetRouter:
                    if s == f.shard) >= n_shard:
                 self._resolve(inst, None, None)
                 _C_UNDECIDED.inc()
+                h["undecided"] += 1
             return
 
     def _give_up(self, f: _InFlight, why: str) -> None:
@@ -510,6 +522,27 @@ class FleetRouter:
         self._flush()
         return handled
 
+    def status(self) -> Dict[str, Any]:
+        """The router's shard-status surface (docs/SERVING.md "shard rv
+        status"): per-shard health counters beside the fleet totals.  A
+        shard whose driver rv-halted shows as a too_late burst with
+        undecided resolutions — the router's view of a runtime-
+        verification stop it cannot observe directly."""
+        return {
+            "shards": {name: dict(self.shard_health.get(
+                name, {"too_late": 0, "nacks": 0, "undecided": 0}))
+                for name in self.ring.shards},
+            "inflight": len(self._inflight),
+            "decided": sum(1 for v in self.results.values()
+                           if v is not None),
+            "undecided": sum(1 for v in self.results.values()
+                             if v is None),
+            "give_ups": self.give_ups,
+            "nack_retries": self.nack_retries,
+            "reproposals": self.reproposals,
+            "migrations": self.migrations,
+        }
+
     def raise_if_gave_up(self) -> None:
         """Surface give-ups as the client-visible error (docs/SERVING.md
         NACK-retry contract): silent loss is never an outcome."""
@@ -567,7 +600,8 @@ class DriverServer:
                  admission_bytes_per_lane: int = 0,
                  shed_deadline_ms: int = 250,
                  adaptive_cap_ms: int = 0,
-                 ports: Optional[List[int]] = None):
+                 ports: Optional[List[int]] = None,
+                 rv=None):
         from round_tpu.runtime.chaos import alloc_ports
         from round_tpu.runtime.transport import HostTransport
 
@@ -583,6 +617,10 @@ class DriverServer:
         self.admission_bytes_per_lane = admission_bytes_per_lane
         self.shed_deadline_ms = shed_deadline_ms
         self.adaptive_cap_ms = adaptive_cap_ms
+        # runtime verification (round_tpu/rv): the rv.dump.RvConfig the
+        # shard's LaneDrivers serve under; a 'halt' violation surfaces
+        # through errors/join() and the router's too_late drain
+        self.rv = rv
         if ports is None:
             ports = alloc_ports(n)
         elif len(ports) != n:
@@ -623,7 +661,7 @@ class DriverServer:
                 seed=self.seed, max_rounds=self.max_rounds,
                 value_schedule="uniform", use_pump=self.use_pump,
                 admission=admission, adaptive=adaptive,
-                clients={self.n},
+                clients={self.n}, rv=self.rv,
             )
             self.results[i] = driver.serve(
                 idle_ms=self.idle_ms, max_ms=self.max_ms,
@@ -631,6 +669,22 @@ class DriverServer:
         except Exception as e:  # noqa: BLE001 — surfaced by join()
             self.errors[i] = e
             raise
+
+    def rv_summary(self) -> Dict[str, Any]:
+        """Aggregate rv status across this shard's replicas (the
+        apps/fleet.py serve/bench output surface)."""
+        viols = [v for st in self.stats
+                 for v in st.get("rv_violations", [])]
+        return {
+            "enabled": self.rv is not None,
+            "checks": sum(st.get("rv_checks", 0) for st in self.stats),
+            "violations": viols,
+            "artifacts": sorted({a for st in self.stats
+                                 for a in st.get("rv_artifacts", [])}),
+            "halted": sorted(
+                i for i, e in self.errors.items()
+                if type(e).__name__ == "RvViolation"),
+        }
 
     def start(self) -> List[Tuple[str, int]]:
         for i in range(self.n):

@@ -688,6 +688,7 @@ def run_instance_loop(
     wire: str = "binary",
     pump: bool = True,
     health=None,
+    rv=None,
 ) -> List[Optional[int]]:
     """The PerfTest2 loop (PerfTest2.scala:19-110): `instances` consecutive
     consensus instances over one transport, with start-skew stashing —
@@ -796,6 +797,22 @@ def run_instance_loop(
             and _os.environ.get("ROUND_TPU_PUMP", "1") != "0"):
         pump_state = _make_runner_pump(transport, algo, my_id,
                                        len(peers), nbr_byzantine)
+    # runtime-verification setup (round_tpu/rv): one RvRuntime + monitor
+    # program for the whole loop, one HostRv per instance inside the body
+    rv_state = None
+    if rv is not None:
+        from round_tpu.rv.compile import monitor_program
+        from round_tpu.rv.dump import RvRuntime
+
+        program = monitor_program(algo, len(peers))
+        if program is None:
+            log.warning("node %d: rv requested but %s has no decision "
+                        "plane to monitor; rv disabled", my_id,
+                        type(algo).__name__)
+        else:
+            rv_state = (RvRuntime(rv, node=my_id, n=len(peers),
+                                  seed=seed, max_rounds=max_rounds),
+                        program, rv)
     try:
         return _run_instance_loop_body(
             algo, my_id, peers, transport, instances, timeout_ms, seed,
@@ -803,8 +820,13 @@ def run_instance_loop(
             delay_first_send_ms, nbr_byzantine, value_schedule, adaptive,
             checkpoint_dir, view, view_schedule, wire, pump_state,
             decisions, raw_decisions, replied, enc_cache, stash, current,
-            foreign, start, health)
+            foreign, start, health, rv_state)
     finally:
+        if rv_state is not None:
+            # stats survive an rv-halt (the lane driver's discipline):
+            # the exit-3 summary must carry the violation record, not
+            # just the artifact path on the exception
+            rv_state[0].fill_stats(stats_out)
         if pump_state is not None:
             pump_state.close()
 
@@ -815,7 +837,7 @@ def _run_instance_loop_body(
     delay_first_send_ms, nbr_byzantine, value_schedule, adaptive,
     checkpoint_dir, view, view_schedule, wire, pump_state,
     decisions, raw_decisions, replied, enc_cache, stash, current,
-    foreign, start, health=None,
+    foreign, start, health=None, rv_state=None,
 ) -> List[Optional[int]]:
     # ordered view-change schedule: entry i moves the group from epoch i
     # to i+1, so a replica only PROPOSES an entry its own epoch has not
@@ -830,6 +852,21 @@ def _run_instance_loop_body(
                 if view.removed:
                     break
                 vid, vpeers = view.my_id, view.view.peers()
+            inst_rv = None
+            if rv_state is not None:
+                from round_tpu.rv.compile import (
+                    HostRv, schedule_init_values,
+                )
+
+                rv_runtime, rv_program, rv_cfg = rv_state
+                nn = len(vpeers)
+                inst_rv = HostRv(
+                    rv_runtime, rv_program, inst,
+                    schedule_init_values(algo, nn, value_schedule,
+                                         base_value, inst),
+                    [_schedule_value(value_schedule, base_value, pid,
+                                     inst) for pid in range(nn)],
+                    gossip=rv_cfg.gossip)
             runner = HostRunner(
                 algo, vid, vpeers, transport, instance_id=inst,
                 timeout_ms=timeout_ms, seed=seed + inst,
@@ -847,6 +884,7 @@ def _run_instance_loop_body(
                 wire=wire,
                 pump_state=pump_state,
                 health=health,
+                rv=inst_rv,
             )
             value = _schedule_value(value_schedule, base_value, vid, inst)
             res = runner.run(instance_io(algo, value),
@@ -903,6 +941,8 @@ def _run_instance_loop_body(
             )
             view.stale = False  # any mid-change staleness was resolved
             # by propose/adopt; the next data instance starts fresh
+    # rv stats are banked by run_instance_loop's finally (they must
+    # survive an rv-halt raising out of this body)
     return decisions
 
 
@@ -1332,6 +1372,7 @@ class HostRunner:
         wire: str = "binary",
         pump_state: Optional["_RunnerPumpState"] = None,
         health=None,
+        rv=None,
     ):
         self.algo = algo
         self.id = my_id
@@ -1410,6 +1451,14 @@ class HostRunner:
         # health does not reset between instances.  None = the polite
         # pre-overload world (zero behavior change).
         self._health = health
+        # runtime-verification monitor (round_tpu/rv compile.HostRv, one
+        # per instance): the Python-path equivalent of the lane driver's
+        # fused monitor term — per-round verdicts after every update,
+        # the agreement check at the FLAG_DECISION adoption sites, and
+        # decision gossip on decide.  None = monitors off (zero behavior
+        # change).
+        self._rv = rv
+        self._rv_replied: Dict[Tuple[int, int], float] = {}
         self.malformed = 0
         self.timeouts = 0   # rounds ended by deadline expiry (diagnostics)
         self._trajectory: List[int] = []   # per-round deadline used (ms)
@@ -1673,6 +1722,10 @@ class HostRunner:
                     elif tg.flag == FLAG_DECISION \
                             and tg.instance == self.instance_id:
                         ok, p = self._loads(raw)
+                        if ok and p is not None and self._rv is not None:
+                            # agreement check before adoption (see the
+                            # Python-pump ingest site)
+                            self._rv.on_decision_frame(state, p, r)
                         adopted = (self.algo.adopt_decision(state, p)
                                    if ok else None)
                         if adopted is not None:
@@ -2018,6 +2071,12 @@ class HostRunner:
                             # our late traffic with the value — adopt and exit
                             # instead of burning this round's timeout
                             ok, p = self._loads(raw)
+                            if ok and p is not None \
+                                    and self._rv is not None:
+                                # the agreement term's cold site: check
+                                # BEFORE adoption overwrites the state
+                                # the conflict lives in
+                                self._rv.on_decision_frame(state, p, r)
                             adopted = (self.algo.adopt_decision(state, p)
                                        if ok else None)
                             if adopted is not None:
@@ -2218,6 +2277,21 @@ class HostRunner:
                     rr, sid, seed, state, vals, mask,
                 )
                 exited = bool(np.asarray(exit_flag))
+            if self._rv is not None and not view_int():
+                # runtime verification: the post-update verdict vector
+                # (rv/compile.py HostRv — same labels/order as the lane
+                # driver's fused term).  halt raises out of the runner;
+                # shed is resolved after the loop (forced undecided).
+                self._rv.after_update(state, r)
+                if self._rv.gossip and self._rv.just_decided:
+                    # decision gossip — the agreement monitor's
+                    # observability channel: peers learn this decision
+                    # while their own lanes are still live
+                    for d in range(self.n):
+                        if d != self.id:
+                            _try_send_decision(
+                                self.transport, self._rv_replied, d,
+                                self.instance_id, self._rv.mon.prev_val)
             if self._health is not None:
                 # one completed round wave of quarantine evidence: heard
                 # peers decay/rejoin, unheard peers accrue timeout score
@@ -2247,6 +2321,10 @@ class HostRunner:
         decided = bool(np.asarray(algo.decided(state)))
         if view_int():
             # never report a decision across a view boundary (see above)
+            decided = False
+        if self._rv is not None and self._rv.shed:
+            # rv 'shed' policy: a violating instance is reported
+            # undecided — its decision must not enter the log
             decided = False
         decision = np.asarray(algo.decision(state))
         if decided:

@@ -233,6 +233,7 @@ class LaneDriver:
         admission: Optional[AdmissionControl] = None,
         health=None,
         clients=None,
+        rv=None,
     ):
         if wire not in ("binary", "pickle"):
             raise ValueError(f"wire must be 'binary' or 'pickle', "
@@ -388,6 +389,34 @@ class LaneDriver:
         # serve loop at the next jitted dispatch)
         self._io_proto = (np.asarray(instance_io(algo, 0)["initial_value"])
                           if self._clients else None)
+        # RUNTIME VERIFICATION (round_tpu/rv, docs/RUNTIME_VERIFICATION
+        # .md): ``rv`` is an rv.dump.RvConfig — compile the algorithm's
+        # monitor program and fuse its per-lane verdict term into the
+        # update mega-step (engine/executor.py LaneStep).  The carried
+        # monitor state (prior decided mask + values for irrevocability,
+        # peer-learned decisions for agreement, the instance's initial-
+        # value matrix for validity) threads through the lane axis like
+        # any other per-lane array.  None = monitors off, byte-identical
+        # pre-rv behavior.
+        self._rv = None
+        self._rv_mon = None
+        if rv is not None:
+            from round_tpu.rv.compile import monitor_program
+            from round_tpu.rv.dump import RvRuntime
+
+            self._rv_mon = monitor_program(algo, n)
+            if self._rv_mon is None:
+                log.warning("node %d: rv requested but %s has no "
+                            "decision plane to monitor; rv disabled",
+                            my_id, type(algo).__name__)
+            else:
+                self._rv = RvRuntime(rv, node=my_id, n=n, seed=seed,
+                                     max_rounds=max_rounds)
+                (self._rv_prev_dec, self._rv_prev_val, self._rv_ext_dec,
+                 self._rv_ext_val, self._rv_init) = self._rv_mon.zeros(L)
+                self._rv_client_inst: set = set()
+                self._rv_shed_lanes: set = set()
+                self._rv_init_cache: Dict[int, np.ndarray] = {}
 
     # -- native pump setup -------------------------------------------------
 
@@ -494,6 +523,50 @@ class LaneDriver:
             self._init_cache[key] = got
         return got
 
+    def _rv_reset_lane(self, lane: int, inst: int, client_io) -> None:
+        """Fresh monitor state for one admitted instance: no decision
+        history, no peer decision heard, and the validity witness rows —
+        the deterministic schedule matrix, or the client proposal
+        broadcast to all n (the fleet's uniform-proposal contract)."""
+        from round_tpu.rv.compile import schedule_init_values
+
+        iid = inst & 0xFFFF
+        self._rv_prev_dec[lane] = False
+        self._rv_prev_val[lane] = 0
+        self._rv_ext_dec[lane] = False
+        self._rv_ext_val[lane] = 0
+        self._rv_shed_lanes.discard(lane)
+        if client_io is not None:
+            self._rv_client_inst.add(iid)
+            self._rv_init[lane] = np.asarray(
+                client_io["initial_value"])[None]
+        else:
+            self._rv_client_inst.discard(iid)
+            # the witness matrix is deterministic in (schedule, base,
+            # inst) and the schedule draws from a ~5-value domain —
+            # cache it like _init_leaves caches init states, so hot
+            # admission does not rebuild n io pytrees per instance
+            key = inst % 5 if self.value_schedule in ("mixed", "uniform") \
+                else inst
+            got = self._rv_init_cache.get(key)
+            if got is None:
+                if len(self._rv_init_cache) >= 64:
+                    self._rv_init_cache.clear()
+                got = schedule_init_values(
+                    self.algo, self.n, self.value_schedule,
+                    self.base_value, inst)
+                self._rv_init_cache[key] = got
+            self._rv_init[lane] = got
+
+    def _rv_values(self, inst: int) -> List[int]:
+        """The artifact ``values`` row: per-process scheduled proposals
+        (client-proposed instances have no scalar schedule — the dump
+        records zeros and the meta block carries the observed plane)."""
+        if inst & 0xFFFF in getattr(self, "_rv_client_inst", ()):
+            return [0] * self.n
+        return [_schedule_value(self.value_schedule, self.base_value,
+                                pid, inst) for pid in range(self.n)]
+
     def _admit(self, inst: int, io=None) -> None:
         iid = inst & 0xFFFF
         lane = self.table.admit(iid)
@@ -501,6 +574,9 @@ class LaneDriver:
             value = _schedule_value(self.value_schedule, self.base_value,
                                     self.id, inst)
             io = instance_io(self.algo, value)
+            client_io = None
+        else:
+            client_io = io
         self._write_row(lane, self._init_leaves(io))
         self._inst[lane] = inst
         self._seeds[lane] = np.uint32(self.seed + inst)
@@ -518,6 +594,8 @@ class LaneDriver:
         self._max_rnd[lane, self.id] = 0
         self._next_round[lane] = 0
         self._pending[lane] = {}
+        if self._rv is not None:
+            self._rv_reset_lane(lane, inst, client_io)
         _C_ADMIT.inc()
         _G_OCC.set(self.table.occupancy)
         if TRACE.enabled:
@@ -693,6 +771,11 @@ class LaneDriver:
         iid = tag.instance
         lane = self.table.lane_of(iid)
         if lane is None:
+            if tag.flag == FLAG_DECISION and self._rv is not None:
+                # agreement over the decision bank: a peer's decision
+                # for an instance we completed must match ours
+                self._rv_check_done(iid, raw)
+                return
             if tag.flag != FLAG_NORMAL:
                 return
             if iid in self._done:
@@ -732,6 +815,11 @@ class LaneDriver:
             return
         if tag.flag == FLAG_DECISION:
             ok, p = self._loads(raw, sender)
+            if ok and p is not None and self._rv is not None:
+                # record the peer decision for the fused agreement term
+                # and check the already-decided case NOW (the adoption
+                # below overwrites the lane before the next wave)
+                self._rv_note_ext(lane, p)
             adopted = (self.algo.adopt_decision(self._state_row(lane), p)
                        if ok else None)
             if adopted is not None:
@@ -1021,7 +1109,9 @@ class LaneDriver:
         step = self._steps[c]
         if step is None:
             step = lane_step(self.algo.rounds[c], self.n, self.L,
-                             self._sid, self._seeds, self._state_tree())
+                             self._sid, self._seeds, self._state_tree(),
+                             monitor=self._rv_mon
+                             if self._rv is not None else None)
             self._steps[c] = step
         return step
 
@@ -1197,16 +1287,30 @@ class LaneDriver:
 
     def _update_wave(self, ready: List[int]) -> List[Tuple[int, bool]]:
         """One mega-step update per round class with ready lanes; returns
-        [(lane, exited)]."""
+        [(lane, exited)].  With rv enabled the SAME dispatch also
+        returns the monitor verdicts and the advanced carried monitor
+        state — the fusion contract (no second dispatch, same
+        lanes.update_dispatches count either way)."""
         out: List[Tuple[int, bool]] = []
         for c in sorted({int(self._rr[l]) % self.k for l in ready}):
             group = [l for l in ready if int(self._rr[l]) % self.k == c]
             active = np.zeros((self.L,), dtype=bool)
             active[group] = True
             vals, mask = self._boxes[c].values_mask()
-            st, ex = self._step(c).update(
-                self._rr, self._sid, self._seeds, self._state_tree(),
-                vals, mask, active)
+            if self._rv is None:
+                st, ex = self._step(c).update(
+                    self._rr, self._sid, self._seeds, self._state_tree(),
+                    vals, mask, active)
+            else:
+                old_dec = self._rv_prev_dec.copy()
+                st, ex, ok, ndec, nval = self._step(c).update(
+                    self._rr, self._sid, self._seeds, self._state_tree(),
+                    vals, mask, active, self._rv_prev_dec,
+                    self._rv_prev_val, self._rv_ext_dec,
+                    self._rv_ext_val, self._rv_init)
+                # owning copies: admission/oob paths write rows in place
+                self._rv_prev_dec = np.array(ndec)
+                self._rv_prev_val = np.array(nval)
             self._copy_back(st)
             ex = np.asarray(ex)
             _C_UPD_D.inc()
@@ -1214,7 +1318,115 @@ class LaneDriver:
             _H_IPD.observe(len(group))
             for lane in group:
                 out.append((lane, bool(ex[lane])))
+            if self._rv is not None:
+                self._rv_after_wave(group, np.asarray(ok), old_dec)
         return out
+
+    # -- runtime verification (round_tpu/rv) -------------------------------
+
+    def _rv_after_wave(self, group: List[int], ok: np.ndarray,
+                       old_dec: np.ndarray) -> None:
+        """Consume one fused wave's verdicts: gossip newly-decided lanes
+        (the agreement monitor's observability channel) and act on every
+        tripped monitor per the configured policy."""
+        rv = self._rv
+        rv.note_checks(len(group) * self._rv_mon.n_monitors)
+        if rv.cfg.gossip:
+            for lane in group:
+                if self._rv_prev_dec[lane] and not old_dec[lane]:
+                    iid = int(self._inst[lane]) & 0xFFFF
+                    val = self._rv_prev_val[lane]
+                    for d in range(self.n):
+                        if d != self.id:
+                            _try_send_decision(
+                                self.transport, self._replied, d, iid,
+                                val, enc_cache=self._enc_cache)
+        for lane in group:
+            bad = np.nonzero(~ok[lane])[0]
+            for fidx in bad:
+                self._rv_violate(lane, int(fidx), "mega-step")
+
+    def _rv_violate(self, lane: int, fidx: int, where: str) -> None:
+        inst = int(self._inst[lane])
+        label = self._rv_mon.labels[fidx]
+        observed = {
+            "decided": bool(self._rv_prev_dec[lane]),
+            "decision": decision_scalar(self._rv_prev_val[lane]),
+            "ext_decided": bool(self._rv_ext_dec[lane]),
+            "ext_decision": decision_scalar(self._rv_ext_val[lane]),
+        }
+        # violate() RAISES RvViolation itself under the halt policy
+        action = self._rv.violate(
+            inst=inst, round_=int(self._rr[lane]), label=label,
+            values=self._rv_values(inst), observed=observed, where=where)
+        if action == "shed":
+            self._rv_shed_lanes.add(lane)
+
+    def _rv_check_oob(self, lane: int, row) -> None:
+        """Eager verdicts on an oob-adopted lane (rv/compile.py
+        eager_verdicts — the cold-path twin of the fused term)."""
+        from round_tpu.rv.compile import eager_verdicts
+
+        self._rv.note_checks(self._rv_mon.n_monitors)
+        tripped, decided, decision = eager_verdicts(
+            self._rv_mon, row, bool(self._rv_prev_dec[lane]),
+            self._rv_prev_val[lane], bool(self._rv_ext_dec[lane]),
+            self._rv_ext_val[lane], self._rv_init[lane])
+        self._rv_prev_dec[lane] = decided
+        self._rv_prev_val[lane] = decision
+        for fidx in tripped:
+            self._rv_violate(lane, int(fidx), "oob-adopt")
+
+    def _rv_note_ext(self, lane: int, payload) -> None:
+        """A FLAG_DECISION arrived for a LIVE lane: record the peer's
+        decision for the fused agreement term, and — since the adoption
+        below will overwrite the lane's state before the next wave —
+        check the already-decided case at this site (the Python-path
+        site both drivers share; HostRunner's equivalent lives in
+        rv/compile.py InstanceMonitor)."""
+        p = self._rv_mon
+        try:
+            v = np.asarray(payload, dtype=p.decision_dtype).reshape(
+                p.decision_shape)
+        except Exception:  # noqa: BLE001 — a garbage decision frame is
+            return         # the adoption path's problem, not rv's
+        self._rv_ext_dec[lane] = True
+        self._rv_ext_val[lane] = v
+        agree = p.slot_index("agreement")
+        if agree is not None and self._rv_prev_dec[lane] \
+                and not np.array_equal(v, self._rv_prev_val[lane]):
+            self._rv_violate(lane, agree, "decision-adopt")
+
+    def _rv_check_done(self, iid: int, raw) -> None:
+        """A FLAG_DECISION arrived for a COMPLETED instance: the banked
+        decision and the peer's must agree — the cold-path half of the
+        agreement monitor."""
+        banked = self._done.get(iid)
+        if banked is None:
+            return
+        ok, payload = self._loads(raw)
+        if not ok or payload is None:
+            return
+        p = self._rv_mon
+        agree = p.slot_index("agreement")
+        if agree is None:
+            return
+        try:
+            v = np.asarray(payload, dtype=p.decision_dtype).reshape(
+                p.decision_shape)
+        except Exception:  # noqa: BLE001
+            return
+        if not np.array_equal(v, np.asarray(banked)):
+            observed = {"decided": True,
+                        "decision": decision_scalar(banked),
+                        "ext_decision": decision_scalar(v)}
+            # violate() raises under the halt policy; shed has no lane
+            # to retire here (the instance already completed) — the
+            # record and counters are the outcome
+            self._rv.violate(
+                inst=iid, round_=-1, label=p.labels[agree],
+                values=self._rv_values(iid), observed=observed,
+                where="decision-bank")
 
     # -- lane lifecycle ----------------------------------------------------
 
@@ -1368,7 +1580,20 @@ class LaneDriver:
             self.rounds_run += 1
             _C_ROUNDS.inc()
             row = self._state_row(lane)
-            finished.append((lane, True,
+            shed = False
+            if self._rv is not None:
+                # an adopted decision never reaches a fused wave: check
+                # it eagerly (same verdict math — rv/compile.py) so an
+                # adopted INVALID value still trips — and the shed
+                # policy applies HERE too: an adopted violating
+                # decision must not enter the log either
+                self._rv_check_oob(lane, row)
+                shed = lane in self._rv_shed_lanes
+                self._rv_shed_lanes.discard(lane)
+                if shed:
+                    self.shed_instances += 1
+                    _C_SHED_INSTANCES.inc()
+            finished.append((lane, not shed,
                              np.asarray(self.algo.decision(row))))
         if not ready:
             return finished
@@ -1398,7 +1623,12 @@ class LaneDriver:
                     timedout=timedout, exited=exited,
                     wall_ms=round(
                         (_time.monotonic() - self._t0[lane]) * 1e3, 3))
-            if exited or r + 1 >= self.max_rounds:
+            if exited or r + 1 >= self.max_rounds or (
+                    self._rv is not None
+                    and lane in self._rv_shed_lanes):
+                # rv 'shed' policy: a lane whose monitor tripped retires
+                # NOW, forced undecided below — a violating decision
+                # must not enter the log or stream to a client
                 finishing.append(lane)
             else:
                 self._rr[lane] = r + 1
@@ -1415,9 +1645,16 @@ class LaneDriver:
             decided_v, decision_v = dec_fn(self._state_tree())
             decided_v = np.asarray(decided_v)
             decision_v = np.asarray(decision_v)
-            finished.extend(
-                (lane, bool(decided_v[lane]), decision_v[lane])
-                for lane in finishing)
+            for lane in finishing:
+                shed = (self._rv is not None
+                        and lane in self._rv_shed_lanes)
+                if shed:
+                    self._rv_shed_lanes.discard(lane)
+                    self.shed_instances += 1
+                    _C_SHED_INSTANCES.inc()
+                finished.append(
+                    (lane, bool(decided_v[lane]) and not shed,
+                     decision_v[lane]))
         return finished
 
     def _bank_pump_stats(self) -> None:
@@ -1449,6 +1686,8 @@ class LaneDriver:
             self._trajectory)
         if self._health is not None:
             stats_out["quarantine"] = self._health.summary()
+        if self._rv is not None:
+            self._rv.fill_stats(stats_out)
 
     def run(self, instances: int, checkpoint_dir: Optional[str] = None,
             stats_out: Optional[Dict[str, int]] = None,
@@ -1498,6 +1737,18 @@ class LaneDriver:
                         self._done[i & 0xFFFF] = None
                 log.info("node %d: resumed %d completed instance(s) from "
                          "%s", self.id, len(completed), checkpoint_dir)
+        try:
+            self._run_loop(instances, checkpoint_dir, results, completed,
+                           next_admit)
+        finally:
+            # stats survive an rv-halt (the RvViolation propagates with
+            # the violation record already banked)
+            self._bank_pump_stats()
+            self._fill_stats(stats_out)
+        return results
+
+    def _run_loop(self, instances: int, checkpoint_dir, results,
+                  completed: set, next_admit: int) -> None:
         while len(completed) < instances:
             if self._admission is not None:
                 self._admission_update()
@@ -1565,9 +1816,6 @@ class LaneDriver:
             for lane, decided, decision in self._tick(deferring):
                 self._finish_lane(lane, decided, decision, results,
                                   checkpoint_dir, completed, instances)
-        self._bank_pump_stats()
-        self._fill_stats(stats_out)
-        return results
 
     def _admit_proposals(self) -> None:
         """Admit queued client proposals into free lanes, under the same
@@ -1618,6 +1866,25 @@ class LaneDriver:
                 self.transport.send(
                     sender, Tag(instance=iid, flag=FLAG_TOO_LATE))
 
+    def _rv_fail_clients(self) -> None:
+        """Best-effort client notification on an rv halt: FLAG_TOO_LATE
+        for every queued proposal and live client instance."""
+        try:
+            for iid, _io, sender in list(self._proposals):
+                self.transport.send(
+                    sender, Tag(instance=iid, flag=FLAG_TOO_LATE))
+            for lane in np.nonzero(self._live)[0]:
+                iid = int(self._inst[int(lane)]) & 0xFFFF
+                c = self._client_of.get(iid)
+                targets = set(self._subscribers)
+                if c is not None:
+                    targets.add(c)
+                for t in targets:
+                    self.transport.send(
+                        t, Tag(instance=iid, flag=FLAG_TOO_LATE))
+        except Exception:  # noqa: BLE001 — the halt still propagates
+            pass
+
     def serve(self, idle_ms: int = 4000, max_ms: int = 600_000,
               stop=None, stats_out: Optional[Dict[str, int]] = None,
               ) -> Dict[int, Optional[int]]:
@@ -1636,19 +1903,54 @@ class LaneDriver:
         decision-log entry} for every instance served (None =
         finished undecided)."""
         results: Dict[int, Optional[int]] = {}
+        try:
+            self._serve_loop(results, idle_ms, max_ms, stop)
+        finally:
+            # stats survive an rv-halt (DriverServer.rv_summary reads
+            # them after join)
+            self._bank_pump_stats()
+            self._fill_stats(stats_out)
+        return results
+
+    def _serve_loop(self, results: Dict[int, Optional[int]],
+                    idle_ms: int, max_ms: int, stop) -> None:
         t_end = _time.monotonic() + max_ms / 1000.0
         last_active = _time.monotonic()
         while True:
             now = _time.monotonic()
             if now >= t_end or (stop is not None and stop()):
                 break
-            if self._admission is not None:
-                self._admission_update()
-            self._admit_proposals()
-            deferring = (self._admission is not None
-                         and self._admission.shedding
-                         and bool(self._proposals))
-            finished = self._tick(deferring)
+            if self._rv is None:
+                if self._admission is not None:
+                    self._admission_update()
+                self._admit_proposals()
+                deferring = (self._admission is not None
+                             and self._admission.shedding
+                             and bool(self._proposals))
+                finished = self._tick(deferring)
+            else:
+                from round_tpu.rv.dump import RvViolation
+
+                try:
+                    # admission replays stashed frames through _ingest,
+                    # where a halt can trip too (decision-bank
+                    # agreement) — the fail-fast handler must cover the
+                    # whole serving step, not just the tick
+                    if self._admission is not None:
+                        self._admission_update()
+                    self._admit_proposals()
+                    deferring = (self._admission is not None
+                                 and self._admission.shedding
+                                 and bool(self._proposals))
+                    finished = self._tick(deferring)
+                except RvViolation:
+                    # rv halt while client-serving: tell every proposer/
+                    # subscriber their in-flight instances are dead
+                    # (FLAG_TOO_LATE — the router resolves them
+                    # undecided) instead of letting clients retry into
+                    # a halted shard until their give-up budget burns
+                    self._rv_fail_clients()
+                    raise
             for lane, decided, decision in finished:
                 inst, raw = self._retire_lane(lane, decided, decision)
                 iid = inst & 0xFFFF
@@ -1659,9 +1961,6 @@ class LaneDriver:
                 last_active = _time.monotonic()
             elif _time.monotonic() - last_active >= idle_ms / 1000.0:
                 break
-        self._bank_pump_stats()
-        self._fill_stats(stats_out)
-        return results
 
 
 def run_instance_loop_lanes(
@@ -1684,6 +1983,7 @@ def run_instance_loop_lanes(
     use_pump: bool = True,
     admission: Optional[AdmissionControl] = None,
     health=None,
+    rv=None,
 ) -> List[Optional[int]]:
     """The lane-batched form of run_instance_loop: same schedule, same
     seeds, same decision-log shape — the work just flows through one
@@ -1692,13 +1992,15 @@ def run_instance_loop_lanes(
     drivers byte-for-byte (tests/test_lanes.py).  ``use_pump=False`` pins
     the Python pump (the native-pump A/B baseline, tests/test_pump.py).
     ``admission``/``health`` opt in to the overload hardening
-    (docs/HOST_FAULT_MODEL.md): load shedding + peer quarantine."""
+    (docs/HOST_FAULT_MODEL.md): load shedding + peer quarantine.  ``rv``
+    (rv.dump.RvConfig) fuses the runtime-verification monitors into the
+    mega-step (docs/RUNTIME_VERIFICATION.md)."""
     driver = LaneDriver(
         algo, my_id, peers, transport, lanes=lanes, timeout_ms=timeout_ms,
         seed=seed, base_value=base_value, max_rounds=max_rounds,
         nbr_byzantine=nbr_byzantine, value_schedule=value_schedule,
         adaptive=adaptive, wire=wire, use_pump=use_pump,
-        admission=admission, health=health,
+        admission=admission, health=health, rv=rv,
     )
     return driver.run(instances, checkpoint_dir=checkpoint_dir,
                       stats_out=stats_out)

@@ -61,6 +61,13 @@ _C_CHANGES = METRICS.counter("view.changes")
 _C_ADOPTS = METRICS.counter("view.adopts")
 _C_STALE = METRICS.counter("view.stale_peers")
 _C_REPLIES = METRICS.counter("view.replies")
+# proof-licensed reconfiguration (round_tpu/rv/license.py,
+# docs/MEMBERSHIP.md "proof-licensed resizing"): ops refused because no
+# all-n proof licenses the target size, and ops that proceeded anyway
+# (the --view-unlicensed-ok escape hatch, or decided elsewhere and
+# adopted) leaving the replica flagged degraded
+_C_REFUSED = METRICS.counter("view.refused")
+_C_DEGRADED = METRICS.counter("view.degraded")
 
 # -- the MembershipOp encoding (DynamicMembership.scala:217-229), shared
 # with the simulation path: apps/dynamic_membership.py imports these -----
@@ -171,7 +178,9 @@ class ViewManager:
     """
 
     def __init__(self, my_id: int, view: View, transport,
-                 add_host: str = "127.0.0.1"):
+                 add_host: str = "127.0.0.1", license=None,
+                 license_model: Optional[str] = None,
+                 unlicensed_ok: bool = False):
         if not view.group.contains(my_id):
             raise ValueError(f"my_id={my_id} not in view of n={view.n}")
         self.my_id: Optional[int] = my_id
@@ -181,6 +190,20 @@ class ViewManager:
         self.removed = False
         self.stale = False       # a peer was observed AHEAD of our epoch
         self.history: List[Tuple[int, int, int]] = []  # (epoch, kind, arg)
+        # proof-licensed reconfiguration (rv/license.py
+        # ProofLicenseRegistry + the serving protocol's name): with a
+        # ``license``, propose() consults the parameterized-proof
+        # registry BEFORE running the membership consensus — a resize
+        # the all-n proofs do not cover is REFUSED (no op proposed), or,
+        # under ``unlicensed_ok``, proceeds with this replica flagged
+        # DEGRADED.  Ops decided elsewhere and adopted can only be
+        # flagged, never refused (the group already moved).  None = the
+        # pre-license world, zero behavior change.
+        self.license = license
+        self.license_model = license_model
+        self.unlicensed_ok = unlicensed_ok
+        self.degraded = False
+        self.refusals: List[Dict[str, Any]] = []
         self._replied: Dict[int, float] = {}  # FLAG_VIEW rate limiter
         # encoded current view, cached per epoch: reply_view used to
         # re-serialize the SAME view for every stale peer it answered
@@ -243,6 +266,8 @@ class ViewManager:
 
         if self.removed:
             return None
+        if not self._license_gate(kind, arg):
+            return None
         inst = view_instance(self.epoch)
         runner = HostRunner(
             algo, self.my_id, self.view.peers(), self.transport,
@@ -261,6 +286,55 @@ class ViewManager:
         kind_d, arg_d = decode(int(np.asarray(res.decision)))
         self.apply_op(kind_d, arg_d)
         return kind_d, arg_d
+
+    def _license_gate(self, kind: int, arg: int) -> bool:
+        """The proof gate of propose(): True = proceed.  A non-licensed
+        resize is refused (obs event ``view_refused`` + counter), or —
+        under the explicit escape hatch — proceeds with the replica
+        flagged degraded (``view_degraded``)."""
+        if self.license is None:
+            return True
+        new_n = self.view.apply(kind, arg, add_host=self.add_host).n
+        lic = self.license.check(self.license_model, new_n)
+        if lic.ok:
+            return True
+        if not self.unlicensed_ok:
+            _C_REFUSED.inc()
+            self.refusals.append({
+                "epoch": self.epoch, "kind": kind, "arg": arg,
+                "n": new_n, "license": lic.to_json()})
+            if TRACE.enabled:
+                TRACE.emit("view_refused", node=self.my_id,
+                           epoch=self.epoch,
+                           op=("add" if kind == ADD else "remove"),
+                           arg=arg, n=new_n, status=lic.status,
+                           reason=lic.reason)
+            log.warning("node %s: membership op REFUSED (n=%d %s): %s",
+                        self.my_id, new_n, lic.status, lic.reason)
+            return False
+        self._flag_degraded(new_n, lic)
+        return True
+
+    def _flag_degraded(self, new_n: int, lic) -> None:
+        self.degraded = True
+        _C_DEGRADED.inc()
+        if TRACE.enabled:
+            TRACE.emit("view_degraded", node=self.my_id,
+                       epoch=self.epoch, n=new_n, status=lic.status,
+                       reason=lic.reason)
+        log.warning("node %s: view move to n=%d is UNLICENSED (%s) — "
+                    "proceeding degraded: %s", self.my_id, new_n,
+                    lic.status, lic.reason)
+
+    def _license_observe(self, new_n: int) -> None:
+        """The adopt/apply-path check: an op already decided can only be
+        FLAGGED (cache-only — never stall a committed move on a cold
+        solver run)."""
+        if self.license is None or self.degraded:
+            return
+        lic = self.license.check(self.license_model, new_n, solve=False)
+        if not lic.ok:
+            self._flag_degraded(new_n, lic)
 
     def apply_op(self, kind: int, arg: int) -> None:
         """Apply one DECIDED op atomically: group + ids + wire + epoch."""
@@ -375,6 +449,9 @@ class ViewManager:
             log.info("view catch-up: removed from the group at epoch %d",
                      v.epoch)
             return True
+        # an adopted op is already committed group-wide: the license
+        # check can only FLAG here (cache-only, never a solver stall)
+        self._license_observe(v.n)
         old_view = self.view
         self.transport.rewire(v.peers(), my_id=new_id)
         self.my_id = new_id

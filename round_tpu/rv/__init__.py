@@ -1,0 +1,35 @@
+"""Runtime verification: wire-speed monitors fused into serving.
+
+The composition ROADMAP item 4 asked for: the same Spec formulas
+spec/check.py evaluates offline (and fuzz/objectives.py evaluates
+vmapped inside jitted dispatches) become LIVE monitors on the serving
+tier — every instance a LaneDriver or HostRunner advances is
+invariant-checked at marginal cost ~0, violations halt-and-dump a
+replayable artifact (the PR 8 fuzz/replay.py schedule format), and
+ViewManager membership changes are licensed by the PR 9 parameterized
+proofs instead of by hope.
+
+Modules:
+  compile.py  — the monitor compiler: Spec → jitted per-lane monitor
+                term (fused into the LaneDriver mega-step; a Python-path
+                equivalent drives HostRunner) via the SHARED formula
+                enumeration of spec/check.py:spec_formulas.
+  dump.py     — the violation pipeline: obs events + rv.* counters +
+                halt-and-dump artifacts that `fuzz_cli replay`
+                reproduces bit-exactly on engine and host wire.
+  license.py  — proof-licensed reconfiguration: the parameterized-proof
+                registry consulted by ViewManager before a membership op
+                commits.
+  fixtures.py — deliberately broken rounds (selector-registered) that
+                trip each monitor: the injected-violation end-to-end
+                pins of tests/test_rv.py.
+
+See docs/RUNTIME_VERIFICATION.md for monitor semantics, the dump
+artifact schema, and the licensing state machine.
+"""
+
+from round_tpu.rv.compile import (  # noqa: F401
+    InstanceMonitor, MonitorProgram, monitor_program,
+)
+from round_tpu.rv.dump import RvConfig, RvRuntime, RvViolation  # noqa: F401
+from round_tpu.rv.license import License, ProofLicenseRegistry  # noqa: F401

@@ -1,0 +1,343 @@
+"""The monitor compiler: a protocol's Spec → wire-speed lane monitors.
+
+What is soundly checkable AT A REPLICA differs from what is checkable
+over a recorded full-system trace, and this module is explicit about the
+split (docs/RUNTIME_VERIFICATION.md "monitor semantics"):
+
+  * The full-state formulas — invariants, safety_predicate,
+    round_invariants — quantify over all n processes' state, which no
+    single replica holds.  They stay with the offline/engine surface
+    (spec/check.py:check_trace, fuzz/objectives.py:spec_holds), and the
+    compiler CLASSIFIES them (``MonitorProgram.offline``) so the dump
+    pipeline and docs can say exactly which formulas a live verdict does
+    NOT cover.
+
+  * The decision-plane properties — Agreement, Validity, Irrevocability
+    — have exact locally-checkable forms over what a replica genuinely
+    observes: its own decision history (irrevocability needs one carried
+    (prior decided, prior decision) pair per lane), the instance's
+    initial-value vector (deterministic from the shared value schedule,
+    or the uniform client proposal — validity's witness set), and
+    peer decisions learned over the wire (FLAG_DECISION gossip/replies —
+    agreement's observability channel).  These compile into the jitted
+    per-lane monitor term: the ``spec_holds`` evaluation lifted to the
+    ``[L, ...]`` lane axis and FUSED into the LaneDriver mega-step
+    (engine/executor.py LaneStep — one extra output alongside decisions,
+    no second dispatch), with the eager numpy equivalent
+    (InstanceMonitor) driving HostRunner so both drivers report the same
+    verdict vector under the same labels.
+
+Labels/ordering come from the ONE shared enumeration
+(spec/check.py:spec_formulas): a Spec edit moves the offline checker and
+the live monitors together or not at all (tests/test_rv.py pins this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.spec.check import SpecFormula, spec_formulas
+
+# the decision-plane monitor slots, in verdict-vector order.  Matched
+# case-insensitively against Spec property names so a protocol's own
+# "Agreement" keeps its check_trace label on the live verdict.
+WIRE_MONITORS = ("agreement", "validity", "irrevocability")
+
+
+@dataclasses.dataclass(frozen=True)
+class MonitorProgram:
+    """One algorithm's compiled monitor set at group size ``n``.
+
+    labels:        verdict-vector labels, index-aligned with the ok[F]
+                   output of ``check_lane`` (and InstanceMonitor).
+    slots:         the WIRE_MONITORS slot each verdict implements.
+    offline:       the Spec formulas NOT live-checkable at a replica
+                   (full-state invariants etc.) — check_trace territory.
+    decision_*:    shape/dtype of ``algo.decision`` (the carried monitor
+                   state rides these).
+    init_*:        shape/dtype of one process's initial value (validity's
+                   witness rows are [n, *init_shape]).
+    check_lane:    pure jit-safe per-lane term
+                   (state_row, prev_dec, prev_val, ext_dec, ext_val,
+                   init_vals) -> (ok[F] bool, decided, decision);
+                   engine/executor.py vmaps it over the lane axis inside
+                   the update mega-step.
+    """
+
+    algo: Any
+    n: int
+    labels: Tuple[str, ...]
+    slots: Tuple[str, ...]
+    offline: Tuple[SpecFormula, ...]
+    decision_shape: Tuple[int, ...]
+    decision_dtype: Any
+    init_shape: Tuple[int, ...]
+    init_dtype: Any
+    validity_comparable: bool = True
+    check_lane: Callable = dataclasses.field(repr=False, default=None)
+
+    @property
+    def n_monitors(self) -> int:
+        return len(self.labels)
+
+    def slot_index(self, slot: str) -> Optional[int]:
+        try:
+            return self.slots.index(slot)
+        except ValueError:
+            return None
+
+    def zeros(self, lanes: int):
+        """Fresh carried monitor state for ``lanes`` slots:
+        (prev_decided, prev_decision, ext_decided, ext_decision,
+        init_values) — the pytree threaded through the lane driver."""
+        return (
+            np.zeros((lanes,), dtype=bool),
+            np.zeros((lanes,) + self.decision_shape,
+                     dtype=self.decision_dtype),
+            np.zeros((lanes,), dtype=bool),
+            np.zeros((lanes,) + self.decision_shape,
+                     dtype=self.decision_dtype),
+            np.zeros((lanes, self.n) + self.init_shape,
+                     dtype=self.init_dtype),
+        )
+
+
+def _probe_shapes(algo, n: int):
+    """(decision shape/dtype, init shape/dtype) from one eager init-state
+    probe — the instance_io contract both host loops build from."""
+    from round_tpu.core.rounds import RoundCtx
+    from round_tpu.runtime.host import instance_io
+
+    io = instance_io(algo, 0)
+    iv = np.asarray(io["initial_value"])
+    ctx = RoundCtx(id=np.int32(0), n=n, r=np.int32(0))
+    st = algo.make_init_state(ctx, io)
+    dec = np.asarray(algo.decision(st))
+    bool(np.asarray(algo.decided(st)).reshape(()))  # must be scalar bool
+    return (tuple(dec.shape), dec.dtype, tuple(iv.shape), iv.dtype)
+
+
+def _same(a, b):
+    return jnp.all(jnp.asarray(a) == jnp.asarray(b))
+
+
+def _impl(cond, then):
+    return jnp.logical_or(jnp.logical_not(cond), then)
+
+
+def monitor_program(algo, n: int) -> Optional[MonitorProgram]:
+    """Compile ``algo``'s monitor set, or None when there is nothing to
+    soundly monitor: no decision plane (decided/decision accessors —
+    e.g. the cellular-automaton models), or a Spec that names none of
+    the decision-plane properties.
+
+    THE SPEC IS THE CONTRACT: a wire monitor compiles ONLY for the
+    slots the algorithm's own Spec names (case-insensitive match on
+    WIRE_MONITORS).  Guessing built-ins for unnamed slots mis-fires on
+    protocols whose contract is legitimately weaker — k-set agreement
+    decides up to k DISTINCT values (an exact-equality agreement
+    monitor would trip on correct runs), ε-agreement decides averages
+    no process proposed (a proposal-membership validity monitor would
+    trip).  What the Spec does not claim, the wire does not check."""
+    try:
+        dshape, ddtype, ishape, idtype = _probe_shapes(algo, n)
+    except Exception:  # noqa: BLE001 — no decision plane, no monitors
+        return None
+
+    enum = spec_formulas(algo.spec) if getattr(algo, "spec", None) \
+        else ()
+    by_name: Dict[str, SpecFormula] = {
+        e.name.lower(): e for e in enum if e.kind == "property"}
+    named = [slot for slot in WIRE_MONITORS if slot in by_name]
+    if not named:
+        return None
+    # the live labels ARE the check_trace labels — both sides read the
+    # one shared enumeration (the desync-proof contract)
+    labels = [by_name[slot].label for slot in named]
+
+    # validity needs decision and initial values to be comparable; for
+    # algorithms where they are not (a digest-decision protocol, say),
+    # the slot degrades to vacuous-True rather than mis-firing.  EXACT
+    # shape equality, not broadcastability: the fused term compares via
+    # jnp broadcast while the eager twin uses np.array_equal, and only
+    # identical shapes keep the two paths' verdicts identical (the
+    # lanes-vs-host parity contract)
+    validity_comparable = dshape == ishape
+
+    decided_fn, decision_fn = algo.decided, algo.decision
+
+    def check_lane(state_row, prev_dec, prev_val, ext_dec, ext_val,
+                   init_vals):
+        decided = jnp.asarray(decided_fn(state_row)).reshape(())
+        decision = jnp.asarray(decision_fn(state_row))
+        oks = []
+        for slot in named:
+            if slot == "agreement":
+                oks.append(_impl(jnp.logical_and(decided, ext_dec),
+                                 _same(decision, ext_val)))
+            elif slot == "validity":
+                if validity_comparable:
+                    witness = jax.vmap(
+                        lambda iv: _same(decision, iv))(init_vals)
+                    oks.append(_impl(decided, jnp.any(witness)))
+                else:
+                    oks.append(jnp.asarray(True))
+            else:  # irrevocability
+                oks.append(_impl(prev_dec, jnp.logical_and(
+                    decided, _same(decision, prev_val))))
+        return jnp.stack(oks), decided, decision
+
+    offline = tuple(e for e in enum
+                    if not (e.kind == "property"
+                            and e.name.lower() in WIRE_MONITORS))
+    return MonitorProgram(
+        algo=algo, n=n, labels=tuple(labels), slots=tuple(named),
+        offline=offline, decision_shape=dshape, decision_dtype=ddtype,
+        init_shape=ishape, init_dtype=idtype,
+        validity_comparable=validity_comparable, check_lane=check_lane)
+
+
+def schedule_init_values(algo, n: int, value_schedule: str,
+                         base_value: int, inst: int) -> np.ndarray:
+    """The [n, *init_shape] initial-value matrix of one SCHEDULED
+    instance — deterministic in (schedule, base, pid, inst), so every
+    replica computes the same validity witness set without any wire
+    traffic (the same determinism the chaos harness leans on)."""
+    from round_tpu.runtime.host import _schedule_value, instance_io
+
+    rows = [np.asarray(instance_io(
+        algo, _schedule_value(value_schedule, base_value, pid, inst)
+    )["initial_value"]) for pid in range(n)]
+    return np.stack(rows)
+
+
+def eager_verdicts(p: MonitorProgram, state, prev_dec, prev_val,
+                   ext_dec, ext_val, init_vals):
+    """Numpy evaluation of the verdict vector on ONE lane/instance —
+    the same comparisons as the fused jnp term, slot for slot, for the
+    cold paths that never reach an update dispatch (HostRunner rounds,
+    oob-adopted lanes).  Returns (tripped indices, decided, decision)."""
+    decided = bool(np.asarray(p.algo.decided(state)).reshape(()))
+    decision = np.asarray(p.algo.decision(state))
+    same = np.array_equal
+    ok = []
+    for slot in p.slots:
+        if slot == "agreement":
+            ok.append(not (decided and ext_dec)
+                      or same(decision, ext_val))
+        elif slot == "validity":
+            ok.append((not decided) or not p.validity_comparable
+                      or bool(np.any([same(decision, iv)
+                                      for iv in init_vals])))
+        else:  # irrevocability
+            ok.append((not prev_dec)
+                      or (decided and same(decision, prev_val)))
+    return [i for i in range(p.n_monitors) if not ok[i]], decided, \
+        decision
+
+
+class InstanceMonitor:
+    """The Python-path monitor equivalent: one instance, one lane —
+    eager numpy evaluation of EXACTLY the fused term's math, driving
+    HostRunner (runtime/host.py).  Both drivers report the same verdict
+    vector under the same labels (tests/test_rv.py pins lanes-vs-host
+    verdict parity on the broken fixtures)."""
+
+    __slots__ = ("program", "prev_dec", "prev_val", "ext_dec", "ext_val",
+                 "init_vals")
+
+    def __init__(self, program: MonitorProgram, init_values: np.ndarray):
+        self.program = program
+        self.prev_dec = False
+        self.prev_val = np.zeros(program.decision_shape,
+                                 dtype=program.decision_dtype)
+        self.ext_dec = False
+        self.ext_val = np.zeros_like(self.prev_val)
+        self.init_vals = np.asarray(init_values)
+
+    def note_ext(self, value) -> None:
+        """Record a peer decision learned over the wire (FLAG_DECISION
+        gossip / TooLate reply) — agreement's observability channel."""
+        try:
+            v = np.asarray(value, dtype=self.prev_val.dtype).reshape(
+                self.prev_val.shape)
+        except Exception:  # noqa: BLE001 — a garbage decision frame is
+            return         # the transport's problem, not the monitor's
+        self.ext_dec = True
+        self.ext_val = v
+
+    def check(self, state) -> List[int]:
+        """Evaluate the verdict vector on a post-update state; returns
+        the indices of TRIPPED monitors (empty = all held) and advances
+        the carried (prev decided, prev decision) pair.  Pure numpy —
+        same comparisons as the fused jnp term, with no per-round
+        device dispatch on the Python driver's hot loop."""
+        tripped, decided, decision = eager_verdicts(
+            self.program, state, self.prev_dec, self.prev_val,
+            self.ext_dec, self.ext_val, self.init_vals)
+        self.prev_dec, self.prev_val = decided, decision
+        return tripped
+
+
+class HostRv:
+    """One instance's monitor driver for the sequential HostRunner: the
+    Python-path equivalent of the fused lane term (same verdict vector,
+    same labels, same carried state), plus the violation-policy glue.
+    ``values`` is the artifact proposals row the dump pipeline records.
+    """
+
+    __slots__ = ("rt", "program", "inst", "values", "mon", "shed",
+                 "just_decided", "gossip")
+
+    def __init__(self, runtime, program: MonitorProgram, inst: int,
+                 init_values: np.ndarray, values, gossip: bool = True):
+        self.rt = runtime
+        self.program = program
+        self.inst = inst
+        self.values = list(values)
+        self.mon = InstanceMonitor(program, init_values)
+        self.shed = False
+        self.just_decided = False
+        self.gossip = gossip
+
+    def _act(self, tripped: List[int], r: int, where: str) -> None:
+        for fidx in tripped:
+            observed = {
+                "decided": bool(self.mon.prev_dec),
+                "decision": _scalar(self.mon.prev_val),
+                "ext_decided": bool(self.mon.ext_dec),
+                "ext_decision": _scalar(self.mon.ext_val),
+            }
+            # violate() RAISES RvViolation itself under the halt policy
+            action = self.rt.violate(
+                inst=self.inst, round_=r,
+                label=self.program.labels[fidx], values=self.values,
+                observed=observed, where=where)
+            if action == "shed":
+                self.shed = True
+
+    def after_update(self, state, r: int) -> None:
+        """One completed round's verdicts (the fused term's site)."""
+        was = self.mon.prev_dec
+        self.rt.note_checks(self.program.n_monitors)
+        tripped = self.mon.check(state)
+        self.just_decided = self.mon.prev_dec and not was
+        self._act(tripped, r, "round")
+
+    def on_decision_frame(self, state, payload, r: int) -> None:
+        """A FLAG_DECISION arrived mid-instance: record it for the
+        agreement term and re-check NOW — the adoption that follows
+        overwrites the state the conflict lives in."""
+        self.mon.note_ext(payload)
+        self._act(self.mon.check(state), r, "decision-adopt")
+
+
+def _scalar(v) -> int:
+    from round_tpu.runtime.host import decision_scalar
+
+    return decision_scalar(np.asarray(v))

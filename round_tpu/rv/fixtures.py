@@ -1,0 +1,137 @@
+"""Deliberately broken rounds: one fixture per wire monitor.
+
+The analysis/fixtures.py idea applied to the runtime-verification tier:
+each fixture is a tiny OTR-shaped consensus whose update is broken in
+exactly one way, so the injected-violation end-to-end tests
+(tests/test_rv.py) can pin that the RIGHT monitor trips, under the lane
+driver AND HostRunner, and that the dumped artifact replays to the same
+violating state on the engine.
+
+All three are selector-registered (``rv-broken-agreement`` /
+``rv-broken-validity`` / ``rv-broken-revoke``) so the dump artifacts are
+replayable through the standard fuzz_cli surfaces — an rv dump names its
+protocol, and replay resolves it like any other model.  They are test
+fixtures, not protocols: never deploy one.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from round_tpu.core.algorithm import Algorithm
+from round_tpu.core.rounds import Round, RoundCtx, broadcast
+from round_tpu.models.common import ghost_decide
+from round_tpu.models.otr import OtrSpec, OtrState
+from round_tpu.ops.mailbox import Mailbox
+
+# rounds a fixture keeps participating after deciding: long enough for
+# FLAG_DECISION gossip to land while the lane is still live (the
+# agreement monitor's deterministic trip window)
+_AFTER = 6
+
+
+class _BrokenOtrRound(Round):
+    """OtrRound's shape with a pluggable (wrong) decision rule."""
+
+    def send(self, ctx: RoundCtx, state: OtrState):
+        return broadcast(ctx, state.x)
+
+    def _decide_value(self, ctx: RoundCtx, state: OtrState,
+                      mbox: Mailbox):
+        raise NotImplementedError
+
+    def update(self, ctx: RoundCtx, state: OtrState,
+               mbox: Mailbox) -> OtrState:
+        quorum = mbox.size() > (2 * ctx.n) // 3
+        v = self._decide_value(ctx, state, mbox)
+        state = ghost_decide(state, quorum, v)
+        after = jnp.where(state.decided, state.after - 1, state.after)
+        ctx.exit_at_end_of_round(state.decided & (after <= 0))
+        # x is deliberately NOT overwritten (plain OTR converges x onto
+        # the decision): the fixtures keep the heterogeneous proposals
+        # flowing every round, so min != max stays observable after the
+        # (broken) decisions land
+        return state.replace(after=after)
+
+
+class _AgreementBreakRound(_BrokenOtrRound):
+    """Even pids decide the MIN received value, odd pids the MAX — both
+    are received (hence proposed) values, so validity holds while
+    agreement is broken system-wide the moment proposals differ."""
+
+    def _decide_value(self, ctx, state, mbox):
+        lo = mbox.masked_min()
+        hi = mbox.masked_max()
+        return jnp.where(ctx.id % 2 == 0, lo, hi).astype(state.x.dtype)
+
+
+class _ValidityBreakRound(_BrokenOtrRound):
+    """Decides a FABRICATED value no process proposed (the schedule
+    domain is mod 5; 99 is unreachable)."""
+
+    def _decide_value(self, ctx, state, mbox):
+        return jnp.asarray(99, dtype=state.x.dtype)
+
+
+class _RevokeRound(_BrokenOtrRound):
+    """Decides the MIN received value, then REVOKES it: from round 2 on,
+    a decided lane's decision silently flips to the MAX proposal it
+    heard at decision time — another proposed value, so validity holds
+    while irrevocability is broken."""
+
+    def _decide_value(self, ctx, state, mbox):
+        return mbox.masked_min().astype(state.x.dtype)
+
+    def update(self, ctx, state, mbox):
+        hi = mbox.masked_max().astype(state.x.dtype)
+        state = super().update(ctx, state, mbox)
+        revoke = state.decided & (ctx.r >= 2) & (hi > state.decision)
+        return state.replace(
+            decision=jnp.where(revoke, hi, state.decision))
+
+
+class _BrokenConsensus(Algorithm):
+    """The shared Algorithm shell: OTR's state/init/accessors (and Spec,
+    so the monitors carry the Spec's own property labels) around one
+    broken round."""
+
+    fault_envelope = "n > 3f"
+
+    def __init__(self, rnd: Round):
+        self.rounds = (rnd,)
+        self.spec = OtrSpec()
+
+    def make_init_state(self, ctx: RoundCtx, io) -> OtrState:
+        return OtrState(
+            x=jnp.asarray(io["initial_value"], dtype=jnp.int32),
+            decided=jnp.asarray(False),
+            decision=jnp.asarray(-1, dtype=jnp.int32),
+            after=jnp.asarray(_AFTER, dtype=jnp.int32),
+        )
+
+    def decided(self, state: OtrState):
+        return state.decided
+
+    def decision(self, state: OtrState):
+        return state.decision
+
+    def adopt_decision(self, state, decision):
+        # oob adoption would HEAL the injected violation
+        # nondeterministically: a replica that adopts the first peer
+        # decision it hears never produces its OWN broken one, and on a
+        # loaded box the adoption can win the race against the lane's
+        # ready update wave.  The fixtures refuse adoption (a legitimate
+        # Algorithm choice — None = "cannot adopt") so every replica's
+        # broken update runs and its monitor trips deterministically.
+        return None
+
+
+FIXTURES = {
+    "rv-broken-agreement": _AgreementBreakRound,
+    "rv-broken-validity": _ValidityBreakRound,
+    "rv-broken-revoke": _RevokeRound,
+}
+
+
+def select_fixture(name: str) -> Algorithm:
+    return _BrokenConsensus(FIXTURES[name]())

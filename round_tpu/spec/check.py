@@ -17,7 +17,7 @@ Conventions:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +33,55 @@ def formula_label(f, fallback: str) -> str:
     if not name or "<lambda>" in name:
         return fallback
     return f"{fallback} ({name})"
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecFormula:
+    """One enumerated spec formula: the label is EXACTLY the string the
+    trace checker attaches to an evaluation error / report row.
+
+    kind ∈ {"invariant", "property", "safety_predicate",
+    "round_invariant"}; ``name`` is the property name for properties (the
+    Spec's own naming), the structural position otherwise; ``group`` is
+    the round index for round_invariants (else -1)."""
+
+    label: str
+    kind: str
+    name: str
+    formula: Any
+    group: int = -1
+
+
+def spec_formulas(spec: Spec) -> Tuple["SpecFormula", ...]:
+    """THE shared formula enumeration: every formula a Spec carries, in a
+    fixed order, under the labels ``check_trace`` reports.
+
+    Both the offline trace checker (check_trace below) and the live
+    runtime-verification monitor compiler (round_tpu/rv/compile.py)
+    enumerate through here — so an edited Spec cannot desync the offline
+    report's labels/ordering from the jitted monitors' verdict vector.
+    Order: invariants, properties, safety_predicate, round_invariants
+    (group-major)."""
+    out = []
+    for i, f in enumerate(spec.invariants):
+        out.append(SpecFormula(
+            formula_label(f, f"invariants[{i}]"), "invariant",
+            f"invariants[{i}]", f))
+    for name, f in spec.properties:
+        out.append(SpecFormula(
+            f"property {name!r}", "property", name, f))
+    if spec.safety_predicate is not None:
+        f = spec.safety_predicate
+        out.append(SpecFormula(
+            formula_label(f, "safety_predicate"), "safety_predicate",
+            "safety_predicate", f))
+    for j, group in enumerate(spec.round_invariants):
+        for m, f in enumerate(group):
+            out.append(SpecFormula(
+                formula_label(f, f"round_invariants[{j}][{m}]"),
+                "round_invariant", f"round_invariants[{j}][{m}]", f,
+                group=j))
+    return tuple(out)
 
 
 def _eval_formula(f, env, label):
@@ -134,29 +183,34 @@ def check_trace(
     old_trace = _shift_old(trace, init_state)
     rs = jnp.arange(1, T + 1, dtype=jnp.int32)
     k = rounds_per_phase
+    # the ONE formula enumeration (labels + order), shared with the live
+    # monitor compiler (round_tpu/rv/compile.py) — see spec_formulas
+    enum = spec_formulas(spec)
+    inv_refs = [e for e in enum if e.kind == "invariant"]
+    prop_refs = [e for e in enum if e.kind == "property"]
+    safety_ref = next(
+        (e for e in enum if e.kind == "safety_predicate"), None)
+    rinv_refs = [e for e in enum if e.kind == "round_invariant"]
 
     def at_step(state_t, old_t, ho_t, r_t):
         env = Env(state=state_t, n=n, old=old_t, init0=init_state, ho=ho_t, r=r_t)
         inv = (
             jnp.stack([
-                _eval_formula(f, env, formula_label(f, f"invariants[{i}]"))
-                for i, f in enumerate(spec.invariants)
+                _eval_formula(e.formula, env, e.label) for e in inv_refs
             ])
-            if spec.invariants
+            if inv_refs
             else jnp.ones((0,), dtype=bool)
         )
         props = {
-            name: _eval_formula(f, env, f"property {name!r}")
-            for name, f in spec.properties
+            e.name: _eval_formula(e.formula, env, e.label)
+            for e in prop_refs
         }
-        if spec.safety_predicate is not None:
+        if safety_ref is not None:
             pre_env = Env(
                 state=old_t, n=n, old=None, init0=init_state, ho=ho_t, r=r_t - 1
             )
-            safe = _eval_formula(
-                spec.safety_predicate, pre_env,
-                formula_label(spec.safety_predicate, "safety_predicate"),
-            )
+            safe = _eval_formula(safety_ref.formula, pre_env,
+                                 safety_ref.label)
         else:
             safe = jnp.asarray(True)
         if spec.round_invariants:
@@ -166,11 +220,8 @@ def check_trace(
                     jnp.where(
                         phase_round == j,
                         jnp.all(jnp.stack([
-                            _eval_formula(
-                                f, env,
-                                formula_label(f, f"round_invariants[{j}][{m}]"),
-                            )
-                            for m, f in enumerate(group)
+                            _eval_formula(e.formula, env, e.label)
+                            for e in rinv_refs if e.group == j
                         ]))
                         if group
                         else jnp.asarray(True),

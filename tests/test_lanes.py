@@ -225,11 +225,13 @@ def test_lane_admission_churn_no_recompile():
     assert delta("lanes.admitted") == 3 * 20
     assert delta("lanes.retired") == 3 * 20
     assert delta("lanes.dispatches") > 0
-    # ...with ONE compiled mega-step per (round class, n, bucket): churn
-    # re-uses padded slots, it never re-traces
+    # ...with ONE compiled mega-step per (round class, n, bucket,
+    # monitored?): churn re-uses padded slots, it never re-traces (the
+    # third key element is the rv-monitor fusion flag — False here,
+    # monitors off; see tests/test_rv.py for the monitored pin)
     for rnd in algo.rounds:
         keys = set(getattr(rnd, "_lane_jit", {}).keys())
-        assert keys == {(3, 4)}, keys
+        assert keys == {(3, 4, False)}, keys
 
 
 def test_lanes_late_replica_adopts_decision_replies():

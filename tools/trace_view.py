@@ -227,6 +227,32 @@ def correlate_faults(events: Sequence[Dict[str, Any]]) -> Dict[str, List]:
             "unobserved": unobserved, "unmatched": unmatched}
 
 
+def rv_events(events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Runtime-verification and licensing events on the merged timeline
+    (round_tpu/rv, the PR-3 epoch-boundary rendering pattern): every
+    ``rv_violation`` (which monitor tripped, where, under which policy)
+    plus the membership-op licensing verdicts ``view_refused`` /
+    ``view_degraded``, time-ordered."""
+    out = []
+    for e in events:
+        ev = e.get("ev")
+        if ev == "rv_violation":
+            out.append({
+                "t": e.get("t", 0.0), "kind": "rv_violation",
+                "node": e.get("node"), "inst": e.get("inst"),
+                "round": e.get("round"), "formula": e.get("formula"),
+                "where": e.get("where"), "policy": e.get("policy"),
+            })
+        elif ev in ("view_refused", "view_degraded"):
+            out.append({
+                "t": e.get("t", 0.0), "kind": ev,
+                "node": e.get("node"), "epoch": e.get("epoch"),
+                "n": e.get("n"), "op": e.get("op"),
+                "status": e.get("status"), "reason": e.get("reason"),
+            })
+    return sorted(out, key=lambda r: r["t"])
+
+
 def timeline(events: Sequence[Dict[str, Any]], limit: int = 0) -> List[str]:
     """Human-readable merged timeline (offset seconds from first event)."""
     evs = [e for e in events if "t" in e]
@@ -258,12 +284,14 @@ def report(paths: Sequence[str], show_timeline: bool = False,
     lat = round_latencies(events)
     corr = correlate_faults(events)
     epochs = view_epochs(events)
+    rv = rv_events(events)
     if as_json:
         return json.dumps({
             "files": list(paths),
             "events": len(events),
             "round_latency_ms": lat,
             "view_epochs": epochs,
+            "rv": rv,
             "faults": {k: len(v) for k, v in corr.items()},
             "correlation": corr,
         }, indent=1)
@@ -283,6 +311,26 @@ def report(paths: Sequence[str], show_timeline: bool = False,
         n_reconn = sum(1 for e in events if e.get("ev") == "wire_reconnect")
         n_rewire = sum(1 for e in events if e.get("ev") == "wire_rewire")
         out.append(f"  wire: {n_rewire} rewires, {n_reconn} reconnects")
+    if rv:
+        t0 = min(e["t"] for e in events if "t" in e)
+        out.append("")
+        out.append("## runtime verification (rv_violation / "
+                   "view_refused / view_degraded)")
+        for r in rv[:max_listed]:
+            if r["kind"] == "rv_violation":
+                out.append(
+                    f"  +{r['t'] - t0:8.3f}s n{r['node']} "
+                    f"i{r['inst']} r{r['round']} VIOLATION "
+                    f"{r['formula']} @{r['where']} "
+                    f"policy={r['policy']}")
+            else:
+                out.append(
+                    f"  +{r['t'] - t0:8.3f}s n{r['node']} "
+                    f"{r['kind'].upper()} op={r.get('op')} "
+                    f"n={r.get('n')} [{r.get('status')}] "
+                    f"{r.get('reason')}")
+        if len(rv) > max_listed:
+            out.append(f"  ... {len(rv) - max_listed} more")
     if lat:
         out.append("")
         out.append("## per-round latency (ms, across instances and nodes)")
