@@ -3,7 +3,10 @@
 Usage:
   python -m round_tpu.apps.fuzz_cli search --algo otr --n 4 --rounds 12 \\
       --pop 1024 --generations 30 [--objective undecided|delay|safety] \\
+      [--value-cap F] [--liar-seeds F] \\
       [--minimize] [--out artifact.json] [--host-record] [--time-box-s 60]
+  python -m round_tpu.apps.fuzz_cli crosscheck --algo otr --n 4 \\
+      [--schedules 10000] [--bank DIR] [--host-record]
   python -m round_tpu.apps.fuzz_cli replay --artifact artifact.json \\
       [--engine] [--host] [--processes]
   python -m round_tpu.apps.fuzz_cli hostile [--frames 10000] [--seed 0]
@@ -13,6 +16,11 @@ engine (round_tpu/fuzz, docs/FUZZING.md), optionally delta-debugs the best
 finding to a minimal reproducer and exports it as a schedule artifact.
 With --host-record the exported artifact also banks the real-wire outcome
 (an in-process socket cluster), making it a self-checking regression.
+
+`crosscheck` runs the proof/fuzzer cross-check (round_tpu/byz): an
+in-envelope sweep that must stay safety-violation-free and a
+past-envelope sweep judged by the protocol's adversary model, with the
+minimized equivocation counterexample optionally banked (--bank).
 
 `replay` re-runs an artifact and exits nonzero if any recorded outcome
 stops reproducing — the regression-bank check (tests/regressions/).
@@ -59,8 +67,20 @@ def _cmd_search(args) -> int:
                                  if args.values else None))
     pred = _objective(args.objective, target.horizon, target.n)
     log = (lambda m: print(m, file=sys.stderr)) if not args.quiet else None
+    seeds = None
+    value_cap = args.value_cap
+    if args.liar_seeds > 0:
+        from round_tpu.byz.crosscheck import liar_rows
+
+        seeds = liar_rows(target.n, target.horizon, args.liar_seeds,
+                          seed=args.seed)
+        if value_cap is None:
+            # seeding liars implies opting into the value family —
+            # otherwise mutate's benign default would scrub the seeds
+            value_cap = args.liar_seeds
     res = search(target, pop_size=args.pop, generations=args.generations,
                  seed=args.seed, time_box_s=args.time_box_s,
+                 value_cap=value_cap, seed_rows=seeds,
                  stop_when=pred if args.stop_on_hit else None, log_fn=log)
     # "hit" gates minimization, so it must describe the row minimize will
     # run on — the best-EVER genome, which a time-boxed or coverage-mode
@@ -90,10 +110,13 @@ def _cmd_search(args) -> int:
         mr = fmin.minimize(target, res.best_row, pred, log_fn=log)
         summary["dropped_links"] = {"initial": mr.dropped_initial,
                                     "minimal": mr.dropped_final}
+        summary["value_events"] = {"initial": mr.value_initial,
+                                   "minimal": mr.value_final}
         if args.out:
             art = replay.make_artifact(
                 protocol=args.algo, schedule=mr.schedule,
                 values=target.init_values, seed=args.seed,
+                value_plan=mr.value_plan,
                 meta={"objective": summary["objective"],
                       "generations": res.generations,
                       "search_seed": args.seed,
@@ -117,7 +140,9 @@ def _cmd_replay(args) -> int:
     art = replay.load_artifact(args.artifact)
     out = {"artifact": args.artifact, "protocol": art["protocol"],
            "n": art["n"], "rounds": art["rounds"],
-           "drops": len(art.get("drops", []))}
+           "drops": len(art.get("drops", [])),
+           "value_subs": len(art.get("value_subs", [])),
+           "stale_subs": len(art.get("stale_subs", []))}
     rc = 0
     if args.engine or not (args.host or args.processes):
         ok, got = replay.check_engine(art)
@@ -138,6 +163,18 @@ def _cmd_replay(args) -> int:
         rc |= 0 if ok else 1
     print(json.dumps(out))
     return rc
+
+
+def _cmd_crosscheck(args) -> int:
+    from round_tpu.byz.crosscheck import crosscheck
+
+    log = (lambda m: print(m, file=sys.stderr)) if not args.quiet else None
+    res = crosscheck(args.algo, args.n, min_schedules=args.schedules,
+                     pop_size=args.pop, seed=args.seed,
+                     time_box_s=args.time_box_s, bank_dir=args.bank,
+                     host_record=args.host_record, log_fn=log)
+    print(json.dumps(res.record()))
+    return 0 if res.ok else 1
 
 
 def _cmd_hostile(args) -> int:
@@ -178,8 +215,37 @@ def main(argv=None) -> int:
     s.add_argument("--host-record", action="store_true",
                    help="also bank the real-wire outcome in the artifact")
     s.add_argument("--host-timeout-ms", type=int, default=250)
+    s.add_argument("--value-cap", type=int, default=None,
+                   help="max byzantine-VALUE adversaries per genome "
+                        "(round_tpu/byz).  Default: value family OFF "
+                        "(the PR-8 benign search) unless --liar-seeds "
+                        "opts in; pass (n-1)//3 for the envelope cap")
+    s.add_argument("--liar-seeds", type=int, default=0, metavar="F",
+                   help="seed the population with F-liar genomes "
+                        "(byz/crosscheck.liar_rows) so the value "
+                        "adversary needn't evolve from zero")
     s.add_argument("--quiet", action="store_true")
     s.set_defaults(fn=_cmd_search)
+
+    c = sub.add_parser(
+        "crosscheck",
+        help="proof/fuzzer cross-check: in/past-envelope sweeps "
+             "(round_tpu/byz/crosscheck.py)")
+    c.add_argument("--algo", default="otr")
+    c.add_argument("--n", type=int, default=4)
+    c.add_argument("--schedules", type=int, default=10_000,
+                   help="minimum schedules the in-envelope sweep must "
+                        "clear violation-free")
+    c.add_argument("--pop", type=int, default=512)
+    c.add_argument("--seed", type=int, default=0)
+    c.add_argument("--time-box-s", type=float, default=None)
+    c.add_argument("--bank", type=str, default=None, metavar="DIR",
+                   help="bank a minimized past-envelope counterexample "
+                        "artifact under DIR")
+    c.add_argument("--host-record", action="store_true",
+                   help="also bank the real-wire outcome in the artifact")
+    c.add_argument("--quiet", action="store_true")
+    c.set_defaults(fn=_cmd_crosscheck)
 
     h = sub.add_parser("hostile", help="hostile-wire fuzz gate")
     h.add_argument("--frames", type=int, default=10_000)

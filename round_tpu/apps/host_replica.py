@@ -89,6 +89,15 @@ def main(argv=None) -> int:
                     help="with --algo lvb: consensus over opaque uint8[B] "
                          "payloads (the KB-scale wire-fraction workload; "
                          "defaults to 1024 for --algo lvb)")
+    ap.add_argument("--algo-opt", action="append", default=[],
+                    metavar="K=V",
+                    help="algorithm option (repeatable), passed to the "
+                         "selector — e.g. after_decision=6 keeps decided "
+                         "OTR replicas participating (the byz rv workout "
+                         "needs the equivocation victim alive when the "
+                         "honest camp's decision gossip lands); integer "
+                         "values are parsed, everything else stays a "
+                         "string")
     ap.add_argument("--value-schedule", choices=["mixed", "uniform"],
                     default="mixed",
                     help="per-instance proposal schedule: 'mixed' "
@@ -373,9 +382,17 @@ def main(argv=None) -> int:
     if args.algo in ("lvb", "lastvoting-bytes", "lastvotingbytes") \
             and args.payload_bytes <= 0:
         args.payload_bytes = 1024
-    algo = select(args.algo,
-                  {"payload_bytes": args.payload_bytes}
-                  if args.payload_bytes > 0 else {})
+    algo_opts = ({"payload_bytes": args.payload_bytes}
+                 if args.payload_bytes > 0 else {})
+    for kv in args.algo_opt:
+        if "=" not in kv:
+            ap.error(f"--algo-opt wants K=V, got {kv!r}")
+        k, _, v = kv.partition("=")
+        try:
+            algo_opts[k] = int(v)
+        except ValueError:
+            algo_opts[k] = v
+    algo = select(args.algo, algo_opts)
 
     adaptive = None
     if args.adaptive_timeout:
@@ -556,13 +573,46 @@ def main(argv=None) -> int:
                     schedule_path=args.chaos_schedule,
                     gossip=args.rv_gossip)
         if args.instances <= 1:
-            if rv_cfg is not None:
+            inst_rv = None
+            rv_runtime = None
+            if rv_cfg is not None and args.chaos_schedule:
+                # a schedule artifact names EVERY replica's proposal —
+                # exactly the validity witness set the instance loops
+                # derive from their shared value schedule — so a
+                # single-instance ARTIFACT REPLAY can run the monitors:
+                # the adversarial workout of round_tpu/byz (an
+                # equivocating peer must TRIP agreement, never crash
+                # this driver)
+                import numpy as np
+
+                from round_tpu.fuzz.replay import load_artifact
+                from round_tpu.rv.compile import HostRv, monitor_program
+                from round_tpu.rv.dump import RvRuntime
+
+                program = monitor_program(algo, len(peers))
+                if program is None:
+                    print(f"warning: --rv requested but {args.algo} has "
+                          "no decision plane to monitor; rv disabled",
+                          file=sys.stderr)
+                else:
+                    values = [int(v) for v in
+                              load_artifact(args.chaos_schedule)["values"]]
+                    rv_runtime = RvRuntime(
+                        rv_cfg, node=args.id, n=len(peers),
+                        seed=args.seed, max_rounds=args.max_rounds)
+                    inst_rv = HostRv(
+                        rv_runtime, program, args.instance,
+                        np.asarray(values, dtype=np.int32), values,
+                        gossip=rv_cfg.gossip)
+            elif rv_cfg is not None:
                 # single-instance proposals are per-CLI --value flags:
                 # the validity witness set (every replica's proposal) is
                 # not derivable here, unlike the loops' shared
-                # deterministic schedule
+                # deterministic schedule (or a --chaos-schedule
+                # artifact's recorded proposals)
                 print("warning: --rv applies to the --instances loops "
-                      "(ignored for a single-instance run)",
+                      "or a --chaos-schedule replay (ignored for a "
+                      "plain single-instance run)",
                       file=sys.stderr)
             if args.checkpoint_dir:
                 print("warning: --checkpoint-dir applies to the "
@@ -576,12 +626,22 @@ def main(argv=None) -> int:
                 delay_first_send_ms=args.delay_first_send_ms,
                 nbr_byzantine=args.nbr_byzantine,
                 adaptive=adaptive, wire=args.wire, health=health,
+                rv=inst_rv,
             )
-            res = runner.run(
-                instance_io(algo, args.value),
-                max_rounds=args.max_rounds,
-            )
-            d = decision_scalar(res.decision) if res.decided else None
+            halt = None
+            try:
+                res = runner.run(
+                    instance_io(algo, args.value),
+                    max_rounds=args.max_rounds,
+                )
+            except Exception as e:
+                from round_tpu.rv.dump import RvViolation
+
+                if inst_rv is None or not isinstance(e, RvViolation):
+                    raise
+                halt, res = e, None
+            d = (decision_scalar(res.decision)
+                 if res is not None and res.decided else None)
             dump_decision_log([d])
             if args.linger_ms > 0:
                 from round_tpu.runtime.host import serve_decisions
@@ -589,22 +649,43 @@ def main(argv=None) -> int:
                 serve_decisions(
                     tr, [d], idle_ms=args.linger_ms,
                     adoptable=getattr(algo, "payload_bytes", None) is None)
-            print(json.dumps({
+            summary = {
                 "id": args.id,
-                "decided": res.decided,
+                "decided": res is not None and res.decided,
                 "decision": d,  # null when undecided (never state garbage)
                 # list form so harnesses consume single- and multi-instance
                 # runs uniformly (host_perftest.measure_processes)
                 "decisions": [d],
-                "decided_instances": 1 if res.decided else 0,
-                "rounds": res.rounds_run,
-                "dropped": res.dropped_messages,
-                "timeouts": res.timeouts,
-                "timeout_trajectory": res.timeout_trajectory,
+                "decided_instances": 1 if d is not None else 0,
+                "rounds": res.rounds_run if res is not None else 0,
+                "dropped": (res.dropped_messages
+                            if res is not None else tr.dropped),
+                "timeouts": res.timeouts if res is not None else 0,
+                "timeout_trajectory": (res.timeout_trajectory
+                                       if res is not None else []),
                 # the RESOLVED catch-up send policy (conf + CLI override),
                 # so deployments and tests can audit boolean precedence
                 "send_when_catching_up": args.send_when_catching_up,
-            }))
+            }
+            if args.chaos_schedule:
+                summary["chaos_injected"] = tr.injected
+            if rv_runtime is not None:
+                # the loop drivers' rv summary shape (fill_stats), so
+                # replay harnesses consume both uniformly
+                rv_stats: dict = {}
+                rv_runtime.fill_stats(rv_stats)
+                summary["rv"] = {
+                    "policy": rv_cfg.policy,
+                    "checks": rv_stats.get("rv_checks", 0),
+                    "violations": rv_stats.get("rv_violations", []),
+                    "artifacts": rv_stats.get("rv_artifacts", []),
+                }
+                if halt is not None:
+                    summary["rv"]["halted"] = str(halt)
+                    if halt.artifact:
+                        summary["rv"]["artifacts"] = list(set(
+                            summary["rv"]["artifacts"] + [halt.artifact]))
+            print(json.dumps(summary))
             return 0
 
         # PerfTest2 loop: consecutive instances via the shared helper

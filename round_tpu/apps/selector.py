@@ -60,6 +60,17 @@ def select(name: str, options: Optional[Dict[str, Any]] = None) -> Algorithm:
         from round_tpu.models.tpc import TwoPhaseCommit
 
         return TwoPhaseCommit()
+    if name == "pbft":
+        # byzantine-envelope consensus (models/pbft.py Bcp): a
+        # first-class VALUE-adversary fuzz target (round_tpu/byz)
+        from round_tpu.models.pbft import PbftConsensus
+
+        return PbftConsensus(
+            synchronized=options.get("synchronized", False))
+    if name in ("pbft-vc", "pbftvc"):
+        from round_tpu.models.pbft import PbftViewChange
+
+        return PbftViewChange()
     if name.startswith("rv-broken-"):
         # runtime-verification TEST FIXTURES (round_tpu/rv/fixtures.py):
         # deliberately broken rounds whose violation dumps must be
@@ -71,5 +82,6 @@ def select(name: str, options: Optional[Dict[str, Any]] = None) -> Algorithm:
             return select_fixture(name)
     raise ValueError(
         f"unknown algorithm {name!r} "
-        "(expected otr|lv|lvb|lve|slv|mlv|benor|floodmin|kset|tpc)"
+        "(expected otr|lv|lvb|lve|slv|mlv|benor|floodmin|kset|tpc|"
+        "pbft|pbft-vc)"
     )

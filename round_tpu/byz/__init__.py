@@ -1,0 +1,40 @@
+"""round_tpu.byz — the Byzantine VALUE-adversary engine.
+
+PR 8's fault genome is omission-shaped (crashes, drops, partitions,
+byzantine *silence*); PR 9 proves ``n > Kf`` envelopes whose hard case is
+the adversary that LIES.  This package closes the gap:
+
+  * ``lies``      — per-protocol lie models: how a compromised sender
+                    forges a well-formed payload claiming value ``v``
+                    (digest-consistent for the PBFT family).  ONE
+                    function per protocol, applied by the jitted engine
+                    AND the host wire, so lies are bit-identical across
+                    both worlds.
+  * ``adversary`` — the value-fault tensors (membership mask +
+                    equivocation / stale-replay thresholds), the
+                    counter-hash event formula (per-(round, src, dst)
+                    draws under dedicated streams — equivocation IS
+                    per-destination divergence), the explicit
+                    ``[T, n, n]`` substitution-plan materializer, and
+                    the engine hook ``ValueAdversary`` that
+                    executor.run_phases fuses into the update step.
+  * ``crosscheck``— the proof/fuzzer cross-check harness: in-envelope
+                    sweeps must find ZERO safety violations; past-envelope
+                    sweeps of benign-model protocols must find (and
+                    minimize, and bank) one.  The banked counterexamples
+                    live in tests/regressions/ (the LastVoting
+                    commit-round coordinator equivocation, the OTR
+                    early-victim split) and double as the rv-under-lies
+                    fixtures of tests/test_byz.py.
+"""
+
+from round_tpu.byz.adversary import (  # noqa: F401
+    STREAM_BYZ_STALE,
+    STREAM_BYZ_VAL,
+    ValueAdversary,
+    hash_adversary,
+    plan_adversary,
+    value_events,
+    value_plan,
+)
+from round_tpu.byz.lies import LIE_MODELS, forge_payload, lie_for  # noqa: F401

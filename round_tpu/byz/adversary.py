@@ -1,0 +1,252 @@
+"""The value-adversary fault model as tensors + the engine hook.
+
+Omission families answer "which links deliver"; the value adversary
+answers "what a delivered frame CLAIMS".  Three primitives:
+
+  * ``value_events`` — the ONE counter-hash formula deciding, per
+    (round, src, dst), whether a byzantine-value sender substitutes its
+    payload toward that destination and with which claimed value.
+    Same murmur3 link hash as every other family
+    (scenarios.link_bernoulli's mix) under two dedicated streams, so one
+    (salt0, salt1) pair yields schedules independent of the omission
+    families.  Per-destination draws make EQUIVOCATION the base case:
+    the same sender in the same round claims different values to
+    different receivers.
+
+  * ``value_plan`` — the explicit ``[T, n, n] int32`` substitution plan
+    (``plan[r, dst, src]``): ``VP_NONE`` = truthful, ``VP_STALE`` =
+    replay the sender's previous transmission of this round class,
+    ``v >= 0`` = claim value ``v``.  Bit-identical to what the hash
+    formula draws (the row_sampler/row_schedule pin of PR 8, extended to
+    the value dimension) — the form fuzz/minimize.py delta-debugs,
+    fuzz/replay.py exports, and runtime/chaos.py replays on real wire.
+
+  * ``ValueAdversary`` — the engine hook: executor.run_phases hands it
+    the round's truthful payload tensor and it returns the per-receiver
+    mailbox values, all inside the SAME jitted vmapped evaluation (fuzz
+    throughput stays batched-dispatch-bound).  Stale replay carries each
+    round class's last actually-SENT payload in the scan carry
+    (``prev``), mirroring the host wire's per-class byte cache: a class
+    never sent yet replays nothing (truthful delivery), identically on
+    both worlds.
+"""
+
+from __future__ import annotations
+
+import functools as _functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from round_tpu.byz.lies import LieFn, generic_lie
+from round_tpu.engine import scenarios
+from round_tpu.utils.tree import tree_where
+
+# Value-adversary stream constants: per-(round, link) draws from the one
+# counter-based link hash, disjoint from the omission/silence/wire
+# streams (scenarios / runtime/chaos.py / fuzz/genome.py STREAM_BYZ).
+STREAM_BYZ_VAL = 0xA53F9C71    # substitute? draws (per round, link)
+STREAM_BYZ_STALE = 0xC3D21B85  # stale-replay draws
+STREAM_BYZ_FACE = 0xD7E84A2D   # which FACE (vA/vB) each link hears
+
+# explicit-plan opcodes (plan[r, dst, src])
+VP_NONE = -1   # truthful delivery
+VP_STALE = -2  # replay the sender's previous send of this round class
+
+
+def _link_u32(salt0, salt1, r, n: int, stream: int) -> jnp.ndarray:
+    """[n(recv), n(send)] uint32 — the counter link hash at round r under
+    ``stream`` (the jnp twin of scenarios.host_link_u32, full matrix)."""
+    i = jnp.arange(n, dtype=jnp.uint32)
+    idx = i[:, None] * jnp.uint32(n) + i[None, :]
+    z = idx * jnp.uint32(scenarios.LINK_GOLD) + jnp.asarray(salt0).astype(
+        jnp.uint32)
+    z = z ^ (jnp.asarray(r).astype(jnp.uint32)
+             * jnp.uint32(scenarios.LINK_RMIX)
+             + jnp.asarray(salt1).astype(jnp.uint32)
+             + jnp.uint32(stream))
+    return scenarios._mix32(z)
+
+
+def lie_pair(salt0, salt1, num_values: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The genome's TWO-FACED lie palette ``(vA, vB)``: one pair of
+    claimed values per (salt0, salt1), spanning the value domain.  A
+    hash-mode adversary only ever claims one of these two — the classic
+    split-brain equivocation shape (side A hears vA, side B hears vB),
+    and the shape quorum-steering attacks on digest protocols need: the
+    same face stays consistent across a phase's rounds, so a forged
+    prepare certificate can actually assemble.  (Explicit plans keep
+    full per-event generality — this narrows the SEARCH space, not the
+    replay format.)"""
+    m = jnp.uint32(max(1, num_values))
+    a = scenarios._mix32(jnp.asarray(salt0).astype(jnp.uint32)
+                         ^ jnp.uint32(STREAM_BYZ_VAL))
+    b = scenarios._mix32(jnp.asarray(salt1).astype(jnp.uint32)
+                         + jnp.uint32(STREAM_BYZ_VAL))
+    return (a % m).astype(jnp.int32), (b % m).astype(jnp.int32)
+
+
+def value_events(byz_value, equiv_p8, stale_p8, salt0, salt1, r, n: int,
+                 num_values: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The round-r value-fault draws: ``(sub_v, stale)`` with
+    ``sub_v [n(recv), n(send)] int32`` (claimed value, VP_NONE where
+    truthful) and ``stale [n, n] bool``.  Equivocation wins over stale
+    (the two events are disjoint by construction); the diagonal is never
+    substituted (a process cannot lie to itself — the engines'
+    self-delivery convention)."""
+    byz = jnp.asarray(byz_value)
+    eye = jnp.eye(n, dtype=bool)
+    u = _link_u32(salt0, salt1, r, n, STREAM_BYZ_VAL)
+    equiv = (byz[None, :]
+             & ((u & jnp.uint32(0xFF))
+                < jnp.asarray(equiv_p8).astype(jnp.uint32))
+             & ~eye)
+    # the FACE each (src, dst) link hears is ROUND-INDEPENDENT (drawn at
+    # r=0 under its own stream): an equivocator tells each peer ONE
+    # consistent story, so the per-round draws only gate WHETHER it lies
+    # this round — the shape that lets forged quorum certificates
+    # actually assemble across a phase's rounds
+    va, vb = lie_pair(salt0, salt1, num_values)
+    face = (_link_u32(salt0, salt1, 0, n, STREAM_BYZ_FACE)
+            >> jnp.uint32(8)) & jnp.uint32(1)
+    v = jnp.where(face.astype(bool), va, vb)
+    sub_v = jnp.where(equiv, v, jnp.int32(VP_NONE))
+    u2 = _link_u32(salt0, salt1, r, n, STREAM_BYZ_STALE)
+    stale = (byz[None, :] & ~equiv
+             & ((u2 & jnp.uint32(0xFF))
+                < jnp.asarray(stale_p8).astype(jnp.uint32))
+             & ~eye)
+    return sub_v, stale
+
+
+def _plan_fn(n: int, rounds: int, num_values: int):
+    def materialize(byz_value, equiv_p8, stale_p8, salt0, salt1):
+        def one(r):
+            sub_v, stale = value_events(
+                byz_value, equiv_p8, stale_p8, salt0, salt1, r, n,
+                num_values)
+            return jnp.where(stale, jnp.int32(VP_STALE), sub_v)
+
+        return jax.vmap(one)(jnp.arange(rounds, dtype=jnp.int32))
+
+    return materialize
+
+
+@_functools.lru_cache(maxsize=None)
+def _jitted_plan_fn(n: int, rounds: int, num_values: int):
+    return jax.jit(_plan_fn(n, rounds, num_values))
+
+
+def value_plan(row, rounds: int, num_values: int) -> np.ndarray:
+    """Materialize one genome row dict's value-fault fields into the
+    explicit ``[rounds, n, n] int32`` substitution plan — bit-identical
+    to the draws ``hash_adversary`` makes (pinned by tests/test_byz.py,
+    the value-dimension twin of genome.row_schedule)."""
+    n = int(np.asarray(row["byz_value"]).shape[-1])
+    out = _jitted_plan_fn(n, rounds, num_values)(
+        jnp.asarray(row["byz_value"]), jnp.asarray(row["equiv_p8"]),
+        jnp.asarray(row["stale_p8"]), jnp.asarray(row["salt0"]),
+        jnp.asarray(row["salt1"]))
+    return np.asarray(out)
+
+
+class ValueAdversary:
+    """The engine hook: per-round payload substitution, fused into the
+    jitted round step (engine/executor.py run_round).
+
+    ``events_fn(r) -> (sub_v [n, n] int32, stale [n, n] bool)`` supplies
+    the round's draws (hash- or plan-backed); ``lie`` is the protocol's
+    lie model (byz/lies.py), dispatched on the STATIC round-class index.
+    ``apply`` turns the round's truthful ``[n(send), ...]`` payload tree
+    into per-receiver ``[n(recv), n(send), ...]`` mailbox values and
+    advances the per-class (valid, payload) stale carry."""
+
+    def __init__(self, n: int, rounds_per_phase: int,
+                 events_fn: Callable[[Any], Tuple[jnp.ndarray, jnp.ndarray]],
+                 lie: Optional[LieFn] = None):
+        self.n = n
+        self.k = max(1, rounds_per_phase)
+        self.events_fn = events_fn
+        self.lie = lie or generic_lie
+
+    def init_prev(self, payload_zero) -> Tuple[jnp.ndarray, Any]:
+        """Fresh stale carry for ONE round class: (ever-sent [n] bool,
+        last-sent payload zeros)."""
+        return (jnp.zeros((self.n,), dtype=bool),
+                jax.tree_util.tree_map(jnp.zeros_like, payload_zero))
+
+    def apply(self, j: int, r, payload, dest, prev):
+        """One round's substitution.  ``j`` = static round-class index,
+        ``r`` = traced round number, ``payload`` the truthful
+        ``[n(send), ...]`` tree, ``dest [n(send), n]`` the send mask
+        (whether the sender transmitted at all this round), ``prev`` the
+        class's stale carry.  Returns (values [n(recv), n(send), ...],
+        new prev)."""
+        n = self.n
+        valid, prev_payload = prev
+        sub_v, stale = self.events_fn(r)
+        vmax = jnp.maximum(sub_v, 0)
+
+        lie = self.lie
+
+        def lie_one(p_i, v_i):
+            return lie(j, p_i, v_i)
+
+        # [n_recv, n_send, ...]: inner vmap over senders, outer over the
+        # per-receiver claimed-value rows — equivocation is exactly the
+        # outer axis varying
+        lied = jax.vmap(lambda vrow: jax.vmap(lie_one)(payload, vrow))(vmax)
+
+        sel_equiv = sub_v >= 0
+        sel_stale = stale & valid[None, :]
+
+        def mix(l_lied, l_truth, l_prev):
+            extra = l_truth.ndim - 1
+            se = sel_equiv.reshape(sel_equiv.shape + (1,) * extra)
+            ss = sel_stale.reshape(sel_stale.shape + (1,) * extra)
+            truth = jnp.broadcast_to(l_truth[None], (n,) + l_truth.shape)
+            prevb = jnp.broadcast_to(l_prev[None], (n,) + l_prev.shape)
+            return jnp.where(se, l_lied, jnp.where(ss, prevb, truth))
+
+        values = jax.tree_util.tree_map(
+            lambda a, b, c: mix(a, jnp.asarray(b), jnp.asarray(c)),
+            lied, payload, prev_payload)
+
+        sent = jnp.any(jnp.asarray(dest), axis=1)
+        new_prev = (valid | sent, tree_where(sent, payload, prev_payload))
+        return values, new_prev
+
+
+def hash_adversary(n: int, rounds_per_phase: int, byz_value, equiv_p8,
+                   stale_p8, salt0, salt1, num_values: int,
+                   lie: Optional[LieFn] = None) -> ValueAdversary:
+    """Hash-mode adversary over (possibly traced) genome leaves — what
+    the vmapped population evaluation builds per candidate."""
+    def events(r):
+        return value_events(byz_value, equiv_p8, stale_p8, salt0, salt1,
+                            r, n, num_values)
+
+    return ValueAdversary(n, rounds_per_phase, events, lie=lie)
+
+
+def plan_adversary(n: int, rounds_per_phase: int, plan,
+                   lie: Optional[LieFn] = None) -> ValueAdversary:
+    """Explicit-plan adversary (``plan [T, n, n] int32``, VP_* opcodes).
+    Rounds past the plan clamp to the LAST row — the from_schedule
+    convention, shared with the host wire's lookup."""
+    plan = jnp.asarray(plan, jnp.int32)
+    T = plan.shape[0]
+
+    def events(r):
+        row = plan[jnp.minimum(jnp.asarray(r), T - 1)]
+        return (jnp.where(row >= 0, row, jnp.int32(VP_NONE)),
+                row == jnp.int32(VP_STALE))
+
+    return ValueAdversary(n, rounds_per_phase, events, lie=lie)
+
+
+def plan_is_trivial(plan) -> bool:
+    """True when the plan holds no substitution events at all."""
+    return bool(np.all(np.asarray(plan) == VP_NONE))

@@ -1,0 +1,144 @@
+"""Lie models: how a value adversary forges one round payload.
+
+A lie model is ONE pure function
+
+    lie(k, payload, v) -> forged payload (same pytree structure/shapes)
+
+where ``k`` is the STATIC round-class index (``r % rounds_per_phase``),
+``payload`` is a SINGLE sender's payload pytree for that class (per-lane
+shapes — scalars for OTR/LastVoting, dicts for the PBFT family) and ``v``
+is the claimed value (scalar, traced or concrete).  The same function is
+
+  * vmapped over (receiver, sender) by the jitted engine
+    (byz/adversary.py ValueAdversary) — equivocation is just different
+    ``v`` per destination in the same round;
+  * applied to the DECODED wire payload by the host chaos layer
+    (runtime/chaos.py FaultyTransport value-fault families), then
+    re-encoded — so an engine finding replays byte-equivalently on real
+    sockets (the receiver decodes the identical forged values).
+
+The default ``generic_lie`` claims ``v`` in every leaf (ints -> v, bools
+-> v & 1) — "corrupted but well-formed": the bytes parse, the dtypes and
+shapes are honest, only the VALUES lie.  Protocols that carry integrity
+checks get smarter models: the PBFT forgeries recompute the digest of
+the lied request, so the lie survives the receiver's
+``MessageDigest.isEqual`` recheck — the attack the byzantine literature
+actually means by equivocation.
+
+Everything here must stay jit-safe (jnp only, Python dispatch only on
+the static ``k``): the engine traces these functions inside the vmapped
+population evaluation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+LieFn = Callable[[int, Any, Any], Any]
+
+
+def _claim(leaf, v):
+    """One leaf claiming value ``v``: dtype/shape-preserving broadcast."""
+    leaf = jnp.asarray(leaf)
+    v = jnp.asarray(v)
+    if leaf.dtype == jnp.bool_:
+        out = (v.astype(jnp.int32) % 2).astype(jnp.bool_)
+    else:
+        out = v.astype(leaf.dtype)
+    return jnp.broadcast_to(out, leaf.shape)
+
+
+def generic_lie(k: int, payload: Any, v) -> Any:
+    """Claim ``v`` in every leaf of the payload (the protocol-agnostic
+    forgery).  For value-broadcast protocols (OTR's x, LastVoting's
+    vote/x rounds) this IS the classic equivocation: different
+    destinations hear different well-formed values."""
+    import jax
+
+    del k
+    return jax.tree_util.tree_map(lambda leaf: _claim(leaf, v), payload)
+
+
+def pbft_lie(k: int, payload: Any, v) -> Any:
+    """Digest-consistent forgery for the 3-phase Bcp (models/pbft.py):
+    the lied request ships with the digest OF THE LIE, so the receiver's
+    recheck passes and the lie enters the quorum counting — silence or a
+    torn (req, digest) pair would be caught like a failed
+    MessageDigest.isEqual and degrade to omission."""
+    from round_tpu.models.pbft import digest
+
+    v32 = jnp.asarray(v, jnp.int32)
+    if k == 0:  # pre-prepare: {"req", "dig"}
+        return {"req": _claim(payload["req"], v32),
+                "dig": _claim(payload["dig"], digest(v32))}
+    if k == 1:  # prepare: {"dig", "ok"} — claim a valid matching digest
+        return {"dig": _claim(payload["dig"], digest(v32)),
+                "ok": jnp.broadcast_to(jnp.asarray(True),
+                                       jnp.shape(payload["ok"]))}
+    # commit: bare digest scalar
+    return _claim(payload, digest(v32))
+
+
+def pbft_vc_lie(k: int, payload: Any, v) -> Any:
+    """The PbftViewChange forgery (6-round phases).  View/next-view
+    fields stay TRUTHFUL — a lied view number fails the receivers'
+    same-view filters and collapses to omission; the interesting
+    adversary lies about the VALUE while staying protocol-coherent."""
+    from round_tpu.models.pbft import digest
+
+    v32 = jnp.asarray(v, jnp.int32)
+    if k == 0:  # pre-prepare: {"req", "dig", "view"}
+        return {"req": _claim(payload["req"], v32),
+                "dig": _claim(payload["dig"], digest(v32)),
+                "view": payload["view"]}
+    if k == 1:  # prepare: {"dig", "ok", "view"}
+        return {"dig": _claim(payload["dig"], digest(v32)),
+                "ok": jnp.broadcast_to(jnp.asarray(True),
+                                       jnp.shape(payload["ok"])),
+                "view": payload["view"]}
+    if k == 2:  # commit: {"dig", "view"}
+        return {"dig": _claim(payload["dig"], digest(v32)),
+                "view": payload["view"]}
+    if k == 3:  # view-change: {"nv", "pr", "pv"} — a forged certificate
+        return {"nv": payload["nv"],
+                "pr": _claim(payload["pr"], v32),
+                "pv": payload["pv"]}
+    if k == 4:  # view-change-ack: {"nv", "ackd"} — garbage ack digests
+        return {"nv": payload["nv"],
+                "ackd": _claim(payload["ackd"], digest(v32))}
+    # new-view: {"nv", "sel"} — the equivocating new primary
+    return {"nv": payload["nv"], "sel": _claim(payload["sel"], v32)}
+
+
+#: protocol (selector name) -> lie model; anything absent gets the
+#: generic value-claim forgery.  Keyed on the ARTIFACT protocol string so
+#: engine and host resolve the identical model.
+LIE_MODELS: Dict[str, LieFn] = {
+    "pbft": pbft_lie,
+    "pbft-vc": pbft_vc_lie,
+    "pbftvc": pbft_vc_lie,
+}
+
+
+def lie_for(protocol: str) -> LieFn:
+    return LIE_MODELS.get((protocol or "").lower(), generic_lie)
+
+
+def forge_payload(protocol: str, k: int, payload: Any, v: int) -> Any:
+    """HOST-side forgery: apply the protocol's lie model to a DECODED
+    wire payload (numpy leaves) and return a numpy pytree with the
+    ORIGINAL dtypes/shapes — what runtime/chaos.py re-encodes.  The
+    engine applies the same jnp function under vmap; equal inputs give
+    equal forged values, which is the engine<->host replay fidelity
+    contract (tests/test_byz.py pins it)."""
+    import jax
+
+    lied = lie_for(protocol)(k, payload, int(v))
+    return jax.tree_util.tree_map(
+        lambda orig, new: np.asarray(new).astype(
+            np.asarray(orig).dtype, copy=False).reshape(
+            np.shape(orig)),
+        payload, lied)

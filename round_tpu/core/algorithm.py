@@ -36,11 +36,25 @@ class Algorithm:
         automaton, and the parameterized verifier (verify/param.py) proves
         the quorum lemmas UNDER this condition — so it is a spec-level
         declaration, not documentation.
+      adversary_model: which adversary the fault_envelope's ``f`` counts —
+        "benign" (crash/omission: OTR, LastVoting; a VALUE adversary is
+        outside the model at ANY f, and the byz cross-check treats one
+        liar as past-envelope) or "byzantine" (the PBFT family: f liars
+        are IN the envelope while n > Kf).  Consumed by
+        round_tpu/byz/crosscheck.py to budget the value adversary.
+      decision_null: the decision value the protocol's contract reads as
+        an explicit ABORT (the PBFT family decides null when a quorum
+        fails) — a decided lane holding it satisfies termination but is
+        exempt from the agreement/validity counting
+        (fuzz/objectives.lane_objectives).  None (default) = every
+        decision is a real value.
     """
 
     rounds: Tuple[Round, ...] = ()
     spec = None
     fault_envelope: Optional[str] = None
+    adversary_model: str = "benign"
+    decision_null: Optional[int] = None
 
     @property
     def rounds_per_phase(self) -> int:

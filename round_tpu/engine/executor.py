@@ -70,12 +70,22 @@ class LocalTopology:
         return jax.random.split(key, self.n)
 
 
-def run_round(rnd, state, done, r, ho, key, topo):
+def run_round(rnd, state, done, r, ho, key, topo, adversary=None,
+              adv_class=0, adv_prev=None):
     """Execute one communication-closed round on this chip's lane slice.
 
     `topo` abstracts where lanes live (LocalTopology above, or
     parallel.mesh.ProcShardTopology for the proc-sharded multi-chip path);
     everything else — the send/exchange/update semantics — is shared.
+
+    With an ``adversary`` (byz/adversary.py ValueAdversary), the mailbox
+    VALUES each receiver folds are per-receiver substitutions of the
+    truthful payload tensor (equivocation / stale replay / well-formed
+    corruption), fused into the same vmapped update — the round math is
+    otherwise identical, and ``adversary=None`` traces exactly the
+    pre-existing program.  ``adv_class`` is the static round-class index
+    (lie-model dispatch), ``adv_prev`` the class's stale carry; the
+    adversary path returns ``(state, done, new_prev)``.
     """
     n = topo.n
     ids = topo.lane_ids()
@@ -104,6 +114,27 @@ def run_round(rnd, state, done, r, ho, key, topo):
 
     # update: per-lane fold of the mailbox into the state
     upd_keys = topo.lane_keys(key)
+
+    if adversary is not None:
+        # value adversary: lanes must be local (the substitution tensor is
+        # [n_recv, n_send, ...]; sharded receivers would need their slice)
+        if not isinstance(topo, LocalTopology):  # pragma: no cover
+            raise NotImplementedError(
+                "value adversaries run on LocalTopology only")
+        values, new_prev = adversary.apply(
+            adv_class, r, payload, dest, adv_prev)
+
+        def _update_adv(i, s, mbox_mask, k, vals):
+            ctx = RoundCtx(id=i, n=n, r=r, rng=k)
+            s2 = rnd.update(ctx, s, Mailbox(vals, mbox_mask))
+            return s2, ctx._exit
+
+        new_state, exit_flags = jax.vmap(_update_adv)(
+            ids, state, deliver, upd_keys, values)
+        state = tree_where(active_local, new_state, state)
+        done = jnp.logical_or(done,
+                              jnp.logical_and(active_local, exit_flags))
+        return state, done, new_prev
 
     def _update(i, s, mbox_mask, k):
         ctx = RoundCtx(id=i, n=n, r=r, rng=k)
@@ -143,9 +174,16 @@ def run_phases(
     max_phases: int,
     topo,
     record_fn: Optional[Callable[[Any, jnp.ndarray, jnp.ndarray], Any]] = None,
+    adversary=None,
 ):
     """Scan `max_phases` phases over an initialized lane slice.  Shared by the
-    single-chip and proc-sharded paths."""
+    single-chip and proc-sharded paths.
+
+    With an ``adversary`` (byz/adversary.py ValueAdversary), every round's
+    mailbox values pass through the value-substitution hook (see
+    run_round); the scan carry additionally threads one (ever-sent,
+    last-sent-payload) pair per round class — the stale-replay memory,
+    matching the host wire's per-class byte cache."""
     k_rounds = algo.rounds_per_phase
     assert k_rounds >= 1, "algorithm has no rounds"
     n_local = topo.n_local
@@ -154,8 +192,27 @@ def run_phases(
     decided_round0 = jnp.full((n_local,), -1, dtype=jnp.int32)
     ho_key, upd_key = jax.random.split(key)
 
+    prev0 = ()
+    if adversary is not None:
+        # stale-carry init: one zeros-payload per round class, shaped from
+        # a send trace on state0 (payload shapes are a fixed point across
+        # phases — the lax.scan carry contract roundlint enforces)
+        ids = topo.lane_ids()
+
+        def _payload_zero(j, rnd):
+            def _s(i, s):
+                ctx = RoundCtx(id=i, n=topo.n, r=jnp.int32(j))
+                return rnd.send(ctx, rnd.pre(ctx, s)).payload
+
+            return jax.tree_util.tree_map(
+                jnp.zeros_like, jax.vmap(_s)(ids, state0))
+
+        prev0 = tuple(adversary.init_prev(_payload_zero(j, rnd))
+                      for j, rnd in enumerate(algo.rounds))
+
     def phase_step(carry, phase_idx):
-        state, done, decided_round = carry
+        state, done, decided_round = carry[:3]
+        prev = carry[3] if adversary is not None else None
         recs = []
         for j, rnd in enumerate(algo.rounds):
             r = (phase_idx * k_rounds + j).astype(jnp.int32)
@@ -163,17 +220,30 @@ def run_phases(
             # algorithm randomness comes from folding the round into upd_key.
             ho = ho_sampler(ho_key, r)
             k_upd = jax.random.fold_in(upd_key, r)
-            state, done = run_round(rnd, state, done, r, ho, k_upd, topo)
+            if adversary is not None:
+                state, done, prev_j = run_round(
+                    rnd, state, done, r, ho, k_upd, topo,
+                    adversary=adversary, adv_class=j, adv_prev=prev[j])
+                prev = prev[:j] + (prev_j,) + prev[j + 1:]
+            else:
+                state, done = run_round(rnd, state, done, r, ho, k_upd, topo)
             dec = _decided_or_false(algo, state, n_local)
             decided_round = jnp.where(dec & (decided_round < 0), r, decided_round)
             if record_fn is not None:
                 recs.append(record_fn(state, done, r))
         out = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *recs) if recs else None
-        return (state, done, decided_round), out
+        new_carry = (state, done, decided_round)
+        if adversary is not None:
+            new_carry = new_carry + (prev,)
+        return new_carry, out
 
-    (state, done, decided_round), recorded = jax.lax.scan(
-        phase_step, (state0, done0, decided_round0), jnp.arange(max_phases)
+    carry0 = (state0, done0, decided_round0)
+    if adversary is not None:
+        carry0 = carry0 + (prev0,)
+    final_carry, recorded = jax.lax.scan(
+        phase_step, carry0, jnp.arange(max_phases)
     )
+    state, done, decided_round = final_carry[:3]
 
     if recorded is not None:
         # [phases, k, ...] -> [rounds, ...]

@@ -82,6 +82,18 @@ class FaultMix:
                     test_scripts/oneDownLV.sh analogue)
       p8:           [S] int32 — iid per-link drop threshold (p = p8/256)
       salt0/salt1:  [S] int32 — hash-sampler salts (scenarios._key_salt)
+
+    VALUE-adversary tensors (round_tpu/byz — optional, default None so
+    every omission-only construction site is unchanged; the fused
+    histogram paths ignore them, the general-engine adversary hook
+    (executor.run_phases(adversary=...)) consumes them):
+
+      byz_value:    [S, n] bool — senders that LIE (equivocation /
+                    stale replay / well-formed corruption)
+      equiv_p8:     [S] int32 — per-(round, src, dst) substitution
+                    threshold (p = equiv_p8/256) under STREAM_BYZ_VAL
+      stale_p8:     [S] int32 — stale-replay threshold under
+                    STREAM_BYZ_STALE
     """
 
     crashed: jnp.ndarray
@@ -92,6 +104,9 @@ class FaultMix:
     p8: jnp.ndarray
     salt0: jnp.ndarray
     salt1: jnp.ndarray
+    byz_value: Optional[jnp.ndarray] = None
+    equiv_p8: Optional[jnp.ndarray] = None
+    stale_p8: Optional[jnp.ndarray] = None
 
     @property
     def n(self) -> int:

@@ -51,6 +51,16 @@ STREAM_BYZ = 0xB5F0D1E3
 # teaches nothing; the interesting schedules are sparse (see severity)
 P8_CAP = 232
 
+
+def value_cap_default(n: int) -> int:
+    """The byzantine-VALUE mutation envelope: at most ``(n - 1) // 3``
+    liars (the classic n > 3f budget).  Protocols whose declared
+    envelope is BENIGN (crash/omission — OTR, LastVoting) get cap 0 in
+    the cross-check's in-envelope sweeps (byz/crosscheck.py): a value
+    adversary is outside their fault model by definition."""
+    return max(0, (n - 1) // 3)
+
+
 #: the family blocks crossover inherits wholesale (field name -> leaves)
 FAMILIES: Dict[str, tuple] = {
     "omission": ("p8",),
@@ -58,11 +68,18 @@ FAMILIES: Dict[str, tuple] = {
     "partition": ("side", "heal_round"),
     "rotate": ("rotate_down",),
     "byz": ("byz",),
+    "byzval": ("byz_value", "equiv_p8", "stale_p8"),
     "salts": ("salt0", "salt1"),
 }
 
 _FIELDS = ("crashed", "crash_round", "side", "heal_round", "rotate_down",
-           "p8", "salt0", "salt1", "byz")
+           "p8", "salt0", "salt1", "byz", "byz_value", "equiv_p8",
+           "stale_p8")
+
+#: value-adversary fields absent from a (pre-value-genome) row dict get
+#: these zero defaults — PR-8 rows, banked artifacts and hand-written
+#: test rows stay valid currency
+_VALUE_FIELDS = ("byz_value", "equiv_p8", "stale_p8")
 
 
 @dataclasses.dataclass
@@ -86,6 +103,9 @@ class Population:
     salt0: np.ndarray        # [P] int32
     salt1: np.ndarray        # [P] int32
     byz: np.ndarray          # [P, n] bool
+    byz_value: np.ndarray    # [P, n] bool — value adversaries (byz/)
+    equiv_p8: np.ndarray     # [P] int32 — equivocation threshold /256
+    stale_p8: np.ndarray     # [P] int32 — stale-replay threshold /256
 
     @property
     def size(self) -> int:
@@ -96,7 +116,8 @@ class Population:
         return self.crashed.shape[1]
 
     def mix(self) -> FaultMix:
-        """The FaultMix view (drops byz) — what engine.fast consumes."""
+        """The FaultMix view (drops byz-silence; carries the value
+        tensors) — what engine.fast consumes."""
         return FaultMix(
             crashed=jnp.asarray(self.crashed),
             crash_round=jnp.asarray(self.crash_round),
@@ -106,6 +127,9 @@ class Population:
             p8=jnp.asarray(self.p8),
             salt0=jnp.asarray(self.salt0),
             salt1=jnp.asarray(self.salt1),
+            byz_value=jnp.asarray(self.byz_value),
+            equiv_p8=jnp.asarray(self.equiv_p8),
+            stale_p8=jnp.asarray(self.stale_p8),
         )
 
     def leaves(self) -> tuple:
@@ -123,6 +147,9 @@ class Population:
 
     @classmethod
     def from_rows(cls, rows) -> "Population":
+        rows = [dict(r) for r in rows]
+        for r in rows:
+            _fill_value_fields(r)
         return cls(**{f: np.stack([np.asarray(r[f]) for r in rows])
                       for f in _FIELDS})
 
@@ -132,11 +159,25 @@ class Population:
         # np.array(copy=True): jax device arrays view as read-only numpy,
         # and the genetic operators mutate in place
         kw = {f: np.array(getattr(mix, f))
-              for f in _FIELDS if f != "byz"}
+              for f in _FIELDS
+              if f != "byz" and getattr(mix, f, None) is not None}
         P, n = kw["crashed"].shape
         kw["byz"] = (np.zeros((P, n), dtype=bool) if byz is None
                      else np.asarray(byz, dtype=bool))
+        kw.setdefault("byz_value", np.zeros((P, n), dtype=bool))
+        kw.setdefault("equiv_p8", np.zeros((P,), dtype=np.int32))
+        kw.setdefault("stale_p8", np.zeros((P,), dtype=np.int32))
         return cls(**kw)
+
+
+def _fill_value_fields(row: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """In-place: default the value-adversary fields of a row dict to
+    zeros (the truthful adversary) — pre-value-genome rows stay valid."""
+    n = int(np.asarray(row["crashed"]).shape[-1])
+    row.setdefault("byz_value", np.zeros((n,), dtype=bool))
+    row.setdefault("equiv_p8", np.int32(0))
+    row.setdefault("stale_p8", np.int32(0))
+    return row
 
 
 # ---------------------------------------------------------------------------
@@ -198,6 +239,12 @@ def schedule_fn(n: int, rounds: int):
     return materialize
 
 
+#: the fields schedule_fn consumes — the DELIVERY half of the genome;
+#: the value-adversary fields materialize separately (row_value_plan)
+_SCHEDULE_FIELDS = ("crashed", "crash_round", "side", "heal_round",
+                    "rotate_down", "p8", "salt0", "salt1", "byz")
+
+
 @_functools.lru_cache(maxsize=None)
 def _jitted_schedule_fn(n: int, rounds: int):
     return jax.jit(schedule_fn(n, rounds))
@@ -208,8 +255,20 @@ def row_schedule(row: Dict[str, np.ndarray], rounds: int) -> np.ndarray:
     deliver schedule (jit cached per (n, rounds))."""
     n = int(np.asarray(row["crashed"]).shape[-1])
     out = _jitted_schedule_fn(n, rounds)(
-        *[jnp.asarray(row[f]) for f in _FIELDS])
+        *[jnp.asarray(row[f]) for f in _SCHEDULE_FIELDS])
     return np.asarray(out)
+
+
+def row_value_plan(row: Dict[str, np.ndarray], rounds: int,
+                   num_values: int) -> np.ndarray:
+    """Materialize one genome row's VALUE-fault fields into the explicit
+    [rounds, n, n] int32 substitution plan (byz/adversary.py opcodes) —
+    bit-identical to the hash-mode draws the vmapped evaluation makes,
+    exactly as row_schedule is for the delivery mask."""
+    from round_tpu.byz import adversary as _adv
+
+    row = _fill_value_fields(dict(row))
+    return _adv.value_plan(row, rounds, num_values)
 
 
 # ---------------------------------------------------------------------------
@@ -230,11 +289,17 @@ def severity(pop: Population, horizon: int) -> np.ndarray:
     # a partition only costs while it is active and actually splits
     split = (pop.side.max(axis=1) != pop.side.min(axis=1))
     part_frac = split * np.clip(pop.heal_round / h, 0.0, 1.0)
+    # value adversaries: rent scales with membership AND lie intensity —
+    # a surgical one-liar/one-round equivocation must outscore a
+    # spray-everything liar that hurts equally (the minimizer pressure)
+    value_frac = pop.byz_value.mean(axis=1) * np.clip(
+        (pop.equiv_p8 + pop.stale_p8) / 256.0, 0.0, 1.0)
     return (pop.p8 / 256.0
             + crash_frac
             + 0.5 * part_frac
             + 0.25 * (pop.rotate_down > 0)
-            + 0.5 * pop.byz.mean(axis=1)).astype(np.float64)
+            + 0.5 * pop.byz.mean(axis=1)
+            + 0.75 * value_frac).astype(np.float64)
 
 
 # ---------------------------------------------------------------------------
@@ -277,13 +342,20 @@ def _flip_one_capped(rng: np.random.Generator, mask_rows: np.ndarray,
 
 
 def mutate(rng: np.random.Generator, pop: Population, horizon: int,
-           rate: float = 0.9) -> Population:
-    """Per-family point mutations: each row draws ~1-2 of the six family
-    operators.  Returns a NEW population (inputs untouched)."""
+           rate: float = 0.9,
+           value_cap: Optional[int] = None) -> Population:
+    """Per-family point mutations: each row draws ~1-2 of the seven
+    family operators.  ``value_cap`` bounds the byzantine-VALUE
+    membership per row (default ``(n-1)//3`` — the envelope cap; 0 keeps
+    the value adversary OUT of the gene pool entirely, the benign-model
+    in-envelope sweeps of byz/crosscheck.py).  Returns a NEW population
+    (inputs untouched)."""
     P, n = pop.size, pop.n
     out = pop.take(np.arange(P))  # deep copy via fancy-index
     h = max(1, horizon)
-    ops = rng.random((P, 6)) < (rate / 3.0)
+    if value_cap is None:
+        value_cap = value_cap_default(n)
+    ops = rng.random((P, 7)) < (rate / 3.0)
 
     r = np.flatnonzero(ops[:, 0])      # omission intensity
     out.p8[r] = np.clip(out.p8[r] + rng.integers(-48, 49, r.size),
@@ -314,6 +386,29 @@ def mutate(rng: np.random.Generator, pop: Population, horizon: int,
         .astype(np.int64).astype(np.int32)
     out.salt1[r] = rng.integers(0, 2**32, r.size, dtype=np.uint32) \
         .astype(np.int64).astype(np.int32)
+
+    r = np.flatnonzero(ops[:, 6])      # value-adversary family
+    if value_cap > 0:
+        _flip_one_capped(rng, out.byz_value, r, cap=value_cap)
+        out.equiv_p8[r] = np.clip(
+            out.equiv_p8[r] + rng.integers(-64, 65, r.size), 0, P8_CAP
+        ).astype(np.int32)
+        out.stale_p8[r] = np.clip(
+            out.stale_p8[r] + rng.integers(-48, 49, r.size), 0, P8_CAP
+        ).astype(np.int32)
+    else:
+        # cap 0 = the benign fault model: the family stays OFF, and any
+        # inherited value genes are scrubbed (crossover with a capped
+        # parent must not smuggle lies into an in-envelope sweep)
+        out.byz_value[:] = False
+        out.equiv_p8[:] = 0
+        out.stale_p8[:] = 0
+    # over-cap rows (a raised-then-lowered cap, hand-seeded rows) are
+    # trimmed back to the envelope, highest-index members first
+    over = np.flatnonzero(out.byz_value.sum(axis=1) > max(value_cap, 0))
+    for i in over:
+        members = np.flatnonzero(out.byz_value[i])
+        out.byz_value[i, members[max(value_cap, 0):]] = False
     return out
 
 

@@ -39,26 +39,64 @@ SAFETY_WEIGHT = 100.0
 
 def lane_objectives(decided: jnp.ndarray, decision: jnp.ndarray,
                     decided_round: jnp.ndarray, init_values: jnp.ndarray,
-                    horizon: int) -> Dict[str, jnp.ndarray]:
+                    horizon: int,
+                    honest: jnp.ndarray = None,
+                    null_value=None,
+                    extra_valid: jnp.ndarray = None) -> Dict[str, jnp.ndarray]:
     """Per-candidate objective components from a batched engine outcome.
 
     Args (all leading axis [P]): decided [P, n] bool, decision [P, n],
     decided_round [P, n] int32 (-1 = never), init_values [n] (the
     proposals — Validity's witness set), horizon = rounds simulated.
     Returns a dict of [P] arrays (floats/int32) — jit-safe.
+
+    ``honest`` ([P, n] bool, default all-True) scopes the SAFETY terms to
+    non-byzantine lanes — the byzantine-consensus reading of the Spec
+    (round_tpu/byz): a value adversary's in-engine lane state is a
+    fiction (a real liar has no honest state to judge), so agreement is
+    counted over honest PAIRS and validity over honest deciders.  The
+    witness set stays ALL proposals — a liar's declared initial value is
+    a legitimate input, its wire forgeries are not.  Liveness terms stay
+    global: a liar that stalls everyone still scores.
+
+    ``null_value`` (Algorithm.decision_null) marks an explicit
+    abort/null decision the protocol's contract permits (the PBFT
+    family's decide(null)): null deciders leave the SAFETY terms —
+    agreement is over pairs of non-null deciders, validity over non-null
+    decisions — but still count as decided for the liveness terms (the
+    instance terminated; mass-null is liveness damage only through
+    decide_round, exactly the reference Spec's reading).
+
+    ``extra_valid`` ([P, n] bool) widens Validity's witness set per
+    candidate: True where the lane's decision is a value an ACTIVE liar
+    claimed on the wire (round_tpu/byz).  A lie-sourced value is an
+    INPUT to the system — a byzantine PBFT primary fabricating a request
+    that every honest replica then accepts is correct protocol behavior,
+    not a validity bug; the violation Validity still catches is a value
+    nobody (honest or lying) ever introduced.  Agreement is unaffected:
+    two honest deciders splitting over the liar's two faces is the
+    violation the cross-check hunts.
     """
     und = 1.0 - jnp.mean(decided.astype(jnp.float32), axis=1)
     dr = jnp.where(decided_round < 0, horizon, decided_round)
     decide_round = jnp.max(dr, axis=1).astype(jnp.int32)
 
-    both = decided[:, :, None] & decided[:, None, :]
+    if honest is None:
+        hdec = decided
+    else:
+        hdec = decided & jnp.asarray(honest)
+    if null_value is not None:
+        hdec = hdec & (decision != jnp.asarray(null_value))
+    both = hdec[:, :, None] & hdec[:, None, :]
     diff = decision[:, :, None] != decision[:, None, :]
     agreement_viol = (jnp.sum((both & diff).astype(jnp.int32), axis=(1, 2))
                       // 2)
 
     valid = jnp.any(
         decision[:, :, None] == init_values[None, None, :], axis=2)
-    validity_viol = jnp.sum((decided & ~valid).astype(jnp.int32), axis=1)
+    if extra_valid is not None:
+        valid = valid | jnp.asarray(extra_valid)
+    validity_viol = jnp.sum((hdec & ~valid).astype(jnp.int32), axis=1)
 
     return {
         "undecided": und,

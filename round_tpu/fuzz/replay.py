@@ -1,14 +1,17 @@
 """Schedule artifacts: a TPU/CPU-sim finding as a portable, replayable file.
 
 The artifact is a small JSON document naming exactly the (round, dst, src)
-link events a minimized schedule drops, the proposals, and the RECORDED
-outcome on both worlds:
+link events a minimized schedule drops, the VALUE events a byzantine
+sender forges (schema v2, round_tpu/byz), the proposals, and the
+RECORDED outcome on both worlds:
 
   {
-    "kind": "round_tpu.fuzz.schedule", "version": 1,
+    "kind": "round_tpu.fuzz.schedule", "version": 2,
     "protocol": "otr", "n": 4, "rounds": 12, "seed": 0,
     "values": [0, 1, 2, 3],
     "drops": [[r, dst, src], ...],          # off-diagonal, deliver=False
+    "value_subs": [[r, dst, src, v], ...],  # v2: claimed-value forgeries
+    "stale_subs": [[r, dst, src], ...],     # v2: stale-round replays
     "expected": {
       "engine": {"decided": [...], "decision": [...],
                  "decided_round": [...]},
@@ -16,6 +19,10 @@ outcome on both worlds:
     },
     "meta": {...}                            # provenance (free-form)
   }
+
+Version 1 artifacts (drops only) load unchanged; an artifact is written
+as v1 unless it carries value events, so the PR-8 regression bank stays
+byte-compatible with older readers.
 
 Replay surfaces:
   * engine — `scenarios.from_schedule` through the SAME batched evaluator
@@ -43,7 +50,7 @@ import numpy as np
 from round_tpu.obs.metrics import METRICS
 
 ARTIFACT_KIND = "round_tpu.fuzz.schedule"
-ARTIFACT_VERSION = 1
+ARTIFACT_VERSION = 2
 
 
 # ---------------------------------------------------------------------------
@@ -53,9 +60,12 @@ ARTIFACT_VERSION = 1
 
 def make_artifact(*, protocol: str, schedule: np.ndarray,
                   values: np.ndarray, seed: int = 0,
+                  value_plan: Optional[np.ndarray] = None,
                   engine_outcome: Optional[Dict[str, Any]] = None,
                   host_outcome: Optional[Dict[str, Any]] = None,
                   meta: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    from round_tpu.byz.adversary import VP_STALE, plan_is_trivial
+
     schedule = np.asarray(schedule, dtype=bool)
     T, n, n2 = schedule.shape
     if n != n2:
@@ -65,9 +75,12 @@ def make_artifact(*, protocol: str, schedule: np.ndarray,
         raise ValueError("self-delivery must be True in every round "
                          "(the engines' HO convention)")
     drops = np.argwhere(~schedule & ~eye[None, :, :])
+    has_values = value_plan is not None and not plan_is_trivial(value_plan)
     art: Dict[str, Any] = {
         "kind": ARTIFACT_KIND,
-        "version": ARTIFACT_VERSION,
+        # v1 unless the artifact actually carries value events: the PR-8
+        # drop-only bank keeps its wire format
+        "version": ARTIFACT_VERSION if has_values else 1,
         "protocol": protocol,
         "n": int(n),
         "rounds": int(T),
@@ -76,6 +89,21 @@ def make_artifact(*, protocol: str, schedule: np.ndarray,
         "drops": [[int(r), int(d), int(s)] for r, d, s in drops],
         "expected": {},
     }
+    if has_values:
+        plan = np.asarray(value_plan, dtype=np.int32)
+        if plan.shape != schedule.shape:
+            raise ValueError(
+                f"value plan {plan.shape} != schedule {schedule.shape}")
+        if np.any(plan[:, eye] != -1):
+            raise ValueError("value events must be off-diagonal "
+                             "(a process cannot lie to itself)")
+        subs = np.argwhere(plan >= 0)
+        stale = np.argwhere(plan == VP_STALE)
+        art["value_subs"] = [
+            [int(r), int(d), int(s), int(plan[r, d, s])]
+            for r, d, s in subs]
+        art["stale_subs"] = [[int(r), int(d), int(s)]
+                             for r, d, s in stale]
     if engine_outcome is not None:
         art["expected"]["engine"] = engine_outcome
     if host_outcome is not None:
@@ -88,9 +116,16 @@ def make_artifact(*, protocol: str, schedule: np.ndarray,
 def dump_artifact(path: str, art: Dict[str, Any]) -> None:
     if art.get("kind") != ARTIFACT_KIND:
         raise ValueError(f"not a fuzz schedule artifact: {art.get('kind')!r}")
-    with open(path, "w") as fh:
+    # write-then-rename: several replicas of one cluster can dump the
+    # SAME violation path concurrently (rv/dump.py names artifacts by
+    # (protocol, inst, label), not by node) — a plain open(path, "w")
+    # interleaves and a reader sees torn JSON; with replace() readers
+    # only ever see one writer's complete document
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as fh:
         json.dump(art, fh, indent=1, sort_keys=True)
         fh.write("\n")
+    os.replace(tmp, path)
     METRICS.counter("fuzz.exports").inc()
 
 
@@ -109,6 +144,13 @@ def load_artifact(path: str) -> Dict[str, Any]:
     for r, d, s in art.get("drops", []):
         if not (0 <= r < T and 0 <= d < n and 0 <= s < n and d != s):
             raise ValueError(f"{path}: bad drop event {(r, d, s)}")
+    for r, d, s, v in art.get("value_subs", []):
+        if not (0 <= r < T and 0 <= d < n and 0 <= s < n and d != s
+                and v >= 0):
+            raise ValueError(f"{path}: bad value event {(r, d, s, v)}")
+    for r, d, s in art.get("stale_subs", []):
+        if not (0 <= r < T and 0 <= d < n and 0 <= s < n and d != s):
+            raise ValueError(f"{path}: bad stale event {(r, d, s)}")
     return art
 
 
@@ -119,6 +161,24 @@ def schedule_from_artifact(art: Dict[str, Any]) -> np.ndarray:
     for r, d, s in art.get("drops", []):
         sched[r, d, s] = False
     return sched
+
+
+def value_plan_from_artifact(art: Dict[str, Any]) -> Optional[np.ndarray]:
+    """[rounds, n, n] int32 substitution plan (byz/adversary.py opcodes),
+    or None for a drops-only (v1) artifact."""
+    from round_tpu.byz.adversary import VP_NONE, VP_STALE
+
+    subs = art.get("value_subs", [])
+    stale = art.get("stale_subs", [])
+    if not subs and not stale:
+        return None
+    n, T = int(art["n"]), int(art["rounds"])
+    plan = np.full((T, n, n), VP_NONE, dtype=np.int32)
+    for r, d, s, v in subs:
+        plan[r, d, s] = v
+    for r, d, s in stale:
+        plan[r, d, s] = VP_STALE
+    return plan
 
 
 def _outcome_json(decided, decision, rounds_key: str, rounds) -> Dict:
@@ -148,10 +208,14 @@ def _target_for(art: Dict[str, Any], seed: Optional[int] = None):
 
 
 def replay_engine(art: Dict[str, Any]) -> Dict[str, Any]:
-    """Run the artifact's schedule through the batched engine; returns the
-    outcome in artifact form (expected.engine's schema)."""
+    """Run the artifact's schedule (and value plan, for v2) through the
+    batched engine; returns the outcome in artifact form
+    (expected.engine's schema)."""
     target = _target_for(art)
-    out = target.evaluate_schedules(schedule_from_artifact(art)[None])
+    vplan = value_plan_from_artifact(art)
+    out = target.evaluate_schedules(
+        schedule_from_artifact(art)[None],
+        None if vplan is None else vplan[None])
     METRICS.counter("fuzz.replays").inc()
     return _outcome_json(
         np.asarray(out["decided"][0]), np.asarray(out["decision"][0]),
@@ -232,6 +296,7 @@ def replay_host_threads(art: Dict[str, Any], *, timeout_ms: int = 250,
 
     n = int(art["n"])
     schedule = schedule_from_artifact(art)
+    vplan = value_plan_from_artifact(art)
     algo = _shared_algo(art["protocol"])
     _warm_host_round_fns(algo, n)
     ports = alloc_ports(n)
@@ -241,7 +306,10 @@ def replay_host_threads(art: Dict[str, Any], *, timeout_ms: int = 250,
 
     def node(i):
         tr0 = HostTransport(i, peers[i][1], proto=proto)
-        tr = FaultyTransport(tr0, FaultPlan(), n, schedule=schedule)
+        tr = FaultyTransport(tr0, FaultPlan(), n, schedule=schedule,
+                             value_plan=vplan,
+                             protocol=art["protocol"],
+                             rounds_per_phase=algo.rounds_per_phase)
         try:
             runner = HostRunner(algo, i, peers, tr, timeout_ms=timeout_ms)
             results[i] = runner.run(
@@ -272,11 +340,20 @@ def replay_host_threads(art: Dict[str, Any], *, timeout_ms: int = 250,
 
 def run_schedule_cluster(workdir: str, artifact_path: str, *,
                          timeout_ms: int = 250, proto: str = "tcp",
-                         join_timeout: float = 150.0) -> Dict[str, Any]:
+                         join_timeout: float = 150.0,
+                         rv: Optional[str] = None,
+                         rv_gossip=False,
+                         algo_opts: Optional[Dict[str, Any]] = None
+                         ) -> Dict[str, Any]:
     """Replay on a REAL MULTI-PROCESS cluster: n apps/host_replica
     subprocesses, each wrapping its wire in the explicit-schedule
-    FaultyTransport (--chaos-schedule).  Returns the outcome in artifact
-    form plus the raw per-replica summaries."""
+    FaultyTransport (--chaos-schedule; a v2 artifact's value-fault plan
+    rides along automatically).  With ``rv``, each replica additionally
+    runs the runtime-verification monitors at that policy (the artifact's
+    proposal vector is the validity witness set) — the adversarial
+    workout for round_tpu/rv: an equivocating peer must TRIP the
+    agreement monitor, never crash the driver.  Returns the outcome in
+    artifact form plus the raw per-replica summaries."""
     import subprocess
 
     from round_tpu.runtime.chaos import alloc_ports, cluster_env
@@ -289,14 +366,26 @@ def run_schedule_cluster(workdir: str, artifact_path: str, *,
     env = cluster_env()
 
     def argv(i: int):
-        return [sys.executable, "-m", "round_tpu.apps.host_replica",
-                "--id", str(i), "--peers", peer_arg,
-                "--algo", art["protocol"],
-                "--value", str(int(art["values"][i])),
-                "--timeout-ms", str(timeout_ms),
-                "--max-rounds", str(int(art["rounds"])),
-                "--proto", proto,
-                "--chaos-schedule", artifact_path]
+        a = [sys.executable, "-m", "round_tpu.apps.host_replica",
+             "--id", str(i), "--peers", peer_arg,
+             "--algo", art["protocol"],
+             "--value", str(int(art["values"][i])),
+             "--timeout-ms", str(timeout_ms),
+             "--max-rounds", str(int(art["rounds"])),
+             "--proto", proto,
+             "--chaos-schedule", artifact_path]
+        for k, v in (algo_opts or {}).items():
+            a += ["--algo-opt", f"{k}={v}"]
+        if rv:
+            a += ["--rv", rv,
+                  "--rv-dir", os.path.join(workdir, f"rv-{i}")]
+            # rv_gossip: True = every replica gossips decisions; a
+            # collection of node ids scopes it (the byz workout keeps
+            # the equivocation VICTIM silent so its early decision
+            # cannot convert the honest camp before it decides)
+            if rv_gossip is True or (rv_gossip and i in rv_gossip):
+                a += ["--rv-gossip"]
+        return a
 
     procs = [subprocess.Popen(argv(i), stdout=subprocess.PIPE,
                               stderr=subprocess.PIPE, text=True, env=env)

@@ -43,6 +43,14 @@ class FuzzTarget:
     discipline, so genome-eval and schedule-eval are bit-comparable.
     Both return numpy outcome dicts (decided/decision/decided_round +
     objective components + per-candidate coverage bits).
+
+    VALUE adversaries (round_tpu/byz): the genome's byz_value/equiv_p8/
+    stale_p8 fields drive per-(round, src, dst) payload substitution
+    through the protocol's lie model, fused into the same jitted vmapped
+    evaluation; ``evaluate_schedules(scheds, value_plans=...)`` is the
+    explicit-plan twin.  ``value_domain`` is the claimed-value range
+    (proposals plus one fabricable non-proposal, so validity attacks are
+    expressible); safety objectives are scoped to HONEST lanes.
     """
 
     name: str
@@ -53,13 +61,20 @@ class FuzzTarget:
     rounds_per_phase: int
     init_values: np.ndarray            # [n] proposals
     seed: int
+    value_domain: int = 0              # claimed-value range for lies
     _eval: Callable = dataclasses.field(repr=False, default=None)
-    _eval_sched: Dict[int, Callable] = dataclasses.field(
+    _eval_sched: Dict[Any, Callable] = dataclasses.field(
         repr=False, default_factory=dict)
 
     @property
     def n_cells(self) -> int:
         return self.horizon * CELLS_PER_ROUND
+
+    @property
+    def lie(self):
+        from round_tpu.byz.lies import lie_for
+
+        return lie_for(self.name)
 
     # -- batched evaluation -------------------------------------------------
 
@@ -73,47 +88,69 @@ class FuzzTarget:
         res["severity"] = sev
         return res
 
-    def evaluate_schedules(self, schedules: np.ndarray
+    def evaluate_schedules(self, schedules: np.ndarray,
+                           value_plans: Optional[np.ndarray] = None
                            ) -> Dict[str, np.ndarray]:
-        """Outcomes of explicit deliver schedules [K, T, n, n] bool.  K is
-        padded up to a power of two (repeating the last row) so the
-        minimizer's shrinking batches hit a handful of compiled shapes
-        instead of one per K."""
+        """Outcomes of explicit deliver schedules [K, T, n, n] bool, each
+        optionally paired with a value-substitution plan [K, T, n, n]
+        int32 (byz/adversary.py opcodes).  K is padded up to a power of
+        two (repeating the last row) so the minimizer's shrinking batches
+        hit a handful of compiled shapes instead of one per K."""
         schedules = np.asarray(schedules, dtype=bool)
         K, T = schedules.shape[0], schedules.shape[1]
         if T != self.horizon:
             raise ValueError(
                 f"schedule length {T} != target horizon {self.horizon}")
+        if value_plans is not None:
+            value_plans = np.asarray(value_plans, dtype=np.int32)
+            if value_plans.shape != schedules.shape:
+                raise ValueError(
+                    f"value plans {value_plans.shape} != schedules "
+                    f"{schedules.shape}")
         K_pad = 1 << max(0, (K - 1).bit_length())
         if K_pad != K:
             pad = np.repeat(schedules[-1:], K_pad - K, axis=0)
             schedules = np.concatenate([schedules, pad], axis=0)
-        fn = self._eval_sched.get(K_pad)
+            if value_plans is not None:
+                vpad = np.repeat(value_plans[-1:], K_pad - K, axis=0)
+                value_plans = np.concatenate([value_plans, vpad], axis=0)
+        key = (K_pad, value_plans is not None)
+        fn = self._eval_sched.get(key)
         if fn is None:
-            fn = jax.jit(self._make_schedule_eval())
-            self._eval_sched[K_pad] = fn
-        out = fn(jnp.asarray(schedules))
+            fn = jax.jit(self._make_schedule_eval(
+                with_plan=value_plans is not None))
+            self._eval_sched[key] = fn
+        if value_plans is None:
+            out = fn(jnp.asarray(schedules))
+        else:
+            out = fn(jnp.asarray(schedules), jnp.asarray(value_plans))
         METRICS.counter("fuzz.dispatches").inc()
         METRICS.counter("fuzz.candidates").inc(int(schedules.shape[0]))
         return {k: np.asarray(v)[:K] for k, v in out.items()}
 
     # -- construction helpers ----------------------------------------------
 
-    def _run_one(self, sampler):
+    def _run_one(self, sampler, adversary=None):
         topo = LocalTopology(self.n)
         io = {"initial_value": jnp.asarray(self.init_values)}
         state0 = init_lanes(self.algo, io, self.n, topo)
         key = jax.random.PRNGKey(self.seed)
         st, done, dround, _ = run_phases(
-            self.algo, state0, key, sampler, self.phases, topo)
+            self.algo, state0, key, sampler, self.phases, topo,
+            adversary=adversary)
         return st, done, dround
 
-    def _outcome(self, st, done, dround):
+    def _outcome(self, st, done, dround, honest=None, claimed_fn=None):
         decided = self.algo.decided(st)
         decision = jnp.asarray(self.algo.decision(st))
+        # lie-sourced decisions are valid inputs (objectives.lane_objectives
+        # extra_valid); claimed_fn(decision) -> [P, n] bool marks them
+        extra_valid = None if claimed_fn is None else claimed_fn(decision)
         obj = objectives.lane_objectives(
             decided, decision, dround,
-            jnp.asarray(self.init_values), self.horizon)
+            jnp.asarray(self.init_values), self.horizon, honest=honest,
+            null_value=getattr(self.algo, "decision_null", None),
+            extra_valid=extra_valid)
         return {
             "decided": decided,
             "decision": decision,
@@ -144,20 +181,42 @@ class FuzzTarget:
         return bits.reshape(-1)
 
     def _make_genome_eval(self):
+        from round_tpu.byz.adversary import hash_adversary, lie_pair
+
+        lie = self.lie
+
         def one(crashed, crash_round, side, heal_round, rotate_down, p8,
-                salt0, salt1, byz):
+                salt0, salt1, byz, byz_value, equiv_p8, stale_p8):
             samp = genome.row_sampler(
                 self.n, crashed, crash_round, side, heal_round,
                 rotate_down, p8, salt0, salt1, byz)
-            st, done, dround = self._run_one(samp)
+            adv = hash_adversary(
+                self.n, self.rounds_per_phase, byz_value, equiv_p8,
+                stale_p8, salt0, salt1, self.value_domain, lie=lie)
+            st, done, dround = self._run_one(samp, adversary=adv)
             return st, done, dround, self._coverage_bits(samp)
 
         def ev(crashed, crash_round, side, heal_round, rotate_down, p8,
-               salt0, salt1, byz, sev):
+               salt0, salt1, byz, byz_value, equiv_p8, stale_p8, sev):
             st, done, dround, cov = jax.vmap(one)(
                 crashed, crash_round, side, heal_round, rotate_down, p8,
-                salt0, salt1, byz)
-            out = self._outcome(st, done, dround)
+                salt0, salt1, byz, byz_value, equiv_p8, stale_p8)
+            # honest = cannot lie: safety objectives are scoped to
+            # non-value-adversary lanes (objectives.lane_objectives)
+            honest = ~(byz_value
+                       & ((equiv_p8 > 0) | (stale_p8 > 0))[:, None])
+            # an active equivocator's two faces (adversary.lie_pair) are
+            # lie-sourced inputs: deciding one is not a validity bug
+            equiv_active = (jnp.any(byz_value, axis=1) & (equiv_p8 > 0))
+            va, vb = lie_pair(salt0, salt1, self.value_domain)
+
+            def claimed(decision):
+                hit = ((decision == va[:, None])
+                       | (decision == vb[:, None]))
+                return equiv_active[:, None] & hit
+
+            out = self._outcome(st, done, dround, honest=honest,
+                                claimed_fn=claimed)
             out["coverage"] = cov
             # the combined objective rides the same dispatch (the ISSUE's
             # "lane scores computed inside the jitted step")
@@ -167,28 +226,59 @@ class FuzzTarget:
 
         return ev
 
-    def _make_schedule_eval(self):
-        def one(sched):
+    def _make_schedule_eval(self, with_plan: bool = False):
+        from round_tpu.byz.adversary import VP_NONE, plan_adversary
+
+        lie = self.lie
+
+        def one(sched, plan=None):
             samp = lambda key, r: sched[  # noqa: E731
                 jnp.minimum(r, sched.shape[0] - 1)]
-            st, done, dround = self._run_one(samp)
+            adv = None
+            if plan is not None:
+                adv = plan_adversary(self.n, self.rounds_per_phase, plan,
+                                     lie=lie)
+            st, done, dround = self._run_one(samp, adversary=adv)
             return st, done, dround
 
         def ev(schedules):
             st, done, dround = jax.vmap(one)(schedules)
             return self._outcome(st, done, dround)
 
-        return ev
+        def ev_plan(schedules, plans):
+            st, done, dround = jax.vmap(one)(schedules, plans)
+            # honest = senders the plan never substitutes for
+            honest = ~jnp.any(plans != VP_NONE, axis=(1, 2))
+
+            def claimed(decision):
+                # lie-sourced decisions are valid inputs, matching the
+                # genome path's extra_valid semantics: a decision equal
+                # to ANY value the plan claims (>= 0 entries) is excused
+                # from the validity count — without this, ddmin through
+                # the plan evaluator would score phantom validity
+                # violations the genome evaluator never saw
+                sub = plans[:, :, :, :, None]
+                hit = (sub == decision[:, None, None, None, :]) & (sub >= 0)
+                return jnp.any(hit, axis=(1, 2, 3))
+
+            return self._outcome(st, done, dround, honest=honest,
+                                 claimed_fn=claimed)
+
+        return ev_plan if with_plan else ev
 
 
 def make_target(algo_name: str, n: int, horizon: int, seed: int = 0,
                 values: Optional[np.ndarray] = None,
-                algo_options: Optional[dict] = None) -> FuzzTarget:
+                algo_options: Optional[dict] = None,
+                value_domain: Optional[int] = None) -> FuzzTarget:
     """Build a FuzzTarget for a selector-registered protocol.
 
     `horizon` is rounded UP to whole phases.  Default proposals are the
     "mixed" shape (i % 4 + distinctness) so agreement is non-trivial; pass
-    `values` to pin them (they are recorded in exported artifacts)."""
+    `values` to pin them (they are recorded in exported artifacts).
+    ``value_domain`` bounds the values a lie can claim (default: the
+    proposal range plus ONE fabricable non-proposal, so equivocation and
+    validity attacks are both in the search space)."""
     from round_tpu.apps.selector import select
 
     algo = select(algo_name, algo_options or {})
@@ -200,9 +290,12 @@ def make_target(algo_name: str, n: int, horizon: int, seed: int = 0,
         values = np.asarray(values, dtype=np.int32)
         if values.shape != (n,):
             raise ValueError(f"values must be [n={n}], got {values.shape}")
+    if value_domain is None:
+        value_domain = int(values.max(initial=0)) + 2
     t = FuzzTarget(name=algo_name, algo=algo, n=n, horizon=phases * k,
                    phases=phases, rounds_per_phase=k,
-                   init_values=values, seed=seed)
+                   init_values=values, seed=seed,
+                   value_domain=int(value_domain))
     t._eval = jax.jit(t._make_genome_eval())
     return t
 
@@ -247,6 +340,8 @@ def search(target: FuzzTarget, pop_size: int, generations: int, *,
            novelty_weight: float = 0.5, time_box_s: Optional[float] = None,
            stop_when: Optional[Callable[[Dict[str, np.ndarray]],
                                         np.ndarray]] = None,
+           value_cap: Optional[int] = None,
+           seed_rows: Optional[List[Dict[str, np.ndarray]]] = None,
            log_fn: Optional[Callable[[str], None]] = None) -> FuzzResult:
     """Evolve `pop_size` fault schedules for up to `generations`
     generations (or until `time_box_s` wall-clock runs out, or some
@@ -257,9 +352,24 @@ def search(target: FuzzTarget, pop_size: int, generations: int, *,
     before this generation.  Elites survive verbatim; the rest of the next
     generation is family-block crossover of tournament winners plus
     per-family point mutations.
+
+    ``value_cap`` bounds the byzantine-VALUE membership mutation can
+    reach (genome.mutate): None (default) keeps the family OFF — the
+    PR-8 benign pipeline, whose callers export drops-only artifacts and
+    never thread value plans — so value adversaries are strictly
+    OPT-IN (`value_cap >= 1`, or `genome.value_cap_default(n)` for the
+    envelope cap; byz/crosscheck.py and `fuzz_cli --value-cap` do).
+    ``seed_rows`` splices hand-picked genomes over the seed population's
+    head (the cross-check harness seeds the past-envelope sweep with the
+    adversary class under test so the search starts INSIDE it).
     """
     rng = np.random.default_rng(seed)
     pop = genome.seed_population(seed, pop_size, target.n, target.horizon)
+    if seed_rows:
+        rows = [genome._fill_value_fields(dict(r)) for r in seed_rows]
+        for i, row in enumerate(rows[:pop.size]):
+            for f in genome._FIELDS:
+                getattr(pop, f)[i] = np.asarray(row[f])
     n_elite = max(1, int(pop_size * elite_frac))
     coverage = np.zeros(target.n_cells, dtype=bool)
     best_score, best_row, best_out = -np.inf, None, None
@@ -319,7 +429,8 @@ def search(target: FuzzTarget, pop_size: int, generations: int, *,
         pb = cand[1][np.arange(n_child),
                      np.argmax(sel_score[cand[1]], axis=1)]
         children = genome.mutate(
-            rng, genome.crossover(rng, pop, pa, pb), target.horizon)
+            rng, genome.crossover(rng, pop, pa, pb), target.horizon,
+            value_cap=0 if value_cap is None else value_cap)
         pop = genome.Population(**{
             f: np.concatenate([getattr(elites, f), getattr(children, f)])
             for f in genome._FIELDS})

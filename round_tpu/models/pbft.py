@@ -371,6 +371,13 @@ class PbftViewChange(Algorithm):
     EventRounds, executable and composed).  Decides through a faulty
     primary; f < n/3."""
 
+    # byzantine-grade envelope: f counts VALUE adversaries (liars), not
+    # just crashes — the round_tpu/byz cross-check budgets (n-1)//3
+    # liars INSIDE this envelope
+    fault_envelope = "n > 3f"
+    adversary_model = "byzantine"
+    decision_null = DECIDE_NULL
+
     def __init__(self):
         self.rounds = (
             VcPrePrepare(), VcPrepare(), VcCommit(),
@@ -409,6 +416,10 @@ class PbftViewChange(Algorithm):
 
 class PbftConsensus(Algorithm):
     """Single-decision PBFT-style consensus, f < n/3 byzantine."""
+
+    fault_envelope = "n > 3f"      # see PbftViewChange: byzantine-grade
+    adversary_model = "byzantine"
+    decision_null = DECIDE_NULL
 
     def __init__(self, synchronized: bool = False):
         rounds = (BcpPrePrepare(), BcpPrepare(), BcpCommit())

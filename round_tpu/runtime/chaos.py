@@ -71,6 +71,29 @@ STREAM_TRUNCATE = 0x8E3F0189
 STREAM_GARBAGE = 0x9F4F56B5
 _PARTITION_SALT = 0x9A87  # matches scenarios.partition's fold-in constant
 
+# The value-adversary streams (equivocation / stale replay) live in
+# round_tpu/byz/adversary.py: value faults are SCHEDULED here (explicit
+# [T, n, n] plans from v2 fuzz artifacts), never hash-drawn per send.
+
+#: Native-round-pump compatibility, DECLARED per fault surface — the
+#: silent-composition gate: ``enable_pump`` refuses unless every ACTIVE
+#: surface of this transport is explicitly declared True here.  A new
+#: fault family added without a declaration therefore falls back to the
+#: Python pump instead of silently bypassing its injection semantics.
+#: Sender-side byte-stream families are safe (the native receiver sees
+#: exactly the faulted frames); receiver-side hold/release families are
+#: not (natively-ingested frames would skip this wrapper's recv());
+#: value-fault families start UNPROVEN: the forged frames are
+#: well-formed and would template-ingest, but the zero-copy pinned-
+#: mailbox interaction has no parity pin yet, so they keep the Python
+#: pump (pump.fast_frames stays 0 — tests/test_byz.py).
+PUMP_COMPAT = {
+    "drop": True, "dup": True, "truncate": True, "garbage": True,
+    "crash": True, "partition": True, "schedule": True,
+    "delay": False, "reorder": False,
+    "value": False,
+}
+
 
 def _p8(p: float) -> int:
     """Probability → 8-bit threshold, exactly link_bernoulli's clamp: any
@@ -149,7 +172,9 @@ class FaultyTransport:
     not).  `injected` counts every applied fault for assertions and
     stats.  Non-NORMAL (control-plane) frames pass through untouched."""
 
-    def __init__(self, inner, plan: FaultPlan, n: int, schedule=None):
+    def __init__(self, inner, plan: FaultPlan, n: int, schedule=None,
+                 value_plan=None, protocol: Optional[str] = None,
+                 rounds_per_phase: Optional[int] = None):
         self.inner = inner
         self.plan = plan
         self.n = n
@@ -175,6 +200,37 @@ class FaultyTransport:
                 raise ValueError(
                     f"schedule n={sched.shape[1]} != transport n={n}")
             self.schedule = sched
+        # scheduled VALUE-fault families (round_tpu/byz): an explicit
+        # [T, n, n] int32 substitution plan — plan[r, dst, src] is
+        # VP_NONE (truthful), VP_STALE (replay this sender's previous
+        # transmission of the round class) or v >= 0 (re-encode the frame
+        # claiming value v through the protocol's lie model).  Purely
+        # sender-side: the frame on the wire IS the forged frame, so an
+        # engine equivocation finding replays byte-equivalently here.
+        self.value_plan = None
+        self.protocol = protocol
+        self._rpp = max(1, int(rounds_per_phase or 1))
+        # stale-replay memory: per round class, the LAST truthful payload
+        # bytes actually sent at an earlier round (the engine's carried
+        # (ever-sent, last-sent) pair, in byte form) + the in-round cache
+        self._class_prev: Dict[int, bytes] = {}
+        self._class_cur: Dict[int, tuple] = {}
+        self._class_inst: Optional[int] = None
+        if value_plan is not None:
+            import numpy as np
+
+            vp = np.asarray(value_plan, dtype=np.int32)
+            if vp.ndim != 3 or vp.shape[1] != vp.shape[2]:
+                raise ValueError(
+                    f"value plan must be [T, n, n] int32, got {vp.shape}")
+            if vp.shape[1] != n:
+                raise ValueError(
+                    f"value plan n={vp.shape[1]} != transport n={n}")
+            if protocol is None:
+                raise ValueError(
+                    "value_plan needs the protocol name (lie-model and "
+                    "round-class resolution)")
+            self.value_plan = vp
 
     @classmethod
     def from_schedule_file(cls, inner, path: str) -> "FaultyTransport":
@@ -182,14 +238,28 @@ class FaultyTransport:
         (round_tpu/fuzz/replay.py schema) instead of hash-derived
         families — the constructor that turns a minimized engine finding
         into a deterministic host-wire regression: the SAME link events
-        the engine mask suppressed are dropped on the real wire
-        (delivery equivalence pinned by tests/test_fuzz.py)."""
-        from round_tpu.fuzz.replay import load_artifact, schedule_from_artifact
+        the engine mask suppressed are dropped on the real wire, and (v2
+        artifacts) the SAME value-substitution events are forged into
+        the outgoing frames (delivery equivalence pinned by
+        tests/test_fuzz.py; value equivalence by tests/test_byz.py)."""
+        from round_tpu.fuzz.replay import (
+            load_artifact,
+            schedule_from_artifact,
+            value_plan_from_artifact,
+        )
 
         art = load_artifact(path)
+        vplan = value_plan_from_artifact(art)
+        rpp = None
+        if vplan is not None:
+            from round_tpu.apps.selector import select
+
+            rpp = select(art["protocol"]).rounds_per_phase
         return cls(inner, FaultPlan(seed=int(art.get("seed", 0))),
                    n=int(art["n"]),
-                   schedule=schedule_from_artifact(art))
+                   schedule=schedule_from_artifact(art),
+                   value_plan=vplan, protocol=art["protocol"],
+                   rounds_per_phase=rpp)
 
     # -- the seeded link hash ----------------------------------------------
 
@@ -220,6 +290,72 @@ class FaultyTransport:
         if TRACE.enabled:
             TRACE.emit("fault", node=self.inner.id, family=family,
                        src=src, dst=dst, round=r, inst=inst)
+
+    # -- scheduled value faults (round_tpu/byz) ----------------------------
+
+    def _note_sent(self, r: int, inst: int, payload: bytes) -> None:
+        """Advance the per-round-class stale memory: ``_class_prev[k]``
+        holds the last truthful payload bytes this sender transmitted at
+        a round STRICTLY earlier than the current one (the byte twin of
+        the engine's carried (ever-sent, last-sent) pair).  An instance
+        change — or a round restart, for callers that re-tag — resets
+        it: a fresh instance has no stale history (so a new instance
+        whose first send lands on the SAME round number as the previous
+        instance's last send cannot inherit its payload)."""
+        if inst != self._class_inst:
+            self._class_prev.clear()
+            self._class_cur.clear()
+            self._class_inst = inst
+        k = r % self._rpp
+        cur = self._class_cur.get(k)
+        if cur is not None:
+            if cur[0] == r:
+                return  # same round, same payload: one entry per round
+            if cur[0] > r:  # rounds restarted without an instance tag
+                self._class_prev.clear()
+                self._class_cur.clear()
+            else:
+                self._class_prev[k] = cur[1]
+        self._class_cur[k] = (r, bytes(payload))
+
+    def _value_fault(self, to: int, r: int, inst: int,
+                     payload: bytes) -> bytes:
+        """Apply the scheduled value op for (r, to): forge the frame
+        claiming the planned value through the protocol's lie model
+        (byz/lies.py — decode, lie, re-encode: well-formed by
+        construction), or substitute the sender's previous transmission
+        of this round class (stale replay).  Undecodable/empty frames
+        pass through untouched — a lie needs a well-formed truth to
+        forge."""
+        vp = self.value_plan
+        src = self.inner.id
+        vn, T = vp.shape[1], vp.shape[0]
+        if not (0 <= src < vn and 0 <= to < vn):
+            return payload
+        op = int(vp[min(r, T - 1), to, src])
+        if op == -1:
+            return payload
+        k = r % self._rpp
+        if op == -2:  # VP_STALE
+            prev = self._class_prev.get(k)
+            if prev is None:
+                return payload  # nothing sent earlier: truthful
+            self._count("byz_stale", src, to, r, inst)
+            return prev
+        if not payload:
+            return payload
+        from round_tpu.byz.lies import forge_payload
+        from round_tpu.runtime import codec
+
+        try:
+            obj = codec.loads(bytes(payload))
+            forged = codec.encode(forge_payload(self.protocol, k, obj, op))
+        except Exception:  # noqa: BLE001 — an unforgeable frame (foreign
+            # codec, control payload riding FLAG_NORMAL) stays truthful;
+            # the adversary only forges what it can parse
+            return payload
+        self._count("byz_equivocate", src, to, r, inst)
+        return forged
 
     # -- HostTransport surface ---------------------------------------------
 
@@ -255,20 +391,57 @@ class FaultyTransport:
     def reconnects(self):
         return self.inner.reconnects
 
+    def active_surfaces(self):
+        """The fault surfaces this transport actually applies — the
+        inputs of the PUMP_COMPAT capability check."""
+        out = []
+        p = self.plan
+        if self.schedule is not None:
+            out.append("schedule")
+        else:
+            if p.drop > 0:
+                out.append("drop")
+            if p.dup > 0:
+                out.append("dup")
+            if p.truncate > 0:
+                out.append("truncate")
+            if p.garbage > 0:
+                out.append("garbage")
+            if p.crash_round >= 0:
+                out.append("crash")
+            if p.heal_round > 0:
+                out.append("partition")
+        # the receiver-side hold/release families apply in recv()
+        # REGARDLESS of schedule mode (_maybe_hold consults only the
+        # plan), so they stay declared even when an explicit schedule
+        # turned the sender-side hash families off — a schedule+delay
+        # transport must refuse the pump like any delay plan
+        if p.delay > 0:
+            out.append("delay")
+        if p.reorder > 0:
+            out.append("reorder")
+        if self.value_plan is not None:
+            out.append("value")
+        return out
+
     def enable_pump(self, L, n, k, nbz=0):
-        """Native-round-pump pass-through: the pump RECEIVE path is safe
-        under any plan whose families are all sender-side (drop, crash,
-        partition, dup, truncate, garbage apply in send/send_buffered
+        """Native-round-pump pass-through, gated by the EXPLICIT
+        capability map (PUMP_COMPAT): the pump engages only when every
+        active fault surface is declared pump-compatible.  Sender-side
+        byte families (drop, crash, partition, dup, truncate, garbage,
+        explicit schedules) are — faults apply in send/send_buffered
         before the wire, so the native receiver sees exactly the faulted
-        frame stream).  The receiver-side hold/release families (delay,
-        reorder) live in THIS wrapper's recv() — frames the native pump
-        ingests would bypass them — so such plans refuse the pump and the
-        drivers keep the Python pump.  The pump SEND path is never
-        offered here (no ``pump_send_ok``): sends must keep flowing
-        through send_buffered so faults stay per logical frame.
-        Explicit-schedule mode is sender-side by construction, so it
-        passes through like any drop-only plan."""
-        if self.plan.delay > 0 or self.plan.reorder > 0:
+        frame stream.  The receiver-side hold/release families (delay,
+        reorder) are not — frames the native pump ingests would bypass
+        this wrapper's recv().  VALUE-fault plans are declared
+        incompatible until a zero-copy parity pin exists (PUMP_COMPAT),
+        so a value-schedule run falls back to the Python pump
+        (pump.fast_frames stays 0) rather than silently bypassing
+        injection.  The pump SEND path is never offered here (no
+        ``pump_send_ok``): sends must keep flowing through send_buffered
+        so faults stay per logical frame."""
+        if not all(PUMP_COMPAT.get(s, False)
+                   for s in self.active_surfaces()):
             return None
         f = getattr(self.inner, "enable_pump", None)
         return None if f is None else f(L, n, k, nbz)
@@ -305,6 +478,12 @@ class FaultyTransport:
         schedules framing-invariant (pinned by tests/test_chaos.py)."""
         plan, src = self.plan, self.inner.id
         r, inst = tag.round, tag.instance
+        if self.value_plan is not None:
+            # stale-replay memory advances on every SEND attempt (the
+            # engine's prev carry updates on the dest mask, not on
+            # delivery — a round whose frames all drop still refreshes
+            # the sender's last-sent payload)
+            self._note_sent(r, inst, payload)
         if self.schedule is not None:
             # explicit schedule: one lookup decides the frame's fate; the
             # hash families are OFF in this mode.  Out-of-range peers
@@ -319,6 +498,8 @@ class FaultyTransport:
             if not self.schedule[min(r, T - 1), to, src]:
                 self._count("drop", src, to, r, inst)
                 return False, payload, False
+            if self.value_plan is not None:
+                payload = self._value_fault(to, r, inst, payload)
             return True, payload, False
         if 0 <= plan.crash_round <= r:
             self._count("crash_mute", src, to, r, inst)
@@ -329,6 +510,10 @@ class FaultyTransport:
         if self._event(STREAM_DROP, src, to, r, plan.drop):
             self._count("drop", src, to, r, inst)
             return False, payload, False  # silent loss, UDP-style
+        if self.value_plan is not None:
+            # a standalone value plan composes with the hash families:
+            # lies apply only to frames the omission families deliver
+            payload = self._value_fault(to, r, inst, payload)
         if payload and self._event(STREAM_TRUNCATE, src, to, r,
                                    plan.truncate):
             u = self._u32(STREAM_TRUNCATE, src, to, r)

@@ -115,23 +115,32 @@ def dump_violation(cfg: RvConfig, *, n: int, seed: int, rounds: int,
         return None
     from round_tpu.fuzz import replay
 
+    vplan = None
     if cfg.schedule_path is not None:
         src = replay.load_artifact(cfg.schedule_path)
         sched = replay.schedule_from_artifact(src)
+        # a v2 source's VALUE plan rides into the dump too — a
+        # lie-caused violation replays only if the lies replay
+        # (round_tpu/byz; the same last-row clamp semantics)
+        vplan = replay.value_plan_from_artifact(src)
         # the dump pins the VIOLATING run's horizon; the source schedule
         # clamps to its last row past its own horizon on every replay
         # surface, so truncation/extension below is outcome-neutral
         if sched.shape[0] >= rounds:
             sched = sched[:rounds]
+            vplan = None if vplan is None else vplan[:rounds]
         else:
+            pad = rounds - sched.shape[0]
             sched = np.concatenate(
-                [sched, np.repeat(sched[-1:], rounds - sched.shape[0],
-                                  axis=0)])
+                [sched, np.repeat(sched[-1:], pad, axis=0)])
+            if vplan is not None:
+                vplan = np.concatenate(
+                    [vplan, np.repeat(vplan[-1:], pad, axis=0)])
     else:
         sched = np.ones((rounds, n, n), dtype=bool)
     try:
         art = replay.make_artifact(
-            protocol=cfg.protocol, schedule=sched,
+            protocol=cfg.protocol, schedule=sched, value_plan=vplan,
             values=np.asarray(values, dtype=np.int64), seed=seed,
             meta={"rv": {
                 "formula": label,

@@ -46,14 +46,21 @@ _C_DENIED = METRICS.counter("license.denied")
 
 # serving-tier algorithm names -> parameterized-proof model names
 # (verify/param.py PARAM_SUITES keys are suite names; values name the
-# registry model).  Variants that share round code but NOT the proved
-# automaton (lvb's byte payloads, slv/mlv's restructured phases) are
-# deliberately absent: their resizes are unlicensed until they carry
-# their own extraction.
+# registry model).  LastVotingBytes licenses against the proved
+# lastvoting automaton: the byte variant INHERITS the four rounds
+# unchanged (models/lastvoting.py LastVotingBytes — the value is opaque
+# to every quorum/timestamp test the automaton abstracts, so the
+# extracted transition structure is the same object; only the int-domain
+# trace Spec does not apply, which licensing never consults).  Variants
+# that RESTRUCTURE the phases (slv/mlv) are deliberately absent: their
+# resizes stay unlicensed until they carry their own extraction.
 MODEL_ALIASES: Dict[str, str] = {
     "otr": "otr",
     "lv": "lastvoting",
     "lastvoting": "lastvoting",
+    "lvb": "lastvoting",
+    "lastvoting-bytes": "lastvoting",
+    "lastvotingbytes": "lastvoting",
 }
 
 

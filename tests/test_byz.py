@@ -1,0 +1,591 @@
+"""Byzantine value adversaries (round_tpu/byz) — the ISSUE 13 pins.
+
+Tier-1 (lean, per the 870 s budget):
+  * hash-mode vs explicit-plan bit-identity: one genome row's value
+    draws evaluated through the vmapped population path and through
+    ``row_value_plan`` + ``evaluate_schedules`` give the SAME outcome
+    (the PR-8 row_sampler/row_schedule pin, extended to lies);
+  * lie-model parity: ``forge_payload`` (the host wire's decode-lie-
+    re-encode) equals the jnp lie the engine applies, leaf for leaf;
+  * artifact schema v2 round-trip (value_subs / stale_subs), v1
+    back-compat, and loader validation;
+  * the silent-composition gate: a value-fault plan is declared
+    pump-INCOMPATIBLE, so ``enable_pump`` refuses and the drivers keep
+    the Python pump (``pump.fast_frames`` stays 0) instead of silently
+    bypassing injection;
+  * genome envelope caps: ``value_cap=0`` scrubs the family, caps
+    bound liar membership, PR-8 rows stay valid currency;
+  * ONE jitted equivocation search + ONE banked-fixture replay
+    (< 30 s together), plus 1-minimality of the banked fixture;
+  * rv-under-lies: the banked equivocation fixture trips the fused
+    AGREEMENT monitor under BOTH the lane driver and HostRunner with
+    identical verdict labels, and the halt-and-dump artifact
+    round-trips through ``fuzz_cli replay``.
+
+Heavy arms (-m fuzz / -m slow): the 10k-schedule in/past-envelope
+cross-check sweeps per protocol, and the multi-process rv workout
+(an equivocating peer trips agreement on a real host_replica cluster,
+never crashes it).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from round_tpu.byz.adversary import VP_NONE, VP_STALE
+from round_tpu.byz.crosscheck import early_victim_split, liar_rows
+from round_tpu.byz.lies import forge_payload, lie_for
+from round_tpu.fuzz import genome, minimize as fmin, replay
+from round_tpu.fuzz.objectives import safety_violated
+from round_tpu.fuzz.search import make_target, search
+from round_tpu.models.pbft import digest
+from round_tpu.obs.metrics import METRICS
+from round_tpu.runtime.chaos import (
+    PUMP_COMPAT,
+    FaultPlan,
+    FaultyTransport,
+    alloc_ports,
+)
+
+REG_DIR = os.path.join(os.path.dirname(__file__), "regressions")
+OTR_FIXTURE = os.path.join(REG_DIR, "otr_equivocation_victim.json")
+LV_FIXTURE = os.path.join(REG_DIR, "lastvoting_equivocation_4.json")
+
+#: the loop drivers' "mixed" proposal schedule for instance 1
+#: (runtime/host._schedule_value) — the fixture was minimized against
+#: exactly these proposals so the engine finding transfers to the
+#: instance-loop clusters below
+LOOP_VALUES = np.array([1, 3, 0, 2], dtype=np.int32)
+VICTIM = 1  # the fixture's lone early decider
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_target():
+    return make_target("otr", 4, 12, seed=9, values=tuple(LOOP_VALUES))
+
+
+def _target(name, n, horizon, seed=9, values=None):
+    return make_target(name, n, horizon, seed=seed,
+                       values=None if values is None
+                       else np.asarray(values, np.int32))
+
+
+# ---------------------------------------------------------------------------
+# Hash-mode vs explicit-plan bit-identity
+# ---------------------------------------------------------------------------
+
+
+def test_value_plan_bit_identical_hash_vs_schedule():
+    """One liar-bearing genome row evaluated through the vmapped
+    population path and through (row_schedule, row_value_plan) +
+    evaluate_schedules yields the IDENTICAL outcome — the value
+    dimension of the PR-8 sampler/schedule pin, on the byzantine-grade
+    PBFT target."""
+    t = _target("pbft", 3, 9, seed=1)
+    pop = genome.seed_population(0, 2, 3, t.horizon)
+    row = {f: np.asarray(getattr(pop, f)[0]) for f in genome._FIELDS}
+    row["byz_value"] = np.array([True, False, False])
+    row["equiv_p8"] = np.int32(200)
+    row["stale_p8"] = np.int32(40)
+
+    o1 = t.evaluate(genome.Population.from_rows([row]))
+    sched = genome.row_schedule(row, t.horizon)
+    vplan = genome.row_value_plan(row, t.horizon, t.value_domain)
+    assert (vplan != VP_NONE).any(), "row drew no value events at all"
+    o2 = t.evaluate_schedules(sched[None], vplan[None])
+    for k in ("decided", "decision", "decided_round"):
+        assert np.array_equal(o1[k][0], o2[k][0]), k
+
+
+def test_value_plan_diagonal_and_pr8_rows():
+    """The plan never lies on the diagonal (self-delivery is honest),
+    and a PR-8 row dict WITHOUT value fields stays valid currency
+    (zero-filled: the truthful adversary)."""
+    t = _fixture_target()
+    pop = genome.seed_population(3, 1, 4, t.horizon)
+    row = {f: np.asarray(getattr(pop, f)[0]) for f in genome._FIELDS}
+    row["byz_value"] = np.ones(4, dtype=bool)
+    row["equiv_p8"] = np.int32(232)
+    row["stale_p8"] = np.int32(232)
+    vplan = genome.row_value_plan(row, t.horizon, t.value_domain)
+    eye = np.eye(4, dtype=bool)
+    assert (vplan[:, eye] == VP_NONE).all()
+
+    legacy = {f: row[f] for f in genome._FIELDS
+              if f not in genome._VALUE_FIELDS}
+    assert not (genome.row_value_plan(legacy, t.horizon, t.value_domain)
+                != VP_NONE).any()
+    pop2 = genome.Population.from_rows([legacy])
+    assert not pop2.byz_value.any()
+
+
+# ---------------------------------------------------------------------------
+# Lie models: engine <-> host parity
+# ---------------------------------------------------------------------------
+
+
+def test_forge_payload_matches_engine_lie():
+    """forge_payload (host: decode, lie, re-encode) must produce exactly
+    the values the jnp lie model computes under the engine — per leaf,
+    dtype- and shape-preserving, for the generic claim AND the
+    digest-consistent PBFT forgeries."""
+    cases = [
+        ("otr", 0, np.int32(7)),
+        ("lastvoting", 1, {"x": np.int32(3), "ts": np.int32(1)}),
+        ("pbft", 0, {"req": np.int32(5),
+                     "dig": np.asarray(digest(np.int32(5)), np.int32)}),
+        ("pbft", 1, {"dig": np.int32(11), "ok": np.bool_(False)}),
+        ("pbft", 2, np.int32(9)),
+        ("pbft-vc", 3, {"nv": np.int32(1), "pr": np.int32(2),
+                        "pv": np.int32(0)}),
+    ]
+    for proto, k, payload in cases:
+        v = 2
+        host = forge_payload(proto, k, payload, v)
+        eng = lie_for(proto)(k, payload, v)
+        p_leaves = jax.tree_util.tree_leaves(payload)
+        h_leaves = jax.tree_util.tree_leaves(host)
+        e_leaves = jax.tree_util.tree_leaves(eng)
+        for pl, hl, el in zip(p_leaves, h_leaves, e_leaves):
+            hl = np.asarray(hl)
+            # dtype/shape honest (well-formed), values equal to the
+            # engine's jnp forgery
+            assert hl.dtype == np.asarray(pl).dtype, (proto, k)
+            assert hl.shape == np.shape(pl), (proto, k)
+            assert np.array_equal(hl, np.asarray(el)), (proto, k)
+
+
+def test_pbft_lie_is_digest_consistent():
+    """The forged pre-prepare ships the digest OF THE LIE — the
+    receiver's recheck passes, so the lie enters quorum counting
+    instead of degrading to omission."""
+    forged = forge_payload(
+        "pbft", 0, {"req": np.int32(5),
+                    "dig": np.asarray(digest(np.int32(5)), np.int32)}, 3)
+    assert int(forged["req"]) == 3
+    assert int(forged["dig"]) == int(np.asarray(digest(np.int32(3))))
+
+
+# ---------------------------------------------------------------------------
+# Artifact schema v2
+# ---------------------------------------------------------------------------
+
+
+def test_artifact_v2_roundtrip(tmp_path):
+    n, T = 3, 4
+    sched = np.ones((T, n, n), dtype=bool)
+    sched[1, 2, 0] = False
+    plan = np.full((T, n, n), VP_NONE, dtype=np.int32)
+    plan[0, 1, 2] = 3
+    plan[2, 0, 1] = VP_STALE
+    art = replay.make_artifact(protocol="otr", schedule=sched,
+                               values=np.arange(n), seed=5,
+                               value_plan=plan)
+    assert art["version"] == 2
+    assert art["value_subs"] == [[0, 1, 2, 3]]
+    assert art["stale_subs"] == [[2, 0, 1]]
+    p = tmp_path / "v2.json"
+    replay.dump_artifact(str(p), art)
+    back = replay.load_artifact(str(p))
+    assert np.array_equal(replay.schedule_from_artifact(back), sched)
+    assert np.array_equal(replay.value_plan_from_artifact(back), plan)
+
+    # a trivial plan keeps the v1 wire format (PR-8 bank compatibility)
+    v1 = replay.make_artifact(
+        protocol="otr", schedule=sched, values=np.arange(n),
+        value_plan=np.full((T, n, n), VP_NONE, np.int32))
+    assert v1["version"] == 1 and "value_subs" not in v1
+    assert replay.value_plan_from_artifact(v1) is None
+
+    # loader validation: an on-diagonal lie is rejected
+    bad = dict(art)
+    bad["value_subs"] = [[0, 1, 1, 3]]
+    p2 = tmp_path / "bad.json"
+    p2.write_text(json.dumps(bad))
+    with pytest.raises(ValueError, match="bad value event"):
+        replay.load_artifact(str(p2))
+
+
+def test_make_artifact_rejects_diagonal_lie():
+    n, T = 3, 2
+    plan = np.full((T, n, n), VP_NONE, dtype=np.int32)
+    plan[0, 1, 1] = 2
+    with pytest.raises(ValueError, match="off-diagonal"):
+        replay.make_artifact(protocol="otr",
+                             schedule=np.ones((T, n, n), bool),
+                             values=np.arange(n), value_plan=plan)
+
+
+# ---------------------------------------------------------------------------
+# The silent-composition gate (satellite: pump capability check)
+# ---------------------------------------------------------------------------
+
+
+class _PumpyInner:
+    """Minimal transport stub whose enable_pump reports engagement."""
+
+    id = 0
+    n = 4
+
+    def enable_pump(self, L, n, k, nbz=0):
+        return "ENGAGED"
+
+
+def test_value_plan_refuses_native_pump():
+    """PUMP_COMPAT declares value-fault families pump-incompatible, so
+    enable_pump returns None (Python-pump fallback) even when the inner
+    transport would engage — while a drops-only schedule still passes
+    through.  The integration half (pump.fast_frames stays 0 on a live
+    value-schedule lanes run) rides test_rv_agreement_under_lies."""
+    n, T = 4, 3
+    sched = np.ones((T, n, n), dtype=bool)
+    plan = np.full((T, n, n), VP_NONE, dtype=np.int32)
+    plan[0, 1, 0] = 2
+
+    assert PUMP_COMPAT["value"] is False  # the explicit declaration
+    tr = FaultyTransport(_PumpyInner(), FaultPlan(), n, schedule=sched,
+                         value_plan=plan, protocol="otr",
+                         rounds_per_phase=1)
+    assert "value" in tr.active_surfaces()
+    assert tr.enable_pump(4, n, 1) is None
+
+    tr2 = FaultyTransport(_PumpyInner(), FaultPlan(), n, schedule=sched)
+    assert tr2.enable_pump(4, n, 1) == "ENGAGED"
+
+    # receiver-side hold/release families apply in recv() regardless of
+    # schedule mode, so a schedule+delay plan must STILL refuse the pump
+    tr2d = FaultyTransport(_PumpyInner(), FaultPlan(delay=0.5), n,
+                           schedule=sched)
+    assert "delay" in tr2d.active_surfaces()
+    assert tr2d.enable_pump(4, n, 1) is None
+
+    # an UNDECLARED surface must also refuse (the gate is allow-listed,
+    # not deny-listed: new families default to the Python pump)
+    tr3 = FaultyTransport(_PumpyInner(), FaultPlan(), n, schedule=sched)
+    tr3.active_surfaces = lambda: ["schedule", "mystery"]
+    assert tr3.enable_pump(4, n, 1) is None
+
+
+def test_value_plan_requires_protocol():
+    with pytest.raises(ValueError, match="protocol"):
+        FaultyTransport(_PumpyInner(), FaultPlan(), 4,
+                        value_plan=np.full((2, 4, 4), VP_NONE, np.int32))
+
+
+# ---------------------------------------------------------------------------
+# Genome: envelope caps
+# ---------------------------------------------------------------------------
+
+
+def test_mutate_value_cap_bounds_membership():
+    rng = np.random.default_rng(0)
+    pop = genome.seed_population(1, 64, 7, 12)
+    pop.byz_value[:] = rng.random(pop.byz_value.shape) < 0.5
+    pop.equiv_p8[:] = 100
+    out = genome.mutate(rng, pop, 12, value_cap=2)
+    assert (out.byz_value.sum(axis=1) <= 2).all()
+    # cap 0 = the benign model: the family is scrubbed entirely, so
+    # crossover with a capped parent cannot smuggle lies into an
+    # in-envelope sweep
+    out0 = genome.mutate(rng, pop, 12, value_cap=0)
+    assert not out0.byz_value.any()
+    assert (out0.equiv_p8 == 0).all() and (out0.stale_p8 == 0).all()
+    # default cap: the classic (n-1)//3 envelope
+    assert genome.value_cap_default(7) == 2
+    outd = genome.mutate(rng, pop, 12)
+    assert (outd.byz_value.sum(axis=1) <= 2).all()
+
+
+def test_severity_prices_value_adversary():
+    """A liar costs severity rent proportional to membership AND
+    intensity — the minimizer pressure toward surgical equivocation."""
+    pop = genome.seed_population(0, 2, 4, 12)
+    for f in genome._FIELDS:
+        getattr(pop, f)[:] = 0
+    pop.byz_value[1, 0] = True
+    pop.equiv_p8[1] = 128
+    sev = genome.severity(pop, 12)
+    assert sev[1] > sev[0]
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 smoke: one jitted equivocation search + one fixture replay
+# ---------------------------------------------------------------------------
+
+
+def test_equivocation_search_smoke():
+    """A past-envelope OTR sweep (one liar, liar-seeded) finds a safety
+    violation within a few generations, inside the jitted vmapped
+    evaluation — the lean tier-1 smoke of the cross-check rung."""
+    t = _fixture_target()
+    res = search(t, pop_size=256, generations=15, seed=4,
+                 stop_when=safety_violated(), value_cap=1,
+                 seed_rows=liar_rows(4, t.horizon, 1, seed=4),
+                 time_box_s=30.0)
+    # best_outcome is the best-ever row's recorded scalar components —
+    # no extra dispatch (tier-1 budget: a pop-1 re-evaluation would
+    # cost one more jit compile)
+    viol = (res.best_outcome["agreement_viol"]
+            + res.best_outcome["validity_viol"])
+    assert viol > 0, res.best_outcome
+    assert res.best_row["byz_value"].any(), \
+        "safety broke without a liar — an omission-only OTR violation " \
+        "would falsify the n > 3f proof itself"
+
+
+def test_banked_fixture_replays_and_is_one_minimal():
+    """The banked equivocation counterexample reproduces its recorded
+    engine outcome AND is 1-minimal over BOTH event kinds: re-enabling
+    any dropped link or retracting any lie loses the early-victim
+    split."""
+    art = replay.load_artifact(OTR_FIXTURE)
+    ok, got = replay.check_engine(art)
+    assert ok, got
+    t = _fixture_target()
+    sched = replay.schedule_from_artifact(art)
+    vplan = replay.value_plan_from_artifact(art)
+    assert vplan is not None and (vplan >= 0).sum() >= 1
+    pred = early_victim_split()
+    out = t.evaluate_schedules(sched[None], vplan[None])
+    assert bool(pred(out)[0])
+    assert fmin.verify_one_minimal(t, sched, pred, value_plan=vplan)
+    # retracting the lies entirely loses the finding (the equivocation,
+    # not the drop, is the counterexample's load-bearing half)
+    truthful = np.full_like(vplan, VP_NONE)
+    out2 = t.evaluate_schedules(sched[None], truthful[None])
+    assert not bool(pred(out2)[0])
+
+
+# ---------------------------------------------------------------------------
+# rv-under-lies: the fused agreement monitor vs the equivocation fixture
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _lingering_otr():
+    """One OTR(after_decision=6) for every cluster in this module: the
+    jitted round trios and monitored mega-steps cache on its Rounds.
+    The lingering tail keeps the equivocation VICTIM participating when
+    the honest camp's decision gossip lands — the deterministic trip
+    window (same idea as rv/fixtures.py _AFTER)."""
+    from round_tpu.models.otr import OTR
+
+    algo = OTR(after_decision=6)
+    replay._warm_host_round_fns(algo, 4)
+    return algo
+
+
+def _lied_cluster(driver, rv_policy="log", victim_policy=None,
+                  dump_dir=None):
+    """A 4-replica thread cluster over the banked equivocation fixture:
+    every node's wire wrapped in the explicit-schedule FaultyTransport
+    (drops + forged frames), monitors on.  The victim never gossips —
+    its early decision must not convert the honest camp before the camp
+    decides (byz/crosscheck.early_victim_split)."""
+    from round_tpu.runtime.host import run_instance_loop
+    from round_tpu.runtime.lanes import run_instance_loop_lanes
+    from round_tpu.runtime.transport import HostTransport
+    from round_tpu.rv.dump import RvConfig
+
+    art = replay.load_artifact(OTR_FIXTURE)
+    n = art["n"]
+    sched = replay.schedule_from_artifact(art)
+    vplan = replay.value_plan_from_artifact(art)
+    algo = _lingering_otr()
+    ports = alloc_ports(n)
+    peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
+    results, stats, errors = {}, {}, {}
+
+    def node(i):
+        tr0 = HostTransport(i, peers[i][1])
+        tr = FaultyTransport(tr0, FaultPlan(), n, schedule=sched,
+                             value_plan=vplan, protocol="otr",
+                             rounds_per_phase=algo.rounds_per_phase)
+        policy = (victim_policy if i == VICTIM and victim_policy
+                  else rv_policy)
+        rv = RvConfig(policy=policy, protocol="otr",
+                      schedule_path=OTR_FIXTURE,
+                      dump_dir=dump_dir, gossip=(i != VICTIM))
+        st: dict = {}
+        try:
+            # 2000 ms deadlines (test_rv's cluster discipline): round
+            # walls are ~1-3 ms warm, so the slack only pays off when a
+            # box-load or first-compile stall would otherwise turn a
+            # delivered frame into a phantom drop and morph WHICH
+            # decisions the split produces; the one scheduled drop
+            # burns a single deadline, bounding the cost
+            kw = dict(timeout_ms=2000, seed=7, value_schedule="mixed",
+                      max_rounds=art["rounds"], stats_out=st, rv=rv)
+            if driver == "lanes":
+                results[i] = run_instance_loop_lanes(
+                    algo, i, peers, tr, 1, lanes=2, **kw)
+            else:
+                results[i] = run_instance_loop(algo, i, peers, tr, 1,
+                                               **kw)
+            stats[i] = st
+        except Exception as e:  # noqa: BLE001 — asserted by callers
+            stats[i] = st
+            errors[i] = e
+        finally:
+            tr0.close()
+
+    threads = [threading.Thread(target=node, args=(i,)) for i in
+               range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=150)
+    assert not any(t.is_alive() for t in threads), "replica wedged"
+    return results, stats, errors
+
+
+def _formulas(stats, node):
+    return {v["formula"]
+            for v in stats.get(node, {}).get("rv_violations", [])}
+
+
+#: victim formula sets per driver, filled by the parametrized test so
+#: the cross-driver label comparison needs no extra cluster runs
+_TRIPPED: dict = {}
+
+
+@pytest.mark.parametrize("driver", ["seq", "lanes"])
+def test_rv_agreement_under_lies(driver):
+    """The adversarial workout (ISSUE 13 satellite): an equivocating
+    peer — forged frames on the real wire, scheduled by the banked
+    counterexample — trips the fused AGREEMENT monitor on the victim
+    under BOTH drivers, with the identical verdict label, and never
+    crashes a driver.  The lanes leg also pins the silent-composition
+    gate end-to-end: the value-schedule transport refused the native
+    pump, so pump.fast_frames must not move.
+
+    Bounded retries: the split needs the victim to out-pace the honest
+    camp by one round, and the scheduled drop makes node 0's catch-up
+    pacing-sensitive — a box-load stall can morph WHICH decisions form
+    (the lie never fired, nothing to observe).  A run without the split
+    says nothing about the monitor, so it is retried; a BROKEN monitor
+    fails every attempt, so the claim stays falsifiable."""
+    ff = METRICS.counter("pump.fast_frames").value
+    for _attempt in range(3):
+        _res, stats, errors = _lied_cluster(driver)
+        assert not errors, errors
+        if ("property 'Agreement'" in _formulas(stats, VICTIM)
+                and not any("property 'Agreement'" in _formulas(stats, i)
+                            for i in range(4) if i != VICTIM)):
+            break
+    _TRIPPED[driver] = _formulas(stats, VICTIM)
+    assert "property 'Agreement'" in _TRIPPED[driver], \
+        f"victim missed the equivocation: {stats.get(VICTIM)}"
+    # honest replicas observed no violation of their own
+    for i in range(4):
+        if i != VICTIM:
+            assert "property 'Agreement'" not in _formulas(stats, i)
+    if driver == "lanes":
+        assert METRICS.counter("pump.fast_frames").value == ff, \
+            "value-schedule run engaged the native pump"
+    if len(_TRIPPED) == 2:
+        # identical verdict label across the lane driver's fused term
+        # and HostRunner's Python path — one formula enumeration, no
+        # per-driver drift.  Compared on the AGREEMENT label (the
+        # equivocation's deterministic trip); whether the follow-on
+        # Irrevocability trip also fires depends on adoption timing,
+        # so full-set equality would be a timing assertion in disguise
+        agree = {f for f in _TRIPPED["seq"] if "Agreement" in f}
+        assert agree == {f for f in _TRIPPED["lanes"]
+                         if "Agreement" in f} and agree
+
+
+def test_halt_dump_roundtrips_fuzz_cli(tmp_path):
+    """policy=halt on the victim: the agreement violation raises
+    RvViolation out of the driver carrying a dump artifact that (a) is
+    a v2 schedule artifact CARRYING the equivocation events, and (b)
+    round-trips through `fuzz_cli replay` with exit 0."""
+    from round_tpu.apps.fuzz_cli import main as fuzz_main
+    from round_tpu.rv.dump import RvViolation
+
+    for _attempt in range(3):  # same retry rationale as the test above
+        _res, stats, errors = _lied_cluster(
+            "seq", victim_policy="halt", dump_dir=str(tmp_path))
+        if errors:
+            break
+    assert set(errors) == {VICTIM}
+    e = errors[VICTIM]
+    assert isinstance(e, RvViolation)
+    assert e.artifact and os.path.exists(e.artifact)
+    art = replay.load_artifact(e.artifact)
+    assert art["version"] == 2 and art["value_subs"], \
+        "the dump lost the lies — it could never reproduce the trip"
+    assert art["meta"]["rv"]["formula"] == "property 'Agreement'"
+    assert fuzz_main(["replay", "--artifact", e.artifact]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Heavy arms: the cross-check sweeps and the multi-process rv workout
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fuzz
+@pytest.mark.slow
+@pytest.mark.parametrize("proto", ["otr", "lastvoting", "pbft"])
+def test_crosscheck_envelopes(proto, tmp_path):
+    """The proof/fuzzer cross-check at acceptance scale: >= 10k
+    schedules in-envelope with ZERO safety violations; past-envelope
+    behaves as the adversary model predicts — benign protocols yield a
+    minimized, banked equivocation counterexample, the byzantine-grade
+    PBFT yields NO safety violation even at n = 3f (its > 2n/3 quorums
+    intersect in an honest process at any f; the envelope buys
+    liveness, which the sweep scores as damage instead)."""
+    from round_tpu.byz.crosscheck import crosscheck
+
+    res = crosscheck(proto, 4, min_schedules=10_000, seed=3,
+                     bank_dir=str(tmp_path), time_box_s=240.0)
+    assert res.in_ok, res.record()
+    assert res.inside.evaluated >= 10_000
+    assert res.past_ok, res.record()
+    if proto in ("otr", "lastvoting"):
+        assert res.artifact is not None
+        assert res.artifact["value_subs"] or res.artifact["stale_subs"]
+        ok, got = replay.check_engine(
+            replay.load_artifact(res.artifact_path))
+        assert ok, got
+    else:
+        assert not res.past.violation
+
+
+@pytest.mark.slow
+def test_equivocation_artifact_multiprocess_rv(tmp_path):
+    """The acceptance pin on a REAL multi-process cluster: the banked
+    equivocation artifact (a) reproduces its recorded outcome on plain
+    host_replica subprocesses, and (b) under monitors, trips AGREEMENT
+    on the victim — which completes cleanly (the monitor fires, the
+    driver never crashes)."""
+    art = replay.load_artifact(OTR_FIXTURE)
+    res = replay.run_schedule_cluster(
+        str(tmp_path / "plain"), OTR_FIXTURE, timeout_ms=1200)
+    got = {k: res[k] for k in ("decided", "decision", "rounds")}
+    assert got == art["expected"]["host"], got
+
+    res = replay.run_schedule_cluster(
+        str(tmp_path / "rv"), OTR_FIXTURE, timeout_ms=1200, rv="log",
+        rv_gossip={i for i in range(art["n"]) if i != VICTIM},
+        algo_opts={"after_decision": 6})
+    by_node = {s["id"]: s for s in res["summaries"]}
+    trips = {i: {v["formula"]
+                 for v in by_node[i].get("rv", {}).get("violations", [])}
+             for i in by_node}
+    assert "property 'Agreement'" in trips[VICTIM], trips
+    # every replica ran monitors and exited cleanly (run_schedule_cluster
+    # raises on any nonzero replica)
+    assert all(by_node[i]["rv"]["checks"] > 0 for i in by_node)
+    # the honest camp's decisions survive the adversarial workout
+    for i in by_node:
+        if i != VICTIM:
+            assert res["decision"][i] == art["expected"]["host"][
+                "decision"][i]

@@ -52,11 +52,28 @@ def test_banked_artifact_replays_on_engine(path):
 def test_banked_artifact_replays_on_host_wire(path):
     art = replay.load_artifact(path)
     assert art["expected"].get("host"), "artifact banked without host run"
+    if (art.get("meta", {}).get("host_tier") == "slow"
+            and os.environ.get("RUN_SLOW_VCS") != "1"):
+        # load-sensitive by protocol structure (a LastVoting phase is
+        # all-or-nothing: a box-load stall anywhere in phase 0 rolls the
+        # decision into the lie-free next phase, changing WHICH
+        # decisions exist, not just when) — the host half rides the
+        # slow tier; the engine half above stays tier-1 and the
+        # byz-crosscheck soak rung replays it continuously
+        pytest.skip("host replay rides the slow tier "
+                    "(meta.host_tier=slow; RUN_SLOW_VCS=1 to run)")
     # 400 ms deadline: generous vs warm localhost round walls (~1-3 ms),
     # so a full-suite scheduler stall cannot turn a delivered frame into
     # a phantom drop; burned-deadline rounds (the drops themselves) pace
-    # the replay, so the cost is rounds x 0.4 s worst case
-    ok, got = replay.check_host(art, timeout_ms=400)
+    # the replay, so the cost is rounds x 0.4 s worst case.  An artifact
+    # may RAISE its own deadline (meta.host_timeout_ms) when its banked
+    # outcome needs more slack — LastVoting's 4-round phases decide only
+    # if no round of the phase times out, so a start-skew stall would
+    # roll an in-phase decision into the NEXT phase and (under a
+    # commit-round lie) change which decisions exist, not just when
+    ok, got = replay.check_host(
+        art, timeout_ms=int(art.get("meta", {}).get("host_timeout_ms",
+                                                    400)))
     assert ok, (f"{os.path.basename(path)} stopped reproducing on the "
                 f"host wire: {got} != {art['expected']['host']}")
 
@@ -77,7 +94,7 @@ def test_banked_artifact_replays_on_multiprocess_cluster(tmp_path):
     decides-at-round-k artifact could record a later decision under load
     (the PR-7 load-timing-flake lesson, applied to the new suite)."""
     path = next(p for p in ARTIFACTS
-                if replay.load_artifact(p)["protocol"] == "otr")
+                if os.path.basename(p) == "otr_undecided_horizon.json")
     art = replay.load_artifact(path)
     res = replay.run_schedule_cluster(str(tmp_path), path, timeout_ms=400)
     got = {k: res[k] for k in ("decided", "decision", "rounds")}

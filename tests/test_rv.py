@@ -389,8 +389,13 @@ def test_license_registry_verdicts():
         and lic.envelope == "n > 3f" and lic.f_max == 1
     assert reg.check("otr", 3).status == "outside-envelope"
     assert reg.check("lv", 5).ok  # n > 2f: f_max = 2
-    # no parameterized proof registered: byte-payload variant, unknown
-    assert reg.check("lvb", 9).status == "unlicensed"
+    # the byte-payload variant licenses against the proved lastvoting
+    # automaton (shared round code; MODEL_ALIASES — ISSUE 13 satellite):
+    # same suite, same n > 2f envelope, inherited from LastVoting
+    lvb = reg.check("lvb", 9)
+    assert lvb.ok and lvb.model == "lastvoting" \
+        and lvb.envelope == "n > 2f" and lvb.f_max == 4
+    # ... while a model with NO parameterized proof still refuses
     assert reg.check("benor", 9).status == "unlicensed"
     # a prover that cannot prove (cold cache, solve=False) denies
     cold = ProofLicenseRegistry(prover=lambda s, c, solve: (False, None))

@@ -1070,6 +1070,43 @@ def check_fuzz(rng, it):
     return cfg
 
 
+def check_byz_crosscheck(rng, it):
+    """The byz-crosscheck rotation rung (ISSUE 13): one time-boxed
+    proof/fuzzer cross-check per iteration — an in-envelope sweep that
+    must stay safety-violation-free and a past-envelope sweep that must
+    behave as the protocol's adversary model predicts (benign: the
+    evolved value adversary finds an equivocation counterexample;
+    byzantine: no safety break exists even at n = 3f, only liveness
+    damage) — banking violations-found, schedules/s and the sweep
+    verdicts into SOAK.jsonl.  The rung then replays every banked
+    EQUIVOCATION artifact (tests/regressions/*_equivocation_*) on the
+    engine and FAILS if one stops reproducing its recorded outcome —
+    the lies' half of the fuzz rung's regression gate, run
+    continuously."""
+    import glob
+
+    from round_tpu.byz.crosscheck import crosscheck
+    from round_tpu.fuzz import replay as freplay
+
+    seed = int(rng.integers(0, 2**31))
+    proto = str(rng.choice(["otr", "lastvoting", "pbft", "pbft-vc"]))
+    res = crosscheck(proto, 4, min_schedules=5_000, seed=seed,
+                     time_box_s=45.0)
+    cfg = dict(kind="byz-crosscheck", it=it, seed=seed, **res.record())
+    if not res.ok:
+        return {**cfg, "fail": f"cross-check claim broken for {proto}: "
+                               f"in_ok={res.in_ok} past_ok={res.past_ok}"}
+    for path in sorted(glob.glob(os.path.join(
+            REPO, "tests", "regressions", "*_equivocation_*.json"))):
+        ok, got = freplay.check_engine(freplay.load_artifact(path))
+        if not ok:
+            return {**cfg,
+                    "fail": f"banked equivocation artifact stopped "
+                            f"reproducing: {os.path.basename(path)}",
+                    "got": got}
+    return cfg
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=60.0)
@@ -1116,7 +1153,7 @@ def main():
                 check_host_perf, check_host_lanes, check_host_pump,
                 lambda r, i: check_host_perf(r, i, payload=True),
                 check_fuzz, check_verify_param, check_host_overload,
-                check_host_fleet, check_host_rv]
+                check_host_fleet, check_host_rv, check_byz_crosscheck]
     while time.monotonic() < t_end:
         check = rotation[it % len(rotation)]
         t0 = time.perf_counter()
