@@ -1152,6 +1152,7 @@ def hist_scan(
     counts_fn: Callable,
     coin_fn: Optional[Callable] = None,
     lane_ids: Optional[jnp.ndarray] = None,
+    ho_fn: Optional[Callable] = None,
 ):
     """The round-step scaffolding every histogram engine shares: subround
     dispatch (phase_len switch), exit/freeze bookkeeping (exited lanes stop
@@ -1167,17 +1168,29 @@ def hist_scan(
     a semantics fix here propagates to every engine; `n` is the GLOBAL
     group size (quorum thresholds), which may exceed the local lane axis.
     `lane_ids` are the global ids of the local lanes (default: arange),
-    passed to update_counts for rounds with needs_lane_ids."""
+    passed to update_counts for rounds with needs_lane_ids.
+
+    ``ho_fn(r) -> block`` selects the CROSS-ROUND SOFTWARE-PIPELINED form
+    (PERF_MODEL.md "ICI exchange roofline"): the round-r HO/delivery block
+    rides the scan carry, double-buffered — generated during round r-1
+    with no data dependency on round r-1's update, so on TPU the VPU
+    mask-gen (and the ICI remote-copy start it feeds) may overlap the
+    count matmul's MXU work.  counts_fn is then called as
+    counts_fn(state, k, done, r, block).  ho_fn=None (the default) is the
+    straight-line compile-insurance loop, unchanged: counts_fn computes
+    its own mask in-round.  The two forms are bit-identical — the carried
+    block is a pure function of the round index, only WHEN it is computed
+    moves."""
     lanes_like = decided_fn(state0)
     done0 = jnp.zeros(lanes_like.shape, dtype=bool)
     decided_round0 = jnp.full(lanes_like.shape, -1, dtype=jnp.int32)
 
-    def step(carry, r):
-        state, done, decided_round = carry
+    def step_round(state, done, decided_round, r, ho):
         coin = coin_fn(r) if coin_fn is not None else None
 
         def subround(k, state):
-            counts = counts_fn(state, k, done, r)
+            counts = (counts_fn(state, k, done, r) if ho_fn is None
+                      else counts_fn(state, k, done, r, ho))
             size = jnp.sum(counts, axis=1)
             extra = {}
             if rnd.needs_lane_ids:
@@ -1201,12 +1214,26 @@ def hist_scan(
         done = done | (active & exit_)
         dec = decided_fn(state)
         decided_round = jnp.where(dec & (decided_round < 0), r, decided_round)
-        return (state, done, decided_round), None
+        return state, done, decided_round
 
-    (state, done, decided_round), _ = jax.lax.scan(
-        step, (state0, done0, decided_round0),
-        jnp.arange(max_rounds, dtype=jnp.int32),
-    )
+    rounds = jnp.arange(max_rounds, dtype=jnp.int32)
+    if ho_fn is None:
+        def step(carry, r):
+            return step_round(*carry, r, None), None
+
+        (state, done, decided_round), _ = jax.lax.scan(
+            step, (state0, done0, decided_round0), rounds)
+    else:
+        def step(carry, r):
+            state, done, decided_round, ho = carry
+            state, done, decided_round = step_round(
+                state, done, decided_round, r, ho)
+            # round r+1's block: depends only on (mix, r+1), never on this
+            # round's update — free to overlap the count/update work above
+            return (state, done, decided_round, ho_fn(r + 1)), None
+
+        (state, done, decided_round, _), _ = jax.lax.scan(
+            step, (state0, done0, decided_round0, ho_fn(0)), rounds)
     return state, done, decided_round
 
 

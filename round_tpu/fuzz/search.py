@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+import warnings
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -80,8 +81,16 @@ class FuzzTarget:
 
     def evaluate(self, pop: genome.Population) -> Dict[str, np.ndarray]:
         sev = genome.severity(pop, self.horizon)
-        out = self._eval(*[jnp.asarray(x) for x in pop.leaves()],
-                         jnp.asarray(sev, jnp.float32))
+        # the population device buffers are DONATED (make_target's
+        # donate_argnums): they are freshly staged from the numpy
+        # Population each call and never read back, so XLA may reuse them
+        # for outputs instead of allocating a second population footprint
+        # per dispatch (ISSUE 14 throughput satellite)
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            out = self._eval(*[jnp.asarray(x) for x in pop.leaves()],
+                             jnp.asarray(sev, jnp.float32))
         METRICS.counter("fuzz.dispatches").inc()
         METRICS.counter("fuzz.candidates").inc(pop.size)
         res = {k: np.asarray(v) for k, v in out.items()}
@@ -117,13 +126,19 @@ class FuzzTarget:
         key = (K_pad, value_plans is not None)
         fn = self._eval_sched.get(key)
         if fn is None:
-            fn = jax.jit(self._make_schedule_eval(
-                with_plan=value_plans is not None))
+            # schedules/plans are the big buffers here ([K, T, n, n]);
+            # they are staged fresh from numpy per call, so donate them
+            fn = jax.jit(
+                self._make_schedule_eval(with_plan=value_plans is not None),
+                donate_argnums=(0,) if value_plans is None else (0, 1))
             self._eval_sched[key] = fn
-        if value_plans is None:
-            out = fn(jnp.asarray(schedules))
-        else:
-            out = fn(jnp.asarray(schedules), jnp.asarray(value_plans))
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            if value_plans is None:
+                out = fn(jnp.asarray(schedules))
+            else:
+                out = fn(jnp.asarray(schedules), jnp.asarray(value_plans))
         METRICS.counter("fuzz.dispatches").inc()
         METRICS.counter("fuzz.candidates").inc(int(schedules.shape[0]))
         return {k: np.asarray(v)[:K] for k, v in out.items()}
@@ -296,7 +311,11 @@ def make_target(algo_name: str, n: int, horizon: int, seed: int = 0,
                    phases=phases, rounds_per_phase=k,
                    init_values=values, seed=seed,
                    value_domain=int(value_domain))
-    t._eval = jax.jit(t._make_genome_eval())
+    # every genome leaf + the severity vector is donated: evaluate()
+    # stages them fresh from numpy per generation and never reads them
+    # back, so the dispatch runs without a second population allocation
+    t._eval = jax.jit(t._make_genome_eval(),
+                      donate_argnums=tuple(range(len(genome._FIELDS) + 1)))
     return t
 
 

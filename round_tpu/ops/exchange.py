@@ -67,3 +67,85 @@ def exchange(
     packets.
     """
     return payload, deliver_mask(ho, dest_mask, active)
+
+
+# ---------------------------------------------------------------------------
+# Receiver-block slicing: THE hash-mode HO formula at arbitrary receiver rows
+# ---------------------------------------------------------------------------
+
+def ho_block(colmask, side, salt0, salt1r, p8, jg=None) -> jnp.ndarray:
+    """``[.., m, n]`` receiver-block rows of the hash-mode HO matrix at
+    GLOBAL receiver ids ``jg`` (default ``arange(n)``: the dense matrix):
+
+        ho[.., j, i] = (colmask[i] ∧ side[j] = side[i] ∧ keep(j, i)) ∨ (i = j)
+
+    with keep(j, i) the murmur3-finalized link draw at flat index j·n + i —
+    bit-exact with ``scenarios.link_bernoulli`` / ``from_fault_params`` at
+    the same indices, because the finalizer is imported from the ONE shared
+    implementation (ops.fused._fmix32).
+
+    This is the receiver-block slicing every proc-sharded exchange shares:
+    ``ops.fused.ho_link_mask`` is the ``jg=None`` dense instance (the
+    oracle, ``engine.fast.mix_ho``, the per-scenario replay), and
+    ``parallel.mesh._ho_block`` / the ICI ring-exchange path
+    (``parallel/ici.py``) call it at each device's global receiver rows —
+    so the sharded paths' claimed bit-parity cannot drift from the dense
+    formula (tests/test_mesh.py pins rows against the dense matrix).
+
+    Leading batch dims broadcast; salts/p8 may be scalars or ``[..]``.
+    ``jg`` may be a traced vector (``jax.lax.axis_index``-derived under
+    shard_map)."""
+    from round_tpu.ops.fused import _GOLD, _fmix32  # lazy: fused imports us
+
+    colmask = jnp.asarray(colmask)
+    n = colmask.shape[-1]
+    i = jnp.arange(n, dtype=jnp.uint32)
+    if jg is None:
+        jg = jnp.arange(n, dtype=jnp.int32)
+    jg = jnp.asarray(jg)
+    idx = jg.astype(jnp.uint32)[:, None] * jnp.uint32(n) + i[None, :]
+    s0 = jnp.asarray(salt0).astype(jnp.uint32)[..., None, None]
+    s1 = jnp.asarray(salt1r).astype(jnp.uint32)[..., None, None]
+    p8 = jnp.asarray(p8)
+    z = idx * jnp.uint32(_GOLD) + s0
+    z = z ^ s1
+    keep = (_fmix32(z) & jnp.uint32(0xFF)) \
+        >= p8.astype(jnp.uint32)[..., None, None]
+    keep = keep | (p8 <= 0)[..., None, None]
+    side = jnp.asarray(side)
+    side_rows = jnp.take(side, jg, axis=-1)
+    ho = ((colmask != 0)[..., None, :]
+          & (side_rows[..., :, None] == side[..., None, :]) & keep)
+    eye = jnp.arange(n, dtype=jg.dtype)[None, :] == jg[:, None]
+    return ho | eye
+
+
+# ---------------------------------------------------------------------------
+# Packed sender codes: ONE exchanged tensor per histogram subround
+# ---------------------------------------------------------------------------
+
+def hist_pack(payload: jnp.ndarray, sending: jnp.ndarray) -> jnp.ndarray:
+    """Fold a histogram subround's (payload, sender-eligibility) pair into
+    ONE wire tensor: ``code = payload + 1`` where the lane transmits, 0
+    (silence) otherwise.  The proc-sharded collective path gathers payload
+    and sending as two tensors; the ICI ring exchange moves only this
+    packed code — same information, ~½ the bytes (int32 + bool → int32)."""
+    return jnp.where(sending, payload.astype(jnp.int32) + 1, 0)
+
+
+def hist_code_counts(code_full, ho, num_values: int) -> jnp.ndarray:
+    """``[.., V, m]`` receiver-block histogram counts from the packed
+    sender codes (``hist_pack``) and the block's HO rows:
+
+        counts[.., v, j] = #{ i : ho[.., j, i] ∧ code[.., i] = v + 1 }
+
+    Termwise identical to the unpacked form
+    ``Σᵢ (payload[i] = v) ∧ sending[i] ∧ ho[j, i]`` — silence is code 0,
+    which matches no histogram row — and the accumulation is exact int32,
+    so packed and unpacked paths are bit-identical, order-free."""
+    oh = (code_full[..., None, :]
+          == (1 + jnp.arange(num_values,
+                             dtype=code_full.dtype))[None, :, None])
+    return jnp.einsum(
+        "...vi,...ji->...vj",
+        oh.astype(jnp.int32), jnp.asarray(ho).astype(jnp.int32))

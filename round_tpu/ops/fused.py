@@ -908,25 +908,16 @@ def ho_link_mask(colmask, side, salt0, salt1r, p8) -> jnp.ndarray:
     THE one dense implementation of the link-mask formula — the oracle
     (hist_exchange_reference), the whole-mix form (engine.fast.mix_ho) and
     the per-scenario replay (scenarios.from_fault_params) all call it, so
-    the hash stream cannot drift between them.  (_lv_keep stays separate:
-    the LV kernel computes single rows/columns, not the dense matrix.)
-    Leading batch dims broadcast; salts/p8 may be scalars or [..]."""
-    colmask = jnp.asarray(colmask)
-    n = colmask.shape[-1]
-    i = jnp.arange(n, dtype=jnp.uint32)
-    idx = i[:, None] * jnp.uint32(n) + i[None, :]      # [recv j, sender i]
-    s0 = jnp.asarray(salt0).astype(jnp.uint32)[..., None, None]
-    s1 = jnp.asarray(salt1r).astype(jnp.uint32)[..., None, None]
-    p8 = jnp.asarray(p8)
-    z = idx * jnp.uint32(_GOLD) + s0
-    z = z ^ s1
-    keep = (_fmix32(z) & jnp.uint32(0xFF)) \
-        >= p8.astype(jnp.uint32)[..., None, None]
-    keep = keep | (p8 <= 0)[..., None, None]
-    side = jnp.asarray(side)
-    ho = ((colmask != 0)[..., None, :]
-          & (side[..., :, None] == side[..., None, :]) & keep)
-    return ho | jnp.eye(n, dtype=bool)
+    the hash stream cannot drift between them.  Since the ICI rung it IS
+    the ``jg=None`` instance of ``ops.exchange.ho_block`` — the
+    receiver-block form the proc-sharded paths slice — so the dense matrix
+    and every sharded block come from one formula.  (_lv_keep stays
+    separate: the LV kernel computes single rows/columns, not the dense
+    matrix.)  Leading batch dims broadcast; salts/p8 may be scalars or
+    [..]."""
+    from round_tpu.ops.exchange import ho_block  # lazy: it imports _fmix32
+
+    return ho_block(colmask, side, salt0, salt1r, p8)
 
 
 def hist_exchange_reference(

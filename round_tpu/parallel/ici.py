@@ -1,0 +1,647 @@
+"""Pallas ICI mailbox exchange: ring remote-copies instead of XLA gathers.
+
+The proc-sharded runners (parallel/mesh.py) distribute receivers over the
+``proc`` mesh axis and, per round, move each shard's O(n) sender vectors to
+every other shard.  Until the ICI rung that exchange was a plain XLA
+``all_gather`` of TWO full tensors (payload + sender-eligibility); this
+module replaces it with ONE Pallas ring exchange of the packed sender code
+(ops.exchange.hist_pack), moved chunk-by-chunk over ICI with
+``pltpu.make_async_remote_copy`` + DMA semaphores at LOGICAL device ids —
+SNIPPETS.md [1]/[3]'s pattern, grown into the framework's wire:
+
+  * each ring step forwards exactly one receiver-block slice (the
+    [S_l, n_l] chunk a peer shard actually consumes), so per-device ICI
+    traffic is the (p-1)/p remote fraction of the gather — the XLA
+    collective is counted at its full [S_l, n] output, and the packed code
+    additionally folds the eligibility gather away (~½ the bytes again);
+  * the DMA chain is explicit, so the cross-round software-pipelined loop
+    (engine.fast.hist_scan ho_fn form) can overlap round r+1's HO-block
+    generation (VPU) and the remote-copy start with round r's count matmul
+    (MXU) — the overlap slack PERF_MODEL.md's pipelining analysis names.
+
+HONESTY CONTRACT (this box has no TPU): everything here is landed
+*provably one flag away* rather than measured on silicon —
+
+  * interpret-mode kernels are BIT-PARITY with the collective path over a
+    forced 8-host-device CPU mesh for every MULTICHIP dryrun family
+    (tests/test_ici.py, the multichip-ici soak rung);
+  * ``jax.export`` lowering proves the TPU path emits the Pallas
+    custom-call and NO XLA all-gather for the exchange
+    (tpu_lowering_flags / tests/test_ici.py);
+  * the collective-traffic win is measured by compiled-HLO cost analysis
+    on the CPU mesh (collective path) against the ring's static DMA bytes
+    (exchange_bytes_report), banked per family in SOAK.jsonl and the
+    ``pallas-ici`` bench arm;
+  * what is NOT yet measured: whether Mosaic actually overlaps the DMA
+    with the MXU pass on hardware, and the in-kernel fusion of the count
+    matmul into the ring steps (chunk-wise accumulate while later chunks
+    are in flight — exact, since int32 adds commute).  PERF_MODEL.md "ICI
+    exchange roofline" carries both as open headroom.
+
+Interpret mode has no barrier-semaphore lowering on CPU, so the neighbor
+barrier (and its ``collective_id``) is compiled only on the real TPU path;
+the interpret emulation discharges each DMA through lockstep collectives,
+which subsumes the barrier.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import re
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+#: collective_id for the ring kernel's neighbor barrier (Mosaic requires a
+#: stable id per distinct collective kernel in flight; this module has one)
+RING_COLLECTIVE_ID = 19
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8,
+}
+
+#: public v5e ICI ceilings for the roofline band: per-link bandwidth is
+#: quoted at 400 Gbps/link with 2 links per ring direction on a 2D torus;
+#: the band [low, high] brackets one-link vs both-links utilization
+ICI_GBPS_BAND = (25.0, 100.0)  # GB/s usable per device, conservative band
+
+
+def _ring_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *,
+                 p: int, cols: int, axis: str, ring_stride: int,
+                 other_axes: tuple, barrier: bool):
+    """All-gather over the ring: out[:, d*cols:(d+1)*cols] = shard d's x.
+
+    Slot j of `out` holds origin-j's chunk on EVERY device, so the slice
+    forwarded at step k — origin (me - k) mod p — names the same columns
+    on sender and receiver: src_ref and dst_ref are one slice expression,
+    and each slot is written exactly once per invocation (no buffer reuse
+    across steps, hence no clobber window between ring neighbors).  Step
+    k's send waits both its own completion and the step-k arrival from the
+    left (``.wait()`` covers send_sem and recv_sem in the symmetric SPMD
+    program), so the chunk forwarded at k+1 is always resident.
+
+    Device ids are FLAT LOGICAL (position in mesh.devices.flat): the ring
+    rides the `axis` coordinate at its row-major ``ring_stride``, with
+    every other mesh axis (``other_axes``: (name, stride) pairs) pinned —
+    on the (scenario × proc) mesh the exchange stays inside this
+    scenario-row's proc ring, exactly like the all_gather it replaces."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    me = jax.lax.axis_index(axis)
+    base = jnp.int32(0)
+    for name, stride in other_axes:
+        base = base + jax.lax.axis_index(name) * stride
+    right = base + jax.lax.rem(me + 1, p) * ring_stride
+    if barrier:
+        # all ring neighbors inside the kernel before the first remote
+        # write (the Mosaic collective discipline; needs collective_id)
+        left = base + jax.lax.rem(me + p - 1, p) * ring_stride
+        bsem = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(
+            bsem, inc=1, device_id=left,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(
+            bsem, inc=1, device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(bsem, 2)
+
+    local = pltpu.make_async_copy(
+        x_ref, out_ref.at[:, pl.ds(me * cols, cols)], copy_sem)
+    local.start()
+    local.wait()
+
+    def step(k, _):
+        src = jax.lax.rem(me - k + p, p)  # origin of the chunk forwarded now
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=out_ref.at[:, pl.ds(src * cols, cols)],
+            dst_ref=out_ref.at[:, pl.ds(src * cols, cols)],
+            send_sem=send_sem, recv_sem=recv_sem,
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        return 0
+
+    jax.lax.fori_loop(0, p - 1, step, 0)
+
+
+def ring_exchange(x: jnp.ndarray, *, axis: str, p: int, interpret: bool,
+                  ring_stride: int = 1, other_axes: tuple = ()
+                  ) -> jnp.ndarray:
+    """``[S_l, cols]`` per-shard chunk -> ``[S_l, p * cols]`` full tensor,
+    device chunks in ring-coordinate order (= ``all_gather(...,
+    tiled=True)`` column order).  Must run inside shard_map over `axis`
+    with p shards; ``ring_stride``/``other_axes`` carry the flat-logical
+    layout of any additional mesh axes (see _ring_kernel).
+
+    The TPU path (interpret=False) compiles the Mosaic ring kernel with
+    the neighbor barrier; interpret mode (the CPU parity path) discharges
+    each remote DMA through lockstep collectives — the barrier primitive
+    has no CPU lowering and is subsumed by that discharge.  The interpret
+    discharge only supports single-axis meshes; multi-axis callers go
+    through make_ring_gather, which swaps in the ppermute ring emulation
+    there."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S_l, cols = x.shape
+    kernel = functools.partial(
+        _ring_kernel, p=p, cols=cols, axis=axis, ring_stride=ring_stride,
+        other_axes=tuple(other_axes), barrier=not interpret)
+    params = {}
+    if not interpret:
+        params["compiler_params"] = pltpu.TPUCompilerParams(
+            collective_id=RING_COLLECTIVE_ID)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((S_l, p * cols), x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA] * 3,
+        interpret=interpret,
+        name="ici_ring_exchange",
+        **params,
+    )(x)
+
+
+def _ring_gather_emulated(x: jnp.ndarray, axis: str, p: int) -> jnp.ndarray:
+    """The interpret-mode stand-in for the ring kernel on MULTI-AXIS
+    meshes (jax's DMA discharge emulates remote copies only inside a
+    single-named-axis env): the SAME wire pattern — p-1 right-neighbor
+    ring hops of the [S_l, cols] chunk, nothing else crosses a device —
+    as lax.ppermute steps.  Output is the origin-ordered concatenation,
+    bit-identical to the kernel's (integer copies commute with nothing).
+    Note exchange_bytes_report counts the ici side from the STATIC
+    ring_bytes_per_round formula (see its docstring) — this emulation's
+    compiled collective-permutes would measure the same wire pattern,
+    but the banked number is the model, kept honest by the parity tests
+    pinning that both paths move identical chunks."""
+    me = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+    hops = [x]
+    for _ in range(p - 1):
+        hops.append(jax.lax.ppermute(hops[-1], axis, perm))
+    stacked = jnp.stack(hops)            # hop k holds origin (me - k) mod p
+    slot_of = jnp.remainder(me - jnp.arange(p), p)
+    ordered = jnp.take(stacked, slot_of, axis=0)   # slot j = origin j
+    return jnp.moveaxis(ordered, 0, 1).reshape(
+        (x.shape[0], p * x.shape[1]))
+
+
+def make_ring_gather(axis: str, p: int, interpret: bool,
+                     mesh=None) -> Callable:
+    """A drop-in for ``lax.all_gather(x, axis, axis=1, tiled=True)`` over
+    the ring exchange: ``[S_l, n_l, *F] -> [S_l, p * n_l, *F]`` (trailing
+    feature dims ride flattened into the ring columns).  p == 1 shards
+    are the identity — no kernel, no copy.
+
+    ``mesh`` (when given) supplies the flat-logical layout for the Mosaic
+    kernel on multi-axis meshes, and selects the ppermute ring emulation
+    for interpret mode there (see _ring_gather_emulated)."""
+    ring_stride = 1
+    other_axes: tuple = ()
+    if mesh is not None and len(mesh.axis_names) > 1:
+        stride, strides = 1, {}
+        for name in reversed(list(mesh.axis_names)):
+            strides[name] = stride
+            stride *= mesh.shape[name]
+        ring_stride = strides[axis]
+        other_axes = tuple((name, strides[name])
+                           for name in mesh.axis_names if name != axis)
+
+    def gather(x):
+        if p == 1:
+            return x
+        S_l, n_l = x.shape[0], x.shape[1]
+        feat = x.shape[2:]
+        flat = x.reshape(S_l, -1)
+        if interpret and other_axes:
+            full = _ring_gather_emulated(flat, axis, p)
+        else:
+            full = ring_exchange(
+                flat, axis=axis, p=p, interpret=interpret,
+                ring_stride=ring_stride, other_axes=other_axes)
+        return full.reshape((S_l, p * n_l) + feat)
+
+    return gather
+
+
+def ring_bytes_per_round(S_l: int, n_l: int, p: int, itemsize: int,
+                         exchanges_per_round: int = 1) -> int:
+    """Per-device ICI bytes one round of the ring exchange moves: p-1
+    remote copies of the [S_l, n_l] chunk (the only data that crosses a
+    chip; the local slot write stays on-device)."""
+    return (p - 1) * S_l * n_l * itemsize * exchanges_per_round
+
+
+# ---------------------------------------------------------------------------
+# Compiled-HLO cost analysis: collective bytes per round
+# ---------------------------------------------------------------------------
+
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+_COLLECTIVE_RE = re.compile(
+    r"=\s*(\([^)]*\)|\S+)\s+"
+    r"(all-gather|all-reduce|collective-permute|all-to-all|"
+    r"reduce-scatter)(-start)?\(")
+
+
+def _shape_bytes(shape_text: str) -> int:
+    """Transferred bytes of one collective's result shape.  An async
+    ``-start`` op carries a TUPLE ``(operand, result[, context..])``;
+    only the result component is the wire transfer, so a tuple counts
+    its LARGEST element (the result is never smaller than the operand,
+    and context scalars are tiny) — keeping async and sync lowerings of
+    the same collective equal (a sync ``all-gather s32[..]`` already
+    counts the result alone)."""
+    def one(dtype, dims):
+        if dtype not in _DTYPE_BYTES:
+            return 0
+        count = 1
+        for d in dims.split(","):
+            if d:
+                count *= int(d)
+        return count * _DTYPE_BYTES[dtype]
+
+    sizes = [one(dt, dm) for dt, dm in _SHAPE_RE.findall(shape_text)]
+    if shape_text.lstrip().startswith("(") and len(sizes) > 1:
+        return max(sizes)
+    return sum(sizes)
+
+
+def hlo_collective_bytes(hlo_text: str) -> dict:
+    """Sum the result bytes of every cross-device collective op in an
+    optimized HLO dump — the compiled-HLO cost analysis of "bytes moved
+    per round" (loop bodies appear once in the dump, so ops inside the
+    round ``while`` count once per round).  ``-start`` ops are counted,
+    their ``-done`` halves are not (same transfer)."""
+    per_kind: dict = {}
+    total = 0
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        b = _shape_bytes(m.group(1))
+        kind = m.group(2)
+        per_kind[kind] = per_kind.get(kind, 0) + b
+        total += b
+    return {"total": total, "per_kind": per_kind}
+
+
+# ---------------------------------------------------------------------------
+# The family table: every MULTICHIP dryrun family, both exchange paths
+# ---------------------------------------------------------------------------
+
+def _family_runner(family: str, n: int, S: int, rounds: int, key):
+    """(state0, mix, run_fn) for one proc-sharded dryrun family, where
+    ``run_fn(state0, mix, mesh, exchange, pipelined)`` executes it.  The
+    SAME builders back the parity tests, the soak rung, the bench arm and
+    the watch probe, so they cannot check different programs."""
+    from round_tpu.engine import fast
+    from round_tpu.parallel import mesh as meshmod
+
+    if family == "hist":
+        from round_tpu.models.otr import OtrState
+
+        V = 4
+        mix = fast.standard_mix(key, S, n, p_drop=0.25)
+        init = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0, V,
+                                  dtype=jnp.int32)
+        rnd = fast.OtrHist(n_values=V, after_decision=2)
+        state0 = OtrState.fresh(init, S, n)
+
+        def run(state0, mix, mesh, exchange, pipelined, interpret=None):
+            return meshmod.run_hist_proc_sharded(
+                rnd, state0, mix, rounds, mesh, exchange=exchange,
+                pipelined=pipelined, interpret=interpret)
+
+        return state0, mix, run
+    if family == "benor":
+        from round_tpu.models.benor import BenOrState
+
+        mix = fast.standard_mix(key, S, n, p_drop=0.15)
+        init = jax.random.bernoulli(jax.random.fold_in(key, 1), 0.5, (n,))
+        rnd = fast.BenOrHist()
+        state0 = BenOrState(
+            x=jnp.broadcast_to(init, (S, n)),
+            vote=jnp.full((S, n), -1, jnp.int32),
+            can_decide=jnp.zeros((S, n), bool),
+            decided=jnp.zeros((S, n), bool),
+            decision=jnp.zeros((S, n), bool),
+        )
+
+        def run(state0, mix, mesh, exchange, pipelined, interpret=None):
+            return meshmod.run_hist_proc_sharded(
+                rnd, state0, mix, rounds, mesh, exchange=exchange,
+                pipelined=pipelined, interpret=interpret)
+
+        return state0, mix, run
+    if family == "tpc":
+        from round_tpu.models.tpc import TpcState
+
+        mix = fast.standard_mix(key, S, n, p_drop=0.25, f=max(1, n // 4),
+                                crash_round=0)
+        votes = jax.random.bernoulli(jax.random.fold_in(key, 2), 0.8, (n,))
+        state0 = TpcState(
+            coord=jnp.zeros((S, n), jnp.int32),
+            vote=jnp.broadcast_to(votes, (S, n)),
+            decision=jnp.full((S, n), -1, jnp.int32),
+            decided=jnp.zeros((S, n), bool),
+        )
+
+        def run(state0, mix, mesh, exchange, pipelined, interpret=None):
+            return meshmod.run_tpc_proc_sharded(
+                state0, mix, mesh, exchange=exchange, pipelined=pipelined,
+                interpret=interpret)
+
+        return state0, mix, run
+    if family == "erb":
+        from round_tpu.models.erb import ErbState, broadcast_io
+
+        V = 8
+        mix = fast.standard_mix(key, S, n, p_drop=0.25, f=max(1, n // 4),
+                                crash_round=0)
+        io = broadcast_io(0, 5, n)
+        state0 = ErbState.fresh(io, S, n)
+
+        def run(state0, mix, mesh, exchange, pipelined, interpret=None):
+            return meshmod.run_erb_proc_sharded(
+                state0, mix, mesh, rounds, V, exchange=exchange,
+                pipelined=pipelined, interpret=interpret)
+
+        return state0, mix, run
+    if family == "lattice":
+        from round_tpu.models.lattice import LatticeState, lattice_io
+
+        m = 10
+        mix = fast.standard_mix(key, S, n, p_drop=0.2)
+        sets = [[i % m, (5 * i + 2) % m] for i in range(n)]
+        io = lattice_io(sets, m)
+        init = jnp.asarray(io["initial_value"], bool)
+        state0 = LatticeState(
+            active=jnp.ones((S, n), bool),
+            proposed=jnp.broadcast_to(init, (S, n, m)),
+            decided=jnp.zeros((S, n), bool),
+            decision=jnp.zeros((S, n, m), bool),
+        )
+
+        def run(state0, mix, mesh, exchange, pipelined, interpret=None):
+            return meshmod.run_lattice_proc_sharded(
+                state0, mix, mesh, rounds, exchange=exchange,
+                pipelined=pipelined, interpret=interpret)
+
+        return state0, mix, run
+    raise ValueError(f"unknown ici family {family!r}")
+
+
+FAMILIES = ("hist", "benor", "tpc", "erb", "lattice")
+
+
+def family_parity(family: str, *, n: int = 16, S: int = 8,
+                  proc_shards: int = 2, rounds: int = 6,
+                  seed: int = 3, pipelined: bool = True) -> bool:
+    """Raw-bit tree equality of the ICI exchange against the collective
+    path for one dryrun family on the virtual mesh — the
+    ``_assert_tree_parity`` discipline as a predicate."""
+    import numpy as np
+
+    from round_tpu.parallel.mesh import make_mesh
+
+    key = jax.random.PRNGKey(seed)
+    state0, mix, run = _family_runner(family, n, S, rounds, key)
+    ndev = len(jax.devices())
+    mesh = make_mesh(ndev, proc_shards=proc_shards)
+    ref = run(state0, mix, mesh, "collective", False)
+    got = run(state0, mix, mesh, "ici", pipelined)
+    for a, b in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(ref)):
+        a, b = np.asarray(a), np.asarray(b)
+        if a.shape != b.shape or not (
+                a.view(np.uint8) == b.view(np.uint8)).all():
+            return False
+    return True
+
+
+#: gathering subround branches per family in the compiled module:
+#: hist_scan dispatches subround k = r % phase_len, so EVERY branch's
+#: all_gather pair appears once in the HLO while exactly ONE branch
+#: executes per round — the cost analysis must divide the module total
+#: by this count (= phase_len minus no-exchange subrounds; single-phase
+#: families compile no switch).  Pinned against the round classes by
+#: tests/test_ici.py::test_exchange_branch_counts.
+_EXCHANGE_BRANCHES = {"hist": 1, "benor": 2, "tpc": 2, "erb": 1,
+                      "lattice": 1}
+
+
+def exchange_bytes_report(*, n: int = 16, S: int = 8, proc_shards: int = 2,
+                          rounds: int = 3, family: str = "hist") -> dict:
+    """Collective bytes moved per round, ici vs all_gather, for one
+    family: the collective path's bytes come from the compiled HLO on the
+    virtual mesh (hlo_collective_bytes over the optimized module — real
+    all-gathers, really lowered — divided by _EXCHANGE_BRANCHES, since a
+    multi-subround switch compiles every branch but executes one per
+    round), the ici path's from the ring kernel's static DMA sizes (its
+    interpret-mode CPU lowering emulates the DMAs through collectives, so
+    compiling THAT would measure the emulation, not the kernel — the TPU
+    module keeps the bytes inside the Mosaic custom-call).  The gate: ici
+    moves at most the (p-1)/p remote fraction of the full-tensor
+    gather."""
+    from round_tpu.parallel.mesh import make_mesh
+
+    key = jax.random.PRNGKey(3)
+    state0, mix, run = _family_runner(family, n, S, rounds, key)
+    ndev = len(jax.devices())
+    mesh = make_mesh(ndev, proc_shards=proc_shards)
+
+    txt = (jax.jit(lambda s0, mx: run(s0, mx, mesh, "collective", False))
+           .lower(state0, mix).compile().as_text())
+    coll = hlo_collective_bytes(txt)
+    branches = _EXCHANGE_BRANCHES[family]
+    coll = {"total": coll["total"] // branches,
+            "per_kind": {k: v // branches
+                         for k, v in coll["per_kind"].items()}}
+
+    s_shards = ndev // proc_shards
+    S_l, n_l = S // s_shards, n // proc_shards
+    # per round the ici path exchanges ONE packed tensor: int32 codes for
+    # the histogram families, int8 (active | bit-planes) for lattice
+    if family == "lattice":
+        m = state0.proposed.shape[-1]
+        ici = ring_bytes_per_round(S_l, n_l * (m + 1), proc_shards, 1)
+    else:
+        ici = ring_bytes_per_round(S_l, n_l, proc_shards, 4)
+    bound = (proc_shards - 1) / proc_shards
+    ratio = ici / coll["total"] if coll["total"] else float("inf")
+    return {
+        "family": family,
+        "n": n, "S": S, "proc_shards": proc_shards,
+        "collective_bytes_per_round": coll["total"],
+        "collective_per_kind": coll["per_kind"],
+        "ici_bytes_per_round": ici,
+        "ratio": round(ratio, 4),
+        "bound": round(bound, 4),
+        "ok": coll["total"] > 0 and ratio <= bound + 1e-9,
+    }
+
+
+def tpu_lowering_flags(*, n: int = 128, S: int = 8, proc_shards: int = 2,
+                       rounds: int = 2, family: str = "hist") -> dict:
+    """jax.export the ICI runner for platform "tpu" from this (CPU) box:
+    runs the Pallas→Mosaic pipeline for real and proves (a) the exchange
+    lowers to the TPU custom-call and (b) NO XLA all-gather remains in
+    the module — the collective was replaced, not duplicated.  Returns
+    the flags; raises on export failure (callers decide skip-vs-fail)."""
+    from jax import export as jexport
+
+    from round_tpu.parallel.mesh import make_mesh
+
+    key = jax.random.PRNGKey(3)
+    state0, mix, run = _family_runner(family, n, S, rounds, key)
+    ndev = len(jax.devices())
+    mesh = make_mesh(ndev, proc_shards=proc_shards)
+
+    exp = jexport.export(
+        jax.jit(lambda s0, mx: run(s0, mx, mesh, "ici", True,
+                                   interpret=False)),
+        platforms=("tpu",),
+    )(state0, mix)
+    txt = exp.mlir_module()
+    return {
+        "nr_devices": exp.nr_devices,
+        "tpu_custom_call": "tpu_custom_call" in txt,
+        "xla_all_gather_ops": sum(
+            1 for line in txt.splitlines()
+            if "stablehlo.all_gather" in line or '"all-gather"' in line),
+    }
+
+
+# ---------------------------------------------------------------------------
+# The exchange-aware roofline (PERF_MODEL.md "ICI exchange roofline")
+# ---------------------------------------------------------------------------
+
+def roofline(*, n: int = 1024, S: int = 10_000, V: int = 16, p: int = 4,
+             dot: str = "i8") -> dict:
+    """Predicted proc-sharded rounds/sec band at the flagship shape.
+
+    Per device per round: the count matmul shrinks to the receiver block
+    ([v_pad, n] x [n, n_l] per scenario — 1/p of the single-chip MACs),
+    the HO block to n_l·n hashes, and the wire to the ring's
+    (p-1)/p · S_l·n_l·4 bytes.  Compute band reuses PERF_MODEL.md's v2
+    t_round band scaled by 1/p; comm band divides the ring bytes by the
+    ICI_GBPS_BAND.  The prediction assumes the pipelined loop hides
+    whichever side is shorter (max, not sum) — exactly the overlap that
+    is NOT yet measured on silicon."""
+    v_pad = V + 1
+    if v_pad % 8:
+        v_pad += 8 - v_pad % 8
+    # PERF_MODEL v2 per-(scenario, round) t_round bands, seconds
+    t_round = {"i8": (0.68e-6, 1.2e-6), "bf16": (1.36e-6, 2.6e-6)}[dot]
+    eff_rounds = 0.775 * S  # family-split discount, PERF_MODEL.md
+    comp_lo = eff_rounds * t_round[0] / p
+    comp_hi = eff_rounds * t_round[1] / p
+    S_l = S  # scenario axis unsharded in the pure-proc shape
+    wire = ring_bytes_per_round(S_l, n // p, p, 4)
+    comm_lo = wire / (ICI_GBPS_BAND[1] * 1e9)
+    comm_hi = wire / (ICI_GBPS_BAND[0] * 1e9)
+    overlap_lo = max(comp_lo, comm_lo)   # full overlap, fast band
+    serial_hi = comp_hi + comm_hi        # zero overlap, slow band
+    return {
+        "n": n, "S": S, "V": V, "p": p, "dot": dot,
+        "ici_bytes_per_round_per_device": wire,
+        "t_compute_us": [round(comp_lo * 1e6, 1), round(comp_hi * 1e6, 1)],
+        "t_wire_us": [round(comm_lo * 1e6, 1), round(comm_hi * 1e6, 1)],
+        "rounds_per_sec": [round(1.0 / serial_hi, 1),
+                           round(1.0 / overlap_lo, 1)],
+        "single_chip_rounds_per_sec": [107, 190],  # PERF_MODEL v2-i8 band
+    }
+
+
+# ---------------------------------------------------------------------------
+# The status probe: one JSON line, PROBE_STAGE-narrated
+# ---------------------------------------------------------------------------
+
+def status(*, n: int = 64, S: int = 16, proc_shards: int = 2,
+           rounds: int = 4, stage_fn=None) -> dict:
+    """The Pallas-ICI status line every surface banks (the ``pallas-ici``
+    bench arm, tools/tpu_watch.py's rotation step, and — piecewise — the
+    multichip-ici soak rung): interpret parity on the hist family, the
+    TPU lowering flags, the measured bytes ratio, and the flagship
+    roofline prediction.  ``stage_fn(name)`` narrates progress in the
+    PROBE_STAGE discipline so a hang names its stage."""
+    def stage(s):
+        if stage_fn:
+            stage_fn(s)
+
+    from round_tpu.parallel.mesh import has_shard_map
+
+    out: dict = {"n": n, "S": S, "proc_shards": proc_shards}
+    if not has_shard_map():
+        out["skipped"] = "no shard_map in this jax build"
+        return out
+    ndev = len(jax.devices())
+    if ndev < 2 or ndev % proc_shards:
+        # a skipped STATUS line, never a bare make_mesh assert: a stock
+        # 1-device box (no forced host-device flag) must still bank a
+        # parseable record (the bench arm forces the flag; the module CLI
+        # and direct callers may not)
+        out["skipped"] = (f"needs a device count divisible by "
+                          f"proc_shards={proc_shards} and >= 2, have "
+                          f"{ndev}")
+        return out
+    stage("ici-parity")
+    out["parity"] = family_parity(
+        "hist", n=n, S=S, proc_shards=proc_shards, rounds=rounds)
+    stage("ici-bytes")
+    try:
+        rep = exchange_bytes_report(
+            n=n, S=S, proc_shards=proc_shards, rounds=rounds)
+        out["bytes"] = {k: rep[k] for k in
+                        ("collective_bytes_per_round",
+                         "ici_bytes_per_round", "ratio", "bound", "ok")}
+    except Exception as e:  # noqa: BLE001 — a cost-analysis failure is a
+        # recorded fact, not a probe abort
+        out["bytes"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    stage("ici-lowering")
+    lowering_ok = True
+    try:
+        out["lowering"] = tpu_lowering_flags(
+            n=max(n, 128), S=S, proc_shards=proc_shards, rounds=2)
+        lowering_ok = bool(out["lowering"]["tpu_custom_call"]
+                           and out["lowering"]["xla_all_gather_ops"] == 0)
+    except Exception as e:  # noqa: BLE001 — banked, NOT gated: some jax
+        # builds can't cross-lower for tpu (the soak rung and the test
+        # suite's skip-not-fail make the same call); a SUCCESSFUL export
+        # with bad flags still gates below
+        out["lowering"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    stage("ici-roofline")
+    out["roofline"] = roofline(p=max(proc_shards, 2))
+    out["ok"] = bool(
+        out["parity"]
+        and out.get("bytes", {}).get("ok")
+        and lowering_ok)
+    return out
+
+
+def _main():
+    """``python -m round_tpu.parallel.ici``: print the status line as one
+    JSON object, narrating PROBE_STAGE markers on stderr (the bench
+    driver's marker format — tools/tpu_watch.py banks the last stage a
+    killed probe reached)."""
+    import sys
+
+    def stage(s):
+        sys.stderr.write("PROBE_STAGE " + s + "\n")
+        sys.stderr.flush()
+
+    stage("ici-import")
+    print(json.dumps(status(stage_fn=stage)), flush=True)
+
+
+if __name__ == "__main__":
+    _main()

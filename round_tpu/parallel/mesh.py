@@ -37,6 +37,34 @@ SCENARIO_AXIS = "scenario"
 PROC_AXIS = "proc"
 
 
+def has_shard_map() -> bool:
+    """True when this jax build offers shard_map under either spelling —
+    the skip-not-fail predicate of every sharded test/probe."""
+    if hasattr(jax, "shard_map"):
+        return True
+    try:
+        from jax.experimental.shard_map import shard_map as _  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """jax.shard_map across jax versions: the top-level spelling
+    (``check_vma``) when present, else the jax.experimental spelling
+    (``check_rep`` — the same knob under its pre-0.6 name).  Every
+    shard_map in this package routes through here, so the sharded paths
+    run (rather than AttributeError) on both generations of jax."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
 def make_mesh(
     n_devices: Optional[int] = None, proc_shards: int = 1, devices=None
 ) -> Mesh:
@@ -80,7 +108,7 @@ def sharded_keyed_parity(one_fn, keys, n_devices, devices=None):
     mesh = Mesh(np.asarray(devs[:n_devices]), (SCENARIO_AXIS,))
 
     @partial(
-        jax.shard_map, mesh=mesh, in_specs=(_P(SCENARIO_AXIS),),
+        shard_map, mesh=mesh, in_specs=(_P(SCENARIO_AXIS),),
         out_specs=_P(SCENARIO_AXIS), check_vma=False,
     )
     def run(keys_shard):
@@ -171,7 +199,7 @@ def sharded_simulate(
         return state, done, decided_round
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(spec, P(SCENARIO_AXIS)),
         out_specs=(spec, spec, spec),
@@ -186,26 +214,32 @@ def sharded_simulate(
 def _ho_block(mix_l, r, jg, n):
     """This device's HO mask block at GLOBAL (receiver jg, sender i)
     indices — the scenarios.from_fault_params formula row-sliced, through
-    the ONE shared hash finalizer (ops.fused._fmix32).  Shared by every
-    receiver-sharded counts_fn (histogram and bitset families)."""
+    the ONE shared receiver-block helper (ops.exchange.ho_block, which the
+    dense ops.fused.ho_link_mask is also an instance of).  Shared by every
+    receiver-sharded counts_fn (histogram and bitset families) and the ICI
+    exchange path."""
     from round_tpu.engine import fast as _fast
+    from round_tpu.ops.exchange import ho_block
 
-    n_l = jg.shape[0]
-    j0 = jg[0]
     colmask, side_r, p8, salt0, salt1r = _fast.round_params(mix_l, r)
-    idx = (jg.astype(jnp.uint32)[None, :, None] * jnp.uint32(n)
-           + jnp.arange(n, dtype=jnp.uint32)[None, None, :])
-    z = idx * jnp.uint32(0x9E3779B9) \
-        + salt0.astype(jnp.uint32)[:, None, None]
-    z = z ^ salt1r.astype(jnp.uint32)[:, None, None]
-    keep = ((_fast.fused._fmix32(z) & jnp.uint32(0xFF))
-            >= p8.astype(jnp.uint32)[:, None, None])
-    keep = keep | (p8 <= 0)[:, None, None]
-    side_l = jax.lax.dynamic_slice_in_dim(side_r, j0, n_l, axis=1)
-    eye = jnp.arange(n, dtype=jnp.int32)[None, :] == jg[:, None]
-    return (colmask[:, None, :]
-            & (side_l[:, :, None] == side_r[:, None, :])
-            & keep) | eye[None]
+    return ho_block(colmask, side_r, salt0, salt1r, p8, jg=jg)
+
+
+def _resolve_exchange(exchange: str, pipelined, interpret):
+    """Shared kwarg policy of the proc-sharded runners: the XLA-collective
+    path stays the default A/B control; ``exchange="ici"`` opts into the
+    Pallas ring exchange, which defaults to the cross-round pipelined loop
+    (straight-line stays selectable as the compile-insurance fallback).
+    ``interpret=None`` resolves per backend — interpret kernels on CPU
+    (the bit-parity emulation), compiled Mosaic on an accelerator."""
+    if exchange not in ("collective", "ici"):
+        raise ValueError(f"unknown exchange {exchange!r}; "
+                         "want 'collective' or 'ici'")
+    if pipelined is None:
+        pipelined = exchange == "ici"
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    return exchange, pipelined, interpret
 
 
 def run_hist_proc_sharded(
@@ -216,6 +250,9 @@ def run_hist_proc_sharded(
     mesh: Mesh,
     decided_fn=None,
     send_guard_fn=None,
+    exchange: str = "collective",
+    pipelined=None,
+    interpret=None,
 ):
     """engine.fast.run_hist with the PROCESS axis sharded over PROC_AXIS
     (and scenarios over SCENARIO_AXIS): the fast histogram path for groups
@@ -243,11 +280,26 @@ def run_hist_proc_sharded(
     the payload and ANDed into the delivery — note this sharded
     formulation has NO hardwired self-delivery to correct (the eye term is
     part of `ho` and the guard masks it like any sender), unlike the
-    kernel path's subtract_self_delivery discipline."""
+    kernel path's subtract_self_delivery discipline.
+
+    ``exchange="ici"`` (opt-in; "collective" stays the A/B control) swaps
+    the two XLA all_gathers for ONE Pallas ring exchange of the packed
+    sender code (parallel/ici.py: make_async_remote_copy chunks at
+    LOGICAL device ids — only the (p-1)/p remote receiver-block slices
+    ever cross a chip), and defaults the round loop to the cross-round
+    software-pipelined form (hist_scan ho_fn: round r+1's HO block is
+    generated while round r's count matmul runs; ``pipelined=False`` is
+    the straight-line compile-insurance fallback).  All four combinations
+    are bit-identical — pinned by tests/test_ici.py and the multichip-ici
+    soak rung."""
     from functools import partial as _partial
 
     from round_tpu.engine import fast as _fast
+    from round_tpu.ops.exchange import hist_code_counts, hist_pack
+    from round_tpu.parallel import ici as _ici
 
+    exchange, pipelined, interpret = _resolve_exchange(
+        exchange, pipelined, interpret)
     if decided_fn is None:
         decided_fn = lambda s: s.decided  # noqa: E731
     s_shards = mesh.shape[SCENARIO_AXIS]
@@ -261,7 +313,7 @@ def run_hist_proc_sharded(
     spec_mix = P(SCENARIO_AXIS)
 
     @_partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(spec_state, spec_mix),
         out_specs=(spec_state, spec_state, spec_state),
         check_vma=False,
@@ -269,22 +321,31 @@ def run_hist_proc_sharded(
     def run(state0_l, mix_l):
         j0 = jax.lax.axis_index(PROC_AXIS) * n_l
         jg = j0 + jnp.arange(n_l, dtype=jnp.int32)        # global receiver ids
+        ring = _ici.make_ring_gather(PROC_AXIS, p_shards, interpret,
+                                     mesh=mesh)
 
-        def counts_fn(state, k, done, r):
+        def counts_fn(state, k, done, r, ho=None):
             if k in rnd.no_exchange_subrounds:
                 # the subround consumes no counts (TPC's prepare): skip
                 # the gathers and the count einsum entirely
                 return jnp.zeros(
                     (done.shape[0], V, done.shape[1]), jnp.int32)
-            ho = _ho_block(mix_l, r, jg, n)
+            if ho is None:  # straight-line loop: mask generated in-round
+                ho = _ho_block(mix_l, r, jg, n)
 
             payload = rnd.payload(state, k)                # [S_l, n_l]
-            payload_full = jax.lax.all_gather(
-                payload, PROC_AXIS, axis=1, tiled=True)           # [S_l, n]
             # sender eligibility = active ∧ guard, fused into ONE gather
             # (deliver only ever uses the conjunction)
             sending = ~done if send_guard_fn is None \
                 else (~done) & send_guard_fn(state, k)
+            if exchange == "ici":
+                # ONE packed wire tensor over the Pallas ring: silence is
+                # code 0, which matches no histogram row — termwise equal
+                # to the two-gather form, exact int32 sums either way
+                code_full = ring(hist_pack(payload, sending))
+                return hist_code_counts(code_full, ho, V)
+            payload_full = jax.lax.all_gather(
+                payload, PROC_AXIS, axis=1, tiled=True)           # [S_l, n]
             sending_full = jax.lax.all_gather(
                 sending, PROC_AXIS, axis=1, tiled=True)           # [S_l, n]
             deliver = ho & sending_full[:, None, :]        # [S_l, n_l, n]
@@ -296,14 +357,17 @@ def run_hist_proc_sharded(
             )                                              # [S_l, V, n_l]
 
         coin_fn = _fast.hash_coin_fn(mix_l, jg) if rnd.needs_coin else None
+        ho_fn = (lambda r: _ho_block(mix_l, r, jg, n)) if pipelined else None
         return _fast.hist_scan(
             rnd, state0_l, decided_fn, max_rounds, n, counts_fn, coin_fn,
-            lane_ids=jg)
+            lane_ids=jg, ho_fn=ho_fn)
 
     return run(state0, mix)
 
 
-def run_tpc_proc_sharded(state0, mix, mesh: Mesh, max_rounds: int = 3):
+def run_tpc_proc_sharded(state0, mix, mesh: Mesh, max_rounds: int = 3,
+                         exchange: str = "collective", pipelined=None,
+                         interpret=None):
     """TPC on the proc-sharded fast path: the coordinator's guarded sends
     become a send_guard_fn (prepare/commit: only the coordinator's lane
     broadcasts).  Bit-identical to fast.run_tpc_fast on the same mix."""
@@ -322,19 +386,30 @@ def run_tpc_proc_sharded(state0, mix, mesh: Mesh, max_rounds: int = 3):
     return run_hist_proc_sharded(
         rnd, state0, mix, max_rounds, mesh,
         decided_fn=lambda s: s.decided, send_guard_fn=guard,
+        exchange=exchange, pipelined=pipelined, interpret=interpret,
     )
 
 
-def run_lattice_proc_sharded(state0, mix, mesh: Mesh, max_rounds: int):
+def run_lattice_proc_sharded(state0, mix, mesh: Mesh, max_rounds: int,
+                             exchange: str = "collective", pipelined=None,
+                             interpret=None):
     """Lattice agreement on the receiver-sharded fast path: the bit-plane
     exchange gathers the full [n, m] proposal matrix (O(n·m) ICI per
     round) and computes this device's Hamming-equality and OR-count
     blocks locally.  Bit-identical to fast.run_lattice_fast — counts are
-    exact int32 accumulations."""
+    exact int32 accumulations.
+
+    ``exchange="ici"``: the active mask and the m proposal bit-planes ride
+    ONE int8 ring exchange ([S_l, n_l, m+1] packed) instead of two XLA
+    gathers; same pipelined/straight loop policy as
+    run_hist_proc_sharded."""
     from functools import partial as _partial
 
     from round_tpu.engine import fast as _fast
+    from round_tpu.parallel import ici as _ici
 
+    exchange, pipelined, interpret = _resolve_exchange(
+        exchange, pipelined, interpret)
     s_shards = mesh.shape[SCENARIO_AXIS]
     p_shards = mesh.shape[PROC_AXIS]
     S, n = mix.crashed.shape
@@ -347,7 +422,7 @@ def run_lattice_proc_sharded(state0, mix, mesh: Mesh, max_rounds: int):
     spec_mix = P(SCENARIO_AXIS)
 
     @_partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(spec_state, spec_mix),
         out_specs=(spec_state, spec_state, spec_state),
         check_vma=False,
@@ -355,24 +430,38 @@ def run_lattice_proc_sharded(state0, mix, mesh: Mesh, max_rounds: int):
     def run(state0_l, mix_l):
         jg = (jax.lax.axis_index(PROC_AXIS) * n_l
               + jnp.arange(n_l, dtype=jnp.int32))
+        ring = _ici.make_ring_gather(PROC_AXIS, p_shards, interpret,
+                                     mesh=mesh)
 
-        def counts_fn(state, k, done, r):
-            ho = _ho_block(mix_l, r, jg, n)
-            P_full = jax.lax.all_gather(
-                state.proposed, PROC_AXIS, axis=1, tiled=True)  # [S_l, n, m]
-            active_full = jax.lax.all_gather(
-                ~done, PROC_AXIS, axis=1, tiled=True)
+        def counts_fn(state, k, done, r, ho=None):
+            if ho is None:
+                ho = _ho_block(mix_l, r, jg, n)
+            if exchange == "ici":
+                # active | bit-planes packed into one int8 ring tensor
+                planes = jnp.concatenate(
+                    [(~done)[..., None], state.proposed], axis=-1)
+                full = ring(planes.astype(jnp.int8))     # [S_l, n, m+1]
+                active_full = full[..., 0] != 0
+                P_full = full[..., 1:] != 0
+            else:
+                P_full = jax.lax.all_gather(
+                    state.proposed, PROC_AXIS, axis=1, tiled=True)
+                active_full = jax.lax.all_gather(
+                    ~done, PROC_AXIS, axis=1, tiled=True)
             deliver = ho & active_full[:, None, :]
             return _fast.lattice_counts(deliver, state.proposed, P_full)
 
+        ho_fn = (lambda r: _ho_block(mix_l, r, jg, n)) if pipelined else None
         return _fast.hist_scan(
-            rnd, state0_l, lambda s: s.decided, max_rounds, n, counts_fn)
+            rnd, state0_l, lambda s: s.decided, max_rounds, n, counts_fn,
+            ho_fn=ho_fn)
 
     return run(state0, mix)
 
 
 def run_erb_proc_sharded(state0, mix, mesh: Mesh, max_rounds: int,
-                         n_values: int):
+                         n_values: int, exchange: str = "collective",
+                         pipelined=None, interpret=None):
     """ERB on the proc-sharded fast path: the defined-senders flooding
     guard gathers with the payload.  Bit-identical to fast.run_erb_fast
     on the same mix (protocol-generated runs)."""
@@ -383,6 +472,7 @@ def run_erb_proc_sharded(state0, mix, mesh: Mesh, max_rounds: int,
         rnd, state0, mix, max_rounds, mesh,
         decided_fn=lambda s: s.delivered,
         send_guard_fn=lambda s, k: s.x_def,
+        exchange=exchange, pipelined=pipelined, interpret=interpret,
     )
 
 
@@ -418,7 +508,7 @@ def sharded_hist_loop(
     spec1 = P(SCENARIO_AXIS)
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(spec2,) * 3 + (spec1,) * 6,
         out_specs=(tuple([spec2] * n_state), spec2, spec2),
@@ -659,6 +749,24 @@ def _dryrun_cpu(n_devices: int) -> None:
         "bit-parity vs single-device"
     )
 
+    # the PALLAS ICI arm (ISSUE 14): the same shard policy, the two XLA
+    # all_gathers swapped for the interpret-mode ring exchange under the
+    # cross-round pipelined loop — bit-parity against the SAME
+    # single-device reference as the collective path above, so the
+    # artifact evidences both exchange paths on one mix
+    with jax.default_device(devs[0]):
+        got4i = run_hist_proc_sharded(rnd4, st4, mix4, r4, mesh,
+                                      exchange="ici")
+        jax.block_until_ready(got4i)
+    _assert_tree_parity(got4i, ref4,
+                        "pallas-ici exchange diverged from single-device")
+    print(
+        "dryrun_multichip pallas-ici arm ok: interpret-mode ring exchange "
+        "(packed sender codes, pipelined HO carry) over mesh "
+        f"{dict(zip(mesh.axis_names, mesh.devices.shape))}, bit-parity vs "
+        "single-device"
+    )
+
     # the GUARDED-SEND sharded path (send_guard_fn: TPC's coordinator
     # rounds) — the sharded sender guard is new machinery the artifact
     # must evidence
@@ -701,7 +809,7 @@ def _dryrun_cpu(n_devices: int) -> None:
         st6 = _PbftVcState.fresh(x6, S6, n4)
         sp = P(SCENARIO_AXIS)
 
-        @partial(jax.shard_map, mesh=loop_mesh, in_specs=(sp, sp),
+        @partial(shard_map, mesh=loop_mesh, in_specs=(sp, sp),
                  out_specs=(sp, sp, sp), check_vma=False)
         def run_vc(st, mx):
             return _fastmod.run_pbft_vc_fast(st, mx, max_rounds=12)

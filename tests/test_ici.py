@@ -1,0 +1,232 @@
+"""Pallas ICI ring exchange (parallel/ici.py): interpret-mode bit-parity
+with the XLA-collective path on the forced 8-host-device mesh, the
+TPU-platform lowering guard (the exchange really becomes a Mosaic
+custom-call, with NO residual all_gather), receiver-block slicing units,
+and the compiled-HLO collective-bytes gate.
+
+Budget discipline (ISSUE 14): tier-1 keeps the two structurally distinct
+ring payloads (hist's int32 packed codes, lattice's int8 bit-planes), the
+straight-line fallback pin, one lowering guard and the bytes gate —
+~30 s; the remaining families and the proc_shards=4 sweep ride -m slow
+(and every family runs in the multichip-ici soak rung)."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from round_tpu.ops.exchange import hist_code_counts, hist_pack, ho_block
+from round_tpu.ops.fused import ho_link_mask
+from round_tpu.parallel import ici
+from round_tpu.parallel.mesh import has_shard_map, make_mesh, shard_map
+
+
+def _needs_mesh():
+    if not has_shard_map():
+        pytest.skip("this jax build has no shard_map")
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh (conftest XLA_FLAGS)")
+
+
+# ---------------------------------------------------------------------------
+# Receiver-block slicing units (no mesh needed)
+# ---------------------------------------------------------------------------
+
+def test_ho_block_rows_match_dense():
+    """ho_block at arbitrary global receiver rows == those rows of the
+    dense ho_link_mask — the ONE formula claim the sharded paths rest on,
+    incl. batch dims and the p8<=0 keep-all carve-out."""
+    key = jax.random.PRNGKey(7)
+    B, n = 3, 12
+    colmask = jax.random.bernoulli(key, 0.8, (B, n))
+    side = jax.random.randint(jax.random.fold_in(key, 1), (B, n), 0, 2)
+    salt0 = jnp.asarray([11, 22, 33], jnp.uint32)
+    salt1r = jnp.asarray([5, 6, 7], jnp.uint32)
+    p8 = jnp.asarray([64, 0, 200], jnp.int32)
+    dense = ho_link_mask(colmask, side, salt0, salt1r, p8)
+    for jg in ([0, 1, 2], [5, 9, 11], [3], list(range(n))):
+        jg_a = jnp.asarray(jg, jnp.int32)
+        block = ho_block(colmask, side, salt0, salt1r, p8, jg=jg_a)
+        np.testing.assert_array_equal(
+            np.asarray(block), np.asarray(dense)[:, jg, :])
+
+
+def test_ho_block_default_is_dense():
+    """jg=None IS the dense matrix: ho_link_mask is now the jg=None
+    instance, so this pins the dedupe didn't fork the formula."""
+    key = jax.random.PRNGKey(3)
+    n = 9
+    colmask = jax.random.bernoulli(key, 0.7, (n,))
+    side = jnp.zeros((n,), jnp.int32)
+    dense = ho_link_mask(colmask, side, 17, 4, 120)
+    block = ho_block(colmask, side, 17, 4, 120)
+    np.testing.assert_array_equal(np.asarray(block), np.asarray(dense))
+
+
+def test_hist_pack_code_counts_match_unpacked():
+    """The packed-code histogram (ONE wire tensor) is termwise equal to
+    the two-tensor form: silence is code 0, matching no histogram row."""
+    key = jax.random.PRNGKey(5)
+    S, n, m, V = 4, 10, 6, 5
+    payload = jax.random.randint(key, (S, n), 0, V, dtype=jnp.int32)
+    sending = jax.random.bernoulli(jax.random.fold_in(key, 1), 0.6, (S, n))
+    ho = jax.random.bernoulli(jax.random.fold_in(key, 2), 0.5, (S, m, n))
+    code = hist_pack(payload, sending)
+    got = hist_code_counts(code, ho, V)
+    deliver = ho & sending[:, None, :]
+    oh = payload[:, None, :] == jnp.arange(V, dtype=jnp.int32)[None, :, None]
+    want = jnp.einsum("svi,sji->svj", oh.astype(jnp.int32),
+                      deliver.astype(jnp.int32))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_exchange_branch_counts():
+    """_EXCHANGE_BRANCHES must equal each family's gathering subround
+    count from the ROUND CLASSES (phase_len minus no-exchange
+    subrounds): the compiled module holds every switch branch's gathers
+    while one executes per round, so a drifted entry mis-scales the
+    banked bytes-per-round."""
+    from round_tpu.engine import fast
+
+    rounds = {"hist": fast.OtrHist(n_values=4, after_decision=2),
+              "benor": fast.BenOrHist(),
+              "tpc": fast.TpcHist(),
+              "erb": fast.ErbHist(n_values=8),
+              "lattice": fast.LatticeHist(m=10)}
+    assert set(ici._EXCHANGE_BRANCHES) == set(ici.FAMILIES)
+    for family, rnd in rounds.items():
+        want = rnd.phase_len - len(rnd.no_exchange_subrounds)
+        assert ici._EXCHANGE_BRANCHES[family] == want, family
+
+
+def test_ring_bytes_and_hlo_parser():
+    """ring_bytes_per_round arithmetic + the HLO collective-bytes parser
+    on a synthetic dump: start/done pairing (the -done half never
+    double-counts), kind split, and ASYNC TUPLE accounting — a -start
+    op's (operand, result[, context..]) tuple must count the result
+    alone, so async and sync lowerings of one collective read equal."""
+    assert ici.ring_bytes_per_round(8, 4, 4, 4) == 3 * 8 * 4 * 4
+    assert ici.ring_bytes_per_round(8, 4, 1, 4) == 0
+    txt = "\n".join([
+        "  %ag = s32[8,16] all-gather(%x), dimensions={1}",
+        "  %cp = (u8[4,4], u8[4,4]) collective-permute-start(%y)",
+        "  %cpd = u8[4,4] collective-permute-done(%cp)",
+        "  %ags = (s32[8,16], s32[8,64], u32[], u32[]) all-gather-start(%z)",
+        "  %agd = s32[8,64] all-gather-done(%ags)",
+        "  %plain = s32[8,16] add(%ag, %ag)",
+    ])
+    rep = ici.hlo_collective_bytes(txt)
+    assert rep["per_kind"]["collective-permute"] == 4 * 4
+    # sync all-gather result + async all-gather-start RESULT component
+    # (not operand, not context scalars)
+    assert rep["per_kind"]["all-gather"] == 8 * 16 * 4 + 8 * 64 * 4
+    assert rep["total"] == 8 * 16 * 4 + 8 * 64 * 4 + 4 * 4
+
+
+# ---------------------------------------------------------------------------
+# Interpret-mode bit-parity on the virtual mesh
+# ---------------------------------------------------------------------------
+
+def test_ring_exchange_kernel_interpret_single_axis():
+    """The Pallas ring KERNEL itself (_ring_kernel's DMA chain under the
+    interpret discharge — not the multi-axis ppermute emulation the
+    2-axis runner meshes select): on a single-axis mesh the interpret
+    path really executes make_async_remote_copy slot writes, so a
+    slot-indexing or copy-ordering bug in the kernel body fails HERE,
+    not on first silicon.  Output must equal all_gather's tiled column
+    order, for p=4 and the degenerate-ring p=2."""
+    _needs_mesh()
+    S, cols = 4, 6
+    for p in (2, 4):
+        mesh = Mesh(np.asarray(jax.devices()[:p]), ("ring",))
+        x = jnp.arange(S * p * cols, dtype=jnp.int32).reshape(S, p * cols)
+
+        @partial(shard_map, mesh=mesh, in_specs=(P(None, "ring"),),
+                 out_specs=P(None, None))
+        def run(x_l, p=p):
+            return ici.ring_exchange(x_l, axis="ring", p=p, interpret=True)
+
+        np.testing.assert_array_equal(np.asarray(run(x)), np.asarray(x))
+
+
+def test_hist_parity_both_loop_forms():
+    """hist family: ONE collective reference vs the ICI exchange under
+    BOTH round-loop forms — the cross-round pipelined default and the
+    straight-line compile-insurance fallback — raw-bit tree equality
+    (the _assert_tree_parity discipline)."""
+    _needs_mesh()
+    key = jax.random.PRNGKey(3)
+    state0, mix, run = ici._family_runner("hist", 16, 8, 6, key)
+    mesh = make_mesh(len(jax.devices()), proc_shards=2)
+    ref = run(state0, mix, mesh, "collective", False)
+    for pipelined in (True, False):
+        got = run(state0, mix, mesh, "ici", pipelined)
+        for a, b in zip(jax.tree_util.tree_leaves(got),
+                        jax.tree_util.tree_leaves(ref)):
+            a, b = np.asarray(a), np.asarray(b)
+            assert a.shape == b.shape
+            np.testing.assert_array_equal(a.view(np.uint8),
+                                          b.view(np.uint8))
+
+
+def test_lattice_parity():
+    """lattice family tier-1: the OTHER ring payload shape (active mask +
+    m proposal bit-planes packed int8) against its two-gather control."""
+    _needs_mesh()
+    assert ici.family_parity("lattice", n=16, S=8, proc_shards=2, rounds=6)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family", ["benor", "tpc", "erb"])
+def test_family_parity_slow(family):
+    """The remaining MULTICHIP dryrun families (guarded sends, coins):
+    same raw-bit parity; -m slow per the tier-1 budget (each family also
+    runs every multichip-ici soak rotation)."""
+    _needs_mesh()
+    assert ici.family_parity(family, n=16, S=8, proc_shards=2, rounds=6)
+
+
+@pytest.mark.slow
+def test_hist_parity_four_shards():
+    """proc_shards=4: a real multi-hop ring (3 forwards/step) on the
+    scenario×proc mesh."""
+    _needs_mesh()
+    assert ici.family_parity("hist", n=16, S=8, proc_shards=4, rounds=6)
+
+
+# ---------------------------------------------------------------------------
+# TPU lowering guard + collective-bytes gate
+# ---------------------------------------------------------------------------
+
+def test_ici_lowers_to_mosaic_for_tpu():
+    """jax.export(platforms=("tpu",)) of the ICI hist runner from this
+    CPU-only box: the exchange IS a Mosaic custom-call and NO XLA
+    all-gather remains — the collective was replaced, not duplicated
+    (test_flagship_shape.py pattern; skip-not-fail without shard_map)."""
+    _needs_mesh()
+    flags = ici.tpu_lowering_flags()
+    assert flags["nr_devices"] == len(jax.devices())
+    assert flags["tpu_custom_call"], "no Mosaic kernel in the ICI lowering"
+    assert flags["xla_all_gather_ops"] == 0, flags
+
+
+def test_exchange_bytes_drop():
+    """Compiled-HLO cost analysis on the hist family: the ring moves at
+    most the (p-1)/p remote fraction of the full-tensor all_gather's
+    bytes per round (ISSUE 14 acceptance gate)."""
+    _needs_mesh()
+    rep = ici.exchange_bytes_report()
+    assert rep["collective_bytes_per_round"] > 0, rep
+    assert rep["ok"], rep
+    assert rep["ratio"] <= rep["bound"] + 1e-9, rep
+
+
+@pytest.mark.slow
+def test_lattice_lowering_slow():
+    _needs_mesh()
+    flags = ici.tpu_lowering_flags(family="lattice")
+    assert flags["tpu_custom_call"] and flags["xla_all_gather_ops"] == 0

@@ -1107,6 +1107,55 @@ def check_byz_crosscheck(rng, it):
     return cfg
 
 
+def check_multichip_ici(rng, it):
+    """The multichip-ici rotation rung (ISSUE 14): for EVERY proc-sharded
+    dryrun family, raw-bit parity of the Pallas ICI ring exchange against
+    the XLA-collective control on the forced-8-host-device mesh (the
+    interpret kernels — the one-flag-away claim, re-proved per rotation),
+    plus the per-family collective-bytes ratio from compiled-HLO cost
+    analysis banked as a trajectory.  FAILS on a parity break or a bytes
+    ratio past the (p-1)/p bound; the TPU lowering flags ride along as a
+    banked (not gated — tests/test_ici.py gates them) status."""
+    from round_tpu.parallel import ici
+    from round_tpu.parallel.mesh import has_shard_map
+
+    cfg = dict(kind="multichip-ici", it=it)
+    if not has_shard_map() or len(jax.devices()) < 8:
+        return {**cfg, "skipped": "no shard_map / 8-device mesh"}
+    proc_shards = int(rng.choice([2, 4]))
+    rounds = int(rng.integers(4, 8))
+    pipelined = bool(rng.integers(0, 2))
+    cfg.update(proc_shards=proc_shards, rounds=rounds, pipelined=pipelined)
+    families = {}
+    for family in ici.FAMILIES:
+        par = ici.family_parity(family, n=16, S=8, proc_shards=proc_shards,
+                                rounds=rounds, pipelined=pipelined)
+        rep = ici.exchange_bytes_report(
+            n=16, S=8, proc_shards=proc_shards, rounds=rounds,
+            family=family)
+        families[family] = {
+            "parity": par, "bytes_ratio": rep["ratio"],
+            "bytes_bound": rep["bound"], "bytes_ok": rep["ok"],
+            "collective_bytes_per_round": rep[
+                "collective_bytes_per_round"],
+            "ici_bytes_per_round": rep["ici_bytes_per_round"]}
+        if not par:
+            return {**cfg, "families": families,
+                    "fail": f"ici parity break: {family} at "
+                            f"p={proc_shards} pipelined={pipelined}"}
+        if not rep["ok"]:
+            return {**cfg, "families": families,
+                    "fail": f"ici bytes ratio regression: {family} "
+                            f"{rep['ratio']} > bound {rep['bound']}"}
+    cfg["families"] = families
+    try:
+        cfg["lowering"] = ici.tpu_lowering_flags(proc_shards=proc_shards)
+    except Exception as e:  # noqa: BLE001 — banked, not gated: some jax
+        # builds can't cross-lower for tpu; the tier-1 guard owns the gate
+        cfg["lowering"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    return cfg
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=60.0)
@@ -1153,7 +1202,8 @@ def main():
                 check_host_perf, check_host_lanes, check_host_pump,
                 lambda r, i: check_host_perf(r, i, payload=True),
                 check_fuzz, check_verify_param, check_host_overload,
-                check_host_fleet, check_host_rv, check_byz_crosscheck]
+                check_host_fleet, check_host_rv, check_byz_crosscheck,
+                check_multichip_ici]
     while time.monotonic() < t_end:
         check = rotation[it % len(rotation)]
         t0 = time.perf_counter()

@@ -121,6 +121,46 @@ def _persist_window_artifact(step, out):
              "out": "", "err": str(e)})
 
 
+def bank_ici_status():
+    """ISSUE 14 satellite: bank the Pallas ICI lowering/parity status line
+    once per rotation.  `bench.py --pallas-ici` narrates PROBE_STAGE
+    markers exactly like the flagship probe — run() banks the last stage
+    on a hang — and its one metric line carries interpret parity, the
+    TPU-lowering flags, the collective-bytes ratio and the exchange-aware
+    roofline; on a real accelerator it also times ici vs collective.  The
+    compact record keeps the one-flag-away evidence in TPU_WATCH.jsonl
+    next to every probe."""
+    py = sys.executable
+    bench = os.path.join(REPO, "bench.py")
+    ok, out = run("pallas_ici", [py, bench, "--pallas-ici",
+                                 "--probe-timeout", "90",
+                                 "--watchdog", "600"], 600 + 90 + 60)
+    if not ok:
+        return
+    for ln in out.strip().splitlines():
+        if not (ln.startswith("{") and ln.endswith("}")):
+            continue
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue
+        if rec.get("metric") != "pallas_ici_status":
+            continue
+        ex = rec.get("extra") or {}
+        low = ex.get("lowering") or {}
+        byt = ex.get("bytes") or {}
+        log({"step": "pallas-ici-status", "ok": rec.get("value") == 1.0,
+             "backend": ex.get("backend"),
+             "parity": ex.get("parity"),
+             "tpu_custom_call": low.get("tpu_custom_call"),
+             "xla_all_gather_ops": low.get("xla_all_gather_ops"),
+             "bytes_ratio": byt.get("ratio"),
+             "roofline_rps": (ex.get("roofline") or {}).get(
+                 "rounds_per_sec"),
+             **({"timed_ab": ex["timed_ab"]} if "timed_ab" in ex else {}),
+             "error": rec.get("error")})
+
+
 def attempt_window():
     """The tunnel just answered a probe: escalate.  Returns True when the
     full flagship was recorded."""
@@ -185,6 +225,7 @@ def attempt_window():
 
 def main():
     log({"step": "watcher-start", "ok": True, "wall_s": 0.0, "out": ""})
+    rotation = 0
     while True:
         ok, _ = run("probe", [sys.executable, "-c", PROBE_SRC], 90)
         if ok:
@@ -192,6 +233,16 @@ def main():
                 log({"step": "watcher-done", "ok": True, "wall_s": 0.0,
                      "out": "full flagship recorded"})
                 return
+        # the Pallas ICI status banks probe-up-or-not (the parity/
+        # lowering stages run on the CPU backend too; the bench arm
+        # forces the host platform when the probe is down) — but it is
+        # minutes of compiles, and the watcher's job is catching
+        # perishable tunnel windows.  So: AFTER the window attempt, and
+        # only on the first rotation + every 10th (~20 min) — the
+        # CPU-side evidence does not change between rotations.
+        if rotation % 10 == 0:
+            bank_ici_status()
+        rotation += 1
         time.sleep(120)
 
 
