@@ -68,6 +68,14 @@ def serve_main(args) -> int:
 
         rv = RvConfig(policy=args.rv, protocol=args.algo,
                       dump_dir=args.rv_dir or "rv_dumps")
+    snap = None
+    if args.snap:
+        from round_tpu.snap import SnapConfig
+
+        snap = SnapConfig(
+            policy=args.snap, protocol=args.algo,
+            dump_dir=args.snap_dir or "snap_dumps",
+            every_k=args.snap_every, bank_dir=args.snap_bank)
     # fixed ports: the bench parent announced them to the router
     srv = DriverServer(
         algo, n=len(ports), lanes=args.lanes,
@@ -77,18 +85,21 @@ def serve_main(args) -> int:
         use_pump=not args.no_pump,
         admission_bytes_per_lane=args.admission_bytes_per_lane,
         shed_deadline_ms=args.shed_deadline_ms,
-        adaptive_cap_ms=args.adaptive_cap_ms, ports=ports, rv=rv)
+        adaptive_cap_ms=args.adaptive_cap_ms, ports=ports, rv=rv,
+        snap=snap)
     srv.start()
     rc = 0
     try:
         try:
             srv.join(timeout_s=args.max_ms / 1000.0 + 30.0)
         except RuntimeError:
-            # an rv-halted replica surfaces through rv_summary below;
-            # anything else keeps the loud failure
-            if not (rv is not None and srv.errors and all(
-                    type(e).__name__ == "RvViolation"
-                    for e in srv.errors.values())):
+            # an rv- or snap-halted replica surfaces through its
+            # summary below; anything else keeps the loud failure
+            if not ((rv is not None or snap is not None)
+                    and srv.errors and all(
+                        type(e).__name__ in ("RvViolation",
+                                             "SnapViolation")
+                        for e in srv.errors.values())):
                 raise
             rc = 3
     finally:
@@ -109,6 +120,8 @@ def serve_main(args) -> int:
         }
         if rv is not None:
             summary["rv"] = srv.rv_summary()
+        if snap is not None:
+            summary["snap"] = srv.snap_summary()
         print(json.dumps(summary))
     return rc
 
@@ -340,6 +353,19 @@ def main(argv=None) -> int:
                          "with clients failed fast via FLAG_TOO_LATE")
     sv.add_argument("--rv-dir", type=str, default=None, metavar="DIR",
                     help="violation dump directory (default rv_dumps/)")
+    sv.add_argument("--snap", nargs="?", const="log", default=None,
+                    choices=["halt", "shed", "log"], metavar="POLICY",
+                    help="round-consistent snapshots for this shard "
+                         "(round_tpu/snap, docs/SNAPSHOTS.md): replica "
+                         "0 collects cuts and audits the full-state "
+                         "invariants; POLICY = halt | shed | log")
+    sv.add_argument("--snap-every", type=int, default=4, metavar="K")
+    sv.add_argument("--snap-dir", type=str, default=None, metavar="DIR",
+                    help="snap violation dump directory (default "
+                         "snap_dumps/)")
+    sv.add_argument("--snap-bank", type=str, default=None, metavar="DIR",
+                    help="bank assembled cuts as .snapcut files "
+                         "(apps/snap_cli.py audits them offline)")
 
     bn = sub.add_parser("bench", help="spawn a fleet + open-loop loadgen")
     bn.add_argument("--drivers", type=int, default=4)

@@ -41,7 +41,7 @@ from round_tpu.runtime.transport import HostTransport  # noqa: E402
 def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
              errors=None, proto="tcp", stats=None, algo=None, rate=1,
              adaptive_cap_ms=0, wire="binary", lanes=0, pump=True,
-             rv=None):
+             rv=None, snap=None):
     tr = HostTransport(my_id, peers[my_id][1], proto=proto)
     # ONE algorithm object across instances: the jitted round functions
     # cache on its rounds, so instance 2+ skip compilation entirely.
@@ -69,6 +69,7 @@ def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
                 algo, my_id, peers, tr, instances, lanes=lanes,
                 timeout_ms=timeout_ms, seed=seed, stats_out=node_stats,
                 adaptive=adaptive, wire=wire, use_pump=pump, rv=rv,
+                snap=snap,
             )
         elif rate > 1:
             # the in-flight window (PerfTest2 -rt): `rate` concurrent
@@ -82,7 +83,7 @@ def run_node(my_id, peers, algo_name, instances, timeout_ms, results, seed,
             results[my_id] = run_instance_loop(
                 algo, my_id, peers, tr, instances, timeout_ms=timeout_ms,
                 seed=seed, stats_out=node_stats, adaptive=adaptive,
-                wire=wire, pump=pump, rv=rv,
+                wire=wire, pump=pump, rv=rv, snap=snap,
             )
         if stats is not None:
             stats[my_id] = node_stats
@@ -140,7 +141,7 @@ def _algo_opts(payload_bytes):
 def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
             proto="tcp", rate=1, adaptive_cap_ms=0, wire="binary",
             lanes=0, payload_bytes=0, pump=True, rv=None,
-            algo_obj=None):
+            algo_obj=None, snap=None):
     """Run `instances` consecutive consensus instances over `n` replicas
     (threads, each with its own transport+sockets — on a single-vCPU box
     the GIL interleaving beats process-per-replica; see measure_processes
@@ -171,7 +172,7 @@ def measure(n=4, instances=20, algo="otr", timeout_ms=300, seed=0,
             target=run_node,
             args=(i, peers, algo, instances, timeout_ms, results, seed,
                   errors, proto, stats, shared_algo, rate, adaptive_cap_ms,
-                  wire, lanes, pump, rv),
+                  wire, lanes, pump, rv, snap),
         )
         for i in range(n)
     ]
@@ -565,6 +566,85 @@ def measure_rv_ab(n=4, instances=64, algo="otr", timeout_ms=300,
             # byte-identity of the LAST pair's decision logs (same
             # seeds both arms — the fused monitor must be a pure
             # observer)
+            "logs_identical": logs["off"] == logs["on"],
+        },
+    }
+
+
+def measure_snap_ab(n=4, instances=64, algo="lvb", timeout_ms=300,
+                    proto="tcp", lanes=16, pairs=3, warmup=1, seed=0,
+                    payload_bytes=1024, every_k=2):
+    """The snapshot-audit overhead A/B (round_tpu/snap acceptance): arm
+    A is the lane driver with snapshots OFF, arm B the SAME driver with
+    sampling + cut assembly + the batched audit live (policy 'log', no
+    dumps, collector = replica 0).  Interleaved pairs; the gate is
+    overhead <= 5% dps AND byte-identical decision logs AND zero
+    violations + zero divergences on the clean run, AND the digest
+    check actually ENGAGED (cuts_audited > 0) — the ``host-snap`` soak
+    rung banks this per rotation.
+
+    The gate workload is lvb@1KiB, the capacity-bound serving regime:
+    its spec=None means the audit arm exercises the FULL sampling /
+    cut-assembly / digest-divergence path while the formula dispatch is
+    empty — exactly the cost every protocol pays (protocols carrying
+    offline formulas add one vmapped dispatch per cut batch, measured
+    separately in tests/test_snap.py's perf arm)."""
+    from round_tpu.apps.perf_ab import interleaved_ab
+    from round_tpu.snap import SnapConfig
+
+    logs = {"off": None, "on": None}
+    counts = {"violations": 0, "divergences": 0, "cuts_audited": 0,
+              "samples": 0}
+    shared = select(algo, _algo_opts(payload_bytes))
+
+    def arm(snap_on):
+        def run():
+            snap = (SnapConfig(policy="log", every_k=every_k)
+                    if snap_on else None)
+            res, res_logs = measure(
+                n=n, instances=instances, algo=algo,
+                timeout_ms=timeout_ms, proto=proto, lanes=lanes,
+                payload_bytes=payload_bytes, seed=seed, snap=snap,
+                algo_obj=shared)
+            logs["on" if snap_on else "off"] = res_logs
+            if snap_on:
+                for st in res["extra"]["node_stats"].values():
+                    counts["violations"] += len(
+                        st.get("snap_violations", []))
+                    counts["divergences"] += len(
+                        st.get("snap_divergences", []))
+                    counts["cuts_audited"] += st.get(
+                        "snap_cuts_audited", 0)
+                    counts["samples"] += st.get("snap_samples", 0)
+            return res["value"]
+        return run
+
+    ab = interleaved_ab(arm(False), arm(True), pairs=pairs,
+                        warmup=warmup)
+    return {
+        "metric": f"host_{algo}_n{n}_snap_overhead",
+        "value": ab["ratio"],
+        "unit": "x (snap-on/snap-off decisions-per-sec)",
+        "extra": {
+            "dps_off": ab["mean_a"],
+            "dps_on": ab["mean_b"],
+            "median_off": ab["median_a"],
+            "median_on": ab["median_b"],
+            "samples_off": ab["a"],
+            "samples_on": ab["b"],
+            "pairs": pairs,
+            "warmup": warmup,
+            "instances": instances,
+            "lanes": lanes,
+            "n": n,
+            "every_k": every_k,
+            "payload_bytes": payload_bytes,
+            "snap_samples": counts["samples"],
+            "snap_cuts_audited": counts["cuts_audited"],
+            "snap_violations": counts["violations"],
+            "snap_divergences": counts["divergences"],
+            # byte-identity of the LAST pair's decision logs (same
+            # seeds both arms — sampling must be a pure observer)
             "logs_identical": logs["off"] == logs["on"],
         },
     }

@@ -29,6 +29,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def _is_snap_halt(e) -> bool:
+    """SnapViolation subclasses RvViolation (one halt surface), but the
+    summary must file it under the right block."""
+    return type(e).__name__ == "SnapViolation"
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--id", type=int, required=True)
@@ -277,6 +283,41 @@ def main(argv=None) -> int:
                          "decision fan-out — by default the agreement "
                          "monitor taps only the decision-reply/catch-up "
                          "traffic that already flows)")
+    ap.add_argument("--snap", nargs="?", const="log", default=None,
+                    choices=["halt", "shed", "log"], metavar="POLICY",
+                    help="round-consistent snapshots (round_tpu/snap, "
+                         "docs/SNAPSHOTS.md): sample round-boundary "
+                         "state, assemble cuts at the collector replica "
+                         "and audit the FULL-STATE invariants the live "
+                         "rv monitors cannot see.  POLICY on a cut "
+                         "violation: halt (exit 3, artifact path in the "
+                         "summary) | shed (violating instance retired "
+                         "undecided) | log (default)")
+    ap.add_argument("--snap-every", type=int, default=4, metavar="K",
+                    help="sample every Kth round per instance "
+                         "(deterministically jittered; default 4)")
+    ap.add_argument("--snap-collector", type=int, default=0,
+                    metavar="PID",
+                    help="replica that assembles and audits cuts "
+                         "(default 0)")
+    ap.add_argument("--snap-dir", type=str, default=None, metavar="DIR",
+                    help="violation dump directory (default: "
+                         "snap_dumps/); artifacts are fuzz/replay.py "
+                         "schedule JSON with meta.rv naming the "
+                         "formula, replayable via fuzz_cli replay")
+    ap.add_argument("--snap-bank", type=str, default=None, metavar="DIR",
+                    help="bank every assembled cut as a .snapcut file "
+                         "for offline audit (apps/snap_cli.py)")
+    ap.add_argument("--snap-budget", type=int, default=256 << 10,
+                    metavar="BYTES",
+                    help="sample-traffic byte budget per second (token "
+                         "bucket; 0 = unbudgeted; default 256 KiB/s — "
+                         "audit traffic never starves serving)")
+    ap.add_argument("--snap-deadline-ms", type=int, default=3000,
+                    metavar="MS",
+                    help="how long a part-cut waits for missing "
+                         "contributors before the fault-envelope "
+                         "tolerance resolves it (default 3000)")
     ap.add_argument("--view-license", action="store_true",
                     help="proof-licensed reconfiguration (rv/license.py "
                          "+ docs/MEMBERSHIP.md): membership ops are "
@@ -572,6 +613,31 @@ def main(argv=None) -> int:
                     dump_dir=args.rv_dir or "rv_dumps",
                     schedule_path=args.chaos_schedule,
                     gossip=args.rv_gossip)
+        snap_cfg = None
+        if args.snap:
+            if args.instances <= 1 or (args.lanes <= 1 and args.rate > 1):
+                # the snapshot driver rides the loop drivers (the rv
+                # gate's own guard pattern); a single-instance run has
+                # no derivable proposal row and no loop to flush from
+                print("warning: --snap applies to the sequential and "
+                      "lane --instances loops only (ignored here)",
+                      file=sys.stderr)
+            elif not 0 <= args.snap_collector < len(peers):
+                ap.error(f"--snap-collector {args.snap_collector} is "
+                         f"not a replica id of this n={len(peers)} "
+                         "cluster")
+            else:
+                from round_tpu.snap import SnapConfig
+
+                snap_cfg = SnapConfig(
+                    policy=args.snap, protocol=args.algo,
+                    dump_dir=args.snap_dir or "snap_dumps",
+                    schedule_path=args.chaos_schedule,
+                    every_k=args.snap_every,
+                    collector=args.snap_collector,
+                    budget_bytes_per_s=args.snap_budget,
+                    cut_deadline_ms=args.snap_deadline_ms,
+                    bank_dir=args.snap_bank)
         if args.instances <= 1:
             inst_rv = None
             rv_runtime = None
@@ -726,7 +792,7 @@ def main(argv=None) -> int:
                     adaptive=adaptive, stats_out=stats,
                     checkpoint_dir=args.checkpoint_dir, wire=args.wire,
                     use_pump=args.pump, admission=admission,
-                    health=health, rv=rv_cfg,
+                    health=health, rv=rv_cfg, snap=snap_cfg,
                 )
             except Exception as e:
                 from round_tpu.rv.dump import RvViolation
@@ -767,7 +833,7 @@ def main(argv=None) -> int:
                     checkpoint_dir=args.checkpoint_dir,
                     view=manager, view_schedule=view_schedule,
                     wire=args.wire, pump=args.pump, health=health,
-                    rv=rv_cfg,
+                    rv=rv_cfg, snap=snap_cfg,
                 )
             except Exception as e:
                 from round_tpu.rv.dump import RvViolation
@@ -816,11 +882,31 @@ def main(argv=None) -> int:
                 "violations": stats.get("rv_violations", []),
                 "artifacts": stats.get("rv_artifacts", []),
             }
-            if halt is not None:
+            if halt is not None and not _is_snap_halt(halt):
                 summary["rv"]["halted"] = str(halt)
                 if halt.artifact:
                     summary["rv"]["artifacts"] = list(set(
                         summary["rv"]["artifacts"] + [halt.artifact]))
+        if snap_cfg is not None:
+            summary["snap"] = {
+                "policy": snap_cfg.policy,
+                "collector": snap_cfg.collector,
+                "samples": stats.get("snap_samples", 0),
+                "sample_bytes": stats.get("snap_sample_bytes", 0),
+                "skipped": stats.get("snap_skipped", 0),
+                "cuts": stats.get("snap_cuts", 0),
+                "partial_cuts": stats.get("snap_partial_cuts", 0),
+                "cuts_audited": stats.get("snap_cuts_audited", 0),
+                "checks": stats.get("snap_checks", 0),
+                "violations": stats.get("snap_violations", []),
+                "divergences": stats.get("snap_divergences", []),
+                "artifacts": stats.get("snap_artifacts", []),
+            }
+            if halt is not None and _is_snap_halt(halt):
+                summary["snap"]["halted"] = str(halt)
+                if halt.artifact:
+                    summary["snap"]["artifacts"] = list(set(
+                        summary["snap"]["artifacts"] + [halt.artifact]))
         if manager is not None:
             # the view trajectory: final epoch/n/id, the applied op
             # history, and a clean `removed` marker — the harness's

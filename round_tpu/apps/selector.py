@@ -80,6 +80,15 @@ def select(name: str, options: Optional[Dict[str, Any]] = None) -> Algorithm:
 
         if name in FIXTURES:
             return select_fixture(name)
+    if name.startswith("snap-broken-"):
+        # snapshot-audit TEST FIXTURES (round_tpu/snap/fixtures.py):
+        # full-state invariant breaches invisible to every per-lane
+        # monitor — the cut auditor's injected-violation workout, dump
+        # artifacts replayable like any other protocol
+        from round_tpu.snap.fixtures import FIXTURES, select_fixture
+
+        if name in FIXTURES:
+            return select_fixture(name)
     raise ValueError(
         f"unknown algorithm {name!r} "
         "(expected otr|lv|lvb|lve|slv|mlv|benor|floodmin|kset|tpc|"

@@ -553,6 +553,27 @@ def lane_step(rnd, n: int, lanes: int, sid, seeds, state,
         return step
 
 
+def lane_sample_rows(leaves, lane: int):
+    """One lane's state rows off the COMPLETED update mega-step — the
+    snapshot subsystem's sample-extraction contract (round_tpu/snap,
+    docs/SNAPSHOTS.md): the mega-step already materializes the full
+    post-update ``[L, ...]`` state back to host numpy (the driver's
+    copy-back is what admission/oob paths mutate in place), so sampling
+    a lane is a host-side row copy of arrays ALREADY transferred — zero
+    additional device dispatches, the same no-second-dispatch discipline
+    as the fused rv monitor term (tests/test_snap.py pins the
+    ``lanes.dispatches`` count snap-on vs snap-off).
+
+    Rows are OWNING copies with shapes preserved exactly (``np.array``,
+    not ``ascontiguousarray`` — the latter promotes 0-d rows to [1] and
+    would desync the lane sample's wire shape from the HostRunner's):
+    the sample outlives the lane (the emitter encodes it after the
+    driver has moved on, and the collector holds it until the cut
+    assembles), while the driver's leaves are reused in place every
+    wave."""
+    return [np.array(leaf[lane]) for leaf in leaves]
+
+
 def lane_decide(algo: Algorithm, lanes: int, state):
     """Cached jitted ``state[L, ...] -> (decided[L], decision[L, ...])``
     for the lane driver's retire path (one dispatch per update wave that

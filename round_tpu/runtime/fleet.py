@@ -601,7 +601,7 @@ class DriverServer:
                  shed_deadline_ms: int = 250,
                  adaptive_cap_ms: int = 0,
                  ports: Optional[List[int]] = None,
-                 rv=None):
+                 rv=None, snap=None):
         from round_tpu.runtime.chaos import alloc_ports
         from round_tpu.runtime.transport import HostTransport
 
@@ -621,6 +621,12 @@ class DriverServer:
         # shard's LaneDrivers serve under; a 'halt' violation surfaces
         # through errors/join() and the router's too_late drain
         self.rv = rv
+        # round-consistent snapshots (round_tpu/snap): the SnapConfig
+        # every replica of this shard serves under — ONE shared config,
+        # so cfg.collector names the replica (pid) that assembles and
+        # audits the shard's cuts (the in-shard collector deployment;
+        # banked .snapcut files feed apps/snap_cli.py offline)
+        self.snap = snap
         if ports is None:
             ports = alloc_ports(n)
         elif len(ports) != n:
@@ -661,7 +667,7 @@ class DriverServer:
                 seed=self.seed, max_rounds=self.max_rounds,
                 value_schedule="uniform", use_pump=self.use_pump,
                 admission=admission, adaptive=adaptive,
-                clients={self.n}, rv=self.rv,
+                clients={self.n}, rv=self.rv, snap=self.snap,
             )
             self.results[i] = driver.serve(
                 idle_ms=self.idle_ms, max_ms=self.max_ms,
@@ -684,6 +690,30 @@ class DriverServer:
             "halted": sorted(
                 i for i, e in self.errors.items()
                 if type(e).__name__ == "RvViolation"),
+        }
+
+    def snap_summary(self) -> Dict[str, Any]:
+        """Aggregate snapshot status across this shard's replicas (the
+        apps/fleet.py serve/bench output surface; non-collector
+        replicas contribute sample counts only)."""
+        return {
+            "enabled": self.snap is not None,
+            "samples": sum(st.get("snap_samples", 0)
+                           for st in self.stats),
+            "cuts": sum(st.get("snap_cuts", 0) for st in self.stats),
+            "cuts_audited": sum(st.get("snap_cuts_audited", 0)
+                                for st in self.stats),
+            "partial_cuts": sum(st.get("snap_partial_cuts", 0)
+                                for st in self.stats),
+            "violations": [v for st in self.stats
+                           for v in st.get("snap_violations", [])],
+            "divergences": [d for st in self.stats
+                            for d in st.get("snap_divergences", [])],
+            "artifacts": sorted({a for st in self.stats
+                                 for a in st.get("snap_artifacts", [])}),
+            "halted": sorted(
+                i for i, e in self.errors.items()
+                if type(e).__name__ == "SnapViolation"),
         }
 
     def start(self) -> List[Tuple[str, int]]:

@@ -75,7 +75,8 @@ from round_tpu.ops.mailbox import Mailbox
 from round_tpu.runtime import codec
 from round_tpu.runtime.log import get_logger
 from round_tpu.runtime.oob import (
-    FLAG_DECISION, FLAG_NACK, FLAG_NORMAL, FLAG_VIEW, Message, Tag,
+    FLAG_DECISION, FLAG_NACK, FLAG_NORMAL, FLAG_SNAP, FLAG_VIEW, Message,
+    Tag,
 )
 from round_tpu.runtime.transport import HostTransport, RoundPump
 
@@ -689,6 +690,7 @@ def run_instance_loop(
     pump: bool = True,
     health=None,
     rv=None,
+    snap=None,
 ) -> List[Optional[int]]:
     """The PerfTest2 loop (PerfTest2.scala:19-110): `instances` consecutive
     consensus instances over one transport, with start-skew stashing —
@@ -813,6 +815,18 @@ def run_instance_loop(
             rv_state = (RvRuntime(rv, node=my_id, n=len(peers),
                                   seed=seed, max_rounds=max_rounds),
                         program, rv)
+    # snapshot setup (round_tpu/snap): ONE SnapDriver for the whole loop
+    # — the emitter, the collector's part-cut state and the audit jit
+    # cache all outlive any single instance (the pump-state discipline)
+    snap_state = None
+    if snap is not None:
+        from round_tpu.snap.driver import SnapDriver
+
+        snap_state = SnapDriver(
+            snap, algo, node=my_id, n=len(peers), seed=seed,
+            max_rounds=max_rounds, transport=transport,
+            value_schedule=value_schedule, base_value=base_value,
+            view=view)
     try:
         return _run_instance_loop_body(
             algo, my_id, peers, transport, instances, timeout_ms, seed,
@@ -820,13 +834,15 @@ def run_instance_loop(
             delay_first_send_ms, nbr_byzantine, value_schedule, adaptive,
             checkpoint_dir, view, view_schedule, wire, pump_state,
             decisions, raw_decisions, replied, enc_cache, stash, current,
-            foreign, start, health, rv_state)
+            foreign, start, health, rv_state, snap_state)
     finally:
         if rv_state is not None:
             # stats survive an rv-halt (the lane driver's discipline):
             # the exit-3 summary must carry the violation record, not
             # just the artifact path on the exception
             rv_state[0].fill_stats(stats_out)
+        if snap_state is not None:
+            snap_state.fill_stats(stats_out)
         if pump_state is not None:
             pump_state.close()
 
@@ -837,7 +853,7 @@ def _run_instance_loop_body(
     delay_first_send_ms, nbr_byzantine, value_schedule, adaptive,
     checkpoint_dir, view, view_schedule, wire, pump_state,
     decisions, raw_decisions, replied, enc_cache, stash, current,
-    foreign, start, health=None, rv_state=None,
+    foreign, start, health=None, rv_state=None, snap_state=None,
 ) -> List[Optional[int]]:
     # ordered view-change schedule: entry i moves the group from epoch i
     # to i+1, so a replica only PROPOSES an entry its own epoch has not
@@ -885,6 +901,7 @@ def _run_instance_loop_body(
                 pump_state=pump_state,
                 health=health,
                 rv=inst_rv,
+                snap=snap_state,
             )
             value = _schedule_value(value_schedule, base_value, vid, inst)
             res = runner.run(instance_io(algo, value),
@@ -941,6 +958,10 @@ def _run_instance_loop_body(
             )
             view.stale = False  # any mid-change staleness was resolved
             # by propose/adopt; the next data instance starts fresh
+    if snap_state is not None:
+        # end of the schedule: resolve pending part-cuts and audit the
+        # tail (a final-cut halt raises from here, the lanes discipline)
+        snap_state.flush(force=True)
     # rv stats are banked by run_instance_loop's finally (they must
     # survive an rv-halt raising out of this body)
     return decisions
@@ -1373,6 +1394,7 @@ class HostRunner:
         pump_state: Optional["_RunnerPumpState"] = None,
         health=None,
         rv=None,
+        snap=None,
     ):
         self.algo = algo
         self.id = my_id
@@ -1459,6 +1481,13 @@ class HostRunner:
         # change).
         self._rv = rv
         self._rv_replied: Dict[Tuple[int, int], float] = {}
+        # round-consistent snapshot hook (round_tpu/snap SnapDriver,
+        # shared across the loop's consecutive runners like the pump
+        # state): post-update round-boundary samples, FLAG_SNAP frame
+        # routing, and — on the collector replica — the periodic cut
+        # audit flush.  None = snapshots off (zero behavior change).
+        self._snap = snap
+        self._snap_shed = False
         self.malformed = 0
         self.timeouts = 0   # rounds ended by deadline expiry (diagnostics)
         self._trajectory: List[int] = []   # per-round deadline used (ms)
@@ -1741,6 +1770,11 @@ class HostRunner:
                         if TRACE.enabled:
                             TRACE.emit("nack_seen", node=self.id,
                                        inst=tg.instance, src=sender)
+                    elif tg.flag == FLAG_SNAP and self._snap is not None:
+                        # snapshot sample routed off the pump's misc
+                        # path (round_tpu/snap) — the Python ingest
+                        # site's twin
+                        self._snap.on_frame(sender, tg, raw)
                     elif tg.flag == FLAG_NORMAL and self.foreign is not None:
                         ok, p = self._loads(raw)
                         if ok:
@@ -2097,6 +2131,12 @@ class HostRunner:
                             if TRACE.enabled:
                                 TRACE.emit("nack_seen", node=self.id,
                                            inst=tag.instance, src=sender)
+                        elif tag.flag == FLAG_SNAP \
+                                and self._snap is not None:
+                            # snapshot sample (round_tpu/snap): the
+                            # collector joins it into a cut — never
+                            # round traffic, any instance's coordinate
+                            self._snap.on_frame(sender, tag, raw)
                         elif tag.flag == FLAG_NORMAL and self.foreign is not None:
                             ok, p = self._loads(raw)
                             if ok:
@@ -2292,6 +2332,29 @@ class HostRunner:
                             _try_send_decision(
                                 self.transport, self._rv_replied, d,
                                 self.instance_id, self._rv.mon.prev_val)
+            if self._snap is not None and not view_int() \
+                    and not oob_decided \
+                    and self._snap.due(self.instance_id, r):
+                # round boundary: sample the post-update state (the
+                # deterministic policy decides — snap/sample.py; an
+                # oob-adopted exit skipped the update, so its round has
+                # no boundary state to sample and the cut tolerates the
+                # gap like any missing contributor).  due() first: the
+                # leaf flatten/asarray extraction stays off the
+                # (every_k-1)/every_k of rounds that would discard it.
+                self._snap.after_round(
+                    self.instance_id, r,
+                    [np.asarray(x)
+                     for x in jax.tree_util.tree_leaves(state)])
+            if self._snap is not None:
+                # collector housekeeping (no-op elsewhere): audit
+                # assembled cuts; halt raises out of the runner here,
+                # shed of the CURRENT instance forces it undecided below
+                for iid in self._snap.flush():
+                    # cut coordinates are 16-bit (the Tag's instance
+                    # field), so the RUNNER'S id masks for the compare
+                    if iid == self.instance_id & 0xFFFF:
+                        self._snap_shed = True
             if self._health is not None:
                 # one completed round wave of quarantine evidence: heard
                 # peers decay/rejoin, unheard peers accrue timeout score
@@ -2325,6 +2388,11 @@ class HostRunner:
         if self._rv is not None and self._rv.shed:
             # rv 'shed' policy: a violating instance is reported
             # undecided — its decision must not enter the log
+            decided = False
+        if self._snap_shed:
+            # snapshot 'shed' policy (the collector replica's verdict):
+            # an instance whose cut violated a full-state invariant is
+            # reported undecided — same discipline as the rv shed
             decided = False
         decision = np.asarray(algo.decision(state))
         if decided:

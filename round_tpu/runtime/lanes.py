@@ -51,7 +51,9 @@ import numpy as np
 
 from round_tpu.core.algorithm import Algorithm
 from round_tpu.core.rounds import Round, RoundCtx
-from round_tpu.engine.executor import lane_decide, lane_step
+from round_tpu.engine.executor import (
+    lane_decide, lane_sample_rows, lane_step,
+)
 from round_tpu.obs.metrics import METRICS
 from round_tpu.obs.trace import TRACE
 from round_tpu.runtime import codec
@@ -62,8 +64,9 @@ from round_tpu.runtime.host import (
 from round_tpu.runtime.instances import AdmissionControl, LaneTable
 from round_tpu.runtime.log import get_logger
 from round_tpu.runtime.oob import (
-    FLAG_DECISION, FLAG_NACK, FLAG_NORMAL, FLAG_PROPOSE, FLAG_SUBSCRIBE,
-    FLAG_TOO_LATE, FLEET_MAX_INSTANCE, FLEET_MIN_INSTANCE, Tag,
+    FLAG_DECISION, FLAG_NACK, FLAG_NORMAL, FLAG_PROPOSE, FLAG_SNAP,
+    FLAG_SUBSCRIBE, FLAG_TOO_LATE, FLEET_MAX_INSTANCE, FLEET_MIN_INSTANCE,
+    Tag,
 )
 from round_tpu.runtime.transport import RoundPump
 
@@ -234,6 +237,7 @@ class LaneDriver:
         health=None,
         clients=None,
         rv=None,
+        snap=None,
     ):
         if wire not in ("binary", "pickle"):
             raise ValueError(f"wire must be 'binary' or 'pickle', "
@@ -417,6 +421,22 @@ class LaneDriver:
                 self._rv_client_inst: set = set()
                 self._rv_shed_lanes: set = set()
                 self._rv_init_cache: Dict[int, np.ndarray] = {}
+        # ROUND-CONSISTENT SNAPSHOTS (round_tpu/snap, docs/SNAPSHOTS.md):
+        # ``snap`` is a snap.audit.SnapConfig — sample this replica's
+        # per-lane state at round boundaries (deterministic policy, byte-
+        # budgeted through the SAME admission control so audit traffic
+        # can never starve serving) and, on the collector replica,
+        # assemble cuts + run the batched full-state audit.  None =
+        # snapshots off, byte-identical pre-snap behavior.
+        self._snap = None
+        if snap is not None:
+            from round_tpu.snap.driver import SnapDriver
+
+            self._snap = SnapDriver(
+                snap, algo, node=my_id, n=n, seed=seed,
+                max_rounds=max_rounds, transport=transport,
+                value_schedule=value_schedule, base_value=base_value,
+                admission=admission)
 
     # -- native pump setup -------------------------------------------------
 
@@ -596,6 +616,13 @@ class LaneDriver:
         self._pending[lane] = {}
         if self._rv is not None:
             self._rv_reset_lane(lane, inst, client_io)
+        if self._snap is not None and client_io is not None:
+            # the fleet's uniform-proposal contract: the client scalar
+            # IS every pid's proposal row (artifact values + the
+            # auditor's init reconstruction seed)
+            self._snap.note_client_value(
+                inst, decision_scalar(
+                    np.asarray(client_io["initial_value"])))
         _C_ADMIT.inc()
         _G_OCC.set(self.table.occupancy)
         if TRACE.enabled:
@@ -767,6 +794,13 @@ class LaneDriver:
             if TRACE.enabled:
                 TRACE.emit("nack_seen", node=self.id, inst=tag.instance,
                            src=sender)
+            return
+        if tag.flag == FLAG_SNAP:
+            # snapshot sample (round_tpu/snap): collector-side cut
+            # assembly — never round traffic, never a lane mailbox.  A
+            # non-collector receiving one drops it as wire noise.
+            if self._snap is not None:
+                self._snap.on_frame(sender, tag, raw)
             return
         iid = tag.instance
         lane = self.table.lane_of(iid)
@@ -1471,6 +1505,10 @@ class LaneDriver:
             self._pump.close_lane(lane)
             self._goahead_armed.discard(lane)
         self.table.retire(iid)
+        if self._snap is not None:
+            # the proposal-row note dies with the instance (emission
+            # only happens for live lanes, always before retire)
+            self._snap.forget_value(iid)
         self._live[lane] = False
         self._waiting[lane] = False
         self._need_send[lane] = False
@@ -1521,6 +1559,24 @@ class LaneDriver:
         _G_QUEUED.set(queued)
         _G_SHEDDING.set(1 if shedding else 0)
         return shedding
+
+    def _snap_flush(self, force: bool = False) -> List[int]:
+        """Snapshot housekeeping (round_tpu/snap): poll cut deadlines,
+        run the batched audit dispatch, and translate the policy's shed
+        verdicts into LIVE lanes (counted like every other shed; an
+        instance that already completed has nothing left to retire).
+        A halt-policy violation raises SnapViolation out of the flush
+        itself — the caller's RvViolation discipline covers it."""
+        if self._snap is None:
+            return []
+        lanes = []
+        for iid in self._snap.flush(force=force):
+            lane = self.table.lane_of(iid & 0xFFFF)
+            if lane is not None and self._live[lane]:
+                self.shed_instances += 1
+                _C_SHED_INSTANCES.inc()
+                lanes.append(lane)
+        return lanes
 
     def _tick(self, deferring: bool) -> List[Tuple[int, bool, Any]]:
         """ONE serving tick, shared by the scheduled loop (run) and the
@@ -1623,6 +1679,17 @@ class LaneDriver:
                     timedout=timedout, exited=exited,
                     wall_ms=round(
                         (_time.monotonic() - self._t0[lane]) * 1e3, 3))
+            if self._snap is not None \
+                    and self._snap.due(int(self._inst[lane]), r):
+                # round boundary: sample the post-update state row off
+                # the mega-step's copied-back leaves — zero extra
+                # dispatches (engine/executor.py lane_sample_rows; the
+                # deterministic policy decides, snap/sample.py).  The
+                # due() pre-check keeps the per-lane row copies off the
+                # (every_k-1)/every_k of rounds that would discard them.
+                self._snap.after_round(
+                    int(self._inst[lane]), r,
+                    lane_sample_rows(self._state, lane))
             if exited or r + 1 >= self.max_rounds or (
                     self._rv is not None
                     and lane in self._rv_shed_lanes):
@@ -1688,6 +1755,8 @@ class LaneDriver:
             stats_out["quarantine"] = self._health.summary()
         if self._rv is not None:
             self._rv.fill_stats(stats_out)
+        if self._snap is not None:
+            self._snap.fill_stats(stats_out)
 
     def run(self, instances: int, checkpoint_dir: Optional[str] = None,
             stats_out: Optional[Dict[str, int]] = None,
@@ -1816,6 +1885,18 @@ class LaneDriver:
             for lane, decided, decision in self._tick(deferring):
                 self._finish_lane(lane, decided, decision, results,
                                   checkpoint_dir, completed, instances)
+            for lane in self._snap_flush():
+                # snapshot 'shed' policy: the violating instance retires
+                # undecided NOW (halt raised inside the flush; log did
+                # nothing) — the rv shed discipline at cut granularity
+                self._finish_lane(
+                    lane, False,
+                    np.asarray(self.algo.decision(self._state_row(lane))),
+                    results, checkpoint_dir, completed, instances)
+        if self._snap is not None:
+            # end of the schedule: resolve every pending part-cut and
+            # audit the tail (a final-cut halt raises from here)
+            self._snap.flush(force=True)
 
     def _admit_proposals(self) -> None:
         """Admit queued client proposals into free lanes, under the same
@@ -1957,10 +2038,41 @@ class LaneDriver:
                 results[iid] = (decision_scalar(decision) if decided
                                 else None)
                 self._stream_decision(iid, decided, raw)
+            if self._snap is not None:
+                from round_tpu.rv.dump import RvViolation
+
+                try:
+                    shed_lanes = self._snap_flush()
+                except RvViolation:
+                    # snap halt while client-serving: same fail-fast
+                    # contract as an rv halt — clients learn their
+                    # in-flight instances are dead instead of retrying
+                    # into a halted shard
+                    self._rv_fail_clients()
+                    raise
+                for lane in shed_lanes:
+                    inst, _raw = self._retire_lane(
+                        lane, False, np.asarray(
+                            self.algo.decision(self._state_row(lane))))
+                    iid = inst & 0xFFFF
+                    results[iid] = None
+                    self._stream_decision(iid, False, None)
             if finished or self.table.occupancy or self._proposals:
                 last_active = _time.monotonic()
             elif _time.monotonic() - last_active >= idle_ms / 1000.0:
                 break
+        if self._snap is not None:
+            from round_tpu.rv.dump import RvViolation
+
+            try:
+                # end of serving: resolve pending part-cuts and audit
+                # the tail
+                self._snap.flush(force=True)
+            except RvViolation:
+                # a tail-cut halt keeps the fail-fast contract: any
+                # still-queued client must not retry into a dead shard
+                self._rv_fail_clients()
+                raise
 
 
 def run_instance_loop_lanes(
@@ -1984,6 +2096,7 @@ def run_instance_loop_lanes(
     admission: Optional[AdmissionControl] = None,
     health=None,
     rv=None,
+    snap=None,
 ) -> List[Optional[int]]:
     """The lane-batched form of run_instance_loop: same schedule, same
     seeds, same decision-log shape — the work just flows through one
@@ -1994,13 +2107,16 @@ def run_instance_loop_lanes(
     ``admission``/``health`` opt in to the overload hardening
     (docs/HOST_FAULT_MODEL.md): load shedding + peer quarantine.  ``rv``
     (rv.dump.RvConfig) fuses the runtime-verification monitors into the
-    mega-step (docs/RUNTIME_VERIFICATION.md)."""
+    mega-step (docs/RUNTIME_VERIFICATION.md).  ``snap``
+    (snap.audit.SnapConfig) samples round-boundary state into
+    round-consistent cuts and audits the full-state invariants
+    (docs/SNAPSHOTS.md)."""
     driver = LaneDriver(
         algo, my_id, peers, transport, lanes=lanes, timeout_ms=timeout_ms,
         seed=seed, base_value=base_value, max_rounds=max_rounds,
         nbr_byzantine=nbr_byzantine, value_schedule=value_schedule,
         adaptive=adaptive, wire=wire, use_pump=use_pump,
-        admission=admission, health=health, rv=rv,
+        admission=admission, health=health, rv=rv, snap=snap,
     )
     return driver.run(instances, checkpoint_dir=checkpoint_dir,
                       stats_out=stats_out)

@@ -77,6 +77,19 @@ FLAG_NACK = 10
 # 11/12: clear of lock_manager's 8/9, FLAG_NACK 10, and FLAG_BATCH.
 FLAG_PROPOSE = 11
 FLAG_SUBSCRIBE = 12
+# round-consistent snapshot samples (round_tpu/snap, docs/SNAPSHOTS.md):
+# a replica's own per-lane state sampled at a ROUND BOUNDARY — the HO
+# model's communication-closed rounds make a round-aligned cut a
+# consistent global state BY CONSTRUCTION, so no Chandy-Lamport marker
+# protocol rides the wire, only the samples themselves.  Payload is a
+# codec-typed dict (runtime/codec.py — zero pickle, template-parseable
+# like every hot frame): the state leaves, the instance's proposal row,
+# and a blake2b digest of the canonical state encoding (divergence
+# forensics).  Tag carries the coordinate: instance, round, and the view
+# epoch in the callStack byte (a cut must never join samples across a
+# membership change).  13: clear of lock_manager's 8/9, FLAG_NACK 10,
+# the fleet pair 11/12, and FLAG_BATCH.
+FLAG_SNAP = 13
 # the serveable instance-id range for fleet clients: 0 is the lane
 # driver's free-slot marker and 0xFF00.. is reserved for view-change
 # consensus (runtime/view.py view_instance) — BOTH the trusted router

@@ -41,12 +41,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from round_tpu.spec.check import SpecFormula, spec_formulas
-
-# the decision-plane monitor slots, in verdict-vector order.  Matched
-# case-insensitively against Spec property names so a protocol's own
-# "Agreement" keeps its check_trace label on the live verdict.
-WIRE_MONITORS = ("agreement", "validity", "irrevocability")
+# the decision-plane monitor slots, in verdict-vector order — the SHARED
+# classification (spec/check.py WIRE_MONITORS / SpecFormula.scope): a
+# formula is a wire monitor here iff spec_formulas labels it scope
+# "live", so this compiler and the snapshot auditor (snap/audit.py,
+# which takes the "offline" side) partition the enumeration with no
+# formula claimed twice and none dropped.  Matched case-insensitively
+# against Spec property names so a protocol's own "Agreement" keeps its
+# check_trace label on the live verdict.
+from round_tpu.spec.check import (  # noqa: F401 — WIRE_MONITORS re-export
+    WIRE_MONITORS, SpecFormula, spec_formulas,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -151,8 +156,10 @@ def monitor_program(algo, n: int) -> Optional[MonitorProgram]:
 
     enum = spec_formulas(algo.spec) if getattr(algo, "spec", None) \
         else ()
+    # scope "live" IS the wire-monitor predicate (spec/check.py
+    # formula_scope) — one labeling, shared with the snapshot auditor
     by_name: Dict[str, SpecFormula] = {
-        e.name.lower(): e for e in enum if e.kind == "property"}
+        e.name.lower(): e for e in enum if e.scope == "live"}
     named = [slot for slot in WIRE_MONITORS if slot in by_name]
     if not named:
         return None
@@ -192,9 +199,7 @@ def monitor_program(algo, n: int) -> Optional[MonitorProgram]:
                     decided, _same(decision, prev_val))))
         return jnp.stack(oks), decided, decision
 
-    offline = tuple(e for e in enum
-                    if not (e.kind == "property"
-                            and e.name.lower() in WIRE_MONITORS))
+    offline = tuple(e for e in enum if e.scope != "live")
     return MonitorProgram(
         algo=algo, n=n, labels=tuple(labels), slots=tuple(named),
         offline=offline, decision_shape=dshape, decision_dtype=ddtype,

@@ -1,0 +1,33 @@
+"""round_tpu.snap — round-consistent global snapshots (docs/SNAPSHOTS.md).
+
+Fleet-wide cut capture at round boundaries (communication-closed rounds
+make a round-aligned cut a consistent global state by construction — no
+marker protocol), batched full-state invariant auditing (the offline
+half of the Spec, jitted and vmapped over cuts), and divergence
+forensics (blake2b state digests banked per replica per sampled round).
+
+Surfaces:
+  sample.py  — deterministic sampling policy, FLAG_SNAP payloads,
+               digests, the byte-budgeted emitter
+  collect.py — cut assembly: round alignment, epoch fencing,
+               envelope-tolerated partial cuts, .snapcut banking
+  audit.py   — the batched offline-formula evaluator + the rv-shared
+               halt/shed/log violation pipeline (SnapConfig)
+  driver.py  — SnapDriver, the three-seam facade the serving drivers
+               hold (after_round / on_frame / flush)
+  fixtures.py — snap-broken-conservation, the monitor-invisible
+               full-state violation (tests/test_snap.py)
+"""
+
+from round_tpu.snap.audit import (  # noqa: F401
+    AuditProgram, CutAuditor, SnapConfig, SnapRuntime, SnapViolation,
+    audit_program,
+)
+from round_tpu.snap.collect import (  # noqa: F401
+    Cut, SnapCollector, bank_cut, envelope_f_max, load_cut,
+)
+from round_tpu.snap.driver import SnapDriver  # noqa: F401
+from round_tpu.snap.sample import (  # noqa: F401
+    SampleEmitter, SnapPolicy, decode_sample, encode_sample,
+    sample_jitter, state_digest,
+)

@@ -1,0 +1,62 @@
+"""A deliberately broken round INVISIBLE to every per-lane monitor.
+
+The rv/fixtures.py idea one tier up: the runtime-verification fixtures
+break the decision plane (agreement / validity / irrevocability), which
+the fused lane monitors catch.  This fixture breaks a FULL-STATE
+invariant while keeping the decision plane spotless — the exact class
+of bug PR 12 classified offline and round_tpu/snap exists to catch on
+live traffic:
+
+  ``snap-broken-conservation`` — OTR's shape, but from round 1 on every
+  process silently corrupts its ESTIMATE ``x`` to a fabricated value no
+  process ever proposed (9900 + pid: outside the mod-5 schedule domain
+  and distinct per pid, so no accidental quorum forms), and NOBODY EVER
+  DECIDES.  Every decision-plane monitor is vacuously satisfied — no
+  decision means agreement, validity and irrevocability hold by
+  implication — while OTR's invariant chain (Otr.scala:94-120) is
+  system-wide false: ``keep_init`` ("every estimate is some process's
+  initial value") fails in every chain member the moment the corruption
+  lands.  Only an evaluator holding the GLOBAL state can see it; a
+  round-consistent cut is exactly that (tests/test_snap.py pins the
+  end-to-end catch with the rv monitors provably silent on the same
+  run).
+
+Selector-registered (``snap-broken-conservation``) so violation
+artifacts replay through the standard fuzz_cli surfaces.  A test
+fixture, not a protocol: never deploy it.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from round_tpu.core.rounds import Round, RoundCtx, broadcast
+from round_tpu.models.otr import OtrState
+from round_tpu.ops.mailbox import Mailbox
+from round_tpu.rv.fixtures import _BrokenConsensus
+
+
+class _ConservationBreakRound(Round):
+    """OTR's send, a corrupting update, no decisions ever."""
+
+    def send(self, ctx: RoundCtx, state: OtrState):
+        return broadcast(ctx, state.x)
+
+    def update(self, ctx: RoundCtx, state: OtrState,
+               mbox: Mailbox) -> OtrState:
+        # from round 1 on: the estimate silently becomes a value NO
+        # process proposed — keep_init breaks, nothing else moves
+        fabricated = (9900 + ctx.id).astype(state.x.dtype)
+        x = jnp.where(ctx.r >= 1, fabricated, state.x)
+        # never decide, never exit early: the decision plane stays
+        # spotless (and vacuously monitor-clean) for the whole horizon
+        return state.replace(x=x)
+
+
+FIXTURES = {
+    "snap-broken-conservation": _ConservationBreakRound,
+}
+
+
+def select_fixture(name: str):
+    return _BrokenConsensus(FIXTURES[name]())

@@ -24,6 +24,40 @@ import jax.numpy as jnp
 
 from round_tpu.spec.dsl import Env, Spec, SpecFieldError
 
+# The decision-plane property slots a single replica can check EXACTLY
+# over its own observations — the live monitor compiler
+# (round_tpu/rv/compile.py) compiles precisely these into the fused
+# per-lane verdict term.  Matched case-insensitively against Spec
+# property names; defined HERE so the compiler and the snapshot auditor
+# (round_tpu/snap/audit.py) share one labeling instead of re-deriving it.
+WIRE_MONITORS = ("agreement", "validity", "irrevocability")
+# property names that are LIVENESS claims: meaningful only at the end of
+# a run (check_trace final_properties) — never on a mid-run state, so
+# the snapshot auditor must exclude them or false-positive on every
+# not-yet-decided cut
+_LIVENESS_NAMES = frozenset({"termination"})
+
+
+def formula_scope(kind: str, name: str) -> str:
+    """The live/offline/final classification every formula consumer
+    shares (rv/compile.py monitor compiler, snap/audit.py cut auditor):
+
+      live    — decision-plane properties with an exact locally-checkable
+                per-replica form (WIRE_MONITORS);
+      final   — liveness properties, meaningful only at the end of a run;
+      offline — full-state formulas (invariants, safety_predicate,
+                round_invariants, remaining safety properties): only a
+                consistent GLOBAL state — a recorded trace, or a
+                round-aligned snapshot cut — can evaluate them.
+    """
+    if kind == "property":
+        low = name.lower()
+        if low in WIRE_MONITORS:
+            return "live"
+        if low in _LIVENESS_NAMES:
+            return "final"
+    return "offline"
+
 
 def formula_label(f, fallback: str) -> str:
     """Human-readable name for a spec formula: named properties keep their
@@ -43,13 +77,17 @@ class SpecFormula:
     kind ∈ {"invariant", "property", "safety_predicate",
     "round_invariant"}; ``name`` is the property name for properties (the
     Spec's own naming), the structural position otherwise; ``group`` is
-    the round index for round_invariants (else -1)."""
+    the round index for round_invariants (else -1); ``scope`` is the
+    live/offline/final classification of ``formula_scope`` — computed
+    ONCE here so rv/compile.py and snap/audit.py cannot drift apart on
+    which formulas the wire covers."""
 
     label: str
     kind: str
     name: str
     formula: Any
     group: int = -1
+    scope: str = "offline"
 
 
 def spec_formulas(spec: Spec) -> Tuple["SpecFormula", ...]:
@@ -66,21 +104,26 @@ def spec_formulas(spec: Spec) -> Tuple["SpecFormula", ...]:
     for i, f in enumerate(spec.invariants):
         out.append(SpecFormula(
             formula_label(f, f"invariants[{i}]"), "invariant",
-            f"invariants[{i}]", f))
+            f"invariants[{i}]", f,
+            scope=formula_scope("invariant", f"invariants[{i}]")))
     for name, f in spec.properties:
         out.append(SpecFormula(
-            f"property {name!r}", "property", name, f))
+            f"property {name!r}", "property", name, f,
+            scope=formula_scope("property", name)))
     if spec.safety_predicate is not None:
         f = spec.safety_predicate
         out.append(SpecFormula(
             formula_label(f, "safety_predicate"), "safety_predicate",
-            "safety_predicate", f))
+            "safety_predicate", f,
+            scope=formula_scope("safety_predicate", "safety_predicate")))
     for j, group in enumerate(spec.round_invariants):
         for m, f in enumerate(group):
             out.append(SpecFormula(
                 formula_label(f, f"round_invariants[{j}][{m}]"),
                 "round_invariant", f"round_invariants[{j}][{m}]", f,
-                group=j))
+                group=j,
+                scope=formula_scope("round_invariant",
+                                    f"round_invariants[{j}][{m}]")))
     return tuple(out)
 
 
@@ -136,6 +179,72 @@ class SpecReport:
                 continue
             ok = ok & jnp.all(vals)
         return ok
+
+
+def cut_env(state: Any, n: int, r: int, init0: Any = None) -> Env:
+    """The evaluation context of ONE round-aligned global snapshot (a
+    round_tpu/snap cut): the [n, ...] state stamped round ``r`` is the
+    POST-state of round r — check_trace's step t=r — so formulas see
+    ``env.r = r + 1``.  No ``old`` (the previous round's state was not
+    sampled) and no ``ho`` (the HO matrix is not reconstructible from a
+    cut); formulas that reach for either are not cut-evaluable and the
+    callers classify them out (check_cut below / snap/audit.py)."""
+    return Env(state=state, n=n, old=None, init0=init0,
+               ho=None, r=jnp.asarray(r, dtype=jnp.int32) + 1)
+
+
+def check_cut(spec: Spec, state: Any, n: int, r: int,
+              init0: Any = None, rounds_per_phase: int = 1
+              ) -> Dict[str, Any]:
+    """Evaluate the OFFLINE formulas of ``spec`` on ONE cut — the eager
+    reference twin of the batched snapshot auditor (snap/audit.py pins
+    its jitted vmapped verdicts against this, formula for formula).
+
+    Returns {label: bool | None}: None marks a formula that is not
+    cut-evaluable (it needs ``old``, the HO matrix, or an init snapshot
+    that was not provided).  The invariant chain is reported as ONE
+    entry, ``"invariants (chain)"`` — the DISJUNCTION over the chain,
+    matching check_trace's ``any_invariant`` steady-state expectation
+    (a single invariant being false is normal chain progress; NO
+    invariant holding is the violation) — and only when every chain
+    member is cut-evaluable (a partial disjunction would be weaker than
+    the spec's).  ``safety_predicate`` constrains the executing round's
+    HO and is never cut-evaluable.  Round-invariant group j applies iff
+    ``r % rounds_per_phase == j`` (True elsewhere), the check_trace
+    phase arithmetic."""
+    enum = spec_formulas(spec)
+    # numpy-leaf cuts (the collector stacks host arrays) must lift to
+    # jnp: quantifier bodies index state rows by a vmapped tracer
+    state = jax.tree_util.tree_map(jnp.asarray, state)
+    if init0 is not None:
+        init0 = jax.tree_util.tree_map(jnp.asarray, init0)
+    env = cut_env(state, n, r, init0=init0)
+    out: Dict[str, Any] = {}
+
+    def _try(e):
+        try:
+            return bool(jnp.asarray(_eval_formula(e.formula, env,
+                                                  e.label)))
+        except (ValueError, SpecFieldError):
+            # "no previous-round snapshot" / "no HO matrix" / "no init
+            # snapshot" / a field the sampled state does not carry —
+            # not cut-evaluable, by construction not a violation
+            return None
+
+    inv = [e for e in enum if e.kind == "invariant"]
+    if inv:
+        vals = [_try(e) for e in inv]
+        out["invariants (chain)"] = (None if any(v is None for v in vals)
+                                     else any(vals))
+    for e in enum:
+        if e.kind == "property" and e.scope == "offline":
+            out[e.label] = _try(e)
+        elif e.kind == "round_invariant":
+            if r % rounds_per_phase == e.group:
+                out[e.label] = _try(e)
+            else:
+                out[e.label] = True  # group does not apply to this round
+    return out
 
 
 def _shift_old(trace: Any, init_state: Any) -> Any:

@@ -694,6 +694,82 @@ def check_host_rv(rng, it):
     return cfg
 
 
+def check_host_snap(rng, it):
+    """The host-snap rotation rung (ISSUE 15): the interleaved SNAPSHOT
+    A/B (apps/host_perftest.measure_snap_ab — the lane driver with
+    round-consistent snapshot sampling + cut assembly + the batched
+    audit live vs the same driver snapshots-off).  Banked per rotation:
+    the overhead ratio, per-arm dps, sample/cut/divergence counts and
+    decision-log byte-identity.  Gates: the digest/divergence layer
+    actually ENGAGED (snap.cuts_audited > 0 — a silently-dead collector
+    would pass every other gate vacuously), zero violations and zero
+    divergences on the clean run, logs byte-identical (sampling is a
+    pure observer), and overhead <= 5% dps under the usual noise margin
+    (the <=5% acceptance number is the idle-box interleaved
+    measurement; the rotation gates a DECISIVE regression).  The gate
+    workload is lvb@1KiB — the capacity-bound serving regime, and the
+    maximal per-sample byte cost (KB state rows through the budget
+    path) — at the deployed default sampling rate (every_k=4).  The
+    measured direct hook cost is ~4% of run wall; the per-arm spread of
+    this deadline-paced harness is BIMODAL (runs quantize on burned
+    phase deadlines, dps per arm jumping ~2x run to run), so a
+    sub-margin first read gets ONE bounded re-measure before gating —
+    both ratios are banked.  ~45-90 s."""
+    from round_tpu.apps.host_perftest import measure_snap_ab
+
+    ratios = []
+    for _attempt in range(2):
+        res = measure_snap_ab(
+            n=4, instances=32, lanes=8, timeout_ms=300, pairs=3,
+            warmup=1, seed=int(rng.integers(1e6)), algo="lvb",
+            payload_bytes=1024, every_k=4)
+        med_ratio = (res["extra"]["median_on"]
+                     / max(res["extra"]["median_off"], 1e-9))
+        ratios.append(round(res["value"], 3))
+        if res["value"] >= 0.85 or med_ratio >= 0.85:
+            break
+    snap_m = {k: v for k, v in
+              METRICS.snapshot(compact=True)["counters"].items()
+              if k.startswith("snap.")}
+    cfg = dict(kind="host-snap", it=it, ratio=res["value"],
+               median_ratio=round(med_ratio, 3),
+               attempt_ratios=ratios,
+               lanes=res["extra"]["lanes"],
+               instances=res["extra"]["instances"],
+               every_k=res["extra"]["every_k"],
+               payload_bytes=res["extra"]["payload_bytes"],
+               dps_off=res["extra"]["dps_off"],
+               dps_on=res["extra"]["dps_on"],
+               snap_samples=res["extra"]["snap_samples"],
+               snap_cuts_audited=res["extra"]["snap_cuts_audited"],
+               snap_violations=res["extra"]["snap_violations"],
+               snap_divergences=res["extra"]["snap_divergences"],
+               logs_identical=res["extra"]["logs_identical"],
+               snap_counters=snap_m)
+    if res["extra"]["snap_cuts_audited"] <= 0:
+        return {**cfg, "fail": "snap.cuts_audited == 0 — the snapshot "
+                               "arm ran with a dead collector (no cut "
+                               "ever assembled/audited)"}
+    if res["extra"]["snap_violations"]:
+        return {**cfg, "fail": f"{res['extra']['snap_violations']} snap "
+                               "violation(s) on a CLEAN run — the "
+                               "auditor is mis-firing"}
+    if res["extra"]["snap_divergences"]:
+        return {**cfg, "fail": f"{res['extra']['snap_divergences']} "
+                               "digest divergence(s) on a CLEAN run — "
+                               "samples corrupted or equivocating"}
+    if not res["extra"]["logs_identical"]:
+        return {**cfg, "fail": "decision logs diverged snap-on vs off "
+                               "— sampling is not a pure observer"}
+    # the host-rv rung's noise discipline: +/-30-40% per-arm spread at
+    # pairs=3, so gate only a decisive regression, bank the trajectory
+    if res["value"] < 0.85 and med_ratio < 0.85:
+        return {**cfg, "fail": f"snapshot overhead regression: on/off "
+                               f"mean {res['value']} and median "
+                               f"{round(med_ratio, 3)} both < 0.85"}
+    return cfg
+
+
 def check_host_pump(rng, it):
     """The host-pump rotation rung: the interleaved PUMP A/B
     (apps/host_perftest.measure_pump_ab — Python round pump vs the
@@ -1203,7 +1279,7 @@ def main():
                 lambda r, i: check_host_perf(r, i, payload=True),
                 check_fuzz, check_verify_param, check_host_overload,
                 check_host_fleet, check_host_rv, check_byz_crosscheck,
-                check_multichip_ici]
+                check_multichip_ici, check_host_snap]
     while time.monotonic() < t_end:
         check = rotation[it % len(rotation)]
         t0 = time.perf_counter()

@@ -253,6 +253,46 @@ def rv_events(events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return sorted(out, key=lambda r: r["t"])
 
 
+def snap_events(events: Sequence[Dict[str, Any]]
+                ) -> Dict[str, Any]:
+    """Round-consistent snapshot activity on the merged timeline
+    (round_tpu/snap, docs/SNAPSHOTS.md): sample counts per node, every
+    assembled cut (with its round and missing-contributor count), and
+    every ``snap_violation`` / ``snap_divergence`` — the records worth a
+    line each, time-ordered."""
+    samples: Dict[Any, int] = {}
+    cuts: List[Dict[str, Any]] = []
+    alerts: List[Dict[str, Any]] = []
+    for e in events:
+        ev = e.get("ev")
+        if ev == "snap_sample":
+            samples[e.get("node")] = samples.get(e.get("node"), 0) + 1
+        elif ev == "snap_cut":
+            cuts.append({
+                "t": e.get("t", 0.0), "inst": e.get("inst"),
+                "round": e.get("round"), "epoch": e.get("epoch"),
+                "missing": e.get("missing", 0),
+                "partial": bool(e.get("partial")),
+            })
+        elif ev == "snap_violation":
+            alerts.append({
+                "t": e.get("t", 0.0), "kind": "snap_violation",
+                "node": e.get("node"), "inst": e.get("inst"),
+                "round": e.get("round"), "formula": e.get("formula"),
+                "policy": e.get("policy"),
+            })
+        elif ev == "snap_divergence":
+            alerts.append({
+                "t": e.get("t", 0.0), "kind": "snap_divergence",
+                "node": e.get("node"), "inst": e.get("inst"),
+                "round": e.get("round"),
+                "divergence": e.get("kind"),
+            })
+    return {"samples_by_node": samples,
+            "cuts": sorted(cuts, key=lambda c: c["t"]),
+            "alerts": sorted(alerts, key=lambda a: a["t"])}
+
+
 def timeline(events: Sequence[Dict[str, Any]], limit: int = 0) -> List[str]:
     """Human-readable merged timeline (offset seconds from first event)."""
     evs = [e for e in events if "t" in e]
@@ -285,6 +325,7 @@ def report(paths: Sequence[str], show_timeline: bool = False,
     corr = correlate_faults(events)
     epochs = view_epochs(events)
     rv = rv_events(events)
+    snap = snap_events(events)
     if as_json:
         return json.dumps({
             "files": list(paths),
@@ -292,6 +333,7 @@ def report(paths: Sequence[str], show_timeline: bool = False,
             "round_latency_ms": lat,
             "view_epochs": epochs,
             "rv": rv,
+            "snap": snap,
             "faults": {k: len(v) for k, v in corr.items()},
             "correlation": corr,
         }, indent=1)
@@ -331,6 +373,33 @@ def report(paths: Sequence[str], show_timeline: bool = False,
                     f"{r.get('reason')}")
         if len(rv) > max_listed:
             out.append(f"  ... {len(rv) - max_listed} more")
+    if snap["samples_by_node"] or snap["cuts"] or snap["alerts"]:
+        t0 = min(e["t"] for e in events if "t" in e)
+        out.append("")
+        per_node = " ".join(
+            f"n{n}:{c}" for n, c in sorted(snap["samples_by_node"].items()))
+        out.append(f"## snapshots (snap_sample / snap_cut / "
+                   f"snap_violation / snap_divergence) — samples {per_node}"
+                   if per_node else "## snapshots")
+        for c in snap["cuts"][:max_listed]:
+            out.append(
+                f"  +{c['t'] - t0:8.3f}s CUT i{c['inst']} r{c['round']} "
+                f"epoch {c['epoch']} missing={c['missing']}"
+                + (" PARTIAL" if c["partial"] else ""))
+        if len(snap["cuts"]) > max_listed:
+            out.append(f"  ... {len(snap['cuts']) - max_listed} more cuts")
+        for a in snap["alerts"][:max_listed]:
+            if a["kind"] == "snap_violation":
+                out.append(
+                    f"  +{a['t'] - t0:8.3f}s n{a['node']} i{a['inst']} "
+                    f"r{a['round']} SNAP VIOLATION {a['formula']} "
+                    f"policy={a['policy']}")
+            else:
+                out.append(
+                    f"  +{a['t'] - t0:8.3f}s n{a['node']} i{a['inst']} "
+                    f"r{a['round']} SNAP DIVERGENCE {a['divergence']}")
+        if len(snap["alerts"]) > max_listed:
+            out.append(f"  ... {len(snap['alerts']) - max_listed} more")
     if lat:
         out.append("")
         out.append("## per-round latency (ms, across instances and nodes)")
