@@ -76,6 +76,14 @@ def serve_main(args) -> int:
             policy=args.snap, protocol=args.algo,
             dump_dir=args.snap_dir or "snap_dumps",
             every_k=args.snap_every, bank_dir=args.snap_bank)
+    kv = None
+    if getattr(args, "kv", False):
+        from round_tpu.kv.store import KvConfig
+
+        kv = KvConfig(lease_ms=args.kv_lease_ms,
+                      lease_replica=args.kv_lease_replica,
+                      keyspace=args.kv_keyspace,
+                      broken_lease=args.kv_broken_lease)
     # fixed ports: the bench parent announced them to the router
     srv = DriverServer(
         algo, n=len(ports), lanes=args.lanes,
@@ -86,7 +94,7 @@ def serve_main(args) -> int:
         admission_bytes_per_lane=args.admission_bytes_per_lane,
         shed_deadline_ms=args.shed_deadline_ms,
         adaptive_cap_ms=args.adaptive_cap_ms, ports=ports, rv=rv,
-        snap=snap)
+        snap=snap, kv=kv)
     srv.start()
     rc = 0
     try:
@@ -122,6 +130,8 @@ def serve_main(args) -> int:
             summary["rv"] = srv.rv_summary()
         if snap is not None:
             summary["snap"] = srv.snap_summary()
+        if kv is not None:
+            summary["kv"] = srv.kv_summary()
         print(json.dumps(summary))
     return rc
 
@@ -366,6 +376,23 @@ def main(argv=None) -> int:
     sv.add_argument("--snap-bank", type=str, default=None, metavar="DIR",
                     help="bank assembled cuts as .snapcut files "
                          "(apps/snap_cli.py audits them offline)")
+    sv.add_argument("--kv", action="store_true",
+                    help="serve this shard as a replicated KV store "
+                         "(round_tpu/kv, docs/KV.md): decided lvb "
+                         "records apply to a per-replica state machine, "
+                         "FLAG_READ serves the three read grades, "
+                         "FLAG_TXN validates transaction records")
+    sv.add_argument("--kv-lease-replica", type=int, default=0,
+                    help="which replica answers lease reads")
+    sv.add_argument("--kv-lease-ms", type=float, default=0.0,
+                    help="lease staleness bound (0 = derive from the "
+                         "round deadline, rv.compile.lease_bound_ms)")
+    sv.add_argument("--kv-keyspace", type=int, default=4096)
+    sv.add_argument("--kv-broken-lease", action="store_true",
+                    help="INJECT the stale-lease fixture: the lease "
+                         "replica freezes each key's first answer and "
+                         "never refuses — the kv/lin.py checker must "
+                         "catch it (tests + docs only)")
 
     bn = sub.add_parser("bench", help="spawn a fleet + open-loop loadgen")
     bn.add_argument("--drivers", type=int, default=4)

@@ -120,6 +120,27 @@ def combined_score(obj: Dict[str, jnp.ndarray], severity: jnp.ndarray,
             - severity_weight * jnp.asarray(severity, jnp.float32))
 
 
+def kv_stream_viol(decided: jnp.ndarray, decision: jnp.ndarray,
+                   record_value) -> jnp.ndarray:
+    """[P] int32 — the KV decision-stream invariant (round_tpu/kv,
+    docs/KV.md) as a lane objective: under the serving path every
+    replica of an instance proposes the SAME client record (the router
+    fans one lvb payload out to the whole group), so any decided lane
+    whose decision differs from that record is a PHANTOM APPLY — a
+    per-key state machine executing a record no client ever wrote.
+
+    This is Validity with a singleton witness set, which also subsumes
+    Agreement on the instance: if every decider must equal the record,
+    any two deciders must equal each other.  It gets its own objective
+    (rather than reusing ``validity_viol`` with pinned values) because
+    the KV reading is the invariant the kv/lin.py history checker
+    enforces post-hoc — the fuzzer hunts the same bug pre-hoc, and a
+    hit here is the engine-level root cause of a ``non-linearizable``
+    history verdict."""
+    bad = decided & (decision != jnp.asarray(record_value))
+    return jnp.sum(bad.astype(jnp.int32), axis=1)
+
+
 def spec_holds(formula: Callable[[Env], Any], state: Any, n: int
                ) -> jnp.ndarray:
     """[P] bool — evaluate one spec/dsl.py formula on every candidate's
@@ -173,4 +194,21 @@ def safety_violated():
                 + np.asarray(out["validity_viol"])) > 0
 
     pred.__name__ = "safety_violated()"
+    return pred
+
+
+def kv_stream_violated(record_value: int):
+    """The KV decision-stream invariant (``kv_stream_viol``) as a
+    minimizer predicate: some decided lane applied a record that is not
+    the uniformly-proposed client record.  Drives the kv fuzz arm's
+    search stop, ddmin shrinking and artifact verification with ONE
+    oracle, like the rv/byz arms."""
+    import numpy as np
+
+    def pred(out):
+        bad = (np.asarray(out["decided"])
+               & (np.asarray(out["decision"]) != record_value))
+        return bad.sum(axis=1) > 0
+
+    pred.__name__ = f"kv_stream_violated(record={record_value})"
     return pred

@@ -17,11 +17,20 @@ The declared form is a saturating power law,
 
     log(knee_dps) = b0 + b1·log(drivers) + b2·log(lanes)
                        + b3·log1p(payload_KiB)
+                       + b4·read_frac + b5·lease_frac
 
 fitted by least squares over the banked knee samples.  b1 is the
 scale-out exponent (1.0 = perfect driver scaling), b2 the lane
 amortization exponent (PERF_MODEL.md measured strong sub-linearity past
-L≈64), b3 the payload tax.  The fit refuses (<3 distinct samples or a
+L≈64), b3 the payload tax.  b4/b5 are the READ axes (apps/kv.py bench
+--sweep): read_frac is the fraction of offered ops that are reads,
+lease_frac the fraction served at the lease grade — reads skip the
+consensus write path entirely, so a read-heavy mix should lift the op
+knee (b4 > 0) and lease-serving lifts it further (b5 > 0) because a
+lease read costs one local frame instead of a round wave.  Knee
+samples from the pre-KV benches simply omit the fields (0.0 default),
+and the zero-variance pinning below keeps them out of the fit until a
+sweep actually varies them.  The fit refuses (<3 distinct samples or a
 singular design) rather than extrapolating from nothing.
 
 Feedback derivations (documented in PERF_MODEL.md, pinned monotone by
@@ -65,14 +74,20 @@ class CapacityModel:
     r2: float
     n_samples: int
     samples: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    # read axes (apps/kv.py bench): 0.0 on pre-KV model artifacts
+    b_read: float = 0.0
+    b_lease: float = 0.0
 
     def predict_dps(self, drivers: int, lanes: int,
-                    payload_bytes: int = 0) -> float:
+                    payload_bytes: int = 0, read_frac: float = 0.0,
+                    lease_frac: float = 0.0) -> float:
         return math.exp(
             self.b0
             + self.b_drivers * math.log(max(1, drivers))
             + self.b_lanes * math.log(max(1, lanes))
-            + self.b_payload * math.log1p(payload_bytes / 1024.0))
+            + self.b_payload * math.log1p(payload_bytes / 1024.0)
+            + self.b_read * read_frac
+            + self.b_lease * lease_frac)
 
     def recommended_lanes(self, drivers: int = 1,
                           payload_bytes: int = 0) -> int:
@@ -128,11 +143,13 @@ def fit_capacity(samples: List[Dict[str, Any]]) -> CapacityModel:
     """Fit the power-law capacity model from measured knee samples.
 
     Each sample: ``{"drivers": D, "lanes": L, "payload_bytes": B,
-    "knee_dps": dps}`` (extra keys ride along into the artifact).
-    Raises CapacityFitError on fewer than 3 usable samples or a design
-    matrix without enough variation to identify the exponents (columns
-    with zero variance are PINNED to 0 instead — a sweep that never
-    varied payload fits b_payload = 0, honestly)."""
+    "knee_dps": dps}`` plus optional read axes ``read_frac`` /
+    ``lease_frac`` (0.0 when absent — pre-KV samples) (extra keys ride
+    along into the artifact).  Raises CapacityFitError on fewer than 3
+    usable samples or a design matrix without enough variation to
+    identify the exponents (columns with zero variance are PINNED to 0
+    instead — a sweep that never varied payload fits b_payload = 0,
+    honestly)."""
     rows = [s for s in samples if s.get("knee_dps", 0) > 0]
     if len(rows) < 3:
         raise CapacityFitError(
@@ -142,18 +159,20 @@ def fit_capacity(samples: List[Dict[str, Any]]) -> CapacityModel:
         [1.0,
          math.log(max(1, int(s.get("drivers", 1)))),
          math.log(max(1, int(s.get("lanes", 1)))),
-         math.log1p(int(s.get("payload_bytes", 0)) / 1024.0)]
+         math.log1p(int(s.get("payload_bytes", 0)) / 1024.0),
+         float(s.get("read_frac", 0.0)),
+         float(s.get("lease_frac", 0.0))]
         for s in rows])
     # pin unidentifiable exponents: a column that never varies carries
     # no information — lstsq would smear the intercept across it
-    active = [0] + [j for j in (1, 2, 3)
+    active = [0] + [j for j in (1, 2, 3, 4, 5)
                     if np.ptp(cols[:, j]) > 1e-12]
     if active == [0]:
         raise CapacityFitError(
             "degenerate design: no axis (drivers/lanes/payload) varies "
             "across the samples — an intercept-only 'model' cannot "
             "derive anything")
-    coef = np.zeros(4)
+    coef = np.zeros(6)
     sol, _res, rank, _sv = np.linalg.lstsq(cols[:, active], y, rcond=None)
     if rank < len(active):
         raise CapacityFitError(
@@ -167,6 +186,7 @@ def fit_capacity(samples: List[Dict[str, Any]]) -> CapacityModel:
     return CapacityModel(
         b0=float(coef[0]), b_drivers=float(coef[1]),
         b_lanes=float(coef[2]), b_payload=float(coef[3]),
+        b_read=float(coef[4]), b_lease=float(coef[5]),
         r2=round(r2, 4), n_samples=len(rows),
         samples=[{k: v for k, v in s.items()} for s in rows])
 

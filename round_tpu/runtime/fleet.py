@@ -76,8 +76,8 @@ from round_tpu.obs.trace import TRACE
 from round_tpu.runtime import codec
 from round_tpu.runtime.log import get_logger
 from round_tpu.runtime.oob import (
-    FLAG_DECISION, FLAG_NACK, FLAG_PROPOSE, FLAG_SUBSCRIBE, FLAG_TOO_LATE,
-    FLEET_MAX_INSTANCE, FLEET_MIN_INSTANCE, Tag,
+    FLAG_DECISION, FLAG_NACK, FLAG_PROPOSE, FLAG_READ, FLAG_SUBSCRIBE,
+    FLAG_TOO_LATE, FLAG_TXN, FLEET_MAX_INSTANCE, FLEET_MIN_INSTANCE, Tag,
 )
 
 log = get_logger("fleet")
@@ -161,6 +161,18 @@ class ShardMap:
             i = 0
         return self._ring[i][1]
 
+    def owner_key(self, key: bytes) -> str:
+        """The shard owning this BYTE key (the kv data plane routes by
+        key, not instance id, so every write of a key lands in one
+        shard's decision stream — docs/KV.md)."""
+        if not self._ring:
+            raise ValueError("empty shard ring")
+        h = _h64(bytes(key))
+        i = bisect.bisect_right(self._ring, (h, "￿"))
+        if i == len(self._ring):
+            i = 0
+        return self._ring[i][1]
+
 
 @dataclasses.dataclass
 class _InFlight:
@@ -174,6 +186,7 @@ class _InFlight:
     retries: int = 0            # NACK-scheduled re-proposes so far
     reproposals: int = 0        # timer-scheduled catch-up re-sends
     next_retry: float = 0.0     # 0 = not in backoff
+    txn: bool = False           # ship under FLAG_TXN (kv transactions)
     # DISTINCT (shard, replica) pairs that answered FLAG_TOO_LATE: the
     # instance resolves undecided only when every replica of its
     # CURRENT shard said so — a single undecided replica re-answering
@@ -228,6 +241,11 @@ class FleetRouter:
         # resolutions, which is how the router — which never sees the
         # shard's process — observes runtime-verification trouble
         self.shard_health: Dict[str, Dict[str, int]] = {}
+        # the kv read verb (round_tpu/kv): FLAG_READ frames and the
+        # NACKs of shed reads route to whoever registered here (the
+        # KVClient); the router stays kv-agnostic otherwise
+        self.on_read_reply: Optional[Callable] = None
+        self.on_read_nack: Optional[Callable] = None
 
     # -- shard membership --------------------------------------------------
 
@@ -330,12 +348,17 @@ class FleetRouter:
             arr = arr.astype(np.int32)
         return codec.encode(arr)
 
-    def propose(self, instance_id: int, value) -> None:
+    def propose(self, instance_id: int, value, *,
+                shard: Optional[str] = None, txn: bool = False) -> None:
         """Route one instance to its ring owner and ship the proposal to
         every replica of that shard (coalesced; ``pump``/``flush`` ships
         the wave).  ``value`` is the client's initial value — a scalar
         for the int-domain protocols, a uint8[B] vector for the byte-
-        payload workload."""
+        payload workload.  ``shard`` overrides the ring placement (the
+        kv data plane routes by KEY via ``ring.owner_key``, so every
+        write of a key shares one decision stream); ``txn`` ships the
+        proposal under FLAG_TXN — same state machine, but the shard
+        validates the payload as a kv transaction record."""
         inst = int(instance_id)
         if not MIN_INSTANCE <= inst <= MAX_FLEET_INSTANCE:
             raise ValueError(
@@ -343,9 +366,13 @@ class FleetRouter:
                 f"[{MIN_INSTANCE}, {MAX_FLEET_INSTANCE}]")
         if inst in self._inflight or inst in self.results:
             raise ValueError(f"instance {inst} already proposed")
+        if shard is not None and shard not in self._links:
+            raise ValueError(f"unknown shard {shard!r}")
         now = _time.monotonic()
         f = _InFlight(inst=inst, payload=self._encode_value(value),
-                      shard=self.ring.owner(inst), t_first=now, t_last=now)
+                      shard=shard if shard is not None
+                      else self.ring.owner(inst),
+                      t_first=now, t_last=now, txn=txn)
         self._inflight[inst] = f
         _C_PROPOSALS.inc()
         _G_INFLIGHT.set(len(self._inflight))
@@ -358,7 +385,8 @@ class FleetRouter:
         link = self._links.get(f.shard)
         if link is None:
             return  # shard gone mid-flight; rebalance re-routes it
-        tag = Tag(instance=f.inst & 0xFFFF, flag=FLAG_PROPOSE)
+        tag = Tag(instance=f.inst & 0xFFFF,
+                  flag=FLAG_TXN if f.txn else FLAG_PROPOSE)
         sendb = getattr(link, "send_buffered", None)
         for j in range(self._link_n[f.shard]):
             if sendb is not None:
@@ -366,6 +394,32 @@ class FleetRouter:
             else:
                 link.send(j, tag, f.payload)
         f.t_last = _time.monotonic()
+
+    def shard_n(self, shard: str) -> int:
+        """Replica count of one shard (the kv client's majority rule)."""
+        return self._link_n[shard]
+
+    def send_read(self, shard: str, replica: int, rid: int,
+                  payload: bytes) -> bool:
+        """Ship one FLAG_READ frame to a single replica of ``shard``
+        (round_tpu/kv three-grade reads) and flush immediately — read
+        latency is the product here, so reads never wait for the next
+        proposal wave's coalesce."""
+        from round_tpu.kv.reads import read_tag
+
+        link = self._links.get(shard)
+        if link is None:
+            return False
+        tag = read_tag(rid)
+        sendb = getattr(link, "send_buffered", None)
+        if sendb is not None:
+            sendb(replica, tag, payload)
+            fl = getattr(link, "flush", None)
+            if fl is not None:
+                fl()
+        else:
+            link.send(replica, tag, payload)
+        return True
 
     def subscribe(self, shard: Optional[str] = None) -> None:
         """Ask ``shard`` (default: all) to stream EVERY decision it
@@ -398,6 +452,12 @@ class FleetRouter:
     def _on_frame(self, shard: str, got) -> None:
         sender, tag, raw = got
         inst = tag.instance
+        if tag.flag == FLAG_READ:
+            # a kv read reply (the payload carries the full read id);
+            # routed whole to the registered client, never resolved here
+            if self.on_read_reply is not None:
+                self.on_read_reply(shard, sender, tag, raw)
+            return
         if tag.flag == FLAG_DECISION:
             if inst not in self._inflight:
                 if inst in self.results:
@@ -420,6 +480,14 @@ class FleetRouter:
         if tag.flag == FLAG_NACK:
             f = self._inflight.get(inst)
             if f is None:
+                # not a write of ours: a SHED READ NACKs back with the
+                # 16-bit read id in Tag.instance (kv/reads.py read_tag) —
+                # hand it to the kv client's retry machinery.  The id
+                # spaces can collide in their low 16 bits; an in-flight
+                # write always wins the ambiguity (reads self-heal on
+                # their own retry timer regardless)
+                if self.on_read_nack is not None:
+                    self.on_read_nack(shard, inst)
                 return
             _C_NACKS.inc()
             if TRACE.enabled:
@@ -601,7 +669,7 @@ class DriverServer:
                  shed_deadline_ms: int = 250,
                  adaptive_cap_ms: int = 0,
                  ports: Optional[List[int]] = None,
-                 rv=None, snap=None):
+                 rv=None, snap=None, kv=None):
         from round_tpu.runtime.chaos import alloc_ports
         from round_tpu.runtime.transport import HostTransport
 
@@ -627,6 +695,11 @@ class DriverServer:
         # audits the shard's cuts (the in-shard collector deployment;
         # banked .snapcut files feed apps/snap_cli.py offline)
         self.snap = snap
+        # replicated key-value serving (round_tpu/kv): a kv.store.KvConfig
+        # turns every replica into a KV shard member — decisions apply to
+        # a per-replica KVState, FLAG_READ serves the three grades,
+        # FLAG_TXN validates transaction records (docs/KV.md)
+        self.kv = kv
         if ports is None:
             ports = alloc_ports(n)
         elif len(ports) != n:
@@ -660,6 +733,12 @@ class DriverServer:
 
             adaptive = AdaptiveTimeout(cap_ms=self.adaptive_cap_ms,
                                        seed=self.seed * 31 + i)
+        kv_shard = None
+        if self.kv is not None:
+            from round_tpu.kv.store import KVShard
+
+            kv_shard = KVShard(self.kv, node=i, n=self.n,
+                               timeout_ms=self.timeout_ms)
         try:
             driver = LaneDriver(
                 self.algo, i, peers, self._transports[i],
@@ -668,6 +747,7 @@ class DriverServer:
                 value_schedule="uniform", use_pump=self.use_pump,
                 admission=admission, adaptive=adaptive,
                 clients={self.n}, rv=self.rv, snap=self.snap,
+                kv=kv_shard,
             )
             self.results[i] = driver.serve(
                 idle_ms=self.idle_ms, max_ms=self.max_ms,
@@ -690,6 +770,30 @@ class DriverServer:
             "halted": sorted(
                 i for i, e in self.errors.items()
                 if type(e).__name__ == "RvViolation"),
+        }
+
+    def kv_summary(self) -> Dict[str, Any]:
+        """Aggregate kv status across this shard's replicas (the
+        apps/kv.py serve/bench output surface)."""
+        return {
+            "enabled": self.kv is not None,
+            "applied": sum(st.get("kv_applied", 0) for st in self.stats),
+            "reads_lin": sum(st.get("kv_reads_lin", 0)
+                             for st in self.stats),
+            "reads_lease": sum(st.get("kv_reads_lease", 0)
+                               for st in self.stats),
+            "reads_stale": sum(st.get("kv_reads_stale", 0)
+                               for st in self.stats),
+            "lease_refused": sum(st.get("kv_lease_refused", 0)
+                                 for st in self.stats),
+            "lease_grants": sum(st.get("kv_lease_grants", 0)
+                                for st in self.stats),
+            "txn_frames": sum(st.get("kv_txn_frames", 0)
+                              for st in self.stats),
+            "txn_commits": sum(st.get("kv_txn_commits", 0)
+                               for st in self.stats),
+            "txn_aborts": sum(st.get("kv_txn_aborts", 0)
+                              for st in self.stats),
         }
 
     def snap_summary(self) -> Dict[str, Any]:

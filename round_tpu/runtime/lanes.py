@@ -64,9 +64,9 @@ from round_tpu.runtime.host import (
 from round_tpu.runtime.instances import AdmissionControl, LaneTable
 from round_tpu.runtime.log import get_logger
 from round_tpu.runtime.oob import (
-    FLAG_DECISION, FLAG_NACK, FLAG_NORMAL, FLAG_PROPOSE, FLAG_SNAP,
-    FLAG_SUBSCRIBE, FLAG_TOO_LATE, FLEET_MAX_INSTANCE, FLEET_MIN_INSTANCE,
-    Tag,
+    FLAG_DECISION, FLAG_NACK, FLAG_NORMAL, FLAG_PROPOSE, FLAG_READ,
+    FLAG_SNAP, FLAG_SUBSCRIBE, FLAG_TOO_LATE, FLAG_TXN,
+    FLEET_MAX_INSTANCE, FLEET_MIN_INSTANCE, Tag,
 )
 from round_tpu.runtime.transport import RoundPump
 
@@ -238,6 +238,7 @@ class LaneDriver:
         clients=None,
         rv=None,
         snap=None,
+        kv=None,
     ):
         if wire not in ("binary", "pickle"):
             raise ValueError(f"wire must be 'binary' or 'pickle', "
@@ -437,6 +438,18 @@ class LaneDriver:
                 max_rounds=max_rounds, transport=transport,
                 value_schedule=value_schedule, base_value=base_value,
                 admission=admission)
+        # REPLICATED KV SERVING (round_tpu/kv, docs/KV.md): ``kv`` is a
+        # kv.store.KVShard — decided client instances additionally apply
+        # to its per-replica state machine IN DECISION ORDER, FLAG_READ
+        # frames serve the three read grades (linearizable reads queue
+        # behind their write barrier + one serve wave; lease/stale answer
+        # inline), and FLAG_TXN rides the PROPOSE machinery with record
+        # validation.  None = kv off, byte-identical pre-kv behavior.
+        self._kv = kv
+        self._kv_reads: List[Any] = []   # queued linearizable reads
+        self._kv_wave = 0                # serve-loop wave counter
+        self._kv_prev_rounds = 0         # lease-freshness deltas
+        self._kv_prev_timeouts = 0
 
     # -- native pump setup -------------------------------------------------
 
@@ -697,7 +710,22 @@ class LaneDriver:
         if tag.flag == FLAG_SUBSCRIBE:
             self._subscribers.add(sender)
             return
-        if tag.flag != FLAG_PROPOSE:
+        if tag.flag == FLAG_READ:
+            # the kv read verb (round_tpu/kv, docs/KV.md): lease/stale
+            # grades answer inline from applied state, linearizable
+            # reads queue behind their write barrier + one serve wave —
+            # and SHED like proposals under admission pressure (lease/
+            # stale stay served while shedding: they cost no lane)
+            if self._kv is not None:
+                self._kv_read_frame(sender, tag, raw)
+            return
+        if tag.flag == FLAG_TXN and self._kv is None:
+            # the txn verb needs a kv shard to validate against
+            self._note_malformed(sender)
+            self.transport.send(sender, Tag(instance=tag.instance,
+                                            flag=FLAG_TOO_LATE))
+            return
+        if tag.flag not in (FLAG_PROPOSE, FLAG_TXN):
             return  # decisions/NACKs are client->driver only downstream
         iid = tag.instance
         if not FLEET_MIN_INSTANCE <= iid <= FLEET_MAX_INSTANCE:
@@ -746,6 +774,17 @@ class LaneDriver:
         # adopt_decision discipline)
         arr = (arr.astype(proto.dtype) if arr.dtype != proto.dtype
                else np.array(arr))
+        if tag.flag == FLAG_TXN and not self._kv.is_txn_record(arr):
+            # FLAG_TXN is PROPOSE's state machine plus payload
+            # validation (runtime/oob.py): a non-transaction record
+            # on the txn verb is refused with the give-up signal
+            self._note_malformed(sender)
+            self.transport.send(sender,
+                                Tag(instance=iid, flag=FLAG_TOO_LATE))
+            return
+        if self._kv is not None:
+            # register the write barrier for linearizable reads
+            self._kv.note_propose(iid, arr)
         self._proposals.append((iid, {"initial_value": arr}, sender))
         self._proposed.add(iid)
         self._client_of[iid] = sender
@@ -775,6 +814,88 @@ class LaneDriver:
             self.client_streams += 1
             _C_CLIENT_STREAM.inc()
 
+    # -- kv serving (round_tpu/kv, docs/KV.md) -----------------------------
+
+    def _kv_read_frame(self, sender: int, tag: Tag, raw) -> None:
+        """One FLAG_READ frame: lease/stale answer inline (no lane, no
+        consensus — served even while shedding), linearizable reads
+        queue behind their write barrier + one serve wave, and SHED with
+        the same accounted NACK as proposals under admission pressure
+        (Tag.instance carries the 16-bit read id for correlation)."""
+        from round_tpu.kv import reads as _kvr
+
+        req = _kvr.decode_read(bytes(raw) if raw is not None else b"")
+        if req is None:
+            self._note_malformed(sender)
+            return
+        if _kvr.serve_read(self._kv, sender, req["r"], req["k"],
+                           req["g"], self.transport):
+            return
+        if ((self._admission is not None and self._admission.shedding)
+                or len(self._kv_reads) >= _STASH_CAP):
+            self._shed_frame(sender, tag.instance)
+            return
+        self._kv.reads_lin += 1
+        _kvr.C_READS[_kvr.GRADE_LIN].inc()
+        self._kv_reads.append(_kvr.PendingRead(
+            sender, req["r"], req["k"],
+            self._kv.barrier_for(req["k"]), self._kv_wave))
+
+    def _kv_tick(self) -> None:
+        """One serve wave's kv work: advance the wave counter, feed the
+        lease clock (a round wave that advanced by THRESHOLD — not
+        deadline — heard a quorum inside one round trip; works on both
+        the Python and native pumps, which never surface per-peer frames
+        here), revoke the lease for good once the rv monitor has
+        recorded any violation, and release queued linearizable reads
+        whose write barrier drained at least one full wave ago."""
+        from round_tpu.kv import reads as _kvr
+
+        dr = self.rounds_run - self._kv_prev_rounds
+        dt = self.timeouts - self._kv_prev_timeouts
+        self._kv_prev_rounds = self.rounds_run
+        self._kv_prev_timeouts = self.timeouts
+        # the wave is a ROUND wave, not a serve-loop iteration: a
+        # queued linearizable read must see actual round progress
+        # before it answers (the read-index cost — this is what makes
+        # a lease read an order of magnitude cheaper).  An idle lane
+        # table runs no rounds, so idleness itself advances the wave:
+        # per-link FIFO already ordered the read after every acked
+        # write's apply, and there is nothing in flight to wait out.
+        if dr > 0 or not self.table.occupancy:
+            self._kv_wave += 1
+        if dr > dt:
+            self._kv.lease.note_quorum()
+        if (self._rv is not None
+                and getattr(self._rv, "violations", None)):
+            self._kv.lease.revoke()
+        if not self._kv_reads:
+            return
+        keep = []
+        for pr in self._kv_reads:
+            if pr.ready(self._kv.pending, self._kv_wave):
+                seq, val = self._kv.answer(pr.key)
+                self.transport.send(
+                    pr.sender, _kvr.read_tag(pr.rid),
+                    _kvr.encode_reply(pr.rid, _kvr.ST_OK, seq, val))
+            else:
+                keep.append(pr)
+        self._kv_reads = keep
+
+    def _kv_fail_reads(self) -> None:
+        """Best-effort on a halt: refuse every queued linearizable read
+        so clients fall to their retry/give-up path immediately."""
+        from round_tpu.kv import reads as _kvr
+
+        for pr in self._kv_reads:
+            try:
+                self.transport.send(
+                    pr.sender, _kvr.read_tag(pr.rid),
+                    _kvr.encode_reply(pr.rid, _kvr.ST_REFUSED, 0, b""))
+            except Exception:  # noqa: BLE001 — the halt still propagates
+                pass
+        self._kv_reads = []
+
     def _ingest(self, got) -> None:
         sender, tag, raw = got
         if not 0 <= sender < self.n:
@@ -786,6 +907,10 @@ class LaneDriver:
             self.malformed += 1
             _C_MALFORMED.inc()
             return
+        if self._kv is not None:
+            # any peer frame is lease-freshness evidence (the Python
+            # pump path; the native pump feeds note_quorum via _kv_tick)
+            self._kv.lease.note_peer(sender)
         if tag.flag == FLAG_NACK:
             # a peer SHED our frame (admission overload, not wire loss):
             # purely informational — the protocol's own retransmission is
@@ -1757,16 +1882,23 @@ class LaneDriver:
             self._rv.fill_stats(stats_out)
         if self._snap is not None:
             self._snap.fill_stats(stats_out)
+        if self._kv is not None:
+            self._kv.fill_stats(stats_out)
 
     def run(self, instances: int, checkpoint_dir: Optional[str] = None,
             stats_out: Optional[Dict[str, int]] = None,
+            linger_ms: int = 0,
             ) -> List[Optional[int]]:
         """Run ``instances`` consecutive consensus instances (numbered
         1..instances, the PerfTest2 schedule) with up to the lane width in
         flight; returns the per-instance decision log like
         run_instance_loop.  With ``checkpoint_dir``, the log is durably
         checkpointed as instances complete and an existing checkpoint
-        RESUMES (completed instances are not re-run)."""
+        RESUMES (completed instances are not re-run).  ``linger_ms``
+        keeps answering laggards' retransmissions for that idle window
+        after the schedule completes (host.serve_decisions, lane-driver
+        form) — without it a replica whose deciding quorum excluded it
+        can find every peer already exited (see _linger)."""
         results: List[Optional[int]] = [None] * instances
         completed: set = set()
         next_admit = 1
@@ -1809,12 +1941,40 @@ class LaneDriver:
         try:
             self._run_loop(instances, checkpoint_dir, results, completed,
                            next_admit)
+            if linger_ms > 0:
+                self._linger(linger_ms)
         finally:
             # stats survive an rv-halt (the RvViolation propagates with
             # the violation record already banked)
             self._bank_pump_stats()
             self._fill_stats(stats_out)
         return results
+
+    def _linger(self, linger_ms: int, max_ms: int = 120_000) -> None:
+        """host.serve_decisions, lane-driver form: the decision-reply
+        (TooLate) path only runs while something pumps the wire, so a
+        batch replica that returns the moment ITS OWN log is full
+        strands any peer whose deciding quorum excluded it — the
+        straggler retransmits deadline-paced rounds into closed
+        sockets until max_rounds burns (observed as a polite replica's
+        None in the asymmetric-overload test, a scheduling lottery,
+        not a wedge).  Keep ticking the now-empty lane table: _tick
+        still drains frames, and a completed instance's NORMAL traffic
+        is answered from the decision bank through the same reply path
+        as during the run.  Every reply re-arms the idle window, so
+        the linger outlasts the LAST laggard contact by ``linger_ms``,
+        hard-capped at ``max_ms``."""
+        window = linger_ms / 1000.0
+        now = _time.monotonic()
+        t_end = now + max_ms / 1000.0
+        deadline = now + window
+        mark = max(self._replied.values(), default=float("-inf"))
+        while _time.monotonic() < min(deadline, t_end):
+            self._tick(False)
+            newest = max(self._replied.values(), default=float("-inf"))
+            if newest > mark:
+                mark = newest
+                deadline = newest + window
 
     def _run_loop(self, instances: int, checkpoint_dir, results,
                   completed: set, next_admit: int) -> None:
@@ -1949,7 +2109,11 @@ class LaneDriver:
 
     def _rv_fail_clients(self) -> None:
         """Best-effort client notification on an rv halt: FLAG_TOO_LATE
-        for every queued proposal and live client instance."""
+        for every queued proposal and live client instance (queued kv
+        reads are refused too, and the lease dies with the shard)."""
+        if self._kv is not None:
+            self._kv.lease.revoke()
+            self._kv_fail_reads()
         try:
             for iid, _io, sender in list(self._proposals):
                 self.transport.send(
@@ -2037,7 +2201,14 @@ class LaneDriver:
                 iid = inst & 0xFFFF
                 results[iid] = (decision_scalar(decision) if decided
                                 else None)
+                if self._kv is not None:
+                    # apply IN DECISION ORDER before the decision
+                    # streams: a client that sees its ack must find
+                    # every replica's read view already reflecting it
+                    self._kv.on_decision(iid, decided, raw)
                 self._stream_decision(iid, decided, raw)
+            if self._kv is not None:
+                self._kv_tick()
             if self._snap is not None:
                 from round_tpu.rv.dump import RvViolation
 
@@ -2056,6 +2227,8 @@ class LaneDriver:
                             self.algo.decision(self._state_row(lane))))
                     iid = inst & 0xFFFF
                     results[iid] = None
+                    if self._kv is not None:
+                        self._kv.on_decision(iid, False, None)
                     self._stream_decision(iid, False, None)
             if finished or self.table.occupancy or self._proposals:
                 last_active = _time.monotonic()
@@ -2097,6 +2270,7 @@ def run_instance_loop_lanes(
     health=None,
     rv=None,
     snap=None,
+    linger_ms: int = 0,
 ) -> List[Optional[int]]:
     """The lane-batched form of run_instance_loop: same schedule, same
     seeds, same decision-log shape — the work just flows through one
@@ -2110,7 +2284,8 @@ def run_instance_loop_lanes(
     mega-step (docs/RUNTIME_VERIFICATION.md).  ``snap``
     (snap.audit.SnapConfig) samples round-boundary state into
     round-consistent cuts and audits the full-state invariants
-    (docs/SNAPSHOTS.md)."""
+    (docs/SNAPSHOTS.md).  ``linger_ms`` answers laggards for an idle
+    window after the schedule completes (LaneDriver._linger)."""
     driver = LaneDriver(
         algo, my_id, peers, transport, lanes=lanes, timeout_ms=timeout_ms,
         seed=seed, base_value=base_value, max_rounds=max_rounds,
@@ -2119,4 +2294,4 @@ def run_instance_loop_lanes(
         admission=admission, health=health, rv=rv, snap=snap,
     )
     return driver.run(instances, checkpoint_dir=checkpoint_dir,
-                      stats_out=stats_out)
+                      stats_out=stats_out, linger_ms=linger_ms)

@@ -90,6 +90,27 @@ FLAG_SUBSCRIBE = 12
 # membership change).  13: clear of lock_manager's 8/9, FLAG_NACK 10,
 # the fleet pair 11/12, and FLAG_BATCH.
 FLAG_SNAP = 13
+# the KV serving verbs (round_tpu/kv, docs/KV.md): client frames beside
+# the fleet pair, same untrusted-boundary discipline.
+#   READ: "answer this key at this consistency grade" — payload is a
+#   codec dict {r: read id, k: key bytes, g: grade} and the reply rides
+#   the SAME flag back with {r, st, seq, v}.  Reads never occupy the
+#   consensus instance-id space: Tag.instance carries the 16-bit read id
+#   only so shedding can refuse one with the accounted FLAG_NACK
+#   (linearizable reads queue a round-wave barrier, so under admission
+#   pressure they are shed and NACK-accounted exactly like proposals;
+#   lease/stale grades answer from applied state and stay cheap enough
+#   to serve while shedding).
+#   TXN: "propose this transaction-control record" — PROPOSE's exact
+#   state machine (idempotent retry/catch-up, FLAG_DECISION stream,
+#   accounted NACK under shedding) but the payload MUST decode as a KV
+#   transaction record (kv/store.py: TXN/PREPARE/COMMIT/ABORT), so a
+#   shard can refuse transaction verbs when KV serving is off and
+#   account them separately (kv.txn_frames).
+# 14/15: clear of lock_manager's 8/9, FLAG_NACK 10, the fleet pair
+# 11/12, FLAG_SNAP 13 and FLAG_BATCH.
+FLAG_READ = 14
+FLAG_TXN = 15
 # the serveable instance-id range for fleet clients: 0 is the lane
 # driver's free-slot marker and 0xFF00.. is reserved for view-change
 # consensus (runtime/view.py view_instance) — BOTH the trusted router

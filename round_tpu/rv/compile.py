@@ -346,3 +346,85 @@ def _scalar(v) -> int:
     from round_tpu.runtime.host import decision_scalar
 
     return decision_scalar(np.asarray(v))
+
+
+# -- leader-lease staleness bounds (round_tpu/kv, docs/KV.md) --------------
+#
+# The KV tier's lease reads are LICENSED by the same observability
+# argument as the agreement monitor above: a replica that keeps hearing
+# a quorum of its group inside a bounded window cannot have missed a
+# decision wave (communication-closed rounds — every decided instance
+# ran a wave this replica's quorum participated in), so its applied
+# state is at most one in-flight wave stale.  The bound is therefore
+# expressed in ROUNDS and converted to wall time by the driver's round
+# deadline — the monitor's carried-state staleness bound, not an
+# unrelated wall-clock lease.  A replica that stops hearing a quorum
+# (partition, chaos drops) must REFUSE lease reads until the quorum
+# returns; a tripped agreement monitor revokes the lease permanently
+# (carried state is no longer trustworthy at any staleness).
+
+
+def lease_bound_ms(timeout_ms: float, rounds: int = 2) -> float:
+    """The lease validity window in wall time: ``rounds`` round
+    deadlines.  Two rounds is the carried-state argument's minimum — one
+    full wave may be in flight past the last quorum heard, and one more
+    deadline bounds how long that wave can linger before this replica's
+    own timeout fires and it re-observes the quorum (or stops serving)."""
+    return float(rounds) * float(timeout_ms)
+
+
+class LeaseClock:
+    """Quorum-heard staleness clock for lease reads (one per driver).
+
+    ``note_peer(pid)`` records round traffic from a consensus peer; the
+    lease is VALID while at least ``quorum`` distinct peers (self
+    included) have been heard within ``bound_ms``.  ``revoke()`` kills
+    the lease for good — the agreement monitor's carried state tripped,
+    so no staleness window makes local reads safe again."""
+
+    def __init__(self, n: int, my_id: int, bound_ms: float,
+                 quorum: Optional[int] = None):
+        import time as _time
+
+        self.n = n
+        self.id = my_id
+        self.bound_ms = float(bound_ms)
+        self.quorum = quorum if quorum is not None else n // 2 + 1
+        self._now = _time.monotonic
+        self._heard: Dict[int, float] = {my_id: self._now()}
+        self._last_quorum = float("-inf")
+        self.revoked = False
+        self.refusals = 0
+        self.grants = 0
+
+    def note_peer(self, pid: int) -> None:
+        if 0 <= pid < self.n:
+            self._heard[pid] = self._now()
+
+    def note_quorum(self) -> None:
+        """A round advanced by THRESHOLD (not deadline): the driver just
+        heard >= n-f distinct peers inside one round trip, which is the
+        strongest freshness evidence there is.  This is the signal the
+        native round pump feeds (per-peer frames never surface to
+        Python there — only round progress does)."""
+        self._last_quorum = self._now()
+
+    def valid(self, now: Optional[float] = None) -> bool:
+        """One lease check: quorum heard inside the staleness bound and
+        the agreement monitor never tripped.  Counts grants/refusals —
+        the kv.lease_* observability surface reads them."""
+        if self.revoked:
+            self.refusals += 1
+            return False
+        t = self._now() if now is None else now
+        self._heard[self.id] = t  # self is always current
+        horizon = t - self.bound_ms / 1000.0
+        fresh = sum(1 for ts in self._heard.values() if ts >= horizon)
+        if fresh >= self.quorum or self._last_quorum >= horizon:
+            self.grants += 1
+            return True
+        self.refusals += 1
+        return False
+
+    def revoke(self) -> None:
+        self.revoked = True

@@ -1,0 +1,595 @@
+"""Replicated KV store on multi-shot SMR (round_tpu/kv) — the kv suite.
+
+The serving contract (ISSUE 18 / docs/KV.md), pinned here:
+
+  * the record codec round-trips every op (and refuses garbage) — the
+    uint8[B] lvb payload IS the typed (key, seq, value) record;
+  * ``KVState`` apply semantics: decision-order folding, deterministic
+    lock-conflict PREPARE votes readable via the reserved vote key,
+    idempotent commit/abort — the exact properties client-coordinated
+    2PC needs from a replicated log;
+  * the SMR array rider replays a decided PUT stream to the same
+    (seq, digest) tables the host store holds;
+  * the three read grades against a LIVE in-process cluster: a
+    linearizable read observes a committed concurrent write (the
+    read-index wave), a lease read refuses once the staleness bound
+    starves (and serves under quorum evidence), a stale read never
+    touches the wire;
+  * the kv/lin.py checker: clean histories pass, every violation kind
+    is caught, and a violating history banks a replayable artifact —
+    including the injected broken-lease fixture;
+  * the capacity model's read axes: read-heavy knees identify
+    b_read/b_lease, pre-KV samples default to 0.0;
+  * the fuzz arm: the KV decision-stream invariant holds in-envelope
+    (tier-1 smoke; the 10k-schedule sweep + past-envelope minimized
+    counterexample ride ``-m fuzz``/``-m slow``).
+
+Heavy arms — the 2-shard subprocess fleet forms (clean ≥1k-op run and
+the caught broken-lease run) — ride ``-m slow`` (tier-1 budget
+discipline)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from round_tpu.kv import lin as klin
+from round_tpu.kv import reads as R
+from round_tpu.kv import txn as ktxn
+from round_tpu.kv.store import (
+    OP_ABORT, OP_COMMIT, OP_PREPARE, OP_PUT, OP_TXN, KVShard, KVState,
+    KvConfig, decode_record, encode_record, key_index, kv_array_apply,
+    value_digest,
+)
+
+B = 64  # lvb payload width for every in-process cluster in this file
+
+
+# ---------------------------------------------------------------------------
+# record codec
+# ---------------------------------------------------------------------------
+
+
+def test_record_codec_roundtrips_all_ops():
+    pairs = [(7, b"alpha", b"v1"), (9, b"k2", b"")]
+    for op in (OP_PUT, OP_TXN, OP_PREPARE, OP_COMMIT, OP_ABORT):
+        row = encode_record(op, pairs, 128, txn=42)
+        assert row.shape == (128,) and row.dtype == np.uint8
+        rec = decode_record(row)
+        assert rec == {"op": op, "txn": 42, "pairs": pairs}
+
+
+def test_record_codec_header_carries_array_rider_coordinates():
+    row = encode_record(OP_PUT, [(3, b"key", b"val")], B, keyspace=256)
+    kidx = int(row[8]) | int(row[9]) << 8
+    assert kidx == key_index(b"key", 256)
+    dig = int.from_bytes(bytes(row[10:14]), "little")
+    assert dig == value_digest(b"val")
+
+
+def test_record_codec_refuses_garbage_and_overflow():
+    assert decode_record(np.zeros(B, np.uint8)) is None       # no magic
+    assert decode_record(np.zeros(4, np.uint8)) is None       # short
+    row = encode_record(OP_PUT, [(1, b"k", b"v")], B)
+    row[1] = 99                                               # bad op
+    assert decode_record(row) is None
+    trunc = encode_record(OP_PUT, [(1, b"k", b"v" * 30)], B)[:20]
+    assert decode_record(trunc) is None                       # cut body
+    with pytest.raises(ValueError):
+        encode_record(OP_PUT, [(1, b"k", b"v" * 60)], B)      # > payload
+    with pytest.raises(ValueError):
+        encode_record(OP_PUT, [], B)
+
+
+# ---------------------------------------------------------------------------
+# KVState: apply semantics, votes, locks, idempotence
+# ---------------------------------------------------------------------------
+
+
+def _rec(op, pairs, txn=0):
+    return {"op": op, "txn": txn, "pairs": pairs}
+
+
+def test_kvstate_put_and_txn_apply_atomically():
+    st = KVState()
+    st.apply(_rec(OP_PUT, [(1, b"a", b"x")]))
+    st.apply(_rec(OP_TXN, [(2, b"a", b"y"), (1, b"b", b"z")], txn=5))
+    assert st.get(b"a") == (2, b"y")
+    assert st.get(b"b") == (1, b"z")
+    assert st.txn_commits == 1 and st.applied == 2
+
+
+def test_kvstate_register_converges_under_reordered_apply():
+    """The soak-caught regression: concurrent same-key writes are
+    separate instances, and instances COMPLETE in different orders on
+    different replicas — a last-apply-wins fold leaves the lease
+    replica answering a different seq than the lin majority.  The fold
+    is seq-LWW, so every completion interleave converges."""
+    import itertools
+
+    pairs = [(s, b"k", f"v{s}".encode()) for s in (1, 5, 2, 3)]
+    states = []
+    for perm in itertools.permutations(pairs):
+        st = KVState()
+        for p in perm:
+            st.apply(_rec(OP_PUT, [p]))
+        states.append(st.get(b"k"))
+    assert set(states) == {(5, b"v5")}
+
+
+def test_kvstate_prepare_votes_are_deterministic_lock_conflicts():
+    st = KVState()
+    st.apply(_rec(OP_PREPARE, [(1, b"k", b"v1")], txn=1))
+    st.apply(_rec(OP_PREPARE, [(1, b"k", b"v2")], txn=2))  # k locked by 1
+    assert st.get(ktxn.vote_key(1)) == (1, b"y")
+    assert st.get(ktxn.vote_key(2)) == (2, b"n")
+    # an unknown txn's vote key reads as never-written
+    assert st.get(ktxn.vote_key(9)) == (0, b"")
+    # commit applies ONLY the buffered yes-voter; nothing leaked early
+    assert st.get(b"k") == (0, b"")
+    st.apply(_rec(OP_COMMIT, [(1, b"k", b"")], txn=1))
+    assert st.get(b"k") == (1, b"v1")
+    # the no-voter's commit is a forced no-op (its vote was n)
+    st.apply(_rec(OP_COMMIT, [(1, b"k", b"")], txn=2))
+    assert st.get(b"k") == (1, b"v1") and st.txn_aborts == 1
+
+
+def test_kvstate_commit_abort_idempotent_and_lock_release():
+    st = KVState()
+    st.apply(_rec(OP_PREPARE, [(1, b"k", b"v")], txn=1))
+    st.apply(_rec(OP_PREPARE, [(1, b"k", b"v")], txn=1))   # re-decided
+    st.apply(_rec(OP_ABORT, [(1, b"k", b"")], txn=1))
+    st.apply(_rec(OP_ABORT, [(1, b"k", b"")], txn=1))      # idempotent
+    assert st.get(b"k") == (0, b"") and st.txn_aborts == 1
+    # the abort released the lock: a fresh prepare votes yes
+    st.apply(_rec(OP_PREPARE, [(2, b"k", b"w")], txn=3))
+    assert st.get(ktxn.vote_key(3)) == (3, b"y")
+    st.apply(_rec(OP_COMMIT, [(2, b"k", b"")], txn=3))
+    st.apply(_rec(OP_COMMIT, [(2, b"k", b"")], txn=3))     # idempotent
+    assert st.get(b"k") == (2, b"w") and st.txn_commits == 1
+
+
+def test_tpc_decide_is_all_votes_yes():
+    assert ktxn.tpc_decide([True, True])
+    assert not ktxn.tpc_decide([True, False])
+
+
+# ---------------------------------------------------------------------------
+# the SMR array rider: host store vs jit fold parity
+# ---------------------------------------------------------------------------
+
+
+def test_kv_array_rider_matches_host_state():
+    import jax.numpy as jnp
+
+    keyspace = 64
+    host = KVState()
+    seqs = jnp.zeros(keyspace, jnp.int32)
+    digs = jnp.zeros(keyspace, jnp.uint32)
+    # (2, k0) then (1, k0): a stale seq arriving late must lose on
+    # both sides of the parity (the seq-LWW register fold)
+    rows = [encode_record(OP_PUT, [(s, f"k{i}".encode(), b"v" * (i + 1))],
+                          B, keyspace=keyspace)
+            for s, i in ((1, 0), (1, 1), (2, 0), (1, 2), (1, 0))]
+    # a non-PUT and a non-record row must be no-ops for the rider
+    rows.append(encode_record(OP_PREPARE, [(1, b"k0", b"z")], B,
+                              txn=7, keyspace=keyspace))
+    rows.append(np.zeros(B, np.uint8))
+    for row in rows:
+        rec = decode_record(row)
+        if rec is not None and rec["op"] == OP_PUT:
+            host.apply(rec)
+        (seqs, digs) = kv_array_apply((seqs, digs), jnp.asarray(row))
+    for key, (seq, val) in host.data.items():
+        k = key_index(key, keyspace)
+        assert int(seqs[k]) == seq
+        assert int(digs[k]) == value_digest(val)
+    # untouched coordinates stayed zero
+    touched = {key_index(k, keyspace) for k in host.data}
+    for k in range(keyspace):
+        if k not in touched:
+            assert int(seqs[k]) == 0 and int(digs[k]) == 0
+
+
+# ---------------------------------------------------------------------------
+# lease clock semantics (the tier-1 lean staleness arm)
+# ---------------------------------------------------------------------------
+
+
+def test_lease_refuses_when_staleness_bound_starves():
+    shard = KVShard(KvConfig(lease_ms=50.0), node=0, n=3, timeout_ms=25)
+    # no quorum evidence ever heard: the clock is stale, the lease
+    # REFUSES — refusal is the contract, not an error
+    assert shard.lease_answer(b"k") is None
+    assert shard.lease_refused == 1
+    # quorum evidence (a decided instance) licenses local answers
+    shard.state.apply(_rec(OP_PUT, [(4, b"k", b"v")]))
+    shard.lease.note_quorum()
+    assert shard.lease_answer(b"k") == (4, b"v")
+    # an rv revocation is forever, whatever the clock says
+    shard.lease.revoke()
+    shard.lease.note_quorum()
+    assert shard.lease_answer(b"k") is None
+
+
+def test_broken_lease_fixture_freezes_and_never_refuses():
+    shard = KVShard(KvConfig(broken_lease=True), node=0, n=3,
+                    timeout_ms=25)
+    shard.state.apply(_rec(OP_PUT, [(1, b"k", b"old")]))
+    assert shard.lease_answer(b"k") == (1, b"old")   # never refuses
+    shard.state.apply(_rec(OP_PUT, [(2, b"k", b"new")]))
+    # the frozen answer is the VIOLATION the checker must catch
+    assert shard.lease_answer(b"k") == (1, b"old")
+
+
+# ---------------------------------------------------------------------------
+# the linearizability checker
+# ---------------------------------------------------------------------------
+
+
+def _w(key, seq, t0, t1, ok=True, **kw):
+    return {"cl": "c0", "op": "w", "key": key, "seq": seq, "val": "aa",
+            "t0": t0, "t1": t1, "ok": ok, **kw}
+
+
+def _r(key, res_seq, t0, t1, grade="lin", ok=True, **kw):
+    return {"cl": "c0", "op": "r", "key": key, "grade": grade, "t0": t0,
+            "t1": t1, "ok": ok, "res_seq": res_seq, "res_val": "aa",
+            **kw}
+
+
+def test_checker_passes_clean_and_concurrent_histories():
+    assert klin.check_history([]) == []
+    assert klin.check_history([
+        _w("6b", 1, 0.0, 1.0), _r("6b", 1, 1.1, 1.2),
+        _r("6b", 1, 1.3, 1.4, grade="lease"),
+        _r("6b", 0, 1.3, 1.4, grade="stale"),
+    ]) == []
+    # a read CONCURRENT with a write may see either side of it
+    for res in (0, 1):
+        assert klin.check_history([
+            _w("6b", 1, 0.0, 1.0), _r("6b", res, 0.5, 0.6)]) == []
+    # a failed write may or may not have taken effect
+    for res in (0, 1):
+        assert klin.check_history([
+            _w("6b", 1, 0.0, 1.0, ok=False),
+            _r("6b", res, 1.1, 1.2)]) == []
+
+
+def test_checker_catches_every_violation_kind():
+    # non-linearizable: a read AFTER an acked write misses it
+    v = klin.check_history([_w("6b", 1, 0.0, 1.0),
+                            _r("6b", 0, 1.1, 1.2)])
+    assert [x["kind"] for x in v] == ["non-linearizable"]
+    # the broken-lease shape: lease read returns a superseded seq
+    v = klin.check_history([
+        _w("6b", 1, 0.0, 1.0), _w("6b", 2, 1.1, 2.0),
+        _r("6b", 1, 2.1, 2.2, grade="lease")])
+    assert [x["kind"] for x in v] == ["non-linearizable"]
+    # stale grade is weaker: the same superseded answer is LEGAL...
+    assert klin.check_history([
+        _w("6b", 1, 0.0, 1.0), _w("6b", 2, 1.1, 2.0),
+        _r("6b", 1, 2.1, 2.2, grade="stale")]) == []
+    # ...but a stale read may not see the future or an aborted txn
+    v = klin.check_history([_r("6b", 3, 0.0, 0.1, grade="stale"),
+                            _w("6b", 3, 1.0, 2.0)])
+    assert [x["kind"] for x in v] == ["stale-read-uncommitted"]
+    v = klin.check_history([
+        _w("6b", 1, 0.0, 1.0, ok=False, txn=4, aborted=True),
+        _r("6b", 1, 1.1, 1.2)])
+    assert [x["kind"] for x in v] == ["aborted-read"]
+    v = klin.check_history([_w("6b", 1, 0.0, 1.0),
+                            _r("6b", 7, 1.1, 1.2)])
+    assert [x["kind"] for x in v] == ["phantom-read"]
+
+
+def test_checker_artifact_banks_and_replays(tmp_path):
+    hist = [_w("6b", 1, 0.0, 1.0), _r("6b", 0, 1.1, 1.2)]
+    viol = klin.check_history(hist)
+    assert viol
+    path = klin.dump_history_violation(str(tmp_path), hist, viol,
+                                       meta={"fixture": "unit"})
+    assert path and os.path.exists(path)
+    art = klin.load_artifact(path)
+    assert art["kind"] == "kv-lin" and art["meta"]["kv"]["ops"] == 2
+    rep = klin.replay_artifact(path)
+    assert rep["matches_expected"]
+    assert [v["kind"] for v in rep["violations"]] == ["non-linearizable"]
+    # the CLI replay path agrees (exit 0 = verdict reproduced)
+    from round_tpu.apps.kv import main as kv_main
+
+    assert kv_main(["check", path]) == 0
+    with pytest.raises(ValueError):
+        klin.load_artifact(__file__)
+
+
+# ---------------------------------------------------------------------------
+# the capacity model's read axes
+# ---------------------------------------------------------------------------
+
+
+def test_capacity_fit_identifies_read_axes():
+    from round_tpu.runtime.capacity import CapacityModel, fit_capacity
+
+    base = dict(drivers=2, lanes=16, payload_bytes=256)
+    samples = [
+        {**base, "knee_dps": 100.0},                       # pre-KV: no axes
+        {**base, "knee_dps": 210.0, "read_frac": 0.5, "lease_frac": 0.2},
+        {**base, "knee_dps": 420.0, "read_frac": 0.9, "lease_frac": 0.5},
+        {**base, "knee_dps": 300.0, "read_frac": 0.9, "lease_frac": 0.1},
+    ]
+    m = fit_capacity(samples)
+    # read-heavier mixes lift the op knee; lease share lifts it further
+    assert m.b_read > 0 and m.b_lease > 0
+    assert (m.predict_dps(2, 16, 256, read_frac=0.9, lease_frac=0.5)
+            > m.predict_dps(2, 16, 256, read_frac=0.5, lease_frac=0.2)
+            > m.predict_dps(2, 16, 256))
+    # zero-variance pinning: a sweep that never varied the read axes
+    # fits them to 0, honestly — and old model artifacts load with 0.0
+    m2 = fit_capacity([
+        {**base, "lanes": 4, "knee_dps": 50.0},
+        {**base, "lanes": 16, "knee_dps": 100.0},
+        {**base, "lanes": 64, "knee_dps": 140.0}])
+    assert m2.b_read == 0.0 and m2.b_lease == 0.0
+    legacy = {k: v for k, v in m2.to_json().items()
+              if k not in ("b_read", "b_lease")}
+    m3 = CapacityModel(**legacy)
+    assert m3.b_read == 0.0 and m3.predict_dps(2, 16, 256) \
+        == pytest.approx(m2.predict_dps(2, 16, 256))
+
+
+# ---------------------------------------------------------------------------
+# the three grades against a live in-process cluster
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def kv_cluster():
+    from round_tpu.kv.client import KVClient
+    from round_tpu.models.lastvoting import LastVotingBytes
+    from round_tpu.runtime.fleet import DriverServer, FleetRouter
+
+    srv = DriverServer(LastVotingBytes(payload_bytes=B), n=3, lanes=8,
+                       timeout_ms=150, idle_ms=60_000, max_ms=120_000,
+                       kv=KvConfig())
+    srv.start()
+    router = FleetRouter(proto="tcp")
+    router.add_shard("s0", srv.replicas)
+    cl = KVClient(router, payload_bytes=B)
+    yield srv, router, cl
+    router.close()
+    srv.stop()
+    srv.join(30.0)
+
+
+def test_lin_read_observes_committed_concurrent_write(kv_cluster):
+    srv, router, cl = kv_cluster
+    cl.put(b"lin-k", b"v1")
+    assert cl.drain(30.0)
+    # a write acked BEFORE the read was issued must be visible
+    cl.read(b"lin-k", R.GRADE_LIN)
+    assert cl.drain(20.0)
+    op = cl.history[-1]
+    assert op["grade"] == "lin" and op["ok"]
+    assert op["res_seq"] == 1 and op["res_val"] == b"v1".hex()
+    # a write still IN FLIGHT when the read arrives: the read-index
+    # barrier defers the answer behind it (per-link FIFO puts the
+    # PROPOSE ahead of the read), so the read observes it too
+    cl.put(b"lin-k", b"v2")
+    cl.read(b"lin-k", R.GRADE_LIN)
+    assert cl.drain(30.0)
+    reads = [op for op in cl.history
+             if op["op"] == "r" and op["key"] == b"lin-k".hex()]
+    assert reads[-1]["res_seq"] == 2
+    assert klin.check_history(cl.history) == []
+
+
+def test_lease_read_serves_locally_or_falls_back(kv_cluster):
+    srv, router, cl = kv_cluster
+    cl.put(b"lease-k", b"lv")
+    assert cl.drain(30.0)
+    cl.read(b"lease-k", R.GRADE_LEASE)
+    assert cl.drain(20.0)
+    op = cl.history[-1]
+    assert op["ok"] and op["res_seq"] == 1
+    # served at the lease grade, or REFUSED and completed as the lin
+    # fallback (both are the contract; a starved clock must not lie)
+    assert op["grade"] == ("lease" if not op.get("fallback") else "lin")
+    assert cl.lease_served + cl.lease_fallbacks >= 1
+    assert klin.check_history(cl.history) == []
+
+
+def test_stale_read_serves_from_the_decision_bank(kv_cluster):
+    srv, router, cl = kv_cluster
+    cl.put(b"stale-k", b"sv")
+    assert cl.drain(30.0)
+    rid = cl.read(b"stale-k", R.GRADE_STALE)
+    assert rid is None                       # completed INLINE
+    op = cl.history[-1]
+    assert op["grade"] == "stale" and op["ok"]
+    assert op["res_seq"] == 1 and op["res_val"] == b"sv".hex()
+    # an unknown key reads as the initial register, still inline
+    assert cl.read(b"never-written", R.GRADE_STALE) is None
+    assert cl.history[-1]["res_seq"] == 0
+
+
+class _NoWireRouter:
+    """A router that EXPLODES on any data-plane touch: the stale-grade
+    zero-wire-traffic proof.  KVClient's ctor installs its two reply
+    hooks (plain setattr); everything else is a contract breach."""
+
+    def __getattr__(self, name):
+        raise AssertionError(
+            f"stale read touched the wire: router.{name}")
+
+
+def test_stale_read_is_wire_free():
+    from round_tpu.kv.client import KVClient
+
+    cl = KVClient(_NoWireRouter(), payload_bytes=B)
+    cl.mirror[b"k"] = (3, b"banked")
+    assert cl.read(b"k", R.GRADE_STALE) is None
+    assert cl.read(b"unknown", R.GRADE_STALE) is None
+    seen = [(op["res_seq"], op["res_val"]) for op in cl.history]
+    assert seen == [(3, b"banked".hex()), (0, "")]
+
+
+def test_single_shard_txn_commits_atomically(kv_cluster):
+    srv, router, cl = kv_cluster
+    res = cl.txn({b"txn-a": b"1", b"txn-b": b"2"}, deadline_s=30.0)
+    assert res["committed"] and res["shards"] == 1
+    for key, val in ((b"txn-a", b"1"), (b"txn-b", b"2")):
+        cl.read(key, R.GRADE_LIN)
+        assert cl.drain(20.0)
+        assert cl.history[-1]["res_val"] == val.hex()
+    assert klin.check_history(cl.history) == []
+
+
+def test_kv_summary_counts_the_traffic():
+    """Replica kv counters surface through DriverServer.kv_summary at
+    serve exit — the apps/kv.py serve/bench reporting surface (own
+    short-lived cluster: stats land when the serve loop returns)."""
+    from round_tpu.kv.client import KVClient
+    from round_tpu.models.lastvoting import LastVotingBytes
+    from round_tpu.runtime.fleet import DriverServer, FleetRouter
+
+    srv = DriverServer(LastVotingBytes(payload_bytes=B), n=3, lanes=8,
+                       timeout_ms=150, idle_ms=30_000, max_ms=60_000,
+                       kv=KvConfig())
+    srv.start()
+    router = FleetRouter(proto="tcp")
+    router.add_shard("s0", srv.replicas)
+    cl = KVClient(router, payload_bytes=B)
+    try:
+        cl.put(b"sum-k", b"v")
+        assert cl.drain(30.0)
+        cl.read(b"sum-k", R.GRADE_LIN)
+        assert cl.drain(20.0)
+        assert cl.txn({b"sum-a": b"1", b"sum-b": b"2"},
+                      deadline_s=30.0)["committed"]
+    finally:
+        router.close()
+        srv.stop()
+        srv.join(30.0)
+    s = srv.kv_summary()
+    assert s["enabled"]
+    assert s["applied"] > 0 and s["reads_lin"] > 0
+    assert s["txn_frames"] > 0 and s["txn_commits"] > 0
+
+
+# ---------------------------------------------------------------------------
+# the fuzz arm: the KV decision-stream invariant
+# ---------------------------------------------------------------------------
+
+_KV_REC = 2  # the uniformly-proposed record token (engine value domain)
+
+
+def _kv_fuzz_target(seed=5):
+    from round_tpu.fuzz.search import make_target
+
+    return make_target("lastvoting", n=4, horizon=12, seed=seed,
+                       values=np.full(4, _KV_REC, dtype=np.int32))
+
+
+def test_fuzz_smoke_kv_stream_invariant_holds_in_envelope():
+    """Tier-1 smoke: benign fault schedules (the proved envelope) never
+    make a decided lane apply anything but the uniformly-proposed
+    record — the engine-level root of the KV serving contract."""
+    from round_tpu.fuzz.objectives import kv_stream_violated
+    from round_tpu.fuzz.search import search
+
+    t = _kv_fuzz_target()
+    pred = kv_stream_violated(_KV_REC)
+    res = search(t, pop_size=64, generations=2, seed=5, stop_when=pred)
+    assert res.evaluated == 128          # no early stop = no violation
+    assert res.best_outcome["validity_viol"] == 0
+    assert res.best_outcome["agreement_viol"] == 0
+
+
+@pytest.mark.fuzz
+@pytest.mark.slow
+def test_fuzz_kv_stream_sweep_and_counterexample(tmp_path):
+    """The heavy arm: >= 4k in-envelope schedules with the invariant
+    intact; ONE value liar past the envelope yields a phantom apply,
+    minimized (ddmin over links + lie events) and banked as a v2
+    schedule artifact whose engine replay reproduces bit-exact."""
+    from round_tpu.byz.crosscheck import liar_rows
+    from round_tpu.fuzz import minimize as fmin, replay as freplay
+    from round_tpu.fuzz.objectives import kv_stream_violated
+    from round_tpu.fuzz.search import search
+
+    t = _kv_fuzz_target()
+    pred = kv_stream_violated(_KV_REC)
+    res = search(t, pop_size=512, generations=8, seed=5, stop_when=pred,
+                 time_box_s=180.0)
+    assert res.evaluated >= 4000 or res.generations < 8
+    assert res.best_outcome["validity_viol"] == 0
+    assert res.best_outcome["agreement_viol"] == 0
+
+    seeds = liar_rows(4, t.horizon, 1, seed=5)
+    res2 = search(t, pop_size=256, generations=12, seed=7,
+                  stop_when=pred, value_cap=1, seed_rows=seeds,
+                  time_box_s=180.0)
+    assert pred(res2.outcome).any(), "one value liar must phantom-apply"
+    mr = fmin.minimize(t, res2.best_row, pred)
+    art = freplay.make_artifact(
+        protocol=t.name, schedule=mr.schedule, values=t.init_values,
+        seed=t.seed, value_plan=mr.value_plan,
+        meta={"objective": pred.__name__})
+    art["expected"]["engine"] = freplay.replay_engine(art)
+    path = str(tmp_path / "kv-stream-counterexample.json")
+    freplay.dump_artifact(path, art)
+    ok, got = freplay.check_engine(freplay.load_artifact(path))
+    assert ok, got
+
+
+# ---------------------------------------------------------------------------
+# heavy arms: the 2-shard subprocess fleet forms
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_kv_fleet_clean_run_serves_all_grades():
+    """The acceptance form: >= 1k mixed ops against a 2-shard
+    process-per-shard fleet — zero checker violations, all three grades
+    engaged, lease reads an order cheaper than lin reads, accounting
+    clean end to end."""
+    from round_tpu.apps.kv import run_kv_bench
+
+    rep = run_kv_bench(shards=2, n=3, lanes=16, rate=150.0, ops=1000,
+                       payload_bytes=256, timeout_ms=150, seed=3,
+                       deadline_s=240.0)
+    assert rep["lin_ok"], rep["violations"]
+    assert rep["checked_ops"] >= 1000
+    assert rep["shed_accounting_ok"]
+    ol = rep["open_loop"]
+    assert ol["give_ups"] == 0
+    g = ol["read_grades"]
+    assert all(g[name]["count"] > 0 for name in ("lin", "lease", "stale"))
+    assert ol["lease_served"] > 0
+    assert g["lease"]["p50_ms"] * 5 <= g["lin"]["p50_ms"]
+    for srv in rep["servers"].values():
+        assert srv["kv"]["enabled"] and srv["kv"]["applied"] > 0
+
+
+@pytest.mark.slow
+def test_kv_fleet_broken_lease_is_caught_with_artifact(tmp_path):
+    """The injected stale-lease fixture on a real fleet: the lease
+    replica freezes answers, the checker CATCHES it, and the banked
+    artifact replays to the same verdict."""
+    from round_tpu.apps.kv import run_kv_bench
+
+    rep = run_kv_bench(shards=2, n=3, lanes=16, rate=100.0, ops=400,
+                       payload_bytes=256, timeout_ms=150, seed=7,
+                       keys=16, grade_mix=(0.2, 0.6, 0.2),
+                       broken_lease=True, dump_dir=str(tmp_path),
+                       deadline_s=240.0)
+    assert not rep["lin_ok"]
+    assert any(v["kind"] in ("non-linearizable", "stale-read-uncommitted")
+               for v in rep["violations"])
+    assert rep["artifact"] and os.path.exists(rep["artifact"])
+    replayed = klin.replay_artifact(rep["artifact"])
+    assert replayed["matches_expected"]

@@ -337,7 +337,7 @@ def test_native_send_pause_bounds_pump_path_redials():
 
 def _lanes_cluster(n, instances, admissions=None, healths=None,
                    lanes=2, lanes_by=None, timeout_ms=400, seed=11,
-                   max_rounds=24):
+                   max_rounds=24, linger_ms=0):
     ports = alloc_ports(n)
     peers = {i: ("127.0.0.1", ports[i]) for i in range(n)}
     results, stats, errors = {}, {i: {} for i in range(n)}, {}
@@ -350,7 +350,7 @@ def _lanes_cluster(n, instances, admissions=None, healths=None,
                 lanes=(lanes_by or {}).get(i, lanes),
                 timeout_ms=timeout_ms, seed=seed,
                 value_schedule="uniform", max_rounds=max_rounds,
-                stats_out=stats[i],
+                linger_ms=linger_ms, stats_out=stats[i],
                 admission=(admissions or {}).get(i),
                 health=(healths or {}).get(i))
         except BaseException as e:  # noqa: BLE001
@@ -387,8 +387,19 @@ def test_lane_driver_sheds_with_full_nack_accounting():
     seen = METRICS.counter("overload.nacks_seen")
     base = (sent.value, supp.value, frames.value, seen.value)
     ac = AdmissionControl(high_bytes_per_lane=1, shed_deadline_ms=1)
+    # linger_ms: under this overload shape an instance's deciding
+    # quorum is sometimes {0,1,2} while the fourth replica's lane sits
+    # round-skewed — the trio then finishes ITS schedule in
+    # milliseconds and, without the linger, closed its sockets while
+    # the straggler retransmitted into the void until max_rounds
+    # burned (~1-in-10: a polite replica returned None on an instance
+    # the others decided).  The linger keeps the decision-reply path
+    # alive for an idle window, so the straggler adopts within one
+    # retransmission; a REAL wedge still fails through
+    # _lanes_cluster's 240 s join timeout.
     results, stats = _lanes_cluster(4, 8, admissions={0: ac},
-                                    lanes_by={0: 1}, lanes=4)
+                                    lanes_by={0: 1}, lanes=4,
+                                    linger_ms=3000)
     d_sent = sent.value - base[0]
     d_supp = supp.value - base[1]
     d_frames = frames.value - base[2]

@@ -1005,6 +1005,79 @@ def check_host_fleet(rng, it):
     return cfg
 
 
+def check_host_kv(rng, it):
+    """The host-kv rotation rung (ISSUE 18): the replicated KV store
+    (round_tpu/kv, docs/KV.md) under its YCSB-style mixed workload on a
+    2-shard fleet — a 90/10 read-heavy arm and a 50/50 write-heavy arm,
+    both at zipf key skew, both gated on:
+
+      * ZERO kv/lin.py violations over the complete banked client
+        history (the serving contract, checked post-hoc — a hit banks a
+        replayable kv-lin artifact before this rung fails);
+      * lease-read ENGAGEMENT: the lease grade actually served reads
+        (a store that silently falls back to lin on every lease read
+        passes latency gates while the lease plane is dead);
+      * the fleet shed/NACK accounting invariant + zero router give-ups
+        (the host-fleet rung's end-to-end discipline, kv verbs
+        included).
+
+    Banked per arm: achieved dps AND ops/s, per-grade read p50/p99, and
+    the lease-vs-lin p50 ratio — the acceptance trajectory (lease >= 5x
+    cheaper) the soak log monitors for drift.  ~1-2 min per iteration
+    (two fleets incl. subprocess startup)."""
+    from round_tpu.apps.kv import run_kv_bench
+
+    seed = int(rng.integers(0, 2**31))
+    kw = dict(shards=2, n=3, lanes=16, payload_bytes=256,
+              timeout_ms=150, seed=seed, keys=48, key_skew=0.8,
+              grade_mix=(0.25, 0.45, 0.3), warmup=4, deadline_s=240.0,
+              idle_ms=2500)
+    arms = {}
+    cfg = dict(kind="host-kv", it=it, seed=seed, arms=arms)
+    for name, read_frac, rate, ops in (("r90", 0.9, 120.0, 360),
+                                       ("r50", 0.5, 40.0, 120)):
+        rep = run_kv_bench(rate=rate, ops=ops, read_frac=read_frac, **kw)
+        ol = rep["open_loop"]
+        g = ol["read_grades"]
+        lin_p50 = g["lin"]["p50_ms"]
+        lease_p50 = g["lease"]["p50_ms"]
+        arms[name] = dict(
+            read_frac=read_frac, offered_rate=rate, ops=ops,
+            completed=ol["completed"], writes_decided=ol["writes_decided"],
+            achieved_dps=ol["achieved_dps"], achieved_ops=ol["achieved_ops"],
+            write_p50_ms=ol["write_p50_ms"], write_p99_ms=ol["write_p99_ms"],
+            read_grades=g, lease_served=ol["lease_served"],
+            lease_fallbacks=ol["lease_fallbacks"],
+            lease_vs_lin_p50=(round(lin_p50 / lease_p50, 2)
+                              if lin_p50 and lease_p50 else None),
+            checked_ops=rep["checked_ops"], violations=rep["violations"],
+            give_ups=ol["give_ups"], nack_retries=ol["nack_retries"],
+            shed_frames=rep["shed_frames"],
+            nacks_accounted=rep["nacks_accounted"],
+            servers=rep["servers"])
+        if rep["violations"]:
+            return {**cfg, "fail": f"{name}: linearizability violation(s) "
+                                   f"in the banked history — artifact at "
+                                   f"{rep.get('artifact')}"}
+        if not rep["shed_accounting_ok"]:
+            return {**cfg, "fail": f"{name}: shed accounting broken "
+                                   f"through the router (kv verbs "
+                                   f"included): shed_frames != nacks"}
+        if ol["give_ups"] > 0:
+            return {**cfg, "fail": f"{name}: router gave up on "
+                                   f"{ol['give_ups']} instance(s)"}
+        if ol["lease_served"] <= 0:
+            return {**cfg, "fail": f"{name}: lease grade never served a "
+                                   f"read ({ol['lease_fallbacks']} "
+                                   f"fallbacks) — the lease plane is "
+                                   f"dead, not fast"}
+        if ol["completed"] < 0.9 * ol["issued"]:
+            return {**cfg, "fail": f"{name}: store fell behind: "
+                                   f"{ol['completed']}/{ol['issued']} "
+                                   f"ops completed"}
+    return cfg
+
+
 #: the verify-param rung's suite subset: the two parameterized
 #: threshold-automaton suites plus enough fixed-spec suites that the
 #: federated --jobs dispatch has real work to overlap on 2 vCPUs
@@ -1279,7 +1352,7 @@ def main():
                 lambda r, i: check_host_perf(r, i, payload=True),
                 check_fuzz, check_verify_param, check_host_overload,
                 check_host_fleet, check_host_rv, check_byz_crosscheck,
-                check_multichip_ici, check_host_snap]
+                check_multichip_ici, check_host_snap, check_host_kv]
     while time.monotonic() < t_end:
         check = rotation[it % len(rotation)]
         t0 = time.perf_counter()
