@@ -12,7 +12,7 @@ as its event loop (apps/loadgen.py kv_open_loop does).
 from __future__ import annotations
 
 import time as _time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from round_tpu.kv import reads as R
 from round_tpu.kv import txn as T
@@ -76,7 +76,10 @@ class KVClient:
         self._seq: Dict[bytes, int] = {}
         self._writes: Dict[int, Dict[str, Any]] = {}
         self._reads: Dict[int, _PendingRead] = {}
-        self._rid16: Dict[int, int] = {}
+        # 16-bit NACK-correlation tag -> the rids sharing it: rids
+        # alias mod 65536 on the wire (reads.read_tag), so one tag can
+        # cover several in-flight reads on a long run
+        self._rid16: Dict[int, Set[int]] = {}
         self._rid = 1
         self._txn = 1
         self.lease_served = 0
@@ -116,11 +119,15 @@ class KVClient:
     # -- reads -------------------------------------------------------------
 
     def read(self, key: bytes, grade: int,
-             internal: bool = False) -> Optional[int]:
+             internal: bool = False,
+             shard: Optional[str] = None) -> Optional[int]:
         """One read at ``grade``; stale completes INLINE (zero wire
         traffic) and returns None, lease/lin return a read id that
         resolves through ``pump``.  ``internal`` reads (the 2PC vote
-        reads) stay out of the banked history."""
+        reads) stay out of the banked history.  ``shard`` overrides the
+        ring's key->shard routing — the vote reads need it: a txn's
+        vote key is replicated state on EACH participant shard, not on
+        the shard the key itself would hash to."""
         t0 = _time.monotonic()
         if grade == R.GRADE_STALE:
             seq, val = R.local_stale_read(self.mirror, key)
@@ -133,12 +140,13 @@ class KVClient:
             return None
         rid = self._rid
         self._rid += 1
-        shard = self.router.ring.owner_key(key)
+        if shard is None:
+            shard = self.router.ring.owner_key(key)
         mode = "lease" if grade == R.GRADE_LEASE else "lin"
         pr = _PendingRead(rid, key, R.GRADE_NAMES[grade], mode, shard, t0)
         pr.internal = internal
         self._reads[rid] = pr
-        self._rid16[R.read_tag(rid).instance] = rid
+        self._rid16.setdefault(R.read_tag(rid).instance, set()).add(rid)
         self._send_read(pr)
         return rid
 
@@ -160,7 +168,14 @@ class KVClient:
     def _complete_read(self, pr: _PendingRead, ok: bool,
                        seq: int = 0, val: bytes = b"") -> None:
         self._reads.pop(pr.rid, None)
-        self._rid16.pop(R.read_tag(pr.rid).instance, None)
+        iid = R.read_tag(pr.rid).instance
+        tagged = self._rid16.get(iid)
+        if tagged is not None:
+            # drop only THIS rid from the shared 16-bit slot: an
+            # aliased read still in flight keeps its NACK correlation
+            tagged.discard(pr.rid)
+            if not tagged:
+                del self._rid16[iid]
         t1 = _time.monotonic()
         pr.result = (ok, seq, val)
         if pr.internal:
@@ -205,18 +220,22 @@ class KVClient:
             self._complete_read(pr, True, seq, val)
 
     def _on_read_nack(self, shard: str, iid: int) -> None:
-        rid = self._rid16.get(iid)
-        pr = self._reads.get(rid) if rid is not None else None
-        if pr is None:
-            return
-        if pr.attempts >= self.read_give_up:
-            self.read_give_ups += 1
-            _C_READ_GIVE_UPS.inc()
-            self._complete_read(pr, False)
-            return
-        _C_READ_RETRIES.inc()
-        backoff = min(self.read_backoff_ms * (2.0 ** pr.attempts), 1000.0)
-        pr.next_retry = _time.monotonic() + backoff / 1000.0
+        # the 16-bit tag may cover several aliased in-flight reads;
+        # back off every one targeting the shedding shard (they would
+        # all be shed the same way)
+        for rid in list(self._rid16.get(iid, ())):
+            pr = self._reads.get(rid)
+            if pr is None or pr.shard != shard:
+                continue
+            if pr.attempts >= self.read_give_up:
+                self.read_give_ups += 1
+                _C_READ_GIVE_UPS.inc()
+                self._complete_read(pr, False)
+                continue
+            _C_READ_RETRIES.inc()
+            backoff = min(self.read_backoff_ms * (2.0 ** pr.attempts),
+                          1000.0)
+            pr.next_retry = _time.monotonic() + backoff / 1000.0
 
     # -- the event loop ----------------------------------------------------
 
@@ -267,11 +286,12 @@ class KVClient:
             self.pump(20)
         return all(self.router.results.get(i) is not None for i in insts)
 
-    def _read_blocking(self, key: bytes, grade: int,
-                       deadline_s: float) -> Optional[Tuple[int, bytes]]:
+    def _read_blocking(self, key: bytes, grade: int, deadline_s: float,
+                       shard: Optional[str] = None,
+                       ) -> Optional[Tuple[int, bytes]]:
         """A blocking INTERNAL read (the 2PC vote reads): never banked
         in the client history."""
-        rid = self.read(key, grade, internal=True)
+        rid = self.read(key, grade, internal=True, shard=shard)
         pr = self._reads[rid]
         t_end = _time.monotonic() + deadline_s
         while pr.result is None and _time.monotonic() < t_end:
@@ -326,9 +346,13 @@ class KVClient:
         prepared = self._wait_insts(prep, deadline_s)
         votes = []
         if prepared:
-            for _shard in by_shard:
+            # each PARTICIPANT holds its own replicated vote under the
+            # same reserved key: read it from every participant shard
+            # (the ring would route the vote key to one fixed shard)
+            for shard in by_shard:
                 ans = self._read_blocking(T.vote_key(txn_id),
-                                          R.GRADE_LIN, deadline_s)
+                                          R.GRADE_LIN, deadline_s,
+                                          shard=shard)
                 votes.append(ans is not None and ans[1] == b"y")
         commit = prepared and bool(votes) and T.tpc_decide(votes)
         out_op = OP_COMMIT if commit else OP_ABORT

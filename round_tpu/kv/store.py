@@ -152,13 +152,18 @@ class KVState:
 
     Each write is its own consensus instance, and instances COMPLETE in
     different orders on different replicas (lanes run concurrently), so
-    the register fold must be commutative: a pair lands only when its
-    seq is >= the stored seq (seq-LWW).  Replicas then converge to the
-    max decided seq per key whatever their local completion interleave
-    — the divergence a last-apply-wins fold develops under concurrent
+    the register fold must be commutative: a pair lands only when it
+    WINS the stored pair under a total order — seq first, value digest
+    (then raw value) breaking seq ties.  Replicas then converge to one
+    winner per key whatever their local completion interleave — the
+    divergence a last-apply-wins fold develops under concurrent
     same-key writes is exactly the non-linearizable lease/lin split the
-    kv/lin.py checker caught in soak.  Client seqs are per-key
-    monotonic, so seq order IS the single writer's program order."""
+    kv/lin.py checker caught in soak.  The tie-break matters the moment
+    TWO clients write one key: each allocates seqs from its own per-key
+    counter, so equal seqs with different values are a normal race, and
+    '>= stored seq' alone would let apply order (per-replica!) pick the
+    survivor.  Within one client, seqs are per-key monotonic, so seq
+    order IS that writer's program order."""
 
     def __init__(self):
         self.data: Dict[bytes, Tuple[int, bytes]] = {}
@@ -179,10 +184,26 @@ class KVState:
             return (txn, b"y" if t["vote"] else b"n")
         return self.data.get(key, (0, b""))
 
+    @staticmethod
+    def _wins(seq: int, value: bytes, cur: Tuple[int, bytes]) -> bool:
+        """The fold's total order: higher seq wins; equal seqs (two
+        clients' independent counters colliding on one key) break by
+        value digest then raw value — stable across replicas, so every
+        apply interleave converges to the SAME survivor.  The array
+        rider folds the same order over its digest table."""
+        cseq, cval = cur
+        if seq != cseq:
+            return seq > cseq
+        if value == cval:
+            return True  # re-applying the stored pair is a no-op
+        return ((value_digest(value), value)
+                > (value_digest(cval), cval))
+
     def _put_all(self, pairs) -> None:
         for seq, key, value in pairs:
-            if int(seq) >= self.data.get(key, (0, b""))[0]:
-                self.data[key] = (int(seq), bytes(value))
+            seq, value = int(seq), bytes(value)
+            if self._wins(seq, value, self.data.get(key, (0, b""))):
+                self.data[key] = (seq, value)
 
     def apply(self, rec: Dict[str, Any]) -> None:
         """Fold one decided record, in decision order."""
@@ -271,14 +292,22 @@ class KVShard:
         self.reads_lease = 0
         self.reads_stale = 0
         self.lease_refused = 0
+        self.lease_barrier = 0
         self.txn_frames = 0
 
     # -- write path --------------------------------------------------------
 
     def note_propose(self, iid: int, row) -> None:
         rec = decode_record(row)
-        if rec is not None:
-            self.pending[iid] = {k for _s, k, _v in rec["pairs"]}
+        if rec is None:
+            return
+        keys = {k for _s, k, _v in rec["pairs"]}
+        if rec["op"] == OP_PREPARE:
+            # the vote materializes when the prepare APPLIES: the
+            # coordinator's linearizable vote read must wait behind it
+            keys.add(TXN_VOTE_PREFIX
+                     + int(rec["txn"]).to_bytes(4, "big"))
+        self.pending[iid] = keys
 
     def is_txn_record(self, row) -> bool:
         rec = decode_record(row)
@@ -327,6 +356,14 @@ class KVShard:
         if not self.lease.valid():
             self.lease_refused += 1
             return None
+        if self.barrier_for(key):
+            # a seen-but-unapplied write touches the key: its client
+            # may already hold an ack through another replica's
+            # decision stream, so the applied value here could miss it
+            # — refuse, the client re-runs as lin behind the barrier
+            self.lease_refused += 1
+            self.lease_barrier += 1
+            return None
         return self.state.get(key)
 
     def fill_stats(self, stats_out: Optional[Dict[str, Any]]) -> None:
@@ -337,6 +374,7 @@ class KVShard:
                      ("kv_reads_lease", self.reads_lease),
                      ("kv_reads_stale", self.reads_stale),
                      ("kv_lease_refused", self.lease_refused),
+                     ("kv_lease_barrier", self.lease_barrier),
                      ("kv_lease_grants", self.lease.grants),
                      ("kv_txn_frames", self.txn_frames),
                      ("kv_txn_commits", self.state.txn_commits),
@@ -369,9 +407,14 @@ def kv_array_apply(state, cmd):
            | cmd[_HDR + 1].astype(jnp.int32) << 8
            | cmd[_HDR + 2].astype(jnp.int32) << 16
            | cmd[_HDR + 3].astype(jnp.int32) << 24)
-    # seq-LWW like KVState._put_all: instance completion order differs
-    # per replica, so the fold must be commutative to converge
-    win = is_put & (seq >= seqs[kidx])
+    # the same total order as KVState._wins: instance completion order
+    # differs per replica, so the fold must be commutative to converge
+    # — seq first, digest breaking seq ties (the raw-value tail of the
+    # host tie-break only matters under a u32 digest collision, where
+    # the (seq, digest) table is identical either way)
+    cur_seq, cur_dig = seqs[kidx], digs[kidx]
+    win = is_put & ((seq > cur_seq)
+                    | ((seq == cur_seq) & (dig >= cur_dig)))
     seqs = jnp.where(win, seqs.at[kidx].set(seq), seqs)
     digs = jnp.where(win, digs.at[kidx].set(dig), digs)
     return (seqs, digs)

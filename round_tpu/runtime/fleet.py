@@ -786,6 +786,8 @@ class DriverServer:
                                for st in self.stats),
             "lease_refused": sum(st.get("kv_lease_refused", 0)
                                  for st in self.stats),
+            "lease_barrier": sum(st.get("kv_lease_barrier", 0)
+                                 for st in self.stats),
             "lease_grants": sum(st.get("kv_lease_grants", 0)
                                 for st in self.stats),
             "txn_frames": sum(st.get("kv_txn_frames", 0)

@@ -120,6 +120,27 @@ def test_kvstate_register_converges_under_reordered_apply():
     assert set(states) == {(5, b"v5")}
 
 
+def test_kvstate_equal_seqs_converge_across_apply_orders():
+    """TWO clients writing one key allocate seqs from independent
+    per-client counters, so equal seqs with different values are a
+    normal race — and apply order differs per replica.  The fold's
+    tie-break (value digest, then raw value) must pick the SAME
+    survivor under every completion interleave."""
+    import itertools
+
+    pairs = [(1, b"k", b"cA1"), (1, b"k", b"cB1"),
+             (2, b"k", b"cA2"), (2, b"k", b"cB2")]
+    outcomes = set()
+    for perm in itertools.permutations(pairs):
+        st = KVState()
+        for p in perm:
+            st.apply(_rec(OP_PUT, [p]))
+        outcomes.add(st.get(b"k"))
+    assert len(outcomes) == 1
+    seq, val = outcomes.pop()
+    assert seq == 2 and val in (b"cA2", b"cB2")
+
+
 def test_kvstate_prepare_votes_are_deterministic_lock_conflicts():
     st = KVState()
     st.apply(_rec(OP_PREPARE, [(1, b"k", b"v1")], txn=1))
@@ -174,6 +195,10 @@ def test_kv_array_rider_matches_host_state():
     rows = [encode_record(OP_PUT, [(s, f"k{i}".encode(), b"v" * (i + 1))],
                           B, keyspace=keyspace)
             for s, i in ((1, 0), (1, 1), (2, 0), (1, 2), (1, 0))]
+    # an equal-seq different-value pair: both sides must break the tie
+    # the same way (value digest)
+    rows.insert(3, encode_record(OP_PUT, [(2, b"k0", b"tie")], B,
+                                 keyspace=keyspace))
     # a non-PUT and a non-record row must be no-ops for the rider
     rows.append(encode_record(OP_PREPARE, [(1, b"k0", b"z")], B,
                               txn=7, keyspace=keyspace))
@@ -213,6 +238,42 @@ def test_lease_refuses_when_staleness_bound_starves():
     shard.lease.revoke()
     shard.lease.note_quorum()
     assert shard.lease_answer(b"k") is None
+
+
+def test_lease_refuses_behind_pending_write_barrier():
+    """A write SEEN but not yet applied here may already be acked
+    through another replica's decision stream — a lease answer from
+    applied state would miss it (read-your-writes breach), so the
+    lease must refuse and send the client down the lin barrier path."""
+    shard = KVShard(KvConfig(lease_ms=50.0), node=0, n=3, timeout_ms=25)
+    shard.state.apply(_rec(OP_PUT, [(1, b"k", b"old")]))
+    shard.lease.note_quorum()
+    assert shard.lease_answer(b"k") == (1, b"old")
+    row = encode_record(OP_PUT, [(2, b"k", b"new")], B)
+    shard.note_propose(9, row)
+    assert shard.lease_answer(b"k") is None
+    assert shard.lease_barrier == 1
+    # a key the pending write does not touch still serves locally
+    shard.state.apply(_rec(OP_PUT, [(1, b"other", b"x")]))
+    shard.lease.note_quorum()
+    assert shard.lease_answer(b"other") == (1, b"x")
+    # the apply releases the barrier and the fresh value serves
+    shard.on_decision(9, True, row)
+    shard.lease.note_quorum()
+    assert shard.lease_answer(b"k") == (2, b"new")
+
+
+def test_prepare_barrier_covers_the_vote_key():
+    """The coordinator's linearizable vote read must wait behind the
+    prepare whose apply materializes the vote."""
+    shard = KVShard(KvConfig(lease_ms=50.0), node=0, n=3, timeout_ms=25)
+    row = encode_record(OP_PREPARE, [(1, b"k", b"v")], B, txn=6)
+    shard.note_propose(4, row)
+    assert shard.barrier_for(ktxn.vote_key(6)) == {4}
+    assert shard.barrier_for(b"k") == {4}
+    shard.on_decision(4, True, row)
+    assert shard.barrier_for(ktxn.vote_key(6)) == set()
+    assert shard.answer(ktxn.vote_key(6)) == (6, b"y")
 
 
 def test_broken_lease_fixture_freezes_and_never_refuses():
@@ -437,6 +498,71 @@ def test_stale_read_is_wire_free():
     assert seen == [(3, b"banked".hex()), (0, "")]
 
 
+class _FakeReadRouter:
+    """Just enough router for client-side read bookkeeping tests:
+    records sends, never answers."""
+
+    class _Ring:
+        def owner_key(self, key):
+            return "s0"
+
+    def __init__(self):
+        self.ring = self._Ring()
+        self.results = {}
+        self.sent = []
+
+    def shard_n(self, shard):
+        return 3
+
+    def send_read(self, shard, replica, rid, payload):
+        self.sent.append((shard, replica, rid))
+
+    def pump(self, timeout_ms=0):
+        return 0
+
+
+def test_read_nack_correlation_survives_rid16_aliasing():
+    """Read ids alias mod 65536 on the wire tag; completing one read
+    of an aliased pair must NOT strand the other without its fast-NACK
+    backoff (the long-bench regression: >65k reads per client)."""
+    from round_tpu.kv.client import KVClient
+
+    cl = KVClient(_FakeReadRouter(), payload_bytes=B)
+    r1 = cl.read(b"k1", R.GRADE_LIN)
+    cl._rid = r1 + 65536
+    r2 = cl.read(b"k2", R.GRADE_LIN)
+    assert R.read_tag(r1).instance == R.read_tag(r2).instance
+    iid = R.read_tag(r1).instance
+    # completing the first must keep the aliased second correlated
+    cl._complete_read(cl._reads[r1], True, 1, b"v")
+    cl._on_read_nack("s0", iid)
+    assert cl._reads[r2].next_retry > 0      # fast backoff engaged
+    # completing the second clears the shared slot entirely
+    cl._complete_read(cl._reads[r2], False)
+    assert iid not in cl._rid16
+    # rid 65536 maps to tag 1 (zero instance ids are reserved): both
+    # still correlate
+    cl._rid = 65536
+    r3 = cl.read(b"k3", R.GRADE_LIN)
+    assert R.read_tag(r3).instance == 1
+    cl._on_read_nack("s0", 1)
+    assert cl._reads[r3].next_retry > 0
+
+
+def test_nack_backoff_only_touches_the_shedding_shard():
+    """Aliased reads against DIFFERENT shards: a NACK from one shard
+    must not back off the other shard's read."""
+    from round_tpu.kv.client import KVClient
+
+    cl = KVClient(_FakeReadRouter(), payload_bytes=B)
+    r1 = cl.read(b"k1", R.GRADE_LIN, shard="s0")
+    cl._rid = r1 + 65536
+    r2 = cl.read(b"k2", R.GRADE_LIN, shard="s1")
+    cl._on_read_nack("s1", R.read_tag(r1).instance)
+    assert cl._reads[r1].next_retry == 0.0
+    assert cl._reads[r2].next_retry > 0
+
+
 def test_single_shard_txn_commits_atomically(kv_cluster):
     srv, router, cl = kv_cluster
     res = cl.txn({b"txn-a": b"1", b"txn-b": b"2"}, deadline_s=30.0)
@@ -444,6 +570,96 @@ def test_single_shard_txn_commits_atomically(kv_cluster):
     for key, val in ((b"txn-a", b"1"), (b"txn-b", b"2")):
         cl.read(key, R.GRADE_LIN)
         assert cl.drain(20.0)
+        assert cl.history[-1]["res_val"] == val.hex()
+    assert klin.check_history(cl.history) == []
+
+
+@pytest.fixture(scope="module")
+def kv_cluster2():
+    """A TWO-shard in-process cluster: the cross-shard 2PC arm (each
+    shard its own DriverServer, one router ring over both)."""
+    from round_tpu.kv.client import KVClient
+    from round_tpu.models.lastvoting import LastVotingBytes
+    from round_tpu.runtime.fleet import DriverServer, FleetRouter
+
+    srvs = [DriverServer(LastVotingBytes(payload_bytes=B), n=3, lanes=8,
+                         timeout_ms=150, idle_ms=60_000, max_ms=120_000,
+                         kv=KvConfig()) for _ in range(2)]
+    for s in srvs:
+        s.start()
+    router = FleetRouter(proto="tcp")
+    for i, s in enumerate(srvs):
+        router.add_shard(f"s{i}", s.replicas)
+    cl = KVClient(router, payload_bytes=B)
+    yield srvs, router, cl
+    router.close()
+    for s in srvs:
+        s.stop()
+        s.join(30.0)
+
+
+def _key_on(ring, shard: str, prefix: str) -> bytes:
+    for i in range(4096):
+        k = f"{prefix}{i}".encode()
+        if ring.owner_key(k) == shard:
+            return k
+    raise AssertionError(f"no {prefix}* key hashes to {shard}")
+
+
+def test_cross_shard_txn_commits_end_to_end(kv_cluster2):
+    """The 2PC happy path on a real two-shard fleet: participants on
+    BOTH shards vote yes (each vote read from ITS shard — the vote key
+    is replicated per participant, not ring-routed), the TPC fold
+    commits, and both keys serve the transaction's values."""
+    srvs, router, cl = kv_cluster2
+    ka = _key_on(router.ring, "s0", "xa")
+    kb = _key_on(router.ring, "s1", "xb")
+    res = cl.txn({ka: b"A1", kb: b"B1"}, deadline_s=60.0)
+    assert res["committed"] and res["shards"] == 2
+    for key, val in ((ka, b"A1"), (kb, b"B1")):
+        cl.read(key, R.GRADE_LIN)
+        assert cl.drain(30.0)
+        assert cl.history[-1]["ok"]
+        assert cl.history[-1]["res_val"] == val.hex()
+    assert klin.check_history(cl.history) == []
+
+
+def test_cross_shard_txn_conflicting_prepare_aborts_atomically(
+        kv_cluster2):
+    """The regression arm for the vote-read routing bug: a conflicting
+    prepare holds one participant's lock, so that shard votes NO while
+    the other votes YES — the coordinator must collect BOTH votes (one
+    per participant shard) and abort everywhere; a commit here would
+    silently drop the no-voter's buffered pairs."""
+    srvs, router, cl = kv_cluster2
+    ka = _key_on(router.ring, "s0", "ya")
+    kb = _key_on(router.ring, "s1", "yb")
+    res = cl.txn({ka: b"A1", kb: b"B1"}, deadline_s=60.0)
+    assert res["committed"]
+
+    blocker = 9001
+    prep = encode_record(OP_PREPARE, [(99, ka, b"blk")], B, txn=blocker)
+    inst = cl._alloc_inst()
+    router.propose(inst, prep, shard="s0", txn=True)
+    assert cl._wait_insts([inst], 30.0)
+    res2 = cl.txn({ka: b"A2", kb: b"B2"}, deadline_s=60.0)
+    assert not res2["committed"]
+    # atomic abort: NEITHER side leaked its buffered pair
+    for key, val in ((ka, b"A1"), (kb, b"B1")):
+        cl.read(key, R.GRADE_LIN)
+        assert cl.drain(30.0)
+        assert cl.history[-1]["res_val"] == val.hex()
+    # release the blocker: the abort left no locks behind, so a retry
+    # of the same write set commits
+    ab = encode_record(OP_ABORT, [(99, ka, b"")], B, txn=blocker)
+    inst = cl._alloc_inst()
+    router.propose(inst, ab, shard="s0", txn=True)
+    assert cl._wait_insts([inst], 30.0)
+    res3 = cl.txn({ka: b"A3", kb: b"B3"}, deadline_s=60.0)
+    assert res3["committed"]
+    for key, val in ((ka, b"A3"), (kb, b"B3")):
+        cl.read(key, R.GRADE_LIN)
+        assert cl.drain(30.0)
         assert cl.history[-1]["res_val"] == val.hex()
     assert klin.check_history(cl.history) == []
 
