@@ -10,7 +10,14 @@ AST passes, producing typed findings across six rule families:
   comm-closure, tpu-lowerability, recompile-hazard, purity,
   spec-coherence, threshold-extractable
 
-CLI: ``python -m round_tpu.apps.lint [--all|MODEL] [--json] [--baseline …]``
+runtimelint.py extends the gate to the SERVING tier (``--runtime``) with
+five more families over runtime/, kv/, obs/ and native/transport.cpp:
+
+  lock-discipline, wire-coherence, fold-determinism,
+  counter-accounting, obs-vocab
+
+CLI: ``python -m round_tpu.apps.lint [--all|MODEL] [--runtime]
+[--check-docs] [--json] [--baseline …]``
 Catalog + suppression workflow: docs/ANALYSIS.md.
 """
 
@@ -20,6 +27,7 @@ from round_tpu.analysis.findings import (
     Suppression,
     apply_baseline,
     default_baseline_path,
+    default_runtime_baseline_path,
     load_baseline,
 )
 from round_tpu.analysis.lint import lint_all, lint_model
@@ -31,6 +39,7 @@ __all__ = [
     "Suppression",
     "apply_baseline",
     "default_baseline_path",
+    "default_runtime_baseline_path",
     "load_baseline",
     "lint_all",
     "lint_model",

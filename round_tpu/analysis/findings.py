@@ -24,7 +24,10 @@ from typing import Iterable, List, Optional, Sequence, Tuple
 #: (exit nonzero) unless baselined.
 SEVERITIES = ("error", "warn")
 
-#: the rule families the gate covers (docs/ANALYSIS.md catalog)
+#: the rule families the gate covers (docs/ANALYSIS.md catalog).
+#: The first six lint model-layer round/spec code (PR 4 / PR 9); the
+#: last five are the runtime families (runtimelint.py): the serving
+#: tier — locks, wire constants, SMR folds, and the obs vocabulary.
 FAMILIES = (
     "comm-closure",
     "tpu-lowerability",
@@ -32,6 +35,11 @@ FAMILIES = (
     "purity",
     "spec-coherence",
     "threshold-extractable",
+    "lock-discipline",
+    "wire-coherence",
+    "fold-determinism",
+    "counter-accounting",
+    "obs-vocab",
 )
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -91,12 +99,15 @@ class Finding:
 
 @dataclasses.dataclass(frozen=True)
 class Suppression:
-    """One baseline entry: (model, rule, file) + a mandatory reason."""
+    """One baseline entry: (model, rule, file) + a mandatory reason.
+    ``since`` names the PR that added the entry, so baseline archaeology
+    does not need git blame."""
 
     model: str
     rule: str
     file: str
     reason: str
+    since: str = ""
 
     def matches(self, f: Finding) -> bool:
         return (
@@ -104,6 +115,10 @@ class Suppression:
             and self.rule == f.rule
             and (f.file == self.file or f.file.endswith(self.file))
         )
+
+    def render(self) -> str:
+        since = f" [since {self.since}]" if self.since else ""
+        return f"{self.model} {self.rule} {self.file}{since}"
 
 
 class BaselineError(ValueError):
@@ -113,6 +128,15 @@ class BaselineError(ValueError):
 def default_baseline_path() -> str:
     return os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "baseline.json")
+
+
+def default_runtime_baseline_path() -> str:
+    """The runtime sweep's suppression file.  Separate from the model
+    baseline so each gate's stale-entry report stays exact (a model-only
+    lint cannot tell whether a runtime entry still matches anything,
+    and vice versa)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "runtime_baseline.json")
 
 
 def load_baseline(path: Optional[str] = None) -> List[Suppression]:
@@ -133,7 +157,8 @@ def load_baseline(path: Optional[str] = None) -> List[Suppression]:
                 f"baseline entry needs a model, a rule id, a file and a "
                 f"non-empty reason string"
             )
-        out.append(Suppression(e["model"], e["rule"], e["file"], e["reason"]))
+        out.append(Suppression(e["model"], e["rule"], e["file"], e["reason"],
+                               e.get("since", "")))
     return out
 
 

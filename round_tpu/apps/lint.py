@@ -6,6 +6,9 @@ Usage:
     python -m round_tpu.apps.lint --all --json          # machine output
     python -m round_tpu.apps.lint --all --baseline round_tpu/analysis/baseline.json
     python -m round_tpu.apps.lint --list                # registry contents
+    python -m round_tpu.apps.lint --runtime --all       # serving-tier sweep
+    python -m round_tpu.apps.lint --check-docs          # obs-vocab drift only
+    python -m round_tpu.apps.lint --runtime --fixtures  # broken corpus
 
 Exit status: 0 when every finding is baselined (or none exist), 1 when any
 non-baselined finding remains, 2 on usage errors.  Rule catalog and the
@@ -42,13 +45,25 @@ def main(argv=None) -> int:
                     help="lint every registered model")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit one JSON document instead of text")
-    ap.add_argument("--baseline", default=analysis.default_baseline_path(),
+    ap.add_argument("--baseline", default=None,
                     help="suppression baseline (JSON; 'none' disables); "
-                         "default: round_tpu/analysis/baseline.json")
+                         "default: round_tpu/analysis/baseline.json, or "
+                         "runtime_baseline.json under --runtime")
     ap.add_argument("--fixtures", action="store_true",
                     help="lint the broken self-test corpus "
-                         "(round_tpu/analysis/fixtures.py) instead of the "
-                         "registry — demo/debugging aid")
+                         "(round_tpu/analysis/fixtures.py, or the "
+                         "runtime_fixtures/ corpus under --runtime) "
+                         "instead of the registry — demo/debugging aid")
+    ap.add_argument("--runtime", action="store_true",
+                    help="run the serving-tier sweep (runtimelint: lock/"
+                         "pump discipline, wire coherence, fold "
+                         "determinism, counter accounting, obs vocab) "
+                         "instead of the model registry")
+    ap.add_argument("--check-docs", action="store_true", dest="check_docs",
+                    help="runtime obs-vocab family only: diff the emitted "
+                         "metric/event vocabulary against "
+                         "docs/OBSERVABILITY.md in both directions "
+                         "(implies --runtime)")
     ap.add_argument("--list", action="store_true", dest="list_models",
                     help="list registered models and exit")
     ns = ap.parse_args(sys.argv[1:] if argv is None else argv)
@@ -61,7 +76,29 @@ def main(argv=None) -> int:
             os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
         return 0
 
-    if ns.fixtures:
+    runtime = ns.runtime or ns.check_docs
+    if ns.check_docs and ns.fixtures:
+        ap.error("--check-docs and --fixtures are mutually exclusive")
+    default_bl = (analysis.default_runtime_baseline_path() if runtime
+                  else analysis.default_baseline_path())
+    bl_path = ns.baseline if ns.baseline is not None else default_bl
+
+    if runtime:
+        from round_tpu.analysis.runtimelint import runtime_lint
+
+        if ns.fixtures:
+            from round_tpu.analysis.runtime_fixtures import RUNTIME_FIXTURES
+
+            findings = []
+            for fx in RUNTIME_FIXTURES:
+                findings.extend(runtime_lint(fx.config, fx.families))
+            baseline = []
+        else:
+            fams = ("obs-vocab",) if ns.check_docs else None
+            findings = runtime_lint(families=fams)
+            baseline = ([] if bl_path in ("none", "")
+                        else analysis.load_baseline(bl_path))
+    elif ns.fixtures:
         from round_tpu.analysis.fixtures import FIXTURES
 
         findings = analysis.lint_all(registry=FIXTURES)
@@ -74,11 +111,15 @@ def main(argv=None) -> int:
         except KeyError as e:
             print(e.args[0], file=sys.stderr)
             return 2
-        baseline = ([] if ns.baseline in ("none", "")
-                    else analysis.load_baseline(ns.baseline))
+        baseline = ([] if bl_path in ("none", "")
+                    else analysis.load_baseline(bl_path))
 
     gating, suppressed, stale = analysis.apply_baseline(findings, baseline)
-    if not (ns.all or ns.fixtures):
+    if ns.check_docs:
+        # a single-family sweep cannot tell which other families' baseline
+        # entries are stale
+        stale = []
+    if not (ns.all or ns.fixtures or runtime):
         # a partial lint cannot tell which OTHER models' entries are stale
         stale = []
 
@@ -99,10 +140,10 @@ def main(argv=None) -> int:
             print(f.render())
         if suppressed:
             print(f"{len(suppressed)} finding(s) suppressed by baseline "
-                  f"({ns.baseline})")
+                  f"({bl_path})")
         for s in stale:
             print(f"note: stale baseline entry matched nothing: "
-                  f"{s.model} {s.rule} {s.file} — remove it", file=sys.stderr)
+                  f"{s.render()} — remove it", file=sys.stderr)
         verdict = "CLEAN" if not gating else f"{len(gating)} gating finding(s)"
         print(verdict)
     return 0 if not gating else 1

@@ -169,22 +169,33 @@ def _load() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        # cross-PROCESS build lock: replicas start concurrently (one OS
-        # process each) and must not race `make` writing the same .so
-        import fcntl
+        # ROUND_TPU_NATIVE_SO points at a prebuilt alternative library
+        # (the sanitizer builds: `make san` -> libroundnet-tsan.so /
+        # libroundnet-asan.so) and skips the default build entirely
+        override = os.environ.get("ROUND_TPU_NATIVE_SO")
+        if override:
+            lib = ctypes.CDLL(override)
+        else:
+            # cross-PROCESS build lock: replicas start concurrently (one
+            # OS process each) and must not race `make` writing the same
+            # .so
+            import fcntl
 
-        os.makedirs(os.path.join(_NATIVE_DIR, "_build"), exist_ok=True)
-        with open(os.path.join(_NATIVE_DIR, "_build", ".lock"), "w") as lk:
-            fcntl.flock(lk, fcntl.LOCK_EX)
-            # build only the transport library: the sat solver binary is an
-            # unrelated target and must not gate (or slow) replica startup
-            subprocess.run(
-                ["make", "-s", "_build/libroundnet.so"], cwd=_NATIVE_DIR,
-                check=True, capture_output=True,
+            os.makedirs(os.path.join(_NATIVE_DIR, "_build"),
+                        exist_ok=True)
+            with open(os.path.join(_NATIVE_DIR, "_build", ".lock"),
+                      "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+                # build only the transport library: the sat solver
+                # binary is an unrelated target and must not gate (or
+                # slow) replica startup
+                subprocess.run(
+                    ["make", "-s", "_build/libroundnet.so"],
+                    cwd=_NATIVE_DIR, check=True, capture_output=True,
+                )
+            lib = ctypes.CDLL(
+                os.path.join(_NATIVE_DIR, "_build", "libroundnet.so")
             )
-        lib = ctypes.CDLL(
-            os.path.join(_NATIVE_DIR, "_build", "libroundnet.so")
-        )
         lib.rt_node_create.restype = ctypes.c_void_p
         lib.rt_node_create.argtypes = [ctypes.c_int, ctypes.c_int]
         lib.rt_node_create_udp.restype = ctypes.c_void_p

@@ -62,6 +62,14 @@ def test_every_family_fires_on_fixtures():
             tfx.THRESHOLD_FIXTURES_BY_NAME["tfix-data-bound"]):
         if f.family == "threshold-extractable":
             found.setdefault(f.family, []).append(f)
+    # the five runtime families fire on the runtime_fixtures/ corpus
+    # (goldens in tests/test_runtimelint.py)
+    from round_tpu.analysis import runtime_fixtures as rfx
+    from round_tpu.analysis.runtimelint import runtime_lint
+
+    for fx in rfx.RUNTIME_FIXTURES:
+        for f in runtime_lint(fx.config, fx.families):
+            found.setdefault(f.family, []).append(f)
     missing = set(analysis.FAMILIES) - set(found)
     assert not missing, f"rule families with no fixture finding: {missing}"
 

@@ -491,46 +491,67 @@ def check_otr_flagship_shape(rng, it):
     return cfg
 
 
-def check_lint(rng, it):
-    """The static-analysis rung: run roundlint's full sweep through the
-    actual CLI (`python -m round_tpu.apps.lint --all --json`) and bank the
-    per-family finding counts — a finding-count regression (or a stale
-    baseline entry) shows up in the SOAK.jsonl trajectory the same way a
-    differential divergence would.  Fast (~10 s: pure CPU abstract
-    tracing, nothing executes)."""
+def _lint_cli(args, cfg, key_prefix=""):
+    """Run one apps.lint invocation, fold its JSON counts into cfg, and
+    return a failure record (or None).  Gating findings and stale
+    baseline entries are both hard failures: a stale suppression is a
+    silently-rotting gate — the finding it documented is gone, so the
+    entry now shadows any FUTURE finding with the same (model, rule,
+    file)."""
     import subprocess
 
     proc = subprocess.run(
-        [sys.executable, "-m", "round_tpu.apps.lint", "--all", "--json"],
+        [sys.executable, "-m", "round_tpu.apps.lint", *args, "--json"],
         capture_output=True, text=True, timeout=300, cwd=REPO,
     )
-    cfg = dict(kind="lint", it=it, exit=proc.returncode)
+    label = " ".join(args)
+    cfg[f"{key_prefix}exit"] = proc.returncode
     try:
         doc = json.loads(proc.stdout)
     except ValueError:
-        return {**cfg, "fail": "lint CLI emitted no JSON",
+        return {**cfg, "fail": f"lint CLI ({label}) emitted no JSON",
                 "stderr": proc.stderr[-300:]}
-    cfg.update(
-        total=doc["total"], gating=doc["gating"],
-        suppressed=len(doc["suppressed"]),
-        stale_baseline=len(doc["stale_baseline"]),
-        by_family=doc["counts_by_family"],
-    )
+    cfg.update({
+        f"{key_prefix}total": doc["total"],
+        f"{key_prefix}gating": doc["gating"],
+        f"{key_prefix}suppressed": len(doc["suppressed"]),
+        f"{key_prefix}stale_baseline": len(doc["stale_baseline"]),
+        f"{key_prefix}by_family": doc["counts_by_family"],
+    })
     if proc.returncode != 0 or doc["gating"]:
         first = doc["findings"][0] if doc["findings"] else {}
         return {**cfg, "fail": f"{doc['gating']} non-baselined lint "
-                               f"finding(s)",
+                               f"finding(s) ({label})",
                 "first": f"{first.get('file')}:{first.get('line')} "
                          f"{first.get('rule')} ({first.get('model')})"}
     if doc["stale_baseline"]:
-        # a stale suppression is a silently-rotting gate: the finding it
-        # documented is gone, so the entry now shadows any FUTURE finding
-        # with the same (model, rule, file).  Hard failure, not a note.
         first = doc["stale_baseline"][0]
         return {**cfg, "fail": f"{len(doc['stale_baseline'])} stale "
-                               f"baseline entr(y/ies) — remove them",
+                               f"baseline entr(y/ies) ({label}) — "
+                               f"remove them",
                 "first": f"{first.get('model')} {first.get('rule')} "
                          f"{first.get('file')}"}
+    return None
+
+
+def check_lint(rng, it):
+    """The static-analysis rung: the model-layer sweep, the runtime
+    sweep (runtimelint: lock/pump discipline, wire coherence, fold
+    determinism, counter accounting) and the obs-vocabulary drift gate
+    (`--check-docs`), all through the actual CLI, with per-family
+    finding counts banked — a finding-count regression, a stale
+    baseline entry, or docs drift shows up in the SOAK.jsonl trajectory
+    the same way a differential divergence would.  Fast (~25 s total:
+    pure CPU abstract tracing + AST sweeps, nothing heavy executes)."""
+    cfg = dict(kind="lint", it=it)
+    for args, prefix in (
+        (["--all"], ""),
+        (["--runtime", "--all"], "runtime_"),
+        (["--check-docs"], "docs_"),
+    ):
+        fail = _lint_cli(args, cfg, prefix)
+        if fail is not None:
+            return fail
     return cfg
 
 
