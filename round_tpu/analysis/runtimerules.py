@@ -1210,6 +1210,7 @@ LOCK_FILES = (
     "round_tpu/runtime/health.py",
     "round_tpu/runtime/view.py",
     "round_tpu/runtime/checkpoint.py",
+    "round_tpu/runtime/control.py",
     "round_tpu/kv/client.py",
     "round_tpu/kv/reads.py",
     "round_tpu/snap/collect.py",
@@ -1275,6 +1276,9 @@ COUNTER_PAIRS = (
     CounterPair("shed accounting",
                 lhs=("overload.shed_frames",),
                 rhs=("overload.nacks_sent", "overload.nacks_suppressed")),
+    CounterPair("tenant shed accounting",
+                lhs=("tenant.shed_frames",),
+                rhs=("tenant.nacks_sent", "tenant.nacks_suppressed")),
 )
 
 #: emission sites whose metric name is computed — each declares its

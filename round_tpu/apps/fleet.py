@@ -51,6 +51,18 @@ def _aggregate_server_stats(stats: List[Dict[str, Any]]) -> Dict[str, int]:
     return out
 
 
+def _parse_tenant_weights(text: Optional[str]
+                          ) -> Optional[Dict[int, float]]:
+    """``"1:1,2:3"`` -> {1: 1.0, 2: 3.0} (the serve-side share table)."""
+    if not text:
+        return None
+    out: Dict[int, float] = {}
+    for pair in text.split(","):
+        t, _, w = pair.partition(":")
+        out[int(t)] = float(w) if w else 1.0
+    return out
+
+
 def serve_main(args) -> int:
     """One shard process: bind the given ports, serve until idle."""
     import jax
@@ -94,7 +106,9 @@ def serve_main(args) -> int:
         admission_bytes_per_lane=args.admission_bytes_per_lane,
         shed_deadline_ms=args.shed_deadline_ms,
         adaptive_cap_ms=args.adaptive_cap_ms, ports=ports, rv=rv,
-        snap=snap, kv=kv)
+        snap=snap, kv=kv,
+        tenants=_parse_tenant_weights(args.tenant_weights),
+        tenant_bytes_per_lane=args.tenant_bytes_per_lane)
     srv.start()
     rc = 0
     try:
@@ -126,6 +140,8 @@ def serve_main(args) -> int:
                 if any(r.get(i) is not None for r in srv.results)),
             **agg,
         }
+        if args.tenant_weights:
+            summary["tenants"] = srv.tenant_summary()
         if rv is not None:
             summary["rv"] = srv.rv_summary()
         if snap is not None:
@@ -140,7 +156,9 @@ def _spawn_fleet(drivers: int, n: int, lanes: int, algo: str,
                  payload_bytes: int, timeout_ms: int, seed: int,
                  proto: str, idle_ms: int, max_ms: int,
                  admission_bytes_per_lane: int, shed_deadline_ms: int,
-                 no_pump: bool, adaptive_cap_ms: int = 0):
+                 no_pump: bool, adaptive_cap_ms: int = 0,
+                 tenant_weights: Optional[str] = None,
+                 tenant_bytes_per_lane: int = 0):
     """D shard processes (the deployment shape) + their address lists."""
     import subprocess
     import tempfile
@@ -167,6 +185,11 @@ def _spawn_fleet(drivers: int, n: int, lanes: int, algo: str,
                      str(admission_bytes_per_lane)]
         if adaptive_cap_ms > 0:
             argv += ["--adaptive-cap-ms", str(adaptive_cap_ms)]
+        if tenant_weights:
+            argv += ["--tenant-weights", tenant_weights]
+            if tenant_bytes_per_lane > 0:
+                argv += ["--tenant-bytes-per-lane",
+                         str(tenant_bytes_per_lane)]
         if no_pump:
             argv += ["--no-pump"]
         # stderr goes to an unbuffered temp FILE, not a pipe: the bench
@@ -226,21 +249,30 @@ def run_fleet_bench(*, drivers: int = 4, rate: float = 100.0,
                     adaptive_cap_ms: int = 0,
                     capacity_out: Optional[str] = None,
                     capacity_samples: Optional[str] = None,
+                    tenants: Optional[List[Dict[str, Any]]] = None,
+                    tenant_bytes_per_lane: int = 64 << 10,
                     ) -> Dict[str, Any]:
     """Spawn a ``drivers``-shard fleet (one OS process per shard), drive
     it open-loop at ``rate`` (or walk the ``rates`` ladder to the knee),
     collect the per-shard server summaries and gate the end-to-end
     NACK/shed accounting invariant.  The measurement core of
     --open-loop, --ab-fleet and the host-fleet soak rung."""
-    from round_tpu.apps.loadgen import open_loop, sweep
+    from round_tpu.apps.loadgen import open_loop, open_loop_tenants, sweep
     from round_tpu.runtime.fleet import FleetRouter
 
     _algo, payload_bytes = _select_algo(algo, payload_bytes)
     max_ms = int(deadline_s * 1000) + 120_000
+    tenant_weights = None
+    if tenants:
+        tenant_weights = ",".join(
+            f"{int(s['tenant'])}:{float(s.get('weight', 1.0))}"
+            for s in sorted(tenants, key=lambda s: int(s["tenant"])))
     procs, addrs = _spawn_fleet(
         drivers, n, lanes, algo, payload_bytes, timeout_ms, seed, proto,
         idle_ms, max_ms, admission_bytes_per_lane, shed_deadline_ms,
-        no_pump, adaptive_cap_ms=adaptive_cap_ms)
+        no_pump, adaptive_cap_ms=adaptive_cap_ms,
+        tenant_weights=tenant_weights,
+        tenant_bytes_per_lane=tenant_bytes_per_lane)
     report: Dict[str, Any] = {
         "drivers": drivers, "n": n, "lanes": lanes, "algo": algo,
         "payload_bytes": payload_bytes, "skew": skew,
@@ -265,7 +297,12 @@ def run_fleet_bench(*, drivers: int = 4, rate: float = 100.0,
             start_id[0] = rep["last_id"] + 1
             return rep
 
-        if rates:
+        if tenants:
+            report["tenant_mix"] = open_loop_tenants(
+                router, tenants, seed=seed,
+                payload_bytes=payload_bytes, warmup=warmup,
+                deadline_s=deadline_s)
+        elif rates:
             report["sweep"] = sweep(run_point, rates)
         else:
             report["open_loop"] = run_point(rate)
@@ -312,6 +349,21 @@ def run_fleet_bench(*, drivers: int = 4, rate: float = 100.0,
     report["shed_frames"] = shed
     report["nacks_accounted"] = nacks
     report["shed_accounting_ok"] = shed == nacks
+    if tenants:
+        # the SAME invariant, metered per tenant: every tenant-shed
+        # frame any shard counted is NACK-accounted to THAT tenant
+        per: Dict[int, Dict[str, int]] = {}
+        for o in outs.values():
+            for tid, st in (o.get("tenants", {}) or {}) \
+                    .get("by_tenant", {}).items():
+                agg = per.setdefault(int(tid), {})
+                for k, v in st.items():
+                    agg[k] = agg.get(k, 0) + int(v)
+        report["tenant_stats"] = per
+        report["tenant_shed_accounting_ok"] = all(
+            st.get("shed_frames", 0)
+            == st.get("nacks_sent", 0) + st.get("nacks_suppressed", 0)
+            for st in per.values())
     if capacity_samples and report.get("sweep", {}).get("knee_dps"):
         report["capacity"] = bank_and_maybe_fit(
             capacity_samples, capacity_out, {
@@ -321,6 +373,241 @@ def run_fleet_bench(*, drivers: int = 4, rate: float = 100.0,
                 "knee_rate": report["sweep"]["knee_rate"],
                 "knee_p99_ms": report["sweep"]["knee_p99_ms"],
             })
+    return report
+
+
+def run_autoscale_bench(*, algo: str = "lvb", n: int = 3,
+                        lanes: int = 8, payload_bytes: int = 1024,
+                        timeout_ms: int = 300, seed: int = 0,
+                        min_shards: int = 1, max_shards: int = 4,
+                        multipliers=(0.3, 1.0, 2.0, 3.0),
+                        point_s: float = 5.0, slo_ms: float = 2000.0,
+                        model_path: str = "CAPACITY_r02.json",
+                        regions: int = 2,
+                        admission_bytes_per_lane: int = 0,
+                        tenants: Optional[List[Dict[str, Any]]] = None,
+                        tenant_bytes_per_lane: int = 64 << 10,
+                        license_registry=None,
+                        license_solve: Optional[bool] = None,
+                        warmup: int = 8, deadline_s: float = 60.0,
+                        window_s: float = 1.5, dwell_steps: int = 2,
+                        cooldown_s: float = 1.5,
+                        step_interval_s: float = 0.25,
+                        bank_out: Optional[str] = None,
+                        capacity_samples: Optional[str] = None,
+                        capacity_out: Optional[str] = None,
+                        ) -> Dict[str, Any]:
+    """The autoscale trajectory bench: an IN-PROCESS fleet under a
+    FleetSupervisor, load swept as MULTIPLES of the fitted knee for the
+    minimum fleet (0.3x -> 3x), every resize decision banked.
+
+    The gate the fleet-autoscale soak rung reads: the SLO must be held
+    by SCALING, not shedding — a point that stays inside the SLO while
+    the router eats NACK-retries/give-ups AND the model says capacity
+    existed at a fleet size the supervisor never reached is flagged
+    ``slo_met_by_shedding`` and fails the rung.  With ``tenants``, each
+    point offers the mix through the weighted-fair admission path and
+    the per-tenant shed accounting invariant is gated too.
+
+    In-SLO achieved rates per distinct fleet size, plus the
+    supervisor's knee-drift samples, feed ``capacity.fit`` — the
+    CAPACITY_r03 refit is exactly this bench's output."""
+    from round_tpu.apps.loadgen import open_loop, open_loop_tenants
+    from round_tpu.runtime.capacity import CapacityModel
+    from round_tpu.runtime.control import FleetSupervisor
+    from round_tpu.runtime.fleet import DriverServer, FleetRouter
+
+    algo_obj, payload_bytes = _select_algo(algo, payload_bytes)
+    model = CapacityModel.load(model_path)
+    base = float(model.predict_dps(min_shards, lanes,
+                                   payload_bytes=payload_bytes))
+    weights = ({int(s["tenant"]): float(s.get("weight", 1.0))
+                for s in tenants} if tenants else None)
+    servers: Dict[str, DriverServer] = {}
+
+    def spawn(name: str):
+        srv = DriverServer(
+            algo_obj, n=n, lanes=lanes, timeout_ms=timeout_ms,
+            idle_ms=120_000,
+            admission_bytes_per_lane=admission_bytes_per_lane,
+            tenants=weights,
+            tenant_bytes_per_lane=tenant_bytes_per_lane)
+        servers[name] = srv
+        return srv.start()
+
+    def retire(name: str) -> None:
+        srv = servers[name]
+        srv.stop()
+        srv.join(30)
+
+    router = FleetRouter()
+    report: Dict[str, Any] = {
+        "algo": algo, "n": n, "lanes": lanes,
+        "payload_bytes": payload_bytes, "seed": seed,
+        "min_shards": min_shards, "max_shards": max_shards,
+        "slo_ms": slo_ms, "model": model_path,
+        "base_knee_dps": round(base, 2),
+        "multipliers": list(multipliers),
+        "tenants": bool(tenants),
+        "mode": "in-process-autoscale",
+    }
+    try:
+        for d in range(min_shards):
+            router.add_shard(f"s{d}", spawn(f"s{d}"),
+                             region=f"r{d % max(1, regions)}")
+        sup = FleetSupervisor(
+            router, algo_name=algo, n=n, spawn=spawn, retire=retire,
+            model=model, lanes=lanes, payload_bytes=payload_bytes,
+            slo_ms=slo_ms, min_shards=min_shards, max_shards=max_shards,
+            license_registry=license_registry,
+            license_solve=license_solve,
+            region_fn=lambda i: f"r{i % max(1, regions)}",
+            window_s=window_s, dwell_steps=dwell_steps,
+            cooldown_s=cooldown_s, step_interval_s=step_interval_s)
+        # pre-warm the proof license OUTSIDE the measured windows: the
+        # deployed posture is a nightly verifier_cli --cache run making
+        # every live check a warm memo hit, not a mid-blast solver call
+        report["license_prewarm"] = sup._license().to_json()
+        points: List[Dict[str, Any]] = []
+        start_id = 1
+        for j, mult in enumerate(multipliers):
+            rate = mult * base
+            if tenants:
+                # the sweep re-derives each tenant's offered rate from
+                # the multiplier; a spec's own rate (CLI form) survives
+                # as the RELATIVE split when no explicit frac is given
+                fracs = [float(s.get("frac", s.get("rate", 1.0)))
+                         for s in tenants]
+                tot = sum(fracs) or 1.0
+                specs = [dict(s, rate=rate * fracs[k] / tot,
+                              instances=max(
+                                  10, int(rate * fracs[k] / tot
+                                          * point_s)))
+                         for k, s in enumerate(tenants)]
+                rep = open_loop_tenants(
+                    router, specs, seed=seed + j,
+                    payload_bytes=payload_bytes, start_id=start_id,
+                    warmup=warmup if j == 0 else 0,
+                    deadline_s=deadline_s, controller=sup)
+                decided, total = rep["decided"], rep["instances"]
+                p99 = max((t["p99_ms"] for t in rep["tenants"].values()
+                           if t["p99_ms"] is not None), default=None)
+            else:
+                instances = max(20, int(rate * point_s))
+                rep = open_loop(
+                    router, rate, instances, seed=seed + j,
+                    payload_bytes=payload_bytes, start_id=start_id,
+                    warmup=warmup if j == 0 else 0,
+                    deadline_s=deadline_s, controller=sup)
+                decided, total = rep["decided"], rep["instances"]
+                p99 = rep["p99_ms"]
+            start_id = rep["last_id"] + 1
+            rep["multiplier"] = mult
+            rep["offered_dps"] = round(rate, 2)
+            rep["drivers_at_end"] = len(sup.owned)
+            rep["within_slo"] = (decided >= 0.9 * total
+                                 and (p99 is None or p99 <= slo_ms))
+            # the shed-not-scale smell: inside the SLO, but the router
+            # absorbed overload (retries/give-ups) while the model says
+            # a fleet size the supervisor never reached held this rate
+            overloaded = (rep.get("give_ups", 0) > 0
+                          or rep.get("nack_retries", 0) > 0.05 * total
+                          or any(t.get("nacks", 0) > 0
+                                 for t in rep.get("tenants", {})
+                                 .values()))
+            cap_existed = (rep["drivers_at_end"] < max_shards
+                           and rate <= float(model.predict_dps(
+                               max_shards, lanes,
+                               payload_bytes=payload_bytes)))
+            rep["slo_met_by_shedding"] = bool(
+                rep["within_slo"] and overloaded and cap_existed)
+            points.append(rep)
+        report["points"] = points
+        report["supervisor"] = sup.summary()
+        report["slo_met_by_shedding"] = any(
+            p["slo_met_by_shedding"] for p in points)
+        report["slo_held"] = all(p["within_slo"] for p in points
+                                 if p["multiplier"] <= 1.0)
+        # live knee samples for the refit: best in-SLO achieved rate per
+        # distinct fleet size + every knee-drift sample the supervisor
+        # banked mid-blast
+        by_drivers: Dict[int, Dict[str, Any]] = {}
+        for p in points:
+            if not p["within_slo"]:
+                continue
+            d = p["drivers_at_end"]
+            # an in-SLO point far below the model's prediction for this
+            # fleet size is just light load, not a knee observation —
+            # banking it would teach the fit that capacity IS the
+            # offered rate
+            if p["offered_dps"] < 0.8 * float(model.predict_dps(
+                    d, lanes, payload_bytes=payload_bytes)):
+                continue
+            dps = (p.get("achieved_dps")
+                   or sum(t["achieved_dps"]
+                          for t in p.get("tenants", {}).values()))
+            if d not in by_drivers \
+                    or dps > by_drivers[d]["knee_dps"]:
+                by_drivers[d] = {
+                    "drivers": d, "lanes": lanes, "n": n,
+                    "payload_bytes": payload_bytes,
+                    "knee_dps": dps, "knee_rate": p["offered_dps"],
+                    "knee_p99_ms": p.get("p99_ms"),
+                    "source": "autoscale_bench",
+                }
+        # knee-drift samples collapse to ONE live knee per fleet size
+        # (the max achieved rate measured under breach at that size) so
+        # a long breachy run cannot swamp the refit's sample bank
+        drift: Dict[int, Dict[str, Any]] = {}
+        for s in sup.knee_samples:
+            d = int(s["drivers"])
+            if d not in drift or s["knee_dps"] > drift[d]["knee_dps"]:
+                drift[d] = {
+                    "drivers": d, "lanes": lanes, "n": n,
+                    "payload_bytes": payload_bytes,
+                    "knee_dps": s["knee_dps"],
+                    "read_frac": s.get("read_frac", 0.0),
+                    "source": "knee_drift",
+                }
+        for d, s in drift.items():
+            if d not in by_drivers \
+                    or s["knee_dps"] > by_drivers[d]["knee_dps"]:
+                by_drivers[d] = s
+        report["live_samples"] = list(by_drivers.values())
+        report["knee_drift_samples"] = len(sup.knee_samples)
+    finally:
+        router.close()
+        for srv in servers.values():
+            srv.stop()
+        for srv in servers.values():
+            try:
+                srv.join(30)
+            except RuntimeError:
+                pass
+        if tenants:
+            per: Dict[int, Dict[str, int]] = {}
+            for srv in servers.values():
+                for tid, st in srv.tenant_summary() \
+                        .get("by_tenant", {}).items():
+                    agg = per.setdefault(int(tid), {})
+                    for k, v in st.items():
+                        agg[k] = agg.get(k, 0) + int(v)
+            report["tenant_stats"] = per
+            report["tenant_shed_accounting_ok"] = all(
+                st.get("shed_frames", 0)
+                == st.get("nacks_sent", 0)
+                + st.get("nacks_suppressed", 0)
+                for st in per.values())
+    if capacity_samples and report.get("live_samples"):
+        fit = None
+        for s in report["live_samples"]:
+            fit = bank_and_maybe_fit(capacity_samples, capacity_out, s)
+        report["capacity"] = fit
+    if bank_out:
+        tmp = bank_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=1)
+        os.replace(tmp, bank_out)
     return report
 
 
@@ -350,6 +637,16 @@ def main(argv=None) -> int:
                     help="> 0 opts into admission control + NACK load "
                          "shedding (PR 10) on every replica")
     sv.add_argument("--shed-deadline-ms", type=int, default=250)
+    sv.add_argument("--tenant-weights", type=str, default=None,
+                    metavar="T:W,..",
+                    help="per-tenant weighted-fair admission (PR 20): "
+                         "'1:1,2:3' gives tenant 2 a 3x byte share; "
+                         "any listed tenant opts every replica into "
+                         "TenantAdmission metering")
+    sv.add_argument("--tenant-bytes-per-lane", type=int,
+                    default=64 << 10,
+                    help="the per-lane byte budget the tenant shares "
+                         "divide (runtime/instances.py TenantAdmission)")
     sv.add_argument("--adaptive-cap-ms", type=int, default=0,
                     help="> 0 replaces the fixed --timeout-ms deadline "
                          "with EWMA+backoff adaptive deadlines capped "
@@ -419,14 +716,79 @@ def main(argv=None) -> int:
                     help="with --capacity-samples: (re)fit and write "
                          "the capacity model artifact here")
 
+    bn.add_argument("--tenants", type=str, default=None,
+                    metavar="SPEC;SPEC..",
+                    help="per-tenant mix: 't=1,rate=50,inst=100,w=1,"
+                         "skew=0;t=2,...' (apps/loadgen.py "
+                         "parse_tenant_specs) — offers every tenant's "
+                         "stream through the same router with weighted-"
+                         "fair admission on the shards")
+    bn.add_argument("--tenant-bytes-per-lane", type=int,
+                    default=64 << 10)
+
     ft = sub.add_parser("fit", help="fit the capacity model from banked "
                                     "knee samples")
     ft.add_argument("--samples", type=str, required=True)
     ft.add_argument("--out", type=str, required=True)
 
+    au = sub.add_parser(
+        "autoscale",
+        help="model-driven autoscale trajectory bench: an in-process "
+             "fleet under a FleetSupervisor, load swept as multiples "
+             "of the fitted knee, every resize licensed + banked")
+    au.add_argument("--algo", type=str, default="lvb")
+    au.add_argument("--n", type=int, default=3)
+    au.add_argument("--lanes", type=int, default=8)
+    au.add_argument("--payload-bytes", type=int, default=1024)
+    au.add_argument("--min-shards", type=int, default=1)
+    au.add_argument("--max-shards", type=int, default=4)
+    au.add_argument("--multipliers", type=str, default="0.3,1,2,3",
+                    help="offered load as multiples of the model's "
+                         "knee for --min-shards")
+    au.add_argument("--point-s", type=float, default=5.0)
+    au.add_argument("--slo-ms", type=float, default=2000.0)
+    au.add_argument("--model", type=str, default="CAPACITY_r02.json")
+    au.add_argument("--regions", type=int, default=2)
+    au.add_argument("--seed", type=int, default=0)
+    au.add_argument("--timeout-ms", type=int, default=300)
+    au.add_argument("--deadline-s", type=float, default=60.0)
+    au.add_argument("--admission-bytes-per-lane", type=int, default=0)
+    au.add_argument("--tenants", type=str, default=None,
+                    metavar="SPEC;SPEC..")
+    au.add_argument("--tenant-bytes-per-lane", type=int,
+                    default=64 << 10)
+    au.add_argument("--bank", type=str, default=None, metavar="FILE",
+                    help="bank the full trajectory report (e.g. "
+                         "AUTOSCALE_r01.json)")
+    au.add_argument("--capacity-samples", type=str, default=None,
+                    help="append the live knee samples to this bank")
+    au.add_argument("--capacity-out", type=str, default=None,
+                    help="refit target (e.g. CAPACITY_r03.json)")
+
     args = ap.parse_args(argv)
     if args.cmd == "serve":
         return serve_main(args)
+    if args.cmd == "autoscale":
+        from round_tpu.apps.loadgen import parse_tenant_specs
+
+        report = run_autoscale_bench(
+            algo=args.algo, n=args.n, lanes=args.lanes,
+            payload_bytes=args.payload_bytes,
+            timeout_ms=args.timeout_ms, seed=args.seed,
+            min_shards=args.min_shards, max_shards=args.max_shards,
+            multipliers=[float(m)
+                         for m in args.multipliers.split(",")],
+            point_s=args.point_s, slo_ms=args.slo_ms,
+            model_path=args.model, regions=args.regions,
+            admission_bytes_per_lane=args.admission_bytes_per_lane,
+            tenants=(parse_tenant_specs(args.tenants)
+                     if args.tenants else None),
+            tenant_bytes_per_lane=args.tenant_bytes_per_lane,
+            deadline_s=args.deadline_s, bank_out=args.bank,
+            capacity_samples=args.capacity_samples,
+            capacity_out=args.capacity_out)
+        print(json.dumps(report))
+        return 0
     if args.cmd == "fit":
         from round_tpu.runtime.capacity import fit_capacity
 
@@ -439,6 +801,8 @@ def main(argv=None) -> int:
                           "b_lanes": model.b_lanes,
                           "b_payload": model.b_payload}))
         return 0
+    from round_tpu.apps.loadgen import parse_tenant_specs
+
     rates = ([float(r) for r in args.sweep.split(",")]
              if args.sweep else None)
     t0 = _time.perf_counter()
@@ -451,7 +815,10 @@ def main(argv=None) -> int:
         admission_bytes_per_lane=args.admission_bytes_per_lane,
         adaptive_cap_ms=args.adaptive_cap_ms,
         no_pump=args.no_pump, capacity_samples=args.capacity_samples,
-        capacity_out=args.capacity_out)
+        capacity_out=args.capacity_out,
+        tenants=(parse_tenant_specs(args.tenants)
+                 if args.tenants else None),
+        tenant_bytes_per_lane=args.tenant_bytes_per_lane)
     report["harness_wall_s"] = round(_time.perf_counter() - t0, 3)
     print(json.dumps(report))
     return 0

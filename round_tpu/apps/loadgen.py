@@ -101,13 +101,18 @@ def open_loop(router, rate: float, instances: int, *, seed: int = 0,
               skew: float = 0.0, payload_bytes: int = 0,
               value_base: int = 0, start_id: int = 1,
               warmup: int = 0, deadline_s: float = 120.0,
-              value_fn: Optional[Callable[[int], Any]] = None
-              ) -> Dict[str, Any]:
+              value_fn: Optional[Callable[[int], Any]] = None,
+              controller=None) -> Dict[str, Any]:
     """Offer ``instances`` arrivals at ``rate``/s through ``router`` and
     report per-request decision latency + offered-vs-achieved
     throughput.  ``warmup`` proposals (closed-loop, excluded from the
     stats) absorb the fleet's jit compiles so the measured window sees a
-    warm fabric — the same discipline as every perf_ab harness."""
+    warm fabric — the same discipline as every perf_ab harness.
+
+    ``controller`` (a runtime.control.FleetSupervisor, or anything with
+    ``maybe_step()``) is polled once per pump iteration: the autoscale
+    loop observes the SAME router the load flows through, so a resize
+    lands mid-blast exactly as it would in production."""
     if value_fn is None:
         if payload_bytes > 0:
             def value_fn(i):
@@ -144,6 +149,8 @@ def open_loop(router, rate: float, instances: int, *, seed: int = 0,
             router.pump(int(min(20.0, gap_ms)))
         else:
             router.pump(20)
+        if controller is not None:
+            controller.maybe_step()
     wall = _time.monotonic() - t0
     lats = sorted(router.latency_ms[m] for m in measured
                   if m in router.latency_ms)
@@ -218,6 +225,169 @@ def sweep(make_run, rates: List[float], *, p99_cap_ms: float = 2000.0,
         "knee_dps": knee["achieved_dps"] if knee else None,
         "knee_p99_ms": knee["p99_ms"] if knee else None,
     }
+
+
+# -- per-tenant workload mixes (docs/SERVING.md control plane) --------------
+
+def plan_tenant_arrivals(tenants: List[Dict[str, Any]], seed: int,
+                         ring, start_id: int = 1
+                         ) -> List[Dict[str, Any]]:
+    """A merged multi-tenant offered-load schedule.  Each spec in
+    ``tenants`` is ``{"tenant": id, "rate": r, "instances": n}`` plus
+    optional ``"skew"`` (per-tenant Zipf hot-shard exponent — a hot
+    tenant is usually hot on a FEW shards, not everywhere) and
+    ``"weight"`` (carried through to the report, not used here).
+
+    Instance-id ranges are DISJOINT per tenant: each tenant plans from a
+    sequential cursor starting where the previous tenant's plan stopped
+    consuming ids (a skewed plan eats ids past start+instances to fill
+    hot-shard pools), so two tenants never collide on an id and the
+    per-tenant decision accounting stays exact.  Arrival clocks are
+    independent per tenant (seed + tenant*7919), merged by time."""
+    merged: List[Dict[str, Any]] = []
+    cursor = int(start_id)
+    for spec in sorted(tenants, key=lambda s: int(s["tenant"])):
+        tid = int(spec["tenant"])
+        if not 0 <= tid <= 0xFF:
+            raise ValueError(f"tenant id {tid} outside 0..255")
+        plan = plan_arrivals(float(spec["rate"]),
+                             int(spec["instances"]),
+                             seed + tid * 7919,
+                             float(spec.get("skew", 0.0)),
+                             ring, start_id=cursor)
+        for p in plan:
+            p["tenant"] = tid
+        merged.extend(plan)
+        cursor = max([cursor - 1] + [p["inst"] for p in plan]) + 1
+    merged.sort(key=lambda p: (p["t"], p["inst"]))
+    return merged
+
+
+def open_loop_tenants(router, tenants: List[Dict[str, Any]], *,
+                      seed: int = 0, payload_bytes: int = 0,
+                      value_base: int = 0, start_id: int = 1,
+                      warmup: int = 0, deadline_s: float = 120.0,
+                      controller=None) -> Dict[str, Any]:
+    """The multi-tenant open_loop: every tenant's Poisson stream rides
+    the SAME router (and the same pump loop — contention is the point),
+    each propose stamped with its tenant id so the drivers' weighted-
+    fair admission (runtime/instances.py TenantAdmission) can meter it.
+    The report carries per-tenant p50/p95/p99, offered-vs-achieved, and
+    the NACK/give-up split from the router's per-tenant counters — the
+    isolation gate reads exactly this."""
+    next_id = start_id
+    if warmup > 0:
+        for _ in range(warmup):
+            if payload_bytes > 0:
+                router.propose(next_id,
+                               payload_value(value_base + next_id,
+                                             payload_bytes))
+            else:
+                router.propose(next_id, value_base + next_id)
+            next_id += 1
+        router.drain(deadline_s)
+    plan = plan_tenant_arrivals(tenants, seed, router.ring,
+                                start_id=next_id)
+    by_tenant: Dict[int, List[int]] = {}
+    for p in plan:
+        by_tenant.setdefault(p["tenant"], []).append(p["inst"])
+    nacks0 = dict(router.tenant_nacks)
+    gups0 = dict(router.tenant_give_ups)
+    t0 = _time.monotonic()
+    i = 0
+    t_hard = t0 + deadline_s
+    while (i < len(plan) or router._inflight) \
+            and _time.monotonic() < t_hard:
+        now = _time.monotonic() - t0
+        while i < len(plan) and plan[i]["t"] <= now:
+            p = plan[i]
+            _H_ARRIVAL_LAG.observe((now - p["t"]) * 1000.0)
+            if payload_bytes > 0:
+                val = payload_value(value_base + p["inst"],
+                                    payload_bytes)
+            else:
+                val = value_base + p["inst"]
+            router.propose(p["inst"], val, tenant=p["tenant"])
+            i += 1
+        if i < len(plan):
+            gap_ms = max(0.0, (plan[i]["t"] - (_time.monotonic() - t0))
+                         * 1000.0)
+            router.pump(int(min(20.0, gap_ms)))
+        else:
+            router.pump(20)
+        if controller is not None:
+            controller.maybe_step()
+    wall = _time.monotonic() - t0
+    specs = {int(s["tenant"]): s for s in tenants}
+
+    def pct(lats, p):
+        if not lats:
+            return None
+        return round(lats[min(len(lats) - 1,
+                              int(math.ceil(p / 100.0 * len(lats))) - 1)],
+                     2)
+
+    per_tenant: Dict[int, Dict[str, Any]] = {}
+    for tid, ids in sorted(by_tenant.items()):
+        lats = sorted(router.latency_ms[m] for m in ids
+                      if m in router.latency_ms)
+        decided = sum(1 for m in ids
+                      if router.results.get(m) is not None)
+        resolved_t = [router.decide_t[m] for m in ids
+                      if m in router.decide_t]
+        span = (max(resolved_t) - t0) if resolved_t else wall
+        per_tenant[tid] = {
+            "weight": float(specs[tid].get("weight", 1.0)),
+            "offered_rate": float(specs[tid]["rate"]),
+            "instances": len(ids),
+            "decided": decided,
+            "achieved_dps": round(decided / span, 2) if span > 0
+            else 0.0,
+            "p50_ms": pct(lats, 50), "p95_ms": pct(lats, 95),
+            "p99_ms": pct(lats, 99),
+            "nacks": router.tenant_nacks.get(tid, 0)
+            - nacks0.get(tid, 0),
+            "give_ups": router.tenant_give_ups.get(tid, 0)
+            - gups0.get(tid, 0),
+        }
+    all_ids = [p["inst"] for p in plan]
+    return {
+        "tenants": per_tenant,
+        "instances": len(plan),
+        "decided": sum(t["decided"] for t in per_tenant.values()),
+        "wall_s": round(wall, 3),
+        "payload_bytes": payload_bytes,
+        "seed": seed,
+        "last_id": max([next_id - 1] + all_ids),
+    }
+
+
+def parse_tenant_specs(text: str) -> List[Dict[str, Any]]:
+    """Parse the CLI tenant-mix grammar: ';'-separated groups of
+    key=value pairs — ``t=0,rate=50,inst=100,w=1,skew=0``.  Keys:
+    t (tenant id), rate (req/s), inst (instances), w (weight, default
+    1), skew (Zipf exponent, default 0)."""
+    out: List[Dict[str, Any]] = []
+    for group in text.split(";"):
+        group = group.strip()
+        if not group:
+            continue
+        kv = {}
+        for pair in group.split(","):
+            k, _, v = pair.partition("=")
+            kv[k.strip()] = v.strip()
+        try:
+            out.append({"tenant": int(kv["t"]),
+                        "rate": float(kv["rate"]),
+                        "instances": int(kv["inst"]),
+                        "weight": float(kv.get("w", 1.0)),
+                        "skew": float(kv.get("skew", 0.0))})
+        except KeyError as e:
+            raise ValueError(
+                f"tenant spec {group!r} missing key {e}") from None
+    if not out:
+        raise ValueError("empty tenant spec")
+    return out
 
 
 # -- the KV serving workload (round_tpu/kv, docs/KV.md) ---------------------
@@ -365,6 +535,16 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--warmup", type=int, default=8)
     ap.add_argument("--deadline-s", type=float, default=180.0)
+    ap.add_argument("--tenants", type=str, default=None,
+                    metavar="SPEC;SPEC..",
+                    help="per-tenant mix instead of one stream: "
+                         "'t=1,rate=50,inst=100,w=1,skew=0;t=2,...' — "
+                         "tenant ids ride FLAG_PROPOSE tags, shards "
+                         "meter each tenant under weighted-fair "
+                         "admission, the report splits p50/p95/p99 and "
+                         "offered-vs-achieved per tenant")
+    ap.add_argument("--tenant-bytes-per-lane", type=int,
+                    default=64 << 10)
     ap.add_argument("--capacity-out", type=str, default=None,
                     metavar="FILE",
                     help="with --sweep: bank the measured knee into "
@@ -385,7 +565,10 @@ def main(argv=None) -> int:
         seed=args.seed, warmup=args.warmup, deadline_s=args.deadline_s,
         capacity_samples=(args.capacity_out + ".samples.json"
                           if args.capacity_out else None),
-        capacity_out=args.capacity_out)
+        capacity_out=args.capacity_out,
+        tenants=(parse_tenant_specs(args.tenants)
+                 if args.tenants else None),
+        tenant_bytes_per_lane=args.tenant_bytes_per_lane)
     print(json.dumps(report))
     return 0
 

@@ -61,10 +61,19 @@ class KVClient:
                  lease_replica: int = 0, keyspace: int = 4096,
                  read_retry_ms: float = 500.0,
                  read_backoff_ms: float = 25.0,
-                 read_give_up: int = 12):
+                 read_give_up: int = 12,
+                 tenant: int = 0):
         self.router = router
         self.payload_bytes = payload_bytes
         self.client = client
+        # tenancy (docs/SERVING.md "per-tenant admission"): a nonzero
+        # tenant id namespaces this session's KEY SPACE (every key gets
+        # a tenant prefix, so tenants cannot collide or read each other)
+        # and rides Tag.call_stack on every write/txn/read, so the shard
+        # meters this session against the tenant's weighted-fair share
+        if not 0 <= int(tenant) <= 0xFF:
+            raise ValueError(f"tenant id {tenant} outside [0, 255]")
+        self.tenant = int(tenant)
         self.lease_replica = lease_replica
         self.keyspace = keyspace
         self.read_retry_ms = read_retry_ms
@@ -95,6 +104,14 @@ class KVClient:
         self.next_id += 1
         return inst
 
+    def _ns(self, key: bytes) -> bytes:
+        """The tenant's slice of the key space: a ``t<id>/`` prefix on
+        every data key (vote keys stay raw — 2PC control state is
+        protocol-owned, not tenant data)."""
+        if not self.tenant:
+            return key
+        return b"t%d/" % self.tenant + key
+
     def next_seq(self, key: bytes) -> int:
         s = self._seq.get(key, 0) + 1
         self._seq[key] = s
@@ -103,6 +120,7 @@ class KVClient:
     def put(self, key: bytes, value: bytes) -> int:
         """One asynchronous write; resolves through ``pump`` (the
         router's decision stream is the ack)."""
+        key = self._ns(key)
         seq = self.next_seq(key)
         rec = encode_record(OP_PUT, [(seq, key, value)],
                             self.payload_bytes, keyspace=self.keyspace)
@@ -111,7 +129,7 @@ class KVClient:
         op = {"cl": self.client, "op": "w", "key": key.hex(),
               "seq": seq, "val": value.hex(), "t0": _time.monotonic(),
               "inst": inst}
-        self.router.propose(inst, rec, shard=shard)
+        self.router.propose(inst, rec, shard=shard, tenant=self.tenant)
         self._writes[inst] = (op, key, seq, value)
         _C_PUTS.inc()
         return inst
@@ -128,6 +146,9 @@ class KVClient:
         ring's key->shard routing — the vote reads need it: a txn's
         vote key is replicated state on EACH participant shard, not on
         the shard the key itself would hash to."""
+        if not internal:
+            # internal (vote) keys are protocol state, never namespaced
+            key = self._ns(key)
         t0 = _time.monotonic()
         if grade == R.GRADE_STALE:
             seq, val = R.local_stale_read(self.mirror, key)
@@ -159,11 +180,12 @@ class KVClient:
             R.GRADE_LEASE if pr.mode == "lease" else R.GRADE_LIN)
         if pr.mode == "lease":
             self.router.send_read(pr.shard, self.lease_replica, pr.rid,
-                                  payload)
+                                  payload, tenant=self.tenant)
         else:
             n = self.router.shard_n(pr.shard)
             for j in range(n):
-                self.router.send_read(pr.shard, j, pr.rid, payload)
+                self.router.send_read(pr.shard, j, pr.rid, payload,
+                                      tenant=self.tenant)
 
     def _complete_read(self, pr: _PendingRead, ok: bool,
                        seq: int = 0, val: bytes = b"") -> None:
@@ -306,6 +328,7 @@ class KVClient:
         protocol).  Returns {"committed": bool, "txn": id,
         "shards": k}."""
         t0 = _time.monotonic()
+        pairs = {self._ns(k): v for k, v in pairs.items()}
         by_shard = T.plan_txn(self.router.ring, pairs)
         seqs = {k: self.next_seq(k) for k in pairs}
         txn_id = self._txn
@@ -328,7 +351,8 @@ class KVClient:
                 OP_TXN, [(seqs[k], k, v) for k, v in sub.items()],
                 self.payload_bytes, txn=txn_id, keyspace=self.keyspace)
             inst = self._alloc_inst()
-            self.router.propose(inst, rec, shard=shard, txn=True)
+            self.router.propose(inst, rec, shard=shard, txn=True,
+                                tenant=self.tenant)
             committed = self._wait_insts([inst], deadline_s)
             bank_writes(committed, _time.monotonic())
             return {"committed": committed, "txn": txn_id, "shards": 1}
@@ -341,7 +365,8 @@ class KVClient:
                 OP_PREPARE, [(seqs[k], k, v) for k, v in sub.items()],
                 self.payload_bytes, txn=txn_id, keyspace=self.keyspace)
             inst = self._alloc_inst()
-            self.router.propose(inst, rec, shard=shard, txn=True)
+            self.router.propose(inst, rec, shard=shard, txn=True,
+                                tenant=self.tenant)
             prep.append(inst)
         prepared = self._wait_insts(prep, deadline_s)
         votes = []
@@ -363,7 +388,8 @@ class KVClient:
                 out_op, [(seqs[k0], k0, b"")], self.payload_bytes,
                 txn=txn_id, keyspace=self.keyspace)
             inst = self._alloc_inst()
-            self.router.propose(inst, rec, shard=shard, txn=True)
+            self.router.propose(inst, rec, shard=shard, txn=True,
+                                tenant=self.tenant)
             outs.append(inst)
         self._wait_insts(outs, deadline_s)
         bank_writes(commit, _time.monotonic())

@@ -174,6 +174,90 @@ class ShardMap:
         return self._ring[i][1]
 
 
+class TwoLevelRing:
+    """Region → shard two-level consistent-hash ring (docs/SERVING.md
+    "planet-scale control plane").
+
+    The outer ring consistent-hashes over REGION names (a supervisor-
+    owned shard group each); every region owns an inner ShardMap over
+    its shards.  Routing is two cheap hash hops — outer pick, inner
+    pick — and rebalance motion is LOCAL BY CONSTRUCTION: adding or
+    removing a shard changes one region's inner ring only, so keys in
+    every other region cannot move (the reshuffle a flat ring pays on
+    every membership change is confined to one region's arc).  With a
+    single region the outer hop is a constant and the inner ShardMap
+    hashes exactly like the flat ring did — placement is byte-identical
+    to the pre-region fleet, which is what keeps every existing test
+    and banked artifact valid.
+
+    Same interface as ShardMap (``shards``/``owner``/``owner_key``/
+    ``add``/``remove``/``__len__``) plus the region surface
+    (``regions``/``region_of``); ``add`` grows a ``region=`` keyword
+    that defaults to one flat region."""
+
+    def __init__(self, vnodes: int = 64, region_vnodes: int = 64):
+        self.vnodes = vnodes
+        self.region_vnodes = region_vnodes
+        self._outer: List[Tuple[int, str]] = []
+        self._inner: Dict[str, ShardMap] = {}
+        self._region_of: Dict[str, str] = {}
+
+    @property
+    def shards(self) -> List[str]:
+        return sorted(self._region_of)
+
+    @property
+    def regions(self) -> List[str]:
+        return sorted(self._inner)
+
+    def region_of(self, shard: str) -> str:
+        return self._region_of[shard]
+
+    def __len__(self) -> int:
+        return len(self._region_of)
+
+    def add(self, shard: str, region: str = "r0") -> None:
+        if shard in self._region_of:
+            raise ValueError(f"shard {shard!r} already in the ring")
+        if region not in self._inner:
+            self._inner[region] = ShardMap(vnodes=self.vnodes)
+            for v in range(self.region_vnodes):
+                self._outer.append(
+                    (_h64(f"region:{region}#{v}".encode()), region))
+            self._outer.sort()
+        self._inner[region].add(shard)
+        self._region_of[shard] = region
+
+    def remove(self, shard: str) -> None:
+        region = self._region_of.pop(shard)
+        self._inner[region].remove(shard)
+        if not len(self._inner[region]):
+            # an empty region must leave the outer ring too, or its arc
+            # would route keys into a ring with no owner
+            del self._inner[region]
+            self._outer = [(h, r) for h, r in self._outer if r != region]
+
+    def _region_for(self, h: int) -> str:
+        i = bisect.bisect_right(self._outer, (h, "￿"))
+        if i == len(self._outer):
+            i = 0
+        return self._outer[i][1]
+
+    def owner(self, instance_id: int) -> str:
+        """Two hash hops: the key's outer arc names the region, the
+        region's inner ring names the shard."""
+        if not self._outer:
+            raise ValueError("empty shard ring")
+        h = _h64(int(instance_id).to_bytes(8, "big"))
+        return self._inner[self._region_for(h)].owner(instance_id)
+
+    def owner_key(self, key: bytes) -> str:
+        if not self._outer:
+            raise ValueError("empty shard ring")
+        h = _h64(bytes(key))
+        return self._inner[self._region_for(h)].owner_key(key)
+
+
 @dataclasses.dataclass
 class _InFlight:
     """One proposed-but-unresolved instance in the router."""
@@ -187,6 +271,7 @@ class _InFlight:
     reproposals: int = 0        # timer-scheduled catch-up re-sends
     next_retry: float = 0.0     # 0 = not in backoff
     txn: bool = False           # ship under FLAG_TXN (kv transactions)
+    tenant: int = 0             # rides Tag.call_stack on the client verbs
     # DISTINCT (shard, replica) pairs that answered FLAG_TOO_LATE: the
     # instance resolves undecided only when every replica of its
     # CURRENT shard said so — a single undecided replica re-answering
@@ -223,7 +308,10 @@ class FleetRouter:
         self.repropose_cap_ms = repropose_cap_ms
         self.max_reproposals = max_reproposals
         self._transport_factory = transport_factory
-        self.ring = ShardMap()
+        # region → shard two-level ring: one flat region unless
+        # ``add_shard(..., region=)`` says otherwise (placement is then
+        # byte-identical to the old flat ShardMap)
+        self.ring = TwoLevelRing()
         self._links: Dict[str, Any] = {}       # shard -> transport
         self._link_n: Dict[str, int] = {}      # shard -> group size
         self._inflight: Dict[int, _InFlight] = {}
@@ -231,11 +319,18 @@ class FleetRouter:
         self.errors: Dict[int, str] = {}
         self.latency_ms: Dict[int, float] = {}
         self.decide_t: Dict[int, float] = {}
-        self.nack_retries = 0
+        self.proposals = 0       # lifetime count (the supervisor's
+        self.nack_retries = 0    # offered-rate signal reads its deltas)
         self.give_ups = 0
         self.dup_decisions = 0
         self.migrations = 0
         self.reproposals = 0
+        # per-tenant attribution (docs/SERVING.md "per-tenant
+        # admission"): which tenant proposed each instance, and the
+        # NACK/give-up tallies the isolation pin + loadgen report read
+        self.tenant_of: Dict[int, int] = {}
+        self.tenant_nacks: Dict[int, int] = {}
+        self.tenant_give_ups: Dict[int, int] = {}
         # per-shard health counters (docs/SERVING.md "shard rv status"):
         # an rv-halted shard drains as a TOO_LATE burst + undecided
         # resolutions, which is how the router — which never sees the
@@ -261,19 +356,22 @@ class FleetRouter:
             tr.add_peer(j, host, port)
         return tr
 
-    def add_shard(self, name: str, replicas: List[Tuple[str, int]]) -> None:
+    def add_shard(self, name: str, replicas: List[Tuple[str, int]],
+                  region: str = "r0") -> None:
         """Join one shard (a DriverServer's replica address list) under a
-        STABLE name and claim its arc of the ring.  In-flight instances
-        stay with their current shard (their decision stream is live) —
-        only NEW proposals land on the new arcs."""
-        self.ring.add(name)
+        STABLE name and claim its arc of ``region``'s inner ring.
+        In-flight instances stay with their current shard (their
+        decision stream is live) — only NEW proposals land on the new
+        arcs, and only keys inside ``region`` can move at all (the
+        two-level ring's locality guarantee)."""
+        self.ring.add(name, region=region)
         self._links[name] = self._make_link(replicas)
         self._link_n[name] = len(replicas)
         _G_SHARDS.set(len(self.ring))
         _C_REBALANCES.inc()
         if TRACE.enabled:
             TRACE.emit("fleet_rebalance", node=None, op="add", shard=name,
-                       shards=len(self.ring))
+                       region=region, shards=len(self.ring))
 
     def remove_shard(self, name: str) -> int:
         """Drop one shard from the ring and MIGRATE its unresolved
@@ -349,7 +447,8 @@ class FleetRouter:
         return codec.encode(arr)
 
     def propose(self, instance_id: int, value, *,
-                shard: Optional[str] = None, txn: bool = False) -> None:
+                shard: Optional[str] = None, txn: bool = False,
+                tenant: int = 0) -> None:
         """Route one instance to its ring owner and ship the proposal to
         every replica of that shard (coalesced; ``pump``/``flush`` ships
         the wave).  ``value`` is the client's initial value — a scalar
@@ -358,12 +457,17 @@ class FleetRouter:
         kv data plane routes by KEY via ``ring.owner_key``, so every
         write of a key shares one decision stream); ``txn`` ships the
         proposal under FLAG_TXN — same state machine, but the shard
-        validates the payload as a kv transaction record."""
+        validates the payload as a kv transaction record; ``tenant``
+        (0-255) namespaces the instance under per-tenant weighted-fair
+        admission — it rides the otherwise-free Tag.call_stack byte on
+        every (re)propose, zero wire-format change."""
         inst = int(instance_id)
         if not MIN_INSTANCE <= inst <= MAX_FLEET_INSTANCE:
             raise ValueError(
                 f"instance id {inst} outside the serveable range "
                 f"[{MIN_INSTANCE}, {MAX_FLEET_INSTANCE}]")
+        if not 0 <= int(tenant) <= 0xFF:
+            raise ValueError(f"tenant id {tenant} outside [0, 255]")
         if inst in self._inflight or inst in self.results:
             raise ValueError(f"instance {inst} already proposed")
         if shard is not None and shard not in self._links:
@@ -372,8 +476,12 @@ class FleetRouter:
         f = _InFlight(inst=inst, payload=self._encode_value(value),
                       shard=shard if shard is not None
                       else self.ring.owner(inst),
-                      t_first=now, t_last=now, txn=txn)
+                      t_first=now, t_last=now, txn=txn,
+                      tenant=int(tenant))
         self._inflight[inst] = f
+        self.proposals += 1
+        if f.tenant:
+            self.tenant_of[inst] = f.tenant
         _C_PROPOSALS.inc()
         _G_INFLIGHT.set(len(self._inflight))
         self._send_propose(f)
@@ -386,7 +494,8 @@ class FleetRouter:
         if link is None:
             return  # shard gone mid-flight; rebalance re-routes it
         tag = Tag(instance=f.inst & 0xFFFF,
-                  flag=FLAG_TXN if f.txn else FLAG_PROPOSE)
+                  flag=FLAG_TXN if f.txn else FLAG_PROPOSE,
+                  call_stack=f.tenant)
         sendb = getattr(link, "send_buffered", None)
         for j in range(self._link_n[f.shard]):
             if sendb is not None:
@@ -400,17 +509,19 @@ class FleetRouter:
         return self._link_n[shard]
 
     def send_read(self, shard: str, replica: int, rid: int,
-                  payload: bytes) -> bool:
+                  payload: bytes, tenant: int = 0) -> bool:
         """Ship one FLAG_READ frame to a single replica of ``shard``
         (round_tpu/kv three-grade reads) and flush immediately — read
         latency is the product here, so reads never wait for the next
-        proposal wave's coalesce."""
+        proposal wave's coalesce.  ``tenant`` rides Tag.call_stack so
+        linearizable reads meter against the tenant's share too."""
         from round_tpu.kv.reads import read_tag
 
         link = self._links.get(shard)
         if link is None:
             return False
-        tag = read_tag(rid)
+        tag = dataclasses.replace(read_tag(rid),
+                                  call_stack=int(tenant) & 0xFF)
         sendb = getattr(link, "send_buffered", None)
         if sendb is not None:
             sendb(replica, tag, payload)
@@ -490,6 +601,9 @@ class FleetRouter:
                     self.on_read_nack(shard, inst)
                 return
             _C_NACKS.inc()
+            if f.tenant:
+                self.tenant_nacks[f.tenant] = \
+                    self.tenant_nacks.get(f.tenant, 0) + 1
             if TRACE.enabled:
                 TRACE.emit("fleet_nack", node=None, inst=inst,
                            shard=shard, src=sender)
@@ -536,6 +650,9 @@ class FleetRouter:
         self.results[f.inst] = None
         self.errors[f.inst] = why
         self.give_ups += 1
+        if f.tenant:
+            self.tenant_give_ups[f.tenant] = \
+                self.tenant_give_ups.get(f.tenant, 0) + 1
         _C_GIVE_UPS.inc()
         _G_INFLIGHT.set(len(self._inflight))
         if TRACE.enabled:
@@ -609,6 +726,11 @@ class FleetRouter:
             "nack_retries": self.nack_retries,
             "reproposals": self.reproposals,
             "migrations": self.migrations,
+            "regions": {r: [s for s in self.ring.shards
+                            if self.ring.region_of(s) == r]
+                        for r in self.ring.regions},
+            "tenant_nacks": dict(self.tenant_nacks),
+            "tenant_give_ups": dict(self.tenant_give_ups),
         }
 
     def raise_if_gave_up(self) -> None:
@@ -669,7 +791,9 @@ class DriverServer:
                  shed_deadline_ms: int = 250,
                  adaptive_cap_ms: int = 0,
                  ports: Optional[List[int]] = None,
-                 rv=None, snap=None, kv=None):
+                 rv=None, snap=None, kv=None,
+                 tenants: Optional[Dict[int, float]] = None,
+                 tenant_bytes_per_lane: int = 64 << 10):
         from round_tpu.runtime.chaos import alloc_ports
         from round_tpu.runtime.transport import HostTransport
 
@@ -700,6 +824,12 @@ class DriverServer:
         # a per-replica KVState, FLAG_READ serves the three grades,
         # FLAG_TXN validates transaction records (docs/KV.md)
         self.kv = kv
+        # per-tenant weighted-fair admission (runtime/instances.py
+        # TenantAdmission, docs/SERVING.md): tenant id -> weight; every
+        # replica meters its client intake per tenant so a hot tenant
+        # sheds against its own share.  None = the tenant-blind shard.
+        self.tenants = dict(tenants) if tenants else None
+        self.tenant_bytes_per_lane = tenant_bytes_per_lane
         if ports is None:
             ports = alloc_ports(n)
         elif len(ports) != n:
@@ -715,7 +845,8 @@ class DriverServer:
         self.errors: Dict[int, BaseException] = {}
 
     def _run_replica(self, i: int) -> None:
-        from round_tpu.runtime.instances import AdmissionControl
+        from round_tpu.runtime.instances import (AdmissionControl,
+                                                 TenantAdmission)
         from round_tpu.runtime.lanes import LaneDriver
 
         peers = {j: self.replicas[j] for j in range(self.n)}
@@ -723,6 +854,12 @@ class DriverServer:
         if self.admission_bytes_per_lane > 0:
             admission = AdmissionControl(
                 high_bytes_per_lane=self.admission_bytes_per_lane,
+                shed_deadline_ms=self.shed_deadline_ms)
+        tenant_admission = None
+        if self.tenants is not None:
+            tenant_admission = TenantAdmission(
+                bytes_per_lane=self.tenant_bytes_per_lane,
+                weights=self.tenants,
                 shed_deadline_ms=self.shed_deadline_ms)
         adaptive = None
         if self.adaptive_cap_ms > 0:
@@ -747,7 +884,7 @@ class DriverServer:
                 value_schedule="uniform", use_pump=self.use_pump,
                 admission=admission, adaptive=adaptive,
                 clients={self.n}, rv=self.rv, snap=self.snap,
-                kv=kv_shard,
+                kv=kv_shard, tenants=tenant_admission,
             )
             self.results[i] = driver.serve(
                 idle_ms=self.idle_ms, max_ms=self.max_ms,
@@ -797,6 +934,20 @@ class DriverServer:
             "txn_aborts": sum(st.get("kv_txn_aborts", 0)
                               for st in self.stats),
         }
+
+    def tenant_summary(self) -> Dict[str, Any]:
+        """Aggregate per-tenant shed accounting across this shard's
+        replicas (the fleet-autoscale soak rung gates shed_frames ==
+        nacks_sent + nacks_suppressed per tenant over exactly this)."""
+        by_tenant: Dict[int, Dict[str, int]] = {}
+        for st in self.stats:
+            for t, d in st.get("tenants", {}).items():
+                agg = by_tenant.setdefault(int(t), {})
+                for k, v in d.items():
+                    agg[k] = agg.get(k, 0) + v
+        return {"enabled": self.tenants is not None,
+                "weights": dict(self.tenants or {}),
+                "by_tenant": by_tenant}
 
     def snap_summary(self) -> Dict[str, Any]:
         """Aggregate snapshot status across this shard's replicas (the

@@ -105,6 +105,125 @@ class AdmissionControl:
         return not self.shedding
 
 
+class TenantAdmission:
+    """Per-tenant weighted-fair admission: AdmissionControl's watermark
+    arithmetic metered PER TENANT over the client intake queue
+    (docs/SERVING.md "per-tenant admission").
+
+    The driver-wide AdmissionControl budget cannot attribute pressure —
+    one hot tenant's backlog trips the shared watermark and the NACKs
+    land on everyone.  This meter namespaces the intake queue by the
+    tenant id each client frame carries (the Tag.call_stack byte, free
+    on FLAG_PROPOSE/FLAG_TXN/FLAG_READ — runtime/oob.py) and gives each
+    tenant its own watermark pair over its own queued bytes:
+
+        share_t = live_lanes × bytes_per_lane × w_t / Σw
+
+    with the same high/low hysteresis as the global meter.  A tenant at
+    3× its weighted share sheds against its OWN budget; a tenant inside
+    its share is never shed by a neighbour's backlog (pinned by
+    tests/test_control.py and the fleet-autoscale soak rung).  Under
+    driver-wide ``backpressure`` (the global meter tripped, or the
+    native inbox watermark), only tenants already ABOVE their low
+    watermark join the shed — an in-envelope tenant keeps admitting.
+
+    Admission ORDER is deficit-weighted round-robin: ``next_tenant``
+    picks the queued, non-shedding tenant with the lowest
+    weight-normalized admit count, so lane slots divide in weight
+    proportion when several tenants contend.
+
+    Like AdmissionControl, deliberately DUMB: no wall clock inside —
+    the driver stamps ``shed_started`` per tenant and owns the
+    deadline-shed policy."""
+
+    __slots__ = ("bytes_per_lane", "low_frac", "shed_deadline_ms",
+                 "weights", "default_weight", "shedding", "shed_started",
+                 "sheds", "_admitted", "_share")
+
+    def __init__(self, bytes_per_lane: int = 64 << 10,
+                 weights: Optional[Dict[int, float]] = None,
+                 low_frac: float = 0.5, shed_deadline_ms: int = 2000,
+                 default_weight: float = 1.0):
+        if bytes_per_lane <= 0:
+            raise ValueError("bytes_per_lane must be > 0")
+        if not 0.0 < low_frac < 1.0:
+            raise ValueError(f"low_frac must be in (0, 1), got {low_frac}")
+        if default_weight <= 0:
+            raise ValueError("default_weight must be > 0")
+        self.bytes_per_lane = bytes_per_lane
+        self.low_frac = low_frac
+        self.shed_deadline_ms = shed_deadline_ms
+        self.weights: Dict[int, float] = dict(weights or {})
+        for t, w in self.weights.items():
+            if w <= 0:
+                raise ValueError(f"tenant {t} weight must be > 0, got {w}")
+        self.default_weight = default_weight
+        self.shedding: Dict[int, bool] = {}
+        self.shed_started: Dict[int, float] = {}  # driver-stamped
+        self.sheds = 0
+        self._admitted: Dict[int, int] = {}
+        self._share: Dict[int, int] = {}
+
+    def weight(self, tenant: int) -> float:
+        return float(self.weights.get(tenant, self.default_weight))
+
+    def share_bytes(self, tenant: int, live_lanes: int,
+                    present=None) -> int:
+        """This tenant's high-watermark byte share of the intake budget
+        (``present`` = the tenants sharing it; configured ∪ queued)."""
+        if present is None:
+            present = set(self.weights) | {tenant}
+        total = max(1, live_lanes) * self.bytes_per_lane
+        wsum = sum(self.weight(t) for t in present) or 1.0
+        return max(1, int(total * self.weight(tenant) / wsum))
+
+    def update(self, live_lanes: int, queued_by_tenant: Dict[int, int],
+               backpressure: bool = False) -> set:
+        """Re-evaluate every tenant's watermark; returns the set of
+        shedding tenants.  Pure arithmetic, same hysteresis discipline
+        as AdmissionControl.update."""
+        present = set(self.weights) | set(queued_by_tenant)
+        out = set()
+        for t in sorted(present):
+            q = int(queued_by_tenant.get(t, 0))
+            high = self.share_bytes(t, live_lanes, present)
+            low = int(high * self.low_frac)
+            now = (q > low) if self.shedding.get(t, False) else (q >= high)
+            if backpressure and q > low:
+                # global pressure attributes to the tenants already over
+                # their low watermark; an in-envelope tenant never sheds
+                # for a neighbour's backlog
+                now = True
+            self.shedding[t] = now
+            self._share[t] = high
+            if now:
+                out.add(t)
+            else:
+                self.shed_started.pop(t, None)
+        return out
+
+    def is_shedding(self, tenant: int) -> bool:
+        return self.shedding.get(tenant, False)
+
+    def next_tenant(self, queued_tenants) -> Optional[int]:
+        """Deficit-weighted round-robin pick: the non-shedding queued
+        tenant with the lowest weight-normalized admit count (ties break
+        on the lower tenant id, deterministically).  None = every queued
+        tenant is shedding (the caller defers)."""
+        best = None
+        best_c = None
+        for t in sorted(queued_tenants):
+            if self.is_shedding(t):
+                continue
+            c = self._admitted.get(t, 0) / self.weight(t)
+            if best_c is None or c < best_c:
+                best, best_c = t, c
+        return best
+
+    def note_admit(self, tenant: int) -> None:
+        self._admitted[tenant] = self._admitted.get(tenant, 0) + 1
+
+
 class LaneTable:
     """Slot table mapping live instance ids onto lane indices — the
     dispatcher role of InstanceMux (InstanceDispatcher.scala:84-89) turned

@@ -116,6 +116,15 @@ _C_NACKS_SUPP = METRICS.counter("overload.nacks_suppressed")
 _C_NACKS_SEEN = METRICS.counter("overload.nacks_seen")
 _G_QUEUED = METRICS.gauge("overload.queued_bytes")
 _G_SHEDDING = METRICS.gauge("overload.shedding")
+# per-tenant overload vocabulary (docs/SERVING.md "per-tenant
+# admission"): the same shed accounting NAMESPACED by the tenant id a
+# client frame carries in Tag.call_stack — the fleet-autoscale soak rung
+# gates shed_frames == nacks_sent + nacks_suppressed PER TENANT
+_C_T_SHED_FRAMES = METRICS.counter("tenant.shed_frames")
+_C_T_SHED_INSTANCES = METRICS.counter("tenant.shed_instances")
+_C_T_NACKS_SENT = METRICS.counter("tenant.nacks_sent")
+_C_T_NACKS_SUPP = METRICS.counter("tenant.nacks_suppressed")
+_G_T_SHEDDING = METRICS.gauge("tenant.shedding")
 
 _STASH_CAP = 4096  # same eviction discipline as InstanceMux._STASH_CAP
 _DONE_CAP = 8192   # client-serving decision-bank cap (_retire_lane)
@@ -209,6 +218,92 @@ class _ClassBox:
                 self.mask)
 
 
+class _IntakeQueue:
+    """Client-proposal intake, namespaced by tenant: one FIFO deque per
+    tenant plus a global arrival sequence.  The tenant-blind pop
+    (``pop_fifo``) follows strict arrival order across every deque —
+    byte-identical scheduling to the single pre-tenant deque — while the
+    weighted-fair path pops one tenant's head in O(1) and meters queued
+    BYTES per tenant, the unit TenantAdmission's watermark arithmetic
+    runs in (runtime/instances.py, docs/SERVING.md)."""
+
+    __slots__ = ("_q", "_bytes", "_len", "_seq")
+
+    def __init__(self):
+        self._q: Dict[int, collections.deque] = {}
+        self._bytes: Dict[int, int] = {}
+        self._len = 0
+        self._seq = 0
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __bool__(self) -> bool:
+        return self._len > 0
+
+    def push(self, tenant: int, iid: int, io, sender: int,
+             nbytes: int) -> None:
+        q = self._q.get(tenant)
+        if q is None:
+            q = self._q[tenant] = collections.deque()
+        q.append((self._seq, iid, io, sender, nbytes))
+        self._seq += 1
+        self._bytes[tenant] = self._bytes.get(tenant, 0) + int(nbytes)
+        self._len += 1
+
+    def append(self, item) -> None:
+        """Legacy single-deque surface — a 3-tuple (iid, io, sender)
+        lands in the tenant-0 deque (tests drive the admission path
+        through this)."""
+        iid, io, sender = item
+        arr = io.get("initial_value") if isinstance(io, dict) else None
+        self.push(0, iid, io, sender, int(getattr(arr, "nbytes", 0)))
+
+    def bytes_by_tenant(self) -> Dict[int, int]:
+        return dict(self._bytes)
+
+    def tenants_queued(self) -> List[int]:
+        return [t for t, q in self._q.items() if q]
+
+    def queued(self, tenant: int) -> int:
+        q = self._q.get(tenant)
+        return len(q) if q else 0
+
+    def _pop(self, tenant: int):
+        q = self._q[tenant]
+        _seq, iid, io, sender, nb = q.popleft()
+        b = self._bytes.get(tenant, 0) - nb
+        self._bytes[tenant] = b if b > 0 else 0
+        if not q:
+            del self._q[tenant]
+            self._bytes.pop(tenant, None)
+        self._len -= 1
+        return iid, io, sender
+
+    def pop_tenant(self, tenant: int):
+        return self._pop(tenant)
+
+    def pop_fifo(self):
+        best_t = None
+        best_seq = None
+        for t, q in self._q.items():
+            if q and (best_seq is None or q[0][0] < best_seq):
+                best_t, best_seq = t, q[0][0]
+        return (best_t,) + self._pop(best_t)
+
+    def drain_tenant(self, tenant: int):
+        out = []
+        while self._q.get(tenant):
+            out.append(self._pop(tenant))
+        return out
+
+    def items(self):
+        """(iid, io, sender) over every queued proposal, any order."""
+        for q in self._q.values():
+            for _seq, iid, io, sender, _nb in q:
+                yield iid, io, sender
+
+
 class LaneDriver:
     """Drive up to ``lanes`` concurrent consensus instances of ONE replica
     as lanes of the engine's batch axis (module docstring).  The driver is
@@ -239,6 +334,7 @@ class LaneDriver:
         rv=None,
         snap=None,
         kv=None,
+        tenants=None,
     ):
         if wire not in ("binary", "pickle"):
             raise ValueError(f"wire must be 'binary' or 'pickle', "
@@ -379,7 +475,14 @@ class LaneDriver:
         # accounted FLAG_NACK while shedding.  Empty set = the
         # pre-fleet driver, byte-identical behavior.
         self._clients = frozenset(clients or ())
-        self._proposals: collections.deque = collections.deque()
+        # per-tenant weighted-fair admission (instances.TenantAdmission,
+        # docs/SERVING.md "per-tenant admission"): client frames carry a
+        # tenant id in Tag.call_stack; None = the tenant-blind driver,
+        # byte-identical pre-tenant behavior (every frame lands in the
+        # tenant-0 deque and pops in strict arrival order)
+        self._tenants = tenants
+        self._tenant_stats: Dict[int, Dict[str, int]] = {}
+        self._proposals = _IntakeQueue()
         self._proposed: set = set()
         self._client_of: Dict[int, int] = {}
         self._subscribers: set = set()
@@ -672,18 +775,38 @@ class LaneDriver:
                       self.id, len(raw), e)
             return False, None
 
-    def _shed_frame(self, sender: int, iid: int) -> None:
+    def _shed_frame(self, sender: int, iid: int,
+                    tenant: Optional[int] = None) -> None:
         """Refuse one future-instance frame under load shedding: counted,
         and answered with a rate-limited FLAG_NACK so the sender can tell
         a shed from wire loss.  Accounting invariant (the host-overload
         soak rung gates it): every shed ticks exactly one of nacks_sent /
-        nacks_suppressed."""
+        nacks_suppressed.  Under per-tenant metering ``tenant`` is the
+        client frame's Tag.call_stack byte (None = unattributed — peer
+        sheds, or the tenant-blind driver) and the SAME invariant holds
+        per tenant: tenant.shed_frames == tenant.nacks_sent +
+        tenant.nacks_suppressed (the fleet-autoscale rung gates it); the
+        NACK reply echoes the tenant id in call_stack so the router can
+        attribute it without an inflight lookup."""
         self.shed_frames += 1
         _C_SHED_FRAMES.inc()
+        ts = None
+        if tenant is not None and self._tenants is not None:
+            ts = self._tenant_stats.setdefault(
+                tenant, {"shed_frames": 0, "shed_instances": 0,
+                         "nacks_sent": 0, "nacks_suppressed": 0})
+            ts["shed_frames"] += 1
+            _C_T_SHED_FRAMES.inc()
+            if TRACE.enabled:
+                TRACE.emit("tenant_shed", node=self.id, inst=iid,
+                           src=sender, tenant=tenant)
         now = _time.monotonic()
         if now - self._nacked.get((sender, iid), -1.0) <= 0.25:
             self.nacks_suppressed += 1
             _C_NACKS_SUPP.inc()
+            if ts is not None:
+                ts["nacks_suppressed"] += 1
+                _C_T_NACKS_SUPP.inc()
             return
         if len(self._nacked) >= 8192:
             # the rate-limit map must not become its own overload vector
@@ -691,9 +814,13 @@ class LaneDriver:
             # NACK survives to suppress its own repeats)
             self._nacked.clear()
         self._nacked[(sender, iid)] = now
-        self.transport.send(sender, Tag(instance=iid, flag=FLAG_NACK))
+        self.transport.send(sender, Tag(instance=iid, flag=FLAG_NACK,
+                                        call_stack=tenant or 0))
         self.nacks_sent += 1
         _C_NACKS_SENT.inc()
+        if ts is not None:
+            ts["nacks_sent"] += 1
+            _C_T_NACKS_SENT.inc()
         if TRACE.enabled:
             TRACE.emit("shed", node=self.id, inst=iid, src=sender)
 
@@ -749,9 +876,22 @@ class LaneDriver:
             return
         if self.table.lane_of(iid) is not None or iid in self._proposed:
             return  # running or queued: the retry is absorbed
+        # the tenant id rides the otherwise-free call_stack byte on the
+        # client verbs (runtime/oob.py); tenant-blind drivers fold every
+        # frame into tenant 0 so the intake pops strict arrival order
+        tenant = (tag.call_stack & 0xFF) if self._tenants is not None \
+            else 0
+        if self._tenants is not None \
+                and self._tenants.is_shedding(tenant):
+            # a hot tenant sheds against its OWN weighted share — before
+            # the driver-wide budget is even consulted
+            self._shed_frame(sender, iid, tenant=tenant)
+            return
         if ((self._admission is not None and self._admission.shedding)
                 or len(self._proposals) >= _STASH_CAP):
-            self._shed_frame(sender, iid)
+            self._shed_frame(
+                sender, iid,
+                tenant=tenant if self._tenants is not None else None)
             return
         ok, payload = self._loads(raw, sender)
         if not ok or payload is None:
@@ -785,7 +925,8 @@ class LaneDriver:
         if self._kv is not None:
             # register the write barrier for linearizable reads
             self._kv.note_propose(iid, arr)
-        self._proposals.append((iid, {"initial_value": arr}, sender))
+        self._proposals.push(tenant, iid, {"initial_value": arr}, sender,
+                             arr.nbytes)
         self._proposed.add(iid)
         self._client_of[iid] = sender
         self.client_proposals += 1
@@ -831,9 +972,16 @@ class LaneDriver:
         if _kvr.serve_read(self._kv, sender, req["r"], req["k"],
                            req["g"], self.transport):
             return
+        # linearizable reads cost a lane wave, so they shed per tenant
+        # too (the kv key space is tenant-namespaced by the client's key
+        # prefix; the read verb carries the tenant in call_stack)
+        r_tenant = (tag.call_stack & 0xFF) if self._tenants is not None \
+            else None
         if ((self._admission is not None and self._admission.shedding)
-                or len(self._kv_reads) >= _STASH_CAP):
-            self._shed_frame(sender, tag.instance)
+                or len(self._kv_reads) >= _STASH_CAP
+                or (r_tenant is not None
+                    and self._tenants.is_shedding(r_tenant))):
+            self._shed_frame(sender, tag.instance, tenant=r_tenant)
             return
         self._kv.reads_lin += 1
         _kvr.C_READS[_kvr.GRADE_LIN].inc()
@@ -1876,6 +2024,15 @@ class LaneDriver:
             stats_out[key] = stats_out.get(key, 0) + v
         stats_out.setdefault("timeout_trajectory", []).extend(
             self._trajectory)
+        if self._tenants is not None:
+            # per-tenant shed accounting, keyed by tenant id: the
+            # fleet-autoscale soak rung gates shed_frames ==
+            # nacks_sent + nacks_suppressed for EVERY tenant here
+            ten = stats_out.setdefault("tenants", {})
+            for t, d in self._tenant_stats.items():
+                agg = ten.setdefault(t, {})
+                for k, v in d.items():
+                    agg[k] = agg.get(k, 0) + v
         if self._health is not None:
             stats_out["quarantine"] = self._health.summary()
         if self._rv is not None:
@@ -2058,9 +2215,51 @@ class LaneDriver:
             # audit the tail (a final-cut halt raises from here)
             self._snap.flush(force=True)
 
+    def _tenant_instance_shed(self, tenant: int) -> None:
+        ts = self._tenant_stats.setdefault(
+            tenant, {"shed_frames": 0, "shed_instances": 0,
+                     "nacks_sent": 0, "nacks_suppressed": 0})
+        ts["shed_instances"] += 1
+        _C_T_SHED_INSTANCES.inc()
+
+    def _tenant_update(self) -> None:
+        """Re-evaluate the per-tenant watermarks over each tenant's
+        queued intake bytes, and deadline-shed a tenant that stayed over
+        its share: ONLY that tenant's backlog drains — its neighbours
+        keep admitting (the weighted-fair isolation contract; contrast
+        the global deadline shed below, which drains everything)."""
+        shedding = self._tenants.update(
+            self.table.width, self._proposals.bytes_by_tenant(),
+            backpressure=(self._admission is not None
+                          and self._admission.shedding))
+        _G_T_SHEDDING.set(len(shedding))
+        now = _time.monotonic()
+        for t in sorted(shedding):
+            if not self._proposals.queued(t):
+                continue
+            started = self._tenants.shed_started.get(t)
+            if started is None:
+                self._tenants.shed_started[t] = now
+            elif (now - started) * 1000.0 \
+                    >= self._tenants.shed_deadline_ms:
+                for iid, _io, sender in self._proposals.drain_tenant(t):
+                    self._proposed.discard(iid)
+                    self._client_of.pop(iid, None)
+                    self.shed_instances += 1
+                    self._tenants.sheds += 1
+                    _C_SHED_INSTANCES.inc()
+                    self._tenant_instance_shed(t)
+                    self._shed_frame(sender, iid, tenant=t)
+                _G_CLIENT_QUEUE.set(len(self._proposals))
+
     def _admit_proposals(self) -> None:
         """Admit queued client proposals into free lanes, under the same
-        admission defer/shed discipline as the scheduled loop."""
+        admission defer/shed discipline as the scheduled loop.  With
+        per-tenant metering (TenantAdmission) the admission ORDER is
+        deficit-weighted round-robin across non-shedding tenants, so
+        lane slots divide in weight proportion when tenants contend."""
+        if self._tenants is not None:
+            self._tenant_update()
         while self._proposals and self.table.can_admit():
             if self._admission is not None \
                     and not self._admission.admit_ok():
@@ -2076,17 +2275,30 @@ class LaneDriver:
                     # client's cue to back off and retry) instead of
                     # aging in an unbounded queue
                     while self._proposals:
-                        iid, _io, sender = self._proposals.popleft()
+                        tenant, iid, _io, sender = \
+                            self._proposals.pop_fifo()
                         self._proposed.discard(iid)
                         self._client_of.pop(iid, None)
                         self.shed_instances += 1
                         self._admission.sheds += 1
                         _C_SHED_INSTANCES.inc()
-                        self._shed_frame(sender, iid)
+                        if self._tenants is not None:
+                            self._tenant_instance_shed(tenant)
+                            self._shed_frame(sender, iid, tenant=tenant)
+                        else:
+                            self._shed_frame(sender, iid)
                     _G_CLIENT_QUEUE.set(0)
                     self._admission_update()
                 return
-            iid, io, sender = self._proposals.popleft()
+            if self._tenants is not None:
+                t = self._tenants.next_tenant(
+                    self._proposals.tenants_queued())
+                if t is None:
+                    return  # every queued tenant over budget: defer
+                iid, io, sender = self._proposals.pop_tenant(t)
+                self._tenants.note_admit(t)
+            else:
+                _t, iid, io, sender = self._proposals.pop_fifo()
             self._proposed.discard(iid)
             _G_CLIENT_QUEUE.set(len(self._proposals))
             if iid in self._done \
@@ -2115,7 +2327,7 @@ class LaneDriver:
             self._kv.lease.revoke()
             self._kv_fail_reads()
         try:
-            for iid, _io, sender in list(self._proposals):
+            for iid, _io, sender in list(self._proposals.items()):
                 self.transport.send(
                     sender, Tag(instance=iid, flag=FLAG_TOO_LATE))
             for lane in np.nonzero(self._live)[0]:

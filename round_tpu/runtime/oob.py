@@ -129,7 +129,11 @@ class Tag:
     reference's — is REUSED by the view subsystem (runtime/view.py) to
     stamp the sender's view epoch (mod 256) onto every NORMAL frame, so a
     replica still running an old view is detected from its very first
-    packet and answered with a FLAG_VIEW catch-up."""
+    packet and answered with a FLAG_VIEW catch-up.  On the CLIENT verbs
+    (FLAG_PROPOSE / FLAG_TXN / FLAG_READ / FLAG_NACK) the byte is free —
+    no epoch rides there — and carries the TENANT id (0-255) for
+    per-tenant weighted-fair admission (runtime/instances.py
+    TenantAdmission, docs/SERVING.md): zero wire-format change."""
 
     instance: int
     round: int = 0

@@ -1,0 +1,369 @@
+"""Planet-scale control plane (runtime/control.py + the two-level ring
+and per-tenant admission it steers) — the PR-20 suite.
+
+The control-plane contract (ISSUE 20 / docs/SERVING.md), pinned here:
+
+  * TenantAdmission arithmetic: weighted shares, the same high/low
+    hysteresis as the global meter, the STRICT backpressure rule (an
+    in-envelope tenant never sheds for a neighbour's backlog), and
+    deficit-weighted round-robin admission order;
+  * TwoLevelRing: multi-region balance, rebalance motion LOCAL to one
+    region by construction, and byte-identical placement to the flat
+    ShardMap with a single region (every pre-region test and banked
+    artifact stays valid);
+  * supervisor resize mid-blast: a licensed grow lands a freshly
+    spawned shard in the ring with the fleet's decision log
+    BYTE-IDENTICAL to an unresized control; a licensed shrink migrates
+    the victim's in-flight instances over idempotent-PROPOSE — zero
+    decision loss either way;
+  * an UNLICENSED resize is refused: no ring change, no spawn, the
+    denial banked as a decision and surfaced (`autoscale_refused` /
+    `view.refused`) — never a silent move;
+  * tenant isolation end-to-end: a tenant flooding far past its
+    weighted share sheds against its OWN budget while an in-envelope
+    tenant at equal weight is never NACKed, and the per-tenant shed
+    accounting invariant holds on the serving side.
+
+Heavy autoscale trajectory runs ride the fleet-autoscale soak rung and
+``apps/fleet.py autoscale`` (tier-1 budget discipline).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+
+import pytest
+
+from round_tpu.apps.loadgen import payload_value, plan_tenant_arrivals
+from round_tpu.apps.selector import select
+from round_tpu.runtime.control import FleetSupervisor
+from round_tpu.runtime.fleet import (
+    DriverServer, FleetRouter, ShardMap, TwoLevelRing,
+)
+from round_tpu.runtime.instances import TenantAdmission
+from round_tpu.rv.license import ProofLicenseRegistry
+
+
+@functools.lru_cache(maxsize=None)
+def _algo(name: str, payload_bytes: int = 0):
+    return select(name, {"payload_bytes": payload_bytes}
+                  if payload_bytes else {})
+
+
+def _scripted_registry(proved: bool) -> ProofLicenseRegistry:
+    """A license registry with a scripted prover verdict: the envelope
+    arithmetic stays REAL (n vs 'n > Kf'), only the solver call is
+    replaced — tier-1 never waits on z3."""
+    return ProofLicenseRegistry(
+        prover=lambda suite, cache_dir, solve: (proved, True))
+
+
+# ---------------------------------------------------------------------------
+# TenantAdmission arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_shares_follow_weights():
+    ta = TenantAdmission(bytes_per_lane=1000,
+                         weights={1: 1.0, 2: 3.0})
+    present = {1, 2}
+    s1 = ta.share_bytes(1, live_lanes=4, present=present)
+    s2 = ta.share_bytes(2, live_lanes=4, present=present)
+    assert s1 == 1000  # 4 * 1000 * 1/4
+    assert s2 == 3000  # 4 * 1000 * 3/4
+    # an unconfigured tenant rides the default weight and dilutes the
+    # pool it joins
+    s1b = ta.share_bytes(1, live_lanes=4, present={1, 2, 9})
+    assert s1b == 800  # 4000 * 1/5
+    with pytest.raises(ValueError):
+        TenantAdmission(bytes_per_lane=0)
+    with pytest.raises(ValueError):
+        TenantAdmission(weights={1: -1.0})
+    with pytest.raises(ValueError):
+        TenantAdmission(low_frac=1.0)
+
+
+def test_tenant_hysteresis_and_strict_backpressure():
+    ta = TenantAdmission(bytes_per_lane=1000, weights={1: 1.0, 2: 1.0},
+                         low_frac=0.5)
+    # share per tenant: 2 lanes * 1000 / 2 = 1000 high, 500 low
+    shed = ta.update(2, {1: 999, 2: 100})
+    assert shed == set()
+    shed = ta.update(2, {1: 1000, 2: 100})
+    assert shed == {1}
+    # hysteresis: once shedding, only dropping TO the low watermark
+    # clears it (q > low keeps shedding)
+    assert ta.update(2, {1: 501, 2: 100}) == {1}
+    assert ta.update(2, {1: 500, 2: 100}) == set()
+    # STRICT backpressure rule: global pressure attributes only to
+    # tenants strictly over their low watermark — tenant 2 at exactly
+    # low (500) keeps admitting, tenant 1 just above it sheds
+    shed = ta.update(2, {1: 501, 2: 500}, backpressure=True)
+    assert shed == {1}
+
+
+def test_tenant_next_is_deficit_weighted():
+    ta = TenantAdmission(bytes_per_lane=1000, weights={1: 1.0, 2: 3.0})
+    ta.update(4, {1: 10, 2: 10})
+    picks = []
+    for _ in range(8):
+        t = ta.next_tenant([1, 2])
+        picks.append(t)
+        ta.note_admit(t)
+    # weight 3 tenant gets ~3 of every 4 slots; ties break low-id
+    assert picks.count(2) == 6 and picks.count(1) == 2
+    # a shedding tenant is skipped; all-shedding defers
+    ta.shedding[2] = True
+    assert ta.next_tenant([1, 2]) == 1
+    ta.shedding[1] = True
+    assert ta.next_tenant([1, 2]) is None
+
+
+# ---------------------------------------------------------------------------
+# TwoLevelRing
+# ---------------------------------------------------------------------------
+
+
+def test_two_level_ring_flat_equivalence_single_region():
+    flat = ShardMap(["s0", "s1", "s2"])
+    ring = TwoLevelRing()
+    for s in ("s0", "s1", "s2"):
+        ring.add(s)
+    assert all(ring.owner(k) == flat.owner(k) for k in range(1, 2001))
+    keys = [b"k%d" % i for i in range(512)]
+    assert all(ring.owner_key(k) == flat.owner_key(k) for k in keys)
+
+
+def test_two_level_ring_balance_and_local_motion():
+    ring = TwoLevelRing()
+    for i in range(4):
+        ring.add(f"s{i}", region=f"r{i % 2}")
+    assert ring.regions == ["r0", "r1"]
+    assert ring.region_of("s3") == "r1"
+    keys = list(range(1, 4001))
+    owners = {k: ring.owner(k) for k in keys}
+    share = {s: sum(1 for o in owners.values() if o == s)
+             for s in ring.shards}
+    assert min(share.values()) > 0  # every shard owns a real arc
+    # motion is LOCAL: removing an r0 shard cannot move any key that
+    # lived in r1 — the outer ring did not change
+    ring.remove("s2")
+    for k in keys:
+        if owners[k] == "s2":
+            assert ring.owner(k) != "s2"
+        else:
+            # r1 keys CANNOT move (outer ring unchanged); r0's
+            # surviving shard keeps its keys too (inner minimal motion)
+            assert ring.owner(k) == owners[k]
+    # removing a region's last shard drops its outer arc entirely
+    ring.remove("s0")
+    assert ring.regions == ["r1"]
+    with pytest.raises(ValueError):
+        ring.add("s1", region="r1")
+    with pytest.raises(ValueError):
+        TwoLevelRing().owner(1)
+
+
+def test_plan_tenant_arrivals_disjoint_ids():
+    ring = ShardMap(["s0", "s1"])
+    specs = [{"tenant": 1, "rate": 50.0, "instances": 30},
+             {"tenant": 2, "rate": 50.0, "instances": 30, "skew": 1.2}]
+    plan = plan_tenant_arrivals(specs, seed=0, ring=ring, start_id=10)
+    assert len(plan) == 60
+    ids1 = {p["inst"] for p in plan if p["tenant"] == 1}
+    ids2 = {p["inst"] for p in plan if p["tenant"] == 2}
+    assert not ids1 & ids2  # disjoint id ranges per tenant
+    assert min(ids1 | ids2) >= 10
+    assert [p["t"] for p in plan] == sorted(p["t"] for p in plan)
+    with pytest.raises(ValueError):
+        plan_tenant_arrivals([{"tenant": 300, "rate": 1,
+                               "instances": 1}], 0, ring)
+
+
+# ---------------------------------------------------------------------------
+# supervisor resize mid-blast (in-process fleets)
+# ---------------------------------------------------------------------------
+
+
+def _sup_fleet(initial, registry, max_shards=3, lanes=8):
+    """One in-process fleet + a supervisor that can spawn more of it."""
+    servers = {}
+    router = FleetRouter()
+
+    def spawn(name):
+        srv = DriverServer(_algo("lv"), n=3, lanes=lanes,
+                           timeout_ms=1500, idle_ms=60_000)
+        servers[name] = srv
+        return srv.start()
+
+    def retire(name):
+        servers[name].stop()
+
+    for name in initial:
+        router.add_shard(name, spawn(name))
+    sup = FleetSupervisor(
+        router, algo_name="lv", n=3, spawn=spawn, retire=retire,
+        min_shards=1, max_shards=max_shards, license_registry=registry)
+    return servers, router, sup
+
+
+def _shutdown(servers, router):
+    for srv in servers.values():
+        srv.stop()
+    for srv in servers.values():
+        srv.join(60)
+    router.close()
+
+
+def _log_bytes(router):
+    return json.dumps(sorted(router.results.items())).encode()
+
+
+def test_supervisor_grow_midblast_byte_identical_log():
+    K = 12
+    # control: the post-resize fleet shape from the start, no resize
+    servers_c, router_c, _sup = _sup_fleet(
+        ["s0"], _scripted_registry(True))
+    try:
+        _sup.grow("manual")  # a0 joins BEFORE any traffic
+        for i in range(1, K + 1):
+            router_c.propose(i, 70 + i)
+        assert router_c.drain(90)
+        control = _log_bytes(router_c)
+    finally:
+        _shutdown(servers_c, router_c)
+
+    servers, router, sup = _sup_fleet(["s0"], _scripted_registry(True))
+    try:
+        for i in range(1, K // 2 + 1):
+            router.propose(i, 70 + i)
+        dec = sup.grow("manual")  # resize MID-BLAST, half in flight
+        assert dec["action"] == "grow" and dec["shard"] == "a0"
+        assert dec["license"]["status"] == "licensed"
+        assert router.ring.shards == ["a0", "s0"]
+        for i in range(K // 2 + 1, K + 1):
+            router.propose(i, 70 + i)
+        assert router.drain(90)
+        assert _log_bytes(router) == control  # zero loss, same values
+        assert sup.grows == 1 and sup.refused == 0
+    finally:
+        _shutdown(servers, router)
+
+
+def test_supervisor_shrink_migrates_inflight_zero_loss():
+    K = 10
+    servers, router, sup = _sup_fleet(["s0"], _scripted_registry(True))
+    try:
+        sup.grow("manual")
+        for i in range(1, K + 1):
+            router.propose(i, 500 + i)
+        # retire the spawned shard while its instances are in flight:
+        # remove_shard re-proposes them idempotently to the survivor
+        dec = sup.shrink("manual")
+        assert dec["action"] == "shrink" and dec["shard"] == "a0"
+        assert router.ring.shards == ["s0"]
+        assert router.drain(90)
+        assert router.results == {i: 500 + i for i in range(1, K + 1)}
+        assert router.give_ups == 0
+        assert sup.shrinks == 1
+    finally:
+        _shutdown(servers, router)
+
+
+def test_unlicensed_resize_refused_no_ring_change():
+    servers, router, sup = _sup_fleet(["s0"], _scripted_registry(False))
+    spawned_before = dict(servers)
+    try:
+        dec = sup.grow("manual")
+        assert dec["action"] == "refused"
+        assert dec["license"]["status"] == "unlicensed"
+        assert router.ring.shards == ["s0"]     # no ring change
+        assert list(servers) == list(spawned_before)  # no spawn either
+        assert sup.refused == 1 and sup.grows == 0
+        assert sup.decisions[-1] is dec
+        # the fleet keeps serving through the refusal
+        router.propose(1, 9001)
+        assert router.drain(60) and router.results[1] == 9001
+    finally:
+        _shutdown(servers, router)
+
+
+def test_outside_envelope_refusal_is_real_arithmetic():
+    # no scripted prover here: otr's 'n > 3f' envelope admits no fault
+    # at n=3, so the REAL registry refuses before ever consulting z3
+    lic = ProofLicenseRegistry().check("otr", 3)
+    assert not lic.ok and lic.status == "outside-envelope"
+
+
+# ---------------------------------------------------------------------------
+# tenant isolation end-to-end (the acceptance pin)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # ~50 s of real shed traffic; the weighted-fair
+# arithmetic is pinned tier-1 above and the fleet-autoscale soak rung
+# gates the same isolation end-to-end every rotation
+def test_hot_tenant_sheds_against_own_budget_not_neighbours():
+    PAY = 1024
+    srv = DriverServer(_algo("lvb", PAY), n=3, lanes=4,
+                       timeout_ms=1500, idle_ms=60_000,
+                       tenants={1: 1.0, 2: 1.0},
+                       tenant_bytes_per_lane=2 * PAY)
+    router = FleetRouter()
+    try:
+        router.add_shard("s0", srv.start())
+        # the HOT tenant: 40 KiB offered at once against a ~4 KiB share
+        hot = list(range(100, 140))
+        for i in hot:
+            router.propose(i, payload_value(i, PAY), tenant=1)
+        # the in-envelope tenant: never more than one outstanding
+        polite = list(range(1, 7))
+        import time as _t
+        for i in polite:
+            router.propose(i, payload_value(i, PAY), tenant=2)
+            t_end = _t.monotonic() + 60
+            while router.results.get(i) is None \
+                    and _t.monotonic() < t_end:
+                router.pump(20)
+            assert router.results.get(i) is not None
+        router.drain(120)
+        # isolation: every polite request decided, ZERO NACKs charged
+        # to tenant 2 — the hot tenant shed against its own budget
+        assert router.tenant_nacks.get(2, 0) == 0
+        assert router.tenant_give_ups.get(2, 0) == 0
+        for i in polite:
+            assert router.results[i] is not None
+        assert router.tenant_nacks.get(1, 0) > 0  # the hot one paid
+        # per-tenant shed accounting holds on the serving side (replica
+        # stats fill at exit — the serve_main summary discipline)
+        srv.stop()
+        srv.join(60)
+        summary = srv.tenant_summary()
+        assert summary["enabled"]
+        by = summary["by_tenant"]
+        for tid, st in by.items():
+            assert st["shed_frames"] == (st["nacks_sent"]
+                                         + st["nacks_suppressed"]), tid
+        assert by[1]["shed_frames"] > 0
+        assert by.get(2, {}).get("shed_frames", 0) == 0
+    finally:
+        srv.stop()
+        srv.join(60)
+        router.close()
+
+
+def test_kv_client_tenant_namespaces_key_space():
+    """A nonzero-tenant KV session prefixes every data key with its
+    tenant slice (sessions cannot collide across tenants), tenant 0 is
+    the raw legacy key space, and the id is bounded by the wire byte."""
+    from round_tpu.kv.client import KVClient
+
+    class _R:  # KVClient's ctor only installs the read callbacks
+        pass
+
+    c = KVClient(_R(), tenant=7)
+    assert c._ns(b"user:42") == b"t7/user:42"
+    assert KVClient(_R(), tenant=0)._ns(b"user:42") == b"user:42"
+    with pytest.raises(ValueError):
+        KVClient(_R(), tenant=256)

@@ -514,7 +514,7 @@ class _FakeReadRouter:
     def shard_n(self, shard):
         return 3
 
-    def send_read(self, shard, replica, rid, payload):
+    def send_read(self, shard, replica, rid, payload, tenant=0):
         self.sent.append((shard, replica, rid))
 
     def pump(self, timeout_ms=0):

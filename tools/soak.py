@@ -1026,6 +1026,96 @@ def check_host_fleet(rng, it):
     return cfg
 
 
+def check_fleet_autoscale(rng, it):
+    """The fleet-autoscale rotation rung (ISSUE 20): the model-driven
+    control plane (runtime/control.py FleetSupervisor) closing the
+    capacity loop LIVE over an in-process fleet, offered load swept
+    0.3x -> 2x of the fitted knee for the minimum fleet, with a
+    3x-weight hot tenant and an in-envelope tenant riding the same
+    router through weighted-fair admission.  Banked per iteration: the
+    full resize-decision trajectory (signals, model verdict, license
+    verdict per decision), p99-vs-SLO per point, per-tenant
+    offered-vs-achieved.  Gates:
+
+      * the supervisor must ACT — at 2x the knee the model's headroom
+        rule deterministically demands growth, so zero banked resize
+        decisions means the control loop is dead;
+      * never ``slo_met_by_shedding``: a point that holds the SLO while
+        the router eats NACK-retries/give-ups AND the model says
+        capacity existed at a fleet size the supervisor never reached
+        means the controller shed instead of scaling — the exact
+        failure this PR exists to prevent;
+      * the per-tenant PR-10 invariant on the serving side:
+        shed_frames == nacks_sent + nacks_suppressed for EVERY tenant;
+      * tenant isolation: the in-envelope tenant (offered UNDER its
+        weighted share) is never NACKed, at any point of the sweep;
+      * in-envelope points (multiplier <= 1) stay within the SLO.
+
+    ~2-3 min per iteration (in-process; the license is pre-warmed by
+    the bench outside the measured windows)."""
+    from round_tpu.apps.fleet import run_autoscale_bench
+
+    seed = int(rng.integers(0, 2**31))
+    tenants = [
+        {"tenant": 1, "weight": 3.0, "frac": 0.8},   # hot, 3x share
+        {"tenant": 2, "weight": 1.0, "frac": 0.2},   # in-envelope
+    ]
+    rep = run_autoscale_bench(
+        algo="lvb", n=3, lanes=8, payload_bytes=1024, seed=seed,
+        min_shards=1, max_shards=3, multipliers=[0.3, 1.0, 2.0],
+        point_s=4.0, slo_ms=8000.0, regions=2, tenants=tenants,
+        deadline_s=45.0, warmup=8)
+    sup = rep["supervisor"]
+    cfg = dict(kind="fleet-autoscale", it=it, seed=seed,
+               base_knee_dps=rep["base_knee_dps"],
+               grows=sup["grows"], shrinks=sup["shrinks"],
+               refused=sup["refused"], knee_drifts=sup["knee_drifts"],
+               shards_at_end=sup["shards"],
+               decisions=sup["decisions"],
+               license_prewarm=rep["license_prewarm"]["status"],
+               points=[{k: p.get(k) for k in
+                        ("multiplier", "offered_dps", "drivers_at_end",
+                         "within_slo", "slo_met_by_shedding", "decided",
+                         "instances", "tenants")}
+                       for p in rep["points"]],
+               tenant_stats=rep.get("tenant_stats"),
+               live_samples=len(rep.get("live_samples", [])))
+    if rep["license_prewarm"]["status"] != "licensed":
+        return {**cfg, "fail": f"the resize license did not prove: "
+                               f"{rep['license_prewarm']['reason']} — "
+                               f"every grow would be refused"}
+    if not cfg["decisions"]:
+        return {**cfg, "fail": "zero resize decisions banked across a "
+                               "0.3x->2x knee sweep: the control loop "
+                               "never acted (2x the model knee must "
+                               "trip the headroom rule)"}
+    if rep["slo_met_by_shedding"]:
+        return {**cfg, "fail": "SLO met by SHEDDING while the model "
+                               "says capacity existed at an unreached "
+                               "fleet size: the supervisor shed "
+                               "instead of scaling"}
+    if not rep.get("tenant_shed_accounting_ok", True):
+        return {**cfg, "fail": "per-tenant shed accounting broken on "
+                               "the serving side: shed_frames != "
+                               "nacks_sent + nacks_suppressed for some "
+                               "tenant"}
+    for p in rep["points"]:
+        t2 = p.get("tenants", {}).get(2)
+        if t2 and (t2["nacks"] > 0 or t2["give_ups"] > 0):
+            return {**cfg, "fail": f"in-envelope tenant NACKed at "
+                                   f"{p['multiplier']}x: the hot "
+                                   f"tenant's backlog leaked across "
+                                   f"the weighted-fair boundary "
+                                   f"({t2['nacks']} nacks, "
+                                   f"{t2['give_ups']} give-ups)"}
+        if p["multiplier"] <= 1.0 and not p["within_slo"]:
+            return {**cfg, "fail": f"in-envelope point "
+                                   f"{p['multiplier']}x fell out of "
+                                   f"the SLO: {p['decided']}/"
+                                   f"{p['instances']} decided"}
+    return cfg
+
+
 def check_host_kv(rng, it):
     """The host-kv rotation rung (ISSUE 18): the replicated KV store
     (round_tpu/kv, docs/KV.md) under its YCSB-style mixed workload on a
@@ -1373,7 +1463,8 @@ def main():
                 lambda r, i: check_host_perf(r, i, payload=True),
                 check_fuzz, check_verify_param, check_host_overload,
                 check_host_fleet, check_host_rv, check_byz_crosscheck,
-                check_multichip_ici, check_host_snap, check_host_kv]
+                check_multichip_ici, check_host_snap, check_host_kv,
+                check_fleet_autoscale]
     while time.monotonic() < t_end:
         check = rotation[it % len(rotation)]
         t0 = time.perf_counter()

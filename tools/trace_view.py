@@ -253,6 +253,43 @@ def rv_events(events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return sorted(out, key=lambda r: r["t"])
 
 
+def control_events(events: Sequence[Dict[str, Any]]
+                   ) -> List[Dict[str, Any]]:
+    """Control-plane activity on the merged timeline (runtime/control.py
+    FleetSupervisor + per-tenant admission, docs/SERVING.md): every
+    ``autoscale_grow`` / ``autoscale_shrink`` (which shard, which
+    region, fleet size after, why), every ``autoscale_refused``
+    (license denial — the resize that did NOT happen), and the
+    ``tenant_shed`` pressure per tenant, time-ordered."""
+    out: List[Dict[str, Any]] = []
+    shed_by_tenant: Dict[Any, int] = {}
+    for e in events:
+        ev = e.get("ev")
+        if ev in ("autoscale_grow", "autoscale_shrink"):
+            out.append({
+                "t": e.get("t", 0.0), "kind": ev,
+                "shard": e.get("shard"), "region": e.get("region"),
+                "shards": e.get("shards"),
+                "migrated": e.get("migrated"),
+                "reason": e.get("reason"),
+            })
+        elif ev == "autoscale_refused":
+            out.append({
+                "t": e.get("t", 0.0), "kind": ev,
+                "op": e.get("op"), "n": e.get("n"),
+                "status": e.get("status"), "reason": e.get("reason"),
+            })
+        elif ev == "tenant_shed":
+            shed_by_tenant[e.get("tenant")] = \
+                shed_by_tenant.get(e.get("tenant"), 0) + 1
+    resizes = sorted(out, key=lambda r: r["t"])
+    if shed_by_tenant:
+        resizes.append({"kind": "tenant_shed_totals",
+                        "by_tenant": {str(k): v for k, v in
+                                      sorted(shed_by_tenant.items())}})
+    return resizes
+
+
 def snap_events(events: Sequence[Dict[str, Any]]
                 ) -> Dict[str, Any]:
     """Round-consistent snapshot activity on the merged timeline
@@ -326,6 +363,7 @@ def report(paths: Sequence[str], show_timeline: bool = False,
     epochs = view_epochs(events)
     rv = rv_events(events)
     snap = snap_events(events)
+    control = control_events(events)
     if as_json:
         return json.dumps({
             "files": list(paths),
@@ -334,6 +372,7 @@ def report(paths: Sequence[str], show_timeline: bool = False,
             "view_epochs": epochs,
             "rv": rv,
             "snap": snap,
+            "control": control,
             "faults": {k: len(v) for k, v in corr.items()},
             "correlation": corr,
         }, indent=1)
@@ -373,6 +412,32 @@ def report(paths: Sequence[str], show_timeline: bool = False,
                     f"{r.get('reason')}")
         if len(rv) > max_listed:
             out.append(f"  ... {len(rv) - max_listed} more")
+    if control:
+        t0 = min(e["t"] for e in events if "t" in e)
+        out.append("")
+        out.append("## control plane (autoscale_grow / autoscale_shrink"
+                   " / autoscale_refused / tenant_shed)")
+        for c in control[:max_listed]:
+            if c["kind"] == "autoscale_refused":
+                out.append(
+                    f"  +{c['t'] - t0:8.3f}s REFUSED op={c.get('op')} "
+                    f"n={c.get('n')} [{c.get('status')}] "
+                    f"{c.get('reason')}")
+            elif c["kind"] == "tenant_shed_totals":
+                per = " ".join(f"t{k}:{v}" for k, v in
+                               c["by_tenant"].items())
+                out.append(f"  tenant sheds — {per}")
+            else:
+                mig = (f" migrated={c['migrated']}"
+                       if c.get("migrated") is not None else "")
+                out.append(
+                    f"  +{c['t'] - t0:8.3f}s "
+                    f"{c['kind'].replace('autoscale_', '').upper()} "
+                    f"{c.get('shard')} in {c.get('region')} -> "
+                    f"{c.get('shards')} shards{mig} "
+                    f"({c.get('reason')})")
+        if len(control) > max_listed:
+            out.append(f"  ... {len(control) - max_listed} more")
     if snap["samples_by_node"] or snap["cuts"] or snap["alerts"]:
         t0 = min(e["t"] for e in events if "t" in e)
         out.append("")
